@@ -1,0 +1,33 @@
+"""k8s_device_plugin_tpu — a TPU-native Kubernetes device plugin.
+
+A ground-up rebuild, for Cloud TPU nodes, of the capabilities of the
+reference GPU topology device plugin (gpucloud/k8s-device-plugin, mounted at
+/root/reference): per-node accelerator discovery, kubelet device-plugin gRPC
+service for the extended resource ``google.com/tpu``, interconnect-topology-
+aware multi-chip placement, device health tracking, and a cluster controller
+that reconciles real allocations onto pod annotations.
+
+Layer map (mirrors SURVEY.md §1; reference layer in parens):
+
+- ``discovery``  — TPU chip enumeration via C++ ``libtpuinfo`` / sysfs (L1;
+  replaces the NVML cgo binding, /root/reference/nvidia.go + vendored nvml).
+- ``topology``   — ICI mesh model + placement policy (L2;
+  /root/reference/topology.go, device.go, utils.go, hwloc).
+- ``server``     — DevicePlugin gRPC server + kubelet registration (L3;
+  /root/reference/server.go).
+- ``health``     — chip health watcher with recovery (L1/L3;
+  /root/reference/nvidia.go:51-102).
+- ``kube`` / ``controller`` — minimal Kubernetes client, pod informer,
+  kubelet-checkpoint reconciliation (L4; /root/reference/controller.go).
+- ``supervisor`` — process lifecycle, socket watcher, restart loop (L5;
+  /root/reference/main.go, watchers.go).
+- ``workload`` / ``parallel`` — the JAX side this plugin exists to enable: a
+  sharded smoke workload that validates allocated chips end-to-end
+  (jax.devices() → pjit step over a Mesh).
+
+The control plane is Python (this environment has no Go toolchain; the
+reference's is Go) and the hardware layer is native C++ (``native/tpuinfo``),
+mirroring the reference's Go-over-cgo split.
+"""
+
+__version__ = "0.1.0"

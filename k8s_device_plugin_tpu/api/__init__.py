@@ -1,0 +1,3 @@
+"""Device-plugin v1beta1 protocol: messages, constants, gRPC wiring."""
+from . import constants  # noqa: F401
+from . import deviceplugin_pb2 as pb  # noqa: F401

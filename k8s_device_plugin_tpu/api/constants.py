@@ -1,0 +1,43 @@
+"""Device-plugin protocol constants.
+
+Mirrors the behavioral constants of the reference
+(/root/reference/vendor/k8s.io/kubernetes/pkg/kubelet/apis/deviceplugin/v1beta1/constants.go:19-37
+and /root/reference/server.go:30-33) with TPU-native values.
+"""
+
+# Protocol version spoken over the Registration/DevicePlugin services.
+VERSION = "v1beta1"
+
+# Directory the kubelet serves its registration socket from and watches for
+# plugin sockets. Mounted into the DaemonSet pod via hostPath.
+DEVICE_PLUGIN_PATH = "/var/lib/kubelet/device-plugins/"
+
+# The kubelet's own registration socket (relative to DEVICE_PLUGIN_PATH).
+KUBELET_SOCKET_NAME = "kubelet.sock"
+KUBELET_SOCKET = DEVICE_PLUGIN_PATH + KUBELET_SOCKET_NAME
+
+# This plugin's socket (relative to DEVICE_PLUGIN_PATH).
+PLUGIN_SOCKET_NAME = "tpu.sock"
+
+# Extended resource advertised to the kubelet. The reference advertises
+# "nvidia.com/gpu-topo" (/root/reference/server.go:30); the TPU-native
+# resource follows GKE convention.
+RESOURCE_NAME = "google.com/tpu"
+
+# Device health states (kubelet contract).
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+# Kubelet device-manager checkpoint file (read-only to us); see
+# /root/reference/controller.go:184-197.
+KUBELET_CHECKPOINT = DEVICE_PLUGIN_PATH + "kubelet_internal_checkpoint"
+
+# Node/pod annotation carrying the node's ICI topology and per-pod real chip
+# assignments (the reference uses "nvidia.com/gpu-topo" for both,
+# /root/reference/server.go:296, /root/reference/controller.go:165).
+TOPOLOGY_ANNOTATION = "google.com/tpu-topology"
+POD_DEVICES_ANNOTATION = "google.com/tpu-devices"
+
+# Env var understood the same way as the reference's DP_DISABLE_HEALTHCHECKS
+# (/root/reference/server.go:32-33): "all" disables health watching.
+ENV_DISABLE_HEALTHCHECKS = "DP_DISABLE_HEALTHCHECKS"

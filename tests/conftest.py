@@ -1,0 +1,19 @@
+"""Test configuration.
+
+JAX-facing tests run on a virtual 8-device CPU mesh so multi-chip sharding is
+exercised without TPU hardware (the env vars must be set before jax is first
+imported anywhere in the process).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Make the repo root importable regardless of pytest invocation directory.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
