@@ -1,0 +1,108 @@
+"""TPU chip model and accelerator-type tables.
+
+The TPU-native analog of the reference's device model: where the reference
+carries rich per-GPU NVML state (/root/reference/vendor/.../nvml/nvml.go:201-266)
+and discovers interconnects dynamically, TPU host shapes are *fixed per
+accelerator generation*, so the model is a static table keyed by chip type
+(SURVEY.md §2.5, §5 "distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+GIB = 1024**3
+
+# PCI identity of Google TPU accelerators.
+GOOGLE_VENDOR_ID = 0x1AE0
+
+# device-id → chip generation (mirrors native/tpuinfo/tpuinfo.cc kModels;
+# best-effort — unknown ids still enumerate, and the supervisor can override
+# the type from the GKE node label cloud.google.com/gke-tpu-accelerator).
+DEVICE_ID_TO_TYPE = {
+    0x0027: "v2",
+    0x0056: "v3",
+    0x005E: "v4",
+    0x0062: "v5e",
+    0x0063: "v5p",
+    0x006F: "v6e",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class AcceleratorSpec:
+    """Fixed per-generation host shape and chip properties."""
+
+    chip_type: str
+    chips_per_host: int
+    # ICI shape of the chips *within one host*, x-fastest. For torus
+    # generations this is the host's block of the larger slice torus.
+    host_bounds: Tuple[int, int, int]
+    # Whether inter-host ICI wraps into a torus (v4/v5p 3D torus slices) or
+    # the mesh ends at the host/slice boundary (v2/v3/v5e/v6e).
+    torus: bool
+    hbm_bytes: int
+    cores_per_chip: int
+
+
+ACCELERATOR_SPECS = {
+    "v2": AcceleratorSpec("v2", 4, (2, 2, 1), False, 8 * GIB, 2),
+    "v3": AcceleratorSpec("v3", 4, (2, 2, 1), False, 16 * GIB, 2),
+    "v4": AcceleratorSpec("v4", 4, (2, 2, 1), True, 32 * GIB, 2),
+    "v5e": AcceleratorSpec("v5e", 8, (2, 4, 1), False, 16 * GIB, 1),
+    "v5p": AcceleratorSpec("v5p", 4, (2, 2, 1), True, 95 * GIB, 2),
+    "v6e": AcceleratorSpec("v6e", 8, (2, 4, 1), False, 32 * GIB, 1),
+}
+
+
+def spec_for(chip_type: str, chip_count: int = 0) -> AcceleratorSpec:
+    """Spec for a chip type; unknown types get a linear mesh of chip_count."""
+    if chip_type in ACCELERATOR_SPECS:
+        return ACCELERATOR_SPECS[chip_type]
+    n = max(chip_count, 1)
+    return AcceleratorSpec(chip_type or "unknown", n, (n, 1, 1), False, 0, 0)
+
+
+def parse_gke_accelerator_label(value: str) -> Optional[str]:
+    """Map a GKE node label like 'tpu-v5p-slice' / 'tpu-v5-lite-podslice' /
+    'tpu-v4-podslice' to a chip type."""
+    v = value.lower()
+    if "v5-lite" in v or "v5e" in v:
+        return "v5e"
+    for t in ("v6e", "v5p", "v4", "v3", "v2"):
+        if t in v:
+            return t
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuChip:
+    """One discovered TPU chip.
+
+    ``device_id_str`` is the kubelet-facing device ID. The reference uses
+    NVML UUIDs (/root/reference/nvidia.go:28); TPUs have no per-chip UUID, so
+    identity is synthesized from the PCI address (stable across reboots —
+    SURVEY.md §7 "hard parts"), falling back to the accel index.
+    """
+
+    index: int
+    dev_path: str
+    pci_addr: str
+    vendor_id: int
+    device_id: int
+    numa_node: int
+    chip_type: str
+    hbm_bytes: int
+    core_count: int
+
+    @property
+    def device_id_str(self) -> str:
+        if self.pci_addr:
+            return f"tpu-{self.pci_addr}"
+        return f"tpu-accel{self.index}"
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["id"] = self.device_id_str
+        return d

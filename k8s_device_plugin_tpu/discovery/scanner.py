@@ -1,0 +1,254 @@
+"""TPU chip discovery: native libtpuinfo via ctypes, pure-Python fallback.
+
+The TPU-native replacement for the reference's device enumeration
+(/root/reference/nvidia.go:20-49 over the NVML cgo binding). Both backends
+scan ``<sysfs>/class-style accel dir`` + ``<dev>`` and must return identical
+results (tests assert parity); the native path exists to mirror the
+reference's native split and to host future libtpu queries.
+
+Like the reference's "no NVML → block, don't crash" behavior
+(/root/reference/main.go:27-41), a missing accel class dir is a *normal*
+result (0 chips, CPU-only node), not an error.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+from typing import List, Optional
+
+from .chips import DEVICE_ID_TO_TYPE, GOOGLE_VENDOR_ID, TpuChip, spec_for
+
+log = logging.getLogger(__name__)
+
+DEFAULT_SYSFS_ACCEL = "/sys/class/accel"
+DEFAULT_DEV = "/dev"
+DEFAULT_NUMA_DIR = "/sys/devices/system/node"
+
+_TPUINFO_MAX_CHIPS = 16
+_PATH_LEN = 128
+_TYPE_LEN = 16
+
+
+class _CChip(ctypes.Structure):
+    # Mirrors tpuinfo_chip in native/tpuinfo/tpuinfo.h.
+    _fields_ = [
+        ("index", ctypes.c_int),
+        ("dev_path", ctypes.c_char * _PATH_LEN),
+        ("pci_addr", ctypes.c_char * (_TYPE_LEN + 16)),
+        ("vendor_id", ctypes.c_uint),
+        ("device_id", ctypes.c_uint),
+        ("numa_node", ctypes.c_int),
+        ("chip_type", ctypes.c_char * _TYPE_LEN),
+        ("hbm_bytes", ctypes.c_longlong),
+        ("core_count", ctypes.c_int),
+    ]
+
+
+def _default_lib_paths() -> List[str]:
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    return [
+        os.environ.get("TPUINFO_LIB", ""),
+        os.path.join(repo, "native", "tpuinfo", "build", "libtpuinfo.so"),
+        "libtpuinfo.so",
+    ]
+
+
+class NativeTpuInfo:
+    """ctypes binding over libtpuinfo.so (native/tpuinfo/)."""
+
+    def __init__(self, lib_path: Optional[str] = None):
+        paths = [lib_path] if lib_path else _default_lib_paths()
+        last_err: Optional[Exception] = None
+        self._lib = None
+        for p in paths:
+            if not p:
+                continue
+            try:
+                self._lib = ctypes.CDLL(p)
+                break
+            except OSError as e:  # try next candidate
+                last_err = e
+        if self._lib is None:
+            raise OSError(f"libtpuinfo.so not found: {last_err}")
+        self._lib.tpuinfo_scan.restype = ctypes.c_int
+        self._lib.tpuinfo_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(_CChip), ctypes.c_int,
+        ]
+        self._lib.tpuinfo_chip_health.restype = ctypes.c_int
+        self._lib.tpuinfo_chip_health.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        self._lib.tpuinfo_numa_node_count.restype = ctypes.c_int
+        self._lib.tpuinfo_numa_node_count.argtypes = [ctypes.c_char_p]
+        self._lib.tpuinfo_probe_libtpu.restype = ctypes.c_int
+        self._lib.tpuinfo_probe_libtpu.argtypes = [ctypes.c_char_p]
+        self._lib.tpuinfo_version.restype = ctypes.c_char_p
+
+    def version(self) -> str:
+        return self._lib.tpuinfo_version().decode()
+
+    def scan(self, sysfs_accel_dir: str, dev_dir: str) -> List[TpuChip]:
+        buf = (_CChip * _TPUINFO_MAX_CHIPS)()
+        n = self._lib.tpuinfo_scan(
+            sysfs_accel_dir.encode(), dev_dir.encode(), buf, _TPUINFO_MAX_CHIPS
+        )
+        if n < 0:
+            raise OSError(-n, f"tpuinfo_scan({sysfs_accel_dir}) failed")
+        chips = []
+        for i in range(min(n, _TPUINFO_MAX_CHIPS)):
+            c = buf[i]
+            chips.append(
+                TpuChip(
+                    index=c.index,
+                    dev_path=c.dev_path.decode(),
+                    pci_addr=c.pci_addr.decode(),
+                    vendor_id=c.vendor_id,
+                    device_id=c.device_id,
+                    numa_node=c.numa_node,
+                    chip_type=c.chip_type.decode(),
+                    hbm_bytes=c.hbm_bytes,
+                    core_count=c.core_count,
+                )
+            )
+        return chips
+
+    def chip_health(self, sysfs_accel_dir: str, dev_dir: str, index: int) -> bool:
+        r = self._lib.tpuinfo_chip_health(
+            sysfs_accel_dir.encode(), dev_dir.encode(), index
+        )
+        if r < 0:
+            raise OSError(-r, f"tpuinfo_chip_health(accel{index}) failed")
+        return bool(r)
+
+    def numa_node_count(self, nodes_dir: str = DEFAULT_NUMA_DIR) -> int:
+        r = self._lib.tpuinfo_numa_node_count(nodes_dir.encode())
+        if r < 0:
+            raise OSError(-r, "tpuinfo_numa_node_count failed")
+        return r
+
+    def probe_libtpu(self, path: str = "") -> bool:
+        return bool(self._lib.tpuinfo_probe_libtpu(path.encode()))
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python fallback (identical semantics; used when the .so isn't built)
+# ---------------------------------------------------------------------------
+
+def _read_trimmed(path: str) -> str:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def _read_int(path: str, default: int) -> int:
+    s = _read_trimmed(path)
+    if not s:
+        return default
+    try:
+        return int(s, 0)
+    except ValueError:
+        return default
+
+
+def _pci_addr(devdir: str) -> str:
+    uevent = _read_trimmed(os.path.join(devdir, "uevent"))
+    for line in uevent.splitlines():
+        if line.startswith("PCI_SLOT_NAME="):
+            return line.split("=", 1)[1]
+    try:
+        link = os.readlink(devdir)
+        return os.path.basename(link)
+    except OSError:
+        return ""
+
+
+class PyTpuInfo:
+    """Pure-Python scanner, result-identical to NativeTpuInfo."""
+
+    def version(self) -> str:
+        return "tpuinfo-py 0.1.0"
+
+    def scan(self, sysfs_accel_dir: str, dev_dir: str) -> List[TpuChip]:
+        try:
+            entries = os.listdir(sysfs_accel_dir)
+        except FileNotFoundError:
+            return []
+        chips = []
+        for name in entries:
+            if not name.startswith("accel"):
+                continue
+            try:
+                idx = int(name[5:])
+            except ValueError:
+                continue
+            devdir = os.path.join(sysfs_accel_dir, name, "device")
+            vendor = _read_int(os.path.join(devdir, "vendor"), 0)
+            if vendor not in (0, GOOGLE_VENDOR_ID):
+                continue
+            device = _read_int(os.path.join(devdir, "device"), 0)
+            chip_type = DEVICE_ID_TO_TYPE.get(device, "unknown")
+            spec = spec_for(chip_type) if chip_type != "unknown" else None
+            chips.append(
+                TpuChip(
+                    index=idx,
+                    dev_path=os.path.join(dev_dir, f"accel{idx}"),
+                    pci_addr=_pci_addr(devdir),
+                    vendor_id=vendor,
+                    device_id=device,
+                    numa_node=_read_int(os.path.join(devdir, "numa_node"), -1),
+                    chip_type=chip_type,
+                    hbm_bytes=spec.hbm_bytes if spec else 0,
+                    core_count=spec.cores_per_chip if spec else 0,
+                )
+            )
+        chips.sort(key=lambda c: (c.pci_addr, c.index))
+        return chips
+
+    def chip_health(self, sysfs_accel_dir: str, dev_dir: str, index: int) -> bool:
+        base = os.path.join(sysfs_accel_dir, f"accel{index}")
+        if not os.path.exists(base):
+            raise FileNotFoundError(base)
+        if not os.path.exists(os.path.join(dev_dir, f"accel{index}")):
+            return False
+        enable = os.path.join(base, "device", "enable")
+        if os.path.exists(enable) and _read_int(enable, 1) == 0:
+            return False
+        health = os.path.join(base, "device", "health")
+        if os.path.exists(health):
+            return _read_trimmed(health).lower() in ("ok", "healthy", "1")
+        return True
+
+    def numa_node_count(self, nodes_dir: str = DEFAULT_NUMA_DIR) -> int:
+        try:
+            entries = os.listdir(nodes_dir)
+        except FileNotFoundError:
+            return 1
+        n = sum(
+            1
+            for e in entries
+            if e.startswith("node") and e[4:].isdigit()
+        )
+        return max(n, 1)
+
+    def probe_libtpu(self, path: str = "") -> bool:
+        try:
+            ctypes.CDLL(path or "libtpu.so")
+            return True
+        except OSError:
+            return False
+
+
+def get_backend(prefer_native: bool = True):
+    """Native backend when libtpuinfo.so is available, else Python."""
+    if prefer_native:
+        try:
+            return NativeTpuInfo()
+        except OSError as e:
+            log.warning("libtpuinfo unavailable (%s); using Python scanner", e)
+    return PyTpuInfo()

@@ -1,0 +1,214 @@
+/* libtpuinfo implementation. See tpuinfo.h for the contract and the mapping
+ * onto the reference's NVML/hwloc native surfaces. */
+
+#include "tpuinfo.h"
+
+#include <dirent.h>
+#include <dlfcn.h>
+#include <errno.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cctype>
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr unsigned int kGoogleVendorId = 0x1ae0;
+
+/* Known Google TPU PCI device ids → chip generation. The table is best-
+ * effort (ids for newer parts may be missing); unknown Google accel devices
+ * still enumerate with chip_type "unknown" and the control plane can
+ * override the type from node labels (cloud.google.com/gke-tpu-accelerator)
+ * — discovery never depends on this table being complete. */
+struct ChipModel {
+  unsigned int device_id;
+  const char* type;
+  long long hbm_bytes;
+  int core_count;
+};
+constexpr long long GiB = 1024LL * 1024 * 1024;
+const ChipModel kModels[] = {
+    {0x0027, "v2", 8 * GiB, 2},
+    {0x0056, "v3", 16 * GiB, 2},
+    {0x005e, "v4", 32 * GiB, 2},
+    {0x0062, "v5e", 16 * GiB, 1},
+    {0x0063, "v5p", 95 * GiB, 2},
+    {0x006f, "v6e", 32 * GiB, 1},
+};
+
+std::string ReadTrimmed(const std::string& path) {
+  std::ifstream f(path);
+  if (!f.good()) return "";
+  std::stringstream ss;
+  ss << f.rdbuf();
+  std::string s = ss.str();
+  while (!s.empty() && std::isspace(static_cast<unsigned char>(s.back())))
+    s.pop_back();
+  return s;
+}
+
+long ReadLong(const std::string& path, long dflt) {
+  std::string s = ReadTrimmed(path);
+  if (s.empty()) return dflt;
+  errno = 0;
+  char* end = nullptr;
+  long v = std::strtol(s.c_str(), &end, 0);
+  if (errno != 0 || end == s.c_str()) return dflt;
+  return v;
+}
+
+bool PathExists(const std::string& p) {
+  struct stat st;
+  return ::stat(p.c_str(), &st) == 0;
+}
+
+/* Resolve /sys/class/accel/accelN/device's PCI address. Prefer the
+ * PCI_SLOT_NAME from uevent (works on fake trees without symlinks); fall
+ * back to the basename of the resolved device symlink. */
+std::string PciAddr(const std::string& devdir) {
+  std::string uevent = ReadTrimmed(devdir + "/uevent");
+  size_t pos = uevent.find("PCI_SLOT_NAME=");
+  if (pos != std::string::npos) {
+    size_t start = pos + strlen("PCI_SLOT_NAME=");
+    size_t end = uevent.find('\n', start);
+    return uevent.substr(start, end == std::string::npos ? end : end - start);
+  }
+  char buf[512];
+  ssize_t n = ::readlink(devdir.c_str(), buf, sizeof(buf) - 1);
+  if (n > 0) {
+    buf[n] = '\0';
+    std::string link(buf);
+    size_t slash = link.find_last_of('/');
+    return slash == std::string::npos ? link : link.substr(slash + 1);
+  }
+  return "";
+}
+
+struct ScannedChip {
+  tpuinfo_chip c;
+  std::string sort_key;  /* pci addr, falling back to index */
+};
+
+}  // namespace
+
+extern "C" {
+
+int tpuinfo_scan(const char* sysfs_class_dir, const char* dev_dir,
+                 tpuinfo_chip* out, int max_chips) {
+  if (sysfs_class_dir == nullptr || dev_dir == nullptr || out == nullptr)
+    return -EINVAL;
+  DIR* d = ::opendir(sysfs_class_dir);
+  if (d == nullptr) {
+    if (errno == ENOENT) return 0; /* CPU-only node */
+    return -errno;
+  }
+  std::vector<ScannedChip> chips;
+  struct dirent* ent;
+  while ((ent = ::readdir(d)) != nullptr) {
+    const char* name = ent->d_name;
+    if (strncmp(name, "accel", 5) != 0) continue;
+    char* endp = nullptr;
+    long idx = std::strtol(name + 5, &endp, 10);
+    if (endp == name + 5 || *endp != '\0') continue;
+
+    std::string base = std::string(sysfs_class_dir) + "/" + name;
+    std::string devdir = base + "/device";
+    unsigned int vendor =
+        static_cast<unsigned int>(ReadLong(devdir + "/vendor", 0));
+    if (vendor != 0 && vendor != kGoogleVendorId) continue; /* not a TPU */
+    unsigned int device =
+        static_cast<unsigned int>(ReadLong(devdir + "/device", 0));
+
+    ScannedChip sc{};
+    sc.c.index = static_cast<int>(idx);
+    snprintf(sc.c.dev_path, sizeof(sc.c.dev_path), "%s/accel%ld", dev_dir,
+             idx);
+    std::string pci = PciAddr(devdir);
+    snprintf(sc.c.pci_addr, sizeof(sc.c.pci_addr), "%s", pci.c_str());
+    sc.c.vendor_id = vendor;
+    sc.c.device_id = device;
+    sc.c.numa_node = static_cast<int>(ReadLong(devdir + "/numa_node", -1));
+    snprintf(sc.c.chip_type, sizeof(sc.c.chip_type), "unknown");
+    for (const ChipModel& m : kModels) {
+      if (m.device_id == device) {
+        snprintf(sc.c.chip_type, sizeof(sc.c.chip_type), "%s", m.type);
+        sc.c.hbm_bytes = m.hbm_bytes;
+        sc.c.core_count = m.core_count;
+        break;
+      }
+    }
+    char key[64];
+    snprintf(key, sizeof(key), "%s#%08ld", pci.c_str(), idx);
+    sc.sort_key = key;
+    chips.push_back(sc);
+  }
+  ::closedir(d);
+
+  std::sort(chips.begin(), chips.end(),
+            [](const ScannedChip& a, const ScannedChip& b) {
+              return a.sort_key < b.sort_key;
+            });
+  int n = static_cast<int>(chips.size());
+  for (int i = 0; i < n && i < max_chips; ++i) out[i] = chips[i].c;
+  return n;
+}
+
+int tpuinfo_chip_health(const char* sysfs_class_dir, const char* dev_dir,
+                        int index) {
+  if (sysfs_class_dir == nullptr || dev_dir == nullptr) return -EINVAL;
+  char buf[512];
+  snprintf(buf, sizeof(buf), "%s/accel%d", sysfs_class_dir, index);
+  if (!PathExists(buf)) return -ENOENT;
+  snprintf(buf, sizeof(buf), "%s/accel%d", dev_dir, index);
+  if (!PathExists(buf)) return 0; /* device node vanished */
+  snprintf(buf, sizeof(buf), "%s/accel%d/device/enable", sysfs_class_dir,
+           index);
+  if (PathExists(buf) && ReadLong(buf, 1) == 0) return 0; /* PCI disabled */
+  snprintf(buf, sizeof(buf), "%s/accel%d/device/health", sysfs_class_dir,
+           index);
+  if (PathExists(buf)) {
+    std::string h = ReadTrimmed(buf);
+    std::transform(h.begin(), h.end(), h.begin(),
+                   [](unsigned char ch) { return std::tolower(ch); });
+    return (h == "ok" || h == "healthy" || h == "1") ? 1 : 0;
+  }
+  return 1;
+}
+
+int tpuinfo_numa_node_count(const char* sysfs_nodes_dir) {
+  if (sysfs_nodes_dir == nullptr) return -EINVAL;
+  DIR* d = ::opendir(sysfs_nodes_dir);
+  if (d == nullptr) return errno == ENOENT ? 1 : -errno;
+  int count = 0;
+  struct dirent* ent;
+  while ((ent = ::readdir(d)) != nullptr) {
+    const char* name = ent->d_name;
+    if (strncmp(name, "node", 4) != 0) continue;
+    char* endp = nullptr;
+    std::strtol(name + 4, &endp, 10);
+    if (endp != name + 4 && *endp == '\0') ++count;
+  }
+  ::closedir(d);
+  return count > 0 ? count : 1;
+}
+
+int tpuinfo_probe_libtpu(const char* path) {
+  const char* soname =
+      (path != nullptr && path[0] != '\0') ? path : "libtpu.so";
+  void* h = ::dlopen(soname, RTLD_LAZY | RTLD_LOCAL);
+  if (h == nullptr) return 0;
+  ::dlclose(h);
+  return 1;
+}
+
+const char* tpuinfo_version(void) { return "tpuinfo 0.1.0"; }
+
+}  /* extern "C" */

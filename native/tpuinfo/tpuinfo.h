@@ -1,0 +1,72 @@
+/* libtpuinfo — native TPU chip discovery & host-topology shim.
+ *
+ * The TPU-native replacement for the two native surfaces the reference
+ * consumes through cgo: the NVML binding
+ * (/root/reference/vendor/github.com/NVIDIA/gpu-monitoring-tools/bindings/go/nvml/)
+ * and the hwloc binding (/root/reference/vendor/github.com/gpucloud/gohwloc/).
+ * Like the reference's NVML shim it never hard-links an accelerator library:
+ * everything is read from sysfs/devfs, and libtpu.so (if present) is only
+ * ever dlopen'd, so the shared object loads fine on CPU-only nodes
+ * (cf. nvml_dl.c:21-46 dlopen trick).
+ *
+ * All entry points take explicit sysfs/dev roots so tests can point them at
+ * fake trees (the hwloc-synthetic-topology trick, SURVEY.md §4).
+ *
+ * C ABI, consumed from Python via ctypes.
+ */
+
+#ifndef TPUINFO_H_
+#define TPUINFO_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define TPUINFO_MAX_CHIPS 16
+#define TPUINFO_PATH_LEN 128
+#define TPUINFO_TYPE_LEN 16
+
+typedef struct {
+  int index;                      /* N in accelN / /dev/accelN */
+  char dev_path[TPUINFO_PATH_LEN];/* /dev/accel0 (within dev_root) */
+  char pci_addr[TPUINFO_TYPE_LEN + 16]; /* 0000:00:05.0; "" if unknown */
+  unsigned int vendor_id;         /* PCI vendor, 0x1ae0 for Google */
+  unsigned int device_id;         /* PCI device id */
+  int numa_node;                  /* -1 if unknown */
+  char chip_type[TPUINFO_TYPE_LEN]; /* "v4","v5e","v5p","v6e","unknown" */
+  long long hbm_bytes;            /* 0 if unknown */
+  int core_count;                 /* TensorCores per chip; 0 if unknown */
+} tpuinfo_chip;
+
+/* Scan sysfs_class_dir (host: /sys/class/accel) and dev_dir (host: /dev)
+ * for TPU chips. Fills at most max_chips entries ordered by PCI address
+ * (stable across reboots). Returns the chip count (possibly > max_chips,
+ * truncated), or -errno on scan failure. A missing class dir is not an
+ * error: returns 0 (CPU-only node). */
+int tpuinfo_scan(const char* sysfs_class_dir, const char* dev_dir,
+                 tpuinfo_chip* out, int max_chips);
+
+/* Health of chip accel<index>: 1 healthy, 0 unhealthy, -errno on error.
+ * A chip is unhealthy when its device node is gone, its PCI device is
+ * disabled, or a "health" attribute (fault injection / future driver
+ * surface) reads anything other than ok|healthy|1. */
+int tpuinfo_chip_health(const char* sysfs_class_dir, const char* dev_dir,
+                        int index);
+
+/* Host topology (hwloc replacement): number of NUMA nodes listed in
+ * sysfs_nodes_dir (host: /sys/devices/system/node). Returns >= 1, or
+ * -errno. */
+int tpuinfo_numa_node_count(const char* sysfs_nodes_dir);
+
+/* Optional libtpu probe: returns 1 if libtpu.so can be dlopen'd at the
+ * given path (or default soname when path is NULL/empty), else 0. Never
+ * fatal. */
+int tpuinfo_probe_libtpu(const char* path);
+
+const char* tpuinfo_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUINFO_H_ */

@@ -1,0 +1,61 @@
+"""Shared fakes: fake sysfs/dev trees for TPU discovery tests.
+
+The analog of hwloc's synthetic-topology hook the reference never used
+(SURVEY.md §4): build a `/sys/class/accel`-shaped tree in a tmpdir and point
+the scanners at it.
+"""
+
+from __future__ import annotations
+
+import os
+
+from k8s_device_plugin_tpu.discovery.chips import DEVICE_ID_TO_TYPE
+
+# chip_type -> PCI device id, derived from the product table so a new chip
+# generation can't silently desync the fakes.
+TYPE_TO_DEVICE_ID = {v: k for k, v in DEVICE_ID_TO_TYPE.items()}
+
+
+def make_fake_tpu_node(
+    root: str,
+    chip_type: str = "v5p",
+    count: int = 4,
+    numa_of=lambda i: 0,
+    vendor: int = 0x1AE0,
+):
+    """Create <root>/sys/class/accel + <root>/dev with `count` fake chips.
+
+    Returns (sysfs_accel_dir, dev_dir).
+    """
+    accel_dir = os.path.join(root, "sys", "class", "accel")
+    dev_dir = os.path.join(root, "dev")
+    os.makedirs(dev_dir, exist_ok=True)
+    device_id = TYPE_TO_DEVICE_ID.get(chip_type, 0)
+    for i in range(count):
+        devdir = os.path.join(accel_dir, f"accel{i}", "device")
+        os.makedirs(devdir, exist_ok=True)
+        pci = f"0000:00:{4 + i:02x}.0"
+        _write(devdir, "vendor", f"0x{vendor:04x}")
+        _write(devdir, "device", f"0x{device_id:04x}")
+        _write(devdir, "numa_node", str(numa_of(i)))
+        _write(devdir, "uevent", f"DRIVER=accel\nPCI_SLOT_NAME={pci}\n")
+        # Fake device node (a regular file is enough for path checks).
+        with open(os.path.join(dev_dir, f"accel{i}"), "w") as f:
+            f.write("")
+    os.makedirs(accel_dir, exist_ok=True)
+    return accel_dir, dev_dir
+
+
+def set_chip_health(accel_dir: str, index: int, healthy: bool):
+    """Flip the fault-injection health attribute for chip `index`."""
+    devdir = os.path.join(accel_dir, f"accel{index}", "device")
+    _write(devdir, "health", "ok" if healthy else "failed")
+
+
+def remove_dev_node(dev_dir: str, index: int):
+    os.unlink(os.path.join(dev_dir, f"accel{index}"))
+
+
+def _write(d: str, name: str, content: str):
+    with open(os.path.join(d, name), "w") as f:
+        f.write(content + "\n")

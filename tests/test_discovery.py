@@ -1,0 +1,119 @@
+"""Discovery backend tests: fake sysfs trees through both scanners.
+
+Covers SURVEY.md §2.2/§2.8 behavior: enumeration, stable identity, CPU-only
+nodes, health probing — with native (C++) and Python backends asserted
+identical (BASELINE configs 1-2).
+"""
+
+import subprocess
+import os
+
+import pytest
+
+from k8s_device_plugin_tpu.discovery.scanner import (
+    NativeTpuInfo,
+    PyTpuInfo,
+    get_backend,
+)
+from tests import fakes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "native", "tpuinfo")
+NATIVE_LIB = os.path.join(NATIVE_DIR, "build", "libtpuinfo.so")
+
+
+@pytest.fixture(scope="session")
+def native_lib():
+    if not os.path.exists(NATIVE_LIB):
+        subprocess.run(["make", "-C", NATIVE_DIR], check=True, capture_output=True)
+    return NATIVE_LIB
+
+
+@pytest.fixture(params=["python", "native"])
+def backend(request, native_lib):
+    if request.param == "native":
+        return NativeTpuInfo(native_lib)
+    return PyTpuInfo()
+
+
+def test_scan_v5p_host(backend, tmp_path):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5p", 4)
+    chips = backend.scan(accel, dev)
+    assert len(chips) == 4
+    assert [c.index for c in chips] == [0, 1, 2, 3]
+    assert all(c.chip_type == "v5p" for c in chips)
+    assert chips[0].pci_addr == "0000:00:04.0"
+    assert chips[0].dev_path == os.path.join(dev, "accel0")
+    assert chips[0].device_id_str == "tpu-0000:00:04.0"
+    assert chips[0].numa_node == 0
+    assert chips[0].hbm_bytes == 95 * 1024**3
+
+
+def test_scan_orders_by_pci_address(backend, tmp_path):
+    # accel indices deliberately don't follow PCI order.
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v4", 0)
+    for idx, bus in [(2, 4), (0, 6), (1, 5)]:
+        devdir = os.path.join(accel, f"accel{idx}", "device")
+        os.makedirs(devdir)
+        fakes._write(devdir, "vendor", "0x1ae0")
+        fakes._write(devdir, "device", "0x005e")
+        fakes._write(devdir, "numa_node", "0")
+        fakes._write(devdir, "uevent", f"PCI_SLOT_NAME=0000:00:{bus:02x}.0")
+        open(os.path.join(dev, f"accel{idx}"), "w").close()
+    chips = backend.scan(accel, dev)
+    assert [c.index for c in chips] == [2, 1, 0]  # PCI-address order
+
+
+def test_scan_cpu_only_node(backend, tmp_path):
+    # No accel class dir at all: 0 chips, no error (BASELINE config 1).
+    chips = backend.scan(str(tmp_path / "missing"), str(tmp_path))
+    assert chips == []
+
+
+def test_scan_skips_non_google_devices(backend, tmp_path):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v4", 2, vendor=0x10DE)
+    assert backend.scan(accel, dev) == []
+
+
+def test_chip_health_states(backend, tmp_path):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 2)
+    assert backend.chip_health(accel, dev, 0) is True or backend.chip_health(accel, dev, 0) == 1
+    fakes.set_chip_health(accel, 0, False)
+    assert not backend.chip_health(accel, dev, 0)
+    fakes.set_chip_health(accel, 0, True)
+    assert backend.chip_health(accel, dev, 0)
+    fakes.remove_dev_node(dev, 1)
+    assert not backend.chip_health(accel, dev, 1)
+
+
+def test_chip_health_missing_chip_raises(backend, tmp_path):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 1)
+    with pytest.raises(OSError):
+        backend.chip_health(accel, dev, 7)
+
+
+def test_native_and_python_scan_identical(native_lib, tmp_path):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v4", 4, numa_of=lambda i: i // 2)
+    native = NativeTpuInfo(native_lib).scan(accel, dev)
+    py = PyTpuInfo().scan(accel, dev)
+    assert native == py
+
+
+def test_numa_node_count(backend, tmp_path):
+    nodes = tmp_path / "node_dir"
+    nodes.mkdir()
+    for n in range(2):
+        (nodes / f"node{n}").mkdir()
+    (nodes / "possible").write_text("0-1\n")
+    assert backend.numa_node_count(str(nodes)) == 2
+    assert backend.numa_node_count(str(tmp_path / "nope")) == 1
+
+
+def test_get_backend_falls_back(monkeypatch):
+    monkeypatch.setenv("TPUINFO_LIB", "/definitely/not/here.so")
+    monkeypatch.setattr(
+        "k8s_device_plugin_tpu.discovery.scanner._default_lib_paths",
+        lambda: ["/definitely/not/here.so"],
+    )
+    b = get_backend(prefer_native=True)
+    assert isinstance(b, PyTpuInfo)
