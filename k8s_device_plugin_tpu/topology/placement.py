@@ -1,0 +1,264 @@
+"""Placement policy: pick contiguous ICI sub-meshes; track alloc/free state.
+
+The TPU-native counterpart of the reference's findBestDevice policy
+(/root/reference/topology.go:114-205) and UpdatePodDevice bookkeeping
+(/root/reference/topology.go:256-285). The reference's policy, translated to
+its intent (policy comment /root/reference/topology.go:118-130):
+
+  * n == 1: pick the device whose removal damages future multi-device
+    placements least ("find1GPUDevice" descends the *lowest*-scored branch).
+  * n > 1: pick the smallest sufficient, best-connected group
+    ("findNGPUDevice" BFS for the densest branch).
+
+On a mesh the same intent becomes geometric:
+
+  * n == 1: prefer an available chip with the fewest available neighbors
+    (corner/edge chips first — preserves intact 2×2 blocks).
+  * n > 1: try every axis-aligned sub-box of volume n that fits the bounds
+    (the ideal contiguous sub-mesh XLA wants for its collectives); among
+    fully-available placements choose max internal ICI links, then minimal
+    fragmentation (fewest available neighbors bordering the set). If no
+    exact box is free, fall back to greedy BFS growth from the best seed.
+
+All scoring uses the precomputed tables in IciMesh — no hardware queries
+(vs. the reference's live O(N²) NVML rescoring, topology.go:231-253).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .mesh import Coord, IciMesh
+
+
+def _box_shapes(n: int, bounds: Coord) -> List[Coord]:
+    """All (a,b,c) with a*b*c == n fitting inside bounds, most cube-like
+    first (more internal links for the same volume)."""
+    shapes = []
+    for a in range(1, n + 1):
+        if n % a:
+            continue
+        for b in range(1, n // a + 1):
+            if (n // a) % b:
+                continue
+            c = n // a // b
+            if a <= bounds[0] and b <= bounds[1] and c <= bounds[2]:
+                shapes.append((a, b, c))
+    # Cube-ness: minimize surface area == maximize internal links.
+    shapes.sort(key=lambda s: s[0] * s[1] + s[1] * s[2] + s[0] * s[2])
+    return shapes
+
+
+class PlacementState:
+    """Allocation bookkeeping plus the best-fit selection policy.
+
+    Thread-safe: Allocate (gRPC thread), the controller's free path, and the
+    health watcher all touch this state — same contention the reference
+    handles with its tree mutex.
+    """
+
+    def __init__(self, mesh: IciMesh):
+        self.mesh = mesh
+        self._lock = threading.RLock()
+        self._allocated: Set[str] = set()
+        self._unhealthy: Set[str] = set()
+
+    # -- state -------------------------------------------------------------
+
+    @property
+    def allocated(self) -> Set[str]:
+        with self._lock:
+            return set(self._allocated)
+
+    @property
+    def unhealthy(self) -> Set[str]:
+        with self._lock:
+            return set(self._unhealthy)
+
+    def available(self) -> List[str]:
+        with self._lock:
+            return [
+                i
+                for i in self.mesh.ids
+                if i not in self._allocated and i not in self._unhealthy
+            ]
+
+    def allocate(self, ids: Iterable[str]) -> None:
+        """Mark chips allocated (UpdatePodDevice(adds, nil) analog)."""
+        with self._lock:
+            for i in ids:
+                if i in self.mesh.by_id:
+                    self._allocated.add(i)
+
+    def free(self, ids: Iterable[str]) -> None:
+        """Mark chips free (UpdatePodDevice(nil, dels) analog). Unknown ids
+        are ignored, matching the reference's tolerant free path
+        (/root/reference/topology.go:270-285)."""
+        with self._lock:
+            for i in ids:
+                self._allocated.discard(i)
+
+    def set_health(self, chip_id: str, healthy: bool) -> bool:
+        """Returns True if the health state changed."""
+        with self._lock:
+            if healthy:
+                if chip_id in self._unhealthy:
+                    self._unhealthy.discard(chip_id)
+                    return True
+                return False
+            if chip_id not in self._unhealthy:
+                self._unhealthy.add(chip_id)
+                return True
+            return False
+
+    def reset(
+        self,
+        allocated: Optional[Iterable[str]] = None,
+        unhealthy: Optional[Iterable[str]] = None,
+    ) -> None:
+        """Replace state wholesale — used for checkpoint state rebuild at
+        startup (the reference loses this state, SURVEY.md §5)."""
+        with self._lock:
+            self._allocated = set(allocated or ())
+            self._unhealthy = set(unhealthy or ())
+
+    # -- policy ------------------------------------------------------------
+
+    def select(
+        self,
+        n: int,
+        available: Optional[Sequence[str]] = None,
+        must_include: Sequence[str] = (),
+    ) -> List[str]:
+        """Choose n chips. `available` restricts the candidate pool (the
+        kubelet passes one for GetPreferredAllocation); default is this
+        state's own availability. Returns [] when n chips can't be found
+        (caller falls back to the kubelet's pick, mirroring
+        /root/reference/server.go:191-193)."""
+        with self._lock:
+            pool = list(available) if available is not None else self.available()
+            pool = [p for p in pool if p in self.mesh.by_id]
+            must = [m for m in must_include if m in self.mesh.by_id]
+            if not all(m in pool for m in must):
+                pool = list(dict.fromkeys(list(pool) + must))
+            if n <= 0 or len(pool) < n or len(must) > n:
+                return []
+            if n == 1:
+                return [must[0]] if must else [self._select_one(pool)]
+            return self._select_n(n, pool, must)
+
+    def _avail_neighbor_count(self, chip_id: str, pool: Set[str]) -> int:
+        return sum(1 for nb in self.mesh.neighbors(chip_id) if nb in pool)
+
+    def _select_one(self, pool: List[str]) -> str:
+        pool_set = set(pool)
+        # Fewest available neighbors first (corner-first); tie-break on
+        # stable id order for determinism.
+        return min(
+            pool,
+            key=lambda c: (self._avail_neighbor_count(c, pool_set), c),
+        )
+
+    def _select_n(self, n: int, pool: List[str], must: List[str]) -> List[str]:
+        pool_set = set(pool)
+        best = self._best_box(n, pool_set, set(must))
+        if best is not None:
+            return sorted(best)
+        grown = self._grow(n, pool_set, must)
+        if grown is not None:
+            return sorted(grown)
+        # Last resort: any n available chips, best set-score combination if
+        # the pool is small, else first-n (reference's fallback semantics).
+        if len(pool) <= 12:
+            combos = [
+                c
+                for c in itertools.combinations(sorted(pool), n)
+                if all(m in c for m in must)
+            ]
+            if combos:
+                return list(
+                    max(combos, key=lambda c: self.mesh.internal_links(c))
+                )
+        rest = [p for p in sorted(pool) if p not in must]
+        return (must + rest)[:n]
+
+    def _best_box(
+        self, n: int, pool: Set[str], must: Set[str]
+    ) -> Optional[List[str]]:
+        mesh = self.mesh
+        bx, by, bz = mesh.bounds
+        best: Optional[Tuple[Tuple[int, int, int], List[str]]] = None
+        for shape in _box_shapes(n, mesh.bounds):
+            sx, sy, sz = shape
+            for ox in range(bx - sx + 1):
+                for oy in range(by - sy + 1):
+                    for oz in range(bz - sz + 1):
+                        ids = []
+                        ok = True
+                        for dx in range(sx):
+                            for dy in range(sy):
+                                for dz in range(sz):
+                                    m = mesh.by_coords.get(
+                                        (ox + dx, oy + dy, oz + dz)
+                                    )
+                                    if m is None or m.id not in pool:
+                                        ok = False
+                                        break
+                                    ids.append(m.id)
+                                if not ok:
+                                    break
+                            if not ok:
+                                break
+                        if not ok or not must.issubset(ids):
+                            continue
+                        frag = sum(
+                            1
+                            for i in ids
+                            for nb in mesh.neighbors(i)
+                            if nb in pool and nb not in ids
+                        )
+                        key = (-mesh.internal_links(ids), frag, tuple(sorted(ids)))
+                        if best is None or key < best[0]:
+                            best = (key, ids)
+        return best[1] if best else None
+
+    def _grow(
+        self, n: int, pool: Set[str], must: List[str]
+    ) -> Optional[List[str]]:
+        """Greedy connected growth: seed with must-includes (or the best-
+        connected available chip) and repeatedly add the neighbor with the
+        most links into the current set."""
+        mesh = self.mesh
+        if must:
+            current = list(dict.fromkeys(must))
+        else:
+            seed = max(
+                sorted(pool), key=lambda c: self._avail_neighbor_count(c, pool)
+            )
+            current = [seed]
+        cur_set = set(current)
+        while len(current) < n:
+            frontier = {
+                nb
+                for c in current
+                for nb in mesh.neighbors(c)
+                if nb in pool and nb not in cur_set
+            }
+            if not frontier:
+                # Disconnected remainder: pull in the best unconnected chip.
+                rest = [p for p in sorted(pool) if p not in cur_set]
+                if not rest:
+                    return None
+                nxt = rest[0]
+            else:
+                nxt = max(
+                    sorted(frontier),
+                    key=lambda f: sum(
+                        1 for nb in mesh.neighbors(f) if nb in cur_set
+                    ),
+                )
+            current.append(nxt)
+            cur_set.add(nxt)
+        return current

@@ -1,0 +1,82 @@
+"""Node topology schema — the JSON published as a node annotation.
+
+The analog of the reference's Topology schema (/root/reference/device.go:8-97)
+serialized into the node annotation for an external scheduler extender
+(/root/reference/server.go:287-309). Where the reference describes a PCI/NUMA
+tree of GPUs, this describes the node's ICI mesh: accelerator type, host
+bounds, torus-ness, and per-chip identity/coords/NUMA — everything a
+scheduler needs to co-locate a multi-host slice over mesh-adjacent hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import platform
+from typing import List, Optional
+
+from .mesh import IciMesh
+
+SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass
+class ChipInfo:
+    id: str
+    index: int
+    dev_path: str
+    pci_addr: str
+    numa_node: int
+    coords: List[int]
+    hbm_bytes: int
+    core_count: int
+
+
+@dataclasses.dataclass
+class NodeTopology:
+    version: int
+    hostname: str
+    chip_type: str
+    chip_count: int
+    host_bounds: List[int]
+    torus: bool
+    numa_nodes: int
+    chips: List[ChipInfo]
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @staticmethod
+    def from_json(s: str) -> "NodeTopology":
+        d = json.loads(s)
+        chips = [ChipInfo(**c) for c in d.pop("chips", [])]
+        return NodeTopology(chips=chips, **d)
+
+    @staticmethod
+    def from_mesh(
+        mesh: IciMesh,
+        numa_nodes: int = 1,
+        hostname: Optional[str] = None,
+    ) -> "NodeTopology":
+        return NodeTopology(
+            version=SCHEMA_VERSION,
+            hostname=hostname or platform.node(),
+            chip_type=mesh.spec.chip_type,
+            chip_count=len(mesh.mesh_chips),
+            host_bounds=list(mesh.bounds),
+            torus=mesh.spec.torus,
+            numa_nodes=numa_nodes,
+            chips=[
+                ChipInfo(
+                    id=m.id,
+                    index=m.chip.index,
+                    dev_path=m.chip.dev_path,
+                    pci_addr=m.chip.pci_addr,
+                    numa_node=m.chip.numa_node,
+                    coords=list(m.coords),
+                    hbm_bytes=m.chip.hbm_bytes,
+                    core_count=m.chip.core_count,
+                )
+                for m in mesh.mesh_chips
+            ],
+        )

@@ -1,0 +1,230 @@
+"""Topology model + placement policy tests (SURVEY.md §2.5/§2.7/§2.16).
+
+Golden-allocation tests over fake meshes of each supported accelerator type
+— the unit coverage the reference never had (its topology_test.go is empty,
+SURVEY.md §4).
+"""
+
+import pytest
+
+from k8s_device_plugin_tpu.discovery.chips import TpuChip, spec_for
+from k8s_device_plugin_tpu.topology.mesh import (
+    IciMesh,
+    SCORE_ADJACENT,
+    SCORE_DCN,
+    SCORE_2_HOPS,
+)
+from k8s_device_plugin_tpu.topology.placement import PlacementState, _box_shapes
+from k8s_device_plugin_tpu.topology.schema import NodeTopology
+
+
+def make_chips(chip_type: str, n: int):
+    return [
+        TpuChip(
+            index=i,
+            dev_path=f"/dev/accel{i}",
+            pci_addr=f"0000:00:{4 + i:02x}.0",
+            vendor_id=0x1AE0,
+            device_id=0,
+            numa_node=i // max(n // 2, 1),
+            chip_type=chip_type,
+            hbm_bytes=0,
+            core_count=2,
+        )
+        for i in range(n)
+    ]
+
+
+def mesh_of(chip_type: str, n: int) -> IciMesh:
+    return IciMesh(make_chips(chip_type, n))
+
+
+# -- mesh geometry ----------------------------------------------------------
+
+def test_v5p_host_coords_and_adjacency():
+    m = mesh_of("v5p", 4)  # 2x2x1 block
+    assert m.bounds == (2, 2, 1)
+    coords = [mc.coords for mc in m.mesh_chips]
+    assert coords == [(0, 0, 0), (1, 0, 0), (0, 1, 0), (1, 1, 0)]
+    ids = m.ids
+    # Corner chips have exactly 2 neighbors in a 2x2 mesh.
+    for i in ids:
+        assert len(m.neighbors(i)) == 2
+    assert m.hops(ids[0], ids[3]) == 2  # diagonal
+    assert m.score_pair(ids[0], ids[1]) == SCORE_ADJACENT
+    assert m.score_pair(ids[0], ids[3]) == SCORE_2_HOPS
+
+
+def test_v5e_host_is_2x4_mesh():
+    m = mesh_of("v5e", 8)
+    assert m.bounds == (2, 4, 1)
+    ids = m.ids
+    # (0,0) corner: 2 neighbors; (0,1) edge: 3 neighbors.
+    assert len(m.neighbors(ids[0])) == 2
+    assert len(m.neighbors(ids[2])) == 3
+    # Mesh (not torus): far corner is 1+3 hops away, no wraparound.
+    assert m.hops(ids[0], ids[7]) == 4
+
+
+def test_torus_wrap_on_large_dim():
+    # A v4 4x1x1 slice bounds: torus wraps the 4-long dimension.
+    chips = make_chips("v4", 4)
+    m = IciMesh(chips, bounds=(4, 1, 1))
+    ids = m.ids
+    assert m.hops(ids[0], ids[3]) == 1  # wraps around
+    assert set(m.neighbors(ids[0])) == {ids[1], ids[3]}
+
+
+def test_no_wrap_on_size_2_dims():
+    m = mesh_of("v4", 4)  # 2x2x1 torus generation, but dims of size 2
+    ids = m.ids
+    # Each corner has exactly 2 distinct neighbors (no double-link).
+    assert all(len(set(m.neighbors(i))) == 2 for i in ids)
+
+
+def test_unknown_type_degrades_to_linear():
+    chips = make_chips("unknown", 3)
+    m = IciMesh(chips)
+    assert m.bounds == (3, 1, 1)
+    assert m.is_contiguous(m.ids)
+
+
+def test_oversubscribed_bounds_degrade():
+    # 6 chips claiming v5p (4-chip host shape): degrade to linear, don't fail.
+    chips = make_chips("v5p", 6)
+    m = IciMesh(chips)
+    assert m.bounds == (6, 1, 1)
+
+
+def test_set_score_and_contiguity():
+    m = mesh_of("v5e", 8)
+    ids = m.ids
+    row = [ids[0], ids[2], ids[4], ids[6]]  # x=0 column: chain
+    assert m.is_contiguous(row)
+    assert m.internal_links(row) == 3
+    assert not m.is_contiguous([ids[0], ids[7]])
+    assert m.set_score([ids[0], ids[1]]) == SCORE_ADJACENT
+
+
+# -- placement policy -------------------------------------------------------
+
+def test_box_shapes_prefer_cubes():
+    shapes = _box_shapes(4, (4, 4, 4))
+    # Most compact 4-chip box first: some rotation of 2x2x1, never 4x1x1.
+    assert sorted(shapes[0]) == [1, 2, 2]
+
+def test_select_whole_host_v5p():
+    st = PlacementState(mesh_of("v5p", 4))
+    got = st.select(4)
+    assert sorted(got) == sorted(st.mesh.ids)
+
+
+def test_select_pair_is_adjacent():
+    m = mesh_of("v5p", 4)
+    st = PlacementState(m)
+    got = st.select(2)
+    assert len(got) == 2
+    assert m.hops(got[0], got[1]) == 1
+
+
+def test_select_one_preserves_blocks():
+    # On a 2x4 v5e mesh with one row end allocated, a single-chip pick must
+    # not carve the middle of the remaining block.
+    m = mesh_of("v5e", 8)
+    st = PlacementState(m)
+    one = st.select(1)
+    assert len(one) == 1
+    # Corner chip (2 neighbors), not an interior one (3 neighbors).
+    assert len(m.neighbors(one[0])) == 2
+
+
+def test_select_2x2_in_v5e():
+    m = mesh_of("v5e", 8)
+    st = PlacementState(m)
+    got = st.select(4)
+    assert len(got) == 4
+    assert m.is_contiguous(got)
+    assert m.internal_links(got) == 4  # a 2x2 block, not a 1x4 chain
+
+
+def test_select_respects_allocated():
+    m = mesh_of("v5p", 4)
+    st = PlacementState(m)
+    first = st.select(2)
+    st.allocate(first)
+    second = st.select(2)
+    assert set(first).isdisjoint(second)
+    st.allocate(second)
+    assert st.select(1) == []
+    st.free(first)
+    assert len(st.select(2)) == 2
+
+
+def test_select_respects_unhealthy():
+    m = mesh_of("v5p", 4)
+    st = PlacementState(m)
+    bad = m.ids[0]
+    assert st.set_health(bad, healthy=False)
+    got = st.select(4)
+    assert got == []  # only 3 healthy chips remain
+    got3 = st.select(3)
+    assert bad not in got3
+    assert st.set_health(bad, healthy=True)  # recovery
+    assert len(st.select(4)) == 4
+
+
+def test_select_with_available_pool_and_must_include():
+    m = mesh_of("v5e", 8)
+    st = PlacementState(m)
+    pool = m.ids[:6]
+    must = [m.ids[3]]
+    got = st.select(2, available=pool, must_include=must)
+    assert m.ids[3] in got
+    assert all(g in pool for g in got)
+    assert m.hops(got[0], got[1]) == 1
+
+
+def test_select_fragmented_falls_back_connected():
+    # Allocate a diagonal so no 2x2 box is free; a 4-chip request must still
+    # return 4 available chips.
+    m = mesh_of("v5e", 8)
+    st = PlacementState(m)
+    st.allocate([m.ids[1], m.ids[4]])
+    got = st.select(4)
+    assert len(got) == 4
+    assert set(got).isdisjoint({m.ids[1], m.ids[4]})
+
+
+def test_select_must_include_outside_pool_extends_pool():
+    # must_include chips outside `available` are merged before the size
+    # check, so pool of n-1 plus one must chip still succeeds.
+    m = mesh_of("v5p", 4)
+    st = PlacementState(m)
+    got = st.select(2, available=[m.ids[0]], must_include=[m.ids[1]])
+    assert sorted(got) == sorted([m.ids[0], m.ids[1]])
+
+
+def test_select_overask_returns_empty():
+    st = PlacementState(mesh_of("v5p", 4))
+    assert st.select(5) == []
+    assert st.select(0) == []
+
+
+def test_state_reset_for_checkpoint_rebuild():
+    m = mesh_of("v5p", 4)
+    st = PlacementState(m)
+    st.reset(allocated=[m.ids[0]], unhealthy=[m.ids[1]])
+    assert st.available() == sorted(set(m.ids) - {m.ids[0], m.ids[1]})
+
+
+# -- schema -----------------------------------------------------------------
+
+def test_node_topology_roundtrip():
+    m = mesh_of("v5p", 4)
+    topo = NodeTopology.from_mesh(m, numa_nodes=2, hostname="host-a")
+    s = topo.to_json()
+    back = NodeTopology.from_json(s)
+    assert back == topo
+    assert back.chip_type == "v5p"
+    assert back.host_bounds == [2, 2, 1]
+    assert back.chips[0].coords == [0, 0, 0]
