@@ -1,0 +1,324 @@
+"""The TPU device-plugin gRPC server (kubelet-facing).
+
+TPU-native rebuild of the reference's NvidiaDevicePlugin
+(/root/reference/server.go:36-284). Same lifecycle contract — serve on our
+own unix socket under the kubelet's device-plugins dir, self-dial probe,
+register with the kubelet, stream the device list, answer Allocate — with
+the TPU-specific differences recorded in ARCHITECTURE.md:
+
+* Allocate returns explicit DeviceSpecs (/dev/accel*) + a libtpu.so Mount +
+  TPU runtime env, because no container-runtime hook interprets an env var
+  for TPUs (vs. NVIDIA_VISIBLE_DEVICES, /root/reference/server.go:196-198).
+* GetPreferredAllocation serves topology-best sets to the kubelet up front;
+  the reference's Allocate-time substitution (server.go:185-216) is kept as
+  an optional compat mode (``substitute_on_allocate``) and records the
+  kubeletID→realID mapping in ``shadow_map`` exactly like the reference's
+  shadowMap, for the controller's checkpoint reconciliation.
+* ListAndWatch re-advertises on *both* health transitions — the reference
+  never recovers a device (FIXME /root/reference/server.go:170).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional, Sequence
+
+import grpc
+
+from ..api import constants
+from ..api import deviceplugin_pb2 as pb
+from ..api.grpc_defs import (
+    DevicePluginServicer,
+    RegistrationStub,
+    add_device_plugin_servicer,
+)
+from ..topology.mesh import IciMesh
+from ..topology.placement import PlacementState
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class PluginConfig:
+    """Knobs the reference hard-codes or reads from env
+    (/root/reference/server.go:30-33, main.go:19-21)."""
+
+    resource_name: str = constants.RESOURCE_NAME
+    plugin_socket_name: str = constants.PLUGIN_SOCKET_NAME
+    device_plugin_dir: str = constants.DEVICE_PLUGIN_PATH
+    # Host path of libtpu.so to mount into containers; GKE TPU node images
+    # stage it here. Empty string disables the mount.
+    libtpu_host_path: str = "/home/kubernetes/bin/libtpu.so"
+    libtpu_container_path: str = "/usr/lib/libtpu.so"
+    # Reference-compatible Allocate-time substitution for kubelets too old
+    # for GetPreferredAllocation (see module docstring).
+    substitute_on_allocate: bool = False
+    # cgroup device permissions for /dev/accel* nodes.
+    device_permissions: str = "rwm"
+
+    @property
+    def socket_path(self) -> str:
+        return os.path.join(self.device_plugin_dir, self.plugin_socket_name)
+
+    @property
+    def kubelet_socket(self) -> str:
+        return os.path.join(self.device_plugin_dir, constants.KUBELET_SOCKET_NAME)
+
+
+class TpuDevicePlugin(DevicePluginServicer):
+    """Serves the DevicePlugin service for one node's TPU chips."""
+
+    def __init__(
+        self,
+        mesh: IciMesh,
+        state: Optional[PlacementState] = None,
+        config: Optional[PluginConfig] = None,
+    ):
+        self.mesh = mesh
+        self.state = state or PlacementState(mesh)
+        self.config = config or PluginConfig()
+        # kubelet-chosen ID → actually-allocated ID, drained by the
+        # controller's checkpoint reconciliation (reference shadowMap,
+        # /root/reference/server.go:49, controller.go:200-210). Only
+        # populated in substitute_on_allocate mode.
+        self.shadow_map: Dict[str, str] = {}
+        self._server: Optional[grpc.Server] = None
+        self._stop = threading.Event()
+        # Device-list versioning: streams re-send whenever bumped.
+        self._version = 0
+        self._version_cv = threading.Condition()
+
+    # ------------------------------------------------------------------
+    # Lifecycle (reference Start/Stop/Serve/Register, server.go:93-155,256)
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        sock = self.config.socket_path
+        if os.path.exists(sock):
+            os.unlink(sock)
+        self._stop.clear()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8),
+            options=[("grpc.max_concurrent_streams", 64)],
+        )
+        add_device_plugin_servicer(self, self._server)
+        self._server.add_insecure_port(f"unix:{sock}")
+        self._server.start()
+        # Self-dial probe, like the reference's dial-after-listen
+        # (server.go:110-116): fail fast if the socket isn't servable.
+        with grpc.insecure_channel(f"unix:{sock}") as ch:
+            grpc.channel_ready_future(ch).result(timeout=5)
+        log.info("device plugin serving on %s", sock)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._version_cv:
+            self._version_cv.notify_all()
+        if self._server is not None:
+            self._server.stop(grace=1).wait()
+            self._server = None
+        try:
+            os.unlink(self.config.socket_path)
+        except OSError:
+            pass
+
+    def register(self, timeout: float = 10.0) -> None:
+        """Register with the kubelet (reference server.go:136-155)."""
+        with grpc.insecure_channel(f"unix:{self.config.kubelet_socket}") as ch:
+            grpc.channel_ready_future(ch).result(timeout=timeout)
+            stub = RegistrationStub(ch)
+            stub.Register(
+                pb.RegisterRequest(
+                    version=constants.VERSION,
+                    endpoint=self.config.plugin_socket_name,
+                    resource_name=self.config.resource_name,
+                    options=pb.DevicePluginOptions(
+                        get_preferred_allocation_available=True,
+                    ),
+                ),
+                timeout=timeout,
+            )
+        log.info(
+            "registered %s with kubelet at %s",
+            self.config.resource_name,
+            self.config.kubelet_socket,
+        )
+
+    def serve(self) -> None:
+        self.start()
+        self.register()
+
+    # ------------------------------------------------------------------
+    # Health plumbing (reference health chan, server.go:180-182)
+    # ------------------------------------------------------------------
+
+    def notify_health(self, chip_id: str, healthy: bool) -> None:
+        """Called by the health watcher; re-advertises on any transition."""
+        if self.state.set_health(chip_id, healthy):
+            log.warning(
+                "chip %s is now %s",
+                chip_id,
+                constants.HEALTHY if healthy else constants.UNHEALTHY,
+            )
+            self._bump()
+
+    def _bump(self) -> None:
+        with self._version_cv:
+            self._version += 1
+            self._version_cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # DevicePlugin service
+    # ------------------------------------------------------------------
+
+    def _device_list(self) -> List[pb.Device]:
+        unhealthy = self.state.unhealthy
+        devices = []
+        for mc in self.mesh.mesh_chips:
+            d = pb.Device(
+                ID=mc.id,
+                health=(
+                    constants.UNHEALTHY
+                    if mc.id in unhealthy
+                    else constants.HEALTHY
+                ),
+            )
+            if mc.chip.numa_node >= 0:
+                d.topology.nodes.add(ID=mc.chip.numa_node)
+            devices.append(d)
+        return devices
+
+    def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions(get_preferred_allocation_available=True)
+
+    def ListAndWatch(self, request, context):
+        last_sent = -1
+        while not self._stop.is_set():
+            with self._version_cv:
+                if self._version == last_sent:
+                    self._version_cv.wait(timeout=5.0)
+                if self._version == last_sent:
+                    continue
+                last_sent = self._version
+            resp = pb.ListAndWatchResponse(devices=self._device_list())
+            log.info(
+                "ListAndWatch send: %d devices (%d unhealthy)",
+                len(resp.devices),
+                sum(1 for d in resp.devices if d.health != constants.HEALTHY),
+            )
+            yield resp
+
+    def GetPreferredAllocation(self, request, context):
+        resp = pb.PreferredAllocationResponse()
+        for creq in request.container_requests:
+            picked = self.state.select(
+                creq.allocation_size,
+                available=list(creq.available_deviceIDs),
+                must_include=list(creq.must_include_deviceIDs),
+            )
+            log.info(
+                "GetPreferredAllocation: size=%d pool=%d -> %s",
+                creq.allocation_size,
+                len(creq.available_deviceIDs),
+                picked,
+            )
+            resp.container_responses.add(deviceIDs=picked)
+        return resp
+
+    def Allocate(self, request, context):
+        # Two-phase: validate + plan every container first, then commit, so
+        # a bad container can't leak partial allocation state.
+        plans = []
+        for creq in request.container_requests:
+            requested = list(creq.devicesIDs)
+            unknown = [i for i in requested if i not in self.mesh.by_id]
+            if unknown:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"unknown device ids: {unknown}",
+                )
+            assigned = requested
+            substitutions = {}
+            if self.config.substitute_on_allocate and requested:
+                best = self.state.select(len(requested))
+                if best:
+                    assigned = best
+                    for kubelet_id, real_id in zip(sorted(requested), best):
+                        if kubelet_id != real_id:
+                            substitutions[kubelet_id] = real_id
+            plans.append((requested, assigned, substitutions))
+        resp = pb.AllocateResponse()
+        for requested, assigned, substitutions in plans:
+            self.shadow_map.update(substitutions)
+            self.state.allocate(assigned)
+            resp.container_responses.append(self._container_response(assigned))
+            log.info("Allocate: requested=%s assigned=%s", requested, assigned)
+        self._bump()  # availability changed; refresh any watchers
+        return resp
+
+    def PreStartContainer(self, request, context):
+        return pb.PreStartContainerResponse()
+
+    # ------------------------------------------------------------------
+    # Response construction (the TPU analog of server.go:195-202)
+    # ------------------------------------------------------------------
+
+    def _container_response(
+        self, ids: Sequence[str]
+    ) -> pb.ContainerAllocateResponse:
+        resp = pb.ContainerAllocateResponse()
+        if not ids:
+            # Protocol-legal: a container in the pod that requests no TPUs.
+            return resp
+        chips = [self.mesh.by_id[i] for i in ids]
+        for mc in chips:
+            resp.devices.add(
+                container_path=mc.chip.dev_path,
+                host_path=mc.chip.dev_path,
+                permissions=self.config.device_permissions,
+            )
+        if self.config.libtpu_host_path and os.path.exists(
+            self.config.libtpu_host_path
+        ):
+            resp.mounts.add(
+                container_path=self.config.libtpu_container_path,
+                host_path=self.config.libtpu_host_path,
+                read_only=True,
+            )
+            resp.envs["TPU_LIBRARY_PATH"] = self.config.libtpu_container_path
+        resp.envs.update(self._tpu_env(chips))
+        resp.annotations[constants.POD_DEVICES_ANNOTATION] = ",".join(ids)
+        return resp
+
+    def _tpu_env(self, chips) -> Dict[str, str]:
+        """TPU runtime env describing the chips visible in the container.
+
+        The libtpu runtime discovers chips from /dev, but needs the topology
+        bounds when a *subset* of the host's chips is exposed; JAX reads
+        these through libtpu. Bounds are the bounding box of the allocated
+        coords when the set is an exact sub-box, else the full host bounds.
+        """
+        env = {
+            "TPU_CHIPS_PER_HOST_BOUNDS": self._bounds_str(chips),
+            "TPU_HOST_BOUNDS": "1,1,1",
+            "TPU_VISIBLE_CHIPS": ",".join(
+                str(mc.chip.index) for mc in chips
+            ),
+            "TPU_ACCELERATOR_TYPE": self.mesh.spec.chip_type,
+            "TPU_WORKER_ID": "0",
+            "TPU_SKIP_MDS_QUERY": "true",
+        }
+        return env
+
+    def _bounds_str(self, chips) -> str:
+        coords = [mc.coords for mc in chips]
+        lo = [min(c[d] for c in coords) for d in range(3)]
+        hi = [max(c[d] for c in coords) for d in range(3)]
+        dims = [hi[d] - lo[d] + 1 for d in range(3)]
+        if dims[0] * dims[1] * dims[2] == len(chips):
+            return ",".join(str(d) for d in dims)
+        return ",".join(str(b) for b in self.mesh.bounds)

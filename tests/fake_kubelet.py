@@ -1,0 +1,73 @@
+"""A fake kubelet for integration tests (SURVEY.md §4: "a fake kubelet ...
+is ~100 lines").
+
+Serves the Registration service on a `kubelet.sock` inside a tmp
+device-plugins dir, records registrations, and offers a DevicePlugin client
+to drive ListAndWatch/Allocate/GetPreferredAllocation against the plugin
+exactly the way the real kubelet does — over unix sockets.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent import futures
+from typing import List, Optional
+
+import grpc
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
+from k8s_device_plugin_tpu.api.grpc_defs import (
+    DevicePluginStub,
+    RegistrationServicer,
+    add_registration_servicer,
+)
+
+
+class FakeKubelet(RegistrationServicer):
+    def __init__(self, device_plugin_dir: str):
+        self.device_plugin_dir = device_plugin_dir
+        self.socket_path = os.path.join(
+            device_plugin_dir, constants.KUBELET_SOCKET_NAME
+        )
+        self.registrations: List[pb.RegisterRequest] = []
+        self.registered = threading.Event()
+        self._server: Optional[grpc.Server] = None
+
+    # Registration service --------------------------------------------------
+
+    def Register(self, request: pb.RegisterRequest, context) -> pb.Empty:
+        self.registrations.append(request)
+        self.registered.set()
+        return pb.Empty()
+
+    # Lifecycle --------------------------------------------------------------
+
+    def start(self) -> None:
+        os.makedirs(self.device_plugin_dir, exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        add_registration_servicer(self, self._server)
+        self._server.add_insecure_port(f"unix:{self.socket_path}")
+        self._server.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0.2).wait()
+            self._server = None
+
+    # Client side (kubelet → plugin) -----------------------------------------
+
+    def plugin_channel(self, endpoint: str) -> grpc.Channel:
+        sock = os.path.join(self.device_plugin_dir, endpoint)
+        ch = grpc.insecure_channel(f"unix:{sock}")
+        grpc.channel_ready_future(ch).result(timeout=5)
+        return ch
+
+    def plugin_stub(self, endpoint: Optional[str] = None) -> DevicePluginStub:
+        if endpoint is None:
+            assert self.registrations, "no plugin registered yet"
+            endpoint = self.registrations[-1].endpoint
+        return DevicePluginStub(self.plugin_channel(endpoint))

@@ -1,0 +1,276 @@
+"""DevicePlugin gRPC server integration tests over a fake kubelet.
+
+Covers SURVEY.md §2.4/§2.14 and BASELINE configs 1-3: registration,
+ListAndWatch, topology-preferred allocation, Allocate with device nodes +
+libtpu mount + TPU env, health re-advertisement with recovery, and the
+reference-compat substitution mode (shadowMap).
+"""
+
+import os
+import queue
+import threading
+
+import grpc
+import pytest
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
+from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+from k8s_device_plugin_tpu.server.plugin import PluginConfig, TpuDevicePlugin
+from k8s_device_plugin_tpu.topology.mesh import IciMesh
+from tests import fakes
+from tests.fake_kubelet import FakeKubelet
+
+
+@pytest.fixture
+def dp_dir(tmp_path):
+    d = tmp_path / "device-plugins"
+    d.mkdir()
+    return str(d)
+
+
+@pytest.fixture
+def kubelet(dp_dir):
+    k = FakeKubelet(dp_dir)
+    k.start()
+    yield k
+    k.stop()
+
+
+def make_plugin(tmp_path, dp_dir, chip_type="v5p", count=4, **cfg_kwargs):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), chip_type, count)
+    chips = PyTpuInfo().scan(accel, dev)
+    mesh = IciMesh(chips)
+    cfg = PluginConfig(
+        device_plugin_dir=dp_dir,
+        libtpu_host_path=cfg_kwargs.pop("libtpu_host_path", ""),
+        **cfg_kwargs,
+    )
+    return TpuDevicePlugin(mesh, config=cfg)
+
+
+@pytest.fixture
+def plugin(tmp_path, dp_dir, kubelet):
+    p = make_plugin(tmp_path, dp_dir)
+    p.serve()
+    yield p
+    p.stop()
+
+
+def recv_stream(stub, out: queue.Queue, stop: threading.Event):
+    try:
+        for resp in stub.ListAndWatch(pb.Empty()):
+            out.put(resp)
+            if stop.is_set():
+                break
+    except grpc.RpcError:
+        pass
+
+
+def test_register_with_kubelet(plugin, kubelet):
+    assert kubelet.registered.wait(timeout=5)
+    req = kubelet.registrations[-1]
+    assert req.resource_name == "google.com/tpu"
+    assert req.version == "v1beta1"
+    assert req.endpoint == constants.PLUGIN_SOCKET_NAME
+    assert req.options.get_preferred_allocation_available
+
+
+def test_get_device_plugin_options(plugin, kubelet):
+    stub = kubelet.plugin_stub()
+    opts = stub.GetDevicePluginOptions(pb.Empty())
+    assert opts.get_preferred_allocation_available
+    assert not opts.pre_start_required
+
+
+def test_list_and_watch_initial_list(plugin, kubelet):
+    stub = kubelet.plugin_stub()
+    resp = next(iter(stub.ListAndWatch(pb.Empty())))
+    assert len(resp.devices) == 4
+    assert all(d.health == constants.HEALTHY for d in resp.devices)
+    assert all(d.ID.startswith("tpu-0000:") for d in resp.devices)
+    # NUMA topology hints are attached (fake tree pins chips to node 0).
+    assert resp.devices[0].topology.nodes[0].ID == 0
+
+
+def test_health_transition_readvertises_and_recovers(plugin, kubelet):
+    stub = kubelet.plugin_stub()
+    out: queue.Queue = queue.Queue()
+    stop = threading.Event()
+    t = threading.Thread(
+        target=recv_stream, args=(stub, out, stop), daemon=True
+    )
+    t.start()
+    first = out.get(timeout=5)
+    assert all(d.health == constants.HEALTHY for d in first.devices)
+
+    bad = plugin.mesh.ids[0]
+    plugin.notify_health(bad, healthy=False)
+    second = out.get(timeout=5)
+    sick = {d.ID: d.health for d in second.devices}
+    assert sick[bad] == constants.UNHEALTHY
+    assert sum(1 for h in sick.values() if h == constants.UNHEALTHY) == 1
+
+    # Recovery path — the reference can't do this (server.go:170 FIXME).
+    plugin.notify_health(bad, healthy=True)
+    third = out.get(timeout=5)
+    assert all(d.health == constants.HEALTHY for d in third.devices)
+    stop.set()
+
+
+def test_get_preferred_allocation_is_adjacent(plugin, kubelet):
+    stub = kubelet.plugin_stub()
+    req = pb.PreferredAllocationRequest()
+    req.container_requests.add(
+        available_deviceIDs=plugin.mesh.ids, allocation_size=2
+    )
+    resp = stub.GetPreferredAllocation(req)
+    picked = list(resp.container_responses[0].deviceIDs)
+    assert len(picked) == 2
+    assert plugin.mesh.hops(picked[0], picked[1]) == 1
+
+
+def test_allocate_returns_devices_env_annotations(plugin, kubelet):
+    stub = kubelet.plugin_stub()
+    ids = plugin.mesh.ids[:2]
+    req = pb.AllocateRequest()
+    req.container_requests.add(devicesIDs=ids)
+    resp = stub.Allocate(req)
+    cresp = resp.container_responses[0]
+    # Device nodes for exactly the allocated chips.
+    host_paths = sorted(d.host_path for d in cresp.devices)
+    assert host_paths == sorted(
+        plugin.mesh.by_id[i].chip.dev_path for i in ids
+    )
+    assert all(d.permissions == "rwm" for d in cresp.devices)
+    # TPU runtime env describes the sub-slice.
+    assert cresp.envs["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert cresp.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,1,1"
+    assert cresp.envs["TPU_ACCELERATOR_TYPE"] == "v5p"
+    # Real ids recorded for the controller.
+    assert (
+        cresp.annotations[constants.POD_DEVICES_ANNOTATION] == ",".join(ids)
+    )
+    # State marked allocated.
+    assert set(ids).issubset(plugin.state.allocated)
+
+
+def test_allocate_whole_host_bounds(plugin, kubelet):
+    stub = kubelet.plugin_stub()
+    req = pb.AllocateRequest()
+    req.container_requests.add(devicesIDs=plugin.mesh.ids)
+    resp = stub.Allocate(req)
+    env = resp.container_responses[0].envs
+    assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+
+
+def test_allocate_unknown_id_rejected(plugin, kubelet):
+    stub = kubelet.plugin_stub()
+    req = pb.AllocateRequest()
+    req.container_requests.add(devicesIDs=["tpu-bogus"])
+    with pytest.raises(grpc.RpcError) as exc:
+        stub.Allocate(req)
+    assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_allocate_mounts_libtpu_when_present(tmp_path, dp_dir, kubelet):
+    libtpu = tmp_path / "libtpu.so"
+    libtpu.write_bytes(b"\x7fELF")
+    p = make_plugin(tmp_path, dp_dir, libtpu_host_path=str(libtpu))
+    p.serve()
+    try:
+        stub = kubelet.plugin_stub()
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=p.mesh.ids[:1])
+        resp = stub.Allocate(req)
+        cresp = resp.container_responses[0]
+        assert len(cresp.mounts) == 1
+        assert cresp.mounts[0].host_path == str(libtpu)
+        assert cresp.mounts[0].read_only
+        assert cresp.envs["TPU_LIBRARY_PATH"] == cresp.mounts[0].container_path
+    finally:
+        p.stop()
+
+
+def test_substitution_mode_records_shadow_map(tmp_path, dp_dir, kubelet):
+    p = make_plugin(tmp_path, dp_dir, substitute_on_allocate=True)
+    p.serve()
+    try:
+        stub = kubelet.plugin_stub()
+        ids = p.mesh.ids
+        # Kubelet "arbitrarily" picks a diagonal (non-adjacent) pair.
+        diagonal = [ids[0], ids[3]]
+        assert p.mesh.hops(*diagonal) == 2
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=diagonal)
+        resp = stub.Allocate(req)
+        got = sorted(
+            d.host_path for d in resp.container_responses[0].devices
+        )
+        # The plugin substituted an adjacent pair...
+        real = resp.container_responses[0].annotations[
+            constants.POD_DEVICES_ANNOTATION
+        ].split(",")
+        assert p.mesh.hops(real[0], real[1]) == 1
+        assert len(got) == 2
+        # ...and recorded the kubeletID→realID mapping for reconciliation.
+        assert p.shadow_map  # non-empty
+        for k, v in p.shadow_map.items():
+            assert k in diagonal and v in real
+    finally:
+        p.stop()
+
+
+def test_allocate_multi_container_bad_one_leaks_nothing(plugin, kubelet):
+    # A bad container in the request must not leak allocation state from the
+    # good containers planned before it.
+    stub = kubelet.plugin_stub()
+    req = pb.AllocateRequest()
+    req.container_requests.add(devicesIDs=plugin.mesh.ids[:2])
+    req.container_requests.add(devicesIDs=["tpu-bogus"])
+    with pytest.raises(grpc.RpcError):
+        stub.Allocate(req)
+    assert plugin.state.allocated == set()
+
+
+def test_allocate_empty_container_request_ok(plugin, kubelet):
+    # Protocol-legal: a pod container that requests no TPUs.
+    stub = kubelet.plugin_stub()
+    req = pb.AllocateRequest()
+    req.container_requests.add(devicesIDs=[])
+    resp = stub.Allocate(req)
+    cresp = resp.container_responses[0]
+    assert len(cresp.devices) == 0
+    assert len(cresp.envs) == 0
+
+
+def test_substitution_mode_still_rejects_bogus_ids(tmp_path, dp_dir, kubelet):
+    p = make_plugin(tmp_path, dp_dir, substitute_on_allocate=True)
+    p.serve()
+    try:
+        stub = kubelet.plugin_stub()
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=["tpu-bogus"])
+        with pytest.raises(grpc.RpcError) as exc:
+            stub.Allocate(req)
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "tpu-bogus" not in p.shadow_map
+        assert p.state.allocated == set()
+    finally:
+        p.stop()
+
+
+def test_restart_reuses_socket(tmp_path, dp_dir, kubelet):
+    p = make_plugin(tmp_path, dp_dir)
+    p.serve()
+    p.stop()
+    assert not os.path.exists(p.config.socket_path)
+    p2 = make_plugin(tmp_path, dp_dir)
+    p2.serve()  # must not fail on leftover socket state
+    try:
+        stub = kubelet.plugin_stub()
+        resp = next(iter(stub.ListAndWatch(pb.Empty())))
+        assert len(resp.devices) == 4
+    finally:
+        p2.stop()
