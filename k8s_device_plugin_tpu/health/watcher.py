@@ -1,0 +1,112 @@
+"""Chip health watcher.
+
+The TPU-native analog of the reference's XID watcher
+(/root/reference/nvidia.go:51-102): the reference registers for NVML
+XidCriticalError events and polls WaitForEvent on a 5 s loop; TPUs expose no
+event fd, so this polls per-chip health through the discovery backend
+(device node presence, PCI enable state, health attribute — see
+tpuinfo_chip_health) on the same 5 s cadence.
+
+Differences from the reference, both deliberate:
+
+* **Recovery**: transitions are reported in both directions; the reference
+  marks devices Unhealthy forever (FIXME /root/reference/server.go:170).
+* **Scan-failure blast radius**: if the whole sysfs tree becomes unreadable,
+  every chip is reported unhealthy — the analog of the reference's
+  empty-UUID event ⇒ all devices unhealthy (/root/reference/nvidia.go:88-93).
+
+``DP_DISABLE_HEALTHCHECKS=all`` (same env contract as the reference,
+/root/reference/server.go:32-33,231-242) disables the watcher.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable, Dict, Optional, Sequence
+
+from ..api import constants
+from ..discovery.chips import TpuChip
+
+log = logging.getLogger(__name__)
+
+HealthCallback = Callable[[str, bool], None]  # (chip_id, healthy)
+
+
+def healthchecks_disabled() -> bool:
+    v = os.environ.get(constants.ENV_DISABLE_HEALTHCHECKS, "")
+    return "all" in v.split(",")
+
+
+class HealthWatcher:
+    """Polls chip health and reports transitions to a callback.
+
+    The callback contract matches TpuDevicePlugin.notify_health: it is
+    invoked once per chip per transition (not per poll), from the watcher
+    thread.
+    """
+
+    def __init__(
+        self,
+        backend,
+        sysfs_accel_dir: str,
+        dev_dir: str,
+        chips: Sequence[TpuChip],
+        callback: HealthCallback,
+        interval_s: float = 5.0,
+    ):
+        self._backend = backend
+        self._sysfs = sysfs_accel_dir
+        self._dev = dev_dir
+        self._chips = list(chips)
+        self._callback = callback
+        self._interval = interval_s
+        self._last: Dict[str, bool] = {c.device_id_str: True for c in self._chips}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if healthchecks_disabled():
+            log.warning(
+                "%s contains 'all'; health checks disabled",
+                constants.ENV_DISABLE_HEALTHCHECKS,
+            )
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-health-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval + 2)
+            self._thread = None
+
+    def poll_once(self) -> None:
+        """One health sweep; split out for tests and for an initial
+        synchronous check before serving."""
+        for chip in self._chips:
+            cid = chip.device_id_str
+            try:
+                healthy = bool(
+                    self._backend.chip_health(self._sysfs, self._dev, chip.index)
+                )
+            except OSError as e:
+                # Whole-tree failure (or chip directory gone): unhealthy.
+                log.error("health probe failed for %s: %s", cid, e)
+                healthy = False
+            if healthy != self._last[cid]:
+                self._last[cid] = healthy
+                self._callback(cid, healthy)
+
+    def _run(self) -> None:
+        log.info(
+            "health watcher started: %d chips, %.1fs interval",
+            len(self._chips),
+            self._interval,
+        )
+        while not self._stop.wait(self._interval):
+            self.poll_once()

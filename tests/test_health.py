@@ -1,0 +1,134 @@
+"""Health path fault-injection tests (SURVEY.md §2.3, BASELINE config 5).
+
+Injects faults through the fake sysfs tree and asserts the full path:
+sysfs flip → watcher poll → plugin notify → ListAndWatch re-advertisement —
+including the recovery direction the reference lacks.
+"""
+
+import queue
+import threading
+
+import pytest
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
+from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+from k8s_device_plugin_tpu.health.watcher import HealthWatcher, healthchecks_disabled
+from k8s_device_plugin_tpu.server.plugin import PluginConfig, TpuDevicePlugin
+from k8s_device_plugin_tpu.topology.mesh import IciMesh
+from tests import fakes
+from tests.fake_kubelet import FakeKubelet
+
+
+@pytest.fixture
+def node(tmp_path):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5p", 4)
+    chips = PyTpuInfo().scan(accel, dev)
+    return accel, dev, chips
+
+
+def test_watcher_reports_transitions_once(node):
+    accel, dev, chips = node
+    events = []
+    w = HealthWatcher(
+        PyTpuInfo(), accel, dev, chips, lambda cid, h: events.append((cid, h))
+    )
+    w.poll_once()
+    assert events == []  # all healthy, no transitions
+    fakes.set_chip_health(accel, 0, False)
+    w.poll_once()
+    w.poll_once()  # no duplicate report on steady state
+    assert events == [(chips[0].device_id_str, False)]
+    fakes.set_chip_health(accel, 0, True)
+    w.poll_once()
+    assert events[-1] == (chips[0].device_id_str, True)
+
+
+def test_watcher_dev_node_removal(node):
+    accel, dev, chips = node
+    events = []
+    w = HealthWatcher(
+        PyTpuInfo(), accel, dev, chips, lambda cid, h: events.append((cid, h))
+    )
+    fakes.remove_dev_node(dev, 2)
+    w.poll_once()
+    assert events == [(chips[2].device_id_str, False)]
+
+
+def test_watcher_whole_tree_failure_marks_all_unhealthy(node, tmp_path):
+    accel, dev, chips = node
+    events = []
+    w = HealthWatcher(
+        PyTpuInfo(), accel, dev, chips, lambda cid, h: events.append((cid, h))
+    )
+    import shutil
+
+    shutil.rmtree(accel)  # sysfs gone: every chip must go unhealthy
+    w.poll_once()
+    assert sorted(events) == sorted(
+        (c.device_id_str, False) for c in chips
+    )
+
+
+def test_healthchecks_disabled_env(monkeypatch, node):
+    accel, dev, chips = node
+    monkeypatch.setenv(constants.ENV_DISABLE_HEALTHCHECKS, "all")
+    assert healthchecks_disabled()
+    w = HealthWatcher(PyTpuInfo(), accel, dev, chips, lambda *a: None,
+                      interval_s=0.01)
+    w.start()
+    assert w._thread is None  # never started
+    monkeypatch.setenv(constants.ENV_DISABLE_HEALTHCHECKS, "xids")
+    assert not healthchecks_disabled()
+
+
+def test_end_to_end_sysfs_to_listandwatch(tmp_path, node):
+    """BASELINE config 5: injected unhealthy chip is re-advertised, then
+    recovers."""
+    accel, dev, chips = node
+    dp_dir = tmp_path / "dp"
+    dp_dir.mkdir()
+    kubelet = FakeKubelet(str(dp_dir))
+    kubelet.start()
+    plugin = TpuDevicePlugin(
+        IciMesh(chips),
+        config=PluginConfig(device_plugin_dir=str(dp_dir), libtpu_host_path=""),
+    )
+    plugin.serve()
+    watcher = HealthWatcher(
+        PyTpuInfo(), accel, dev, chips, plugin.notify_health, interval_s=0.05
+    )
+    watcher.start()
+    try:
+        stub = kubelet.plugin_stub()
+        out: queue.Queue = queue.Queue()
+        stop = threading.Event()
+
+        def recv():
+            try:
+                for resp in stub.ListAndWatch(pb.Empty()):
+                    out.put(resp)
+                    if stop.is_set():
+                        break
+            except Exception:
+                pass
+
+        threading.Thread(target=recv, daemon=True).start()
+        first = out.get(timeout=5)
+        assert all(d.health == constants.HEALTHY for d in first.devices)
+
+        fakes.set_chip_health(accel, 1, False)
+        second = out.get(timeout=5)
+        by_id = {d.ID: d.health for d in second.devices}
+        assert by_id[chips[1].device_id_str] == constants.UNHEALTHY
+        # Unhealthy chip is excluded from placement.
+        assert chips[1].device_id_str not in plugin.state.select(3)
+
+        fakes.set_chip_health(accel, 1, True)
+        third = out.get(timeout=5)
+        assert all(d.health == constants.HEALTHY for d in third.devices)
+        stop.set()
+    finally:
+        watcher.stop()
+        plugin.stop()
+        kubelet.stop()
