@@ -1,0 +1,8 @@
+"""Entrypoint: python -m k8s_device_plugin_tpu [flags]."""
+
+import sys
+
+from .supervisor.main import main
+
+if __name__ == "__main__":
+    sys.exit(main())

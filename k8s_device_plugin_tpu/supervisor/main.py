@@ -1,0 +1,265 @@
+"""Process supervisor: discovery → serve → register → watch → restart.
+
+The analog of the reference's main loop (/root/reference/main.go:23-113):
+build everything, serve + register, then sit on an event queue fed by the
+fs watcher and signal handlers; a recreated kubelet.sock (kubelet restart)
+or SIGHUP tears the plugin down and rebuilds it, SIGTERM/SIGINT exits
+cleanly.
+
+Deliberate differences from the reference:
+
+* **CPU-only nodes serve 0 devices** instead of blocking before registration
+  (/root/reference/main.go:33-41 blocks forever without NVML): the TPU
+  backend needs no accelerator library to answer "no chips", and a
+  registered plugin reporting 0 devices keeps the DaemonSet observable
+  (BASELINE config 1). SIGHUP re-runs discovery, so chips appearing later
+  (driver install) are picked up without a pod restart.
+* **The controller runs in a thread**, so the supervisor's event loop stays
+  live; the reference's controller.Run blocks the select loop, making its
+  restart-on-fsnotify effectively unreachable (SURVEY.md §3.1 note).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import os
+import queue
+import signal
+import sys
+from typing import List, Optional
+
+from ..api import constants
+from ..discovery.chips import TpuChip, parse_gke_accelerator_label, spec_for
+from ..discovery.scanner import (
+    DEFAULT_DEV,
+    DEFAULT_NUMA_DIR,
+    DEFAULT_SYSFS_ACCEL,
+    get_backend,
+)
+from ..health.watcher import HealthWatcher
+from ..server.plugin import PluginConfig, TpuDevicePlugin
+from ..topology.mesh import IciMesh
+from ..topology.placement import PlacementState
+from .watchers import FsWatcher, SignalWatcher
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class DaemonConfig:
+    node_name: str = ""
+    device_plugin_dir: str = constants.DEVICE_PLUGIN_PATH
+    sysfs_accel_dir: str = DEFAULT_SYSFS_ACCEL
+    dev_dir: str = DEFAULT_DEV
+    numa_dir: str = DEFAULT_NUMA_DIR
+    resource_name: str = constants.RESOURCE_NAME
+    # Override the chip type detected from PCI ids (e.g. from the GKE node
+    # label cloud.google.com/gke-tpu-accelerator).
+    accelerator_type: str = ""
+    libtpu_host_path: str = "/home/kubernetes/bin/libtpu.so"
+    substitute_on_allocate: bool = False
+    health_interval_s: float = 5.0
+    resync_interval_s: float = 30.0
+    enable_controller: bool = True
+    kubeconfig: str = ""
+    prefer_native_backend: bool = True
+
+
+class Daemon:
+    """One node's device-plugin process."""
+
+    def __init__(self, cfg: DaemonConfig):
+        self.cfg = cfg
+        self.backend = get_backend(prefer_native=cfg.prefer_native_backend)
+        self.events: "queue.Queue" = queue.Queue()
+        self.plugin: Optional[TpuDevicePlugin] = None
+        self.health: Optional[HealthWatcher] = None
+        self.controller = None  # set by kube wiring when enabled
+        self._kube = None
+
+    # -- build/teardown of one plugin generation ---------------------------
+
+    def discover(self) -> List[TpuChip]:
+        chips = self.backend.scan(self.cfg.sysfs_accel_dir, self.cfg.dev_dir)
+        override = self.cfg.accelerator_type
+        if override:
+            chip_type = parse_gke_accelerator_label(override) or override
+            spec = spec_for(chip_type, len(chips))
+            chips = [
+                dataclasses.replace(
+                    c,
+                    chip_type=chip_type,
+                    hbm_bytes=spec.hbm_bytes or c.hbm_bytes,
+                    core_count=spec.cores_per_chip or c.core_count,
+                )
+                for c in chips
+            ]
+        log.info(
+            "discovered %d TPU chips (%s) via %s",
+            len(chips),
+            chips[0].chip_type if chips else "-",
+            self.backend.version(),
+        )
+        return chips
+
+    def build_and_serve(self) -> None:
+        chips = self.discover()
+        mesh = IciMesh(chips)
+        state = PlacementState(mesh)
+        self.plugin = TpuDevicePlugin(
+            mesh,
+            state=state,
+            config=PluginConfig(
+                resource_name=self.cfg.resource_name,
+                device_plugin_dir=self.cfg.device_plugin_dir,
+                libtpu_host_path=self.cfg.libtpu_host_path,
+                substitute_on_allocate=self.cfg.substitute_on_allocate,
+            ),
+        )
+        self.plugin.serve()
+        if chips:
+            self.health = HealthWatcher(
+                self.backend,
+                self.cfg.sysfs_accel_dir,
+                self.cfg.dev_dir,
+                chips,
+                self.plugin.notify_health,
+                interval_s=self.cfg.health_interval_s,
+            )
+            self.health.start()
+        self._start_kube_integration(mesh)
+
+    def _start_kube_integration(self, mesh: IciMesh) -> None:
+        """Node-annotation publishing + pod controller; soft-fails when no
+        API server is reachable (e.g. unit environments)."""
+        if not self.cfg.enable_controller:
+            return
+        try:
+            from ..controller.wiring import start_kube_integration
+
+            self.controller, self._kube = start_kube_integration(
+                self, mesh
+            )
+        except Exception as e:  # pragma: no cover - env-dependent
+            log.warning("kube integration disabled: %s", e)
+            self.controller = None
+
+    def teardown(self) -> None:
+        if self.controller is not None:
+            try:
+                self.controller.stop()
+            except Exception:
+                log.exception("controller stop failed")
+            self.controller = None
+        if self.health is not None:
+            self.health.stop()
+            self.health = None
+        if self.plugin is not None:
+            self.plugin.stop()
+            self.plugin = None
+
+    # -- supervisor loop ---------------------------------------------------
+
+    def run(self, max_iterations: Optional[int] = None) -> int:
+        """The restart loop. max_iterations bounds event-queue turns for
+        tests; None means run until SIGTERM/SIGINT."""
+        fs = FsWatcher(self.cfg.device_plugin_dir, self.events)
+        sigs = SignalWatcher(self.events)
+        fs.start()
+        sigs.start()
+        rc = 0
+        restart = True
+        iterations = 0
+        try:
+            while True:
+                if restart:
+                    self.teardown()
+                    try:
+                        self.build_and_serve()
+                    except Exception:
+                        log.exception("build/serve failed; will retry on "
+                                      "next kubelet event or SIGHUP")
+                    restart = False
+                if max_iterations is not None and iterations >= max_iterations:
+                    return rc
+                iterations += 1
+                try:
+                    kind, payload = self.events.get(timeout=1.0)
+                except queue.Empty:
+                    continue
+                if kind == "create" and payload == constants.KUBELET_SOCKET_NAME:
+                    log.info("kubelet socket recreated; restarting plugin")
+                    restart = True
+                elif kind == "signal" and payload == signal.SIGHUP:
+                    log.info("SIGHUP; restarting plugin")
+                    restart = True
+                elif kind == "signal" and payload in (
+                    signal.SIGTERM,
+                    signal.SIGINT,
+                ):
+                    log.info("signal %d; shutting down", payload)
+                    return 0
+        finally:
+            self.teardown()
+            fs.stop()
+            sigs.stop()
+
+
+def parse_args(argv) -> DaemonConfig:
+    p = argparse.ArgumentParser(
+        prog="tpu-device-plugin",
+        description="TPU-native Kubernetes device plugin",
+    )
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument("--device-plugin-dir", default=constants.DEVICE_PLUGIN_PATH)
+    p.add_argument("--sysfs-accel-dir", default=DEFAULT_SYSFS_ACCEL)
+    p.add_argument("--dev-dir", default=DEFAULT_DEV)
+    p.add_argument("--resource-name", default=constants.RESOURCE_NAME)
+    p.add_argument(
+        "--accelerator-type",
+        default=os.environ.get("TPU_ACCELERATOR_TYPE", ""),
+        help="override chip type, e.g. v5p or a GKE accelerator label value",
+    )
+    p.add_argument(
+        "--libtpu-path", default="/home/kubernetes/bin/libtpu.so"
+    )
+    p.add_argument(
+        "--substitute-on-allocate",
+        action="store_true",
+        help="reference-compatible Allocate-time substitution for kubelets "
+        "without GetPreferredAllocation",
+    )
+    p.add_argument("--health-interval", type=float, default=5.0)
+    p.add_argument("--resync-interval", type=float, default=30.0)
+    p.add_argument("--no-controller", action="store_true")
+    p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
+    p.add_argument("--python-backend", action="store_true",
+                   help="skip libtpuinfo.so, use the Python scanner")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    a = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if a.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    return DaemonConfig(
+        node_name=a.node_name,
+        device_plugin_dir=a.device_plugin_dir,
+        sysfs_accel_dir=a.sysfs_accel_dir,
+        dev_dir=a.dev_dir,
+        resource_name=a.resource_name,
+        accelerator_type=a.accelerator_type,
+        libtpu_host_path=a.libtpu_path,
+        substitute_on_allocate=a.substitute_on_allocate,
+        health_interval_s=a.health_interval,
+        resync_interval_s=a.resync_interval,
+        enable_controller=not a.no_controller,
+        kubeconfig=a.kubeconfig,
+        prefer_native_backend=not a.python_backend,
+    )
+
+
+def main(argv=None) -> int:
+    cfg = parse_args(argv if argv is not None else sys.argv[1:])
+    return Daemon(cfg).run()

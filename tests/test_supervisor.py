@@ -1,0 +1,211 @@
+"""Supervisor lifecycle tests (SURVEY.md §2.1/§2.15, BASELINE config 1).
+
+Covers the restart loop in-process (event queue driven) and the real CLI
+end-to-end as a subprocess: register, report devices, SIGHUP rebuild,
+SIGTERM clean exit.
+"""
+
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
+from k8s_device_plugin_tpu.supervisor.main import Daemon, DaemonConfig, parse_args
+from k8s_device_plugin_tpu.supervisor.watchers import FsWatcher
+from tests import fakes
+from tests.fake_kubelet import FakeKubelet
+
+
+def daemon_config(tmp_path, dp_dir, **kw):
+    return DaemonConfig(
+        device_plugin_dir=str(dp_dir),
+        sysfs_accel_dir=os.path.join(str(tmp_path), "sys", "class", "accel"),
+        dev_dir=os.path.join(str(tmp_path), "dev"),
+        libtpu_host_path="",
+        enable_controller=False,
+        prefer_native_backend=False,
+        **kw,
+    )
+
+
+@pytest.fixture
+def dp_dir(tmp_path):
+    d = tmp_path / "dp"
+    d.mkdir()
+    return d
+
+
+@pytest.fixture
+def kubelet(dp_dir):
+    k = FakeKubelet(str(dp_dir))
+    k.start()
+    yield k
+    k.stop()
+
+
+def run_daemon_thread(daemon):
+    t = threading.Thread(target=daemon.run, daemon=True)
+    t.start()
+    return t
+
+
+def stop_daemon(daemon, thread):
+    daemon.events.put(("signal", signal.SIGTERM))
+    thread.join(timeout=10)
+    assert not thread.is_alive()
+
+
+def test_cpu_only_node_serves_zero_devices(tmp_path, dp_dir, kubelet):
+    # BASELINE config 1: no accel tree at all; plugin still registers and
+    # reports 0 devices instead of blocking.
+    daemon = Daemon(daemon_config(tmp_path, dp_dir))
+    t = run_daemon_thread(daemon)
+    try:
+        assert kubelet.registered.wait(10)
+        stub = kubelet.plugin_stub()
+        resp = next(iter(stub.ListAndWatch(pb.Empty())))
+        assert len(resp.devices) == 0
+    finally:
+        stop_daemon(daemon, t)
+
+
+def test_v4_node_serves_four_devices(tmp_path, dp_dir, kubelet):
+    fakes.make_fake_tpu_node(str(tmp_path), "v4", 4)
+    daemon = Daemon(daemon_config(tmp_path, dp_dir))
+    t = run_daemon_thread(daemon)
+    try:
+        assert kubelet.registered.wait(10)
+        stub = kubelet.plugin_stub()
+        resp = next(iter(stub.ListAndWatch(pb.Empty())))
+        assert len(resp.devices) == 4
+        # Health watcher comes up just after registration; allow the daemon
+        # thread a moment to assign it.
+        deadline = time.time() + 5
+        while daemon.health is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert daemon.health is not None  # watcher running on TPU nodes
+    finally:
+        stop_daemon(daemon, t)
+
+
+def test_accelerator_type_override(tmp_path, dp_dir, kubelet):
+    fakes.make_fake_tpu_node(str(tmp_path), "v4", 4)
+    daemon = Daemon(
+        daemon_config(tmp_path, dp_dir, accelerator_type="tpu-v5p-slice")
+    )
+    t = run_daemon_thread(daemon)
+    try:
+        assert kubelet.registered.wait(10)
+        assert daemon.plugin.mesh.spec.chip_type == "v5p"
+    finally:
+        stop_daemon(daemon, t)
+
+
+def test_kubelet_socket_recreate_triggers_restart(tmp_path, dp_dir, kubelet):
+    fakes.make_fake_tpu_node(str(tmp_path), "v5p", 4)
+    daemon = Daemon(daemon_config(tmp_path, dp_dir))
+    t = run_daemon_thread(daemon)
+    try:
+        assert kubelet.registered.wait(10)
+        first_plugin = daemon.plugin
+        kubelet.registered.clear()
+        daemon.events.put(("create", constants.KUBELET_SOCKET_NAME))
+        assert kubelet.registered.wait(10)  # re-registered
+        assert daemon.plugin is not first_plugin  # rebuilt
+        assert len(kubelet.registrations) == 2
+    finally:
+        stop_daemon(daemon, t)
+
+
+def test_sighup_triggers_rediscovery(tmp_path, dp_dir, kubelet):
+    # Start with 0 chips; hot-plug chips; SIGHUP re-discovers them.
+    daemon = Daemon(daemon_config(tmp_path, dp_dir))
+    t = run_daemon_thread(daemon)
+    try:
+        assert kubelet.registered.wait(10)
+        assert len(daemon.plugin.mesh.ids) == 0
+        fakes.make_fake_tpu_node(str(tmp_path), "v5e", 8)
+        kubelet.registered.clear()
+        daemon.events.put(("signal", signal.SIGHUP))
+        assert kubelet.registered.wait(10)
+        assert len(daemon.plugin.mesh.ids) == 8
+    finally:
+        stop_daemon(daemon, t)
+
+
+def test_fs_watcher_sees_socket_recreate(tmp_path):
+    out: queue.Queue = queue.Queue()
+    w = FsWatcher(str(tmp_path), out)
+    w.start()
+    try:
+        time.sleep(0.2)
+        p = tmp_path / "kubelet.sock"
+        p.write_text("")
+        kind, name = out.get(timeout=5)
+        assert (kind, name) == ("create", "kubelet.sock")
+        p.unlink()
+        kind, name = out.get(timeout=5)
+        assert (kind, name) == ("delete", "kubelet.sock")
+    finally:
+        w.stop()
+
+
+def test_parse_args_defaults_and_flags(monkeypatch):
+    monkeypatch.setenv("NODE_NAME", "node-7")
+    cfg = parse_args([])
+    assert cfg.node_name == "node-7"
+    assert cfg.resource_name == constants.RESOURCE_NAME
+    assert cfg.enable_controller
+    cfg = parse_args(
+        ["--no-controller", "--substitute-on-allocate", "--python-backend",
+         "--accelerator-type", "v5e"]
+    )
+    assert not cfg.enable_controller
+    assert cfg.substitute_on_allocate
+    assert not cfg.prefer_native_backend
+    assert cfg.accelerator_type == "v5e"
+
+
+def test_cli_end_to_end_subprocess(tmp_path, dp_dir, kubelet):
+    """The real daemon binary: register → devices → SIGHUP → SIGTERM."""
+    fakes.make_fake_tpu_node(str(tmp_path), "v5p", 4)
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "k8s_device_plugin_tpu",
+            "--device-plugin-dir", str(dp_dir),
+            "--sysfs-accel-dir", os.path.join(str(tmp_path), "sys", "class", "accel"),
+            "--dev-dir", os.path.join(str(tmp_path), "dev"),
+            "--libtpu-path", "",
+            "--no-controller",
+            "--health-interval", "0.2",
+        ],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        assert kubelet.registered.wait(15)
+        stub = kubelet.plugin_stub()
+        resp = next(iter(stub.ListAndWatch(pb.Empty())))
+        assert len(resp.devices) == 4
+
+        kubelet.registered.clear()
+        proc.send_signal(signal.SIGHUP)
+        assert kubelet.registered.wait(15)
+
+        proc.terminate()
+        rc = proc.wait(timeout=15)
+        assert rc == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
