@@ -1,0 +1,272 @@
+"""Pod reconciliation controller.
+
+The analog of the reference's informer controller
+(/root/reference/controller.go:75-249): watch this node's pods that request
+our resource, and
+
+* on pod **update** — once the kubelet has written its device-manager
+  checkpoint, translate the kubelet's device IDs for the pod through the
+  plugin's shadow map (Allocate-time substitution mode) and patch the *real*
+  chip IDs onto the pod annotation, so the scheduler extender knows which
+  physical chips the pod got (/root/reference/controller.go:173-225);
+* on pod **delete** — free the pod's chips in the placement state
+  (/root/reference/controller.go:148-171);
+* at **startup** — rebuild allocation state from the checkpoint, which the
+  reference loses across restarts (SURVEY.md §5 "known gap").
+
+Implementation shape: a list+watch loop feeding a work queue, one worker
+draining it with bounded retries — the same informer/workqueue pattern as
+client-go, sized to this plugin's needs.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, Optional, Set
+
+from ..api import constants
+from ..kube import checkpoint as ckpt
+from ..kube.client import KubeClient, KubeError
+from ..utils.podresources import is_tpu_pod
+
+log = logging.getLogger(__name__)
+
+
+class Controller:
+    def __init__(
+        self,
+        client: KubeClient,
+        plugin,  # TpuDevicePlugin
+        node_name: str,
+        resource_name: str = constants.RESOURCE_NAME,
+        checkpoint_path: str = constants.KUBELET_CHECKPOINT,
+        devices_annotation: str = constants.POD_DEVICES_ANNOTATION,
+        watch_timeout_s: int = 60,
+        max_retries: int = 5,
+        resync_interval_s: float = 30.0,
+    ):
+        self.client = client
+        self.plugin = plugin
+        self.node_name = node_name
+        self.resource_name = resource_name
+        self.checkpoint_path = checkpoint_path
+        self.devices_annotation = devices_annotation
+        self.watch_timeout_s = watch_timeout_s
+        self.max_retries = max_retries
+        self.resync_interval_s = resync_interval_s
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._threads = []
+        # pod uid -> chip ids we believe it holds (for delete-time free when
+        # the annotation is missing).
+        self._pod_devices: Dict[str, Set[str]] = {}
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self.rebuild_state()
+        self._stop.clear()
+        for name, target in (
+            ("pod-informer", self._informer_loop),
+            ("pod-worker", self._worker_loop),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._queue.put(None)
+        for t in self._threads:
+            t.join(timeout=self.watch_timeout_s + 5)
+        self._threads = []
+
+    # ------------------------------------------------------------------
+    # Startup state rebuild (reference gap — SURVEY.md §5)
+    # ------------------------------------------------------------------
+
+    def rebuild_state(self) -> None:
+        """Reconstruct allocated-chip state from the kubelet checkpoint,
+        keeping only entries whose pod still exists on this node."""
+        entries = ckpt.read_checkpoint(self.checkpoint_path)
+        by_pod = ckpt.device_ids_by_pod(entries, self.resource_name)
+        if not by_pod:
+            return
+        try:
+            pods = self.client.list_pods(node_name=self.node_name)
+            live_uids = {
+                p["metadata"]["uid"] for p in pods.get("items", [])
+            }
+        except (KubeError, OSError) as e:
+            log.warning(
+                "state rebuild: pod list failed (%s); trusting checkpoint", e
+            )
+            live_uids = set(by_pod)
+        allocated = []
+        for uid, ids in by_pod.items():
+            if uid not in live_uids:
+                continue
+            real = [self.plugin.shadow_map.get(i, i) for i in ids]
+            known = [r for r in real if r in self.plugin.mesh.by_id]
+            allocated.extend(known)
+            if known:
+                self._pod_devices[uid] = set(known)
+        if allocated:
+            self.plugin.state.allocate(allocated)
+            log.info(
+                "rebuilt allocation state from checkpoint: %d chips across "
+                "%d pods", len(allocated), len(self._pod_devices),
+            )
+
+    # ------------------------------------------------------------------
+    # Informer
+    # ------------------------------------------------------------------
+
+    def _informer_loop(self) -> None:
+        resource_version = ""
+        last_list = 0.0
+        while not self._stop.is_set():
+            try:
+                # Periodic resync (informer-style): catches pods whose
+                # kubelet checkpoint entry appeared after their last pod
+                # event, so reconciliation never needs a fresh event.
+                if time.time() - last_list > self.resync_interval_s:
+                    resource_version = ""
+                if not resource_version:
+                    pods = self.client.list_pods(node_name=self.node_name)
+                    last_list = time.time()
+                    resource_version = (
+                        pods.get("metadata", {}).get("resourceVersion", "")
+                    )
+                    for pod in pods.get("items", []):
+                        self._enqueue("MODIFIED", pod)
+                for etype, obj in self.client.watch_pods(
+                    node_name=self.node_name,
+                    resource_version=resource_version,
+                    timeout_seconds=min(
+                        self.watch_timeout_s, int(self.resync_interval_s) or 1
+                    ),
+                ):
+                    if self._stop.is_set():
+                        return
+                    rv = obj.get("metadata", {}).get("resourceVersion")
+                    if rv:
+                        resource_version = rv
+                    if etype == "BOOKMARK":
+                        continue
+                    self._enqueue(etype, obj)
+            except KubeError as e:
+                if e.status_code == 410:  # resourceVersion too old: relist
+                    log.info("watch expired; relisting")
+                    resource_version = ""
+                else:
+                    log.warning("watch error: %s", e)
+                    self._stop.wait(2.0)
+            except OSError as e:
+                log.warning("watch connection error: %s", e)
+                self._stop.wait(2.0)
+
+    def _enqueue(self, etype: str, pod: dict, retries: int = 0) -> None:
+        if is_tpu_pod(pod, self.resource_name) or etype == "DELETED":
+            self._queue.put((etype, pod, retries))
+
+    # ------------------------------------------------------------------
+    # Worker
+    # ------------------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None or self._stop.is_set():
+                return
+            etype, pod, retries = item
+            try:
+                if etype == "DELETED":
+                    self._handle_delete(pod)
+                else:
+                    self._handle_update(pod)
+            except Exception as e:  # bounded retry, workqueue-style
+                if retries + 1 >= self.max_retries:
+                    log.error(
+                        "giving up on pod %s after %d tries: %s",
+                        pod.get("metadata", {}).get("name"),
+                        retries + 1,
+                        e,
+                    )
+                else:
+                    log.warning("pod event retry (%s): %s", etype, e)
+                    time.sleep(min(0.1 * 2**retries, 2.0))
+                    self._queue.put((etype, pod, retries + 1))
+
+    # reference updatePodFunc, /root/reference/controller.go:173-225
+    def _handle_update(self, pod: dict) -> None:
+        meta = pod.get("metadata", {})
+        uid = meta.get("uid", "")
+        annotations = meta.get("annotations") or {}
+        if self.devices_annotation in annotations:
+            # Already reconciled; just track for delete-time free.
+            ids = [
+                i
+                for i in annotations[self.devices_annotation].split(",")
+                if i in self.plugin.mesh.by_id
+            ]
+            if ids:
+                self._pod_devices[uid] = set(ids)
+            return
+        entries = ckpt.read_checkpoint(self.checkpoint_path)
+        kubelet_ids = ckpt.device_ids_by_pod(entries, self.resource_name).get(
+            uid
+        )
+        if not kubelet_ids:
+            return  # kubelet hasn't admitted the pod yet
+        # Translate through the shadow map and drain consumed entries
+        # (reference controller.go:200-210).
+        real = []
+        for kid in kubelet_ids:
+            rid = self.plugin.shadow_map.pop(kid, kid)
+            if rid in self.plugin.mesh.by_id:
+                real.append(rid)
+        if not real:
+            return
+        self.client.patch_pod_annotations(
+            meta.get("namespace", "default"),
+            meta.get("name", ""),
+            {self.devices_annotation: ",".join(sorted(real))},
+        )
+        self._pod_devices[uid] = set(real)
+        self.plugin.state.allocate(real)
+        log.info(
+            "reconciled pod %s/%s -> chips %s",
+            meta.get("namespace"),
+            meta.get("name"),
+            sorted(real),
+        )
+
+    # reference deletePodFunc, /root/reference/controller.go:148-171
+    def _handle_delete(self, pod: dict) -> None:
+        meta = pod.get("metadata", {})
+        uid = meta.get("uid", "")
+        annotations = meta.get("annotations") or {}
+        ids: Set[str] = set()
+        if self.devices_annotation in annotations:
+            ids = {
+                i
+                for i in annotations[self.devices_annotation].split(",")
+                if i
+            }
+        ids |= self._pod_devices.pop(uid, set())
+        if not ids:
+            return
+        self.plugin.state.free(ids)
+        self.plugin._bump()
+        log.info(
+            "freed chips %s from deleted pod %s/%s",
+            sorted(ids),
+            meta.get("namespace"),
+            meta.get("name"),
+        )

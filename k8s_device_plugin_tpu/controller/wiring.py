@@ -1,0 +1,89 @@
+"""Wires the kube-facing pieces onto a running daemon.
+
+The analog of the reference's post-Serve sequence
+(/root/reference/main.go:80-89): build the kube client, publish the node's
+topology annotation for the scheduler extender (RegisterToSched,
+/root/reference/server.go:287-309), and run the pod controller — except the
+controller runs in threads so the supervisor loop stays responsive
+(SURVEY.md §3.1 note on the reference's blocked select loop).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional, Tuple
+
+from ..api import constants
+from ..kube.client import KubeClient, KubeError
+from ..topology.mesh import IciMesh
+from ..topology.schema import NodeTopology
+from .controller import Controller
+
+log = logging.getLogger(__name__)
+
+
+def publish_node_topology(
+    client: KubeClient,
+    node_name: str,
+    mesh: IciMesh,
+    numa_nodes: int = 1,
+    annotation: str = constants.TOPOLOGY_ANNOTATION,
+    retries: int = 3,
+) -> NodeTopology:
+    """Publish the ICI topology as a node annotation, retrying on conflict
+    like the reference's patchNode loop (/root/reference/server.go:312-347).
+    Also sets a scheduler-friendly label with the mesh shape."""
+    topo = NodeTopology.from_mesh(mesh, numa_nodes=numa_nodes, hostname=node_name)
+    shape = "x".join(str(b) for b in mesh.bounds)
+    last: Optional[Exception] = None
+    for attempt in range(retries):
+        try:
+            client.patch_node_annotations(node_name, {annotation: topo.to_json()})
+            if mesh.mesh_chips:
+                client.patch_node_labels(
+                    node_name,
+                    {
+                        "google.com/tpu-topology": shape,
+                        "google.com/tpu-accelerator": mesh.spec.chip_type,
+                    },
+                )
+            log.info(
+                "published topology for %s: %s %s chips=%d",
+                node_name,
+                mesh.spec.chip_type,
+                shape,
+                len(mesh.mesh_chips),
+            )
+            return topo
+        except KubeError as e:
+            last = e
+            if e.status_code != 409:
+                raise
+            time.sleep(0.2 * (attempt + 1))
+    raise last  # type: ignore[misc]
+
+
+def start_kube_integration(daemon, mesh: IciMesh) -> Tuple[Controller, KubeClient]:
+    cfg = daemon.cfg
+    client = KubeClient.from_env(cfg.kubeconfig)
+    node_name = cfg.node_name or os.uname().nodename
+    numa = 1
+    try:
+        numa = daemon.backend.numa_node_count(cfg.numa_dir)
+    except OSError:
+        pass
+    publish_node_topology(client, node_name, mesh, numa_nodes=numa)
+    controller = Controller(
+        client,
+        daemon.plugin,
+        node_name=node_name,
+        resource_name=cfg.resource_name,
+        checkpoint_path=os.path.join(
+            cfg.device_plugin_dir, "kubelet_internal_checkpoint"
+        ),
+        resync_interval_s=cfg.resync_interval_s,
+    )
+    controller.start()
+    return controller, client

@@ -65,10 +65,12 @@ def spec_for(chip_type: str, chip_count: int = 0) -> AcceleratorSpec:
 
 
 def parse_gke_accelerator_label(value: str) -> Optional[str]:
-    """Map a GKE node label like 'tpu-v5p-slice' / 'tpu-v5-lite-podslice' /
-    'tpu-v4-podslice' to a chip type."""
+    """Map an accelerator name to a chip type. Accepts both GKE node label
+    values ('tpu-v5p-slice', 'tpu-v5-lite-podslice', 'tpu-v4-podslice') and
+    TPU VM accelerator-type strings ('v4-8', 'v5litepod-4', 'v5p-8',
+    'v6e-4'), since $TPU_ACCELERATOR_TYPE on real TPU VMs uses the latter."""
     v = value.lower()
-    if "v5-lite" in v or "v5e" in v:
+    if "v5-lite" in v or "v5lite" in v or "v5e" in v:
         return "v5e"
     for t in ("v6e", "v5p", "v4", "v3", "v2"):
         if t in v:

@@ -1,0 +1,252 @@
+"""Minimal Kubernetes REST client.
+
+Replaces the reference's vendored client-go (34 MB of k8s.io libraries,
+/root/reference/controller.go:29-52) with the small surface this plugin
+actually needs: in-cluster or kubeconfig auth, node get/patch, pod
+list/watch/patch. Built on `requests` (the only HTTP client in this image)
+over the plain Kubernetes REST API.
+
+Auth resolution order mirrors client-go's
+(/root/reference/controller.go:29-52: kubeconfig env first, else
+in-cluster):
+
+1. explicit kubeconfig path (flag or $KUBECONFIG),
+2. in-cluster service account
+   (/var/run/secrets/kubernetes.io/serviceaccount/),
+3. explicit base_url (tests / kubectl proxy).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import tempfile
+from typing import Dict, Generator, Iterable, List, Optional, Tuple
+
+import requests
+import yaml
+
+log = logging.getLogger(__name__)
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+MERGE_PATCH = "application/merge-patch+json"
+STRATEGIC_MERGE_PATCH = "application/strategic-merge-patch+json"
+
+
+class KubeError(Exception):
+    def __init__(self, status_code: int, message: str):
+        super().__init__(f"HTTP {status_code}: {message}")
+        self.status_code = status_code
+
+
+class KubeConfigError(Exception):
+    pass
+
+
+class KubeClient:
+    def __init__(
+        self,
+        base_url: str,
+        token: str = "",
+        ca_path: Optional[str] = None,
+        client_cert: Optional[Tuple[str, str]] = None,
+        timeout: float = 10.0,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._session = requests.Session()
+        if token:
+            self._session.headers["Authorization"] = f"Bearer {token}"
+        self._session.verify = ca_path if ca_path else True
+        if base_url.startswith("http://"):
+            self._session.verify = False
+        if client_cert:
+            self._session.cert = client_cert
+
+    # -- constructors ------------------------------------------------------
+
+    @staticmethod
+    def from_env(kubeconfig: str = "") -> "KubeClient":
+        """kubeconfig (explicit or $KUBECONFIG) first, else in-cluster."""
+        path = kubeconfig or os.environ.get("KUBECONFIG", "")
+        if path:
+            return KubeClient.from_kubeconfig(path)
+        return KubeClient.in_cluster()
+
+    @staticmethod
+    def in_cluster(sa_dir: str = SERVICE_ACCOUNT_DIR) -> "KubeClient":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        token_path = os.path.join(sa_dir, "token")
+        if not host or not os.path.exists(token_path):
+            raise KubeConfigError("not running in a cluster")
+        with open(token_path) as f:
+            token = f.read().strip()
+        ca = os.path.join(sa_dir, "ca.crt")
+        return KubeClient(
+            f"https://{host}:{port}",
+            token=token,
+            ca_path=ca if os.path.exists(ca) else None,
+        )
+
+    @staticmethod
+    def from_kubeconfig(path: str, context: str = "") -> "KubeClient":
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = context or cfg.get("current-context", "")
+        ctx = _named(cfg.get("contexts", []), ctx_name)
+        if ctx is None:
+            raise KubeConfigError(f"context {ctx_name!r} not found in {path}")
+        cluster = _named(cfg.get("clusters", []), ctx["context"]["cluster"])
+        user = _named(cfg.get("users", []), ctx["context"]["user"])
+        if cluster is None or user is None:
+            raise KubeConfigError(f"incomplete context {ctx_name!r}")
+        cl = cluster["cluster"]
+        us = user.get("user", {})
+        ca_path = cl.get("certificate-authority")
+        if not ca_path and cl.get("certificate-authority-data"):
+            ca_path = _materialize(cl["certificate-authority-data"], "ca.crt")
+        token = us.get("token", "")
+        if not token and us.get("tokenFile"):
+            with open(us["tokenFile"]) as f:
+                token = f.read().strip()
+        client_cert = None
+        cert, key = us.get("client-certificate"), us.get("client-key")
+        if us.get("client-certificate-data") and us.get("client-key-data"):
+            cert = _materialize(us["client-certificate-data"], "client.crt")
+            key = _materialize(us["client-key-data"], "client.key")
+        if cert and key:
+            client_cert = (cert, key)
+        return KubeClient(
+            cl["server"], token=token, ca_path=ca_path, client_cert=client_cert
+        )
+
+    # -- raw ---------------------------------------------------------------
+
+    def _request(self, method: str, path: str, **kw) -> requests.Response:
+        kw.setdefault("timeout", self.timeout)
+        resp = self._session.request(method, self.base_url + path, **kw)
+        if resp.status_code >= 400:
+            raise KubeError(resp.status_code, resp.text[:500])
+        return resp
+
+    def get(self, path: str, params: Optional[dict] = None) -> dict:
+        return self._request("GET", path, params=params).json()
+
+    def patch(
+        self, path: str, body: dict, content_type: str = STRATEGIC_MERGE_PATCH
+    ) -> dict:
+        return self._request(
+            "PATCH",
+            path,
+            data=json.dumps(body),
+            headers={"Content-Type": content_type},
+        ).json()
+
+    # -- nodes -------------------------------------------------------------
+
+    def get_node(self, name: str) -> dict:
+        return self.get(f"/api/v1/nodes/{name}")
+
+    def patch_node_annotations(
+        self, name: str, annotations: Dict[str, Optional[str]]
+    ) -> dict:
+        """Strategic-merge patch of node annotations, like the reference's
+        patchNode (/root/reference/server.go:312-347). None deletes a key."""
+        body = {"metadata": {"annotations": annotations}}
+        return self.patch(f"/api/v1/nodes/{name}", body)
+
+    def patch_node_labels(
+        self, name: str, labels: Dict[str, Optional[str]]
+    ) -> dict:
+        return self.patch(f"/api/v1/nodes/{name}", {"metadata": {"labels": labels}})
+
+    # -- pods --------------------------------------------------------------
+
+    def list_pods(
+        self,
+        node_name: str = "",
+        namespace: str = "",
+        label_selector: str = "",
+    ) -> dict:
+        path = (
+            f"/api/v1/namespaces/{namespace}/pods" if namespace else "/api/v1/pods"
+        )
+        params: Dict[str, str] = {}
+        if node_name:
+            params["fieldSelector"] = f"spec.nodeName={node_name}"
+        if label_selector:
+            params["labelSelector"] = label_selector
+        return self.get(path, params=params)
+
+    def watch_pods(
+        self,
+        node_name: str = "",
+        resource_version: str = "",
+        timeout_seconds: int = 60,
+    ) -> Generator[Tuple[str, dict], None, None]:
+        """Yields (event_type, pod) from a single watch window; callers
+        reconnect (the informer does). Raises KubeError(410) when the
+        resourceVersion is too old — caller must relist."""
+        params: Dict[str, str] = {
+            "watch": "true",
+            "timeoutSeconds": str(timeout_seconds),
+            "allowWatchBookmarks": "true",
+        }
+        if node_name:
+            params["fieldSelector"] = f"spec.nodeName={node_name}"
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        resp = self._request(
+            "GET",
+            "/api/v1/pods",
+            params=params,
+            stream=True,
+            timeout=timeout_seconds + 10,
+        )
+        try:
+            for line in resp.iter_lines():
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    log.warning("unparseable watch line: %.120r", line)
+                    continue
+                etype = ev.get("type", "")
+                obj = ev.get("object", {})
+                if etype == "ERROR":
+                    code = obj.get("code", 500)
+                    raise KubeError(code, obj.get("message", "watch error"))
+                yield etype, obj
+        finally:
+            resp.close()
+
+    def patch_pod_annotations(
+        self,
+        namespace: str,
+        name: str,
+        annotations: Dict[str, Optional[str]],
+    ) -> dict:
+        """Pod annotation patch, like the reference's patchPodObject
+        (/root/reference/controller.go:227-249)."""
+        body = {"metadata": {"annotations": annotations}}
+        return self.patch(f"/api/v1/namespaces/{namespace}/pods/{name}", body)
+
+
+def _named(items: Iterable[dict], name: str) -> Optional[dict]:
+    for it in items:
+        if it.get("name") == name:
+            return it
+    return None
+
+
+def _materialize(b64: str, filename: str) -> str:
+    d = tempfile.mkdtemp(prefix="kubecfg-")
+    path = os.path.join(d, filename)
+    with open(path, "wb") as f:
+        f.write(base64.b64decode(b64))
+    return path

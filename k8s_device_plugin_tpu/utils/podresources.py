@@ -1,0 +1,36 @@
+"""Pod resource inspection.
+
+The analog of the reference's IsGPUTopoPod/GetGPUTopoNum
+(/root/reference/utils.go:10-31): how many of our extended resource a pod
+requests, using scheduler semantics — sum across app containers, then max
+with each init container (init containers run serially, so the pod's
+effective request is the max; /root/reference/utils.go:14-26 via the
+vendored scheduler Resource type).
+"""
+
+from __future__ import annotations
+
+from ..api import constants
+
+
+def _container_request(container: dict, resource_name: str) -> int:
+    req = (container.get("resources") or {}).get("requests") or {}
+    try:
+        return int(req.get(resource_name, 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def tpu_request(pod: dict, resource_name: str = constants.RESOURCE_NAME) -> int:
+    spec = pod.get("spec") or {}
+    total = sum(
+        _container_request(c, resource_name)
+        for c in spec.get("containers") or []
+    )
+    for init in spec.get("initContainers") or []:
+        total = max(total, _container_request(init, resource_name))
+    return total
+
+
+def is_tpu_pod(pod: dict, resource_name: str = constants.RESOURCE_NAME) -> bool:
+    return tpu_request(pod, resource_name) > 0

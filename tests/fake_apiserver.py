@@ -1,0 +1,229 @@
+"""In-process fake Kubernetes API server for controller tests.
+
+Implements exactly the REST surface KubeClient uses: pod list (with
+fieldSelector spec.nodeName), pod watch (close-delimited JSON-lines stream),
+pod/node PATCH. State mutations emit watch events like the real API server.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+
+class FakeApiServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rv = 0
+        self.pods: Dict[Tuple[str, str], dict] = {}  # (ns, name) -> pod
+        self.nodes: Dict[str, dict] = {}
+        self.pod_patches: List[Tuple[str, str, dict]] = []
+        self.node_patches: List[Tuple[str, dict]] = []
+        self._watchers: List["queue.Queue"] = []
+        # (rv, event) log so watches replay from a resourceVersion like the
+        # real API server does.
+        self._event_log: List[Tuple[int, dict]] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- state helpers (tests drive these) ---------------------------------
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def add_node(self, name: str, node: Optional[dict] = None):
+        with self._lock:
+            self.nodes[name] = node or {
+                "metadata": {"name": name, "annotations": {}, "labels": {}}
+            }
+
+    def add_pod(self, pod: dict, event: str = "ADDED"):
+        meta = pod.setdefault("metadata", {})
+        key = (meta.get("namespace", "default"), meta.get("name", ""))
+        with self._lock:
+            meta["resourceVersion"] = self._next_rv()
+            self.pods[key] = pod
+            self._broadcast(event, pod)
+
+    def update_pod(self, pod: dict):
+        self.add_pod(pod, event="MODIFIED")
+
+    def delete_pod(self, namespace: str, name: str):
+        with self._lock:
+            pod = self.pods.pop((namespace, name), None)
+            if pod is not None:
+                pod["metadata"]["resourceVersion"] = self._next_rv()
+                self._broadcast("DELETED", pod)
+
+    def _broadcast(self, etype: str, pod: dict):
+        ev = {"type": etype, "object": pod}
+        self._event_log.append(
+            (int(pod["metadata"]["resourceVersion"]), ev)
+        )
+        for q in list(self._watchers):
+            q.put(ev)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> str:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"  # close-delimited streams
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                params = dict(urllib.parse.parse_qsl(parsed.query))
+                if parsed.path == "/api/v1/pods":
+                    if params.get("watch") == "true":
+                        server._handle_watch(self, params)
+                    else:
+                        server._handle_list(self, params)
+                else:
+                    self.send_error(404)
+
+            def do_PATCH(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                parts = self.path.strip("/").split("/")
+                # api/v1/namespaces/{ns}/pods/{name} | api/v1/nodes/{name}
+                if len(parts) == 6 and parts[2] == "namespaces" and parts[4] == "pods":
+                    server._patch_pod(self, parts[3], parts[5], body)
+                elif len(parts) == 4 and parts[2] == "nodes":
+                    server._patch_node(self, parts[3], body)
+                else:
+                    self.send_error(404)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        for q in list(self._watchers):
+            q.put(None)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # -- handlers ----------------------------------------------------------
+
+    def _send_json(self, handler, obj, code=200):
+        data = json.dumps(obj).encode()
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
+    def _filter_pods(self, params) -> List[dict]:
+        fs = params.get("fieldSelector", "")
+        node = ""
+        if fs.startswith("spec.nodeName="):
+            node = fs.split("=", 1)[1]
+        with self._lock:
+            pods = list(self.pods.values())
+        if node:
+            pods = [
+                p for p in pods if (p.get("spec") or {}).get("nodeName") == node
+            ]
+        return pods
+
+    def _handle_list(self, handler, params):
+        with self._lock:
+            rv = str(self._rv)
+        self._send_json(
+            handler,
+            {
+                "kind": "PodList",
+                "metadata": {"resourceVersion": rv},
+                "items": self._filter_pods(params),
+            },
+        )
+
+    def _handle_watch(self, handler, params):
+        q: "queue.Queue" = queue.Queue()
+        since = int(params.get("resourceVersion", 0) or 0)
+        with self._lock:
+            # Replay events newer than the caller's resourceVersion, then
+            # register for live ones — atomically, so none are lost.
+            for rv, ev in self._event_log:
+                if rv > since:
+                    q.put(ev)
+            self._watchers.append(q)
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.end_headers()
+            timeout = float(params.get("timeoutSeconds", 5))
+            deadline = timeout
+            while True:
+                try:
+                    ev = q.get(timeout=min(deadline, 0.5))
+                except queue.Empty:
+                    deadline -= 0.5
+                    if deadline <= 0:
+                        return
+                    continue
+                if ev is None:
+                    return
+                handler.wfile.write(json.dumps(ev).encode() + b"\n")
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            self._watchers.remove(q)
+
+    @staticmethod
+    def _merge_annotations(meta: dict, patch_meta: dict, key: str):
+        incoming = (patch_meta or {}).get(key)
+        if incoming is None:
+            return
+        current = meta.setdefault(key, {})
+        for k, v in incoming.items():
+            if v is None:
+                current.pop(k, None)
+            else:
+                current[k] = v
+
+    def _patch_pod(self, handler, ns, name, body):
+        with self._lock:
+            pod = self.pods.get((ns, name))
+            if pod is None:
+                self._send_json(
+                    handler, {"message": f"pod {ns}/{name} not found"}, 404
+                )
+                return
+            self._merge_annotations(
+                pod["metadata"], body.get("metadata", {}), "annotations"
+            )
+            pod["metadata"]["resourceVersion"] = self._next_rv()
+            self.pod_patches.append((ns, name, body))
+            self._broadcast("MODIFIED", pod)
+        self._send_json(handler, pod)
+
+    def _patch_node(self, handler, name, body):
+        with self._lock:
+            node = self.nodes.get(name)
+            if node is None:
+                self._send_json(
+                    handler, {"message": f"node {name} not found"}, 404
+                )
+                return
+            meta = body.get("metadata", {})
+            self._merge_annotations(node["metadata"], meta, "annotations")
+            self._merge_annotations(node["metadata"], meta, "labels")
+            self.node_patches.append((name, body))
+        self._send_json(handler, node)

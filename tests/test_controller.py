@@ -1,0 +1,275 @@
+"""Controller + kube client + checkpoint tests (SURVEY.md §2.10-2.13).
+
+Drives the reconciliation paths end-to-end against a fake API server and a
+fake kubelet checkpoint file: annotation patching, shadow-map translation,
+delete→free, and the startup state rebuild the reference lacks.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.controller.controller import Controller
+from k8s_device_plugin_tpu.controller.wiring import publish_node_topology
+from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+from k8s_device_plugin_tpu.kube import checkpoint as ckpt
+from k8s_device_plugin_tpu.kube.client import KubeClient
+from k8s_device_plugin_tpu.server.plugin import PluginConfig, TpuDevicePlugin
+from k8s_device_plugin_tpu.topology.mesh import IciMesh
+from k8s_device_plugin_tpu.topology.schema import NodeTopology
+from k8s_device_plugin_tpu.utils.podresources import is_tpu_pod, tpu_request
+from tests import fakes
+from tests.fake_apiserver import FakeApiServer
+
+NODE = "tpu-node-1"
+
+
+# ---------------------------------------------------------------------------
+# podresources
+# ---------------------------------------------------------------------------
+
+def pod_dict(name, uid, tpus=0, node=NODE, annotations=None, init_tpus=0):
+    containers = [
+        {
+            "name": "main",
+            "resources": {"requests": {"google.com/tpu": str(tpus)} if tpus else {}},
+        }
+    ]
+    spec = {"nodeName": node, "containers": containers}
+    if init_tpus:
+        spec["initContainers"] = [
+            {
+                "name": "init",
+                "resources": {"requests": {"google.com/tpu": str(init_tpus)}},
+            }
+        ]
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "uid": uid,
+            "annotations": annotations or {},
+        },
+        "spec": spec,
+        "status": {},
+    }
+
+
+def test_tpu_request_scheduler_semantics():
+    assert tpu_request(pod_dict("p", "u", tpus=2)) == 2
+    # init containers max, not sum (reference utils.go:14-26 semantics).
+    assert tpu_request(pod_dict("p", "u", tpus=2, init_tpus=4)) == 4
+    assert tpu_request(pod_dict("p", "u", tpus=4, init_tpus=2)) == 4
+    assert not is_tpu_pod(pod_dict("p", "u", tpus=0))
+    assert tpu_request({}) == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint parsing
+# ---------------------------------------------------------------------------
+
+def checkpoint_doc(entries):
+    return json.dumps({"Data": {"PodDeviceEntries": entries,
+                                "RegisteredDevices": {}},
+                       "Checksum": 12345})
+
+
+def test_checkpoint_flat_format():
+    doc = checkpoint_doc([
+        {"PodUID": "u1", "ContainerName": "c", "ResourceName": "google.com/tpu",
+         "DeviceIDs": ["a", "b"]},
+        {"PodUID": "u2", "ContainerName": "c", "ResourceName": "other/res",
+         "DeviceIDs": ["x"]},
+    ])
+    entries = ckpt.parse_checkpoint(doc)
+    assert len(entries) == 2
+    by_pod = ckpt.device_ids_by_pod(entries, "google.com/tpu")
+    assert by_pod == {"u1": ["a", "b"]}
+
+
+def test_checkpoint_numa_map_format():
+    # post-1.20 kubelet: DeviceIDs keyed by NUMA node.
+    doc = checkpoint_doc([
+        {"PodUID": "u1", "ContainerName": "c", "ResourceName": "google.com/tpu",
+         "DeviceIDs": {"0": ["a"], "1": ["b", "c"]}},
+    ])
+    by_pod = ckpt.device_ids_by_pod(ckpt.parse_checkpoint(doc), "google.com/tpu")
+    assert sorted(by_pod["u1"]) == ["a", "b", "c"]
+
+
+def test_checkpoint_missing_and_corrupt(tmp_path):
+    assert ckpt.read_checkpoint(str(tmp_path / "nope")) == []
+    bad = tmp_path / "ckpt"
+    bad.write_text("{not json")
+    assert ckpt.read_checkpoint(str(bad)) == []
+
+
+# ---------------------------------------------------------------------------
+# controller end-to-end against fake API server
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def api():
+    s = FakeApiServer()
+    url = s.start()
+    s.add_node(NODE)
+    yield s, KubeClient(url)
+    s.stop()
+
+
+@pytest.fixture
+def plugin(tmp_path):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5p", 4)
+    chips = PyTpuInfo().scan(accel, dev)
+    return TpuDevicePlugin(
+        IciMesh(chips), config=PluginConfig(libtpu_host_path="")
+    )
+
+
+def write_checkpoint(tmp_path, by_pod):
+    entries = [
+        {"PodUID": uid, "ContainerName": "main",
+         "ResourceName": "google.com/tpu", "DeviceIDs": ids}
+        for uid, ids in by_pod.items()
+    ]
+    path = tmp_path / "kubelet_internal_checkpoint"
+    path.write_text(checkpoint_doc(entries))
+    return str(path)
+
+
+def make_controller(api, plugin, tmp_path, by_pod=None):
+    server, client = api
+    path = write_checkpoint(tmp_path, by_pod or {})
+    return Controller(
+        client,
+        plugin,
+        node_name=NODE,
+        checkpoint_path=path,
+        watch_timeout_s=2,
+    ), server
+
+
+def wait_for(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_update_patches_real_ids_onto_pod(api, plugin, tmp_path):
+    ids = plugin.mesh.ids
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    server.add_pod(pod_dict("jax-pod", "uid-1", tpus=2))
+    # kubelet admits the pod: checkpoint appears with its device picks.
+    write_checkpoint(tmp_path, {"uid-1": ids[:2]})
+    ctrl.start()
+    try:
+        assert wait_for(lambda: server.pod_patches)
+        ns, name, body = server.pod_patches[0]
+        assert (ns, name) == ("default", "jax-pod")
+        got = body["metadata"]["annotations"][constants.POD_DEVICES_ANNOTATION]
+        assert got == ",".join(sorted(ids[:2]))
+        assert set(ids[:2]).issubset(plugin.state.allocated)
+    finally:
+        ctrl.stop()
+
+
+def test_update_translates_shadow_map(api, plugin, tmp_path):
+    ids = plugin.mesh.ids
+    # Substitution mode: kubelet thinks it allocated ids[0],ids[3]; plugin
+    # actually handed out ids[0],ids[1].
+    plugin.shadow_map[ids[3]] = ids[1]
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    server.add_pod(pod_dict("jax-pod", "uid-1", tpus=2))
+    write_checkpoint(tmp_path, {"uid-1": [ids[0], ids[3]]})
+    ctrl.start()
+    try:
+        assert wait_for(lambda: server.pod_patches)
+        _, _, body = server.pod_patches[0]
+        got = body["metadata"]["annotations"][constants.POD_DEVICES_ANNOTATION]
+        assert got == ",".join(sorted([ids[0], ids[1]]))
+        assert plugin.shadow_map == {}  # drained (controller.go:200-210)
+    finally:
+        ctrl.stop()
+
+
+def test_delete_frees_devices(api, plugin, tmp_path):
+    ids = plugin.mesh.ids
+    plugin.state.allocate(ids[:2])
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    pod = pod_dict(
+        "jax-pod", "uid-1", tpus=2,
+        annotations={constants.POD_DEVICES_ANNOTATION: ",".join(ids[:2])},
+    )
+    server.add_pod(pod)
+    ctrl.start()
+    try:
+        # Let the informer's initial list land before deleting, as in real
+        # life (the pod existed long before it is deleted).
+        assert wait_for(lambda: ctrl._pod_devices)
+        server.delete_pod("default", "jax-pod")
+        assert wait_for(lambda: plugin.state.allocated == set())
+    finally:
+        ctrl.stop()
+
+
+def test_startup_rebuild_from_checkpoint(api, plugin, tmp_path):
+    """The reference loses allocation state across restarts (SURVEY §5);
+    we rebuild it, ignoring entries for pods that no longer exist."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_controller(
+        api, plugin, tmp_path,
+        by_pod={"uid-live": ids[:2], "uid-gone": [ids[2]]},
+    )
+    server.add_pod(pod_dict("live-pod", "uid-live", tpus=2))
+    # uid-gone has no live pod: its chips must stay free.
+    ctrl.rebuild_state()
+    assert plugin.state.allocated == set(ids[:2])
+
+
+def test_resync_catches_late_checkpoint(api, plugin, tmp_path):
+    """The kubelet writes its checkpoint *after* the pod event in real life;
+    the informer resync must reconcile without a fresh pod event."""
+    ids = plugin.mesh.ids
+    server, client = api
+    path = write_checkpoint(tmp_path, {})
+    ctrl = Controller(
+        client, plugin, node_name=NODE, checkpoint_path=path,
+        watch_timeout_s=2, resync_interval_s=0.3,
+    )
+    server.add_pod(pod_dict("late-pod", "uid-late", tpus=2))
+    ctrl.start()
+    try:
+        time.sleep(0.5)  # pod event long processed, checkpoint still empty
+        assert not server.pod_patches
+        write_checkpoint(tmp_path, {"uid-late": ids[:2]})
+        assert wait_for(lambda: server.pod_patches)
+    finally:
+        ctrl.stop()
+
+
+def test_watch_stream_delivers_events(api):
+    server, client = api
+    server.add_pod(pod_dict("w1", "uid-w1", tpus=1))
+    events = []
+    for etype, obj in client.watch_pods(node_name=NODE, timeout_seconds=2):
+        events.append((etype, obj["metadata"]["name"]))
+        break
+    assert events == [("ADDED", "w1")]
+
+
+def test_publish_node_topology(api, plugin):
+    server, client = api
+    topo = publish_node_topology(client, NODE, plugin.mesh, numa_nodes=2)
+    node = server.nodes[NODE]
+    ann = node["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION]
+    parsed = NodeTopology.from_json(ann)
+    assert parsed == topo
+    assert parsed.chip_count == 4
+    assert node["metadata"]["labels"]["google.com/tpu-topology"] == "2x2x1"
+    assert node["metadata"]["labels"]["google.com/tpu-accelerator"] == "v5p"

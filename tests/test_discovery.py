@@ -117,3 +117,18 @@ def test_get_backend_falls_back(monkeypatch):
     )
     b = get_backend(prefer_native=True)
     assert isinstance(b, PyTpuInfo)
+
+
+def test_parse_accelerator_names():
+    from k8s_device_plugin_tpu.discovery.chips import parse_gke_accelerator_label as p
+
+    # GKE node label values.
+    assert p("tpu-v5p-slice") == "v5p"
+    assert p("tpu-v5-lite-podslice") == "v5e"
+    assert p("tpu-v4-podslice") == "v4"
+    # TPU VM accelerator-type strings ($TPU_ACCELERATOR_TYPE).
+    assert p("v5litepod-4") == "v5e"
+    assert p("v4-8") == "v4"
+    assert p("v5p-16") == "v5p"
+    assert p("v6e-8") == "v6e"
+    assert p("gpu-a100") is None
