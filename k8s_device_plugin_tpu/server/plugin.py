@@ -88,6 +88,9 @@ class TpuDevicePlugin(DevicePluginServicer):
         self.shadow_map: Dict[str, str] = {}
         self._server: Optional[grpc.Server] = None
         self._stop = threading.Event()
+        # Serializes Allocate plan→commit so concurrent RPCs (8-thread
+        # executor) can't plan overlapping chip sets.
+        self._allocate_lock = threading.Lock()
         # Device-list versioning: streams re-send whenever bumped.
         self._version = 0
         self._version_cv = threading.Condition()
@@ -230,34 +233,44 @@ class TpuDevicePlugin(DevicePluginServicer):
         return resp
 
     def Allocate(self, request, context):
-        # Two-phase: validate + plan every container first, then commit, so
-        # a bad container can't leak partial allocation state.
-        plans = []
-        for creq in request.container_requests:
-            requested = list(creq.devicesIDs)
-            unknown = [i for i in requested if i not in self.mesh.by_id]
-            if unknown:
-                context.abort(
-                    grpc.StatusCode.INVALID_ARGUMENT,
-                    f"unknown device ids: {unknown}",
+        # Two-phase under one lock: validate + plan every container first,
+        # then commit — a bad container can't leak partial allocation state,
+        # and concurrent RPCs can't plan overlapping chip sets.
+        with self._allocate_lock:
+            plans = []
+            planned: set = set()
+            for creq in request.container_requests:
+                requested = list(creq.devicesIDs)
+                unknown = [i for i in requested if i not in self.mesh.by_id]
+                if unknown:
+                    context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        f"unknown device ids: {unknown}",
+                    )
+                assigned = requested
+                substitutions = {}
+                if self.config.substitute_on_allocate and requested:
+                    pool = [
+                        a for a in self.state.available() if a not in planned
+                    ]
+                    best = self.state.select(len(requested), available=pool)
+                    if best:
+                        assigned = best
+                        for kubelet_id, real_id in zip(sorted(requested), best):
+                            if kubelet_id != real_id:
+                                substitutions[kubelet_id] = real_id
+                planned.update(assigned)
+                plans.append((requested, assigned, substitutions))
+            resp = pb.AllocateResponse()
+            for requested, assigned, substitutions in plans:
+                self.shadow_map.update(substitutions)
+                self.state.allocate(assigned)
+                resp.container_responses.append(
+                    self._container_response(assigned)
                 )
-            assigned = requested
-            substitutions = {}
-            if self.config.substitute_on_allocate and requested:
-                best = self.state.select(len(requested))
-                if best:
-                    assigned = best
-                    for kubelet_id, real_id in zip(sorted(requested), best):
-                        if kubelet_id != real_id:
-                            substitutions[kubelet_id] = real_id
-            plans.append((requested, assigned, substitutions))
-        resp = pb.AllocateResponse()
-        for requested, assigned, substitutions in plans:
-            self.shadow_map.update(substitutions)
-            self.state.allocate(assigned)
-            resp.container_responses.append(self._container_response(assigned))
-            log.info("Allocate: requested=%s assigned=%s", requested, assigned)
-        self._bump()  # availability changed; refresh any watchers
+                log.info(
+                    "Allocate: requested=%s assigned=%s", requested, assigned
+                )
         return resp
 
     def PreStartContainer(self, request, context):
@@ -308,11 +321,21 @@ class TpuDevicePlugin(DevicePluginServicer):
             "TPU_VISIBLE_CHIPS": ",".join(
                 str(mc.chip.index) for mc in chips
             ),
-            "TPU_ACCELERATOR_TYPE": self.mesh.spec.chip_type,
+            "TPU_ACCELERATOR_TYPE": self._accelerator_type(len(chips)),
             "TPU_WORKER_ID": "0",
             "TPU_SKIP_MDS_QUERY": "true",
         }
         return env
+
+    def _accelerator_type(self, n_chips: int) -> str:
+        """Accelerator-type string in the format real TPU VMs use
+        ('v4-8', 'v5litepod-4', 'v5p-8'): generation plus TensorCore count
+        (chip count for single-core generations like v5e)."""
+        spec = self.mesh.spec
+        n = n_chips * max(spec.cores_per_chip, 1)
+        if spec.chip_type == "v5e":
+            return f"v5litepod-{n}"
+        return f"{spec.chip_type}-{n}"
 
     def _bounds_str(self, chips) -> str:
         coords = [mc.coords for mc in chips]

@@ -146,7 +146,7 @@ def test_allocate_returns_devices_env_annotations(plugin, kubelet):
     # TPU runtime env describes the sub-slice.
     assert cresp.envs["TPU_VISIBLE_CHIPS"] == "0,1"
     assert cresp.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,1,1"
-    assert cresp.envs["TPU_ACCELERATOR_TYPE"] == "v5p"
+    assert cresp.envs["TPU_ACCELERATOR_TYPE"] == "v5p-4"  # 2 chips x 2 cores
     # Real ids recorded for the controller.
     assert (
         cresp.annotations[constants.POD_DEVICES_ANNOTATION] == ",".join(ids)
@@ -274,3 +274,24 @@ def test_restart_reuses_socket(tmp_path, dp_dir, kubelet):
         assert len(resp.devices) == 4
     finally:
         p2.stop()
+
+
+def test_substitution_multi_container_gets_disjoint_chips(tmp_path, dp_dir, kubelet):
+    # Two containers in one AllocateRequest must not be planned onto the
+    # same chips in substitution mode.
+    p = make_plugin(tmp_path, dp_dir, substitute_on_allocate=True)
+    p.serve()
+    try:
+        stub = kubelet.plugin_stub()
+        ids = p.mesh.ids
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=ids[:2])
+        req.container_requests.add(devicesIDs=ids[2:4])
+        resp = stub.Allocate(req)
+        sets = [
+            {d.host_path for d in c.devices} for c in resp.container_responses
+        ]
+        assert sets[0].isdisjoint(sets[1])
+        assert len(sets[0]) == 2 and len(sets[1]) == 2
+    finally:
+        p.stop()
