@@ -1,0 +1,105 @@
+"""Device-mesh helpers for workloads running on plugin-allocated chips.
+
+The bridge between the control plane and the JAX workload: a pod allocated
+``google.com/tpu: N`` sees N chips (via the device nodes + env the plugin's
+Allocate returned) and builds a ``jax.sharding.Mesh`` over them here. Axes
+follow the standard TPU recipe (data / fsdp / model): data-parallel batch
+splitting, fully-sharded parameter storage, and tensor parallelism for the
+model dimension — XLA inserts the ICI collectives implied by the shardings.
+
+No counterpart exists in the reference (it is a device plugin; workloads
+bring their own NCCL — SURVEY.md §2 parallelism table). This module exists
+because on TPU the *framework side* of that contract is a mesh + named
+shardings rather than an external comms library.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names, in mesh order.
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+MODEL_AXIS = "model"
+AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS)
+
+
+def factorize(n: int, max_model: int = 4) -> Tuple[int, int, int]:
+    """Split n devices into (data, fsdp, model) sizes.
+
+    Heuristic: model parallelism is kept small (it pays per-layer collective
+    latency), fsdp takes the bulk (parameter sharding scales memory), data
+    absorbs the rest. All factors divide n exactly.
+    """
+    if n < 1:
+        raise ValueError(f"need at least 1 device, got {n}")
+    model = 1
+    for cand in range(min(max_model, n), 0, -1):
+        if n % cand == 0:
+            model = cand
+            break
+    rest = n // model
+    fsdp = 1
+    for cand in range(int(math.isqrt(rest)), 0, -1):
+        if rest % cand == 0:
+            fsdp = rest // cand
+            break
+    data = rest // fsdp
+    return (data, fsdp, model)
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    shape: Optional[Tuple[int, int, int]] = None,
+) -> Mesh:
+    """Build a (data, fsdp, model) mesh over the given devices (default: all
+    local devices, i.e. the chips the plugin allocated to this container)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if shape is None:
+        shape = factorize(len(devs))
+    if np.prod(shape) != len(devs):
+        raise ValueError(f"mesh shape {shape} != {len(devs)} devices")
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def host_bounds_from_env() -> Optional[Tuple[int, int, int]]:
+    """The allocated sub-slice shape the plugin exported
+    (TPU_CHIPS_PER_HOST_BOUNDS, see server/plugin.py:_tpu_env), if set."""
+    raw = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
+    if not raw:
+        return None
+    try:
+        x, y, z = (int(v) for v in raw.split(","))
+        return (x, y, z)
+    except ValueError:
+        return None
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dim split over data+fsdp (the standard dp×fsdp layout)."""
+    return NamedSharding(mesh, P((DATA_AXIS, FSDP_AXIS),))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+# Logical-axis → mesh-axis rules for flax logical partitioning: parameters
+# shard their embed dim over fsdp (ZeRO-3 style) and their wide dims over
+# model (tensor parallelism); activations shard batch over data+fsdp.
+LOGICAL_AXIS_RULES = (
+    ("batch", (DATA_AXIS, FSDP_AXIS)),
+    ("embed", FSDP_AXIS),
+    ("mlp", MODEL_AXIS),
+    ("heads", MODEL_AXIS),
+    ("kv", None),
+    ("vocab", MODEL_AXIS),
+    ("seq", None),
+)

@@ -259,6 +259,18 @@ class TpuDevicePlugin(DevicePluginServicer):
                         for kubelet_id, real_id in zip(sorted(requested), best):
                             if kubelet_id != real_id:
                                 substitutions[kubelet_id] = real_id
+                    elif not (
+                        set(requested).issubset(pool)
+                    ):
+                        # No topology pick and the kubelet's own choice
+                        # overlaps an earlier container's plan or an
+                        # unavailable chip: refusing beats double-mounting
+                        # the same /dev/accel* into two containers.
+                        context.abort(
+                            grpc.StatusCode.RESOURCE_EXHAUSTED,
+                            f"cannot allocate {len(requested)} chips "
+                            f"disjoint from prior containers",
+                        )
                 planned.update(assigned)
                 plans.append((requested, assigned, substitutions))
             resp = pb.AllocateResponse()

@@ -1,0 +1,153 @@
+"""The smoke-workload model: a small causal transformer LM.
+
+This is the JAX pod payload the plugin exists to schedule (the analog of the
+reference's smoke pod, /root/reference/pod1.yml, which just runs
+nvidia-smi): big enough to exercise the MXU (bf16 matmuls), tensor/fsdp
+sharding (flax logical partitioning → mesh axes from parallel.mesh), and the
+ICI collectives XLA inserts for them — small enough to compile in seconds.
+
+TPU-first choices: bf16 activations/compute with f32 params and optimizer
+state; static shapes throughout; no Python control flow under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import partitioning as nn_partitioning
+
+param_with_axes = nn_partitioning.param_with_axes
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 512
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_seq_len: int = 128
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def tiny() -> "ModelConfig":
+        return ModelConfig(
+            vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+            max_seq_len=16,
+        )
+
+
+class Attention(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        head_dim = cfg.d_model // cfg.n_heads
+        wq = param_with_axes(
+            "wq", nn.initializers.xavier_uniform(),
+            (cfg.d_model, cfg.n_heads, head_dim), jnp.float32,
+            axes=("embed", "heads", "kv"),
+        )
+        wk = param_with_axes(
+            "wk", nn.initializers.xavier_uniform(),
+            (cfg.d_model, cfg.n_heads, head_dim), jnp.float32,
+            axes=("embed", "heads", "kv"),
+        )
+        wv = param_with_axes(
+            "wv", nn.initializers.xavier_uniform(),
+            (cfg.d_model, cfg.n_heads, head_dim), jnp.float32,
+            axes=("embed", "heads", "kv"),
+        )
+        wo = param_with_axes(
+            "wo", nn.initializers.xavier_uniform(),
+            (cfg.n_heads, head_dim, cfg.d_model), jnp.float32,
+            axes=("heads", "kv", "embed"),
+        )
+        x = x.astype(cfg.dtype)
+        q = jnp.einsum("bsd,dhk->bshk", x, wq.astype(cfg.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(cfg.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(cfg.dtype))
+        scores = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(
+            jnp.asarray(head_dim, cfg.dtype)
+        )
+        seq = x.shape[1]
+        causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+        scores = jnp.where(causal[None, None, :, :], scores, -1e9)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+            cfg.dtype
+        )
+        out = jnp.einsum("bhst,bthk->bshk", probs, v)
+        return jnp.einsum("bshk,hkd->bsd", out, wo.astype(cfg.dtype))
+
+
+class Mlp(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        w1 = param_with_axes(
+            "w1", nn.initializers.xavier_uniform(),
+            (cfg.d_model, cfg.d_ff), jnp.float32, axes=("embed", "mlp"),
+        )
+        w2 = param_with_axes(
+            "w2", nn.initializers.xavier_uniform(),
+            (cfg.d_ff, cfg.d_model), jnp.float32, axes=("mlp", "embed"),
+        )
+        x = x.astype(cfg.dtype)
+        h = jax.nn.gelu(x @ w1.astype(cfg.dtype))
+        return h @ w2.astype(cfg.dtype)
+
+
+class Block(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + Attention(self.cfg)(nn.RMSNorm(use_scale=True)(x))
+        x = x + Mlp(self.cfg)(nn.RMSNorm(use_scale=True)(x))
+        return x
+
+
+class TransformerLM(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        embed = param_with_axes(
+            "embed", nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.d_model), jnp.float32,
+            axes=("vocab", "embed"),
+        )
+        pos = param_with_axes(
+            "pos", nn.initializers.normal(0.02),
+            (cfg.max_seq_len, cfg.d_model), jnp.float32,
+            axes=("seq", "embed"),
+        )
+        seq = tokens.shape[1]
+        x = embed[tokens] + pos[:seq][None, :, :]
+        x = x.astype(cfg.dtype)
+        for _ in range(cfg.n_layers):
+            x = Block(cfg)(x)
+        x = nn.RMSNorm(use_scale=True)(x)
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x.astype(jnp.float32), embed
+        )
+        return logits
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array):
+    model = TransformerLM(cfg)
+    tokens = jnp.zeros((2, cfg.max_seq_len), dtype=jnp.int32)
+    variables = model.init(rng, tokens)
+    return variables["params"]
+
+
+def forward(cfg: ModelConfig, params, tokens):
+    return TransformerLM(cfg).apply({"params": params}, tokens)

@@ -1,0 +1,130 @@
+"""Sharded training step for the smoke workload.
+
+Builds the full TPU training recipe over a (data, fsdp, model) mesh: params
+placed by their flax logical axes, batch split over data×fsdp, one jitted
+train step whose gradients/optimizer update run under those shardings —
+XLA inserts the psum/all-gather/reduce-scatter collectives over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections.abc import Mapping
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.linen import partitioning as nn_partitioning
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import LOGICAL_AXIS_RULES, batch_sharding, replicated
+from .model import ModelConfig, TransformerLM, forward
+
+
+def loss_fn(cfg: ModelConfig, params, tokens) -> jax.Array:
+    """Next-token cross-entropy (last position predicts nothing)."""
+    logits = forward(cfg, params, tokens)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh):
+    """NamedShardings for every param from its logical axes."""
+    model = TransformerLM(cfg)
+    tokens = jnp.zeros((2, cfg.max_seq_len), dtype=jnp.int32)
+    abstract = jax.eval_shape(model.init, jax.random.PRNGKey(0), tokens)
+    axes = nn_partitioning.get_axis_names(abstract.get("params_axes", {}))
+    params_shape = abstract["params"]
+
+    def to_sharding(path, leaf):
+        names = _lookup(axes, path)
+        if names is None:
+            return replicated(mesh)
+        spec = nn_partitioning.logical_to_mesh_axes(
+            names, rules=LOGICAL_AXIS_RULES
+        )
+        # Drop mesh axes that don't divide the dim evenly (tiny configs).
+        cleaned = []
+        for dim, axis in zip(leaf.shape, spec):
+            size = _axis_size(mesh, axis)
+            cleaned.append(axis if size and dim % size == 0 else None)
+        return NamedSharding(mesh, P(*cleaned))
+
+    flat = jax.tree_util.tree_flatten_with_path(params_shape)[0]
+    treedef = jax.tree_util.tree_structure(params_shape)
+    shardings = [to_sharding(path, leaf) for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def _axis_size(mesh: Mesh, axis) -> int:
+    if axis is None:
+        return 1
+    if isinstance(axis, (tuple, list)):
+        size = 1
+        for a in axis:
+            size *= mesh.shape[a]
+        return size
+    return mesh.shape[axis]
+
+
+def _lookup(axes_tree, path):
+    # axes_tree is a (Frozen)Dict whose leaves are PartitionSpecs of
+    # *logical* axis names (flax get_axis_names output).
+    node: Any = axes_tree
+    for key in path:
+        name = getattr(key, "key", None)
+        if name is None or not isinstance(node, Mapping) or name not in node:
+            return None
+        node = node[name]
+    if isinstance(node, (tuple, list, P)):
+        return tuple(node)
+    return None
+
+
+def make_train_state(
+    cfg: ModelConfig, mesh: Mesh, rng: jax.Array, lr: float = 1e-3
+) -> Tuple[Dict, Dict, optax.GradientTransformation]:
+    """Initialize sharded params + optimizer state on the mesh."""
+    tx = optax.adamw(lr)
+    shardings = param_shardings(cfg, mesh)
+    tokens = jnp.zeros((2, cfg.max_seq_len), dtype=jnp.int32)
+
+    @functools.partial(jax.jit, out_shardings=shardings)
+    def init():
+        return TransformerLM(cfg).init(rng, tokens)["params"]
+
+    params = init()
+    opt_shardings = jax.tree_util.tree_map(
+        lambda _: None, jax.eval_shape(tx.init, params),
+        is_leaf=lambda x: False,
+    )
+    del opt_shardings  # optimizer state inherits param shardings via jit
+    opt_state = jax.jit(tx.init)(params)
+    return params, opt_state, tx
+
+
+def make_train_step(cfg: ModelConfig, mesh: Mesh, tx):
+    """One jitted, donated train step: (params, opt_state, tokens) →
+    (params, opt_state, loss)."""
+    shardings = param_shardings(cfg, mesh)
+    bsh = batch_sharding(mesh)
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(shardings, None, bsh),
+        out_shardings=(shardings, None, replicated(mesh)),
+        donate_argnums=(0, 1),
+    )
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step

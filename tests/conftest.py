@@ -8,10 +8,21 @@ imported anywhere in the process).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the host environment pre-registers a TPU PJRT plugin
+# (sitecustomize on TPU-tunneled hosts pins jax_platforms to the plugin, so
+# env vars alone don't stick — override the jax config directly).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax  # noqa: E402  (must come after the env setup above)
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # control-plane tests don't need jax
+    pass
 
 # Make the repo root importable regardless of pytest invocation directory.
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -295,3 +295,23 @@ def test_substitution_multi_container_gets_disjoint_chips(tmp_path, dp_dir, kube
         assert len(sets[0]) == 2 and len(sets[1]) == 2
     finally:
         p.stop()
+
+
+def test_substitution_fallback_never_overlaps(tmp_path, dp_dir, kubelet):
+    # When select() can't find a disjoint set for a later container, the
+    # request is refused rather than double-mounting chips.
+    p = make_plugin(tmp_path, dp_dir, substitute_on_allocate=True)
+    p.serve()
+    try:
+        stub = kubelet.plugin_stub()
+        ids = p.mesh.ids
+        p.notify_health(ids[3], healthy=False)  # only 3 chips available
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=ids[2:4])
+        req.container_requests.add(devicesIDs=ids[0:2])
+        with pytest.raises(grpc.RpcError) as exc:
+            stub.Allocate(req)
+        assert exc.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert p.state.allocated == set()  # nothing committed
+    finally:
+        p.stop()
