@@ -63,6 +63,8 @@ class Controller:
         # pod uid -> chip ids we believe it holds (for delete-time free when
         # the annotation is missing).
         self._pod_devices: Dict[str, Set[str]] = {}
+        # Optional TopologyPublisher owned by the wiring; stopped with us.
+        self.publisher = None
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -80,6 +82,8 @@ class Controller:
             self._threads.append(t)
 
     def stop(self) -> None:
+        if self.publisher is not None:
+            self.publisher.stop()
         self._stop.set()
         self._queue.put(None)
         for t in self._threads:
@@ -117,7 +121,7 @@ class Controller:
             if known:
                 self._pod_devices[uid] = set(known)
         if allocated:
-            self.plugin.state.allocate(allocated)
+            self.plugin.mark_allocated(allocated)
             log.info(
                 "rebuilt allocation state from checkpoint: %d chips across "
                 "%d pods", len(allocated), len(self._pod_devices),
@@ -239,7 +243,7 @@ class Controller:
             {self.devices_annotation: ",".join(sorted(real))},
         )
         self._pod_devices[uid] = set(real)
-        self.plugin.state.allocate(real)
+        self.plugin.mark_allocated(real)
         log.info(
             "reconciled pod %s/%s -> chips %s",
             meta.get("namespace"),
@@ -262,8 +266,7 @@ class Controller:
         ids |= self._pod_devices.pop(uid, set())
         if not ids:
             return
-        self.plugin.state.free(ids)
-        self.plugin._bump()
+        self.plugin.free_devices(ids)
         log.info(
             "freed chips %s from deleted pod %s/%s",
             sorted(ids),

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from typing import Optional, Tuple
 
@@ -31,11 +32,14 @@ def publish_node_topology(
     numa_nodes: int = 1,
     annotation: str = constants.TOPOLOGY_ANNOTATION,
     retries: int = 3,
+    available=None,
 ) -> NodeTopology:
     """Publish the ICI topology as a node annotation, retrying on conflict
     like the reference's patchNode loop (/root/reference/server.go:312-347).
     Also sets a scheduler-friendly label with the mesh shape."""
-    topo = NodeTopology.from_mesh(mesh, numa_nodes=numa_nodes, hostname=node_name)
+    topo = NodeTopology.from_mesh(
+        mesh, numa_nodes=numa_nodes, hostname=node_name, available=available
+    )
     shape = "x".join(str(b) for b in mesh.bounds)
     last: Optional[Exception] = None
     for attempt in range(retries):
@@ -65,6 +69,63 @@ def publish_node_topology(
     raise last  # type: ignore[misc]
 
 
+class TopologyPublisher:
+    """Debounced node-annotation republisher: allocation/health changes
+    trigger a publish of the current availability within ``debounce_s``,
+    coalescing bursts (e.g. a multi-container Allocate)."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        node_name: str,
+        plugin,
+        numa_nodes: int = 1,
+        debounce_s: float = 0.3,
+    ):
+        self.client = client
+        self.node_name = node_name
+        self.plugin = plugin
+        self.numa_nodes = numa_nodes
+        self.debounce_s = debounce_s
+        self._dirty = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="topology-publisher", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._dirty.set()
+        self._thread.join(timeout=5)
+
+    def trigger(self) -> None:
+        self._dirty.set()
+
+    def publish_now(self) -> None:
+        publish_node_topology(
+            self.client,
+            self.node_name,
+            self.plugin.mesh,
+            numa_nodes=self.numa_nodes,
+            available=self.plugin.state.available(),
+        )
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._dirty.wait()
+            if self._stop.is_set():
+                return
+            self._stop.wait(self.debounce_s)  # coalesce bursts
+            self._dirty.clear()
+            try:
+                self.publish_now()
+            except Exception as e:
+                log.warning("topology republish failed: %s", e)
+
+
 def start_kube_integration(daemon, mesh: IciMesh) -> Tuple[Controller, KubeClient]:
     cfg = daemon.cfg
     client = KubeClient.from_env(cfg.kubeconfig)
@@ -74,7 +135,26 @@ def start_kube_integration(daemon, mesh: IciMesh) -> Tuple[Controller, KubeClien
         numa = daemon.backend.numa_node_count(cfg.numa_dir)
     except OSError:
         pass
-    publish_node_topology(client, node_name, mesh, numa_nodes=numa)
+    publisher = TopologyPublisher(
+        client, node_name, daemon.plugin, numa_nodes=numa
+    )
+    publisher.start()
+    daemon.plugin.on_availability_change = publisher.trigger
+
+    def emit_health_event(chip_id: str, healthy: bool) -> None:
+        try:
+            client.create_event(
+                "default",
+                {"kind": "Node", "name": node_name},
+                reason="TPUChipRecovered" if healthy else "TPUChipUnhealthy",
+                message=f"TPU chip {chip_id} is now "
+                f"{'Healthy' if healthy else 'Unhealthy'}",
+                event_type="Normal" if healthy else "Warning",
+            )
+        except (KubeError, OSError) as e:
+            log.warning("event emit failed: %s", e)
+
+    daemon.plugin.on_health_transition = emit_health_event
     controller = Controller(
         client,
         daemon.plugin,
@@ -85,5 +165,9 @@ def start_kube_integration(daemon, mesh: IciMesh) -> Tuple[Controller, KubeClien
         ),
         resync_interval_s=cfg.resync_interval_s,
     )
-    controller.start()
+    controller.publisher = publisher  # stopped with the controller
+    controller.start()  # rebuilds allocation state from the checkpoint
+    # Authoritative initial publish AFTER the rebuild, so a restarted
+    # daemon never advertises chips that running pods already hold.
+    publisher.publish_now()
     return controller, client

@@ -1,0 +1,2 @@
+"""Topology-aware kube-scheduler extender (the reference's unimplemented
+-topo-sched-endpoint integration, /root/reference/server.go:298-300)."""

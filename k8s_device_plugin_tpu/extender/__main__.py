@@ -1,0 +1,32 @@
+"""Entrypoint: python -m k8s_device_plugin_tpu.extender [--port 12346]."""
+
+import argparse
+import logging
+import signal
+import threading
+
+from .server import ExtenderHTTPServer
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(prog="tpu-scheduler-extender")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=12346)
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    a = p.parse_args()
+    logging.basicConfig(
+        level=logging.DEBUG if a.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    srv = ExtenderHTTPServer(host=a.host, port=a.port)
+    srv.start()
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,222 @@
+"""Topology-aware scheduler extender.
+
+The reference *intends* an external scheduler integration — it publishes
+the node topology annotation and takes a ``-topo-sched-endpoint`` flag, but
+the registration call is an unimplemented TODO
+(/root/reference/server.go:298-300, main.go:20). This module implements
+that missing half: a kube-scheduler **extender webhook**
+(`HTTPExtender`, kube-scheduler policy `extenders:` config) that filters
+and prioritizes nodes for ``google.com/tpu`` pods using the live topology
+annotations the plugin publishes (BASELINE config 4: steer multi-chip pods
+onto mesh-adjacent chips).
+
+Protocol (k8s.io/kube-scheduler/extender/v1, stable JSON over HTTP):
+
+  POST /filter      ExtenderArgs{Pod, Nodes|NodeNames} → ExtenderFilterResult
+  POST /prioritize  ExtenderArgs{Pod, Nodes|NodeNames} → HostPriorityList
+
+Scoring: simulate this plugin's own placement policy on each candidate
+node's published mesh + availability; a node where the request forms a
+compact sub-box with many internal ICI links scores high, a node where it
+would fragment across non-adjacent chips scores low, a node that the
+request fills exactly gets a packing bonus (keeps whole hosts free for
+future slice jobs).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List, Optional, Tuple
+
+from ..api import constants
+from ..topology.placement import PlacementState, _box_shapes
+from ..topology.schema import NodeTopology
+from ..utils.httpserver import BackgroundHTTPServer
+from ..utils.podresources import tpu_request
+
+log = logging.getLogger(__name__)
+
+MAX_SCORE = 10
+
+
+def _ideal_internal_links(n: int) -> int:
+    """Internal ICI links of the most compact n-chip box (unconstrained)."""
+    shapes = _box_shapes(n, (n, n, n))
+    if not shapes:
+        return max(n - 1, 1)
+    a, b, c = shapes[0]
+    return (a - 1) * b * c + a * (b - 1) * c + a * b * (c - 1)
+
+
+class TopologyExtender:
+    """Pure scoring/filtering logic (HTTP wrapper below)."""
+
+    def __init__(self, resource_name: str = constants.RESOURCE_NAME):
+        self.resource_name = resource_name
+
+    # -- node topology parsing --------------------------------------------
+
+    def _topology_of(self, node: dict) -> Optional[NodeTopology]:
+        ann = (node.get("metadata") or {}).get("annotations") or {}
+        raw = ann.get(constants.TOPOLOGY_ANNOTATION)
+        if not raw:
+            return None
+        try:
+            return NodeTopology.from_json(raw)
+        except (json.JSONDecodeError, TypeError, KeyError) as e:
+            log.warning(
+                "bad topology annotation on %s: %s",
+                (node.get("metadata") or {}).get("name"),
+                e,
+            )
+            return None
+
+    # -- filter ------------------------------------------------------------
+
+    def filter(self, pod: dict, nodes: List[dict]) -> Tuple[List[dict], Dict[str, str]]:
+        """Returns (passing_nodes, failed{name: reason})."""
+        n = tpu_request(pod, self.resource_name)
+        if n <= 0:
+            return nodes, {}
+        passing, failed = [], {}
+        for node in nodes:
+            name = (node.get("metadata") or {}).get("name", "")
+            topo = self._topology_of(node)
+            if topo is None:
+                failed[name] = "no TPU topology published"
+                continue
+            local = min(n, topo.chip_count)
+            if local <= 0:
+                failed[name] = "node reports 0 TPU chips"
+                continue
+            if n > topo.chip_count and n % topo.chip_count != 0:
+                failed[name] = (
+                    f"multi-host request of {n} not a multiple of host "
+                    f"size {topo.chip_count}"
+                )
+                continue
+            if n > topo.chip_count and len(topo.available) < topo.chip_count:
+                failed[name] = "multi-host slice needs the full host free"
+                continue
+            if len(topo.available) < local:
+                failed[name] = (
+                    f"{len(topo.available)} chips available, {local} needed"
+                )
+                continue
+            passing.append(node)
+        return passing, failed
+
+    # -- prioritize --------------------------------------------------------
+
+    def score_node(self, n: int, topo: NodeTopology) -> int:
+        local = min(n, topo.chip_count)
+        if local <= 0 or len(topo.available) < local:
+            return 0
+        mesh = topo.to_mesh()
+        state = PlacementState(mesh)
+        state.reset(allocated=set(mesh.ids) - set(topo.available))
+        sel = state.select(local)
+        if len(sel) < local:
+            return 0
+        links = mesh.internal_links(sel)
+        ideal = _ideal_internal_links(local)
+        base = round((MAX_SCORE - 2) * min(links / ideal, 1.0)) if ideal else 0
+        packing_bonus = 2 if len(topo.available) == local else 0
+        return min(base + packing_bonus, MAX_SCORE)
+
+    def prioritize(self, pod: dict, nodes: List[dict]) -> List[dict]:
+        n = tpu_request(pod, self.resource_name)
+        out = []
+        for node in nodes:
+            name = (node.get("metadata") or {}).get("name", "")
+            if n <= 0:
+                out.append({"host": name, "score": 0})
+                continue
+            topo = self._topology_of(node)
+            score = self.score_node(n, topo) if topo else 0
+            out.append({"host": name, "score": score})
+        return out
+
+
+def _get_ci(d: dict, key: str):
+    """Case-tolerant key get: the kube-scheduler marshals ExtenderArgs with
+    lowercase JSON tags ('pod', 'nodes'), while hand-written clients often
+    send Go field casing ('Pod', 'Nodes'). Accept both."""
+    if key in d:
+        return d[key]
+    for k, v in d.items():
+        if k.lower() == key.lower():
+            return v
+    return None
+
+
+class ExtenderHTTPServer(BackgroundHTTPServer):
+    """HTTP wrapper speaking the scheduler-extender JSON protocol.
+
+    Response keys use the protocol's lowercase JSON tags
+    (k8s.io/kube-scheduler/extender/v1: 'nodes', 'failedNodes', 'error';
+    HostPriority 'host'/'score'); Go's case-insensitive unmarshal accepts
+    them either way but real kube-schedulers emit and expect lowercase.
+    """
+
+    def __init__(
+        self,
+        extender: Optional[TopologyExtender] = None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ):
+        super().__init__(host, port)
+        self.extender = extender or TopologyExtender()
+
+    def handler_class(self):
+        ext = self.extender
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _read_args(self) -> dict:
+                length = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            def _send(self, obj, code=200):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                try:
+                    args = self._read_args()
+                except json.JSONDecodeError:
+                    self._send({"error": "bad JSON"}, 400)
+                    return
+                pod = _get_ci(args, "pod") or {}
+                nodes = _get_ci(args, "nodes") or {}
+                items = _get_ci(nodes, "items") or []
+                if self.path == "/filter":
+                    passing, failed = ext.filter(pod, items)
+                    self._send(
+                        {
+                            "nodes": {"items": passing},
+                            "nodenames": None,
+                            "failedNodes": failed,
+                            "error": "",
+                        }
+                    )
+                elif self.path == "/prioritize":
+                    self._send(ext.prioritize(pod, items))
+                else:
+                    self._send({"error": f"unknown path {self.path}"}, 404)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send({"ok": True})
+                else:
+                    self._send({"error": "not found"}, 404)
+
+        return Handler

@@ -225,6 +225,43 @@ class KubeClient:
         finally:
             resp.close()
 
+    # -- events ------------------------------------------------------------
+
+    def create_event(
+        self,
+        namespace: str,
+        involved_object: dict,
+        reason: str,
+        message: str,
+        event_type: str = "Normal",
+        component: str = "tpu-device-plugin",
+    ) -> dict:
+        """Emit a core/v1 Event (the reference wires a broadcaster but never
+        emits one, /root/reference/controller.go:76-80)."""
+        import datetime
+
+        now = (
+            datetime.datetime.now(datetime.timezone.utc)
+            .strftime("%Y-%m-%dT%H:%M:%SZ")
+        )
+        body = {
+            "metadata": {"generateName": f"{component}."},
+            "involvedObject": involved_object,
+            "reason": reason,
+            "message": message,
+            "type": event_type,
+            "source": {"component": component},
+            "firstTimestamp": now,
+            "lastTimestamp": now,
+            "count": 1,
+        }
+        return self._request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/events",
+            data=json.dumps(body),
+            headers={"Content-Type": "application/json"},
+        ).json()
+
     def patch_pod_annotations(
         self,
         namespace: str,

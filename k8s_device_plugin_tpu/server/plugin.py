@@ -25,7 +25,7 @@ import logging
 import os
 import threading
 from concurrent import futures
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 import grpc
 
@@ -38,6 +38,7 @@ from ..api.grpc_defs import (
 )
 from ..topology.mesh import IciMesh
 from ..topology.placement import PlacementState
+from ..utils import metrics
 
 log = logging.getLogger(__name__)
 
@@ -59,6 +60,20 @@ class PluginConfig:
     substitute_on_allocate: bool = False
     # cgroup device permissions for /dev/accel* nodes.
     device_permissions: str = "rwm"
+    # Multi-host slice membership (v4/v5p slices spanning hosts over ICI):
+    # this host's index in the slice, the slice's host list, and the host
+    # grid shape ("x,y,z"). Exported to containers that get the whole host
+    # so libtpu/JAX can form the cross-host mesh. Defaults = single host.
+    #
+    # Provisioning contract (GKE multi-host node-pool semantics): a node
+    # configured with worker_hostnames is *dedicated* to slice workloads —
+    # every host in the slice runs exactly one whole-host worker pod of the
+    # same jobset. Whole-host allocation on such a node therefore IS the
+    # multi-host case; don't configure these on nodes meant for standalone
+    # single-host jobs (their containers would wait for slice peers).
+    worker_id: int = 0
+    worker_hostnames: str = ""
+    slice_host_bounds: str = "1,1,1"
 
     @property
     def socket_path(self) -> str:
@@ -91,6 +106,17 @@ class TpuDevicePlugin(DevicePluginServicer):
         # Serializes Allocate plan→commit so concurrent RPCs (8-thread
         # executor) can't plan overlapping chip sets.
         self._allocate_lock = threading.Lock()
+        # Invoked (no args) whenever allocatable capacity changes —
+        # allocation, free, health transition. The wiring attaches the
+        # node-annotation republisher here so the scheduler extender sees
+        # live availability.
+        self.on_availability_change: Optional[Callable[[], None]] = None
+        # Invoked (chip_id, healthy) on health transitions; the wiring
+        # attaches a Kubernetes Event emitter (the reference wires an event
+        # broadcaster but never emits, /root/reference/controller.go:76-80).
+        self.on_health_transition: Optional[Callable[[str, bool], None]] = None
+        metrics.CHIPS.set(len(mesh.mesh_chips), state="total")
+        self._update_chip_gauges()
         # Device-list versioning: streams re-send whenever bumped.
         self._version = 0
         self._version_cv = threading.Condition()
@@ -167,7 +193,42 @@ class TpuDevicePlugin(DevicePluginServicer):
                 chip_id,
                 constants.HEALTHY if healthy else constants.UNHEALTHY,
             )
+            metrics.HEALTH_TRANSITIONS.inc(
+                direction="recovered" if healthy else "unhealthy"
+            )
             self._bump()
+            self._availability_changed()
+            hook = self.on_health_transition
+            if hook is not None:
+                try:
+                    hook(chip_id, healthy)
+                except Exception:
+                    log.exception("health-transition hook failed")
+
+    def free_devices(self, ids: Iterable[str]) -> None:
+        """Controller free path (pod deleted)."""
+        self.state.free(ids)
+        self._availability_changed()
+
+    def mark_allocated(self, ids: Iterable[str]) -> None:
+        """Controller allocation path (checkpoint rebuild/reconcile) —
+        like Allocate, keeps gauges and the published availability fresh."""
+        self.state.allocate(ids)
+        self._availability_changed()
+
+    def _availability_changed(self) -> None:
+        self._update_chip_gauges()
+        hook = self.on_availability_change
+        if hook is not None:
+            try:
+                hook()
+            except Exception:
+                log.exception("availability-change hook failed")
+
+    def _update_chip_gauges(self) -> None:
+        metrics.CHIPS.set(len(self.state.allocated), state="allocated")
+        metrics.CHIPS.set(len(self.state.unhealthy), state="unhealthy")
+        metrics.CHIPS.set(len(self.state.available()), state="available")
 
     def _bump(self) -> None:
         with self._version_cv:
@@ -213,6 +274,7 @@ class TpuDevicePlugin(DevicePluginServicer):
                 len(resp.devices),
                 sum(1 for d in resp.devices if d.health != constants.HEALTHY),
             )
+            metrics.LISTANDWATCH_SENDS.inc()
             yield resp
 
     def GetPreferredAllocation(self, request, context):
@@ -243,6 +305,7 @@ class TpuDevicePlugin(DevicePluginServicer):
                 requested = list(creq.devicesIDs)
                 unknown = [i for i in requested if i not in self.mesh.by_id]
                 if unknown:
+                    metrics.GRPC_ERRORS.inc(method="Allocate")
                     context.abort(
                         grpc.StatusCode.INVALID_ARGUMENT,
                         f"unknown device ids: {unknown}",
@@ -266,6 +329,7 @@ class TpuDevicePlugin(DevicePluginServicer):
                         # overlaps an earlier container's plan or an
                         # unavailable chip: refusing beats double-mounting
                         # the same /dev/accel* into two containers.
+                        metrics.GRPC_ERRORS.inc(method="Allocate")
                         context.abort(
                             grpc.StatusCode.RESOURCE_EXHAUSTED,
                             f"cannot allocate {len(requested)} chips "
@@ -283,6 +347,9 @@ class TpuDevicePlugin(DevicePluginServicer):
                 log.info(
                     "Allocate: requested=%s assigned=%s", requested, assigned
                 )
+                metrics.ALLOCATIONS.inc()
+                metrics.ALLOCATED_CHIPS.inc(len(assigned))
+        self._availability_changed()
         return resp
 
     def PreStartContainer(self, request, context):
@@ -327,16 +394,30 @@ class TpuDevicePlugin(DevicePluginServicer):
         these through libtpu. Bounds are the bounding box of the allocated
         coords when the set is an exact sub-box, else the full host bounds.
         """
+        cfg = self.config
+        whole_host = len(chips) == len(self.mesh.mesh_chips)
+        multi_host = whole_host and bool(cfg.worker_hostnames)
+        n_hosts = (
+            len(cfg.worker_hostnames.split(",")) if multi_host else 1
+        )
         env = {
             "TPU_CHIPS_PER_HOST_BOUNDS": self._bounds_str(chips),
-            "TPU_HOST_BOUNDS": "1,1,1",
+            # Cross-host slice topology only applies when the container owns
+            # the whole host block; sub-host allocations are single-worker.
+            "TPU_HOST_BOUNDS": (
+                cfg.slice_host_bounds if multi_host else "1,1,1"
+            ),
             "TPU_VISIBLE_CHIPS": ",".join(
                 str(mc.chip.index) for mc in chips
             ),
-            "TPU_ACCELERATOR_TYPE": self._accelerator_type(len(chips)),
-            "TPU_WORKER_ID": "0",
+            "TPU_ACCELERATOR_TYPE": self._accelerator_type(
+                len(chips) * n_hosts
+            ),
+            "TPU_WORKER_ID": str(cfg.worker_id if multi_host else 0),
             "TPU_SKIP_MDS_QUERY": "true",
         }
+        if multi_host:
+            env["TPU_WORKER_HOSTNAMES"] = cfg.worker_hostnames
         return env
 
     def _accelerator_type(self, n_chips: int) -> str:

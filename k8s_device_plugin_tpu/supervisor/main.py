@@ -65,6 +65,12 @@ class DaemonConfig:
     enable_controller: bool = True
     kubeconfig: str = ""
     prefer_native_backend: bool = True
+    # Prometheus endpoint; 0 disables.
+    metrics_port: int = 0
+    # Multi-host slice membership (see PluginConfig).
+    worker_id: int = 0
+    worker_hostnames: str = ""
+    slice_host_bounds: str = "1,1,1"
 
 
 class Daemon:
@@ -78,6 +84,17 @@ class Daemon:
         self.health: Optional[HealthWatcher] = None
         self.controller = None  # set by kube wiring when enabled
         self._kube = None
+        self.metrics_server = None
+        if cfg.metrics_port:
+            from ..utils.metrics import MetricsServer
+
+            try:
+                self.metrics_server = MetricsServer(port=cfg.metrics_port)
+                url = self.metrics_server.start()
+                log.info("metrics at %s/metrics", url)
+            except OSError as e:
+                log.warning("metrics endpoint disabled: %s", e)
+                self.metrics_server = None
 
     # -- build/teardown of one plugin generation ---------------------------
 
@@ -116,6 +133,9 @@ class Daemon:
                 device_plugin_dir=self.cfg.device_plugin_dir,
                 libtpu_host_path=self.cfg.libtpu_host_path,
                 substitute_on_allocate=self.cfg.substitute_on_allocate,
+                worker_id=self.cfg.worker_id,
+                worker_hostnames=self.cfg.worker_hostnames,
+                slice_host_bounds=self.cfg.slice_host_bounds,
             ),
         )
         self.plugin.serve()
@@ -205,6 +225,9 @@ class Daemon:
             self.teardown()
             fs.stop()
             sigs.stop()
+            if self.metrics_server is not None:
+                self.metrics_server.stop()
+                self.metrics_server = None
 
 
 def parse_args(argv) -> DaemonConfig:
@@ -233,6 +256,16 @@ def parse_args(argv) -> DaemonConfig:
     )
     p.add_argument("--health-interval", type=float, default=5.0)
     p.add_argument("--resync-interval", type=float, default=30.0)
+    p.add_argument("--metrics-port", type=int, default=2112,
+                   help="Prometheus /metrics port; 0 disables")
+    p.add_argument("--worker-id", type=int,
+                   default=int(os.environ.get("TPU_WORKER_ID", "0") or 0))
+    p.add_argument("--worker-hostnames",
+                   default=os.environ.get("TPU_WORKER_HOSTNAMES", ""),
+                   help="comma-separated hosts of this node's TPU slice")
+    p.add_argument("--slice-host-bounds",
+                   default=os.environ.get("TPU_HOST_BOUNDS", "1,1,1"),
+                   help="host grid of the slice, e.g. 2,2,1")
     p.add_argument("--no-controller", action="store_true")
     p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
     p.add_argument("--python-backend", action="store_true",
@@ -257,6 +290,10 @@ def parse_args(argv) -> DaemonConfig:
         enable_controller=not a.no_controller,
         kubeconfig=a.kubeconfig,
         prefer_native_backend=not a.python_backend,
+        metrics_port=a.metrics_port,
+        worker_id=a.worker_id,
+        worker_hostnames=a.worker_hostnames,
+        slice_host_bounds=a.slice_host_bounds,
     )
 
 

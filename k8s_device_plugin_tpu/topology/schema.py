@@ -15,6 +15,7 @@ import json
 import platform
 from typing import List, Optional
 
+from ..discovery.chips import TpuChip, spec_for
 from .mesh import IciMesh
 
 SCHEMA_VERSION = 1
@@ -42,6 +43,12 @@ class NodeTopology:
     torus: bool
     numa_nodes: int
     chips: List[ChipInfo]
+    # Chip ids currently allocatable (not allocated, not unhealthy); kept
+    # fresh by republishing on allocation/health changes so the scheduler
+    # extender can filter/score on live capacity — the reference publishes
+    # only the static tree and leaves the extender integration as a TODO
+    # (/root/reference/server.go:298-300).
+    available: List[str] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
@@ -57,6 +64,7 @@ class NodeTopology:
         mesh: IciMesh,
         numa_nodes: int = 1,
         hostname: Optional[str] = None,
+        available: Optional[List[str]] = None,
     ) -> "NodeTopology":
         return NodeTopology(
             version=SCHEMA_VERSION,
@@ -66,6 +74,9 @@ class NodeTopology:
             host_bounds=list(mesh.bounds),
             torus=mesh.spec.torus,
             numa_nodes=numa_nodes,
+            available=sorted(available)
+            if available is not None
+            else sorted(mesh.ids),
             chips=[
                 ChipInfo(
                     id=m.id,
@@ -80,3 +91,30 @@ class NodeTopology:
                 for m in mesh.mesh_chips
             ],
         )
+
+    def to_mesh(self) -> IciMesh:
+        """Reconstruct the mesh (the extender does this from the node
+        annotation). Chip order must reproduce the published coords, so
+        chips are rebuilt in their recorded coordinate order."""
+        ordered = sorted(
+            self.chips,
+            key=lambda c: (c.coords[2], c.coords[1], c.coords[0]),
+        )
+        chips = [
+            TpuChip(
+                index=c.index,
+                dev_path=c.dev_path,
+                pci_addr=c.pci_addr,
+                vendor_id=0,
+                device_id=0,
+                numa_node=c.numa_node,
+                chip_type=self.chip_type,
+                hbm_bytes=c.hbm_bytes,
+                core_count=c.core_count,
+            )
+            for c in ordered
+        ]
+        spec = spec_for(self.chip_type, len(chips))
+        if self.torus != spec.torus:
+            spec = dataclasses.replace(spec, torus=self.torus)
+        return IciMesh(chips, spec=spec, bounds=tuple(self.host_bounds))

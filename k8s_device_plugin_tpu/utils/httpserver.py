@@ -1,0 +1,44 @@
+"""Shared background HTTP server scaffolding (metrics + extender)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import ThreadingHTTPServer
+from typing import Optional, Type
+
+log = logging.getLogger(__name__)
+
+
+class BackgroundHTTPServer:
+    """A ThreadingHTTPServer run on a daemon thread with start/stop/port."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._address = (host, port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def handler_class(self) -> Type:
+        raise NotImplementedError
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None
+        return self._httpd.server_address[1]
+
+    def start(self) -> str:
+        self._httpd = ThreadingHTTPServer(self._address, self.handler_class())
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=type(self).__name__,
+            daemon=True,
+        )
+        self._thread.start()
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,148 @@
+"""Prometheus-format metrics endpoint.
+
+The reference has no metrics at all (SURVEY.md §5: "No Prometheus"); this
+is a deliberate capability add. Zero dependencies: a tiny registry
+rendering the Prometheus text exposition format over http.server, scraped
+at :``--metrics-port``/metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, Tuple
+
+from .httpserver import BackgroundHTTPServer
+
+
+class Metric:
+    def __init__(self, name: str, help_text: str, kind: str):
+        self.name = name
+        self.help = help_text
+        self.kind = kind  # "counter" | "gauge"
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+        return tuple(sorted(labels.items()))
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = value
+
+    def get(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        with self._lock:
+            if not self._values:
+                lines.append(f"{self.name} 0")
+            for key, value in sorted(self._values.items()):
+                if key:
+                    label_s = ",".join(f'{k}="{v}"' for k, v in key)
+                    lines.append(f"{self.name}{{{label_s}}} {_fmt(value)}")
+                else:
+                    lines.append(f"{self.name} {_fmt(value)}")
+        return "\n".join(lines)
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(v)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._start = time.time()
+
+    def counter(self, name: str, help_text: str) -> Metric:
+        return self._register(name, help_text, "counter")
+
+    def gauge(self, name: str, help_text: str) -> Metric:
+        return self._register(name, help_text, "gauge")
+
+    def _register(self, name: str, help_text: str, kind: str) -> Metric:
+        if name not in self._metrics:
+            self._metrics[name] = Metric(name, help_text, kind)
+        return self._metrics[name]
+
+    def render(self) -> str:
+        parts = [m.render() for m in self._metrics.values()]
+        parts.append(
+            "# HELP tpu_plugin_uptime_seconds Seconds since plugin start\n"
+            "# TYPE tpu_plugin_uptime_seconds gauge\n"
+            f"tpu_plugin_uptime_seconds {_fmt(round(time.time() - self._start, 1))}"
+        )
+        return "\n".join(parts) + "\n"
+
+
+# The plugin's metrics (module-level: one daemon per process).
+REGISTRY = Registry()
+CHIPS = REGISTRY.gauge(
+    "tpu_plugin_chips", "Chip counts by state (total/allocated/unhealthy)"
+)
+ALLOCATIONS = REGISTRY.counter(
+    "tpu_plugin_allocations_total", "Container allocation requests served"
+)
+ALLOCATED_CHIPS = REGISTRY.counter(
+    "tpu_plugin_allocated_chips_total", "Chips handed to containers"
+)
+HEALTH_TRANSITIONS = REGISTRY.counter(
+    "tpu_plugin_health_transitions_total",
+    "Chip health transitions by direction",
+)
+LISTANDWATCH_SENDS = REGISTRY.counter(
+    "tpu_plugin_listandwatch_sends_total",
+    "Device-list advertisements streamed to the kubelet",
+)
+GRPC_ERRORS = REGISTRY.counter(
+    "tpu_plugin_grpc_errors_total", "gRPC requests answered with an error"
+)
+
+
+class MetricsServer(BackgroundHTTPServer):
+    """Serves GET /metrics (and /healthz) for Prometheus scrapes."""
+
+    def __init__(self, registry: Registry = REGISTRY, host: str = "0.0.0.0",
+                 port: int = 0):
+        super().__init__(host, port)
+        self.registry = registry
+
+    def handler_class(self):
+        registry = self.registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = registry.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                elif self.path == "/healthz":
+                    body = b"ok\n"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                else:
+                    body = b"not found\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        return Handler

@@ -23,6 +23,7 @@ class FakeApiServer:
         self.nodes: Dict[str, dict] = {}
         self.pod_patches: List[Tuple[str, str, dict]] = []
         self.node_patches: List[Tuple[str, dict]] = []
+        self.events: List[dict] = []
         self._watchers: List["queue.Queue"] = []
         # (rv, event) log so watches replay from a resourceVersion like the
         # real API server does.
@@ -87,6 +88,18 @@ class FakeApiServer:
                         server._handle_watch(self, params)
                     else:
                         server._handle_list(self, params)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                parts = self.path.strip("/").split("/")
+                # api/v1/namespaces/{ns}/events
+                if len(parts) == 5 and parts[4] == "events":
+                    with server._lock:
+                        server.events.append(body)
+                    server._send_json(self, body, 201)
                 else:
                     self.send_error(404)
 

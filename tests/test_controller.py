@@ -273,3 +273,17 @@ def test_publish_node_topology(api, plugin):
     assert parsed.chip_count == 4
     assert node["metadata"]["labels"]["google.com/tpu-topology"] == "2x2x1"
     assert node["metadata"]["labels"]["google.com/tpu-accelerator"] == "v5p"
+
+
+def test_rebuild_updates_gauges_and_hooks(api, plugin, tmp_path):
+    """Checkpoint rebuild must flow through the notifying allocation path
+    so the published availability and metrics reflect held chips."""
+    ids = plugin.mesh.ids
+    changed = []
+    plugin.on_availability_change = lambda: changed.append(True)
+    ctrl, server = make_controller(api, plugin, tmp_path,
+                                   by_pod={"uid-live": ids[:2]})
+    server.add_pod(pod_dict("live-pod", "uid-live", tpus=2))
+    ctrl.rebuild_state()
+    assert plugin.state.allocated == set(ids[:2])
+    assert changed  # hook fired -> publisher would republish

@@ -1,0 +1,182 @@
+"""Scheduler-extender tests (BASELINE config 4): filter/prioritize over
+published node topologies, driven through the real HTTP protocol.
+
+Scenario under test: an 8-chip pod across 2×v5p hosts must land on hosts
+whose chips are fully free (whole ICI block), and partial/fragmented hosts
+must score below compact ones.
+"""
+
+import json
+
+import pytest
+import requests
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.discovery.chips import TpuChip
+from k8s_device_plugin_tpu.extender.server import (
+    ExtenderHTTPServer,
+    TopologyExtender,
+)
+from k8s_device_plugin_tpu.topology.mesh import IciMesh
+from k8s_device_plugin_tpu.topology.schema import NodeTopology
+
+
+def make_mesh(chip_type="v5p", n=4):
+    chips = [
+        TpuChip(
+            index=i,
+            dev_path=f"/dev/accel{i}",
+            pci_addr=f"0000:00:{4 + i:02x}.0",
+            vendor_id=0x1AE0,
+            device_id=0,
+            numa_node=0,
+            chip_type=chip_type,
+            hbm_bytes=0,
+            core_count=2,
+        )
+        for i in range(n)
+    ]
+    return IciMesh(chips)
+
+
+def make_node(name, chip_type="v5p", n=4, available=None):
+    mesh = make_mesh(chip_type, n)
+    topo = NodeTopology.from_mesh(
+        mesh, hostname=name,
+        available=available if available is not None else mesh.ids,
+    )
+    return {
+        "metadata": {
+            "name": name,
+            "annotations": {constants.TOPOLOGY_ANNOTATION: topo.to_json()},
+        }
+    }, mesh
+
+
+def tpu_pod(n):
+    return {
+        "metadata": {"name": "p", "namespace": "default", "uid": "u"},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "resources": {"requests": {"google.com/tpu": str(n)}},
+                }
+            ]
+        },
+    }
+
+
+@pytest.fixture(scope="module")
+def http_server():
+    srv = ExtenderHTTPServer(host="127.0.0.1")
+    url = srv.start()
+    yield url
+    srv.stop()
+
+
+def post(url, path, pod, nodes, keycase="lower"):
+    # Real kube-schedulers marshal ExtenderArgs with lowercase JSON tags;
+    # Go-cased keys are accepted too (tested explicitly below).
+    if keycase == "lower":
+        body = {"pod": pod, "nodes": {"items": nodes}}
+    else:
+        body = {"Pod": pod, "Nodes": {"items": nodes}}
+    resp = requests.post(f"{url}{path}", json=body, timeout=10)
+    resp.raise_for_status()
+    return resp.json()
+
+
+def test_filter_by_availability(http_server):
+    full, _ = make_node("full")
+    mesh = make_mesh()
+    partial, _ = make_node("partial", available=mesh.ids[:1])
+    empty, _ = make_node("empty", available=[])
+    plain = {"metadata": {"name": "cpu-node", "annotations": {}}}
+    out = post(http_server, "/filter", tpu_pod(2), [full, partial, empty, plain])
+    names = [n["metadata"]["name"] for n in out["nodes"]["items"]]
+    assert names == ["full"]
+    assert set(out["failedNodes"]) == {"partial", "empty", "cpu-node"}
+    assert "available" in out["failedNodes"]["partial"]
+
+
+def test_filter_passes_everything_for_non_tpu_pod(http_server):
+    node, _ = make_node("n1")
+    plain = {"metadata": {"name": "cpu-node", "annotations": {}}}
+    pod = {"metadata": {"name": "p"}, "spec": {"containers": [{"name": "c"}]}}
+    out = post(http_server, "/filter", pod, [node, plain])
+    assert len(out["nodes"]["items"]) == 2
+    assert out["failedNodes"] == {}
+
+
+def test_multi_host_slice_requires_full_hosts(http_server):
+    # 8-chip pod over 4-chip v5p hosts: only fully-free hosts qualify.
+    free, _ = make_node("free-host")
+    mesh = make_mesh()
+    busy, _ = make_node("busy-host", available=mesh.ids[:3])
+    out = post(http_server, "/filter", tpu_pod(8), [free, busy])
+    names = [n["metadata"]["name"] for n in out["nodes"]["items"]]
+    assert names == ["free-host"]
+    assert "full host" in out["failedNodes"]["busy-host"]
+
+
+def test_multi_host_non_multiple_rejected(http_server):
+    node, _ = make_node("h1")
+    out = post(http_server, "/filter", tpu_pod(6), [node])
+    assert out["nodes"]["items"] == []
+    assert "multiple" in out["failedNodes"]["h1"]
+
+
+def test_prioritize_prefers_compact_blocks(http_server):
+    # v5e hosts: one with a free 2x2 block, one with a fragmented diagonal
+    # scatter of 4 chips.
+    mesh = make_mesh("v5e", 8)
+    # 2x2 block: coords (0,0),(1,0),(0,1),(1,1) = ids[0],ids[1],ids[2],ids[3]
+    block, _ = make_node("block", "v5e", 8, available=mesh.ids[:4])
+    scatter, _ = make_node(
+        "scatter", "v5e", 8,
+        available=[mesh.ids[0], mesh.ids[3], mesh.ids[4], mesh.ids[7]],
+    )
+    out = post(http_server, "/prioritize", tpu_pod(4), [block, scatter])
+    scores = {e["host"]: e["score"] for e in out}
+    assert scores["block"] > scores["scatter"]
+
+
+def test_prioritize_packing_bonus(http_server):
+    # Exact-fit host (4 free, ask 4) outranks a host with 8 free (which
+    # should be preserved for bigger jobs).
+    exact, _ = make_node("exact", "v5p", 4)
+    roomy, _ = make_node("roomy", "v5e", 8)
+    out = post(http_server, "/prioritize", tpu_pod(4), [exact, roomy])
+    scores = {e["host"]: e["score"] for e in out}
+    assert scores["exact"] > scores["roomy"]
+
+
+def test_score_zero_when_unsatisfiable():
+    ext = TopologyExtender()
+    mesh = make_mesh()
+    topo = NodeTopology.from_mesh(mesh, available=mesh.ids[:1])
+    assert ext.score_node(4, topo) == 0
+
+
+def test_bad_annotation_fails_filter(http_server):
+    node = {
+        "metadata": {
+            "name": "corrupt",
+            "annotations": {constants.TOPOLOGY_ANNOTATION: "{not json"},
+        }
+    }
+    out = post(http_server, "/filter", tpu_pod(1), [node])
+    assert "corrupt" in out["failedNodes"]
+
+
+def test_healthz(http_server):
+    assert requests.get(f"{http_server}/healthz", timeout=5).json() == {
+        "ok": True
+    }
+
+
+def test_go_cased_request_keys_accepted(http_server):
+    node, _ = make_node("n1")
+    out = post(http_server, "/filter", tpu_pod(2), [node], keycase="go")
+    assert [n["metadata"]["name"] for n in out["nodes"]["items"]] == ["n1"]

@@ -1,0 +1,176 @@
+"""Metrics endpoint, k8s Events, live topology republish, slice env.
+
+All capability *adds* over the reference (SURVEY.md §5: no Prometheus, an
+event broadcaster that never emits, a static-only scheduler annotation).
+"""
+
+import time
+
+import pytest
+import requests
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
+from k8s_device_plugin_tpu.controller.wiring import TopologyPublisher
+from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+from k8s_device_plugin_tpu.kube.client import KubeClient
+from k8s_device_plugin_tpu.server.plugin import PluginConfig, TpuDevicePlugin
+from k8s_device_plugin_tpu.topology.mesh import IciMesh
+from k8s_device_plugin_tpu.topology.schema import NodeTopology
+from k8s_device_plugin_tpu.utils import metrics
+from tests import fakes
+from tests.fake_apiserver import FakeApiServer
+
+NODE = "tpu-node-1"
+
+
+def make_plugin(tmp_path, chip_type="v5p", count=4, **cfg):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), chip_type, count)
+    chips = PyTpuInfo().scan(accel, dev)
+    return TpuDevicePlugin(
+        IciMesh(chips),
+        config=PluginConfig(libtpu_host_path="", **cfg),
+    )
+
+
+def wait_for(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_metrics_registry_rendering():
+    reg = metrics.Registry()
+    c = reg.counter("test_total", "a counter")
+    g = reg.gauge("test_gauge", "a gauge")
+    c.inc()
+    c.inc(2, method="Allocate")
+    g.set(4, state="available")
+    text = reg.render()
+    assert "# TYPE test_total counter" in text
+    assert "test_total 3" in text or "test_total{" in text
+    assert 'test_total{method="Allocate"} 2' in text
+    assert 'test_gauge{state="available"} 4' in text
+    assert "tpu_plugin_uptime_seconds" in text
+
+
+def test_metrics_server_scrape(tmp_path):
+    plugin = make_plugin(tmp_path)
+    plugin.state.allocate(plugin.mesh.ids[:2])
+    plugin._availability_changed()
+    srv = metrics.MetricsServer(host="127.0.0.1")
+    url = srv.start()
+    try:
+        text = requests.get(f"{url}/metrics", timeout=5).text
+        assert 'tpu_plugin_chips{state="total"} 4' in text
+        assert 'tpu_plugin_chips{state="allocated"} 2' in text
+        assert 'tpu_plugin_chips{state="available"} 2' in text
+        assert requests.get(f"{url}/healthz", timeout=5).text == "ok\n"
+        assert requests.get(f"{url}/nope", timeout=5).status_code == 404
+    finally:
+        srv.stop()
+
+
+# -- events + live republish ------------------------------------------------
+
+@pytest.fixture
+def api():
+    s = FakeApiServer()
+    url = s.start()
+    s.add_node(NODE)
+    yield s, KubeClient(url)
+    s.stop()
+
+
+def test_health_transition_emits_event(tmp_path, api):
+    server, client = api
+    plugin = make_plugin(tmp_path)
+
+    def emit(chip_id, healthy):
+        client.create_event(
+            "default",
+            {"kind": "Node", "name": NODE},
+            reason="TPUChipRecovered" if healthy else "TPUChipUnhealthy",
+            message=f"chip {chip_id}",
+            event_type="Normal" if healthy else "Warning",
+        )
+
+    plugin.on_health_transition = emit
+    bad = plugin.mesh.ids[0]
+    plugin.notify_health(bad, healthy=False)
+    assert wait_for(lambda: server.events)
+    ev = server.events[0]
+    assert ev["reason"] == "TPUChipUnhealthy"
+    assert ev["type"] == "Warning"
+    assert ev["involvedObject"]["name"] == NODE
+    plugin.notify_health(bad, healthy=True)
+    assert wait_for(lambda: len(server.events) == 2)
+    assert server.events[1]["reason"] == "TPUChipRecovered"
+
+
+def test_publisher_republishes_on_allocation(tmp_path, api):
+    server, client = api
+    plugin = make_plugin(tmp_path)
+    pub = TopologyPublisher(client, NODE, plugin, debounce_s=0.05)
+    pub.publish_now()
+    pub.start()
+    plugin.on_availability_change = pub.trigger
+    try:
+        topo = NodeTopology.from_json(
+            server.nodes[NODE]["metadata"]["annotations"][
+                constants.TOPOLOGY_ANNOTATION
+            ]
+        )
+        assert len(topo.available) == 4
+        plugin.state.allocate(plugin.mesh.ids[:2])
+        plugin._availability_changed()
+
+        def republished():
+            t = NodeTopology.from_json(
+                server.nodes[NODE]["metadata"]["annotations"][
+                    constants.TOPOLOGY_ANNOTATION
+                ]
+            )
+            return len(t.available) == 2
+
+        assert wait_for(republished)
+    finally:
+        pub.stop()
+
+
+# -- multi-host slice env ---------------------------------------------------
+
+def test_whole_host_multi_host_env(tmp_path):
+    plugin = make_plugin(
+        tmp_path,
+        worker_id=1,
+        worker_hostnames="host-a,host-b",
+        slice_host_bounds="2,1,1",
+    )
+    resp = plugin._container_response(plugin.mesh.ids)  # whole host
+    env = dict(resp.envs)
+    assert env["TPU_HOST_BOUNDS"] == "2,1,1"
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_WORKER_HOSTNAMES"] == "host-a,host-b"
+    # 4 chips × 2 cores × 2 hosts = v5p-16
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-16"
+
+
+def test_sub_host_allocation_stays_single_worker(tmp_path):
+    plugin = make_plugin(
+        tmp_path,
+        worker_id=1,
+        worker_hostnames="host-a,host-b",
+        slice_host_bounds="2,1,1",
+    )
+    resp = plugin._container_response(plugin.mesh.ids[:2])
+    env = dict(resp.envs)
+    assert env["TPU_HOST_BOUNDS"] == "1,1,1"
+    assert env["TPU_WORKER_ID"] == "0"
+    assert "TPU_WORKER_HOSTNAMES" not in env
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-4"
