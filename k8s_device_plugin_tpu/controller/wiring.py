@@ -33,12 +33,14 @@ def publish_node_topology(
     annotation: str = constants.TOPOLOGY_ANNOTATION,
     retries: int = 3,
     available=None,
+    numa_info=None,
 ) -> NodeTopology:
     """Publish the ICI topology as a node annotation, retrying on conflict
     like the reference's patchNode loop (/root/reference/server.go:312-347).
     Also sets a scheduler-friendly label with the mesh shape."""
     topo = NodeTopology.from_mesh(
-        mesh, numa_nodes=numa_nodes, hostname=node_name, available=available
+        mesh, numa_nodes=numa_nodes, hostname=node_name, available=available,
+        numa_info=numa_info,
     )
     shape = "x".join(str(b) for b in mesh.bounds)
     last: Optional[Exception] = None
@@ -81,12 +83,14 @@ class TopologyPublisher:
         plugin,
         numa_nodes: int = 1,
         debounce_s: float = 0.3,
+        numa_info=None,
     ):
         self.client = client
         self.node_name = node_name
         self.plugin = plugin
         self.numa_nodes = numa_nodes
         self.debounce_s = debounce_s
+        self.numa_info = numa_info
         self._dirty = threading.Event()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -111,6 +115,7 @@ class TopologyPublisher:
             self.plugin.mesh,
             numa_nodes=self.numa_nodes,
             available=self.plugin.state.available(),
+            numa_info=self.numa_info,
         )
 
     def _run(self) -> None:
@@ -131,12 +136,15 @@ def start_kube_integration(daemon, mesh: IciMesh) -> Tuple[Controller, KubeClien
     client = KubeClient.from_env(cfg.kubeconfig)
     node_name = cfg.node_name or os.uname().nodename
     numa = 1
+    numa_info = []
     try:
         numa = daemon.backend.numa_node_count(cfg.numa_dir)
+        numa_info = daemon.backend.numa_topology(cfg.numa_dir)
     except OSError:
         pass
     publisher = TopologyPublisher(
-        client, node_name, daemon.plugin, numa_nodes=numa
+        client, node_name, daemon.plugin, numa_nodes=numa,
+        numa_info=numa_info,
     )
     publisher.start()
     daemon.plugin.on_availability_change = publisher.trigger

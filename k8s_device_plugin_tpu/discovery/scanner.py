@@ -31,6 +31,15 @@ _PATH_LEN = 128
 _TYPE_LEN = 16
 
 
+class _CNumaNode(ctypes.Structure):
+    # Mirrors tpuinfo_numa_node_info in native/tpuinfo/tpuinfo.h.
+    _fields_ = [
+        ("node_id", ctypes.c_int),
+        ("mem_total_bytes", ctypes.c_longlong),
+        ("cpu_count", ctypes.c_int),
+    ]
+
+
 class _CChip(ctypes.Structure):
     # Mirrors tpuinfo_chip in native/tpuinfo/tpuinfo.h.
     _fields_ = [
@@ -84,6 +93,10 @@ class NativeTpuInfo:
         ]
         self._lib.tpuinfo_numa_node_count.restype = ctypes.c_int
         self._lib.tpuinfo_numa_node_count.argtypes = [ctypes.c_char_p]
+        self._lib.tpuinfo_numa_topology.restype = ctypes.c_int
+        self._lib.tpuinfo_numa_topology.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(_CNumaNode), ctypes.c_int,
+        ]
         self._lib.tpuinfo_probe_libtpu.restype = ctypes.c_int
         self._lib.tpuinfo_probe_libtpu.argtypes = [ctypes.c_char_p]
         self._lib.tpuinfo_version.restype = ctypes.c_char_p
@@ -129,6 +142,20 @@ class NativeTpuInfo:
         if r < 0:
             raise OSError(-r, "tpuinfo_numa_node_count failed")
         return r
+
+    def numa_topology(self, nodes_dir: str = DEFAULT_NUMA_DIR) -> List[dict]:
+        buf = (_CNumaNode * 64)()
+        n = self._lib.tpuinfo_numa_topology(nodes_dir.encode(), buf, 64)
+        if n < 0:
+            raise OSError(-n, "tpuinfo_numa_topology failed")
+        return [
+            {
+                "node_id": buf[i].node_id,
+                "mem_total_bytes": buf[i].mem_total_bytes,
+                "cpu_count": buf[i].cpu_count,
+            }
+            for i in range(min(n, 64))
+        ]
 
     def probe_libtpu(self, path: str = "") -> bool:
         return bool(self._lib.tpuinfo_probe_libtpu(path.encode()))
@@ -235,6 +262,50 @@ class PyTpuInfo:
             if e.startswith("node") and e[4:].isdigit()
         )
         return max(n, 1)
+
+    def numa_topology(self, nodes_dir: str = DEFAULT_NUMA_DIR) -> List[dict]:
+        try:
+            entries = sorted(
+                int(e[4:])
+                for e in os.listdir(nodes_dir)
+                if e.startswith("node") and e[4:].isdigit()
+            )
+        except FileNotFoundError:
+            return []
+        out = []
+        for nid in entries:
+            base = os.path.join(nodes_dir, f"node{nid}")
+            mem_kb = 0
+            for line in _read_trimmed(
+                os.path.join(base, "meminfo")
+            ).splitlines():
+                if "MemTotal:" in line:
+                    try:
+                        mem_kb = int(line.split("MemTotal:")[1].split()[0])
+                    except (ValueError, IndexError):
+                        pass
+                    break
+            cpus = 0
+            for part in _read_trimmed(os.path.join(base, "cpulist")).split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                if "-" in part:
+                    lo, hi = part.split("-", 1)
+                    try:
+                        cpus += int(hi) - int(lo) + 1
+                    except ValueError:
+                        pass
+                else:
+                    cpus += 1
+            out.append(
+                {
+                    "node_id": nid,
+                    "mem_total_bytes": mem_kb * 1024,
+                    "cpu_count": cpus,
+                }
+            )
+        return out
 
     def probe_libtpu(self, path: str = "") -> bool:
         try:

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the workload hot path."""
+from .rmsnorm import rmsnorm  # noqa: F401
+from .attention import flash_attention, reference_attention  # noqa: F401

@@ -1,0 +1,108 @@
+"""Fused RMSNorm as a Pallas TPU kernel.
+
+The workload's normalization layers are memory-bound elementwise chains
+(square → mean → rsqrt → scale); fusing them into one VMEM pass avoids
+HBM round-trips between the reduction and the scale. Forward runs in
+Pallas (per-row blocks in VMEM, VPU reductions); the backward pass is
+expressed with jnp in a custom_vjp — XLA already fuses it well, and the
+saved residuals (x, rrms) come from the kernel.
+
+On non-TPU backends the same kernel runs in interpreter mode, so tests and
+the CPU mesh exercise identical code paths (pallas_guide.md: Debugging /
+interpret=True).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Rows per grid step: multiple of the f32 sublane tile (8) with headroom.
+_BLOCK_ROWS = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _rmsnorm_kernel(x_ref, scale_ref, out_ref, rrms_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    rrms = jax.lax.rsqrt(ms + eps)
+    out_ref[:] = (x * rrms * scale_ref[:].astype(jnp.float32)).astype(
+        out_ref.dtype
+    )
+    rrms_ref[:] = rrms
+
+
+def _rmsnorm_fwd_pallas(x2d: jax.Array, scale: jax.Array, eps: float):
+    rows, d = x2d.shape
+    block = min(_BLOCK_ROWS, rows)
+    grid = (pl.cdiv(rows, block),)
+    out, rrms = pl.pallas_call(
+        functools.partial(_rmsnorm_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, d), x2d.dtype),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2d, scale.reshape(1, d))
+    return out, rrms
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rmsnorm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """y = x * scale / sqrt(mean(x², -1) + eps), fused on TPU.
+
+    x: (..., d), scale: (d,). Differentiable w.r.t. x and scale.
+    """
+    y, _ = _fwd(x, scale, eps)
+    return y
+
+
+def _fwd(x, scale, eps):
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    out, rrms = _rmsnorm_fwd_pallas(x2d, scale, eps)
+    return out.reshape(shape), (x2d, rrms, scale)
+
+
+def _vjp_fwd(x, scale, eps):
+    y, res = _fwd(x, scale, eps)
+    return y, res
+
+
+def _vjp_bwd(eps, res, g):
+    x2d, rrms, scale = res
+    d = x2d.shape[-1]
+    g2d = g.reshape(-1, d).astype(jnp.float32)
+    xf = x2d.astype(jnp.float32)
+    sf = scale.astype(jnp.float32)
+    gs = g2d * sf  # dL/d(normalized x)
+    # dx = rrms * (gs - x * mean(gs * x) * rrms² )
+    inner = jnp.mean(gs * xf, axis=-1, keepdims=True)
+    dx = rrms * (gs - xf * inner * rrms * rrms)
+    dscale = jnp.sum(g2d * xf * rrms, axis=0)
+    return (
+        dx.astype(x2d.dtype).reshape(g.shape),
+        dscale.astype(scale.dtype),
+    )
+
+
+rmsnorm.defvjp(_vjp_fwd, _vjp_bwd)

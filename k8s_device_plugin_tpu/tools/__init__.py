@@ -1,0 +1,1 @@
+"""Debug/operator CLIs."""

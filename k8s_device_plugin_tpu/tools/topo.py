@@ -1,0 +1,107 @@
+"""Debug CLI: print the node's TPU topology tree.
+
+The analog of the reference's printDeviceTree debug output at -v=2
+(/root/reference/main.go:70-72, topology.go:100-112): render what the
+plugin would discover and how it scores placements, either from a live
+sysfs scan or from a published node-annotation JSON.
+
+    python -m k8s_device_plugin_tpu.tools.topo
+    python -m k8s_device_plugin_tpu.tools.topo --sysfs /tmp/fake/sys/class/accel --dev /tmp/fake/dev
+    python -m k8s_device_plugin_tpu.tools.topo --from-json topo.json --select 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..discovery.scanner import DEFAULT_DEV, DEFAULT_SYSFS_ACCEL, get_backend
+from ..topology.mesh import IciMesh
+from ..topology.placement import PlacementState
+from ..topology.schema import NodeTopology
+
+
+def render_mesh(mesh: IciMesh, available=None) -> str:
+    lines = []
+    spec = mesh.spec
+    lines.append(
+        f"accelerator: {spec.chip_type}  bounds: "
+        f"{'x'.join(map(str, mesh.bounds))}  torus: {spec.torus}  "
+        f"chips: {len(mesh.mesh_chips)}"
+    )
+    avail = set(available) if available is not None else set(mesh.ids)
+    bx, by, bz = mesh.bounds
+    for z in range(bz):
+        if bz > 1:
+            lines.append(f"z={z}:")
+        for y in range(by):
+            row = []
+            for x in range(bx):
+                mc = mesh.by_coords.get((x, y, z))
+                if mc is None:
+                    row.append("      .      ")
+                else:
+                    mark = " " if mc.id in avail else "*"
+                    row.append(f"[{mc.chip.index}:{mc.chip.pci_addr[-7:]}{mark}]")
+            lines.append("  " + " ".join(row))
+    lines.append("  (* = allocated/unhealthy)")
+    for mc in mesh.mesh_chips:
+        neigh = ", ".join(
+            f"accel{mesh.by_id[n].chip.index}" for n in mesh.neighbors(mc.id)
+        )
+        lines.append(
+            f"  accel{mc.chip.index} {mc.id} coords={mc.coords} "
+            f"numa={mc.chip.numa_node} ici-neighbors=[{neigh}]"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-topo")
+    p.add_argument("--sysfs", default=DEFAULT_SYSFS_ACCEL)
+    p.add_argument("--dev", default=DEFAULT_DEV)
+    p.add_argument("--from-json", default="",
+                   help="render a published node-topology JSON instead")
+    p.add_argument("--select", type=int, default=0, metavar="N",
+                   help="also show which N chips the placement policy picks")
+    p.add_argument("--json", action="store_true",
+                   help="emit the NodeTopology JSON instead of ASCII")
+    a = p.parse_args(argv)
+
+    available = None
+    if a.from_json:
+        with open(a.from_json) as f:
+            topo = NodeTopology.from_json(f.read())
+        mesh = topo.to_mesh()
+        available = topo.available
+    else:
+        backend = get_backend()
+        chips = backend.scan(a.sysfs, a.dev)
+        if not chips:
+            print("no TPU chips found (CPU-only node?)", file=sys.stderr)
+            return 1
+        mesh = IciMesh(chips)
+
+    if a.json:
+        print(NodeTopology.from_mesh(mesh, available=available).to_json())
+        return 0
+
+    print(render_mesh(mesh, available))
+    if a.select:
+        state = PlacementState(mesh)
+        if available is not None:
+            state.reset(allocated=set(mesh.ids) - set(available))
+        picked = state.select(a.select)
+        score = mesh.set_score(picked) if picked else 0
+        print(
+            f"\nselect({a.select}) -> "
+            f"{[mesh.by_id[i].chip.index for i in picked] if picked else 'none'}"
+            f"  internal-links={mesh.internal_links(picked) if picked else 0}"
+            f"  avg-score={score:.1f}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,11 @@ class NodeTopology:
     # only the static tree and leaves the extender integration as a TODO
     # (/root/reference/server.go:298-300).
     available: List[str] = dataclasses.field(default_factory=list)
+    # Host NUMA detail from the native reader (tpuinfo_numa_topology) —
+    # populates the CPU/memory part of the reference's schema that it
+    # declared but never filled (/root/reference/device.go:19-97):
+    # [{node_id, mem_total_bytes, cpu_count}].
+    numa: List[dict] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
@@ -65,6 +70,7 @@ class NodeTopology:
         numa_nodes: int = 1,
         hostname: Optional[str] = None,
         available: Optional[List[str]] = None,
+        numa_info: Optional[List[dict]] = None,
     ) -> "NodeTopology":
         return NodeTopology(
             version=SCHEMA_VERSION,
@@ -77,6 +83,7 @@ class NodeTopology:
             available=sorted(available)
             if available is not None
             else sorted(mesh.ids),
+            numa=list(numa_info or []),
             chips=[
                 ChipInfo(
                     id=m.id,

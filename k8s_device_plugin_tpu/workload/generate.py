@@ -1,0 +1,98 @@
+"""Greedy generation smoke — the forward-only/inference path.
+
+Exercises what training doesn't: the Pallas flash-attention kernel
+(ops/attention.py, forward-only), static-shape decoding under jit (the
+sequence buffer stays max_seq_len; a position counter masks the future), and
+argmax sampling with no data-dependent Python control flow (lax.fori_loop,
+pallas_guide.md/XLA semantics).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .model import ModelConfig, TransformerLM
+
+
+def greedy_generate(
+    cfg: ModelConfig,
+    params,
+    prompt: jax.Array,
+    steps: int,
+) -> jax.Array:
+    """Append `steps` greedy tokens to `prompt` (batch, prompt_len).
+
+    The whole loop is one jitted computation on a fixed (batch,
+    max_seq_len) buffer: each iteration runs the forward on the full
+    buffer, reads the logits at the current position, and writes the argmax
+    token at position+1. Positions beyond the current length hold zeros and
+    cannot influence earlier positions (causal attention), so static shapes
+    are preserved with no recompilation per step.
+    """
+    batch, prompt_len = prompt.shape
+    if prompt_len + steps > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {prompt_len} + steps {steps} exceeds max_seq_len "
+            f"{cfg.max_seq_len}"
+        )
+    run = _compiled_decode(cfg, batch, prompt_len, steps)
+    return run(params, prompt)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_decode(cfg: ModelConfig, batch: int, prompt_len: int,
+                     steps: int):
+    """One compiled decode loop per (cfg, shapes) — repeat calls hit the
+    jit cache instead of re-tracing a fresh closure each time."""
+    model = TransformerLM(cfg)
+
+    @jax.jit
+    def run(params, prompt):
+        buf = jnp.zeros((batch, cfg.max_seq_len), jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+
+        def step(i, buf):
+            pos = prompt_len + i  # traced offset, static shapes
+            logits = model.apply({"params": params}, buf)
+            next_tok = jnp.argmax(
+                jax.lax.dynamic_slice_in_dim(logits, pos - 1, 1, axis=1),
+                axis=-1,
+            ).astype(jnp.int32)  # (batch, 1)
+            return jax.lax.dynamic_update_slice(buf, next_tok, (0, pos))
+
+        buf = jax.lax.fori_loop(0, steps, step, buf)
+        return buf[:, : prompt_len + steps]
+
+    return run
+
+
+def run_generation_smoke(
+    cfg: Optional[ModelConfig] = None,
+    batch: int = 2,
+    prompt_len: int = 8,
+    steps: int = 8,
+    seed: int = 0,
+) -> dict:
+    from .model import init_params
+
+    cfg = cfg or ModelConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(seed + 1), (batch, prompt_len), 0, cfg.vocab_size
+    )
+    tokens = greedy_generate(cfg, params, prompt, steps)
+    return {
+        "prompt_shape": list(prompt.shape),
+        "output_shape": list(tokens.shape),
+        "tokens_in_vocab": bool(
+            jnp.all((tokens >= 0) & (tokens < cfg.vocab_size))
+        ),
+        "prompt_preserved": bool(
+            jnp.array_equal(tokens[:, :prompt_len], prompt)
+        ),
+        "flash_attention": cfg.use_flash_attention,
+    }

@@ -20,7 +20,25 @@ import jax
 import jax.numpy as jnp
 from flax.linen import partitioning as nn_partitioning
 
+from ..ops import flash_attention, rmsnorm
+
 param_with_axes = nn_partitioning.param_with_axes
+
+
+class Norm(nn.Module):
+    """RMSNorm, optionally via the fused Pallas kernel (ops/rmsnorm.py)."""
+
+    cfg: "ModelConfig"
+
+    @nn.compact
+    def __call__(self, x):
+        if not self.cfg.use_pallas_norm:
+            return nn.RMSNorm(use_scale=True)(x)
+        scale = param_with_axes(
+            "scale", nn.initializers.ones, (x.shape[-1],), jnp.float32,
+            axes=("embed",),
+        )
+        return rmsnorm(x, scale)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -32,6 +50,11 @@ class ModelConfig:
     d_ff: int = 512
     max_seq_len: int = 128
     dtype: Any = jnp.bfloat16
+    # Pallas kernels (ops/): both carry custom VJPs and are safe for
+    # training; flash attention's backward recomputes through the
+    # reference formulation (see ops/attention.py).
+    use_pallas_norm: bool = False
+    use_flash_attention: bool = False
 
     @staticmethod
     def tiny() -> "ModelConfig":
@@ -72,16 +95,24 @@ class Attention(nn.Module):
         q = jnp.einsum("bsd,dhk->bshk", x, wq.astype(cfg.dtype))
         k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(cfg.dtype))
         v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(cfg.dtype))
-        scores = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(
-            jnp.asarray(head_dim, cfg.dtype)
-        )
-        seq = x.shape[1]
-        causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
-        scores = jnp.where(causal[None, None, :, :], scores, -1e9)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
-            cfg.dtype
-        )
-        out = jnp.einsum("bhst,bthk->bshk", probs, v)
+        if cfg.use_flash_attention:
+            # Pallas flash-attention path; (b,s,h,k) -> (b,h,s,k).
+            out = flash_attention(
+                q.transpose(0, 2, 1, 3),
+                k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+            ).transpose(0, 2, 1, 3)
+        else:
+            scores = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(
+                jnp.asarray(head_dim, cfg.dtype)
+            )
+            seq = x.shape[1]
+            causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+            scores = jnp.where(causal[None, None, :, :], scores, -1e9)
+            probs = jax.nn.softmax(
+                scores.astype(jnp.float32), axis=-1
+            ).astype(cfg.dtype)
+            out = jnp.einsum("bhst,bthk->bshk", probs, v)
         return jnp.einsum("bshk,hkd->bsd", out, wo.astype(cfg.dtype))
 
 
@@ -109,8 +140,8 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        x = x + Attention(self.cfg)(nn.RMSNorm(use_scale=True)(x))
-        x = x + Mlp(self.cfg)(nn.RMSNorm(use_scale=True)(x))
+        x = x + Attention(self.cfg)(Norm(self.cfg)(x))
+        x = x + Mlp(self.cfg)(Norm(self.cfg)(x))
         return x
 
 
@@ -135,7 +166,7 @@ class TransformerLM(nn.Module):
         x = x.astype(cfg.dtype)
         for _ in range(cfg.n_layers):
             x = Block(cfg)(x)
-        x = nn.RMSNorm(use_scale=True)(x)
+        x = Norm(cfg)(x)
         logits = jnp.einsum(
             "bsd,vd->bsv", x.astype(jnp.float32), embed
         )

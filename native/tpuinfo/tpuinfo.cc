@@ -200,6 +200,69 @@ int tpuinfo_numa_node_count(const char* sysfs_nodes_dir) {
   return count > 0 ? count : 1;
 }
 
+namespace {
+
+/* "0-11,24-35" → 24. Empty/garbage → 0. */
+int CountCpuList(const std::string& cpulist) {
+  int total = 0;
+  std::stringstream ss(cpulist);
+  std::string part;
+  while (std::getline(ss, part, ',')) {
+    if (part.empty()) continue;
+    size_t dash = part.find('-');
+    if (dash == std::string::npos) {
+      ++total;
+    } else {
+      long lo = std::strtol(part.substr(0, dash).c_str(), nullptr, 10);
+      long hi = std::strtol(part.substr(dash + 1).c_str(), nullptr, 10);
+      if (hi >= lo) total += static_cast<int>(hi - lo + 1);
+    }
+  }
+  return total;
+}
+
+/* nodeN/meminfo first lines look like "Node 0 MemTotal:  131072000 kB". */
+long long ParseMemTotalKb(const std::string& meminfo_path) {
+  std::ifstream f(meminfo_path);
+  std::string line;
+  while (std::getline(f, line)) {
+    size_t pos = line.find("MemTotal:");
+    if (pos == std::string::npos) continue;
+    return std::strtoll(line.c_str() + pos + strlen("MemTotal:"), nullptr,
+                        10);
+  }
+  return 0;
+}
+
+}  // namespace
+
+int tpuinfo_numa_topology(const char* sysfs_nodes_dir,
+                          tpuinfo_numa_node_info* out, int max_nodes) {
+  if (sysfs_nodes_dir == nullptr || out == nullptr) return -EINVAL;
+  DIR* d = ::opendir(sysfs_nodes_dir);
+  if (d == nullptr) return errno == ENOENT ? 0 : -errno;
+  std::vector<int> ids;
+  struct dirent* ent;
+  while ((ent = ::readdir(d)) != nullptr) {
+    const char* name = ent->d_name;
+    if (strncmp(name, "node", 4) != 0) continue;
+    char* endp = nullptr;
+    long id = std::strtol(name + 4, &endp, 10);
+    if (endp != name + 4 && *endp == '\0') ids.push_back(static_cast<int>(id));
+  }
+  ::closedir(d);
+  std::sort(ids.begin(), ids.end());
+  int n = static_cast<int>(ids.size());
+  for (int i = 0; i < n && i < max_nodes; ++i) {
+    std::string base =
+        std::string(sysfs_nodes_dir) + "/node" + std::to_string(ids[i]);
+    out[i].node_id = ids[i];
+    out[i].mem_total_bytes = ParseMemTotalKb(base + "/meminfo") * 1024LL;
+    out[i].cpu_count = CountCpuList(ReadTrimmed(base + "/cpulist"));
+  }
+  return n;
+}
+
 int tpuinfo_probe_libtpu(const char* path) {
   const char* soname =
       (path != nullptr && path[0] != '\0') ? path : "libtpu.so";

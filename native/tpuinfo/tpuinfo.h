@@ -58,6 +58,19 @@ int tpuinfo_chip_health(const char* sysfs_class_dir, const char* dev_dir,
  * -errno. */
 int tpuinfo_numa_node_count(const char* sysfs_nodes_dir);
 
+/* Per-NUMA-node detail (replaces the hwloc NUMA walk the reference's
+ * host-topology schema wanted, /root/reference/device.go:19-97): node id,
+ * MemTotal from nodeN/meminfo, and cpu count from nodeN/cpulist. Returns
+ * the node count (possibly > max_nodes, truncated), or -errno. */
+typedef struct {
+  int node_id;
+  long long mem_total_bytes; /* 0 if unknown */
+  int cpu_count;             /* 0 if unknown */
+} tpuinfo_numa_node_info;
+
+int tpuinfo_numa_topology(const char* sysfs_nodes_dir,
+                          tpuinfo_numa_node_info* out, int max_nodes);
+
 /* Optional libtpu probe: returns 1 if libtpu.so can be dlopen'd at the
  * given path (or default soname when path is NULL/empty), else 0. Never
  * fatal. */

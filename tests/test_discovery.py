@@ -132,3 +132,34 @@ def test_parse_accelerator_names():
     assert p("v5p-16") == "v5p"
     assert p("v6e-8") == "v6e"
     assert p("gpu-a100") is None
+
+
+def make_fake_numa(tmp_path, nodes):
+    d = tmp_path / "numa"
+    d.mkdir()
+    for nid, (mem_kb, cpulist) in nodes.items():
+        nd = d / f"node{nid}"
+        nd.mkdir()
+        (nd / "meminfo").write_text(
+            f"Node {nid} MemTotal:       {mem_kb} kB\n"
+            f"Node {nid} MemFree:        1 kB\n"
+        )
+        (nd / "cpulist").write_text(cpulist + "\n")
+    return str(d)
+
+
+def test_numa_topology(backend, tmp_path):
+    d = make_fake_numa(
+        tmp_path, {0: (131072000, "0-11,24-35"), 1: (65536000, "12-23")}
+    )
+    topo = backend.numa_topology(d)
+    assert topo == [
+        {"node_id": 0, "mem_total_bytes": 131072000 * 1024, "cpu_count": 24},
+        {"node_id": 1, "mem_total_bytes": 65536000 * 1024, "cpu_count": 12},
+    ]
+    assert backend.numa_topology(str(tmp_path / "missing")) == []
+
+
+def test_numa_topology_native_python_identical(native_lib, tmp_path):
+    d = make_fake_numa(tmp_path, {0: (1000, "0-3"), 1: (2000, "4,6,8-9")})
+    assert NativeTpuInfo(native_lib).numa_topology(d) == PyTpuInfo().numa_topology(d)

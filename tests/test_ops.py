@@ -1,0 +1,183 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh) + generation smoke.
+
+Correctness oracles are the plain-jnp formulations; the same kernels
+compile natively when jax.default_backend() == "tpu".
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from k8s_device_plugin_tpu.ops import (
+    flash_attention,
+    reference_attention,
+    rmsnorm,
+)
+from k8s_device_plugin_tpu.workload.generate import (
+    greedy_generate,
+    run_generation_smoke,
+)
+from k8s_device_plugin_tpu.workload.model import ModelConfig, init_params
+
+
+def ref_rmsnorm(x, s, eps=1e-6):
+    ms = jnp.mean(x * x, -1, keepdims=True)
+    return x * jax.lax.rsqrt(ms + eps) * s
+
+
+def test_rmsnorm_matches_reference():
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+    s = jax.random.normal(jax.random.PRNGKey(1), (128,)) * 0.1 + 1.0
+    assert jnp.allclose(rmsnorm(x, s), ref_rmsnorm(x, s), atol=1e-6)
+
+
+def test_rmsnorm_gradients_match_reference():
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 64), jnp.float32)
+    s = jnp.ones((64,))
+
+    def loss_pallas(x, s):
+        return jnp.sum(jnp.sin(rmsnorm(x, s)))
+
+    def loss_ref(x, s):
+        return jnp.sum(jnp.sin(ref_rmsnorm(x, s)))
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1))(x, s)
+    gr = jax.grad(loss_ref, argnums=(0, 1))(x, s)
+    assert jnp.allclose(gp[0], gr[0], atol=1e-5)
+    assert jnp.allclose(gp[1], gr[1], atol=1e-5)
+
+
+def test_rmsnorm_odd_row_count():
+    # Rows not divisible by the block size exercise the grid remainder.
+    x = jax.random.normal(jax.random.PRNGKey(0), (300, 32), jnp.float32)
+    s = jnp.ones((32,))
+    assert jnp.allclose(rmsnorm(x, s), ref_rmsnorm(x, s), atol=1e-6)
+
+
+@pytest.mark.parametrize("block_q,block_kv", [(64, 64), (128, 64), (64, 32)])
+def test_flash_attention_matches_reference(block_q, block_kv):
+    q = jax.random.normal(jax.random.PRNGKey(2), (2, 2, 128, 32), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(3), (2, 2, 128, 32), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(4), (2, 2, 128, 32), jnp.float32)
+    out = flash_attention(q, k, v, block_q=block_q, block_kv=block_kv)
+    ref = reference_attention(q, k, v)
+    assert jnp.allclose(out, ref, atol=2e-5)
+
+
+def test_flash_attention_is_causal():
+    # Changing future tokens must not change earlier outputs.
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 64, 16), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 64, 16), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 64, 16), jnp.float32)
+    out1 = flash_attention(q, k, v, block_q=32, block_kv=32)
+    k2 = k.at[:, :, 32:].set(0.0)
+    v2 = v.at[:, :, 32:].set(99.0)
+    out2 = flash_attention(q, k2, v2, block_q=32, block_kv=32)
+    assert jnp.allclose(out1[:, :, :32], out2[:, :, :32], atol=1e-6)
+    assert not jnp.allclose(out1[:, :, 32:], out2[:, :, 32:], atol=1e-2)
+
+
+def test_flash_attention_uneven_seq_falls_back_to_divisor_blocks():
+    # seq=100 isn't a multiple of the requested 64-blocks; the largest
+    # divisor <= 64 (50) is used instead of raising.
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 100, 16), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 100, 16), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 100, 16), jnp.float32)
+    out = flash_attention(q, k, v, block_q=64, block_kv=64)
+    assert jnp.allclose(out, reference_attention(q, k, v), atol=2e-5)
+
+
+def test_flash_attention_gradients_match_reference():
+    # custom_vjp: backward recomputes through the reference formulation.
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 64, 16), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 64, 16), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 64, 16), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.tanh(flash_attention(q, k, v)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.tanh(reference_attention(q, k, v)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert jnp.allclose(a, b, atol=2e-4)
+
+
+def test_model_with_flash_attention_trains():
+    from k8s_device_plugin_tpu.parallel.mesh import batch_sharding, make_mesh
+    from k8s_device_plugin_tpu.workload import train
+
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=16, use_flash_attention=True,
+    )
+    mesh = make_mesh(jax.devices()[:1])
+    params, opt_state, tx = train.make_train_state(
+        cfg, mesh, jax.random.PRNGKey(0)
+    )
+    step = train.make_train_step(cfg, mesh, tx)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64),
+        batch_sharding(mesh),
+    )
+    _, _, loss0 = step(params, opt_state, tokens)
+    assert jnp.isfinite(loss0)
+
+
+def test_model_with_pallas_norm_trains():
+    from k8s_device_plugin_tpu.parallel.mesh import batch_sharding, make_mesh
+    from k8s_device_plugin_tpu.workload import train
+
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=16, use_pallas_norm=True,
+    )
+    mesh = make_mesh(jax.devices()[:1])
+    params, opt_state, tx = train.make_train_state(
+        cfg, mesh, jax.random.PRNGKey(0)
+    )
+    step = train.make_train_step(cfg, mesh, tx)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64),
+        batch_sharding(mesh),
+    )
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_greedy_generate_deterministic_and_causal():
+    cfg = ModelConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, 64)
+    out1 = greedy_generate(cfg, params, prompt, steps=6)
+    out2 = greedy_generate(cfg, params, prompt, steps=6)
+    assert jnp.array_equal(out1, out2)
+    assert out1.shape == (2, 10)
+    assert jnp.array_equal(out1[:, :4], prompt)
+    # Shorter continuation is a prefix of the longer one (greedy + causal).
+    out3 = greedy_generate(cfg, params, prompt, steps=3)
+    assert jnp.array_equal(out3, out1[:, :7])
+
+
+def test_generate_overlong_rejected():
+    cfg = ModelConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.zeros((1, 10), jnp.int32)
+    with pytest.raises(ValueError, match="exceeds"):
+        greedy_generate(cfg, params, prompt, steps=10)
+
+
+def test_generation_smoke_with_flash_attention():
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, use_flash_attention=True, use_pallas_norm=True,
+    )
+    report = run_generation_smoke(cfg, batch=1, prompt_len=8, steps=4)
+    assert report["tokens_in_vocab"]
+    assert report["prompt_preserved"]
+    assert report["flash_attention"]
