@@ -293,7 +293,8 @@ class PyTpuInfo:
                 if "-" in part:
                     lo, hi = part.split("-", 1)
                     try:
-                        cpus += int(hi) - int(lo) + 1
+                        if int(hi) >= int(lo):  # mirror the C guard
+                            cpus += int(hi) - int(lo) + 1
                     except ValueError:
                         pass
                 else:

@@ -1,0 +1,15 @@
+"""Shared kernel policy helpers."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def interpret_mode() -> bool:
+    """Pallas interpreter mode: on for non-TPU backends (CPU test mesh) and
+    force-on via TPU_PLUGIN_PALLAS_INTERPRET=1 for on-TPU debugging."""
+    if os.environ.get("TPU_PLUGIN_PALLAS_INTERPRET") == "1":
+        return True
+    return jax.default_backend() != "tpu"

@@ -20,11 +20,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._common import interpret_mode
+
 _NEG_INF = -1e30
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, out_ref, *, block_q: int,
@@ -63,8 +63,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, out_ref, *, block_q: int,
         )
         return m_new, l_new, acc_new
 
-    # Only kv blocks at or before this q block can contribute (causal).
-    n_kv = qi + 1 if block_kv == block_q else pl.cdiv(seq, block_kv)
+    # Only kv blocks intersecting positions <= this q block's last row can
+    # contribute (causal) — general for any block_q/block_kv combination.
+    n_kv = pl.cdiv((qi + 1) * block_q, block_kv)
     m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
     out_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
 
@@ -126,7 +127,7 @@ def _flash_fwd(q, k, v, block_q, block_kv):
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
-        interpret=_interpret(),
+        interpret=interpret_mode(),
     )(qf, kf, vf)
     return out.reshape(b, h, seq, d), (q, k, v)
 

@@ -21,12 +21,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._common import interpret_mode
+
 # Rows per grid step: multiple of the f32 sublane tile (8) with headroom.
 _BLOCK_ROWS = 256
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _rmsnorm_kernel(x_ref, scale_ref, out_ref, rrms_ref, *, eps: float):
@@ -61,7 +61,7 @@ def _rmsnorm_fwd_pallas(x2d: jax.Array, scale: jax.Array, eps: float):
             jax.ShapeDtypeStruct((rows, d), x2d.dtype),
             jax.ShapeDtypeStruct((rows, 1), jnp.float32),
         ],
-        interpret=_interpret(),
+        interpret=interpret_mode(),
     )(x2d, scale.reshape(1, d))
     return out, rrms
 

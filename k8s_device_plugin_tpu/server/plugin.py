@@ -60,6 +60,12 @@ class PluginConfig:
     substitute_on_allocate: bool = False
     # cgroup device permissions for /dev/accel* nodes.
     device_permissions: str = "rwm"
+    # CDI (Container Device Interface, k8s >= 1.26): when set (e.g.
+    # "google.com/tpu"), Allocate additionally returns fully-qualified CDI
+    # device names "<kind>=<chip id>" so CDI-aware runtimes do the device
+    # injection instead of the raw DeviceSpecs. Both are returned; the
+    # runtime uses whichever it supports.
+    cdi_kind: str = ""
     # Multi-host slice membership (v4/v5p slices spanning hosts over ICI):
     # this host's index in the slice, the slice's host list, and the host
     # grid shape ("x,y,z"). Exported to containers that get the whole host
@@ -384,6 +390,9 @@ class TpuDevicePlugin(DevicePluginServicer):
             resp.envs["TPU_LIBRARY_PATH"] = self.config.libtpu_container_path
         resp.envs.update(self._tpu_env(chips))
         resp.annotations[constants.POD_DEVICES_ANNOTATION] = ",".join(ids)
+        if self.config.cdi_kind:
+            for i in ids:
+                resp.cdi_devices.add(name=f"{self.config.cdi_kind}={i}")
         return resp
 
     def _tpu_env(self, chips) -> Dict[str, str]:

@@ -67,6 +67,8 @@ class DaemonConfig:
     prefer_native_backend: bool = True
     # Prometheus endpoint; 0 disables.
     metrics_port: int = 0
+    # CDI kind for Allocate responses ("" disables; see PluginConfig).
+    cdi_kind: str = ""
     # Multi-host slice membership (see PluginConfig).
     worker_id: int = 0
     worker_hostnames: str = ""
@@ -133,6 +135,7 @@ class Daemon:
                 device_plugin_dir=self.cfg.device_plugin_dir,
                 libtpu_host_path=self.cfg.libtpu_host_path,
                 substitute_on_allocate=self.cfg.substitute_on_allocate,
+                cdi_kind=self.cfg.cdi_kind,
                 worker_id=self.cfg.worker_id,
                 worker_hostnames=self.cfg.worker_hostnames,
                 slice_host_bounds=self.cfg.slice_host_bounds,
@@ -258,6 +261,9 @@ def parse_args(argv) -> DaemonConfig:
     p.add_argument("--resync-interval", type=float, default=30.0)
     p.add_argument("--metrics-port", type=int, default=2112,
                    help="Prometheus /metrics port; 0 disables")
+    p.add_argument("--cdi-kind", default="",
+                   help="emit CDI device names of this kind in Allocate "
+                   "responses (e.g. google.com/tpu); empty disables")
     p.add_argument("--worker-id", type=int,
                    default=int(os.environ.get("TPU_WORKER_ID", "0") or 0))
     p.add_argument("--worker-hostnames",
@@ -291,6 +297,7 @@ def parse_args(argv) -> DaemonConfig:
         kubeconfig=a.kubeconfig,
         prefer_native_backend=not a.python_backend,
         metrics_port=a.metrics_port,
+        cdi_kind=a.cdi_kind,
         worker_id=a.worker_id,
         worker_hostnames=a.worker_hostnames,
         slice_host_bounds=a.slice_host_bounds,

@@ -62,7 +62,13 @@ class NodeTopology:
     def from_json(s: str) -> "NodeTopology":
         d = json.loads(s)
         chips = [ChipInfo(**c) for c in d.pop("chips", [])]
-        return NodeTopology(chips=chips, **d)
+        # Tolerate unknown keys so older consumers keep parsing annotations
+        # published by newer daemons during rolling upgrades (new fields are
+        # additive; SCHEMA_VERSION bumps only on breaking changes).
+        known = {f.name for f in dataclasses.fields(NodeTopology)} - {"chips"}
+        return NodeTopology(
+            chips=chips, **{k: v for k, v in d.items() if k in known}
+        )
 
     @staticmethod
     def from_mesh(

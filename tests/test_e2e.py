@@ -1,0 +1,243 @@
+"""Full-system end-to-end test: the real daemon binary as a subprocess
+against a fake kubelet (gRPC), fake API server (HTTP), and fake sysfs node.
+
+One flow covering every BASELINE config except real hardware: register →
+ListAndWatch → preferred allocation → Allocate (env/devices) → controller
+reconciliation from the kubelet checkpoint → live availability republish →
+sysfs-injected health fault + recovery → k8s events → pod delete frees
+chips → clean SIGTERM.
+"""
+
+import copy
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+import requests
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
+from tests import fakes
+from tests.fake_apiserver import FakeApiServer
+from tests.fake_kubelet import FakeKubelet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NODE = "tpu-node-1"
+
+
+def wait_for(cond, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+@pytest.fixture
+def system(tmp_path):
+    dp_dir = tmp_path / "dp"
+    dp_dir.mkdir()
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5p", 4)
+    api = FakeApiServer()
+    url = api.start()
+    api.add_node(NODE)
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(
+        "apiVersion: v1\nkind: Config\ncurrent-context: c\n"
+        "contexts: [{name: c, context: {cluster: cl, user: u}}]\n"
+        f"clusters: [{{name: cl, cluster: {{server: \"{url}\"}}}}]\n"
+        "users: [{name: u, user: {token: t}}]\n"
+    )
+    kubelet = FakeKubelet(str(dp_dir))
+    kubelet.start()
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "k8s_device_plugin_tpu",
+            "--device-plugin-dir", str(dp_dir),
+            "--sysfs-accel-dir", accel,
+            "--dev-dir", dev,
+            "--libtpu-path", "",
+            "--node-name", NODE,
+            "--kubeconfig", str(kubeconfig),
+            "--accelerator-type", "v5p",
+            "--health-interval", "0.2",
+            "--resync-interval", "1",
+            "--metrics-port", "0",
+        ],
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        yield {
+            "proc": proc,
+            "api": api,
+            "kubelet": kubelet,
+            "accel": accel,
+            "dp_dir": str(dp_dir),
+        }
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        kubelet.stop()
+        api.stop()
+
+
+def test_full_lifecycle(system):
+    proc, api, kubelet = system["proc"], system["api"], system["kubelet"]
+    accel, dp_dir = system["accel"], system["dp_dir"]
+
+    # 1. Registration + device advertisement.
+    assert kubelet.registered.wait(20)
+    stub = kubelet.plugin_stub()
+    out: queue.Queue = queue.Queue()
+    stop = threading.Event()
+
+    def recv():
+        try:
+            for r in stub.ListAndWatch(pb.Empty()):
+                out.put(r)
+                if stop.is_set():
+                    break
+        except Exception:
+            pass
+
+    threading.Thread(target=recv, daemon=True).start()
+    first = out.get(timeout=10)
+    assert len(first.devices) == 4
+    ids = [d.ID for d in first.devices]
+
+    # 2. Topology published with full availability.
+    def annotation():
+        raw = api.nodes[NODE]["metadata"]["annotations"].get(
+            constants.TOPOLOGY_ANNOTATION
+        )
+        return json.loads(raw) if raw else None
+
+    assert wait_for(lambda: annotation() is not None)
+    assert len(annotation()["available"]) == 4
+    assert annotation()["chip_type"] == "v5p"
+
+    # 3. Preferred allocation + Allocate.
+    req = pb.PreferredAllocationRequest()
+    req.container_requests.add(available_deviceIDs=ids, allocation_size=4)
+    pref = list(
+        stub.GetPreferredAllocation(req).container_responses[0].deviceIDs
+    )
+    areq = pb.AllocateRequest()
+    areq.container_requests.add(devicesIDs=pref)
+    cresp = stub.Allocate(areq).container_responses[0]
+    assert len(cresp.devices) == 4
+    assert cresp.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+
+    # 4. Availability republished as empty.
+    assert wait_for(lambda: annotation()["available"] == [])
+
+    # 5. Controller reconciles the kubelet checkpoint onto the pod.
+    api.add_pod(
+        {
+            "metadata": {"name": "jax-pod", "namespace": "default",
+                         "uid": "uid-1", "annotations": {}},
+            "spec": {"nodeName": NODE, "containers": [
+                {"name": "m",
+                 "resources": {"requests": {"google.com/tpu": "4"}}}]},
+            "status": {},
+        }
+    )
+    with open(os.path.join(dp_dir, "kubelet_internal_checkpoint"), "w") as f:
+        json.dump(
+            {"Data": {"PodDeviceEntries": [
+                {"PodUID": "uid-1", "ContainerName": "m",
+                 "ResourceName": "google.com/tpu", "DeviceIDs": pref}],
+                "RegisteredDevices": {}}, "Checksum": 1}, f)
+    assert wait_for(lambda: api.pod_patches)
+    _, _, body = api.pod_patches[0]
+    patched = body["metadata"]["annotations"][constants.POD_DEVICES_ANNOTATION]
+    assert sorted(patched.split(",")) == sorted(pref)
+
+    # 6. Health fault via sysfs → Unhealthy re-advertisement + k8s event.
+    fakes.set_chip_health(accel, 1, False)
+    resp = out.get(timeout=10)
+    sick = {d.ID: d.health for d in resp.devices}
+    assert constants.UNHEALTHY in sick.values()
+    assert wait_for(lambda: any(
+        e["reason"] == "TPUChipUnhealthy" for e in api.events))
+
+    # 7. Recovery.
+    fakes.set_chip_health(accel, 1, True)
+    resp = out.get(timeout=10)
+    assert all(d.health == constants.HEALTHY for d in resp.devices)
+    assert wait_for(lambda: any(
+        e["reason"] == "TPUChipRecovered" for e in api.events))
+
+    # 8. Pod delete frees the chips (availability returns).
+    api.delete_pod("default", "jax-pod")
+    assert wait_for(lambda: len(annotation()["available"]) == 4)
+
+    # 9. Clean shutdown.
+    stop.set()
+    proc.terminate()
+    assert proc.wait(timeout=15) == 0
+
+
+def test_daemon_restart_rebuilds_from_checkpoint(system):
+    """Kill the daemon mid-allocation; a restarted daemon must rebuild the
+    allocated state from the kubelet checkpoint (reference gap, SURVEY §5)."""
+    proc, api, kubelet = system["proc"], system["api"], system["kubelet"]
+    dp_dir = system["dp_dir"]
+    assert kubelet.registered.wait(20)
+    stub = kubelet.plugin_stub()
+    first = next(iter(stub.ListAndWatch(pb.Empty())))
+    ids = sorted(d.ID for d in first.devices)
+
+    # Pod exists and the kubelet checkpoint records 2 chips for it.
+    api.add_pod(
+        {
+            "metadata": {"name": "p", "namespace": "default",
+                         "uid": "uid-9", "annotations": {}},
+            "spec": {"nodeName": NODE, "containers": [
+                {"name": "m",
+                 "resources": {"requests": {"google.com/tpu": "2"}}}]},
+            "status": {},
+        }
+    )
+    with open(os.path.join(dp_dir, "kubelet_internal_checkpoint"), "w") as f:
+        json.dump(
+            {"Data": {"PodDeviceEntries": [
+                {"PodUID": "uid-9", "ContainerName": "m",
+                 "ResourceName": "google.com/tpu", "DeviceIDs": ids[:2]}],
+                "RegisteredDevices": {}}, "Checksum": 1}, f)
+
+    proc.kill()
+    proc.wait()
+
+    # Restart: same config, fresh process.
+    kubelet.registered.clear()
+    argv = proc.args
+    proc2 = subprocess.Popen(argv, cwd=REPO, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+    try:
+        assert kubelet.registered.wait(20)
+
+        def annotation():
+            raw = api.nodes[NODE]["metadata"]["annotations"].get(
+                constants.TOPOLOGY_ANNOTATION
+            )
+            return json.loads(raw) if raw else None
+
+        # The restarted daemon's authoritative publish excludes held chips.
+        assert wait_for(
+            lambda: annotation() is not None
+            and sorted(annotation()["available"]) == ids[2:]
+        )
+    finally:
+        proc2.terminate()
+        proc2.wait(timeout=15)

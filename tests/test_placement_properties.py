@@ -1,0 +1,119 @@
+"""Placement-policy invariants under randomized load/free churn.
+
+Seeded pseudo-random sequences over every supported accelerator shape;
+invariants the policy must never violate regardless of fragmentation:
+
+  I1 select(n) returns exactly n distinct, available, known chips — or [].
+  I2 select(n) is [] only if fewer than n chips are available.
+  I3 when a contiguous n-set exists among available chips, the returned
+     set is contiguous.
+  I4 allocate/free bookkeeping round-trips (free restores availability).
+  I5 selection is deterministic for identical state.
+"""
+
+import itertools
+import random
+
+import pytest
+
+from k8s_device_plugin_tpu.discovery.chips import TpuChip
+from k8s_device_plugin_tpu.topology.mesh import IciMesh
+from k8s_device_plugin_tpu.topology.placement import PlacementState
+
+
+def mesh_of(chip_type: str, n: int) -> IciMesh:
+    chips = [
+        TpuChip(
+            index=i,
+            dev_path=f"/dev/accel{i}",
+            pci_addr=f"0000:00:{4 + i:02x}.0",
+            vendor_id=0x1AE0,
+            device_id=0,
+            numa_node=0,
+            chip_type=chip_type,
+            hbm_bytes=0,
+            core_count=1,
+        )
+        for i in range(n)
+    ]
+    return IciMesh(chips)
+
+
+SHAPES = [("v2", 4), ("v4", 4), ("v5p", 4), ("v5e", 8), ("v6e", 8),
+          ("unknown", 6)]
+
+
+def contiguous_subset_exists(mesh, available, n):
+    avail = [i for i in mesh.ids if i in available]
+    if len(avail) < n:
+        return False
+    return any(
+        mesh.is_contiguous(c) for c in itertools.combinations(avail, n)
+    )
+
+
+@pytest.mark.parametrize("chip_type,count", SHAPES)
+def test_invariants_under_churn(chip_type, count):
+    mesh = mesh_of(chip_type, count)
+    state = PlacementState(mesh)
+    rng = random.Random(1234)
+    held = []  # list of allocated id-sets
+
+    for step in range(200):
+        action = rng.random()
+        if action < 0.55:
+            n = rng.randint(1, count)
+            avail_before = set(state.available())
+            got = state.select(n)
+            got2 = state.select(n)
+            assert got == got2  # I5 determinism
+            if got:
+                assert len(got) == len(set(got)) == n  # I1
+                assert set(got) <= avail_before  # I1 availability
+                if contiguous_subset_exists(mesh, avail_before, n):
+                    assert mesh.is_contiguous(got), (
+                        f"step {step}: non-contiguous {got} though a "
+                        f"contiguous {n}-set exists in {sorted(avail_before)}"
+                    )  # I3
+                state.allocate(got)
+                held.append(set(got))
+            else:
+                assert len(avail_before) < n  # I2
+        elif held:
+            freed = held.pop(rng.randrange(len(held)))
+            state.free(freed)
+            assert freed <= set(state.available())  # I4
+
+    # Drain: free everything, full availability restored.
+    for s in held:
+        state.free(s)
+    assert sorted(state.available()) == sorted(mesh.ids)  # I4
+
+
+@pytest.mark.parametrize("chip_type,count", SHAPES)
+def test_full_pack_then_drain(chip_type, count):
+    """Packing one chip at a time must fill the whole mesh (no stranded
+    capacity from the corner-first policy)."""
+    mesh = mesh_of(chip_type, count)
+    state = PlacementState(mesh)
+    taken = []
+    for _ in range(count):
+        got = state.select(1)
+        assert len(got) == 1
+        state.allocate(got)
+        taken.extend(got)
+    assert sorted(taken) == sorted(mesh.ids)
+    assert state.select(1) == []
+
+
+def test_pairs_pack_v5e_without_fragmentation():
+    """Four 2-chip requests on a 2x4 mesh must all be ICI-adjacent — the
+    policy may not fragment the mesh into unusable singles."""
+    mesh = mesh_of("v5e", 8)
+    state = PlacementState(mesh)
+    for _ in range(4):
+        got = state.select(2)
+        assert len(got) == 2
+        assert mesh.hops(got[0], got[1]) == 1
+        state.allocate(got)
+    assert state.available() == []

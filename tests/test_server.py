@@ -315,3 +315,21 @@ def test_substitution_fallback_never_overlaps(tmp_path, dp_dir, kubelet):
         assert p.state.allocated == set()  # nothing committed
     finally:
         p.stop()
+
+
+def test_cdi_devices_when_enabled(tmp_path, dp_dir, kubelet):
+    p = make_plugin(tmp_path, dp_dir, cdi_kind="google.com/tpu")
+    p.serve()
+    try:
+        stub = kubelet.plugin_stub()
+        ids = p.mesh.ids[:2]
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=ids)
+        cresp = stub.Allocate(req).container_responses[0]
+        assert sorted(c.name for c in cresp.cdi_devices) == sorted(
+            f"google.com/tpu={i}" for i in ids
+        )
+        # Raw DeviceSpecs still present for non-CDI runtimes.
+        assert len(cresp.devices) == 2
+    finally:
+        p.stop()
