@@ -43,6 +43,11 @@ def control_plane_allocation(root: str) -> dict:
     accel, dev = fakes.make_fake_tpu_node(root, "v5e", 4)
     kubelet = FakeKubelet(dp_dir)
     kubelet.start()
+    # The daemon is pure control plane — it never imports jax. Strip the
+    # host's TPU site-hook trigger so the subprocess doesn't pay ~2 s of
+    # jax import (sitecustomize imports jax into every python process when
+    # PALLAS_AXON_POOL_IPS is set).
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
     t0 = time.monotonic()
     daemon = subprocess.Popen(
         [
@@ -57,6 +62,7 @@ def control_plane_allocation(root: str) -> dict:
         cwd=REPO,
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
+        env=env,
     )
     try:
         assert kubelet.registered.wait(30), "daemon never registered"

@@ -61,10 +61,15 @@ class NodeTopology:
     @staticmethod
     def from_json(s: str) -> "NodeTopology":
         d = json.loads(s)
-        chips = [ChipInfo(**c) for c in d.pop("chips", [])]
-        # Tolerate unknown keys so older consumers keep parsing annotations
-        # published by newer daemons during rolling upgrades (new fields are
-        # additive; SCHEMA_VERSION bumps only on breaking changes).
+        # Tolerate unknown keys (top-level and per-chip) so older consumers
+        # keep parsing annotations published by newer daemons during rolling
+        # upgrades (new fields are additive; SCHEMA_VERSION bumps only on
+        # breaking changes).
+        chip_known = {f.name for f in dataclasses.fields(ChipInfo)}
+        chips = [
+            ChipInfo(**{k: v for k, v in c.items() if k in chip_known})
+            for c in d.pop("chips", [])
+        ]
         known = {f.name for f in dataclasses.fields(NodeTopology)} - {"chips"}
         return NodeTopology(
             chips=chips, **{k: v for k, v in d.items() if k in known}

@@ -8,11 +8,9 @@ sysfs-injected health fault + recovery → k8s events → pod delete frees
 chips → clean SIGTERM.
 """
 
-import copy
 import json
 import os
 import queue
-import signal
 import subprocess
 import sys
 import threading
@@ -75,6 +73,13 @@ def system(tmp_path):
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
     )
+    # Drain the daemon's output so it can't block on a full pipe buffer;
+    # keep it around for diagnostics on failure.
+    daemon_log: list = []
+    threading.Thread(
+        target=lambda: daemon_log.extend(iter(proc.stdout.readline, b"")),
+        daemon=True,
+    ).start()
     try:
         yield {
             "proc": proc,
@@ -82,6 +87,7 @@ def system(tmp_path):
             "kubelet": kubelet,
             "accel": accel,
             "dp_dir": str(dp_dir),
+            "daemon_log": daemon_log,
         }
     finally:
         if proc.poll() is None:
