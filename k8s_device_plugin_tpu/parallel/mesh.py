@@ -23,11 +23,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# Canonical axis names, in mesh order.
+# Canonical axis names, in mesh order. ``seq`` is the context-parallel axis
+# (ring attention, parallel/ring.py); it has size 1 unless a workload opts
+# into sequence sharding, so dp/fsdp/tp-only meshes are unchanged.
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
-AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS)
+AXES = (DATA_AXIS, FSDP_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 
 def factorize(n: int, max_model: int = 4) -> Tuple[int, int, int]:
@@ -56,13 +59,19 @@ def factorize(n: int, max_model: int = 4) -> Tuple[int, int, int]:
 
 def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
-    shape: Optional[Tuple[int, int, int]] = None,
+    shape: Optional[Tuple[int, ...]] = None,
 ) -> Mesh:
-    """Build a (data, fsdp, model) mesh over the given devices (default: all
-    local devices, i.e. the chips the plugin allocated to this container)."""
+    """Build a (data, fsdp, seq, model) mesh over the given devices
+    (default: all local devices, i.e. the chips the plugin allocated to this
+    container). ``shape`` may be given as (data, fsdp, model) — seq=1 is
+    inserted — or as the full 4-tuple to enable context parallelism."""
     devs = list(devices) if devices is not None else list(jax.devices())
     if shape is None:
         shape = factorize(len(devs))
+    if len(shape) == 3:
+        shape = (shape[0], shape[1], 1, shape[2])
+    if len(shape) != len(AXES):
+        raise ValueError(f"mesh shape {shape} must have {len(AXES)} axes")
     if np.prod(shape) != len(devs):
         raise ValueError(f"mesh shape {shape} != {len(devs)} devices")
     arr = np.array(devs).reshape(shape)

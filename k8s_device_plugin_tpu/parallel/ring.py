@@ -1,0 +1,143 @@
+"""Ring attention: causal self-attention with the sequence sharded over a
+mesh axis (context parallelism).
+
+Long-context attention does not fit one chip's HBM (the O(seq) KV and the
+O(seq_local x seq) score stream); ring attention shards the sequence over
+the ``seq`` mesh axis and rotates K/V shards around the ring with
+``lax.ppermute`` (one ICI hop per step on TPU), accumulating the exact
+softmax online (flash-style running max / denominator) — each chip only
+ever holds 1/N of K/V plus the in-flight block, and the rotation overlaps
+with the local attention compute under XLA's async collectives.
+
+No counterpart exists in the reference (it is a device plugin; SURVEY.md §2
+parallelism table) — this is the workload-side long-context path the plugin
+exists to place well: the ring lives entirely on ICI when the plugin
+allocates a contiguous sub-mesh.
+
+The math: for each ring step t, a chip holding query shard i computes
+attention scores against the K/V shard that originated at shard
+(i - t) mod N, masks them causally by *global* positions, and folds them
+into the running (m, l, acc) online-softmax state; after N steps each query
+has seen the full (causal) sequence exactly once. Gradients flow through
+``lax.scan`` + ``ppermute`` transposes, so the op is reverse-differentiable
+with no custom VJP.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+
+_NEG_INF = -1e30
+
+
+def _ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    n_shards: int,
+) -> jax.Array:
+    """Per-shard body (runs inside shard_map): q/k/v are the local
+    [batch, heads, seq_local, head_dim] shards."""
+    _, _, s_local, d = q.shape
+    idx = lax.axis_index(axis_name)
+    scale = 1.0 / (d ** 0.5)
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = idx * s_local + jnp.arange(s_local)  # global query positions
+
+    # The scan carry must be device-varying like q/k/v (shard_map VMA): the
+    # fresh zero/neg-inf states are constants, so cast them explicitly.
+    mesh_axes = tuple(jax.typeof(q).vma)
+
+    def _varying(x):
+        return lax.pcast(x, mesh_axes, to="varying")
+
+    m0 = _varying(jnp.full(q.shape[:3] + (1,), _NEG_INF, jnp.float32))
+    l0 = _varying(jnp.zeros(q.shape[:3] + (1,), jnp.float32))
+    acc0 = _varying(jnp.zeros(q.shape[:3] + (d,), jnp.float32))
+    # Rotate K/V shards one hop down-ring between compute steps (shard
+    # j -> j+1), so at step t we hold the shard that originated at
+    # (idx - t) mod N. N compute steps need exactly N-1 rotations: step 0
+    # runs on the local shard outside the scan, each scan iteration
+    # rotates then computes.
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    def fold(state, t, k_cur, v_cur):
+        m, l, acc = state
+        src = (idx - t) % n_shards
+        kv_pos = src * s_local + jnp.arange(s_local)
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk",
+            q32,
+            k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        causal = kv_pos[None, :] <= q_pos[:, None]  # [s_local, s_local]
+        s = jnp.where(causal[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd",
+            p,
+            v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l_new, acc_new)
+
+    def step(carry, t):
+        m, l, acc, k_cur, v_cur = carry
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        m, l, acc = fold((m, l, acc), t, k_cur, v_cur)
+        return (m, l, acc, k_cur, v_cur), None
+
+    state = fold((m0, l0, acc0), 0, k, v)
+    if n_shards > 1:
+        (m, l, acc, _, _), _ = lax.scan(
+            step, state + (k, v), jnp.arange(1, n_shards)
+        )
+    else:
+        m, l, acc = state
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = SEQ_AXIS,
+    batch_axes: Union[str, Sequence[str]] = (DATA_AXIS, FSDP_AXIS),
+    heads_axis: str = MODEL_AXIS,
+) -> jax.Array:
+    """Causal attention over [batch, heads, seq, head_dim] with seq sharded
+    over ``seq_axis`` (and batch/heads over their axes as usual).
+
+    Exact (not approximate): identical math to full softmax attention, just
+    accumulated ring-step by ring-step. Requires batch/heads/seq divisible
+    by the respective mesh axis sizes.
+    """
+    n_shards = mesh.shape[seq_axis]
+    spec = P(tuple(batch_axes) if not isinstance(batch_axes, str)
+             else batch_axes, heads_axis, seq_axis, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=seq_axis, n_shards=n_shards
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -55,6 +55,13 @@ class ModelConfig:
     # reference formulation (see ops/attention.py).
     use_pallas_norm: bool = False
     use_flash_attention: bool = False
+    # Context parallelism: shard the sequence over the mesh's ``seq`` axis
+    # and run ring attention (parallel/ring.py). ``ring_mesh`` must be the
+    # training mesh (its seq axis size must divide max_seq_len, and batch/
+    # heads must divide their axes). Mutually exclusive with
+    # use_flash_attention.
+    use_ring_attention: bool = False
+    ring_mesh: Any = None
 
     @staticmethod
     def tiny() -> "ModelConfig":
@@ -95,7 +102,23 @@ class Attention(nn.Module):
         q = jnp.einsum("bsd,dhk->bshk", x, wq.astype(cfg.dtype))
         k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(cfg.dtype))
         v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(cfg.dtype))
-        if cfg.use_flash_attention:
+        if cfg.use_ring_attention:
+            from ..parallel.ring import ring_attention
+
+            if cfg.use_flash_attention:
+                raise ValueError(
+                    "use_ring_attention and use_flash_attention are "
+                    "mutually exclusive"
+                )
+            if cfg.ring_mesh is None:
+                raise ValueError("use_ring_attention requires cfg.ring_mesh")
+            out = ring_attention(
+                q.transpose(0, 2, 1, 3),
+                k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+                cfg.ring_mesh,
+            ).transpose(0, 2, 1, 3)
+        elif cfg.use_flash_attention:
             # Pallas flash-attention path; (b,s,h,k) -> (b,h,s,k).
             out = flash_attention(
                 q.transpose(0, 2, 1, 3),
