@@ -24,13 +24,20 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Canonical axis names, in mesh order. ``seq`` is the context-parallel axis
-# (ring attention, parallel/ring.py); it has size 1 unless a workload opts
-# into sequence sharding, so dp/fsdp/tp-only meshes are unchanged.
+# (ring attention, parallel/ring.py), ``expert`` the expert-parallel axis
+# (workload/moe.py) and ``pipe`` the pipeline-parallel axis
+# (parallel/pipeline.py); each has size 1 unless a workload opts in, so
+# dp/fsdp/tp-only meshes are unchanged. Order puts the heaviest-traffic
+# axis (model: per-layer collectives) innermost so it lands on adjacent
+# ICI neighbors, and the lightest (data: one gradient psum per step)
+# outermost where DCN hops are acceptable.
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
-AXES = (DATA_AXIS, FSDP_AXIS, SEQ_AXIS, MODEL_AXIS)
+AXES = (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, PIPE_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 
 def factorize(n: int, max_model: int = 4) -> Tuple[int, int, int]:
@@ -61,15 +68,18 @@ def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     shape: Optional[Tuple[int, ...]] = None,
 ) -> Mesh:
-    """Build a (data, fsdp, seq, model) mesh over the given devices
-    (default: all local devices, i.e. the chips the plugin allocated to this
-    container). ``shape`` may be given as (data, fsdp, model) — seq=1 is
-    inserted — or as the full 4-tuple to enable context parallelism."""
+    """Build a (data, fsdp, expert, pipe, seq, model) mesh over the given
+    devices (default: all local devices, i.e. the chips the plugin allocated
+    to this container). ``shape`` may be given short — (data, fsdp, model)
+    or (data, fsdp, seq, model) — with the remaining axes inserted at size
+    1, or as the full 6-tuple to enable expert/pipeline parallelism."""
     devs = list(devices) if devices is not None else list(jax.devices())
     if shape is None:
         shape = factorize(len(devs))
     if len(shape) == 3:
         shape = (shape[0], shape[1], 1, shape[2])
+    if len(shape) == 4:  # (data, fsdp, seq, model): expert=pipe=1
+        shape = (shape[0], shape[1], 1, 1, shape[2], shape[3])
     if len(shape) != len(AXES):
         raise ValueError(f"mesh shape {shape} must have {len(AXES)} axes")
     if np.prod(shape) != len(devs):
@@ -111,4 +121,10 @@ LOGICAL_AXIS_RULES = (
     ("kv", None),
     ("vocab", MODEL_AXIS),
     ("seq", None),
+    # MoE expert weights shard their expert dim over the expert axis
+    # (workload/moe.py); XLA inserts the dispatch/combine all-to-alls.
+    ("expert", EXPERT_AXIS),
+    # Stacked per-layer params (scan-over-layers models) shard the layer
+    # dim over the pipeline axis (parallel/pipeline.py).
+    ("layers", PIPE_AXIS),
 )

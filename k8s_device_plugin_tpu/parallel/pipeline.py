@@ -1,0 +1,146 @@
+"""GPipe-style pipeline parallelism over the mesh's ``pipe`` axis.
+
+TPU-first pipelining: the layer stack is sharded over the ``pipe`` mesh axis
+(one contiguous group of layers per stage), the batch is split into
+microbatches, and activations flow stage-to-stage with ``ppermute`` — a
+single-neighbor ICI hop per step, the cheapest collective a TPU mesh offers.
+The schedule is expressed as one ``lax.scan`` under ``shard_map`` (manual
+only over ``pipe`` via ``axis_names``; data/fsdp/model/expert stay under
+GSPMD auto-sharding inside the stage), so the whole pipeline is one XLA
+program with static shapes — no host round-trips between microbatches.
+
+Differentiable end to end: ``ppermute`` transposes to the reverse
+permutation, so ``jax.grad`` through ``pipeline_apply`` yields the classic
+backward pipeline for free.
+
+No counterpart exists in the reference (it is a device plugin with no ML
+code — SURVEY.md §2 parallelism table); this covers the pipeline-parallel
+(PP) axis of the workload stack's parallelism matrix.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import PIPE_AXIS
+
+
+def stack_stages(stacked_layers, n_stages: int):
+    """[L, ...] per-layer leaves → [n_stages, L/n_stages, ...]."""
+
+    def reshape(leaf):
+        n_layers = leaf.shape[0]
+        if n_layers % n_stages:
+            raise ValueError(
+                f"{n_layers} layers not divisible by {n_stages} stages"
+            )
+        return leaf.reshape(n_stages, n_layers // n_stages, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, stacked_layers)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x: jax.Array,
+    mesh: Mesh,
+    n_microbatches: int,
+) -> jax.Array:
+    """Run ``x`` through all pipeline stages with microbatching.
+
+    ``stage_params``: pytree whose leaves have leading dim == pipe axis size
+    (slice s holds stage s's parameters — see :func:`stack_stages`).
+    ``stage_fn(params_slice, x_mb) -> y_mb`` applies one stage and must
+    preserve the microbatch's shape/dtype (transformer blocks do).
+    ``x``: [batch, ...] with batch divisible by ``n_microbatches``.
+
+    Schedule: T = M + S - 1 ticks. At tick t stage 0 ingests microbatch
+    min(t, M-1), every stage applies its layers, outputs rotate one hop
+    along ``pipe``; the last stage banks microbatch t-(S-1)'s result. The
+    banked outputs are broadcast back over ``pipe`` with a psum (they are
+    zero elsewhere), keeping the caller's activations replicated over pipe
+    exactly as they were on entry.
+    """
+    n_stages = mesh.shape[PIPE_AXIS]
+    if n_stages == 1:
+        return stage_fn(
+            jax.tree_util.tree_map(lambda a: a[0], stage_params), x
+        )
+    m = n_microbatches
+    batch = x.shape[0]
+    if batch % m:
+        raise ValueError(f"batch {batch} not divisible by {m} microbatches")
+    x_mb = x.reshape(m, batch // m, *x.shape[1:])
+    params_spec = jax.tree_util.tree_map(
+        lambda _: P(PIPE_AXIS), stage_params
+    )
+    # The shard_map boundary is f32: every psum the program needs over the
+    # partial-manual pipe axis — the forward broadcast-back below AND the
+    # transposed cotangent-psum for this replicated input — segfaults
+    # XLA:CPU when the operand is bf16 (jax 0.9.0, virtual-device meshes).
+    # Stage compute still runs in the caller's dtype; the ppermute hops
+    # stay bf16. On TPU the boundary casts are fused elementwise ops.
+    x_dtype = x.dtype
+
+    def run(params_local, mb_all):
+        p_local = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        stage = jax.lax.axis_index(PIPE_AXIS)
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+        # The carries become pipe-varying after the first tick (axis_index /
+        # ppermute); cast the zero initials to match the scan carry type.
+        # stop_gradient: the initials are constants, and without it the
+        # scan's init-carry cotangent would flow into pcast's transpose —
+        # a psum over pipe on a bf16 operand, which hits the same XLA:CPU
+        # segfault the boundary casts above work around.
+        zeros = jnp.zeros_like(mb_all).astype(x_dtype)
+        state = jax.lax.stop_gradient(
+            jax.lax.pcast(zeros[0], (PIPE_AXIS,), to="varying")
+        )
+        banked = jax.lax.stop_gradient(
+            jax.lax.pcast(zeros, (PIPE_AXIS,), to="varying")
+        )
+
+        def tick(carry, t):
+            state, banked = carry
+            # Index + pcast-to-varying in f32, THEN cast to the compute
+            # dtype: the transpose of this pcast is the cotangent psum for
+            # the replicated microbatch input, and ordering the casts this
+            # way keeps that psum f32 (see the XLA:CPU note above).
+            feed = jax.lax.dynamic_index_in_dim(
+                mb_all, jnp.clip(t, 0, m - 1), 0, keepdims=False
+            )
+            feed = jax.lax.pcast(
+                feed, (PIPE_AXIS,), to="varying"
+            ).astype(x_dtype)
+            inp = jnp.where(stage == 0, feed, state)
+            out = stage_fn(p_local, inp)
+            widx = t - (n_stages - 1)
+            ok = jnp.logical_and(stage == n_stages - 1, widx >= 0)
+            widx = jnp.clip(widx, 0, m - 1)
+            cur = jax.lax.dynamic_index_in_dim(
+                banked, widx, 0, keepdims=False
+            )
+            banked = jax.lax.dynamic_update_index_in_dim(
+                banked, jnp.where(ok, out, cur), widx, 0
+            )
+            state = jax.lax.ppermute(out, PIPE_AXIS, perm)
+            return (state, banked), None
+
+        (state, banked), _ = jax.lax.scan(
+            tick, (state, banked), jnp.arange(m + n_stages - 1)
+        )
+        banked = jnp.where(stage == n_stages - 1, banked, 0)
+        return jax.lax.psum(banked.astype(jnp.float32), PIPE_AXIS)
+
+    y_mb = jax.shard_map(
+        run,
+        mesh=mesh,
+        axis_names={PIPE_AXIS},
+        in_specs=(params_spec, P()),
+        out_specs=P(),
+    )(stage_params, x_mb.astype(jnp.float32))
+    return y_mb.astype(x_dtype).reshape(batch, *x.shape[1:])

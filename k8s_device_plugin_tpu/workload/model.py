@@ -62,6 +62,50 @@ class ModelConfig:
     # use_flash_attention.
     use_ring_attention: bool = False
     ring_mesh: Any = None
+    # Expert parallelism: n_experts > 0 replaces the dense MLP with a
+    # routed MoE (workload/moe.py) whose expert dim shards over the mesh's
+    # ``expert`` axis. Aux load-balance loss is sown and picked up by
+    # train.loss_fn with weight moe_aux_weight.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
+    # Stack the layer params with nn.scan (logical axis "layers", mapped to
+    # the mesh ``pipe`` axis by parallel/mesh.py). Required for pipeline
+    # parallelism; also the TPU-first layout for deep models (one compiled
+    # block body instead of n_layers copies).
+    scan_layers: bool = False
+    # Pipeline parallelism: > 0 runs the block stack through
+    # parallel/pipeline.py with this many microbatches over pipe_mesh's
+    # ``pipe`` axis. Requires scan_layers.
+    pipeline_microbatches: int = 0
+    pipe_mesh: Any = None
+
+    def __post_init__(self):
+        if self.pipeline_microbatches > 0:
+            if not self.scan_layers:
+                raise ValueError(
+                    "pipeline_microbatches requires scan_layers=True "
+                    "(stacked layer params)"
+                )
+            if self.n_experts > 0:
+                raise ValueError(
+                    "MoE aux-loss collection is not supported under the "
+                    "pipelined schedule; use expert parallelism without "
+                    "pipeline_microbatches"
+                )
+            if self.use_ring_attention:
+                raise ValueError(
+                    "ring attention cannot run inside the pipelined "
+                    "schedule (its shard_map would nest inside the "
+                    "pipe-manual shard_map); use context parallelism "
+                    "without pipeline_microbatches"
+                )
+            if self.pipe_mesh is None:
+                raise ValueError(
+                    "pipeline_microbatches requires pipe_mesh (the training "
+                    "mesh whose pipe axis carries the stages)"
+                )
 
     @staticmethod
     def tiny() -> "ModelConfig":
@@ -163,9 +207,41 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        x = x + Attention(self.cfg)(Norm(self.cfg)(x))
-        x = x + Mlp(self.cfg)(Norm(self.cfg)(x))
+        cfg = self.cfg
+        x = x + Attention(cfg)(Norm(cfg)(x))
+        if cfg.n_experts > 0:
+            from .moe import MoeMlp
+
+            mlp = MoeMlp(
+                n_experts=cfg.n_experts, d_ff=cfg.d_ff, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
+            )
+        else:
+            mlp = Mlp(cfg)
+        x = x + mlp(Norm(cfg)(x))
         return x
+
+
+def embed_tokens(cfg: ModelConfig, embed, pos, tokens):
+    """Token + position embedding, shared by the flax forward and the
+    pipelined forward so the two paths cannot drift."""
+    seq = tokens.shape[1]
+    return (embed[tokens] + pos[:seq][None, :, :]).astype(cfg.dtype)
+
+
+def unembed(x, embed):
+    """Tied-embedding logits projection (f32 for the softmax)."""
+    return jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32), embed)
+
+
+class BlockScanBody(nn.Module):
+    """nn.scan adapter: Block with a (carry, scan-input) signature."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, _):
+        return Block(self.cfg)(x), None
 
 
 class TransformerLM(nn.Module):
@@ -184,16 +260,25 @@ class TransformerLM(nn.Module):
             (cfg.max_seq_len, cfg.d_model), jnp.float32,
             axes=("seq", "embed"),
         )
-        seq = tokens.shape[1]
-        x = embed[tokens] + pos[:seq][None, :, :]
-        x = x.astype(cfg.dtype)
-        for _ in range(cfg.n_layers):
-            x = Block(cfg)(x)
+        x = embed_tokens(cfg, embed, pos, tokens)
+        if cfg.scan_layers:
+            # One compiled block body, params stacked on a leading "layers"
+            # logical axis (→ mesh pipe axis). The pipelined *schedule* runs
+            # through forward() below — inside flax the stack is a plain
+            # lax.scan so init/eval_shape see identical param trees.
+            scanned = nn_partitioning.scan_with_axes(
+                BlockScanBody,
+                variable_axes={"params": 0, "intermediates": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+                axis_name="layers",
+            )(cfg, name="blocks")
+            x, _ = scanned(x, None)
+        else:
+            for _ in range(cfg.n_layers):
+                x = Block(cfg)(x)
         x = Norm(cfg)(x)
-        logits = jnp.einsum(
-            "bsd,vd->bsv", x.astype(jnp.float32), embed
-        )
-        return logits
+        return unembed(x, embed)
 
 
 def init_params(cfg: ModelConfig, rng: jax.Array):
@@ -204,4 +289,62 @@ def init_params(cfg: ModelConfig, rng: jax.Array):
 
 
 def forward(cfg: ModelConfig, params, tokens):
+    if cfg.pipeline_microbatches > 0:
+        return forward_pipelined(cfg, params, tokens)
     return TransformerLM(cfg).apply({"params": params}, tokens)
+
+
+def forward_with_aux(cfg: ModelConfig, params, tokens):
+    """Forward pass plus the summed auxiliary losses (MoE load balance).
+
+    The single dispatch point for every forward variant: MoE models run
+    with the intermediates collection mutable so the sown balance terms can
+    be collected (pipelined MoE is rejected in __post_init__, so the two
+    special paths never overlap); everything else defers to forward() and
+    reports zero aux.
+    """
+    if cfg.n_experts > 0:
+        logits, mods = TransformerLM(cfg).apply(
+            {"params": params}, tokens, mutable=["intermediates"]
+        )
+        aux = jnp.zeros((), jnp.float32)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            mods.get("intermediates", {})
+        )[0]:
+            # Only the MoE balance terms; other sown diagnostics must not
+            # leak into the loss. sum() collapses stacked leaves
+            # (scan-over-layers models sow one value per layer).
+            if any(
+                getattr(k, "key", None) == "moe_aux_loss" for k in path
+            ):
+                aux = aux + jnp.sum(jnp.asarray(leaf, jnp.float32))
+        return logits, aux
+    return forward(cfg, params, tokens), jnp.zeros((), jnp.float32)
+
+
+def forward_pipelined(cfg: ModelConfig, params, tokens):
+    """The same computation as TransformerLM but with the block stack run
+    under the GPipe schedule (parallel/pipeline.py) over cfg.pipe_mesh's
+    ``pipe`` axis. Embedding/unembedding and the final norm stay outside the
+    pipeline (they are pipe-replicated either way)."""
+    from ..parallel.mesh import PIPE_AXIS
+    from ..parallel.pipeline import pipeline_apply, stack_stages
+
+    embed = params["embed"]
+    x = embed_tokens(cfg, embed, params["pos"], tokens)
+
+    n_stages = cfg.pipe_mesh.shape[PIPE_AXIS]
+    stage_params = stack_stages(params["blocks"], n_stages)
+
+    def stage_fn(p_stage, xmb):
+        def body(h, p_layer):
+            return Block(cfg).apply({"params": p_layer["Block_0"]}, h), None
+
+        h, _ = jax.lax.scan(body, xmb, p_stage)
+        return h
+
+    x = pipeline_apply(
+        stage_fn, stage_params, x, cfg.pipe_mesh, cfg.pipeline_microbatches
+    )
+    x = Norm(cfg).apply({"params": params["Norm_0"]}, x)
+    return unembed(x, embed)

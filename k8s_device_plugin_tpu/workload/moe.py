@@ -1,0 +1,99 @@
+"""Mixture-of-Experts MLP with expert parallelism.
+
+TPU-first MoE: top-k routing with **capacity-based dense dispatch** — the
+token→expert assignment is expressed as one-hot dispatch/combine tensors and
+the whole layer becomes four einsums with static shapes. That keeps every
+FLOP on the MXU and lets GSPMD insert the dispatch/combine all-to-alls over
+the mesh's ``expert`` axis (parallel/mesh.py EXPERT_AXIS) from the sharding
+of the expert weights alone — no ragged gather/scatter, no data-dependent
+shapes, nothing XLA can't tile.
+
+No counterpart exists in the reference (it is a device plugin with no ML
+code — SURVEY.md §2 parallelism table); this module is part of the JAX
+workload stack the plugin schedules, covering the expert-parallel (EP) axis
+of the framework's parallelism matrix.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import partitioning as nn_partitioning
+
+param_with_axes = nn_partitioning.param_with_axes
+
+
+class MoeMlp(nn.Module):
+    """Top-k routed expert MLP (drop-in for the dense Mlp).
+
+    Per batch row (the routing group): route each of S tokens to its top-k
+    experts, cap each expert at ``capacity`` tokens per group (overflow
+    tokens fall through the residual), run the expert FFNs batched over all
+    experts at once, and combine weighted by the router probabilities.
+
+    Sows the Switch-Transformer load-balance loss under
+    ``intermediates/moe_aux_loss`` (apply with ``mutable=["intermediates"]``
+    to collect it — workload/train.py does).
+    """
+
+    n_experts: int
+    d_ff: int
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    dtype: object = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        e, k = self.n_experts, self.top_k
+        capacity = max(1, int(self.capacity_factor * s * k / e))
+
+        wg = param_with_axes(
+            "wg", nn.initializers.xavier_uniform(), (d, e), jnp.float32,
+            axes=("embed", "expert_gate"),
+        )
+        w1 = param_with_axes(
+            "w1", nn.initializers.xavier_uniform(),
+            (e, d, self.d_ff), jnp.float32, axes=("expert", "embed", "mlp"),
+        )
+        w2 = param_with_axes(
+            "w2", nn.initializers.xavier_uniform(),
+            (e, self.d_ff, d), jnp.float32, axes=("expert", "mlp", "embed"),
+        )
+
+        # Routing in f32 (router logits are precision-sensitive).
+        probs = jax.nn.softmax(
+            jnp.einsum("bsd,de->bse", x.astype(jnp.float32), wg), axis=-1
+        )
+        topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [b,s,k]
+        topk_probs = topk_probs / jnp.sum(topk_probs, -1, keepdims=True)
+
+        # Position-in-expert via cumsum over the (token, k-slot) order; the
+        # k axis varies fastest so a token's 1st choice outranks the next
+        # token's 2nd choice at the same expert.
+        slot_onehot = jax.nn.one_hot(topk_idx, e)  # [b,s,k,e]
+        flat = slot_onehot.reshape(b, s * k, e)
+        pos = (jnp.cumsum(flat, axis=1) - flat).astype(jnp.int32)
+        within = pos < capacity
+        pos_onehot = jax.nn.one_hot(pos, capacity) * (
+            flat * within
+        )[..., None]  # [b, s*k, e, cap]
+        slots = pos_onehot.reshape(b, s, k, e, capacity)
+        dispatch = slots.sum(axis=2)  # [b,s,e,cap] ∈ {0,1}
+        combine = jnp.einsum("bsk,bskec->bsec", topk_probs, slots)
+
+        # Expert compute: batched over all experts, MXU-shaped einsums.
+        cdt = self.dtype
+        xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(cdt), x.astype(cdt))
+        h = jax.nn.gelu(jnp.einsum("ebcd,edf->ebcf", xe, w1.astype(cdt)))
+        ye = jnp.einsum("ebcf,efd->ebcd", h, w2.astype(cdt))
+        y = jnp.einsum("bsec,ebcd->bsd", combine.astype(cdt), ye)
+
+        # Switch load-balance loss: e * Σ_e (token fraction)·(prob mass).
+        top1 = jax.nn.one_hot(topk_idx[..., 0], e)
+        frac_tokens = jnp.mean(top1, axis=(0, 1))
+        frac_probs = jnp.mean(probs, axis=(0, 1))
+        aux = e * jnp.sum(frac_tokens * frac_probs)
+        self.sow("intermediates", "moe_aux_loss", aux)
+        return y

@@ -19,17 +19,18 @@ from flax.linen import partitioning as nn_partitioning
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import LOGICAL_AXIS_RULES, batch_sharding, replicated
-from .model import ModelConfig, TransformerLM, forward
+from .model import ModelConfig, TransformerLM, forward_with_aux
 
 
 def loss_fn(cfg: ModelConfig, params, tokens) -> jax.Array:
-    """Next-token cross-entropy (last position predicts nothing)."""
-    logits = forward(cfg, params, tokens)
+    """Next-token cross-entropy (last position predicts nothing), plus the
+    MoE load-balance aux loss when the model routes experts."""
+    logits, aux = forward_with_aux(cfg, params, tokens)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + cfg.moe_aux_weight * aux
 
 
 def param_shardings(cfg: ModelConfig, mesh: Mesh):

@@ -1,0 +1,177 @@
+"""Pipeline parallelism (parallel/pipeline.py) on the 8-device CPU mesh."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_device_plugin_tpu.parallel.mesh import (
+    PIPE_AXIS,
+    batch_sharding,
+    make_mesh,
+)
+from k8s_device_plugin_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stages,
+)
+from k8s_device_plugin_tpu.workload import train
+from k8s_device_plugin_tpu.workload.model import (
+    ModelConfig,
+    forward,
+    init_params,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU mesh"
+)
+
+
+def _toy(mesh, n_stages, L=8, D=16):
+    ws = jax.random.normal(jax.random.PRNGKey(0), (L, D, D)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, D))
+
+    def stage_fn(p, xmb):
+        def body(h, w):
+            return jnp.tanh(h @ w), None
+
+        h, _ = jax.lax.scan(body, xmb, p["w"])
+        return h
+
+    return ws, x, stage_fn
+
+
+def _seq_apply(ws, x):
+    for i in range(ws.shape[0]):
+        x = jnp.tanh(x @ ws[i])
+    return x
+
+
+def test_pipeline_matches_sequential():
+    mesh = make_mesh(shape=(1, 2, 1, 4, 1, 1))
+    ws, x, stage_fn = _toy(mesh, 4)
+    y = pipeline_apply(stage_fn, stack_stages({"w": ws}, 4), x, mesh, 4)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(_seq_apply(ws, x)), atol=1e-5
+    )
+
+
+def test_pipeline_grad_matches_sequential():
+    mesh = make_mesh(shape=(1, 2, 1, 4, 1, 1))
+    ws, x, stage_fn = _toy(mesh, 4)
+
+    def loss_pp(w):
+        return jnp.sum(
+            pipeline_apply(stage_fn, stack_stages({"w": w}, 4), x, mesh, 4)
+            ** 2
+        )
+
+    def loss_seq(w):
+        return jnp.sum(_seq_apply(w, x) ** 2)
+
+    g1 = jax.jit(jax.grad(loss_pp))(ws)
+    g2 = jax.jit(jax.grad(loss_seq))(ws)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
+
+
+def test_single_stage_mesh_falls_through():
+    mesh = make_mesh(shape=(1, 4, 1, 1, 1, 2))
+    ws, x, stage_fn = _toy(mesh, 1)
+    y = pipeline_apply(stage_fn, stack_stages({"w": ws}, 1), x, mesh, 4)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(_seq_apply(ws, x)), atol=1e-5
+    )
+
+
+def test_stack_stages_rejects_indivisible():
+    with pytest.raises(ValueError, match="not divisible"):
+        stack_stages({"w": jnp.zeros((3, 2))}, 2)
+
+
+def test_pipeline_rejects_bad_microbatching():
+    mesh = make_mesh(shape=(1, 2, 1, 4, 1, 1))
+    ws, x, stage_fn = _toy(mesh, 4)
+    with pytest.raises(ValueError, match="microbatch"):
+        pipeline_apply(stage_fn, stack_stages({"w": ws}, 4), x, mesh, 3)
+
+
+def _cfgs():
+    mesh = make_mesh(shape=(1, 2, 1, 2, 1, 2))
+    cfg_scan = dataclasses.replace(
+        ModelConfig.tiny(), n_layers=4, scan_layers=True
+    )
+    cfg_pp = dataclasses.replace(
+        cfg_scan, pipeline_microbatches=4, pipe_mesh=mesh
+    )
+    return mesh, cfg_scan, cfg_pp
+
+
+def test_model_pipelined_forward_matches_scanned():
+    _, cfg_scan, cfg_pp = _cfgs()
+    params = init_params(cfg_scan, jax.random.PRNGKey(0))
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (8, cfg_scan.max_seq_len), 0,
+        cfg_scan.vocab_size,
+    )
+    a = np.asarray(forward(cfg_scan, params, toks), np.float32)
+    b = np.asarray(forward(cfg_pp, params, toks), np.float32)
+    np.testing.assert_allclose(a, b, atol=5e-2, rtol=5e-2)
+
+
+def test_model_pipelined_grads_match_scanned():
+    _, cfg_scan, cfg_pp = _cfgs()
+    params = init_params(cfg_scan, jax.random.PRNGKey(0))
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (8, cfg_scan.max_seq_len), 0,
+        cfg_scan.vocab_size,
+    )
+    g_pp = jax.grad(lambda p: train.loss_fn(cfg_pp, p, toks))(params)
+    g_sc = jax.grad(lambda p: train.loss_fn(cfg_scan, p, toks))(params)
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), g_pp, g_sc
+    )
+    assert max(jax.tree_util.tree_leaves(diffs)) < 5e-2
+
+
+def test_pipelined_train_step_converges():
+    mesh, _, cfg_pp = _cfgs()
+    params, opt_state, tx = train.make_train_state(
+        cfg_pp, mesh, jax.random.PRNGKey(0)
+    )
+    stacked = jax.tree_util.tree_leaves(params["blocks"])[0]
+    assert PIPE_AXIS in tuple(stacked.sharding.spec), stacked.sharding
+    step = train.make_train_step(cfg_pp, mesh, tx)
+    toks = jax.device_put(
+        jax.random.randint(
+            jax.random.PRNGKey(1), (8, cfg_pp.max_seq_len), 0,
+            cfg_pp.vocab_size,
+        ),
+        batch_sharding(mesh),
+    )
+    losses = []
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, toks)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="scan_layers"):
+        dataclasses.replace(ModelConfig.tiny(), pipeline_microbatches=2)
+    with pytest.raises(ValueError, match="MoE"):
+        dataclasses.replace(
+            ModelConfig.tiny(), n_layers=2, scan_layers=True,
+            pipeline_microbatches=2, n_experts=2,
+        )
+    with pytest.raises(ValueError, match="ring attention"):
+        dataclasses.replace(
+            ModelConfig.tiny(), n_layers=2, scan_layers=True,
+            pipeline_microbatches=2, use_ring_attention=True,
+        )
+    with pytest.raises(ValueError, match="pipe_mesh"):
+        dataclasses.replace(
+            ModelConfig.tiny(), n_layers=2, scan_layers=True,
+            pipeline_microbatches=2,
+        )

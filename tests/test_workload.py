@@ -42,7 +42,9 @@ def test_host_bounds_from_env(monkeypatch):
 
 def test_make_mesh_all_devices():
     mesh = make_mesh()
-    assert dict(mesh.shape) == {"data": 1, "fsdp": 2, "seq": 1, "model": 4}
+    assert dict(mesh.shape) == {
+        "data": 1, "fsdp": 2, "expert": 1, "pipe": 1, "seq": 1, "model": 4,
+    }
 
 
 def test_params_are_sharded_across_mesh():
