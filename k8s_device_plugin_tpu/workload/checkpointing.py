@@ -1,0 +1,114 @@
+"""Workload checkpoint/resume: sharding-aware train-state persistence.
+
+The reference has no checkpoint/resume of its own — its only "checkpoint"
+surface is *reading* the kubelet device-manager file
+(/root/reference/controller.go:184-197, handled here by kube/checkpoint.py).
+On the workload side, a TPU training pod that gets rescheduled (node drain,
+chip health eviction — the plugin's own health path causes exactly this)
+must resume rather than restart; this module closes that loop with orbax:
+
+- async-friendly save of (params, opt_state, step) every N steps;
+- restore that re-places every leaf onto the *current* mesh's shardings
+  (the rescheduled pod may land on a different chip set or even a
+  different mesh shape — orbax reshards on restore from the
+  ShapeDtypeStruct+sharding template);
+- atomicity and retention are orbax's (tmp-dir rename commit, max_to_keep).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def _abstract_like(tree):
+    """ShapeDtypeStruct pytree carrying each leaf's sharding — the restore
+    template that makes orbax lay leaves out for the current mesh."""
+
+    def one(leaf):
+        return jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=getattr(leaf, "sharding", None)
+        )
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+class TrainCheckpointer:
+    """Thin orbax CheckpointManager wrapper for the smoke-workload train
+    state. One item, standard pytree layout, synchronous by default (the
+    smoke workload's states are small; pass ``async_save=True`` for real
+    runs so the save overlaps the next step)."""
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_every: int = 50,
+        async_save: bool = False,
+    ):
+        self.directory = os.path.abspath(directory)
+        self.save_every = max(1, save_every)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    def maybe_save(self, step: int, params, opt_state) -> bool:
+        """Save if ``step`` is on the cadence; returns whether it saved."""
+        if step % self.save_every:
+            return False
+        return self.save(step, params, opt_state)
+
+    def save(self, step: int, params, opt_state) -> bool:
+        return self._mgr.save(
+            step,
+            args=ocp.args.StandardSave(
+                {"params": params, "opt_state": opt_state}
+            ),
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore_latest(
+        self, params_template, opt_state_template
+    ) -> Optional[Tuple[int, Any, Any]]:
+        """Restore the newest checkpoint onto the templates' shardings.
+
+        Templates are live (or abstract) trees whose leaves carry the
+        shapes/dtypes/shardings the *current* process wants — typically the
+        freshly initialized state on the current mesh. Returns
+        (step, params, opt_state), or None when no checkpoint exists.
+        """
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.StandardRestore(
+                {
+                    "params": _abstract_like(params_template),
+                    "opt_state": _abstract_like(opt_state_template),
+                }
+            ),
+        )
+        return step, restored["params"], restored["opt_state"]
+
+    def wait(self) -> None:
+        """Block until in-flight async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

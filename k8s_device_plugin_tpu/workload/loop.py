@@ -1,0 +1,81 @@
+"""Resumable training loop: the smoke workload's long-running form.
+
+Ties together the sharded train step (train.py) and checkpoint/resume
+(checkpointing.py): a pod evicted mid-run — e.g. by the plugin's own
+health path re-advertising its chip Unhealthy — restarts, restores the
+newest checkpoint onto whatever mesh its new allocation supports, and
+continues from the saved step rather than step 0.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..parallel.mesh import batch_sharding, make_mesh
+from .checkpointing import TrainCheckpointer
+from .model import ModelConfig
+from . import train
+
+
+def synthetic_batch(cfg: ModelConfig, mesh, batch: int, step: int):
+    """Deterministic per-step synthetic tokens (so a resumed run sees the
+    same stream it would have seen uninterrupted)."""
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(step), (batch, cfg.max_seq_len), 0, cfg.vocab_size
+    )
+    return jax.device_put(tokens, batch_sharding(mesh))
+
+
+def run_training(
+    cfg: Optional[ModelConfig] = None,
+    steps: int = 100,
+    batch_per_device: int = 8,
+    checkpoint_dir: Optional[str] = None,
+    save_every: int = 20,
+    seed: int = 0,
+    mesh=None,
+) -> dict:
+    """Train for ``steps`` total steps, resuming from ``checkpoint_dir``
+    when it holds a previous run's state. Returns a JSON-able report."""
+    cfg = cfg or ModelConfig()
+    mesh = mesh if mesh is not None else make_mesh()
+    params, opt_state, tx = train.make_train_state(
+        cfg, mesh, jax.random.PRNGKey(seed)
+    )
+    step_fn = train.make_train_step(cfg, mesh, tx)
+
+    start_step = 0
+    ckpt = None
+    if checkpoint_dir:
+        ckpt = TrainCheckpointer(checkpoint_dir, save_every=save_every)
+        restored = ckpt.restore_latest(params, opt_state)
+        if restored is not None:
+            start_step, params, opt_state = restored
+            start_step += 1  # saved state is *after* that step ran
+
+    batch = batch_per_device * mesh.size
+    losses = []
+    step = start_step
+    for step in range(start_step, steps):
+        params, opt_state, loss = step_fn(
+            params, opt_state, synthetic_batch(cfg, mesh, batch, step)
+        )
+        losses.append(float(loss))
+        if ckpt is not None:
+            ckpt.maybe_save(step, params, opt_state)
+    if ckpt is not None and losses:
+        ckpt.save(step, params, opt_state)
+        ckpt.wait()
+        ckpt.close()
+
+    return {
+        "start_step": start_step,
+        "end_step": steps,
+        "resumed": start_step > 0,
+        "first_loss": losses[0] if losses else None,
+        "final_loss": losses[-1] if losses else None,
+        "losses": losses,
+        "mesh": dict(mesh.shape),
+    }

@@ -28,7 +28,7 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def _toy(mesh, n_stages, L=8, D=16):
+def _toy(L=8, D=16):
     ws = jax.random.normal(jax.random.PRNGKey(0), (L, D, D)) * 0.1
     x = jax.random.normal(jax.random.PRNGKey(1), (8, D))
 
@@ -50,7 +50,7 @@ def _seq_apply(ws, x):
 
 def test_pipeline_matches_sequential():
     mesh = make_mesh(shape=(1, 2, 1, 4, 1, 1))
-    ws, x, stage_fn = _toy(mesh, 4)
+    ws, x, stage_fn = _toy()
     y = pipeline_apply(stage_fn, stack_stages({"w": ws}, 4), x, mesh, 4)
     np.testing.assert_allclose(
         np.asarray(y), np.asarray(_seq_apply(ws, x)), atol=1e-5
@@ -59,7 +59,7 @@ def test_pipeline_matches_sequential():
 
 def test_pipeline_grad_matches_sequential():
     mesh = make_mesh(shape=(1, 2, 1, 4, 1, 1))
-    ws, x, stage_fn = _toy(mesh, 4)
+    ws, x, stage_fn = _toy()
 
     def loss_pp(w):
         return jnp.sum(
@@ -77,7 +77,7 @@ def test_pipeline_grad_matches_sequential():
 
 def test_single_stage_mesh_falls_through():
     mesh = make_mesh(shape=(1, 4, 1, 1, 1, 2))
-    ws, x, stage_fn = _toy(mesh, 1)
+    ws, x, stage_fn = _toy()
     y = pipeline_apply(stage_fn, stack_stages({"w": ws}, 1), x, mesh, 4)
     np.testing.assert_allclose(
         np.asarray(y), np.asarray(_seq_apply(ws, x)), atol=1e-5
@@ -91,7 +91,7 @@ def test_stack_stages_rejects_indivisible():
 
 def test_pipeline_rejects_bad_microbatching():
     mesh = make_mesh(shape=(1, 2, 1, 4, 1, 1))
-    ws, x, stage_fn = _toy(mesh, 4)
+    ws, x, stage_fn = _toy()
     with pytest.raises(ValueError, match="microbatch"):
         pipeline_apply(stage_fn, stack_stages({"w": ws}, 4), x, mesh, 3)
 
