@@ -100,6 +100,16 @@ class NativeTpuInfo:
         self._lib.tpuinfo_probe_libtpu.restype = ctypes.c_int
         self._lib.tpuinfo_probe_libtpu.argtypes = [ctypes.c_char_p]
         self._lib.tpuinfo_version.restype = ctypes.c_char_p
+        self._lib.tpuinfo_health_events_open.restype = ctypes.c_int
+        self._lib.tpuinfo_health_events_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        self._lib.tpuinfo_health_events_wait.restype = ctypes.c_int
+        self._lib.tpuinfo_health_events_wait.argtypes = [
+            ctypes.c_int, ctypes.c_int,
+        ]
+        self._lib.tpuinfo_health_events_close.restype = None
+        self._lib.tpuinfo_health_events_close.argtypes = [ctypes.c_int]
 
     def version(self) -> str:
         return self._lib.tpuinfo_version().decode()
@@ -159,6 +169,26 @@ class NativeTpuInfo:
 
     def probe_libtpu(self, path: str = "") -> bool:
         return bool(self._lib.tpuinfo_probe_libtpu(path.encode()))
+
+    # Event-driven health (the NVML EventSet analog, tpuinfo.h). Returns
+    # an fd handle or raises when inotify/the roots are unavailable —
+    # callers fall back to interval polling.
+    def health_events_open(self, sysfs_accel_dir: str, dev_dir: str) -> int:
+        fd = self._lib.tpuinfo_health_events_open(
+            sysfs_accel_dir.encode(), dev_dir.encode()
+        )
+        if fd < 0:
+            raise OSError(-fd, "tpuinfo_health_events_open failed")
+        return fd
+
+    def health_events_wait(self, fd: int, timeout_ms: int) -> bool:
+        r = self._lib.tpuinfo_health_events_wait(fd, timeout_ms)
+        if r < 0:
+            raise OSError(-r, "tpuinfo_health_events_wait failed")
+        return bool(r)
+
+    def health_events_close(self, fd: int) -> None:
+        self._lib.tpuinfo_health_events_close(fd)
 
 
 # ---------------------------------------------------------------------------
@@ -314,6 +344,61 @@ class PyTpuInfo:
             return True
         except OSError:
             return False
+
+    # Event-driven health: same contract as NativeTpuInfo (tpuinfo.h), via
+    # ctypes inotify — pure-Python deployments get event latency too.
+    def health_events_open(self, sysfs_accel_dir: str, dev_dir: str) -> int:
+        from ..utils import inotify
+
+        libc = inotify.load_libc()
+        fd = inotify.init_nonblocking(libc)
+        # Full mutation mask only on sysfs attribute dirs; the dev dir is
+        # the real /dev in production, where watching child writes would
+        # fire on every tty/null close — presence only there (mirrors the
+        # native shim, tpuinfo.cc).
+        mutation_roots = [sysfs_accel_dir]
+        try:
+            for name in sorted(os.listdir(sysfs_accel_dir)):
+                if name.startswith("accel"):
+                    mutation_roots.append(
+                        os.path.join(sysfs_accel_dir, name, "device")
+                    )
+        except OSError:
+            pass
+        watches = 0
+        for root in mutation_roots:
+            if root and inotify.add_watch(
+                libc, fd, root, inotify.MUTATION_MASK
+            ):
+                watches += 1
+        if dev_dir and inotify.add_watch(
+            libc, fd, dev_dir, inotify.PRESENCE_MASK
+        ):
+            watches += 1
+        if watches == 0:
+            os.close(fd)
+            raise OSError(2, "no watchable health roots")
+        self._libc = libc
+        return fd
+
+    def health_events_wait(self, fd: int, timeout_ms: int) -> bool:
+        import select
+
+        ready, _, _ = select.select([fd], [], [], timeout_ms / 1000.0)
+        if not ready:
+            return False
+        try:
+            while os.read(fd, 4096):
+                pass
+        except BlockingIOError:
+            pass
+        return True
+
+    def health_events_close(self, fd: int) -> None:
+        try:
+            os.close(fd)
+        except OSError:
+            pass
 
 
 def get_backend(prefer_native: bool = True):

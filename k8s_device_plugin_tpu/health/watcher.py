@@ -2,10 +2,14 @@
 
 The TPU-native analog of the reference's XID watcher
 (/root/reference/nvidia.go:51-102): the reference registers for NVML
-XidCriticalError events and polls WaitForEvent on a 5 s loop; TPUs expose no
-event fd, so this polls per-chip health through the discovery backend
-(device node presence, PCI enable state, health attribute — see
-tpuinfo_chip_health) on the same 5 s cadence.
+XidCriticalError events and polls WaitForEvent on a 5 s loop; here the
+discovery backend provides an inotify-based event source over the sysfs
+health surfaces (tpuinfo_health_events_*, the EventSet analog), so
+transitions are detected the moment the driver/fault-injection writes
+them — with the same 5 s probe as a fallback cadence when events are
+unavailable (filesystems without inotify) and as a safety net for
+mutations inotify can't see (e.g. a bind-mounted sysfs changing
+underneath).
 
 Differences from the reference, both deliberate:
 
@@ -103,10 +107,45 @@ class HealthWatcher:
                 self._callback(cid, healthy)
 
     def _run(self) -> None:
+        events_fd = None
+        if hasattr(self._backend, "health_events_open"):
+            try:
+                events_fd = self._backend.health_events_open(
+                    self._sysfs, self._dev
+                )
+            except OSError as e:
+                log.warning(
+                    "health event source unavailable (%s); interval "
+                    "polling only",
+                    e,
+                )
         log.info(
-            "health watcher started: %d chips, %.1fs interval",
+            "health watcher started: %d chips, %.1fs interval, events=%s",
             len(self._chips),
             self._interval,
+            events_fd is not None,
         )
-        while not self._stop.wait(self._interval):
-            self.poll_once()
+        try:
+            while not self._stop.is_set():
+                if events_fd is not None:
+                    # Wait for an event OR one full interval (the fallback
+                    # sweep), in sub-second slices so stop() is prompt.
+                    try:
+                        waited = 0.0
+                        while waited < self._interval and not self._stop.is_set():
+                            if self._backend.health_events_wait(
+                                events_fd, 500
+                            ):
+                                break
+                            waited += 0.5
+                    except OSError as e:
+                        log.warning("health event wait failed (%s)", e)
+                        self._backend.health_events_close(events_fd)
+                        events_fd = None
+                elif self._stop.wait(self._interval):
+                    break
+                if not self._stop.is_set():
+                    self.poll_once()
+        finally:
+            if events_fd is not None:
+                self._backend.health_events_close(events_fd)

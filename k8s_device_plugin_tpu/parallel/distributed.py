@@ -1,0 +1,135 @@
+"""Multi-host distributed runtime: slice-wide JAX from plugin-exported env.
+
+The reference's multi-device story stops at describing the interconnect for
+placement (SURVEY.md §5 "Distributed communication backend": NVML P2P feeds
+scoring; workloads bring their own NCCL). On TPU the framework owns this
+plane: a pod spanning a multi-host slice must bring up ONE jax runtime per
+host, all agreeing on a coordinator, before ``jax.devices()`` shows the
+whole slice and XLA collectives can ride ICI/DCN.
+
+The device plugin's Allocate response exports the slice layout
+(``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES`` — server/plugin.py:_tpu_env);
+this module is the workload-side consumer: parse that env, elect the first
+worker as coordinator, ``jax.distributed.initialize``, and build the global
+mesh. Verified for real with multi-process CPU SPMD in the tests (two
+processes, one TCP coordinator, global mesh + collectives across both —
+the DCN analog without TPU pods).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from .mesh import batch_sharding, make_mesh
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceEnv:
+    """The multi-host slice layout as the plugin exported it."""
+
+    worker_id: int
+    hostnames: Tuple[str, ...]
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hostnames)
+
+    @property
+    def coordinator_address(self) -> str:
+        # Convention: the first worker in the slice hosts the coordinator
+        # (it exists as long as the slice does, and every worker has its
+        # name). Matches how the plugin orders TPU_WORKER_HOSTNAMES.
+        return f"{self.hostnames[0]}:{self.coordinator_port}"
+
+
+def slice_env(environ: Optional[Mapping[str, str]] = None) -> Optional[SliceEnv]:
+    """Parse the plugin-exported slice env; None when not on a multi-host
+    slice (no/empty TPU_WORKER_HOSTNAMES)."""
+    environ = os.environ if environ is None else environ
+    raw = environ.get("TPU_WORKER_HOSTNAMES", "")
+    hosts = tuple(h.strip() for h in raw.split(",") if h.strip())
+    if not hosts:
+        return None
+    # Malformed values raise rather than coerce: silently defaulting
+    # worker_id would give two hosts process_id 0 and hang every worker in
+    # the jax.distributed init barrier with no pointer at the bad env.
+    try:
+        worker_id = int(environ.get("TPU_WORKER_ID", "0") or 0)
+    except ValueError as e:
+        raise ValueError(
+            f"unparseable TPU_WORKER_ID="
+            f"{environ.get('TPU_WORKER_ID')!r}"
+        ) from e
+    try:
+        port = int(
+            environ.get("TPU_COORDINATOR_PORT", "")
+            or DEFAULT_COORDINATOR_PORT
+        )
+    except ValueError as e:
+        raise ValueError(
+            f"unparseable TPU_COORDINATOR_PORT="
+            f"{environ.get('TPU_COORDINATOR_PORT')!r}"
+        ) from e
+    if not 0 <= worker_id < len(hosts):
+        raise ValueError(
+            f"TPU_WORKER_ID={worker_id} out of range for "
+            f"{len(hosts)} worker hostnames"
+        )
+    return SliceEnv(worker_id=worker_id, hostnames=hosts,
+                    coordinator_port=port)
+
+
+def initialize(env: Optional[SliceEnv] = None) -> bool:
+    """Bring up the distributed runtime when the env says multi-host.
+
+    Single-host (env is None or one hostname) is a no-op returning False —
+    jax works standalone there, and skipping initialize keeps single-chip
+    pods free of a coordinator round-trip. Idempotent: a second call on an
+    already-initialized runtime is a no-op returning True.
+    """
+    env = slice_env() if env is None else env
+    if env is None or env.num_hosts < 2:
+        return False
+    try:
+        state = jax.distributed.global_state
+        if state.client is not None:  # already initialized
+            return True
+    except Exception:
+        pass
+    jax.distributed.initialize(
+        coordinator_address=env.coordinator_address,
+        num_processes=env.num_hosts,
+        process_id=env.worker_id,
+    )
+    return True
+
+
+def global_mesh(shape: Optional[Sequence[int]] = None):
+    """Mesh over the whole slice (every host's chips), standard axes.
+
+    Call after initialize(): jax.devices() is then the global device list,
+    ordered so same-host chips are contiguous — outer mesh axes (data/fsdp)
+    land across hosts (DCN-tolerant collectives) and inner axes (model)
+    stay within a host's ICI domain.
+    """
+    return make_mesh(jax.devices(), shape=tuple(shape) if shape else None)
+
+
+def shard_host_batch(local_batch: np.ndarray, mesh) -> jax.Array:
+    """Assemble the global batch from this host's shard.
+
+    Each host feeds only its local examples; the result is one global array
+    whose batch dim is sharded over (data, fsdp) — no cross-host transfer
+    of input data, the DCN only ever carries gradients/activations.
+    """
+    sharding: NamedSharding = batch_sharding(mesh)
+    return jax.make_array_from_process_local_data(sharding, local_batch)

@@ -38,7 +38,7 @@ from ..api.grpc_defs import (
 )
 from ..topology.mesh import IciMesh
 from ..topology.placement import PlacementState
-from ..utils import metrics
+from ..utils import metrics, profiling
 
 log = logging.getLogger(__name__)
 
@@ -284,6 +284,10 @@ class TpuDevicePlugin(DevicePluginServicer):
             yield resp
 
     def GetPreferredAllocation(self, request, context):
+        with profiling.timed(method="GetPreferredAllocation"):
+            return self._get_preferred_allocation(request, context)
+
+    def _get_preferred_allocation(self, request, context):
         resp = pb.PreferredAllocationResponse()
         for creq in request.container_requests:
             picked = self.state.select(
@@ -301,6 +305,10 @@ class TpuDevicePlugin(DevicePluginServicer):
         return resp
 
     def Allocate(self, request, context):
+        with profiling.timed(method="Allocate"):
+            return self._allocate(request, context)
+
+    def _allocate(self, request, context):
         # Two-phase under one lock: validate + plan every container first,
         # then commit — a bad container can't leak partial allocation state,
         # and concurrent RPCs can't plan overlapping chip sets.

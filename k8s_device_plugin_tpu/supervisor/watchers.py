@@ -10,7 +10,6 @@ stat-polling fallback for filesystems without inotify.
 
 from __future__ import annotations
 
-import ctypes
 import errno
 import logging
 import os
@@ -21,12 +20,16 @@ import struct
 import threading
 from typing import Callable, Iterable, Optional
 
-log = logging.getLogger(__name__)
+from ..utils.inotify import (
+    IN_CREATE,
+    IN_DELETE,
+    IN_MOVED_TO,
+    add_watch,
+    init_nonblocking,
+    load_libc,
+)
 
-# inotify event masks (linux/inotify.h)
-IN_CREATE = 0x00000100
-IN_DELETE = 0x00000200
-IN_MOVED_TO = 0x00000080
+log = logging.getLogger(__name__)
 
 _EVENT_FMT = "iIII"
 _EVENT_SIZE = struct.calcsize(_EVENT_FMT)
@@ -72,17 +75,13 @@ class FsWatcher:
     # -- inotify path ------------------------------------------------------
 
     def _init_inotify(self) -> None:
-        libc = ctypes.CDLL("libc.so.6", use_errno=True)
-        fd = libc.inotify_init1(os.O_NONBLOCK)
-        if fd < 0:
-            raise OSError(ctypes.get_errno(), "inotify_init1")
-        wd = libc.inotify_add_watch(
-            fd, self.path.encode(), IN_CREATE | IN_DELETE | IN_MOVED_TO
-        )
-        if wd < 0:
-            e = ctypes.get_errno()
+        libc = load_libc()
+        fd = init_nonblocking(libc)
+        if not add_watch(
+            libc, fd, self.path, IN_CREATE | IN_DELETE | IN_MOVED_TO
+        ):
             os.close(fd)
-            raise OSError(e, f"inotify_add_watch({self.path})")
+            raise OSError(errno.EINVAL, f"inotify_add_watch({self.path})")
         self._fd = fd
 
     def _run_inotify(self) -> None:

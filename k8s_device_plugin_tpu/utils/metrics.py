@@ -61,6 +61,69 @@ def _fmt(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(v)
 
 
+# Request-latency bucket bounds (seconds): sub-ms gRPC handlers up through
+# multi-second outliers (kube API round-trips under contention).
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Histogram:
+    """Prometheus histogram (cumulative le buckets + _sum/_count)."""
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple[Tuple[str, str], ...], list] = {}
+        self._sums: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._totals: Dict[Tuple[Tuple[str, str], ...], int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            return self._totals.get(tuple(sorted(labels.items())), 0)
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        with self._lock:
+            for key in sorted(self._totals):
+                base = ",".join(f'{k}="{v}"' for k, v in key)
+                sep = "," if base else ""
+                for bound, c in zip(self.buckets, self._counts[key]):
+                    lines.append(
+                        f'{self.name}_bucket{{{base}{sep}le="{_fmt(bound)}"}}'
+                        f" {c}"
+                    )
+                lines.append(
+                    f'{self.name}_bucket{{{base}{sep}le="+Inf"}} '
+                    f"{self._totals[key]}"
+                )
+                label_s = f"{{{base}}}" if base else ""
+                lines.append(
+                    f"{self.name}_sum{label_s} {_fmt(self._sums[key])}"
+                )
+                lines.append(
+                    f"{self.name}_count{label_s} {self._totals[key]}"
+                )
+        return "\n".join(lines)
+
+
 class Registry:
     def __init__(self):
         self._metrics: Dict[str, Metric] = {}
@@ -71,6 +134,12 @@ class Registry:
 
     def gauge(self, name: str, help_text: str) -> Metric:
         return self._register(name, help_text, "gauge")
+
+    def histogram(self, name: str, help_text: str,
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
+        if name not in self._metrics:
+            self._metrics[name] = Histogram(name, help_text, buckets)
+        return self._metrics[name]
 
     def _register(self, name: str, help_text: str, kind: str) -> Metric:
         if name not in self._metrics:
@@ -108,6 +177,10 @@ LISTANDWATCH_SENDS = REGISTRY.counter(
 )
 GRPC_ERRORS = REGISTRY.counter(
     "tpu_plugin_grpc_errors_total", "gRPC requests answered with an error"
+)
+RPC_LATENCY = REGISTRY.histogram(
+    "tpu_plugin_rpc_latency_seconds",
+    "Wall latency of device-plugin gRPC handlers, by method",
 )
 
 

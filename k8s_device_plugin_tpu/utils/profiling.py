@@ -1,0 +1,66 @@
+"""Profiling & tracing hooks — daemon latency + workload XLA traces.
+
+The reference has neither tracing nor profiling (SURVEY.md §5 "Tracing /
+profiling: none"); this is a deliberate capability add on both planes:
+
+- **Control plane**: ``timed()`` observes wall latency of gRPC handlers /
+  kube round-trips into a Prometheus histogram
+  (utils/metrics.py RPC_LATENCY) — the daemon's hot paths become visible
+  to a scrape instead of requiring log archaeology.
+- **Workload plane**: ``trace()`` wraps ``jax.profiler`` so any training
+  window can be captured as a TensorBoard-loadable XLA trace (per-op HLO
+  timings, TPU step breakdown), and ``annotate()`` names host-side regions
+  inside that trace. Both are exact no-ops unless a trace dir is given, so
+  they can stay in production code paths.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+from . import metrics
+
+
+@contextlib.contextmanager
+def timed(histogram=None, **labels) -> Iterator[None]:
+    """Observe the block's wall time into ``histogram`` (default: the
+    plugin RPC latency histogram)."""
+    h = metrics.RPC_LATENCY if histogram is None else histogram
+    start = time.monotonic()
+    try:
+        yield
+    finally:
+        h.observe(time.monotonic() - start, **labels)
+
+
+@contextlib.contextmanager
+def trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax profiler trace of the block into ``trace_dir``
+    (TensorBoard: `tensorboard --logdir <dir>` → Profile). No-op when
+    trace_dir is falsy or jax is unavailable (control-plane processes
+    never import jax — SURVEY.md §7 design stance)."""
+    if not trace_dir:
+        yield
+        return
+    try:
+        import jax
+    except ImportError:
+        yield
+        return
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Name a host-side region inside an active jax trace (no-op without
+    jax or outside a trace)."""
+    try:
+        import jax
+    except ImportError:
+        yield
+        return
+    with jax.profiler.TraceAnnotation(name):
+        yield

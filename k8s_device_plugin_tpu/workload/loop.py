@@ -9,11 +9,13 @@ continues from the saved step rather than step 0.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
 
 from ..parallel.mesh import batch_sharding, make_mesh
+from ..utils.profiling import trace
 from .checkpointing import TrainCheckpointer
 from .model import ModelConfig
 from . import train
@@ -36,9 +38,15 @@ def run_training(
     save_every: int = 20,
     seed: int = 0,
     mesh=None,
+    profile_dir: Optional[str] = None,
 ) -> dict:
     """Train for ``steps`` total steps, resuming from ``checkpoint_dir``
-    when it holds a previous run's state. Returns a JSON-able report."""
+    when it holds a previous run's state. ``profile_dir`` (or env
+    ``TPU_WORKLOAD_PROFILE_DIR``) captures the whole run as a
+    TensorBoard-loadable XLA trace. Returns a JSON-able report."""
+    profile_dir = profile_dir or os.environ.get(
+        "TPU_WORKLOAD_PROFILE_DIR", ""
+    )
     cfg = cfg or ModelConfig()
     mesh = mesh if mesh is not None else make_mesh()
     params, opt_state, tx = train.make_train_state(
@@ -48,27 +56,34 @@ def run_training(
 
     start_step = 0
     ckpt = None
-    if checkpoint_dir:
-        ckpt = TrainCheckpointer(checkpoint_dir, save_every=save_every)
-        restored = ckpt.restore_latest(params, opt_state)
-        if restored is not None:
-            start_step, params, opt_state = restored
-            start_step += 1  # saved state is *after* that step ran
-
     batch = batch_per_device * mesh.size
     losses = []
-    step = start_step
-    for step in range(start_step, steps):
-        params, opt_state, loss = step_fn(
-            params, opt_state, synthetic_batch(cfg, mesh, batch, step)
-        )
-        losses.append(float(loss))
+    try:
+        if checkpoint_dir:
+            ckpt = TrainCheckpointer(checkpoint_dir, save_every=save_every)
+            restored = ckpt.restore_latest(params, opt_state)
+            if restored is not None:
+                start_step, params, opt_state = restored
+                start_step += 1  # saved state is *after* that step ran
+
+        step = start_step
+        with trace(profile_dir):
+            for step in range(start_step, steps):
+                params, opt_state, loss = step_fn(
+                    params, opt_state,
+                    synthetic_batch(cfg, mesh, batch, step),
+                )
+                losses.append(float(loss))
+                if ckpt is not None:
+                    ckpt.maybe_save(step, params, opt_state)
+        if ckpt is not None and losses:
+            ckpt.save(step, params, opt_state)
+    finally:
+        # Always flush + close (zero-step resumes, exceptions mid-loop):
+        # leaking the manager would strand in-flight async saves.
         if ckpt is not None:
-            ckpt.maybe_save(step, params, opt_state)
-    if ckpt is not None and losses:
-        ckpt.save(step, params, opt_state)
-        ckpt.wait()
-        ckpt.close()
+            ckpt.wait()
+            ckpt.close()
 
     return {
         "start_step": start_step,

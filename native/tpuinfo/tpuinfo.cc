@@ -6,8 +6,10 @@
 #include <dirent.h>
 #include <dlfcn.h>
 #include <errno.h>
+#include <poll.h>
 #include <stdio.h>
 #include <string.h>
+#include <sys/inotify.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
@@ -261,6 +263,65 @@ int tpuinfo_numa_topology(const char* sysfs_nodes_dir,
     out[i].cpu_count = CountCpuList(ReadTrimmed(base + "/cpulist"));
   }
   return n;
+}
+
+int tpuinfo_health_events_open(const char* sysfs_class_dir,
+                               const char* dev_dir) {
+  int fd = ::inotify_init1(IN_NONBLOCK | IN_CLOEXEC);
+  if (fd < 0) return -errno;
+  /* Full mutation mask only on the sysfs attribute dirs. The dev dir is
+   * the real /dev in production: a directory watch reports child events,
+   * so IN_MODIFY/IN_CLOSE_WRITE there would fire on every tty/null write
+   * and degrade the event source into a busy poll — for /dev only node
+   * presence matters. */
+  const unsigned int presence =
+      IN_CREATE | IN_DELETE | IN_MOVED_TO | IN_MOVED_FROM;
+  const unsigned int mutation =
+      presence | IN_MODIFY | IN_CLOSE_WRITE | IN_ATTRIB;
+  int watches = 0;
+  if (sysfs_class_dir != nullptr && sysfs_class_dir[0] != '\0') {
+    if (::inotify_add_watch(fd, sysfs_class_dir, mutation) >= 0) ++watches;
+    DIR* d = ::opendir(sysfs_class_dir);
+    if (d != nullptr) {
+      struct dirent* ent;
+      while ((ent = ::readdir(d)) != nullptr) {
+        if (strncmp(ent->d_name, "accel", 5) != 0) continue;
+        std::string attr = std::string(sysfs_class_dir) + "/" + ent->d_name +
+                           "/device";
+        if (::inotify_add_watch(fd, attr.c_str(), mutation) >= 0) ++watches;
+      }
+      ::closedir(d);
+    }
+  }
+  if (dev_dir != nullptr && dev_dir[0] != '\0') {
+    if (::inotify_add_watch(fd, dev_dir, presence) >= 0) ++watches;
+  }
+  if (watches == 0) {
+    /* Nothing watchable (both roots missing): not an event source. */
+    ::close(fd);
+    return -ENOENT;
+  }
+  return fd;
+}
+
+int tpuinfo_health_events_wait(int fd, int timeout_ms) {
+  if (fd < 0) return -EBADF;
+  struct pollfd pfd;
+  pfd.fd = fd;
+  pfd.events = POLLIN;
+  pfd.revents = 0;
+  int rc = ::poll(&pfd, 1, timeout_ms);
+  if (rc < 0) return errno == EINTR ? 0 : -errno;
+  if (rc == 0) return 0;
+  /* Drain: we only report "something changed"; callers re-probe health. */
+  char buf[4096];
+  while (::read(fd, buf, sizeof(buf)) > 0) {
+  }
+  return 1;
+}
+
+void tpuinfo_health_events_close(int fd) {
+  if (fd >= 0) ::close(fd);
 }
 
 int tpuinfo_probe_libtpu(const char* path) {

@@ -76,6 +76,26 @@ int tpuinfo_numa_topology(const char* sysfs_nodes_dir,
  * fatal. */
 int tpuinfo_probe_libtpu(const char* path);
 
+/* Event-driven health: the analog of the reference's NVML EventSet
+ * (RegisterEventForDevice + WaitForEvent,
+ * /root/reference/vendor/.../nvml/bindings.go:97-146) built on inotify.
+ *
+ * tpuinfo_health_events_open watches the accel class dir, every
+ * accelN/device attribute dir under it, and the device-node dir; returns
+ * an fd handle >= 0, or -errno when inotify is unavailable (callers fall
+ * back to interval polling).
+ *
+ * tpuinfo_health_events_wait blocks up to timeout_ms for any
+ * health-relevant mutation (attribute write, chip dir or device node
+ * appearing/disappearing), drains the queue, and returns 1 when events
+ * arrived, 0 on timeout, -errno on error. Like NVML's WaitForEvent it
+ * reports "something changed" — callers re-probe chip health to learn
+ * what (tpuinfo_chip_health). */
+int tpuinfo_health_events_open(const char* sysfs_class_dir,
+                               const char* dev_dir);
+int tpuinfo_health_events_wait(int fd, int timeout_ms);
+void tpuinfo_health_events_close(int fd);
+
 const char* tpuinfo_version(void);
 
 #ifdef __cplusplus

@@ -132,3 +132,76 @@ def test_end_to_end_sysfs_to_listandwatch(tmp_path, node):
         watcher.stop()
         plugin.stop()
         kubelet.stop()
+
+
+# ---------------------------------------------------------------------------
+# Event-driven health (tpuinfo_health_events_*, the NVML EventSet analog)
+# ---------------------------------------------------------------------------
+
+def _backends():
+    from k8s_device_plugin_tpu.discovery.scanner import NativeTpuInfo
+
+    backends = [PyTpuInfo()]
+    try:
+        backends.append(NativeTpuInfo())
+    except OSError:
+        pass
+    return backends
+
+
+@pytest.mark.parametrize(
+    "backend", _backends(), ids=lambda b: type(b).__name__
+)
+def test_event_source_wakes_on_health_write(node, backend):
+    accel, dev, chips = node
+    fd = backend.health_events_open(accel, dev)
+    try:
+        assert backend.health_events_wait(fd, 50) is False  # quiet
+        fakes.set_chip_health(accel, 1, False)
+        assert backend.health_events_wait(fd, 2000) is True
+        # drained: quiet again
+        assert backend.health_events_wait(fd, 50) is False
+        fakes.remove_dev_node(dev, 2)
+        assert backend.health_events_wait(fd, 2000) is True
+    finally:
+        backend.health_events_close(fd)
+
+
+@pytest.mark.parametrize(
+    "backend", _backends(), ids=lambda b: type(b).__name__
+)
+def test_event_source_open_fails_without_roots(tmp_path, backend):
+    with pytest.raises(OSError):
+        backend.health_events_open(
+            str(tmp_path / "nope-a"), str(tmp_path / "nope-b")
+        )
+
+
+def test_watcher_event_driven_latency(node):
+    """With a long poll interval, a health flip must still be reported
+    within ~a second via the event source (not the 30 s fallback)."""
+    import time
+
+    accel, dev, chips = node
+    got = threading.Event()
+    events = []
+
+    def cb(cid, healthy):
+        events.append((cid, healthy))
+        got.set()
+
+    w = HealthWatcher(PyTpuInfo(), accel, dev, chips, cb, interval_s=30.0)
+    w.start()
+    try:
+        time.sleep(0.3)  # let the watcher enter its event wait
+        t0 = time.monotonic()
+        fakes.set_chip_health(accel, 3, False)
+        assert got.wait(5), "no event-driven health report"
+        latency = time.monotonic() - t0
+        assert events == [(chips[3].device_id_str, False)]
+        assert latency < 5.0  # far below the 30 s poll interval
+    finally:
+        # stop() must be prompt despite the 30 s interval (sliced waits).
+        t1 = time.monotonic()
+        w.stop()
+        assert time.monotonic() - t1 < 5

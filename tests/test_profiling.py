@@ -1,0 +1,123 @@
+"""Profiling hooks (utils/profiling.py) + histogram metric."""
+
+import os
+
+import pytest
+
+from k8s_device_plugin_tpu.utils import metrics, profiling
+from k8s_device_plugin_tpu.utils.metrics import Histogram, Registry
+
+
+def test_histogram_observe_and_render():
+    h = Histogram("test_latency_seconds", "t", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.005, method="A")
+    h.observe(0.05, method="A")
+    h.observe(5.0, method="A")
+    out = h.render()
+    assert 'test_latency_seconds_bucket{method="A",le="0.01"} 1' in out
+    assert 'test_latency_seconds_bucket{method="A",le="0.1"} 2' in out
+    assert 'test_latency_seconds_bucket{method="A",le="1"} 2' in out
+    assert 'test_latency_seconds_bucket{method="A",le="+Inf"} 3' in out
+    assert 'test_latency_seconds_count{method="A"} 3' in out
+    assert h.count(method="A") == 3
+    assert h.count(method="B") == 0
+
+
+def test_histogram_via_registry_renders_with_scrape():
+    reg = Registry()
+    h = reg.histogram("reg_hist_seconds", "t", buckets=(1.0,))
+    h.observe(0.5)
+    out = reg.render()
+    assert "# TYPE reg_hist_seconds histogram" in out
+    assert 'reg_hist_seconds_bucket{le="1"} 1' in out
+
+
+def test_timed_observes_block():
+    h = Histogram("timed_test_seconds", "t", buckets=(10.0,))
+    with profiling.timed(h, method="X"):
+        pass
+    assert h.count(method="X") == 1
+
+
+def test_timed_observes_on_exception():
+    h = Histogram("timed_exc_seconds", "t", buckets=(10.0,))
+    with pytest.raises(RuntimeError):
+        with profiling.timed(h, method="X"):
+            raise RuntimeError("boom")
+    assert h.count(method="X") == 1
+
+
+def test_rpc_latency_recorded_by_server(tmp_path):
+    """Allocate through the real gRPC server lands in the RPC histogram."""
+    from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
+    from tests.fake_kubelet import FakeKubelet
+    from tests.test_server import make_plugin
+
+    dp_dir = tmp_path / "dp"
+    dp_dir.mkdir()
+    kubelet = FakeKubelet(str(dp_dir))
+    kubelet.start()
+    plugin = make_plugin(tmp_path, str(dp_dir))
+    plugin.serve()
+    try:
+        assert kubelet.registered.wait(10)
+        stub = kubelet.plugin_stub()
+        before_alloc = metrics.RPC_LATENCY.count(method="Allocate")
+        before_pref = metrics.RPC_LATENCY.count(
+            method="GetPreferredAllocation"
+        )
+        lw = next(iter(stub.ListAndWatch(pb.Empty())))
+        preq = pb.PreferredAllocationRequest()
+        preq.container_requests.add(
+            available_deviceIDs=[d.ID for d in lw.devices],
+            allocation_size=1,
+        )
+        stub.GetPreferredAllocation(preq)
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=[lw.devices[0].ID])
+        stub.Allocate(req)
+        assert (
+            metrics.RPC_LATENCY.count(method="Allocate") == before_alloc + 1
+        )
+        assert (
+            metrics.RPC_LATENCY.count(method="GetPreferredAllocation")
+            == before_pref + 1
+        )
+    finally:
+        plugin.stop()
+        kubelet.stop()
+
+
+def test_trace_noop_without_dir():
+    with profiling.trace(""):
+        pass
+    with profiling.trace(None):
+        pass
+
+
+def test_trace_writes_profile(tmp_path):
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "prof")
+    with profiling.trace(d):
+        with profiling.annotate("test-region"):
+            jnp.ones((8, 8)).sum().block_until_ready()
+    found = []
+    for root, _, files in os.walk(d):
+        found.extend(files)
+    assert found, "no trace artifacts written"
+
+
+def test_loop_profile_dir(tmp_path):
+    from k8s_device_plugin_tpu.parallel.mesh import make_mesh
+    from k8s_device_plugin_tpu.workload.loop import run_training
+    from k8s_device_plugin_tpu.workload.model import ModelConfig
+    import jax
+
+    d = str(tmp_path / "prof")
+    run_training(
+        ModelConfig.tiny(), steps=2, batch_per_device=4,
+        mesh=make_mesh(jax.devices()[:1]), profile_dir=d,
+    )
+    assert os.path.isdir(d)
