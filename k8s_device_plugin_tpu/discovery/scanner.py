@@ -100,16 +100,28 @@ class NativeTpuInfo:
         self._lib.tpuinfo_probe_libtpu.restype = ctypes.c_int
         self._lib.tpuinfo_probe_libtpu.argtypes = [ctypes.c_char_p]
         self._lib.tpuinfo_version.restype = ctypes.c_char_p
-        self._lib.tpuinfo_health_events_open.restype = ctypes.c_int
-        self._lib.tpuinfo_health_events_open.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p,
-        ]
-        self._lib.tpuinfo_health_events_wait.restype = ctypes.c_int
-        self._lib.tpuinfo_health_events_wait.argtypes = [
-            ctypes.c_int, ctypes.c_int,
-        ]
-        self._lib.tpuinfo_health_events_close.restype = None
-        self._lib.tpuinfo_health_events_close.argtypes = [ctypes.c_int]
+        # Event API is newer than the core symbols: a stale .so (version
+        # skew via TPUINFO_LIB) must degrade to interval polling, not
+        # crash the daemon at startup with an AttributeError get_backend
+        # wouldn't catch.
+        try:
+            self._lib.tpuinfo_health_events_open.restype = ctypes.c_int
+            self._lib.tpuinfo_health_events_open.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p,
+            ]
+            self._lib.tpuinfo_health_events_wait.restype = ctypes.c_int
+            self._lib.tpuinfo_health_events_wait.argtypes = [
+                ctypes.c_int, ctypes.c_int,
+            ]
+            self._lib.tpuinfo_health_events_close.restype = None
+            self._lib.tpuinfo_health_events_close.argtypes = [ctypes.c_int]
+            self._has_events = True
+        except AttributeError:
+            log.warning(
+                "libtpuinfo.so lacks tpuinfo_health_events_*; health "
+                "falls back to interval polling (rebuild native/tpuinfo)"
+            )
+            self._has_events = False
 
     def version(self) -> str:
         return self._lib.tpuinfo_version().decode()
@@ -174,6 +186,8 @@ class NativeTpuInfo:
     # an fd handle or raises when inotify/the roots are unavailable —
     # callers fall back to interval polling.
     def health_events_open(self, sysfs_accel_dir: str, dev_dir: str) -> int:
+        if not self._has_events:
+            raise OSError(38, "libtpuinfo.so lacks the event API")  # ENOSYS
         fd = self._lib.tpuinfo_health_events_open(
             sysfs_accel_dir.encode(), dev_dir.encode()
         )
@@ -369,11 +383,11 @@ class PyTpuInfo:
         for root in mutation_roots:
             if root and inotify.add_watch(
                 libc, fd, root, inotify.MUTATION_MASK
-            ):
+            ) >= 0:
                 watches += 1
         if dev_dir and inotify.add_watch(
             libc, fd, dev_dir, inotify.PRESENCE_MASK
-        ):
+        ) >= 0:
             watches += 1
         if watches == 0:
             os.close(fd)

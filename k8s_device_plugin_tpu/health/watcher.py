@@ -4,12 +4,17 @@ The TPU-native analog of the reference's XID watcher
 (/root/reference/nvidia.go:51-102): the reference registers for NVML
 XidCriticalError events and polls WaitForEvent on a 5 s loop; here the
 discovery backend provides an inotify-based event source over the sysfs
-health surfaces (tpuinfo_health_events_*, the EventSet analog), so
-transitions are detected the moment the driver/fault-injection writes
-them — with the same 5 s probe as a fallback cadence when events are
-unavailable (filesystems without inotify) and as a safety net for
-mutations inotify can't see (e.g. a bind-mounted sysfs changing
-underneath).
+health surfaces (tpuinfo_health_events_*, the EventSet analog).
+
+Latency honesty: inotify observes VFS-path writes — fault injection,
+device nodes appearing/disappearing, orchestration writing attributes,
+bind-mounted health files. A kernel driver that flips an attribute's
+*value* internally (sysfs_notify semantics) generates no inotify event;
+those transitions are caught by the interval probe, so worst-case
+detection is one poll interval, and the event source is a fast path, not
+a guarantee. (A production driver surface advertising sysfs_notify would
+slot in here as a poll(2)-on-attribute-fd event source with the same
+backend contract.)
 
 Differences from the reference, both deliberate:
 

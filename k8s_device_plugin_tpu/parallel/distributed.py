@@ -59,16 +59,20 @@ def slice_env(environ: Optional[Mapping[str, str]] = None) -> Optional[SliceEnv]
     hosts = tuple(h.strip() for h in raw.split(",") if h.strip())
     if not hosts:
         return None
-    # Malformed values raise rather than coerce: silently defaulting
-    # worker_id would give two hosts process_id 0 and hang every worker in
-    # the jax.distributed init barrier with no pointer at the bad env.
-    try:
-        worker_id = int(environ.get("TPU_WORKER_ID", "0") or 0)
-    except ValueError as e:
+    # Malformed or missing values raise rather than coerce: silently
+    # defaulting worker_id would give two hosts process_id 0 and hang
+    # every worker in the jax.distributed init barrier with no pointer at
+    # the bad env.
+    raw_id = environ.get("TPU_WORKER_ID", "")
+    if raw_id == "" and len(hosts) > 1:
         raise ValueError(
-            f"unparseable TPU_WORKER_ID="
-            f"{environ.get('TPU_WORKER_ID')!r}"
-        ) from e
+            f"TPU_WORKER_ID is unset but TPU_WORKER_HOSTNAMES lists "
+            f"{len(hosts)} workers; every host would claim process 0"
+        )
+    try:
+        worker_id = int(raw_id or 0)
+    except ValueError as e:
+        raise ValueError(f"unparseable TPU_WORKER_ID={raw_id!r}") from e
     try:
         port = int(
             environ.get("TPU_COORDINATOR_PORT", "")
@@ -99,12 +103,8 @@ def initialize(env: Optional[SliceEnv] = None) -> bool:
     env = slice_env() if env is None else env
     if env is None or env.num_hosts < 2:
         return False
-    try:
-        state = jax.distributed.global_state
-        if state.client is not None:  # already initialized
-            return True
-    except Exception:
-        pass
+    if jax.distributed.is_initialized():
+        return True
     jax.distributed.initialize(
         coordinator_address=env.coordinator_address,
         num_processes=env.num_hosts,

@@ -77,11 +77,12 @@ class FsWatcher:
     def _init_inotify(self) -> None:
         libc = load_libc()
         fd = init_nonblocking(libc)
-        if not add_watch(
+        wd = add_watch(
             libc, fd, self.path, IN_CREATE | IN_DELETE | IN_MOVED_TO
-        ):
+        )
+        if wd < 0:
             os.close(fd)
-            raise OSError(errno.EINVAL, f"inotify_add_watch({self.path})")
+            raise OSError(-wd, f"inotify_add_watch({self.path})")
         self._fd = fd
 
     def _run_inotify(self) -> None:

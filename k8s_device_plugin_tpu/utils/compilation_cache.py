@@ -1,0 +1,42 @@
+"""Persistent XLA compilation cache for the workload.
+
+First compile of the training step costs tens of seconds on TPU; a pod
+that restarts (eviction, resume — the cases workload/loop.py exists for)
+pays it again for byte-identical programs. Pointing jax's persistent
+compilation cache at a volume turns that into a disk read. Opt-in via
+``TPU_WORKLOAD_COMPILATION_CACHE_DIR`` (mount a hostPath/PVC there in the
+pod spec) or an explicit call.
+
+No counterpart in the reference (no ML code); this is part of the
+workload stack's time-to-first-step budget (BASELINE.md north star).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+ENV_VAR = "TPU_WORKLOAD_COMPILATION_CACHE_DIR"
+
+
+def maybe_enable(cache_dir: Optional[str] = None) -> bool:
+    """Enable jax's persistent compilation cache when a directory is
+    configured (argument wins over $TPU_WORKLOAD_COMPILATION_CACHE_DIR).
+    Safe to call repeatedly; returns whether the cache is on."""
+    d = cache_dir or os.environ.get(ENV_VAR, "")
+    if not d:
+        return False
+    import jax
+
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    # Cache everything: the workload's jits are few and all worth keeping
+    # (default threshold skips fast compiles, which on CPU test runs is
+    # every compile — making the behavior untestable).
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    log.info("persistent compilation cache at %s", d)
+    return True

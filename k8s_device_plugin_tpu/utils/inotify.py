@@ -41,7 +41,10 @@ def init_nonblocking(libc: ctypes.CDLL) -> int:
     return fd
 
 
-def add_watch(libc: ctypes.CDLL, fd: int, path: str, mask: int) -> bool:
-    """Add a watch; False (not an exception) when the path is unwatchable —
-    callers count successes and decide whether zero watches is fatal."""
-    return libc.inotify_add_watch(fd, path.encode(), mask) >= 0
+def add_watch(libc: ctypes.CDLL, fd: int, path: str, mask: int) -> int:
+    """Add a watch. Returns the watch descriptor (>= 0), or -errno when the
+    path is unwatchable (ENOENT, ENOSPC watch limit, ...) — callers count
+    successes, decide whether zero watches is fatal, and keep the real
+    errno for the error they raise."""
+    wd = libc.inotify_add_watch(fd, path.encode(), mask)
+    return wd if wd >= 0 else -ctypes.get_errno()

@@ -15,6 +15,7 @@ from typing import Optional
 import jax
 
 from ..parallel.mesh import batch_sharding, make_mesh
+from ..utils import compilation_cache
 from ..utils.profiling import trace
 from .checkpointing import TrainCheckpointer
 from .model import ModelConfig
@@ -47,6 +48,7 @@ def run_training(
     profile_dir = profile_dir or os.environ.get(
         "TPU_WORKLOAD_PROFILE_DIR", ""
     )
+    compilation_cache.maybe_enable()
     cfg = cfg or ModelConfig()
     mesh = mesh if mesh is not None else make_mesh()
     params, opt_state, tx = train.make_train_state(

@@ -80,8 +80,33 @@ class ModelConfig:
     # ``pipe`` axis. Requires scan_layers.
     pipeline_microbatches: int = 0
     pipe_mesh: Any = None
+    # Incremental decoding: the model consumes ONE position per call
+    # (tokens [batch, 1]) and attends over a K/V cache carried in the flax
+    # "cache" collection (workload/generate.py drives it). Per-token cost
+    # becomes O(seq·d) instead of a full O(seq²·d) forward.
+    decode: bool = False
+
+    def decode_supported(self) -> bool:
+        """Whether this config has a decode-mode (KV cache) equivalent:
+        the plain dense attention path only. MoE is excluded because its
+        capacity-based dispatch depends on sequence length — a 1-token
+        decode step has no cross-token slot competition, so it would
+        silently diverge from the full forward whenever a token
+        overflows."""
+        return not (
+            self.scan_layers
+            or self.use_ring_attention
+            or self.use_flash_attention
+            or self.pipeline_microbatches > 0
+            or self.n_experts > 0
+        )
 
     def __post_init__(self):
+        if self.decode and not self.decode_supported():
+            raise ValueError(
+                "decode mode supports the plain dense attention path only "
+                "(no scan_layers/ring/flash/pipeline/MoE)"
+            )
         if self.pipeline_microbatches > 0:
             if not self.scan_layers:
                 raise ValueError(
@@ -146,7 +171,9 @@ class Attention(nn.Module):
         q = jnp.einsum("bsd,dhk->bshk", x, wq.astype(cfg.dtype))
         k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(cfg.dtype))
         v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(cfg.dtype))
-        if cfg.use_ring_attention:
+        if cfg.decode:
+            out = self._decode_attend(q, k, v)
+        elif cfg.use_ring_attention:
             from ..parallel.ring import ring_attention
 
             if cfg.use_flash_attention:
@@ -181,6 +208,44 @@ class Attention(nn.Module):
             ).astype(cfg.dtype)
             out = jnp.einsum("bhst,bthk->bshk", probs, v)
         return jnp.einsum("bshk,hkd->bsd", out, wo.astype(cfg.dtype))
+
+    def _decode_attend(self, q, k, v):
+        """One-position attention over the K/V cache (q/k/v: [b,1,h,kd]).
+
+        The cache buffers are static [b, max_seq_len, h, kd]; the current
+        position comes from the per-layer cache index, new K/V is written
+        there, and attention masks every slot past it — static shapes, no
+        recompilation per step.
+        """
+        cfg = self.cfg
+        b, _, h, kd = q.shape
+        s = cfg.max_seq_len
+        cache_k = self.variable(
+            "cache", "k", jnp.zeros, (b, s, h, kd), cfg.dtype
+        )
+        cache_v = self.variable(
+            "cache", "v", jnp.zeros, (b, s, h, kd), cfg.dtype
+        )
+        index = self.variable(
+            "cache", "index", lambda: jnp.zeros((), jnp.int32)
+        )
+        i = index.value
+        cache_k.value = jax.lax.dynamic_update_slice(
+            cache_k.value, k, (0, i, 0, 0)
+        )
+        cache_v.value = jax.lax.dynamic_update_slice(
+            cache_v.value, v, (0, i, 0, 0)
+        )
+        index.value = i + 1
+        scores = jnp.einsum(
+            "bqhk,bthk->bhqt", q, cache_k.value
+        ) / jnp.sqrt(jnp.asarray(kd, cfg.dtype))
+        valid = jnp.arange(s)[None, None, None, :] <= i
+        scores = jnp.where(valid, scores, -1e9)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+            cfg.dtype
+        )
+        return jnp.einsum("bhqt,bthk->bqhk", probs, cache_v.value)
 
 
 class Mlp(nn.Module):
@@ -260,7 +325,27 @@ class TransformerLM(nn.Module):
             (cfg.max_seq_len, cfg.d_model), jnp.float32,
             axes=("seq", "embed"),
         )
-        x = embed_tokens(cfg, embed, pos, tokens)
+        if cfg.decode:
+            # One position per call: its absolute index is the top-level
+            # cache counter (the per-layer attention indices advance in
+            # lockstep with it).
+            if tokens.shape[1] != 1:
+                raise ValueError(
+                    f"decode mode consumes one position per call, got "
+                    f"tokens {tokens.shape}; the cache index only "
+                    f"advances by 1"
+                )
+            pos_idx = self.variable(
+                "cache", "pos_idx", lambda: jnp.zeros((), jnp.int32)
+            )
+            i = pos_idx.value
+            pos_idx.value = i + 1
+            x = (
+                embed[tokens]
+                + jax.lax.dynamic_slice_in_dim(pos, i, 1, axis=0)[None]
+            ).astype(cfg.dtype)
+        else:
+            x = embed_tokens(cfg, embed, pos, tokens)
         if cfg.scan_layers:
             # One compiled block body, params stacked on a leading "layers"
             # logical axis (→ mesh pipe axis). The pipelined *schedule* runs

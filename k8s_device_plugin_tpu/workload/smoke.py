@@ -44,6 +44,9 @@ def run_smoke(
     batch_per_device: int = 8,
     seed: int = 0,
 ) -> dict:
+    from ..utils import compilation_cache
+
+    compilation_cache.maybe_enable()
     t0 = time.monotonic()
     devices = jax.devices()
     t_devices = time.monotonic() - t0

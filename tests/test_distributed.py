@@ -41,10 +41,15 @@ def test_slice_env_parsing():
     assert env.coordinator_address == "host-a:9000"
 
 
-def test_slice_env_defaults():
-    env = slice_env({"TPU_WORKER_HOSTNAMES": "a,b"})
+def test_slice_env_defaults_single_host():
+    env = slice_env({"TPU_WORKER_HOSTNAMES": "a"})
     assert env.worker_id == 0
     assert env.coordinator_port == DEFAULT_COORDINATOR_PORT
+
+
+def test_slice_env_missing_worker_id_multi_host_raises():
+    with pytest.raises(ValueError, match="unset"):
+        slice_env({"TPU_WORKER_HOSTNAMES": "a,b"})
 
 
 def test_slice_env_bad_worker_id():
@@ -146,5 +151,9 @@ def test_slice_env_unparseable_values_raise():
         slice_env({"TPU_WORKER_HOSTNAMES": "a,b", "TPU_WORKER_ID": "w1"})
     with pytest.raises(ValueError, match="TPU_COORDINATOR_PORT"):
         slice_env(
-            {"TPU_WORKER_HOSTNAMES": "a,b", "TPU_COORDINATOR_PORT": "x"}
+            {
+                "TPU_WORKER_HOSTNAMES": "a,b",
+                "TPU_WORKER_ID": "0",
+                "TPU_COORDINATOR_PORT": "x",
+            }
         )

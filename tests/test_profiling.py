@@ -121,3 +121,35 @@ def test_loop_profile_dir(tmp_path):
         mesh=make_mesh(jax.devices()[:1]), profile_dir=d,
     )
     assert os.path.isdir(d)
+
+
+def test_compilation_cache_opt_in(tmp_path, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_device_plugin_tpu.utils import compilation_cache
+
+    monkeypatch.delenv(compilation_cache.ENV_VAR, raising=False)
+    assert compilation_cache.maybe_enable() is False
+
+    saved = {
+        name: jax.config.read(name)
+        for name in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+        )
+    }
+    d = str(tmp_path / "xla-cache")
+    try:
+        assert compilation_cache.maybe_enable(d) is True
+        # A fresh jitted program must land in the cache directory.
+        jax.jit(lambda x: x * 2 + jnp.float32(41))(
+            jnp.arange(7, dtype=jnp.float32)
+        ).block_until_ready()
+        assert any(os.scandir(d)), "no compilation cache entries written"
+    finally:
+        # The cache config is process-global; restore it so later tests
+        # don't read/write executables from this test's tmp dir.
+        for name, value in saved.items():
+            jax.config.update(name, value)
