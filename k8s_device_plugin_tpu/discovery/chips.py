@@ -44,15 +44,26 @@ class AcceleratorSpec:
     torus: bool
     hbm_bytes: int
     cores_per_chip: int
+    # Per-chip dense bf16 peak (Google-published per-generation numbers);
+    # the MFU denominator for the workload bench. 0 = unknown generation.
+    peak_flops_bf16: float = 0.0
 
+
+TFLOPS = 1e12
 
 ACCELERATOR_SPECS = {
-    "v2": AcceleratorSpec("v2", 4, (2, 2, 1), False, 8 * GIB, 2),
-    "v3": AcceleratorSpec("v3", 4, (2, 2, 1), False, 16 * GIB, 2),
-    "v4": AcceleratorSpec("v4", 4, (2, 2, 1), True, 32 * GIB, 2),
-    "v5e": AcceleratorSpec("v5e", 8, (2, 4, 1), False, 16 * GIB, 1),
-    "v5p": AcceleratorSpec("v5p", 4, (2, 2, 1), True, 95 * GIB, 2),
-    "v6e": AcceleratorSpec("v6e", 8, (2, 4, 1), False, 32 * GIB, 1),
+    "v2": AcceleratorSpec("v2", 4, (2, 2, 1), False, 8 * GIB, 2,
+                          46 * TFLOPS),
+    "v3": AcceleratorSpec("v3", 4, (2, 2, 1), False, 16 * GIB, 2,
+                          123 * TFLOPS),
+    "v4": AcceleratorSpec("v4", 4, (2, 2, 1), True, 32 * GIB, 2,
+                          275 * TFLOPS),
+    "v5e": AcceleratorSpec("v5e", 8, (2, 4, 1), False, 16 * GIB, 1,
+                           197 * TFLOPS),
+    "v5p": AcceleratorSpec("v5p", 4, (2, 2, 1), True, 95 * GIB, 2,
+                           459 * TFLOPS),
+    "v6e": AcceleratorSpec("v6e", 8, (2, 4, 1), False, 32 * GIB, 1,
+                           918 * TFLOPS),
 }
 
 

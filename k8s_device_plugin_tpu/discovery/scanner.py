@@ -242,6 +242,11 @@ def _pci_addr(devdir: str) -> str:
 class PyTpuInfo:
     """Pure-Python scanner, result-identical to NativeTpuInfo."""
 
+    def __init__(self) -> None:
+        # fd → (sysfs class dir, watched attribute roots) for hot-add
+        # watch refresh (_refresh_watches).
+        self._ev_state: dict = {}
+
     def version(self) -> str:
         return "tpuinfo-py 0.1.0"
 
@@ -380,11 +385,13 @@ class PyTpuInfo:
         except OSError:
             pass
         watches = 0
+        watched = set()
         for root in mutation_roots:
             if root and inotify.add_watch(
                 libc, fd, root, inotify.MUTATION_MASK
             ) >= 0:
                 watches += 1
+                watched.add(root)
         if dev_dir and inotify.add_watch(
             libc, fd, dev_dir, inotify.PRESENCE_MASK
         ) >= 0:
@@ -393,7 +400,32 @@ class PyTpuInfo:
             os.close(fd)
             raise OSError(2, "no watchable health roots")
         self._libc = libc
+        self._ev_state[fd] = (sysfs_accel_dir, watched)
         return fd
+
+    def _refresh_watches(self, fd: int) -> None:
+        """Watch attribute dirs of chips hot-added after open — a presence
+        event on the class dir wakes the waiter, but the new chip's own
+        attribute writes would otherwise never fire (the native shim shares
+        this gap; there the interval sweep is the backstop)."""
+        from ..utils import inotify
+
+        state = self._ev_state.get(fd)
+        if state is None:
+            return
+        sysfs_accel_dir, watched = state
+        try:
+            names = sorted(os.listdir(sysfs_accel_dir))
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith("accel"):
+                continue
+            root = os.path.join(sysfs_accel_dir, name, "device")
+            if root not in watched and inotify.add_watch(
+                self._libc, fd, root, inotify.MUTATION_MASK
+            ) >= 0:
+                watched.add(root)
 
     def health_events_wait(self, fd: int, timeout_ms: int) -> bool:
         import select
@@ -406,9 +438,11 @@ class PyTpuInfo:
                 pass
         except BlockingIOError:
             pass
+        self._refresh_watches(fd)
         return True
 
     def health_events_close(self, fd: int) -> None:
+        self._ev_state.pop(fd, None)
         try:
             os.close(fd)
         except OSError:

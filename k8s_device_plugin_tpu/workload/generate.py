@@ -194,6 +194,10 @@ def run_generation_smoke(
             jnp.array_equal(tokens[:, :prompt_len], prompt)
         ),
         "flash_attention": cfg.use_flash_attention,
+        # Stable schema: always present. None means "no KV-decode path to
+        # judge against" (flash/ring/MoE configs); the KV branch below
+        # overwrites it with the real verdict.
+        "ok": None,
     }
     if kv_decode_supported(cfg):
         # KV-decoder correctness signal: compare the *logits* both paths

@@ -78,7 +78,11 @@ def run_training(
                 losses.append(float(loss))
                 if ckpt is not None:
                     ckpt.maybe_save(step, params, opt_state)
-        if ckpt is not None and losses:
+        if ckpt is not None and losses and ckpt.latest_step() != step:
+            # Skip when maybe_save already wrote this step (final step on a
+            # save_every boundary) — re-saving would rely on orbax's
+            # version-specific should_save=False skip and can raise
+            # StepAlreadyExistsError elsewhere.
             ckpt.save(step, params, opt_state)
     finally:
         # Always flush + close (zero-step resumes, exceptions mid-loop):

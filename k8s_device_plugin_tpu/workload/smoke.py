@@ -38,6 +38,32 @@ def expected_chip_count() -> Optional[int]:
     return len([c for c in raw.split(",") if c != ""])
 
 
+def peak_flops_for(
+    device_kind: str, n_devices: int, platform: str = "tpu"
+) -> float:
+    """Aggregate dense-bf16 peak of the attached devices (MFU denominator).
+
+    device_kind strings look like "TPU v5e" / "TPU v5 lite" / "TPU v4";
+    map them through the same chip-type parser the discovery path uses.
+    When the kind string doesn't parse but the backend IS an accelerator
+    (tunneled PJRT plugins report opaque kinds), fall back to the host's
+    generation env vars. 0.0 when the generation is unknown or the
+    platform is cpu (test runs) — callers must treat that as "MFU
+    unavailable", never divide by it.
+    """
+    from ..discovery.chips import parse_gke_accelerator_label, spec_for
+
+    chip_type = parse_gke_accelerator_label(device_kind.replace(" ", ""))
+    if chip_type is None and platform != "cpu":
+        chip_type = parse_gke_accelerator_label(
+            os.environ.get("PALLAS_AXON_TPU_GEN", "")
+            or os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        )
+    if chip_type is None:
+        return 0.0
+    return spec_for(chip_type).peak_flops_bf16 * n_devices
+
+
 def run_smoke(
     steps: int = 20,
     cfg: Optional[ModelConfig] = None,
@@ -82,6 +108,14 @@ def run_smoke(
     elapsed = time.monotonic() - t2
     step_time = elapsed / max(steps, 1)
 
+    flops_step = cfg.train_flops_per_step(batch)
+    peak = peak_flops_for(
+        devices[0].device_kind if devices else "",
+        len(devices),
+        jax.default_backend(),
+    )
+    mfu = (flops_step / step_time / peak) if peak > 0 else None
+
     return {
         "backend": jax.default_backend(),
         "devices": len(devices),
@@ -93,6 +127,9 @@ def run_smoke(
         "time_to_first_step_s": round(t_first_step, 3),
         "step_time_s": round(step_time, 5),
         "tokens_per_s": round(batch * cfg.max_seq_len / step_time, 1),
+        "model_flops_per_step": flops_step,
+        "peak_flops_bf16": peak,
+        "mfu": round(mfu, 4) if mfu is not None else None,
         "first_loss": round(first_loss, 4),
         "final_loss": round(loss, 4),
         "loss_decreased": loss < first_loss,
@@ -102,8 +139,22 @@ def run_smoke(
     }
 
 
-def main() -> int:
-    report = run_smoke()
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch-per-device", type=int, default=8)
+    p.add_argument(
+        "--bench", action="store_true",
+        help="use the MXU-stressing ModelConfig.bench() shape",
+    )
+    args = p.parse_args(argv)
+    report = run_smoke(
+        steps=args.steps,
+        cfg=ModelConfig.bench() if args.bench else None,
+        batch_per_device=args.batch_per_device,
+    )
     print(json.dumps(report))
     return 0 if report["ok"] else 1
 

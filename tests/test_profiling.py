@@ -132,8 +132,11 @@ def test_compilation_cache_opt_in(tmp_path, monkeypatch):
     monkeypatch.delenv(compilation_cache.ENV_VAR, raising=False)
     assert compilation_cache.maybe_enable() is False
 
+    # getattr rather than jax.config.read: read() raises AttributeError for
+    # contextmanager-backed flags on this JAX version; the attribute access
+    # is the public, stable way to snapshot current values.
     saved = {
-        name: jax.config.read(name)
+        name: getattr(jax.config, name)
         for name in (
             "jax_compilation_cache_dir",
             "jax_persistent_cache_min_compile_time_secs",

@@ -111,6 +111,29 @@ def test_run_smoke_on_cpu_mesh():
     assert report["devices"] == 8
     assert report["loss_decreased"]
     assert report["tokens_per_s"] > 0
+    # CPU runs have no meaningful peak — MFU must be absent, not a lie.
+    assert report["mfu"] is None
+    assert report["model_flops_per_step"] > 0
+
+
+def test_mfu_accounting():
+    from k8s_device_plugin_tpu.workload.smoke import peak_flops_for
+
+    # Generation parse from jax device_kind strings, scaled by count.
+    assert peak_flops_for("TPU v5e", 1) == 197e12
+    assert peak_flops_for("TPU v5 lite", 2) == 2 * 197e12
+    assert peak_flops_for("TPU v4", 4) == 4 * 275e12
+    # cpu platform: no env fallback, no fake peak.
+    assert peak_flops_for("cpu", 8, platform="cpu") == 0.0
+
+    # Analytic FLOPs: the 6N rule dominates at bench scale — the total
+    # must sit between 6·N·tokens (projections only) and ~1.3× of it
+    # (attention scores at seq=2048 add <20%).
+    cfg = ModelConfig.bench()
+    tokens = 4 * cfg.max_seq_len
+    n = cfg.matmul_params()
+    total = cfg.train_flops_per_step(4)
+    assert 6 * n * tokens < total < 1.3 * 6 * n * tokens
 
 
 def test_graft_entry_compiles():
