@@ -39,5 +39,14 @@ TOPOLOGY_ANNOTATION = "google.com/tpu-topology"
 POD_DEVICES_ANNOTATION = "google.com/tpu-devices"
 
 # Env var understood the same way as the reference's DP_DISABLE_HEALTHCHECKS
-# (/root/reference/server.go:32-33): "all" disables health watching.
+# (/root/reference/server.go:32-33,231-242): a comma-separated list of
+# check classes to disable. Classes: "all", "events" (inotify fast path;
+# "xids" — the reference's spelling of its event class — is an alias),
+# "interval" (periodic sweeps). See health/watcher.py.
 ENV_DISABLE_HEALTHCHECKS = "DP_DISABLE_HEALTHCHECKS"
+
+# Override of the app-level fault-reason skip list (the analog of the
+# reference's hardcoded XID 31/43/45 skip, /root/reference/nvidia.go:84-86).
+# Comma-separated reason tokens; see health/watcher.py
+# DEFAULT_APP_FAULT_REASONS for the default.
+ENV_APP_FAULT_REASONS = "DP_APP_FAULT_REASONS"

@@ -91,6 +91,17 @@ class NativeTpuInfo:
         self._lib.tpuinfo_chip_health.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
         ]
+        # Reasoned health is newer than tpuinfo_chip_health; a stale .so
+        # degrades to the unreasoned probe (reason ""), same as events below.
+        try:
+            self._lib.tpuinfo_chip_health_reason.restype = ctypes.c_int
+            self._lib.tpuinfo_chip_health_reason.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_int,
+            ]
+            self._has_health_reason = True
+        except AttributeError:
+            self._has_health_reason = False
         self._lib.tpuinfo_numa_node_count.restype = ctypes.c_int
         self._lib.tpuinfo_numa_node_count.argtypes = [ctypes.c_char_p]
         self._lib.tpuinfo_numa_topology.restype = ctypes.c_int
@@ -158,6 +169,22 @@ class NativeTpuInfo:
         if r < 0:
             raise OSError(-r, f"tpuinfo_chip_health(accel{index}) failed")
         return bool(r)
+
+    def chip_health_detail(
+        self, sysfs_accel_dir: str, dev_dir: str, index: int
+    ) -> "tuple[bool, str]":
+        """(healthy, fault reason) — reason is a normalized token ("" when
+        healthy) so the watcher can discriminate app-level from hardware
+        faults (the reference's XID-number read, nvidia.go:84-86)."""
+        if not self._has_health_reason:
+            return self.chip_health(sysfs_accel_dir, dev_dir, index), ""
+        buf = ctypes.create_string_buffer(64)
+        r = self._lib.tpuinfo_chip_health_reason(
+            sysfs_accel_dir.encode(), dev_dir.encode(), index, buf, len(buf)
+        )
+        if r < 0:
+            raise OSError(-r, f"tpuinfo_chip_health_reason(accel{index}) failed")
+        return bool(r), buf.value.decode()
 
     def numa_node_count(self, nodes_dir: str = DEFAULT_NUMA_DIR) -> int:
         r = self._lib.tpuinfo_numa_node_count(nodes_dir.encode())
@@ -227,6 +254,40 @@ def _read_int(path: str, default: int) -> int:
         return default
 
 
+# Mirrors TPUINFO_REASON_LEN - 1 (native snprintf truncation) so both
+# backends return identical tokens for oversized health values.
+_REASON_MAX = 63
+
+
+def _read_bytes_trimmed(path: str) -> bytes:
+    """Raw-byte read: a failing chip can write arbitrary bytes into its
+    health attribute, and a strict text decode would raise right when the
+    watcher most needs to classify the fault."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return b""
+    return data.strip()
+
+
+def _normalize_reason(raw: bytes) -> str:
+    """Fault token normalization, byte-identical to the native shim's
+    NormalizeReason (tpuinfo.cc): per BYTE (so each byte of a multi-byte
+    UTF-8 sequence becomes its own '_' on both backends), ASCII alnum
+    lowercased, everything else → '_', truncated like the native
+    TPUINFO_REASON_LEN buffer."""
+    out = []
+    for b in raw[:_REASON_MAX]:
+        if 0x30 <= b <= 0x39 or 0x61 <= b <= 0x7A:  # 0-9 a-z
+            out.append(chr(b))
+        elif 0x41 <= b <= 0x5A:  # A-Z
+            out.append(chr(b + 0x20))
+        else:
+            out.append("_")
+    return "".join(out)
+
+
 def _pci_addr(devdir: str) -> str:
     uevent = _read_trimmed(os.path.join(devdir, "uevent"))
     for line in uevent.splitlines():
@@ -287,18 +348,29 @@ class PyTpuInfo:
         return chips
 
     def chip_health(self, sysfs_accel_dir: str, dev_dir: str, index: int) -> bool:
+        return self.chip_health_detail(sysfs_accel_dir, dev_dir, index)[0]
+
+    def chip_health_detail(
+        self, sysfs_accel_dir: str, dev_dir: str, index: int
+    ) -> "tuple[bool, str]":
+        """(healthy, fault reason) — reason tokens are byte-identical to
+        the native backend's (normalized lowercase [a-z0-9_]); see
+        tpuinfo_chip_health_reason in native/tpuinfo/tpuinfo.h."""
         base = os.path.join(sysfs_accel_dir, f"accel{index}")
         if not os.path.exists(base):
             raise FileNotFoundError(base)
         if not os.path.exists(os.path.join(dev_dir, f"accel{index}")):
-            return False
+            return False, "dev_node_missing"
         enable = os.path.join(base, "device", "enable")
         if os.path.exists(enable) and _read_int(enable, 1) == 0:
-            return False
+            return False, "pci_disabled"
         health = os.path.join(base, "device", "health")
         if os.path.exists(health):
-            return _read_trimmed(health).lower() in ("ok", "healthy", "1")
-        return True
+            token = _read_bytes_trimmed(health)
+            if token.lower() in (b"ok", b"healthy", b"1"):
+                return True, ""
+            return False, _normalize_reason(token)
+        return True, ""
 
     def numa_node_count(self, nodes_dir: str = DEFAULT_NUMA_DIR) -> int:
         try:

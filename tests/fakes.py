@@ -46,10 +46,17 @@ def make_fake_tpu_node(
     return accel_dir, dev_dir
 
 
-def set_chip_health(accel_dir: str, index: int, healthy: bool):
-    """Flip the fault-injection health attribute for chip `index`."""
+def set_chip_health(
+    accel_dir: str, index: int, healthy: bool, reason: str = "failed"
+):
+    """Flip the fault-injection health attribute for chip `index`.
+
+    `reason` is the fault token written when unhealthy — hardware-grade by
+    default; pass an app-level token (e.g. "app_error") to exercise the
+    fault-classification skip path.
+    """
     devdir = os.path.join(accel_dir, f"accel{index}", "device")
-    _write(devdir, "health", "ok" if healthy else "failed")
+    _write(devdir, "health", "ok" if healthy else reason)
 
 
 def remove_dev_node(dev_dir: str, index: int):

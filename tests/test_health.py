@@ -27,6 +27,17 @@ def node(tmp_path):
     return accel, dev, chips
 
 
+def _backends():
+    from k8s_device_plugin_tpu.discovery.scanner import NativeTpuInfo
+
+    backends = [PyTpuInfo()]
+    try:
+        backends.append(NativeTpuInfo())
+    except OSError:
+        pass
+    return backends
+
+
 def test_watcher_reports_transitions_once(node):
     accel, dev, chips = node
     events = []
@@ -80,6 +91,173 @@ def test_healthchecks_disabled_env(monkeypatch, node):
     assert w._thread is None  # never started
     monkeypatch.setenv(constants.ENV_DISABLE_HEALTHCHECKS, "xids")
     assert not healthchecks_disabled()
+
+
+def test_disable_classes_parsing(monkeypatch):
+    from k8s_device_plugin_tpu.health.watcher import disabled_health_classes
+
+    monkeypatch.delenv(constants.ENV_DISABLE_HEALTHCHECKS, raising=False)
+    assert disabled_health_classes() == frozenset()
+    monkeypatch.setenv(
+        constants.ENV_DISABLE_HEALTHCHECKS, "events, interval"
+    )
+    assert disabled_health_classes() == {"events", "interval"}
+    # "xids" is the reference's spelling for its event class
+    # (/root/reference/server.go:231-242): accepted as an alias.
+    monkeypatch.setenv(constants.ENV_DISABLE_HEALTHCHECKS, "xids")
+    assert "events" in disabled_health_classes()
+    assert not healthchecks_disabled()
+
+
+def test_events_class_disabled_never_opens_event_source(monkeypatch, node):
+    accel, dev, chips = node
+    monkeypatch.setenv(constants.ENV_DISABLE_HEALTHCHECKS, "events")
+
+    class NoEventsAllowed(PyTpuInfo):
+        def health_events_open(self, *a):
+            raise AssertionError("event source opened despite 'events' class")
+
+    got = threading.Event()
+    events = []
+
+    def cb(cid, healthy):
+        events.append((cid, healthy))
+        got.set()
+
+    w = HealthWatcher(NoEventsAllowed(), accel, dev, chips, cb,
+                      interval_s=0.05)
+    w.start()
+    try:
+        fakes.set_chip_health(accel, 0, False)
+        assert got.wait(5), "interval polling should still report"
+        assert events[0] == (chips[0].device_id_str, False)
+    finally:
+        w.stop()
+
+
+def test_interval_class_disabled_event_driven_only(monkeypatch, node):
+    accel, dev, chips = node
+    monkeypatch.setenv(constants.ENV_DISABLE_HEALTHCHECKS, "interval")
+    got = threading.Event()
+    events = []
+
+    def cb(cid, healthy):
+        events.append((cid, healthy))
+        got.set()
+
+    w = HealthWatcher(PyTpuInfo(), accel, dev, chips, cb, interval_s=0.2)
+    w.start()
+    try:
+        import time
+
+        time.sleep(0.4)  # past several intervals: no sweep should run
+        fakes.set_chip_health(accel, 2, False)
+        assert got.wait(5), "event-driven sweep should report the flip"
+        assert events == [(chips[2].device_id_str, False)]
+    finally:
+        w.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fault classification (the XID 31/43/45 skip analog, nvidia.go:84-86)
+# ---------------------------------------------------------------------------
+
+def test_app_level_fault_not_marked_unhealthy(node):
+    accel, dev, chips = node
+    events = []
+    w = HealthWatcher(
+        PyTpuInfo(), accel, dev, chips, lambda cid, h: events.append((cid, h))
+    )
+    fakes.set_chip_health(accel, 0, False, reason="app_error")
+    w.poll_once()
+    assert events == []  # app fault: chip stays advertised Healthy
+    fakes.set_chip_health(accel, 0, False, reason="preempted")
+    w.poll_once()
+    assert events == []
+    # The same chip then hits a hardware fault: now it goes Unhealthy.
+    fakes.set_chip_health(accel, 0, False, reason="hbm_ecc")
+    w.poll_once()
+    assert events == [(chips[0].device_id_str, False)]
+    fakes.set_chip_health(accel, 0, True)
+    w.poll_once()
+    assert events[-1] == (chips[0].device_id_str, True)
+
+
+def test_hardware_fault_classes_marked_unhealthy(node):
+    accel, dev, chips = node
+    events = []
+    w = HealthWatcher(
+        PyTpuInfo(), accel, dev, chips, lambda cid, h: events.append((cid, h))
+    )
+    fakes.set_chip_health(accel, 1, False, reason="ici_link_down")
+    fakes.remove_dev_node(dev, 2)
+    w.poll_once()
+    assert sorted(events) == sorted(
+        [(chips[1].device_id_str, False), (chips[2].device_id_str, False)]
+    )
+
+
+def test_app_fault_reasons_env_override(monkeypatch, node):
+    accel, dev, chips = node
+    monkeypatch.setenv(constants.ENV_APP_FAULT_REASONS, "flaky_driver")
+    events = []
+    w = HealthWatcher(
+        PyTpuInfo(), accel, dev, chips, lambda cid, h: events.append((cid, h))
+    )
+    fakes.set_chip_health(accel, 0, False, reason="flaky_driver")
+    w.poll_once()
+    assert events == []  # overridden skip list applies
+    # The default app-level tokens are NOT skipped once overridden.
+    fakes.set_chip_health(accel, 1, False, reason="app_error")
+    w.poll_once()
+    assert events == [(chips[1].device_id_str, False)]
+
+
+@pytest.mark.parametrize(
+    "backend", _backends(), ids=lambda b: type(b).__name__
+)
+def test_chip_health_detail_backend_parity(node, backend):
+    accel, dev, chips = node
+    assert backend.chip_health_detail(accel, dev, 0) == (True, "")
+    with open(f"{accel}/accel0/device/health", "w") as f:
+        f.write("HBM ECC uncorrectable!\n")
+    assert backend.chip_health_detail(accel, dev, 0) == (
+        False, "hbm_ecc_uncorrectable_"
+    )
+    fakes.remove_dev_node(dev, 1)
+    assert backend.chip_health_detail(accel, dev, 1) == (
+        False, "dev_node_missing"
+    )
+    with open(f"{accel}/accel2/device/enable", "w") as f:
+        f.write("0\n")
+    assert backend.chip_health_detail(accel, dev, 2) == (
+        False, "pci_disabled"
+    )
+    with pytest.raises(OSError):
+        backend.chip_health_detail(accel, dev, 9)
+
+
+@pytest.mark.parametrize(
+    "backend", _backends(), ids=lambda b: type(b).__name__
+)
+def test_chip_health_detail_hostile_bytes_parity(node, backend):
+    """A failing chip can write arbitrary bytes into its health attribute;
+    both backends must classify (not crash) and agree byte-for-byte —
+    non-UTF-8 garbage, a Unicode char whose str.lower() would cross into
+    ASCII (K, the Kelvin sign), and an oversized token (native truncates
+    at TPUINFO_REASON_LEN-1; Python mirrors it)."""
+    accel, dev, chips = node
+    with open(f"{accel}/accel0/device/health", "wb") as f:
+        f.write(b"\xfc\xfcFault 31\n")
+    assert backend.chip_health_detail(accel, dev, 0) == (
+        False, "__fault_31"
+    )
+    with open(f"{accel}/accel1/device/health", "wb") as f:
+        f.write("K\n".encode())  # Kelvin sign: 3 UTF-8 bytes
+    assert backend.chip_health_detail(accel, dev, 1) == (False, "___")
+    with open(f"{accel}/accel2/device/health", "wb") as f:
+        f.write(b"x" * 100 + b"\n")
+    assert backend.chip_health_detail(accel, dev, 2) == (False, "x" * 63)
 
 
 def test_end_to_end_sysfs_to_listandwatch(tmp_path, node):
@@ -137,17 +315,6 @@ def test_end_to_end_sysfs_to_listandwatch(tmp_path, node):
 # ---------------------------------------------------------------------------
 # Event-driven health (tpuinfo_health_events_*, the NVML EventSet analog)
 # ---------------------------------------------------------------------------
-
-def _backends():
-    from k8s_device_plugin_tpu.discovery.scanner import NativeTpuInfo
-
-    backends = [PyTpuInfo()]
-    try:
-        backends.append(NativeTpuInfo())
-    except OSError:
-        pass
-    return backends
-
 
 @pytest.mark.parametrize(
     "backend", _backends(), ids=lambda b: type(b).__name__

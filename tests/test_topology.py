@@ -173,6 +173,19 @@ def test_select_respects_unhealthy():
     assert len(st.select(4)) == 4
 
 
+def test_select_filters_unhealthy_from_caller_pool():
+    # The kubelet's available pool lags the plugin's health view by one
+    # ListAndWatch round trip: a chip the plugin knows is unhealthy must
+    # never be picked even when the caller's pool offers it.
+    m = mesh_of("v5p", 4)
+    st = PlacementState(m)
+    bad = m.ids[0]
+    st.set_health(bad, healthy=False)
+    got = st.select(2, available=list(m.ids))
+    assert len(got) == 2 and bad not in got
+    assert st.select(4, available=list(m.ids)) == []
+
+
 def test_select_with_available_pool_and_must_include():
     m = mesh_of("v5e", 8)
     st = PlacementState(m)
