@@ -34,13 +34,18 @@ def publish_node_topology(
     retries: int = 3,
     available=None,
     numa_info=None,
+    worker_id: int = 0,
+    worker_hostnames: str = "",
+    slice_host_bounds: str = "1,1,1",
 ) -> NodeTopology:
     """Publish the ICI topology as a node annotation, retrying on conflict
     like the reference's patchNode loop (/root/reference/server.go:312-347).
     Also sets a scheduler-friendly label with the mesh shape."""
     topo = NodeTopology.from_mesh(
         mesh, numa_nodes=numa_nodes, hostname=node_name, available=available,
-        numa_info=numa_info,
+        numa_info=numa_info, worker_id=worker_id,
+        worker_hostnames=worker_hostnames,
+        slice_host_bounds=slice_host_bounds,
     )
     shape = "x".join(str(b) for b in mesh.bounds)
     last: Optional[Exception] = None
@@ -84,6 +89,9 @@ class TopologyPublisher:
         numa_nodes: int = 1,
         debounce_s: float = 0.3,
         numa_info=None,
+        worker_id: int = 0,
+        worker_hostnames: str = "",
+        slice_host_bounds: str = "1,1,1",
     ):
         self.client = client
         self.node_name = node_name
@@ -91,6 +99,9 @@ class TopologyPublisher:
         self.numa_nodes = numa_nodes
         self.debounce_s = debounce_s
         self.numa_info = numa_info
+        self.worker_id = worker_id
+        self.worker_hostnames = worker_hostnames
+        self.slice_host_bounds = slice_host_bounds
         self._dirty = threading.Event()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -116,6 +127,9 @@ class TopologyPublisher:
             numa_nodes=self.numa_nodes,
             available=self.plugin.state.available(),
             numa_info=self.numa_info,
+            worker_id=self.worker_id,
+            worker_hostnames=self.worker_hostnames,
+            slice_host_bounds=self.slice_host_bounds,
         )
 
     def _run(self) -> None:
@@ -131,9 +145,37 @@ class TopologyPublisher:
                 log.warning("topology republish failed: %s", e)
 
 
-def start_kube_integration(daemon, mesh: IciMesh) -> Tuple[Controller, KubeClient]:
+def maybe_derive_slice_config(client: KubeClient, cfg, mesh: IciMesh) -> None:
+    """Fill cfg's slice membership from GKE node labels when the flags
+    didn't set it (kube/gke.py). Mutates cfg in place; never overrides
+    explicit flags. MUST run before the plugin is constructed/served —
+    Allocate exports these to containers (server/plugin.py _tpu_env), so
+    deriving after serve would race the kubelet's first Allocate."""
+    if cfg.worker_hostnames or not mesh.mesh_chips:
+        return
+    from ..kube.gke import derive_slice_membership
+
+    node_name = cfg.node_name or os.uname().nodename
+    derived = derive_slice_membership(client, node_name, mesh.bounds)
+    if derived is not None:
+        log.info(
+            "slice membership from GKE labels: worker %d of %s "
+            "(host grid %s)",
+            derived.worker_id,
+            derived.worker_hostnames,
+            derived.slice_host_bounds,
+        )
+        cfg.worker_id = derived.worker_id
+        cfg.worker_hostnames = derived.worker_hostnames
+        cfg.slice_host_bounds = derived.slice_host_bounds
+
+
+def start_kube_integration(
+    daemon, mesh: IciMesh, client: Optional[KubeClient] = None
+) -> Tuple[Controller, KubeClient]:
     cfg = daemon.cfg
-    client = KubeClient.from_env(cfg.kubeconfig)
+    if client is None:
+        client = KubeClient.from_env(cfg.kubeconfig)
     node_name = cfg.node_name or os.uname().nodename
     numa = 1
     numa_info = []
@@ -144,7 +186,9 @@ def start_kube_integration(daemon, mesh: IciMesh) -> Tuple[Controller, KubeClien
         pass
     publisher = TopologyPublisher(
         client, node_name, daemon.plugin, numa_nodes=numa,
-        numa_info=numa_info,
+        numa_info=numa_info, worker_id=cfg.worker_id,
+        worker_hostnames=cfg.worker_hostnames,
+        slice_host_bounds=cfg.slice_host_bounds,
     )
     publisher.start()
     daemon.plugin.on_availability_change = publisher.trigger

@@ -31,23 +31,15 @@ from http.server import BaseHTTPRequestHandler
 from typing import Dict, List, Optional, Tuple
 
 from ..api import constants
-from ..topology.placement import PlacementState, _box_shapes
+from ..topology.placement import PlacementState, ideal_box_links
 from ..topology.schema import NodeTopology
+from ..topology.slice import SliceView, group_by_slice
 from ..utils.httpserver import BackgroundHTTPServer
 from ..utils.podresources import tpu_request
 
 log = logging.getLogger(__name__)
 
 MAX_SCORE = 10
-
-
-def _ideal_internal_links(n: int) -> int:
-    """Internal ICI links of the most compact n-chip box (unconstrained)."""
-    shapes = _box_shapes(n, (n, n, n))
-    if not shapes:
-        return max(n - 1, 1)
-    a, b, c = shapes[0]
-    return (a - 1) * b * c + a * (b - 1) * c + a * b * (c - 1)
 
 
 class TopologyExtender:
@@ -76,14 +68,23 @@ class TopologyExtender:
     # -- filter ------------------------------------------------------------
 
     def filter(self, pod: dict, nodes: List[dict]) -> Tuple[List[dict], Dict[str, str]]:
-        """Returns (passing_nodes, failed{name: reason})."""
+        """Returns (passing_nodes, failed{name: reason}).
+
+        Multi-host requests (n > a node's chip count) are gang-evaluated
+        across the *whole candidate list*: the node must belong to a slice
+        in which enough whole-free member hosts (drawn from these
+        candidates) exist to serve the job over ICI. Box-ness of the gang
+        is a score concern (prioritize), not a filter concern."""
         n = tpu_request(pod, self.resource_name)
         if n <= 0:
             return nodes, {}
+        parsed = [(node, self._topology_of(node)) for node in nodes]
+        slice_views = self._slice_views(
+            [t for _, t in parsed if t is not None]
+        )
         passing, failed = [], {}
-        for node in nodes:
+        for node, topo in parsed:
             name = (node.get("metadata") or {}).get("name", "")
-            topo = self._topology_of(node)
             if topo is None:
                 failed[name] = "no TPU topology published"
                 continue
@@ -91,15 +92,11 @@ class TopologyExtender:
             if local <= 0:
                 failed[name] = "node reports 0 TPU chips"
                 continue
-            if n > topo.chip_count and n % topo.chip_count != 0:
-                failed[name] = (
-                    f"multi-host request of {n} not a multiple of host "
-                    f"size {topo.chip_count}"
-                )
-                continue
-            if n > topo.chip_count and len(topo.available) < topo.chip_count:
-                failed[name] = "multi-host slice needs the full host free"
-                continue
+            if n > topo.chip_count:
+                reason = self._multi_host_reason(n, topo, slice_views)
+                if reason:
+                    failed[name] = reason
+                    continue
             if len(topo.available) < local:
                 failed[name] = (
                     f"{len(topo.available)} chips available, {local} needed"
@@ -108,9 +105,56 @@ class TopologyExtender:
             passing.append(node)
         return passing, failed
 
+    def _slice_views(
+        self, topos: List[NodeTopology]
+    ) -> Dict[tuple, SliceView]:
+        """Slice key → SliceView over the candidate nodes' topologies."""
+        return {
+            key: SliceView(members)
+            for key, members in group_by_slice(topos).items()
+        }
+
+    def _multi_host_reason(
+        self, n: int, topo: NodeTopology, slice_views: Dict[tuple, SliceView]
+    ) -> str:
+        """Empty string when the node can serve an n-chip multi-host gang;
+        else the filter-failure reason."""
+        if n % topo.chip_count != 0:
+            return (
+                f"multi-host request of {n} not a multiple of host "
+                f"size {topo.chip_count}"
+            )
+        if len(topo.available) < topo.chip_count:
+            return "multi-host slice needs the full host free"
+        if len(topo.slice_hosts) <= 1:
+            return (
+                "node is not part of a multi-host slice (no ICI to peers; "
+                "a cross-host gang here would ride DCN)"
+            )
+        k = n // topo.chip_count
+        if k > len(topo.slice_hosts):
+            return (
+                f"slice has {len(topo.slice_hosts)} hosts, "
+                f"{k} needed"
+            )
+        view = slice_views.get(tuple(topo.slice_hosts))
+        if view is None or len(view.free_coords()) < k:
+            free = 0 if view is None else len(view.free_coords())
+            return (
+                f"slice has {free} whole-free candidate hosts, {k} needed"
+            )
+        return ""
+
     # -- prioritize --------------------------------------------------------
 
-    def score_node(self, n: int, topo: NodeTopology) -> int:
+    def score_node(
+        self,
+        n: int,
+        topo: NodeTopology,
+        slice_views: Optional[Dict[tuple, SliceView]] = None,
+    ) -> int:
+        if n > topo.chip_count > 0:
+            return self._score_multi_host(n, topo, slice_views or {})
         local = min(n, topo.chip_count)
         if local <= 0 or len(topo.available) < local:
             return 0
@@ -121,21 +165,51 @@ class TopologyExtender:
         if len(sel) < local:
             return 0
         links = mesh.internal_links(sel)
-        ideal = _ideal_internal_links(local)
+        ideal = ideal_box_links(local)
         base = round((MAX_SCORE - 2) * min(links / ideal, 1.0)) if ideal else 0
         packing_bonus = 2 if len(topo.available) == local else 0
         return min(base + packing_bonus, MAX_SCORE)
 
+    def _score_multi_host(
+        self, n: int, topo: NodeTopology, slice_views: Dict[tuple, SliceView]
+    ) -> int:
+        """Score = quality of the best ICI-adjacent host gang this node can
+        join: a gang forming a contiguous sub-box of the slice's host grid
+        scores by box compactness; a node that could only join a scattered
+        gang scores 0 (DCN-heavy collectives) — so mesh-adjacent host
+        pairs outrank non-adjacent ones (BASELINE config 3)."""
+        if n % topo.chip_count != 0 or len(topo.slice_hosts) <= 1:
+            return 0
+        view = slice_views.get(tuple(topo.slice_hosts))
+        if view is None:
+            return 0
+        return view.gang_score(
+            n // topo.chip_count, topo.hostname, max_score=MAX_SCORE
+        )
+
     def prioritize(self, pod: dict, nodes: List[dict]) -> List[dict]:
         n = tpu_request(pod, self.resource_name)
+        parsed = (
+            [(node, self._topology_of(node)) for node in nodes]
+            if n > 0
+            else [(node, None) for node in nodes]
+        )
+        topos = [t for _, t in parsed if t is not None]
+        # Slice views are only needed when some candidate would serve this
+        # request multi-host.
+        slice_views = (
+            self._slice_views(topos)
+            if any(n > t.chip_count > 0 for t in topos)
+            else {}
+        )
         out = []
-        for node in nodes:
+        for node, topo in parsed:
             name = (node.get("metadata") or {}).get("name", "")
-            if n <= 0:
-                out.append({"host": name, "score": 0})
-                continue
-            topo = self._topology_of(node)
-            score = self.score_node(n, topo) if topo else 0
+            score = (
+                self.score_node(n, topo, slice_views)
+                if n > 0 and topo is not None
+                else 0
+            )
             out.append({"host": name, "score": score})
         return out
 

@@ -169,9 +169,12 @@ class HealthWatcher:
                 log.error("health probe failed for %s: %s", cid, e)
                 healthy, reason = False, "probe_error"
             if not healthy and reason in self._app_reasons:
-                # Application-level fault: the chip hardware is fine; do
-                # not withdraw it from the kubelet (reference skips XIDs
-                # 31/43/45 the same way, nvidia.go:84-86).
+                # Application-level fault: skip the transition entirely —
+                # the reference's XID 31/43/45 'continue' (nvidia.go:84-86).
+                # Skipping (not asserting Healthy) matters: a chip already
+                # hardware-Unhealthy whose attribute later shows an
+                # app-class token must STAY withdrawn until a genuinely
+                # healthy probe.
                 if self._app_fault.get(cid) != reason:
                     self._app_fault[cid] = reason
                     log.info(
@@ -181,9 +184,8 @@ class HealthWatcher:
                         reason,
                     )
                     metrics.APP_FAULTS.inc(reason=reason)
-                healthy = True
-            else:
-                self._app_fault.pop(cid, None)
+                continue
+            self._app_fault.pop(cid, None)
             if healthy != self._last[cid]:
                 self._last[cid] = healthy
                 self._callback(cid, healthy)

@@ -151,6 +151,10 @@ class KubeClient:
     def get_node(self, name: str) -> dict:
         return self.get(f"/api/v1/nodes/{name}")
 
+    def list_nodes(self, label_selector: str = "") -> dict:
+        params = {"labelSelector": label_selector} if label_selector else None
+        return self.get("/api/v1/nodes", params=params)
+
     def patch_node_annotations(
         self, name: str, annotations: Dict[str, Optional[str]]
     ) -> dict:

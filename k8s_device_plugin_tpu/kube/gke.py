@@ -1,0 +1,168 @@
+"""GKE multi-host slice membership derived from node labels.
+
+The reference configures everything by flags/env (/root/reference/main.go:19-21);
+round-1 of this framework did the same for slice membership (--worker-id /
+--worker-hostnames / --slice-host-bounds), which means hand-configuring
+every node of a multi-host pool. On GKE the information is already on the
+node object:
+
+* ``cloud.google.com/gke-tpu-topology``   — the slice's CHIP topology
+  ("2x2x2", "4x8"), set by GKE on every TPU node of a multi-host pool;
+* ``cloud.google.com/gke-nodepool``       — the node pool name; all hosts
+  of one slice live in one dedicated pool (GKE multi-host semantics);
+* ``kubernetes.io/hostname``              — the TPU hostname peers use.
+
+Derivation: host grid = slice chip topology ÷ this host's chip bounds
+(dimension-wise; must divide exactly), peers = nodes in the same pool with
+the same topology label, worker id = this node's position among peers
+ordered by the GKE ``-w-<N>`` hostname suffix (falling back to hostname
+sort when the suffix convention is absent).
+
+Fallback contract: any ambiguity (labels missing, dimensions that don't
+divide, peer count not matching the host grid) returns None and the daemon
+keeps whatever the flags/env provided — derivation only ever *adds*
+configuration, it never overrides explicit flags (the caller checks that
+worker_hostnames is unset before invoking this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+from typing import List, Optional, Sequence, Tuple
+
+log = logging.getLogger(__name__)
+
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+
+_W_SUFFIX = re.compile(r"-w-(\d+)$")
+
+
+@dataclasses.dataclass
+class SliceMembership:
+    worker_id: int
+    worker_hostnames: str  # comma-separated, ordered by worker id
+    slice_host_bounds: str  # "x,y,z"
+
+
+def parse_topology_label(label: str) -> Optional[Tuple[int, int, int]]:
+    """'2x2x2' / '4x8' → (2,2,2) / (4,8,1); None on junk."""
+    try:
+        dims = [int(p) for p in label.lower().split("x")]
+    except (ValueError, AttributeError):
+        return None
+    if not dims or any(d < 1 for d in dims) or len(dims) > 3:
+        return None
+    while len(dims) < 3:
+        dims.append(1)
+    return (dims[0], dims[1], dims[2])
+
+
+def _host_grid(
+    slice_chips: Tuple[int, int, int], host_chips: Sequence[int]
+) -> Optional[Tuple[int, int, int]]:
+    grid = []
+    for s, h in zip(slice_chips, host_chips):
+        h = max(int(h), 1)
+        if s % h:
+            return None
+        grid.append(s // h)
+    return (grid[0], grid[1], grid[2])
+
+
+def _ordered_hostnames(nodes: List[dict]) -> List[str]:
+    """Peer hostnames ordered by worker index.
+
+    GKE multi-host TPU hostnames carry a ``-w-<N>`` suffix (the same
+    convention TPU_WORKER_HOSTNAMES uses); when every peer has one, N is
+    the order. Otherwise fall back to plain hostname sort — stable, and
+    identical on every node, which is what matters (all peers must derive
+    the same ordering or their worker ids collide)."""
+    hosts = []
+    for n in nodes:
+        meta = n.get("metadata") or {}
+        labels = meta.get("labels") or {}
+        hosts.append(labels.get(HOSTNAME_LABEL) or meta.get("name") or "")
+    hosts = [h for h in hosts if h]
+    suffixed = {}
+    for h in hosts:
+        m = _W_SUFFIX.search(h)
+        if m is None:
+            return sorted(hosts)
+        suffixed[h] = int(m.group(1))
+    return sorted(hosts, key=lambda h: suffixed[h])
+
+
+def derive_slice_membership(
+    client, node_name: str, host_chip_bounds: Sequence[int]
+) -> Optional[SliceMembership]:
+    """Derive this node's slice membership from GKE labels, or None.
+
+    `client` needs get_node(name) and list_nodes(label_selector) (duck-
+    typed; KubeClient provides both). `host_chip_bounds` is this host's
+    own chip grid (IciMesh.bounds)."""
+    try:
+        node = client.get_node(node_name)
+    except Exception as e:
+        log.debug("gke derivation: get_node(%s) failed: %s", node_name, e)
+        return None
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    topo_label = labels.get(GKE_TPU_TOPOLOGY_LABEL, "")
+    pool = labels.get(GKE_NODEPOOL_LABEL, "")
+    if not topo_label or not pool:
+        return None
+    slice_chips = parse_topology_label(topo_label)
+    if slice_chips is None:
+        log.warning(
+            "gke derivation: unparseable %s=%r",
+            GKE_TPU_TOPOLOGY_LABEL,
+            topo_label,
+        )
+        return None
+    grid = _host_grid(slice_chips, host_chip_bounds)
+    if grid is None:
+        log.warning(
+            "gke derivation: slice topology %s not divisible by host "
+            "chip bounds %s",
+            topo_label,
+            list(host_chip_bounds),
+        )
+        return None
+    n_hosts = grid[0] * grid[1] * grid[2]
+    if n_hosts <= 1:
+        return None  # single-host slice: standalone semantics
+    try:
+        peers = client.list_nodes(
+            f"{GKE_NODEPOOL_LABEL}={pool},"
+            f"{GKE_TPU_TOPOLOGY_LABEL}={topo_label}"
+        ).get("items", [])
+    except Exception as e:
+        log.warning("gke derivation: node list failed: %s", e)
+        return None
+    hostnames = _ordered_hostnames(peers)
+    if len(hostnames) != n_hosts:
+        log.warning(
+            "gke derivation: pool %s has %d nodes, host grid %s needs %d "
+            "— falling back to flags",
+            pool,
+            len(hostnames),
+            "x".join(str(g) for g in grid),
+            n_hosts,
+        )
+        return None
+    own = labels.get(HOSTNAME_LABEL) or node_name
+    if own not in hostnames:
+        log.warning(
+            "gke derivation: own hostname %r not among peers %s", own,
+            hostnames,
+        )
+        return None
+    return SliceMembership(
+        worker_id=hostnames.index(own),
+        worker_hostnames=",".join(hostnames),
+        slice_host_bounds=",".join(str(g) for g in grid),
+    )

@@ -86,6 +86,7 @@ class Daemon:
         self.health: Optional[HealthWatcher] = None
         self.controller = None  # set by kube wiring when enabled
         self._kube = None
+        self._kube_client = None  # pre-serve client (build_and_serve)
         self.metrics_server = None
         if cfg.metrics_port:
             from ..utils.metrics import MetricsServer
@@ -127,6 +128,21 @@ class Daemon:
         chips = self.discover()
         mesh = IciMesh(chips)
         state = PlacementState(mesh)
+        self._kube_client = None
+        if self.cfg.enable_controller:
+            # Kube client + GKE slice-membership derivation BEFORE the
+            # plugin exists: Allocate exports worker_id/hostnames to
+            # containers, so they must be final before the kubelet can
+            # call. Soft-fails (no API server in unit environments).
+            try:
+                from ..controller.wiring import maybe_derive_slice_config
+                from ..kube.client import KubeClient
+
+                self._kube_client = KubeClient.from_env(self.cfg.kubeconfig)
+                maybe_derive_slice_config(self._kube_client, self.cfg, mesh)
+            except Exception as e:
+                log.warning("kube client unavailable pre-serve: %s", e)
+                self._kube_client = None
         self.plugin = TpuDevicePlugin(
             mesh,
             state=state,
@@ -169,7 +185,7 @@ class Daemon:
             from ..controller.wiring import start_kube_integration
 
             self.controller, self._kube = start_kube_integration(
-                self, mesh
+                self, mesh, client=self._kube_client
             )
         except Exception as e:  # pragma: no cover - env-dependent
             log.warning("kube integration disabled: %s", e)

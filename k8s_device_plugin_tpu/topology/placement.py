@@ -51,6 +51,22 @@ def _box_shapes(n: int, bounds: Coord) -> List[Coord]:
     return shapes
 
 
+def box_links(shape: Coord) -> int:
+    """Internal mesh links of an a×b×c box."""
+    a, b, c = shape
+    return (a - 1) * b * c + a * (b - 1) * c + a * b * (c - 1)
+
+
+def ideal_box_links(n: int) -> int:
+    """Internal links of the most compact unconstrained n-box — the
+    denominator for box-quality scores (chip-level in the extender,
+    host-level in topology/slice.py)."""
+    shapes = _box_shapes(n, (n, n, n))
+    if not shapes:
+        return max(n - 1, 1)
+    return box_links(shapes[0])
+
+
 class PlacementState:
     """Allocation bookkeeping plus the best-fit selection policy.
 

@@ -21,6 +21,30 @@ from .mesh import IciMesh
 SCHEMA_VERSION = 1
 
 
+def host_coords_for(worker_id: int, bounds: List[int]) -> List[int]:
+    """Worker id → host-grid coordinates, x-fastest row-major.
+
+    Mirrors the GKE multi-host convention (TPU_WORKER_ID enumerates hosts
+    over TPU_HOST_BOUNDS with x varying fastest) and the chip-coordinate
+    assumption in mesh.IciMesh. Out-of-range ids clamp into the grid (a
+    misconfigured worker_id must not crash publishing)."""
+    bx, by, bz = (max(int(b), 1) for b in bounds[:3])
+    w = max(int(worker_id), 0) % (bx * by * bz)
+    return [w % bx, (w // bx) % by, w // (bx * by)]
+
+
+def parse_bounds(s: str) -> List[int]:
+    """'2,2,1' → [2, 2, 1]; tolerant of junk (falls back to single host)."""
+    try:
+        parts = [int(p) for p in s.split(",")]
+    except (ValueError, AttributeError):
+        return [1, 1, 1]
+    parts = [max(p, 1) for p in parts[:3]]
+    while len(parts) < 3:
+        parts.append(1)
+    return parts
+
+
 @dataclasses.dataclass
 class ChipInfo:
     id: str
@@ -54,6 +78,26 @@ class NodeTopology:
     # declared but never filled (/root/reference/device.go:19-97):
     # [{node_id, mem_total_bytes, cpu_count}].
     numa: List[dict] = dataclasses.field(default_factory=list)
+    # Multi-host slice membership (v4/v5p slices spanning hosts over ICI).
+    # The scheduler extender uses these to gang-evaluate host *sets*: a
+    # multi-host pod should land on hosts that are ICI-adjacent in the
+    # slice's host grid, not arbitrary hosts joined over DCN. Defaults
+    # describe a standalone single-host node (empty slice_hosts = not part
+    # of a provisioned slice).
+    slice_host_bounds: List[int] = dataclasses.field(
+        default_factory=lambda: [1, 1, 1]
+    )
+    worker_id: int = 0
+    # This host's coordinates in the slice's host grid, derived from
+    # worker_id (see host_coords_for). Published explicitly so consumers
+    # need not re-derive (and so a future daemon that *discovers* real
+    # coordinates can publish them without a schema change).
+    host_coords: List[int] = dataclasses.field(
+        default_factory=lambda: [0, 0, 0]
+    )
+    # Hostnames of every slice member, ordered by worker id. All members
+    # publish the identical list — it doubles as the slice identity key.
+    slice_hosts: List[str] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
@@ -82,7 +126,11 @@ class NodeTopology:
         hostname: Optional[str] = None,
         available: Optional[List[str]] = None,
         numa_info: Optional[List[dict]] = None,
+        worker_id: int = 0,
+        worker_hostnames: str = "",
+        slice_host_bounds: str = "1,1,1",
     ) -> "NodeTopology":
+        bounds = parse_bounds(slice_host_bounds)
         return NodeTopology(
             version=SCHEMA_VERSION,
             hostname=hostname or platform.node(),
@@ -95,6 +143,12 @@ class NodeTopology:
             if available is not None
             else sorted(mesh.ids),
             numa=list(numa_info or []),
+            slice_host_bounds=bounds,
+            worker_id=worker_id,
+            host_coords=host_coords_for(worker_id, bounds),
+            slice_hosts=[
+                h.strip() for h in worker_hostnames.split(",") if h.strip()
+            ],
             chips=[
                 ChipInfo(
                     id=m.id,

@@ -1,0 +1,127 @@
+"""Slice-level host topology: gang evaluation of multi-host placements.
+
+The reference publishes per-node topology for an external scheduler and
+leaves the endpoint integration as a TODO (/root/reference/server.go:287-309,
+298-300); its extender model scores nodes one at a time, which cannot
+express the thing multi-host TPU slices actually need: the *set* of hosts
+serving one job must be ICI-adjacent in the slice's host grid, or the
+workload's collectives ride DCN instead of ICI.
+
+This module models the host grid the way placement.py models the chip
+grid: slice members are points at ``host_coords`` inside
+``slice_host_bounds``; a k-host gang is good when it forms a contiguous
+sub-box (host-level ICI bundles on every internal face), and best when
+the box is cube-like (max internal links). Hosts from different slices
+never gang — there is no ICI between slices, only DCN.
+
+Inputs are published ``NodeTopology`` annotations (topology/schema.py),
+so the extender can gang-evaluate from the API server alone, with no
+direct daemon contact — the same decoupling the reference's annotation
+design chose.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .placement import _box_shapes, box_links, ideal_box_links
+from .schema import NodeTopology
+
+Coord = Tuple[int, int, int]
+
+
+def group_by_slice(
+    topos: Sequence[NodeTopology],
+) -> Dict[Tuple[str, ...], List[NodeTopology]]:
+    """Group published topologies into slices.
+
+    The ordered slice-member hostname list is the identity key (every
+    member publishes the identical list). Nodes with no slice membership
+    (standalone hosts) are excluded — they cannot serve multi-host jobs
+    over ICI.
+    """
+    groups: Dict[Tuple[str, ...], List[NodeTopology]] = {}
+    for t in topos:
+        if len(t.slice_hosts) > 1:
+            groups.setdefault(tuple(t.slice_hosts), []).append(t)
+    return groups
+
+
+class SliceView:
+    """One slice's host grid, with per-host availability."""
+
+    def __init__(self, members: Sequence[NodeTopology]):
+        if not members:
+            raise ValueError("empty slice")
+        self.bounds: Coord = tuple(members[0].slice_host_bounds)  # type: ignore[assignment]
+        self.chips_per_host = members[0].chip_count
+        # host coords → topology, for members actually observed (a slice
+        # host whose daemon hasn't published yet is simply absent and
+        # can't be ganged with).
+        self.by_coords: Dict[Coord, NodeTopology] = {
+            tuple(t.host_coords): t for t in members  # type: ignore[misc]
+        }
+
+    def _free(self, t: NodeTopology) -> bool:
+        # Multi-host slice jobs take whole hosts (PluginConfig contract:
+        # slice-member nodes are dedicated, server/plugin.py).
+        return len(t.available) >= t.chip_count > 0
+
+    def free_coords(self) -> List[Coord]:
+        return [c for c, t in self.by_coords.items() if self._free(t)]
+
+    def best_gang(
+        self, k: int, must_include: Optional[str] = None
+    ) -> Tuple[List[str], int]:
+        """Best k-host gang: (hostnames, internal host-grid links).
+
+        Prefers the most compact contiguous sub-box of free hosts
+        (``_box_shapes`` orders cube-like first). When no full box of
+        free hosts exists, falls back to ([], 0) — the caller decides
+        whether a scattered gang is acceptable (the extender scores it
+        0 rather than hard-failing, mirroring chip-level placement's
+        box-then-grow policy at the host level).
+        """
+        free = set(self.free_coords())
+        if k <= 0 or len(free) < k:
+            return [], 0
+        bx, by, bz = self.bounds
+        must_coord = None
+        if must_include is not None:
+            for c, t in self.by_coords.items():
+                if t.hostname == must_include:
+                    must_coord = c
+                    break
+            if must_coord is None or must_coord not in free:
+                return [], 0
+        for shape in _box_shapes(k, self.bounds):
+            sx, sy, sz = shape
+            for ox in range(bx - sx + 1):
+                for oy in range(by - sy + 1):
+                    for oz in range(bz - sz + 1):
+                        box = [
+                            (ox + dx, oy + dy, oz + dz)
+                            for dx in range(sx)
+                            for dy in range(sy)
+                            for dz in range(sz)
+                        ]
+                        if must_coord is not None and must_coord not in box:
+                            continue
+                        if all(c in free for c in box):
+                            return (
+                                [self.by_coords[c].hostname for c in box],
+                                box_links(shape),
+                            )
+        return [], 0
+
+    def gang_score(self, k: int, hostname: str, max_score: int = 10) -> int:
+        """0..max_score quality of the best k-gang containing hostname:
+        box-ness of the gang (internal host links vs the ideal compact
+        box). 0 when the host can only join a scattered (non-box) gang."""
+        gang, links = self.best_gang(k, must_include=hostname)
+        if not gang:
+            return 0
+        ideal = ideal_box_links(k)
+        if ideal <= 0:
+            return max_score
+        return max(1, round(max_score * min(links / ideal, 1.0)))

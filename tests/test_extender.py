@@ -39,11 +39,22 @@ def make_mesh(chip_type="v5p", n=4):
     return IciMesh(chips)
 
 
-def make_node(name, chip_type="v5p", n=4, available=None):
+def make_node(
+    name,
+    chip_type="v5p",
+    n=4,
+    available=None,
+    worker_id=0,
+    slice_hosts=(),
+    slice_bounds="1,1,1",
+):
     mesh = make_mesh(chip_type, n)
     topo = NodeTopology.from_mesh(
         mesh, hostname=name,
         available=available if available is not None else mesh.ids,
+        worker_id=worker_id,
+        worker_hostnames=",".join(slice_hosts),
+        slice_host_bounds=slice_bounds,
     )
     return {
         "metadata": {
@@ -51,6 +62,25 @@ def make_node(name, chip_type="v5p", n=4, available=None):
             "annotations": {constants.TOPOLOGY_ANNOTATION: topo.to_json()},
         }
     }, mesh
+
+
+def make_slice_nodes(
+    hostnames, slice_bounds, chip_type="v5p", n=4, busy=()
+):
+    """One node dict per slice member host; `busy` hosts have a chip in
+    use (so they are not whole-free)."""
+    mesh = make_mesh(chip_type, n)
+    nodes = []
+    for wid, h in enumerate(hostnames):
+        node, _ = make_node(
+            h, chip_type, n,
+            available=mesh.ids[1:] if h in busy else None,
+            worker_id=wid,
+            slice_hosts=hostnames,
+            slice_bounds=slice_bounds,
+        )
+        nodes.append(node)
+    return nodes
 
 
 def tpu_pod(n):
@@ -110,14 +140,73 @@ def test_filter_passes_everything_for_non_tpu_pod(http_server):
 
 
 def test_multi_host_slice_requires_full_hosts(http_server):
-    # 8-chip pod over 4-chip v5p hosts: only fully-free hosts qualify.
-    free, _ = make_node("free-host")
-    mesh = make_mesh()
-    busy, _ = make_node("busy-host", available=mesh.ids[:3])
-    out = post(http_server, "/filter", tpu_pod(8), [free, busy])
+    # 8-chip pod over 4-chip v5p hosts: only fully-free slice members
+    # qualify.
+    nodes = make_slice_nodes(
+        ["free-host", "other-host", "busy-host"], "3,1,1",
+        busy=("busy-host",),
+    )
+    out = post(http_server, "/filter", tpu_pod(8), nodes)
     names = [n["metadata"]["name"] for n in out["nodes"]["items"]]
-    assert names == ["free-host"]
+    assert names == ["free-host", "other-host"]
     assert "full host" in out["failedNodes"]["busy-host"]
+
+
+def test_multi_host_requires_slice_membership(http_server):
+    # A fully-free standalone host (no slice peers) cannot serve a
+    # multi-host gang: its cross-host traffic would ride DCN, not ICI.
+    standalone, _ = make_node("standalone")
+    out = post(http_server, "/filter", tpu_pod(8), [standalone])
+    assert out["nodes"]["items"] == []
+    assert "not part of a multi-host slice" in (
+        out["failedNodes"]["standalone"]
+    )
+
+
+def test_multi_host_insufficient_free_slice_hosts(http_server):
+    # 2-host slice with one busy member: the free member can't gang.
+    nodes = make_slice_nodes(["h0", "h1"], "2,1,1", busy=("h1",))
+    out = post(http_server, "/filter", tpu_pod(8), nodes)
+    assert out["nodes"]["items"] == []
+    assert "whole-free" in out["failedNodes"]["h0"]
+
+
+def test_multi_host_adjacent_pair_outranks_non_adjacent(http_server):
+    """BASELINE config 3 / VERDICT r1 #2: an 8-chip pod over 2×v5p hosts
+    must prefer the mesh-adjacent host pair. Slice of 4 hosts on a
+    4x1x1 host grid with h1 busy: h2+h3 form an adjacent pair; h0's only
+    free peers (h2, h3) are not adjacent to it, so h0 scores 0."""
+    nodes = make_slice_nodes(
+        ["h0", "h1", "h2", "h3"], "4,1,1", busy=("h1",)
+    )
+    out = post(http_server, "/filter", tpu_pod(8), nodes)
+    names = [n["metadata"]["name"] for n in out["nodes"]["items"]]
+    assert names == ["h0", "h2", "h3"]  # h1 not whole-free
+    scores = {
+        e["host"]: e["score"]
+        for e in post(http_server, "/prioritize", tpu_pod(8), nodes)
+    }
+    assert scores["h2"] > scores["h0"]
+    assert scores["h3"] > scores["h0"]
+    assert scores["h0"] == 0  # could only join a scattered (DCN-ish) gang
+    assert scores["h1"] == 0
+
+
+def test_multi_host_2x2_gang_scores_by_box(http_server):
+    # 2x2 host grid, 16-chip pod (k=4): the full grid is the gang; every
+    # member scores identically and maximally (perfect 2x2 box).
+    hostnames = ["a", "b", "c", "d"]
+    nodes = make_slice_nodes(hostnames, "2,2,1")
+    scores = {
+        e["host"]: e["score"]
+        for e in post(http_server, "/prioritize", tpu_pod(16), nodes)
+    }
+    assert all(scores[h] > 0 for h in hostnames)
+    assert len(set(scores.values())) == 1
+    # One busy member: k=4 no longer fits in free hosts; filter fails all.
+    nodes = make_slice_nodes(hostnames, "2,2,1", busy=("d",))
+    out = post(http_server, "/filter", tpu_pod(16), nodes)
+    assert out["nodes"]["items"] == []
 
 
 def test_multi_host_non_multiple_rejected(http_server):

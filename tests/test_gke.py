@@ -1,0 +1,126 @@
+"""GKE slice-membership derivation tests (kube/gke.py).
+
+Fakes the node objects a GKE multi-host TPU pool publishes and asserts the
+derived worker id / peer list / host grid — plus every fallback-to-flags
+path (missing labels, non-dividing topology, wrong peer count).
+"""
+
+from k8s_device_plugin_tpu.kube.gke import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    HOSTNAME_LABEL,
+    derive_slice_membership,
+    parse_topology_label,
+)
+
+
+class StubClient:
+    def __init__(self, nodes):
+        self.nodes = {n["metadata"]["name"]: n for n in nodes}
+        self.last_selector = None
+
+    def get_node(self, name):
+        return self.nodes[name]
+
+    def list_nodes(self, label_selector=""):
+        self.last_selector = label_selector
+        want = dict(
+            part.split("=", 1) for part in label_selector.split(",") if part
+        )
+        items = [
+            n
+            for n in self.nodes.values()
+            if all(
+                (n["metadata"].get("labels") or {}).get(k) == v
+                for k, v in want.items()
+            )
+        ]
+        return {"items": items}
+
+
+def gke_node(name, hostname, topology="2x2x2", pool="tpu-pool"):
+    return {
+        "metadata": {
+            "name": name,
+            "labels": {
+                GKE_TPU_TOPOLOGY_LABEL: topology,
+                GKE_NODEPOOL_LABEL: pool,
+                HOSTNAME_LABEL: hostname,
+            },
+        }
+    }
+
+
+def test_parse_topology_label():
+    assert parse_topology_label("2x2x2") == (2, 2, 2)
+    assert parse_topology_label("4x8") == (4, 8, 1)
+    assert parse_topology_label("16") == (16, 1, 1)
+    assert parse_topology_label("") is None
+    assert parse_topology_label("2x2x2x2") is None
+    assert parse_topology_label("axb") is None
+    assert parse_topology_label("0x2") is None
+
+
+def test_derive_two_host_v5p_slice():
+    # v5p-16: chip topology 2x2x2, hosts are 2x2x1 → host grid 1x1x2.
+    nodes = [
+        gke_node("gke-a", "tpu-vm-w-0"),
+        gke_node("gke-b", "tpu-vm-w-1"),
+    ]
+    m = derive_slice_membership(StubClient(nodes), "gke-b", (2, 2, 1))
+    assert m is not None
+    assert m.worker_id == 1
+    assert m.worker_hostnames == "tpu-vm-w-0,tpu-vm-w-1"
+    assert m.slice_host_bounds == "1,1,2"
+
+
+def test_derive_orders_by_w_suffix_not_lexicographically():
+    # -w-10 sorts after -w-9 numerically (lexicographic would misorder).
+    hosts = [f"vm-w-{i}" for i in range(16)]
+    nodes = [
+        gke_node(f"n{i}", hosts[i], topology="8x16") for i in range(16)
+    ]
+    m = derive_slice_membership(StubClient(nodes), "n10", (2, 4, 1))
+    assert m is not None
+    assert m.slice_host_bounds == "4,4,1"
+    assert m.worker_hostnames.split(",") == hosts
+    assert m.worker_id == 10
+
+
+def test_derive_single_host_slice_is_standalone():
+    # v5p-8 single host: topology equals host bounds → no multi-host.
+    nodes = [gke_node("gke-a", "tpu-vm-w-0", topology="2x2x1")]
+    assert (
+        derive_slice_membership(StubClient(nodes), "gke-a", (2, 2, 1))
+        is None
+    )
+
+
+def test_derive_fallbacks():
+    # Missing labels → None.
+    bare = {"metadata": {"name": "n", "labels": {}}}
+    assert (
+        derive_slice_membership(StubClient([bare]), "n", (2, 2, 1)) is None
+    )
+    # Topology not divisible by host bounds → None.
+    nodes = [gke_node("n", "h-w-0", topology="3x2x2")]
+    assert (
+        derive_slice_membership(StubClient(nodes), "n", (2, 2, 1)) is None
+    )
+    # Peer count doesn't match the host grid → None (no guessing).
+    nodes = [gke_node("a", "h-w-0"), gke_node("b", "h-w-1"),
+             gke_node("c", "h-w-2")]
+    assert (
+        derive_slice_membership(StubClient(nodes), "a", (2, 2, 1)) is None
+    )
+
+
+def test_derive_without_w_suffix_sorts_hostnames():
+    nodes = [
+        gke_node("x", "beta"),
+        gke_node("y", "alpha"),
+    ]
+    m = derive_slice_membership(StubClient(nodes), "x", (2, 2, 1))
+    assert m is not None
+    assert m.worker_hostnames == "alpha,beta"
+    assert m.worker_id == 1  # "beta" sorts second

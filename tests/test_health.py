@@ -183,6 +183,26 @@ def test_app_level_fault_not_marked_unhealthy(node):
     assert events[-1] == (chips[0].device_id_str, True)
 
 
+def test_app_fault_does_not_resurrect_hardware_unhealthy_chip(node):
+    """A chip already hardware-Unhealthy whose health attribute later
+    shows an app-class token must STAY withdrawn (the skip is a no-op,
+    like the reference's XID 'continue' — not an assertion of health)."""
+    accel, dev, chips = node
+    events = []
+    w = HealthWatcher(
+        PyTpuInfo(), accel, dev, chips, lambda cid, h: events.append((cid, h))
+    )
+    fakes.set_chip_health(accel, 0, False, reason="hbm_ecc")
+    w.poll_once()
+    assert events == [(chips[0].device_id_str, False)]
+    fakes.set_chip_health(accel, 0, False, reason="app_error")
+    w.poll_once()
+    assert events == [(chips[0].device_id_str, False)]  # no recovery
+    fakes.set_chip_health(accel, 0, True)
+    w.poll_once()
+    assert events[-1] == (chips[0].device_id_str, True)
+
+
 def test_hardware_fault_classes_marked_unhealthy(node):
     accel, dev, chips = node
     events = []

@@ -241,3 +241,63 @@ def test_node_topology_roundtrip():
     assert back.chip_type == "v5p"
     assert back.host_bounds == [2, 2, 1]
     assert back.chips[0].coords == [0, 0, 0]
+    # Slice defaults: standalone host.
+    assert back.slice_hosts == [] and back.host_coords == [0, 0, 0]
+
+
+def test_node_topology_slice_fields_roundtrip():
+    m = mesh_of("v5p", 4)
+    topo = NodeTopology.from_mesh(
+        m, hostname="h2", worker_id=2,
+        worker_hostnames="h0,h1,h2,h3", slice_host_bounds="2,2,1",
+    )
+    back = NodeTopology.from_json(topo.to_json())
+    assert back.slice_hosts == ["h0", "h1", "h2", "h3"]
+    assert back.slice_host_bounds == [2, 2, 1]
+    assert back.worker_id == 2
+    assert back.host_coords == [0, 1, 0]  # x-fastest row-major
+
+
+def test_host_coords_for_x_fastest():
+    from k8s_device_plugin_tpu.topology.schema import host_coords_for
+
+    bounds = [2, 2, 2]
+    assert [host_coords_for(w, bounds) for w in range(8)] == [
+        [0, 0, 0], [1, 0, 0], [0, 1, 0], [1, 1, 0],
+        [0, 0, 1], [1, 0, 1], [0, 1, 1], [1, 1, 1],
+    ]
+    # Junk tolerance: out-of-range id wraps, junk bounds fall back.
+    assert host_coords_for(9, bounds) == [1, 0, 0]
+    from k8s_device_plugin_tpu.topology.schema import parse_bounds
+
+    assert parse_bounds("2,2,1") == [2, 2, 1]
+    assert parse_bounds("4") == [4, 1, 1]
+    assert parse_bounds("garbage") == [1, 1, 1]
+
+
+def test_slice_view_best_gang():
+    from k8s_device_plugin_tpu.topology.slice import SliceView, group_by_slice
+
+    m = mesh_of("v5p", 4)
+    hosts = ["h0", "h1", "h2", "h3"]
+
+    def member(wid, available=None):
+        return NodeTopology.from_mesh(
+            m, hostname=hosts[wid], available=available, worker_id=wid,
+            worker_hostnames=",".join(hosts), slice_host_bounds="4,1,1",
+        )
+
+    members = [member(0), member(1, available=m.ids[:2]), member(2),
+               member(3)]
+    groups = group_by_slice(members)
+    assert list(groups) == [tuple(hosts)]
+    view = SliceView(groups[tuple(hosts)])
+    # h1 is not whole-free: the best adjacent pair is (h2, h3).
+    gang, links = view.best_gang(2)
+    assert sorted(gang) == ["h2", "h3"] and links == 1
+    # h0 can't join any contiguous pair (its only neighbor h1 is busy).
+    assert view.best_gang(2, must_include="h0") == ([], 0)
+    assert view.gang_score(2, "h2") > 0
+    assert view.gang_score(2, "h0") == 0
+    # 3-host gangs: no contiguous triple free (h1 splits the line).
+    assert view.best_gang(3) == ([], 0)
