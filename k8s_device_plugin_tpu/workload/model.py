@@ -149,6 +149,10 @@ class ModelConfig:
         return ModelConfig(
             vocab_size=32768, d_model=2048, n_heads=16, n_layers=4,
             d_ff=8192, max_seq_len=2048, use_flash_attention=True,
+            # Stacked layer params: one scanned block body (faster compile,
+            # 3x fewer param/opt buffers — measured 2x faster steps on a
+            # remote-PJRT link where every returned buffer costs ~1 ms).
+            scan_layers=True,
         )
 
     # --- analytic FLOPs accounting (the MFU numerator) -------------------
