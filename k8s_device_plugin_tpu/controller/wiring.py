@@ -37,6 +37,7 @@ def publish_node_topology(
     worker_id: int = 0,
     worker_hostnames: str = "",
     slice_host_bounds: str = "1,1,1",
+    host_info=None,
 ) -> NodeTopology:
     """Publish the ICI topology as a node annotation, retrying on conflict
     like the reference's patchNode loop (/root/reference/server.go:312-347).
@@ -46,6 +47,7 @@ def publish_node_topology(
         numa_info=numa_info, worker_id=worker_id,
         worker_hostnames=worker_hostnames,
         slice_host_bounds=slice_host_bounds,
+        host_info=host_info,
     )
     shape = "x".join(str(b) for b in mesh.bounds)
     last: Optional[Exception] = None
@@ -92,6 +94,7 @@ class TopologyPublisher:
         worker_id: int = 0,
         worker_hostnames: str = "",
         slice_host_bounds: str = "1,1,1",
+        host_info=None,
     ):
         self.client = client
         self.node_name = node_name
@@ -102,6 +105,7 @@ class TopologyPublisher:
         self.worker_id = worker_id
         self.worker_hostnames = worker_hostnames
         self.slice_host_bounds = slice_host_bounds
+        self.host_info = host_info
         self._dirty = threading.Event()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -130,6 +134,7 @@ class TopologyPublisher:
             worker_id=self.worker_id,
             worker_hostnames=self.worker_hostnames,
             slice_host_bounds=self.slice_host_bounds,
+            host_info=self.host_info,
         )
 
     def _run(self) -> None:
@@ -151,7 +156,12 @@ def maybe_derive_slice_config(client: KubeClient, cfg, mesh: IciMesh) -> None:
     explicit flags. MUST run before the plugin is constructed/served —
     Allocate exports these to containers (server/plugin.py _tpu_env), so
     deriving after serve would race the kubelet's first Allocate."""
-    if cfg.worker_hostnames or not mesh.mesh_chips:
+    explicitly_configured = (
+        cfg.worker_hostnames
+        or cfg.worker_id != 0
+        or cfg.slice_host_bounds not in ("", "1,1,1")
+    )
+    if explicitly_configured or not mesh.mesh_chips:
         return
     from ..kube.gke import derive_slice_membership
 
@@ -179,16 +189,23 @@ def start_kube_integration(
     node_name = cfg.node_name or os.uname().nodename
     numa = 1
     numa_info = []
+    host_info = {}
     try:
         numa = daemon.backend.numa_node_count(cfg.numa_dir)
         numa_info = daemon.backend.numa_topology(cfg.numa_dir)
     except OSError:
         pass
+    try:
+        if hasattr(daemon.backend, "host_info"):
+            host_info = daemon.backend.host_info(cfg.proc_dir)
+    except OSError:
+        host_info = {}
     publisher = TopologyPublisher(
         client, node_name, daemon.plugin, numa_nodes=numa,
         numa_info=numa_info, worker_id=cfg.worker_id,
         worker_hostnames=cfg.worker_hostnames,
         slice_host_bounds=cfg.slice_host_bounds,
+        host_info=host_info,
     )
     publisher.start()
     daemon.plugin.on_availability_change = publisher.trigger

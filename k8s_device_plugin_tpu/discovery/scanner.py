@@ -40,6 +40,16 @@ class _CNumaNode(ctypes.Structure):
     ]
 
 
+class _CHostInfo(ctypes.Structure):
+    # Mirrors tpuinfo_host_info_t in native/tpuinfo/tpuinfo.h.
+    _fields_ = [
+        ("mem_total_bytes", ctypes.c_longlong),
+        ("cpu_count", ctypes.c_int),
+        ("cpu_sockets", ctypes.c_int),
+        ("cpu_model", ctypes.c_char * 64),
+    ]
+
+
 class _CChip(ctypes.Structure):
     # Mirrors tpuinfo_chip in native/tpuinfo/tpuinfo.h.
     _fields_ = [
@@ -111,6 +121,20 @@ class NativeTpuInfo:
         self._lib.tpuinfo_probe_libtpu.restype = ctypes.c_int
         self._lib.tpuinfo_probe_libtpu.argtypes = [ctypes.c_char_p]
         self._lib.tpuinfo_version.restype = ctypes.c_char_p
+        # Coordinate/host-info surfaces are newer; degrade on a stale .so.
+        try:
+            self._lib.tpuinfo_chip_coords.restype = ctypes.c_int
+            self._lib.tpuinfo_chip_coords.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int * 3),
+            ]
+            self._lib.tpuinfo_host_info.restype = ctypes.c_int
+            self._lib.tpuinfo_host_info.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(_CHostInfo),
+            ]
+            self._has_host_surfaces = True
+        except AttributeError:
+            self._has_host_surfaces = False
         # Event API is newer than the core symbols: a stale .so (version
         # skew via TPUINFO_LIB) must degrade to interval polling, not
         # crash the daemon at startup with an AttributeError get_backend
@@ -208,6 +232,40 @@ class NativeTpuInfo:
 
     def probe_libtpu(self, path: str = "") -> bool:
         return bool(self._lib.tpuinfo_probe_libtpu(path.encode()))
+
+    def chip_coords(
+        self, sysfs_accel_dir: str, index: int
+    ) -> "Optional[tuple]":
+        """Ground-truth ICI coords from the driver's coords attribute, or
+        None when unpublished (the PCI-order assumption stands,
+        unverified). Raises OSError on a garbled attribute."""
+        if not self._has_host_surfaces:
+            return None
+        buf = (ctypes.c_int * 3)()
+        r = self._lib.tpuinfo_chip_coords(
+            sysfs_accel_dir.encode(), index, ctypes.byref(buf)
+        )
+        if r < 0:
+            raise OSError(-r, f"tpuinfo_chip_coords(accel{index}) failed")
+        if r == 0:
+            return None
+        return (buf[0], buf[1], buf[2])
+
+    def host_info(self, proc_dir: str = "/proc") -> dict:
+        """Host CPU/memory summary (reference schema parity,
+        /root/reference/device.go:19-97)."""
+        if not self._has_host_surfaces:
+            return {}
+        info = _CHostInfo()
+        r = self._lib.tpuinfo_host_info(proc_dir.encode(), ctypes.byref(info))
+        if r < 0:
+            raise OSError(-r, "tpuinfo_host_info failed")
+        return {
+            "mem_total_bytes": info.mem_total_bytes,
+            "cpu_count": info.cpu_count,
+            "cpu_sockets": info.cpu_sockets,
+            "cpu_model": info.cpu_model.decode(errors="replace"),
+        }
 
     # Event-driven health (the NVML EventSet analog, tpuinfo.h). Returns
     # an fd handle or raises when inotify/the roots are unavailable —
@@ -435,6 +493,71 @@ class PyTpuInfo:
             return True
         except OSError:
             return False
+
+    def chip_coords(
+        self, sysfs_accel_dir: str, index: int
+    ) -> "Optional[tuple]":
+        """Result-identical to NativeTpuInfo.chip_coords (tpuinfo.h)."""
+        path = os.path.join(
+            sysfs_accel_dir, f"accel{index}", "device", "coords"
+        )
+        if not os.path.exists(path):
+            return None
+        parts = _read_trimmed(path).split(",")
+        vals = []
+        for p in parts[:3]:
+            try:
+                v = int(p.strip())
+            except ValueError:
+                raise OSError(22, f"garbled coords attribute {path!r}")
+            if v < 0:
+                raise OSError(22, f"garbled coords attribute {path!r}")
+            vals.append(v)
+        if not vals:
+            raise OSError(22, f"garbled coords attribute {path!r}")
+        while len(vals) < 3:
+            vals.append(0)
+        return tuple(vals)
+
+    def host_info(self, proc_dir: str = "/proc") -> dict:
+        """Result-identical to NativeTpuInfo.host_info (tpuinfo.h)."""
+        mem = 0
+        for line in _read_trimmed(
+            os.path.join(proc_dir, "meminfo")
+        ).splitlines():
+            if "MemTotal:" in line:
+                try:
+                    mem = int(line.split("MemTotal:")[1].split()[0]) * 1024
+                except (ValueError, IndexError):
+                    pass
+                break
+        cpu_count = 0
+        packages: list = []
+        model = ""
+        for line in _read_trimmed(
+            os.path.join(proc_dir, "cpuinfo")
+        ).splitlines():
+            if line.startswith("processor"):
+                cpu_count += 1
+            elif line.startswith("physical id"):
+                try:
+                    pid = int(line.split(":", 1)[1])
+                except (ValueError, IndexError):
+                    continue
+                if pid not in packages:
+                    packages.append(pid)
+            elif not model and line.startswith("model name"):
+                parts = line.split(":", 1)
+                if len(parts) == 2:
+                    # The native struct truncates at 63 chars; mirror it.
+                    model = parts[1].strip()[:63]
+        sockets = len(packages) or (1 if cpu_count else 0)
+        return {
+            "mem_total_bytes": mem,
+            "cpu_count": cpu_count,
+            "cpu_sockets": sockets,
+            "cpu_model": model,
+        }
 
     # Event-driven health: same contract as NativeTpuInfo (tpuinfo.h), via
     # ctypes inotify — pure-Python deployments get event latency too.

@@ -79,8 +79,13 @@ class TopologyExtender:
         if n <= 0:
             return nodes, {}
         parsed = [(node, self._topology_of(node)) for node in nodes]
-        slice_views = self._slice_views(
-            [t for _, t in parsed if t is not None]
+        topos = [t for _, t in parsed if t is not None]
+        # Slice views only matter when some candidate would serve this
+        # request multi-host (same guard as prioritize).
+        slice_views = (
+            self._slice_views(topos)
+            if any(n > t.chip_count > 0 for t in topos)
+            else {}
         )
         passing, failed = [], {}
         for node, topo in parsed:
@@ -272,20 +277,26 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                 pod = _get_ci(args, "pod") or {}
                 nodes = _get_ci(args, "nodes") or {}
                 items = _get_ci(nodes, "items") or []
-                if self.path == "/filter":
-                    passing, failed = ext.filter(pod, items)
-                    self._send(
-                        {
-                            "nodes": {"items": passing},
-                            "nodenames": None,
-                            "failedNodes": failed,
-                            "error": "",
-                        }
-                    )
-                elif self.path == "/prioritize":
-                    self._send(ext.prioritize(pod, items))
-                else:
-                    self._send({"error": f"unknown path {self.path}"}, 404)
+                try:
+                    if self.path == "/filter":
+                        passing, failed = ext.filter(pod, items)
+                        self._send(
+                            {
+                                "nodes": {"items": passing},
+                                "nodenames": None,
+                                "failedNodes": failed,
+                                "error": "",
+                            }
+                        )
+                    elif self.path == "/prioritize":
+                        self._send(ext.prioritize(pod, items))
+                    else:
+                        self._send({"error": f"unknown path {self.path}"}, 404)
+                except Exception as e:  # annotations are external input —
+                    # one bad one must cost an error payload, not the
+                    # scheduler's whole HTTP call.
+                    log.exception("extender %s failed", self.path)
+                    self._send({"error": f"{type(e).__name__}: {e}"}, 500)
 
             def do_GET(self):
                 if self.path == "/healthz":

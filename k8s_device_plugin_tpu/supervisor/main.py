@@ -54,6 +54,7 @@ class DaemonConfig:
     sysfs_accel_dir: str = DEFAULT_SYSFS_ACCEL
     dev_dir: str = DEFAULT_DEV
     numa_dir: str = DEFAULT_NUMA_DIR
+    proc_dir: str = "/proc"
     resource_name: str = constants.RESOURCE_NAME
     # Override the chip type detected from PCI ids (e.g. from the GKE node
     # label cloud.google.com/gke-tpu-accelerator).
@@ -124,9 +125,33 @@ class Daemon:
         )
         return chips
 
+    def _discover_coords(self, chips) -> Optional[dict]:
+        """Driver-published ICI coordinates per chip index, when the
+        backend and sysfs expose them (tpuinfo_chip_coords); None keeps
+        the PCI-order assumption."""
+        if not hasattr(self.backend, "chip_coords"):
+            return None
+        out = {}
+        for c in chips:
+            try:
+                xyz = self.backend.chip_coords(
+                    self.cfg.sysfs_accel_dir, c.index
+                )
+            except OSError as e:
+                log.warning(
+                    "chip coords read failed for accel%d (%s); keeping "
+                    "the PCI-order assumption",
+                    c.index,
+                    e,
+                )
+                return None
+            if xyz is not None:
+                out[c.index] = xyz
+        return out or None
+
     def build_and_serve(self) -> None:
         chips = self.discover()
-        mesh = IciMesh(chips)
+        mesh = IciMesh(chips, discovered_coords=self._discover_coords(chips))
         state = PlacementState(mesh)
         self._kube_client = None
         if self.cfg.enable_controller:

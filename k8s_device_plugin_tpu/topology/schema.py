@@ -78,6 +78,12 @@ class NodeTopology:
     # declared but never filled (/root/reference/device.go:19-97):
     # [{node_id, mem_total_bytes, cpu_count}].
     numa: List[dict] = dataclasses.field(default_factory=list)
+    # Host system summary (CPU packages, memory, model) — the part of the
+    # reference's schema its hwloc surface declared but never filled
+    # (/root/reference/device.go:19-97), for extenders co-scheduling
+    # CPU-heavy input pipelines with TPU pods:
+    # {mem_total_bytes, cpu_count, cpu_sockets, cpu_model}.
+    host: dict = dataclasses.field(default_factory=dict)
     # Multi-host slice membership (v4/v5p slices spanning hosts over ICI).
     # The scheduler extender uses these to gang-evaluate host *sets*: a
     # multi-host pod should land on hosts that are ICI-adjacent in the
@@ -129,6 +135,7 @@ class NodeTopology:
         worker_id: int = 0,
         worker_hostnames: str = "",
         slice_host_bounds: str = "1,1,1",
+        host_info: Optional[dict] = None,
     ) -> "NodeTopology":
         bounds = parse_bounds(slice_host_bounds)
         return NodeTopology(
@@ -143,6 +150,7 @@ class NodeTopology:
             if available is not None
             else sorted(mesh.ids),
             numa=list(numa_info or []),
+            host=dict(host_info or {}),
             slice_host_bounds=bounds,
             worker_id=worker_id,
             host_coords=host_coords_for(worker_id, bounds),

@@ -30,6 +30,19 @@ from .schema import NodeTopology
 Coord = Tuple[int, int, int]
 
 
+def _norm3(vals, floor: int) -> Coord:
+    """Normalize an annotation-sourced int list to exactly 3 dims."""
+    out = []
+    for v in list(vals)[:3]:
+        try:
+            out.append(max(int(v), floor))
+        except (TypeError, ValueError):
+            out.append(floor if floor > 0 else 0)
+    while len(out) < 3:
+        out.append(max(floor, 1) if floor > 0 else 0)
+    return (out[0], out[1], out[2])
+
+
 def group_by_slice(
     topos: Sequence[NodeTopology],
 ) -> Dict[Tuple[str, ...], List[NodeTopology]]:
@@ -53,14 +66,36 @@ class SliceView:
     def __init__(self, members: Sequence[NodeTopology]):
         if not members:
             raise ValueError("empty slice")
-        self.bounds: Coord = tuple(members[0].slice_host_bounds)  # type: ignore[assignment]
+        # Annotations are external input (hand-written or third-party
+        # publishers): normalize shapes rather than crash the extender —
+        # bounds/coords pad to 3 dims, floor 1.
+        self.bounds: Coord = _norm3(members[0].slice_host_bounds, floor=1)
         self.chips_per_host = members[0].chip_count
         # host coords → topology, for members actually observed (a slice
         # host whose daemon hasn't published yet is simply absent and
-        # can't be ganged with).
-        self.by_coords: Dict[Coord, NodeTopology] = {
-            tuple(t.host_coords): t for t in members  # type: ignore[misc]
-        }
+        # can't be ganged with). Colliding coordinates (e.g. two members
+        # publishing wrapped out-of-range worker ids) mean the grid
+        # cannot be trusted at that point: drop ALL colliders rather than
+        # silently gang hosts that may not be ICI-adjacent.
+        self.by_coords: Dict[Coord, NodeTopology] = {}
+        seen: Dict[Coord, int] = {}
+        for t in members:
+            c: Coord = _norm3(t.host_coords, floor=0)
+            seen[c] = seen.get(c, 0) + 1
+            self.by_coords[c] = t
+        for c, count in seen.items():
+            if count > 1:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "slice %s: %d members publish host_coords %s "
+                    "(misconfigured worker ids?); excluding that grid "
+                    "point from gang evaluation",
+                    members[0].slice_hosts,
+                    count,
+                    list(c),
+                )
+                del self.by_coords[c]
 
     def _free(self, t: NodeTopology) -> bool:
         # Multi-host slice jobs take whole hosts (PluginConfig contract:

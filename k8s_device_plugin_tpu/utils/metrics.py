@@ -171,6 +171,11 @@ HEALTH_TRANSITIONS = REGISTRY.counter(
     "tpu_plugin_health_transitions_total",
     "Chip health transitions by direction",
 )
+COORD_MISMATCHES = REGISTRY.counter(
+    "tpu_plugin_coord_assumption_mismatches_total",
+    "Chips whose driver-published ICI coordinates contradicted the "
+    "PCI-order assumption (ground truth used)",
+)
 APP_FAULTS = REGISTRY.counter(
     "tpu_plugin_app_faults_total",
     "Application-level chip faults observed (not marked unhealthy), "

@@ -373,6 +373,81 @@ void tpuinfo_health_events_close(int fd) {
   if (fd >= 0) ::close(fd);
 }
 
+int tpuinfo_chip_coords(const char* sysfs_class_dir, int index,
+                        int out_xyz[3]) {
+  if (sysfs_class_dir == nullptr || out_xyz == nullptr) return -EINVAL;
+  char buf[512];
+  snprintf(buf, sizeof(buf), "%s/accel%d/device/coords", sysfs_class_dir,
+           index);
+  if (!PathExists(buf)) return 0; /* no ground truth published */
+  std::string s = ReadTrimmed(buf);
+  int vals[3] = {0, 0, 0};
+  int n = 0;
+  std::stringstream ss(s);
+  std::string part;
+  while (std::getline(ss, part, ',') && n < 3) {
+    errno = 0;
+    char* end = nullptr;
+    long v = std::strtol(part.c_str(), &end, 10);
+    if (errno != 0 || end == part.c_str() || v < 0) return -EINVAL;
+    vals[n++] = static_cast<int>(v);
+  }
+  if (n == 0) return -EINVAL;
+  for (int i = 0; i < 3; ++i) out_xyz[i] = vals[i];
+  return 1;
+}
+
+int tpuinfo_host_info(const char* proc_dir, tpuinfo_host_info_t* out) {
+  if (proc_dir == nullptr || out == nullptr) return -EINVAL;
+  out->mem_total_bytes = 0;
+  out->cpu_count = 0;
+  out->cpu_sockets = 0;
+  out->cpu_model[0] = '\0';
+  {
+    std::ifstream f(std::string(proc_dir) + "/meminfo");
+    std::string line;
+    while (std::getline(f, line)) {
+      size_t pos = line.find("MemTotal:");
+      if (pos == std::string::npos) continue;
+      out->mem_total_bytes =
+          std::strtoll(line.c_str() + pos + strlen("MemTotal:"), nullptr,
+                       10) *
+          1024LL;
+      break;
+    }
+  }
+  {
+    std::ifstream f(std::string(proc_dir) + "/cpuinfo");
+    std::string line;
+    std::vector<long> packages;
+    while (std::getline(f, line)) {
+      if (line.compare(0, 9, "processor") == 0) {
+        ++out->cpu_count;
+      } else if (line.compare(0, 11, "physical id") == 0) {
+        size_t colon = line.find(':');
+        if (colon != std::string::npos) {
+          long id = std::strtol(line.c_str() + colon + 1, nullptr, 10);
+          if (std::find(packages.begin(), packages.end(), id) ==
+              packages.end())
+            packages.push_back(id);
+        }
+      } else if (out->cpu_model[0] == '\0' &&
+                 line.compare(0, 10, "model name") == 0) {
+        size_t colon = line.find(':');
+        if (colon != std::string::npos) {
+          size_t start = line.find_first_not_of(" \t", colon + 1);
+          if (start != std::string::npos)
+            snprintf(out->cpu_model, sizeof(out->cpu_model), "%s",
+                     line.substr(start).c_str());
+        }
+      }
+    }
+    out->cpu_sockets = static_cast<int>(packages.size());
+    if (out->cpu_sockets == 0 && out->cpu_count > 0) out->cpu_sockets = 1;
+  }
+  return 0;
+}
+
 int tpuinfo_probe_libtpu(const char* path) {
   const char* soname =
       (path != nullptr && path[0] != '\0') ? path : "libtpu.so";

@@ -59,6 +59,30 @@ def set_chip_health(
     _write(devdir, "health", "ok" if healthy else reason)
 
 
+def set_chip_coords(accel_dir: str, index: int, coords: str):
+    """Publish driver ground-truth ICI coords ("x,y,z") for chip `index`."""
+    devdir = os.path.join(accel_dir, f"accel{index}", "device")
+    _write(devdir, "coords", coords)
+
+
+def make_fake_proc(root: str, cpus: int = 4, sockets: int = 2,
+                   mem_kb: int = 8_000_000, model: str = "Fake CPU v1"):
+    """Create <root>/proc with cpuinfo + meminfo for host_info tests."""
+    proc = os.path.join(root, "proc")
+    os.makedirs(proc, exist_ok=True)
+    lines = []
+    for i in range(cpus):
+        lines += [
+            f"processor\t: {i}",
+            f"model name\t: {model}",
+            f"physical id\t: {i % sockets}",
+            "",
+        ]
+    _write(proc, "cpuinfo", "\n".join(lines))
+    _write(proc, "meminfo", f"MemTotal:       {mem_kb} kB")
+    return proc
+
+
 def remove_dev_node(dev_dir: str, index: int):
     os.unlink(os.path.join(dev_dir, f"accel{index}"))
 

@@ -109,6 +109,41 @@ def test_numa_node_count(backend, tmp_path):
     assert backend.numa_node_count(str(tmp_path / "nope")) == 1
 
 
+def test_chip_coords_backend_parity(backend, tmp_path):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5p", 4)
+    # Unpublished: None (the PCI-order assumption stands, unverified).
+    assert backend.chip_coords(accel, 0) is None
+    fakes.set_chip_coords(accel, 0, "1,0,0")
+    assert backend.chip_coords(accel, 0) == (1, 0, 0)
+    fakes.set_chip_coords(accel, 1, "0,1")  # short form pads with 0
+    assert backend.chip_coords(accel, 1) == (0, 1, 0)
+    fakes.set_chip_coords(accel, 2, "garbage")
+    with pytest.raises(OSError):
+        backend.chip_coords(accel, 2)
+
+
+def test_host_info_backend_parity(native_lib, tmp_path):
+    proc = fakes.make_fake_proc(
+        str(tmp_path), cpus=8, sockets=2, mem_kb=16_000_000,
+        model="Fake CPU v1",
+    )
+    py = PyTpuInfo().host_info(proc)
+    native = NativeTpuInfo(native_lib).host_info(proc)
+    assert py == native
+    assert py == {
+        "mem_total_bytes": 16_000_000 * 1024,
+        "cpu_count": 8,
+        "cpu_sockets": 2,
+        "cpu_model": "Fake CPU v1",
+    }
+    # Missing proc dir: zeros, not an exception.
+    empty = PyTpuInfo().host_info(str(tmp_path / "nope"))
+    assert empty["cpu_count"] == 0
+    assert empty == NativeTpuInfo(native_lib).host_info(
+        str(tmp_path / "nope")
+    )
+
+
 def test_get_backend_falls_back(monkeypatch):
     monkeypatch.setenv("TPUINFO_LIB", "/definitely/not/here.so")
     monkeypatch.setattr(

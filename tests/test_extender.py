@@ -241,6 +241,32 @@ def test_prioritize_packing_bonus(http_server):
     assert scores["exact"] > scores["roomy"]
 
 
+def test_malformed_slice_annotation_never_crashes_scheduling(http_server):
+    """Annotations are external input: a hand-written slice_host_bounds
+    with 2 elements (or junk coords) must not 500 the scheduler's
+    filter/prioritize calls (previously an unpack ValueError escaped
+    do_POST and aborted the HTTP connection)."""
+    import copy
+
+    nodes = make_slice_nodes(["m0", "m1"], "2,1,1")
+    # Corrupt the published annotation: truncate bounds + garbage coords.
+    raw = nodes[0]["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION]
+    d = json.loads(raw)
+    d["slice_host_bounds"] = [2]
+    d["host_coords"] = ["x", None]
+    bad = copy.deepcopy(nodes[0])
+    bad["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION] = (
+        json.dumps(d)
+    )
+    for path in ("/filter", "/prioritize"):
+        resp = requests.post(
+            f"{http_server}{path}",
+            json={"pod": tpu_pod(8), "nodes": {"items": [bad, nodes[1]]}},
+            timeout=10,
+        )
+        assert resp.status_code == 200, resp.text
+
+
 def test_score_zero_when_unsatisfiable():
     ext = TopologyExtender()
     mesh = make_mesh()

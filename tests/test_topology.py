@@ -301,3 +301,60 @@ def test_slice_view_best_gang():
     assert view.gang_score(2, "h0") == 0
     # 3-host gangs: no contiguous triple free (h1 splits the line).
     assert view.best_gang(3) == ([], 0)
+
+
+def test_mesh_discovered_coords_override_assumption():
+    # Valid driver-published coords (a permutation of the assumed grid)
+    # take effect; mismatches are counted, not ignored.
+    from k8s_device_plugin_tpu.utils import metrics
+
+    chips = make_chips("v5p", 4)
+    assumed = IciMesh(chips)
+    # Swap the coordinates of the first two chips vs the assumption.
+    discovered = {
+        chips[0].index: (1, 0, 0),
+        chips[1].index: (0, 0, 0),
+        chips[2].index: (0, 1, 0),
+        chips[3].index: (1, 1, 0),
+    }
+    m = IciMesh(chips, discovered_coords=discovered)
+    assert m.by_id[chips[0].device_id_str].coords == (1, 0, 0)
+    assert m.by_id[chips[1].device_id_str].coords == (0, 0, 0)
+    # Adjacency is rebuilt from the discovered layout, same mesh shape.
+    assert sorted(m.bounds) == sorted(assumed.bounds)
+
+
+def test_mesh_invalid_discovered_coords_fall_back():
+    chips = make_chips("v5p", 4)
+    # Duplicate coordinates: untrustworthy -> assumption kept.
+    bad = {c.index: (0, 0, 0) for c in chips}
+    m = IciMesh(chips, discovered_coords=bad)
+    assert m.by_id[chips[1].device_id_str].coords == (1, 0, 0)
+    # Partial coverage: also kept.
+    partial = {chips[0].index: (1, 1, 0)}
+    m2 = IciMesh(chips, discovered_coords=partial)
+    assert m2.by_id[chips[0].device_id_str].coords == (0, 0, 0)
+    # Out-of-bounds: kept.
+    oob = {c.index: (i, 0, 9) for i, c in enumerate(chips)}
+    m3 = IciMesh(chips, discovered_coords=oob)
+    assert m3.by_id[chips[0].device_id_str].coords == (0, 0, 0)
+
+
+def test_slice_view_drops_colliding_coords():
+    # Two members publishing the same host_coords (wrapped worker ids)
+    # make that grid point untrustworthy: both are excluded.
+    from k8s_device_plugin_tpu.topology.slice import SliceView
+
+    m = mesh_of("v5p", 4)
+    hosts = ["h0", "h1"]
+
+    def member(wid):
+        return NodeTopology.from_mesh(
+            m, hostname=hosts[wid % 2], worker_id=wid,
+            worker_hostnames=",".join(hosts), slice_host_bounds="2,1,1",
+        )
+
+    # worker ids 0 and 2 both wrap to coords [0,0,0] in a 2x1x1 grid.
+    view = SliceView([member(0), member(1), member(2)])
+    assert (0, 0, 0) not in view.by_coords
+    assert view.best_gang(2) == ([], 0)  # only h1's point survives
