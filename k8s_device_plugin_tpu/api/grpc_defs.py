@@ -105,6 +105,74 @@ def add_device_plugin_servicer(servicer: DevicePluginServicer, server: grpc.Serv
 
 
 # ---------------------------------------------------------------------------
+# Plugin-watcher registration (pluginregistration/v1) — the kubelet dials
+# the PLUGIN for this one, so the plugin serves it and the (fake) kubelet
+# consumes the stub.
+# ---------------------------------------------------------------------------
+
+from . import pluginregistration_pb2 as regpb  # noqa: E402
+
+WATCHER_REGISTRATION_SERVICE = "pluginregistration.Registration"
+
+
+class WatcherRegistrationServicer:
+    """Base class for the plugin-side watcher Registration service."""
+
+    def GetInfo(self, request: regpb.InfoRequest, context) -> regpb.PluginInfo:
+        raise NotImplementedError
+
+    def NotifyRegistrationStatus(
+        self, request: regpb.RegistrationStatus, context
+    ) -> regpb.RegistrationStatusResponse:
+        raise NotImplementedError
+
+
+def add_watcher_registration_servicer(
+    servicer: WatcherRegistrationServicer, server: grpc.Server
+) -> None:
+    handlers = {
+        "GetInfo": grpc.unary_unary_rpc_method_handler(
+            servicer.GetInfo,
+            request_deserializer=regpb.InfoRequest.FromString,
+            response_serializer=regpb.PluginInfo.SerializeToString,
+        ),
+        "NotifyRegistrationStatus": grpc.unary_unary_rpc_method_handler(
+            servicer.NotifyRegistrationStatus,
+            request_deserializer=regpb.RegistrationStatus.FromString,
+            response_serializer=(
+                regpb.RegistrationStatusResponse.SerializeToString
+            ),
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                WATCHER_REGISTRATION_SERVICE, handlers
+            ),
+        )
+    )
+
+
+class WatcherRegistrationStub:
+    """Client for the plugin's watcher Registration service (kubelet →
+    plugin; used by the fake kubelet watcher in tests)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.GetInfo = channel.unary_unary(
+            f"/{WATCHER_REGISTRATION_SERVICE}/GetInfo",
+            request_serializer=regpb.InfoRequest.SerializeToString,
+            response_deserializer=regpb.PluginInfo.FromString,
+        )
+        self.NotifyRegistrationStatus = channel.unary_unary(
+            f"/{WATCHER_REGISTRATION_SERVICE}/NotifyRegistrationStatus",
+            request_serializer=regpb.RegistrationStatus.SerializeToString,
+            response_deserializer=(
+                regpb.RegistrationStatusResponse.FromString
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
 # Client side
 # ---------------------------------------------------------------------------
 

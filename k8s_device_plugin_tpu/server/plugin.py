@@ -31,10 +31,13 @@ import grpc
 
 from ..api import constants
 from ..api import deviceplugin_pb2 as pb
+from ..api import pluginregistration_pb2 as regpb
 from ..api.grpc_defs import (
     DevicePluginServicer,
     RegistrationStub,
+    WatcherRegistrationServicer,
     add_device_plugin_servicer,
+    add_watcher_registration_servicer,
 )
 from ..topology.mesh import IciMesh
 from ..topology.placement import PlacementState
@@ -80,10 +83,26 @@ class PluginConfig:
     worker_id: int = 0
     worker_hostnames: str = ""
     slice_host_bounds: str = "1,1,1"
+    # How to register with the kubelet:
+    #   "register" — dial the kubelet's v1beta1 Registration.Register RPC
+    #                (the only path the reference has, server.go:136-155);
+    #   "watcher"  — serve pluginregistration/v1 on a socket under
+    #                plugins_registry_dir and let the kubelet's plugin
+    #                watcher dial us (kubelet >= 1.12);
+    #   "both"     — do both (harmless: the kubelet dedups by resource).
+    registration_mode: str = "register"
+    plugins_registry_dir: str = "/var/lib/kubelet/plugins_registry/"
+    watcher_socket_name: str = "google.com-tpu-reg.sock"
 
     @property
     def socket_path(self) -> str:
         return os.path.join(self.device_plugin_dir, self.plugin_socket_name)
+
+    @property
+    def watcher_socket_path(self) -> str:
+        return os.path.join(
+            self.plugins_registry_dir, self.watcher_socket_name
+        )
 
     @property
     def kubelet_socket(self) -> str:
@@ -108,6 +127,7 @@ class TpuDevicePlugin(DevicePluginServicer):
         # populated in substitute_on_allocate mode.
         self.shadow_map: Dict[str, str] = {}
         self._server: Optional[grpc.Server] = None
+        self._watcher_server: Optional[grpc.Server] = None
         self._stop = threading.Event()
         # Serializes Allocate plan→commit so concurrent RPCs (8-thread
         # executor) can't plan overlapping chip sets.
@@ -156,6 +176,13 @@ class TpuDevicePlugin(DevicePluginServicer):
         if self._server is not None:
             self._server.stop(grace=1).wait()
             self._server = None
+        if self._watcher_server is not None:
+            self._watcher_server.stop(grace=1).wait()
+            self._watcher_server = None
+            try:
+                os.unlink(self.config.watcher_socket_path)
+            except OSError:
+                pass
         try:
             os.unlink(self.config.socket_path)
         except OSError:
@@ -183,9 +210,57 @@ class TpuDevicePlugin(DevicePluginServicer):
             self.config.kubelet_socket,
         )
 
+    def start_watcher_registration(self) -> None:
+        """Serve pluginregistration/v1 under plugins_registry so the
+        kubelet's plugin watcher registers us (GetInfo → it dials our
+        DevicePlugin endpoint; NotifyRegistrationStatus reports back)."""
+        plugin = self
+
+        class _Watcher(WatcherRegistrationServicer):
+            def GetInfo(self, request, context):
+                return regpb.PluginInfo(
+                    type="DevicePlugin",
+                    name=plugin.config.resource_name,
+                    endpoint=plugin.config.socket_path,
+                    supported_versions=[constants.VERSION],
+                )
+
+            def NotifyRegistrationStatus(self, request, context):
+                if request.plugin_registered:
+                    log.info(
+                        "kubelet plugin watcher registered %s",
+                        plugin.config.resource_name,
+                    )
+                else:
+                    log.error(
+                        "kubelet plugin watcher REJECTED %s: %s",
+                        plugin.config.resource_name,
+                        request.error,
+                    )
+                    metrics.GRPC_ERRORS.inc(method="WatcherRegistration")
+                return regpb.RegistrationStatusResponse()
+
+        sock = self.config.watcher_socket_path
+        os.makedirs(self.config.plugins_registry_dir, exist_ok=True)
+        if os.path.exists(sock):
+            os.unlink(sock)
+        self._watcher_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=2)
+        )
+        add_watcher_registration_servicer(_Watcher(), self._watcher_server)
+        self._watcher_server.add_insecure_port(f"unix:{sock}")
+        self._watcher_server.start()
+        log.info("plugin-watcher registration socket at %s", sock)
+
     def serve(self) -> None:
         self.start()
-        self.register()
+        mode = self.config.registration_mode
+        if mode not in ("register", "watcher", "both"):
+            raise ValueError(f"unknown registration_mode {mode!r}")
+        if mode in ("watcher", "both"):
+            self.start_watcher_registration()
+        if mode in ("register", "both"):
+            self.register()
 
     # ------------------------------------------------------------------
     # Health plumbing (reference health chan, server.go:180-182)

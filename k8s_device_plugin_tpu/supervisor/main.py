@@ -74,6 +74,10 @@ class DaemonConfig:
     worker_id: int = 0
     worker_hostnames: str = ""
     slice_host_bounds: str = "1,1,1"
+    # Registration path: "register" (dial kubelet, reference-style),
+    # "watcher" (plugins_registry socket, kubelet >= 1.12), or "both".
+    registration_mode: str = "register"
+    plugins_registry_dir: str = "/var/lib/kubelet/plugins_registry/"
 
 
 class Daemon:
@@ -180,6 +184,8 @@ class Daemon:
                 worker_id=self.cfg.worker_id,
                 worker_hostnames=self.cfg.worker_hostnames,
                 slice_host_bounds=self.cfg.slice_host_bounds,
+                registration_mode=self.cfg.registration_mode,
+                plugins_registry_dir=self.cfg.plugins_registry_dir,
             ),
         )
         if chips:
@@ -319,6 +325,13 @@ def parse_args(argv) -> DaemonConfig:
     p.add_argument("--slice-host-bounds",
                    default=os.environ.get("TPU_HOST_BOUNDS", "1,1,1"),
                    help="host grid of the slice, e.g. 2,2,1")
+    p.add_argument("--registration-mode", default="register",
+                   choices=["register", "watcher", "both"],
+                   help="kubelet registration path: dial its Register RPC "
+                   "(reference-compatible), serve a plugins_registry "
+                   "watcher socket, or both")
+    p.add_argument("--plugins-registry-dir",
+                   default="/var/lib/kubelet/plugins_registry/")
     p.add_argument("--no-controller", action="store_true")
     p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
     p.add_argument("--python-backend", action="store_true",
@@ -348,6 +361,8 @@ def parse_args(argv) -> DaemonConfig:
         worker_id=a.worker_id,
         worker_hostnames=a.worker_hostnames,
         slice_host_bounds=a.slice_host_bounds,
+        registration_mode=a.registration_mode,
+        plugins_registry_dir=a.plugins_registry_dir,
     )
 
 

@@ -26,7 +26,11 @@ import dataclasses
 import itertools
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import logging
+
 from ..discovery.chips import AcceleratorSpec, TpuChip, spec_for
+
+log = logging.getLogger(__name__)
 
 Coord = Tuple[int, int, int]
 
@@ -136,9 +140,7 @@ class IciMesh:
             )
         )
         if not valid:
-            import logging
-
-            logging.getLogger(__name__).warning(
+            log.warning(
                 "discovered chip coordinates are incomplete or invalid "
                 "(%s within bounds %s); keeping the PCI-order assumption",
                 got,
@@ -147,11 +149,9 @@ class IciMesh:
             return assumed
         mismatches = sum(1 for a, g in zip(assumed, got) if a != g)
         if mismatches:
-            import logging
-
             from ..utils import metrics
 
-            logging.getLogger(__name__).warning(
+            log.warning(
                 "driver-published ICI coordinates differ from the "
                 "PCI-order assumption for %d/%d chips; using the "
                 "published ground truth",

@@ -24,8 +24,12 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import logging
+
 from .placement import _box_shapes, box_links, ideal_box_links
 from .schema import NodeTopology
+
+log = logging.getLogger(__name__)
 
 Coord = Tuple[int, int, int]
 
@@ -85,9 +89,7 @@ class SliceView:
             self.by_coords[c] = t
         for c, count in seen.items():
             if count > 1:
-                import logging
-
-                logging.getLogger(__name__).warning(
+                log.warning(
                     "slice %s: %d members publish host_coords %s "
                     "(misconfigured worker ids?); excluding that grid "
                     "point from gang evaluation",

@@ -389,7 +389,11 @@ int tpuinfo_chip_coords(const char* sysfs_class_dir, int index,
     errno = 0;
     char* end = nullptr;
     long v = std::strtol(part.c_str(), &end, 10);
-    if (errno != 0 || end == part.c_str() || v < 0) return -EINVAL;
+    /* The whole token must be the number (reject "1abc"); the Python
+     * backend rejects the same inputs — parity-tested. */
+    while (end != nullptr && (*end == ' ' || *end == '\t')) ++end;
+    if (errno != 0 || end == part.c_str() || *end != '\0' || v < 0)
+      return -EINVAL;
     vals[n++] = static_cast<int>(v);
   }
   if (n == 0) return -EINVAL;

@@ -333,3 +333,71 @@ def test_cdi_devices_when_enabled(tmp_path, dp_dir, kubelet):
         assert len(cresp.devices) == 2
     finally:
         p.stop()
+
+
+# ---------------------------------------------------------------------------
+# Plugin-watcher registration (pluginregistration/v1)
+# ---------------------------------------------------------------------------
+
+def test_watcher_registration_flow(tmp_path, dp_dir):
+    """Act as the kubelet's plugin watcher: find the socket under
+    plugins_registry, GetInfo, dial the advertised DevicePlugin endpoint,
+    then report the outcome via NotifyRegistrationStatus."""
+    from k8s_device_plugin_tpu.api import pluginregistration_pb2 as regpb
+    from k8s_device_plugin_tpu.api.grpc_defs import (
+        DevicePluginStub,
+        WatcherRegistrationStub,
+    )
+
+    registry = tmp_path / "plugins_registry"
+    p = make_plugin(
+        tmp_path, dp_dir,
+        registration_mode="watcher",
+        plugins_registry_dir=str(registry),
+    )
+    p.serve()  # no fake kubelet: watcher mode must not dial Register
+    try:
+        socks = os.listdir(registry)
+        assert socks == [p.config.watcher_socket_name]
+        with grpc.insecure_channel(
+            f"unix:{registry / socks[0]}"
+        ) as ch:
+            stub = WatcherRegistrationStub(ch)
+            info = stub.GetInfo(regpb.InfoRequest(), timeout=5)
+            assert info.type == "DevicePlugin"
+            assert info.name == constants.RESOURCE_NAME
+            assert list(info.supported_versions) == [constants.VERSION]
+            # Dial the advertised endpoint like the kubelet would.
+            with grpc.insecure_channel(f"unix:{info.endpoint}") as pch:
+                resp = DevicePluginStub(pch).GetDevicePluginOptions(
+                    pb.Empty(), timeout=5
+                )
+                assert resp.get_preferred_allocation_available
+            stub.NotifyRegistrationStatus(
+                regpb.RegistrationStatus(plugin_registered=True), timeout=5
+            )
+    finally:
+        p.stop()
+    assert not os.path.exists(registry / p.config.watcher_socket_name)
+
+
+def test_watcher_mode_both_also_dials_kubelet(tmp_path, dp_dir, kubelet):
+    registry = tmp_path / "plugins_registry"
+    p = make_plugin(
+        tmp_path, dp_dir,
+        registration_mode="both",
+        plugins_registry_dir=str(registry),
+    )
+    p.serve()
+    try:
+        assert kubelet.registered.wait(5)  # Register RPC still happened
+        assert os.listdir(registry) == [p.config.watcher_socket_name]
+    finally:
+        p.stop()
+
+
+def test_unknown_registration_mode_rejected(tmp_path, dp_dir):
+    p = make_plugin(tmp_path, dp_dir, registration_mode="bogus")
+    with pytest.raises(ValueError):
+        p.serve()
+    p.stop()
