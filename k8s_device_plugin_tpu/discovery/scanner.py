@@ -506,13 +506,13 @@ class PyTpuInfo:
         parts = _read_trimmed(path).split(",")
         vals = []
         for p in parts[:3]:
-            try:
-                v = int(p.strip())
-            except ValueError:
+            p = p.strip()
+            # ASCII decimal digits only — int() alone is looser than the
+            # native strtol+end check (it takes '1_0', unicode digits);
+            # both backends must reject identical inputs (parity-tested).
+            if not p or not p.isascii() or not p.isdigit():
                 raise OSError(22, f"garbled coords attribute {path!r}")
-            if v < 0:
-                raise OSError(22, f"garbled coords attribute {path!r}")
-            vals.append(v)
+            vals.append(int(p))
         if not vals:
             raise OSError(22, f"garbled coords attribute {path!r}")
         while len(vals) < 3:
@@ -642,6 +642,33 @@ class PyTpuInfo:
             os.close(fd)
         except OSError:
             pass
+
+
+def collect_chip_coords(
+    backend, sysfs_accel_dir: str, chips
+) -> "Optional[dict]":
+    """Driver-published ICI coordinates per chip index, when the backend
+    and sysfs expose them (tpuinfo_chip_coords); None keeps the PCI-order
+    assumption. Shared by the daemon and the topo debug CLI so the two
+    render identical meshes; a garbled attribute warns (naming the chip)
+    and falls back — never crashes discovery."""
+    if not hasattr(backend, "chip_coords"):
+        return None
+    out = {}
+    for c in chips:
+        try:
+            xyz = backend.chip_coords(sysfs_accel_dir, c.index)
+        except OSError as e:
+            log.warning(
+                "chip coords read failed for accel%d (%s); keeping the "
+                "PCI-order assumption",
+                c.index,
+                e,
+            )
+            return None
+        if xyz is not None:
+            out[c.index] = xyz
+    return out or None
 
 
 def get_backend(prefer_native: bool = True):

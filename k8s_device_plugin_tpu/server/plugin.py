@@ -253,10 +253,13 @@ class TpuDevicePlugin(DevicePluginServicer):
         log.info("plugin-watcher registration socket at %s", sock)
 
     def serve(self) -> None:
-        self.start()
         mode = self.config.registration_mode
         if mode not in ("register", "watcher", "both"):
+            # Before start(): the error path must not leave a running gRPC
+            # server + plugin socket behind (argparse choices guard the
+            # CLI; this guards library callers).
             raise ValueError(f"unknown registration_mode {mode!r}")
+        self.start()
         if mode in ("watcher", "both"):
             self.start_watcher_registration()
         if mode in ("register", "both"):

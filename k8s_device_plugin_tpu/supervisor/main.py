@@ -36,6 +36,7 @@ from ..discovery.scanner import (
     DEFAULT_DEV,
     DEFAULT_NUMA_DIR,
     DEFAULT_SYSFS_ACCEL,
+    collect_chip_coords,
     get_backend,
 )
 from ..health.watcher import HealthWatcher, healthchecks_disabled
@@ -129,33 +130,14 @@ class Daemon:
         )
         return chips
 
-    def _discover_coords(self, chips) -> Optional[dict]:
-        """Driver-published ICI coordinates per chip index, when the
-        backend and sysfs expose them (tpuinfo_chip_coords); None keeps
-        the PCI-order assumption."""
-        if not hasattr(self.backend, "chip_coords"):
-            return None
-        out = {}
-        for c in chips:
-            try:
-                xyz = self.backend.chip_coords(
-                    self.cfg.sysfs_accel_dir, c.index
-                )
-            except OSError as e:
-                log.warning(
-                    "chip coords read failed for accel%d (%s); keeping "
-                    "the PCI-order assumption",
-                    c.index,
-                    e,
-                )
-                return None
-            if xyz is not None:
-                out[c.index] = xyz
-        return out or None
-
     def build_and_serve(self) -> None:
         chips = self.discover()
-        mesh = IciMesh(chips, discovered_coords=self._discover_coords(chips))
+        mesh = IciMesh(
+            chips,
+            discovered_coords=collect_chip_coords(
+                self.backend, self.cfg.sysfs_accel_dir, chips
+            ),
+        )
         state = PlacementState(mesh)
         self._kube_client = None
         if self.cfg.enable_controller:

@@ -16,7 +16,12 @@ import argparse
 import json
 import sys
 
-from ..discovery.scanner import DEFAULT_DEV, DEFAULT_SYSFS_ACCEL, get_backend
+from ..discovery.scanner import (
+    DEFAULT_DEV,
+    DEFAULT_SYSFS_ACCEL,
+    collect_chip_coords,
+    get_backend,
+)
 from ..topology.mesh import IciMesh
 from ..topology.placement import PlacementState
 from ..topology.schema import NodeTopology
@@ -70,24 +75,47 @@ def main(argv=None) -> int:
     a = p.parse_args(argv)
 
     available = None
+    extra = []
     if a.from_json:
         with open(a.from_json) as f:
             topo = NodeTopology.from_json(f.read())
         mesh = topo.to_mesh()
         available = topo.available
+        if topo.slice_hosts:
+            extra.append(
+                f"slice: worker {topo.worker_id} at "
+                f"{tuple(topo.host_coords)} in host grid "
+                f"{'x'.join(map(str, topo.slice_host_bounds))} of "
+                f"{topo.slice_hosts}"
+            )
+        if topo.host:
+            h = topo.host
+            extra.append(
+                f"host: {h.get('cpu_count', 0)} cpus / "
+                f"{h.get('cpu_sockets', 0)} sockets, "
+                f"{h.get('mem_total_bytes', 0) // (1 << 30)} GiB — "
+                f"{h.get('cpu_model', '')}"
+            )
     else:
         backend = get_backend()
         chips = backend.scan(a.sysfs, a.dev)
         if not chips:
             print("no TPU chips found (CPU-only node?)", file=sys.stderr)
             return 1
-        mesh = IciMesh(chips)
+        # Same coordinate resolution as the daemon (shared helper, so the
+        # debug view and the daemon render identical meshes).
+        mesh = IciMesh(
+            chips,
+            discovered_coords=collect_chip_coords(backend, a.sysfs, chips),
+        )
 
     if a.json:
         print(NodeTopology.from_mesh(mesh, available=available).to_json())
         return 0
 
     print(render_mesh(mesh, available))
+    for line in extra:
+        print(line)
     if a.select:
         state = PlacementState(mesh)
         if available is not None:

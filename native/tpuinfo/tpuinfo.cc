@@ -386,14 +386,18 @@ int tpuinfo_chip_coords(const char* sysfs_class_dir, int index,
   std::stringstream ss(s);
   std::string part;
   while (std::getline(ss, part, ',') && n < 3) {
+    /* Trim, then require pure ASCII decimal digits — no sign, no hex,
+     * no trailing garbage. Exactly what the Python backend accepts
+     * (parity-tested); strtol alone is looser ("+1", "0x1", "1abc"). */
+    size_t b = part.find_first_not_of(" \t\r\n\f\v");
+    size_t e = part.find_last_not_of(" \t\r\n\f\v");
+    if (b == std::string::npos) return -EINVAL;
+    std::string tok = part.substr(b, e - b + 1);
+    for (char ch : tok)
+      if (ch < '0' || ch > '9') return -EINVAL;
     errno = 0;
-    char* end = nullptr;
-    long v = std::strtol(part.c_str(), &end, 10);
-    /* The whole token must be the number (reject "1abc"); the Python
-     * backend rejects the same inputs — parity-tested. */
-    while (end != nullptr && (*end == ' ' || *end == '\t')) ++end;
-    if (errno != 0 || end == part.c_str() || *end != '\0' || v < 0)
-      return -EINVAL;
+    long v = std::strtol(tok.c_str(), nullptr, 10);
+    if (errno != 0 || v < 0) return -EINVAL;
     vals[n++] = static_cast<int>(v);
   }
   if (n == 0) return -EINVAL;

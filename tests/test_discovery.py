@@ -117,9 +117,16 @@ def test_chip_coords_backend_parity(backend, tmp_path):
     assert backend.chip_coords(accel, 0) == (1, 0, 0)
     fakes.set_chip_coords(accel, 1, "0,1")  # short form pads with 0
     assert backend.chip_coords(accel, 1) == (0, 1, 0)
-    fakes.set_chip_coords(accel, 2, "garbage")
-    with pytest.raises(OSError):
-        backend.chip_coords(accel, 2)
+    # Both backends must reject IDENTICAL inputs: trailing garbage,
+    # signs, underscore separators, unicode digits (Python int() and C
+    # strtol are each looser than the shared contract in different ways).
+    for bad in ("garbage", "1abc,0,0", "+1,0,0", "-1,0,0", "1_0,0,0",
+                "１,0,0", "0x1,0,0", ",,"):
+        fakes.set_chip_coords(accel, 2, bad)
+        with pytest.raises(OSError):
+            backend.chip_coords(accel, 2)
+    fakes.set_chip_coords(accel, 2, " 1 , 1 , 0 ")  # whitespace tolerated
+    assert backend.chip_coords(accel, 2) == (1, 1, 0)
 
 
 def test_host_info_backend_parity(native_lib, tmp_path):
