@@ -124,10 +124,10 @@ def run_workload_subprocess() -> dict:
                 # below the uniform-target entropy floor; caught by the
                 # first_loss_sane check) and batch 8 crashes it. inner 40
                 # amortizes per-dispatch/per-buffer link overhead (see
-                # make_multi_train_step); inner 80 measures ~0.43 MFU vs
-                # ~0.35 here but pushes the COLD time-to-first-step past
-                # the 30 s north star (the first dispatch runs all inner
-                # steps), so 40 is the default operating point.
+                # make_multi_train_step): ~0.50 MFU warm-cache / 151 ms
+                # per step on v5e; inner 80 measures ~0.52 warm but its
+                # longer windows absorb more shared-chip contention when
+                # cold, so 40 is the robust default.
                 "--bench --steps 80 --batch-per-device 4 --inner-steps 40",
             ).split()
             env = dict(os.environ)

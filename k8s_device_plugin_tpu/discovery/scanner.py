@@ -506,13 +506,18 @@ class PyTpuInfo:
         parts = _read_trimmed(path).split(",")
         vals = []
         for p in parts[:3]:
-            p = p.strip()
-            # ASCII decimal digits only — int() alone is looser than the
-            # native strtol+end check (it takes '1_0', unicode digits);
-            # both backends must reject identical inputs (parity-tested).
+            # Trim the native parser's exact whitespace set (a bare
+            # .strip() also removes Unicode whitespace the C++ side
+            # keeps), then ASCII decimal digits only with the same
+            # INT32_MAX bound — both backends accept and reject
+            # byte-identical inputs (parity-tested).
+            p = p.strip(" \t\r\n\f\v")
             if not p or not p.isascii() or not p.isdigit():
                 raise OSError(22, f"garbled coords attribute {path!r}")
-            vals.append(int(p))
+            v = int(p)
+            if v > 2147483647:
+                raise OSError(22, f"garbled coords attribute {path!r}")
+            vals.append(v)
         if not vals:
             raise OSError(22, f"garbled coords attribute {path!r}")
         while len(vals) < 3:

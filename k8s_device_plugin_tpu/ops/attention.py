@@ -102,13 +102,17 @@ def _fwd_kernel(
     # no MXU work (the tile DMA still happens; grids are static).
     @pl.when(kj * block_kv <= (qi + 1) * block_q - 1)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)          # (block_kv, d)
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
+        # Matmuls run in the INPUT dtype with f32 accumulation
+        # (preferred_element_type): bf16 inputs hit the MXU at full rate
+        # (an upfront astype(f32) would halve matmul throughput), while
+        # f32 inputs (CPU tests) keep exact f32 math.
+        q = q_ref[0]  # (block_q, d)
+        k = k_ref[0]  # (block_kv, d)
+        v = v_ref[0]
+        s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (block_q, block_kv)
+        )  # (block_q, block_kv) f32
         s = jnp.where(_causal_mask(qi, kj, block_q, block_kv), s, _NEG_INF)
 
         m_prev = m_scr[...]  # (block_q, 128) lane-broadcast
@@ -123,7 +127,7 @@ def _fwd_kernel(
         acc_scr[...] = acc_scr[...] * _cols(
             alpha, acc_scr.shape[-1]
         ) + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scr[...] = m_next
@@ -158,14 +162,14 @@ def _dq_kernel(
 
     @pl.when(kj * block_kv <= (qi + 1) * block_q - 1)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        o = o_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        o = o_ref[0]
         lse = lse_ref[0]  # (block_q, _LSE_LANES), lanes identical
-        s = jax.lax.dot_general(
-            q * scale, k, (((1,), (1,)), ((), ())),
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         s = jnp.where(_causal_mask(qi, kj, block_q, block_kv), s, _NEG_INF)
@@ -175,11 +179,14 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         )
         # delta = rowsum(dO · O), recomputed per tile (cheap; saves an
-        # HBM residual).
-        delta = _lanes(jnp.sum(do * o, axis=-1, keepdims=True))
+        # HBM residual). f32 elementwise regardless of input dtype.
+        delta = _lanes(jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32),
+            axis=-1, keepdims=True,
+        ))
         ds = p * (dp - _cols(delta, dp.shape[-1]))
         dq_scr[...] += scale * jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -205,30 +212,33 @@ def _dkv_kernel(
     # fully masked (causal) — skip.
     @pl.when((qi + 1) * block_q - 1 >= kj * block_kv)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        o = o_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        o = o_ref[0]
         lse = lse_ref[0]
-        s = jax.lax.dot_general(
-            q * scale, k, (((1,), (1,)), ((), ())),
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         s = jnp.where(_causal_mask(qi, kj, block_q, block_kv), s, _NEG_INF)
         p = jnp.exp(s - _cols(lse, s.shape[-1]))  # (block_q, block_kv)
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_kv, d)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        delta = _lanes(jnp.sum(do * o, axis=-1, keepdims=True))
+        delta = _lanes(jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32),
+            axis=-1, keepdims=True,
+        ))
         ds = p * (dp - _cols(delta, dp.shape[-1]))
         dk_scr[...] += scale * jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_kv, d)
 

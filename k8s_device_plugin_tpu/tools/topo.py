@@ -86,7 +86,9 @@ def main(argv=None) -> int:
                 f"slice: worker {topo.worker_id} at "
                 f"{tuple(topo.host_coords)} in host grid "
                 f"{'x'.join(map(str, topo.slice_host_bounds))} of "
-                f"{topo.slice_hosts}"
+                f"{len(topo.slice_hosts)} hosts: "
+                f"{', '.join(topo.slice_hosts[:8])}"
+                f"{', ...' if len(topo.slice_hosts) > 8 else ''}"
             )
         if topo.host:
             h = topo.host

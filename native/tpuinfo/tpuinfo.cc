@@ -397,7 +397,9 @@ int tpuinfo_chip_coords(const char* sysfs_class_dir, int index,
       if (ch < '0' || ch > '9') return -EINVAL;
     errno = 0;
     long v = std::strtol(tok.c_str(), nullptr, 10);
-    if (errno != 0 || v < 0) return -EINVAL;
+    /* Shared upper bound with the Python backend (INT32_MAX): without
+     * it static_cast<int> would silently wrap huge values. */
+    if (errno != 0 || v < 0 || v > 2147483647L) return -EINVAL;
     vals[n++] = static_cast<int>(v);
   }
   if (n == 0) return -EINVAL;

@@ -121,7 +121,9 @@ def test_chip_coords_backend_parity(backend, tmp_path):
     # signs, underscore separators, unicode digits (Python int() and C
     # strtol are each looser than the shared contract in different ways).
     for bad in ("garbage", "1abc,0,0", "+1,0,0", "-1,0,0", "1_0,0,0",
-                "１,0,0", "0x1,0,0", ",,"):
+                "１,0,0", "0x1,0,0", ",,",
+                "4294967297,0,0",  # > INT32_MAX: shared bound, no wrap
+                "1,\u00a02,0"):  # interior NBSP: outside the trim set
         fakes.set_chip_coords(accel, 2, bad)
         with pytest.raises(OSError):
             backend.chip_coords(accel, 2)
