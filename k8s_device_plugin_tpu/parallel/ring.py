@@ -45,9 +45,14 @@ def _ring_attention_local(
     *,
     axis_name: str,
     n_shards: int,
+    q_chunk: int = 0,
 ) -> jax.Array:
     """Per-shard body (runs inside shard_map): q/k/v are the local
-    [batch, heads, seq_local, head_dim] shards."""
+    [batch, heads, seq_local, head_dim] shards. ``q_chunk`` > 0 scans the
+    query dimension in chunks of that size inside each ring step, capping
+    the materialized score buffer at [b, h, q_chunk, s_local] instead of
+    [b, h, s_local, s_local] — the flash-style memory bound for
+    long-context shards (must divide s_local)."""
     _, _, s_local, d = q.shape
     idx = lax.axis_index(axis_name)
     scale = 1.0 / (d ** 0.5)
@@ -71,17 +76,15 @@ def _ring_attention_local(
     # rotates then computes.
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
 
-    def fold(state, t, k_cur, v_cur):
-        m, l, acc = state
-        src = (idx - t) % n_shards
-        kv_pos = src * s_local + jnp.arange(s_local)
+    def _fold_block(m, l, acc, qc, qc_pos, kv_pos, k_cur, v_cur):
+        """Online-softmax update of one (q block) x (kv shard) tile."""
         s = jnp.einsum(
             "bhqd,bhkd->bhqk",
-            q32,
+            qc,
             k_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
-        causal = kv_pos[None, :] <= q_pos[:, None]  # [s_local, s_local]
+        causal = kv_pos[None, :] <= qc_pos[:, None]
         s = jnp.where(causal[None, None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -93,7 +96,48 @@ def _ring_attention_local(
             v_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
-        return (m_new, l_new, acc_new)
+        return m_new, l_new, acc_new
+
+    def fold(state, t, k_cur, v_cur):
+        m, l, acc = state
+        src = (idx - t) % n_shards
+        kv_pos = src * s_local + jnp.arange(s_local)
+        if not q_chunk or q_chunk >= s_local:
+            m, l, acc = _fold_block(
+                m, l, acc, q32, q_pos, kv_pos, k_cur, v_cur
+            )
+            return (m, l, acc)
+        # Chunked queries: scan q blocks so only a
+        # [b, h, q_chunk, s_local] score tile is ever live. The body is
+        # rematerialized (jax.checkpoint): without it, AD would store
+        # every chunk's probability tile for the einsum transposes and
+        # restore the O(s_local²) peak this path exists to avoid — with
+        # it, the backward recomputes each tile from the O(q_chunk)
+        # residuals.
+        n_chunks = s_local // q_chunk
+        folded = jax.checkpoint(_fold_block)
+
+        def chunk_body(_, c):
+            qc = lax.dynamic_slice_in_dim(q32, c * q_chunk, q_chunk, axis=2)
+            qc_pos = lax.dynamic_slice_in_dim(q_pos, c * q_chunk, q_chunk)
+            mc = lax.dynamic_slice_in_dim(m, c * q_chunk, q_chunk, axis=2)
+            lc = lax.dynamic_slice_in_dim(l, c * q_chunk, q_chunk, axis=2)
+            ac = lax.dynamic_slice_in_dim(acc, c * q_chunk, q_chunk, axis=2)
+            mc, lc, ac = folded(
+                mc, lc, ac, qc, qc_pos, kv_pos, k_cur, v_cur
+            )
+            return None, (mc, lc, ac)
+
+        _, (ms, ls, accs) = lax.scan(
+            chunk_body, None, jnp.arange(n_chunks)
+        )
+        # [n_chunks, b, h, q_chunk, ...] -> [b, h, s_local, ...]
+        def unchunk(x):
+            return jnp.moveaxis(x, 0, 2).reshape(
+                x.shape[1], x.shape[2], s_local, x.shape[-1]
+            )
+
+        return (unchunk(ms), unchunk(ls), unchunk(accs))
 
     def step(carry, t):
         m, l, acc, k_cur, v_cur = carry
@@ -121,20 +165,36 @@ def ring_attention(
     seq_axis: str = SEQ_AXIS,
     batch_axes: Union[str, Sequence[str]] = (DATA_AXIS, FSDP_AXIS),
     heads_axis: str = MODEL_AXIS,
+    q_chunk: int = 0,
 ) -> jax.Array:
     """Causal attention over [batch, heads, seq, head_dim] with seq sharded
     over ``seq_axis`` (and batch/heads over their axes as usual).
 
     Exact (not approximate): identical math to full softmax attention, just
     accumulated ring-step by ring-step. Requires batch/heads/seq divisible
-    by the respective mesh axis sizes.
+    by the respective mesh axis sizes. ``q_chunk`` > 0 (dividing the local
+    seq shard) additionally bounds per-step memory at a
+    [q_chunk, s_local] score tile — the flash-style cap for long-context
+    shards whose full [s_local, s_local] score matrix would not fit.
     """
     n_shards = mesh.shape[seq_axis]
+    if q_chunk:
+        s_local = q.shape[2] // n_shards
+        if s_local % q_chunk:
+            # Validate here, where both quantities are known — inside
+            # shard_map the failure would be a cryptic reshape mismatch.
+            raise ValueError(
+                f"q_chunk={q_chunk} must divide the local seq shard "
+                f"{s_local} (seq {q.shape[2]} over {n_shards} shards)"
+            )
     spec = P(tuple(batch_axes) if not isinstance(batch_axes, str)
              else batch_axes, heads_axis, seq_axis, None)
     fn = jax.shard_map(
         functools.partial(
-            _ring_attention_local, axis_name=seq_axis, n_shards=n_shards
+            _ring_attention_local,
+            axis_name=seq_axis,
+            n_shards=n_shards,
+            q_chunk=q_chunk,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

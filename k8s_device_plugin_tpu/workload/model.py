@@ -62,6 +62,9 @@ class ModelConfig:
     # use_flash_attention.
     use_ring_attention: bool = False
     ring_mesh: Any = None
+    # q-chunk size for ring attention (0 = unchunked): caps each ring
+    # step's score tile at [q_chunk, s_local] for long-context shards.
+    ring_q_chunk: int = 0
     # Expert parallelism: n_experts > 0 replaces the dense MLP with a
     # routed MoE (workload/moe.py) whose expert dim shards over the mesh's
     # ``expert`` axis. Aux load-balance loss is sown and picked up by
@@ -238,6 +241,7 @@ class Attention(nn.Module):
                 k.transpose(0, 2, 1, 3),
                 v.transpose(0, 2, 1, 3),
                 cfg.ring_mesh,
+                q_chunk=cfg.ring_q_chunk,
             ).transpose(0, 2, 1, 3)
         elif cfg.use_flash_attention:
             # Pallas flash-attention path; (b,s,h,k) -> (b,h,s,k).
