@@ -1,0 +1,155 @@
+/* Native self-test for libtpuinfo, built and run under ASan+UBSan
+ * (`make check`) — the sanitizer coverage the reference's cgo surfaces
+ * never had (SURVEY.md §5: no -race CI, no sanitizer builds). Builds a
+ * fake sysfs/dev/proc tree in a tmpdir and exercises every entry point,
+ * including the hostile inputs the Python parity tests pin down
+ * (garbled health bytes, malformed coords, oversized values). Exits
+ * non-zero on any assertion or sanitizer report. */
+
+#include "tpuinfo.h"
+
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <fstream>
+#include <string>
+
+static int failures = 0;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ++failures;                                                     \
+    }                                                                 \
+  } while (0)
+
+static void WriteFile(const std::string& path, const std::string& content) {
+  std::ofstream f(path);
+  f << content;
+}
+
+static void WriteBytes(const std::string& path, const char* data, size_t n) {
+  std::ofstream f(path, std::ios::binary);
+  f.write(data, static_cast<std::streamsize>(n));
+}
+
+int main() {
+  char tmpl[] = "/tmp/tpuinfo-selftest-XXXXXX";
+  char* root = mkdtemp(tmpl);
+  if (root == nullptr) {
+    perror("mkdtemp");
+    return 1;
+  }
+  std::string base(root);
+  std::string accel = base + "/sys/class/accel";
+  std::string dev = base + "/dev";
+  std::string proc = base + "/proc";
+  std::string nodes = base + "/nodes";
+  for (int i = 0; i < 4; ++i) {
+    std::string d = accel + "/accel" + std::to_string(i) + "/device";
+    std::string cmd = "mkdir -p '" + d + "' '" + dev + "' '" + proc +
+                      "' '" + nodes + "/node0'";
+    CHECK(system(cmd.c_str()) == 0);
+    WriteFile(d + "/vendor", "0x1ae0\n");
+    WriteFile(d + "/device", "0x0063\n");
+    WriteFile(d + "/numa_node", "0\n");
+    char pci[64];
+    snprintf(pci, sizeof(pci), "PCI_SLOT_NAME=0000:00:%02x.0\n", 4 + i);
+    WriteFile(d + "/uevent", pci);
+    WriteFile(dev + "/accel" + std::to_string(i), "");
+  }
+
+  /* Scan: 4 v5p chips, PCI order, correct model data. */
+  tpuinfo_chip chips[TPUINFO_MAX_CHIPS];
+  int n = tpuinfo_scan(accel.c_str(), dev.c_str(), chips, TPUINFO_MAX_CHIPS);
+  CHECK(n == 4);
+  CHECK(strcmp(chips[0].chip_type, "v5p") == 0);
+  CHECK(chips[0].core_count == 2);
+  CHECK(strcmp(chips[0].pci_addr, "0000:00:04.0") == 0);
+  /* Truncation contract: count returned even when the buffer is small. */
+  CHECK(tpuinfo_scan(accel.c_str(), dev.c_str(), chips, 2) == 4);
+  /* Missing class dir = CPU-only node, not an error. */
+  CHECK(tpuinfo_scan((base + "/nope").c_str(), dev.c_str(), chips, 4) == 0);
+
+  /* Health + reasons, incl. non-UTF-8 garbage bytes. */
+  char reason[TPUINFO_REASON_LEN];
+  CHECK(tpuinfo_chip_health(accel.c_str(), dev.c_str(), 0) == 1);
+  WriteFile(accel + "/accel0/device/health", "HBM ECC!\n");
+  CHECK(tpuinfo_chip_health_reason(accel.c_str(), dev.c_str(), 0, reason,
+                                   sizeof(reason)) == 0);
+  CHECK(strcmp(reason, "hbm_ecc_") == 0);
+  const char garbage[] = {'\xfc', '\xfc', 'F', '\n'};
+  WriteBytes(accel + "/accel1/device/health", garbage, sizeof(garbage));
+  CHECK(tpuinfo_chip_health_reason(accel.c_str(), dev.c_str(), 1, reason,
+                                   sizeof(reason)) == 0);
+  CHECK(strcmp(reason, "__f") == 0);
+  /* Tiny reason buffer: truncated, never overrun (ASan enforces). */
+  char tiny[4];
+  CHECK(tpuinfo_chip_health_reason(accel.c_str(), dev.c_str(), 0, tiny,
+                                   sizeof(tiny)) == 0);
+  CHECK(strlen(tiny) == 3);
+  CHECK(tpuinfo_chip_health(accel.c_str(), dev.c_str(), 9) == -ENOENT);
+
+  /* Coords: valid, short-form, hostile. */
+  int xyz[3];
+  CHECK(tpuinfo_chip_coords(accel.c_str(), 2, xyz) == 0); /* unpublished */
+  WriteFile(accel + "/accel2/device/coords", " 1 , 1 \n");
+  CHECK(tpuinfo_chip_coords(accel.c_str(), 2, xyz) == 1);
+  CHECK(xyz[0] == 1 && xyz[1] == 1 && xyz[2] == 0);
+  const char* bad_coords[] = {"1abc,0,0", "+1,0,0", "-1,0,0",
+                              "4294967297,0,0", "0x1,0,0", ",,"};
+  for (const char* bc : bad_coords) {
+    WriteFile(accel + "/accel2/device/coords", bc);
+    CHECK(tpuinfo_chip_coords(accel.c_str(), 2, xyz) == -EINVAL);
+  }
+
+  /* Host info. */
+  WriteFile(proc + "/meminfo", "MemTotal:       1000 kB\n");
+  WriteFile(proc + "/cpuinfo",
+            "processor\t: 0\nmodel name\t: Fake CPU\nphysical id\t: 0\n\n"
+            "processor\t: 1\nmodel name\t: Fake CPU\nphysical id\t: 1\n\n");
+  tpuinfo_host_info_t hi;
+  CHECK(tpuinfo_host_info(proc.c_str(), &hi) == 0);
+  CHECK(hi.mem_total_bytes == 1000 * 1024LL);
+  CHECK(hi.cpu_count == 2 && hi.cpu_sockets == 2);
+  CHECK(strcmp(hi.cpu_model, "Fake CPU") == 0);
+  CHECK(tpuinfo_host_info((base + "/nope").c_str(), &hi) == 0);
+  CHECK(hi.cpu_count == 0);
+
+  /* NUMA. */
+  WriteFile(nodes + "/node0/meminfo", "Node 0 MemTotal: 2048 kB\n");
+  WriteFile(nodes + "/node0/cpulist", "0-3\n");
+  tpuinfo_numa_node_info ni[4];
+  CHECK(tpuinfo_numa_node_count(nodes.c_str()) == 1);
+  CHECK(tpuinfo_numa_topology(nodes.c_str(), ni, 4) == 1);
+  CHECK(ni[0].cpu_count == 4 && ni[0].mem_total_bytes == 2048 * 1024LL);
+
+  /* Event source: open, quiet wait, wake on write, close. */
+  int fd = tpuinfo_health_events_open(accel.c_str(), dev.c_str());
+  CHECK(fd >= 0);
+  CHECK(tpuinfo_health_events_wait(fd, 10) == 0);
+  WriteFile(accel + "/accel0/device/health", "ok\n");
+  CHECK(tpuinfo_health_events_wait(fd, 2000) == 1);
+  tpuinfo_health_events_close(fd);
+  CHECK(tpuinfo_health_events_open((base + "/na").c_str(),
+                                   (base + "/nb").c_str()) == -ENOENT);
+
+  /* NULL-argument contract. */
+  CHECK(tpuinfo_scan(nullptr, dev.c_str(), chips, 4) == -EINVAL);
+  CHECK(tpuinfo_chip_coords(accel.c_str(), 0, nullptr) == -EINVAL);
+  CHECK(tpuinfo_host_info(nullptr, &hi) == -EINVAL);
+
+  std::string cleanup = "rm -rf '" + base + "'";
+  CHECK(system(cleanup.c_str()) == 0);
+  if (failures == 0) {
+    printf("tpuinfo selftest: all checks passed\n");
+    return 0;
+  }
+  fprintf(stderr, "tpuinfo selftest: %d failures\n", failures);
+  return 1;
+}

@@ -92,6 +92,20 @@ def test_chip_health_missing_chip_raises(backend, tmp_path):
         backend.chip_health(accel, dev, 7)
 
 
+def test_native_selftest_under_sanitizers():
+    """`make check`: the C++ shim's entry points driven under
+    ASan+UBSan (native/tpuinfo/selftest.cc) — memory-safety coverage the
+    reference's cgo surfaces never had (SURVEY.md §5)."""
+    r = subprocess.run(
+        ["make", "-C", NATIVE_DIR, "check"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "all checks passed" in r.stdout
+
+
 def test_native_and_python_scan_identical(native_lib, tmp_path):
     accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v4", 4, numa_of=lambda i: i // 2)
     native = NativeTpuInfo(native_lib).scan(accel, dev)
