@@ -32,6 +32,13 @@ UNHEALTHY = "Unhealthy"
 # /root/reference/controller.go:184-197.
 KUBELET_CHECKPOINT = DEVICE_PLUGIN_PATH + "kubelet_internal_checkpoint"
 
+# Kubelet PodResources API socket (podresources/v1, GA k8s 1.28). The
+# supported pod→device introspection plane; the controller prefers it over
+# the internal checkpoint file above (which is all the reference's k8s-1.14
+# vintage had, /root/reference/controller.go:184-197).
+POD_RESOURCES_PATH = "/var/lib/kubelet/pod-resources/"
+POD_RESOURCES_SOCKET = POD_RESOURCES_PATH + "kubelet.sock"
+
 # Node/pod annotation carrying the node's ICI topology and per-pod real chip
 # assignments (the reference uses "nvidia.com/gpu-topo" for both,
 # /root/reference/server.go:296, /root/reference/controller.go:165).

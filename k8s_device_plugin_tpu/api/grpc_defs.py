@@ -173,6 +173,86 @@ class WatcherRegistrationStub:
 
 
 # ---------------------------------------------------------------------------
+# Kubelet PodResources API (podresources/v1) — the kubelet serves this on
+# /var/lib/kubelet/pod-resources/kubelet.sock; the controller consumes the
+# stub. The servicer exists for the fake kubelet in tests.
+# ---------------------------------------------------------------------------
+
+from . import podresources_pb2 as prpb  # noqa: E402
+
+POD_RESOURCES_SERVICE = "v1.PodResourcesLister"
+
+
+class PodResourcesListerServicer:
+    """Base class for the kubelet-side PodResourcesLister service (tests)."""
+
+    def List(
+        self, request: prpb.ListPodResourcesRequest, context
+    ) -> prpb.ListPodResourcesResponse:
+        raise NotImplementedError
+
+    def GetAllocatableResources(
+        self, request: prpb.AllocatableResourcesRequest, context
+    ) -> prpb.AllocatableResourcesResponse:
+        raise NotImplementedError
+
+    def Get(
+        self, request: prpb.GetPodResourcesRequest, context
+    ) -> prpb.GetPodResourcesResponse:
+        raise NotImplementedError
+
+
+def add_pod_resources_servicer(
+    servicer: PodResourcesListerServicer, server: grpc.Server
+) -> None:
+    handlers = {
+        "List": grpc.unary_unary_rpc_method_handler(
+            servicer.List,
+            request_deserializer=prpb.ListPodResourcesRequest.FromString,
+            response_serializer=prpb.ListPodResourcesResponse.SerializeToString,
+        ),
+        "GetAllocatableResources": grpc.unary_unary_rpc_method_handler(
+            servicer.GetAllocatableResources,
+            request_deserializer=prpb.AllocatableResourcesRequest.FromString,
+            response_serializer=(
+                prpb.AllocatableResourcesResponse.SerializeToString
+            ),
+        ),
+        "Get": grpc.unary_unary_rpc_method_handler(
+            servicer.Get,
+            request_deserializer=prpb.GetPodResourcesRequest.FromString,
+            response_serializer=prpb.GetPodResourcesResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(POD_RESOURCES_SERVICE, handlers),)
+    )
+
+
+class PodResourcesListerStub:
+    """Client for the kubelet's PodResourcesLister service."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.List = channel.unary_unary(
+            f"/{POD_RESOURCES_SERVICE}/List",
+            request_serializer=prpb.ListPodResourcesRequest.SerializeToString,
+            response_deserializer=prpb.ListPodResourcesResponse.FromString,
+        )
+        self.GetAllocatableResources = channel.unary_unary(
+            f"/{POD_RESOURCES_SERVICE}/GetAllocatableResources",
+            request_serializer=(
+                prpb.AllocatableResourcesRequest.SerializeToString
+            ),
+            response_deserializer=prpb.AllocatableResourcesResponse.FromString,
+        )
+        self.Get = channel.unary_unary(
+            f"/{POD_RESOURCES_SERVICE}/Get",
+            request_serializer=prpb.GetPodResourcesRequest.SerializeToString,
+            response_deserializer=prpb.GetPodResourcesResponse.FromString,
+        )
+
+
+# ---------------------------------------------------------------------------
 # Client side
 # ---------------------------------------------------------------------------
 

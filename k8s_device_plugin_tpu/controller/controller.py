@@ -4,11 +4,13 @@ The analog of the reference's informer controller
 (/root/reference/controller.go:75-249): watch this node's pods that request
 our resource, and
 
-* on pod **update** — once the kubelet has written its device-manager
-  checkpoint, translate the kubelet's device IDs for the pod through the
-  plugin's shadow map (Allocate-time substitution mode) and patch the *real*
-  chip IDs onto the pod annotation, so the scheduler extender knows which
-  physical chips the pod got (/root/reference/controller.go:173-225);
+* on pod **update** — once the kubelet has admitted the pod, translate the
+  kubelet's device IDs for the pod through the plugin's shadow map
+  (Allocate-time substitution mode) and patch the *real* chip IDs onto the
+  pod annotation, so the scheduler extender knows which physical chips the
+  pod got (/root/reference/controller.go:173-225). The kubelet's IDs come
+  from the PodResources API when served (kube/podresources.py), else from
+  the internal checkpoint file — the reference's only option at k8s 1.14;
 * on pod **delete** — free the pod's chips in the placement state
   (/root/reference/controller.go:148-171);
 * at **startup** — rebuild allocation state from the checkpoint, which the
@@ -25,14 +27,23 @@ import logging
 import queue
 import threading
 import time
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set
 
 from ..api import constants
 from ..kube import checkpoint as ckpt
 from ..kube.client import KubeClient, KubeError
+from ..kube.podresources import PodResourcesClient
 from ..utils.podresources import is_tpu_pod
 
 log = logging.getLogger(__name__)
+
+
+def _nsname(meta: dict) -> str:
+    """Tracking key for a pod without a knowable uid (apiserver-less
+    rebuild) and the deferral guard's self-key. One definition so the
+    'default'-namespace fallback can't drift between the prune, delete,
+    defer, and rebuild sites."""
+    return f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
 
 
 class Controller:
@@ -43,6 +54,7 @@ class Controller:
         node_name: str,
         resource_name: str = constants.RESOURCE_NAME,
         checkpoint_path: str = constants.KUBELET_CHECKPOINT,
+        podresources_socket: str = constants.POD_RESOURCES_SOCKET,
         devices_annotation: str = constants.POD_DEVICES_ANNOTATION,
         watch_timeout_s: int = 60,
         max_retries: int = 5,
@@ -53,6 +65,7 @@ class Controller:
         self.node_name = node_name
         self.resource_name = resource_name
         self.checkpoint_path = checkpoint_path
+        self.podres = PodResourcesClient(podresources_socket)
         self.devices_annotation = devices_annotation
         self.watch_timeout_s = watch_timeout_s
         self.max_retries = max_retries
@@ -89,42 +102,97 @@ class Controller:
         for t in self._threads:
             t.join(timeout=self.watch_timeout_s + 5)
         self._threads = []
+        self.podres.close()
 
     # ------------------------------------------------------------------
     # Startup state rebuild (reference gap — SURVEY.md §5)
     # ------------------------------------------------------------------
 
     def rebuild_state(self) -> None:
-        """Reconstruct allocated-chip state from the kubelet checkpoint,
-        keeping only entries whose pod still exists on this node."""
-        entries = ckpt.read_checkpoint(self.checkpoint_path)
-        by_pod = ckpt.device_ids_by_pod(entries, self.resource_name)
-        if not by_pod:
+        """Reconstruct allocated-chip state, keeping only entries whose pod
+        still exists on this node. Source order: PodResources API when the
+        kubelet serves it (stable contract), else the internal checkpoint
+        file (all the reference's k8s-1.14 kubelet offered)."""
+        # None = no authoritative PodResources answer (socket absent or RPC
+        # failed); {} = the API answered "no assignments", which must NOT
+        # fall through to a possibly-stale checkpoint from a prior boot.
+        by_name = None  # (namespace, name) -> kubelet device ids
+        by_uid: Dict[str, List[str]] = {}
+        if self.podres.available():
+            try:
+                by_name = self.podres.device_ids_by_pod(self.resource_name)
+            except Exception as e:
+                log.warning(
+                    "podresources List failed (%s); using checkpoint", e
+                )
+        if by_name is None:
+            entries = ckpt.read_checkpoint(self.checkpoint_path)
+            by_uid = ckpt.device_ids_by_pod(entries, self.resource_name)
+        if not by_name and not by_uid:
             return
+        items = None
         try:
             pods = self.client.list_pods(node_name=self.node_name)
-            live_uids = {
-                p["metadata"]["uid"] for p in pods.get("items", [])
-            }
+            items = pods.get("items", [])
         except (KubeError, OSError) as e:
             log.warning(
-                "state rebuild: pod list failed (%s); trusting checkpoint", e
+                "state rebuild: pod list failed (%s); trusting kubelet", e
             )
-            live_uids = set(by_pod)
+        # Normalize both sources to live pods keyed the way _handle_delete
+        # will look them up (uid; namespace/name when no uid is knowable).
+        live: Dict[str, List[str]] = {}
+        if items is None:
+            if by_uid:
+                live = dict(by_uid)
+            else:
+                live = {
+                    _nsname({"namespace": ns, "name": name}): ids
+                    for (ns, name), ids in by_name.items()
+                }
+        else:
+            # One (namespace, name) assignment belongs to exactly ONE pod
+            # instance, but a same-name recreation briefly lists both the
+            # Terminating old pod and its replacement. The kubelet's chips
+            # belong to the instance still tearing down (matching the
+            # update path's deferral), so claim in deletionTimestamp-first
+            # order and never attribute one entry twice — a dual-holder
+            # rebuild would later free the chips on the old pod's DELETED
+            # while the replacement still runs on them.
+            def claim_order(p):
+                return 0 if p.get("metadata", {}).get(
+                    "deletionTimestamp"
+                ) else 1
+
+            consumed = set()
+            for p in sorted(items, key=claim_order):
+                meta = p.get("metadata", {})
+                if by_name:
+                    key = (
+                        meta.get("namespace", "default"),
+                        meta.get("name", ""),
+                    )
+                    if key in consumed:
+                        continue
+                    ids = by_name.get(key)
+                    if ids:
+                        consumed.add(key)
+                else:
+                    ids = by_uid.get(meta.get("uid", ""))
+                if ids:
+                    live[meta.get("uid", "")] = ids
         allocated = []
-        for uid, ids in by_pod.items():
-            if uid not in live_uids:
-                continue
+        for key, ids in live.items():
             real = [self.plugin.shadow_map.get(i, i) for i in ids]
             known = [r for r in real if r in self.plugin.mesh.by_id]
             allocated.extend(known)
             if known:
-                self._pod_devices[uid] = set(known)
+                self._pod_devices[key] = set(known)
         if allocated:
             self.plugin.mark_allocated(allocated)
             log.info(
-                "rebuilt allocation state from checkpoint: %d chips across "
-                "%d pods", len(allocated), len(self._pod_devices),
+                "rebuilt allocation state from %s: %d chips across %d pods",
+                "podresources" if by_name else "checkpoint",
+                len(allocated), len(self._pod_devices),
             )
 
     # ------------------------------------------------------------------
@@ -147,6 +215,18 @@ class Controller:
                     resource_version = (
                         pods.get("metadata", {}).get("resourceVersion", "")
                     )
+                    live_keys = set()
+                    for pod in pods.get("items", []):
+                        m = pod.get("metadata", {})
+                        live_keys.add(m.get("uid", ""))
+                        live_keys.add(_nsname(m))
+                    # Prune tracking for pods that vanished while the watch
+                    # was down (a missed DELETED event would otherwise hold
+                    # their chips forever). Enqueued BEFORE the MODIFIED
+                    # batch so a recreated pod deferring on a stale holder
+                    # reconciles in this cycle, not the next; runs in the
+                    # worker for ordering with in-flight events.
+                    self._queue.put(("PRUNE", live_keys, 0))
                     for pod in pods.get("items", []):
                         self._enqueue("MODIFIED", pod)
                 for etype, obj in self.client.watch_pods(
@@ -189,6 +269,15 @@ class Controller:
             if item is None or self._stop.is_set():
                 return
             etype, pod, retries = item
+            if etype == "PRUNE":
+                # Outside the retry machinery: the give-up log below
+                # assumes dict-shaped items, and a prune is cheap to just
+                # redo on the next resync if it ever fails.
+                try:
+                    self._prune_stale(pod)  # pod = set of live keys
+                except Exception as e:
+                    log.warning("prune failed: %s", e)
+                continue
             try:
                 if etype == "DELETED":
                     self._handle_delete(pod)
@@ -207,6 +296,40 @@ class Controller:
                     time.sleep(min(0.1 * 2**retries, 2.0))
                     self._queue.put((etype, pod, retries + 1))
 
+    def _prune_stale(self, live_keys: Set[str]) -> None:
+        """Free chips tracked for pods no longer on the node. Tracking keys
+        are pod uids (or namespace/name from an apiserver-less rebuild);
+        ``live_keys`` carries both forms from a fresh list."""
+        for key in list(self._pod_devices):
+            if key not in live_keys:
+                ids = self._pod_devices.pop(key, set())
+                if ids:
+                    self.plugin.free_devices(ids)
+                    log.info(
+                        "pruned stale tracking for vanished pod %s "
+                        "(freed %s)", key, sorted(ids),
+                    )
+
+    def _kubelet_ids_for_pod(self, meta: dict) -> Optional[List[str]]:
+        """The kubelet's device IDs for one pod: PodResources API first
+        (kube/podresources.py), checkpoint file as the fallback — the only
+        source the reference had (/root/reference/controller.go:184-197)."""
+        if self.podres.available():
+            try:
+                return self.podres.pod_device_ids(
+                    meta.get("namespace", "default"),
+                    meta.get("name", ""),
+                    self.resource_name,
+                )
+            except Exception as e:
+                log.warning(
+                    "podresources Get failed (%s); using checkpoint", e
+                )
+        entries = ckpt.read_checkpoint(self.checkpoint_path)
+        return ckpt.device_ids_by_pod(entries, self.resource_name).get(
+            meta.get("uid", "")
+        )
+
     # reference updatePodFunc, /root/reference/controller.go:173-225
     def _handle_update(self, pod: dict) -> None:
         meta = pod.get("metadata", {})
@@ -220,28 +343,54 @@ class Controller:
                 if i in self.plugin.mesh.by_id
             ]
             if ids:
+                # Supersedes any namespace/name tracking from an
+                # apiserver-less rebuild (rebuild_state).
+                self._pod_devices.pop(_nsname(meta), None)
                 self._pod_devices[uid] = set(ids)
             return
-        entries = ckpt.read_checkpoint(self.checkpoint_path)
-        kubelet_ids = ckpt.device_ids_by_pod(entries, self.resource_name).get(
-            uid
-        )
+        kubelet_ids = self._kubelet_ids_for_pod(meta)
         if not kubelet_ids:
             return  # kubelet hasn't admitted the pod yet
-        # Translate through the shadow map and drain consumed entries
-        # (reference controller.go:200-210).
+        # Translate through the shadow map (reference controller.go:200-210)
+        # — but only *read* here; entries are drained after the patch lands,
+        # so a transient apiserver failure can retry (the reference drains
+        # eagerly and would wedge that pod forever on a failed patch).
         real = []
+        consumed = []
         for kid in kubelet_ids:
-            rid = self.plugin.shadow_map.pop(kid, kid)
+            rid = self.plugin.shadow_map.get(kid, kid)
             if rid in self.plugin.mesh.by_id:
                 real.append(rid)
+                if kid in self.plugin.shadow_map:
+                    consumed.append(kid)
         if not real:
             return
+        # PodResources has no pod-UID dimension, so a recreated pod (same
+        # namespace/name, new uid — e.g. a StatefulSet replacement) can
+        # briefly inherit the OLD instance's assignment while the kubelet
+        # tears it down. If another tracked pod still holds any of these
+        # chips, defer: the old instance's DELETED event (or the resync
+        # prune for a missed one, _prune_stale) frees them and the periodic
+        # resync retries this pod. The pod's own namespace/name key (from
+        # an apiserver-less rebuild, rebuild_state) is this pod, not a
+        # conflicting holder.
+        nsname = _nsname(meta)
+        for other_key, held in self._pod_devices.items():
+            if other_key not in (uid, nsname) and held & set(real):
+                log.info(
+                    "pod %s devices %s still held by pod %s; deferring",
+                    nsname, sorted(held & set(real)), other_key,
+                )
+                return
         self.client.patch_pod_annotations(
             meta.get("namespace", "default"),
             meta.get("name", ""),
             {self.devices_annotation: ",".join(sorted(real))},
         )
+        for kid in consumed:
+            self.plugin.shadow_map.pop(kid, None)
+        # Migrate any rebuild-time namespace/name tracking to the uid key.
+        self._pod_devices.pop(nsname, None)
         self._pod_devices[uid] = set(real)
         self.plugin.mark_allocated(real)
         log.info(
@@ -264,6 +413,9 @@ class Controller:
                 if i
             }
         ids |= self._pod_devices.pop(uid, set())
+        # rebuild_state keys by namespace/name when no uid was knowable
+        # (podresources data with the API server unreachable).
+        ids |= self._pod_devices.pop(_nsname(meta), set())
         if not ids:
             return
         self.plugin.free_devices(ids)

@@ -232,6 +232,7 @@ def start_kube_integration(
         checkpoint_path=os.path.join(
             cfg.device_plugin_dir, "kubelet_internal_checkpoint"
         ),
+        podresources_socket=cfg.podresources_socket,
         resync_interval_s=cfg.resync_interval_s,
     )
     controller.publisher = publisher  # stopped with the controller

@@ -79,6 +79,9 @@ class DaemonConfig:
     # "watcher" (plugins_registry socket, kubelet >= 1.12), or "both".
     registration_mode: str = "register"
     plugins_registry_dir: str = "/var/lib/kubelet/plugins_registry/"
+    # Kubelet PodResources API socket; preferred over the checkpoint file
+    # for pod→device reconciliation ("" forces checkpoint-only).
+    podresources_socket: str = constants.POD_RESOURCES_SOCKET
 
 
 class Daemon:
@@ -314,6 +317,11 @@ def parse_args(argv) -> DaemonConfig:
                    "watcher socket, or both")
     p.add_argument("--plugins-registry-dir",
                    default="/var/lib/kubelet/plugins_registry/")
+    p.add_argument("--podresources-socket",
+                   default=constants.POD_RESOURCES_SOCKET,
+                   help="kubelet PodResources API socket, preferred over "
+                   "the checkpoint file for reconciliation; '' forces "
+                   "checkpoint-only")
     p.add_argument("--no-controller", action="store_true")
     p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
     p.add_argument("--python-backend", action="store_true",
@@ -345,6 +353,7 @@ def parse_args(argv) -> DaemonConfig:
         slice_host_bounds=a.slice_host_bounds,
         registration_mode=a.registration_mode,
         plugins_registry_dir=a.plugins_registry_dir,
+        podresources_socket=a.podresources_socket,
     )
 
 

@@ -18,9 +18,12 @@ import grpc
 
 from k8s_device_plugin_tpu.api import constants
 from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
+from k8s_device_plugin_tpu.api import podresources_pb2 as prpb
 from k8s_device_plugin_tpu.api.grpc_defs import (
     DevicePluginStub,
+    PodResourcesListerServicer,
     RegistrationServicer,
+    add_pod_resources_servicer,
     add_registration_servicer,
 )
 
@@ -71,3 +74,85 @@ class FakeKubelet(RegistrationServicer):
             assert self.registrations, "no plugin registered yet"
             endpoint = self.registrations[-1].endpoint
         return DevicePluginStub(self.plugin_channel(endpoint))
+
+
+class FakePodResources(PodResourcesListerServicer):
+    """A fake kubelet PodResources endpoint (podresources/v1).
+
+    ``pods`` maps (namespace, name) → {resource_name: [device_ids]}; the
+    assignments are what the kubelet's device manager would report. Set
+    ``fail`` to make every RPC abort, exercising the controller's fallback
+    to the checkpoint file.
+    """
+
+    def __init__(self, socket_path: str, serve_get: bool = True):
+        self.socket_path = socket_path
+        self.serve_get = serve_get  # False mimics a pre-1.27 kubelet
+        self.pods = {}
+        self.allocatable = {}  # resource_name -> [device_ids]
+        self.fail = False
+        self._server: Optional[grpc.Server] = None
+
+    def set_pod(self, namespace, name, resource_name, device_ids) -> None:
+        self.pods.setdefault((namespace, name), {})[resource_name] = list(
+            device_ids
+        )
+
+    # PodResourcesLister service --------------------------------------------
+
+    def _pod_msg(self, key) -> prpb.PodResources:
+        ns, name = key
+        pod = prpb.PodResources(name=name, namespace=ns)
+        container = pod.containers.add(name="main")
+        for resource, ids in self.pods.get(key, {}).items():
+            container.devices.add(resource_name=resource, device_ids=ids)
+        return pod
+
+    def List(self, request, context) -> prpb.ListPodResourcesResponse:
+        if self.fail:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "injected failure")
+        resp = prpb.ListPodResourcesResponse()
+        for key in self.pods:
+            resp.pod_resources.append(self._pod_msg(key))
+        return resp
+
+    def GetAllocatableResources(
+        self, request, context
+    ) -> prpb.AllocatableResourcesResponse:
+        if self.fail:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "injected failure")
+        resp = prpb.AllocatableResourcesResponse()
+        for resource, ids in self.allocatable.items():
+            resp.devices.add(resource_name=resource, device_ids=ids)
+        return resp
+
+    def Get(self, request, context) -> prpb.GetPodResourcesResponse:
+        if self.fail:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "injected failure")
+        if not self.serve_get:
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED, "Get requires kubelet >= 1.27"
+            )
+        key = (request.pod_namespace, request.pod_name)
+        if key not in self.pods:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"pod {request.pod_namespace}/{request.pod_name} not found",
+            )
+        return prpb.GetPodResourcesResponse(pod_resources=self._pod_msg(key))
+
+    # Lifecycle --------------------------------------------------------------
+
+    def start(self) -> None:
+        os.makedirs(os.path.dirname(self.socket_path), exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        add_pod_resources_servicer(self, self._server)
+        self._server.add_insecure_port(f"unix:{self.socket_path}")
+        self._server.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0.2).wait()
+            self._server = None

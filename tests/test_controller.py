@@ -148,6 +148,9 @@ def make_controller(api, plugin, tmp_path, by_pod=None):
         plugin,
         node_name=NODE,
         checkpoint_path=path,
+        # Pin checkpoint-only: on a real k8s node the default socket would
+        # exist and silently switch these tests' data source.
+        podresources_socket="",
         watch_timeout_s=2,
     ), server
 
@@ -287,3 +290,254 @@ def test_rebuild_updates_gauges_and_hooks(api, plugin, tmp_path):
     ctrl.rebuild_state()
     assert plugin.state.allocated == set(ids[:2])
     assert changed  # hook fired -> publisher would republish
+
+
+# ---------------------------------------------------------------------------
+# PodResources API path (podresources/v1) — preferred over the checkpoint
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def podres(tmp_path):
+    from tests.fake_kubelet import FakePodResources
+
+    s = FakePodResources(str(tmp_path / "pod-resources" / "kubelet.sock"))
+    s.start()
+    yield s
+    s.stop()
+
+
+def make_podres_controller(api, plugin, tmp_path, podres):
+    server, client = api
+    path = write_checkpoint(tmp_path, {})  # empty: API must be the source
+    return Controller(
+        client, plugin, node_name=NODE, checkpoint_path=path,
+        podresources_socket=podres.socket_path, watch_timeout_s=2,
+    ), server
+
+
+def test_update_reconciles_via_podresources(api, plugin, tmp_path, podres):
+    """With a modern kubelet the controller never reads the checkpoint:
+    the PodResources Get/List RPCs carry the device assignment."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_podres_controller(api, plugin, tmp_path, podres)
+    server.add_pod(pod_dict("jax-pod", "uid-1", tpus=2))
+    podres.set_pod("default", "jax-pod", "google.com/tpu", ids[:2])
+    ctrl.start()
+    try:
+        assert wait_for(lambda: server.pod_patches)
+        _, _, body = server.pod_patches[0]
+        got = body["metadata"]["annotations"][constants.POD_DEVICES_ANNOTATION]
+        assert got == ",".join(sorted(ids[:2]))
+        assert set(ids[:2]).issubset(plugin.state.allocated)
+    finally:
+        ctrl.stop()
+
+
+def test_podresources_list_fallback_pre127(api, plugin, tmp_path, podres):
+    """Kubelets before 1.27 serve List but not Get; the client must fall
+    back transparently."""
+    ids = plugin.mesh.ids
+    podres.serve_get = False
+    ctrl, server = make_podres_controller(api, plugin, tmp_path, podres)
+    server.add_pod(pod_dict("jax-pod", "uid-1", tpus=2))
+    podres.set_pod("default", "jax-pod", "google.com/tpu", ids[2:4])
+    ctrl.start()
+    try:
+        assert wait_for(lambda: server.pod_patches)
+        _, _, body = server.pod_patches[0]
+        got = body["metadata"]["annotations"][constants.POD_DEVICES_ANNOTATION]
+        assert got == ",".join(sorted(ids[2:4]))
+    finally:
+        ctrl.stop()
+
+
+def test_rebuild_from_podresources(api, plugin, tmp_path, podres):
+    """Startup rebuild prefers the PodResources API; entries for pods that
+    no longer exist on the node are ignored, same as the checkpoint path."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_podres_controller(api, plugin, tmp_path, podres)
+    server.add_pod(pod_dict("live-pod", "uid-live", tpus=2))
+    podres.set_pod("default", "live-pod", "google.com/tpu", ids[:2])
+    podres.set_pod("default", "gone-pod", "google.com/tpu", [ids[2]])
+    ctrl.rebuild_state()
+    assert plugin.state.allocated == set(ids[:2])
+    # Delete frees through the same uid-keyed tracking.
+    assert ctrl._pod_devices.get("uid-live") == set(ids[:2])
+
+
+def test_podresources_failure_falls_back_to_checkpoint(
+    api, plugin, tmp_path, podres
+):
+    """A wedged PodResources endpoint (socket exists, RPCs fail) must not
+    stop reconciliation: the checkpoint file still carries the facts."""
+    ids = plugin.mesh.ids
+    podres.fail = True
+    server, client = api
+    path = write_checkpoint(tmp_path, {"uid-1": ids[:2]})
+    ctrl = Controller(
+        client, plugin, node_name=NODE, checkpoint_path=path,
+        podresources_socket=podres.socket_path, watch_timeout_s=2,
+    )
+    server.add_pod(pod_dict("jax-pod", "uid-1", tpus=2))
+    ctrl.start()
+    try:
+        assert wait_for(lambda: server.pod_patches)
+        _, _, body = server.pod_patches[0]
+        got = body["metadata"]["annotations"][constants.POD_DEVICES_ANNOTATION]
+        assert got == ",".join(sorted(ids[:2]))
+    finally:
+        ctrl.stop()
+
+
+def test_podresources_client_allocatable(podres):
+    from k8s_device_plugin_tpu.kube.podresources import PodResourcesClient
+
+    podres.allocatable = {"google.com/tpu": ["a", "b", "c", "d"],
+                          "other.com/nic": ["n0"]}
+    c = PodResourcesClient(podres.socket_path)
+    assert c.available()
+    assert c.allocatable_device_ids("google.com/tpu") == ["a", "b", "c", "d"]
+    assert PodResourcesClient("/nonexistent/sock").available() is False
+
+
+def test_empty_podresources_beats_stale_checkpoint(
+    api, plugin, tmp_path, podres
+):
+    """An authoritative empty PodResources answer must NOT fall through to
+    the checkpoint: after a node reboot the fresh kubelet reports no
+    assignments while the previous boot's checkpoint file still lists
+    chips for a live pod. Trusting it would withhold free capacity."""
+    ids = plugin.mesh.ids
+    server, client = api
+    path = write_checkpoint(tmp_path, {"uid-stale": ids[:2]})  # previous boot
+    server.add_pod(pod_dict("survivor-pod", "uid-stale", tpus=2))
+    ctrl = Controller(
+        client, plugin, node_name=NODE, checkpoint_path=path,
+        podresources_socket=podres.socket_path, watch_timeout_s=2,
+    )
+    ctrl.rebuild_state()
+    assert plugin.state.allocated == set()  # API said: nothing assigned
+
+
+def test_recreated_pod_defers_until_old_instance_freed(
+    api, plugin, tmp_path, podres
+):
+    """PodResources keys pods by (namespace, name) — no uid. A recreated
+    pod must not inherit the old instance's chips while the old instance
+    is still tracked; reconciliation defers until delete frees them."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_podres_controller(api, plugin, tmp_path, podres)
+    podres.set_pod("default", "pod-0", "google.com/tpu", ids[:2])
+    server.add_pod(pod_dict("pod-0", "uid-new", tpus=2))
+    # Old instance (uid-old) still holds the chips.
+    ctrl._pod_devices["uid-old"] = set(ids[:2])
+    ctrl._handle_update(pod_dict("pod-0", "uid-new", tpus=2))
+    assert not server.pod_patches  # deferred
+    # Old instance's DELETED event frees them; resync retries.
+    ctrl._handle_delete(pod_dict("pod-0", "uid-old", tpus=2))
+    ctrl._handle_update(pod_dict("pod-0", "uid-new", tpus=2))
+    assert server.pod_patches
+    assert ctrl._pod_devices.get("uid-new") == set(ids[:2])
+
+
+def test_shadow_map_survives_transient_patch_failure(api, plugin, tmp_path):
+    """Substitution-mode entries must drain only after the pod patch lands,
+    so an apiserver blip doesn't wedge the pod forever."""
+    ids = plugin.mesh.ids
+    plugin.shadow_map[ids[3]] = ids[1]
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    write_checkpoint(tmp_path, {"uid-1": [ids[0], ids[3]]})
+    calls = []
+    real_patch = ctrl.client.patch_pod_annotations
+
+    def flaky_patch(*a, **kw):
+        calls.append(1)
+        if len(calls) == 1:
+            raise OSError("apiserver blip")
+        return real_patch(*a, **kw)
+
+    ctrl.client.patch_pod_annotations = flaky_patch
+    pod = pod_dict("jax-pod", "uid-1", tpus=2)
+    server.add_pod(pod)
+    with pytest.raises(OSError):
+        ctrl._handle_update(pod)
+    assert plugin.shadow_map == {ids[3]: ids[1]}  # NOT drained
+    ctrl._handle_update(pod)  # retry succeeds
+    assert plugin.shadow_map == {}
+    _, _, body = server.pod_patches[0]
+    got = body["metadata"]["annotations"][constants.POD_DEVICES_ANNOTATION]
+    assert got == ",".join(sorted([ids[0], ids[1]]))
+
+
+def test_nsname_rebuild_key_does_not_deadlock_own_pod(
+    api, plugin, tmp_path, podres
+):
+    """An apiserver-less rebuild tracks pods by namespace/name; the same
+    pod's later update event must treat that key as itself, reconcile, and
+    migrate the tracking to its uid."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_podres_controller(api, plugin, tmp_path, podres)
+    podres.set_pod("default", "jax-pod", "google.com/tpu", ids[:2])
+    server.add_pod(pod_dict("jax-pod", "uid-1", tpus=2))
+    # As rebuild_state stores it when list_pods failed:
+    ctrl._pod_devices["default/jax-pod"] = set(ids[:2])
+    ctrl._handle_update(pod_dict("jax-pod", "uid-1", tpus=2))
+    assert server.pod_patches  # NOT deferred
+    assert ctrl._pod_devices == {"uid-1": set(ids[:2])}  # migrated
+
+
+def test_resync_prunes_missed_delete(api, plugin, tmp_path, podres):
+    """A DELETED event missed during a watch gap must not hold chips
+    forever: the periodic relist prunes tracking for vanished pods, which
+    also unblocks a recreated same-name pod's deferral."""
+    ids = plugin.mesh.ids
+    server, client = api
+    path = write_checkpoint(tmp_path, {})
+    ctrl = Controller(
+        client, plugin, node_name=NODE, checkpoint_path=path,
+        podresources_socket=podres.socket_path,
+        watch_timeout_s=2, resync_interval_s=0.3,
+    )
+    # uid-old's pod vanished while the watch was down; its entry is stale.
+    plugin.state.allocate(ids[:2])
+    ctrl._pod_devices["uid-old"] = set(ids[:2])
+    # The replacement instance exists and the kubelet reassigned the chips.
+    server.add_pod(pod_dict("pod-0", "uid-new", tpus=2))
+    podres.set_pod("default", "pod-0", "google.com/tpu", ids[:2])
+    ctrl.start()
+    try:
+        assert wait_for(lambda: "uid-old" not in ctrl._pod_devices)
+        assert wait_for(lambda: server.pod_patches)  # recreated pod freed up
+        assert wait_for(
+            lambda: ctrl._pod_devices.get("uid-new") == set(ids[:2])
+        )
+    finally:
+        ctrl.stop()
+
+
+def test_rebuild_attributes_assignment_to_single_instance(
+    api, plugin, tmp_path, podres
+):
+    """During a same-name recreation the pod list briefly holds both the
+    Terminating old pod and its replacement; the rebuild must attribute
+    the kubelet's (ns,name)-keyed assignment to exactly one of them (the
+    Terminating holder), or the old pod's DELETED would free chips the
+    replacement still runs on."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_podres_controller(api, plugin, tmp_path, podres)
+    podres.set_pod("default", "pod-0", "google.com/tpu", ids[:2])
+    old = pod_dict("pod-0", "uid-old", tpus=2)
+    old["metadata"]["deletionTimestamp"] = "2026-07-30T00:00:00Z"
+    server.add_pod(old)
+    # FakeApiServer keys pods by (ns, name); inject the same-name
+    # replacement directly into the listing the way a real apiserver
+    # briefly shows both instances.
+    new = pod_dict("pod-0", "uid-new", tpus=2)
+    ctrl.client.list_pods = lambda **kw: {"items": [new, old],
+                                          "metadata": {}}
+    ctrl.rebuild_state()
+    assert ctrl._pod_devices == {"uid-old": set(ids[:2])}
+    assert plugin.state.allocated == set(ids[:2])
+    # Old instance finally dies -> chips free exactly once.
+    ctrl._handle_delete(old)
+    assert plugin.state.allocated == set()

@@ -67,6 +67,7 @@ def system(tmp_path):
             "--accelerator-type", "v5p",
             "--health-interval", "0.2",
             "--resync-interval", "1",
+            "--podresources-socket", "",
             "--metrics-port", "0",
         ],
         cwd=REPO,

@@ -72,6 +72,7 @@ def slice_system(tmp_path):
                 worker_hostnames=",".join(hosts),
                 slice_host_bounds="2,1,1",
                 resync_interval_s=1.0,
+                podresources_socket="",  # pin checkpoint-only in tests
             )
         )
         t = threading.Thread(target=daemon.run, daemon=True)
