@@ -253,6 +253,84 @@ class PodResourcesListerStub:
 
 
 # ---------------------------------------------------------------------------
+# DRA plugin service (dra/v1beta1) — the PLUGIN serves this on a socket
+# under /var/lib/kubelet/plugins/<driver>/, announced to the kubelet via
+# the plugins_registry watcher with type "DRAPlugin". The method path uses
+# the upstream package name "v1beta1" (wire contract); the pb2 package is
+# "dra" only to avoid a process-wide protobuf name collision with the
+# deviceplugin v1beta1 messages (see api/dra.proto header).
+# ---------------------------------------------------------------------------
+
+from . import dra_pb2 as drapb  # noqa: E402
+
+DRA_PLUGIN_SERVICE = "v1beta1.DRAPlugin"
+
+
+class DraPluginServicer:
+    """Base class for the plugin-side DRAPlugin service."""
+
+    def NodePrepareResources(
+        self, request: drapb.NodePrepareResourcesRequest, context
+    ) -> drapb.NodePrepareResourcesResponse:
+        raise NotImplementedError
+
+    def NodeUnprepareResources(
+        self, request: drapb.NodeUnprepareResourcesRequest, context
+    ) -> drapb.NodeUnprepareResourcesResponse:
+        raise NotImplementedError
+
+
+def add_dra_plugin_servicer(
+    servicer: DraPluginServicer, server: grpc.Server
+) -> None:
+    handlers = {
+        "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
+            servicer.NodePrepareResources,
+            request_deserializer=drapb.NodePrepareResourcesRequest.FromString,
+            response_serializer=(
+                drapb.NodePrepareResourcesResponse.SerializeToString
+            ),
+        ),
+        "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
+            servicer.NodeUnprepareResources,
+            request_deserializer=(
+                drapb.NodeUnprepareResourcesRequest.FromString
+            ),
+            response_serializer=(
+                drapb.NodeUnprepareResourcesResponse.SerializeToString
+            ),
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(DRA_PLUGIN_SERVICE, handlers),)
+    )
+
+
+class DraPluginStub:
+    """Client for the plugin's DRAPlugin service (kubelet/tests → plugin)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.NodePrepareResources = channel.unary_unary(
+            f"/{DRA_PLUGIN_SERVICE}/NodePrepareResources",
+            request_serializer=(
+                drapb.NodePrepareResourcesRequest.SerializeToString
+            ),
+            response_deserializer=(
+                drapb.NodePrepareResourcesResponse.FromString
+            ),
+        )
+        self.NodeUnprepareResources = channel.unary_unary(
+            f"/{DRA_PLUGIN_SERVICE}/NodeUnprepareResources",
+            request_serializer=(
+                drapb.NodeUnprepareResourcesRequest.SerializeToString
+            ),
+            response_deserializer=(
+                drapb.NodeUnprepareResourcesResponse.FromString
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
 # Client side
 # ---------------------------------------------------------------------------
 

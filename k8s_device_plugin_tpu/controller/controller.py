@@ -418,10 +418,69 @@ class Controller:
         ids |= self._pod_devices.pop(_nsname(meta), set())
         if not ids:
             return
-        self.plugin.free_devices(ids)
+        # A replacement pod can already be RUNNING on this pod's chips by
+        # the time the DELETED event lands (kubelet freed + re-Allocated
+        # them while the old API object lingered on its grace period); its
+        # reconcile is deferred by _handle_update's dual-holder guard, so
+        # our tracking doesn't know yet. Freeing such chips would let a
+        # third pod double-mount them — so chips the kubelet still reports
+        # assigned are RE-BOUND to the namespace/name key instead of
+        # freed: if the replacement holds them, its reconcile migrates the
+        # key to its uid; if it was the old instance's lagging kubelet
+        # cleanup, the entry disappears and the resync prune frees them.
+        still_used = ids & self._kubelet_assigned_chips(exclude_uid=uid)
+        if still_used:
+            self._pod_devices[_nsname(meta)] = (
+                self._pod_devices.get(_nsname(meta), set()) | still_used
+            )
+            log.info(
+                "deleted pod %s/%s: chips %s still assigned per kubelet; "
+                "re-bound for reconcile/prune",
+                meta.get("namespace"), meta.get("name"), sorted(still_used),
+            )
+        freeable = ids - still_used
+        if not freeable:
+            return
+        self.plugin.free_devices(freeable)
         log.info(
             "freed chips %s from deleted pod %s/%s",
-            sorted(ids),
+            sorted(freeable),
             meta.get("namespace"),
             meta.get("name"),
         )
+
+    def _kubelet_assigned_chips(self, exclude_uid: str = "") -> Set[str]:
+        """Real chip ids the kubelet currently reports assigned, translated
+        through the shadow map like reconciliation. The checkpoint path can
+        exclude the deleted pod's own entry by uid; PodResources entries
+        carry no uid, so same-name entries are deliberately INCLUDED (the
+        caller re-binds rather than frees — conservative either way).
+        Empty on any source failure — freeing is then the lesser risk
+        (matches pre-guard behavior)."""
+        assigned = []
+        try:
+            if self.podres.available():
+                for ids in self.podres.device_ids_by_pod(
+                    self.resource_name
+                ).values():
+                    assigned.extend(ids)
+            else:
+                by_uid = ckpt.device_ids_by_pod(
+                    ckpt.read_checkpoint(self.checkpoint_path),
+                    self.resource_name,
+                )
+                for entry_uid, ids in by_uid.items():
+                    if entry_uid != exclude_uid:
+                        assigned.extend(ids)
+        except Exception as e:
+            log.warning("assignment lookup on delete failed: %s", e)
+            return set()
+        used: Set[str] = set()
+        for kid in assigned:
+            # plugin.substitutions, not shadow_map: shadow entries are
+            # drained on reconcile, and a drained kubelet id that happens
+            # to equal another pod's real chip id would mistranslate.
+            rid = self.plugin.substitutions.get(kid, kid)
+            if rid in self.plugin.mesh.by_id:
+                used.add(rid)
+        return used

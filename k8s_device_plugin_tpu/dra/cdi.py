@@ -1,0 +1,152 @@
+"""CDI (Container Device Interface) spec generation for DRA claims.
+
+DRA hands devices to the container runtime as CDI device IDs
+(``<vendor>/<class>=<name>``); the runtime resolves them against spec files
+in /var/run/cdi (or /etc/cdi) and applies their containerEdits. TPU
+containers need three edits per claim: the /dev/accel* (or /dev/vfio)
+device nodes, the libtpu.so mount, and the TPU_* topology env that tells
+libtpu/JAX which chips it owns (the same env the device-plugin path sets in
+its Allocate response, server/plugin.py _tpu_env).
+
+Because that env depends on the *set* of chips in the claim (visible-chip
+list, bounding box), a static per-chip spec cannot express it — so the
+driver writes one CDI device per prepared claim ("claim-<uid>") at
+NodePrepareResources time and removes it at NodeUnprepareResources, the
+same shape the NVIDIA DRA driver uses for its per-claim specs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+log = logging.getLogger(__name__)
+
+# CDI spec version: 0.6.0 is what containerd 1.7+/CRI-O 1.28+ understand.
+CDI_VERSION = "0.6.0"
+DEFAULT_CDI_DIR = "/var/run/cdi"
+
+
+def _spec_filename(kind: str, name: str) -> str:
+    # "google.com/tpu" + "claim-x" -> "google.com-tpu-claim-x.json"
+    return re.sub(r"[^a-zA-Z0-9_.-]", "-", f"{kind}-{name}") + ".json"
+
+
+class CdiRegistry:
+    """Writes and removes per-claim CDI spec files atomically."""
+
+    def __init__(self, cdi_dir: str = DEFAULT_CDI_DIR,
+                 kind: str = "google.com/tpu"):
+        self.cdi_dir = cdi_dir
+        self.kind = kind
+
+    def device_id(self, device_name: str) -> str:
+        return f"{self.kind}={device_name}"
+
+    def write_claim_device(
+        self,
+        claim_uid: str,
+        dev_paths: Sequence[str],
+        env: Dict[str, str],
+        libtpu: Optional[tuple] = None,
+        chip_ids: Sequence[str] = (),
+    ) -> str:
+        """Write the spec for one prepared claim; returns the CDI device ID
+        the kubelet passes to the runtime. ``libtpu`` is the (host_path,
+        container_path) mount decided by server.plugin.libtpu_mount — the
+        decision lives there so both planes stay in lockstep. ``chip_ids``
+        is recorded in the spec's annotations so a restarted driver can
+        rebuild its prepared-claim holds from disk (claim_chip_ids)."""
+        name = f"claim-{claim_uid}"
+        edits: Dict = {
+            "deviceNodes": [
+                {"path": p, "hostPath": p} for p in dev_paths
+            ],
+            "env": [f"{k}={v}" for k, v in sorted(env.items())],
+        }
+        if libtpu is not None:
+            host_path, container_path = libtpu
+            edits["mounts"] = [
+                {
+                    "hostPath": host_path,
+                    "containerPath": container_path,
+                    "options": ["ro", "rbind"],
+                }
+            ]
+            edits["env"].append(f"TPU_LIBRARY_PATH={container_path}")
+        device: Dict = {"name": name, "containerEdits": edits}
+        if chip_ids:
+            device["annotations"] = {
+                "tpu.google.com/chip-ids": ",".join(chip_ids)
+            }
+        spec = {
+            "cdiVersion": CDI_VERSION,
+            "kind": self.kind,
+            "devices": [device],
+        }
+        os.makedirs(self.cdi_dir, exist_ok=True)
+        path = os.path.join(self.cdi_dir, _spec_filename(self.kind, name))
+        # Atomic replace: the runtime may list the dir at any moment.
+        fd, tmp = tempfile.mkstemp(dir=self.cdi_dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(spec, f, indent=1)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        log.info("wrote CDI spec %s (%d device nodes)", path, len(dev_paths))
+        return self.device_id(name)
+
+    def remove_claim_device(self, claim_uid: str) -> None:
+        name = f"claim-{claim_uid}"
+        path = os.path.join(self.cdi_dir, _spec_filename(self.kind, name))
+        try:
+            os.unlink(path)
+            log.info("removed CDI spec %s", path)
+        except FileNotFoundError:
+            pass
+
+    def read_claim_spec(self, claim_uid: str) -> Optional[dict]:
+        """The spec previously written for a claim, or None (test hook and
+        restart-recovery probe)."""
+        name = f"claim-{claim_uid}"
+        path = os.path.join(self.cdi_dir, _spec_filename(self.kind, name))
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def claim_chip_ids(self, claim_uid: str) -> List[str]:
+        """Chip ids recorded in a claim's spec annotations (restart
+        recovery); [] when the spec is missing or predates the field."""
+        spec = self.read_claim_spec(claim_uid)
+        if not spec:
+            return []
+        for dev in spec.get("devices", []):
+            ann = dev.get("annotations") or {}
+            ids = ann.get("tpu.google.com/chip-ids", "")
+            if ids:
+                return ids.split(",")
+        return []
+
+    def list_claim_uids(self) -> List[str]:
+        """Claim uids with spec files on disk (restart recovery)."""
+        prefix = _spec_filename(self.kind, "claim-")[: -len(".json")]
+        uids = []
+        try:
+            names = os.listdir(self.cdi_dir)
+        except OSError:
+            return []
+        for fname in names:
+            if fname.startswith(prefix) and fname.endswith(".json"):
+                uids.append(fname[len(prefix):-len(".json")])
+        return uids

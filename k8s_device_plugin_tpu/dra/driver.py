@@ -1,0 +1,443 @@
+"""The TPU DRA driver: kubelet DRAPlugin service + claim staging.
+
+DRA (Dynamic Resource Allocation, resource.k8s.io) is the modern successor
+to the device-plugin API. The division of labor differs from the classic
+path the reference implements:
+
+* **Inventory** — the driver publishes a ResourceSlice describing every
+  chip with structured attributes (dra/slices.py); the *scheduler* picks
+  devices against claims, so there is no ListAndWatch/Allocate.
+* **Staging** — once a ResourceClaim is allocated and its pod is placed,
+  the kubelet calls NodePrepareResources; the driver resolves the claim's
+  allocated device names, writes a per-claim CDI spec carrying the device
+  nodes + libtpu mount + TPU_* topology env (dra/cdi.py), and returns the
+  CDI id. NodeUnprepareResources reverts it.
+* **Registration** — the plugins_registry watcher socket with type
+  "DRAPlugin" (the same pluginregistration/v1 contract the device-plugin
+  path can already serve, server/plugin.py start_watcher_registration).
+
+The driver shares the TpuDevicePlugin's mesh, env construction, and
+placement state, so a node can run both planes during a migration without
+double-allocating chips.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional
+
+import grpc
+
+from ..api import dra_pb2 as pb
+from ..api.grpc_defs import (
+    DraPluginServicer,
+    WatcherRegistrationServicer,
+    add_dra_plugin_servicer,
+    add_watcher_registration_servicer,
+)
+from ..api import pluginregistration_pb2 as regpb
+from ..kube.client import KubeError
+from ..server import plugin as plugin_mod
+from . import cdi, slices
+
+log = logging.getLogger(__name__)
+
+DRA_VERSION = "v1beta1"
+DEFAULT_PLUGINS_DIR = "/var/lib/kubelet/plugins"
+
+
+class DraDriver(DraPluginServicer):
+    def __init__(
+        self,
+        plugin,  # TpuDevicePlugin: mesh, config, state, _tpu_env
+        kube_client=None,  # KubeClient; None disables claim lookup
+        driver_name: str = slices.DEFAULT_DRIVER,
+        node_name: str = "",
+        plugins_dir: str = DEFAULT_PLUGINS_DIR,
+        plugins_registry_dir: str = "/var/lib/kubelet/plugins_registry/",
+        cdi_dir: str = cdi.DEFAULT_CDI_DIR,
+        resync_interval_s: float = 60.0,
+    ):
+        self.plugin = plugin
+        self.client = kube_client
+        self.driver_name = driver_name
+        self.node_name = node_name or os.uname().nodename
+        self.plugins_dir = plugins_dir
+        self.plugins_registry_dir = plugins_registry_dir
+        self.resync_interval_s = resync_interval_s
+        self.cdi = cdi.CdiRegistry(cdi_dir)
+        self.socket_path = os.path.join(
+            plugins_dir, driver_name, "dra.sock"
+        )
+        self.registry_socket_path = os.path.join(
+            plugins_registry_dir, f"{driver_name}-reg.sock"
+        )
+        self._by_device_name = slices.chips_by_device_name(plugin.mesh)
+        self._lock = threading.Lock()
+        # claim uid -> chip ids staged for it (idempotent prepare; frees
+        # on unprepare even if the apiserver is unreachable then).
+        self.prepared: Dict[str, List[str]] = {}
+        # claim uid -> the claim's allocation results (for request_names).
+        self._results_by_uid: Dict[str, List[dict]] = {}
+        self._server: Optional[grpc.Server] = None
+        self._registry_server: Optional[grpc.Server] = None
+        # ResourceSlice republisher: event-triggered (health transitions)
+        # with retry — a one-shot publish that failed on a transient
+        # apiserver error would leave a registered driver advertising
+        # nothing until restart.
+        self._generation = 0
+        self._republish = threading.Event()
+        self._stop_pub = threading.Event()
+        self._pub_thread: Optional[threading.Thread] = None
+        # Let the classic plane refuse chips our claims hold — the kubelet
+        # can't see DRA holds in its own device accounting.
+        plugin.external_holds = self._held_chip_ids
+
+    def _held_chip_ids(self) -> set:
+        with self._lock:
+            return {c for ids in self.prepared.values() for c in ids}
+
+    # ------------------------------------------------------------------
+    # DRAPlugin service
+    # ------------------------------------------------------------------
+
+    def NodePrepareResources(self, request, context):
+        resp = pb.NodePrepareResourcesResponse()
+        for claim in request.claims:
+            try:
+                devices = self._prepare_claim(claim)
+                resp.claims[claim.uid].devices.extend(devices)
+            except Exception as e:  # per-claim error, not RPC failure
+                log.error(
+                    "prepare claim %s/%s failed: %s",
+                    claim.namespace, claim.name, e,
+                )
+                resp.claims[claim.uid].error = (
+                    f"preparing {claim.namespace}/{claim.name}: {e}"
+                )
+        return resp
+
+    def NodeUnprepareResources(self, request, context):
+        resp = pb.NodeUnprepareResourcesResponse()
+        for claim in request.claims:
+            try:
+                self._unprepare_claim(claim.uid)
+                resp.claims[claim.uid].SetInParent()
+            except Exception as e:
+                log.error("unprepare claim %s failed: %s", claim.uid, e)
+                resp.claims[claim.uid].error = str(e)
+        return resp
+
+    # ------------------------------------------------------------------
+    # Claim staging
+    # ------------------------------------------------------------------
+
+    def _allocated_results(self, claim_obj: dict) -> List[dict]:
+        """This driver's device results from the claim's allocation."""
+        alloc = (claim_obj.get("status") or {}).get("allocation") or {}
+        results = (alloc.get("devices") or {}).get("results") or []
+        return [
+            r for r in results if r.get("driver") == self.driver_name
+        ]
+
+    def _prepare_claim(self, claim) -> List[pb.Device]:
+        with self._lock:
+            already = self.prepared.get(claim.uid)
+        if already is not None:
+            # Idempotent: kubelet retries prepare after restarts.
+            return self._device_msgs(claim.uid, already)
+        if self.client is None:
+            raise RuntimeError("no API client to resolve the claim")
+        claim_obj = slices.get_resource_claim(
+            self.client, claim.namespace, claim.name
+        )
+        if claim_obj is None:
+            raise RuntimeError("ResourceClaim not found")
+        uid = (claim_obj.get("metadata") or {}).get("uid", "")
+        if uid and claim.uid and uid != claim.uid:
+            raise RuntimeError(
+                f"claim uid mismatch: kubelet {claim.uid}, API {uid}"
+            )
+        results = self._allocated_results(claim_obj)
+        if not results:
+            raise RuntimeError("claim has no allocation for this driver")
+        chip_ids = []
+        for r in results:
+            mc = self._by_device_name.get(r.get("device", ""))
+            if mc is None:
+                raise RuntimeError(
+                    f"allocated device {r.get('device')!r} not on this node"
+                )
+            chip_ids.append(mc.id)
+        # Check-and-commit under the classic plane's Allocate lock: an
+        # Allocate snapshots external_holds before its commit phase, so a
+        # prepare racing between its plan and commit could otherwise pass
+        # both guards and double-mount a chip. Lock order everywhere is
+        # _allocate_lock → self._lock.
+        with self.plugin._allocate_lock:
+            # The DRA scheduler allocates against the static ResourceSlice
+            # and is blind to the classic plane's device-manager usage —
+            # refuse a claim whose chips a device-plugin pod already holds
+            # (the mirror of Allocate's external_holds guard) or that are
+            # currently unhealthy (the slice republish lags a transition).
+            held_by_classic = (
+                set(self.plugin.state.allocated) - self._held_chip_ids()
+            )
+            conflict = sorted(set(chip_ids) & held_by_classic)
+            if conflict:
+                raise RuntimeError(
+                    "chips already held by the device-plugin plane: "
+                    f"{conflict}"
+                )
+            broken = sorted(
+                set(chip_ids) & self.plugin.state.unhealthy
+            )
+            if broken:
+                raise RuntimeError(f"chips currently unhealthy: {broken}")
+            chips = [self.plugin.mesh.by_id[i] for i in chip_ids]
+            env = self.plugin._tpu_env(chips)
+            self.cdi.write_claim_device(
+                claim.uid,
+                [mc.chip.dev_path for mc in chips],
+                env,
+                libtpu=plugin_mod.libtpu_mount(self.plugin.config),
+                chip_ids=chip_ids,
+            )
+            with self._lock:
+                self.prepared[claim.uid] = chip_ids
+                self._results_by_uid[claim.uid] = results
+            self.plugin.mark_allocated(chip_ids)
+        log.info(
+            "prepared claim %s/%s: chips %s",
+            claim.namespace, claim.name, chip_ids,
+        )
+        return self._device_msgs(claim.uid, chip_ids)
+
+    def _device_msgs(self, claim_uid: str, chip_ids: List[str]):
+        results = self._results_by_uid.get(claim_uid, [])
+        request_by_chip = {}
+        for r in results:
+            mc = self._by_device_name.get(r.get("device", ""))
+            if mc is not None and r.get("request"):
+                request_by_chip[mc.id] = r["request"]
+        cdi_id = self.cdi.device_id(f"claim-{claim_uid}")
+        msgs = []
+        for chip_id in chip_ids:
+            mc = self.plugin.mesh.by_id[chip_id]
+            msgs.append(
+                pb.Device(
+                    request_names=(
+                        [request_by_chip[chip_id]]
+                        if chip_id in request_by_chip
+                        else []
+                    ),
+                    pool_name=self.node_name,
+                    device_name=slices.device_name(mc),
+                    cdi_device_ids=[cdi_id],
+                )
+            )
+        return msgs
+
+    def _unprepare_claim(self, claim_uid: str) -> None:
+        self.cdi.remove_claim_device(claim_uid)
+        with self._lock:
+            chip_ids = self.prepared.pop(claim_uid, [])
+            self._results_by_uid.pop(claim_uid, None)
+        if chip_ids:
+            self.plugin.free_devices(chip_ids)
+            log.info("unprepared claim %s: freed %s", claim_uid, chip_ids)
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def recover_prepared(self) -> None:
+        """Rebuild prepared-claim holds from the CDI specs on disk: a
+        daemon restart must not forget which chips live claims hold, or
+        the classic plane would see them as free (the DRA analog of the
+        controller's checkpoint state rebuild). Claims unprepared while
+        the daemon was down are reconciled by the kubelet's
+        NodeUnprepareResources retries."""
+        recovered = []
+        for uid in self.cdi.list_claim_uids():
+            ids = [
+                i
+                for i in self.cdi.claim_chip_ids(uid)
+                if i in self.plugin.mesh.by_id
+            ]
+            if ids:
+                with self._lock:
+                    self.prepared[uid] = ids
+                recovered.extend(ids)
+        if recovered:
+            self.plugin.mark_allocated(recovered)
+            log.info(
+                "recovered %d prepared DRA claims holding %s",
+                len(self.prepared), sorted(recovered),
+            )
+
+    def start(self) -> None:
+        self.recover_prepared()
+        os.makedirs(os.path.dirname(self.socket_path), exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        add_dra_plugin_servicer(self, self._server)
+        self._server.add_insecure_port(f"unix:{self.socket_path}")
+        self._server.start()
+        self._start_registry_socket()
+        if self.client is not None:
+            self._stop_pub.clear()
+            self._pub_thread = threading.Thread(
+                target=self._publisher_loop,
+                name="dra-slice-publisher",
+                daemon=True,
+            )
+            self._pub_thread.start()
+            # Health transitions change the advertised inventory (slices
+            # exclude unhealthy chips) — chain onto the existing hook so
+            # the wiring's Event emitter keeps firing too.
+            prev_hook = self.plugin.on_health_transition
+
+            def _chained(chip_id: str, healthy: bool) -> None:
+                if prev_hook is not None:
+                    prev_hook(chip_id, healthy)
+                self.trigger_republish()
+
+            self.plugin.on_health_transition = _chained
+        log.info(
+            "DRA driver %s serving at %s", self.driver_name, self.socket_path
+        )
+
+    def trigger_republish(self) -> None:
+        self._republish.set()
+
+    def _publisher_loop(self) -> None:
+        backoff = 2.0
+        need_publish = True
+        while not self._stop_pub.is_set():
+            if need_publish:
+                try:
+                    self.publish()
+                    backoff = 2.0
+                    need_publish = False
+                except Exception as e:
+                    log.warning(
+                        "ResourceSlice publish failed (retry in %.0fs): %s",
+                        backoff, e,
+                    )
+                    if self._stop_pub.wait(backoff):
+                        return
+                    backoff = min(backoff * 2, 60.0)
+                    continue
+            # Wake on a trigger (health transition) or periodically: a
+            # slice deleted out from under us (kubelet orphan cleanup, an
+            # admin) must be re-created without waiting for a transition —
+            # but a periodic wake with the slice intact publishes nothing
+            # (a PUT every interval would churn watchers).
+            triggered = self._republish.wait(timeout=self.resync_interval_s)
+            self._republish.clear()
+            if self._stop_pub.is_set():
+                return
+            if triggered:
+                self._stop_pub.wait(0.3)  # coalesce transition bursts
+                need_publish = True
+            else:
+                need_publish = not self._slice_exists()
+
+    def _slice_exists(self) -> bool:
+        try:
+            self.client.get(
+                f"{slices.RESOURCE_API}/resourceslices/"
+                f"{slices.slice_name(self.node_name, self.driver_name)}"
+            )
+            return True
+        except KubeError as e:
+            if e.status_code == 404:
+                return False
+            return True  # transient error: don't churn, retry next wake
+        except Exception:
+            return True
+
+    def _start_registry_socket(self) -> None:
+        driver = self
+
+        class _Watcher(WatcherRegistrationServicer):
+            def GetInfo(self, request, context):
+                return regpb.PluginInfo(
+                    type="DRAPlugin",
+                    name=driver.driver_name,
+                    endpoint=driver.socket_path,
+                    supported_versions=[DRA_VERSION],
+                )
+
+            def NotifyRegistrationStatus(self, request, context):
+                if request.plugin_registered:
+                    log.info(
+                        "kubelet registered DRA driver %s",
+                        driver.driver_name,
+                    )
+                else:
+                    log.error(
+                        "kubelet REJECTED DRA driver %s: %s",
+                        driver.driver_name, request.error,
+                    )
+                return regpb.RegistrationStatusResponse()
+
+        os.makedirs(self.plugins_registry_dir, exist_ok=True)
+        sock = self.registry_socket_path
+        if os.path.exists(sock):
+            os.unlink(sock)
+        self._registry_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=2)
+        )
+        add_watcher_registration_servicer(_Watcher(), self._registry_server)
+        self._registry_server.add_insecure_port(f"unix:{sock}")
+        self._registry_server.start()
+
+    def publish(self) -> Optional[dict]:
+        """Publish this node's ResourceSlice, excluding unhealthy chips
+        (the DRA analog of ListAndWatch's Unhealthy marking). Bumps the
+        pool generation so consumers see slice updates in order. No-op
+        without a client."""
+        if self.client is None:
+            return None
+        with self._lock:
+            self._generation += 1
+            generation = self._generation
+        return slices.publish_resource_slice(
+            self.client,
+            self.plugin.mesh,
+            self.node_name,
+            driver=self.driver_name,
+            pool_generation=generation,
+            exclude=self.plugin.state.unhealthy,
+        )
+
+    def stop(self, unpublish: bool = False) -> None:
+        self._stop_pub.set()
+        self._republish.set()
+        if self._pub_thread is not None:
+            self._pub_thread.join(timeout=5)
+            self._pub_thread = None
+        if self._server is not None:
+            self._server.stop(grace=0.5).wait()
+            self._server = None
+        if self._registry_server is not None:
+            self._registry_server.stop(grace=0.5).wait()
+            self._registry_server = None
+        for path in (self.socket_path, self.registry_socket_path):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        if unpublish and self.client is not None:
+            try:
+                slices.delete_resource_slice(
+                    self.client, self.node_name, self.driver_name
+                )
+            except Exception as e:
+                log.warning("ResourceSlice delete failed: %s", e)

@@ -146,6 +146,28 @@ class KubeClient:
             headers={"Content-Type": content_type},
         ).json()
 
+    def create(self, path: str, body: dict) -> dict:
+        """POST a new object to a collection path (e.g. ResourceSlices)."""
+        return self._request(
+            "POST",
+            path,
+            data=json.dumps(body),
+            headers={"Content-Type": "application/json"},
+        ).json()
+
+    def replace(self, path: str, body: dict) -> dict:
+        """PUT over an existing object path (body must carry the current
+        resourceVersion for conflict detection)."""
+        return self._request(
+            "PUT",
+            path,
+            data=json.dumps(body),
+            headers={"Content-Type": "application/json"},
+        ).json()
+
+    def delete(self, path: str) -> dict:
+        return self._request("DELETE", path).json()
+
     # -- nodes -------------------------------------------------------------
 
     def get_node(self, name: str) -> dict:

@@ -46,6 +46,17 @@ from ..utils import metrics, profiling
 log = logging.getLogger(__name__)
 
 
+def libtpu_mount(config) -> Optional[tuple]:
+    """(host_path, container_path) for the libtpu.so mount, or None when
+    the host doesn't stage it. The single definition of the mount decision
+    — used by both the device-plugin Allocate response and the DRA
+    per-claim CDI spec (dra/cdi.py), so the two planes can't hand
+    containers divergent libtpu setups."""
+    if config.libtpu_host_path and os.path.exists(config.libtpu_host_path):
+        return (config.libtpu_host_path, config.libtpu_container_path)
+    return None
+
+
 @dataclasses.dataclass
 class PluginConfig:
     """Knobs the reference hard-codes or reads from env
@@ -126,6 +137,13 @@ class TpuDevicePlugin(DevicePluginServicer):
         # /root/reference/server.go:49, controller.go:200-210). Only
         # populated in substitute_on_allocate mode.
         self.shadow_map: Dict[str, str] = {}
+        # Permanent record of substitution-mode kubeletID→realID mappings.
+        # shadow_map entries are DRAINED on reconcile (reference parity,
+        # controller.go:200-210), which makes them unusable for later
+        # translation; this map keeps the latest mapping per kubelet id so
+        # the controller's delete-time guard can compare the kubelet's
+        # assignments against real chip ids correctly.
+        self.substitutions: Dict[str, str] = {}
         self._server: Optional[grpc.Server] = None
         self._watcher_server: Optional[grpc.Server] = None
         self._stop = threading.Event()
@@ -141,6 +159,12 @@ class TpuDevicePlugin(DevicePluginServicer):
         # attaches a Kubernetes Event emitter (the reference wires an event
         # broadcaster but never emits, /root/reference/controller.go:76-80).
         self.on_health_transition: Optional[Callable[[str, bool], None]] = None
+        # Chips held by a co-resident plane the kubelet can't see (the DRA
+        # driver attaches its prepared-claim set, dra/driver.py). Allocate
+        # refuses these outright: unlike this plane's own holds — which the
+        # kubelet also tracks and never double-assigns — the kubelet is
+        # blind to them, so its picks are the only path to a double mount.
+        self.external_holds: Optional[Callable[[], set]] = None
         metrics.CHIPS.set(len(mesh.mesh_chips), state="total")
         self._update_chip_gauges()
         # Device-list versioning: streams re-send whenever bumped.
@@ -393,6 +417,9 @@ class TpuDevicePlugin(DevicePluginServicer):
         with self._allocate_lock:
             plans = []
             planned: set = set()
+            held_elsewhere = (
+                self.external_holds() if self.external_holds else set()
+            )
             for creq in request.container_requests:
                 requested = list(creq.devicesIDs)
                 unknown = [i for i in requested if i not in self.mesh.by_id]
@@ -427,11 +454,25 @@ class TpuDevicePlugin(DevicePluginServicer):
                             f"cannot allocate {len(requested)} chips "
                             f"disjoint from prior containers",
                         )
+                staged = [i for i in assigned if i in held_elsewhere]
+                if staged:
+                    # The kubelet's device accounting can't see DRA-claim
+                    # holds; refusing beats mounting one chip into two
+                    # containers. Checked on the FINAL set: in substitution
+                    # mode the remap above already steered off held chips
+                    # (select excludes them), so only a pick that survives
+                    # to here is a real conflict.
+                    metrics.GRPC_ERRORS.inc(method="Allocate")
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        f"chips staged by DRA claims: {staged}",
+                    )
                 planned.update(assigned)
                 plans.append((requested, assigned, substitutions))
             resp = pb.AllocateResponse()
             for requested, assigned, substitutions in plans:
                 self.shadow_map.update(substitutions)
+                self.substitutions.update(substitutions)
                 self.state.allocate(assigned)
                 resp.container_responses.append(
                     self._container_response(assigned)
@@ -465,15 +506,15 @@ class TpuDevicePlugin(DevicePluginServicer):
                 host_path=mc.chip.dev_path,
                 permissions=self.config.device_permissions,
             )
-        if self.config.libtpu_host_path and os.path.exists(
-            self.config.libtpu_host_path
-        ):
+        mount = libtpu_mount(self.config)
+        if mount is not None:
+            host_path, container_path = mount
             resp.mounts.add(
-                container_path=self.config.libtpu_container_path,
-                host_path=self.config.libtpu_host_path,
+                container_path=container_path,
+                host_path=host_path,
                 read_only=True,
             )
-            resp.envs["TPU_LIBRARY_PATH"] = self.config.libtpu_container_path
+            resp.envs["TPU_LIBRARY_PATH"] = container_path
         resp.envs.update(self._tpu_env(chips))
         resp.annotations[constants.POD_DEVICES_ANNOTATION] = ",".join(ids)
         if self.config.cdi_kind:

@@ -82,6 +82,12 @@ class DaemonConfig:
     # Kubelet PodResources API socket; preferred over the checkpoint file
     # for pod→device reconciliation ("" forces checkpoint-only).
     podresources_socket: str = constants.POD_RESOURCES_SOCKET
+    # DRA (resource.k8s.io) plane: serve the kubelet DRAPlugin service and
+    # publish this node's ResourceSlice alongside the device-plugin path.
+    enable_dra: bool = False
+    dra_driver_name: str = "tpu.google.com"
+    plugins_dir: str = "/var/lib/kubelet/plugins"
+    cdi_dir: str = "/var/run/cdi"
 
 
 class Daemon:
@@ -94,6 +100,7 @@ class Daemon:
         self.plugin: Optional[TpuDevicePlugin] = None
         self.health: Optional[HealthWatcher] = None
         self.controller = None  # set by kube wiring when enabled
+        self.dra = None  # set by _start_dra when enabled
         self._kube = None
         self._kube_client = None  # pre-serve client (build_and_serve)
         self.metrics_server = None
@@ -191,6 +198,44 @@ class Daemon:
         if self.health is not None:
             self.health.start()
         self._start_kube_integration(mesh)
+        if self.cfg.enable_dra:
+            self._start_dra()
+
+    def _start_dra(self) -> None:
+        """DRA plane (resource.k8s.io): DRAPlugin service + ResourceSlice.
+        Shares the plugin's mesh and placement state so the two planes
+        can't double-allocate chips during a migration."""
+        client = self._kube or self._kube_client  # reuse pre-serve client
+        if client is None:
+            # --no-controller or soft-failed kube wiring: the DRA plane is
+            # useless without an API client (no ResourceSlice inventory,
+            # every claim prepare fails) — build one or don't register.
+            try:
+                from ..kube.client import KubeClient
+
+                client = KubeClient.from_env(self.cfg.kubeconfig)
+            except Exception as e:
+                log.error(
+                    "DRA plane disabled: no API server client (%s)", e
+                )
+                self.dra = None
+                return
+        try:
+            from ..dra.driver import DraDriver
+
+            self.dra = DraDriver(
+                self.plugin,
+                kube_client=client,
+                driver_name=self.cfg.dra_driver_name,
+                node_name=self.cfg.node_name or os.uname().nodename,
+                plugins_dir=self.cfg.plugins_dir,
+                plugins_registry_dir=self.cfg.plugins_registry_dir,
+                cdi_dir=self.cfg.cdi_dir,
+            )
+            self.dra.start()  # publisher thread handles the ResourceSlice
+        except Exception as e:
+            log.warning("DRA plane disabled: %s", e)
+            self.dra = None
 
     def _start_kube_integration(self, mesh: IciMesh) -> None:
         """Node-annotation publishing + pod controller; soft-fails when no
@@ -208,6 +253,12 @@ class Daemon:
             self.controller = None
 
     def teardown(self) -> None:
+        if self.dra is not None:
+            try:
+                self.dra.stop()
+            except Exception:
+                log.exception("DRA driver stop failed")
+            self.dra = None
         if self.controller is not None:
             try:
                 self.controller.stop()
@@ -322,6 +373,14 @@ def parse_args(argv) -> DaemonConfig:
                    help="kubelet PodResources API socket, preferred over "
                    "the checkpoint file for reconciliation; '' forces "
                    "checkpoint-only")
+    p.add_argument("--dra", action="store_true",
+                   help="also serve the DRA plane (resource.k8s.io): "
+                   "kubelet DRAPlugin service, ResourceSlice publishing, "
+                   "per-claim CDI specs")
+    p.add_argument("--dra-driver-name", default="tpu.google.com")
+    p.add_argument("--plugins-dir", default="/var/lib/kubelet/plugins",
+                   help="kubelet plugins dir for the DRA socket")
+    p.add_argument("--cdi-dir", default="/var/run/cdi")
     p.add_argument("--no-controller", action="store_true")
     p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
     p.add_argument("--python-backend", action="store_true",
@@ -354,6 +413,10 @@ def parse_args(argv) -> DaemonConfig:
         registration_mode=a.registration_mode,
         plugins_registry_dir=a.plugins_registry_dir,
         podresources_socket=a.podresources_socket,
+        enable_dra=a.dra,
+        dra_driver_name=a.dra_driver_name,
+        plugins_dir=a.plugins_dir,
+        cdi_dir=a.cdi_dir,
     )
 
 

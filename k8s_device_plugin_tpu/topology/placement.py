@@ -155,15 +155,18 @@ class PlacementState:
         /root/reference/server.go:191-193)."""
         with self._lock:
             pool = list(available) if available is not None else self.available()
-            # The kubelet's pool reflects ITS health view, which lags ours
-            # by one ListAndWatch round trip: drop chips we know are
-            # unhealthy (the plugin is authoritative for health; the
-            # kubelet is authoritative for allocation, so the rest of the
-            # caller's pool is trusted).
+            # The kubelet's pool reflects ITS view, which can lag or miss
+            # ours: health flips lag by one ListAndWatch round trip, and
+            # chips staged by the DRA plane (dra/driver.py) never enter the
+            # kubelet's device-manager accounting at all. Drop both — this
+            # state is the one place both planes record holds, so it is
+            # authoritative for what is actually free.
             pool = [
                 p
                 for p in pool
-                if p in self.mesh.by_id and p not in self._unhealthy
+                if p in self.mesh.by_id
+                and p not in self._unhealthy
+                and p not in self._allocated
             ]
             must = [m for m in must_include if m in self.mesh.by_id]
             if not all(m in pool for m in must):

@@ -21,6 +21,10 @@ class FakeApiServer:
         self._rv = 0
         self.pods: Dict[Tuple[str, str], dict] = {}  # (ns, name) -> pod
         self.nodes: Dict[str, dict] = {}
+        # resource.k8s.io/v1beta1 (DRA): name -> ResourceSlice,
+        # (ns, name) -> ResourceClaim.
+        self.resourceslices: Dict[str, dict] = {}
+        self.resourceclaims: Dict[Tuple[str, str], dict] = {}
         self.pod_patches: List[Tuple[str, str, dict]] = []
         self.node_patches: List[Tuple[str, dict]] = []
         self.events: List[dict] = []
@@ -61,6 +65,12 @@ class FakeApiServer:
                 pod["metadata"]["resourceVersion"] = self._next_rv()
                 self._broadcast("DELETED", pod)
 
+    def add_resource_claim(self, claim: dict):
+        meta = claim.setdefault("metadata", {})
+        key = (meta.get("namespace", "default"), meta.get("name", ""))
+        with self._lock:
+            self.resourceclaims[key] = claim
+
     def _broadcast(self, etype: str, pod: dict):
         ev = {"type": etype, "object": pod}
         self._event_log.append(
@@ -88,6 +98,10 @@ class FakeApiServer:
                         server._handle_watch(self, params)
                     else:
                         server._handle_list(self, params)
+                elif parsed.path.startswith(
+                    "/apis/resource.k8s.io/v1beta1/"
+                ):
+                    server._handle_resource_get(self, parsed.path)
                 else:
                     self.send_error(404)
 
@@ -100,6 +114,54 @@ class FakeApiServer:
                     with server._lock:
                         server.events.append(body)
                     server._send_json(self, body, 201)
+                elif self.path == (
+                    "/apis/resource.k8s.io/v1beta1/resourceslices"
+                ):
+                    name = body.get("metadata", {}).get("name", "")
+                    with server._lock:
+                        if name in server.resourceslices:
+                            server._send_json(
+                                self, {"message": "already exists"}, 409
+                            )
+                            return
+                        body["metadata"]["resourceVersion"] = (
+                            server._next_rv()
+                        )
+                        server.resourceslices[name] = body
+                    server._send_json(self, body, 201)
+                else:
+                    self.send_error(404)
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                prefix = "/apis/resource.k8s.io/v1beta1/resourceslices/"
+                if self.path.startswith(prefix):
+                    name = self.path[len(prefix):]
+                    with server._lock:
+                        if name not in server.resourceslices:
+                            server._send_json(
+                                self, {"message": "not found"}, 404
+                            )
+                            return
+                        body["metadata"]["resourceVersion"] = (
+                            server._next_rv()
+                        )
+                        server.resourceslices[name] = body
+                    server._send_json(self, body)
+                else:
+                    self.send_error(404)
+
+            def do_DELETE(self):
+                prefix = "/apis/resource.k8s.io/v1beta1/resourceslices/"
+                if self.path.startswith(prefix):
+                    name = self.path[len(prefix):]
+                    with server._lock:
+                        gone = server.resourceslices.pop(name, None)
+                    if gone is None:
+                        server._send_json(self, {"message": "not found"}, 404)
+                    else:
+                        server._send_json(self, {"status": "Success"})
                 else:
                     self.send_error(404)
 
@@ -198,6 +260,33 @@ class FakeApiServer:
             pass
         finally:
             self._watchers.remove(q)
+
+    def _handle_resource_get(self, handler, path: str):
+        parts = path.strip("/").split("/")
+        # apis/resource.k8s.io/v1beta1/resourceslices[/{name}]
+        # apis/resource.k8s.io/v1beta1/namespaces/{ns}/resourceclaims/{name}
+        with self._lock:
+            if len(parts) == 4 and parts[3] == "resourceslices":
+                self._send_json(
+                    handler,
+                    {"kind": "ResourceSliceList",
+                     "items": list(self.resourceslices.values())},
+                )
+                return
+            if len(parts) == 5 and parts[3] == "resourceslices":
+                obj = self.resourceslices.get(parts[4])
+            elif (
+                len(parts) == 7
+                and parts[3] == "namespaces"
+                and parts[5] == "resourceclaims"
+            ):
+                obj = self.resourceclaims.get((parts[4], parts[6]))
+            else:
+                obj = None
+        if obj is None:
+            self._send_json(handler, {"message": "not found"}, 404)
+        else:
+            self._send_json(handler, obj)
 
     @staticmethod
     def _merge_annotations(meta: dict, patch_meta: dict, key: str):

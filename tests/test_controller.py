@@ -538,6 +538,66 @@ def test_rebuild_attributes_assignment_to_single_instance(
     ctrl.rebuild_state()
     assert ctrl._pod_devices == {"uid-old": set(ids[:2])}
     assert plugin.state.allocated == set(ids[:2])
-    # Old instance finally dies -> chips free exactly once.
+    # Old instance finally dies. While the kubelet still reports the
+    # (ns,name) assigned, the chips are re-bound (not freed — the entry
+    # may be the replacement's); once the kubelet drops the entry, the
+    # delete frees.
+    ctrl._handle_delete(old)
+    assert plugin.state.allocated == set(ids[:2])  # re-bound, conservative
+    assert ctrl._pod_devices == {"default/pod-0": set(ids[:2])}
+    podres.pods.pop(("default", "pod-0"))
     ctrl._handle_delete(old)
     assert plugin.state.allocated == set()
+
+
+def test_delete_does_not_free_chips_reassigned_to_replacement(
+    api, plugin, tmp_path, podres
+):
+    """An old pod's DELETED event can arrive after the kubelet already
+    re-assigned its chips to a replacement pod (grace-period lag). The
+    delete path must consult the kubelet's current assignments and keep
+    such chips allocated, or a third pod could double-mount them."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_podres_controller(api, plugin, tmp_path, podres)
+    # Old pod-0 instance held ids[:2]; its replacement (uid-new) already
+    # has them per the kubelet, and an unrelated pod holds ids[2].
+    plugin.state.allocate(ids[:3])
+    ctrl._pod_devices["uid-old"] = set(ids[:2])
+    ctrl._pod_devices["uid-other"] = {ids[2]}
+    podres.set_pod("default", "pod-0", "google.com/tpu", ids[:2])
+    podres.set_pod("default", "other", "google.com/tpu", [ids[2]])
+    old = pod_dict("pod-0", "uid-old", tpus=2)
+    # The DELETED object is the OLD instance, but (ns,name) now belongs to
+    # the replacement — the kubelet's entry for pod-0 is the NEW holder's,
+    # so its chips must NOT be freed.
+    ctrl._handle_delete(old)
+    assert plugin.state.allocated == set(ids[:3])  # nothing freed
+    assert "uid-old" not in ctrl._pod_devices
+    # Whereas a pod whose chips the kubelet no longer assigns frees fine.
+    podres.pods.pop(("default", "other"))
+    other = pod_dict("other", "uid-other", tpus=1)
+    ctrl._handle_delete(other)
+    assert plugin.state.allocated == set(ids[:2])
+
+
+def test_delete_guard_translates_via_persistent_substitutions(
+    api, plugin, tmp_path
+):
+    """Substitution mode: pod A's kubelet id K was substituted to real
+    chip R, and the shadow entry was drained on A's reconcile. When pod B
+    (holding real chip K) is deleted, the delete-time guard must translate
+    A's kubelet assignment through the PERSISTENT substitution record —
+    via the drained shadow map, A's entry K would masquerade as B's real
+    chip and wrongly defer the free."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    # Pod A: kubelet allocated ids[1], plugin substituted real ids[0];
+    # reconcile drained the shadow entry but the permanent record remains.
+    plugin.substitutions[ids[1]] = ids[0]
+    plugin.state.allocate([ids[0], ids[1]])  # A holds ids[0], B holds ids[1]
+    write_checkpoint(tmp_path, {"uid-a": [ids[1]]})  # A's kubelet entry
+    ctrl._pod_devices["uid-b"] = {ids[1]}
+    b = pod_dict("pod-b", "uid-b", tpus=1)
+    ctrl._handle_delete(b)
+    # B's chip ids[1] freed (A's kubelet id ids[1] means real ids[0]).
+    assert plugin.state.allocated == {ids[0]}

@@ -1,0 +1,560 @@
+"""DRA plane tests: ResourceSlice publishing, per-claim CDI specs, and the
+kubelet DRAPlugin service (NodePrepareResources/NodeUnprepareResources)
+driven over a real unix-socket gRPC connection, with ResourceClaims served
+by the fake API server."""
+
+import json
+import os
+
+import grpc
+import pytest
+
+from k8s_device_plugin_tpu.api import dra_pb2 as pb
+from k8s_device_plugin_tpu.api.grpc_defs import (
+    DraPluginStub,
+    WatcherRegistrationStub,
+)
+from k8s_device_plugin_tpu.api import pluginregistration_pb2 as regpb
+from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+from k8s_device_plugin_tpu.dra import slices
+from k8s_device_plugin_tpu.dra.cdi import CdiRegistry
+from k8s_device_plugin_tpu.dra.driver import DraDriver
+from k8s_device_plugin_tpu.kube.client import KubeClient
+from k8s_device_plugin_tpu.server.plugin import PluginConfig, TpuDevicePlugin
+from k8s_device_plugin_tpu.topology.mesh import IciMesh
+from tests import fakes
+from tests.fake_apiserver import FakeApiServer
+
+NODE = "tpu-node-1"
+DRIVER = "tpu.google.com"
+
+
+@pytest.fixture
+def plugin(tmp_path):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5p", 4)
+    chips = PyTpuInfo().scan(accel, dev)
+    return TpuDevicePlugin(
+        IciMesh(chips), config=PluginConfig(libtpu_host_path="")
+    )
+
+
+@pytest.fixture
+def api():
+    s = FakeApiServer()
+    url = s.start()
+    s.add_node(NODE)
+    yield s, KubeClient(url)
+    s.stop()
+
+
+@pytest.fixture
+def driver(plugin, api, tmp_path):
+    server, client = api
+    d = DraDriver(
+        plugin,
+        kube_client=client,
+        driver_name=DRIVER,
+        node_name=NODE,
+        plugins_dir=str(tmp_path / "plugins"),
+        plugins_registry_dir=str(tmp_path / "plugins_registry"),
+        cdi_dir=str(tmp_path / "cdi"),
+    )
+    d.start()
+    yield d
+    d.stop()
+
+
+def claim_obj(uid, device_names, requests=None, driver=DRIVER):
+    results = []
+    for i, dn in enumerate(device_names):
+        results.append(
+            {
+                "request": (requests or ["tpus"] * len(device_names))[i],
+                "driver": driver,
+                "pool": NODE,
+                "device": dn,
+            }
+        )
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {
+            "name": f"claim-{uid}",
+            "namespace": "default",
+            "uid": uid,
+        },
+        "status": {"allocation": {"devices": {"results": results}}},
+    }
+
+
+def stub_for(driver):
+    ch = grpc.insecure_channel(f"unix:{driver.socket_path}")
+    grpc.channel_ready_future(ch).result(timeout=5)
+    return DraPluginStub(ch)
+
+
+# ---------------------------------------------------------------------------
+# CDI registry
+# ---------------------------------------------------------------------------
+
+def test_cdi_write_read_remove(tmp_path):
+    reg = CdiRegistry(str(tmp_path / "cdi"))
+    cdi_id = reg.write_claim_device(
+        "uid-1", ["/dev/accel0", "/dev/accel1"], {"TPU_VISIBLE_CHIPS": "0,1"}
+    )
+    assert cdi_id == "google.com/tpu=claim-uid-1"
+    spec = reg.read_claim_spec("uid-1")
+    assert spec["cdiVersion"] == "0.6.0"
+    assert spec["kind"] == "google.com/tpu"
+    dev = spec["devices"][0]
+    assert dev["name"] == "claim-uid-1"
+    nodes = [n["path"] for n in dev["containerEdits"]["deviceNodes"]]
+    assert nodes == ["/dev/accel0", "/dev/accel1"]
+    assert "TPU_VISIBLE_CHIPS=0,1" in dev["containerEdits"]["env"]
+    assert reg.list_claim_uids() == ["uid-1"]
+    reg.remove_claim_device("uid-1")
+    assert reg.read_claim_spec("uid-1") is None
+    reg.remove_claim_device("uid-1")  # idempotent
+
+
+def test_cdi_libtpu_mount(tmp_path):
+    """The mount decision comes from the shared server.plugin.libtpu_mount
+    helper, so both planes stage libtpu identically."""
+    from k8s_device_plugin_tpu.server.plugin import libtpu_mount
+
+    lib = tmp_path / "libtpu.so"
+    lib.write_bytes(b"\x7fELF")
+    reg = CdiRegistry(str(tmp_path / "cdi"))
+    cfg = PluginConfig(libtpu_host_path=str(lib))
+    reg.write_claim_device("u", ["/dev/accel0"], {}, libtpu=libtpu_mount(cfg))
+    edits = reg.read_claim_spec("u")["devices"][0]["containerEdits"]
+    assert edits["mounts"][0]["hostPath"] == str(lib)
+    assert "TPU_LIBRARY_PATH=/usr/lib/libtpu.so" in edits["env"]
+    # No staged libtpu on the host -> no mount, no env.
+    assert libtpu_mount(PluginConfig(libtpu_host_path="")) is None
+
+
+# ---------------------------------------------------------------------------
+# ResourceSlice
+# ---------------------------------------------------------------------------
+
+def test_build_resource_slice_shape(plugin):
+    body = slices.build_resource_slice(plugin.mesh, NODE)
+    assert body["spec"]["driver"] == DRIVER
+    assert body["spec"]["nodeName"] == NODE
+    assert body["spec"]["pool"]["name"] == NODE
+    devices = body["spec"]["devices"]
+    assert len(devices) == 4
+    names = [d["name"] for d in devices]
+    assert names == ["chip-0", "chip-1", "chip-2", "chip-3"]
+    d0 = devices[0]["basic"]
+    # v5p host block is 2x2x1: chip-3 sits at (1,1,0).
+    assert devices[3]["basic"]["attributes"]["coordX"] == {"int": 1}
+    assert devices[3]["basic"]["attributes"]["coordY"] == {"int": 1}
+    assert d0["attributes"]["chipType"] == {"string": "v5p"}
+    assert d0["attributes"]["chipId"]["string"] in plugin.mesh.by_id
+    assert int(d0["capacity"]["hbm"]["value"]) > 0
+    # Device names must be DNS-1123 labels (the reason chip ids with PCI
+    # addresses can't be used directly).
+    import re
+
+    for n in names:
+        assert re.fullmatch(r"[a-z0-9]([-a-z0-9]*[a-z0-9])?", n)
+
+
+def test_publish_resource_slice_create_then_replace(plugin, api):
+    server, client = api
+    slices.publish_resource_slice(client, plugin.mesh, NODE)
+    name = slices.slice_name(NODE)
+    assert name in server.resourceslices
+    first_rv = server.resourceslices[name]["metadata"]["resourceVersion"]
+    slices.publish_resource_slice(
+        client, plugin.mesh, NODE, pool_generation=2
+    )
+    obj = server.resourceslices[name]
+    assert obj["spec"]["pool"]["generation"] == 2
+    assert obj["metadata"]["resourceVersion"] != first_rv
+    slices.delete_resource_slice(client, NODE)
+    assert name not in server.resourceslices
+    slices.delete_resource_slice(client, NODE)  # 404 tolerated
+
+
+# ---------------------------------------------------------------------------
+# DRAPlugin service
+# ---------------------------------------------------------------------------
+
+def test_prepare_and_unprepare_claim(driver, api, plugin):
+    server, _ = api
+    server.add_resource_claim(claim_obj("uid-1", ["chip-0", "chip-1"]))
+    stub = stub_for(driver)
+    req = pb.NodePrepareResourcesRequest()
+    req.claims.add(namespace="default", name="claim-uid-1", uid="uid-1")
+    resp = stub.NodePrepareResources(req)
+    result = resp.claims["uid-1"]
+    assert not result.error
+    assert len(result.devices) == 2
+    assert {d.device_name for d in result.devices} == {"chip-0", "chip-1"}
+    assert result.devices[0].pool_name == NODE
+    assert result.devices[0].request_names == ["tpus"]
+    assert result.devices[0].cdi_device_ids == [
+        "google.com/tpu=claim-uid-1"
+    ]
+    # The CDI spec stages the right device nodes + claim-shaped env.
+    spec = driver.cdi.read_claim_spec("uid-1")
+    edits = spec["devices"][0]["containerEdits"]
+    assert len(edits["deviceNodes"]) == 2
+    env = dict(e.split("=", 1) for e in edits["env"])
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert env["TPU_CHIPS_PER_HOST_BOUNDS"]  # bounding box present
+    # Chips held in the shared placement state (no double-allocation with
+    # the device-plugin plane).
+    assert len(plugin.state.allocated) == 2
+
+    # Idempotent retry (kubelet re-calls after restart).
+    resp2 = stub.NodePrepareResources(req)
+    assert len(resp2.claims["uid-1"].devices) == 2
+
+    ureq = pb.NodeUnprepareResourcesRequest()
+    ureq.claims.add(namespace="default", name="claim-uid-1", uid="uid-1")
+    uresp = stub.NodeUnprepareResources(ureq)
+    assert not uresp.claims["uid-1"].error
+    assert plugin.state.allocated == set()
+    assert driver.cdi.read_claim_spec("uid-1") is None
+
+
+def test_prepare_claim_not_found_is_per_claim_error(driver):
+    stub = stub_for(driver)
+    req = pb.NodePrepareResourcesRequest()
+    req.claims.add(namespace="default", name="missing", uid="uid-x")
+    resp = stub.NodePrepareResources(req)
+    assert "not found" in resp.claims["uid-x"].error
+    assert not resp.claims["uid-x"].devices
+
+
+def test_prepare_unknown_device_is_per_claim_error(driver, api):
+    server, _ = api
+    server.add_resource_claim(claim_obj("uid-2", ["chip-9"]))
+    stub = stub_for(driver)
+    req = pb.NodePrepareResourcesRequest()
+    req.claims.add(namespace="default", name="claim-uid-2", uid="uid-2")
+    resp = stub.NodePrepareResources(req)
+    assert "chip-9" in resp.claims["uid-2"].error
+
+
+def test_prepare_uid_mismatch_rejected(driver, api):
+    server, _ = api
+    server.add_resource_claim(claim_obj("uid-real", ["chip-0"]))
+    stub = stub_for(driver)
+    req = pb.NodePrepareResourcesRequest()
+    # kubelet's claim ref carries a different uid than the API object (a
+    # deleted-and-recreated claim): must not stage the wrong instance.
+    req.claims.add(
+        namespace="default", name="claim-uid-real", uid="uid-other"
+    )
+    resp = stub.NodePrepareResources(req)
+    assert "uid mismatch" in resp.claims["uid-other"].error
+
+
+def test_registry_socket_announces_dra_plugin(driver):
+    ch = grpc.insecure_channel(f"unix:{driver.registry_socket_path}")
+    grpc.channel_ready_future(ch).result(timeout=5)
+    stub = WatcherRegistrationStub(ch)
+    info = stub.GetInfo(regpb.InfoRequest())
+    assert info.type == "DRAPlugin"
+    assert info.name == DRIVER
+    assert info.endpoint == driver.socket_path
+    assert list(info.supported_versions) == ["v1beta1"]
+    stub.NotifyRegistrationStatus(
+        regpb.RegistrationStatus(plugin_registered=True)
+    )
+
+
+def test_other_driver_results_ignored(driver, api):
+    """A claim can mix devices from several drivers; only ours are staged."""
+    server, _ = api
+    claim = claim_obj("uid-3", ["chip-2"])
+    claim["status"]["allocation"]["devices"]["results"].append(
+        {
+            "request": "nic",
+            "driver": "nic.vendor.io",
+            "pool": NODE,
+            "device": "nic-0",
+        }
+    )
+    server.add_resource_claim(claim)
+    stub = stub_for(driver)
+    req = pb.NodePrepareResourcesRequest()
+    req.claims.add(namespace="default", name="claim-uid-3", uid="uid-3")
+    resp = stub.NodePrepareResources(req)
+    assert not resp.claims["uid-3"].error
+    assert [d.device_name for d in resp.claims["uid-3"].devices] == [
+        "chip-2"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Daemon wiring (--dra)
+# ---------------------------------------------------------------------------
+
+def test_daemon_serves_dra_plane(tmp_path):
+    """The supervisor with enable_dra publishes the ResourceSlice and
+    serves NodePrepareResources next to the classic device-plugin path."""
+    import threading
+
+    from k8s_device_plugin_tpu.supervisor.main import Daemon, DaemonConfig
+    from tests.fake_kubelet import FakeKubelet
+
+    api = FakeApiServer()
+    url = api.start()
+    api.add_node(NODE)
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(
+        "apiVersion: v1\nkind: Config\ncurrent-context: c\n"
+        "contexts: [{name: c, context: {cluster: cl, user: u}}]\n"
+        f"clusters: [{{name: cl, cluster: {{server: \"{url}\"}}}}]\n"
+        "users: [{name: u, user: {token: t}}]\n"
+    )
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 4)
+    dp_dir = tmp_path / "dp"
+    dp_dir.mkdir()
+    kubelet = FakeKubelet(str(dp_dir))
+    kubelet.start()
+    daemon = Daemon(
+        DaemonConfig(
+            node_name=NODE,
+            device_plugin_dir=str(dp_dir),
+            sysfs_accel_dir=accel,
+            dev_dir=dev,
+            libtpu_host_path="",
+            kubeconfig=str(kubeconfig),
+            prefer_native_backend=False,
+            podresources_socket="",
+            enable_dra=True,
+            plugins_dir=str(tmp_path / "plugins"),
+            plugins_registry_dir=str(tmp_path / "plugins_registry"),
+            cdi_dir=str(tmp_path / "cdi"),
+        )
+    )
+    t = threading.Thread(target=daemon.run, daemon=True)
+    t.start()
+    try:
+        assert kubelet.registered.wait(15)
+        deadline = 10.0
+        import time as _time
+
+        while daemon.dra is None and deadline > 0:
+            _time.sleep(0.1)
+            deadline -= 0.1
+        assert daemon.dra is not None
+        # ResourceSlice published with the node's 4 chips.
+        name = slices.slice_name(NODE)
+        assert name in api.resourceslices
+        assert len(api.resourceslices[name]["spec"]["devices"]) == 4
+        # Claim staging over the live socket.
+        api.add_resource_claim(claim_obj("uid-d", ["chip-0"]))
+        stub = stub_for(daemon.dra)
+        req = pb.NodePrepareResourcesRequest()
+        req.claims.add(namespace="default", name="claim-uid-d", uid="uid-d")
+        resp = stub.NodePrepareResources(req)
+        assert not resp.claims["uid-d"].error
+        # Both planes share placement state: the chip the claim staged is
+        # withheld from the classic plane's preferred allocations.
+        assert len(daemon.plugin.state.allocated) == 1
+    finally:
+        daemon.events.put(("stop", None))
+        t.join(timeout=10)
+        kubelet.stop()
+        api.stop()
+
+
+def test_classic_plane_excludes_dra_held_chips(driver, api, plugin):
+    """Cross-plane safety: chips staged by a DRA claim are invisible to
+    the kubelet's device accounting, so the classic plane must (a) not
+    prefer them and (b) refuse an Allocate naming them."""
+    from k8s_device_plugin_tpu.api import deviceplugin_pb2 as dppb
+
+    server, _ = api
+    server.add_resource_claim(claim_obj("uid-x", ["chip-0", "chip-1"]))
+    stub = stub_for(driver)
+    req = pb.NodePrepareResourcesRequest()
+    req.claims.add(namespace="default", name="claim-uid-x", uid="uid-x")
+    assert not stub.NodePrepareResources(req).claims["uid-x"].error
+    held = {plugin.mesh.by_id[c].id for c in driver._held_chip_ids()}
+    assert len(held) == 2
+    # (a) preferred allocation never offers held chips even when the
+    # kubelet's pool (which can't know about them) includes everything.
+    picked = plugin.state.select(2, available=plugin.mesh.ids)
+    assert picked and not (set(picked) & held)
+    assert plugin.state.select(4, available=plugin.mesh.ids) == []
+    # (b) Allocate naming a held chip aborts RESOURCE_EXHAUSTED.
+    class _Ctx:
+        def abort(self, code, details):
+            raise grpc.RpcError(f"{code}: {details}")
+
+    areq = dppb.AllocateRequest()
+    areq.container_requests.add(devicesIDs=sorted(held)[:1])
+    with pytest.raises(grpc.RpcError, match="RESOURCE_EXHAUSTED"):
+        plugin._allocate(areq, _Ctx())
+
+
+def test_prepare_refuses_classic_held_chips(driver, api, plugin):
+    """Mirror guard: a claim allocated onto chips a device-plugin pod
+    already holds must error, not double-stage."""
+    server, _ = api
+    chip0_id = slices.chips_by_device_name(plugin.mesh)["chip-0"].id
+    plugin.state.allocate([chip0_id])  # classic pod holds chip-0
+    server.add_resource_claim(claim_obj("uid-c", ["chip-0"]))
+    stub = stub_for(driver)
+    req = pb.NodePrepareResourcesRequest()
+    req.claims.add(namespace="default", name="claim-uid-c", uid="uid-c")
+    resp = stub.NodePrepareResources(req)
+    assert "device-plugin plane" in resp.claims["uid-c"].error
+    assert driver.cdi.read_claim_spec("uid-c") is None
+
+
+def test_recover_prepared_from_cdi_specs(plugin, api, tmp_path):
+    """A restarted driver rebuilds claim holds from the CDI specs on disk,
+    so the classic plane can't hand out chips live claims still own."""
+    server, client = api
+    server.add_resource_claim(claim_obj("uid-r", ["chip-0", "chip-1"]))
+    kw = dict(
+        kube_client=client, driver_name=DRIVER, node_name=NODE,
+        plugins_dir=str(tmp_path / "plugins"),
+        plugins_registry_dir=str(tmp_path / "plugins_registry"),
+        cdi_dir=str(tmp_path / "cdi"),
+    )
+    d1 = DraDriver(plugin, **kw)
+    d1.start()
+    try:
+        stub = stub_for(d1)
+        req = pb.NodePrepareResourcesRequest()
+        req.claims.add(namespace="default", name="claim-uid-r", uid="uid-r")
+        assert not stub.NodePrepareResources(req).claims["uid-r"].error
+    finally:
+        d1.stop()
+    # New process generation: fresh plugin state, same disk.
+    from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo as _P
+
+    accel = os.path.join(str(tmp_path), "sys/class/accel")
+    dev = os.path.join(str(tmp_path), "dev")
+    chips = _P().scan(accel, dev)
+    plugin2 = TpuDevicePlugin(
+        IciMesh(chips), config=PluginConfig(libtpu_host_path="")
+    )
+    d2 = DraDriver(plugin2, **kw)
+    d2.start()
+    try:
+        assert d2.prepared.get("uid-r") is not None
+        assert len(plugin2.state.allocated) == 2
+        # And unprepare still frees after recovery.
+        stub2 = stub_for(d2)
+        ureq = pb.NodeUnprepareResourcesRequest()
+        ureq.claims.add(namespace="default", name="claim-uid-r", uid="uid-r")
+        assert not stub2.NodeUnprepareResources(ureq).claims["uid-r"].error
+        assert plugin2.state.allocated == set()
+    finally:
+        d2.stop()
+
+
+def test_substitution_mode_steers_around_dra_holds(driver, api, plugin):
+    """In substitute_on_allocate mode a kubelet pick of a DRA-held chip is
+    remapped onto free chips rather than refused — the staged-chip guard
+    applies to the final assignment, not the kubelet's raw request."""
+    from k8s_device_plugin_tpu.api import deviceplugin_pb2 as dppb
+
+    server, _ = api
+    server.add_resource_claim(claim_obj("uid-s", ["chip-0"]))
+    stub = stub_for(driver)
+    req = pb.NodePrepareResourcesRequest()
+    req.claims.add(namespace="default", name="claim-uid-s", uid="uid-s")
+    assert not stub.NodePrepareResources(req).claims["uid-s"].error
+    held_id = slices.chips_by_device_name(plugin.mesh)["chip-0"].id
+    plugin.config.substitute_on_allocate = True
+
+    class _Ctx:
+        def abort(self, code, details):
+            raise grpc.RpcError(f"{code}: {details}")
+
+    areq = dppb.AllocateRequest()
+    areq.container_requests.add(devicesIDs=[held_id])
+    resp = plugin._allocate(areq, _Ctx())
+    assigned = [
+        d.host_path for d in resp.container_responses[0].devices
+    ]
+    held_path = plugin.mesh.by_id[held_id].chip.dev_path
+    assert assigned and held_path not in assigned
+
+
+def test_unhealthy_chip_dropped_from_slice_and_refused(driver, api, plugin):
+    """Health integration: a transition republishes the ResourceSlice
+    without the broken chip (bumped pool generation), and a claim already
+    allocated onto it is refused at prepare time."""
+    import time as _time
+
+    server, _ = api
+    chip0 = slices.chips_by_device_name(plugin.mesh)["chip-0"]
+    name = slices.slice_name(NODE, DRIVER)
+
+    def wait_for(cond, timeout=10.0):
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            if cond():
+                return True
+            _time.sleep(0.05)
+        return False
+
+    # Publisher thread's initial publish lists all 4 chips.
+    assert wait_for(lambda: name in server.resourceslices)
+    assert len(server.resourceslices[name]["spec"]["devices"]) == 4
+    gen0 = server.resourceslices[name]["spec"]["pool"]["generation"]
+
+    plugin.notify_health(chip0.id, healthy=False)
+    assert wait_for(
+        lambda: len(server.resourceslices[name]["spec"]["devices"]) == 3
+    )
+    assert server.resourceslices[name]["spec"]["pool"]["generation"] > gen0
+    names = [d["name"] for d in server.resourceslices[name]["spec"]["devices"]]
+    assert "chip-0" not in names
+
+    # A claim the scheduler allocated before the slice update reached it:
+    server.add_resource_claim(claim_obj("uid-h", ["chip-0"]))
+    stub = stub_for(driver)
+    req = pb.NodePrepareResourcesRequest()
+    req.claims.add(namespace="default", name="claim-uid-h", uid="uid-h")
+    assert "unhealthy" in stub.NodePrepareResources(req).claims["uid-h"].error
+
+    # Recovery restores the chip to the inventory.
+    plugin.notify_health(chip0.id, healthy=True)
+    assert wait_for(
+        lambda: len(server.resourceslices[name]["spec"]["devices"]) == 4
+    )
+
+
+def test_deleted_slice_recreated_on_resync(plugin, api, tmp_path):
+    """A ResourceSlice deleted out from under the driver (kubelet orphan
+    cleanup, admin) is re-created on the publisher's periodic wake."""
+    import time as _time
+
+    server, client = api
+    d = DraDriver(
+        plugin, kube_client=client, driver_name=DRIVER, node_name=NODE,
+        plugins_dir=str(tmp_path / "plugins"),
+        plugins_registry_dir=str(tmp_path / "plugins_registry"),
+        cdi_dir=str(tmp_path / "cdi"),
+        resync_interval_s=0.3,
+    )
+    d.start()
+    try:
+        name = slices.slice_name(NODE, DRIVER)
+        deadline = _time.time() + 10
+        while name not in server.resourceslices and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert name in server.resourceslices
+        with server._lock:
+            del server.resourceslices[name]
+        deadline = _time.time() + 10
+        while name not in server.resourceslices and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert name in server.resourceslices  # re-created
+    finally:
+        d.stop()
