@@ -59,6 +59,7 @@ class Controller:
         watch_timeout_s: int = 60,
         max_retries: int = 5,
         resync_interval_s: float = 30.0,
+        evict_on_unhealthy: bool = True,
     ):
         self.client = client
         self.plugin = plugin
@@ -70,6 +71,7 @@ class Controller:
         self.watch_timeout_s = watch_timeout_s
         self.max_retries = max_retries
         self.resync_interval_s = resync_interval_s
+        self.evict_on_unhealthy = evict_on_unhealthy
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._threads = []
@@ -269,14 +271,17 @@ class Controller:
             if item is None or self._stop.is_set():
                 return
             etype, pod, retries = item
-            if etype == "PRUNE":
-                # Outside the retry machinery: the give-up log below
-                # assumes dict-shaped items, and a prune is cheap to just
-                # redo on the next resync if it ever fails.
+            if etype in ("PRUNE", "EVICT"):
+                # Outside the generic retry machinery: the give-up log
+                # below assumes dict-shaped items. Prunes just redo on the
+                # next resync; evictions requeue themselves (bounded).
                 try:
-                    self._prune_stale(pod)  # pod = set of live keys
+                    if etype == "PRUNE":
+                        self._prune_stale(pod)  # pod = set of live keys
+                    else:
+                        self._evict_pods_on_chip(pod, retries)  # chip id
                 except Exception as e:
-                    log.warning("prune failed: %s", e)
+                    log.warning("%s failed: %s", etype.lower(), e)
                 continue
             try:
                 if etype == "DELETED":
@@ -448,6 +453,100 @@ class Controller:
             meta.get("namespace"),
             meta.get("name"),
         )
+
+    # ------------------------------------------------------------------
+    # Unhealthy-chip eviction (BASELINE config 4: "pod evicted and
+    # rescheduled"). Kubernetes never evicts a running pod when a device
+    # it holds goes Unhealthy — ListAndWatch only protects FUTURE
+    # placements — so the controller does it: a broken chip's pods are
+    # evicted (Eviction API, so PDBs are honored) to reschedule onto
+    # healthy capacity. The reference has no analog (its health path ends
+    # at re-advertisement, /root/reference/server.go:169-176).
+    # ------------------------------------------------------------------
+
+    def on_chip_unhealthy(self, chip_id: str) -> None:
+        """Health-transition hook (wired to plugin.on_health_transition);
+        safe from any thread — the worker does the actual eviction."""
+        if self.evict_on_unhealthy:
+            self._queue.put(("EVICT", chip_id, 0))
+
+    def evict_unhealthy_now(self) -> None:
+        """Sweep chips already unhealthy (a transition that fired before
+        the hook was attached, or pre-restart state)."""
+        for chip_id in self.plugin.state.unhealthy:
+            self.on_chip_unhealthy(chip_id)
+
+    def _evict_pods_on_chip(self, chip_id: str, retries: int = 0) -> None:
+        if chip_id not in self.plugin.state.unhealthy:
+            # The chip recovered while this item sat in the queue (or
+            # between PDB-blocked retries) — a transient blip must not
+            # evict pods that are running fine.
+            log.info(
+                "chip %s recovered before eviction ran; skipping", chip_id
+            )
+            return
+        try:
+            pods = self.client.list_pods(
+                node_name=self.node_name
+            ).get("items", [])
+        except (KubeError, OSError) as e:
+            log.warning("eviction: pod list failed: %s", e)
+            self._requeue_evict(chip_id, retries)
+            return
+        holder_keys = {
+            k for k, chips in self._pod_devices.items() if chip_id in chips
+        }
+        failed = False
+        for pod in pods:
+            meta = pod.get("metadata", {})
+            ann = (meta.get("annotations") or {}).get(
+                self.devices_annotation, ""
+            )
+            holds = chip_id in ann.split(",") if ann else False
+            tracked = (
+                meta.get("uid", "") in holder_keys
+                or _nsname(meta) in holder_keys
+            )
+            if not (holds or tracked):
+                continue
+            ns = meta.get("namespace", "default")
+            name = meta.get("name", "")
+            try:
+                self.client.evict_pod(ns, name)
+                log.warning(
+                    "evicted pod %s/%s: TPU chip %s unhealthy",
+                    ns, name, chip_id,
+                )
+                try:
+                    self.client.create_event(
+                        ns,
+                        {"kind": "Pod", "name": name, "namespace": ns},
+                        reason="TPUChipUnhealthy",
+                        message=(
+                            f"evicted: TPU chip {chip_id} on "
+                            f"{self.node_name} is unhealthy"
+                        ),
+                        event_type="Warning",
+                    )
+                except (KubeError, OSError) as e:
+                    log.warning("eviction event emit failed: %s", e)
+            except (KubeError, OSError) as e:
+                # 429: a PodDisruptionBudget blocked it — retrying is the
+                # protocol (the budget frees up as other pods move).
+                log.warning("eviction of %s/%s failed: %s", ns, name, e)
+                failed = True
+        if failed:
+            self._requeue_evict(chip_id, retries)
+
+    def _requeue_evict(self, chip_id: str, retries: int) -> None:
+        if retries + 1 >= self.max_retries:
+            log.error(
+                "giving up evicting pods on chip %s after %d tries",
+                chip_id, retries + 1,
+            )
+            return
+        time.sleep(min(0.2 * 2**retries, 2.0))
+        self._queue.put(("EVICT", chip_id, retries + 1))
 
     def _kubelet_assigned_chips(self, exclude_uid: str = "") -> Set[str]:
         """Real chip ids the kubelet currently reports assigned, translated

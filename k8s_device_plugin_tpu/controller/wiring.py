@@ -210,6 +210,19 @@ def start_kube_integration(
     publisher.start()
     daemon.plugin.on_availability_change = publisher.trigger
 
+    controller = Controller(
+        client,
+        daemon.plugin,
+        node_name=node_name,
+        resource_name=cfg.resource_name,
+        checkpoint_path=os.path.join(
+            cfg.device_plugin_dir, "kubelet_internal_checkpoint"
+        ),
+        podresources_socket=cfg.podresources_socket,
+        resync_interval_s=cfg.resync_interval_s,
+        evict_on_unhealthy=getattr(cfg, "evict_on_unhealthy", True),
+    )
+
     def emit_health_event(chip_id: str, healthy: bool) -> None:
         try:
             client.create_event(
@@ -222,22 +235,16 @@ def start_kube_integration(
             )
         except (KubeError, OSError) as e:
             log.warning("event emit failed: %s", e)
+        if not healthy:
+            controller.on_chip_unhealthy(chip_id)
 
     daemon.plugin.on_health_transition = emit_health_event
-    controller = Controller(
-        client,
-        daemon.plugin,
-        node_name=node_name,
-        resource_name=cfg.resource_name,
-        checkpoint_path=os.path.join(
-            cfg.device_plugin_dir, "kubelet_internal_checkpoint"
-        ),
-        podresources_socket=cfg.podresources_socket,
-        resync_interval_s=cfg.resync_interval_s,
-    )
     controller.publisher = publisher  # stopped with the controller
     controller.start()  # rebuilds allocation state from the checkpoint
     # Authoritative initial publish AFTER the rebuild, so a restarted
     # daemon never advertises chips that running pods already hold.
     publisher.publish_now()
+    # Transitions that fired before the hook attached (the health
+    # watcher's pre-serve sweep) still get their pods evicted.
+    controller.evict_unhealthy_now()
     return controller, client

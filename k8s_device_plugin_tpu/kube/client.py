@@ -288,6 +288,26 @@ class KubeClient:
             headers={"Content-Type": "application/json"},
         ).json()
 
+    def evict_pod(self, namespace: str, name: str) -> dict:
+        """Evict a pod via the Eviction subresource, so
+        PodDisruptionBudgets are honored (429 = budget blocked, caller
+        retries). The subresource exists on every supported API server,
+        so a 404 means the pod is already gone — success. 429 and other
+        errors propagate as KubeError."""
+        body = {
+            "apiVersion": "policy/v1",
+            "kind": "Eviction",
+            "metadata": {"name": name, "namespace": namespace},
+        }
+        try:
+            return self.create(
+                f"/api/v1/namespaces/{namespace}/pods/{name}/eviction", body
+            )
+        except KubeError as e:
+            if e.status_code == 404:
+                return {}
+            raise
+
     def patch_pod_annotations(
         self,
         namespace: str,

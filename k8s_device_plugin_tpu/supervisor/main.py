@@ -84,6 +84,10 @@ class DaemonConfig:
     podresources_socket: str = constants.POD_RESOURCES_SOCKET
     # DRA (resource.k8s.io) plane: serve the kubelet DRAPlugin service and
     # publish this node's ResourceSlice alongside the device-plugin path.
+    # Evict pods holding a chip that goes Unhealthy so they reschedule
+    # onto healthy capacity (BASELINE config 4); ListAndWatch only
+    # protects future placements.
+    evict_on_unhealthy: bool = True
     enable_dra: bool = False
     dra_driver_name: str = "tpu.google.com"
     plugins_dir: str = "/var/lib/kubelet/plugins"
@@ -373,6 +377,10 @@ def parse_args(argv) -> DaemonConfig:
                    help="kubelet PodResources API socket, preferred over "
                    "the checkpoint file for reconciliation; '' forces "
                    "checkpoint-only")
+    p.add_argument("--no-evict-on-unhealthy", action="store_true",
+                   help="do not evict pods whose chips go Unhealthy "
+                   "(eviction is on by default so they reschedule onto "
+                   "healthy capacity)")
     p.add_argument("--dra", action="store_true",
                    help="also serve the DRA plane (resource.k8s.io): "
                    "kubelet DRAPlugin service, ResourceSlice publishing, "
@@ -413,6 +421,7 @@ def parse_args(argv) -> DaemonConfig:
         registration_mode=a.registration_mode,
         plugins_registry_dir=a.plugins_registry_dir,
         podresources_socket=a.podresources_socket,
+        evict_on_unhealthy=not a.no_evict_on_unhealthy,
         enable_dra=a.dra,
         dra_driver_name=a.dra_driver_name,
         plugins_dir=a.plugins_dir,

@@ -28,6 +28,7 @@ class FakeApiServer:
         self.pod_patches: List[Tuple[str, str, dict]] = []
         self.node_patches: List[Tuple[str, dict]] = []
         self.events: List[dict] = []
+        self.evictions: List[Tuple[str, str]] = []
         self._watchers: List["queue.Queue"] = []
         # (rv, event) log so watches replay from a resourceVersion like the
         # real API server does.
@@ -114,6 +115,24 @@ class FakeApiServer:
                     with server._lock:
                         server.events.append(body)
                     server._send_json(self, body, 201)
+                # api/v1/namespaces/{ns}/pods/{name}/eviction
+                elif (
+                    len(parts) == 7
+                    and parts[4] == "pods"
+                    and parts[6] == "eviction"
+                ):
+                    ns, name = parts[3], parts[5]
+                    with server._lock:
+                        exists = (ns, name) in server.pods
+                    if not exists:
+                        server._send_json(
+                            self, {"message": "pod not found"}, 404
+                        )
+                    else:
+                        with server._lock:
+                            server.evictions.append((ns, name))
+                        server.delete_pod(ns, name)
+                        server._send_json(self, {"status": "Success"}, 201)
                 elif self.path == (
                     "/apis/resource.k8s.io/v1beta1/resourceslices"
                 ):

@@ -601,3 +601,109 @@ def test_delete_guard_translates_via_persistent_substitutions(
     ctrl._handle_delete(b)
     # B's chip ids[1] freed (A's kubelet id ids[1] means real ids[0]).
     assert plugin.state.allocated == {ids[0]}
+
+
+# ---------------------------------------------------------------------------
+# Unhealthy-chip eviction (BASELINE config 4)
+# ---------------------------------------------------------------------------
+
+def test_unhealthy_chip_evicts_holding_pod(api, plugin, tmp_path):
+    """A chip going Unhealthy evicts exactly the pods holding it (matched
+    by devices annotation), so they reschedule onto healthy capacity;
+    uninvolved pods survive. The eviction's DELETED event then frees the
+    chips through the normal delete path."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    victim = pod_dict(
+        "victim", "uid-v", tpus=2,
+        annotations={constants.POD_DEVICES_ANNOTATION: ",".join(ids[:2])},
+    )
+    bystander = pod_dict(
+        "bystander", "uid-b", tpus=1,
+        annotations={constants.POD_DEVICES_ANNOTATION: ids[3]},
+    )
+    server.add_pod(victim)
+    server.add_pod(bystander)
+    plugin.state.allocate(ids[:2])
+    ctrl.start()
+    try:
+        assert wait_for(lambda: ctrl._pod_devices.get("uid-v"))
+        plugin.state.set_health(ids[0], healthy=False)
+        ctrl.on_chip_unhealthy(ids[0])
+        assert wait_for(lambda: server.evictions)
+        assert server.evictions == [("default", "victim")]
+        assert ("default", "bystander") not in [
+            (ns, n) for ns, n in server.evictions
+        ]
+        # Eviction deleted the pod; the DELETED event frees its chips.
+        assert wait_for(lambda: plugin.state.allocated == set())
+        # A Warning event was emitted on the pod.
+        assert any(
+            e.get("reason") == "TPUChipUnhealthy" for e in server.events
+        )
+    finally:
+        ctrl.stop()
+
+
+def test_eviction_disabled_by_flag(api, plugin, tmp_path):
+    ids = plugin.mesh.ids
+    server, client = api
+    path = write_checkpoint(tmp_path, {})
+    ctrl = Controller(
+        client, plugin, node_name=NODE, checkpoint_path=path,
+        podresources_socket="", watch_timeout_s=2,
+        evict_on_unhealthy=False,
+    )
+    server.add_pod(pod_dict(
+        "victim", "uid-v", tpus=1,
+        annotations={constants.POD_DEVICES_ANNOTATION: ids[0]},
+    ))
+    ctrl.start()
+    try:
+        ctrl.on_chip_unhealthy(ids[0])
+        time.sleep(0.5)
+        assert server.evictions == []
+    finally:
+        ctrl.stop()
+
+
+def test_evict_unhealthy_now_sweeps_preexisting(api, plugin, tmp_path):
+    """A chip that was already broken before the controller started (the
+    health watcher's pre-serve sweep marked it) still gets its pods
+    evicted via the startup sweep."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    server.add_pod(pod_dict(
+        "victim", "uid-v", tpus=1,
+        annotations={constants.POD_DEVICES_ANNOTATION: ids[0]},
+    ))
+    plugin.state.set_health(ids[0], healthy=False)
+    ctrl.start()
+    try:
+        ctrl.evict_unhealthy_now()
+        assert wait_for(lambda: server.evictions)
+        assert server.evictions == [("default", "victim")]
+    finally:
+        ctrl.stop()
+
+
+def test_health_blip_does_not_evict(api, plugin, tmp_path):
+    """A chip that recovers before the queued eviction runs must not have
+    its pods evicted — transient sysfs blips are not grounds for
+    disruption."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    server.add_pod(pod_dict(
+        "victim", "uid-v", tpus=1,
+        annotations={constants.POD_DEVICES_ANNOTATION: ids[0]},
+    ))
+    # Blip: unhealthy then healthy again before the worker starts.
+    plugin.state.set_health(ids[0], healthy=False)
+    ctrl.on_chip_unhealthy(ids[0])
+    plugin.state.set_health(ids[0], healthy=True)
+    ctrl.start()
+    try:
+        time.sleep(0.6)
+        assert server.evictions == []
+    finally:
+        ctrl.stop()

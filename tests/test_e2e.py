@@ -170,13 +170,15 @@ def test_full_lifecycle(system):
     patched = body["metadata"]["annotations"][constants.POD_DEVICES_ANNOTATION]
     assert sorted(patched.split(",")) == sorted(pref)
 
-    # 6. Health fault via sysfs → Unhealthy re-advertisement + k8s event.
+    # 6. Health fault via sysfs → Unhealthy re-advertisement + k8s event
+    # + the holding pod is EVICTED to reschedule (BASELINE config 4).
     fakes.set_chip_health(accel, 1, False)
     resp = out.get(timeout=10)
     sick = {d.ID: d.health for d in resp.devices}
     assert constants.UNHEALTHY in sick.values()
     assert wait_for(lambda: any(
         e["reason"] == "TPUChipUnhealthy" for e in api.events))
+    assert wait_for(lambda: ("default", "jax-pod") in api.evictions)
 
     # 7. Recovery.
     fakes.set_chip_health(accel, 1, True)
@@ -185,8 +187,7 @@ def test_full_lifecycle(system):
     assert wait_for(lambda: any(
         e["reason"] == "TPUChipRecovered" for e in api.events))
 
-    # 8. Pod delete frees the chips (availability returns).
-    api.delete_pod("default", "jax-pod")
+    # 8. The eviction's delete freed the chips (availability returns).
     assert wait_for(lambda: len(annotation()["available"]) == 4)
 
     # 9. Clean shutdown.
