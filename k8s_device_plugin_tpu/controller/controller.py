@@ -33,6 +33,7 @@ from ..api import constants
 from ..kube import checkpoint as ckpt
 from ..kube.client import KubeClient, KubeError
 from ..kube.podresources import PodResourcesClient
+from ..utils import metrics
 from ..utils.podresources import is_tpu_pod
 
 log = logging.getLogger(__name__)
@@ -229,6 +230,14 @@ class Controller:
                     # reconciles in this cycle, not the next; runs in the
                     # worker for ordering with in-flight events.
                     self._queue.put(("PRUNE", live_keys, 0))
+                    # Level-triggered eviction: chips still unhealthy at
+                    # each resync re-fire, so PDB-blocked evictions and
+                    # pods that weren't reconciled when the transition
+                    # fired are retried until the chip recovers or its
+                    # pods are gone.
+                    if self.evict_on_unhealthy:
+                        for chip_id in self.plugin.state.unhealthy:
+                            self._queue.put(("EVICT", chip_id, 0))
                     for pod in pods.get("items", []):
                         self._enqueue("MODIFIED", pod)
                 for etype, obj in self.client.watch_pods(
@@ -279,7 +288,7 @@ class Controller:
                     if etype == "PRUNE":
                         self._prune_stale(pod)  # pod = set of live keys
                     else:
-                        self._evict_pods_on_chip(pod, retries)  # chip id
+                        self._evict_pods_on_chip(pod)  # pod = chip id
                 except Exception as e:
                     log.warning("%s failed: %s", etype.lower(), e)
                 continue
@@ -476,11 +485,16 @@ class Controller:
         for chip_id in self.plugin.state.unhealthy:
             self.on_chip_unhealthy(chip_id)
 
-    def _evict_pods_on_chip(self, chip_id: str, retries: int = 0) -> None:
+    def _evict_pods_on_chip(self, chip_id: str) -> None:
+        """One eviction attempt per holding pod. No in-line retry loop:
+        eviction is LEVEL-triggered — the informer re-fires EVICT for
+        every still-unhealthy chip at each resync — so PDB-blocked (429)
+        evictions and pods that weren't yet reconciled when the
+        transition fired get retried for as long as the chip stays
+        broken, without sleeping on the worker thread."""
         if chip_id not in self.plugin.state.unhealthy:
-            # The chip recovered while this item sat in the queue (or
-            # between PDB-blocked retries) — a transient blip must not
-            # evict pods that are running fine.
+            # The chip recovered while this item sat in the queue — a
+            # transient blip must not evict pods that are running fine.
             log.info(
                 "chip %s recovered before eviction ran; skipping", chip_id
             )
@@ -491,14 +505,14 @@ class Controller:
             ).get("items", [])
         except (KubeError, OSError) as e:
             log.warning("eviction: pod list failed: %s", e)
-            self._requeue_evict(chip_id, retries)
-            return
+            return  # next resync re-fires
         holder_keys = {
             k for k, chips in self._pod_devices.items() if chip_id in chips
         }
-        failed = False
         for pod in pods:
             meta = pod.get("metadata", {})
+            if meta.get("deletionTimestamp"):
+                continue  # already terminating (e.g. our prior eviction)
             ann = (meta.get("annotations") or {}).get(
                 self.devices_annotation, ""
             )
@@ -513,6 +527,7 @@ class Controller:
             name = meta.get("name", "")
             try:
                 self.client.evict_pod(ns, name)
+                metrics.EVICTIONS.inc(outcome="evicted")
                 log.warning(
                     "evicted pod %s/%s: TPU chip %s unhealthy",
                     ns, name, chip_id,
@@ -531,22 +546,10 @@ class Controller:
                 except (KubeError, OSError) as e:
                     log.warning("eviction event emit failed: %s", e)
             except (KubeError, OSError) as e:
-                # 429: a PodDisruptionBudget blocked it — retrying is the
-                # protocol (the budget frees up as other pods move).
+                # 429: a PodDisruptionBudget blocked it; the next resync
+                # re-fires (the budget frees up as other pods move).
                 log.warning("eviction of %s/%s failed: %s", ns, name, e)
-                failed = True
-        if failed:
-            self._requeue_evict(chip_id, retries)
-
-    def _requeue_evict(self, chip_id: str, retries: int) -> None:
-        if retries + 1 >= self.max_retries:
-            log.error(
-                "giving up evicting pods on chip %s after %d tries",
-                chip_id, retries + 1,
-            )
-            return
-        time.sleep(min(0.2 * 2**retries, 2.0))
-        self._queue.put(("EVICT", chip_id, retries + 1))
+                metrics.EVICTIONS.inc(outcome="failed")
 
     def _kubelet_assigned_chips(self, exclude_uid: str = "") -> Set[str]:
         """Real chip ids the kubelet currently reports assigned, translated

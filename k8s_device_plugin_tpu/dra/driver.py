@@ -41,6 +41,7 @@ from ..api.grpc_defs import (
 from ..api import pluginregistration_pb2 as regpb
 from ..kube.client import KubeError
 from ..server import plugin as plugin_mod
+from ..utils import metrics
 from . import cdi, slices
 
 log = logging.getLogger(__name__)
@@ -110,6 +111,7 @@ class DraDriver(DraPluginServicer):
             try:
                 devices = self._prepare_claim(claim)
                 resp.claims[claim.uid].devices.extend(devices)
+                metrics.DRA_CLAIMS.inc(op="prepare", outcome="ok")
             except Exception as e:  # per-claim error, not RPC failure
                 log.error(
                     "prepare claim %s/%s failed: %s",
@@ -118,6 +120,8 @@ class DraDriver(DraPluginServicer):
                 resp.claims[claim.uid].error = (
                     f"preparing {claim.namespace}/{claim.name}: {e}"
                 )
+                metrics.DRA_CLAIMS.inc(op="prepare", outcome="error")
+        self._update_prepared_gauge()
         return resp
 
     def NodeUnprepareResources(self, request, context):
@@ -126,10 +130,17 @@ class DraDriver(DraPluginServicer):
             try:
                 self._unprepare_claim(claim.uid)
                 resp.claims[claim.uid].SetInParent()
+                metrics.DRA_CLAIMS.inc(op="unprepare", outcome="ok")
             except Exception as e:
                 log.error("unprepare claim %s failed: %s", claim.uid, e)
                 resp.claims[claim.uid].error = str(e)
+                metrics.DRA_CLAIMS.inc(op="unprepare", outcome="error")
+        self._update_prepared_gauge()
         return resp
+
+    def _update_prepared_gauge(self) -> None:
+        with self._lock:
+            metrics.DRA_PREPARED.set(len(self.prepared))
 
     # ------------------------------------------------------------------
     # Claim staging
@@ -278,6 +289,7 @@ class DraDriver(DraPluginServicer):
                 "recovered %d prepared DRA claims holding %s",
                 len(self.prepared), sorted(recovered),
             )
+        self._update_prepared_gauge()
 
     def start(self) -> None:
         self.recover_prepared()

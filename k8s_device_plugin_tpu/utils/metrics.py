@@ -192,6 +192,20 @@ RPC_LATENCY = REGISTRY.histogram(
     "tpu_plugin_rpc_latency_seconds",
     "Wall latency of device-plugin gRPC handlers, by method",
 )
+EVICTIONS = REGISTRY.counter(
+    "tpu_plugin_evictions_total",
+    "Pods evicted because a chip they hold went Unhealthy, by outcome "
+    "(evicted/failed)",
+)
+DRA_CLAIMS = REGISTRY.counter(
+    "tpu_plugin_dra_claims_total",
+    "DRA claim operations served, by op (prepare/unprepare) and outcome "
+    "(ok/error)",
+)
+DRA_PREPARED = REGISTRY.gauge(
+    "tpu_plugin_dra_prepared_claims",
+    "DRA claims currently prepared (holding chips) on this node",
+)
 
 
 class MetricsServer(BackgroundHTTPServer):

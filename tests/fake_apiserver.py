@@ -29,6 +29,8 @@ class FakeApiServer:
         self.node_patches: List[Tuple[str, dict]] = []
         self.events: List[dict] = []
         self.evictions: List[Tuple[str, str]] = []
+        # True = answer evictions with 429 (PodDisruptionBudget blocked).
+        self.block_evictions = False
         self._watchers: List["queue.Queue"] = []
         # (rv, event) log so watches replay from a resourceVersion like the
         # real API server does.
@@ -127,6 +129,12 @@ class FakeApiServer:
                     if not exists:
                         server._send_json(
                             self, {"message": "pod not found"}, 404
+                        )
+                    elif server.block_evictions:
+                        server._send_json(
+                            self,
+                            {"message": "Cannot evict pod: PDB violated"},
+                            429,
                         )
                     else:
                         with server._lock:

@@ -707,3 +707,61 @@ def test_health_blip_does_not_evict(api, plugin, tmp_path):
         assert server.evictions == []
     finally:
         ctrl.stop()
+
+
+def test_pdb_blocked_eviction_retries_until_unblocked(
+    api, plugin, tmp_path
+):
+    """Eviction is level-triggered: a PodDisruptionBudget 429 doesn't
+    exhaust a bounded retry budget — as long as the chip stays unhealthy,
+    each informer resync re-fires the eviction until it lands."""
+    ids = plugin.mesh.ids
+    server, client = api
+    path = write_checkpoint(tmp_path, {})
+    ctrl = Controller(
+        client, plugin, node_name=NODE, checkpoint_path=path,
+        podresources_socket="", watch_timeout_s=2, resync_interval_s=0.3,
+    )
+    server.add_pod(pod_dict(
+        "victim", "uid-v", tpus=1,
+        annotations={constants.POD_DEVICES_ANNOTATION: ids[0]},
+    ))
+    plugin.state.set_health(ids[0], healthy=False)
+    server.block_evictions = True
+    ctrl.start()
+    try:
+        ctrl.on_chip_unhealthy(ids[0])
+        time.sleep(1.0)  # several resyncs' worth of blocked attempts
+        assert server.evictions == []
+        from k8s_device_plugin_tpu.utils import metrics
+
+        assert metrics.EVICTIONS.get(outcome="failed") >= 1
+        server.block_evictions = False  # the budget frees up
+        assert wait_for(lambda: ("default", "victim") in server.evictions)
+    finally:
+        ctrl.stop()
+
+
+def test_late_reconciled_pod_still_evicted(api, plugin, tmp_path):
+    """A chip that dies before its pod is reconciled (no annotation, no
+    tracking yet) still gets the pod evicted once reconciliation catches
+    up, via the resync re-fire."""
+    ids = plugin.mesh.ids
+    server, client = api
+    path = write_checkpoint(tmp_path, {})
+    ctrl = Controller(
+        client, plugin, node_name=NODE, checkpoint_path=path,
+        podresources_socket="", watch_timeout_s=2, resync_interval_s=0.3,
+    )
+    plugin.state.set_health(ids[0], healthy=False)
+    ctrl.start()
+    try:
+        ctrl.on_chip_unhealthy(ids[0])  # fires with no pods at all
+        time.sleep(0.4)
+        # Pod appears (kubelet admitted it against its stale view) and the
+        # checkpoint names the broken chip.
+        server.add_pod(pod_dict("late", "uid-l", tpus=1))
+        write_checkpoint(tmp_path, {"uid-l": [ids[0]]})
+        assert wait_for(lambda: ("default", "late") in server.evictions)
+    finally:
+        ctrl.stop()
