@@ -230,14 +230,17 @@ class Controller:
                     # reconciles in this cycle, not the next; runs in the
                     # worker for ordering with in-flight events.
                     self._queue.put(("PRUNE", live_keys, 0))
-                    # Level-triggered eviction: chips still unhealthy at
-                    # each resync re-fire, so PDB-blocked evictions and
-                    # pods that weren't reconciled when the transition
-                    # fired are retried until the chip recovers or its
-                    # pods are gone.
-                    if self.evict_on_unhealthy:
-                        for chip_id in self.plugin.state.unhealthy:
-                            self._queue.put(("EVICT", chip_id, 0))
+                    # Level-triggered eviction: one sweep item covering
+                    # ALL still-unhealthy chips per resync (a single pod
+                    # list, not one per chip), so PDB-blocked evictions
+                    # and pods that weren't reconciled when the
+                    # transition fired are retried until the chip
+                    # recovers or its pods are gone.
+                    if (
+                        self.evict_on_unhealthy
+                        and self.plugin.state.unhealthy
+                    ):
+                        self._queue.put(("EVICT", None, 0))
                     for pod in pods.get("items", []):
                         self._enqueue("MODIFIED", pod)
                 for etype, obj in self.client.watch_pods(
@@ -282,13 +285,15 @@ class Controller:
             etype, pod, retries = item
             if etype in ("PRUNE", "EVICT"):
                 # Outside the generic retry machinery: the give-up log
-                # below assumes dict-shaped items. Prunes just redo on the
-                # next resync; evictions requeue themselves (bounded).
+                # below assumes dict-shaped items. Both retry by being
+                # re-fired at the next resync (eviction is level-
+                # triggered — no bounded give-up; see _evict_pods_on_chip).
                 try:
                     if etype == "PRUNE":
                         self._prune_stale(pod)  # pod = set of live keys
                     else:
-                        self._evict_pods_on_chip(pod)  # pod = chip id
+                        # pod = chip id, or None for a full sweep
+                        self._evict_pods_on_chip(pod)
                 except Exception as e:
                     log.warning("%s failed: %s", etype.lower(), e)
                 continue
@@ -485,19 +490,24 @@ class Controller:
         for chip_id in self.plugin.state.unhealthy:
             self.on_chip_unhealthy(chip_id)
 
-    def _evict_pods_on_chip(self, chip_id: str) -> None:
-        """One eviction attempt per holding pod. No in-line retry loop:
-        eviction is LEVEL-triggered — the informer re-fires EVICT for
-        every still-unhealthy chip at each resync — so PDB-blocked (429)
+    def _evict_pods_on_chip(self, chip_id: Optional[str]) -> None:
+        """One eviction attempt per holding pod; ``chip_id`` None sweeps
+        ALL currently unhealthy chips with a single pod list (the resync
+        path). No in-line retry loop: eviction is LEVEL-triggered — the
+        informer re-fires a sweep at each resync — so PDB-blocked (429)
         evictions and pods that weren't yet reconciled when the
         transition fired get retried for as long as the chip stays
         broken, without sleeping on the worker thread."""
-        if chip_id not in self.plugin.state.unhealthy:
-            # The chip recovered while this item sat in the queue — a
-            # transient blip must not evict pods that are running fine.
-            log.info(
-                "chip %s recovered before eviction ran; skipping", chip_id
-            )
+        broken = self.plugin.state.unhealthy
+        chips = broken if chip_id is None else ({chip_id} & broken)
+        if not chips:
+            if chip_id is not None:
+                # The chip recovered while this item sat in the queue — a
+                # transient blip must not evict pods running fine.
+                log.info(
+                    "chip %s recovered before eviction ran; skipping",
+                    chip_id,
+                )
             return
         try:
             pods = self.client.list_pods(
@@ -505,9 +515,12 @@ class Controller:
             ).get("items", [])
         except (KubeError, OSError) as e:
             log.warning("eviction: pod list failed: %s", e)
+            metrics.EVICTIONS.inc(outcome="failed")
             return  # next resync re-fires
-        holder_keys = {
-            k for k, chips in self._pod_devices.items() if chip_id in chips
+        tracked_chips = {
+            key: held & chips
+            for key, held in self._pod_devices.items()
+            if held & chips
         }
         for pod in pods:
             meta = pod.get("metadata", {})
@@ -516,12 +529,10 @@ class Controller:
             ann = (meta.get("annotations") or {}).get(
                 self.devices_annotation, ""
             )
-            holds = chip_id in ann.split(",") if ann else False
-            tracked = (
-                meta.get("uid", "") in holder_keys
-                or _nsname(meta) in holder_keys
-            )
-            if not (holds or tracked):
+            pod_chips = (set(ann.split(",")) if ann else set()) & chips
+            pod_chips |= tracked_chips.get(meta.get("uid", ""), set())
+            pod_chips |= tracked_chips.get(_nsname(meta), set())
+            if not pod_chips:
                 continue
             ns = meta.get("namespace", "default")
             name = meta.get("name", "")
@@ -529,8 +540,8 @@ class Controller:
                 self.client.evict_pod(ns, name)
                 metrics.EVICTIONS.inc(outcome="evicted")
                 log.warning(
-                    "evicted pod %s/%s: TPU chip %s unhealthy",
-                    ns, name, chip_id,
+                    "evicted pod %s/%s: TPU chip(s) %s unhealthy",
+                    ns, name, sorted(pod_chips),
                 )
                 try:
                     self.client.create_event(
@@ -538,8 +549,9 @@ class Controller:
                         {"kind": "Pod", "name": name, "namespace": ns},
                         reason="TPUChipUnhealthy",
                         message=(
-                            f"evicted: TPU chip {chip_id} on "
-                            f"{self.node_name} is unhealthy"
+                            f"evicted: TPU chip(s) "
+                            f"{','.join(sorted(pod_chips))} on "
+                            f"{self.node_name} unhealthy"
                         ),
                         event_type="Warning",
                     )

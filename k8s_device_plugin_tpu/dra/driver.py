@@ -427,6 +427,8 @@ class DraDriver(DraPluginServicer):
             driver=self.driver_name,
             pool_generation=generation,
             exclude=self.plugin.state.unhealthy,
+            worker_id=self.plugin.config.worker_id,
+            slice_host_bounds=self.plugin.config.slice_host_bounds,
         )
 
     def stop(self, unpublish: bool = False) -> None:

@@ -49,30 +49,51 @@ def build_resource_slice(
     driver: str = DEFAULT_DRIVER,
     pool_generation: int = 1,
     exclude=(),
+    worker_id: int = 0,
+    slice_host_bounds: str = "",
 ) -> dict:
     """``exclude`` drops chips (by chip id) from the advertised inventory —
     the DRA analog of ListAndWatch marking devices Unhealthy; the scheduler
-    only sees what the slice lists."""
+    only sees what the slice lists. ``worker_id``/``slice_host_bounds``
+    (multi-host ICI slices, v4/v5p) ride on every device so a claim can
+    CEL-select chips from ICI-adjacent hosts — the DRA form of what the
+    classic plane's extender does with NodeTopology host_coords."""
+    # Tolerant parse (schema.parse_bounds): a malformed flag value must
+    # not wedge the publisher loop — the classic plane survives the same
+    # string, and "1,1" normalizing to a single host must not count as
+    # multi-host.
+    from ..topology.schema import host_coords_for, parse_bounds
+
+    bounds = parse_bounds(slice_host_bounds or "")
+    multi_host = bounds[0] * bounds[1] * bounds[2] > 1
+    host_coords = host_coords_for(worker_id, bounds) if multi_host else []
     devices = []
     for mc in mesh.mesh_chips:
         if mc.id in exclude:
             continue
         x, y, z = mc.coords
+        attributes = {
+            "chipId": {"string": mc.id},
+            "pciAddress": {"string": mc.chip.pci_addr},
+            "index": {"int": mc.chip.index},
+            "coordX": {"int": x},
+            "coordY": {"int": y},
+            "coordZ": {"int": z},
+            "numaNode": {"int": mc.chip.numa_node},
+            "chipType": {"string": mc.chip.chip_type},
+            "cores": {"int": mc.chip.core_count},
+        }
+        if multi_host:
+            attributes["workerId"] = {"int": worker_id}
+            attributes["sliceHostBounds"] = {"string": slice_host_bounds}
+            attributes["hostX"] = {"int": host_coords[0]}
+            attributes["hostY"] = {"int": host_coords[1]}
+            attributes["hostZ"] = {"int": host_coords[2]}
         devices.append(
             {
                 "name": device_name(mc),
                 "basic": {
-                    "attributes": {
-                        "chipId": {"string": mc.id},
-                        "pciAddress": {"string": mc.chip.pci_addr},
-                        "index": {"int": mc.chip.index},
-                        "coordX": {"int": x},
-                        "coordY": {"int": y},
-                        "coordZ": {"int": z},
-                        "numaNode": {"int": mc.chip.numa_node},
-                        "chipType": {"string": mc.chip.chip_type},
-                        "cores": {"int": mc.chip.core_count},
-                    },
+                    "attributes": attributes,
                     "capacity": {
                         "hbm": {"value": str(mc.chip.hbm_bytes)}
                     },
@@ -103,11 +124,14 @@ def publish_resource_slice(
     driver: str = DEFAULT_DRIVER,
     pool_generation: int = 1,
     exclude=(),
+    worker_id: int = 0,
+    slice_host_bounds: str = "",
 ) -> dict:
     """Create or replace this node's ResourceSlice. Returns the object as
     the API server stored it."""
     body = build_resource_slice(
-        mesh, node_name, driver, pool_generation, exclude=exclude
+        mesh, node_name, driver, pool_generation, exclude=exclude,
+        worker_id=worker_id, slice_host_bounds=slice_host_bounds,
     )
     name = body["metadata"]["name"]
     path = f"{RESOURCE_API}/resourceslices"

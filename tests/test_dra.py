@@ -558,3 +558,46 @@ def test_deleted_slice_recreated_on_resync(plugin, api, tmp_path):
         assert name in server.resourceslices  # re-created
     finally:
         d.stop()
+
+
+def test_slice_attributes_on_multi_host(plugin):
+    """Multi-host slices publish worker/host-grid attributes per device so
+    a DRA claim can CEL-select ICI-adjacent hosts (the DRA form of the
+    extender's gang evaluation)."""
+    plugin.config.worker_id = 3
+    plugin.config.slice_host_bounds = "2,2,1"
+    body = slices.build_resource_slice(
+        plugin.mesh, NODE, worker_id=3, slice_host_bounds="2,2,1"
+    )
+    attrs = body["spec"]["devices"][0]["basic"]["attributes"]
+    assert attrs["workerId"] == {"int": 3}
+    assert attrs["sliceHostBounds"] == {"string": "2,2,1"}
+    # worker 3 in a 2x2x1 host grid sits at host (1,1,0).
+    assert attrs["hostX"] == {"int": 1}
+    assert attrs["hostY"] == {"int": 1}
+    assert attrs["hostZ"] == {"int": 0}
+    # Single-host slices stay clean — no slice attributes.
+    body1 = slices.build_resource_slice(plugin.mesh, NODE)
+    attrs1 = body1["spec"]["devices"][0]["basic"]["attributes"]
+    assert "workerId" not in attrs1
+
+
+def test_malformed_slice_bounds_do_not_break_publishing(plugin):
+    """A junk --slice-host-bounds value must not wedge the publisher loop
+    (parity with the classic plane's tolerant parse_bounds); strings that
+    normalize to a single host are not multi-host."""
+    for bad in ("2,2", "2x2x1", "garbage", "", "2,2,1,9"):
+        body = slices.build_resource_slice(
+            plugin.mesh, NODE, worker_id=1, slice_host_bounds=bad
+        )
+        assert len(body["spec"]["devices"]) == 4
+    attrs = slices.build_resource_slice(
+        plugin.mesh, NODE, worker_id=0, slice_host_bounds="1,1"
+    )["spec"]["devices"][0]["basic"]["attributes"]
+    assert "workerId" not in attrs  # normalizes to single host
+    # "2,2" normalizes to a real 2x2x1 multi-host grid.
+    attrs2 = slices.build_resource_slice(
+        plugin.mesh, NODE, worker_id=1, slice_host_bounds="2,2"
+    )["spec"]["devices"][0]["basic"]["attributes"]
+    assert attrs2["workerId"] == {"int": 1}
+    assert attrs2["hostX"] == {"int": 1}
