@@ -39,6 +39,23 @@ from ..utils.podresources import is_tpu_pod
 log = logging.getLogger(__name__)
 
 
+def _pod_claim_refs(pod: dict) -> set:
+    """(namespace, claim name) pairs of the ResourceClaims a pod uses.
+    Template-generated claims surface in status.resourceClaimStatuses
+    (pod-level name → actual object name); directly-named claims sit in
+    spec.resourceClaims[].resourceClaimName."""
+    meta = pod.get("metadata", {})
+    ns = meta.get("namespace", "default")
+    refs = set()
+    for st in (pod.get("status") or {}).get("resourceClaimStatuses") or []:
+        if st.get("resourceClaimName"):
+            refs.add((ns, st["resourceClaimName"]))
+    for rc in (pod.get("spec") or {}).get("resourceClaims") or []:
+        if rc.get("resourceClaimName"):
+            refs.add((ns, rc["resourceClaimName"]))
+    return refs
+
+
 def _nsname(meta: dict) -> str:
     """Tracking key for a pod without a knowable uid (apiserver-less
     rebuild) and the deferral guard's self-key. One definition so the
@@ -73,6 +90,11 @@ class Controller:
         self.max_retries = max_retries
         self.resync_interval_s = resync_interval_s
         self.evict_on_unhealthy = evict_on_unhealthy
+        # Optional hook (set when the DRA plane runs): chips → [(ns, name)]
+        # of prepared ResourceClaims holding them. DRA pods carry no
+        # devices annotation and no checkpoint entry, so eviction finds
+        # them through their claim references instead.
+        self.dra_claims_lookup = None
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._threads = []
@@ -522,6 +544,12 @@ class Controller:
             for key, held in self._pod_devices.items()
             if held & chips
         }
+        broken_claims: Dict = {}  # (ns, name) -> broken chips it holds
+        if self.dra_claims_lookup is not None:
+            try:
+                broken_claims = dict(self.dra_claims_lookup(chips))
+            except Exception as e:
+                log.warning("DRA claim lookup failed: %s", e)
         for pod in pods:
             meta = pod.get("metadata", {})
             if meta.get("deletionTimestamp"):
@@ -532,6 +560,9 @@ class Controller:
             pod_chips = (set(ann.split(",")) if ann else set()) & chips
             pod_chips |= tracked_chips.get(meta.get("uid", ""), set())
             pod_chips |= tracked_chips.get(_nsname(meta), set())
+            if broken_claims:
+                for ref in _pod_claim_refs(pod) & set(broken_claims):
+                    pod_chips |= broken_claims[ref]
             if not pod_chips:
                 continue
             ns = meta.get("namespace", "default")

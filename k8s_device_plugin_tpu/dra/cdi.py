@@ -36,6 +36,28 @@ def _spec_filename(kind: str, name: str) -> str:
     return re.sub(r"[^a-zA-Z0-9_.-]", "-", f"{kind}-{name}") + ".json"
 
 
+def spec_chip_ids(spec: Optional[dict]) -> List[str]:
+    """Chip ids recorded in a (parsed) claim spec's annotations; [] when
+    the spec is missing or predates the field."""
+    for dev in (spec or {}).get("devices", []):
+        ann = dev.get("annotations") or {}
+        ids = ann.get("tpu.google.com/chip-ids", "")
+        if ids:
+            return ids.split(",")
+    return []
+
+
+def spec_claim_ref(spec: Optional[dict]) -> Optional[tuple]:
+    """(namespace, name) recorded in a (parsed) claim spec, or None."""
+    for dev in (spec or {}).get("devices", []):
+        ann = dev.get("annotations") or {}
+        ns = ann.get("tpu.google.com/claim-namespace")
+        name = ann.get("tpu.google.com/claim-name")
+        if ns is not None and name is not None:
+            return (ns, name)
+    return None
+
+
 class CdiRegistry:
     """Writes and removes per-claim CDI spec files atomically."""
 
@@ -54,6 +76,7 @@ class CdiRegistry:
         env: Dict[str, str],
         libtpu: Optional[tuple] = None,
         chip_ids: Sequence[str] = (),
+        claim_ref: Optional[tuple] = None,
     ) -> str:
         """Write the spec for one prepared claim; returns the CDI device ID
         the kubelet passes to the runtime. ``libtpu`` is the (host_path,
@@ -79,10 +102,14 @@ class CdiRegistry:
             ]
             edits["env"].append(f"TPU_LIBRARY_PATH={container_path}")
         device: Dict = {"name": name, "containerEdits": edits}
+        annotations: Dict[str, str] = {}
         if chip_ids:
-            device["annotations"] = {
-                "tpu.google.com/chip-ids": ",".join(chip_ids)
-            }
+            annotations["tpu.google.com/chip-ids"] = ",".join(chip_ids)
+        if claim_ref is not None:
+            annotations["tpu.google.com/claim-namespace"] = claim_ref[0]
+            annotations["tpu.google.com/claim-name"] = claim_ref[1]
+        if annotations:
+            device["annotations"] = annotations
         spec = {
             "cdiVersion": CDI_VERSION,
             "kind": self.kind,
@@ -128,15 +155,11 @@ class CdiRegistry:
     def claim_chip_ids(self, claim_uid: str) -> List[str]:
         """Chip ids recorded in a claim's spec annotations (restart
         recovery); [] when the spec is missing or predates the field."""
-        spec = self.read_claim_spec(claim_uid)
-        if not spec:
-            return []
-        for dev in spec.get("devices", []):
-            ann = dev.get("annotations") or {}
-            ids = ann.get("tpu.google.com/chip-ids", "")
-            if ids:
-                return ids.split(",")
-        return []
+        return spec_chip_ids(self.read_claim_spec(claim_uid))
+
+    def claim_ref(self, claim_uid: str) -> Optional[tuple]:
+        """(namespace, name) recorded for a claim, or None."""
+        return spec_claim_ref(self.read_claim_spec(claim_uid))
 
     def list_claim_uids(self) -> List[str]:
         """Claim uids with spec files on disk (restart recovery)."""

@@ -81,6 +81,9 @@ class DraDriver(DraPluginServicer):
         # claim uid -> chip ids staged for it (idempotent prepare; frees
         # on unprepare even if the apiserver is unreachable then).
         self.prepared: Dict[str, List[str]] = {}
+        # claim uid -> (namespace, name) — for the controller's eviction
+        # path to find pods referencing a claim on a broken chip.
+        self.claim_refs: Dict[str, tuple] = {}
         # claim uid -> the claim's allocation results (for request_names).
         self._results_by_uid: Dict[str, List[dict]] = {}
         self._server: Optional[grpc.Server] = None
@@ -157,8 +160,15 @@ class DraDriver(DraPluginServicer):
     def _prepare_claim(self, claim) -> List[pb.Device]:
         with self._lock:
             already = self.prepared.get(claim.uid)
+            if already is not None:
+                # Idempotent: kubelet retries prepare after restarts.
+                # Backfill the claim ref — a claim recovered from a CDI
+                # spec predating the ref annotations would otherwise miss
+                # eviction coverage forever.
+                self.claim_refs.setdefault(
+                    claim.uid, (claim.namespace, claim.name)
+                )
         if already is not None:
-            # Idempotent: kubelet retries prepare after restarts.
             return self._device_msgs(claim.uid, already)
         if self.client is None:
             raise RuntimeError("no API client to resolve the claim")
@@ -216,9 +226,11 @@ class DraDriver(DraPluginServicer):
                 env,
                 libtpu=plugin_mod.libtpu_mount(self.plugin.config),
                 chip_ids=chip_ids,
+                claim_ref=(claim.namespace, claim.name),
             )
             with self._lock:
                 self.prepared[claim.uid] = chip_ids
+                self.claim_refs[claim.uid] = (claim.namespace, claim.name)
                 self._results_by_uid[claim.uid] = results
             self.plugin.mark_allocated(chip_ids)
         log.info(
@@ -252,10 +264,26 @@ class DraDriver(DraPluginServicer):
             )
         return msgs
 
+    def claims_on_chips(self, chip_ids) -> Dict[tuple, set]:
+        """(namespace, name) → the given chips each prepared claim holds —
+        the controller's eviction path uses this to find DRA pods on a
+        broken chip (they carry no devices annotation) and to report the
+        actual chips in the eviction event."""
+        wanted = set(chip_ids)
+        out: Dict[tuple, set] = {}
+        with self._lock:
+            for uid, held in self.prepared.items():
+                hit = wanted & set(held)
+                if hit and uid in self.claim_refs:
+                    ref = self.claim_refs[uid]
+                    out[ref] = out.get(ref, set()) | hit
+        return out
+
     def _unprepare_claim(self, claim_uid: str) -> None:
         self.cdi.remove_claim_device(claim_uid)
         with self._lock:
             chip_ids = self.prepared.pop(claim_uid, [])
+            self.claim_refs.pop(claim_uid, None)
             self._results_by_uid.pop(claim_uid, None)
         if chip_ids:
             self.plugin.free_devices(chip_ids)
@@ -274,14 +302,21 @@ class DraDriver(DraPluginServicer):
         NodeUnprepareResources retries."""
         recovered = []
         for uid in self.cdi.list_claim_uids():
+            # One spec read per claim, outside the lock (file I/O).
+            spec = self.cdi.read_claim_spec(uid)
+            if not spec:
+                continue
             ids = [
                 i
-                for i in self.cdi.claim_chip_ids(uid)
+                for i in cdi.spec_chip_ids(spec)
                 if i in self.plugin.mesh.by_id
             ]
+            ref = cdi.spec_claim_ref(spec)
             if ids:
                 with self._lock:
                     self.prepared[uid] = ids
+                    if ref is not None:
+                        self.claim_refs[uid] = ref
                 recovered.extend(ids)
         if recovered:
             self.plugin.mark_allocated(recovered)

@@ -237,6 +237,10 @@ class Daemon:
                 cdi_dir=self.cfg.cdi_dir,
             )
             self.dra.start()  # publisher thread handles the ResourceSlice
+            if self.controller is not None:
+                # Eviction finds DRA pods (no devices annotation) through
+                # their prepared claims.
+                self.controller.dra_claims_lookup = self.dra.claims_on_chips
         except Exception as e:
             log.warning("DRA plane disabled: %s", e)
             self.dra = None

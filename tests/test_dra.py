@@ -601,3 +601,78 @@ def test_malformed_slice_bounds_do_not_break_publishing(plugin):
     )["spec"]["devices"][0]["basic"]["attributes"]
     assert attrs2["workerId"] == {"int": 1}
     assert attrs2["hostX"] == {"int": 1}
+
+
+def test_unhealthy_chip_evicts_dra_claim_pod(driver, api, plugin, tmp_path):
+    """A pod running on a DRA claim has no devices annotation and no
+    checkpoint entry — eviction must find it through the claim reference
+    when its chip goes Unhealthy."""
+    import time as _time
+
+    from k8s_device_plugin_tpu.controller.controller import Controller
+
+    server, client = api
+    server.add_resource_claim(claim_obj("uid-e", ["chip-0"]))
+    stub = stub_for(driver)
+    req = pb.NodePrepareResourcesRequest()
+    req.claims.add(namespace="default", name="claim-uid-e", uid="uid-e")
+    assert not stub.NodePrepareResources(req).claims["uid-e"].error
+    # The pod referencing the claim via a template-generated status entry.
+    server.add_pod({
+        "metadata": {"name": "dra-pod", "namespace": "default",
+                     "uid": "uid-p", "annotations": {}},
+        "spec": {"nodeName": NODE, "containers": [{"name": "m"}],
+                 "resourceClaims": [{"name": "tpus"}]},
+        "status": {"resourceClaimStatuses": [
+            {"name": "tpus", "resourceClaimName": "claim-uid-e"}]},
+    })
+    ckpt_path = tmp_path / "ckpt"
+    ckpt_path.write_text("{}")
+    ctrl = Controller(
+        client, plugin, node_name=NODE, checkpoint_path=str(ckpt_path),
+        podresources_socket="", watch_timeout_s=2,
+    )
+    ctrl.dra_claims_lookup = driver.claims_on_chips
+    chip0_id = slices.chips_by_device_name(plugin.mesh)["chip-0"].id
+    plugin.state.set_health(chip0_id, healthy=False)
+    ctrl._evict_pods_on_chip(chip0_id)
+    assert ("default", "dra-pod") in server.evictions
+
+
+def test_claim_refs_recovered_from_disk(plugin, api, tmp_path):
+    """claim_refs (the eviction join key) survive a driver restart via
+    the CDI spec annotations."""
+    server, client = api
+    server.add_resource_claim(claim_obj("uid-r2", ["chip-1"]))
+    kw = dict(
+        kube_client=client, driver_name=DRIVER, node_name=NODE,
+        plugins_dir=str(tmp_path / "plugins"),
+        plugins_registry_dir=str(tmp_path / "plugins_registry"),
+        cdi_dir=str(tmp_path / "cdi"),
+    )
+    d1 = DraDriver(plugin, **kw)
+    d1.start()
+    try:
+        stub = stub_for(d1)
+        req = pb.NodePrepareResourcesRequest()
+        req.claims.add(namespace="default", name="claim-uid-r2",
+                       uid="uid-r2")
+        assert not stub.NodePrepareResources(req).claims["uid-r2"].error
+    finally:
+        d1.stop()
+    chips = PyTpuInfo().scan(
+        os.path.join(str(tmp_path), "sys/class/accel"),
+        os.path.join(str(tmp_path), "dev"),
+    )
+    plugin2 = TpuDevicePlugin(
+        IciMesh(chips), config=PluginConfig(libtpu_host_path="")
+    )
+    d2 = DraDriver(plugin2, **kw)
+    d2.start()
+    try:
+        chip1_id = slices.chips_by_device_name(plugin2.mesh)["chip-1"].id
+        assert d2.claims_on_chips([chip1_id]) == {
+            ("default", "claim-uid-r2"): {chip1_id}
+        }
+    finally:
+        d2.stop()
