@@ -69,6 +69,14 @@ class CdiRegistry:
     def device_id(self, device_name: str) -> str:
         return f"{self.kind}={device_name}"
 
+    @staticmethod
+    def claim_device_name(claim_uid: str) -> str:
+        """The single source of the per-claim CDI device naming scheme."""
+        return f"claim-{claim_uid}"
+
+    def claim_device_id(self, claim_uid: str) -> str:
+        return self.device_id(self.claim_device_name(claim_uid))
+
     def write_claim_device(
         self,
         claim_uid: str,
@@ -84,7 +92,7 @@ class CdiRegistry:
         decision lives there so both planes stay in lockstep. ``chip_ids``
         is recorded in the spec's annotations so a restarted driver can
         rebuild its prepared-claim holds from disk (claim_chip_ids)."""
-        name = f"claim-{claim_uid}"
+        name = self.claim_device_name(claim_uid)
         edits: Dict = {
             "deviceNodes": [
                 {"path": p, "hostPath": p} for p in dev_paths
@@ -115,6 +123,13 @@ class CdiRegistry:
             "kind": self.kind,
             "devices": [device],
         }
+        self._write_spec(name, spec)
+        log.info(
+            "wrote CDI spec for %s (%d device nodes)", name, len(dev_paths)
+        )
+        return self.device_id(name)
+
+    def _write_spec(self, name: str, spec: dict) -> None:
         os.makedirs(self.cdi_dir, exist_ok=True)
         path = os.path.join(self.cdi_dir, _spec_filename(self.kind, name))
         # Atomic replace: the runtime may list the dir at any moment.
@@ -129,11 +144,23 @@ class CdiRegistry:
             except OSError:
                 pass
             raise
-        log.info("wrote CDI spec %s (%d device nodes)", path, len(dev_paths))
-        return self.device_id(name)
+
+    def update_claim_ref(self, claim_uid: str, claim_ref: tuple) -> bool:
+        """Persist a late-resolved (namespace, name) into an existing
+        claim spec's annotations (legacy specs written before the field
+        existed), so the next restart recovers it from disk without an
+        API round trip. Returns False when no spec exists."""
+        spec = self.read_claim_spec(claim_uid)
+        if not spec or not spec.get("devices"):
+            return False
+        ann = spec["devices"][0].setdefault("annotations", {})
+        ann["tpu.google.com/claim-namespace"] = claim_ref[0]
+        ann["tpu.google.com/claim-name"] = claim_ref[1]
+        self._write_spec(self.claim_device_name(claim_uid), spec)
+        return True
 
     def remove_claim_device(self, claim_uid: str) -> None:
-        name = f"claim-{claim_uid}"
+        name = self.claim_device_name(claim_uid)
         path = os.path.join(self.cdi_dir, _spec_filename(self.kind, name))
         try:
             os.unlink(path)
@@ -144,7 +171,7 @@ class CdiRegistry:
     def read_claim_spec(self, claim_uid: str) -> Optional[dict]:
         """The spec previously written for a claim, or None (test hook and
         restart-recovery probe)."""
-        name = f"claim-{claim_uid}"
+        name = self.claim_device_name(claim_uid)
         path = os.path.join(self.cdi_dir, _spec_filename(self.kind, name))
         try:
             with open(path) as f:

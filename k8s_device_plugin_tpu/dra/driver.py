@@ -246,7 +246,7 @@ class DraDriver(DraPluginServicer):
             mc = self._by_device_name.get(r.get("device", ""))
             if mc is not None and r.get("request"):
                 request_by_chip[mc.id] = r["request"]
-        cdi_id = self.cdi.device_id(f"claim-{claim_uid}")
+        cdi_id = self.cdi.claim_device_id(claim_uid)
         msgs = []
         for chip_id in chip_ids:
             mc = self.plugin.mesh.by_id[chip_id]
@@ -301,6 +301,7 @@ class DraDriver(DraPluginServicer):
         the daemon was down are reconciled by the kubelet's
         NodeUnprepareResources retries."""
         recovered = []
+        refless = []
         for uid in self.cdi.list_claim_uids():
             # One spec read per claim, outside the lock (file I/O).
             spec = self.cdi.read_claim_spec(uid)
@@ -317,7 +318,10 @@ class DraDriver(DraPluginServicer):
                     self.prepared[uid] = ids
                     if ref is not None:
                         self.claim_refs[uid] = ref
+                if ref is None:
+                    refless.append(uid)
                 recovered.extend(ids)
+        self._resolve_missing_refs(refless)
         if recovered:
             self.plugin.mark_allocated(recovered)
             log.info(
@@ -325,6 +329,42 @@ class DraDriver(DraPluginServicer):
                 len(self.prepared), sorted(recovered),
             )
         self._update_prepared_gauge()
+
+    def _resolve_missing_refs(self, uids: List[str]) -> None:
+        """Resolve (namespace, name) for recovered claims whose CDI specs
+        predate the ref annotations, by listing ResourceClaims and
+        matching uid — the kubelet won't re-prepare a running claim, so
+        without this such claims would miss eviction coverage forever."""
+        if self.client is None or not uids:
+            return
+        try:
+            resp = self.client.get(f"{slices.RESOURCE_API}/resourceclaims")
+        except Exception as e:
+            log.warning(
+                "claim-ref resolution for %d legacy claims failed (their "
+                "pods won't be evicted on chip failure): %s", len(uids), e,
+            )
+            return
+        by_uid = {}
+        for item in resp.get("items", []):
+            m = item.get("metadata", {})
+            if m.get("uid"):
+                by_uid[m["uid"]] = (
+                    m.get("namespace", "default"), m.get("name", "")
+                )
+        resolved = []
+        with self._lock:
+            for uid in uids:
+                if uid in by_uid:
+                    self.claim_refs[uid] = by_uid[uid]
+                    resolved.append((uid, by_uid[uid]))
+        # Persist into the spec annotations so the NEXT restart recovers
+        # from disk even if the apiserver is unreachable then.
+        for uid, ref in resolved:
+            try:
+                self.cdi.update_claim_ref(uid, ref)
+            except OSError as e:
+                log.warning("claim-ref persist for %s failed: %s", uid, e)
 
     def start(self) -> None:
         self.recover_prepared()

@@ -62,6 +62,32 @@ def render_mesh(mesh: IciMesh, available=None) -> str:
     return "\n".join(lines)
 
 
+def _read_claims(cdi_dir: str, mesh: IciMesh) -> list:
+    """Prepared DRA claims from a CDI spec dir, as plain dicts usable by
+    both the ASCII and JSON renderers."""
+    from ..dra.cdi import CdiRegistry, spec_chip_ids, spec_claim_ref
+
+    reg = CdiRegistry(cdi_dir)
+    out = []
+    for uid in reg.list_claim_uids():
+        spec = reg.read_claim_spec(uid)
+        ref = spec_claim_ref(spec)
+        ids = spec_chip_ids(spec)
+        out.append(
+            {
+                "uid": uid,
+                "namespace": ref[0] if ref else "",
+                "name": ref[1] if ref else "",
+                "chip_ids": ids,
+                "chip_indexes": [
+                    mesh.by_id[i].chip.index for i in ids if i in mesh.by_id
+                ],
+                "cdi_id": reg.claim_device_id(uid),
+            }
+        )
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpu-topo")
     p.add_argument("--sysfs", default=DEFAULT_SYSFS_ACCEL)
@@ -72,6 +98,9 @@ def main(argv=None) -> int:
                    help="also show which N chips the placement policy picks")
     p.add_argument("--json", action="store_true",
                    help="emit the NodeTopology JSON instead of ASCII")
+    p.add_argument("--cdi-dir", default="",
+                   help="also render prepared DRA claims from this CDI "
+                   "spec dir (e.g. /var/run/cdi)")
     a = p.parse_args(argv)
 
     available = None
@@ -111,13 +140,35 @@ def main(argv=None) -> int:
             discovered_coords=collect_chip_coords(backend, a.sysfs, chips),
         )
 
+    claims = _read_claims(a.cdi_dir, mesh) if a.cdi_dir else None
+
     if a.json:
-        print(NodeTopology.from_mesh(mesh, available=available).to_json())
+        topo_json = NodeTopology.from_mesh(mesh, available=available).to_json()
+        if claims is None:
+            print(topo_json)
+        else:
+            # --cdi-dir composes into the JSON output too, so scripted
+            # collection never silently drops the claim state.
+            print(json.dumps(
+                {"topology": json.loads(topo_json), "dra_claims": claims}
+            ))
         return 0
 
     print(render_mesh(mesh, available))
     for line in extra:
         print(line)
+    if claims is not None:
+        print(f"\nDRA: {len(claims)} prepared claim(s) in {a.cdi_dir}")
+        for c in claims:
+            label = (
+                f"{c['namespace']}/{c['name']}"
+                if c.get("name")
+                else c["uid"]
+            )
+            print(
+                f"  claim {label}: chips {c['chip_indexes'] or c['chip_ids']}"
+                f"  cdi={c['cdi_id']}"
+            )
     if a.select:
         state = PlacementState(mesh)
         if available is not None:

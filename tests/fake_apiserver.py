@@ -300,6 +300,13 @@ class FakeApiServer:
                      "items": list(self.resourceslices.values())},
                 )
                 return
+            if len(parts) == 4 and parts[3] == "resourceclaims":
+                self._send_json(
+                    handler,
+                    {"kind": "ResourceClaimList",
+                     "items": list(self.resourceclaims.values())},
+                )
+                return
             if len(parts) == 5 and parts[3] == "resourceslices":
                 obj = self.resourceslices.get(parts[4])
             elif (

@@ -607,8 +607,6 @@ def test_unhealthy_chip_evicts_dra_claim_pod(driver, api, plugin, tmp_path):
     """A pod running on a DRA claim has no devices annotation and no
     checkpoint entry — eviction must find it through the claim reference
     when its chip goes Unhealthy."""
-    import time as _time
-
     from k8s_device_plugin_tpu.controller.controller import Controller
 
     server, client = api
@@ -676,3 +674,58 @@ def test_claim_refs_recovered_from_disk(plugin, api, tmp_path):
         }
     finally:
         d2.stop()
+
+
+def test_legacy_spec_refs_resolved_via_api(plugin, api, tmp_path):
+    """Claims recovered from pre-annotation CDI specs (no claim ref) get
+    their (namespace, name) resolved by listing ResourceClaims and
+    matching uid — the kubelet won't re-prepare a running claim, so this
+    is the only path to eviction coverage for them."""
+    server, client = api
+    chip0 = slices.chips_by_device_name(plugin.mesh)["chip-0"]
+    reg = CdiRegistry(str(tmp_path / "cdi"))
+    # A legacy spec: chip ids but no claim-ref annotations.
+    reg.write_claim_device("uid-legacy", ["/dev/accel0"], {},
+                           chip_ids=[chip0.id])
+    server.add_resource_claim({
+        "metadata": {"name": "old-claim", "namespace": "ml",
+                     "uid": "uid-legacy"},
+        "status": {},
+    })
+    d = DraDriver(
+        plugin, kube_client=client, driver_name=DRIVER, node_name=NODE,
+        plugins_dir=str(tmp_path / "plugins"),
+        plugins_registry_dir=str(tmp_path / "plugins_registry"),
+        cdi_dir=str(tmp_path / "cdi"),
+    )
+    d.recover_prepared()
+    assert d.claims_on_chips([chip0.id]) == {("ml", "old-claim"): {chip0.id}}
+
+
+def test_resolved_legacy_ref_persisted_to_spec(plugin, api, tmp_path):
+    """A ref resolved via the API for a legacy spec is written back into
+    the spec annotations, so the next restart needs no API round trip."""
+    server, client = api
+    chip0 = slices.chips_by_device_name(plugin.mesh)["chip-0"]
+    reg = CdiRegistry(str(tmp_path / "cdi"))
+    reg.write_claim_device("uid-lp", ["/dev/accel0"], {},
+                           chip_ids=[chip0.id])
+    server.add_resource_claim({
+        "metadata": {"name": "old2", "namespace": "ml", "uid": "uid-lp"},
+        "status": {},
+    })
+    kw = dict(
+        driver_name=DRIVER, node_name=NODE,
+        plugins_dir=str(tmp_path / "plugins"),
+        plugins_registry_dir=str(tmp_path / "plugins_registry"),
+        cdi_dir=str(tmp_path / "cdi"),
+    )
+    d1 = DraDriver(plugin, kube_client=client, **kw)
+    d1.recover_prepared()
+    assert d1.claim_refs["uid-lp"] == ("ml", "old2")
+    assert reg.claim_ref("uid-lp") == ("ml", "old2")  # persisted
+    # Next generation: NO API client, spec alone carries the ref.
+    plugin.state.reset()
+    d2 = DraDriver(plugin, kube_client=None, **kw)
+    d2.recover_prepared()
+    assert d2.claim_refs["uid-lp"] == ("ml", "old2")
