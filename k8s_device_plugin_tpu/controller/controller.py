@@ -124,10 +124,22 @@ class Controller:
             self.publisher.stop()
         self._stop.set()
         self._queue.put(None)
+        # Asymmetric joins, both bounded well under the DaemonSet's 30 s
+        # SIGTERM grace: the informer can sit inside a streaming watch
+        # read for up to its timeout (~30 s) but only ever touches its
+        # own (abandoned-on-rebuild) queue, so leaking it briefly is
+        # safe; the worker mutates the SHARED plugin placement state, so
+        # it gets the full REST-timeout budget to drain — freeing chips
+        # from pre-stop state after a rebuild's rebuild_state() would
+        # corrupt the new generation's accounting.
         for t in self._threads:
-            t.join(timeout=self.watch_timeout_s + 5)
+            t.join(timeout=15 if t.name == "pod-worker" else 3)
+        leaked = [t.name for t in self._threads if t.is_alive()]
+        if leaked:
+            log.warning("controller threads still draining: %s", leaked)
+        else:
+            self.podres.close()  # safe only once no thread can use it
         self._threads = []
-        self.podres.close()
 
     # ------------------------------------------------------------------
     # Startup state rebuild (reference gap — SURVEY.md §5)

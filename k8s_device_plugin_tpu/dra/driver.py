@@ -321,7 +321,6 @@ class DraDriver(DraPluginServicer):
                 if ref is None:
                     refless.append(uid)
                 recovered.extend(ids)
-        self._resolve_missing_refs(refless)
         if recovered:
             self.plugin.mark_allocated(recovered)
             log.info(
@@ -329,6 +328,9 @@ class DraDriver(DraPluginServicer):
                 len(self.prepared), sorted(recovered),
             )
         self._update_prepared_gauge()
+        # AFTER the holds are recorded: this is a blocking API call, and
+        # the chips must not be published as available while it runs.
+        self._resolve_missing_refs(refless)
 
     def _resolve_missing_refs(self, uids: List[str]) -> None:
         """Resolve (namespace, name) for recovered claims whose CDI specs
