@@ -137,8 +137,11 @@ class Controller:
         leaked = [t.name for t in self._threads if t.is_alive()]
         if leaked:
             log.warning("controller threads still draining: %s", leaked)
-        else:
-            self.podres.close()  # safe only once no thread can use it
+        if "pod-worker" not in leaked:
+            # The worker is podres's only user; the informer routinely
+            # outlives its short join (blocking watch read) and must not
+            # leak the channel on every supervisor rebuild.
+            self.podres.close()
         self._threads = []
 
     # ------------------------------------------------------------------

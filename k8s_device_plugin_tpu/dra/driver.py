@@ -200,18 +200,24 @@ class DraDriver(DraPluginServicer):
         # _allocate_lock → self._lock.
         with self.plugin._allocate_lock:
             # The DRA scheduler allocates against the static ResourceSlice
-            # and is blind to the classic plane's device-manager usage —
-            # refuse a claim whose chips a device-plugin pod already holds
-            # (the mirror of Allocate's external_holds guard) or that are
-            # currently unhealthy (the slice republish lags a transition).
-            held_by_classic = (
-                set(self.plugin.state.allocated) - self._held_chip_ids()
+            # and is blind to live usage — refuse a claim whose chips ANY
+            # current holder owns: a device-plugin pod (the mirror of
+            # Allocate's external_holds guard) or another prepared claim
+            # (a duplicated/buggy scheduler decision; subtracting all DRA
+            # holds here would let two claims stage one chip — caught by
+            # the cross-plane stress test). Idempotent re-prepare of the
+            # SAME claim returned earlier, so any hit is a real conflict.
+            conflict = sorted(
+                set(chip_ids) & set(self.plugin.state.allocated)
             )
-            conflict = sorted(set(chip_ids) & held_by_classic)
             if conflict:
+                holder = (
+                    "another ResourceClaim"
+                    if set(conflict) & self._held_chip_ids()
+                    else "the device-plugin plane"
+                )
                 raise RuntimeError(
-                    "chips already held by the device-plugin plane: "
-                    f"{conflict}"
+                    f"chips already held by {holder}: {conflict}"
                 )
             broken = sorted(
                 set(chip_ids) & self.plugin.state.unhealthy

@@ -729,3 +729,20 @@ def test_resolved_legacy_ref_persisted_to_spec(plugin, api, tmp_path):
     d2 = DraDriver(plugin, kube_client=None, **kw)
     d2.recover_prepared()
     assert d2.claim_refs["uid-lp"] == ("ml", "old2")
+
+
+def test_prepare_refuses_chips_held_by_another_claim(driver, api):
+    """Two claims allocated the same device (duplicated or buggy
+    scheduler decision) must not both stage it — the second prepare
+    errors instead of double-mounting."""
+    server, _ = api
+    server.add_resource_claim(claim_obj("uid-a", ["chip-0"]))
+    server.add_resource_claim(claim_obj("uid-b", ["chip-0"]))
+    stub = stub_for(driver)
+    req = pb.NodePrepareResourcesRequest()
+    req.claims.add(namespace="default", name="claim-uid-a", uid="uid-a")
+    assert not stub.NodePrepareResources(req).claims["uid-a"].error
+    req2 = pb.NodePrepareResourcesRequest()
+    req2.claims.add(namespace="default", name="claim-uid-b", uid="uid-b")
+    err = stub.NodePrepareResources(req2).claims["uid-b"].error
+    assert "another ResourceClaim" in err

@@ -159,3 +159,140 @@ def test_listandwatch_stream_consistent_under_churn(served_plugin):
         plugin.notify_health(chip, healthy=True)
     seen.get(timeout=10)  # stream alive and sending
     assert bad.empty(), f"malformed advertisement: {bad.get()}"
+
+
+def test_cross_plane_concurrency_never_double_allocates(tmp_path):
+    """Classic Allocate (substitution mode) and DRA prepare/unprepare
+    hammer the same chips concurrently; the shared placement state under
+    the Allocate lock must keep the two planes' successful grants
+    disjoint at every instant (the double-mount invariant across planes,
+    not just across containers)."""
+    from k8s_device_plugin_tpu.api import dra_pb2 as drapb
+    from k8s_device_plugin_tpu.api.grpc_defs import DraPluginStub
+    from k8s_device_plugin_tpu.dra.driver import DraDriver
+    from k8s_device_plugin_tpu.dra import slices as dra_slices
+    from k8s_device_plugin_tpu.kube.client import KubeClient
+    from tests.fake_apiserver import FakeApiServer
+
+    dp_dir = tmp_path / "dp"
+    dp_dir.mkdir()
+    kubelet = FakeKubelet(str(dp_dir))
+    kubelet.start()
+    api = FakeApiServer()
+    url = api.start()
+    plugin = TpuDevicePlugin(
+        IciMesh(make_chips("v5e", 8)),
+        config=PluginConfig(
+            device_plugin_dir=str(dp_dir),
+            libtpu_host_path="",
+            substitute_on_allocate=True,
+        ),
+    )
+    plugin.serve()
+    driver = DraDriver(
+        plugin, kube_client=KubeClient(url), node_name="stress-node",
+        plugins_dir=str(tmp_path / "plugins"),
+        plugins_registry_dir=str(tmp_path / "plugins_registry"),
+        cdi_dir=str(tmp_path / "cdi"),
+    )
+    driver.start()
+    by_name = dra_slices.chips_by_device_name(plugin.mesh)
+    name_by_id = {mc.id: n for n, mc in by_name.items()}
+    ids = list(plugin.mesh.by_id)
+
+    stub = kubelet.plugin_stub()
+    ch = grpc.insecure_channel(f"unix:{driver.socket_path}")
+    grpc.channel_ready_future(ch).result(timeout=5)
+    dra_stub = DraPluginStub(ch)
+
+    lock = threading.Lock()
+    classic_held: set = set()
+    dra_held: set = set()
+    failures: queue.Queue = queue.Queue()
+    rounds = 25
+
+    def classic_worker(tid):
+        rng = random.Random(tid)
+        for _ in range(rounds):
+            req = pb.AllocateRequest()
+            req.container_requests.add().devicesIDs.extend(ids[:2])
+            try:
+                resp = stub.Allocate(req, timeout=10)
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    continue
+                failures.put(f"classic rpc error: {e.code()}")
+                return
+            assigned = {
+                i
+                for c in resp.container_responses
+                for i in c.annotations[
+                    constants.POD_DEVICES_ANNOTATION
+                ].split(",")
+            }
+            with lock:
+                clash = assigned & (classic_held | dra_held)
+                if clash:
+                    failures.put(f"classic got held chips {clash}")
+                    return
+                classic_held.update(assigned)
+            threading.Event().wait(rng.uniform(0, 0.01))
+            with lock:
+                classic_held.difference_update(assigned)
+            plugin.free_devices(assigned)
+
+    def dra_worker(tid):
+        rng = random.Random(1000 + tid)
+        for n in range(rounds):
+            uid = f"u-{tid}-{n}"
+            pick = rng.sample(ids, 2)
+            api.add_resource_claim({
+                "metadata": {"name": f"claim-{uid}",
+                             "namespace": "default", "uid": uid},
+                "status": {"allocation": {"devices": {"results": [
+                    {"request": "tpus", "driver": driver.driver_name,
+                     "pool": "stress-node", "device": name_by_id[i]}
+                    for i in pick
+                ]}}},
+            })
+            req = drapb.NodePrepareResourcesRequest()
+            req.claims.add(namespace="default", name=f"claim-{uid}",
+                           uid=uid)
+            resp = dra_stub.NodePrepareResources(req, timeout=10)
+            if resp.claims[uid].error:
+                continue  # chips held elsewhere right now: legal refusal
+            staged = set(driver.prepared.get(uid, []))
+            with lock:
+                clash = staged & (classic_held | dra_held)
+                if clash:
+                    failures.put(f"DRA staged held chips {clash}")
+                    return
+                dra_held.update(staged)
+            threading.Event().wait(rng.uniform(0, 0.01))
+            with lock:
+                dra_held.difference_update(staged)
+            ureq = drapb.NodeUnprepareResourcesRequest()
+            ureq.claims.add(namespace="default", name=f"claim-{uid}",
+                            uid=uid)
+            dra_stub.NodeUnprepareResources(ureq, timeout=10)
+
+    threads = [
+        threading.Thread(target=classic_worker, args=(t,)) for t in range(3)
+    ] + [
+        threading.Thread(target=dra_worker, args=(t,)) for t in range(3)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "worker hung"
+        assert failures.empty(), failures.get()
+        # All grants returned: everything free again on both planes.
+        assert plugin.state.allocated == set()
+        assert driver.prepared == {}
+    finally:
+        driver.stop()
+        plugin.stop()
+        kubelet.stop()
+        api.stop()
