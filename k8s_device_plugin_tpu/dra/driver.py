@@ -199,26 +199,33 @@ class DraDriver(DraPluginServicer):
         # both guards and double-mount a chip. Lock order everywhere is
         # _allocate_lock → self._lock.
         with self.plugin._allocate_lock:
+            # Two CONCURRENT prepares of the same uid both pass the early
+            # idempotency check before either commits; re-check under the
+            # lock so the loser returns idempotently instead of tripping
+            # the conflict guard on its twin's freshly-committed chips.
+            with self._lock:
+                already = self.prepared.get(claim.uid)
+            if already is not None:
+                return self._device_msgs(claim.uid, already)
             # The DRA scheduler allocates against the static ResourceSlice
             # and is blind to live usage — refuse a claim whose chips ANY
             # current holder owns: a device-plugin pod (the mirror of
             # Allocate's external_holds guard) or another prepared claim
             # (a duplicated/buggy scheduler decision; subtracting all DRA
             # holds here would let two claims stage one chip — caught by
-            # the cross-plane stress test). Idempotent re-prepare of the
-            # SAME claim returned earlier, so any hit is a real conflict.
-            conflict = sorted(
-                set(chip_ids) & set(self.plugin.state.allocated)
-            )
+            # the cross-plane stress test).
+            conflict = set(chip_ids) & set(self.plugin.state.allocated)
             if conflict:
-                holder = (
-                    "another ResourceClaim"
-                    if set(conflict) & self._held_chip_ids()
-                    else "the device-plugin plane"
-                )
-                raise RuntimeError(
-                    f"chips already held by {holder}: {conflict}"
-                )
+                by_dra = sorted(conflict & self._held_chip_ids())
+                by_classic = sorted(conflict - set(by_dra))
+                parts = []
+                if by_dra:
+                    parts.append(f"by another ResourceClaim: {by_dra}")
+                if by_classic:
+                    parts.append(
+                        f"by the device-plugin plane: {by_classic}"
+                    )
+                raise RuntimeError("chips already held " + "; ".join(parts))
             broken = sorted(
                 set(chip_ids) & self.plugin.state.unhealthy
             )

@@ -746,3 +746,81 @@ def test_prepare_refuses_chips_held_by_another_claim(driver, api):
     req2.claims.add(namespace="default", name="claim-uid-b", uid="uid-b")
     err = stub.NodePrepareResources(req2).claims["uid-b"].error
     assert "another ResourceClaim" in err
+
+
+def test_sighup_rebuild_recovers_dra_claims(tmp_path):
+    """A SIGHUP rebuild through the real supervisor loop tears down and
+    rebuilds the DRA plane; prepared-claim holds recover from the CDI
+    specs so the new generation still withholds the chips."""
+    import signal as _signal
+    import threading
+    import time as _time
+
+    from k8s_device_plugin_tpu.supervisor.main import Daemon, DaemonConfig
+    from tests.fake_kubelet import FakeKubelet
+
+    api = FakeApiServer()
+    url = api.start()
+    api.add_node(NODE)
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(
+        "apiVersion: v1\nkind: Config\ncurrent-context: c\n"
+        "contexts: [{name: c, context: {cluster: cl, user: u}}]\n"
+        f"clusters: [{{name: cl, cluster: {{server: \"{url}\"}}}}]\n"
+        "users: [{name: u, user: {token: t}}]\n"
+    )
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 4)
+    dp_dir = tmp_path / "dp"
+    dp_dir.mkdir()
+    kubelet = FakeKubelet(str(dp_dir))
+    kubelet.start()
+    daemon = Daemon(DaemonConfig(
+        node_name=NODE, device_plugin_dir=str(dp_dir),
+        sysfs_accel_dir=accel, dev_dir=dev, libtpu_host_path="",
+        kubeconfig=str(kubeconfig), prefer_native_backend=False,
+        podresources_socket="", enable_dra=True,
+        plugins_dir=str(tmp_path / "plugins"),
+        plugins_registry_dir=str(tmp_path / "plugins_registry"),
+        cdi_dir=str(tmp_path / "cdi"),
+    ))
+    t = threading.Thread(target=daemon.run, daemon=True)
+    t.start()
+
+    def wait_for(cond, timeout=15.0):
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            if cond():
+                return True
+            _time.sleep(0.1)
+        return False
+
+    try:
+        assert kubelet.registered.wait(15)
+        assert wait_for(lambda: daemon.dra is not None)
+        gen1 = daemon.dra
+        api.add_resource_claim(claim_obj("uid-hup", ["chip-0"]))
+        stub = stub_for(gen1)
+        req = pb.NodePrepareResourcesRequest()
+        req.claims.add(namespace="default", name="claim-uid-hup",
+                       uid="uid-hup")
+        assert not stub.NodePrepareResources(req).claims["uid-hup"].error
+        assert len(daemon.plugin.state.allocated) == 1
+
+        daemon.events.put(("signal", _signal.SIGHUP))
+        assert wait_for(
+            lambda: daemon.dra is not None and daemon.dra is not gen1
+        )
+        # New generation: hold recovered from the CDI spec on disk.
+        assert wait_for(
+            lambda: daemon.dra.prepared.get("uid-hup") is not None
+        )
+        assert len(daemon.plugin.state.allocated) == 1
+        assert daemon.dra.claims_on_chips(
+            daemon.dra.prepared["uid-hup"]
+        ) == {("default", "claim-uid-hup"):
+              set(daemon.dra.prepared["uid-hup"])}
+    finally:
+        daemon.events.put(("signal", _signal.SIGTERM))
+        t.join(timeout=25)
+        kubelet.stop()
+        api.stop()
