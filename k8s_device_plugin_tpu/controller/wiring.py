@@ -150,12 +150,15 @@ class TopologyPublisher:
                 log.warning("topology republish failed: %s", e)
 
 
-def maybe_derive_slice_config(client: KubeClient, cfg, mesh: IciMesh) -> None:
+def maybe_derive_slice_config(
+    client: KubeClient, cfg, mesh: IciMesh, node: Optional[dict] = None
+) -> None:
     """Fill cfg's slice membership from GKE node labels when the flags
     didn't set it (kube/gke.py). Mutates cfg in place; never overrides
     explicit flags. MUST run before the plugin is constructed/served —
     Allocate exports these to containers (server/plugin.py _tpu_env), so
-    deriving after serve would race the kubelet's first Allocate."""
+    deriving after serve would race the kubelet's first Allocate.
+    ``node`` (prefetched) avoids a second get_node round trip."""
     explicitly_configured = (
         cfg.worker_hostnames
         or cfg.worker_id != 0
@@ -166,7 +169,9 @@ def maybe_derive_slice_config(client: KubeClient, cfg, mesh: IciMesh) -> None:
     from ..kube.gke import derive_slice_membership
 
     node_name = cfg.node_name or os.uname().nodename
-    derived = derive_slice_membership(client, node_name, mesh.bounds)
+    derived = derive_slice_membership(
+        client, node_name, mesh.bounds, node=node
+    )
     if derived is not None:
         log.info(
             "slice membership from GKE labels: worker %d of %s "

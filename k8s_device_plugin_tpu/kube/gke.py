@@ -97,19 +97,45 @@ def _ordered_hostnames(nodes: List[dict]) -> List[str]:
     return sorted(hosts, key=lambda h: suffixed[h])
 
 
+def derive_accelerator_type(client, node_name: str, node=None) -> str:
+    """Chip type from this node's ``gke-tpu-accelerator`` label ('' when
+    the label is absent or unparseable), so a GKE deployment can omit
+    --accelerator-type entirely — the label is authoritative there and
+    PCI-id detection alone can't distinguish same-silicon variants.
+    ``node`` (prefetched object) skips the apiserver round trip."""
+    from ..discovery.chips import parse_gke_accelerator_label
+    from .client import KubeError
+
+    if node is None:
+        try:
+            node = client.get_node(node_name)
+        except (KubeError, OSError):
+            return ""
+    label = (node.get("metadata", {}).get("labels") or {}).get(
+        GKE_TPU_ACCELERATOR_LABEL, ""
+    )
+    if not label:
+        return ""
+    return parse_gke_accelerator_label(label) or ""
+
+
 def derive_slice_membership(
-    client, node_name: str, host_chip_bounds: Sequence[int]
+    client, node_name: str, host_chip_bounds: Sequence[int], node=None
 ) -> Optional[SliceMembership]:
     """Derive this node's slice membership from GKE labels, or None.
 
     `client` needs get_node(name) and list_nodes(label_selector) (duck-
     typed; KubeClient provides both). `host_chip_bounds` is this host's
-    own chip grid (IciMesh.bounds)."""
-    try:
-        node = client.get_node(node_name)
-    except Exception as e:
-        log.debug("gke derivation: get_node(%s) failed: %s", node_name, e)
-        return None
+    own chip grid (IciMesh.bounds). ``node`` (prefetched object) skips
+    the get_node round trip."""
+    if node is None:
+        try:
+            node = client.get_node(node_name)
+        except Exception as e:
+            log.debug(
+                "gke derivation: get_node(%s) failed: %s", node_name, e
+            )
+            return None
     labels = (node.get("metadata") or {}).get("labels") or {}
     topo_label = labels.get(GKE_TPU_TOPOLOGY_LABEL, "")
     pool = labels.get(GKE_NODEPOOL_LABEL, "")

@@ -145,6 +145,45 @@ class Daemon:
         return chips
 
     def build_and_serve(self) -> None:
+        # Kube client BEFORE discovery: on GKE, an unset --accelerator-type
+        # derives from the node's gke-tpu-accelerator label, which must be
+        # final before the chip table override in discover(). Built for
+        # any kube-facing mode (controller OR DRA — a DRA-only node needs
+        # the right chip spec in its ResourceSlice too). Soft-fails (no
+        # API server in unit environments).
+        self._kube_client = None
+        node_obj = None
+        if self.cfg.enable_controller or self.cfg.enable_dra:
+            try:
+                from ..kube.client import KubeClient
+
+                self._kube_client = KubeClient.from_env(self.cfg.kubeconfig)
+            except Exception as e:
+                log.warning("kube client unavailable pre-serve: %s", e)
+        if self._kube_client is not None:
+            # One node fetch serves both label derivations below.
+            try:
+                node_obj = self._kube_client.get_node(
+                    self.cfg.node_name or os.uname().nodename
+                )
+            except Exception as e:
+                log.debug("node prefetch failed: %s", e)
+        if not self.cfg.accelerator_type and node_obj is not None:
+            try:
+                from ..kube.gke import derive_accelerator_type
+
+                derived = derive_accelerator_type(
+                    self._kube_client,
+                    self.cfg.node_name or os.uname().nodename,
+                    node=node_obj,
+                )
+                if derived:
+                    log.info(
+                        "accelerator type from GKE node label: %s", derived
+                    )
+                    self.cfg.accelerator_type = derived
+            except Exception as e:
+                log.warning("accelerator label derivation failed: %s", e)
         chips = self.discover()
         mesh = IciMesh(
             chips,
@@ -153,21 +192,18 @@ class Daemon:
             ),
         )
         state = PlacementState(mesh)
-        self._kube_client = None
-        if self.cfg.enable_controller:
-            # Kube client + GKE slice-membership derivation BEFORE the
-            # plugin exists: Allocate exports worker_id/hostnames to
-            # containers, so they must be final before the kubelet can
-            # call. Soft-fails (no API server in unit environments).
+        if self.cfg.enable_controller and self._kube_client is not None:
+            # GKE slice-membership derivation BEFORE the plugin exists:
+            # Allocate exports worker_id/hostnames to containers, so they
+            # must be final before the kubelet can call.
             try:
                 from ..controller.wiring import maybe_derive_slice_config
-                from ..kube.client import KubeClient
 
-                self._kube_client = KubeClient.from_env(self.cfg.kubeconfig)
-                maybe_derive_slice_config(self._kube_client, self.cfg, mesh)
+                maybe_derive_slice_config(
+                    self._kube_client, self.cfg, mesh, node=node_obj
+                )
             except Exception as e:
-                log.warning("kube client unavailable pre-serve: %s", e)
-                self._kube_client = None
+                log.warning("slice membership derivation failed: %s", e)
         self.plugin = TpuDevicePlugin(
             mesh,
             state=state,

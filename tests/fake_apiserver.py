@@ -105,6 +105,32 @@ class FakeApiServer:
                     "/apis/resource.k8s.io/v1beta1/"
                 ):
                     server._handle_resource_get(self, parsed.path)
+                elif parsed.path == "/api/v1/nodes":
+                    selector = params.get("labelSelector", "")
+                    with server._lock:
+                        items = list(server.nodes.values())
+                    # Equality selectors only (all KubeClient emits).
+                    for term in filter(None, selector.split(",")):
+                        if "=" in term:
+                            k, v = term.split("=", 1)
+                            items = [
+                                n for n in items
+                                if (n.get("metadata", {}).get("labels")
+                                    or {}).get(k) == v
+                            ]
+                    server._send_json(
+                        self, {"kind": "NodeList", "items": items}
+                    )
+                elif parsed.path.startswith("/api/v1/nodes/"):
+                    name = parsed.path[len("/api/v1/nodes/"):]
+                    with server._lock:
+                        node = server.nodes.get(name)
+                    if node is None:
+                        server._send_json(
+                            self, {"message": "node not found"}, 404
+                        )
+                    else:
+                        server._send_json(self, node)
                 else:
                     self.send_error(404)
 

@@ -124,3 +124,30 @@ def test_derive_without_w_suffix_sorts_hostnames():
     assert m is not None
     assert m.worker_hostnames == "alpha,beta"
     assert m.worker_id == 1  # "beta" sorts second
+
+
+def test_derive_accelerator_type_from_node_label():
+    from tests.fake_apiserver import FakeApiServer
+    from k8s_device_plugin_tpu.kube.client import KubeClient
+    from k8s_device_plugin_tpu.kube.gke import derive_accelerator_type
+
+    api = FakeApiServer()
+    url = api.start()
+    try:
+        api.add_node("n1", {
+            "metadata": {"name": "n1", "annotations": {}, "labels": {
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice"}},
+        })
+        api.add_node("n2", {
+            "metadata": {"name": "n2", "annotations": {}, "labels": {
+                "cloud.google.com/gke-tpu-accelerator":
+                    "tpu-v5-lite-podslice"}},
+        })
+        api.add_node("n3")  # no label
+        client = KubeClient(url)
+        assert derive_accelerator_type(client, "n1") == "v5p"
+        assert derive_accelerator_type(client, "n2") == "v5e"
+        assert derive_accelerator_type(client, "n3") == ""
+        assert derive_accelerator_type(client, "ghost") == ""
+    finally:
+        api.stop()
