@@ -111,7 +111,7 @@ def derive_accelerator_type(client, node_name: str, node=None) -> str:
             node = client.get_node(node_name)
         except (KubeError, OSError):
             return ""
-    label = (node.get("metadata", {}).get("labels") or {}).get(
+    label = (((node.get("metadata") or {}).get("labels")) or {}).get(
         GKE_TPU_ACCELERATOR_LABEL, ""
     )
     if not label:

@@ -28,6 +28,7 @@ import os
 import queue
 import signal
 import sys
+import time
 from typing import List, Optional
 
 from ..api import constants
@@ -107,6 +108,9 @@ class Daemon:
         self.dra = None  # set by _start_dra when enabled
         self._kube = None
         self._kube_client = None  # pre-serve client (build_and_serve)
+        # GKE-label-derived chip type (per generation; never written into
+        # cfg so SIGHUP rebuilds re-derive against the current label).
+        self._derived_accelerator_type = ""
         self.metrics_server = None
         if cfg.metrics_port:
             from ..utils.metrics import MetricsServer
@@ -123,7 +127,9 @@ class Daemon:
 
     def discover(self) -> List[TpuChip]:
         chips = self.backend.scan(self.cfg.sysfs_accel_dir, self.cfg.dev_dir)
-        override = self.cfg.accelerator_type
+        override = self.cfg.accelerator_type or getattr(
+            self, "_derived_accelerator_type", ""
+        )
         if override:
             chip_type = parse_gke_accelerator_label(override) or override
             spec = spec_for(chip_type, len(chips))
@@ -152,7 +158,9 @@ class Daemon:
         # the right chip spec in its ResourceSlice too). Soft-fails (no
         # API server in unit environments).
         self._kube_client = None
+        self._derived_accelerator_type = ""  # re-derived every generation
         node_obj = None
+        node_name = self.cfg.node_name or os.uname().nodename
         if self.cfg.enable_controller or self.cfg.enable_dra:
             try:
                 from ..kube.client import KubeClient
@@ -160,28 +168,47 @@ class Daemon:
                 self._kube_client = KubeClient.from_env(self.cfg.kubeconfig)
             except Exception as e:
                 log.warning("kube client unavailable pre-serve: %s", e)
-        if self._kube_client is not None:
-            # One node fetch serves both label derivations below.
-            try:
-                node_obj = self._kube_client.get_node(
-                    self.cfg.node_name or os.uname().nodename
-                )
-            except Exception as e:
-                log.debug("node prefetch failed: %s", e)
+        # One node fetch serves both label derivations — but only when a
+        # consumer needs it (an explicit accelerator type AND explicit
+        # slice flags mean zero pre-serve apiserver calls, as before).
+        slice_explicit = (
+            self.cfg.worker_hostnames
+            or self.cfg.worker_id != 0
+            or self.cfg.slice_host_bounds not in ("", "1,1,1")
+        )
+        need_node = not self.cfg.accelerator_type or (
+            self.cfg.enable_controller and not slice_explicit
+        )
+        if self._kube_client is not None and need_node:
+            # A wrong chip spec lives until the next rebuild, so a
+            # transient apiserver blip gets a couple of brief retries.
+            for attempt in range(3):
+                try:
+                    node_obj = self._kube_client.get_node(node_name)
+                    break
+                except Exception as e:
+                    if attempt == 2:
+                        log.warning(
+                            "node prefetch failed (%s); GKE label "
+                            "derivations skipped this generation", e,
+                        )
+                    else:
+                        time.sleep(0.5 * (attempt + 1))
         if not self.cfg.accelerator_type and node_obj is not None:
             try:
                 from ..kube.gke import derive_accelerator_type
 
                 derived = derive_accelerator_type(
-                    self._kube_client,
-                    self.cfg.node_name or os.uname().nodename,
-                    node=node_obj,
+                    None, node_name, node=node_obj
                 )
                 if derived:
                     log.info(
                         "accelerator type from GKE node label: %s", derived
                     )
-                    self.cfg.accelerator_type = derived
+                    # Kept OUT of cfg so a SIGHUP rebuild re-derives
+                    # against the current label instead of freezing the
+                    # first answer (discover() reads the fallback).
+                    self._derived_accelerator_type = derived
             except Exception as e:
                 log.warning("accelerator label derivation failed: %s", e)
         chips = self.discover()

@@ -151,3 +151,62 @@ def test_derive_accelerator_type_from_node_label():
         assert derive_accelerator_type(client, "ghost") == ""
     finally:
         api.stop()
+
+
+def test_daemon_derives_label_before_discovery(tmp_path):
+    """The behavioral core: with --accelerator-type unset, the daemon
+    derives the chip type from the GKE node label BEFORE discovery, so
+    the discovered chips carry the label's spec (the fake sysfs node's
+    PCI identity says v5e; the label says v5p and must win). The derived
+    value lives outside cfg so a rebuild re-derives it."""
+    import threading
+    import time as _time
+
+    from tests import fakes
+    from tests.fake_apiserver import FakeApiServer
+    from tests.fake_kubelet import FakeKubelet
+    from k8s_device_plugin_tpu.supervisor.main import Daemon, DaemonConfig
+
+    NODE = "gke-derive-node"
+    api = FakeApiServer()
+    url = api.start()
+    api.add_node(NODE, {
+        "metadata": {"name": NODE, "annotations": {}, "labels": {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice"}},
+    })
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(
+        "apiVersion: v1\nkind: Config\ncurrent-context: c\n"
+        "contexts: [{name: c, context: {cluster: cl, user: u}}]\n"
+        f"clusters: [{{name: cl, cluster: {{server: \"{url}\"}}}}]\n"
+        "users: [{name: u, user: {token: t}}]\n"
+    )
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 4)
+    dp_dir = tmp_path / "dp"
+    dp_dir.mkdir()
+    kubelet = FakeKubelet(str(dp_dir))
+    kubelet.start()
+    daemon = Daemon(DaemonConfig(
+        node_name=NODE, device_plugin_dir=str(dp_dir),
+        sysfs_accel_dir=accel, dev_dir=dev, libtpu_host_path="",
+        kubeconfig=str(kubeconfig), prefer_native_backend=False,
+        podresources_socket="",
+        accelerator_type="",  # must not inherit $TPU_ACCELERATOR_TYPE
+    ))
+    t = threading.Thread(target=daemon.run, daemon=True)
+    t.start()
+    try:
+        assert kubelet.registered.wait(15)
+        deadline = _time.time() + 10
+        while daemon.plugin is None and _time.time() < deadline:
+            _time.sleep(0.1)
+        assert daemon.plugin.mesh.spec.chip_type == "v5p"
+        assert daemon._derived_accelerator_type == "v5p"
+        assert daemon.cfg.accelerator_type == ""  # NOT frozen into cfg
+    finally:
+        import signal as _signal
+
+        daemon.events.put(("signal", _signal.SIGTERM))
+        t.join(timeout=25)
+        kubelet.stop()
+        api.stop()
