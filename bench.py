@@ -198,10 +198,17 @@ def main() -> int:
         result["detail"]["workload"] = smoke
 
         if cp is not None and "error" not in smoke:
+            # time_to_ready excludes the (inner_steps-1) real training
+            # steps the first device-side dispatch performs after the
+            # first optimizer step — those are throughput, not readiness
+            # (see workload/smoke.py). Older reports lack the field.
+            ready = smoke.get(
+                "time_to_ready_s", smoke["time_to_first_step_s"]
+            )
             value = (
                 cp["t_allocate_s"]
                 + smoke["time_to_devices_s"]
-                + smoke["time_to_first_step_s"]
+                + ready
             )
         elif cp is not None:
             # Partial: control plane succeeded, accelerator didn't — emit

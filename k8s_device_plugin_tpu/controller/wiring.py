@@ -150,6 +150,17 @@ class TopologyPublisher:
                 log.warning("topology republish failed: %s", e)
 
 
+def slice_config_is_explicit(cfg) -> bool:
+    """True when the operator set slice membership by flag — derivation
+    must never override it. One definition, shared by the supervisor's
+    node-prefetch gate and maybe_derive_slice_config below."""
+    return bool(
+        cfg.worker_hostnames
+        or cfg.worker_id != 0
+        or cfg.slice_host_bounds not in ("", "1,1,1")
+    )
+
+
 def maybe_derive_slice_config(
     client: KubeClient, cfg, mesh: IciMesh, node: Optional[dict] = None
 ) -> None:
@@ -159,12 +170,7 @@ def maybe_derive_slice_config(
     Allocate exports these to containers (server/plugin.py _tpu_env), so
     deriving after serve would race the kubelet's first Allocate.
     ``node`` (prefetched) avoids a second get_node round trip."""
-    explicitly_configured = (
-        cfg.worker_hostnames
-        or cfg.worker_id != 0
-        or cfg.slice_host_bounds not in ("", "1,1,1")
-    )
-    if explicitly_configured or not mesh.mesh_chips:
+    if slice_config_is_explicit(cfg) or not mesh.mesh_chips:
         return
     from ..kube.gke import derive_slice_membership
 

@@ -127,8 +127,8 @@ class Daemon:
 
     def discover(self) -> List[TpuChip]:
         chips = self.backend.scan(self.cfg.sysfs_accel_dir, self.cfg.dev_dir)
-        override = self.cfg.accelerator_type or getattr(
-            self, "_derived_accelerator_type", ""
+        override = (
+            self.cfg.accelerator_type or self._derived_accelerator_type
         )
         if override:
             chip_type = parse_gke_accelerator_label(override) or override
@@ -158,7 +158,6 @@ class Daemon:
         # the right chip spec in its ResourceSlice too). Soft-fails (no
         # API server in unit environments).
         self._kube_client = None
-        self._derived_accelerator_type = ""  # re-derived every generation
         node_obj = None
         node_name = self.cfg.node_name or os.uname().nodename
         if self.cfg.enable_controller or self.cfg.enable_dra:
@@ -171,13 +170,11 @@ class Daemon:
         # One node fetch serves both label derivations — but only when a
         # consumer needs it (an explicit accelerator type AND explicit
         # slice flags mean zero pre-serve apiserver calls, as before).
-        slice_explicit = (
-            self.cfg.worker_hostnames
-            or self.cfg.worker_id != 0
-            or self.cfg.slice_host_bounds not in ("", "1,1,1")
-        )
+        from ..controller.wiring import slice_config_is_explicit
+
         need_node = not self.cfg.accelerator_type or (
-            self.cfg.enable_controller and not slice_explicit
+            self.cfg.enable_controller
+            and not slice_config_is_explicit(self.cfg)
         )
         if self._kube_client is not None and need_node:
             # A wrong chip spec lives until the next rebuild, so a
@@ -205,10 +202,13 @@ class Daemon:
                     log.info(
                         "accelerator type from GKE node label: %s", derived
                     )
-                    # Kept OUT of cfg so a SIGHUP rebuild re-derives
-                    # against the current label instead of freezing the
-                    # first answer (discover() reads the fallback).
-                    self._derived_accelerator_type = derived
+                # Kept OUT of cfg so a SIGHUP rebuild re-derives against
+                # the current label instead of freezing the first answer
+                # (discover() reads this field). Updated — including
+                # cleared — only on a SUCCESSFUL fetch: a rebuild during
+                # an apiserver outage keeps the previous generation's
+                # answer rather than regressing to PCI detection.
+                self._derived_accelerator_type = derived
             except Exception as e:
                 log.warning("accelerator label derivation failed: %s", e)
         chips = self.discover()

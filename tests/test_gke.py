@@ -210,3 +210,21 @@ def test_daemon_derives_label_before_discovery(tmp_path):
         t.join(timeout=25)
         kubelet.stop()
         api.stop()
+
+
+def test_derived_type_survives_rebuild_during_outage(tmp_path):
+    """A rebuild while the apiserver is down must keep the previous
+    generation's derived accelerator type rather than regressing to PCI
+    detection; a later successful fetch without the label clears it."""
+    from k8s_device_plugin_tpu.supervisor.main import Daemon, DaemonConfig
+    from tests import fakes
+
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 4)
+    d = Daemon(DaemonConfig(
+        sysfs_accel_dir=accel, dev_dir=dev, libtpu_host_path="",
+        prefer_native_backend=False, accelerator_type="",
+    ))
+    d._derived_accelerator_type = "v5p"  # generation 1 derived it
+    # Outage path: discover() must still honor the surviving derivation.
+    chips = d.discover()
+    assert chips[0].chip_type == "v5p"

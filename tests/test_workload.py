@@ -126,6 +126,9 @@ def test_run_smoke_multi_step_cpu_mesh():
     assert report["inner_steps"] == 2
     assert report["first_loss_sane"]
     assert report["loss_decreased"]
+    # Readiness excludes the first dispatch's extra (inner_steps-1)
+    # steady-state steps; never negative, never more than the raw number.
+    assert 0 <= report["time_to_ready_s"] <= report["time_to_first_step_s"]
 
 
 def test_multi_train_step_matches_plain_step():
