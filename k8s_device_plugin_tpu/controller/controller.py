@@ -300,10 +300,14 @@ class Controller:
                     log.info("watch expired; relisting")
                     resource_version = ""
                 else:
-                    log.warning("watch error: %s", e)
+                    if not self._stop.is_set():
+                        log.warning("watch error: %s", e)
                     self._stop.wait(2.0)
             except OSError as e:
-                log.warning("watch connection error: %s", e)
+                # A connection error AFTER stop() is the expected shape of
+                # teardown (the apiserver/fake is gone) — not warn-worthy.
+                if not self._stop.is_set():
+                    log.warning("watch connection error: %s", e)
                 self._stop.wait(2.0)
 
     def _enqueue(self, etype: str, pod: dict, retries: int = 0) -> None:
