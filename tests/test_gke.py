@@ -228,3 +228,29 @@ def test_derived_type_survives_rebuild_during_outage(tmp_path):
     # Outage path: discover() must still honor the surviving derivation.
     chips = d.discover()
     assert chips[0].chip_type == "v5p"
+
+
+def test_derive_membership_through_real_rest_client():
+    """The derivation path over the real KubeClient + fake apiserver —
+    including the labelSelector round trip the stub client only
+    simulates: two labeled pool nodes, the daemon's node is worker 1."""
+    from tests.fake_apiserver import FakeApiServer
+    from k8s_device_plugin_tpu.kube.client import KubeClient
+
+    api = FakeApiServer()
+    url = api.start()
+    try:
+        api.add_node("gke-a", gke_node("gke-a", "tpu-vm-w-0"))
+        api.add_node("gke-b", gke_node("gke-b", "tpu-vm-w-1"))
+        # A node from another pool must be filtered out by the selector.
+        api.add_node(
+            "other", gke_node("other", "x-w-0", pool="different-pool")
+        )
+        client = KubeClient(url)
+        m = derive_slice_membership(client, "gke-b", (2, 2, 1))
+        assert m is not None
+        assert m.worker_id == 1
+        assert m.worker_hostnames == "tpu-vm-w-0,tpu-vm-w-1"
+        assert m.slice_host_bounds == "1,1,2"
+    finally:
+        api.stop()
