@@ -17,7 +17,7 @@ import time
 from typing import Optional, Tuple
 
 from ..api import constants
-from ..kube.client import KubeClient, KubeError
+from ..kube.client import KubeClient, KubeError, rfc3339_now
 from ..topology.mesh import IciMesh
 from ..topology.schema import NodeTopology
 from .controller import Controller
@@ -90,6 +90,7 @@ class TopologyPublisher:
         plugin,
         numa_nodes: int = 1,
         debounce_s: float = 0.3,
+        heartbeat_s: float = 300.0,
         numa_info=None,
         worker_id: int = 0,
         worker_hostnames: str = "",
@@ -101,6 +102,7 @@ class TopologyPublisher:
         self.plugin = plugin
         self.numa_nodes = numa_nodes
         self.debounce_s = debounce_s
+        self.heartbeat_s = heartbeat_s
         self.numa_info = numa_info
         self.worker_id = worker_id
         self.worker_hostnames = worker_hostnames
@@ -108,6 +110,12 @@ class TopologyPublisher:
         self.host_info = host_info
         self._dirty = threading.Event()
         self._stop = threading.Event()
+        # Serializes publish_now between the publisher thread and direct
+        # callers (the startup publish), so condition-cache reads/writes
+        # and the patches themselves can't interleave out of order.
+        self._publish_lock = threading.Lock()
+        # Last-written TPUChipsHealthy state (publish_tpu_condition cache).
+        self._condition_cache: dict = {}
         self._thread = threading.Thread(
             target=self._run, name="topology-publisher", daemon=True
         )
@@ -124,30 +132,110 @@ class TopologyPublisher:
         self._dirty.set()
 
     def publish_now(self) -> None:
-        publish_node_topology(
-            self.client,
-            self.node_name,
-            self.plugin.mesh,
-            numa_nodes=self.numa_nodes,
-            available=self.plugin.state.available(),
-            numa_info=self.numa_info,
-            worker_id=self.worker_id,
-            worker_hostnames=self.worker_hostnames,
-            slice_host_bounds=self.slice_host_bounds,
-            host_info=self.host_info,
-        )
+        with self._publish_lock:
+            publish_node_topology(
+                self.client,
+                self.node_name,
+                self.plugin.mesh,
+                numa_nodes=self.numa_nodes,
+                available=self.plugin.state.available(),
+                numa_info=self.numa_info,
+                worker_id=self.worker_id,
+                worker_hostnames=self.worker_hostnames,
+                slice_host_bounds=self.slice_host_bounds,
+                host_info=self.host_info,
+            )
+            # The health condition rides the same serialized publish:
+            # availability changes (allocation AND health transitions)
+            # trigger it, and the retry loop in _run heals transient
+            # apiserver failures for both.
+            publish_tpu_condition(
+                self.client, self.node_name, self.plugin,
+                cache=self._condition_cache,
+            )
 
     def _run(self) -> None:
+        backoff = 1.0
         while not self._stop.is_set():
-            self._dirty.wait()
+            # Timed wait = heartbeat: an idle node still republishes every
+            # heartbeat_s, advancing the condition's lastHeartbeatTime so
+            # tooling can treat a STALE heartbeat as "plugin dead, health
+            # unknown" (the node-problem-detector contract).
+            triggered = self._dirty.wait(timeout=self.heartbeat_s)
             if self._stop.is_set():
                 return
-            self._stop.wait(self.debounce_s)  # coalesce bursts
+            if triggered:
+                self._stop.wait(self.debounce_s)  # coalesce bursts
             self._dirty.clear()
             try:
                 self.publish_now()
+                backoff = 1.0
             except Exception as e:
-                log.warning("topology republish failed: %s", e)
+                # A dropped publish would leave a stale condition or
+                # availability annotation until the NEXT change — retry.
+                log.warning(
+                    "node publish failed (retry in %.0fs): %s", backoff, e
+                )
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 30.0)
+                self._dirty.set()
+
+
+TPU_CONDITION_TYPE = "TPUChipsHealthy"
+
+
+def publish_tpu_condition(
+    client: KubeClient, node_name: str, plugin, cache: Optional[dict] = None
+) -> None:
+    """Surface chip health as a node status condition — the
+    node-problem-detector pattern: cluster tooling (alerts, autorepair,
+    taints-by-condition) reads conditions, not custom annotations.
+
+    lastTransitionTime is preserved when the status is UNCHANGED from
+    the published condition: a daemon restart, or one of several broken
+    chips recovering, must not reset "False for > X minutes" alert
+    clocks. ``cache`` (a dict the caller owns) remembers what was last
+    written so steady-state publishes skip the read round trip; the
+    first publish (empty cache) reads the existing condition from the
+    node. The heartbeat advances on every publish."""
+    unhealthy = sorted(plugin.state.unhealthy)
+    status = "False" if unhealthy else "True"
+    now = rfc3339_now()
+    transition_time = now
+    if cache is not None and cache.get("status") == status:
+        transition_time = cache["transition_time"]
+    elif cache is None or not cache:
+        try:
+            node = client.get_node(node_name)
+            for c in (node.get("status") or {}).get("conditions") or []:
+                if (
+                    c.get("type") == TPU_CONDITION_TYPE
+                    and c.get("status") == status
+                    and c.get("lastTransitionTime")
+                ):
+                    transition_time = c["lastTransitionTime"]
+                    break
+        except (KubeError, OSError):
+            pass  # unreadable: a fresh transition stamp is the default
+    client.patch_node_condition(
+        node_name,
+        {
+            "type": TPU_CONDITION_TYPE,
+            "status": status,
+            "reason": "ChipsUnhealthy" if unhealthy else "AllChipsHealthy",
+            "message": (
+                f"unhealthy TPU chips: {', '.join(unhealthy)}"
+                if unhealthy
+                else f"all {len(plugin.mesh.mesh_chips)} TPU chips healthy"
+            ),
+            "lastHeartbeatTime": now,
+            "lastTransitionTime": transition_time,
+        },
+    )
+    if cache is not None:
+        cache["status"] = status
+        cache["transition_time"] = transition_time
 
 
 def slice_config_is_explicit(cfg) -> bool:
@@ -246,6 +334,8 @@ def start_kube_integration(
             )
         except (KubeError, OSError) as e:
             log.warning("event emit failed: %s", e)
+        # The TPUChipsHealthy condition follows via the publisher thread:
+        # notify_health also fires on_availability_change → trigger.
         if not healthy:
             controller.on_chip_unhealthy(chip_id)
 
@@ -253,8 +343,17 @@ def start_kube_integration(
     controller.publisher = publisher  # stopped with the controller
     controller.start()  # rebuilds allocation state from the checkpoint
     # Authoritative initial publish AFTER the rebuild, so a restarted
-    # daemon never advertises chips that running pods already hold.
-    publisher.publish_now()
+    # daemon never advertises chips that running pods already hold. A
+    # failure here (apiserver blip, stale RBAC during a rolling upgrade)
+    # must not take down the whole kube integration — the publisher
+    # thread retries it.
+    try:
+        publisher.publish_now()
+    except Exception as e:
+        log.warning(
+            "initial node publish failed (retrying in background): %s", e
+        )
+        publisher.trigger()
     # Transitions that fired before the hook attached (the health
     # watcher's pre-serve sweep) still get their pods evicted.
     controller.evict_unhealthy_now()

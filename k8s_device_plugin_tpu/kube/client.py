@@ -36,6 +36,16 @@ MERGE_PATCH = "application/merge-patch+json"
 STRATEGIC_MERGE_PATCH = "application/strategic-merge-patch+json"
 
 
+def rfc3339_now() -> str:
+    """UTC timestamp in the second-precision RFC3339 form the API server
+    uses for event and condition times."""
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
 class KubeError(Exception):
     def __init__(self, status_code: int, message: str):
         super().__init__(f"HTTP {status_code}: {message}")
@@ -190,6 +200,16 @@ class KubeClient:
     ) -> dict:
         return self.patch(f"/api/v1/nodes/{name}", {"metadata": {"labels": labels}})
 
+    def patch_node_condition(self, name: str, condition: dict) -> dict:
+        """Set one condition in node status (strategic merge keys
+        conditions by ``type`` on real API servers) — the
+        node-problem-detector pattern for surfacing hardware state to
+        cluster tooling without custom annotation scraping."""
+        return self.patch(
+            f"/api/v1/nodes/{name}/status",
+            {"status": {"conditions": [condition]}},
+        )
+
     # -- pods --------------------------------------------------------------
 
     def list_pods(
@@ -264,12 +284,7 @@ class KubeClient:
     ) -> dict:
         """Emit a core/v1 Event (the reference wires a broadcaster but never
         emits one, /root/reference/controller.go:76-80)."""
-        import datetime
-
-        now = (
-            datetime.datetime.now(datetime.timezone.utc)
-            .strftime("%Y-%m-%dT%H:%M:%SZ")
-        )
+        now = rfc3339_now()
         body = {
             "metadata": {"generateName": f"{component}."},
             "involvedObject": involved_object,

@@ -227,6 +227,12 @@ class FakeApiServer:
                     server._patch_pod(self, parts[3], parts[5], body)
                 elif len(parts) == 4 and parts[2] == "nodes":
                     server._patch_node(self, parts[3], body)
+                elif (
+                    len(parts) == 5
+                    and parts[2] == "nodes"
+                    and parts[4] == "status"
+                ):
+                    server._patch_node_status(self, parts[3], body)
                 else:
                     self.send_error(404)
 
@@ -375,6 +381,30 @@ class FakeApiServer:
             self.pod_patches.append((ns, name, body))
             self._broadcast("MODIFIED", pod)
         self._send_json(handler, pod)
+
+    def _patch_node_status(self, handler, name, body):
+        """Strategic merge of status.conditions, keyed by type (the real
+        apiserver's patchMergeKey for node conditions)."""
+        with self._lock:
+            node = self.nodes.get(name)
+            if node is None:
+                self._send_json(
+                    handler, {"message": f"node {name} not found"}, 404
+                )
+                return
+            conditions = node.setdefault("status", {}).setdefault(
+                "conditions", []
+            )
+            for incoming in (body.get("status") or {}).get(
+                "conditions", []
+            ):
+                for existing in conditions:
+                    if existing.get("type") == incoming.get("type"):
+                        existing.update(incoming)
+                        break
+                else:
+                    conditions.append(dict(incoming))
+        self._send_json(handler, node)
 
     def _patch_node(self, handler, name, body):
         with self._lock:

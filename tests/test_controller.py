@@ -765,3 +765,99 @@ def test_late_reconciled_pod_still_evicted(api, plugin, tmp_path):
         assert wait_for(lambda: ("default", "late") in server.evictions)
     finally:
         ctrl.stop()
+
+
+# ---------------------------------------------------------------------------
+# Node condition (TPUChipsHealthy)
+# ---------------------------------------------------------------------------
+
+def test_node_condition_tracks_chip_health(api, plugin):
+    """Chip health surfaces as a node status condition (the
+    node-problem-detector pattern): False with the broken chips named,
+    back to True on recovery, merged by type."""
+    from k8s_device_plugin_tpu.controller.wiring import (
+        TPU_CONDITION_TYPE,
+        publish_tpu_condition,
+    )
+
+    server, client = api
+    ids = plugin.mesh.ids
+    publish_tpu_condition(client, NODE, plugin)
+    conds = server.nodes[NODE]["status"]["conditions"]
+    assert len(conds) == 1
+    assert conds[0]["type"] == TPU_CONDITION_TYPE
+    assert conds[0]["status"] == "True"
+    assert "all 4" in conds[0]["message"]
+
+    plugin.state.set_health(ids[0], healthy=False)
+    publish_tpu_condition(client, NODE, plugin)
+    conds = server.nodes[NODE]["status"]["conditions"]
+    assert len(conds) == 1  # merged by type, not appended
+    assert conds[0]["status"] == "False"
+    assert ids[0] in conds[0]["message"]
+    assert conds[0]["reason"] == "ChipsUnhealthy"
+
+    plugin.state.set_health(ids[0], healthy=True)
+    publish_tpu_condition(client, NODE, plugin)
+    conds = server.nodes[NODE]["status"]["conditions"]
+    assert conds[0]["status"] == "True"
+
+
+def test_node_condition_preserves_transition_time(api, plugin):
+    """Re-publishing an UNCHANGED status (daemon restart; one of several
+    broken chips recovering) keeps lastTransitionTime — alert clocks keyed
+    on 'False for > X minutes' must not reset — while the heartbeat
+    advances on every publish."""
+    from k8s_device_plugin_tpu.controller.wiring import (
+        publish_tpu_condition,
+    )
+
+    server, client = api
+    ids = plugin.mesh.ids
+    plugin.state.set_health(ids[0], healthy=False)
+    plugin.state.set_health(ids[1], healthy=False)
+    publish_tpu_condition(client, NODE, plugin)
+    # Simulate a later republish with the same status (chip 1 recovered,
+    # chip 0 still broken — still False overall).
+    server.nodes[NODE]["status"]["conditions"][0]["lastTransitionTime"] = (
+        "2026-01-01T00:00:00Z"
+    )
+    plugin.state.set_health(ids[1], healthy=True)
+    publish_tpu_condition(client, NODE, plugin)
+    cond = server.nodes[NODE]["status"]["conditions"][0]
+    assert cond["status"] == "False"
+    assert cond["lastTransitionTime"] == "2026-01-01T00:00:00Z"  # kept
+    assert ids[1] not in cond["message"]
+    # A real flip stamps a new transition time.
+    plugin.state.set_health(ids[0], healthy=True)
+    publish_tpu_condition(client, NODE, plugin)
+    cond = server.nodes[NODE]["status"]["conditions"][0]
+    assert cond["status"] == "True"
+    assert cond["lastTransitionTime"] != "2026-01-01T00:00:00Z"
+
+
+def test_publisher_heartbeats_when_idle(api, plugin):
+    """An idle node still republishes on the heartbeat interval so the
+    condition's lastHeartbeatTime advances — tooling can treat a stale
+    heartbeat as 'plugin dead, health unknown'."""
+    from k8s_device_plugin_tpu.controller.wiring import TopologyPublisher
+
+    server, client = api
+    pub = TopologyPublisher(
+        client, NODE, plugin, debounce_s=0.05, heartbeat_s=0.3
+    )
+    pub.start()
+    try:
+        # No trigger at all: the timed wait alone must publish.
+        assert wait_for(
+            lambda: (server.nodes[NODE].get("status") or {}).get(
+                "conditions"
+            ),
+            timeout=5,
+        )
+        n_patches = len(server.node_patches)
+        assert wait_for(
+            lambda: len(server.node_patches) > n_patches, timeout=5
+        )  # a second heartbeat cycle republished
+    finally:
+        pub.stop()
