@@ -154,6 +154,17 @@ class TopologyPublisher:
                 cache=self._condition_cache,
             )
 
+    def publish_heartbeat(self) -> None:
+        """Condition-only publish: advances lastHeartbeatTime without the
+        annotation/label patches (nothing else changed on an idle node —
+        two extra node-object writes per cycle would wake every node
+        watcher in the cluster for no information)."""
+        with self._publish_lock:
+            publish_tpu_condition(
+                self.client, self.node_name, self.plugin,
+                cache=self._condition_cache,
+            )
+
     def _run(self) -> None:
         backoff = 1.0
         while not self._stop.is_set():
@@ -168,7 +179,10 @@ class TopologyPublisher:
                 self._stop.wait(self.debounce_s)  # coalesce bursts
             self._dirty.clear()
             try:
-                self.publish_now()
+                if triggered:
+                    self.publish_now()
+                else:
+                    self.publish_heartbeat()
                 backoff = 1.0
             except Exception as e:
                 # A dropped publish would leave a stale condition or

@@ -27,6 +27,7 @@ class FakeApiServer:
         self.resourceclaims: Dict[Tuple[str, str], dict] = {}
         self.pod_patches: List[Tuple[str, str, dict]] = []
         self.node_patches: List[Tuple[str, dict]] = []
+        self.node_status_patches: List[Tuple[str, dict]] = []
         self.events: List[dict] = []
         self.evictions: List[Tuple[str, str]] = []
         # True = answer evictions with 429 (PodDisruptionBudget blocked).
@@ -404,6 +405,7 @@ class FakeApiServer:
                         break
                 else:
                     conditions.append(dict(incoming))
+            self.node_status_patches.append((name, body))
         self._send_json(handler, node)
 
     def _patch_node(self, handler, name, body):

@@ -270,3 +270,18 @@ def test_generation_smoke_skips_kv_for_unsupported_configs():
     report = run_generation_smoke(cfg, batch=1, prompt_len=4, steps=4)
     assert report["prompt_preserved"]
     assert "kv_prefill_logits_maxdiff" not in report
+
+
+def test_bench_report_parsing_schema_guarded():
+    """bench takes the LAST stdout line that is actually a smoke report
+    (has 'ok'), so stray JSON log lines after it can't shadow the
+    measurements — and non-report-only output parses to None."""
+    import bench
+
+    real = '{"ok": true, "time_to_devices_s": 1.0, "mfu": 0.5}'
+    stray = '{"status": "tunnel reconnected"}'
+    out = f"compile log line\n{real}\n{stray}\n"
+    got = bench.parse_smoke_report(out)
+    assert got is not None and got["mfu"] == 0.5
+    assert bench.parse_smoke_report(f"{stray}\nnoise\n") is None
+    assert bench.parse_smoke_report("") is None
