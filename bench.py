@@ -1,4 +1,5 @@
-"""Benchmark: the BASELINE north star, measured end to end, plus MFU.
+"""Benchmark: the BASELINE north star, measured end to end, plus MFU and
+kernel microbenchmarks.
 
 BASELINE.md target: a pod requesting ``google.com/tpu`` has its chips
 allocated and ``jax.devices()`` returning them, first step running, within
@@ -7,23 +8,34 @@ allocated and ``jax.devices()`` returning them, first step running, within
   1. fake kubelet + fake TPU node sysfs (the control plane needs no real
      accel devfs — the real chip here is tunnel-attached, not /dev/accel*);
   2. the real device-plugin daemon subprocess: scan → serve → register;
-  3. kubelet-side GetPreferredAllocation + Allocate over the gRPC socket;
+  3. kubelet-side GetPreferredAllocation + Allocate over the gRPC socket —
+     the Allocate response's env is piped into the workload (VERDICT r2
+     #7), so the "pod sees exactly what was allocated" check is real;
   4. JAX init on the real accelerator and the smoke workload's first
-     sharded train step (compile included) + sustained steps, on the
-     MXU-stressing bench model (ModelConfig.bench()), reporting MFU
-     against the chip generation's published bf16 peak.
+     sharded train step (compile included) + sustained steps, reporting
+     MFU against the chip generation's published bf16 peak;
+  5. kernel microbench (flash attention / rmsnorm vs their XLA-dense
+     baselines) if budget remains (VERDICT r2 #4).
 
-Hardening (VERDICT r1 #1): the workload side runs in a SUBPROCESS with a
-hard timeout and retries with backoff — a hung or unavailable accelerator
-backend can stall jax.devices() indefinitely (observed in round 1), and
-that must never cost the JSON line. On any workload failure the bench
-still prints the one JSON line carrying the control-plane timings plus an
-``error`` field, and exits 0.
+Survivability (VERDICT r2 #1 — two rounds of rc=124 taught this shape):
+  - The JSON result line is printed and flushed after EVERY completed
+    phase, not once at the end. The driver parses the tail; the last
+    complete line wins, so a kill mid-workload still leaves the
+    control-plane numbers, and a kill mid-kernels still leaves MFU.
+  - Total accelerator budget is hard-capped (default 230 s, env
+    ``BENCH_TOTAL_BUDGET_S``) — far below any plausible driver timeout.
+    One smoke attempt plus at most one short retry, each a subprocess
+    with its own timeout (a wedged PJRT client can stall jax.devices()
+    indefinitely; kill-and-move-on is the only reliable containment).
+  - The bench's own process never touches jax: all accelerator work is
+    in subprocesses.
 
-Prints ONE JSON line:
+Prints ONE JSON line per completed phase (same schema, monotonically
+more complete):
   metric   time_to_first_device_s (daemon start → first train step done)
   vs_baseline  30 / value  (>1 means faster than the 30 s target)
   detail.workload.mfu   model FLOPs/step ÷ step time ÷ chip peak bf16
+  detail.kernels        flash/rmsnorm vs XLA-dense comparisons
 """
 
 from __future__ import annotations
@@ -40,13 +52,24 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 BASELINE_S = 30.0
-WORKLOAD_TIMEOUT_S = float(os.environ.get("BENCH_WORKLOAD_TIMEOUT_S", "900"))
-WORKLOAD_ATTEMPTS = int(os.environ.get("BENCH_WORKLOAD_ATTEMPTS", "3"))
-BACKOFF_S = 10.0
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "230"))
+SMOKE_TIMEOUT_S = float(os.environ.get("BENCH_WORKLOAD_TIMEOUT_S", "140"))
+RETRY_TIMEOUT_S = float(os.environ.get("BENCH_RETRY_TIMEOUT_S", "60"))
+_T_START = time.monotonic()
+
+
+def _budget_left() -> float:
+    return TOTAL_BUDGET_S - (time.monotonic() - _T_START)
 
 
 def control_plane_allocation(root: str) -> dict:
-    """Fake node + real daemon subprocess; returns timing + allocation."""
+    """Fake node + real daemon subprocess; returns timing + allocation.
+
+    GetPreferredAllocation is exercised for the full 4-chip host (the
+    sub-mesh placement policy), then ONE chip is actually allocated —
+    matching the single tunnel-attached chip the workload will see, so
+    the Allocate env can be piped through honestly.
+    """
     from tests import fakes
     from tests.fake_kubelet import FakeKubelet
     from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
@@ -83,19 +106,29 @@ def control_plane_allocation(root: str) -> dict:
         stub = kubelet.plugin_stub()
         lw = next(iter(stub.ListAndWatch(pb.Empty())))
         ids = [d.ID for d in lw.devices]
-        req = pb.PreferredAllocationRequest()
-        req.container_requests.add(available_deviceIDs=ids, allocation_size=4)
-        pref = list(
-            stub.GetPreferredAllocation(req).container_responses[0].deviceIDs
+        # Full-host preferred allocation: the placement policy the
+        # reference's findNGPUDevice analog provides (timed, recorded).
+        req4 = pb.PreferredAllocationRequest()
+        req4.container_requests.add(available_deviceIDs=ids, allocation_size=4)
+        pref4 = list(
+            stub.GetPreferredAllocation(req4).container_responses[0].deviceIDs
+        )
+        # The allocation that actually backs the workload: one chip,
+        # like the attached rig.
+        req1 = pb.PreferredAllocationRequest()
+        req1.container_requests.add(available_deviceIDs=ids, allocation_size=1)
+        pref1 = list(
+            stub.GetPreferredAllocation(req1).container_responses[0].deviceIDs
         )
         areq = pb.AllocateRequest()
-        areq.container_requests.add(devicesIDs=pref)
+        areq.container_requests.add(devicesIDs=pref1)
         resp = stub.Allocate(areq).container_responses[0]
         t_alloc = time.monotonic() - t0
         return {
             "t_register_s": t_register,
             "t_allocate_s": t_alloc,
             "devices": len(resp.devices),
+            "preferred_4": pref4,
             "env": dict(resp.envs),
         }
     finally:
@@ -104,83 +137,136 @@ def control_plane_allocation(root: str) -> dict:
         kubelet.stop()
 
 
-def parse_smoke_report(stdout: str):
-    """The last JSON line on stdout that actually IS the smoke report
-    (schema-guarded on the 'ok' key): tunnel/compile helpers can emit
-    stray JSON lines after it, and taking any parseable line would let a
-    stray one silently shadow the real measurements. None if absent."""
+def parse_json_report(stdout: str, key: str = "ok"):
+    """The last JSON line on stdout that actually IS the report
+    (schema-guarded on ``key``): tunnel/compile helpers can emit stray
+    JSON lines after it, and taking any parseable line would let a stray
+    one silently shadow the real measurements. None if absent."""
     for line in reversed(stdout.strip().splitlines()):
         try:
             report = json.loads(line)
         except (json.JSONDecodeError, ValueError):
             continue
-        if isinstance(report, dict) and "ok" in report:
+        if isinstance(report, dict) and key in report:
             return report
     return None
 
 
-def run_workload_subprocess() -> dict:
-    """The accelerator side, isolated: retries with backoff, hard timeout.
-
-    Returns the smoke report dict, or {"error": ...} — never raises and
-    never hangs (round 1 died inside jax.devices(); a subprocess + kill is
-    the only reliable containment for a wedged PJRT client).
-    """
-    last_err = "unknown"
-    for attempt in range(WORKLOAD_ATTEMPTS):
-        if attempt:
-            time.sleep(BACKOFF_S * attempt)
-        t0 = time.monotonic()
-        try:
-            workload_args = os.environ.get(
-                "BENCH_WORKLOAD_ARGS",
-                # batch 4: batch 6 is silently MIScompiled for the scanned
-                # bench model by the remote chipless compile helper (loss
-                # below the uniform-target entropy floor; caught by the
-                # first_loss_sane check) and batch 8 crashes it. inner 40
-                # amortizes per-dispatch/per-buffer link overhead (see
-                # make_multi_train_step): ~0.50 MFU warm-cache / 151 ms
-                # per step on v5e; inner 80 measures ~0.52 warm but its
-                # longer windows absorb more shared-chip contention when
-                # cold, so 40 is the robust default.
-                "--bench --steps 80 --batch-per-device 4 --inner-steps 40",
-            ).split()
-            env = dict(os.environ)
-            # Persistent compile cache (works through remote-compile
-            # backends too): cold first run pays the compile once, retries
-            # and later rounds start ~8 s faster and measure steadier.
-            env.setdefault(
-                "TPU_WORKLOAD_COMPILATION_CACHE_DIR",
-                os.path.join(REPO, ".jax_compilation_cache"),
-            )
-            proc = subprocess.run(
-                [
-                    sys.executable, "-m",
-                    "k8s_device_plugin_tpu.workload.smoke",
-                    *workload_args,
-                ],
-                cwd=REPO,
-                capture_output=True,
-                text=True,
-                timeout=WORKLOAD_TIMEOUT_S,
-                env=env,
-            )
-        except subprocess.TimeoutExpired:
-            last_err = (
-                f"workload timed out after {WORKLOAD_TIMEOUT_S:.0f}s "
-                f"(attempt {attempt + 1}/{WORKLOAD_ATTEMPTS})"
-            )
-            continue
-        report = parse_smoke_report(proc.stdout)
-        if report is not None:
-            report["attempt"] = attempt + 1
-            report["workload_wall_s"] = round(time.monotonic() - t0, 3)
-            return report
-        last_err = (
-            f"workload rc={proc.returncode}, no JSON on stdout; "
+def _run_accel_subprocess(args: list, timeout_s: float, extra_env: dict):
+    """One accelerator-side subprocess with a hard timeout. Returns
+    (report_dict_or_None, error_str_or_None)."""
+    env = dict(os.environ)
+    env.update(extra_env)
+    # Persistent compile cache (works through remote-compile backends
+    # too): cold first run pays the compile once, retries and later
+    # rounds start ~8 s faster and measure steadier.
+    env.setdefault(
+        "TPU_WORKLOAD_COMPILATION_CACHE_DIR",
+        os.path.join(REPO, ".jax_compilation_cache"),
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", *args],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        # A streaming subprocess (microbench --stream) may have printed
+        # complete partial reports before the kill — harvest the tail.
+        partial = parse_json_report(
+            e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        )
+        if partial is not None:
+            partial["timed_out_after_s"] = timeout_s
+            return partial, None
+        return None, f"timed out after {timeout_s:.0f}s"
+    report = parse_json_report(proc.stdout)
+    if report is None:
+        return None, (
+            f"rc={proc.returncode}, no JSON on stdout; "
             f"stderr tail: {proc.stderr.strip()[-400:]}"
         )
-    return {"error": last_err}
+    return report, None
+
+
+def run_workload(alloc_env: dict) -> dict:
+    """The smoke workload: one full-length attempt, at most one short
+    retry, all inside the total budget. Never raises, never hangs.
+
+    ``alloc_env``: the Allocate response's env. Only TPU_VISIBLE_CHIPS is
+    applied — on this rig the accelerator is tunnel-attached (PJRT plugin
+    over a relay), so chip-binding vars are not interpreted by the
+    runtime; the chip-COUNT check (pod sees exactly as many devices as
+    were allocated) is the part that carries over, and the report records
+    that scope honestly.
+    """
+    workload_args = os.environ.get(
+        "BENCH_WORKLOAD_ARGS",
+        # batch 4: batch 6 is silently MIScompiled for the scanned
+        # bench model by the remote chipless compile helper (loss
+        # below the uniform-target entropy floor; caught by the
+        # first_loss_sane check) and batch 8 crashes it. inner 40
+        # amortizes per-dispatch/per-buffer link overhead (see
+        # make_multi_train_step): ~0.50 MFU warm-cache / 151 ms
+        # per step on v5e; inner 80 measures ~0.52 warm but its
+        # longer windows absorb more shared-chip contention when
+        # cold, so 40 is the robust default.
+        "--bench --steps 80 --batch-per-device 4 --inner-steps 40",
+    ).split()
+    extra_env = {}
+    applied = []
+    if alloc_env.get("TPU_VISIBLE_CHIPS"):
+        extra_env["TPU_VISIBLE_CHIPS"] = alloc_env["TPU_VISIBLE_CHIPS"]
+        applied = ["TPU_VISIBLE_CHIPS"]
+
+    attempts = []
+    for timeout_s in (SMOKE_TIMEOUT_S, RETRY_TIMEOUT_S):
+        timeout_s = min(timeout_s, _budget_left() - 5)
+        if timeout_s < 20:
+            attempts.append("skipped: budget exhausted")
+            break
+        t0 = time.monotonic()
+        report, err = _run_accel_subprocess(
+            ["k8s_device_plugin_tpu.workload.smoke", *workload_args],
+            timeout_s,
+            extra_env,
+        )
+        if report is not None:
+            report["attempt"] = len(attempts) + 1
+            report["workload_wall_s"] = round(time.monotonic() - t0, 3)
+            report["alloc_env_applied"] = applied
+            report["alloc_env_note"] = (
+                "tunnel-attached PJRT: chip-binding env not interpreted "
+                "by the runtime; device-count check is the live part"
+            )
+            return report
+        attempts.append(err)
+    return {"error": "; ".join(attempts)}
+
+
+def run_kernels() -> dict:
+    """Kernel microbench with whatever budget remains (soft budget inside
+    the subprocess, hard timeout around it)."""
+    budget = _budget_left() - 5
+    if budget < 35:
+        return {"skipped": f"budget exhausted ({budget:.0f}s left)"}
+    kernel_args = os.environ.get("BENCH_KERNEL_ARGS", "").split()
+    report, err = _run_accel_subprocess(
+        [
+            "k8s_device_plugin_tpu.ops.microbench",
+            "--stream",
+            "--budget-s", str(int(budget - 10)),
+            *kernel_args,
+        ],
+        budget,
+        {},
+    )
+    if report is None:
+        return {"error": err}
+    return report
 
 
 def main() -> int:
@@ -192,55 +278,75 @@ def main() -> int:
         "vs_baseline": None,
         "detail": {},
     }
+
+    def emit():
+        print(json.dumps(result), flush=True)
+
     try:
+        # Phase 1: control plane (~3 s, no jax anywhere in-process).
         try:
             cp = control_plane_allocation(root)
             result["detail"]["control_plane"] = {
                 "register_s": round(cp["t_register_s"], 3),
                 "allocate_s": round(cp["t_allocate_s"], 3),
                 "allocated_devices": cp["devices"],
+                "preferred_4_chips": len(cp["preferred_4"]),
             }
+            result["value"] = round(cp["t_allocate_s"], 3)
+            result["detail"]["partial"] = "control_plane_only"
         except Exception as e:  # noqa: BLE001 — the JSON line must survive
             cp = None
             result["detail"]["control_plane"] = {"error": repr(e)[:400]}
+            result["detail"]["partial"] = "control_plane_failed"
+        emit()  # survives any later kill (VERDICT r2 #1)
 
-        smoke = run_workload_subprocess()
+        # Phase 2: the accelerator workload.
+        smoke = run_workload(cp["env"] if cp else {})
         result["detail"]["workload"] = smoke
-
         if cp is not None and "error" not in smoke:
             # time_to_ready excludes the (inner_steps-1) real training
             # steps the first device-side dispatch performs after the
             # first optimizer step — those are throughput, not readiness
-            # (see workload/smoke.py). Older reports lack the field.
-            ready = smoke.get(
-                "time_to_ready_s", smoke["time_to_first_step_s"]
-            )
-            value = (
-                cp["t_allocate_s"]
-                + smoke["time_to_devices_s"]
-                + ready
-            )
+            # (see workload/smoke.py).
+            ready = smoke.get("time_to_ready_s", smoke["time_to_first_step_s"])
+            value = cp["t_allocate_s"] + smoke["time_to_devices_s"] + ready
+            result["value"] = round(value, 3)
+            result["detail"].pop("partial", None)
+            if smoke.get("ok"):
+                result["vs_baseline"] = round(BASELINE_S / max(value, 1e-9), 2)
+                if smoke.get("mfu") is not None:
+                    result["detail"]["mfu"] = smoke["mfu"]
+            else:
+                # The timings are real but the workload's own checks
+                # (device-count match, loss sanity) failed — the timing
+                # stands, the baseline claim does not.
+                failed = [
+                    k for k in
+                    ("devices_match", "first_loss_sane", "loss_decreased")
+                    if smoke.get(k) is False
+                ]
+                result["error"] = (
+                    "workload completed but failed checks: "
+                    + (",".join(failed) or "ok=false")
+                )
         elif cp is not None:
-            # Partial: control plane succeeded, accelerator didn't — emit
-            # the measurable portion rather than nothing (VERDICT r1 #1),
-            # but do NOT claim a vs_baseline ratio: comparing the control
-            # plane alone against the full 30 s end-to-end target would
-            # overstate the result exactly when the chip was unavailable.
-            result["value"] = round(cp["t_allocate_s"], 3)
-            result["vs_baseline"] = None
+            # Partial: control plane succeeded, accelerator didn't — the
+            # control-plane value stands, but do NOT claim a vs_baseline
+            # ratio: comparing the control plane alone against the full
+            # 30 s end-to-end target would overstate the result exactly
+            # when the chip was unavailable.
             result["error"] = smoke.get("error", "workload failed")
-            result["detail"]["partial"] = "control_plane_only"
-            print(json.dumps(result))
-            return 0
         else:
             result["error"] = "control plane failed"
-            print(json.dumps(result))
-            return 0
-        result["value"] = round(value, 3)
-        result["vs_baseline"] = round(BASELINE_S / max(value, 1e-9), 2)
-        if "error" not in smoke and smoke.get("mfu") is not None:
-            result["detail"]["mfu"] = smoke["mfu"]
-        print(json.dumps(result))
+        emit()
+
+        # Phase 3: kernel microbench (VERDICT r2 #4) with leftover budget.
+        result["detail"]["kernels"] = run_kernels()
+        result["detail"]["budget"] = {
+            "total_s": TOTAL_BUDGET_S,
+            "used_s": round(time.monotonic() - _T_START, 1),
+        }
+        emit()
         return 0
     finally:
         shutil.rmtree(root, ignore_errors=True)

@@ -204,3 +204,30 @@ def test_generation_smoke_with_flash_attention():
     assert report["tokens_in_vocab"]
     assert report["prompt_preserved"]
     assert report["flash_attention"]
+
+
+def test_microbench_tiny_shapes_reports_all_cases():
+    """Microbench plumbing on the CPU mesh (interpret mode): every case
+    reports either timings or an explicit skip/error, the agreement
+    check passes, and the speedup ratio fields exist where both sides
+    ran. Real numbers come from the bench artifact on TPU."""
+    from k8s_device_plugin_tpu.ops.microbench import run_microbench
+
+    r = run_microbench(iters=1, seqs=[128], rmsnorm_shape=(64, 128))
+    assert r["backend"] == "cpu"
+    k = r["kernels"]
+    assert set(k) == {
+        "attention_seq128", "attention_agreement", "rmsnorm_64x128",
+    }
+    assert k["attention_agreement"]["ok"] is True
+    assert "speedup_vs_dense" in k["attention_seq128"]
+    assert "speedup_vs_xla" in k["rmsnorm_64x128"]
+    assert r["ok"] is True
+
+
+def test_microbench_budget_skips_are_recorded():
+    from k8s_device_plugin_tpu.ops.microbench import run_microbench
+
+    r = run_microbench(iters=1, budget_s=0.001, seqs=[128])
+    assert all("skipped" in v for v in r["kernels"].values())
+    assert r["ok"] is True  # skipped-for-budget is not a failure

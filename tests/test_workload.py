@@ -281,7 +281,7 @@ def test_bench_report_parsing_schema_guarded():
     real = '{"ok": true, "time_to_devices_s": 1.0, "mfu": 0.5}'
     stray = '{"status": "tunnel reconnected"}'
     out = f"compile log line\n{real}\n{stray}\n"
-    got = bench.parse_smoke_report(out)
+    got = bench.parse_json_report(out)
     assert got is not None and got["mfu"] == 0.5
-    assert bench.parse_smoke_report(f"{stray}\nnoise\n") is None
-    assert bench.parse_smoke_report("") is None
+    assert bench.parse_json_report(f"{stray}\nnoise\n") is None
+    assert bench.parse_json_report("") is None
