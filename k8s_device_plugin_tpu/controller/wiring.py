@@ -187,6 +187,10 @@ class TopologyPublisher:
             except Exception as e:
                 # A dropped publish would leave a stale condition or
                 # availability annotation until the NEXT change — retry.
+                # Post-stop failures are the expected shape of teardown
+                # (the apiserver is already gone): exit silently.
+                if self._stop.is_set():
+                    return
                 log.warning(
                     "node publish failed (retry in %.0fs): %s", backoff, e
                 )

