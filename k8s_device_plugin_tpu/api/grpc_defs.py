@@ -253,17 +253,24 @@ class PodResourcesListerStub:
 
 
 # ---------------------------------------------------------------------------
-# DRA plugin service (dra/v1beta1) — the PLUGIN serves this on a socket
-# under /var/lib/kubelet/plugins/<driver>/, announced to the kubelet via
-# the plugins_registry watcher with type "DRAPlugin". The method path uses
-# the upstream package name "v1beta1" (wire contract); the pb2 package is
-# "dra" only to avoid a process-wide protobuf name collision with the
-# deviceplugin v1beta1 messages (see api/dra.proto header).
+# DRA plugin service — the PLUGIN serves this on a socket under
+# /var/lib/kubelet/plugins/<driver>/, announced to the kubelet via the
+# plugins_registry watcher with type "DRAPlugin". The kubelet negotiates
+# by FULL gRPC service name ("v1.DRAPlugin" since k8s 1.33, GA;
+# "v1beta1.DRAPlugin" before) and the NodePrepare/Unprepare messages are
+# wire-identical across the two packages, so the same handlers serve both
+# method paths. The pb2 package here is "dra" only to avoid a
+# process-wide protobuf name collision with the deviceplugin v1beta1
+# messages (see api/dra.proto header).
 # ---------------------------------------------------------------------------
 
 from . import dra_pb2 as drapb  # noqa: E402
 
+DRA_PLUGIN_SERVICE_V1 = "v1.DRAPlugin"
 DRA_PLUGIN_SERVICE = "v1beta1.DRAPlugin"
+# Newest first: the kubelet's registration handler picks the first entry
+# it supports from PluginInfo.supported_versions.
+DRA_PLUGIN_SERVICES = (DRA_PLUGIN_SERVICE_V1, DRA_PLUGIN_SERVICE)
 
 
 class DraPluginServicer:
@@ -281,8 +288,12 @@ class DraPluginServicer:
 
 
 def add_dra_plugin_servicer(
-    servicer: DraPluginServicer, server: grpc.Server
+    servicer: DraPluginServicer,
+    server: grpc.Server,
+    services=DRA_PLUGIN_SERVICES,
 ) -> None:
+    """Register the DRAPlugin handlers under every given service name —
+    one server answers both the GA and the beta kubelet method paths."""
     handlers = {
         "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
             servicer.NodePrepareResources,
@@ -302,16 +313,23 @@ def add_dra_plugin_servicer(
         ),
     }
     server.add_generic_rpc_handlers(
-        (grpc.method_handlers_generic_handler(DRA_PLUGIN_SERVICE, handlers),)
+        tuple(
+            grpc.method_handlers_generic_handler(service, handlers)
+            for service in services
+        )
     )
 
 
 class DraPluginStub:
-    """Client for the plugin's DRAPlugin service (kubelet/tests → plugin)."""
+    """Client for the plugin's DRAPlugin service (kubelet/tests → plugin).
+    ``service`` selects the method path — a GA kubelet dials
+    DRA_PLUGIN_SERVICE_V1, a beta one DRA_PLUGIN_SERVICE."""
 
-    def __init__(self, channel: grpc.Channel):
+    def __init__(
+        self, channel: grpc.Channel, service: str = DRA_PLUGIN_SERVICE
+    ):
         self.NodePrepareResources = channel.unary_unary(
-            f"/{DRA_PLUGIN_SERVICE}/NodePrepareResources",
+            f"/{service}/NodePrepareResources",
             request_serializer=(
                 drapb.NodePrepareResourcesRequest.SerializeToString
             ),
@@ -320,7 +338,7 @@ class DraPluginStub:
             ),
         )
         self.NodeUnprepareResources = channel.unary_unary(
-            f"/{DRA_PLUGIN_SERVICE}/NodeUnprepareResources",
+            f"/{service}/NodeUnprepareResources",
             request_serializer=(
                 drapb.NodeUnprepareResourcesRequest.SerializeToString
             ),

@@ -37,14 +37,31 @@ def _spec_filename(kind: str, name: str) -> str:
 
 
 def spec_chip_ids(spec: Optional[dict]) -> List[str]:
-    """Chip ids recorded in a (parsed) claim spec's annotations; [] when
-    the spec is missing or predates the field."""
+    """Chip ids recorded in a (parsed) claim spec's annotations — the
+    union over its CDI devices (multi-request claims write one device
+    per request); [] when the spec is missing or predates the field."""
+    seen = []
     for dev in (spec or {}).get("devices", []):
         ann = dev.get("annotations") or {}
-        ids = ann.get("tpu.google.com/chip-ids", "")
+        for cid in ann.get("tpu.google.com/chip-ids", "").split(","):
+            if cid and cid not in seen:
+                seen.append(cid)
+    return seen
+
+
+def spec_request_groups(spec: Optional[dict]) -> List[tuple]:
+    """[(request_name, [chip_ids])] recorded per CDI device — how a
+    restarted driver recovers which claim request holds which chips
+    (single-request/legacy specs yield one group with request '')."""
+    groups = []
+    for dev in (spec or {}).get("devices", []):
+        ann = dev.get("annotations") or {}
+        ids = [
+            c for c in ann.get("tpu.google.com/chip-ids", "").split(",") if c
+        ]
         if ids:
-            return ids.split(",")
-    return []
+            groups.append((ann.get("tpu.google.com/request", ""), ids))
+    return groups
 
 
 def spec_claim_ref(spec: Optional[dict]) -> Optional[tuple]:
@@ -70,12 +87,18 @@ class CdiRegistry:
         return f"{self.kind}={device_name}"
 
     @staticmethod
-    def claim_device_name(claim_uid: str) -> str:
-        """The single source of the per-claim CDI device naming scheme."""
-        return f"claim-{claim_uid}"
+    def claim_device_name(claim_uid: str, request: str = "") -> str:
+        """The single source of the per-claim CDI device naming scheme.
+        ``request`` names the per-request device of a multi-request
+        claim; empty for single-request claims (and as the spec FILE
+        name, which is always per-claim)."""
+        base = f"claim-{claim_uid}"
+        if request:
+            return base + "-" + re.sub(r"[^a-zA-Z0-9_.-]", "-", request)
+        return base
 
-    def claim_device_id(self, claim_uid: str) -> str:
-        return self.device_id(self.claim_device_name(claim_uid))
+    def claim_device_id(self, claim_uid: str, request: str = "") -> str:
+        return self.device_id(self.claim_device_name(claim_uid, request))
 
     def write_claim_device(
         self,
@@ -86,48 +109,86 @@ class CdiRegistry:
         chip_ids: Sequence[str] = (),
         claim_ref: Optional[tuple] = None,
     ) -> str:
-        """Write the spec for one prepared claim; returns the CDI device ID
-        the kubelet passes to the runtime. ``libtpu`` is the (host_path,
-        container_path) mount decided by server.plugin.libtpu_mount — the
-        decision lives there so both planes stay in lockstep. ``chip_ids``
-        is recorded in the spec's annotations so a restarted driver can
-        rebuild its prepared-claim holds from disk (claim_chip_ids)."""
-        name = self.claim_device_name(claim_uid)
-        edits: Dict = {
-            "deviceNodes": [
-                {"path": p, "hostPath": p} for p in dev_paths
-            ],
-            "env": [f"{k}={v}" for k, v in sorted(env.items())],
-        }
-        if libtpu is not None:
-            host_path, container_path = libtpu
-            edits["mounts"] = [
-                {
-                    "hostPath": host_path,
-                    "containerPath": container_path,
-                    "options": ["ro", "rbind"],
-                }
-            ]
-            edits["env"].append(f"TPU_LIBRARY_PATH={container_path}")
-        device: Dict = {"name": name, "containerEdits": edits}
-        annotations: Dict[str, str] = {}
-        if chip_ids:
-            annotations["tpu.google.com/chip-ids"] = ",".join(chip_ids)
-        if claim_ref is not None:
-            annotations["tpu.google.com/claim-namespace"] = claim_ref[0]
-            annotations["tpu.google.com/claim-name"] = claim_ref[1]
-        if annotations:
-            device["annotations"] = annotations
+        """Write the spec for one single-request claim; returns the CDI
+        device ID the kubelet passes to the runtime."""
+        ids = self.write_claim_devices(
+            claim_uid,
+            [("", dev_paths, env, chip_ids)],
+            libtpu=libtpu,
+            claim_ref=claim_ref,
+        )
+        return ids[""]
+
+    def write_claim_devices(
+        self,
+        claim_uid: str,
+        groups: Sequence[tuple],
+        libtpu: Optional[tuple] = None,
+        claim_ref: Optional[tuple] = None,
+    ) -> Dict[str, str]:
+        """Write one claim's CDI spec; returns request → CDI device id.
+
+        ``groups`` is [(request, dev_paths, env, chip_ids)]. With more
+        than one group the spec carries one CDI device PER REQUEST, so a
+        container referencing only one request of a multi-request claim
+        receives only that request's chips and env (ADVICE r2: one
+        shared device would hand every container all the claim's chips).
+        A single group keeps the legacy per-claim device name. The
+        request names and per-device chip ids persist in the spec's
+        annotations, so a restarted driver rebuilds the association from
+        disk (spec_request_groups) — not just the union of chips.
+
+        ``libtpu`` is the (host_path, container_path) mount decided by
+        server.plugin.libtpu_mount — the decision lives there so both
+        planes stay in lockstep.
+        """
+        multi = len(groups) > 1
+        devices = []
+        ids: Dict[str, str] = {}
+        for request, dev_paths, env, chip_ids in groups:
+            name = self.claim_device_name(
+                claim_uid, request if multi else ""
+            )
+            edits: Dict = {
+                "deviceNodes": [
+                    {"path": p, "hostPath": p} for p in dev_paths
+                ],
+                "env": [f"{k}={v}" for k, v in sorted(env.items())],
+            }
+            if libtpu is not None:
+                host_path, container_path = libtpu
+                edits["mounts"] = [
+                    {
+                        "hostPath": host_path,
+                        "containerPath": container_path,
+                        "options": ["ro", "rbind"],
+                    }
+                ]
+                edits["env"].append(f"TPU_LIBRARY_PATH={container_path}")
+            device: Dict = {"name": name, "containerEdits": edits}
+            annotations: Dict[str, str] = {}
+            if chip_ids:
+                annotations["tpu.google.com/chip-ids"] = ",".join(chip_ids)
+            if request:
+                annotations["tpu.google.com/request"] = request
+            if claim_ref is not None:
+                annotations["tpu.google.com/claim-namespace"] = claim_ref[0]
+                annotations["tpu.google.com/claim-name"] = claim_ref[1]
+            if annotations:
+                device["annotations"] = annotations
+            devices.append(device)
+            ids[request] = self.device_id(name)
         spec = {
             "cdiVersion": CDI_VERSION,
             "kind": self.kind,
-            "devices": [device],
+            "devices": devices,
         }
-        self._write_spec(name, spec)
+        self._write_spec(self.claim_device_name(claim_uid), spec)
         log.info(
-            "wrote CDI spec for %s (%d device nodes)", name, len(dev_paths)
+            "wrote CDI spec for claim %s (%d devices)",
+            claim_uid, len(devices),
         )
-        return self.device_id(name)
+        return ids
 
     def _write_spec(self, name: str, spec: dict) -> None:
         os.makedirs(self.cdi_dir, exist_ok=True)

@@ -33,6 +33,7 @@ import grpc
 
 from ..api import dra_pb2 as pb
 from ..api.grpc_defs import (
+    DRA_PLUGIN_SERVICES,
     DraPluginServicer,
     WatcherRegistrationServicer,
     add_dra_plugin_servicer,
@@ -46,7 +47,6 @@ from . import cdi, slices
 
 log = logging.getLogger(__name__)
 
-DRA_VERSION = "v1beta1"
 DEFAULT_PLUGINS_DIR = "/var/lib/kubelet/plugins"
 
 
@@ -86,8 +86,16 @@ class DraDriver(DraPluginServicer):
         self.claim_refs: Dict[str, tuple] = {}
         # claim uid -> the claim's allocation results (for request_names).
         self._results_by_uid: Dict[str, List[dict]] = {}
+        # claim uid -> whether the CDI spec was written with per-request
+        # devices. Recorded at prepare AND recovery time from the spec
+        # itself: deriving it from surviving chip groups would mis-name
+        # CDI ids after a restart dropped one request's chips.
+        self._multi_request: Dict[str, bool] = {}
         self._server: Optional[grpc.Server] = None
         self._registry_server: Optional[grpc.Server] = None
+        # resource.k8s.io version negotiated from API-group discovery
+        # (slices.negotiate_api_version), cached after first success.
+        self._api_version: Optional[str] = None
         # ResourceSlice republisher: event-triggered (health transitions)
         # with retry — a one-shot publish that failed on a transient
         # apiserver error would leave a registered driver advertising
@@ -103,6 +111,19 @@ class DraDriver(DraPluginServicer):
     def _held_chip_ids(self) -> set:
         with self._lock:
             return {c for ids in self.prepared.values() for c in ids}
+
+    def api_version(self) -> str:
+        """The cluster's negotiated resource.k8s.io version. Raises with
+        a distinct message for "no DRA" vs "unsupported DRA version"
+        (slices.negotiate_api_version); callers surface it per-claim or
+        through the publisher's retry loop."""
+        if self._api_version is None:
+            self._api_version = slices.negotiate_api_version(self.client)
+            log.info(
+                "negotiated resource.k8s.io/%s for driver %s",
+                self._api_version, self.driver_name,
+            )
+        return self._api_version
 
     # ------------------------------------------------------------------
     # DRAPlugin service
@@ -157,6 +178,23 @@ class DraDriver(DraPluginServicer):
             r for r in results if r.get("driver") == self.driver_name
         ]
 
+    def _request_groups(self, results: List[dict]) -> List[tuple]:
+        """[(request_name, [chip_ids])] in result order, one group per
+        distinct request — the unit of CDI container isolation for
+        multi-request claims."""
+        order: List[str] = []
+        by_req: Dict[str, List[str]] = {}
+        for r in results:
+            mc = self._by_device_name.get(r.get("device", ""))
+            if mc is None:
+                continue
+            req = r.get("request", "")
+            if req not in by_req:
+                by_req[req] = []
+                order.append(req)
+            by_req[req].append(mc.id)
+        return [(req, by_req[req]) for req in order]
+
     def _prepare_claim(self, claim) -> List[pb.Device]:
         with self._lock:
             already = self.prepared.get(claim.uid)
@@ -173,8 +211,25 @@ class DraDriver(DraPluginServicer):
         if self.client is None:
             raise RuntimeError("no API client to resolve the claim")
         claim_obj = slices.get_resource_claim(
-            self.client, claim.namespace, claim.name
+            self.client, claim.namespace, claim.name,
+            api_version=self.api_version(),
         )
+        if claim_obj is None:
+            # Ambiguous 404: the claim may be gone — or an in-place
+            # cluster upgrade stopped serving the cached groupVersion.
+            # Re-negotiate (one discovery GET) and retry once before
+            # concluding the claim doesn't exist.
+            fresh = slices.negotiate_api_version(self.client)
+            if fresh != self._api_version:
+                log.info(
+                    "resource.k8s.io re-negotiated %s -> %s",
+                    self._api_version, fresh,
+                )
+                self._api_version = fresh
+                claim_obj = slices.get_resource_claim(
+                    self.client, claim.namespace, claim.name,
+                    api_version=fresh,
+                )
         if claim_obj is None:
             raise RuntimeError("ResourceClaim not found")
         uid = (claim_obj.get("metadata") or {}).get("uid", "")
@@ -231,20 +286,31 @@ class DraDriver(DraPluginServicer):
             )
             if broken:
                 raise RuntimeError(f"chips currently unhealthy: {broken}")
-            chips = [self.plugin.mesh.by_id[i] for i in chip_ids]
-            env = self.plugin._tpu_env(chips)
-            self.cdi.write_claim_device(
+            # One CDI device per request: a container referencing one
+            # request of a multi-request claim gets only that request's
+            # chips and a TPU env computed over exactly those chips
+            # (ADVICE r2: a single shared device handed every container
+            # all the claim's chips).
+            cdi_groups = []
+            for request, ids in self._request_groups(results):
+                group_chips = [self.plugin.mesh.by_id[i] for i in ids]
+                cdi_groups.append((
+                    request,
+                    [mc.chip.dev_path for mc in group_chips],
+                    self.plugin._tpu_env(group_chips),
+                    ids,
+                ))
+            self.cdi.write_claim_devices(
                 claim.uid,
-                [mc.chip.dev_path for mc in chips],
-                env,
+                cdi_groups,
                 libtpu=plugin_mod.libtpu_mount(self.plugin.config),
-                chip_ids=chip_ids,
                 claim_ref=(claim.namespace, claim.name),
             )
             with self._lock:
                 self.prepared[claim.uid] = chip_ids
                 self.claim_refs[claim.uid] = (claim.namespace, claim.name)
                 self._results_by_uid[claim.uid] = results
+                self._multi_request[claim.uid] = len(cdi_groups) > 1
             self.plugin.mark_allocated(chip_ids)
         log.info(
             "prepared claim %s/%s: chips %s",
@@ -254,25 +320,29 @@ class DraDriver(DraPluginServicer):
 
     def _device_msgs(self, claim_uid: str, chip_ids: List[str]):
         results = self._results_by_uid.get(claim_uid, [])
+        groups = self._request_groups(results)
+        multi = self._multi_request.get(claim_uid, len(groups) > 1)
         request_by_chip = {}
-        for r in results:
-            mc = self._by_device_name.get(r.get("device", ""))
-            if mc is not None and r.get("request"):
-                request_by_chip[mc.id] = r["request"]
-        cdi_id = self.cdi.claim_device_id(claim_uid)
+        for req, ids in groups:
+            for cid in ids:
+                request_by_chip[cid] = req
         msgs = []
         for chip_id in chip_ids:
             mc = self.plugin.mesh.by_id[chip_id]
+            req = request_by_chip.get(chip_id, "")
             msgs.append(
                 pb.Device(
-                    request_names=(
-                        [request_by_chip[chip_id]]
-                        if chip_id in request_by_chip
-                        else []
-                    ),
+                    request_names=[req] if req else [],
                     pool_name=self.node_name,
                     device_name=slices.device_name(mc),
-                    cdi_device_ids=[cdi_id],
+                    # Multi-request claims expose one CDI device per
+                    # request; the kubelet applies to each container
+                    # only the ids of the requests it references.
+                    cdi_device_ids=[
+                        self.cdi.claim_device_id(
+                            claim_uid, req if multi else ""
+                        )
+                    ],
                 )
             )
         return msgs
@@ -298,6 +368,7 @@ class DraDriver(DraPluginServicer):
             chip_ids = self.prepared.pop(claim_uid, [])
             self.claim_refs.pop(claim_uid, None)
             self._results_by_uid.pop(claim_uid, None)
+            self._multi_request.pop(claim_uid, None)
         if chip_ids:
             self.plugin.free_devices(chip_ids)
             log.info("unprepared claim %s: freed %s", claim_uid, chip_ids)
@@ -326,9 +397,31 @@ class DraDriver(DraPluginServicer):
                 if i in self.plugin.mesh.by_id
             ]
             ref = cdi.spec_claim_ref(spec)
+            # Rebuild the request→chips association from the per-device
+            # annotations, so an idempotent re-prepare after restart
+            # returns the same request_names and per-request CDI ids the
+            # original prepare did (not an everything-widened view).
+            synth_results = [
+                {
+                    "device": slices.device_name(self.plugin.mesh.by_id[i]),
+                    "request": req,
+                    "driver": self.driver_name,
+                }
+                for req, group in cdi.spec_request_groups(spec)
+                for i in group
+                if i in self.plugin.mesh.by_id
+            ]
             if ids:
                 with self._lock:
                     self.prepared[uid] = ids
+                    if synth_results:
+                        self._results_by_uid[uid] = synth_results
+                    # Spec device count, not surviving-group count: a
+                    # restart that dropped one request's chips must keep
+                    # naming the per-request CDI ids the spec contains.
+                    self._multi_request[uid] = (
+                        len(cdi.spec_request_groups(spec)) > 1
+                    )
                     if ref is not None:
                         self.claim_refs[uid] = ref
                 if ref is None:
@@ -353,7 +446,9 @@ class DraDriver(DraPluginServicer):
         if self.client is None or not uids:
             return
         try:
-            resp = self.client.get(f"{slices.RESOURCE_API}/resourceclaims")
+            resp = self.client.get(
+                f"{slices.resource_api(self.api_version())}/resourceclaims"
+            )
         except Exception as e:
             log.warning(
                 "claim-ref resolution for %d legacy claims failed (their "
@@ -441,10 +536,14 @@ class DraDriver(DraPluginServicer):
             # but a periodic wake with the slice intact publishes nothing
             # (a PUT every interval would churn watchers).
             triggered = self._republish.wait(timeout=self.resync_interval_s)
-            self._republish.clear()
             if self._stop_pub.is_set():
                 return
             if triggered:
+                # Clear only on the triggered path: clearing after a
+                # timed-out wait would eat a trigger landing in the
+                # wait-return→clear window, delaying a health-transition
+                # republish by up to resync_interval_s (ADVICE r2 low).
+                self._republish.clear()
                 self._stop_pub.wait(0.3)  # coalesce transition bursts
                 need_publish = True
             else:
@@ -453,7 +552,7 @@ class DraDriver(DraPluginServicer):
     def _slice_exists(self) -> bool:
         try:
             self.client.get(
-                f"{slices.RESOURCE_API}/resourceslices/"
+                f"{slices.resource_api(self.api_version())}/resourceslices/"
                 f"{slices.slice_name(self.node_name, self.driver_name)}"
             )
             return True
@@ -473,7 +572,12 @@ class DraDriver(DraPluginServicer):
                     type="DRAPlugin",
                     name=driver.driver_name,
                     endpoint=driver.socket_path,
-                    supported_versions=[DRA_VERSION],
+                    # The kubelet validates these against FULL gRPC
+                    # service names (drapb.DRAPluginService), picking
+                    # the newest it supports — a bare "v1beta1" is
+                    # rejected with "none of the supported services
+                    # found" (ADVICE r2 medium).
+                    supported_versions=list(DRA_PLUGIN_SERVICES),
                 )
 
             def NotifyRegistrationStatus(self, request, context):
@@ -510,16 +614,33 @@ class DraDriver(DraPluginServicer):
         with self._lock:
             self._generation += 1
             generation = self._generation
-        return slices.publish_resource_slice(
-            self.client,
-            self.plugin.mesh,
-            self.node_name,
+        kwargs = dict(
             driver=self.driver_name,
             pool_generation=generation,
             exclude=self.plugin.state.unhealthy,
             worker_id=self.plugin.config.worker_id,
             slice_host_bounds=self.plugin.config.slice_host_bounds,
         )
+        try:
+            return slices.publish_resource_slice(
+                self.client, self.plugin.mesh, self.node_name,
+                api_version=self.api_version(), **kwargs,
+            )
+        except KubeError as e:
+            if e.status_code != 404:
+                raise
+            # The versioned collection path 404ing means the cluster no
+            # longer serves the cached groupVersion (in-place upgrade of
+            # a long-running DaemonSet pod): re-negotiate and retry once
+            # instead of failing forever until process restart.
+            stale = self._api_version
+            self._api_version = None
+            fresh = self.api_version()
+            log.info("resource.k8s.io re-negotiated %s -> %s", stale, fresh)
+            return slices.publish_resource_slice(
+                self.client, self.plugin.mesh, self.node_name,
+                api_version=fresh, **kwargs,
+            )
 
     def stop(self, unpublish: bool = False) -> None:
         self._stop_pub.set()
@@ -540,8 +661,13 @@ class DraDriver(DraPluginServicer):
                 pass
         if unpublish and self.client is not None:
             try:
+                # Use the cached version: re-running discovery at
+                # teardown is a wasted roundtrip, and a transient
+                # discovery error would skip the delete and leave a
+                # stale slice advertising a gone node.
                 slices.delete_resource_slice(
-                    self.client, self.node_name, self.driver_name
+                    self.client, self.node_name, self.driver_name,
+                    api_version=self._api_version,
                 )
             except Exception as e:
                 log.warning("ResourceSlice delete failed: %s", e)

@@ -16,13 +16,18 @@ from typing import Dict, List, Optional, Tuple
 
 
 class FakeApiServer:
-    def __init__(self):
+    def __init__(self, dra_versions: Tuple[str, ...] = ("v1", "v1beta1")):
         self._lock = threading.Lock()
         self._rv = 0
         self.pods: Dict[Tuple[str, str], dict] = {}  # (ns, name) -> pod
         self.nodes: Dict[str, dict] = {}
-        # resource.k8s.io/v1beta1 (DRA): name -> ResourceSlice,
-        # (ns, name) -> ResourceClaim.
+        # resource.k8s.io (DRA): name -> ResourceSlice,
+        # (ns, name) -> ResourceClaim. ``dra_versions`` is what this
+        # cluster serves ("v1" GA, "v1beta1" pre-1.33, both, or ()
+        # for a cluster with DRA disabled) — drivers must negotiate via
+        # the /apis/resource.k8s.io group document like against a real
+        # apiserver; requests to an unserved version 404.
+        self.dra_versions = tuple(dra_versions)
         self.resourceslices: Dict[str, dict] = {}
         self.resourceclaims: Dict[Tuple[str, str], dict] = {}
         self.pod_patches: List[Tuple[str, str, dict]] = []
@@ -102,9 +107,11 @@ class FakeApiServer:
                         server._handle_watch(self, params)
                     else:
                         server._handle_list(self, params)
-                elif parsed.path.startswith(
-                    "/apis/resource.k8s.io/v1beta1/"
-                ):
+                elif parsed.path == "/apis/resource.k8s.io":
+                    server._handle_resource_group(self)
+                elif parsed.path.startswith("/apis/resource.k8s.io/"):
+                    if server._dra_version_of(self, parsed.path) is None:
+                        return
                     server._handle_resource_get(self, parsed.path)
                 elif parsed.path == "/api/v1/nodes":
                     selector = params.get("labelSelector", "")
@@ -168,9 +175,12 @@ class FakeApiServer:
                             server.evictions.append((ns, name))
                         server.delete_pod(ns, name)
                         server._send_json(self, {"status": "Success"}, 201)
-                elif self.path == (
-                    "/apis/resource.k8s.io/v1beta1/resourceslices"
+                elif (
+                    self.path.startswith("/apis/resource.k8s.io/")
+                    and self.path.endswith("/resourceslices")
                 ):
+                    if server._dra_version_of(self, self.path) is None:
+                        return
                     name = body.get("metadata", {}).get("name", "")
                     with server._lock:
                         if name in server.resourceslices:
@@ -189,9 +199,15 @@ class FakeApiServer:
             def do_PUT(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
-                prefix = "/apis/resource.k8s.io/v1beta1/resourceslices/"
-                if self.path.startswith(prefix):
-                    name = self.path[len(prefix):]
+                parts = self.path.strip("/").split("/")
+                if (
+                    len(parts) == 5
+                    and parts[1] == "resource.k8s.io"
+                    and parts[3] == "resourceslices"
+                ):
+                    if server._dra_version_of(self, self.path) is None:
+                        return
+                    name = parts[4]
                     with server._lock:
                         if name not in server.resourceslices:
                             server._send_json(
@@ -207,9 +223,15 @@ class FakeApiServer:
                     self.send_error(404)
 
             def do_DELETE(self):
-                prefix = "/apis/resource.k8s.io/v1beta1/resourceslices/"
-                if self.path.startswith(prefix):
-                    name = self.path[len(prefix):]
+                parts = self.path.strip("/").split("/")
+                if (
+                    len(parts) == 5
+                    and parts[1] == "resource.k8s.io"
+                    and parts[3] == "resourceslices"
+                ):
+                    if server._dra_version_of(self, self.path) is None:
+                        return
+                    name = parts[4]
                     with server._lock:
                         gone = server.resourceslices.pop(name, None)
                     if gone is None:
@@ -320,6 +342,47 @@ class FakeApiServer:
             pass
         finally:
             self._watchers.remove(q)
+
+    def _handle_resource_group(self, handler):
+        """APIGroup discovery for /apis/resource.k8s.io — what real
+        version negotiation reads. 404 when DRA is disabled."""
+        if not self.dra_versions:
+            self._send_json(
+                handler,
+                {"message": "the server could not find the requested "
+                 "resource"},
+                404,
+            )
+            return
+        versions = [
+            {"groupVersion": f"resource.k8s.io/{v}", "version": v}
+            for v in self.dra_versions
+        ]
+        self._send_json(
+            handler,
+            {
+                "kind": "APIGroup",
+                "apiVersion": "v1",
+                "name": "resource.k8s.io",
+                "versions": versions,
+                "preferredVersion": versions[0],
+            },
+        )
+
+    def _dra_version_of(self, handler, path: str):
+        """The resource.k8s.io version segment of ``path`` if this fake
+        serves it; otherwise answers 404 (like a real apiserver asked
+        for an unserved groupVersion) and returns None."""
+        parts = path.strip("/").split("/")
+        version = parts[2] if len(parts) > 2 else ""
+        if version in self.dra_versions:
+            return version
+        self._send_json(
+            handler,
+            {"message": f"resource.k8s.io/{version} is not served"},
+            404,
+        )
+        return None
 
     def _handle_resource_get(self, handler, path: str):
         parts = path.strip("/").split("/")
