@@ -51,8 +51,9 @@ class ModelConfig:
     max_seq_len: int = 128
     dtype: Any = jnp.bfloat16
     # Pallas kernels (ops/): both carry custom VJPs and are safe for
-    # training; flash attention's backward recomputes through the
-    # reference formulation (see ops/attention.py).
+    # training; flash attention's backward runs streaming Pallas dq and
+    # fused dk/dv kernels that recompute probability tiles from the
+    # saved logsumexp — no O(seq^2) intermediate (see ops/attention.py).
     use_pallas_norm: bool = False
     use_flash_attention: bool = False
     # Context parallelism: shard the sequence over the mesh's ``seq`` axis

@@ -295,3 +295,92 @@ def test_go_cased_request_keys_accepted(http_server):
     node, _ = make_node("n1")
     out = post(http_server, "/filter", tpu_pod(2), [node], keycase="go")
     assert [n["metadata"]["name"] for n in out["nodes"]["items"]] == ["n1"]
+
+
+def test_shipped_manifest_matches_served_protocol():
+    """deploy/tpu-extender.yml must stay in lockstep with the code: the
+    ConfigMap's extender stanza has to name the verbs this server
+    actually serves, the Service/container ports and the CLI default
+    must agree, and the liveness probe must hit the real /healthz."""
+    import os
+
+    import yaml
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deploy", "tpu-extender.yml",
+    )
+    docs = [d for d in yaml.safe_load_all(open(path)) if d]
+    by_kind = {d["kind"]: d for d in docs}
+    assert set(by_kind) == {"Deployment", "Service", "ConfigMap"}
+
+    container = by_kind["Deployment"]["spec"]["template"]["spec"][
+        "containers"
+    ][0]
+    port = container["ports"][0]["containerPort"]
+    assert ["--port", str(port)] == container["args"]
+    assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert by_kind["Service"]["spec"]["ports"][0]["port"] == port
+
+    sched = yaml.safe_load(by_kind["ConfigMap"]["data"]["config.yaml"])
+    ext = sched["extenders"][0]
+    assert str(port) in ext["urlPrefix"]
+    assert by_kind["Service"]["metadata"]["name"] in ext["urlPrefix"]
+    # The verbs are URL path segments under urlPrefix — they must be the
+    # paths ExtenderHTTPServer routes.
+    assert ext["filterVerb"] == "filter"
+    assert ext["prioritizeVerb"] == "prioritize"
+    assert ext["managedResources"][0]["name"] == constants.RESOURCE_NAME
+    assert ext["nodeCacheCapable"] is False
+
+
+def test_cli_entrypoint_serves_documented_paths(tmp_path):
+    """Drive the deployable entrypoint (python -m ...extender) exactly as
+    the manifest runs it, on an ephemeral port: /healthz answers, and
+    /filter//prioritize speak the extender protocol."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import time as _time
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {
+        k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"
+    }
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "k8s_device_plugin_tpu.extender",
+            "--host", "127.0.0.1", "--port", str(port),
+        ],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+    )
+    url = f"http://127.0.0.1:{port}"
+    try:
+        deadline = _time.time() + 15
+        while True:
+            try:
+                assert requests.get(f"{url}/healthz", timeout=2).json() == {
+                    "ok": True
+                }
+                break
+            except requests.ConnectionError:
+                if _time.time() > deadline:
+                    raise
+                _time.sleep(0.1)
+        node, _ = make_node("n1")
+        body = {"pod": tpu_pod(2), "nodes": {"items": [node]}}
+        out = requests.post(f"{url}/filter", json=body, timeout=10).json()
+        assert [n["metadata"]["name"] for n in out["nodes"]["items"]] == [
+            "n1"
+        ]
+        pr = requests.post(
+            f"{url}/prioritize", json=body, timeout=10
+        ).json()
+        assert pr and pr[0]["host"] == "n1"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
