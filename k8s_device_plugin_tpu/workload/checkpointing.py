@@ -98,7 +98,45 @@ class TrainCheckpointer:
                 }
             ),
         )
-        return step, restored["params"], restored["opt_state"]
+
+        # Force every restored leaf onto a mesh-consistent sharding.
+        # Orbax honors NamedShardings from the templates, but leaves
+        # whose template is single-device (fresh optimizer scalars like
+        # adam's step count are created before any mesh layout) come
+        # back COMMITTED to one device — unlike the movable fresh ones —
+        # and the next jitted train step rejects the mixed-device args
+        # ("Received incompatible devices for jitted computation").
+        # Replicate those over the mesh the rest of the state lives on.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = None
+        for leaf in jax.tree_util.tree_leaves(
+            (params_template, opt_state_template)
+        ):
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                mesh = sh.mesh
+                break
+
+        def relayout(tmpl, leaf):
+            sharding = getattr(tmpl, "sharding", None)
+            if isinstance(sharding, NamedSharding):
+                if getattr(leaf, "sharding", None) == sharding:
+                    return leaf
+                return jax.device_put(leaf, sharding)
+            if mesh is not None:
+                return jax.device_put(
+                    leaf, NamedSharding(mesh, PartitionSpec())
+                )
+            return leaf
+
+        params = jax.tree_util.tree_map(
+            relayout, params_template, restored["params"]
+        )
+        opt_state = jax.tree_util.tree_map(
+            relayout, opt_state_template, restored["opt_state"]
+        )
+        return step, params, opt_state
 
     def wait(self) -> None:
         """Block until in-flight async saves are durable."""
