@@ -8,8 +8,19 @@ import jax
 
 
 def interpret_mode() -> bool:
-    """Pallas interpreter mode: on for non-TPU backends (CPU test mesh) and
-    force-on via TPU_PLUGIN_PALLAS_INTERPRET=1 for on-TPU debugging."""
-    if os.environ.get("TPU_PLUGIN_PALLAS_INTERPRET") == "1":
+    """Pallas interpreter mode: on for the CPU test mesh, off everywhere
+    else; force-on via TPU_PLUGIN_PALLAS_INTERPRET=1 for on-TPU
+    debugging, force-off via =0.
+
+    The off-default is deliberate for unrecognized backend names: a
+    tunneled/plugin PJRT backend for a real TPU can report a platform
+    name other than "tpu", and silently interpreting there would turn
+    the MXU kernels into a Python-speed simulation mid-benchmark. A
+    genuinely non-TPU accelerator fails loudly at Mosaic lowering
+    instead — the debuggable failure mode."""
+    forced = os.environ.get("TPU_PLUGIN_PALLAS_INTERPRET")
+    if forced == "1":
         return True
-    return jax.default_backend() != "tpu"
+    if forced == "0":
+        return False
+    return jax.default_backend() == "cpu"

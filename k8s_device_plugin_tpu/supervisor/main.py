@@ -112,11 +112,24 @@ class Daemon:
         # cfg so SIGHUP rebuilds re-derive against the current label).
         self._derived_accelerator_type = ""
         self.metrics_server = None
+        # Supervisor-loop heartbeat backing /healthz: run() touches it
+        # every event-queue turn (≤1 s cadence when idle); a wedged loop
+        # stops advancing it and the kubelet liveness probe gets 503.
+        # Generously padded vs the 1 s cadence: build_and_serve within a
+        # turn legitimately takes seconds (scan + serve + register).
+        self._heartbeat = time.monotonic()
+        self.heartbeat_stale_s = 60.0
         if cfg.metrics_port:
             from ..utils.metrics import MetricsServer
 
             try:
-                self.metrics_server = MetricsServer(port=cfg.metrics_port)
+                self.metrics_server = MetricsServer(
+                    port=cfg.metrics_port,
+                    liveness_check=lambda: (
+                        time.monotonic() - self._heartbeat
+                        < self.heartbeat_stale_s
+                    ),
+                )
                 url = self.metrics_server.start()
                 log.info("metrics at %s/metrics", url)
             except OSError as e:
@@ -357,6 +370,7 @@ class Daemon:
         iterations = 0
         try:
             while True:
+                self._heartbeat = time.monotonic()
                 if restart:
                     self.teardown()
                     try:

@@ -22,6 +22,40 @@ log = logging.getLogger(__name__)
 ENV_VAR = "TPU_WORKLOAD_COMPILATION_CACHE_DIR"
 
 
+def default_dir() -> str:
+    """The repo-local cache directory the bench, the test suite, and the
+    multichip dryrun all share (single source: if this path ever moves,
+    every consumer moves with it — a silent fork would make each "warm"
+    run recompile from scratch with no error)."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        ".jax_compilation_cache",
+    )
+
+
+def enable_default() -> bool:
+    """Enable the cache at $TPU_WORKLOAD_COMPILATION_CACHE_DIR when set,
+    else at the shared repo-local default."""
+    return maybe_enable(os.environ.get(ENV_VAR) or default_dir())
+
+
+def reset() -> None:
+    """Rebind jax's cache object to the currently-configured directory.
+
+    jax latches the directory in use at the first compile and ignores
+    later config changes; the only rebind hook is private, so it lives
+    behind this one helper (swallowing failure: the cache still works,
+    just possibly against the previous directory)."""
+    try:
+        from jax._src import compilation_cache as _jax_cc
+
+        _jax_cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private API may move
+        pass
+
+
 def maybe_enable(cache_dir: Optional[str] = None) -> bool:
     """Enable jax's persistent compilation cache when a directory is
     configured (argument wins over $TPU_WORKLOAD_COMPILATION_CACHE_DIR).
@@ -32,11 +66,14 @@ def maybe_enable(cache_dir: Optional[str] = None) -> bool:
     import jax
 
     os.makedirs(d, exist_ok=True)
+    previous = getattr(jax.config, "jax_compilation_cache_dir", None)
     jax.config.update("jax_compilation_cache_dir", d)
     # Cache everything: the workload's jits are few and all worth keeping
     # (default threshold skips fast compiles, which on CPU test runs is
     # every compile — making the behavior untestable).
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    if previous and previous != d:
+        reset()  # rebind: jax latched the previous directory
     log.info("persistent compilation cache at %s", d)
     return True

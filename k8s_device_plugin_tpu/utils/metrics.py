@@ -209,15 +209,24 @@ DRA_PREPARED = REGISTRY.gauge(
 
 
 class MetricsServer(BackgroundHTTPServer):
-    """Serves GET /metrics (and /healthz) for Prometheus scrapes."""
+    """Serves GET /metrics (and /healthz) for Prometheus scrapes.
+
+    ``liveness_check`` (optional, () -> bool) backs /healthz: this server
+    runs on its own thread, so an unconditional 200 would only prove the
+    HTTP thread is alive — a kubelet liveness probe needs the answer to
+    reflect the SUPERVISOR loop (wedged loop ⇒ 503 ⇒ restart). Without a
+    check, /healthz degrades to process-up.
+    """
 
     def __init__(self, registry: Registry = REGISTRY, host: str = "0.0.0.0",
-                 port: int = 0):
+                 port: int = 0, liveness_check=None):
         super().__init__(host, port)
         self.registry = registry
+        self.liveness_check = liveness_check
 
     def handler_class(self):
         registry = self.registry
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
@@ -231,8 +240,15 @@ class MetricsServer(BackgroundHTTPServer):
                         "Content-Type", "text/plain; version=0.0.4"
                     )
                 elif self.path == "/healthz":
-                    body = b"ok\n"
-                    self.send_response(200)
+                    check = server.liveness_check
+                    live = True
+                    if check is not None:
+                        try:
+                            live = bool(check())
+                        except Exception:  # noqa: BLE001 — a broken check
+                            live = False  # reads as not-live, not a 500
+                    body = b"ok\n" if live else b"supervisor stalled\n"
+                    self.send_response(200 if live else 503)
                     self.send_header("Content-Type", "text/plain")
                 else:
                     body = b"not found\n"

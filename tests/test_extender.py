@@ -384,3 +384,34 @@ def test_cli_entrypoint_serves_documented_paths(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_all_deploy_manifests_parse():
+    """`kubectl apply -f deploy/` is the documented bring-up for both
+    planes (VERDICT r2 #3): every shipped manifest must parse as YAML
+    and carry apiVersion/kind/metadata.name on each document."""
+    import os
+
+    import yaml
+
+    deploy = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deploy",
+    )
+    manifests = sorted(
+        f for f in os.listdir(deploy) if f.endswith((".yml", ".yaml"))
+    )
+    assert manifests, "no manifests shipped"
+    kinds = set()
+    for fname in manifests:
+        with open(os.path.join(deploy, fname)) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc is None:
+                    continue
+                assert doc.get("apiVersion"), (fname, doc)
+                assert doc.get("kind"), (fname, doc)
+                assert doc.get("metadata", {}).get("name"), (fname, doc)
+                kinds.add(doc["kind"])
+    # Both planes plus the workload examples are present.
+    assert {"DaemonSet", "Deployment", "Service", "ConfigMap",
+            "Pod"} <= kinds

@@ -174,3 +174,59 @@ def test_sub_host_allocation_stays_single_worker(tmp_path):
     assert env["TPU_WORKER_ID"] == "0"
     assert "TPU_WORKER_HOSTNAMES" not in env
     assert env["TPU_ACCELERATOR_TYPE"] == "v5p-4"
+
+
+def test_healthz_reflects_liveness_check():
+    """/healthz backed by a liveness check: a wedged supervisor loop
+    (stale heartbeat) must answer 503 so the kubelet liveness probe in
+    deploy/tpu-device-plugin.yml actually restarts it; a broken check
+    reads as not-live, never a 500."""
+    state = {"live": True}
+    srv = metrics.MetricsServer(
+        host="127.0.0.1", liveness_check=lambda: state["live"]
+    )
+    url = srv.start()
+    try:
+        assert requests.get(f"{url}/healthz", timeout=5).status_code == 200
+        state["live"] = False
+        r = requests.get(f"{url}/healthz", timeout=5)
+        assert r.status_code == 503
+        assert "stalled" in r.text
+        state["live"] = True
+        assert requests.get(f"{url}/healthz", timeout=5).status_code == 200
+    finally:
+        srv.stop()
+
+
+def test_daemon_heartbeat_backs_healthz(tmp_path):
+    """The Daemon wires its supervisor-loop heartbeat into the metrics
+    server's liveness check."""
+    import socket
+    import time as _time
+
+    from k8s_device_plugin_tpu.supervisor.main import Daemon, DaemonConfig
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 4)
+    daemon = Daemon(
+        DaemonConfig(
+            device_plugin_dir=str(tmp_path / "dp"),
+            sysfs_accel_dir=accel,
+            dev_dir=dev,
+            libtpu_host_path="",
+            enable_controller=False,
+            metrics_port=port,
+        )
+    )
+    try:
+        assert daemon.metrics_server is not None
+        url = f"http://127.0.0.1:{port}"
+        assert requests.get(f"{url}/healthz", timeout=5).status_code == 200
+        # Simulate a wedged loop: heartbeat frozen past the threshold.
+        daemon._heartbeat = _time.monotonic() - daemon.heartbeat_stale_s - 1
+        assert requests.get(f"{url}/healthz", timeout=5).status_code == 503
+    finally:
+        if daemon.metrics_server is not None:
+            daemon.metrics_server.stop()

@@ -153,6 +153,9 @@ def test_compilation_cache_opt_in(tmp_path, monkeypatch):
         assert any(os.scandir(d)), "no compilation cache entries written"
     finally:
         # The cache config is process-global; restore it so later tests
-        # don't read/write executables from this test's tmp dir.
+        # don't read/write executables from this test's tmp dir — and
+        # rebind jax's cache object (it latches the directory in use at
+        # first compile; a config update alone leaves it pointed here).
         for name, value in saved.items():
             jax.config.update(name, value)
+        compilation_cache.reset()
