@@ -1,4 +1,5 @@
-"""Entrypoint: python -m k8s_device_plugin_tpu.extender [--port 12346]."""
+"""Entrypoint: python -m k8s_device_plugin_tpu.extender [--port 12346]
+[--gang-admission [--kubeconfig ...]]."""
 
 import argparse
 import logging
@@ -12,6 +13,16 @@ def main() -> int:
     p = argparse.ArgumentParser(prog="tpu-scheduler-extender")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=12346)
+    p.add_argument(
+        "--gang-admission", action="store_true",
+        help="run the scheduling-gate gang admitter next to the "
+        "extender (needs API access: in-cluster or --kubeconfig)",
+    )
+    p.add_argument("--kubeconfig", default="")
+    p.add_argument(
+        "--gang-resync-s", type=float, default=5.0,
+        help="gang re-evaluation interval",
+    )
     p.add_argument("-v", "--verbose", action="count", default=0)
     a = p.parse_args()
     logging.basicConfig(
@@ -20,10 +31,22 @@ def main() -> int:
     )
     srv = ExtenderHTTPServer(host=a.host, port=a.port)
     srv.start()
+    gang = None
+    if a.gang_admission:
+        from ..kube.client import KubeClient
+        from .gang import GangAdmission
+
+        gang = GangAdmission(
+            KubeClient.from_env(a.kubeconfig),
+            resync_interval_s=a.gang_resync_s,
+        )
+        gang.start()
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
+    if gang is not None:
+        gang.stop()
     srv.stop()
     return 0
 

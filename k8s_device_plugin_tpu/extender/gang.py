@@ -1,0 +1,327 @@
+"""Gang admission: all-or-nothing release of TPU pod gangs via
+scheduling gates.
+
+The extender (server.py) filters and scores nodes per scheduling cycle,
+which cannot make N pods admit atomically — the documented gap a
+JobSet/Kueue layer usually fills (docs/operations.md). This controller
+provides the TPU-shaped core of that layer natively, on the modern
+kube primitive for it (pod scheduling gates):
+
+* Workloads create every pod of a gang with the scheduling gate
+  ``tpu.google.com/gang`` plus labels ``tpu.google.com/gang-name``
+  (shared identity) and ``tpu.google.com/gang-size`` (total pod count).
+  Gated pods are invisible to the scheduler — nothing is partially
+  placed, nothing needs rolling back.
+* The controller watches gated pods cluster-wide; once ALL ``size``
+  members of a gang exist it evaluates the gang's total demand against
+  the TPU topology the node daemons publish (the same
+  ``google.com/tpu-topology`` annotations and SliceView gang model the
+  extender reads): single-host pods first-fit onto nodes' free chips,
+  multi-host pods (request > host size — the extender's convention for
+  slice jobs) need a contiguous free host sub-box in one slice.
+* Only when the WHOLE gang fits are the gates removed — gang-wide, in
+  one pass. The default scheduler + extender then place the pods with
+  the usual topology scoring. A gang that doesn't fit stays gated and is
+  re-evaluated every resync; capacity lost after release is handled the
+  same way any scheduling failure is (pods Pending, extender filters).
+
+The admission check is a conservative feasibility test (a necessary
+condition evaluated on published availability), not a placement
+reservation: between release and scheduling another pod can still take
+the chips, in which case the gang waits in Pending exactly as it would
+under any non-reserving admitter. Reservation-grade guarantees remain
+JobSet/Kueue territory; what this closes is the all-or-nothing release
+the reference's extender model (score-one-node-at-a-time,
+/root/reference/docs/README.md) could never express.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..api import constants
+from ..kube.client import KubeClient
+from ..topology.schema import NodeTopology
+from ..topology.slice import SliceView, group_by_slice
+from ..utils.podresources import tpu_request
+
+log = logging.getLogger(__name__)
+
+GATE_NAME = "tpu.google.com/gang"
+GANG_NAME_LABEL = "tpu.google.com/gang-name"
+GANG_SIZE_LABEL = "tpu.google.com/gang-size"
+
+
+def is_gated(pod: dict) -> bool:
+    gates = (pod.get("spec") or {}).get("schedulingGates") or []
+    return any(g.get("name") == GATE_NAME for g in gates)
+
+
+def pod_gang(pod: dict) -> Optional[Tuple[str, str, int]]:
+    """(namespace, gang_name, size) when the pod carries the gang
+    LABELS — gated or not: released members must keep counting toward
+    gang completeness, or a partially-failed release could never be
+    finished (the remainder would read as an incomplete gang forever).
+    Malformed sizes disqualify the pod (logged) rather than wedge the
+    controller."""
+    meta = pod.get("metadata") or {}
+    labels = meta.get("labels") or {}
+    name = labels.get(GANG_NAME_LABEL)
+    raw_size = labels.get(GANG_SIZE_LABEL)
+    if not name or raw_size is None:
+        return None
+    try:
+        size = int(raw_size)
+    except ValueError:
+        log.warning(
+            "pod %s/%s: bad %s=%r",
+            meta.get("namespace", "default"), meta.get("name"),
+            GANG_SIZE_LABEL, raw_size,
+        )
+        return None
+    if size <= 0:
+        return None
+    return (meta.get("namespace", "default"), name, size)
+
+
+class GangAdmission:
+    """Scheduling-gate lifter for TPU pod gangs."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        resource_name: str = constants.RESOURCE_NAME,
+        resync_interval_s: float = 5.0,
+    ):
+        self.client = client
+        self.resource_name = resource_name
+        self.resync_interval_s = resync_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # (gang key, demands) already reported as not-fitting — a gang
+        # waiting for capacity logs once per state, not once per resync.
+        self._reported_waiting: set = set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="gang-admission", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — admission must survive
+                if self._stop.is_set():
+                    return
+                log.warning("gang admission tick failed: %s", e)
+            self._stop.wait(self.resync_interval_s)
+
+    # -- one evaluation pass ----------------------------------------------
+
+    def tick(self) -> List[Tuple[str, str]]:
+        """Evaluate every complete gang once; returns the (namespace,
+        gang_name) pairs released this pass (test observability)."""
+        # Server-side filtering: only gang-labeled pods come back (an
+        # existence selector on the gang-name key) — a flat list of the
+        # whole cluster's pods every resync would be sustained apiserver
+        # load for nothing.
+        pods = self.client.list_pods(
+            label_selector=GANG_NAME_LABEL
+        ).get("items", [])
+        gangs: Dict[Tuple[str, str], List[dict]] = {}
+        sizes: Dict[Tuple[str, str], int] = {}
+        for pod in pods:
+            info = pod_gang(pod)
+            if info is None:
+                continue
+            ns, name, size = info
+            gangs.setdefault((ns, name), []).append(pod)
+            sizes[(ns, name)] = size
+        # Prune the logged-waiting markers of gangs that vanished or
+        # changed shape — the set must not grow without bound.
+        self._reported_waiting = {
+            w for w in self._reported_waiting if w[0] in gangs
+        }
+        if not gangs:
+            return []
+
+        topos = self._node_topologies()
+        released = []
+        for key, members in sorted(gangs.items()):
+            size = sizes[key]
+            gated = [p for p in members if is_gated(p)]
+            if not gated:
+                continue  # fully released; nothing to do
+            if len(members) < size:
+                log.debug(
+                    "gang %s/%s: %d/%d pods present; waiting",
+                    key[0], key[1], len(members), size,
+                )
+                continue
+            if len(members) > size:
+                log.warning(
+                    "gang %s/%s: %d pods exceed declared size %d; "
+                    "refusing to release (misconfigured gang)",
+                    key[0], key[1], len(members), size,
+                )
+                continue
+            if len(gated) < len(members):
+                # A previous release pass partially failed (patch error
+                # mid-gang): the all-or-nothing decision was already
+                # made, and leaving a remainder gated is the one outcome
+                # strictly worse than any other — finish the release.
+                log.warning(
+                    "gang %s/%s: finishing partial release (%d of %d "
+                    "still gated)", key[0], key[1], len(gated), size,
+                )
+                self._release(gated)
+                released.append(key)
+                continue
+            demands = [
+                tpu_request(p, self.resource_name) for p in members
+            ]
+            if not self._fits(demands, topos):
+                waiting = (key, tuple(sorted(demands)))
+                if waiting not in self._reported_waiting:
+                    self._reported_waiting.add(waiting)
+                    log.info(
+                        "gang %s/%s: insufficient TPU capacity for %s; "
+                        "stays gated (re-evaluated every %.0fs)",
+                        key[0], key[1], demands, self.resync_interval_s,
+                    )
+                continue
+            self._reported_waiting = {
+                w for w in self._reported_waiting if w[0] != key
+            }
+            self._release(gated)
+            released.append(key)
+            log.info(
+                "gang %s/%s released: %d pods, demand %s",
+                key[0], key[1], size, demands,
+            )
+        return released
+
+    def _node_topologies(self) -> List[NodeTopology]:
+        topos = []
+        for node in self.client.list_nodes().get("items", []):
+            ann = (node.get("metadata") or {}).get("annotations") or {}
+            raw = ann.get(constants.TOPOLOGY_ANNOTATION)
+            if not raw:
+                continue
+            try:
+                topos.append(NodeTopology.from_json(raw))
+            except (json.JSONDecodeError, TypeError, KeyError) as e:
+                log.warning(
+                    "bad topology annotation on %s: %s",
+                    (node.get("metadata") or {}).get("name"), e,
+                )
+        return topos
+
+    # -- feasibility -------------------------------------------------------
+
+    def _fits(self, demands: List[int], topos: List[NodeTopology]) -> bool:
+        """Whole-gang feasibility against published availability.
+
+        Consumes capacity across the gang: multi-host demands claim
+        contiguous free host boxes in a slice (whole hosts, mirroring
+        the extender's filter contract), then single-host demands
+        first-fit-decreasing onto remaining free chips. Conservative on
+        purpose — a gang released here can still lose a race to other
+        pods, but a gang NOT released here definitely cannot fit."""
+        if not any(demands):
+            return True
+        import copy
+
+        # Local, consumable copies of availability.
+        topos = [copy.deepcopy(t) for t in topos]
+        by_host = {t.hostname: t for t in topos}
+        multi = []
+        single = []
+        for n in demands:
+            if n <= 0:
+                continue
+            host_sizes = [
+                t.chip_count for t in topos if 0 < t.chip_count
+            ]
+            if host_sizes and n > max(host_sizes):
+                multi.append(n)
+            else:
+                single.append(n)
+        # Multi-host first (whole hosts, most constrained).
+        for n in sorted(multi, reverse=True):
+            placed = False
+            for members in group_by_slice(list(by_host.values())).values():
+                per_host = members[0].chip_count
+                if per_host <= 0 or n % per_host != 0:
+                    continue
+                k = n // per_host
+                view = SliceView(members)
+                gang_hosts, _ = view.best_gang(k)
+                if not gang_hosts:
+                    # Same bar as the extender's /filter (server.py
+                    # _multi_host_reason): k whole-free hosts in the
+                    # slice pass even when no contiguous box exists —
+                    # box-ness is a scoring preference there, so
+                    # requiring it HERE would gate gangs the scheduler
+                    # would actually place. Consume arbitrary free
+                    # hosts in that case.
+                    free = view.free_coords()
+                    if len(free) >= k:
+                        gang_hosts = [
+                            view.by_coords[c].hostname for c in free[:k]
+                        ]
+                if gang_hosts:
+                    for h in gang_hosts:
+                        by_host[h].available = []
+                    placed = True
+                    break
+            if not placed:
+                return False
+        # Single-host: first-fit-decreasing over free chip counts.
+        free = sorted(
+            (len(t.available) for t in by_host.values()), reverse=True
+        )
+        for n in sorted(single, reverse=True):
+            for i, f in enumerate(free):
+                if f >= n:
+                    free[i] -= n
+                    free.sort(reverse=True)
+                    break
+            else:
+                return False
+        return True
+
+    # -- release -----------------------------------------------------------
+
+    def _release(self, members: List[dict]) -> None:
+        """Remove the gang gate from every member. Best-effort per pod:
+        a failed patch is retried on the next resync (the gate is only
+        ever removed, so re-processing released pods is a no-op — they
+        no longer match pod_gang)."""
+        for pod in members:
+            meta = pod.get("metadata") or {}
+            ns = meta.get("namespace", "default")
+            name = meta.get("name", "")
+            gates = (pod.get("spec") or {}).get("schedulingGates") or []
+            remaining = [g for g in gates if g.get("name") != GATE_NAME]
+            try:
+                self.client.replace_pod_scheduling_gates(ns, name, remaining)
+            except Exception as e:  # noqa: BLE001 — retried next resync
+                log.warning(
+                    "gate removal for %s/%s failed (retrying next "
+                    "resync): %s", ns, name, e,
+                )

@@ -35,6 +35,7 @@ SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 MERGE_PATCH = "application/merge-patch+json"
 STRATEGIC_MERGE_PATCH = "application/strategic-merge-patch+json"
+JSON_PATCH = "application/json-patch+json"
 
 
 def rfc3339_now() -> str:
@@ -370,6 +371,24 @@ class KubeClient:
         (/root/reference/controller.go:227-249)."""
         body = {"metadata": {"annotations": annotations}}
         return self.patch(f"/api/v1/namespaces/{namespace}/pods/{name}", body)
+
+    def replace_pod_scheduling_gates(
+        self, namespace: str, name: str, gates: List[dict]
+    ) -> dict:
+        """Replace spec.schedulingGates wholesale (JSON Patch).
+
+        Gate removal is the one pod-spec mutation the API server permits
+        on a running object, and strategic merge cannot DELETE list
+        entries — replacing the list is the supported shape (what the
+        gang-admission controller uses to release a gang)."""
+        ops = [{"op": "replace", "path": "/spec/schedulingGates",
+                "value": gates}]
+        return self._request(
+            "PATCH",
+            f"/api/v1/namespaces/{namespace}/pods/{name}",
+            data=json.dumps(ops),
+            headers={"Content-Type": JSON_PATCH},
+        ).json()
 
 
 def _named(items: Iterable[dict], name: str) -> Optional[dict]:

@@ -247,7 +247,12 @@ class FakeApiServer:
                 parts = self.path.strip("/").split("/")
                 # api/v1/namespaces/{ns}/pods/{name} | api/v1/nodes/{name}
                 if len(parts) == 6 and parts[2] == "namespaces" and parts[4] == "pods":
-                    server._patch_pod(self, parts[3], parts[5], body)
+                    if self.headers.get("Content-Type") == (
+                        "application/json-patch+json"
+                    ):
+                        server._json_patch_pod(self, parts[3], parts[5], body)
+                    else:
+                        server._patch_pod(self, parts[3], parts[5], body)
                 elif len(parts) == 4 and parts[2] == "nodes":
                     server._patch_node(self, parts[3], body)
                 elif (
@@ -296,6 +301,17 @@ class FakeApiServer:
             pods = [
                 p for p in pods if (p.get("spec") or {}).get("nodeName") == node
             ]
+        # labelSelector: equality terms ("k=v") and existence terms
+        # ("k") — all KubeClient callers emit.
+        for term in filter(None, params.get("labelSelector", "").split(",")):
+            def labels(p):
+                return (p.get("metadata") or {}).get("labels") or {}
+
+            if "=" in term:
+                k, v = term.split("=", 1)
+                pods = [p for p in pods if labels(p).get(k) == v]
+            else:
+                pods = [p for p in pods if term in labels(p)]
         return pods
 
     def _handle_list(self, handler, params):
@@ -443,6 +459,40 @@ class FakeApiServer:
             )
             pod["metadata"]["resourceVersion"] = self._next_rv()
             self.pod_patches.append((ns, name, body))
+            self._broadcast("MODIFIED", pod)
+        self._send_json(handler, pod)
+
+    def _json_patch_pod(self, handler, ns, name, ops):
+        """RFC-6902 subset (replace/remove/add on simple paths) — enough
+        for what KubeClient emits (scheduling-gate replacement)."""
+        with self._lock:
+            pod = self.pods.get((ns, name))
+            if pod is None:
+                self._send_json(
+                    handler, {"message": f"pod {ns}/{name} not found"}, 404
+                )
+                return
+            for op in ops:
+                parts = [
+                    p.replace("~1", "/").replace("~0", "~")
+                    for p in op.get("path", "").strip("/").split("/")
+                ]
+                parent = pod
+                for p in parts[:-1]:
+                    parent = parent.setdefault(p, {})
+                if op.get("op") in ("replace", "add"):
+                    parent[parts[-1]] = op.get("value")
+                elif op.get("op") == "remove":
+                    parent.pop(parts[-1], None)
+                else:
+                    self._send_json(
+                        handler,
+                        {"message": f"unsupported op {op.get('op')}"},
+                        422,
+                    )
+                    return
+            pod["metadata"]["resourceVersion"] = self._next_rv()
+            self.pod_patches.append((ns, name, {"json_patch": ops}))
             self._broadcast("MODIFIED", pod)
         self._send_json(handler, pod)
 

@@ -312,13 +312,23 @@ def test_shipped_manifest_matches_served_protocol():
     )
     docs = [d for d in yaml.safe_load_all(open(path)) if d]
     by_kind = {d["kind"]: d for d in docs}
-    assert set(by_kind) == {"Deployment", "Service", "ConfigMap"}
+    assert set(by_kind) == {
+        "Deployment", "Service", "ConfigMap", "ServiceAccount",
+        "ClusterRole", "ClusterRoleBinding",
+    }
 
     container = by_kind["Deployment"]["spec"]["template"]["spec"][
         "containers"
     ][0]
     port = container["ports"][0]["containerPort"]
-    assert ["--port", str(port)] == container["args"]
+    assert container["args"][:2] == ["--port", str(port)]
+    assert "--gang-admission" in container["args"]
+    # The gang admitter patches pods; the bound role must allow it.
+    pod_rules = [
+        r for r in by_kind["ClusterRole"]["rules"]
+        if "pods" in r["resources"]
+    ]
+    assert pod_rules and "patch" in pod_rules[0]["verbs"]
     assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
     assert by_kind["Service"]["spec"]["ports"][0]["port"] == port
 

@@ -1,0 +1,259 @@
+"""Gang admission tests: all-or-nothing scheduling-gate release driven
+against the fake API server, using the same published-topology inputs
+the extender reads."""
+
+import grpc  # noqa: F401  (parity with sibling test imports)
+import pytest
+
+from k8s_device_plugin_tpu.extender.gang import (
+    GANG_NAME_LABEL,
+    GANG_SIZE_LABEL,
+    GATE_NAME,
+    GangAdmission,
+    pod_gang,
+)
+from k8s_device_plugin_tpu.kube.client import KubeClient
+from tests.fake_apiserver import FakeApiServer
+from tests.test_extender import make_node, make_slice_nodes
+
+
+def gang_pod(name, gang, size, chips, ns="default", extra_gates=()):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "labels": {
+                GANG_NAME_LABEL: gang,
+                GANG_SIZE_LABEL: str(size),
+            },
+        },
+        "spec": {
+            "schedulingGates": [
+                {"name": GATE_NAME},
+                *({"name": g} for g in extra_gates),
+            ],
+            "containers": [
+                {
+                    "name": "main",
+                    "resources": {
+                        "requests": {"google.com/tpu": str(chips)}
+                    },
+                }
+            ],
+        },
+    }
+
+
+@pytest.fixture
+def api():
+    s = FakeApiServer()
+    url = s.start()
+    yield s, KubeClient(url)
+    s.stop()
+
+
+def gates_of(server, ns, name):
+    return [
+        g["name"]
+        for g in server.pods[(ns, name)]["spec"].get("schedulingGates", [])
+    ]
+
+
+def test_pod_gang_parsing():
+    from k8s_device_plugin_tpu.extender.gang import is_gated
+
+    assert pod_gang(gang_pod("p", "g", 3, 1)) == ("default", "g", 3)
+    assert is_gated(gang_pod("p", "g", 3, 1))
+    # Membership is by LABELS: an already-released pod still counts
+    # toward gang completeness (partial-release recovery); the gate
+    # check is separate.
+    ungated = gang_pod("p", "g", 3, 1)
+    ungated["spec"]["schedulingGates"] = []
+    assert pod_gang(ungated) == ("default", "g", 3)
+    assert not is_gated(ungated)
+    bad = gang_pod("p", "g", 3, 1)
+    bad["metadata"]["labels"][GANG_SIZE_LABEL] = "lots"
+    assert pod_gang(bad) is None
+
+
+def test_incomplete_gang_stays_gated(api):
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    server.add_pod(gang_pod("w0", "train", 3, 1))
+    server.add_pod(gang_pod("w1", "train", 3, 1))
+    adm = GangAdmission(client)
+    assert adm.tick() == []
+    assert GATE_NAME in gates_of(server, "default", "w0")
+
+
+def test_complete_gang_released_when_capacity_fits(api):
+    """3 pods x 1 chip on a 4-chip node: released together, and only the
+    gang gate is removed — foreign gates survive."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    for i in range(3):
+        server.add_pod(
+            gang_pod(f"w{i}", "train", 3, 1, extra_gates=("other/gate",))
+        )
+    adm = GangAdmission(client)
+    assert adm.tick() == [("default", "train")]
+    for i in range(3):
+        gates = gates_of(server, "default", f"w{i}")
+        assert GATE_NAME not in gates
+        assert "other/gate" in gates
+    # Released pods no longer match; the next tick is a no-op.
+    assert adm.tick() == []
+
+
+def test_gang_exceeding_capacity_stays_gated_entirely(api):
+    """5 x 1-chip pods against one 4-chip node: nothing is released —
+    all-or-nothing is the whole point."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    for i in range(5):
+        server.add_pod(gang_pod(f"w{i}", "big", 5, 1))
+    adm = GangAdmission(client)
+    assert adm.tick() == []
+    for i in range(5):
+        assert GATE_NAME in gates_of(server, "default", f"w{i}")
+
+
+def test_gang_released_after_capacity_appears(api):
+    """A gated gang is re-evaluated: freeing chips (topology republish)
+    releases it on the next tick."""
+    server, client = api
+    # Start with only 1 chip free.
+    from k8s_device_plugin_tpu.api import constants
+    from k8s_device_plugin_tpu.topology.schema import NodeTopology
+
+    busy_node, mesh = make_node("n1", n=4)
+    topo = NodeTopology.from_mesh(
+        mesh, hostname="n1", available=mesh.ids[:1]
+    )
+    busy_node["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION] = (
+        topo.to_json()
+    )
+    server.add_node("n1", busy_node)
+    for i in range(2):
+        server.add_pod(gang_pod(f"w{i}", "train", 2, 2))
+    adm = GangAdmission(client)
+    assert adm.tick() == []
+    # Chips free up; the daemon republishes.
+    fresh, _ = make_node("n1", n=4)
+    server.add_node("n1", fresh)
+    assert adm.tick() == [("default", "train")]
+
+
+def test_multi_host_gang_needs_contiguous_free_hosts(api):
+    """Extender-convention multi-host pods (request > host size) are
+    admitted only when a contiguous free host box exists in one slice."""
+    server, client = api
+    hostnames = ["h0", "h1", "h2", "h3"]
+    # 2x2 host grid, h1 busy: an 8-chip (2-host) job still fits (h0+h2
+    # or h2+h3 boxes exist); a 16-chip (4-host) job cannot.
+    nodes = make_slice_nodes(hostnames, "2,2,1", n=4, busy=("h1",))
+    for name, node in zip(hostnames, nodes):
+        server.add_node(name, node)
+    server.add_pod(gang_pod("w0", "twohost", 1, 8))
+    adm = GangAdmission(client)
+    assert adm.tick() == [("default", "twohost")]
+    server.add_pod(gang_pod("x0", "fourhost", 1, 16))
+    assert adm.tick() == []
+    assert GATE_NAME in gates_of(server, "default", "x0")
+
+
+def test_oversized_gang_refused(api):
+    """More pods than the declared size is a misconfiguration: refuse to
+    release rather than guess which subset is the gang."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    for i in range(3):
+        server.add_pod(gang_pod(f"w{i}", "train", 2, 1))
+    adm = GangAdmission(client)
+    assert adm.tick() == []
+
+
+def test_background_loop_releases_and_stops(api):
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    server.add_pod(gang_pod("w0", "solo", 1, 2))
+    adm = GangAdmission(client, resync_interval_s=0.1)
+    adm.start()
+    try:
+        import time
+
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if GATE_NAME not in gates_of(server, "default", "w0"):
+                break
+            time.sleep(0.05)
+        assert GATE_NAME not in gates_of(server, "default", "w0")
+    finally:
+        adm.stop()
+    assert adm._thread is None
+
+
+def test_partial_release_is_finished_next_tick(api):
+    """If a release pass failed mid-gang (some pods ungated, some still
+    gated), the next tick finishes the release instead of reading the
+    remainder as an incomplete gang forever — a stuck remainder is the
+    exact partial placement the feature exists to prevent."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    for i in range(3):
+        server.add_pod(gang_pod(f"w{i}", "train", 3, 1))
+    # Simulate the partial failure: w0 already released out-of-band.
+    server.pods[("default", "w0")]["spec"]["schedulingGates"] = []
+    adm = GangAdmission(client)
+    assert adm.tick() == [("default", "train")]
+    for i in range(3):
+        assert GATE_NAME not in gates_of(server, "default", f"w{i}")
+
+
+def test_scattered_free_hosts_pass_like_the_extender_filter(api):
+    """Feasibility must match the extender's /filter bar: k whole-free
+    hosts in the slice admit the gang even when no contiguous box exists
+    (box-ness is a scoring preference at placement time, not an
+    admission requirement)."""
+    server, client = api
+    hostnames = ["h0", "h1", "h2", "h3"]
+    # 4x1x1 grid with h2 busy: free hosts {0,1,3} are NOT a contiguous
+    # 3-box, but 3 whole-free hosts exist.
+    nodes = make_slice_nodes(hostnames, "4,1,1", n=4, busy=("h2",))
+    for name, node in zip(hostnames, nodes):
+        server.add_node(name, node)
+    server.add_pod(gang_pod("w0", "threehost", 1, 12))
+    adm = GangAdmission(client)
+    assert adm.tick() == [("default", "threehost")]
+
+
+def test_gang_listing_uses_label_selector(api):
+    """The admitter must ask the API server for gang-labeled pods only
+    (server-side existence selector), not list the whole cluster."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    # A big population of unrelated pods plus one 1-pod gang.
+    for i in range(5):
+        server.add_pod({
+            "metadata": {"name": f"noise{i}", "namespace": "default"},
+            "spec": {"containers": []},
+        })
+    server.add_pod(gang_pod("w0", "solo", 1, 1))
+    seen = []
+    orig = client.list_pods
+
+    def spy(**kw):
+        seen.append(kw.get("label_selector", ""))
+        return orig(**kw)
+
+    client.list_pods = spy
+    adm = GangAdmission(client)
+    assert adm.tick() == [("default", "solo")]
+    assert seen and all(GANG_NAME_LABEL in s for s in seen)
