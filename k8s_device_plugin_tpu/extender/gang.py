@@ -46,6 +46,7 @@ from ..api import constants
 from ..kube.client import KubeClient
 from ..topology.schema import NodeTopology
 from ..topology.slice import SliceView, group_by_slice
+from ..utils import metrics
 from ..utils.podresources import tpu_request
 
 log = logging.getLogger(__name__)
@@ -157,10 +158,17 @@ class GangAdmission:
             w for w in self._reported_waiting if w[0] in gangs
         }
         if not gangs:
+            metrics.GANG_WAITING.set(0)  # gauge must not stay stale
             return []
 
+        # One consumable capacity view for the WHOLE tick: a gang
+        # released earlier in this pass must shrink what later gangs see
+        # (two gangs that each fit alone but not together must not both
+        # release). _fits copies, consumes, and returns the consumed
+        # view on success; the loop adopts it.
         topos = self._node_topologies()
         released = []
+        waiting_now = 0
         for key, members in sorted(gangs.items()):
             size = sizes[key]
             gated = [p for p in members if is_gated(p)]
@@ -194,7 +202,9 @@ class GangAdmission:
             demands = [
                 tpu_request(p, self.resource_name) for p in members
             ]
-            if not self._fits(demands, topos):
+            consumed = self._fits(demands, topos)
+            if consumed is None:
+                waiting_now += 1
                 waiting = (key, tuple(sorted(demands)))
                 if waiting not in self._reported_waiting:
                     self._reported_waiting.add(waiting)
@@ -204,6 +214,7 @@ class GangAdmission:
                         key[0], key[1], demands, self.resync_interval_s,
                     )
                 continue
+            topos = consumed
             self._reported_waiting = {
                 w for w in self._reported_waiting if w[0] != key
             }
@@ -213,6 +224,9 @@ class GangAdmission:
                 "gang %s/%s released: %d pods, demand %s",
                 key[0], key[1], size, demands,
             )
+        metrics.GANG_WAITING.set(waiting_now)
+        for _ in released:
+            metrics.GANG_RELEASED.inc()
         return released
 
     def _node_topologies(self) -> List[NodeTopology]:
@@ -233,77 +247,70 @@ class GangAdmission:
 
     # -- feasibility -------------------------------------------------------
 
-    def _fits(self, demands: List[int], topos: List[NodeTopology]) -> bool:
+    def _fits(
+        self, demands: List[int], topos: List[NodeTopology]
+    ) -> Optional[List[NodeTopology]]:
         """Whole-gang feasibility against published availability.
 
-        Consumes capacity across the gang: multi-host demands claim
-        contiguous free host boxes in a slice (whole hosts, mirroring
-        the extender's filter contract), then single-host demands
-        first-fit-decreasing onto remaining free chips. Conservative on
-        purpose — a gang released here can still lose a race to other
-        pods, but a gang NOT released here definitely cannot fit."""
-        if not any(demands):
-            return True
+        Returns the capacity view with this gang's consumption applied
+        (for the caller to carry into later gangs of the same tick), or
+        None when the gang cannot fit. The per-demand bar matches the
+        extender's /filter on every node shape: a demand places
+        single-host on any node whose chip_count and free chips cover
+        it, else multi-host onto whole-free hosts of one slice (n a
+        multiple of that slice's host size, contiguous box preferred but
+        not required — box-ness is a scoring preference at placement
+        time). Conservative on purpose — a gang released here can still
+        lose a race to other pods, but a gang NOT released here
+        definitely cannot fit."""
         import copy
 
-        # Local, consumable copies of availability.
-        topos = [copy.deepcopy(t) for t in topos]
-        by_host = {t.hostname: t for t in topos}
-        multi = []
-        single = []
-        for n in demands:
-            if n <= 0:
-                continue
-            host_sizes = [
-                t.chip_count for t in topos if 0 < t.chip_count
-            ]
-            if host_sizes and n > max(host_sizes):
-                multi.append(n)
-            else:
-                single.append(n)
-        # Multi-host first (whole hosts, most constrained).
-        for n in sorted(multi, reverse=True):
-            placed = False
-            for members in group_by_slice(list(by_host.values())).values():
-                per_host = members[0].chip_count
-                if per_host <= 0 or n % per_host != 0:
-                    continue
-                k = n // per_host
-                view = SliceView(members)
-                gang_hosts, _ = view.best_gang(k)
-                if not gang_hosts:
-                    # Same bar as the extender's /filter (server.py
-                    # _multi_host_reason): k whole-free hosts in the
-                    # slice pass even when no contiguous box exists —
-                    # box-ness is a scoring preference there, so
-                    # requiring it HERE would gate gangs the scheduler
-                    # would actually place. Consume arbitrary free
-                    # hosts in that case.
-                    free = view.free_coords()
-                    if len(free) >= k:
-                        gang_hosts = [
-                            view.by_coords[c].hostname for c in free[:k]
-                        ]
-                if gang_hosts:
-                    for h in gang_hosts:
-                        by_host[h].available = []
-                    placed = True
-                    break
-            if not placed:
-                return False
-        # Single-host: first-fit-decreasing over free chip counts.
-        free = sorted(
-            (len(t.available) for t in by_host.values()), reverse=True
-        )
-        for n in sorted(single, reverse=True):
-            for i, f in enumerate(free):
-                if f >= n:
-                    free[i] -= n
-                    free.sort(reverse=True)
-                    break
-            else:
-                return False
+        work = [copy.deepcopy(t) for t in topos]
+        by_host = {t.hostname: t for t in work}
+        for n in sorted((d for d in demands if d > 0), reverse=True):
+            if not (
+                self._place_single(n, by_host)
+                or self._place_multi(n, by_host)
+            ):
+                return None
+        return work
+
+    @staticmethod
+    def _place_single(n: int, by_host: Dict[str, NodeTopology]) -> bool:
+        """Consume n chips from the tightest single node that can serve
+        the demand locally (best-fit keeps large-free nodes for larger
+        demands)."""
+        best = None
+        for t in by_host.values():
+            if t.chip_count >= n and len(t.available) >= n:
+                if best is None or len(t.available) < len(best.available):
+                    best = t
+        if best is None:
+            return False
+        best.available = best.available[n:]
         return True
+
+    @staticmethod
+    def _place_multi(n: int, by_host: Dict[str, NodeTopology]) -> bool:
+        """Consume k=n/host_size whole-free hosts from one slice."""
+        for members in group_by_slice(list(by_host.values())).values():
+            per_host = members[0].chip_count
+            if per_host <= 0 or n % per_host != 0:
+                continue
+            k = n // per_host
+            view = SliceView(members)
+            gang_hosts, _ = view.best_gang(k)
+            if not gang_hosts:
+                free = view.free_coords()
+                if len(free) >= k:
+                    gang_hosts = [
+                        view.by_coords[c].hostname for c in free[:k]
+                    ]
+            if gang_hosts:
+                for h in gang_hosts:
+                    by_host[h].available = []
+                return True
+        return False
 
     # -- release -----------------------------------------------------------
 

@@ -269,6 +269,8 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                 self.wfile.write(data)
 
             def do_POST(self):
+                from ..utils import metrics
+
                 try:
                     args = self._read_args()
                 except json.JSONDecodeError:
@@ -277,6 +279,7 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                 pod = _get_ci(args, "pod") or {}
                 nodes = _get_ci(args, "nodes") or {}
                 items = _get_ci(nodes, "items") or []
+                verb = self.path.strip("/")
                 try:
                     if self.path == "/filter":
                         passing, failed = ext.filter(pod, items)
@@ -292,15 +295,29 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                         self._send(ext.prioritize(pod, items))
                     else:
                         self._send({"error": f"unknown path {self.path}"}, 404)
+                        return
+                    metrics.EXTENDER_REQUESTS.inc(verb=verb, outcome="ok")
                 except Exception as e:  # annotations are external input —
                     # one bad one must cost an error payload, not the
                     # scheduler's whole HTTP call.
                     log.exception("extender %s failed", self.path)
                     self._send({"error": f"{type(e).__name__}: {e}"}, 500)
+                    metrics.EXTENDER_REQUESTS.inc(verb=verb, outcome="error")
 
             def do_GET(self):
                 if self.path == "/healthz":
                     self._send({"ok": True})
+                elif self.path == "/metrics":
+                    from ..utils.metrics import EXTENDER_REGISTRY
+
+                    data = EXTENDER_REGISTRY.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
                 else:
                     self._send({"error": "not found"}, 404)
 

@@ -206,6 +206,23 @@ DRA_PREPARED = REGISTRY.gauge(
     "tpu_plugin_dra_prepared_claims",
     "DRA claims currently prepared (holding chips) on this node",
 )
+# The extender/gang-admission process exposes its own registry: sharing
+# the daemon's would publish every tpu_plugin_* family as constant zeros
+# from the extender Service, polluting sum()s and alerts across scrapes.
+EXTENDER_REGISTRY = Registry()
+EXTENDER_REQUESTS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_requests_total",
+    "Scheduler-extender HTTP requests served, by verb (filter/"
+    "prioritize) and outcome (ok/error)",
+)
+GANG_RELEASED = EXTENDER_REGISTRY.counter(
+    "tpu_gang_released_total",
+    "Pod gangs released (scheduling gates removed) by the admitter",
+)
+GANG_WAITING = EXTENDER_REGISTRY.gauge(
+    "tpu_gang_waiting",
+    "Complete gangs currently gated for lack of TPU capacity",
+)
 
 
 class MetricsServer(BackgroundHTTPServer):

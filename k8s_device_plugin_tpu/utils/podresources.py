@@ -14,7 +14,13 @@ from ..api import constants
 
 
 def _container_request(container: dict, resource_name: str) -> int:
-    req = (container.get("resources") or {}).get("requests") or {}
+    resources = container.get("resources") or {}
+    req = resources.get("requests") or {}
+    if resource_name not in req:
+        # Extended-resource semantics: specifying only limits implies
+        # requests (the API server defaults it, but raw/unsubmitted pod
+        # specs — admission inputs, tests — carry only what was written).
+        req = resources.get("limits") or {}
     try:
         return int(req.get(resource_name, 0))
     except (TypeError, ValueError):

@@ -257,3 +257,92 @@ def test_gang_listing_uses_label_selector(api):
     adm = GangAdmission(client)
     assert adm.tick() == [("default", "solo")]
     assert seen and all(GANG_NAME_LABEL in s for s in seen)
+
+
+def test_extender_metrics_cover_gang_and_requests(api):
+    """The extender's /metrics surfaces gang admission state and request
+    counters (observability parity with the plugin daemon's endpoint)."""
+    import requests as rq
+
+    from k8s_device_plugin_tpu.extender.server import ExtenderHTTPServer
+    from tests.test_extender import tpu_pod
+
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    server.add_pod(gang_pod("w0", "solo", 1, 2))
+    GangAdmission(client).tick()
+
+    srv = ExtenderHTTPServer(host="127.0.0.1")
+    url = srv.start()
+    try:
+        body = {"pod": tpu_pod(1), "nodes": {"items": [node]}}
+        rq.post(f"{url}/filter", json=body, timeout=5)
+        text = rq.get(f"{url}/metrics", timeout=5).text
+        assert "tpu_gang_released_total" in text
+        assert "tpu_gang_waiting" in text
+        assert (
+            'tpu_extender_requests_total{outcome="ok",verb="filter"} 1'
+            in text
+        )
+        # Scoped registry: daemon families must NOT leak into the
+        # extender's endpoint as constant zeros.
+        assert "tpu_plugin_chips" not in text
+    finally:
+        srv.stop()
+
+
+def test_gangs_competing_for_capacity_release_one_per_tick(api):
+    """Two complete gangs that each fit alone but not together: one tick
+    releases exactly one (capacity consumed across the pass); the other
+    follows when capacity frees."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    server.add_pod(gang_pod("a0", "ga", 1, 4))
+    server.add_pod(gang_pod("b0", "gb", 1, 4))
+    adm = GangAdmission(client)
+    assert adm.tick() == [("default", "ga")]  # sorted order wins
+    assert GATE_NAME in gates_of(server, "default", "b0")
+
+
+def test_heterogeneous_cluster_demand_falls_back_to_slice(api):
+    """A demand matching a busy big node's size must still admit via a
+    free slice of smaller hosts — the extender's /filter would place it
+    there (per-node convention, not cluster-wide max host size)."""
+    server, client = api
+    from k8s_device_plugin_tpu.api import constants
+    from k8s_device_plugin_tpu.topology.schema import NodeTopology
+
+    # Busy 8-chip node (0 free).
+    big, mesh = make_node("big", n=8)
+    topo = NodeTopology.from_mesh(mesh, hostname="big", available=[])
+    big["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION] = (
+        topo.to_json()
+    )
+    server.add_node("big", big)
+    # Fully-free 2-host slice of 4-chip hosts.
+    for name, node in zip(
+        ["h0", "h1"], make_slice_nodes(["h0", "h1"], "2,1,1", n=4)
+    ):
+        server.add_node(name, node)
+    server.add_pod(gang_pod("w0", "hetero", 1, 8))
+    adm = GangAdmission(client)
+    assert adm.tick() == [("default", "hetero")]
+
+
+def test_waiting_gauge_resets_when_gangs_vanish(api):
+    """tpu_gang_waiting must drop to 0 when the waiting gang's pods are
+    deleted — a stale nonzero gauge is a phantom alert."""
+    from k8s_device_plugin_tpu.utils import metrics
+
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    server.add_pod(gang_pod("w0", "toobig", 1, 64))
+    adm = GangAdmission(client)
+    assert adm.tick() == []
+    assert "tpu_gang_waiting 1" in metrics.EXTENDER_REGISTRY.render()
+    server.delete_pod("default", "w0")
+    assert adm.tick() == []
+    assert "tpu_gang_waiting 0" in metrics.EXTENDER_REGISTRY.render()
