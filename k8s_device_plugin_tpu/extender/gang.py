@@ -133,13 +133,16 @@ class GangAdmission:
 
     # -- one evaluation pass ----------------------------------------------
 
-    def tick(self) -> List[Tuple[str, str]]:
-        """Evaluate every complete gang once; returns the (namespace,
-        gang_name) pairs released this pass (test observability)."""
-        # Server-side filtering: only gang-labeled pods come back (an
-        # existence selector on the gang-name key) — a flat list of the
-        # whole cluster's pods every resync would be sustained apiserver
-        # load for nothing.
+    def _collect_gangs(
+        self,
+    ) -> Tuple[Dict[Tuple[str, str], List[dict]], Dict[Tuple[str, str], int]]:
+        """Gang-labeled pods grouped by (namespace, gang_name), plus the
+        declared sizes. The ONE discovery path tick() and explain()
+        share — drift between them would re-open tool-vs-controller
+        divergence. Server-side filtering: only gang-labeled pods come
+        back (an existence selector on the gang-name key) — a flat list
+        of the whole cluster's pods every resync would be sustained
+        apiserver load for nothing."""
         pods = self.client.list_pods(
             label_selector=GANG_NAME_LABEL
         ).get("items", [])
@@ -152,6 +155,12 @@ class GangAdmission:
             ns, name, size = info
             gangs.setdefault((ns, name), []).append(pod)
             sizes[(ns, name)] = size
+        return gangs, sizes
+
+    def tick(self) -> List[Tuple[str, str]]:
+        """Evaluate every complete gang once; returns the (namespace,
+        gang_name) pairs released this pass (test observability)."""
+        gangs, sizes = self._collect_gangs()
         # Prune the logged-waiting markers of gangs that vanished or
         # changed shape — the set must not grow without bound.
         self._reported_waiting = {
@@ -228,6 +237,54 @@ class GangAdmission:
         for _ in released:
             metrics.GANG_RELEASED.inc()
         return released
+
+    def explain(self) -> List[dict]:
+        """Operator diagnosis (tools/gang CLI): one report per gang —
+        membership vs declared size, gate state, per-pod demands, and
+        whether the gang fits the currently-published capacity. Pure
+        read: no gates are touched. Fit verdicts thread the consumed
+        capacity view across gangs in the same sorted order tick()
+        releases in — two gangs competing for one node's chips read
+        "fits" and "blocked", exactly what the controller will do, not
+        two optimistic "fits"."""
+        gangs, sizes = self._collect_gangs()
+        topos = self._node_topologies()
+        reports = []
+        for key, members in sorted(gangs.items()):
+            size = sizes[key]
+            gated = [p for p in members if is_gated(p)]
+            demands = [tpu_request(p, self.resource_name) for p in members]
+            if len(members) < size:
+                status = f"waiting: {len(members)}/{size} pods exist"
+            elif len(members) > size:
+                status = (
+                    f"misconfigured: {len(members)} pods exceed "
+                    f"declared size {size}"
+                )
+            elif not gated:
+                status = "released"
+            elif len(gated) < len(members):
+                status = "partial release in progress"
+            else:
+                consumed = self._fits(demands, topos)
+                if consumed is not None:
+                    topos = consumed  # mirror tick()'s consumption
+                    status = "fits: release due next resync"
+                else:
+                    status = (
+                        "blocked: insufficient TPU capacity for "
+                        f"{demands} on published topology"
+                    )
+            reports.append({
+                "namespace": key[0],
+                "gang": key[1],
+                "size": size,
+                "pods": len(members),
+                "gated": len(gated),
+                "demands": demands,
+                "status": status,
+            })
+        return reports
 
     def _node_topologies(self) -> List[NodeTopology]:
         topos = []

@@ -1,0 +1,49 @@
+"""Debug CLI: why is my gang (not) scheduling?
+
+Renders one line per TPU pod gang — membership vs declared size, gate
+state, per-pod demands, and whether the gang fits the currently
+published topology — using exactly the admission controller's own
+evaluation (extender/gang.py), so the tool can never disagree with the
+admitter about why a gang is stuck.
+
+    python -m k8s_device_plugin_tpu.tools.gang --kubeconfig ~/.kube/config
+    python -m k8s_device_plugin_tpu.tools.gang --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..extender.gang import GangAdmission
+from ..kube.client import KubeClient
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--kubeconfig", default="")
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = p.parse_args(argv)
+    adm = GangAdmission(KubeClient.from_env(args.kubeconfig))
+    reports = adm.explain()
+    if args.json:
+        print(json.dumps(reports, indent=1))
+        return 0
+    if not reports:
+        print("no gang-labeled pods found")
+        return 0
+    width = max(len(f"{r['namespace']}/{r['gang']}") for r in reports)
+    for r in reports:
+        name = f"{r['namespace']}/{r['gang']}"
+        print(
+            f"{name:<{width}}  pods {r['pods']}/{r['size']}  "
+            f"gated {r['gated']}  demands {r['demands']}  {r['status']}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

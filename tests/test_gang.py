@@ -346,3 +346,72 @@ def test_waiting_gauge_resets_when_gangs_vanish(api):
     server.delete_pod("default", "w0")
     assert adm.tick() == []
     assert "tpu_gang_waiting 0" in metrics.EXTENDER_REGISTRY.render()
+
+
+def test_explain_reports_every_gang_state(api, tmp_path):
+    """The tools/gang explainer mirrors the admitter's own evaluation:
+    waiting/incomplete, blocked-on-capacity, fits, and released gangs
+    each get an accurate status — and the CLI renders it."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    # incomplete (1 of 2), blocked (too big), fits (1x2), released.
+    server.add_pod(gang_pod("i0", "incomplete", 2, 1))
+    server.add_pod(gang_pod("b0", "blocked", 1, 64))
+    server.add_pod(gang_pod("f0", "fits", 1, 2))
+    server.add_pod(gang_pod("r0", "released", 1, 1))
+    server.pods[("default", "r0")]["spec"]["schedulingGates"] = []
+
+    adm = GangAdmission(client)
+    by_name = {r["gang"]: r for r in adm.explain()}
+    assert by_name["incomplete"]["status"].startswith("waiting: 1/2")
+    assert by_name["blocked"]["status"].startswith("blocked")
+    assert by_name["fits"]["status"].startswith("fits")
+    assert by_name["released"]["status"] == "released"
+    # explain() is read-only: nothing was released.
+    assert GATE_NAME in gates_of(server, "default", "f0")
+
+    # CLI end-to-end over a kubeconfig.
+    import json as _json
+    import subprocess
+    import sys
+
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(
+        "apiVersion: v1\nkind: Config\ncurrent-context: c\n"
+        "contexts: [{name: c, context: {cluster: cl, user: u}}]\n"
+        f"clusters: [{{name: cl, cluster: {{server: \"{client.base_url}\"}}}}]\n"
+        "users: [{name: u, user: {token: t}}]\n"
+    )
+    import os
+
+    env = {
+        k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"
+    }
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "k8s_device_plugin_tpu.tools.gang",
+            "--kubeconfig", str(kubeconfig), "--json",
+        ],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    parsed = {r["gang"]: r for r in _json.loads(out.stdout)}
+    assert set(parsed) == {"incomplete", "blocked", "fits", "released"}
+
+
+def test_explain_threads_consumed_capacity_like_tick(api):
+    """Two complete gangs competing for one node's chips: explain() must
+    report 'fits' for the one tick() would release and 'blocked' for
+    the other — not two optimistic verdicts."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    server.add_pod(gang_pod("a0", "ga", 1, 4))
+    server.add_pod(gang_pod("b0", "gb", 1, 4))
+    adm = GangAdmission(client)
+    by_name = {r["gang"]: r for r in adm.explain()}
+    assert by_name["ga"]["status"].startswith("fits")
+    assert by_name["gb"]["status"].startswith("blocked")

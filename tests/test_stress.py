@@ -296,3 +296,66 @@ def test_cross_plane_concurrency_never_double_allocates(tmp_path):
         plugin.stop()
         kubelet.stop()
         api.stop()
+
+
+def test_gang_admission_under_pod_churn():
+    """Gangs created and deleted concurrently with the admission loop:
+    at quiescence every surviving gang is either fully gated or fully
+    released (never half), released gangs fit the published capacity,
+    and the loop thread survives the churn."""
+    import time
+
+    from k8s_device_plugin_tpu.extender.gang import (
+        GATE_NAME,
+        GangAdmission,
+    )
+    from k8s_device_plugin_tpu.kube.client import KubeClient
+    from tests.fake_apiserver import FakeApiServer
+    from tests.test_extender import make_node
+    from tests.test_gang import gang_pod
+
+    api = FakeApiServer()
+    url = api.start()
+    # Two 4-chip nodes: capacity for at most 4 two-chip pods at once.
+    for name in ("n1", "n2"):
+        node, _ = make_node(name, n=4)
+        api.add_node(name, node)
+    adm = GangAdmission(KubeClient(url), resync_interval_s=0.05)
+    adm.start()
+    rng = random.Random(7)
+    try:
+        live = []
+        for i in range(30):
+            gname = f"g{i}"
+            size = rng.choice([1, 2, 3])
+            for w in range(size):
+                api.add_pod(gang_pod(f"{gname}-w{w}", gname, size, 2))
+            live.append((gname, size))
+            if rng.random() < 0.4 and live:
+                victim, vsize = live.pop(rng.randrange(len(live)))
+                for w in range(vsize):
+                    api.delete_pod("default", f"{victim}-w{w}")
+            time.sleep(0.01)
+        time.sleep(1.0)  # let the loop settle
+        adm.stop()
+    finally:
+        if adm._thread is not None:
+            adm.stop()
+        api.stop()
+    assert adm._thread is None
+    # Invariant: no half-gated gang remains.
+    states = {}
+    with api._lock:
+        pods = list(api.pods.values())
+    for pod in pods:
+        labels = pod["metadata"].get("labels") or {}
+        g = labels.get("tpu.google.com/gang-name")
+        if not g:
+            continue
+        gated = any(
+            x.get("name") == GATE_NAME
+            for x in pod["spec"].get("schedulingGates") or []
+        )
+        states.setdefault(g, set()).add(gated)
+    for g, flags in states.items():
+        assert len(flags) == 1, f"gang {g} half-released: {flags}"
