@@ -149,6 +149,13 @@ class GangAdmission:
         gangs: Dict[Tuple[str, str], List[dict]] = {}
         sizes: Dict[Tuple[str, str], int] = {}
         for pod in pods:
+            meta = pod.get("metadata") or {}
+            if meta.get("deletionTimestamp"):
+                # Terminating pods linger through their grace period on
+                # real clusters: counting one toward completeness could
+                # release a gang whose member is on its way out (or read
+                # a replacement's gang as oversized).
+                continue
             info = pod_gang(pod)
             if info is None:
                 continue
@@ -197,14 +204,34 @@ class GangAdmission:
                 )
                 continue
             if len(gated) < len(members):
-                # A previous release pass partially failed (patch error
-                # mid-gang): the all-or-nothing decision was already
-                # made, and leaving a remainder gated is the one outcome
-                # strictly worse than any other — finish the release.
-                log.warning(
-                    "gang %s/%s: finishing partial release (%d of %d "
-                    "still gated)", key[0], key[1], len(gated), size,
+                # Two distinct healthy-vs-broken shapes end here, and
+                # both want the gates gone without a fresh capacity
+                # check: (a) replacement pods joining a PLACED gang
+                # (some ungated member is scheduled) — requiring
+                # whole-gang capacity again would deadlock against the
+                # chips the gang itself holds, so release and let the
+                # replacement Pend until its member's chips free;
+                # (b) a release pass that failed mid-gang (no ungated
+                # member scheduled yet) — the all-or-nothing decision
+                # was already made, and a gated remainder is the one
+                # outcome strictly worse than any other.
+                placed = any(
+                    not is_gated(p)
+                    and (p.get("spec") or {}).get("nodeName")
+                    for p in members
                 )
+                if placed:
+                    log.info(
+                        "gang %s/%s: releasing %d replacement pod(s) "
+                        "joining a placed gang",
+                        key[0], key[1], len(gated),
+                    )
+                else:
+                    log.warning(
+                        "gang %s/%s: finishing partial release (%d of "
+                        "%d still gated)", key[0], key[1], len(gated),
+                        size,
+                    )
                 self._release(gated)
                 released.append(key)
                 continue
@@ -264,7 +291,17 @@ class GangAdmission:
             elif not gated:
                 status = "released"
             elif len(gated) < len(members):
-                status = "partial release in progress"
+                if any(
+                    not is_gated(p)
+                    and (p.get("spec") or {}).get("nodeName")
+                    for p in members
+                ):
+                    status = (
+                        "replacement joining placed gang: release due "
+                        "next resync"
+                    )
+                else:
+                    status = "partial release in progress"
             else:
                 consumed = self._fits(demands, topos)
                 if consumed is not None:

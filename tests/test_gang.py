@@ -415,3 +415,56 @@ def test_explain_threads_consumed_capacity_like_tick(api):
     by_name = {r["gang"]: r for r in adm.explain()}
     assert by_name["ga"]["status"].startswith("fits")
     assert by_name["gb"]["status"].startswith("blocked")
+
+
+def test_terminating_pods_do_not_count_toward_gang(api):
+    """A Terminating member (deletionTimestamp set, lingering through
+    its grace period) must not satisfy gang completeness — releasing a
+    gang whose member is on its way out would start a broken job; its
+    replacement pod completes the gang instead."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    server.add_pod(gang_pod("w0", "train", 2, 1))
+    dying = gang_pod("w1", "train", 2, 1)
+    dying["metadata"]["deletionTimestamp"] = "2026-07-30T00:00:00Z"
+    server.add_pod(dying)
+    adm = GangAdmission(client)
+    assert adm.tick() == []  # 1 live member of 2
+    # The replacement lands; the gang completes and releases.
+    server.add_pod(gang_pod("w1b", "train", 2, 1))
+    assert adm.tick() == [("default", "train")]
+    assert GATE_NAME in gates_of(server, "default", "w1")  # untouched
+
+
+def test_replacement_joining_placed_gang_releases_without_warning(
+    api, caplog
+):
+    """A running gang loses a member (terminating) and gets a gated
+    replacement: the replacement is released immediately — re-requiring
+    whole-gang capacity would deadlock against the chips the gang
+    itself holds — and it reads as a replacement join, not as a failed
+    partial release."""
+    import logging
+
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    # w0 running (ungated, scheduled), w1 terminating, w1b replacement.
+    w0 = gang_pod("w0", "train", 2, 1)
+    w0["spec"]["schedulingGates"] = []
+    w0["spec"]["nodeName"] = "n1"
+    server.add_pod(w0)
+    dying = gang_pod("w1", "train", 2, 1)
+    dying["metadata"]["deletionTimestamp"] = "2026-07-30T00:00:00Z"
+    server.add_pod(dying)
+    server.add_pod(gang_pod("w1b", "train", 2, 1))
+
+    adm = GangAdmission(client)
+    by_name = {r["gang"]: r for r in adm.explain()}
+    assert by_name["train"]["status"].startswith("replacement joining")
+    with caplog.at_level(logging.INFO):
+        assert adm.tick() == [("default", "train")]
+    assert GATE_NAME not in gates_of(server, "default", "w1b")
+    assert "replacement pod(s) joining a placed gang" in caplog.text
+    assert "finishing partial release" not in caplog.text
