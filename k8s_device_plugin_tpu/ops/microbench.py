@@ -128,6 +128,60 @@ def _attention_agreement(batch: int, heads: int, seq: int, d: int) -> dict:
     return {"max_abs_diff": round(max_diff, 5), "ok": max_diff < 0.05}
 
 
+def _xent_case(
+    rows: int, d: int, vocab: int, chunk: int, iters: int
+) -> dict:
+    """Chunked-vocab CE (ops/xent.py) vs the full-logits formulation,
+    fwd+bwd wrt (hidden, embed) — the training-path comparison at the
+    bench model's LM-head shape."""
+    from .xent import chunked_softmax_xent, reference_softmax_xent
+
+    key = jax.random.PRNGKey(3)
+    kh, ke, kt = jax.random.split(key, 3)
+    hidden = jax.random.normal(kh, (rows, d), jnp.bfloat16)
+    embed = jax.random.normal(ke, (vocab, d), jnp.float32) * 0.02
+    targets = jax.random.randint(kt, (rows,), 0, vocab)
+
+    chunked_step = jax.jit(
+        jax.grad(
+            lambda h, e: chunked_softmax_xent(h, e, targets, chunk),
+            argnums=(0, 1),
+        )
+    )
+    dense_step = jax.jit(
+        jax.grad(
+            lambda h, e: reference_softmax_xent(h, e, targets),
+            argnums=(0, 1),
+        )
+    )
+    out = {
+        "shape": [rows, d, vocab],
+        "chunk": chunk,
+        "chunked": _bench_side(lambda: chunked_step(hidden, embed), iters),
+        "dense": _bench_side(lambda: dense_step(hidden, embed), iters),
+    }
+    if "ms" in out["chunked"] and "ms" in out["dense"]:
+        out["speedup_vs_dense"] = round(
+            out["dense"]["ms"] / out["chunked"]["ms"], 3
+        )
+    # Same-loss guard at the timed shape (cheap: two forwards). Guarded:
+    # a dense-side OOM must cost only the guard, never the chunked
+    # side's timings — "dense cannot run at this shape" is itself the
+    # result the chunked op exists to demonstrate.
+    try:
+        a = float(jax.jit(
+            lambda h, e: chunked_softmax_xent(h, e, targets, chunk)
+        )(hidden, embed))
+        b = float(jax.jit(
+            lambda h, e: reference_softmax_xent(h, e, targets)
+        )(hidden, embed))
+        out["loss_abs_diff"] = round(abs(a - b), 6)
+        out["ok"] = abs(a - b) < 1e-2
+    except Exception as e:  # noqa: BLE001 — typically dense OOM
+        out["loss_guard_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    return out
+
+
 def _rmsnorm_case(rows: int, d: int, iters: int) -> dict:
     from .rmsnorm import rmsnorm
 
@@ -209,11 +263,20 @@ def run_microbench(
             60.0 if seq >= 8192 else 40.0,
         ))
     agree_seq = min(1024, seqs[-1])
+    # xent at the bench model's LM-head shape, scaled down with the
+    # attention seqs so CPU test runs stay cheap.
+    xv = 32768 if seqs[0] >= 2048 else 128
+    xr, xd, xc = (8192, 2048, 4096) if seqs[0] >= 2048 else (64, 32, 32)
     cases += [
         (
             "attention_agreement",
             lambda: _attention_agreement(1, 4, agree_seq, 128),
             15.0,
+        ),
+        (
+            f"xent_{xr}x{xd}x{xv}",
+            lambda: _xent_case(xr, xd, xv, xc, iters),
+            30.0,
         ),
         (
             "rmsnorm_%dx%d" % rmsnorm_shape,
@@ -231,11 +294,14 @@ def run_microbench(
             report["kernels"][name] = {
                 "error": f"{type(e).__name__}: {str(e)[:300]}"
             }
-        # Flip ok as soon as a failed agreement lands, BEFORE the
-        # streamed print: a timeout-harvested partial line must never
-        # say ok=true past a failed correctness check.
-        agreement = report["kernels"].get("attention_agreement", {})
-        if agreement.get("ok") is False:
+        # Flip ok as soon as any failed correctness guard lands, BEFORE
+        # the streamed print: a timeout-harvested partial line must
+        # never say ok=true past a failed check (attention agreement,
+        # xent same-loss).
+        if any(
+            case.get("ok") is False
+            for case in report["kernels"].values()
+        ):
             report["ok"] = False
         if stream:
             report["wall_s"] = round(time.monotonic() - t_start, 2)

@@ -1,0 +1,155 @@
+"""Chunked-vocabulary softmax cross-entropy (tied-embedding LM head).
+
+The plain training loss materializes the full logits tensor —
+``(batch*seq, vocab)`` f32, e.g. 8192x32768 = 1 GiB per step at the
+bench shape — writes it to HBM out of the unembed matmul, reads it back
+for log_softmax, and keeps it (or its recompute) alive for the backward.
+On TPU that traffic, not the matmul FLOPs, is the cost: HBM bandwidth is
+the bottleneck (pallas_guide.md).
+
+This op computes the identical loss with the vocabulary processed in
+chunks under ``lax.scan``: each step projects one ``(chunk, d)`` slab of
+the embedding, folds it into an online logsumexp (the flash-attention
+trick applied along the vocab axis), captures the target logit where it
+falls in the chunk, and discards the chunk's logits before the next step
+— peak logits residency drops from ``rows x vocab`` to ``rows x chunk``.
+The backward recomputes each chunk's logits from the saved (rows,)
+logsumexp and emits ``dh``/``dembed`` chunk-wise; nothing vocab-sized is
+ever resident beyond the embedding itself and its gradient.
+
+Pure jax (scan + matmuls): the MXU does the work and XLA pipelines the
+scan; a Pallas kernel would add nothing but maintenance. Sharding note:
+the win is for replicated/unsharded vocab (single chip, fsdp); under
+tensor-parallel vocab sharding the standard path's logits are already
+sharded ``1/tp``-sized and XLA's sharded softmax is the right tool.
+
+No reference counterpart (the reference has no ML code); this is the
+repo's own §6 perf bar. Measured by ops/microbench.py ("xent" case).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_softmax_xent(
+    hidden: jax.Array,
+    embed: jax.Array,
+    targets: jax.Array,
+    chunk: int,
+) -> jax.Array:
+    """Mean next-token NLL: ``mean(logsumexp(h@E^T) - (h@E^T)[target])``.
+
+    hidden: (..., d) activations (any leading shape); embed: (vocab, d)
+    tied embedding; targets: (...) int labels, same leading shape as
+    hidden. ``vocab`` must be a multiple of ``chunk``.
+    """
+    loss, _ = _xent_fwd_core(hidden, embed, targets, chunk)
+    return loss
+
+
+def _flatten(hidden, targets):
+    d = hidden.shape[-1]
+    return (
+        hidden.reshape(-1, d).astype(jnp.float32),
+        targets.reshape(-1),
+    )
+
+
+def _embed3(embed, chunk):
+    vocab, d = embed.shape
+    if vocab % chunk != 0:
+        raise ValueError(f"vocab {vocab} not a multiple of chunk {chunk}")
+    return embed.astype(jnp.float32).reshape(vocab // chunk, chunk, d)
+
+
+def _xent_fwd_core(hidden, embed, targets, chunk):
+    h2, t1 = _flatten(hidden, targets)
+    rows = h2.shape[0]
+    e3 = _embed3(embed, chunk)
+
+    def step(carry, inp):
+        m, s, tl = carry
+        idx, emb_c = inp
+        logits = h2 @ emb_c.T  # (rows, chunk) f32 — transient
+        cm = jnp.max(logits, axis=1)
+        nm = jnp.maximum(m, cm)
+        s = s * jnp.exp(m - nm) + jnp.sum(
+            jnp.exp(logits - nm[:, None]), axis=1
+        )
+        base = idx * chunk
+        local = jnp.clip(t1 - base, 0, chunk - 1)
+        t_logit = jnp.take_along_axis(logits, local[:, None], axis=1)[:, 0]
+        in_chunk = (t1 >= base) & (t1 < base + chunk)
+        tl = jnp.where(in_chunk, t_logit, tl)
+        return (nm, s, tl), None
+
+    init = (
+        jnp.full((rows,), -jnp.inf, jnp.float32),
+        jnp.zeros((rows,), jnp.float32),
+        jnp.zeros((rows,), jnp.float32),
+    )
+    (m, s, tl), _ = lax.scan(
+        step, init, (jnp.arange(e3.shape[0]), e3)
+    )
+    lse = m + jnp.log(s)
+    return jnp.mean(lse - tl), lse
+
+
+def _xent_vjp_fwd(hidden, embed, targets, chunk):
+    loss, lse = _xent_fwd_core(hidden, embed, targets, chunk)
+    return loss, (hidden, embed, targets, lse)
+
+
+def _xent_vjp_bwd(chunk, res, g):
+    hidden, embed, targets, lse = res
+    h2, t1 = _flatten(hidden, targets)
+    rows = h2.shape[0]
+    e3 = _embed3(embed, chunk)
+    scale = g / rows  # d(mean)/d(per-row nll)
+
+    def step(dh, inp):
+        idx, emb_c = inp
+        logits = h2 @ emb_c.T
+        p = jnp.exp(logits - lse[:, None])  # softmax over full vocab
+        base = idx * chunk
+        local = t1 - base
+        in_chunk = (local >= 0) & (local < chunk)
+        onehot = (
+            jax.nn.one_hot(
+                jnp.clip(local, 0, chunk - 1), chunk, dtype=jnp.float32
+            )
+            * in_chunk[:, None]
+        )
+        dlogits = (p - onehot) * scale
+        dh = dh + dlogits @ emb_c
+        demb_c = dlogits.T @ h2  # (chunk, d)
+        return dh, demb_c
+
+    dh2, demb3 = lax.scan(
+        step,
+        jnp.zeros_like(h2),
+        (jnp.arange(e3.shape[0]), e3),
+    )
+    dhidden = dh2.reshape(hidden.shape).astype(hidden.dtype)
+    dembed = demb3.reshape(embed.shape).astype(embed.dtype)
+    return dhidden, dembed, None
+
+
+chunked_softmax_xent.defvjp(_xent_vjp_fwd, _xent_vjp_bwd)
+
+
+def reference_softmax_xent(hidden, embed, targets):
+    """The materialize-everything formulation (correctness oracle and
+    microbench baseline): full logits, log_softmax, gather."""
+    logits = jnp.einsum(
+        "...d,vd->...v", hidden.astype(jnp.float32), embed.astype(jnp.float32)
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

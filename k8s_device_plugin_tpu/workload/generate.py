@@ -39,6 +39,12 @@ def greedy_generate(
             f"prompt {prompt_len} + steps {steps} exceeds max_seq_len "
             f"{cfg.max_seq_len}"
         )
+    if cfg.xent_chunk > 0:
+        # Chunked CE is a training-loss concern: it makes forward()
+        # return hidden states, but decoding needs logits — strip it.
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, xent_chunk=0)
     run = _compiled_decode(cfg, batch, prompt_len, steps)
     return run(params, prompt)
 
@@ -173,11 +179,17 @@ def run_generation_smoke(
     steps: int = 8,
     seed: int = 0,
 ) -> dict:
+    import dataclasses
     import time
 
     from .model import init_params
 
     cfg = cfg or ModelConfig.tiny()
+    if cfg.xent_chunk > 0:
+        # Training-loss concern only: every path below (full decode, KV
+        # decode, prefill-logits comparison) needs the model to return
+        # LOGITS. Strip once here so no sub-path can see hidden states.
+        cfg = dataclasses.replace(cfg, xent_chunk=0)
     params = init_params(cfg, jax.random.PRNGKey(seed))
     prompt = jax.random.randint(
         jax.random.PRNGKey(seed + 1), (batch, prompt_len), 0, cfg.vocab_size

@@ -66,6 +66,12 @@ class ModelConfig:
     # q-chunk size for ring attention (0 = unchunked): caps each ring
     # step's score tile at [q_chunk, s_local] for long-context shards.
     ring_q_chunk: int = 0
+    # Chunked-vocab cross-entropy (ops/xent.py): > 0 makes forward()
+    # return final HIDDEN states and the training loss fold the tied
+    # unembedding chunk-wise — full (rows, vocab) logits are never
+    # materialized (HBM-residency win at large vocab). Training-loss
+    # concern only; the generation paths strip it (they need logits).
+    xent_chunk: int = 0
     # Expert parallelism: n_experts > 0 replaces the dense MLP with a
     # routed MoE (workload/moe.py) whose expert dim shards over the mesh's
     # ``expert`` axis. Aux load-balance loss is sown and picked up by
@@ -135,6 +141,11 @@ class ModelConfig:
                     "pipeline_microbatches requires pipe_mesh (the training "
                     "mesh whose pipe axis carries the stages)"
                 )
+        if self.xent_chunk > 0 and self.vocab_size % self.xent_chunk != 0:
+            raise ValueError(
+                f"xent_chunk {self.xent_chunk} must divide vocab_size "
+                f"{self.vocab_size}"
+            )
 
     @staticmethod
     def tiny() -> "ModelConfig":
@@ -418,6 +429,11 @@ class TransformerLM(nn.Module):
             for _ in range(cfg.n_layers):
                 x = Block(cfg)(x)
         x = Norm(cfg)(x)
+        if cfg.xent_chunk > 0 and not cfg.decode:
+            # Chunked-CE training: the loss folds the unembedding
+            # chunk-wise (ops/xent.py); returning logits here would
+            # materialize exactly the tensor the option exists to avoid.
+            return x
         return unembed(x, embed)
 
 
@@ -487,4 +503,6 @@ def forward_pipelined(cfg: ModelConfig, params, tokens):
         stage_fn, stage_params, x, cfg.pipe_mesh, cfg.pipeline_microbatches
     )
     x = Norm(cfg).apply({"params": params["Norm_0"]}, x)
+    if cfg.xent_chunk > 0:
+        return x  # hidden states; the loss unembeds chunk-wise
     return unembed(x, embed)

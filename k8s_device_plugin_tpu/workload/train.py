@@ -24,10 +24,21 @@ from .model import ModelConfig, TransformerLM, forward_with_aux
 
 def loss_fn(cfg: ModelConfig, params, tokens) -> jax.Array:
     """Next-token cross-entropy (last position predicts nothing), plus the
-    MoE load-balance aux loss when the model routes experts."""
-    logits, aux = forward_with_aux(cfg, params, tokens)
+    MoE load-balance aux loss when the model routes experts.
+
+    With cfg.xent_chunk > 0 the forward returns final hidden states and
+    the tied unembedding folds into a chunked-vocab CE (ops/xent.py) —
+    the (rows, vocab) logits tensor is never materialized."""
+    out, aux = forward_with_aux(cfg, params, tokens)
     targets = tokens[:, 1:]
-    logits = logits[:, :-1]
+    if cfg.xent_chunk > 0:
+        from ..ops.xent import chunked_softmax_xent
+
+        nll = chunked_softmax_xent(
+            out[:, :-1], params["embed"], targets, cfg.xent_chunk
+        )
+        return nll + cfg.moe_aux_weight * aux
+    logits = out[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll) + cfg.moe_aux_weight * aux

@@ -217,8 +217,10 @@ def test_microbench_tiny_shapes_reports_all_cases():
     assert r["backend"] == "cpu"
     k = r["kernels"]
     assert set(k) == {
-        "attention_seq128", "attention_agreement", "rmsnorm_64x128",
+        "attention_seq128", "attention_agreement", "xent_64x32x128",
+        "rmsnorm_64x128",
     }
+    assert k["xent_64x32x128"]["ok"] is True
     assert k["attention_agreement"]["ok"] is True
     assert "speedup_vs_dense" in k["attention_seq128"]
     assert "speedup_vs_xla" in k["rmsnorm_64x128"]
@@ -231,3 +233,98 @@ def test_microbench_budget_skips_are_recorded():
     r = run_microbench(iters=1, budget_s=0.001, seqs=[128])
     assert all("skipped" in v for v in r["kernels"].values())
     assert r["ok"] is True  # skipped-for-budget is not a failure
+
+
+def test_chunked_xent_matches_reference_fwd_and_grads():
+    """The chunked-vocab CE must equal the full-logits formulation in
+    value and in gradients wrt both hidden states and the embedding —
+    including targets landing in first/last chunks."""
+    from k8s_device_plugin_tpu.ops.xent import (
+        chunked_softmax_xent,
+        reference_softmax_xent,
+    )
+
+    rows, d, vocab, chunk = 48, 16, 96, 32
+    kh, ke, kt = jax.random.split(jax.random.PRNGKey(0), 3)
+    hidden = jax.random.normal(kh, (6, 8, d), jnp.float32)
+    embed = jax.random.normal(ke, (vocab, d), jnp.float32) * 0.1
+    targets = jnp.concatenate(
+        [jnp.array([0, vocab - 1, 31, 32]),
+         jax.random.randint(kt, (rows - 4,), 0, vocab)]
+    ).reshape(6, 8)
+
+    a = chunked_softmax_xent(hidden, embed, targets, chunk)
+    b = reference_softmax_xent(hidden, embed, targets)
+    assert abs(float(a) - float(b)) < 1e-5
+
+    ga = jax.grad(
+        lambda h, e: chunked_softmax_xent(h, e, targets, chunk),
+        argnums=(0, 1),
+    )(hidden, embed)
+    gb = jax.grad(
+        lambda h, e: reference_softmax_xent(h, e, targets), argnums=(0, 1)
+    )(hidden, embed)
+    for x, y in zip(ga, gb):
+        assert jnp.max(jnp.abs(x - y)) < 1e-5, (x.shape, float(jnp.max(jnp.abs(x - y))))
+
+
+def test_chunked_xent_rejects_bad_chunk():
+    import pytest as _pytest
+
+    from k8s_device_plugin_tpu.ops.xent import chunked_softmax_xent
+
+    h = jnp.zeros((4, 8), jnp.float32)
+    e = jnp.zeros((100, 8), jnp.float32)
+    t = jnp.zeros((4,), jnp.int32)
+    with _pytest.raises(ValueError, match="not a multiple"):
+        chunked_softmax_xent(h, e, t, 32)
+
+
+def test_train_with_chunked_xent_matches_plain_loss_and_learns():
+    """A train step under xent_chunk computes the same loss as the plain
+    path (same params/tokens) and still learns; generation on the same
+    config strips the flag and produces tokens."""
+    import dataclasses
+
+    from k8s_device_plugin_tpu.parallel.mesh import batch_sharding, make_mesh
+    from k8s_device_plugin_tpu.workload import train
+    from k8s_device_plugin_tpu.workload.generate import greedy_generate
+
+    base = ModelConfig.tiny()
+    chunked = dataclasses.replace(base, xent_chunk=32)
+    mesh = make_mesh(jax.devices()[:2], shape=(1, 2, 1))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (4, base.max_seq_len), 0, base.vocab_size
+    )
+    params, opt_state, tx = train.make_train_state(
+        chunked, mesh, jax.random.PRNGKey(0)
+    )
+    plain_loss = float(train.loss_fn(base, params, tokens))
+    chunk_loss = float(train.loss_fn(chunked, params, tokens))
+    assert abs(plain_loss - chunk_loss) < 1e-4
+
+    step = train.make_train_step(chunked, mesh, tx)
+    sharded = jax.device_put(tokens, batch_sharding(mesh))
+    p, o, first = step(params, opt_state, sharded)
+    for _ in range(5):
+        p, o, loss = step(p, o, sharded)
+    assert float(loss) < float(first)
+
+    out = greedy_generate(chunked, p, tokens[:, :8], steps=4)
+    assert out.shape == (4, 12)
+
+
+def test_generation_smoke_strips_xent_chunk():
+    """run_generation_smoke on a chunked-CE training config must strip
+    the flag for every sub-path (full decode, KV decode, prefill-logits
+    comparison all need logits, not hidden states)."""
+    import dataclasses
+
+    from k8s_device_plugin_tpu.workload.generate import run_generation_smoke
+
+    cfg = dataclasses.replace(ModelConfig.tiny(), xent_chunk=32)
+    report = run_generation_smoke(cfg, batch=2, prompt_len=4, steps=4)
+    assert report["tokens_in_vocab"]
+    assert report["prompt_preserved"]
+    # tiny() is kv-decode-supported, so the full correctness verdict ran.
+    assert report["ok"] is True
