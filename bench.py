@@ -340,6 +340,43 @@ def main() -> int:
             result["error"] = "control plane failed"
         emit()
 
+        # Phase 2.5: A/B the chunked-vocab CE (ops/xent.py) on the real
+        # chip when the main smoke succeeded and budget allows — the
+        # decisive number for whether the bench model should train with
+        # it. Short run (compile + a few windows), same batch shape.
+        if (
+            cp is not None
+            and smoke.get("ok")
+            and _budget_left() > 100
+            and os.environ.get("BENCH_SKIP_XENT_AB") != "1"
+        ):
+            ab, err = _run_accel_subprocess(
+                [
+                    "k8s_device_plugin_tpu.workload.smoke",
+                    "--bench", "--steps", "40", "--batch-per-device", "4",
+                    "--inner-steps", "20", "--xent-chunk", "4096",
+                ],
+                min(90.0, _budget_left() - 40),
+                {},
+            )
+            if ab is not None and "error" not in ab:
+                result["detail"]["workload_chunked_xent"] = {
+                    "step_time_s": ab.get("step_time_s"),
+                    "mfu": ab.get("mfu"),
+                    "ok": ab.get("ok"),
+                    "vs_plain_step": (
+                        round(
+                            smoke["step_time_s"] / ab["step_time_s"], 3
+                        )
+                        if ab.get("step_time_s") else None
+                    ),
+                }
+            else:
+                result["detail"]["workload_chunked_xent"] = {
+                    "error": err or ab.get("error", "failed")
+                }
+            emit()
+
         # Phase 3: kernel microbench (VERDICT r2 #4) with leftover budget.
         result["detail"]["kernels"] = run_kernels()
         result["detail"]["budget"] = {

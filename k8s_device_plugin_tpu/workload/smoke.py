@@ -70,6 +70,7 @@ def run_smoke(
     batch_per_device: int = 8,
     seed: int = 0,
     inner_steps: int = 1,
+    xent_chunk: int = 0,
 ) -> dict:
     """inner_steps > 1 runs the step loop device-side via
     train.make_multi_train_step (lax.scan over real sequential updates):
@@ -84,6 +85,10 @@ def run_smoke(
     expected = expected_chip_count()
 
     cfg = cfg or ModelConfig()
+    if xent_chunk:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, xent_chunk=xent_chunk)
     mesh = make_mesh(devices)
     params, opt_state, tx = train.make_train_state(
         cfg, mesh, jax.random.PRNGKey(seed)
@@ -185,6 +190,7 @@ def run_smoke(
             max(t_first_step - (inner_steps - 1) * step_time, 0.0), 3
         ),
         "inner_steps": inner_steps,
+        "xent_chunk": cfg.xent_chunk,
         "step_time_s": round(step_time, 5),
         "tokens_per_s": round(batch * cfg.max_seq_len / step_time, 1),
         "model_flops_per_step": flops_step,
@@ -216,12 +222,18 @@ def main(argv=None) -> int:
         "--bench", action="store_true",
         help="use the MXU-stressing ModelConfig.bench() shape",
     )
+    p.add_argument(
+        "--xent-chunk", type=int, default=0,
+        help="train with the chunked-vocab CE (ops/xent.py) at this "
+        "chunk size (0 = full-logits loss)",
+    )
     args = p.parse_args(argv)
     report = run_smoke(
         steps=args.steps,
         cfg=ModelConfig.bench() if args.bench else None,
         batch_per_device=args.batch_per_device,
         inner_steps=args.inner_steps,
+        xent_chunk=args.xent_chunk,
     )
     print(json.dumps(report))
     return 0 if report["ok"] else 1
