@@ -37,6 +37,7 @@ the reference's extender model (score-one-node-at-a-time,
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import threading
@@ -88,6 +89,48 @@ def pod_gang(pod: dict) -> Optional[Tuple[str, str, int]]:
     return (meta.get("namespace", "default"), name, size)
 
 
+@dataclasses.dataclass
+class GangView:
+    """One gang's membership as discovered in a single pass.
+
+    ``live`` are pods the scheduler could still act on; ``standins`` are
+    finished (Succeeded/Failed) pods topping membership up to the
+    declared size until replacements exist. The split matters: a
+    stand-in's stale nodeName holds no chips, so stand-ins never count
+    as "placed"."""
+
+    size: int
+    live: List[dict]
+    standins: List[dict]
+
+    @property
+    def members(self) -> List[dict]:
+        return self.live + self.standins
+
+    @property
+    def gated(self) -> List[dict]:
+        return [p for p in self.live if is_gated(p)]
+
+    @property
+    def ungated_live(self) -> List[dict]:
+        return [p for p in self.live if not is_gated(p)]
+
+    def demands(self, resource_name: str) -> List[int]:
+        """Chip demands for the whole-gang capacity check: live members
+        plus Failed stand-ins (their replacements are coming and will
+        need chips). Succeeded stand-ins contribute nothing — their
+        work is done, no replacement will be created, and counting them
+        would hold a partially-released gang hostage to capacity it no
+        longer needs (the gated-remainder wedge, re-created)."""
+        out = [tpu_request(p, resource_name) for p in self.live]
+        out += [
+            tpu_request(p, resource_name)
+            for p in self.standins
+            if (p.get("status") or {}).get("phase") == "Failed"
+        ]
+        return out
+
+
 class GangAdmission:
     """Scheduling-gate lifter for TPU pod gangs."""
 
@@ -133,20 +176,34 @@ class GangAdmission:
 
     # -- one evaluation pass ----------------------------------------------
 
-    def _collect_gangs(
-        self,
-    ) -> Tuple[Dict[Tuple[str, str], List[dict]], Dict[Tuple[str, str], int]]:
-        """Gang-labeled pods grouped by (namespace, gang_name), plus the
-        declared sizes. The ONE discovery path tick() and explain()
-        share — drift between them would re-open tool-vs-controller
-        divergence. Server-side filtering: only gang-labeled pods come
-        back (an existence selector on the gang-name key) — a flat list
-        of the whole cluster's pods every resync would be sustained
-        apiserver load for nothing."""
+    def _collect_gangs(self) -> Dict[Tuple[str, str], "GangView"]:
+        """Gang-labeled pods grouped by (namespace, gang_name) into
+        GangViews. The ONE discovery path tick() and explain() share —
+        drift between them would re-open tool-vs-controller divergence.
+        Server-side filtering: only gang-labeled pods come back (an
+        existence selector on the gang-name key) — a flat list of the
+        whole cluster's pods every resync would be sustained apiserver
+        load for nothing.
+
+        Finished pods (phase Succeeded/Failed) are second-class members:
+        with restartPolicy Never they linger undeleted, so counting one
+        alongside its replacement would read the gang as oversized and
+        keep the replacement gated forever. But dropping them outright
+        breaks the partial-release recovery pod_gang documents — a
+        size-2 gang whose released member Failed with no replacement yet
+        would read 1/2 present and its gated peer would wedge. So: live
+        pods form the membership, and finished pods top it up only to
+        the declared size (standing in until a replacement exists,
+        stepping aside once one does). GangView keeps the live/stand-in
+        split because stand-ins must NOT count as placed — a dead pod's
+        stale nodeName holds no chips, and treating it as placed would
+        let replacements skip the whole-gang capacity check one by one
+        after a full-gang crash."""
         pods = self.client.list_pods(
             label_selector=GANG_NAME_LABEL
         ).get("items", [])
-        gangs: Dict[Tuple[str, str], List[dict]] = {}
+        live: Dict[Tuple[str, str], List[dict]] = {}
+        finished: Dict[Tuple[str, str], List[dict]] = {}
         sizes: Dict[Tuple[str, str], int] = {}
         for pod in pods:
             meta = pod.get("metadata") or {}
@@ -160,14 +217,31 @@ class GangAdmission:
             if info is None:
                 continue
             ns, name, size = info
-            gangs.setdefault((ns, name), []).append(pod)
-            sizes[(ns, name)] = size
-        return gangs, sizes
+            key = (ns, name)
+            if (pod.get("status") or {}).get("phase") in (
+                "Succeeded", "Failed",
+            ):
+                finished.setdefault(key, []).append(pod)
+            else:
+                live.setdefault(key, []).append(pod)
+            sizes[key] = size
+        views: Dict[Tuple[str, str], GangView] = {}
+        for key, size in sizes.items():
+            alive = live.get(key, [])
+            done = sorted(
+                finished.get(key, []),
+                key=lambda p: (p.get("metadata") or {}).get("name", ""),
+            )  # deterministic stand-in pick across resyncs
+            short = max(0, size - len(alive))
+            views[key] = GangView(
+                size=size, live=alive, standins=done[:short]
+            )
+        return views
 
     def tick(self) -> List[Tuple[str, str]]:
         """Evaluate every complete gang once; returns the (namespace,
         gang_name) pairs released this pass (test observability)."""
-        gangs, sizes = self._collect_gangs()
+        gangs = self._collect_gangs()
         # Prune the logged-waiting markers of gangs that vanished or
         # changed shape — the set must not grow without bound.
         self._reported_waiting = {
@@ -185,29 +259,29 @@ class GangAdmission:
         topos = self._node_topologies()
         released = []
         waiting_now = 0
-        for key, members in sorted(gangs.items()):
-            size = sizes[key]
-            gated = [p for p in members if is_gated(p)]
+        for key, gv in sorted(gangs.items()):
+            gated = gv.gated
             if not gated:
                 continue  # fully released; nothing to do
-            if len(members) < size:
+            members = gv.members
+            if len(members) < gv.size:
                 log.debug(
                     "gang %s/%s: %d/%d pods present; waiting",
-                    key[0], key[1], len(members), size,
+                    key[0], key[1], len(members), gv.size,
                 )
                 continue
-            if len(members) > size:
+            if len(members) > gv.size:
                 log.warning(
                     "gang %s/%s: %d pods exceed declared size %d; "
                     "refusing to release (misconfigured gang)",
-                    key[0], key[1], len(members), size,
+                    key[0], key[1], len(members), gv.size,
                 )
                 continue
-            if len(gated) < len(members):
+            if gv.ungated_live:
                 # Two distinct healthy-vs-broken shapes end here, and
                 # both want the gates gone without a fresh capacity
                 # check: (a) replacement pods joining a PLACED gang
-                # (some ungated member is scheduled) — requiring
+                # (some LIVE ungated member is scheduled) — requiring
                 # whole-gang capacity again would deadlock against the
                 # chips the gang itself holds, so release and let the
                 # replacement Pend until its member's chips free;
@@ -215,10 +289,14 @@ class GangAdmission:
                 # member scheduled yet) — the all-or-nothing decision
                 # was already made, and a gated remainder is the one
                 # outcome strictly worse than any other.
+                # Stand-ins never reach here: a finished pod's stale
+                # nodeName holds no chips, so a gang whose only ungated
+                # slots are stand-ins (whole-gang crash, replacements
+                # arriving one by one) takes the full capacity check
+                # below instead of leaking out gate-by-gate.
                 placed = any(
-                    not is_gated(p)
-                    and (p.get("spec") or {}).get("nodeName")
-                    for p in members
+                    (p.get("spec") or {}).get("nodeName")
+                    for p in gv.ungated_live
                 )
                 if placed:
                     log.info(
@@ -230,14 +308,17 @@ class GangAdmission:
                     log.warning(
                         "gang %s/%s: finishing partial release (%d of "
                         "%d still gated)", key[0], key[1], len(gated),
-                        size,
+                        gv.size,
                     )
                 self._release(gated)
                 released.append(key)
                 continue
-            demands = [
-                tpu_request(p, self.resource_name) for p in members
-            ]
+            # Whole-gang capacity check over live + Failed-stand-in
+            # demands (GangView.demands): a restarted gang only starts
+            # releasing into capacity that can hold ALL of it, while a
+            # Succeeded member's finished work no longer holds the
+            # remainder hostage.
+            demands = gv.demands(self.resource_name)
             consumed = self._fits(demands, topos)
             if consumed is None:
                 waiting_now += 1
@@ -258,7 +339,7 @@ class GangAdmission:
             released.append(key)
             log.info(
                 "gang %s/%s released: %d pods, demand %s",
-                key[0], key[1], size, demands,
+                key[0], key[1], gv.size, demands,
             )
         metrics.GANG_WAITING.set(waiting_now)
         for _ in released:
@@ -274,27 +355,26 @@ class GangAdmission:
         releases in — two gangs competing for one node's chips read
         "fits" and "blocked", exactly what the controller will do, not
         two optimistic "fits"."""
-        gangs, sizes = self._collect_gangs()
+        gangs = self._collect_gangs()
         topos = self._node_topologies()
         reports = []
-        for key, members in sorted(gangs.items()):
-            size = sizes[key]
-            gated = [p for p in members if is_gated(p)]
-            demands = [tpu_request(p, self.resource_name) for p in members]
-            if len(members) < size:
-                status = f"waiting: {len(members)}/{size} pods exist"
-            elif len(members) > size:
+        for key, gv in sorted(gangs.items()):
+            members = gv.members
+            gated = gv.gated
+            demands = gv.demands(self.resource_name)
+            if len(members) < gv.size:
+                status = f"waiting: {len(members)}/{gv.size} pods exist"
+            elif len(members) > gv.size:
                 status = (
                     f"misconfigured: {len(members)} pods exceed "
-                    f"declared size {size}"
+                    f"declared size {gv.size}"
                 )
             elif not gated:
                 status = "released"
-            elif len(gated) < len(members):
+            elif gv.ungated_live:
                 if any(
-                    not is_gated(p)
-                    and (p.get("spec") or {}).get("nodeName")
-                    for p in members
+                    (p.get("spec") or {}).get("nodeName")
+                    for p in gv.ungated_live
                 ):
                     status = (
                         "replacement joining placed gang: release due "
@@ -315,7 +395,7 @@ class GangAdmission:
             reports.append({
                 "namespace": key[0],
                 "gang": key[1],
-                "size": size,
+                "size": gv.size,
                 "pods": len(members),
                 "gated": len(gated),
                 "demands": demands,
@@ -410,19 +490,38 @@ class GangAdmission:
 
     def _release(self, members: List[dict]) -> None:
         """Remove the gang gate from every member. Best-effort per pod:
-        a failed patch is retried on the next resync (the gate is only
-        ever removed, so re-processing released pods is a no-op — they
-        no longer match pod_gang)."""
+        a failed patch is retried on the next resync (released pods
+        keep their gang labels — deliberately, see pod_gang — so they
+        still match discovery; what keeps them from being re-processed
+        is tick()'s is_gated filter).
+
+        The removal is a guarded JSON Patch (test-at-index + remove),
+        not a wholesale list replace: a gate another controller added
+        between our list and this patch shifts the index, fails the
+        test, and we re-read the live pod and retry against its current
+        gate list instead of silently dropping the foreign gate."""
         for pod in members:
             meta = pod.get("metadata") or {}
             ns = meta.get("namespace", "default")
             name = meta.get("name", "")
             gates = (pod.get("spec") or {}).get("schedulingGates") or []
-            remaining = [g for g in gates if g.get("name") != GATE_NAME]
             try:
-                self.client.replace_pod_scheduling_gates(ns, name, remaining)
+                self._remove_gate(ns, name, gates)
             except Exception as e:  # noqa: BLE001 — retried next resync
                 log.warning(
                     "gate removal for %s/%s failed (retrying next "
                     "resync): %s", ns, name, e,
                 )
+
+    def _remove_gate(self, ns: str, name: str, gates: List[dict]) -> None:
+        try:
+            self.client.remove_pod_scheduling_gate(ns, name, GATE_NAME, gates)
+            return
+        except ValueError:
+            return  # snapshot says already removed; nothing to do
+        except Exception:  # noqa: BLE001 — concurrent gate-list change
+            live = self.client.get_pod(ns, name)
+        live_gates = (live.get("spec") or {}).get("schedulingGates") or []
+        if not any(g.get("name") == GATE_NAME for g in live_gates):
+            return  # someone else removed it; released either way
+        self.client.remove_pod_scheduling_gate(ns, name, GATE_NAME, live_gates)

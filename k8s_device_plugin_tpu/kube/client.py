@@ -372,17 +372,44 @@ class KubeClient:
         body = {"metadata": {"annotations": annotations}}
         return self.patch(f"/api/v1/namespaces/{namespace}/pods/{name}", body)
 
-    def replace_pod_scheduling_gates(
-        self, namespace: str, name: str, gates: List[dict]
+    def get_pod(self, namespace: str, name: str) -> dict:
+        return self.get(f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def remove_pod_scheduling_gate(
+        self, namespace: str, name: str, gate_name: str, gates: List[dict]
     ) -> dict:
-        """Replace spec.schedulingGates wholesale (JSON Patch).
+        """Remove ONE named gate with a guarded JSON Patch.
 
         Gate removal is the one pod-spec mutation the API server permits
         on a running object, and strategic merge cannot DELETE list
-        entries — replacing the list is the supported shape (what the
-        gang-admission controller uses to release a gang)."""
-        ops = [{"op": "replace", "path": "/spec/schedulingGates",
-                "value": gates}]
+        entries — JSON Patch is the supported shape.
+
+        ``gates`` is the caller's snapshot of spec.schedulingGates; the
+        patch is a ``test`` op asserting the gate's name still sits at
+        the snapshot index, followed by a targeted ``remove`` of that
+        index. A gate added or removed by another controller between the
+        snapshot and the patch shifts the index, fails the ``test``, and
+        surfaces as KubeError — the caller re-reads and retries instead
+        of clobbering the other controller's gate (which the wholesale
+        replace would). Raises ValueError when the snapshot has no such
+        gate (nothing to remove)."""
+        idx = next(
+            (i for i, g in enumerate(gates) if g.get("name") == gate_name),
+            None,
+        )
+        if idx is None:
+            raise ValueError(
+                f"gate {gate_name!r} not present in snapshot for "
+                f"{namespace}/{name}"
+            )
+        ops = [
+            {
+                "op": "test",
+                "path": f"/spec/schedulingGates/{idx}/name",
+                "value": gate_name,
+            },
+            {"op": "remove", "path": f"/spec/schedulingGates/{idx}"},
+        ]
         return self._request(
             "PATCH",
             f"/api/v1/namespaces/{namespace}/pods/{name}",

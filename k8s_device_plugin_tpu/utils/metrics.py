@@ -125,9 +125,13 @@ class Histogram:
 
 
 class Registry:
-    def __init__(self):
+    def __init__(self, uptime_name: str = "tpu_plugin_uptime_seconds"):
+        # Per-registry uptime family name: the extender's registry must
+        # not export a tpu_plugin_* metric (the cross-process pollution
+        # the separate registry exists to prevent).
         self._metrics: Dict[str, Metric] = {}
         self._start = time.time()
+        self._uptime_name = uptime_name
 
     def counter(self, name: str, help_text: str) -> Metric:
         return self._register(name, help_text, "counter")
@@ -149,9 +153,10 @@ class Registry:
     def render(self) -> str:
         parts = [m.render() for m in self._metrics.values()]
         parts.append(
-            "# HELP tpu_plugin_uptime_seconds Seconds since plugin start\n"
-            "# TYPE tpu_plugin_uptime_seconds gauge\n"
-            f"tpu_plugin_uptime_seconds {_fmt(round(time.time() - self._start, 1))}"
+            f"# HELP {self._uptime_name} Seconds since process start\n"
+            f"# TYPE {self._uptime_name} gauge\n"
+            f"{self._uptime_name} "
+            f"{_fmt(round(time.time() - self._start, 1))}"
         )
         return "\n".join(parts) + "\n"
 
@@ -209,7 +214,7 @@ DRA_PREPARED = REGISTRY.gauge(
 # The extender/gang-admission process exposes its own registry: sharing
 # the daemon's would publish every tpu_plugin_* family as constant zeros
 # from the extender Service, polluting sum()s and alerts across scrapes.
-EXTENDER_REGISTRY = Registry()
+EXTENDER_REGISTRY = Registry(uptime_name="tpu_extender_uptime_seconds")
 EXTENDER_REQUESTS = EXTENDER_REGISTRY.counter(
     "tpu_extender_requests_total",
     "Scheduler-extender HTTP requests served, by verb (filter/"

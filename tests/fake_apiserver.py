@@ -7,12 +7,21 @@ pod/node PATCH. State mutations emit watch events like the real API server.
 
 from __future__ import annotations
 
+import copy
 import json
 import queue
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
+
+
+class _JsonPatchTestFailed(Exception):
+    pass
+
+
+class _JsonPatchUnsupported(Exception):
+    pass
 
 
 class FakeApiServer:
@@ -31,6 +40,10 @@ class FakeApiServer:
         self.resourceslices: Dict[str, dict] = {}
         self.resourceclaims: Dict[Tuple[str, str], dict] = {}
         self.pod_patches: List[Tuple[str, str, dict]] = []
+        # JSON patches rejected (failed test op / bad path): lets tests
+        # distinguish "guarded attempt failed then correctly no-opped"
+        # from "no attempt at all".
+        self.rejected_pod_patches: List[Tuple[str, str, list]] = []
         self.node_patches: List[Tuple[str, dict]] = []
         self.node_status_patches: List[Tuple[str, dict]] = []
         self.events: List[dict] = []
@@ -139,6 +152,20 @@ class FakeApiServer:
                         )
                     else:
                         server._send_json(self, node)
+                elif parsed.path.startswith("/api/v1/namespaces/"):
+                    parts = parsed.path.strip("/").split("/")
+                    # api/v1/namespaces/{ns}/pods/{name}
+                    if len(parts) == 6 and parts[4] == "pods":
+                        with server._lock:
+                            pod = server.pods.get((parts[3], parts[5]))
+                        if pod is None:
+                            server._send_json(
+                                self, {"message": "pod not found"}, 404
+                            )
+                        else:
+                            server._send_json(self, pod)
+                    else:
+                        self.send_error(404)
                 else:
                     self.send_error(404)
 
@@ -463,8 +490,11 @@ class FakeApiServer:
         self._send_json(handler, pod)
 
     def _json_patch_pod(self, handler, ns, name, ops):
-        """RFC-6902 subset (replace/remove/add on simple paths) — enough
-        for what KubeClient emits (scheduling-gate replacement)."""
+        """RFC-6902 subset (test/replace/remove/add, list indices) —
+        enough for what KubeClient emits (scheduling-gate replacement and
+        the guarded test+remove of one gate). A failed ``test`` rejects
+        the whole patch with 422 and no mutation, mirroring the real
+        apiserver's atomic evaluate-then-apply."""
         with self._lock:
             pod = self.pods.get((ns, name))
             if pod is None:
@@ -472,26 +502,69 @@ class FakeApiServer:
                     handler, {"message": f"pod {ns}/{name} not found"}, 404
                 )
                 return
+            staged = copy.deepcopy(pod)
             for op in ops:
                 parts = [
                     p.replace("~1", "/").replace("~0", "~")
                     for p in op.get("path", "").strip("/").split("/")
                 ]
-                parent = pod
-                for p in parts[:-1]:
-                    parent = parent.setdefault(p, {})
-                if op.get("op") in ("replace", "add"):
-                    parent[parts[-1]] = op.get("value")
-                elif op.get("op") == "remove":
-                    parent.pop(parts[-1], None)
-                else:
+                parent = staged
+                try:
+                    for p in parts[:-1]:
+                        if isinstance(parent, list):
+                            parent = parent[int(p)]
+                        else:
+                            parent = parent.setdefault(p, {})
+                    leaf = parts[-1]
+                    kind = op.get("op")
+                    if isinstance(parent, list):
+                        i = int(leaf)
+                        if kind == "test":
+                            if parent[i] != op.get("value"):
+                                raise _JsonPatchTestFailed(op)
+                        elif kind == "replace":
+                            parent[i] = op.get("value")
+                        elif kind == "add":
+                            parent.insert(i, op.get("value"))
+                        elif kind == "remove":
+                            del parent[i]
+                        else:
+                            raise _JsonPatchUnsupported(kind)
+                    else:
+                        if kind == "test":
+                            if parent.get(leaf) != op.get("value"):
+                                raise _JsonPatchTestFailed(op)
+                        elif kind in ("replace", "add"):
+                            parent[leaf] = op.get("value")
+                        elif kind == "remove":
+                            parent.pop(leaf, None)
+                        else:
+                            raise _JsonPatchUnsupported(kind)
+                except _JsonPatchTestFailed:
+                    self.rejected_pod_patches.append((ns, name, ops))
                     self._send_json(
                         handler,
-                        {"message": f"unsupported op {op.get('op')}"},
+                        {"message": f"test failed for {op.get('path')}"},
                         422,
                     )
                     return
-            pod["metadata"]["resourceVersion"] = self._next_rv()
+                except _JsonPatchUnsupported as e:
+                    self.rejected_pod_patches.append((ns, name, ops))
+                    self._send_json(
+                        handler, {"message": f"unsupported op {e}"}, 422
+                    )
+                    return
+                except (IndexError, ValueError, KeyError, TypeError):
+                    self.rejected_pod_patches.append((ns, name, ops))
+                    self._send_json(
+                        handler,
+                        {"message": f"bad path {op.get('path')}"},
+                        422,
+                    )
+                    return
+            staged["metadata"]["resourceVersion"] = self._next_rv()
+            self.pods[(ns, name)] = staged
+            pod = staged
             self.pod_patches.append((ns, name, {"json_patch": ops}))
             self._broadcast("MODIFIED", pod)
         self._send_json(handler, pod)

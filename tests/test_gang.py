@@ -286,8 +286,11 @@ def test_extender_metrics_cover_gang_and_requests(api):
             in text
         )
         # Scoped registry: daemon families must NOT leak into the
-        # extender's endpoint as constant zeros.
+        # extender's endpoint as constant zeros — including the uptime
+        # family, which is named per-registry.
         assert "tpu_plugin_chips" not in text
+        assert "tpu_plugin_uptime_seconds" not in text
+        assert "tpu_extender_uptime_seconds" in text
     finally:
         srv.stop()
 
@@ -468,3 +471,185 @@ def test_replacement_joining_placed_gang_releases_without_warning(
     assert GATE_NAME not in gates_of(server, "default", "w1b")
     assert "replacement pod(s) joining a placed gang" in caplog.text
     assert "finishing partial release" not in caplog.text
+
+def test_failed_member_plus_replacement_is_not_oversized(api):
+    """restartPolicy-Never churn: a Failed member lingers undeleted and
+    a replacement is created. The Failed pod must not count toward
+    membership (the scheduler ignores it too) — counting it would read
+    the gang as size+1 and keep the replacement gated forever."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    # w0 ran and Failed; w1 still running (placed); r0 is the gated
+    # replacement for w0.
+    failed = gang_pod("w0", "train", 2, 1)
+    failed["spec"]["schedulingGates"] = []
+    failed["spec"]["nodeName"] = "n1"
+    failed["status"] = {"phase": "Failed"}
+    server.add_pod(failed)
+    running = gang_pod("w1", "train", 2, 1)
+    running["spec"]["schedulingGates"] = []
+    running["spec"]["nodeName"] = "n1"
+    running["status"] = {"phase": "Running"}
+    server.add_pod(running)
+    server.add_pod(gang_pod("r0", "train", 2, 1))
+
+    adm = GangAdmission(client)
+    assert adm.tick() == [("default", "train")]
+    assert GATE_NAME not in gates_of(server, "default", "r0")
+
+
+def test_succeeded_member_plus_replacement_is_not_oversized(api):
+    """Same shape with phase=Succeeded (completed one-shot member)."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    done = gang_pod("w0", "train", 2, 1)
+    done["spec"]["schedulingGates"] = []
+    done["spec"]["nodeName"] = "n1"
+    done["status"] = {"phase": "Succeeded"}
+    server.add_pod(done)
+    running = gang_pod("w1", "train", 2, 1)
+    running["spec"]["schedulingGates"] = []
+    running["spec"]["nodeName"] = "n1"
+    server.add_pod(running)
+    server.add_pod(gang_pod("r0", "train", 2, 1))
+
+    adm = GangAdmission(client)
+    assert adm.tick() == [("default", "train")]
+    assert GATE_NAME not in gates_of(server, "default", "r0")
+
+
+def test_release_preserves_gate_added_after_snapshot(api):
+    """A gate another controller adds between the controller's pod list
+    and its release patch must survive: the guarded test+remove patch
+    fails on the shifted index, the controller re-reads, and removes
+    only the gang gate."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    server.add_pod(gang_pod("w0", "solo", 1, 1))
+    adm = GangAdmission(client)
+    # Stale snapshot taken before the foreign controller acts.
+    snapshot = client.list_pods(label_selector=GANG_NAME_LABEL)["items"]
+    # Foreign controller prepends its own gate (index shift).
+    with server._lock:
+        pod = server.pods[("default", "w0")]
+        pod["spec"]["schedulingGates"].insert(0, {"name": "quota/hold"})
+    adm._release([p for p in snapshot if p["metadata"]["name"] == "w0"])
+    gates = gates_of(server, "default", "w0")
+    assert GATE_NAME not in gates
+    assert "quota/hold" in gates
+
+
+def test_release_tolerates_gate_already_removed(api):
+    """If the live pod no longer carries the gang gate when the guarded
+    patch fails, release treats it as done (no second patch, no
+    error)."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    server.add_pod(gang_pod("w0", "solo", 1, 1, extra_gates=("other/g",)))
+    adm = GangAdmission(client)
+    snapshot = client.list_pods(label_selector=GANG_NAME_LABEL)["items"]
+    with server._lock:
+        pod = server.pods[("default", "w0")]
+        pod["spec"]["schedulingGates"] = [{"name": "other/g"}]
+    patches_before = len(server.pod_patches)
+    adm._release([p for p in snapshot if p["metadata"]["name"] == "w0"])
+    gates = gates_of(server, "default", "w0")
+    assert gates == ["other/g"]
+    # Exactly one guarded attempt was made and rejected (proving
+    # _remove_gate tried, re-read, and saw the gate already gone);
+    # no blind second write followed.
+    assert len(server.pod_patches) == patches_before
+    assert [
+        (ns, n) for ns, n, _ in server.rejected_pod_patches
+    ] == [("default", "w0")]
+
+def test_finished_member_without_replacement_does_not_wedge_partial_release(
+    api,
+):
+    """A size-2 gang whose released member ran to completion (Succeeded,
+    restartPolicy Never, no replacement yet) must still finish releasing
+    its gated peer: the finished pod stands in for membership until a
+    replacement exists, so the gang reads complete+placed, not 1/2
+    waiting (which would gate the peer forever)."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    done = gang_pod("w0", "train", 2, 1)
+    done["spec"]["schedulingGates"] = []
+    done["spec"]["nodeName"] = "n1"
+    done["status"] = {"phase": "Succeeded"}
+    server.add_pod(done)
+    # w1's release patch failed in an earlier pass: still gated.
+    server.add_pod(gang_pod("w1", "train", 2, 1))
+
+    adm = GangAdmission(client)
+    assert adm.tick() == [("default", "train")]
+    assert GATE_NAME not in gates_of(server, "default", "w1")
+
+def test_crashed_gang_replacements_take_capacity_check_not_placed_bypass(
+    api,
+):
+    """Whole-gang crash (restartPolicy Never): every member Failed with
+    its stale nodeName still set, replacements arrive one by one. The
+    dead pods hold no chips, so they must NOT count as 'placed' — that
+    bypass would leak replacements out gate-by-gate with no capacity
+    check. With insufficient capacity the replacement stays gated."""
+    from k8s_device_plugin_tpu.api import constants
+    from k8s_device_plugin_tpu.topology.schema import NodeTopology
+
+    server, client = api
+    # Only 1 chip free: the size-2 gang (1 chip each) cannot fit whole.
+    node, mesh = make_node("n1", n=4)
+    topo = NodeTopology.from_mesh(mesh, hostname="n1", available=mesh.ids[:1])
+    node["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION] = (
+        topo.to_json()
+    )
+    server.add_node("n1", node)
+    for i in range(2):
+        dead = gang_pod(f"w{i}", "train", 2, 1)
+        dead["spec"]["schedulingGates"] = []
+        dead["spec"]["nodeName"] = "n1"  # stale: pod is finished
+        dead["status"] = {"phase": "Failed"}
+        server.add_pod(dead)
+    server.add_pod(gang_pod("r0", "train", 2, 1))  # first replacement
+
+    adm = GangAdmission(client)
+    assert adm.tick() == []  # 2-chip gang vs 1 free chip: hold the gate
+    assert GATE_NAME in gates_of(server, "default", "r0")
+
+    # Capacity appears: whole-gang demand now fits; release proceeds.
+    fresh, _ = make_node("n1", n=4)
+    server.add_node("n1", fresh)
+    assert adm.tick() == [("default", "train")]
+    assert GATE_NAME not in gates_of(server, "default", "r0")
+
+def test_succeeded_standin_demand_not_held_against_remainder(api):
+    """Partial-release wedge, tight capacity: the released member
+    Succeeded and its chips went to other workloads; only ONE chip is
+    free. The gated remainder needs one chip — the finished member's
+    demand must not be re-counted, or the remainder would wait for
+    whole-gang capacity that is never needed again."""
+    from k8s_device_plugin_tpu.api import constants
+    from k8s_device_plugin_tpu.topology.schema import NodeTopology
+
+    server, client = api
+    node, mesh = make_node("n1", n=4)
+    topo = NodeTopology.from_mesh(mesh, hostname="n1", available=mesh.ids[:1])
+    node["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION] = (
+        topo.to_json()
+    )
+    server.add_node("n1", node)
+    done = gang_pod("w0", "train", 2, 1)
+    done["spec"]["schedulingGates"] = []
+    done["spec"]["nodeName"] = "n1"
+    done["status"] = {"phase": "Succeeded"}
+    server.add_pod(done)
+    server.add_pod(gang_pod("w1", "train", 2, 1))  # release never landed
+
+    adm = GangAdmission(client)
+    assert adm.tick() == [("default", "train")]
+    assert GATE_NAME not in gates_of(server, "default", "w1")
