@@ -228,10 +228,20 @@ class GangAdmission:
         views: Dict[Tuple[str, str], GangView] = {}
         for key, size in sizes.items():
             alive = live.get(key, [])
+            # Deterministic stand-in pick, Succeeded before Failed: a
+            # Failed stand-in adds its demand to the capacity check
+            # (GangView.demands), but when its replacement is already
+            # among the live pods that demand is double-counted and can
+            # wedge the gang against capacity it doesn't need. A
+            # Succeeded pod is always the safer filler (no replacement
+            # is coming for it, so no demand either way).
             done = sorted(
                 finished.get(key, []),
-                key=lambda p: (p.get("metadata") or {}).get("name", ""),
-            )  # deterministic stand-in pick across resyncs
+                key=lambda p: (
+                    (p.get("status") or {}).get("phase") != "Succeeded",
+                    (p.get("metadata") or {}).get("name", ""),
+                ),
+            )
             short = max(0, size - len(alive))
             views[key] = GangView(
                 size=size, live=alive, standins=done[:short]

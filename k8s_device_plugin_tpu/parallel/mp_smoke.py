@@ -1,0 +1,183 @@
+"""Multi-process SPMD smoke: real ``jax.distributed.initialize`` over N
+localhost processes on the CPU backend.
+
+The single-process virtual-device dryrun (``__graft_entry__``) proves the
+sharded programs compile and run, but it never executes the multi-HOST
+wiring — the coordinator service, per-process device registration, and
+``make_array_from_process_local_data`` (the DCN analog the reference
+delegates to NCCL-bringing workloads, SURVEY.md §5). This module is that
+missing end-to-end drive, reused by both the test suite
+(tests/test_distributed.py) and the driver dryrun:
+
+* ``main()`` — worker entry (``python -m k8s_device_plugin_tpu.parallel.
+  mp_smoke``): joins the coordinator advertised by the plugin-style env
+  (TPU_WORKER_HOSTNAMES/TPU_WORKER_ID/TPU_COORDINATOR_PORT), builds the
+  global mesh with fsdp spanning the processes, and runs one sharded
+  train step whose gradient psum crosses the process boundary.
+* ``launch_local(n)`` — spawns n such workers against one coordinator,
+  asserts every worker exits 0 and all agree on the loss, returns it.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import Optional
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> None:
+    # Env steering must precede any jax backend touch (XLA flags are
+    # parsed once per process); this runs in a fresh worker process.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    local = int(os.environ.get("MP_SMOKE_LOCAL_DEVICES", "2"))
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local}"
+    )
+
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ..workload import train
+    from ..workload.model import ModelConfig
+    from . import distributed
+
+    env = distributed.slice_env()
+    assert env is not None and env.num_hosts >= 2, env
+    assert distributed.initialize(env)
+    total = env.num_hosts * local
+    assert len(jax.devices()) == total, jax.devices()
+    assert len(jax.local_devices()) == local
+
+    # fsdp spans ALL processes: parameter shards and the gradient psum
+    # both cross the process boundary every step.
+    mesh = distributed.global_mesh(shape=(1, total, 1, 1, 1, 1))
+    cfg = ModelConfig.tiny()
+    params, opt_state, tx = train.make_train_state(
+        cfg, mesh, jax.random.PRNGKey(0)
+    )
+    step = train.make_train_step(cfg, mesh, tx)
+    local_batch = np.random.default_rng(env.worker_id).integers(
+        0, cfg.vocab_size, (2 * local, cfg.max_seq_len), dtype=np.int32
+    )
+    tokens = distributed.shard_host_batch(local_batch, mesh)
+    assert tokens.shape[0] == 2 * total
+    params, opt_state, loss = step(params, opt_state, tokens)
+    print(f"mp_smoke worker={env.worker_id} loss={float(loss):.6f}",
+          flush=True)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_local(
+    num_processes: int = 2,
+    local_devices: int = 2,
+    timeout_s: float = 300.0,
+    port: Optional[int] = None,
+    attempts: int = 2,
+) -> float:
+    """Run the multi-process smoke on localhost; returns the agreed loss.
+
+    Raises RuntimeError (with every failed worker's output) when workers
+    fail or disagree on the loss — disagreement would mean the psum
+    didn't actually span the processes. The coordinator port is probed
+    then released before worker 0 re-binds it, so another process can
+    steal it in the window (or a concurrent smoke can cross-talk); a
+    failed round is retried once on a fresh port before giving up —
+    unless the caller pinned ``port``, in which case the collision is
+    theirs to own.
+    """
+    last_err: Optional[Exception] = None
+    for _ in range(attempts if port is None else 1):
+        try:
+            return _launch_once(
+                num_processes, local_devices, timeout_s,
+                _free_port() if port is None else port,
+            )
+        except RuntimeError as e:
+            last_err = e
+    raise last_err  # type: ignore[misc]
+
+
+def _launch_once(
+    num_processes: int, local_devices: int, timeout_s: float, port: int
+) -> float:
+    import time
+
+    hosts = ",".join(["127.0.0.1"] * num_processes)
+    procs = []
+    for wid in range(num_processes):
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
+                         "XLA_FLAGS")
+        }
+        env.update(
+            {
+                "TPU_WORKER_HOSTNAMES": hosts,
+                "TPU_WORKER_ID": str(wid),
+                "TPU_COORDINATOR_PORT": str(port),
+                "MP_SMOKE_LOCAL_DEVICES": str(local_devices),
+                "PYTHONPATH": _REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            }
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m",
+                 "k8s_device_plugin_tpu.parallel.mp_smoke"],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    # Fail fast: one worker dying (e.g. the coordinator at startup)
+    # leaves its peers blocked in the init barrier until timeout — kill
+    # the survivors as soon as the first failure is observed instead of
+    # sitting out the full timeout on them.
+    deadline = time.monotonic() + timeout_s
+    failed = False
+    while time.monotonic() < deadline:
+        codes = [p.poll() for p in procs]
+        if any(c is not None and c != 0 for c in codes):
+            failed = True
+            break
+        if all(c == 0 for c in codes):
+            break
+        time.sleep(0.2)
+    else:
+        failed = True  # deadline hit with workers still running
+    outs, fails = [], []
+    for wid, p in enumerate(procs):
+        if p.poll() is None:
+            p.kill()
+        out, err = p.communicate()
+        if p.returncode != 0:
+            fails.append(f"worker {wid} rc={p.returncode}\n{out}\n{err}")
+        else:
+            outs.append(out.strip().splitlines()[-1])
+    if failed and not fails:
+        fails.append("workers killed at deadline with no failure output")
+    if fails:
+        raise RuntimeError("mp_smoke failed:\n" + "\n---\n".join(fails))
+    losses = {o.split("loss=")[1] for o in outs}
+    if len(losses) != 1:
+        raise RuntimeError(f"workers disagree on loss: {outs}")
+    return float(losses.pop())
+
+
+if __name__ == "__main__":
+    main()

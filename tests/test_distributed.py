@@ -157,3 +157,36 @@ def test_slice_env_unparseable_values_raise():
                 "TPU_COORDINATOR_PORT": "x",
             }
         )
+
+
+def test_mp_smoke_launch_local_fsdp_across_processes():
+    """The driver-dryrun multi-process smoke (parallel/mp_smoke.py): 2
+    real processes, fsdp spanning both, agreed finite loss."""
+    import math
+
+    from k8s_device_plugin_tpu.parallel import mp_smoke
+
+    loss = mp_smoke.launch_local(num_processes=2, local_devices=2)
+    assert math.isfinite(loss)
+
+
+def test_mp_smoke_fails_fast_when_coordinator_port_taken():
+    """A dead coordinator must not stall the smoke for the full timeout:
+    bind the port first so worker 0 dies at startup, and assert the
+    launcher kills the surviving worker and errors well under the
+    deadline."""
+    import time
+
+    from k8s_device_plugin_tpu.parallel import mp_smoke
+
+    with socket.socket() as blocker:
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="mp_smoke failed"):
+            mp_smoke.launch_local(
+                num_processes=2, local_devices=1,
+                timeout_s=240.0, port=port,
+            )
+        assert time.monotonic() - t0 < 120

@@ -653,3 +653,34 @@ def test_succeeded_standin_demand_not_held_against_remainder(api):
     adm = GangAdmission(client)
     assert adm.tick() == [("default", "train")]
     assert GATE_NAME not in gates_of(server, "default", "w1")
+
+def test_standin_pick_prefers_succeeded_over_failed(api):
+    """Mixed finished pods: a0 Failed (its replacement r0 is already
+    live+gated) and b1 Succeeded (no replacement will ever come), 1 chip
+    free. The stand-in pick must prefer the Succeeded pod — picking the
+    Failed one would double-count r0's demand and wedge the gang."""
+    from k8s_device_plugin_tpu.api import constants
+    from k8s_device_plugin_tpu.topology.schema import NodeTopology
+
+    server, client = api
+    node, mesh = make_node("n1", n=4)
+    topo = NodeTopology.from_mesh(mesh, hostname="n1", available=mesh.ids[:1])
+    node["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION] = (
+        topo.to_json()
+    )
+    server.add_node("n1", node)
+    failed = gang_pod("a0", "train", 2, 1)
+    failed["spec"]["schedulingGates"] = []
+    failed["spec"]["nodeName"] = "n1"
+    failed["status"] = {"phase": "Failed"}
+    server.add_pod(failed)
+    done = gang_pod("b1", "train", 2, 1)
+    done["spec"]["schedulingGates"] = []
+    done["spec"]["nodeName"] = "n1"
+    done["status"] = {"phase": "Succeeded"}
+    server.add_pod(done)
+    server.add_pod(gang_pod("r0", "train", 2, 1))  # replaces a0
+
+    adm = GangAdmission(client)
+    assert adm.tick() == [("default", "train")]
+    assert GATE_NAME not in gates_of(server, "default", "r0")
