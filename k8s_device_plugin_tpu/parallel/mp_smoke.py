@@ -24,7 +24,7 @@ import os
 import socket
 import subprocess
 import sys
-from typing import Optional
+from typing import Optional, Tuple
 
 _REPO = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -57,9 +57,18 @@ def main() -> None:
     assert len(jax.devices()) == total, jax.devices()
     assert len(jax.local_devices()) == local
 
-    # fsdp spans ALL processes: parameter shards and the gradient psum
-    # both cross the process boundary every step.
-    mesh = distributed.global_mesh(shape=(1, total, 1, 1, 1, 1))
+    # Default mesh: fsdp spans ALL processes, so parameter shards and
+    # the gradient psum both cross the process boundary every step.
+    # MP_SMOKE_MESH_SHAPE overrides (comma-separated 6-axis shape, e.g.
+    # "2,2,1,1,1,1" = data across hosts + fsdp within) for callers that
+    # want a different cross-process axis.
+    raw_shape = os.environ.get("MP_SMOKE_MESH_SHAPE", "")
+    shape = (
+        tuple(int(x) for x in raw_shape.split(","))
+        if raw_shape
+        else (1, total, 1, 1, 1, 1)
+    )
+    mesh = distributed.global_mesh(shape=shape)
     cfg = ModelConfig.tiny()
     params, opt_state, tx = train.make_train_state(
         cfg, mesh, jax.random.PRNGKey(0)
@@ -87,6 +96,7 @@ def launch_local(
     timeout_s: float = 300.0,
     port: Optional[int] = None,
     attempts: int = 2,
+    mesh_shape: Optional[Tuple[int, ...]] = None,
 ) -> float:
     """Run the multi-process smoke on localhost; returns the agreed loss.
 
@@ -104,7 +114,7 @@ def launch_local(
         try:
             return _launch_once(
                 num_processes, local_devices, timeout_s,
-                _free_port() if port is None else port,
+                _free_port() if port is None else port, mesh_shape,
             )
         except RuntimeError as e:
             last_err = e
@@ -112,7 +122,11 @@ def launch_local(
 
 
 def _launch_once(
-    num_processes: int, local_devices: int, timeout_s: float, port: int
+    num_processes: int,
+    local_devices: int,
+    timeout_s: float,
+    port: int,
+    mesh_shape: Optional[Tuple[int, ...]] = None,
 ) -> float:
     import time
 
@@ -134,6 +148,10 @@ def _launch_once(
                 "PYTHONPATH": _REPO + os.pathsep + env.get("PYTHONPATH", ""),
             }
         )
+        if mesh_shape is not None:
+            env["MP_SMOKE_MESH_SHAPE"] = ",".join(
+                str(x) for x in mesh_shape
+            )
         procs.append(
             subprocess.Popen(
                 [sys.executable, "-m",

@@ -1,15 +1,14 @@
-"""Multi-host distributed runtime (parallel/distributed.py).
+"""Multi-host distributed runtime (parallel/distributed.py + mp_smoke).
 
-The 2-process test runs real multi-process SPMD on CPU: two subprocesses,
-one TCP coordinator, a global mesh spanning both, and a sharded train step
-whose gradient psum crosses the process boundary — the DCN analog.
+The multi-process test runs real multi-process SPMD on CPU via the same
+harness the driver dryrun uses (parallel/mp_smoke.py): two subprocesses,
+one TCP coordinator, a global mesh spanning both, and a sharded train
+step whose gradient psum crosses the process boundary — the DCN analog.
 """
 
-import os
+import math
 import socket
-import subprocess
-import sys
-import textwrap
+import time
 
 import pytest
 
@@ -19,8 +18,7 @@ from k8s_device_plugin_tpu.parallel.distributed import (
     initialize,
     slice_env,
 )
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from k8s_device_plugin_tpu.parallel import mp_smoke
 
 
 def test_slice_env_absent():
@@ -62,90 +60,6 @@ def test_initialize_noop_single_host():
     assert initialize(SliceEnv(0, ("only-host",))) is False
 
 
-_WORKER = textwrap.dedent(
-    """
-    import os, sys
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    import numpy as np
-    from k8s_device_plugin_tpu.parallel import distributed
-
-    env = distributed.slice_env()
-    assert env is not None and env.num_hosts == 2
-    assert distributed.initialize(env)
-    assert len(jax.devices()) == 4, jax.devices()
-    assert len(jax.local_devices()) == 2
-
-    # data axis spans the hosts (outermost = cross-host/DCN), model within
-    mesh = distributed.global_mesh(shape=(2, 2, 1))
-    from k8s_device_plugin_tpu.workload.model import ModelConfig
-    from k8s_device_plugin_tpu.workload import train
-
-    cfg = ModelConfig.tiny()
-    params, opt_state, tx = train.make_train_state(
-        cfg, mesh, jax.random.PRNGKey(0)
-    )
-    step = train.make_train_step(cfg, mesh, tx)
-    local = np.random.default_rng(env.worker_id).integers(
-        0, cfg.vocab_size, (4, cfg.max_seq_len), dtype=np.int32
-    )
-    tokens = distributed.shard_host_batch(local, mesh)
-    assert tokens.shape[0] == 8  # global batch = 2 hosts x 4
-    params, opt_state, loss = step(params, opt_state, tokens)
-    print(f"worker={env.worker_id} loss={float(loss):.6f}", flush=True)
-    """
-)
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def test_two_process_spmd_train_step(tmp_path):
-    """Two processes, one coordinator, one global mesh: the sharded train
-    step runs with its gradient psum crossing the process boundary, and
-    both workers agree on the loss."""
-    port = _free_port()
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
-    procs = []
-    for wid in (0, 1):
-        env = {
-            k: v
-            for k, v in os.environ.items()
-            if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
-        }
-        env.update(
-            {
-                "TPU_WORKER_HOSTNAMES": "127.0.0.1,127.0.0.1",
-                "TPU_WORKER_ID": str(wid),
-                "TPU_COORDINATOR_PORT": str(port),
-                "PYTHONPATH": REPO,
-            }
-        )
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, str(script)],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-            )
-        )
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=300)
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
-        outs.append(out.strip().splitlines()[-1])
-    losses = {o.split("loss=")[1] for o in outs}
-    assert len(losses) == 1, f"workers disagree: {outs}"
-
-
 def test_slice_env_unparseable_values_raise():
     with pytest.raises(ValueError, match="TPU_WORKER_ID"):
         slice_env({"TPU_WORKER_HOSTNAMES": "a,b", "TPU_WORKER_ID": "w1"})
@@ -159,14 +73,16 @@ def test_slice_env_unparseable_values_raise():
         )
 
 
-def test_mp_smoke_launch_local_fsdp_across_processes():
-    """The driver-dryrun multi-process smoke (parallel/mp_smoke.py): 2
-    real processes, fsdp spanning both, agreed finite loss."""
-    import math
-
-    from k8s_device_plugin_tpu.parallel import mp_smoke
-
-    loss = mp_smoke.launch_local(num_processes=2, local_devices=2)
+def test_two_process_spmd_train_step():
+    """Two processes, one coordinator, one global mesh with data across
+    the hosts AND fsdp within each: the sharded train step's gradient
+    all-reduce crosses the process boundary, and launch_local asserts
+    both workers agree on the loss (a disagreement would mean the psum
+    never spanned the processes)."""
+    loss = mp_smoke.launch_local(
+        num_processes=2, local_devices=2,
+        mesh_shape=(2, 2, 1, 1, 1, 1),
+    )
     assert math.isfinite(loss)
 
 
@@ -175,10 +91,6 @@ def test_mp_smoke_fails_fast_when_coordinator_port_taken():
     bind the port first so worker 0 dies at startup, and assert the
     launcher kills the surviving worker and errors well under the
     deadline."""
-    import time
-
-    from k8s_device_plugin_tpu.parallel import mp_smoke
-
     with socket.socket() as blocker:
         blocker.bind(("127.0.0.1", 0))
         blocker.listen(1)

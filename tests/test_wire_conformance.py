@@ -1,0 +1,511 @@
+"""Wire-contract conformance: hand-rolled gRPC surfaces vs the .proto
+descriptors.
+
+Every gRPC surface in this repo is hand-rolled (api/grpc_defs.py builds
+method handlers and multicallables by string path; the pb2 modules are
+protoc output but the .proto sources are maintained by hand). All of it
+is exercised against in-repo fakes — which share those same strings, so
+a drifted method path or field number would pass every other test and
+fail only against a REAL kubelet. This file pins the wiring to the
+authoritative descriptors instead (VERDICT r3 #3; the ADVICE r2 DRA
+service-name bug is exactly the class this catches):
+
+* the reference's vendored device-plugin proto
+  (/root/reference/vendor/k8s.io/kubernetes/pkg/kubelet/apis/
+  deviceplugin/v1beta1/api.proto:17-161) — the kubelet contract the
+  in-repo proto must be a superset of, field numbers and all;
+* the in-repo api/*.proto files vs their protoc-generated pb2 modules
+  (so the .proto sources can't drift into dead documentation);
+* api/grpc_defs.py servicer registrations and client stubs vs the
+  method paths, streaming shapes, and message types those protos
+  declare.
+
+The proto parser below is a deliberately small subset: proto3, no
+nested messages, no enums, map<> fields — the grammar these five files
+actually use. It asserts on anything it doesn't understand rather than
+skipping it.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import pytest
+from google.protobuf.descriptor import FieldDescriptor
+
+from k8s_device_plugin_tpu.api import (
+    deviceplugin_pb2,
+    dra_pb2,
+    grpc_defs,
+    pluginregistration_pb2,
+    podresources_pb2,
+)
+
+API_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "k8s_device_plugin_tpu",
+    "api",
+)
+REFERENCE_PROTO = (
+    "/root/reference/vendor/k8s.io/kubernetes/pkg/kubelet/apis/"
+    "deviceplugin/v1beta1/api.proto"
+)
+
+
+# ---------------------------------------------------------------------------
+# Minimal proto3 parser (services, methods, messages, fields, maps)
+# ---------------------------------------------------------------------------
+
+class Method(NamedTuple):
+    request: str
+    request_stream: bool
+    response: str
+    response_stream: bool
+
+
+class Field(NamedTuple):
+    number: int
+    repeated: bool
+    type_name: str  # scalar name, message name, or "map<k,v>"
+
+
+class Proto(NamedTuple):
+    package: str
+    services: Dict[str, Dict[str, Method]]
+    messages: Dict[str, Dict[str, Field]]
+
+
+_RPC_RE = re.compile(
+    r"\brpc\s+(\w+)\s*\(\s*(stream\s+)?([\w.]+)\s*\)\s*"
+    r"returns\s*\(\s*(stream\s+)?([\w.]+)\s*\)"
+)
+_FIELD_RE = re.compile(
+    r"^\s*(repeated\s+)?"
+    r"(map\s*<\s*[\w.]+\s*,\s*[\w.]+\s*>|[\w.]+)\s+"
+    r"(\w+)\s*=\s*(\d+)\s*;",
+    re.M,
+)
+
+
+def parse_proto(path: str) -> Proto:
+    with open(path) as f:
+        text = f.read()
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    text = re.sub(r"//[^\n]*", "", text)
+    pkg_m = re.search(r"\bpackage\s+([\w.]+)\s*;", text)
+    assert pkg_m, f"{path}: no package"
+    services: Dict[str, Dict[str, Method]] = {}
+    messages: Dict[str, Dict[str, Field]] = {}
+    for kind, name, body in _blocks(text, path):
+        if kind == "service":
+            methods = {}
+            for m in _RPC_RE.finditer(body):
+                methods[m.group(1)] = Method(
+                    request=m.group(3),
+                    request_stream=bool(m.group(2)),
+                    response=m.group(5),
+                    response_stream=bool(m.group(4)),
+                )
+            # Every rpc line must have parsed: count the rpc keywords.
+            assert len(methods) == len(re.findall(r"\brpc\b", body)), (
+                f"{path}: unparsed rpc in service {name}"
+            )
+            services[name] = methods
+        else:
+            fields = {}
+            for m in _FIELD_RE.finditer(body):
+                fields[m.group(3)] = Field(
+                    number=int(m.group(4)),
+                    repeated=bool(m.group(1)),
+                    type_name=re.sub(r"\s+", "", m.group(2)),
+                )
+            assert len(fields) == body.count("="), (
+                f"{path}: unparsed field in message {name}"
+            )
+            messages[name] = fields
+    return Proto(pkg_m.group(1), services, messages)
+
+
+def _blocks(text: str, path: str):
+    """Yield (kind, name, body) for top-level service/message blocks,
+    brace-matched. Asserts there is no nesting (the subset bound)."""
+    for m in re.finditer(r"\b(service|message)\s+(\w+)\s*\{", text):
+        depth = 1
+        i = m.end()
+        while depth:
+            j = min(
+                (k for k in (text.find("{", i), text.find("}", i))
+                 if k != -1),
+                default=-1,
+            )
+            assert j != -1, f"{path}: unbalanced braces in {m.group(2)}"
+            depth += 1 if text[j] == "{" else -1
+            i = j + 1
+        body = text[m.end():i - 1]
+        assert "message" not in body and "enum" not in body, (
+            f"{path}: nested type in {m.group(2)} — parser subset exceeded"
+        )
+        yield m.group(1), m.group(2), body
+
+
+def _is_repeated(f) -> bool:
+    # is_repeated is a property on protobuf >= 5.29 (a method on some
+    # interim releases); older versions only have the deprecated label.
+    rep = getattr(f, "is_repeated", None)
+    if rep is None:
+        return f.label == FieldDescriptor.LABEL_REPEATED
+    return bool(rep() if callable(rep) else rep)
+
+
+_SCALARS = {
+    "string": FieldDescriptor.TYPE_STRING,
+    "bool": FieldDescriptor.TYPE_BOOL,
+    "int64": FieldDescriptor.TYPE_INT64,
+    "int32": FieldDescriptor.TYPE_INT32,
+    "uint64": FieldDescriptor.TYPE_UINT64,
+    "uint32": FieldDescriptor.TYPE_UINT32,
+    "bytes": FieldDescriptor.TYPE_BYTES,
+    "double": FieldDescriptor.TYPE_DOUBLE,
+    "float": FieldDescriptor.TYPE_FLOAT,
+}
+
+
+def assert_message_matches(pb2_module, name: str, fields: Dict[str, Field],
+                           where: str) -> None:
+    cls = getattr(pb2_module, name, None)
+    assert cls is not None, f"{where}: pb2 has no message {name}"
+    desc = cls.DESCRIPTOR
+    by_name = {f.name: f for f in desc.fields}
+    assert set(by_name) == set(fields), (
+        f"{where}.{name}: field sets differ: proto={sorted(fields)} "
+        f"pb2={sorted(by_name)}"
+    )
+    for fname, spec in fields.items():
+        f = by_name[fname]
+        ctx = f"{where}.{name}.{fname}"
+        assert f.number == spec.number, (
+            f"{ctx}: number {f.number} != proto {spec.number}"
+        )
+        if spec.type_name.startswith("map<"):
+            key_t, val_t = spec.type_name[4:-1].split(",")
+            assert _is_repeated(f), ctx
+            entry = f.message_type
+            assert entry is not None and entry.GetOptions().map_entry, (
+                f"{ctx}: expected map field"
+            )
+            _assert_type(entry.fields_by_name["key"], key_t, ctx + ".key")
+            _assert_type(entry.fields_by_name["value"], val_t,
+                         ctx + ".value")
+            continue
+        assert _is_repeated(f) == spec.repeated, (
+            f"{ctx}: repeated={_is_repeated(f)} != proto {spec.repeated}"
+        )
+        _assert_type(f, spec.type_name, ctx)
+
+
+def _assert_type(f, type_name: str, ctx: str) -> None:
+    if type_name in _SCALARS:
+        assert f.type == _SCALARS[type_name], (
+            f"{ctx}: type {f.type} != {type_name}"
+        )
+    else:
+        assert f.type == FieldDescriptor.TYPE_MESSAGE, (
+            f"{ctx}: expected message type {type_name}"
+        )
+        assert f.message_type.name == type_name.split(".")[-1], (
+            f"{ctx}: message type {f.message_type.name} != {type_name}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# grpc_defs introspection: record what the stubs dial and servicers serve
+# ---------------------------------------------------------------------------
+
+class RecordingChannel:
+    """Duck-typed grpc.Channel capturing multicallable registrations."""
+
+    def __init__(self):
+        self.calls: Dict[str, str] = {}  # path -> kind
+
+    def unary_unary(self, path, request_serializer=None,
+                    response_deserializer=None, **kw):
+        self.calls[path] = "unary_unary"
+        return lambda *a, **k: None
+
+    def unary_stream(self, path, request_serializer=None,
+                     response_deserializer=None, **kw):
+        self.calls[path] = "unary_stream"
+        return lambda *a, **k: None
+
+    def stream_unary(self, path, **kw):
+        self.calls[path] = "stream_unary"
+        return lambda *a, **k: None
+
+    def stream_stream(self, path, **kw):
+        self.calls[path] = "stream_stream"
+        return lambda *a, **k: None
+
+
+class RecordingServer:
+    """Duck-typed grpc.Server capturing generic handlers."""
+
+    def __init__(self):
+        self.handlers = []
+
+    def add_generic_rpc_handlers(self, handlers):
+        self.handlers.extend(handlers)
+
+    def lookup(self, path: str):
+        class Details(NamedTuple):
+            method: str
+            invocation_metadata: tuple = ()
+
+        for h in self.handlers:
+            found = h.service(Details(method=path))
+            if found is not None:
+                return found
+        return None
+
+
+def expected_paths(package: str, service: str,
+                   methods: Dict[str, Method]) -> Dict[str, Method]:
+    return {
+        f"/{package}.{service}/{name}": m for name, m in methods.items()
+    }
+
+
+def assert_server_serves(server: RecordingServer, paths: Dict[str, Method],
+                         pb2_module) -> None:
+    for path, m in paths.items():
+        handler = server.lookup(path)
+        assert handler is not None, f"no handler serves {path}"
+        assert handler.request_streaming == m.request_stream, path
+        assert handler.response_streaming == m.response_stream, path
+        req_cls = getattr(pb2_module, m.request)
+        # The registered deserializer must be the declared request
+        # type's parser — a swapped message class decodes garbage.
+        assert handler.request_deserializer == req_cls.FromString, (
+            f"{path}: request deserializer is not {m.request}.FromString"
+        )
+
+
+def assert_stub_dials(channel: RecordingChannel,
+                      paths: Dict[str, Method]) -> None:
+    assert set(channel.calls) == set(paths), (
+        f"stub paths differ: stub={sorted(channel.calls)} "
+        f"proto={sorted(paths)}"
+    )
+    for path, m in paths.items():
+        kind = "unary_stream" if m.response_stream else "unary_unary"
+        assert channel.calls[path] == kind, (
+            f"{path}: {channel.calls[path]} != {kind}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Parsed inputs
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def repo_protos() -> Dict[str, Proto]:
+    return {
+        name: parse_proto(os.path.join(API_DIR, f"{name}.proto"))
+        for name in (
+            "deviceplugin", "pluginregistration", "podresources", "dra"
+        )
+    }
+
+
+@pytest.fixture(scope="module")
+def reference_proto() -> Proto:
+    return parse_proto(REFERENCE_PROTO)
+
+
+# ---------------------------------------------------------------------------
+# 1. Reference parity: the kubelet contract the reference vendored
+# ---------------------------------------------------------------------------
+
+def test_reference_proto_is_subset_of_repo_deviceplugin(
+    repo_protos, reference_proto
+):
+    """Every service, method, message, and field in the reference's
+    vendored v1beta1 api.proto exists here with identical numbers,
+    types, and streaming shapes (this repo adds protocol-legal
+    extensions — GetPreferredAllocation, TopologyInfo, CDI — but must
+    never diverge on what the reference has)."""
+    repo = repo_protos["deviceplugin"]
+    assert repo.package == reference_proto.package == "v1beta1"
+    for svc, methods in reference_proto.services.items():
+        assert svc in repo.services, f"service {svc} missing"
+        for name, m in methods.items():
+            assert name in repo.services[svc], f"{svc}/{name} missing"
+            assert repo.services[svc][name] == m, f"{svc}/{name} differs"
+    for msg, fields in reference_proto.messages.items():
+        assert msg in repo.messages, f"message {msg} missing"
+        for fname, spec in fields.items():
+            assert fname in repo.messages[msg], f"{msg}.{fname} missing"
+            assert repo.messages[msg][fname] == spec, (
+                f"{msg}.{fname}: {repo.messages[msg][fname]} != {spec}"
+            )
+
+
+def test_reference_proto_fields_match_pb2_descriptors(reference_proto):
+    """The generated deviceplugin_pb2 agrees field-by-field with the
+    reference's vendored proto — the on-the-wire layout the kubelet
+    actually decodes."""
+    for msg, fields in reference_proto.messages.items():
+        assert_message_matches(
+            deviceplugin_pb2, msg, _merge_reference(msg, fields),
+            "reference",
+        )
+
+
+def _merge_reference(msg: str, fields: Dict[str, Field]) -> Dict[str, Field]:
+    """The pb2 module carries the repo's protocol-legal EXTENSION fields
+    too (e.g. Device.topology); descriptor comparison needs the union.
+    Extensions may extend reference messages only with NEW field numbers
+    — a number collision is asserted here."""
+    repo = parse_proto(os.path.join(API_DIR, "deviceplugin.proto"))
+    merged = dict(repo.messages[msg])
+    for fname, spec in fields.items():
+        assert merged.get(fname) == spec
+    extra_numbers = {
+        s.number for n, s in merged.items() if n not in fields
+    }
+    assert not extra_numbers & {s.number for s in fields.values()}, (
+        f"{msg}: extension reuses a reference field number"
+    )
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# 2. In-repo protos vs their pb2 modules
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "name,module",
+    [
+        ("deviceplugin", deviceplugin_pb2),
+        ("pluginregistration", pluginregistration_pb2),
+        ("podresources", podresources_pb2),
+        ("dra", dra_pb2),
+    ],
+)
+def test_repo_proto_matches_pb2(repo_protos, name, module):
+    proto = repo_protos[name]
+    assert proto.package == module.DESCRIPTOR.package
+    for msg, fields in proto.messages.items():
+        assert_message_matches(module, msg, fields, name)
+    # No pb2 message the proto doesn't declare (dead codegen drift).
+    assert set(module.DESCRIPTOR.message_types_by_name) == set(
+        proto.messages
+    )
+
+
+# ---------------------------------------------------------------------------
+# 3. grpc_defs method paths, streaming shapes, and message wiring
+# ---------------------------------------------------------------------------
+
+def test_device_plugin_service_wiring(repo_protos):
+    proto = repo_protos["deviceplugin"]
+    paths = expected_paths("v1beta1", "DevicePlugin",
+                           proto.services["DevicePlugin"])
+    server = RecordingServer()
+    grpc_defs.add_device_plugin_servicer(
+        grpc_defs.DevicePluginServicer(), server
+    )
+    assert_server_serves(server, paths, deviceplugin_pb2)
+    chan = RecordingChannel()
+    grpc_defs.DevicePluginStub(chan)
+    assert_stub_dials(chan, paths)
+
+
+def test_registration_service_wiring(repo_protos):
+    proto = repo_protos["deviceplugin"]
+    paths = expected_paths("v1beta1", "Registration",
+                           proto.services["Registration"])
+    server = RecordingServer()
+    grpc_defs.add_registration_servicer(
+        grpc_defs.RegistrationServicer(), server
+    )
+    assert_server_serves(server, paths, deviceplugin_pb2)
+    chan = RecordingChannel()
+    grpc_defs.RegistrationStub(chan)
+    assert_stub_dials(chan, paths)
+
+
+def test_watcher_registration_service_wiring(repo_protos):
+    proto = repo_protos["pluginregistration"]
+    paths = expected_paths("pluginregistration", "Registration",
+                           proto.services["Registration"])
+    server = RecordingServer()
+    grpc_defs.add_watcher_registration_servicer(
+        grpc_defs.WatcherRegistrationServicer(), server
+    )
+    assert_server_serves(server, paths, pluginregistration_pb2)
+    chan = RecordingChannel()
+    grpc_defs.WatcherRegistrationStub(chan)
+    assert_stub_dials(chan, paths)
+
+
+def test_pod_resources_service_wiring(repo_protos):
+    proto = repo_protos["podresources"]
+    paths = expected_paths("v1", "PodResourcesLister",
+                           proto.services["PodResourcesLister"])
+    server = RecordingServer()
+    grpc_defs.add_pod_resources_servicer(
+        grpc_defs.PodResourcesListerServicer(), server
+    )
+    assert_server_serves(server, paths, podresources_pb2)
+    chan = RecordingChannel()
+    grpc_defs.PodResourcesListerStub(chan)
+    assert_stub_dials(chan, paths)
+
+
+def test_dra_service_wiring_both_negotiated_names(repo_protos):
+    """The DRA pb2 package is 'dra' (protobuf name-collision avoidance,
+    api/dra.proto header) but the kubelet negotiates the K8s service
+    names: 'v1.DRAPlugin' (GA, k8s>=1.33) and 'v1beta1.DRAPlugin'
+    (before). Both full method-path sets must be served by one server —
+    this is the exact drift class ADVICE r2 caught by hand."""
+    proto = repo_protos["dra"]
+    methods = proto.services["DRAPlugin"]
+    assert grpc_defs.DRA_PLUGIN_SERVICES == (
+        "v1.DRAPlugin", "v1beta1.DRAPlugin",
+    )
+    server = RecordingServer()
+    grpc_defs.add_dra_plugin_servicer(grpc_defs.DraPluginServicer(), server)
+    for pkg in ("v1", "v1beta1"):
+        paths = expected_paths(pkg, "DRAPlugin", methods)
+        assert_server_serves(server, paths, dra_pb2)
+    for svc in grpc_defs.DRA_PLUGIN_SERVICES:
+        chan = RecordingChannel()
+        grpc_defs.DraPluginStub(chan, service=svc)
+        assert_stub_dials(
+            chan,
+            {f"/{svc}/{n}": m for n, m in methods.items()},
+        )
+
+
+def test_servicer_method_sets_match_protos(repo_protos):
+    """Every rpc in each proto has a same-named servicer method (and no
+    extras) — a renamed handler would register under the wrong path."""
+    cases = [
+        ("deviceplugin", "DevicePlugin", grpc_defs.DevicePluginServicer),
+        ("deviceplugin", "Registration", grpc_defs.RegistrationServicer),
+        ("pluginregistration", "Registration",
+         grpc_defs.WatcherRegistrationServicer),
+        ("podresources", "PodResourcesLister",
+         grpc_defs.PodResourcesListerServicer),
+        ("dra", "DRAPlugin", grpc_defs.DraPluginServicer),
+    ]
+    for proto_name, svc, cls in cases:
+        declared = set(repo_protos[proto_name].services[svc])
+        implemented = {
+            n for n in vars(cls) if not n.startswith("_")
+        }
+        assert declared == implemented, (
+            f"{cls.__name__}: methods {implemented} != proto {declared}"
+        )
