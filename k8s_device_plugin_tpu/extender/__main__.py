@@ -29,7 +29,18 @@ def main() -> int:
         level=logging.DEBUG if a.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
-    srv = ExtenderHTTPServer(host=a.host, port=a.port)
+    from .reservations import ReservationTable
+    from .server import TopologyExtender
+
+    # One reservation table wires the two halves together: what the
+    # gang admitter reserves before releasing gates, the extender's
+    # /filter withholds from every other pod (reservations.py).
+    reservations = ReservationTable()
+    srv = ExtenderHTTPServer(
+        extender=TopologyExtender(reservations=reservations),
+        host=a.host,
+        port=a.port,
+    )
     srv.start()
     gang = None
     if a.gang_admission:
@@ -39,6 +50,7 @@ def main() -> int:
         gang = GangAdmission(
             KubeClient.from_env(a.kubeconfig),
             resync_interval_s=a.gang_resync_s,
+            reservations=reservations,
         )
         gang.start()
     stop = threading.Event()

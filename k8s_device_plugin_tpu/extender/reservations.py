@@ -1,0 +1,221 @@
+"""Post-release gang reservations: closing the release→steal race.
+
+Gang admission's capacity check runs on published availability, and gate
+removal is not a placement: before this existed, any pod could take the
+chips between release and scheduling, stranding the whole gang Pending
+with its gates gone (VERDICT r3 weak #4). Scheduling gates cannot be
+re-ADDED to a live pod (the Pod API permits removal only), so re-gating
+a stranded gang after the fact is not an option against a real API
+server; the fix is to make the reservation FIRST:
+
+* tick() records the exact host→chip counts its feasibility check
+  consumed — BEFORE removing any gate — in this table;
+* the extender's /filter subtracts reservations held by OTHER gangs
+  from every candidate node's availability, so a competitor pod stops
+  passing /filter on the reserved chips the instant the gang releases
+  (the gang's own pods are exempt from their own reservation);
+* the admission tick subtracts all active reservations from its own
+  capacity view, so a second gang can't be released into chips a
+  released-but-not-yet-scheduled gang is counting on (the daemon's
+  published availability lags scheduling).
+
+Lifecycle: a reservation shrinks as gang members schedule (a scheduled
+member's chips show up in the daemon's republished availability, so
+keeping them reserved would double-count), is dropped when every member
+is scheduled or the gang vanishes, is renewed each tick while members
+are still Pending, and lapses at a hard age cap so a gang that can
+never schedule (node died post-release) doesn't fence capacity forever
+— after the lapse the gang Pends like any unschedulable pod, which is
+the API's floor once gates are gone.
+
+One table is shared in-process between GangAdmission and the
+TopologyExtender (deploy/tpu-extender.yml runs both in one container;
+extender/__main__.py wires them). It is deliberately in-memory: on
+restart, gangs released-but-unscheduled lose protection for one
+scheduling race at most, and the admission tick re-reserves on its next
+pass if they still fit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Optional, Set, Tuple
+
+GangKey = Tuple[str, str]  # (namespace, gang name)
+
+DEFAULT_TTL_S = 60.0
+DEFAULT_MAX_AGE_S = 300.0
+
+
+@dataclasses.dataclass
+class Reservation:
+    gang: GangKey
+    # host → chips still reserved there (shrinks as members schedule).
+    hosts: Dict[str, int]
+    created_at: float
+    expires_at: float
+    # Pod names whose placement was already subtracted from ``hosts``.
+    counted_pods: Set[str] = dataclasses.field(default_factory=set)
+
+    @property
+    def total_chips(self) -> int:
+        return sum(self.hosts.values())
+
+
+class ReservationTable:
+    """Thread-safe gang→reservation map with TTL + hard age cap."""
+
+    def __init__(
+        self,
+        ttl_s: float = DEFAULT_TTL_S,
+        max_age_s: float = DEFAULT_MAX_AGE_S,
+        clock=time.monotonic,
+    ):
+        self.ttl_s = ttl_s
+        self.max_age_s = max_age_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._by_gang: Dict[GangKey, Reservation] = {}
+        self.lapsed_total = 0  # reservations that hit the hard age cap
+
+    # -- mutation ----------------------------------------------------------
+
+    def reserve(self, gang: GangKey, host_chips: Dict[str, int]) -> None:
+        now = self._clock()
+        with self._lock:
+            self._by_gang[gang] = Reservation(
+                gang=gang,
+                hosts={h: int(n) for h, n in host_chips.items() if n > 0},
+                created_at=now,
+                expires_at=now + self.ttl_s,
+            )
+
+    def renew(self, gang: GangKey) -> bool:
+        """Extend the reservation's expiry; False when absent or past the
+        hard age cap (the caller logs the lapse; expiry then prunes)."""
+        now = self._clock()
+        with self._lock:
+            r = self._by_gang.get(gang)
+            if r is None:
+                return False
+            if now - r.created_at >= self.max_age_s:
+                return False
+            r.expires_at = min(
+                now + self.ttl_s, r.created_at + self.max_age_s
+            )
+            return True
+
+    def drop(self, gang: GangKey) -> None:
+        with self._lock:
+            self._by_gang.pop(gang, None)
+
+    def lapse(self, gang: GangKey) -> None:
+        """Drop a reservation that aged out with work still unscheduled
+        (counted; ordinary drops are not)."""
+        with self._lock:
+            r = self._by_gang.pop(gang, None)
+            if r is not None and r.hosts:
+                self.lapsed_total += 1
+
+    def clear(self) -> None:
+        """Drop every reservation (test isolation for DEFAULT_TABLE)."""
+        with self._lock:
+            self._by_gang.clear()
+            self.lapsed_total = 0
+
+    def note_scheduled(
+        self, gang: GangKey, pod_name: str, hostname: str, chips: int
+    ) -> None:
+        """A gang member landed: release its chips from the reservation
+        (the daemon's republished availability now accounts for them).
+        Idempotent per pod name."""
+        with self._lock:
+            r = self._by_gang.get(gang)
+            if r is None or pod_name in r.counted_pods:
+                return
+            r.counted_pods.add(pod_name)
+            if hostname in r.hosts:
+                r.hosts[hostname] = max(0, r.hosts[hostname] - chips)
+                if r.hosts[hostname] == 0:
+                    del r.hosts[hostname]
+
+    # -- queries -----------------------------------------------------------
+
+    def _prune_locked(self) -> None:
+        now = self._clock()
+        for key in [
+            k for k, r in self._by_gang.items()
+            if r.expires_at <= now or not r.hosts
+        ]:
+            r = self._by_gang.pop(key)
+            if r.hosts and now - r.created_at >= self.max_age_s:
+                self.lapsed_total += 1
+
+    def active(self) -> Dict[GangKey, Reservation]:
+        """Snapshot of live reservations (expired ones pruned)."""
+        with self._lock:
+            self._prune_locked()
+            return {
+                k: dataclasses.replace(r, hosts=dict(r.hosts))
+                for k, r in self._by_gang.items()
+            }
+
+    def reserved_chips(
+        self, hostname: str, exclude: Optional[GangKey] = None
+    ) -> int:
+        """Chips reserved on ``hostname`` by gangs other than
+        ``exclude`` (a pod is never blocked by its own gang's hold)."""
+        with self._lock:
+            self._prune_locked()
+            return sum(
+                r.hosts.get(hostname, 0)
+                for k, r in self._by_gang.items()
+                if k != exclude
+            )
+
+    def apply(self, topos, exclude: Optional[GangKey] = None) -> Dict[str, int]:
+        """Subtract active holds from published NodeTopology
+        availability, in place (chips within a host are fungible for
+        counting — the hold fences a COUNT, not identities). The ONE
+        place the holds→availability mapping lives: both the extender's
+        /filter shield and the admission tick's capacity view go
+        through here, so they cannot drift. Returns hostname→chips
+        withheld (for failure-reason diagnostics)."""
+        withheld: Dict[str, int] = {}
+        for t in topos:
+            held = self.reserved_chips(t.hostname, exclude=exclude)
+            if held > 0:
+                t.available = t.available[
+                    : max(0, len(t.available) - held)
+                ]
+                withheld[t.hostname] = held
+        return withheld
+
+    def snapshot(self) -> list:
+        """JSON-ready view of active holds (extender /reservations
+        endpoint; tools/gang injects it so the CLI's verdicts match the
+        in-process controller's)."""
+        now = self._clock()
+        return [
+            {
+                "namespace": k[0],
+                "gang": k[1],
+                "hosts": dict(r.hosts),
+                "age_s": round(now - r.created_at, 1),
+                "expires_in_s": round(r.expires_at - now, 1),
+            }
+            for k, r in sorted(self.active().items())
+        ]
+
+    def load_snapshot(self, entries) -> None:
+        """Rebuild holds from a snapshot() payload (fresh TTLs — the
+        consumer is a short-lived diagnosis pass, not the owner)."""
+        for e in entries:
+            self.reserve((e["namespace"], e["gang"]), dict(e["hosts"]))
+
+
+# The in-process table GangAdmission and TopologyExtender share by
+# default (they run in one container, extender/__main__.py).
+DEFAULT_TABLE = ReservationTable()

@@ -36,6 +36,8 @@ from ..topology.schema import NodeTopology
 from ..topology.slice import SliceView, group_by_slice
 from ..utils.httpserver import BackgroundHTTPServer
 from ..utils.podresources import tpu_request
+from .gang import pod_gang
+from .reservations import DEFAULT_TABLE, ReservationTable
 
 log = logging.getLogger(__name__)
 
@@ -45,8 +47,31 @@ MAX_SCORE = 10
 class TopologyExtender:
     """Pure scoring/filtering logic (HTTP wrapper below)."""
 
-    def __init__(self, resource_name: str = constants.RESOURCE_NAME):
+    def __init__(
+        self,
+        resource_name: str = constants.RESOURCE_NAME,
+        reservations: Optional[ReservationTable] = None,
+    ):
         self.resource_name = resource_name
+        # Shared with GangAdmission in this process: chips a released
+        # gang reserved before its gates came off are invisible to every
+        # OTHER pod's filter/score until that gang schedules (closes the
+        # release→steal race — see reservations.py).
+        self.reservations = (
+            DEFAULT_TABLE if reservations is None else reservations
+        )
+
+    def _shield(self, parsed, pod: dict) -> Dict[str, int]:
+        """Subtract other gangs' active reservations from each parsed
+        candidate's availability (in place; the NodeTopology objects are
+        per-request). A pod is never blocked by its own gang's hold —
+        the reservation exists FOR its gang. Returns hostname→chips
+        withheld, for failure-reason diagnostics."""
+        info = pod_gang(pod)
+        own = (info[0], info[1]) if info else None
+        return self.reservations.apply(
+            [t for _, t in parsed if t is not None], exclude=own
+        )
 
     # -- node topology parsing --------------------------------------------
 
@@ -79,6 +104,7 @@ class TopologyExtender:
         if n <= 0:
             return nodes, {}
         parsed = [(node, self._topology_of(node)) for node in nodes]
+        withheld = self._shield(parsed, pod)
         topos = [t for _, t in parsed if t is not None]
         # Slice views only matter when some candidate would serve this
         # request multi-host (same guard as prioritize).
@@ -97,14 +123,19 @@ class TopologyExtender:
             if local <= 0:
                 failed[name] = "node reports 0 TPU chips"
                 continue
+            held = withheld.get(topo.hostname, 0)
+            reserved_note = (
+                f" ({held} reserved for a released gang)" if held else ""
+            )
             if n > topo.chip_count:
                 reason = self._multi_host_reason(n, topo, slice_views)
                 if reason:
-                    failed[name] = reason
+                    failed[name] = reason + reserved_note
                     continue
             if len(topo.available) < local:
                 failed[name] = (
-                    f"{len(topo.available)} chips available, {local} needed"
+                    f"{len(topo.available)} chips available, "
+                    f"{local} needed{reserved_note}"
                 )
                 continue
             passing.append(node)
@@ -199,6 +230,7 @@ class TopologyExtender:
             if n > 0
             else [(node, None) for node in nodes]
         )
+        self._shield(parsed, pod)  # score on shielded availability too
         topos = [t for _, t in parsed if t is not None]
         # Slice views are only needed when some candidate would serve this
         # request multi-host.
@@ -307,6 +339,11 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
             def do_GET(self):
                 if self.path == "/healthz":
                     self._send({"ok": True})
+                elif self.path == "/reservations":
+                    # Active gang holds (reservations.py) — consumed by
+                    # tools/gang so out-of-process diagnosis sees the
+                    # same capacity view the in-process admitter does.
+                    self._send(ext.reservations.snapshot())
                 elif self.path == "/metrics":
                     from ..utils.metrics import EXTENDER_REGISTRY
 

@@ -6,7 +6,15 @@ published topology — using exactly the admission controller's own
 evaluation (extender/gang.py), so the tool can never disagree with the
 admitter about why a gang is stuck.
 
+Reservation caveat: the admitter's capacity view also subtracts the
+in-memory holds of released-but-unscheduled gangs (extender/
+reservations.py), which live inside the extender process. Pass
+``--extender-url http://<extender>:12346`` to fetch them from its
+/reservations endpoint; without it this tool evaluates on published
+availability alone and says so.
+
     python -m k8s_device_plugin_tpu.tools.gang --kubeconfig ~/.kube/config
+    python -m k8s_device_plugin_tpu.tools.gang --extender-url http://extender:12346
     python -m k8s_device_plugin_tpu.tools.gang --json
 """
 
@@ -17,6 +25,7 @@ import json
 import sys
 
 from ..extender.gang import GangAdmission
+from ..extender.reservations import ReservationTable
 from ..kube.client import KubeClient
 
 
@@ -24,10 +33,28 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--kubeconfig", default="")
     p.add_argument(
+        "--extender-url", default="",
+        help="extender base URL; fetches /reservations so verdicts "
+        "include released gangs' capacity holds",
+    )
+    p.add_argument(
         "--json", action="store_true", help="machine-readable output"
     )
     args = p.parse_args(argv)
-    adm = GangAdmission(KubeClient.from_env(args.kubeconfig))
+    table = ReservationTable()
+    holds_known = False
+    if args.extender_url:
+        import requests
+
+        resp = requests.get(
+            args.extender_url.rstrip("/") + "/reservations", timeout=10
+        )
+        resp.raise_for_status()
+        table.load_snapshot(resp.json())
+        holds_known = True
+    adm = GangAdmission(
+        KubeClient.from_env(args.kubeconfig), reservations=table
+    )
     reports = adm.explain()
     if args.json:
         print(json.dumps(reports, indent=1))
@@ -35,6 +62,11 @@ def main(argv=None) -> int:
     if not reports:
         print("no gang-labeled pods found")
         return 0
+    if not holds_known:
+        print(
+            "note: evaluated WITHOUT the extender's reservation holds "
+            "(pass --extender-url to include them)"
+        )
     width = max(len(f"{r['namespace']}/{r['gang']}") for r in reports)
     for r in reports:
         name = f"{r['namespace']}/{r['gang']}"

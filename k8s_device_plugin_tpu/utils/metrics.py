@@ -228,6 +228,19 @@ GANG_WAITING = EXTENDER_REGISTRY.gauge(
     "tpu_gang_waiting",
     "Complete gangs currently gated for lack of TPU capacity",
 )
+GANG_RESERVED = EXTENDER_REGISTRY.gauge(
+    "tpu_gang_reservations",
+    "Released-but-unscheduled gangs currently holding a chip reservation",
+)
+GANG_RESERVED_CHIPS = EXTENDER_REGISTRY.gauge(
+    "tpu_gang_reserved_chips",
+    "Chips fenced off for released-but-unscheduled gangs",
+)
+GANG_RESERVATIONS_LAPSED = EXTENDER_REGISTRY.counter(
+    "tpu_gang_reservations_lapsed_total",
+    "Gang reservations that hit the hard age cap with pods still "
+    "unscheduled (their chips are no longer fenced)",
+)
 
 
 class MetricsServer(BackgroundHTTPServer):

@@ -65,3 +65,14 @@ def no_leaked_controller_threads():
         if t.name in _GUARDED_THREADS and t.is_alive()
     ]
     assert not leaked, f"test leaked controller threads: {leaked}"
+
+
+@pytest.fixture(autouse=True)
+def fresh_reservation_table():
+    """GangAdmission and TopologyExtender share the module-level
+    DEFAULT_TABLE when not wired explicitly; reservations made in one
+    test must not fence capacity in the next."""
+    from k8s_device_plugin_tpu.extender.reservations import DEFAULT_TABLE
+
+    DEFAULT_TABLE.clear()
+    yield
