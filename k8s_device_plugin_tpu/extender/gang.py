@@ -154,9 +154,14 @@ class GangAdmission:
         )
         # Holds are renewed once per tick, so they must outlive several
         # resyncs — with a long --gang-resync-s a 60s TTL would expire
-        # between renewals and silently reopen the steal window.
+        # between renewals and silently reopen the steal window. The
+        # hard age cap scales with it (else every hold would already be
+        # past the cap at its first renewal and lapse immediately).
         self.reservations.ttl_s = max(
             self.reservations.ttl_s, 4 * resync_interval_s
+        )
+        self.reservations.max_age_s = max(
+            self.reservations.max_age_s, 2 * self.reservations.ttl_s
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -288,7 +293,7 @@ class GangAdmission:
         # chips are spoken for.
         topos = self._node_topologies()
         self.reservations.apply(topos)
-        standing = set(self.reservations.active())
+        standing = self.reservations.active()
         released = []
         waiting_now = 0
         for key, gv in sorted(gangs.items()):
@@ -345,29 +350,47 @@ class GangAdmission:
                 self._release(gated)
                 released.append(key)
                 continue
-            if key in standing:
-                # A previous pass reserved and then EVERY gate-removal
-                # patch failed (e.g. apiserver outage): the
-                # all-or-nothing decision is made and its chips are
-                # still fenced — by this gang's OWN hold, which the
-                # capacity view above already subtracted, so a re-check
-                # here would wrongly read "no capacity" and deadlock
-                # until the hold's age cap. Finish the release against
-                # the standing reservation instead.
+            hold = standing.get(key)
+            demands = gv.demands(self.resource_name)
+            if hold is not None:
+                if tuple(sorted(demands)) == hold.demands:
+                    # A previous pass reserved and then EVERY
+                    # gate-removal patch failed (e.g. apiserver
+                    # outage): the all-or-nothing decision is made and
+                    # its chips are still fenced — by this gang's OWN
+                    # hold, which the capacity view above already
+                    # subtracted, so a re-check here would wrongly read
+                    # "no capacity" and deadlock until the hold's age
+                    # cap. Finish the release against the standing
+                    # reservation instead.
+                    log.warning(
+                        "gang %s/%s: finishing release against its "
+                        "standing reservation (previous release pass "
+                        "failed wholesale)", key[0], key[1],
+                    )
+                    self._release(gated)
+                    released.append(key)
+                    continue
+                # Same-named gang recreated with a DIFFERENT shape
+                # while its predecessor's hold lived: the hold fences
+                # chips sized for the old gang and must not excuse a
+                # capacity check for the new one. Drop it; this tick's
+                # view already subtracted it (conservative), so the
+                # fresh evaluation happens next resync on honest
+                # availability.
                 log.warning(
-                    "gang %s/%s: finishing release against its "
-                    "standing reservation (previous release pass "
-                    "failed wholesale)", key[0], key[1],
+                    "gang %s/%s: demands changed under a standing "
+                    "reservation (%s -> %s); dropping the stale hold "
+                    "and re-evaluating next resync",
+                    key[0], key[1], list(hold.demands), sorted(demands),
                 )
-                self._release(gated)
-                released.append(key)
+                self.reservations.drop(key)
                 continue
             # Whole-gang capacity check over live + Failed-stand-in
             # demands (GangView.demands): a restarted gang only starts
             # releasing into capacity that can hold ALL of it, while a
             # Succeeded member's finished work no longer holds the
             # remainder hostage.
-            demands = gv.demands(self.resource_name)
             fit = self._fits(demands, topos)
             if fit is None:
                 waiting_now += 1
@@ -386,8 +409,12 @@ class GangAdmission:
             }
             # Reserve BEFORE the first gate comes off: from the moment a
             # competitor pod can be scheduled, /filter already subtracts
-            # this gang's hold (the whole point — reservations.py).
-            self.reservations.reserve(key, consumed_hosts)
+            # this gang's hold (the whole point — reservations.py). The
+            # demands fingerprint lets a later tick detect a recreated
+            # same-named gang of a different shape.
+            self.reservations.reserve(
+                key, consumed_hosts, demands=tuple(sorted(demands))
+            )
             self._release(gated)
             released.append(key)
             log.info(
@@ -464,7 +491,7 @@ class GangAdmission:
         gangs = self._collect_gangs()
         topos = self._node_topologies()
         self.reservations.apply(topos)
-        standing = set(self.reservations.active())
+        standing = self.reservations.active()
         reports = []
         for key, gv in sorted(gangs.items()):
             members = gv.members
@@ -490,10 +517,18 @@ class GangAdmission:
                     )
                 else:
                     status = "partial release in progress"
-            elif key in standing:
+            elif (
+                key in standing
+                and tuple(sorted(demands)) == standing[key].demands
+            ):
                 status = (
                     "release retry due next resync (standing "
                     "reservation from a failed release pass)"
+                )
+            elif key in standing:
+                status = (
+                    "stale hold from a differently-shaped predecessor: "
+                    "re-evaluated next resync"
                 )
             else:
                 fit = self._fits(demands, topos)
@@ -550,9 +585,14 @@ class GangAdmission:
         host size, contiguous box preferred but not required — box-ness
         is a scoring preference at placement time). Conservative on
         purpose — a gang NOT released here definitely cannot fit."""
-        import copy
-
-        work = [copy.deepcopy(t) for t in topos]
+        # Shallow per-node copies: _place_* only ever reassigns
+        # ``available``, so cloning just that list (not the chip
+        # objects) keeps a 1,000-node x 100-gang tick out of deepcopy
+        # territory (measured by extender/scale_bench.py).
+        work = [
+            dataclasses.replace(t, available=list(t.available))
+            for t in topos
+        ]
         by_host = {t.hostname: t for t in work}
         consumed: Dict[str, int] = {}
         for n in sorted((d for d in demands if d > 0), reverse=True):

@@ -56,6 +56,11 @@ class Reservation:
     hosts: Dict[str, int]
     created_at: float
     expires_at: float
+    # The sorted per-pod demands the hold was reserved FOR: lets the
+    # admitter detect that a same-named gang was deleted and recreated
+    # with a different shape while the hold lived (the hold then fences
+    # the wrong chips and must not excuse a fresh capacity check).
+    demands: Tuple[int, ...] = ()
     # Pod names whose placement was already subtracted from ``hosts``.
     counted_pods: Set[str] = dataclasses.field(default_factory=set)
 
@@ -82,14 +87,25 @@ class ReservationTable:
 
     # -- mutation ----------------------------------------------------------
 
-    def reserve(self, gang: GangKey, host_chips: Dict[str, int]) -> None:
+    def reserve(
+        self,
+        gang: GangKey,
+        host_chips: Dict[str, int],
+        demands: Tuple[int, ...] = (),
+    ) -> None:
         now = self._clock()
         with self._lock:
             self._by_gang[gang] = Reservation(
                 gang=gang,
                 hosts={h: int(n) for h, n in host_chips.items() if n > 0},
                 created_at=now,
-                expires_at=now + self.ttl_s,
+                # The hard age cap bounds even the FIRST expiry: ttl_s
+                # can be auto-raised past max_age_s (long resyncs), and
+                # an unclamped first window would outlive the documented
+                # cap whenever renewals stop (e.g. admission thread dies
+                # while the extender keeps serving /filter).
+                expires_at=now + min(self.ttl_s, self.max_age_s),
+                demands=tuple(sorted(demands)),
             )
 
     def renew(self, gang: GangKey) -> bool:
@@ -182,10 +198,22 @@ class ReservationTable:
         place the holds→availability mapping lives: both the extender's
         /filter shield and the admission tick's capacity view go
         through here, so they cannot drift. Returns hostname→chips
-        withheld (for failure-reason diagnostics)."""
+        withheld (for failure-reason diagnostics).
+
+        One lock acquisition and one prune for the whole call — a
+        per-node reserved_chips() would put O(nodes × holds) lock/prune
+        cycles on the scheduler's /filter hot path."""
+        with self._lock:
+            self._prune_locked()
+            held_by_host: Dict[str, int] = {}
+            for k, r in self._by_gang.items():
+                if k == exclude:
+                    continue
+                for h, n in r.hosts.items():
+                    held_by_host[h] = held_by_host.get(h, 0) + n
         withheld: Dict[str, int] = {}
         for t in topos:
-            held = self.reserved_chips(t.hostname, exclude=exclude)
+            held = held_by_host.get(t.hostname, 0)
             if held > 0:
                 t.available = t.available[
                     : max(0, len(t.available) - held)

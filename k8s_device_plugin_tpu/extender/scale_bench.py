@@ -1,0 +1,217 @@
+"""Control-plane scale benchmark: extender + gang admission at cluster
+scale (default 1,000 nodes / 100 gangs — VERDICT r3 #7).
+
+The reference never measured its control plane (SURVEY.md §6: no
+numbers anywhere); this module makes the TPU build's scheduler-facing
+latencies first-class artifacts: the driver bench (bench.py) runs it
+in-process — no accelerator involved — and records p50/p99 in
+`detail.control_plane_scale`, and tests/test_scale_bench.py bounds the
+numbers so a regression fails CI rather than surfacing as scheduler
+timeouts on a big cluster.
+
+What is synthesized: N single-host v5e nodes (4 chips each) publishing
+REAL NodeTopology JSON annotations — every /filter call re-parses them
+exactly like production — and G complete, gated gangs of 2 pods × 2
+chips. A stub kube client serves the objects without HTTP so the
+numbers isolate the scoring/admission logic (the HTTP layer is a thin
+json loads/dumps measured live by the RPC-latency histograms).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..api import constants
+from ..discovery.chips import TpuChip
+from ..topology.mesh import IciMesh
+from ..topology.schema import NodeTopology
+from .gang import GANG_NAME_LABEL, GANG_SIZE_LABEL, GATE_NAME, GangAdmission
+from .reservations import ReservationTable
+from .server import TopologyExtender
+
+
+def _node(name: str, n_chips: int = 4) -> dict:
+    chips = [
+        TpuChip(
+            index=i,
+            dev_path=f"/dev/accel{i}",
+            pci_addr=f"0000:0{i}:00.0",
+            vendor_id=0x1AE0,
+            device_id=0x0063,
+            numa_node=0,
+            chip_type="v5e",
+            hbm_bytes=16 << 30,
+            core_count=1,
+        )
+        for i in range(n_chips)
+    ]
+    topo = NodeTopology.from_mesh(IciMesh(chips), hostname=name)
+    return {
+        "metadata": {
+            "name": name,
+            "annotations": {constants.TOPOLOGY_ANNOTATION: topo.to_json()},
+        }
+    }
+
+
+def _gang_pod(name: str, gang: str, size: int, chips: int) -> dict:
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "labels": {
+                GANG_NAME_LABEL: gang,
+                GANG_SIZE_LABEL: str(size),
+            },
+        },
+        "spec": {
+            "schedulingGates": [{"name": GATE_NAME}],
+            "containers": [
+                {
+                    "name": "main",
+                    "resources": {
+                        "requests": {constants.RESOURCE_NAME: str(chips)}
+                    },
+                }
+            ],
+        },
+    }
+
+
+def _plain_pod(chips: int) -> dict:
+    return {
+        "metadata": {"name": "bench", "namespace": "default"},
+        "spec": {
+            "containers": [
+                {
+                    "name": "main",
+                    "resources": {
+                        "requests": {constants.RESOURCE_NAME: str(chips)}
+                    },
+                }
+            ]
+        },
+    }
+
+
+class _StubClient:
+    """The two list calls and the gate patch GangAdmission makes, served
+    from memory. Gate removal mutates the pod in place like the real
+    apiserver would."""
+
+    def __init__(self, nodes: List[dict], pods: List[dict]):
+        self.nodes = nodes
+        self.pods = pods
+
+    def list_nodes(self, label_selector: str = "") -> dict:
+        return {"items": self.nodes}
+
+    def list_pods(self, label_selector: str = "", **kw) -> dict:
+        return {"items": self.pods}
+
+    def get_pod(self, ns: str, name: str) -> dict:
+        for p in self.pods:
+            m = p.get("metadata") or {}
+            if m.get("namespace") == ns and m.get("name") == name:
+                return p
+        raise KeyError(f"{ns}/{name}")
+
+    def remove_pod_scheduling_gate(
+        self, ns: str, name: str, gate_name: str, gates: List[dict]
+    ) -> dict:
+        pod = self.get_pod(ns, name)
+        pod["spec"]["schedulingGates"] = [
+            g
+            for g in pod["spec"].get("schedulingGates", [])
+            if g.get("name") != gate_name
+        ]
+        return pod
+
+
+def _pctl(samples_s: List[float]) -> Dict[str, float]:
+    xs = sorted(samples_s)
+    return {
+        "p50_ms": round(xs[len(xs) // 2] * 1e3, 2),
+        "p99_ms": round(xs[min(len(xs) - 1, int(len(xs) * 0.99))] * 1e3, 2),
+        "samples": len(xs),
+    }
+
+
+def run(
+    n_nodes: int = 1000,
+    n_gangs: int = 100,
+    filter_calls: int = 20,
+    tick_rounds: int = 3,
+) -> dict:
+    nodes = [_node(f"node-{i:04d}") for i in range(n_nodes)]
+    ext = TopologyExtender(reservations=ReservationTable())
+
+    filter_s: List[float] = []
+    prioritize_s: List[float] = []
+    for i in range(filter_calls):
+        pod = _plain_pod(chips=(1, 2, 4)[i % 3])
+        t0 = time.perf_counter()
+        passing, _ = ext.filter(pod, nodes)
+        filter_s.append(time.perf_counter() - t0)
+        assert len(passing) == n_nodes  # all-free cluster must all pass
+        t0 = time.perf_counter()
+        scores = ext.prioritize(pod, nodes)
+        prioritize_s.append(time.perf_counter() - t0)
+        assert len(scores) == n_nodes
+
+    def fresh_admission() -> Tuple[GangAdmission, List[dict]]:
+        pods = [
+            _gang_pod(f"g{g:03d}-w{i}", f"gang-{g:03d}", 2, 2)
+            for g in range(n_gangs)
+            for i in range(2)
+        ]
+        client = _StubClient(nodes, pods)
+        return (
+            GangAdmission(client, reservations=ReservationTable()),
+            pods,
+        )
+
+    # "Full" tick: every gang complete and releasable — discovery,
+    # capacity-checking, reserving, and releasing all n_gangs in one
+    # pass (the worst-case tick a resync can see).
+    tick_full_s: List[float] = []
+    steady_s: List[float] = []
+    for _ in range(tick_rounds):
+        adm, pods = fresh_admission()
+        t0 = time.perf_counter()
+        released = adm.tick()
+        tick_full_s.append(time.perf_counter() - t0)
+        assert len(released) == n_gangs
+        # Steady tick: everything already released, holds being renewed
+        # — the every-resync cost while gangs wait to schedule.
+        t0 = time.perf_counter()
+        adm.tick()
+        steady_s.append(time.perf_counter() - t0)
+
+    return {
+        "nodes": n_nodes,
+        "gangs": n_gangs,
+        "filter": _pctl(filter_s),
+        "prioritize": _pctl(prioritize_s),
+        "gang_tick_full": _pctl(tick_full_s),
+        "gang_tick_steady": _pctl(steady_s),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nodes", type=int, default=1000)
+    p.add_argument("--gangs", type=int, default=100)
+    a = p.parse_args(argv)
+    print(json.dumps(run(n_nodes=a.nodes, n_gangs=a.gangs)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

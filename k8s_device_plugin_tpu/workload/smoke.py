@@ -71,14 +71,31 @@ def run_smoke(
     seed: int = 0,
     inner_steps: int = 1,
     xent_chunk: int = 0,
+    emit=None,
 ) -> dict:
     """inner_steps > 1 runs the step loop device-side via
     train.make_multi_train_step (lax.scan over real sequential updates):
     one dispatch and one host sync per ``inner_steps`` steps. ``steps``
-    rounds up to a multiple of ``inner_steps``."""
+    rounds up to a multiple of ``inner_steps``.
+
+    ``emit``, when given, is called with a snapshot of the report after
+    every milestone — devices up, first (compiled) step, each measured
+    window — so a caller that must kill this process mid-run keeps the
+    best partial instead of losing everything to the one final print
+    (VERDICT r3 missing #2; the shape microbench --stream proved).
+    Partial snapshots carry ``ok: None`` and a ``partial`` stage tag;
+    only the final report carries the real ok verdict and no tag."""
     from ..utils import compilation_cache
 
     compilation_cache.maybe_enable()
+    report: dict = {"ok": None}
+
+    def _emit(stage: str) -> None:
+        if emit is not None:
+            snap = dict(report)
+            snap["partial"] = stage
+            emit(snap)
+
     t0 = time.monotonic()
     devices = jax.devices()
     t_devices = time.monotonic() - t0
@@ -90,16 +107,96 @@ def run_smoke(
 
         cfg = dataclasses.replace(cfg, xent_chunk=xent_chunk)
     mesh = make_mesh(devices)
+    report.update(
+        {
+            "backend": jax.default_backend(),
+            "devices": len(devices),
+            "device_kind": devices[0].device_kind if devices else "",
+            "expected_devices": expected,
+            "devices_match": expected is None or expected == len(devices),
+            "mesh": dict(mesh.shape),
+            "time_to_devices_s": round(t_devices, 3),
+            "inner_steps": max(inner_steps, 1),
+            "xent_chunk": cfg.xent_chunk,
+        }
+    )
+    _emit("devices_up")
+
     params, opt_state, tx = train.make_train_state(
         cfg, mesh, jax.random.PRNGKey(seed)
     )
     batch = batch_per_device * len(devices)
     inner_steps = max(inner_steps, 1)
 
+    # Tokens are uniform random, so the step-1 loss of an untrained model
+    # cannot be below ln(vocab) (cross entropy vs independent logits).
+    # A value below the floor means the compiled program is WRONG — this
+    # caught a real silent miscompilation (buffer corruption at memory
+    # pressure) on a remote-compile backend.
+    import math
+
+    loss_floor = math.log(cfg.vocab_size)
+
     def token_batch(key):
         return jax.random.randint(
             key, (batch, cfg.max_seq_len), 0, cfg.vocab_size
         )
+
+    def note_first_step(first_loss: float, t_first_step: float) -> None:
+        report.update(
+            {
+                "time_to_first_step_s": round(t_first_step, 3),
+                # Until a steady-state rate exists, readiness is the
+                # whole first dispatch; refined after the windows.
+                "time_to_ready_s": round(t_first_step, 3),
+                "first_loss": round(first_loss, 4),
+                "first_loss_floor": round(loss_floor, 4),
+                "first_loss_sane": first_loss > loss_floor - 0.25,
+            }
+        )
+        _emit("first_step")
+
+    def note_window(
+        loss: float, step_time: float, windows_done: int, windows: int
+    ) -> None:
+        flops_step = cfg.train_flops_per_step(batch)
+        peak = peak_flops_for(
+            devices[0].device_kind if devices else "",
+            len(devices),
+            jax.default_backend(),
+        )
+        mfu = (flops_step / step_time / peak) if peak > 0 else None
+        report.update(
+            {
+                # Readiness, not throughput: the first multi-step
+                # dispatch runs compile/cache-load + ONE optimizer step
+                # and then (inner_steps-1) MORE real training steps
+                # before the host can observe anything — the pod is
+                # already doing useful work during those, so they are
+                # steady-state throughput, not time-to-ready. Subtract
+                # them at the measured rate (clamped non-negative).
+                "time_to_ready_s": round(
+                    max(
+                        report["time_to_first_step_s"]
+                        - (inner_steps - 1) * step_time,
+                        0.0,
+                    ),
+                    3,
+                ),
+                "step_time_s": round(step_time, 5),
+                "tokens_per_s": round(
+                    batch * cfg.max_seq_len / step_time, 1
+                ),
+                "model_flops_per_step": flops_step,
+                "peak_flops_bf16": peak,
+                "mfu": round(mfu, 4) if mfu is not None else None,
+                "final_loss": round(loss, 4),
+                "loss_decreased": loss < report["first_loss"],
+                "measured_windows": f"{windows_done}/{windows}",
+            }
+        )
+        if windows_done < windows:
+            _emit(f"window_{windows_done}/{windows}")
 
     if inner_steps > 1:
         mstep = train.make_multi_train_step(cfg, mesh, tx, inner_steps)
@@ -121,18 +218,19 @@ def run_smoke(
         t1 = time.monotonic()
         params, opt_state, losses = mstep(params, opt_state, stack)
         first_loss = float(losses[0])
-        t_first_step = time.monotonic() - t1
+        note_first_step(first_loss, time.monotonic() - t1)
 
         calls = max((steps + inner_steps - 1) // inner_steps, 1)
         t2 = time.monotonic()
-        for _ in range(calls):
+        loss = first_loss
+        for i in range(calls):
             params, opt_state, losses = mstep(params, opt_state, stack)
-        # Mean over the final pass: single-batch losses are noisy; the
-        # mean must sit below the first (highest, pre-update) loss once
-        # the repeated batches are being learned.
-        loss = float(jnp.mean(losses))
-        elapsed = time.monotonic() - t2
-        step_time = elapsed / (calls * inner_steps)
+            # Mean over the pass: single-batch losses are noisy; the
+            # mean must sit below the first (highest, pre-update) loss
+            # once the repeated batches are being learned.
+            loss = float(jnp.mean(losses))  # blocks: window boundary
+            step_time = (time.monotonic() - t2) / ((i + 1) * inner_steps)
+            note_window(loss, step_time, i + 1, calls)
     else:
         step = train.make_train_step(cfg, mesh, tx)
         tokens = jax.device_put(
@@ -142,70 +240,23 @@ def run_smoke(
         t1 = time.monotonic()
         params, opt_state, first_loss = step(params, opt_state, tokens)
         first_loss = float(first_loss)  # blocks on the compiled step
-        t_first_step = time.monotonic() - t1
+        note_first_step(first_loss, time.monotonic() - t1)
 
         t2 = time.monotonic()
         loss = first_loss
         for _ in range(steps):
             params, opt_state, loss = step(params, opt_state, tokens)
         loss = float(loss)
-        elapsed = time.monotonic() - t2
-        step_time = elapsed / max(steps, 1)
+        step_time = (time.monotonic() - t2) / max(steps, 1)
+        note_window(loss, step_time, 1, 1)
 
-    flops_step = cfg.train_flops_per_step(batch)
-    peak = peak_flops_for(
-        devices[0].device_kind if devices else "",
-        len(devices),
-        jax.default_backend(),
+    report["ok"] = (
+        bool(report["devices_match"])
+        and report["loss_decreased"]
+        and report["first_loss_sane"]
+        and math.isfinite(loss)
     )
-    mfu = (flops_step / step_time / peak) if peak > 0 else None
-
-    # Tokens are uniform random, so the step-1 loss of an untrained model
-    # cannot be below ln(vocab) (cross entropy vs independent logits).
-    # A value below the floor means the compiled program is WRONG — this
-    # caught a real silent miscompilation (buffer corruption at memory
-    # pressure) on a remote-compile backend.
-    import math
-
-    loss_floor = math.log(cfg.vocab_size)
-    first_loss_sane = first_loss > loss_floor - 0.25
-
-    return {
-        "backend": jax.default_backend(),
-        "devices": len(devices),
-        "device_kind": devices[0].device_kind if devices else "",
-        "expected_devices": expected,
-        "devices_match": expected is None or expected == len(devices),
-        "mesh": dict(mesh.shape),
-        "time_to_devices_s": round(t_devices, 3),
-        "time_to_first_step_s": round(t_first_step, 3),
-        # Readiness, not throughput: the first multi-step dispatch runs
-        # compile/cache-load + ONE optimizer step and then (inner_steps-1)
-        # MORE real training steps before the host can observe anything —
-        # the pod is already doing useful work during those, so they are
-        # steady-state throughput, not time-to-ready. Subtract them at the
-        # measured steady-state rate (clamped: the estimate can't make
-        # readiness negative).
-        "time_to_ready_s": round(
-            max(t_first_step - (inner_steps - 1) * step_time, 0.0), 3
-        ),
-        "inner_steps": inner_steps,
-        "xent_chunk": cfg.xent_chunk,
-        "step_time_s": round(step_time, 5),
-        "tokens_per_s": round(batch * cfg.max_seq_len / step_time, 1),
-        "model_flops_per_step": flops_step,
-        "peak_flops_bf16": peak,
-        "mfu": round(mfu, 4) if mfu is not None else None,
-        "first_loss": round(first_loss, 4),
-        "first_loss_floor": round(loss_floor, 4),
-        "first_loss_sane": first_loss_sane,
-        "final_loss": round(loss, 4),
-        "loss_decreased": loss < first_loss,
-        "ok": (expected is None or expected == len(devices))
-        and loss < first_loss
-        and first_loss_sane
-        and jnp.isfinite(loss).item(),
-    }
+    return report
 
 
 def main(argv=None) -> int:
@@ -227,15 +278,25 @@ def main(argv=None) -> int:
         help="train with the chunked-vocab CE (ops/xent.py) at this "
         "chunk size (0 = full-logits loss)",
     )
+    p.add_argument(
+        "--no-stream", action="store_true",
+        help="suppress the per-milestone partial JSON lines (the final "
+        "report line is always printed)",
+    )
     args = p.parse_args(argv)
+
+    def emit(snapshot: dict) -> None:
+        print(json.dumps(snapshot), flush=True)
+
     report = run_smoke(
         steps=args.steps,
         cfg=ModelConfig.bench() if args.bench else None,
         batch_per_device=args.batch_per_device,
         inner_steps=args.inner_steps,
         xent_chunk=args.xent_chunk,
+        emit=None if args.no_stream else emit,
     )
-    print(json.dumps(report))
+    print(json.dumps(report), flush=True)
     return 0 if report["ok"] else 1
 
 

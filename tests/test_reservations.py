@@ -224,7 +224,10 @@ def test_lapsed_reservation_unfences_and_counts(api):
     assert adm.tick() == [("default", "train")]
     assert ext.filter(tpu_pod(1), [node])[0] == []
 
-    clock.t += 26  # past the cap; pods never scheduled
+    # GangAdmission scaled the table to ttl 4x5=20s / cap 2x20=40s;
+    # jump past the CAP (not merely the ttl) with pods never scheduled.
+    assert table.ttl_s == 20.0 and table.max_age_s == 40.0
+    clock.t += 41
     adm.tick()
     assert table.active() == {}
     assert metrics.GANG_RESERVATIONS_LAPSED.get() == 1
@@ -458,3 +461,59 @@ def test_reservations_endpoint_and_cli_injection(api, tmp_path):
         assert without["beta"]["status"].startswith("fits"), without
     finally:
         srv.stop()
+
+def test_recreated_gang_with_new_shape_does_not_ride_stale_hold(api):
+    """A same-named gang deleted and recreated with BIGGER demands while
+    its predecessor's hold lives must not be released on the stale
+    hold's say-so: the hold is dropped and the new shape is
+    capacity-checked (VERDICT-class strand: gates gone, no room)."""
+    server, client = api
+    table = ReservationTable()
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    for i in range(2):
+        server.add_pod(gang_pod(f"w{i}", "train", 2, 2))
+    adm = GangAdmission(client, reservations=table)
+
+    # Release pass whose gate patches ALL fail: hold stands, gates on.
+    real_remove = client.remove_pod_scheduling_gate
+    client.remove_pod_scheduling_gate = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("down")
+    )
+    assert adm.tick() == [("default", "train")]
+    client.remove_pod_scheduling_gate = real_remove
+    assert table.active()[("default", "train")].demands == (2, 2)
+
+    # Job retry: delete the pods, recreate the gang 2x as hungry —
+    # more than the whole cluster has.
+    for i in range(2):
+        server.pods.pop(("default", f"w{i}"))
+    for i in range(2):
+        server.add_pod(gang_pod(f"v{i}", "train", 2, 4))
+
+    assert adm.tick() == []  # stale hold dropped, NOT released
+    assert table.active() == {}
+    for i in range(2):
+        assert GATE_NAME in gates_of(server, "default", f"v{i}")
+    # Fresh evaluation next resync: 8 chips on a 4-chip node never fits.
+    assert adm.tick() == []
+    for i in range(2):
+        assert GATE_NAME in gates_of(server, "default", f"v{i}")
+
+
+def test_ttl_bump_scales_hard_age_cap_and_clamps_expiry(api):
+    """Long resyncs: ttl scales to 4x resync AND the age cap scales with
+    it (else every hold would lapse at its first renewal); reserve()
+    clamps the first expiry to the cap so a dead admission loop can't
+    fence chips past it."""
+    _, client = api
+    table = ReservationTable()  # ttl 60, max_age 300
+    GangAdmission(client, resync_interval_s=400.0, reservations=table)
+    assert table.ttl_s == 1600.0
+    assert table.max_age_s == 3200.0
+
+    clock = FakeClock()
+    t = ReservationTable(ttl_s=1600, max_age_s=300, clock=clock)
+    t.reserve(("ns", "g"), {"n1": 4})
+    clock.t += 301  # past the (smaller) cap: expiry must have hit first
+    assert t.reserved_chips("n1") == 0

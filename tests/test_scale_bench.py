@@ -1,0 +1,31 @@
+"""Control-plane scale regression guard (extender/scale_bench.py).
+
+Measured on the build machine (2026-07, Python 3.12): filter p50 ~29 ms
+/ p99 ~70 ms, prioritize p50 ~88 ms, gang full tick ~430 ms, steady
+tick ~80 ms at 1,000 nodes / 100 gangs. Bounds below carry ~5-10x
+headroom for slower CI hosts — they exist to catch algorithmic
+regressions (an accidental O(N²) rescore, a deepcopy creeping back into
+_fits), not to benchmark the host.
+"""
+
+from k8s_device_plugin_tpu.extender import scale_bench
+
+
+def test_scale_bench_bounds_at_full_scale():
+    r = scale_bench.run(n_nodes=1000, n_gangs=100, filter_calls=9,
+                        tick_rounds=2)
+    assert r["nodes"] == 1000 and r["gangs"] == 100
+    assert r["filter"]["p99_ms"] < 700, r
+    assert r["prioritize"]["p99_ms"] < 1300, r
+    assert r["gang_tick_full"]["p99_ms"] < 4500, r
+    assert r["gang_tick_steady"]["p99_ms"] < 1000, r
+
+
+def test_scale_bench_correctness_assertions_fire():
+    """run() itself asserts every node passes the all-free filter and
+    every gang releases — a tiny run keeps those invariants covered
+    without the full-scale cost."""
+    r = scale_bench.run(n_nodes=20, n_gangs=5, filter_calls=3,
+                        tick_rounds=1)
+    assert r["filter"]["samples"] == 3
+    assert r["gang_tick_full"]["samples"] == 1
