@@ -47,7 +47,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api import constants
 from ..kube.client import KubeClient
-from ..topology.schema import NodeTopology
+from ..topology.schema import NodeTopology, parse_topology_cached
 from ..topology.slice import SliceView, group_by_slice
 from ..utils import metrics
 from ..utils.podresources import tpu_request
@@ -559,8 +559,8 @@ class GangAdmission:
             if not raw:
                 continue
             try:
-                topos.append(NodeTopology.from_json(raw))
-            except (json.JSONDecodeError, TypeError, KeyError) as e:
+                topos.append(parse_topology_cached(raw))
+            except ValueError as e:  # every malformed shape, normalized
                 log.warning(
                     "bad topology annotation on %s: %s",
                     (node.get("metadata") or {}).get("name"), e,

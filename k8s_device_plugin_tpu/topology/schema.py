@@ -11,6 +11,7 @@ scheduler needs to co-locate a multi-host slice over mesh-adjacent hosts.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import platform
 from typing import List, Optional
@@ -175,7 +176,17 @@ class NodeTopology:
     def to_mesh(self) -> IciMesh:
         """Reconstruct the mesh (the extender does this from the node
         annotation). Chip order must reproduce the published coords, so
-        chips are rebuilt in their recorded coordinate order."""
+        chips are rebuilt in their recorded coordinate order.
+
+        Memoized per instance: the mesh depends only on chips/type/
+        torus/bounds, which no consumer mutates after parsing (the one
+        mutable field by contract is ``available``, which the mesh does
+        not read) — and the extender scores every candidate node on
+        every scheduler RPC, where rebuilding the adjacency/hop tables
+        dominated the profile."""
+        cached = self.__dict__.get("_mesh")
+        if cached is not None:
+            return cached
         ordered = sorted(
             self.chips,
             key=lambda c: (c.coords[2], c.coords[1], c.coords[0]),
@@ -197,4 +208,47 @@ class NodeTopology:
         spec = spec_for(self.chip_type, len(chips))
         if self.torus != spec.torus:
             spec = dataclasses.replace(spec, torus=self.torus)
-        return IciMesh(chips, spec=spec, bounds=tuple(self.host_bounds))
+        mesh = IciMesh(chips, spec=spec, bounds=tuple(self.host_bounds))
+        self.__dict__["_mesh"] = mesh  # plain attr: asdict/to_json skip it
+        return mesh
+
+
+# ---------------------------------------------------------------------------
+# Annotation parse cache
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=8192)
+def _parse_template(raw: str) -> NodeTopology:
+    """Parse + mesh-build once per distinct annotation string.
+
+    Any failure — json, schema, or mesh geometry (bad coords/bounds
+    that pass from_json but break IciMesh) — is normalized to
+    ValueError so every consumer can skip a malformed annotation with
+    one except clause instead of enumerating the internals' exception
+    types. lru_cache does not cache exceptions, so a bad annotation
+    stays the publisher's recurring problem, not a poisoned entry."""
+    try:
+        tmpl = NodeTopology.from_json(raw)
+        tmpl.to_mesh()  # memoize the mesh on the template
+    except Exception as e:  # noqa: BLE001 — untrusted input, normalized
+        raise ValueError(f"bad topology annotation: {e!r}") from e
+    return tmpl
+
+
+def parse_topology_cached(raw: str) -> NodeTopology:
+    """Parse a topology annotation with a process-wide LRU cache.
+
+    Every scheduler /filter+/prioritize RPC re-reads the SAME annotation
+    string for every candidate node, and the gang admitter re-reads them
+    every resync — json decode plus dataclass rebuild dominated the
+    1,000-node profile. The annotation string is immutable (a republish
+    is a new string, i.e. a new cache key), so caching on it is exact.
+
+    Returns a per-call CLONE whose ``available`` list is private —
+    callers (reservation shields, placement consumption) mutate it —
+    while the parsed chips and the memoized IciMesh are shared
+    read-only. Raises ValueError on any malformed annotation."""
+    tmpl = _parse_template(raw)
+    clone = dataclasses.replace(tmpl, available=list(tmpl.available))
+    clone.__dict__["_mesh"] = tmpl.__dict__.get("_mesh")
+    return clone

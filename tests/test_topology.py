@@ -358,3 +358,41 @@ def test_slice_view_drops_colliding_coords():
     view = SliceView([member(0), member(1), member(2)])
     assert (0, 0, 0) not in view.by_coords
     assert view.best_gang(2) == ([], 0)  # only h1's point survives
+
+
+def test_parse_topology_cached_tolerates_mesh_breaking_annotations():
+    """An annotation that json-decodes but breaks mesh geometry (short
+    coords) must surface as ValueError — the one exception consumers
+    catch — not an IndexError that 500s a whole /filter RPC."""
+    import json as _json
+
+    import pytest
+
+    from k8s_device_plugin_tpu.topology.schema import (
+        NodeTopology,
+        parse_topology_cached,
+    )
+    from tests.fakes import make_fake_tpu_node
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        accel, dev = make_fake_tpu_node(d, "v5e", 4)
+        from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+        from k8s_device_plugin_tpu.topology.mesh import IciMesh
+
+        topo = NodeTopology.from_mesh(IciMesh(PyTpuInfo().scan(accel, dev)))
+    good_raw = topo.to_json()
+    broken = _json.loads(good_raw)
+    for c in broken["chips"]:
+        c["coords"] = [0]  # too short for the (z, y, x) sort key
+    with pytest.raises(ValueError):
+        parse_topology_cached(_json.dumps(broken))
+    with pytest.raises(ValueError):
+        parse_topology_cached("{not json")
+    # And the good one round-trips through the cache with a private
+    # available list + shared memoized mesh.
+    a = parse_topology_cached(good_raw)
+    b = parse_topology_cached(good_raw)
+    assert a.to_mesh() is b.to_mesh()
+    a.available.clear()
+    assert len(b.available) == 4
