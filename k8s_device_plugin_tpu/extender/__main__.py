@@ -23,6 +23,17 @@ def main() -> int:
         "--gang-resync-s", type=float, default=5.0,
         help="gang re-evaluation interval",
     )
+    p.add_argument(
+        "--node-cache", action="store_true",
+        help="serve nodeCacheCapable (name-only) scheduler requests "
+        "from a periodically relisted node-annotation cache (needs "
+        "API access; saves the scheduler serializing every node "
+        "object into every request)",
+    )
+    p.add_argument(
+        "--node-cache-interval-s", type=float, default=5.0,
+        help="node-annotation cache relist interval",
+    )
     p.add_argument("-v", "--verbose", action="count", default=0)
     a = p.parse_args()
     logging.basicConfig(
@@ -30,25 +41,36 @@ def main() -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
     from .reservations import ReservationTable
-    from .server import TopologyExtender
+    from .server import NodeAnnotationCache, TopologyExtender
 
     # One reservation table wires the two halves together: what the
     # gang admitter reserves before releasing gates, the extender's
     # /filter withholds from every other pod (reservations.py).
     reservations = ReservationTable()
+    client = None
+    node_cache = None
+    if a.node_cache or a.gang_admission:
+        from ..kube.client import KubeClient
+
+        client = KubeClient.from_env(a.kubeconfig)
+    if a.node_cache:
+        node_cache = NodeAnnotationCache(
+            client, interval_s=a.node_cache_interval_s
+        ).start()
     srv = ExtenderHTTPServer(
-        extender=TopologyExtender(reservations=reservations),
+        extender=TopologyExtender(
+            reservations=reservations, node_cache=node_cache
+        ),
         host=a.host,
         port=a.port,
     )
     srv.start()
     gang = None
     if a.gang_admission:
-        from ..kube.client import KubeClient
         from .gang import GangAdmission
 
         gang = GangAdmission(
-            KubeClient.from_env(a.kubeconfig),
+            client,
             resync_interval_s=a.gang_resync_s,
             reservations=reservations,
         )
@@ -59,6 +81,8 @@ def main() -> int:
     stop.wait()
     if gang is not None:
         gang.stop()
+    if node_cache is not None:
+        node_cache.stop()
     srv.stop()
     return 0
 

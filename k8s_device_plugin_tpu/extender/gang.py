@@ -40,7 +40,6 @@ release and the fence that makes it stick.
 from __future__ import annotations
 
 import dataclasses
-import json
 import logging
 import threading
 from typing import Dict, List, Optional, Tuple

@@ -341,7 +341,15 @@ def test_shipped_manifest_matches_served_protocol():
     assert ext["filterVerb"] == "filter"
     assert ext["prioritizeVerb"] == "prioritize"
     assert ext["managedResources"][0]["name"] == constants.RESOURCE_NAME
-    assert ext["nodeCacheCapable"] is False
+    # nodeCacheCapable: true (name-only requests) is only valid when the
+    # container actually runs the annotation cache.
+    assert ext["nodeCacheCapable"] is True
+    assert "--node-cache" in container["args"]
+    node_rules = [
+        r for r in by_kind["ClusterRole"]["rules"]
+        if "nodes" in r["resources"]
+    ]
+    assert node_rules and {"get", "list"} <= set(node_rules[0]["verbs"])
 
 
 def test_cli_entrypoint_serves_documented_paths(tmp_path):
@@ -425,3 +433,156 @@ def test_all_deploy_manifests_parse():
     # Both planes plus the workload examples are present.
     assert {"DaemonSet", "Deployment", "Service", "ConfigMap",
             "Pod"} <= kinds
+
+
+def test_node_cache_name_only_requests_match_full_objects():
+    """nodeCacheCapable mode: name-only /filter and /prioritize answers
+    must match the full-node-object answers, annotations resolved from
+    the extender's relisted cache; unknown names fail with the normal
+    no-topology reason; a republished annotation is picked up after a
+    refresh."""
+    import requests as rq
+
+    from k8s_device_plugin_tpu.api import constants
+    from k8s_device_plugin_tpu.extender.server import (
+        ExtenderHTTPServer,
+        NodeAnnotationCache,
+        TopologyExtender,
+    )
+    from k8s_device_plugin_tpu.kube.client import KubeClient
+    from k8s_device_plugin_tpu.topology.schema import NodeTopology
+    from tests.fake_apiserver import FakeApiServer
+
+    api = FakeApiServer()
+    url = api.start()
+    try:
+        client = KubeClient(url)
+        free, _ = make_node("n-free", n=4)
+        busy, mesh = make_node("n-busy", n=4)
+        topo = NodeTopology.from_mesh(
+            mesh, hostname="n-busy", available=[]
+        )
+        busy["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION] = (
+            topo.to_json()
+        )
+        api.add_node("n-free", free)
+        api.add_node("n-busy", busy)
+
+        cache = NodeAnnotationCache(client, interval_s=0.2).start()
+        srv = ExtenderHTTPServer(
+            extender=TopologyExtender(node_cache=cache), host="127.0.0.1"
+        )
+        base = srv.start()
+        try:
+            body = {
+                "pod": tpu_pod(2),
+                "nodenames": ["n-free", "n-busy", "n-ghost"],
+            }
+            r = rq.post(f"{base}/filter", json=body, timeout=5).json()
+            assert r["nodenames"] == ["n-free"]
+            assert r["nodes"] is None
+            assert "n-busy" in r["failedNodes"]
+            assert "no TPU topology" in r["failedNodes"]["n-ghost"]
+
+            scores = rq.post(
+                f"{base}/prioritize", json=body, timeout=5
+            ).json()
+            by_host = {s["host"]: s["score"] for s in scores}
+            assert by_host["n-free"] > 0
+            assert by_host["n-busy"] == 0 and by_host["n-ghost"] == 0
+
+            # Full-object parity for the same candidates.
+            full = rq.post(
+                f"{base}/filter",
+                json={"pod": tpu_pod(2), "nodes": {"items": [free, busy]}},
+                timeout=5,
+            ).json()
+            assert [
+                n["metadata"]["name"] for n in full["nodes"]["items"]
+            ] == ["n-free"]
+
+            # The daemon republishes n-busy as free; the cache catches
+            # up within its relist interval.
+            import time
+
+            fresh, _ = make_node("n-busy", n=4)
+            api.add_node("n-busy", fresh)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                r2 = rq.post(f"{base}/filter", json=body, timeout=5).json()
+                if sorted(r2["nodenames"]) == ["n-busy", "n-free"]:
+                    break
+                time.sleep(0.1)
+            assert sorted(r2["nodenames"]) == ["n-busy", "n-free"]
+        finally:
+            srv.stop()
+            cache.stop()
+    finally:
+        api.stop()
+
+
+def test_name_only_request_without_cache_is_an_error():
+    import requests as rq
+
+    from k8s_device_plugin_tpu.extender.server import ExtenderHTTPServer
+
+    srv = ExtenderHTTPServer(host="127.0.0.1")
+    base = srv.start()
+    try:
+        r = rq.post(
+            f"{base}/filter",
+            json={"pod": tpu_pod(1), "nodenames": ["n1"]},
+            timeout=5,
+        )
+        assert r.status_code == 500
+        assert "node cache" in r.json()["error"]
+    finally:
+        srv.stop()
+
+
+def test_node_cache_negative_entries_avoid_per_rpc_fetches():
+    """A relisted node WITHOUT a topology annotation must be cached as
+    known-negative: repeated lookups cost zero API calls (only a name
+    the relist never saw triggers a single fetch, then caches)."""
+    from k8s_device_plugin_tpu.extender.server import NodeAnnotationCache
+
+    calls = {"list": 0, "get": 0}
+
+    class StubClient:
+        def list_nodes(self, label_selector=""):
+            calls["list"] += 1
+            return {"items": [
+                {"metadata": {"name": "bare", "annotations": {}}},
+            ]}
+
+        def get_node(self, name):
+            calls["get"] += 1
+            raise KeyError(name)
+
+    cache = NodeAnnotationCache(StubClient(), interval_s=3600)
+    cache.refresh()
+    for _ in range(5):
+        assert cache.node_object("bare") is None
+    assert calls["get"] == 0  # known-negative: no fetch
+    for _ in range(3):
+        assert cache.node_object("ghost") is None
+    # Unknown name: fetched, and the failure is NOT negative-cached
+    # (the node may appear moments later).
+    assert calls["get"] == 3
+
+
+def test_node_cache_start_survives_apiserver_outage():
+    from k8s_device_plugin_tpu.extender.server import NodeAnnotationCache
+
+    class DownClient:
+        def list_nodes(self, label_selector=""):
+            raise ConnectionError("apiserver down")
+
+        def get_node(self, name):
+            raise ConnectionError("apiserver down")
+
+    cache = NodeAnnotationCache(DownClient(), interval_s=3600).start()
+    try:
+        assert cache.node_object("n1") is None  # degraded, not crashed
+    finally:
+        cache.stop()
