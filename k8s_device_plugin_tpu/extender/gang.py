@@ -584,53 +584,74 @@ class GangAdmission:
         host size, contiguous box preferred but not required — box-ness
         is a scoring preference at placement time). Conservative on
         purpose — a gang NOT released here definitely cannot fit."""
-        # Shallow per-node copies: _place_* only ever reassigns
-        # ``available``, so cloning just that list (not the chip
-        # objects) keeps a 1,000-node x 100-gang tick out of deepcopy
-        # territory (measured by extender/scale_bench.py).
-        work = [
-            dataclasses.replace(t, available=list(t.available))
-            for t in topos
-        ]
-        by_host = {t.hostname: t for t in work}
+        # Copy-on-write: consumption lives in a hostname→available map
+        # whose lists are REPLACED, never mutated, so the input topos
+        # are untouched and only hosts this gang actually consumed get
+        # a cloned NodeTopology in the returned view. Cloning all N
+        # nodes per gang made dataclasses.replace the top line of the
+        # 1,000-node × 100-gang tick profile (scale_bench).
+        avail: Dict[str, List[str]] = {
+            t.hostname: t.available for t in topos
+        }
+        by_host = {t.hostname: t for t in topos}
         consumed: Dict[str, int] = {}
         for n in sorted((d for d in demands if d > 0), reverse=True):
-            host = self._place_single(n, by_host)
+            host = self._place_single(n, by_host, avail)
             if host is not None:
                 consumed[host] = consumed.get(host, 0) + n
                 continue
-            hosts = self._place_multi(n, by_host)
+            hosts = self._place_multi(n, by_host, avail)
             if hosts is None:
                 return None
             per_host = n // len(hosts)
             for h in hosts:
                 consumed[h] = consumed.get(h, 0) + per_host
+        work = [
+            t
+            if avail[t.hostname] is t.available
+            else dataclasses.replace(t, available=avail[t.hostname])
+            for t in topos
+        ]
         return work, consumed
 
     @staticmethod
     def _place_single(
-        n: int, by_host: Dict[str, NodeTopology]
+        n: int,
+        by_host: Dict[str, NodeTopology],
+        avail: Dict[str, List[str]],
     ) -> Optional[str]:
         """Consume n chips from the tightest single node that can serve
         the demand locally (best-fit keeps large-free nodes for larger
         demands); returns the chosen hostname."""
         best = None
-        for t in by_host.values():
-            if t.chip_count >= n and len(t.available) >= n:
-                if best is None or len(t.available) < len(best.available):
-                    best = t
+        best_len = 0
+        for h, t in by_host.items():
+            a_len = len(avail[h])
+            if t.chip_count >= n and a_len >= n:
+                if best is None or a_len < best_len:
+                    best, best_len = h, a_len
         if best is None:
             return None
-        best.available = best.available[n:]
-        return best.hostname
+        avail[best] = avail[best][n:]
+        return best
 
     @staticmethod
     def _place_multi(
-        n: int, by_host: Dict[str, NodeTopology]
+        n: int,
+        by_host: Dict[str, NodeTopology],
+        avail: Dict[str, List[str]],
     ) -> Optional[List[str]]:
         """Consume k=n/host_size whole-free hosts from one slice;
-        returns the chosen hostnames."""
-        for members in group_by_slice(list(by_host.values())).values():
+        returns the chosen hostnames. Materializes current-availability
+        clones for the slice math (rare path: only runs when no single
+        host can serve the demand)."""
+        views = [
+            t
+            if avail[t.hostname] is t.available
+            else dataclasses.replace(t, available=avail[t.hostname])
+            for t in by_host.values()
+        ]
+        for members in group_by_slice(views).values():
             per_host = members[0].chip_count
             if per_host <= 0 or n % per_host != 0:
                 continue
@@ -645,7 +666,7 @@ class GangAdmission:
                     ]
             if gang_hosts:
                 for h in gang_hosts:
-                    by_host[h].available = []
+                    avail[h] = []
                 return list(gang_hosts)
         return None
 

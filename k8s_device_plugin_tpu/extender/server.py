@@ -345,6 +345,12 @@ class NodeAnnotationCache:
         # without them every RPC would re-fetch it from the API server —
         # the exact per-cycle load nodeCacheCapable exists to avoid.
         self._raw: Dict[str, Optional[str]] = {}
+        # Set once a relist has succeeded. Until then, unknown names are
+        # answered as no-topology WITHOUT per-name fetches: with an
+        # empty cache (apiserver outage at start) a 1,000-name request
+        # would otherwise fan out into 1,000 serial blocking GETs
+        # against the same down apiserver, every scheduling cycle.
+        self._synced = False
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -389,18 +395,22 @@ class NodeAnnotationCache:
             )
         with self._lock:
             self._raw = fresh
+            self._synced = True
 
     # -- lookup ------------------------------------------------------------
 
     def node_object(self, name: str) -> Optional[dict]:
         """A minimal node dict carrying the cached annotation (the shape
         the full-objects code path consumes), or None when the node has
-        no published TPU topology. Only a name the last relist has
-        never seen (a node that just joined) costs an API fetch."""
+        no published TPU topology. Only a name the last successful
+        relist has never seen (a node that just joined) costs an API
+        fetch; with no successful relist yet the answer is a degraded
+        no-topology, never a fetch storm."""
         with self._lock:
             known = name in self._raw
             raw = self._raw.get(name)
-        if not known:
+            synced = self._synced
+        if not known and synced:
             raw = self._fetch(name)
         if raw is None:
             return None
@@ -414,12 +424,15 @@ class NodeAnnotationCache:
     def _fetch(self, name: str) -> Optional[str]:
         try:
             node = self.client.get_node(name)
-        except Exception:  # noqa: BLE001 — unknown node reads as no-topo
-            return None
-        ann = (node.get("metadata") or {}).get("annotations") or {}
-        raw = ann.get(constants.TOPOLOGY_ANNOTATION)
+            ann = (node.get("metadata") or {}).get("annotations") or {}
+            raw = ann.get(constants.TOPOLOGY_ANNOTATION)
+        except Exception:  # noqa: BLE001 — absent/unreachable both read
+            # as no-topology; cached until the next relist so a ghost
+            # name repeated every cycle costs one GET per relist
+            # interval, not one per RPC.
+            raw = None
         with self._lock:
-            self._raw[name] = raw  # negative results cached too
+            self._raw[name] = raw
         return raw
 
 

@@ -160,7 +160,16 @@ class ModelConfig:
         enough that the matmuls dominate and MFU is meaningful, small
         enough that params + adam state + activations fit the smallest
         current-generation HBM (v5e, 16 GiB): ~235 M params → ~3.8 GiB of
-        f32 param/opt/grad state."""
+        f32 param/opt/grad state.
+
+        Loss default: full-logits CE, not xent_chunk — deliberately
+        pending data. The chunked-vocab CE (ops/xent.py) is proven
+        EQUAL on CPU meshes (tests/test_ops.py) but whether it's
+        FASTER at this shape is a hardware question the bench's A/B
+        phase answers (BENCH detail.workload_chunked_xent.vs_plain_step,
+        bench.py phase 2.5, now gated only on a chip grant). Flip this
+        default when an artifact shows vs_plain_step > 1, and cite it
+        here."""
         return ModelConfig(
             vocab_size=32768, d_model=2048, n_heads=16, n_layers=4,
             d_ff=8192, max_seq_len=2048, use_flash_attention=True,

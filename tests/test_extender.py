@@ -566,9 +566,10 @@ def test_node_cache_negative_entries_avoid_per_rpc_fetches():
     assert calls["get"] == 0  # known-negative: no fetch
     for _ in range(3):
         assert cache.node_object("ghost") is None
-    # Unknown name: fetched, and the failure is NOT negative-cached
-    # (the node may appear moments later).
-    assert calls["get"] == 3
+    # Unknown name: one fetch, then negative-cached until the next
+    # relist (a ghost name repeated every cycle costs one GET per
+    # relist interval, not one per RPC).
+    assert calls["get"] == 1
 
 
 def test_node_cache_start_survives_apiserver_outage():
@@ -584,5 +585,31 @@ def test_node_cache_start_survives_apiserver_outage():
     cache = NodeAnnotationCache(DownClient(), interval_s=3600).start()
     try:
         assert cache.node_object("n1") is None  # degraded, not crashed
+    finally:
+        cache.stop()
+
+
+def test_node_cache_unsynced_never_fetch_storms():
+    """Before any successful relist (apiserver down at start), unknown
+    names answer as no-topology WITHOUT per-name fetches — a 1,000-name
+    request must not fan out into 1,000 blocking GETs against the same
+    down apiserver."""
+    from k8s_device_plugin_tpu.extender.server import NodeAnnotationCache
+
+    calls = {"get": 0}
+
+    class FlakyClient:
+        def list_nodes(self, label_selector=""):
+            raise ConnectionError("down")
+
+        def get_node(self, name):
+            calls["get"] += 1
+            raise ConnectionError("down")
+
+    cache = NodeAnnotationCache(FlakyClient(), interval_s=3600).start()
+    try:
+        for i in range(50):
+            assert cache.node_object(f"n{i}") is None
+        assert calls["get"] == 0
     finally:
         cache.stop()

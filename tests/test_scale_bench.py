@@ -2,12 +2,12 @@
 
 Measured on the build machine (2026-07, Python 3.12) at 1,000 nodes /
 100 gangs, warm annotation/score caches: filter p50 ~6 ms, prioritize
-p50 ~7 ms, steady tick ~8 ms, full admission tick ~480 ms; p99s absorb
-the cold first call (~50-120 ms — parse + mesh build, cached
-thereafter). Bounds below carry generous headroom for slower CI hosts —
-they exist to catch algorithmic regressions (an accidental O(N²)
-rescore, a deepcopy creeping back into _fits, a lost cache), not to
-benchmark the host.
+p50 ~7 ms, steady tick ~9 ms, full admission tick ~61 ms (copy-on-write
+_fits); p99s absorb the cold first call (~50-120 ms — parse + mesh
+build, cached thereafter). Bounds below carry generous headroom for
+slower CI hosts — they exist to catch algorithmic regressions (an
+accidental O(N²) rescore, per-gang full-view cloning creeping back into
+_fits, a lost cache), not to benchmark the host.
 """
 
 from k8s_device_plugin_tpu.extender import scale_bench
@@ -19,7 +19,7 @@ def test_scale_bench_bounds_at_full_scale():
     assert r["nodes"] == 1000 and r["gangs"] == 100
     assert r["filter"]["p99_ms"] < 700, r
     assert r["prioritize"]["p99_ms"] < 1300, r
-    assert r["gang_tick_full"]["p99_ms"] < 4500, r
+    assert r["gang_tick_full"]["p99_ms"] < 1500, r
     assert r["gang_tick_steady"]["p99_ms"] < 1000, r
 
 
