@@ -168,6 +168,9 @@ class GangAdmission:
         # waiting for capacity logs once per state, not once per resync.
         self._reported_waiting: set = set()
         self._lapsed_reported = 0  # table lapses already inc'd to metrics
+        # Gangs whose hold hit the age cap: never re-fenced (a re-fence
+        # would reset the hold's age and turn the cap into no cap).
+        self._lapsed_gangs: set = set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -298,7 +301,15 @@ class GangAdmission:
         for key, gv in sorted(gangs.items()):
             gated = gv.gated
             if not gated:
-                continue  # fully released; nothing to do
+                # Fully released. An extender restart loses the
+                # in-memory holds (reservations.py's restart story);
+                # while members are still unscheduled, re-fence what
+                # their remaining demand needs so a competitor can't
+                # take the chips they're Pending on. Never re-fence a
+                # LAPSED hold — that would reset its age and void the
+                # cap.
+                topos = self._maybe_refence(key, gv, standing, topos)
+                continue
             members = gv.members
             if len(members) < gv.size:
                 log.debug(
@@ -414,6 +425,10 @@ class GangAdmission:
             self.reservations.reserve(
                 key, consumed_hosts, demands=tuple(sorted(demands))
             )
+            # A fresh gated release is a fresh all-or-nothing decision:
+            # it clears any lapse bar a previous same-named generation
+            # left behind (the new hold ages from now, legitimately).
+            self._lapsed_gangs.discard(key)
             self._release(gated)
             released.append(key)
             log.info(
@@ -439,6 +454,64 @@ class GangAdmission:
         return released
 
     # -- reservations ------------------------------------------------------
+
+    def _maybe_refence(
+        self,
+        key: Tuple[str, str],
+        gv: GangView,
+        standing: Dict,
+        topos: List[NodeTopology],
+    ) -> List[NodeTopology]:
+        """Re-reserve a fully-released gang's unscheduled demand when it
+        has no hold (in-memory holds die with the process). Returns the
+        capacity view with the new hold's consumption applied, so later
+        gangs in the same tick see it."""
+        # Drain AGAIN at the decision point: a hold can lapse in the
+        # prunes between upkeep and this call (tick's own apply()/
+        # active(), or a concurrent /filter thread) — and once lapsed
+        # the hold is gone, so no further lapse can race past this
+        # drain before reserve() below.
+        self._lapsed_gangs |= self.reservations.drain_lapsed()
+        if key in standing or key in self._lapsed_gangs:
+            return topos
+        pending = [
+            p for p in gv.ungated_live
+            if not (p.get("spec") or {}).get("nodeName")
+        ]
+        demands = [
+            d
+            for p in pending
+            if (d := tpu_request(p, self.resource_name)) > 0
+        ]
+        if not demands:
+            # Nothing to fence (all scheduled, or only zero-TPU members
+            # pending) — and reserving an empty hold would churn a
+            # no-op re-fence + log every resync.
+            return topos
+        fit = self._fits(demands, topos)
+        if fit is None:
+            return topos  # capacity already gone; the gang Pends
+        new_topos, consumed = fit
+        # Members already scheduled are OUTSIDE this hold — pre-count
+        # them so upkeep's note_scheduled doesn't drain the fresh hold
+        # by re-subtracting their chips (which would re-create the hold
+        # every tick with a reset age, voiding the cap).
+        scheduled = {
+            (p.get("metadata") or {}).get("name", "")
+            for p in gv.live
+            if (p.get("spec") or {}).get("nodeName")
+        }
+        self.reservations.reserve(
+            key, consumed,
+            demands=tuple(sorted(gv.demands(self.resource_name))),
+            counted_pods=scheduled,
+        )
+        log.info(
+            "gang %s/%s: re-fenced %d chip(s) for %d unscheduled "
+            "pod(s) (hold was lost, e.g. process restart)",
+            key[0], key[1], sum(consumed.values()), len(pending),
+        )
+        return new_topos
 
     def _reservation_upkeep(
         self, gangs: Dict[Tuple[str, str], GangView]
@@ -468,6 +541,7 @@ class GangAdmission:
                     unscheduled += 1
             if unscheduled == 0 and len(gv.live) >= gv.size:
                 self.reservations.drop(key)
+                self._lapsed_gangs.discard(key)
             elif not self.reservations.renew(key):
                 self.reservations.lapse(key)
                 log.warning(
@@ -476,6 +550,12 @@ class GangAdmission:
                     "longer fenced (gates cannot be re-added)",
                     key[0], key[1], unscheduled,
                 )
+        # Drain LAST: a hold can age out inside any routine prune — the
+        # active() iteration above included — not just via the explicit
+        # lapse() branch; every lapsed gang observed this pass is barred
+        # from re-fencing before tick() evaluates it.
+        self._lapsed_gangs |= self.reservations.drain_lapsed()
+        self._lapsed_gangs &= set(gangs)  # bounded by live gangs
 
 
     def explain(self) -> List[dict]:

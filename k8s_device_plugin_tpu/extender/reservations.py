@@ -84,6 +84,11 @@ class ReservationTable:
         self._lock = threading.Lock()
         self._by_gang: Dict[GangKey, Reservation] = {}
         self.lapsed_total = 0  # reservations that hit the hard age cap
+        # Keys that lapsed since the last drain_lapsed() — a hold can
+        # age out inside a routine prune (any active()/apply() call),
+        # so the admitter can't observe every lapse in its own upkeep;
+        # it drains this set instead (and must never re-fence those).
+        self._lapsed_keys: set = set()
 
     # -- mutation ----------------------------------------------------------
 
@@ -92,7 +97,12 @@ class ReservationTable:
         gang: GangKey,
         host_chips: Dict[str, int],
         demands: Tuple[int, ...] = (),
+        counted_pods: Optional[Set[str]] = None,
     ) -> None:
+        """``counted_pods`` pre-marks members whose chips are already
+        OUTSIDE this hold (e.g. a restart re-fence covering only the
+        still-pending members): note_scheduled must not subtract their
+        chips a second time."""
         now = self._clock()
         with self._lock:
             self._by_gang[gang] = Reservation(
@@ -106,6 +116,7 @@ class ReservationTable:
                 # while the extender keeps serving /filter).
                 expires_at=now + min(self.ttl_s, self.max_age_s),
                 demands=tuple(sorted(demands)),
+                counted_pods=set(counted_pods or ()),
             )
 
     def renew(self, gang: GangKey) -> bool:
@@ -134,12 +145,22 @@ class ReservationTable:
             r = self._by_gang.pop(gang, None)
             if r is not None and r.hosts:
                 self.lapsed_total += 1
+                self._lapsed_keys.add(gang)
+
+    def drain_lapsed(self) -> set:
+        """Gang keys whose holds lapsed since the last drain (consumed:
+        the internal set is emptied, keeping it bounded)."""
+        with self._lock:
+            out = self._lapsed_keys
+            self._lapsed_keys = set()
+            return out
 
     def clear(self) -> None:
         """Drop every reservation (test isolation for DEFAULT_TABLE)."""
         with self._lock:
             self._by_gang.clear()
             self.lapsed_total = 0
+            self._lapsed_keys = set()
 
     def note_scheduled(
         self, gang: GangKey, pod_name: str, hostname: str, chips: int
@@ -168,6 +189,7 @@ class ReservationTable:
             r = self._by_gang.pop(key)
             if r.hosts and now - r.created_at >= self.max_age_s:
                 self.lapsed_total += 1
+                self._lapsed_keys.add(key)
 
     def active(self) -> Dict[GangKey, Reservation]:
         """Snapshot of live reservations (expired ones pruned)."""

@@ -276,14 +276,19 @@ def test_extender_metrics_cover_gang_and_requests(api):
     srv = ExtenderHTTPServer(host="127.0.0.1")
     url = srv.start()
     try:
+        from k8s_device_plugin_tpu.utils import metrics as m
+
+        # Delta, not absolute: the counter is module-level and other
+        # tests in the session legitimately serve /filter too.
+        before = int(m.EXTENDER_REQUESTS.get(verb="filter", outcome="ok"))
         body = {"pod": tpu_pod(1), "nodes": {"items": [node]}}
         rq.post(f"{url}/filter", json=body, timeout=5)
         text = rq.get(f"{url}/metrics", timeout=5).text
         assert "tpu_gang_released_total" in text
         assert "tpu_gang_waiting" in text
         assert (
-            'tpu_extender_requests_total{outcome="ok",verb="filter"} 1'
-            in text
+            f'tpu_extender_requests_total{{outcome="ok",verb="filter"}} '
+            f"{before + 1}" in text
         )
         # Scoped registry: daemon families must NOT leak into the
         # extender's endpoint as constant zeros — including the uptime

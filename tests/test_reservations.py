@@ -517,3 +517,133 @@ def test_ttl_bump_scales_hard_age_cap_and_clamps_expiry(api):
     t.reserve(("ns", "g"), {"n1": 4})
     clock.t += 301  # past the (smaller) cap: expiry must have hit first
     assert t.reserved_chips("n1") == 0
+
+def test_restart_refences_released_unscheduled_gang(api):
+    """In-memory holds die with the extender process: a new admission
+    instance (fresh table) must re-fence a released-but-unscheduled
+    gang's remaining demand on its first tick, so competitors can't take
+    the chips its Pending members wait for."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    for i in range(2):
+        server.add_pod(gang_pod(f"w{i}", "train", 2, 2))
+    # First process: releases + reserves.
+    table1 = ReservationTable()
+    adm1 = GangAdmission(client, reservations=table1)
+    assert adm1.tick() == [("default", "train")]
+    # One member binds before the restart.
+    server.pods[("default", "w0")]["spec"]["nodeName"] = "n1"
+
+    # Restart: fresh table, fresh admission.
+    table2 = ReservationTable()
+    adm2 = GangAdmission(client, reservations=table2)
+    ext = TopologyExtender(reservations=table2)
+    assert adm2.tick() == []  # nothing to release...
+    held = table2.active()[("default", "train")]
+    assert held.hosts == {"n1": 2}  # ...but w1's 2 chips re-fenced
+    passing, failed = ext.filter(tpu_pod(4), [node])
+    assert passing == [] and "reserved" in failed["n1"]
+    # The Pending member itself still passes.
+    own = server.pods[("default", "w1")]
+    assert ext.filter(own, [node])[0]
+
+
+def test_lapsed_gang_is_not_refenced(api):
+    """A hold that hit the age cap must stay lapsed: re-fencing it on
+    the next tick would reset its age and void the cap."""
+    server, client = api
+    clock = FakeClock()
+    table = ReservationTable(ttl_s=10, max_age_s=25, clock=clock)
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    for i in range(2):
+        server.add_pod(gang_pod(f"w{i}", "train", 2, 2))
+    adm = GangAdmission(client, reservations=table)
+    assert adm.tick() == [("default", "train")]
+    # Pods never schedule; jump past the (scaled) cap.
+    clock.t += table.max_age_s + 1
+    adm.tick()
+    assert table.active() == {}
+    # Subsequent ticks must NOT resurrect the hold.
+    adm.tick()
+    assert table.active() == {}
+
+def test_refenced_hold_stable_across_ticks(api):
+    """A re-fenced hold pre-counts already-scheduled members: upkeep's
+    note_scheduled must not re-subtract their chips, which would drain
+    and re-create the hold every tick with a reset age (voiding the
+    cap). The hold must sit stable over many ticks."""
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    for i in range(2):
+        server.add_pod(gang_pod(f"w{i}", "train", 2, 2))
+    adm1 = GangAdmission(client, reservations=ReservationTable())
+    assert adm1.tick() == [("default", "train")]
+    server.pods[("default", "w0")]["spec"]["nodeName"] = "n1"
+
+    table2 = ReservationTable()
+    adm2 = GangAdmission(client, reservations=table2)
+    adm2.tick()  # re-fence for w1
+    hold = table2.active()[("default", "train")]
+    assert hold.hosts == {"n1": 2}
+    created = hold.created_at
+    for _ in range(3):
+        adm2.tick()
+    hold = table2.active()[("default", "train")]
+    assert hold.hosts == {"n1": 2}  # not drained
+    assert hold.created_at == created  # not re-created (age intact)
+
+
+def test_zero_tpu_pending_member_does_not_churn_refence(api, caplog):
+    """A fully-released gang whose only unscheduled member requests no
+    TPUs (CPU-side coordinator) must not re-fence a no-op hold + log
+    every resync forever."""
+    import logging
+
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    worker = gang_pod("w0", "mixed", 2, 2)
+    worker["spec"]["schedulingGates"] = []
+    worker["spec"]["nodeName"] = "n1"
+    server.add_pod(worker)
+    coord = gang_pod("c0", "mixed", 2, 0)  # zero TPU request
+    coord["spec"]["schedulingGates"] = []
+    server.add_pod(coord)
+
+    table = ReservationTable()
+    adm = GangAdmission(client, reservations=table)
+    with caplog.at_level(logging.INFO):
+        for _ in range(3):
+            assert adm.tick() == []
+    assert table.active() == {}
+    assert "re-fenced" not in caplog.text
+
+
+def test_lapse_between_upkeep_and_refence_is_still_barred(api):
+    """A hold that lapses in a prune AFTER upkeep's drain (tick's own
+    apply()/active(), or a concurrent /filter) must still be barred
+    from re-fencing: _maybe_refence drains again at the decision
+    point."""
+    server, client = api
+    clock = FakeClock()
+    table = ReservationTable(ttl_s=10, max_age_s=25, clock=clock)
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    for i in range(2):
+        server.add_pod(gang_pod(f"w{i}", "train", 2, 2))
+    adm = GangAdmission(client, reservations=table)
+    assert adm.tick() == [("default", "train")]
+
+    # Lapse recorded by a routine prune (e.g. the extender thread's
+    # apply) with NO upkeep drain having seen it yet.
+    clock.t += table.max_age_s + 1
+    table.active()  # prunes + records the lapse internally
+    gangs = adm._collect_gangs()
+    gv = gangs[("default", "train")]
+    topos = adm._node_topologies()
+    out = adm._maybe_refence(("default", "train"), gv, {}, topos)
+    assert out is topos  # no re-fence
+    assert table.active() == {}
