@@ -246,9 +246,21 @@ def run_microbench(
         "ok": True,
         "backend": jax.default_backend(),
         "device_kind": devices[0].device_kind if devices else "",
+        "devices": len(devices),
+        "time_to_devices_s": round(time.monotonic() - t_start, 3),
         "iters": iters,
         "kernels": {},
     }
+    if stream:
+        # Backend-init proof: under chip contention jax.devices() is
+        # the phase that hangs — a kill during the FIRST kernel compile
+        # should still leave evidence the grant was obtained. ok=None +
+        # stage tag mark it as a partial, same contract as the smoke's
+        # streamed snapshots.
+        print(
+            json.dumps({**report, "ok": None, "partial": "devices_up"}),
+            flush=True,
+        )
 
     # Ordered most-valuable-first so a budget cut drops the tail, not the
     # head: the long-seq training comparison is the design claim. Batch
