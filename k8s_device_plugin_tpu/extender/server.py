@@ -394,8 +394,24 @@ class NodeAnnotationCache:
                 constants.TOPOLOGY_ANNOTATION
             )
         with self._lock:
+            # Snapshot both value sets under the lock: concurrent
+            # _fetch() calls mutate the installed dict, and iterating
+            # it lock-free would race (dict changed size during
+            # iteration).
+            seen = set(self._raw.values())
             self._raw = fresh
+            new_raws = set(fresh.values()) - seen
             self._synced = True
+        # Pre-warm the parse/mesh cache for annotations this relist saw
+        # first (republished or new), on THIS thread: the cold parse
+        # (json + mesh build, the p99 of /filter at 1,000 nodes) then
+        # never lands on a scheduler RPC.
+        for raw in new_raws:
+            if raw:
+                try:
+                    parse_topology_cached(raw)
+                except ValueError:
+                    pass  # malformed stays the publisher's problem
 
     # -- lookup ------------------------------------------------------------
 

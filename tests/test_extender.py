@@ -613,3 +613,50 @@ def test_node_cache_unsynced_never_fetch_storms():
         assert calls["get"] == 0
     finally:
         cache.stop()
+
+
+def test_node_cache_refresh_prewarms_parse_cache():
+    """The relist thread pays the cold parse+mesh build, not the
+    scheduler RPC: after refresh(), the annotation is already in the
+    parse cache."""
+    from k8s_device_plugin_tpu.extender.server import NodeAnnotationCache
+    from k8s_device_plugin_tpu.topology import schema
+
+    node, _ = make_node("n1", n=4)
+
+    class StubClient:
+        def list_nodes(self, label_selector=""):
+            return {"items": [node]}
+
+    schema._parse_template.cache_clear()
+    cache = NodeAnnotationCache(StubClient(), interval_s=3600)
+    cache.refresh()
+    info = schema._parse_template.cache_info()
+    assert info.currsize == 1
+    # The RPC-path parse is now a pure cache hit.
+    raw = node["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION]
+    schema.parse_topology_cached(raw)
+    assert schema._parse_template.cache_info().hits > info.hits
+
+
+def test_node_cache_empty_relist_still_marks_synced():
+    """A successful relist with zero (or no new) annotations must still
+    mark the cache synced — otherwise a node joining between relists
+    could never be resolved by the per-name fetch path."""
+    from k8s_device_plugin_tpu.extender.server import NodeAnnotationCache
+
+    node, _ = make_node("late-joiner", n=4)
+    calls = {"get": 0}
+
+    class EmptyThenGet:
+        def list_nodes(self, label_selector=""):
+            return {"items": []}
+
+        def get_node(self, name):
+            calls["get"] += 1
+            return node
+
+    cache = NodeAnnotationCache(EmptyThenGet(), interval_s=3600)
+    cache.refresh()  # empty but successful
+    got = cache.node_object("late-joiner")
+    assert got is not None and calls["get"] == 1
