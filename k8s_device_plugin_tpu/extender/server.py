@@ -394,19 +394,20 @@ class NodeAnnotationCache:
                 constants.TOPOLOGY_ANNOTATION
             )
         with self._lock:
-            # Snapshot both value sets under the lock: concurrent
+            # Snapshot the value set under the lock: concurrent
             # _fetch() calls mutate the installed dict, and iterating
             # it lock-free would race (dict changed size during
             # iteration).
-            seen = set(self._raw.values())
             self._raw = fresh
-            new_raws = set(fresh.values()) - seen
+            raws = set(fresh.values())
             self._synced = True
-        # Pre-warm the parse/mesh cache for annotations this relist saw
-        # first (republished or new), on THIS thread: the cold parse
-        # (json + mesh build, the p99 of /filter at 1,000 nodes) then
-        # never lands on a scheduler RPC.
-        for raw in new_raws:
+        # Pre-warm the parse/mesh cache for EVERY current annotation on
+        # THIS thread: the cold parse (json + mesh build, the p99 of
+        # /filter at 1,000 nodes) then never lands on a scheduler RPC.
+        # Unconditional on purpose — an already-warm value is a pure
+        # LRU hit, and delta-tracking against the previous relist would
+        # miss entries the shared 8192-entry LRU evicted in between.
+        for raw in raws:
             if raw:
                 try:
                     parse_topology_cached(raw)

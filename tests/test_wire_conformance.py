@@ -509,3 +509,68 @@ def test_servicer_method_sets_match_protos(repo_protos):
         assert declared == implemented, (
             f"{cls.__name__}: methods {implemented} != proto {declared}"
         )
+
+
+# ---------------------------------------------------------------------------
+# 4. Kubelet checkpoint schema vs the reference's vendored Go source
+# ---------------------------------------------------------------------------
+
+REFERENCE_CHECKPOINT_GO = (
+    "/root/reference/vendor/k8s.io/kubernetes/pkg/kubelet/cm/"
+    "devicemanager/checkpoint/checkpoint.go"
+)
+
+
+def _go_struct_fields(src: str, name: str) -> list:
+    """Exported field names of a Go struct (Go's default JSON marshal
+    uses the field name verbatim when there is no json tag — and this
+    file has none)."""
+    m = re.search(
+        rf"type {name} struct \{{(.*?)\n\}}", src, flags=re.S
+    )
+    assert m, f"struct {name} not found"
+    fields = re.findall(r"^\t([A-Z]\w*)\s", m.group(1), flags=re.M)
+    assert fields, f"struct {name} parsed no fields"
+    return fields
+
+
+def test_checkpoint_reader_consumes_reference_field_names():
+    """kube/checkpoint.py reads the kubelet's on-disk file whose JSON
+    keys are the Go struct field names in the reference's vendored
+    checkpoint.go (no json tags ⇒ verbatim field names). Build a
+    checkpoint from EXACTLY those extracted names and assert the reader
+    consumes it — a drifted key in either place fails here instead of
+    silently parsing zero entries on a real node."""
+    import json as _json
+
+    from k8s_device_plugin_tpu.kube.checkpoint import parse_checkpoint
+
+    with open(REFERENCE_CHECKPOINT_GO) as f:
+        src = f.read()
+    entry_fields = _go_struct_fields(src, "PodDevicesEntry")
+    data_fields = _go_struct_fields(src, "checkpointData")
+    top_fields = _go_struct_fields(src, "Data")
+    assert entry_fields == [
+        "PodUID", "ContainerName", "ResourceName", "DeviceIDs",
+        "AllocResp",
+    ]
+    assert set(data_fields) == {"PodDeviceEntries", "RegisteredDevices"}
+    assert set(top_fields) == {"Data", "Checksum"}
+
+    entry = dict(zip(entry_fields, [
+        "uid-1", "main", "google.com/tpu", ["chip-0", "chip-1"], "",
+    ]))
+    doc = {
+        top_fields[0]: {
+            "PodDeviceEntries": [entry],
+            "RegisteredDevices": {"google.com/tpu": ["chip-0", "chip-1"]},
+        },
+        top_fields[1]: 12345,
+    }
+    parsed = parse_checkpoint(_json.dumps(doc))
+    assert len(parsed) == 1
+    e = parsed[0]
+    assert e.pod_uid == "uid-1"
+    assert e.container_name == "main"
+    assert e.resource_name == "google.com/tpu"
+    assert e.device_ids == ["chip-0", "chip-1"]
