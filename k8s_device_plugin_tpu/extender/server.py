@@ -36,6 +36,7 @@ from ..api import constants
 from ..topology.placement import PlacementState, ideal_box_links
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..topology.slice import SliceView, group_by_slice
+from ..utils import metrics
 from ..utils.httpserver import BackgroundHTTPServer
 from ..utils.podresources import tpu_request
 from .gang import pod_gang
@@ -364,6 +365,7 @@ class NodeAnnotationCache:
             # blip at container start must not CrashLoopBackoff the
             # whole extender; per-name fetches and the relist loop
             # recover once the apiserver answers.
+            metrics.NODE_CACHE_RELIST_ERRORS.inc()
             log.warning("initial node-cache relist failed: %s", e)
         self._thread = threading.Thread(
             target=self._loop, name="node-annotation-cache", daemon=True
@@ -382,6 +384,7 @@ class NodeAnnotationCache:
             try:
                 self.refresh()
             except Exception as e:  # noqa: BLE001 — keep serving stale
+                metrics.NODE_CACHE_RELIST_ERRORS.inc()
                 log.warning("node cache relist failed: %s", e)
 
     def refresh(self) -> None:
@@ -400,7 +403,14 @@ class NodeAnnotationCache:
             # iteration).
             self._raw = fresh
             raws = set(fresh.values())
+            with_topo = sum(1 for r in fresh.values() if r)
+            total = len(fresh)
             self._synced = True
+        metrics.NODE_CACHE_NODES.set(with_topo, state="with_topology")
+        metrics.NODE_CACHE_NODES.set(
+            total - with_topo, state="without_topology"
+        )
+        metrics.NODE_CACHE_SYNCED.set(1)
         # Pre-warm the parse/mesh cache for EVERY current annotation on
         # THIS thread: the cold parse (json + mesh build, the p99 of
         # /filter at 1,000 nodes) then never lands on a scheduler RPC.
@@ -491,8 +501,6 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                 self.wfile.write(data)
 
             def do_POST(self):
-                from ..utils import metrics
-
                 try:
                     args = self._read_args()
                 except json.JSONDecodeError:
@@ -556,9 +564,7 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                     # same capacity view the in-process admitter does.
                     self._send(ext.reservations.snapshot())
                 elif self.path == "/metrics":
-                    from ..utils.metrics import EXTENDER_REGISTRY
-
-                    data = EXTENDER_REGISTRY.render().encode()
+                    data = metrics.EXTENDER_REGISTRY.render().encode()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "text/plain; version=0.0.4"

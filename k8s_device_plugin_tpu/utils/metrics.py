@@ -241,6 +241,21 @@ GANG_RESERVATIONS_LAPSED = EXTENDER_REGISTRY.counter(
     "Gang reservations that hit the hard age cap with pods still "
     "unscheduled (their chips are no longer fenced)",
 )
+NODE_CACHE_NODES = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_node_cache_nodes",
+    "Nodes in the annotation cache by state (with_topology/"
+    "without_topology); constant 0 when --node-cache is off",
+)
+NODE_CACHE_SYNCED = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_node_cache_synced",
+    "1 once a node relist has succeeded; 0 means no successful relist "
+    "yet (name-only requests answer no-topology for unknown nodes) OR "
+    "--node-cache is off — alert on it only with the cache enabled",
+)
+NODE_CACHE_RELIST_ERRORS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_node_cache_relist_errors_total",
+    "Node relists that failed (cache serves stale entries meanwhile)",
+)
 
 
 class MetricsServer(BackgroundHTTPServer):

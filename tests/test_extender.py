@@ -660,3 +660,34 @@ def test_node_cache_empty_relist_still_marks_synced():
     cache.refresh()  # empty but successful
     got = cache.node_object("late-joiner")
     assert got is not None and calls["get"] == 1
+
+
+def test_node_cache_metrics():
+    """Cache observability: node counts by topology state, synced flag,
+    and relist-error counter."""
+    from k8s_device_plugin_tpu.extender.server import NodeAnnotationCache
+    from k8s_device_plugin_tpu.utils import metrics as m
+
+    node, _ = make_node("n1", n=4)
+    bare = {"metadata": {"name": "bare", "annotations": {}}}
+
+    class StubClient:
+        def list_nodes(self, label_selector=""):
+            return {"items": [node, bare]}
+
+    errors_before = m.NODE_CACHE_RELIST_ERRORS.get()
+    NodeAnnotationCache(StubClient(), interval_s=3600).refresh()
+    assert m.NODE_CACHE_NODES.get(state="with_topology") == 1
+    assert m.NODE_CACHE_NODES.get(state="without_topology") == 1
+    assert m.NODE_CACHE_SYNCED.get() == 1
+
+    class DownClient:
+        def list_nodes(self, label_selector=""):
+            raise ConnectionError("down")
+
+        def get_node(self, name):
+            raise ConnectionError("down")
+
+    cache = NodeAnnotationCache(DownClient(), interval_s=3600).start()
+    cache.stop()
+    assert m.NODE_CACHE_RELIST_ERRORS.get() == errors_before + 1
