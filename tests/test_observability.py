@@ -230,3 +230,31 @@ def test_daemon_heartbeat_backs_healthz(tmp_path):
     finally:
         if daemon.metrics_server is not None:
             daemon.metrics_server.stop()
+
+
+def test_metrics_doc_in_lockstep_with_registries():
+    """docs/metrics.md must document every registered family and name
+    no family that doesn't exist (uptime families are rendered, not
+    registered, and are asserted separately)."""
+    import os
+    import re
+
+    from k8s_device_plugin_tpu.utils import metrics
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "metrics.md",
+    )
+    doc = open(path).read()
+    documented = set(re.findall(r"`(tpu_[a-z0-9_]+)`", doc))
+    registered = set(metrics.REGISTRY._metrics) | set(
+        metrics.EXTENDER_REGISTRY._metrics
+    )
+    rendered_only = {"tpu_plugin_uptime_seconds",
+                     "tpu_extender_uptime_seconds"}
+    missing = registered - documented
+    assert not missing, f"registered but undocumented: {sorted(missing)}"
+    ghosts = documented - registered - rendered_only
+    assert not ghosts, f"documented but not registered: {sorted(ghosts)}"
+    for fam in rendered_only:
+        assert fam in documented, f"{fam} missing from docs/metrics.md"
