@@ -47,23 +47,27 @@ class AcceleratorSpec:
     # Per-chip dense bf16 peak (Google-published per-generation numbers);
     # the MFU denominator for the workload bench. 0 = unknown generation.
     peak_flops_bf16: float = 0.0
+    # Per-chip HBM bandwidth, GB/s (published): the plausibility bound
+    # for memory-bound kernel measurements (ops/microbench.py). 0 =
+    # unknown generation.
+    hbm_gbps: float = 0.0
 
 
 TFLOPS = 1e12
 
 ACCELERATOR_SPECS = {
     "v2": AcceleratorSpec("v2", 4, (2, 2, 1), False, 8 * GIB, 2,
-                          46 * TFLOPS),
+                          46 * TFLOPS, 700.0),
     "v3": AcceleratorSpec("v3", 4, (2, 2, 1), False, 16 * GIB, 2,
-                          123 * TFLOPS),
+                          123 * TFLOPS, 900.0),
     "v4": AcceleratorSpec("v4", 4, (2, 2, 1), True, 32 * GIB, 2,
-                          275 * TFLOPS),
+                          275 * TFLOPS, 1228.0),
     "v5e": AcceleratorSpec("v5e", 8, (2, 4, 1), False, 16 * GIB, 1,
-                           197 * TFLOPS),
+                           197 * TFLOPS, 819.0),
     "v5p": AcceleratorSpec("v5p", 4, (2, 2, 1), True, 95 * GIB, 2,
-                           459 * TFLOPS),
+                           459 * TFLOPS, 2765.0),
     "v6e": AcceleratorSpec("v6e", 8, (2, 4, 1), False, 32 * GIB, 1,
-                           918 * TFLOPS),
+                           918 * TFLOPS, 1640.0),
 }
 
 
@@ -73,6 +77,29 @@ def spec_for(chip_type: str, chip_count: int = 0) -> AcceleratorSpec:
         return ACCELERATOR_SPECS[chip_type]
     n = max(chip_count, 1)
     return AcceleratorSpec(chip_type or "unknown", n, (n, 1, 1), False, 0, 0)
+
+
+def chip_spec_for(
+    device_kind: str, platform: str = "tpu"
+) -> Optional[AcceleratorSpec]:
+    """AcceleratorSpec for a jax device_kind string, or None.
+
+    device_kind strings look like "TPU v5e" / "TPU v5 lite" / "TPU v4";
+    map them through the same chip-type parser the discovery path uses.
+    When the kind string doesn't parse but the backend IS an accelerator
+    (tunneled PJRT plugins report opaque kinds), fall back to the host's
+    generation env vars. None when the generation is unknown or the
+    platform is cpu (test runs).
+    """
+    import os
+
+    chip_type = parse_gke_accelerator_label(device_kind.replace(" ", ""))
+    if chip_type is None and platform != "cpu":
+        chip_type = parse_gke_accelerator_label(
+            os.environ.get("PALLAS_AXON_TPU_GEN", "")
+            or os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        )
+    return spec_for(chip_type) if chip_type is not None else None
 
 
 def parse_gke_accelerator_label(value: str) -> Optional[str]:

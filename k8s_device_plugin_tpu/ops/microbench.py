@@ -23,6 +23,29 @@ timeout. A side that OOMs (dense at long seq is O(seq^2) memory) is
 recorded as an error for that side only — "dense cannot run at this
 length" is itself a result the flash design exists to win.
 
+Timing methodology (round 4): the attached accelerator is a
+tunnel-attached PJRT plugin, and two properties of that rig break the
+textbook ``block_until_ready`` loop:
+  1. a repeated call with IDENTICAL inputs returns in dispatch-overhead
+     time (~0.05 ms) regardless of the kernel — the relay memoizes by
+     value, so the classic fixed-input timing loop measures the cache,
+     not the chip (it reported 10,457 "TFLOP/s" on a 197 TFLOP chip);
+  2. every host<->device sync pays a ~66 ms link round trip, so a
+     single-dispatch measurement of a sub-ms kernel is ~100% RTT.
+So each timed call (a) varies a scalar input so no value cache can hit,
+(b) runs ``inner`` data-dependent iterations under one ``lax.scan`` so
+per-iteration time amortizes the RTT, and (c) fetches a scalar that
+depends on every output, which forces real completion. The link RTT is
+measured with a no-op jitted probe and subtracted. Validated against
+theory: a 4096^3 bf16 matmul measures 0.727 ms vs the 0.70 ms v5e
+bf16-peak bound (~96% MXU). Every timed side is then checked against
+the chip's published physics — attention/xent TFLOP/s vs 1.15x the
+bf16 peak, rmsnorm GB/s vs 2x the HBM bandwidth (the traffic model
+overcounts a fully-fused side by up to ~1.6x; the cache bug class
+overshoots 10-50x) — and an implausible side is flagged ``suspect``,
+flipping the report's top-level ``timing_suspect``: the bug class this
+redesign fixed must never pass silently again.
+
 No reference counterpart (the reference has no kernels and publishes no
 perf numbers, SURVEY §6); this measures this repo's own design claims.
 """
@@ -39,33 +62,81 @@ import jax
 import jax.numpy as jnp
 
 
-def _timed(fn: Callable[[], object], iters: int) -> float:
-    """Median wall-clock seconds per call over ``iters`` timed calls
-    (caller has already warmed up / compiled)."""
+def _measure_rtt(iters: int = 5) -> float:
+    """Median seconds for a jitted no-op scalar round trip: the
+    dispatch + sync overhead every timed call pays exactly once."""
+
+    @jax.jit
+    def probe(i):
+        return i + 1.0
+
+    float(probe(0.0))  # compile (float arg: timed calls must not retrace)
     times = []
-    for _ in range(iters):
+    for i in range(1, iters + 1):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        float(probe(float(i)))
         times.append(time.perf_counter() - t0)
     times.sort()
     return times[len(times) // 2]
 
 
-def _bench_side(fn: Callable[[], object], iters: int) -> dict:
-    """Compile+warm one side, then time it. Errors (OOM, lowering
-    failures) are contained to this side."""
+def _bench_side(
+    scalar_step: Callable, operands: tuple, inner: int, iters: int,
+    rtt_s: float,
+) -> dict:
+    """Compile+warm one side, then time it scan-amortized.
+
+    ``scalar_step(eps, *operands)`` must trace the kernel under test
+    with an input perturbed by the traced scalar ``eps`` and return an
+    f32 scalar that depends on every output. Each scan iteration feeds
+    the previous scalar into the next ``eps`` (data dependence
+    serializes the loop and defeats CSE); each timed call uses a fresh
+    outer scalar (defeats the relay's by-value result cache).
+    ``operands`` are the case's device arrays, passed as jit ARGUMENTS:
+    a closure-captured concrete array becomes a constant embedded in
+    the serialized computation, which a remote-compile relay rejects
+    once it's embedding a 256 MB embedding table (HTTP 413). Errors
+    (OOM, lowering failures) are contained to this side.
+    """
     try:
+
+        @jax.jit
+        def run(i, *ops):
+            def body(c, _):
+                s = scalar_step(i * 1e-6 + c * 1e-20, *ops)
+                return s, None
+            c, _ = jax.lax.scan(
+                body, jnp.float32(0.0), None, length=inner
+            )
+            return c
+
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())  # compile + first run
+        float(run(0.0, *operands))  # compile + first run (same arg types)
         compile_s = time.perf_counter() - t0
-        sec = _timed(fn, iters)
-        return {"ms": round(sec * 1e3, 3), "compile_s": round(compile_s, 2)}
+        times = []
+        for it in range(1, iters + 1):
+            t0 = time.perf_counter()
+            float(run(float(it), *operands))
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        med = times[len(times) // 2]
+        per_iter = (med - rtt_s) / inner
+        out = {"compile_s": round(compile_s, 2), "inner": inner}
+        if per_iter <= 0 or med < rtt_s * 1.2:
+            # The whole scan ran inside RTT jitter — report the
+            # UNcorrected per-iteration wall as an upper bound and say
+            # so, rather than a meaningless 0.
+            out["rtt_dominated"] = True
+            per_iter = med / inner
+        out["ms"] = round(per_iter * 1e3, 4)
+        return out
     except Exception as e:  # noqa: BLE001 — one side failing is a result
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
 def _attention_case(
-    seq: int, batch: int, heads: int, d: int, iters: int
+    seq: int, batch: int, heads: int, d: int, iters: int,
+    inner: int, rtt_s: float, peak_flops: float,
 ) -> dict:
     from .attention import flash_attention, reference_attention
 
@@ -76,20 +147,31 @@ def _attention_case(
     k = jax.random.normal(kk, shape, jnp.bfloat16)
     v = jax.random.normal(kv, shape, jnp.bfloat16)
 
-    def train_loss(attn):
-        def loss(q, k, v):
-            return attn(q, k, v).astype(jnp.float32).mean()
+    def make_step(attn):
+        grad_fn = jax.grad(
+            lambda q, k, v: attn(q, k, v).astype(jnp.float32).mean(),
+            argnums=(0, 1, 2),
+        )
 
-        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        def scalar_step(eps, q, k, v):
+            gq, gk, gv = grad_fn(q + eps.astype(q.dtype), k, v)
+            return (
+                jnp.sum(gq.astype(jnp.float32))
+                + jnp.sum(gk.astype(jnp.float32))
+                + jnp.sum(gv.astype(jnp.float32))
+            )
 
-    flash_step = train_loss(flash_attention)
-    dense_step = train_loss(reference_attention)
+        return scalar_step
 
     out = {
         "shape": list(shape),
         "dtype": "bfloat16",
-        "flash": _bench_side(lambda: flash_step(q, k, v), iters),
-        "dense": _bench_side(lambda: dense_step(q, k, v), iters),
+        "flash": _bench_side(
+            make_step(flash_attention), (q, k, v), inner, iters, rtt_s
+        ),
+        "dense": _bench_side(
+            make_step(reference_attention), (q, k, v), inner, iters, rtt_s
+        ),
     }
 
     # Causal fwd ~= 2 matmuls * 2*b*h*seq^2*d * 1/2 (masked half skipped
@@ -98,11 +180,12 @@ def _attention_case(
     # fwd+bwd ~= 3.5x fwd (bwd recomputes s/p and runs 5 matmuls).
     flops = 3.5 * 2.0 * batch * heads * seq * seq * d
     for side in ("flash", "dense"):
-        if "ms" in out[side]:
-            out[side]["tflops"] = round(
-                flops / (out[side]["ms"] * 1e-3) / 1e12, 2
-            )
-    if "ms" in out["flash"] and "ms" in out["dense"]:
+        if out[side].get("ms"):
+            tflops = flops / (out[side]["ms"] * 1e-3) / 1e12
+            out[side]["tflops"] = round(tflops, 2)
+            if peak_flops and tflops > 1.15 * peak_flops / 1e12:
+                out[side]["suspect"] = True  # faster than the chip's peak
+    if out["flash"].get("ms") and out["dense"].get("ms"):
         out["speedup_vs_dense"] = round(
             out["dense"]["ms"] / out["flash"]["ms"], 3
         )
@@ -129,7 +212,8 @@ def _attention_agreement(batch: int, heads: int, seq: int, d: int) -> dict:
 
 
 def _xent_case(
-    rows: int, d: int, vocab: int, chunk: int, iters: int
+    rows: int, d: int, vocab: int, chunk: int, iters: int,
+    inner: int, rtt_s: float, peak_flops: float,
 ) -> dict:
     """Chunked-vocab CE (ops/xent.py) vs the full-logits formulation,
     fwd+bwd wrt (hidden, embed) — the training-path comparison at the
@@ -142,25 +226,46 @@ def _xent_case(
     embed = jax.random.normal(ke, (vocab, d), jnp.float32) * 0.02
     targets = jax.random.randint(kt, (rows,), 0, vocab)
 
-    chunked_step = jax.jit(
-        jax.grad(
-            lambda h, e: chunked_softmax_xent(h, e, targets, chunk),
-            argnums=(0, 1),
-        )
-    )
-    dense_step = jax.jit(
-        jax.grad(
-            lambda h, e: reference_softmax_xent(h, e, targets),
-            argnums=(0, 1),
-        )
-    )
+    def make_step(loss_fn):
+        grad_fn = jax.grad(loss_fn, argnums=(0, 1))
+
+        def scalar_step(eps, hidden, embed, targets):
+            gh, ge = grad_fn(
+                hidden + eps.astype(hidden.dtype), embed, targets
+            )
+            return (
+                jnp.sum(gh.astype(jnp.float32)) + jnp.sum(ge) * 1e-6
+            )
+
+        return scalar_step
+
+    ops = (hidden, embed, targets)
     out = {
         "shape": [rows, d, vocab],
         "chunk": chunk,
-        "chunked": _bench_side(lambda: chunked_step(hidden, embed), iters),
-        "dense": _bench_side(lambda: dense_step(hidden, embed), iters),
+        "chunked": _bench_side(
+            make_step(
+                lambda h, e, t: chunked_softmax_xent(h, e, t, chunk)
+            ),
+            ops, inner, iters, rtt_s,
+        ),
+        "dense": _bench_side(
+            make_step(reference_softmax_xent), ops, inner, iters, rtt_s,
+        ),
     }
-    if "ms" in out["chunked"] and "ms" in out["dense"]:
+    # Plausibility: fwd+bwd of the logits matmul is ~3 passes of
+    # 2*rows*d*vocab MACs (the chunked side recomputes and pays more —
+    # the bound still holds). Same bug-class guard as the attention
+    # tflops check: the relay's value cache produces 10-50x absurdities,
+    # so a loose 1.15x-peak bound catches it without false positives.
+    flops = 3 * 2.0 * rows * d * vocab
+    for side in ("chunked", "dense"):
+        if out[side].get("ms"):
+            tflops = flops / (out[side]["ms"] * 1e-3) / 1e12
+            out[side]["tflops"] = round(tflops, 2)
+            if peak_flops and tflops > 1.15 * peak_flops / 1e12:
+                out[side]["suspect"] = True
+    if out["chunked"].get("ms") and out["dense"].get("ms"):
         out["speedup_vs_dense"] = round(
             out["dense"]["ms"] / out["chunked"]["ms"], 3
         )
@@ -182,7 +287,10 @@ def _xent_case(
     return out
 
 
-def _rmsnorm_case(rows: int, d: int, iters: int) -> dict:
+def _rmsnorm_case(
+    rows: int, d: int, iters: int, inner: int, rtt_s: float,
+    hbm_gbps: float,
+) -> dict:
     from .rmsnorm import rmsnorm
 
     x = jax.random.normal(jax.random.PRNGKey(1), (rows, d), jnp.bfloat16)
@@ -193,44 +301,64 @@ def _rmsnorm_case(rows: int, d: int, iters: int) -> dict:
         rrms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
         return (xf * rrms * scale.astype(jnp.float32)).astype(x.dtype)
 
-    def train_loss(norm):
-        def loss(x, scale):
-            return norm(x, scale).astype(jnp.float32).mean()
+    def make_step(norm):
+        grad_fn = jax.grad(
+            lambda x, scale: norm(x, scale).astype(jnp.float32).mean(),
+            argnums=(0, 1),
+        )
 
-        return jax.jit(jax.grad(loss, argnums=(0, 1)))
+        def scalar_step(eps, x, scale):
+            gx, gs = grad_fn(x + eps.astype(x.dtype), scale)
+            return jnp.sum(gx.astype(jnp.float32)) + jnp.sum(
+                gs.astype(jnp.float32)
+            )
 
-    pallas_step = train_loss(rmsnorm)
-    xla_step = train_loss(xla_rmsnorm)
+        return scalar_step
 
     out = {
         "shape": [rows, d],
         "dtype": "bfloat16",
-        "pallas": _bench_side(lambda: pallas_step(x, scale), iters),
-        "xla": _bench_side(lambda: xla_step(x, scale), iters),
+        "pallas": _bench_side(
+            make_step(rmsnorm), (x, scale), inner, iters, rtt_s
+        ),
+        "xla": _bench_side(
+            make_step(xla_rmsnorm), (x, scale), inner, iters, rtt_s
+        ),
     }
     # Memory-bound: fwd reads x + writes out, bwd reads x/g + writes dx
     # (~4 full-tensor HBM transits at bf16), scale negligible.
     traffic_bytes = 4 * rows * d * 2
     for side in ("pallas", "xla"):
-        if "ms" in out[side]:
-            out[side]["gb_per_s"] = round(
-                traffic_bytes / (out[side]["ms"] * 1e-3) / 1e9, 1
-            )
-    if "ms" in out["pallas"] and "ms" in out["xla"]:
+        if out[side].get("ms"):
+            gbps = traffic_bytes / (out[side]["ms"] * 1e-3) / 1e9
+            out[side]["gb_per_s"] = round(gbps, 1)
+            # The 4-transit traffic model overcounts a fully-fused XLA
+            # side (it can skip materializing the normalized output),
+            # so apparent GB/s may legitimately exceed HBM peak by up
+            # to ~1.6x; the cache bug class produces 10-50x, so 2x is
+            # a clean separator.
+            if hbm_gbps and gbps > 2.0 * hbm_gbps:
+                out[side]["suspect"] = True
+    if out["pallas"].get("ms") and out["xla"].get("ms"):
         out["speedup_vs_xla"] = round(out["xla"]["ms"] / out["pallas"]["ms"], 3)
     return out
 
 
 def run_microbench(
-    iters: int = 10,
+    iters: int = 5,
     budget_s: float = 0.0,
     seqs: Optional[list] = None,
     rmsnorm_shape: tuple = (8192, 4096),
     stream: bool = False,
+    inner: Optional[int] = None,
 ) -> dict:
     """``stream=True`` prints the (partial) report line after every
     completed case — a caller that must kill this process on a timeout
-    still gets everything finished so far from the stdout tail."""
+    still gets everything finished so far from the stdout tail.
+
+    ``inner`` overrides every case's scan-amortization length (tests
+    pass 1; on the tunnel rig the per-case defaults amortize the ~66 ms
+    link RTT down to noise)."""
     from ..utils import compilation_cache
 
     compilation_cache.maybe_enable()
@@ -242,13 +370,29 @@ def run_microbench(
         return budget_s - (time.monotonic() - t_start)
 
     devices = jax.devices()
+    t_devices = time.monotonic() - t_start  # before RTT probe / imports
+    platform = jax.default_backend()
+    from ..discovery.chips import chip_spec_for
+
+    device_kind = devices[0].device_kind if devices else ""
+    spec = chip_spec_for(device_kind, platform)
+    peak_flops = spec.peak_flops_bf16 if spec is not None else 0.0
+    hbm_gbps = spec.hbm_gbps if spec is not None else 0.0
+    rtt_s = _measure_rtt()
+    # Per-case scan lengths: enough iterations that the kernel's own
+    # time dominates the subtracted-RTT jitter (fast ops need more).
+    inner_attn = inner or 16
+    inner_xent = inner or 8
+    inner_norm = inner or 128
     report = {
         "ok": True,
-        "backend": jax.default_backend(),
-        "device_kind": devices[0].device_kind if devices else "",
+        "backend": platform,
+        "device_kind": device_kind,
         "devices": len(devices),
-        "time_to_devices_s": round(time.monotonic() - t_start, 3),
+        "time_to_devices_s": round(t_devices, 3),
         "iters": iters,
+        "link_rtt_ms": round(rtt_s * 1e3, 1),
+        "timing": "scan-amortized, value-cache-proof, rtt-corrected",
         "kernels": {},
     }
     if stream:
@@ -271,7 +415,9 @@ def run_microbench(
         batch = max(1, min(4, 8192 // seq))
         cases.append((
             f"attention_seq{seq}",
-            (lambda s=seq, b=batch: _attention_case(s, b, 8, 128, iters)),
+            (lambda s=seq, b=batch: _attention_case(
+                s, b, 8, 128, iters, inner_attn, rtt_s, peak_flops
+            )),
             60.0 if seq >= 8192 else 40.0,
         ))
     agree_seq = min(1024, seqs[-1])
@@ -287,12 +433,16 @@ def run_microbench(
         ),
         (
             f"xent_{xr}x{xd}x{xv}",
-            lambda: _xent_case(xr, xd, xv, xc, iters),
+            lambda: _xent_case(
+                xr, xd, xv, xc, iters, inner_xent, rtt_s, peak_flops
+            ),
             30.0,
         ),
         (
             "rmsnorm_%dx%d" % rmsnorm_shape,
-            lambda: _rmsnorm_case(*rmsnorm_shape, iters),
+            lambda: _rmsnorm_case(
+                *rmsnorm_shape, iters, inner_norm, rtt_s, hbm_gbps
+            ),
             30.0,
         ),
     ]
@@ -315,6 +465,14 @@ def run_microbench(
             for case in report["kernels"].values()
         ):
             report["ok"] = False
+        if any(
+            side.get("suspect")
+            for case in report["kernels"].values()
+            if isinstance(case, dict)
+            for side in case.values()
+            if isinstance(side, dict)
+        ):
+            report["timing_suspect"] = True
         if stream:
             report["wall_s"] = round(time.monotonic() - t_start, 2)
             print(json.dumps(report), flush=True)
@@ -324,7 +482,11 @@ def run_microbench(
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument(
+        "--inner", type=int, default=0,
+        help="override the per-case scan-amortization length (0 = defaults)",
+    )
     p.add_argument(
         "--budget-s", type=float, default=0.0,
         help="soft wall-clock budget; configs that don't fit are skipped",
@@ -343,6 +505,7 @@ def main(argv=None) -> int:
         budget_s=args.budget_s,
         seqs=[int(s) for s in args.seqs.split(",") if s],
         stream=args.stream,
+        inner=args.inner or None,
     )
     print(json.dumps(report), flush=True)
     return 0 if report["ok"] else 1

@@ -10,6 +10,15 @@ saved residuals (x, rrms) come from the kernel.
 On non-TPU backends the same kernel runs in interpreter mode, so tests and
 the CPU mesh exercise identical code paths (pallas_guide.md: Debugging /
 interpret=True).
+
+Measured verdict (ops/microbench.py on v5e, round 4, scan-amortized
+rtt-corrected timing): fwd+bwd at (8192, 4096) bf16 the Pallas path
+runs 0.84x the plain-jnp formulation (987 vs 1170 apparent GB/s) — XLA
+fuses the whole normalize-into-consumer chain and can skip
+materializing the normalized output entirely, which an opaque
+pallas_call boundary cannot. That is why ``ModelConfig.use_pallas_norm``
+defaults to False; the kernel stays as the explicit-VMEM-control option
+and as the tested example of the custom-VJP Pallas pattern.
 """
 
 from __future__ import annotations

@@ -213,7 +213,8 @@ def test_microbench_tiny_shapes_reports_all_cases():
     ran. Real numbers come from the bench artifact on TPU."""
     from k8s_device_plugin_tpu.ops.microbench import run_microbench
 
-    r = run_microbench(iters=1, seqs=[128], rmsnorm_shape=(64, 128))
+    r = run_microbench(iters=1, seqs=[128], rmsnorm_shape=(64, 128),
+                       inner=1)
     assert r["backend"] == "cpu"
     k = r["kernels"]
     assert set(k) == {
@@ -230,7 +231,7 @@ def test_microbench_tiny_shapes_reports_all_cases():
 def test_microbench_budget_skips_are_recorded():
     from k8s_device_plugin_tpu.ops.microbench import run_microbench
 
-    r = run_microbench(iters=1, budget_s=0.001, seqs=[128])
+    r = run_microbench(iters=1, budget_s=0.001, seqs=[128], inner=1)
     assert all("skipped" in v for v in r["kernels"].values())
     assert r["ok"] is True  # skipped-for-budget is not a failure
 
