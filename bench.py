@@ -68,8 +68,13 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 BASELINE_S = 30.0
-TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "230"))
-SMOKE_TIMEOUT_S = float(os.environ.get("BENCH_WORKLOAD_TIMEOUT_S", "140"))
+# 260 = ~30 s control plane/scale/probe + 170 s smoke (the main phase
+# measures ~101 s warm and the in-process interleaved xent A/B adds
+# ~41 s) + the 60 s reserved kernel slice. Still far below any
+# plausible driver timeout; a kill at any point leaves the latest
+# streamed partial.
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "260"))
+SMOKE_TIMEOUT_S = float(os.environ.get("BENCH_WORKLOAD_TIMEOUT_S", "170"))
 # The kernel microbench's guaranteed share of the budget: the smoke and
 # the probe loop may not eat into it (VERDICT r3 #1b).
 KERNEL_RESERVE_S = float(os.environ.get("BENCH_KERNEL_RESERVE_S", "60"))
@@ -316,9 +321,18 @@ def run_workload(alloc_env: dict) -> dict:
         # make_multi_train_step): ~0.50 MFU warm-cache / 151 ms
         # per step on v5e; inner 80 measures ~0.52 warm but its
         # longer windows absorb more shared-chip contention when
-        # cold, so 40 is the robust default.
-        "--bench --steps 80 --batch-per-device 4 --inner-steps 40",
+        # cold, so 40 is the robust default. The chunked-xent A/B
+        # rides the same process (warm backend + data; VERDICT r3
+        # weak #3 — the separate A/B subprocess was always starved).
+        "--bench --steps 80 --batch-per-device 4 --inner-steps 40"
+        " --ab-xent-chunk 4096",
     ).split()
+    if os.environ.get("BENCH_SKIP_XENT_AB") == "1":
+        workload_args = [
+            a for i, a in enumerate(workload_args)
+            if a != "--ab-xent-chunk"
+            and (i == 0 or workload_args[i - 1] != "--ab-xent-chunk")
+        ]
     extra_env = {}
     applied = []
     if alloc_env.get("TPU_VISIBLE_CHIPS"):
@@ -336,6 +350,7 @@ def run_workload(alloc_env: dict) -> dict:
     )
     if report is None:
         return {"error": err or "workload produced no report"}
+    report["ab_requested"] = "--ab-xent-chunk" in workload_args
     report["workload_wall_s"] = round(time.monotonic() - t0, 3)
     report["alloc_env_applied"] = applied
     report["alloc_env_note"] = (
@@ -472,42 +487,24 @@ def main() -> int:
             result["error"] = "control plane failed"
         emit()
 
-        # Phase 2.5: A/B the chunked-vocab CE (ops/xent.py) on the real
-        # chip — the decisive number for whether the bench model should
-        # train with it. Gated on a chip grant and budget, NOT on the
-        # main smoke's verdict (VERDICT r3 weak #3: that gate had never
-        # been true in a driver run). Short run, same batch shape.
-        if (
-            grant["ok"]
-            and _smoke_budget_left() > 75
-            and os.environ.get("BENCH_SKIP_XENT_AB") != "1"
-        ):
-            ab, err = _run_accel_subprocess(
-                [
-                    "k8s_device_plugin_tpu.workload.smoke",
-                    "--bench", "--steps", "40", "--batch-per-device", "4",
-                    "--inner-steps", "20", "--xent-chunk", "4096",
-                ],
-                min(90.0, _smoke_budget_left() - 5),
-                {},
-            )
-            if ab is not None and "error" not in ab:
-                result["detail"]["workload_chunked_xent"] = {
-                    "step_time_s": ab.get("step_time_s"),
-                    "mfu": ab.get("mfu"),
-                    "ok": ab.get("ok"),
-                    "vs_plain_step": (
-                        round(
-                            smoke["step_time_s"] / ab["step_time_s"], 3
-                        )
-                        if ab.get("step_time_s") and smoke.get("step_time_s")
-                        else None
-                    ),
-                }
-            else:
-                result["detail"]["workload_chunked_xent"] = {
-                    "error": err or ab.get("error", "failed")
-                }
+        # Phase 2.5: the chunked-vocab CE A/B rides inside the smoke
+        # subprocess itself (--ab-xent-chunk: same backend, same
+        # device-resident data, warm compile cache — VERDICT r3 weak
+        # #3's separate subprocess paid a full init and was starved in
+        # every driver run). Surface it under the key the artifact
+        # history uses.
+        if isinstance(smoke.get("ab"), dict):
+            result["detail"]["workload_chunked_xent"] = smoke["ab"]
+            emit()
+        elif smoke.get("ab_requested"):
+            # Requested but absent: the subprocess was killed after the
+            # ab_pending snapshot (the main verdict survived; only the
+            # A/B was lost). Record that explicitly — "attempted and
+            # lost" must stay distinguishable from "not requested".
+            result["detail"]["workload_chunked_xent"] = {
+                "error": "A/B attempted but lost "
+                f"(workload ended at stage {smoke.get('partial')!r})"
+            }
             emit()
 
         # Phase 3: kernel microbench (VERDICT r2 #4) on its RESERVED

@@ -162,14 +162,20 @@ class ModelConfig:
         current-generation HBM (v5e, 16 GiB): ~235 M params → ~3.8 GiB of
         f32 param/opt/grad state.
 
-        Loss default: full-logits CE, not xent_chunk — deliberately
-        pending data. The chunked-vocab CE (ops/xent.py) is proven
-        EQUAL on CPU meshes (tests/test_ops.py) but whether it's
-        FASTER at this shape is a hardware question the bench's A/B
-        phase answers (BENCH detail.workload_chunked_xent.vs_plain_step,
-        bench.py phase 2.5, now gated only on a chip grant). Flip this
-        default when an artifact shows vs_plain_step > 1, and cite it
-        here."""
+        Loss default: full-logits CE, set by hardware data (round 4,
+        v5e, interleaved in-process A/B at this exact shape,
+        run_smoke ab_xent_chunk): chunked-CE 142.7/142.8 ms/step vs
+        full-logits 139.7/139.7 across two runs — vs_plain_step
+        0.978/0.979, the chunked bwd's logit recompute costing ~2%
+        where the (batch*seq, 32768) logits (1 GiB bf16) still fit
+        HBM comfortably. xent_chunk stays the lever for vocab/seq
+        combinations where they don't. Measurement note: sequential
+        A/B phases on this shared chip disagreed on the DIRECTION
+        across runs (1.10x then 0.57x — co-tenant drift between
+        phases exceeds the effect); only the interleaved design
+        (alternating single dispatches, per-side medians) reproduces
+        to 0.1%. CPU-mesh equality tests (tests/test_ops.py) pin
+        correctness."""
         return ModelConfig(
             vocab_size=32768, d_model=2048, n_heads=16, n_layers=4,
             d_ff=8192, max_seq_len=2048, use_flash_attention=True,

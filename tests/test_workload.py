@@ -131,6 +131,58 @@ def test_run_smoke_multi_step_cpu_mesh():
     assert 0 <= report["time_to_ready_s"] <= report["time_to_first_step_s"]
 
 
+def test_run_smoke_in_process_xent_ab():
+    """--ab-xent-chunk measures the chunked-CE variant in the same
+    process: the report carries ab.vs_plain_step, the A/B's first loss
+    is finite, and the main verdict is unaffected. Streamed snapshots
+    include the ab_pending stage carrying the final verdict (a kill
+    during the A/B must lose only the A/B)."""
+    snaps = []
+    cfg = ModelConfig.tiny()
+    report = run_smoke(
+        steps=4, cfg=cfg, batch_per_device=1, inner_steps=2,
+        emit=snaps.append, ab_xent_chunk=max(cfg.vocab_size // 2, 1),
+    )
+    assert report["ok"]
+    ab = report["ab"]
+    assert ab["xent_chunk"] == cfg.vocab_size // 2
+    assert "error" not in ab, ab
+    assert ab["step_time_s"] > 0
+    assert ab["vs_plain_step"] > 0
+    import math
+
+    assert math.isfinite(ab["first_loss"])
+    pending = [s for s in snaps if s.get("partial") == "ab_pending"]
+    assert pending and pending[-1]["ok"] is True
+
+
+def test_run_smoke_ab_flips_to_plain_when_main_is_chunked():
+    """When the main config already trains with the chunked CE at the
+    requested chunk, the A/B measures the full-logits variant instead —
+    and vs_plain_step stays oriented so >1 always means chunked wins."""
+    import dataclasses
+
+    cfg = dataclasses.replace(ModelConfig.tiny(), xent_chunk=32)
+    report = run_smoke(
+        steps=4, cfg=cfg, batch_per_device=1, inner_steps=2,
+        ab_xent_chunk=32,
+    )
+    ab = report["ab"]
+    assert "error" not in ab, ab
+    assert ab["main_xent_chunk"] == 32
+    assert ab["variant_xent_chunk"] == 0
+    assert ab["vs_plain_step"] > 0
+
+
+def test_run_smoke_ab_requires_multi_step():
+    report = run_smoke(
+        steps=2, cfg=ModelConfig.tiny(), batch_per_device=1,
+        inner_steps=1, ab_xent_chunk=32,
+    )
+    assert report["ok"]
+    assert "skipped" in report["ab"]
+
+
 def test_multi_train_step_matches_plain_step():
     # One scanned inner step must be bit-identical in loss to the plain
     # step on the same batch (same params, same tokens).
