@@ -330,7 +330,7 @@ def run_workload(alloc_env: dict) -> dict:
     if os.environ.get("BENCH_SKIP_XENT_AB") == "1":
         workload_args = [
             a for i, a in enumerate(workload_args)
-            if a != "--ab-xent-chunk"
+            if not a.startswith("--ab-xent-chunk")  # flag or flag=value
             and (i == 0 or workload_args[i - 1] != "--ab-xent-chunk")
         ]
     extra_env = {}
@@ -350,7 +350,9 @@ def run_workload(alloc_env: dict) -> dict:
     )
     if report is None:
         return {"error": err or "workload produced no report"}
-    report["ab_requested"] = "--ab-xent-chunk" in workload_args
+    report["ab_requested"] = any(
+        a.startswith("--ab-xent-chunk") for a in workload_args
+    )
     report["workload_wall_s"] = round(time.monotonic() - t0, 3)
     report["alloc_env_applied"] = applied
     report["alloc_env_note"] = (
@@ -496,14 +498,15 @@ def main() -> int:
         if isinstance(smoke.get("ab"), dict):
             result["detail"]["workload_chunked_xent"] = smoke["ab"]
             emit()
-        elif smoke.get("ab_requested"):
-            # Requested but absent: the subprocess was killed after the
-            # ab_pending snapshot (the main verdict survived; only the
-            # A/B was lost). Record that explicitly — "attempted and
-            # lost" must stay distinguishable from "not requested".
+        elif smoke.get("ab_requested") and smoke.get("partial") == "ab_pending":
+            # Killed after the ab_pending snapshot: the main verdict
+            # survived and exactly the A/B was lost. Record that
+            # explicitly — "attempted and lost" must stay
+            # distinguishable from "not requested". (Kills BEFORE
+            # ab_pending surface through the main workload error.)
             result["detail"]["workload_chunked_xent"] = {
-                "error": "A/B attempted but lost "
-                f"(workload ended at stage {smoke.get('partial')!r})"
+                "error": "A/B attempted but lost (workload killed "
+                "after the ab_pending snapshot)"
             }
             emit()
 

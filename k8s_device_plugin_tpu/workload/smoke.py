@@ -285,7 +285,7 @@ def run_smoke(
 
 def _ab_xent(
     cfg, mesh, tx, params, opt_state, stack, inner_steps: int,
-    chunk: int, main_step_time, main_step=None,
+    chunk: int, main_step_time, main_step,
 ) -> dict:
     """Measure the OTHER cross-entropy formulation on the already-
     initialized backend, INTERLEAVED with the formulation the main run
@@ -321,10 +321,6 @@ def _ab_xent(
         "main_phase_step_s": main_step_time,
     }
     try:
-        if main_step is None:  # standalone use: run_smoke passes its own
-            main_step = train.make_multi_train_step(
-                cfg, mesh, tx, inner_steps
-            )
         var_step = train.make_multi_train_step(
             ab_cfg, mesh, tx, inner_steps
         )

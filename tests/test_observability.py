@@ -232,6 +232,35 @@ def test_daemon_heartbeat_backs_healthz(tmp_path):
             daemon.metrics_server.stop()
 
 
+def test_grafana_dashboard_in_lockstep_with_registries():
+    """Every tpu_* family referenced by deploy/grafana-dashboard.json
+    must exist in code (registered or rendered) — a renamed metric must
+    break the dashboard's test, not silently blank its panels."""
+    import json as _json
+    import os
+    import re
+
+    from k8s_device_plugin_tpu.utils import metrics
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deploy", "grafana-dashboard.json",
+    )
+    dash = open(path).read()
+    _json.loads(dash)  # must stay valid JSON for Grafana import
+    referenced = {
+        re.sub(r"_(bucket|sum|count)$", "", m)
+        for m in re.findall(r"tpu_[a-z0-9_]+", dash)
+    }
+    known = (
+        set(metrics.REGISTRY._metrics)
+        | set(metrics.EXTENDER_REGISTRY._metrics)
+        | {"tpu_plugin_uptime_seconds", "tpu_extender_uptime_seconds"}
+    )
+    ghosts = referenced - known
+    assert not ghosts, f"dashboard references unknown families: {sorted(ghosts)}"
+
+
 def test_metrics_doc_in_lockstep_with_registries():
     """docs/metrics.md must document every registered family and name
     no family that doesn't exist (uptime families are rendered, not

@@ -228,6 +228,34 @@ def test_microbench_tiny_shapes_reports_all_cases():
     assert r["ok"] is True
 
 
+def test_microbench_suspect_flag_trips_on_implausible_timing():
+    """The physics guard (VERDICT-r4 bug class: relay value-cache
+    timing) must trip per side and per metric: a peak of ~0 makes every
+    real measurement 'faster than the chip', which is exactly what the
+    cache bug looked like."""
+    from k8s_device_plugin_tpu.ops.microbench import (
+        _attention_case, _measure_rtt, _rmsnorm_case, _xent_case,
+    )
+
+    rtt = _measure_rtt(iters=1)
+    attn = _attention_case(
+        128, 1, 2, 128, iters=1, inner=1, rtt_s=rtt, peak_flops=1.0
+    )
+    assert attn["flash"].get("suspect") or attn["flash"].get(
+        "rtt_dominated"
+    ), attn
+    norm = _rmsnorm_case(64, 128, iters=1, inner=1, rtt_s=rtt,
+                         hbm_gbps=1e-9)
+    assert norm["pallas"].get("suspect") or norm["pallas"].get(
+        "rtt_dominated"
+    ), norm
+    xent = _xent_case(64, 32, 128, 32, iters=1, inner=1, rtt_s=rtt,
+                      peak_flops=1.0)
+    assert xent["chunked"].get("suspect") or xent["chunked"].get(
+        "rtt_dominated"
+    ), xent
+
+
 def test_microbench_budget_skips_are_recorded():
     from k8s_device_plugin_tpu.ops.microbench import run_microbench
 
