@@ -298,20 +298,11 @@ def acquire_chip_grant() -> dict:
         time.sleep(min(PROBE_SLEEP_S, max(_smoke_budget_left() - 45, 0)))
 
 
-def run_workload(alloc_env: dict) -> dict:
-    """The smoke workload: one attempt sized to the remaining
-    smoke-side budget (the probe loop already owns retrying for chip
-    grants). Never raises, never hangs; a mid-run kill is harvested
-    into the latest streamed partial.
-
-    ``alloc_env``: the Allocate response's env. Only TPU_VISIBLE_CHIPS is
-    applied — on this rig the accelerator is tunnel-attached (PJRT plugin
-    over a relay), so chip-binding vars are not interpreted by the
-    runtime; the chip-COUNT check (pod sees exactly as many devices as
-    were allocated) is the part that carries over, and the report records
-    that scope honestly.
-    """
-    workload_args = os.environ.get(
+def workload_args_from_env() -> list:
+    """The smoke subprocess's CLI args: BENCH_WORKLOAD_ARGS override or
+    the tuned default, with the --ab-xent-chunk flag (either form)
+    stripped when BENCH_SKIP_XENT_AB=1. Factored out for unit tests."""
+    args = os.environ.get(
         "BENCH_WORKLOAD_ARGS",
         # batch 4: batch 6 is silently MIScompiled for the scanned
         # bench model by the remote chipless compile helper (loss
@@ -328,11 +319,28 @@ def run_workload(alloc_env: dict) -> dict:
         " --ab-xent-chunk 4096",
     ).split()
     if os.environ.get("BENCH_SKIP_XENT_AB") == "1":
-        workload_args = [
-            a for i, a in enumerate(workload_args)
+        args = [
+            a for i, a in enumerate(args)
             if not a.startswith("--ab-xent-chunk")  # flag or flag=value
-            and (i == 0 or workload_args[i - 1] != "--ab-xent-chunk")
+            and (i == 0 or args[i - 1] != "--ab-xent-chunk")
         ]
+    return args
+
+
+def run_workload(alloc_env: dict) -> dict:
+    """The smoke workload: one attempt sized to the remaining
+    smoke-side budget (the probe loop already owns retrying for chip
+    grants). Never raises, never hangs; a mid-run kill is harvested
+    into the latest streamed partial.
+
+    ``alloc_env``: the Allocate response's env. Only TPU_VISIBLE_CHIPS is
+    applied — on this rig the accelerator is tunnel-attached (PJRT plugin
+    over a relay), so chip-binding vars are not interpreted by the
+    runtime; the chip-COUNT check (pod sees exactly as many devices as
+    were allocated) is the part that carries over, and the report records
+    that scope honestly.
+    """
+    workload_args = workload_args_from_env()
     extra_env = {}
     applied = []
     if alloc_env.get("TPU_VISIBLE_CHIPS"):

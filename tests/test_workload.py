@@ -389,6 +389,27 @@ def test_run_smoke_streams_partials():
     assert report["ok"] is True and "partial" not in report
 
 
+def test_bench_workload_args_skip_flag_strips_both_forms(monkeypatch):
+    import bench
+
+    monkeypatch.delenv("BENCH_WORKLOAD_ARGS", raising=False)
+    monkeypatch.delenv("BENCH_SKIP_XENT_AB", raising=False)
+    default = bench.workload_args_from_env()
+    assert "--ab-xent-chunk" in default  # A/B on by default
+
+    monkeypatch.setenv("BENCH_SKIP_XENT_AB", "1")
+    stripped = bench.workload_args_from_env()
+    assert "--ab-xent-chunk" not in stripped
+    assert "4096" not in stripped  # the flag's value went with it
+    assert stripped[:2] == ["--bench", "--steps"]
+
+    # The equals form (valid argparse) must strip too.
+    monkeypatch.setenv(
+        "BENCH_WORKLOAD_ARGS", "--bench --ab-xent-chunk=4096 --steps 8"
+    )
+    assert bench.workload_args_from_env() == ["--bench", "--steps", "8"]
+
+
 def test_bench_is_box_helper():
     """bench.py's placement-shape proof: exact sub-box tilings pass,
     scattered or duplicate picks fail."""
