@@ -64,6 +64,32 @@ def test_flash_attention_matches_reference(block_q, block_kv):
     assert jnp.allclose(out, ref, atol=2e-5)
 
 
+def test_flash_attention_default_block_tiling_fwd_and_grad():
+    """Agreement at 1024x1024 tiles with seq 2048: the exact
+    tile/causal-mask index math of the hardware-tuned block sizes
+    (sweep in docs/round4-notes.md), including one full off-diagonal
+    tile in fwd and both bwd kernels."""
+    q = jax.random.normal(jax.random.PRNGKey(5), (1, 1, 2048, 16),
+                          jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(6), (1, 1, 2048, 16),
+                          jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(7), (1, 1, 2048, 16),
+                          jnp.float32)
+    flash = lambda q_, k_, v_: flash_attention(  # noqa: E731
+        q_, k_, v_, block_q=1024, block_kv=1024
+    )
+    assert jnp.allclose(
+        flash(q, k, v), reference_attention(q, k, v), atol=2e-5
+    )
+    gf = jax.grad(
+        lambda q_: flash(q_, k, v).astype(jnp.float32).mean()
+    )(q)
+    gr = jax.grad(
+        lambda q_: reference_attention(q_, k, v).astype(jnp.float32).mean()
+    )(q)
+    assert jnp.allclose(gf, gr, atol=2e-4)
+
+
 def test_flash_attention_is_causal():
     # Changing future tokens must not change earlier outputs.
     q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 64, 16), jnp.float32)

@@ -274,8 +274,8 @@ def test_graft_dryrun_multichip():
     # Every plan the driver's MULTICHIP artifact records must be there.
     for plan in (
         "fsdp+sp+tp", "fsdp+sp+tp:ring-qchunk", "fsdp+ep+tp", "dp+pp+tp",
-        "fsdp+ep+sp", "fsdp+tp:chunked-xent", "decode",
-        "checkpoint-reshard",
+        "fsdp+ep+sp", "fsdp+tp:chunked-xent", "fsdp+tp:flash-attn",
+        "decode", "checkpoint-reshard",
     ):
         assert f" {plan}:" in proc.stdout, (plan, proc.stdout[-1500:])
 
