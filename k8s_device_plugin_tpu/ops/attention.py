@@ -30,14 +30,18 @@ Runs in interpreter mode off-TPU so the same code is exercised by CPU
 tests.
 
 Measured verdict (ops/microbench.py on v5e, round 4, scan-amortized
-rtt-corrected timing, fwd+bwd bf16 head_dim 128): 2.46x the jitted
-dense formulation at seq 8192 (61 vs 25 TFLOP/s) and 1.98x at seq 2048
-— the causal-skip plus never materializing the O(seq^2) score tensor
-is worth more than the MXU utilization the dense matmuls get for free,
-and the gap widens with sequence length, which is the long-context
-design point. (An earlier artifact showed flash "losing" 0.7x — that
-was the fixed-input timing loop measuring the tunnel relay's
-result cache, not the chip; see ops/microbench.py.)
+rtt-corrected timing, fwd+bwd bf16 head_dim 128): at the long-proven
+512x512 tiles, 2.46x the jitted dense formulation at seq 8192 (61 vs
+25 TFLOP/s) and 1.98x at seq 2048 — the causal-skip plus never
+materializing the O(seq^2) score tensor is worth more than the MXU
+utilization the dense matmuls get for free, and the gap widens with
+sequence length, which is the long-context design point. A block-size
+sweep then measured kv tiles of 1024 a further +45% at seq 8192
+(8.15 -> 5.65 ms, ~88 TFLOP/s, ~3.5x dense), which the default block
+resolution applies from seq 4096 up (_resolve_blocks). (An earlier
+artifact showed flash "losing" 0.7x — that was the fixed-input timing
+loop measuring the tunnel relay's result cache, not the chip; see
+ops/microbench.py.)
 """
 
 from __future__ import annotations
@@ -275,19 +279,38 @@ def _fit_block(seq: int, requested: int) -> int:
     return best_any
 
 
+def _resolve_blocks(seq: int, block_q: int, block_kv: int, d: int):
+    """0 = hardware-tuned default. The v5e sweep (round 4, RTT-corrected
+    scan timing, fwd+bwd bf16 d=128): widening block_kv 512 -> 1024 is
+    +45% at seq 8192 (8.15 -> 5.65 ms; more MXU work per grid step,
+    fewer online-softmax scratch updates), widening block_q past 512
+    adds ~3%, 2048-wide blocks fail to compile (VMEM). 1024 kv tiles
+    apply from seq 4096 up AND head_dim <= 128 — the sweep's validated
+    envelope; a wider head doubles the tile's VMEM footprint, and the
+    2048-block compile failure shows the headroom is finite. Shorter
+    seqs / wider heads keep the long-validated 512. Callers can still
+    pin any size explicitly (both halves of the A/B sweep did)."""
+    if block_q == 0:
+        block_q = 512
+    if block_kv == 0:
+        block_kv = 1024 if (seq >= 4096 and d <= 128) else 512
+    return _fit_block(seq, block_q), _fit_block(seq, block_kv)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
-    block_q: int = 512,
-    block_kv: int = 512,
+    block_q: int = 0,
+    block_kv: int = 0,
 ) -> jax.Array:
     """Causal attention over (batch, heads, seq, head_dim) tensors.
 
     Forward and backward are streaming Pallas kernels: VMEM holds one
     K/V (or Q) tile at a time, so sequence length is bounded by HBM, not
-    VMEM, and no O(seq²) intermediate ever exists.
+    VMEM, and no O(seq²) intermediate ever exists. block_q/block_kv 0 =
+    hardware-tuned per-seq defaults (_resolve_blocks).
     """
     return _flash_fwd(q, k, v, block_q, block_kv)[0]
 
@@ -343,8 +366,7 @@ def _flash_call(q, k, v, block_q, block_kv):
 
 def _flash_fwd(q, k, v, block_q, block_kv):
     b, h, seq, d = q.shape
-    block_q = _fit_block(seq, block_q)
-    block_kv = _fit_block(seq, block_kv)
+    block_q, block_kv = _resolve_blocks(seq, block_q, block_kv, d)
     out, lse = _flash_call(q, k, v, block_q, block_kv)
     return out.reshape(b, h, seq, d), (q, k, v, out, lse)
 
@@ -352,8 +374,7 @@ def _flash_fwd(q, k, v, block_q, block_kv):
 def _flash_bwd(block_q, block_kv, res, g):
     q, k, v, out, lse = res
     b, h, seq, d = q.shape
-    block_q = _fit_block(seq, block_q)
-    block_kv = _fit_block(seq, block_kv)
+    block_q, block_kv = _resolve_blocks(seq, block_q, block_kv, d)
     scale = 1.0 / (d ** 0.5)
     bh = b * h
     qf = q.reshape(bh, seq, d)
