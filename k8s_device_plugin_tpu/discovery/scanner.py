@@ -358,6 +358,31 @@ def _pci_addr(devdir: str) -> str:
         return ""
 
 
+def _parse_coords_attr(path: str) -> tuple:
+    """Strict parse of a ``coords`` sysfs attribute, shared by the
+    accel-class scanner and the vfio backend (discovery/vfio.py)."""
+    parts = _read_trimmed(path).split(",")
+    vals = []
+    for p in parts[:3]:
+        # Trim the native parser's exact whitespace set (a bare
+        # .strip() also removes Unicode whitespace the C++ side
+        # keeps), then ASCII decimal digits only with the same
+        # INT32_MAX bound — both backends accept and reject
+        # byte-identical inputs (parity-tested).
+        p = p.strip(" \t\r\n\f\v")
+        if not p or not p.isascii() or not p.isdigit():
+            raise OSError(22, f"garbled coords attribute {path!r}")
+        v = int(p)
+        if v > 2147483647:
+            raise OSError(22, f"garbled coords attribute {path!r}")
+        vals.append(v)
+    if not vals:
+        raise OSError(22, f"garbled coords attribute {path!r}")
+    while len(vals) < 3:
+        vals.append(0)
+    return tuple(vals)
+
+
 class PyTpuInfo:
     """Pure-Python scanner, result-identical to NativeTpuInfo."""
 
@@ -503,26 +528,7 @@ class PyTpuInfo:
         )
         if not os.path.exists(path):
             return None
-        parts = _read_trimmed(path).split(",")
-        vals = []
-        for p in parts[:3]:
-            # Trim the native parser's exact whitespace set (a bare
-            # .strip() also removes Unicode whitespace the C++ side
-            # keeps), then ASCII decimal digits only with the same
-            # INT32_MAX bound — both backends accept and reject
-            # byte-identical inputs (parity-tested).
-            p = p.strip(" \t\r\n\f\v")
-            if not p or not p.isascii() or not p.isdigit():
-                raise OSError(22, f"garbled coords attribute {path!r}")
-            v = int(p)
-            if v > 2147483647:
-                raise OSError(22, f"garbled coords attribute {path!r}")
-            vals.append(v)
-        if not vals:
-            raise OSError(22, f"garbled coords attribute {path!r}")
-        while len(vals) < 3:
-            vals.append(0)
-        return tuple(vals)
+        return _parse_coords_attr(path)
 
     def host_info(self, proc_dir: str = "/proc") -> dict:
         """Result-identical to NativeTpuInfo.host_info (tpuinfo.h)."""

@@ -1,0 +1,216 @@
+"""TPU discovery for vfio-bound hosts.
+
+Newer GKE TPU node images bind the chips' PCI functions to vfio-pci
+instead of the legacy gasket/accel class driver: there is no
+``/sys/class/accel``, and a workload opens ``/dev/vfio/<group>`` (plus
+the shared ``/dev/vfio/vfio`` container node) with the chip's IOMMU
+group granted to the container. The reference has no analog (NVML
+enumerates GPUs regardless of binding, /root/reference/nvidia.go:20-40);
+for TPUs the devfs layout IS the discovery surface, so this backend
+walks the vfio topology:
+
+    <iommu_groups>/<G>/devices/<pci_addr>/{vendor,device,numa_node,...}
+    <dev_vfio>/<G>                      (the group character device)
+    <dev_vfio>/vfio                     (the shared container device)
+
+and produces the same ``TpuChip`` records as the accel-class scanners —
+identity stays the PCI address, so kubelet device IDs are identical
+across driver bindings (a node image migration does not orphan the
+kubelet's device-manager checkpoint).
+
+Duck-type contract: ``VfioTpuInfo`` implements the same surface the
+accel backends do (scan / chip_health / chip_health_detail /
+chip_coords / version), with the two directory arguments meaning the
+vfio roots: where an accel backend takes ``(sysfs_accel_dir, dev_dir)``
+this one takes ``(iommu_groups_dir, dev_vfio_dir)``. ``resolve_layout``
+below picks the backend and the matching directory pair together and is
+the ONE detection path — the daemon (``Daemon.discover``) and the topo
+debug CLI both call it, so they can never disagree about what a node
+holds; every downstream consumer (health watcher, coords collection,
+mesh rendering) works unchanged. ``health_events_open`` is
+deliberately absent: the health watcher's ``hasattr`` probe then runs
+interval polling only, which is correct — vfio trees carry no
+per-attribute inotify contract. Native note: ``libtpuinfo.so`` covers
+the accel layout; vfio scanning is Python (the daemon's supported
+``--python-backend`` path) until the C++ shim grows a vfio walker.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional
+
+from .chips import DEVICE_ID_TO_TYPE, GOOGLE_VENDOR_ID, TpuChip, spec_for
+from .scanner import (
+    _normalize_reason,
+    _pci_addr,
+    _read_bytes_trimmed,
+    _read_int,
+)
+
+log = logging.getLogger(__name__)
+
+DEFAULT_IOMMU_GROUPS = "/sys/kernel/iommu_groups"
+DEFAULT_DEV_VFIO = "/dev/vfio"
+
+# The shared vfio container node every vfio consumer opens alongside its
+# group node; Allocate must inject it with any group device.
+CONTAINER_NODE = "vfio"
+
+
+class VfioTpuInfo:
+    """vfio-layout scanner; duck-compatible with PyTpuInfo/NativeTpuInfo
+    with (iommu_groups_dir, dev_vfio_dir) as the directory pair."""
+
+    def version(self) -> str:
+        return "tpuinfo-vfio 0.1.0"
+
+    # -- discovery ---------------------------------------------------------
+
+    def _tpu_device_dirs(self, iommu_groups_dir: str, group: int):
+        """Google-TPU PCI device dirs inside one IOMMU group."""
+        devs_dir = os.path.join(iommu_groups_dir, str(group), "devices")
+        try:
+            names = sorted(os.listdir(devs_dir))
+        except (FileNotFoundError, NotADirectoryError):
+            return []
+        out = []
+        for name in names:
+            devdir = os.path.join(devs_dir, name)
+            vendor = _read_int(os.path.join(devdir, "vendor"), 0)
+            if vendor != GOOGLE_VENDOR_ID:
+                continue
+            device = _read_int(os.path.join(devdir, "device"), 0)
+            if device not in DEVICE_ID_TO_TYPE:
+                continue
+            out.append((name, devdir, device))
+        return out
+
+    def scan(self, iommu_groups_dir: str, dev_vfio_dir: str) -> List[TpuChip]:
+        """One TpuChip per IOMMU GROUP — not per PCI function. vfio
+        grants access per group node, so the group is the allocatable
+        unit: emitting one chip per function would hand two pods the
+        same /dev/vfio/<group> (cross-pod access to a "dedicated" chip)
+        and collide on the group-number index that health/coords lookups
+        key on. A group holding several TPU functions (ACS off) is
+        advertised as ONE device identified by its first function, with
+        a warning — capacity under-count beats isolation loss. The chip
+        index is the group number, mirroring the accel backends where
+        index keys /dev/accelN."""
+        try:
+            entries = os.listdir(iommu_groups_dir)
+        except FileNotFoundError:
+            return []  # not a vfio host: 0 chips, never a crash
+        chips = []
+        for name in entries:
+            if not name.isdigit():
+                continue
+            group = int(name)
+            funcs = self._tpu_device_dirs(iommu_groups_dir, group)
+            if not funcs:
+                continue
+            if len(funcs) > 1:
+                log.warning(
+                    "IOMMU group %d holds %d TPU functions (%s); "
+                    "advertising it as ONE device — the group node is "
+                    "the isolation boundary",
+                    group, len(funcs), ", ".join(f[0] for f in funcs),
+                )
+            dev_name, devdir, device = funcs[0]
+            chip_type = DEVICE_ID_TO_TYPE[device]
+            spec = spec_for(chip_type)
+            chips.append(
+                TpuChip(
+                    index=group,
+                    dev_path=os.path.join(dev_vfio_dir, str(group)),
+                    pci_addr=_pci_addr(devdir) or dev_name,
+                    vendor_id=GOOGLE_VENDOR_ID,
+                    device_id=device,
+                    numa_node=_read_int(
+                        os.path.join(devdir, "numa_node"), -1
+                    ),
+                    chip_type=chip_type,
+                    hbm_bytes=spec.hbm_bytes,
+                    core_count=spec.cores_per_chip,
+                )
+            )
+        chips.sort(key=lambda c: (c.pci_addr, c.index))
+        return chips
+
+    # -- health ------------------------------------------------------------
+
+    def chip_health(
+        self, iommu_groups_dir: str, dev_vfio_dir: str, index: int
+    ) -> bool:
+        return self.chip_health_detail(iommu_groups_dir, dev_vfio_dir, index)[0]
+
+    def chip_health_detail(
+        self, iommu_groups_dir: str, dev_vfio_dir: str, index: int
+    ) -> "tuple[bool, str]":
+        """Same conventions (and reason tokens) as the accel backends:
+        missing group dir raises; missing /dev node, pci-disabled, and a
+        non-ok ``health`` attribute are unhealthy with a normalized
+        reason."""
+        base = os.path.join(iommu_groups_dir, str(index))
+        if not os.path.isdir(base):
+            raise FileNotFoundError(base)
+        if not os.path.exists(os.path.join(dev_vfio_dir, str(index))):
+            return False, "dev_node_missing"
+        for _, devdir, _ in self._tpu_device_dirs(iommu_groups_dir, index):
+            enable = os.path.join(devdir, "enable")
+            if os.path.exists(enable) and _read_int(enable, 1) == 0:
+                return False, "pci_disabled"
+            health = os.path.join(devdir, "health")
+            if os.path.exists(health):
+                token = _read_bytes_trimmed(health)
+                if token.lower() not in (b"ok", b"healthy", b"1"):
+                    return False, _normalize_reason(token)
+        return True, ""
+
+    # -- topology ----------------------------------------------------------
+
+    def chip_coords(
+        self, iommu_groups_dir: str, index: int
+    ) -> "Optional[tuple]":
+        """Driver-published ICI coords when exposed (same attribute
+        contract as the accel layout's device/coords)."""
+        from .scanner import _parse_coords_attr
+
+        for _, devdir, _ in self._tpu_device_dirs(iommu_groups_dir, index):
+            path = os.path.join(devdir, "coords")
+            if os.path.exists(path):
+                return _parse_coords_attr(path)
+        return None
+
+
+def resolve_layout(
+    accel_backend,
+    sysfs_accel_dir: str,
+    dev_dir: str,
+    iommu_groups_dir: str = "",
+    dev_vfio_dir: str = "",
+):
+    """The layout auto-detection shared by the daemon (Daemon.discover)
+    and the topo debug CLI (tools/topo.py) — both MUST agree on what a
+    node holds. Scans the accel class first (the long-standing layout,
+    native-accelerated); when it has no chips, scans the vfio topology.
+
+    Returns (backend, (scan_dir_a, scan_dir_b), chips): the backend and
+    the directory pair move together, so every downstream consumer
+    (health watcher, coords collection, rendering) keys on the roots
+    matching the layout that actually enumerated.
+    """
+    dirs = (sysfs_accel_dir, dev_dir)
+    chips = accel_backend.scan(*dirs)
+    if chips:
+        return accel_backend, dirs, chips
+    vfio_dirs = (
+        iommu_groups_dir or DEFAULT_IOMMU_GROUPS,
+        dev_vfio_dir or DEFAULT_DEV_VFIO,
+    )
+    backend = VfioTpuInfo()
+    vfio_chips = backend.scan(*vfio_dirs)
+    if vfio_chips:
+        return backend, vfio_dirs, vfio_chips
+    return accel_backend, dirs, []

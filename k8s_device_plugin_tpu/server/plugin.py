@@ -74,6 +74,11 @@ class PluginConfig:
     substitute_on_allocate: bool = False
     # cgroup device permissions for /dev/accel* nodes.
     device_permissions: str = "rwm"
+    # Node-level device nodes injected alongside every non-empty chip
+    # allocation: on vfio-layout hosts (discovery/vfio.py) a workload
+    # opens the shared /dev/vfio/vfio container device in addition to
+    # its per-chip /dev/vfio/<group> nodes.
+    extra_device_paths: tuple = ()
     # CDI (Container Device Interface, k8s >= 1.26): when set (e.g.
     # "google.com/tpu"), Allocate additionally returns fully-qualified CDI
     # device names "<kind>=<chip id>" so CDI-aware runtimes do the device
@@ -504,6 +509,12 @@ class TpuDevicePlugin(DevicePluginServicer):
             resp.devices.add(
                 container_path=mc.chip.dev_path,
                 host_path=mc.chip.dev_path,
+                permissions=self.config.device_permissions,
+            )
+        for path in self.config.extra_device_paths:
+            resp.devices.add(
+                container_path=path,
+                host_path=path,
                 permissions=self.config.device_permissions,
             )
         mount = libtpu_mount(self.config)

@@ -55,6 +55,11 @@ class DaemonConfig:
     device_plugin_dir: str = constants.DEVICE_PLUGIN_PATH
     sysfs_accel_dir: str = DEFAULT_SYSFS_ACCEL
     dev_dir: str = DEFAULT_DEV
+    # vfio layout roots (newer GKE TPU node images bind chips to
+    # vfio-pci; see discovery/vfio.py). Auto-detected when the accel
+    # class scan finds nothing.
+    iommu_groups_dir: str = ""
+    dev_vfio_dir: str = ""
     numa_dir: str = DEFAULT_NUMA_DIR
     proc_dir: str = "/proc"
     resource_name: str = constants.RESOURCE_NAME
@@ -100,7 +105,14 @@ class Daemon:
 
     def __init__(self, cfg: DaemonConfig):
         self.cfg = cfg
-        self.backend = get_backend(prefer_native=cfg.prefer_native_backend)
+        self._accel_backend = get_backend(
+            prefer_native=cfg.prefer_native_backend
+        )
+        self.backend = self._accel_backend
+        # (scan-root-a, scan-root-b) matching self.backend's layout:
+        # accel-class (sysfs_accel_dir, dev_dir) or vfio
+        # (iommu_groups_dir, dev_vfio_dir). Set by discover().
+        self.scan_dirs = (cfg.sysfs_accel_dir, cfg.dev_dir)
         self.events: "queue.Queue" = queue.Queue()
         self.plugin: Optional[TpuDevicePlugin] = None
         self.health: Optional[HealthWatcher] = None
@@ -139,7 +151,28 @@ class Daemon:
     # -- build/teardown of one plugin generation ---------------------------
 
     def discover(self) -> List[TpuChip]:
-        chips = self.backend.scan(self.cfg.sysfs_accel_dir, self.cfg.dev_dir)
+        # Layout auto-detection (accel class, else vfio — newer node
+        # images bind chips to vfio-pci with no /sys/class/accel at
+        # all), shared with the topo debug CLI so both always agree.
+        # Every (re)discovery starts from the accel-class backend: a
+        # SIGHUP rebuild on a host whose layout changed (node image
+        # update) must re-run the detection, not stay pinned to the
+        # previous round's choice.
+        from ..discovery.vfio import VfioTpuInfo, resolve_layout
+
+        self.backend, self.scan_dirs, chips = resolve_layout(
+            self._accel_backend,
+            self.cfg.sysfs_accel_dir,
+            self.cfg.dev_dir,
+            self.cfg.iommu_groups_dir,
+            self.cfg.dev_vfio_dir,
+        )
+        if isinstance(self.backend, VfioTpuInfo):
+            log.info(
+                "no accel-class chips; using the vfio layout "
+                "(%d IOMMU groups with TPU functions)",
+                len(chips),
+            )
         override = (
             self.cfg.accelerator_type or self._derived_accelerator_type
         )
@@ -228,7 +261,7 @@ class Daemon:
         mesh = IciMesh(
             chips,
             discovered_coords=collect_chip_coords(
-                self.backend, self.cfg.sysfs_accel_dir, chips
+                self.backend, self.scan_dirs[0], chips
             ),
         )
         state = PlacementState(mesh)
@@ -244,6 +277,13 @@ class Daemon:
                 )
             except Exception as e:
                 log.warning("slice membership derivation failed: %s", e)
+        from ..discovery.vfio import CONTAINER_NODE, VfioTpuInfo
+
+        extra_devs = (
+            (os.path.join(self.scan_dirs[1], CONTAINER_NODE),)
+            if isinstance(self.backend, VfioTpuInfo)
+            else ()
+        )
         self.plugin = TpuDevicePlugin(
             mesh,
             state=state,
@@ -258,13 +298,14 @@ class Daemon:
                 slice_host_bounds=self.cfg.slice_host_bounds,
                 registration_mode=self.cfg.registration_mode,
                 plugins_registry_dir=self.cfg.plugins_registry_dir,
+                extra_device_paths=extra_devs,
             ),
         )
         if chips:
             self.health = HealthWatcher(
                 self.backend,
-                self.cfg.sysfs_accel_dir,
-                self.cfg.dev_dir,
+                self.scan_dirs[0],
+                self.scan_dirs[1],
                 chips,
                 self.plugin.notify_health,
                 interval_s=self.cfg.health_interval_s,
@@ -416,6 +457,15 @@ def parse_args(argv) -> DaemonConfig:
     p.add_argument("--device-plugin-dir", default=constants.DEVICE_PLUGIN_PATH)
     p.add_argument("--sysfs-accel-dir", default=DEFAULT_SYSFS_ACCEL)
     p.add_argument("--dev-dir", default=DEFAULT_DEV)
+    p.add_argument(
+        "--iommu-groups-dir", default="",
+        help="vfio layout root (default /sys/kernel/iommu_groups); the "
+        "vfio scan runs when the accel class dir has no chips",
+    )
+    p.add_argument(
+        "--dev-vfio-dir", default="",
+        help="vfio device-node dir (default /dev/vfio)",
+    )
     p.add_argument("--resource-name", default=constants.RESOURCE_NAME)
     p.add_argument(
         "--accelerator-type",
@@ -485,6 +535,8 @@ def parse_args(argv) -> DaemonConfig:
         device_plugin_dir=a.device_plugin_dir,
         sysfs_accel_dir=a.sysfs_accel_dir,
         dev_dir=a.dev_dir,
+        iommu_groups_dir=a.iommu_groups_dir,
+        dev_vfio_dir=a.dev_vfio_dir,
         resource_name=a.resource_name,
         accelerator_type=a.accelerator_type,
         libtpu_host_path=a.libtpu_path,

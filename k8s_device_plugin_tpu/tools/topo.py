@@ -92,6 +92,10 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpu-topo")
     p.add_argument("--sysfs", default=DEFAULT_SYSFS_ACCEL)
     p.add_argument("--dev", default=DEFAULT_DEV)
+    p.add_argument("--iommu-groups", default="",
+                   help="vfio layout root (default /sys/kernel/iommu_groups)")
+    p.add_argument("--dev-vfio", default="",
+                   help="vfio device-node dir (default /dev/vfio)")
     p.add_argument("--from-json", default="",
                    help="render a published node-topology JSON instead")
     p.add_argument("--select", type=int, default=0, metavar="N",
@@ -128,16 +132,22 @@ def main(argv=None) -> int:
                 f"{h.get('cpu_model', '')}"
             )
     else:
-        backend = get_backend()
-        chips = backend.scan(a.sysfs, a.dev)
+        from ..discovery.vfio import resolve_layout
+
+        # Same layout detection AND coordinate resolution as the daemon
+        # (shared helpers), so the debug view and the daemon agree on
+        # vfio hosts and render identical meshes.
+        backend, scan_dirs, chips = resolve_layout(
+            get_backend(), a.sysfs, a.dev, a.iommu_groups, a.dev_vfio
+        )
         if not chips:
             print("no TPU chips found (CPU-only node?)", file=sys.stderr)
             return 1
-        # Same coordinate resolution as the daemon (shared helper, so the
-        # debug view and the daemon render identical meshes).
         mesh = IciMesh(
             chips,
-            discovered_coords=collect_chip_coords(backend, a.sysfs, chips),
+            discovered_coords=collect_chip_coords(
+                backend, scan_dirs[0], chips
+            ),
         )
 
     claims = _read_claims(a.cdi_dir, mesh) if a.cdi_dir else None

@@ -65,6 +65,52 @@ def set_chip_coords(accel_dir: str, index: int, coords: str):
     _write(devdir, "coords", coords)
 
 
+def make_fake_vfio_node(
+    root: str,
+    chip_type: str = "v5p",
+    count: int = 4,
+    numa_of=lambda i: 0,
+    first_group: int = 10,
+):
+    """Create <root>/sys/kernel/iommu_groups + <root>/dev/vfio with
+    `count` fake vfio-bound TPU chips (discovery/vfio.py layout): one
+    IOMMU group per chip holding one Google PCI function, plus the
+    shared /dev/vfio/vfio container node.
+
+    Returns (iommu_groups_dir, dev_vfio_dir).
+    """
+    groups_dir = os.path.join(root, "sys", "kernel", "iommu_groups")
+    dev_vfio = os.path.join(root, "dev", "vfio")
+    os.makedirs(dev_vfio, exist_ok=True)
+    device_id = TYPE_TO_DEVICE_ID.get(chip_type, 0)
+    with open(os.path.join(dev_vfio, "vfio"), "w") as f:
+        f.write("")
+    for i in range(count):
+        group = first_group + i
+        pci = f"0000:00:{4 + i:02x}.0"
+        devdir = os.path.join(groups_dir, str(group), "devices", pci)
+        os.makedirs(devdir, exist_ok=True)
+        _write(devdir, "vendor", "0x1ae0")
+        _write(devdir, "device", f"0x{device_id:04x}")
+        _write(devdir, "numa_node", str(numa_of(i)))
+        _write(devdir, "uevent", f"DRIVER=vfio-pci\nPCI_SLOT_NAME={pci}\n")
+        with open(os.path.join(dev_vfio, str(group)), "w") as f:
+            f.write("")
+    os.makedirs(groups_dir, exist_ok=True)
+    return groups_dir, dev_vfio
+
+
+def set_vfio_chip_health(
+    groups_dir: str, group: int, healthy: bool, reason: str = "failed"
+):
+    """Flip the health attribute of the (single) TPU function in an
+    IOMMU group — the vfio twin of set_chip_health."""
+    devs = os.path.join(groups_dir, str(group), "devices")
+    for name in os.listdir(devs):
+        _write(os.path.join(devs, name), "health",
+               "ok" if healthy else reason)
+
+
 def make_fake_proc(root: str, cpus: int = 4, sockets: int = 2,
                    mem_kb: int = 8_000_000, model: str = "Fake CPU v1"):
     """Create <root>/proc with cpuinfo + meminfo for host_info tests."""

@@ -1,0 +1,193 @@
+"""vfio-layout discovery (discovery/vfio.py) and its supervisor wiring.
+
+Newer GKE TPU node images bind chips to vfio-pci: no /sys/class/accel,
+device nodes are /dev/vfio/<group> plus the shared /dev/vfio/vfio
+container. These tests drive the VfioTpuInfo scanner over a fake vfio
+tree and the full daemon auto-detection end to end (register →
+ListAndWatch → Allocate carrying the container node).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+from k8s_device_plugin_tpu.discovery.vfio import VfioTpuInfo
+from tests import fakes
+
+
+def test_vfio_scan_enumerates_tpu_groups(tmp_path):
+    groups, dev = fakes.make_fake_vfio_node(
+        str(tmp_path), "v5p", 4, numa_of=lambda i: i % 2
+    )
+    chips = VfioTpuInfo().scan(groups, dev)
+    assert len(chips) == 4
+    assert [c.index for c in chips] == [10, 11, 12, 13]  # group numbers
+    assert chips[0].dev_path == os.path.join(dev, "10")
+    assert chips[0].chip_type == "v5p"
+    assert chips[0].pci_addr == "0000:00:04.0"
+    assert chips[0].numa_node == 0 and chips[1].numa_node == 1
+    # Identity is the PCI address — stable across a driver-binding
+    # migration (same ids the accel layout would produce).
+    assert chips[0].device_id_str == "tpu-0000:00:04.0"
+
+
+def test_vfio_scan_missing_tree_is_zero_chips(tmp_path):
+    assert VfioTpuInfo().scan(str(tmp_path / "nope"), "/dev/vfio") == []
+
+
+def test_vfio_scan_ignores_non_tpu_groups(tmp_path):
+    groups, dev = fakes.make_fake_vfio_node(str(tmp_path), "v5e", 2)
+    # A NIC bound to vfio in its own group must not enumerate.
+    nic = os.path.join(groups, "99", "devices", "0000:00:1f.0")
+    os.makedirs(nic)
+    with open(os.path.join(nic, "vendor"), "w") as f:
+        f.write("0x8086\n")
+    with open(os.path.join(dev, "99"), "w") as f:
+        f.write("")
+    chips = VfioTpuInfo().scan(groups, dev)
+    assert len(chips) == 2
+    assert all(c.index != 99 for c in chips)
+
+
+def test_vfio_multi_function_group_is_one_device(tmp_path, caplog):
+    """vfio grants access per GROUP node, so a group holding two TPU
+    functions (ACS off) must advertise as ONE device — two would hand
+    two pods the same /dev/vfio/<group>."""
+    import logging
+
+    groups, dev = fakes.make_fake_vfio_node(str(tmp_path), "v5p", 1)
+    second = os.path.join(groups, "10", "devices", "0000:00:09.0")
+    os.makedirs(second)
+    for fname, val in (
+        ("vendor", "0x1ae0"), ("device", "0x0063"), ("numa_node", "0"),
+        ("uevent", "PCI_SLOT_NAME=0000:00:09.0\n"),
+    ):
+        with open(os.path.join(second, fname), "w") as f:
+            f.write(val + "\n")
+    with caplog.at_level(logging.WARNING):
+        chips = VfioTpuInfo().scan(groups, dev)
+    assert len(chips) == 1
+    assert chips[0].index == 10
+    assert "2 TPU functions" in caplog.text
+
+
+def test_resolve_layout_prefers_accel_then_vfio(tmp_path):
+    """The shared detection the daemon and topo CLI both use: accel
+    chips win when present; an empty accel tree falls through to vfio;
+    neither = accel backend with 0 chips."""
+    from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+    from k8s_device_plugin_tpu.discovery.vfio import resolve_layout
+
+    accel, dev = fakes.make_fake_tpu_node(
+        str(tmp_path / "a"), "v5e", 2
+    )
+    groups, dev_vfio = fakes.make_fake_vfio_node(
+        str(tmp_path / "b"), "v5p", 4
+    )
+    py = PyTpuInfo()
+    be, dirs, chips = resolve_layout(py, accel, dev, groups, dev_vfio)
+    assert be is py and dirs == (accel, dev) and len(chips) == 2
+
+    be, dirs, chips = resolve_layout(
+        py, str(tmp_path / "no-accel"), dev, groups, dev_vfio
+    )
+    assert isinstance(be, VfioTpuInfo)
+    assert dirs == (groups, dev_vfio) and len(chips) == 4
+
+    be, dirs, chips = resolve_layout(
+        py, str(tmp_path / "no-accel"), dev,
+        str(tmp_path / "no-vfio"), dev_vfio,
+    )
+    assert be is py and chips == []
+
+
+def test_vfio_health_detail(tmp_path):
+    groups, dev = fakes.make_fake_vfio_node(str(tmp_path), "v5p", 2)
+    be = VfioTpuInfo()
+    assert be.chip_health_detail(groups, dev, 10) == (True, "")
+    fakes.set_vfio_chip_health(groups, 10, False, "hbm_ecc")
+    assert be.chip_health_detail(groups, dev, 10) == (False, "hbm_ecc")
+    fakes.set_vfio_chip_health(groups, 10, True)
+    assert be.chip_health_detail(groups, dev, 10) == (True, "")
+    # Missing /dev node = unhealthy with the shared reason token.
+    os.unlink(os.path.join(dev, "11"))
+    assert be.chip_health_detail(groups, dev, 11) == (
+        False, "dev_node_missing",
+    )
+
+
+def test_vfio_chip_coords(tmp_path):
+    groups, dev = fakes.make_fake_vfio_node(str(tmp_path), "v5p", 1)
+    be = VfioTpuInfo()
+    assert be.chip_coords(groups, 10) is None
+    devdir = os.path.join(groups, "10", "devices", "0000:00:04.0")
+    with open(os.path.join(devdir, "coords"), "w") as f:
+        f.write("1,0,1\n")
+    assert be.chip_coords(groups, 10) == (1, 0, 1)
+
+
+def test_daemon_autodetects_vfio_layout(tmp_path):
+    """Full daemon on a vfio-only fake node: accel dir absent, chips
+    come from the vfio tree, Allocate injects the per-chip group node
+    AND the shared /dev/vfio/vfio container node, and a health flip
+    re-advertises — the whole stack running off the switched backend
+    and directory pair."""
+    from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
+    from tests.fake_kubelet import FakeKubelet
+
+    root = str(tmp_path)
+    dp = os.path.join(root, "dp")
+    os.makedirs(dp)
+    groups, dev_vfio = fakes.make_fake_vfio_node(root, "v5p", 4)
+    kubelet = FakeKubelet(dp)
+    kubelet.start()
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("PALLAS_AXON_POOL_IPS", "TPU_ACCELERATOR_TYPE")
+    }
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    daemon = subprocess.Popen(
+        [
+            sys.executable, "-m", "k8s_device_plugin_tpu",
+            "--device-plugin-dir", dp,
+            "--sysfs-accel-dir", os.path.join(root, "no-accel-here"),
+            "--dev-dir", os.path.join(root, "dev"),
+            "--iommu-groups-dir", groups,
+            "--dev-vfio-dir", dev_vfio,
+            "--libtpu-path", "",
+            "--no-controller",
+        ],
+        cwd=repo,
+        env=env,
+    )
+    try:
+        assert kubelet.registered.wait(30), "daemon never registered"
+        stub = kubelet.plugin_stub()
+        stream = iter(stub.ListAndWatch(pb.Empty()))
+        lw = next(stream)
+        ids = sorted(d.ID for d in lw.devices)
+        assert len(ids) == 4
+        assert all(i.startswith("tpu-0000:00:") for i in ids)
+
+        areq = pb.AllocateRequest()
+        areq.container_requests.add(devicesIDs=ids[:1])
+        resp = stub.Allocate(areq).container_responses[0]
+        paths = sorted(d.host_path for d in resp.devices)
+        assert os.path.join(dev_vfio, "10") in paths
+        assert os.path.join(dev_vfio, "vfio") in paths  # container node
+        assert len(paths) == 2
+
+        fakes.set_vfio_chip_health(groups, 11, False, "ici_link_down")
+        deadline = time.time() + 20
+        unhealthy = []
+        while time.time() < deadline and not unhealthy:
+            upd = next(stream)
+            unhealthy = [
+                d.ID for d in upd.devices if d.health == "Unhealthy"
+            ]
+        assert unhealthy == ["tpu-0000:00:05.0"], unhealthy
+    finally:
+        daemon.terminate()
+        daemon.wait(timeout=10)
+        kubelet.stop()
