@@ -28,11 +28,13 @@ the ONE detection path — the daemon (``Daemon.discover``) and the topo
 debug CLI both call it, so they can never disagree about what a node
 holds; every downstream consumer (health watcher, coords collection,
 mesh rendering) works unchanged. ``health_events_open`` is
-deliberately absent: the health watcher's ``hasattr`` probe then runs
-interval polling only, which is correct — vfio trees carry no
-per-attribute inotify contract. Native note: ``libtpuinfo.so`` covers
-the accel layout; vfio scanning is Python (the daemon's supported
-``--python-backend`` path) until the C++ shim grows a vfio walker.
+deliberately absent from both walkers: the health watcher's ``hasattr``
+probe then runs interval polling only, which is correct — vfio trees
+carry no per-attribute inotify contract. The walker exists twice, like
+the accel scanners: C++ (``tpuinfo_scan_vfio`` & co. in
+native/tpuinfo/tpuinfo.cc, bound by ``NativeVfioTpuInfo``) and the
+pure-Python ``VfioTpuInfo``, result-identical and parity-tested;
+``get_vfio_backend`` picks like ``scanner.get_backend`` does.
 """
 
 from __future__ import annotations
@@ -43,7 +45,9 @@ from typing import List, Optional
 
 from .chips import DEVICE_ID_TO_TYPE, GOOGLE_VENDOR_ID, TpuChip, spec_for
 from .scanner import (
+    NativeTpuInfo,
     _normalize_reason,
+    _parse_coords_attr,
     _pci_addr,
     _read_bytes_trimmed,
     _read_int,
@@ -149,18 +153,23 @@ class VfioTpuInfo:
         self, iommu_groups_dir: str, dev_vfio_dir: str, index: int
     ) -> "tuple[bool, str]":
         """Same conventions (and reason tokens) as the accel backends:
-        missing group dir raises; missing /dev node, pci-disabled, and a
-        non-ok ``health`` attribute are unhealthy with a normalized
-        reason."""
+        missing group dir raises; a missing /dev node and a non-ok
+        ``health`` attribute are unhealthy with a normalized reason.
+
+        Deliberately NO ``enable == 0 -> pci_disabled`` rule (the accel
+        layout has one): the kernel only pci_enable_device()s a
+        vfio-bound function when userspace opens the group fd, so an
+        IDLE chip legitimately reads enable=0 — copying the accel rule
+        would report every unallocated chip Unhealthy, the watcher
+        would withdraw them, nothing could ever schedule and open them:
+        a permanent all-Unhealthy deadlock. (The gasket/accel driver
+        enables at probe time, which is why the rule is safe there.)"""
         base = os.path.join(iommu_groups_dir, str(index))
         if not os.path.isdir(base):
             raise FileNotFoundError(base)
         if not os.path.exists(os.path.join(dev_vfio_dir, str(index))):
             return False, "dev_node_missing"
         for _, devdir, _ in self._tpu_device_dirs(iommu_groups_dir, index):
-            enable = os.path.join(devdir, "enable")
-            if os.path.exists(enable) and _read_int(enable, 1) == 0:
-                return False, "pci_disabled"
             health = os.path.join(devdir, "health")
             if os.path.exists(health):
                 token = _read_bytes_trimmed(health)
@@ -175,13 +184,146 @@ class VfioTpuInfo:
     ) -> "Optional[tuple]":
         """Driver-published ICI coords when exposed (same attribute
         contract as the accel layout's device/coords)."""
-        from .scanner import _parse_coords_attr
-
         for _, devdir, _ in self._tpu_device_dirs(iommu_groups_dir, index):
             path = os.path.join(devdir, "coords")
             if os.path.exists(path):
                 return _parse_coords_attr(path)
         return None
+
+
+class NativeVfioTpuInfo:
+    """vfio scanning through libtpuinfo.so (tpuinfo_scan_vfio & co. in
+    native/tpuinfo/tpuinfo.cc) — duck-identical to ``VfioTpuInfo``,
+    parity-tested against it over the same fake trees. Raises OSError
+    when the library is absent OR predates the vfio symbols (version
+    skew via TPUINFO_LIB), so ``get_vfio_backend`` can fall back to the
+    Python walker."""
+
+    def __init__(self, lib_path=None):
+        import ctypes
+
+        from .scanner import _CChip, _TPUINFO_MAX_CHIPS
+
+        self._inner = NativeTpuInfo(lib_path)
+        self._ctypes = ctypes
+        self._cchip = _CChip
+        self._max = _TPUINFO_MAX_CHIPS
+        lib = self._inner._lib
+        try:
+            lib.tpuinfo_scan_vfio.restype = ctypes.c_int
+            lib.tpuinfo_scan_vfio.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.POINTER(_CChip), ctypes.c_int,
+            ]
+            lib.tpuinfo_vfio_chip_health.restype = ctypes.c_int
+            lib.tpuinfo_vfio_chip_health.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.tpuinfo_vfio_chip_health_reason.restype = ctypes.c_int
+            lib.tpuinfo_vfio_chip_health_reason.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.tpuinfo_vfio_chip_coords.restype = ctypes.c_int
+            lib.tpuinfo_vfio_chip_coords.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int * 3),
+            ]
+        except AttributeError as e:
+            raise OSError(f"libtpuinfo.so predates the vfio surface: {e}")
+        self._lib = lib
+
+    def version(self) -> str:
+        return self._inner.version() + "+vfio"
+
+    def scan(self, iommu_groups_dir: str, dev_vfio_dir: str) -> List[TpuChip]:
+        buf = (self._cchip * self._max)()
+        n = self._lib.tpuinfo_scan_vfio(
+            iommu_groups_dir.encode(), dev_vfio_dir.encode(), buf, self._max
+        )
+        if n < 0:
+            raise OSError(-n, f"tpuinfo_scan_vfio({iommu_groups_dir}) failed")
+        chips = []
+        for i in range(min(n, self._max)):
+            c = buf[i]
+            chips.append(
+                TpuChip(
+                    index=c.index,
+                    dev_path=c.dev_path.decode(),
+                    pci_addr=c.pci_addr.decode(),
+                    vendor_id=c.vendor_id,
+                    device_id=c.device_id,
+                    numa_node=c.numa_node,
+                    chip_type=c.chip_type.decode(),
+                    hbm_bytes=c.hbm_bytes,
+                    core_count=c.core_count,
+                )
+            )
+        return chips
+
+    def chip_health(
+        self, iommu_groups_dir: str, dev_vfio_dir: str, index: int
+    ) -> bool:
+        r = self._lib.tpuinfo_vfio_chip_health(
+            iommu_groups_dir.encode(), dev_vfio_dir.encode(), index
+        )
+        if r < 0:
+            raise OSError(-r, f"tpuinfo_vfio_chip_health(group {index}) failed")
+        return bool(r)
+
+    def chip_health_detail(
+        self, iommu_groups_dir: str, dev_vfio_dir: str, index: int
+    ) -> "tuple[bool, str]":
+        buf = self._ctypes.create_string_buffer(64)
+        r = self._lib.tpuinfo_vfio_chip_health_reason(
+            iommu_groups_dir.encode(), dev_vfio_dir.encode(), index,
+            buf, len(buf),
+        )
+        if r < 0:
+            raise OSError(
+                -r, f"tpuinfo_vfio_chip_health_reason(group {index}) failed"
+            )
+        return bool(r), buf.value.decode()
+
+    def chip_coords(
+        self, iommu_groups_dir: str, index: int
+    ) -> "Optional[tuple]":
+        xyz = (self._ctypes.c_int * 3)()
+        r = self._lib.tpuinfo_vfio_chip_coords(
+            iommu_groups_dir.encode(), index, self._ctypes.byref(xyz)
+        )
+        if r < 0:
+            raise OSError(
+                -r, f"tpuinfo_vfio_chip_coords(group {index}) failed"
+            )
+        if r == 0:
+            return None
+        return (xyz[0], xyz[1], xyz[2])
+
+
+_VFIO_BACKEND_CACHE: dict = {}
+
+
+def get_vfio_backend(prefer_native: bool = True):
+    """Native vfio walker when libtpuinfo.so (with the vfio surface) is
+    available, else the Python walker — the vfio twin of
+    scanner.get_backend. Memoized per preference: the accel backend is
+    built once per daemon, and every rediscovery (SIGHUP, kubelet socket
+    recreate) calls through here — re-dlopening the library and
+    re-logging the fallback warning each time would be noise."""
+    if prefer_native not in _VFIO_BACKEND_CACHE:
+        backend = None
+        if prefer_native:
+            try:
+                backend = NativeVfioTpuInfo()
+            except OSError as e:
+                log.warning(
+                    "native vfio surface unavailable (%s); using Python "
+                    "walker",
+                    e,
+                )
+        _VFIO_BACKEND_CACHE[prefer_native] = backend or VfioTpuInfo()
+    return _VFIO_BACKEND_CACHE[prefer_native]
 
 
 def resolve_layout(
@@ -209,7 +351,11 @@ def resolve_layout(
         iommu_groups_dir or DEFAULT_IOMMU_GROUPS,
         dev_vfio_dir or DEFAULT_DEV_VFIO,
     )
-    backend = VfioTpuInfo()
+    # Match the caller's native-vs-python preference: an accel backend
+    # that IS native means native was both preferred and available.
+    backend = get_vfio_backend(
+        prefer_native=isinstance(accel_backend, NativeTpuInfo)
+    )
     vfio_chips = backend.scan(*vfio_dirs)
     if vfio_chips:
         return backend, vfio_dirs, vfio_chips

@@ -158,7 +158,7 @@ class Daemon:
         # SIGHUP rebuild on a host whose layout changed (node image
         # update) must re-run the detection, not stay pinned to the
         # previous round's choice.
-        from ..discovery.vfio import VfioTpuInfo, resolve_layout
+        from ..discovery.vfio import resolve_layout
 
         self.backend, self.scan_dirs, chips = resolve_layout(
             self._accel_backend,
@@ -167,7 +167,7 @@ class Daemon:
             self.cfg.iommu_groups_dir,
             self.cfg.dev_vfio_dir,
         )
-        if isinstance(self.backend, VfioTpuInfo):
+        if self.backend is not self._accel_backend:
             log.info(
                 "no accel-class chips; using the vfio layout "
                 "(%d IOMMU groups with TPU functions)",
@@ -277,11 +277,11 @@ class Daemon:
                 )
             except Exception as e:
                 log.warning("slice membership derivation failed: %s", e)
-        from ..discovery.vfio import CONTAINER_NODE, VfioTpuInfo
+        from ..discovery.vfio import CONTAINER_NODE
 
         extra_devs = (
             (os.path.join(self.scan_dirs[1], CONTAINER_NODE),)
-            if isinstance(self.backend, VfioTpuInfo)
+            if self.backend is not self._accel_backend  # vfio layout
             else ()
         )
         self.plugin = TpuDevicePlugin(

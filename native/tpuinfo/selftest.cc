@@ -139,10 +139,63 @@ int main() {
   CHECK(tpuinfo_health_events_open((base + "/na").c_str(),
                                    (base + "/nb").c_str()) == -ENOENT);
 
+  /* vfio layout: scan, group dedup, health classes, coords. */
+  std::string groups = base + "/iommu_groups";
+  std::string dev_vfio = base + "/dev_vfio";
+  CHECK(system(("mkdir -p '" + dev_vfio + "'").c_str()) == 0);
+  WriteFile(dev_vfio + "/vfio", "");
+  for (int g = 10; g <= 11; ++g) {
+    char pci[32];
+    snprintf(pci, sizeof(pci), "0000:00:%02x.0", g - 6);
+    std::string devdir = groups + "/" + std::to_string(g) + "/devices/" + pci;
+    CHECK(system(("mkdir -p '" + devdir + "'").c_str()) == 0);
+    WriteFile(devdir + "/vendor", "0x1ae0\n");
+    WriteFile(devdir + "/device", "0x0063\n");
+    WriteFile(devdir + "/numa_node", "0\n");
+    WriteFile(devdir + "/uevent",
+              std::string("PCI_SLOT_NAME=") + pci + "\n");
+    WriteFile(dev_vfio + "/" + std::to_string(g), "");
+  }
+  tpuinfo_chip vchips[8];
+  CHECK(tpuinfo_scan_vfio(groups.c_str(), dev_vfio.c_str(), vchips, 8) == 2);
+  CHECK(vchips[0].index == 10 && vchips[1].index == 11);
+  CHECK(strcmp(vchips[0].chip_type, "v5p") == 0);
+  CHECK(strstr(vchips[0].dev_path, "/10") != nullptr);
+  /* Second TPU function in group 10 (ACS off): still ONE device. */
+  CHECK(system(("mkdir -p '" + groups + "/10/devices/0000:00:1f.0'")
+                   .c_str()) == 0);
+  WriteFile(groups + "/10/devices/0000:00:1f.0/vendor", "0x1ae0\n");
+  WriteFile(groups + "/10/devices/0000:00:1f.0/device", "0x0063\n");
+  CHECK(tpuinfo_scan_vfio(groups.c_str(), dev_vfio.c_str(), vchips, 8) == 2);
+  /* Health classes + reason parity tokens. */
+  char vreason[64];
+  CHECK(tpuinfo_vfio_chip_health_reason(groups.c_str(), dev_vfio.c_str(), 10,
+                                        vreason, sizeof(vreason)) == 1);
+  WriteFile(groups + "/11/devices/0000:00:05.0/health", "HBM ECC!\n");
+  CHECK(tpuinfo_vfio_chip_health_reason(groups.c_str(), dev_vfio.c_str(), 11,
+                                        vreason, sizeof(vreason)) == 0);
+  CHECK(strcmp(vreason, "hbm_ecc_") == 0);
+  std::string rmnode = "rm -f '" + dev_vfio + "/11'";
+  CHECK(system(rmnode.c_str()) == 0);
+  CHECK(tpuinfo_vfio_chip_health_reason(groups.c_str(), dev_vfio.c_str(), 11,
+                                        vreason, sizeof(vreason)) == 0);
+  CHECK(strcmp(vreason, "dev_node_missing") == 0);
+  CHECK(tpuinfo_vfio_chip_health(groups.c_str(), dev_vfio.c_str(), 99) ==
+        -ENOENT);
+  int vxyz[3];
+  CHECK(tpuinfo_vfio_chip_coords(groups.c_str(), 10, vxyz) == 0);
+  WriteFile(groups + "/10/devices/0000:00:04.0/coords", "1,0,1\n");
+  CHECK(tpuinfo_vfio_chip_coords(groups.c_str(), 10, vxyz) == 1);
+  CHECK(vxyz[0] == 1 && vxyz[1] == 0 && vxyz[2] == 1);
+  CHECK(tpuinfo_scan_vfio((base + "/no-groups").c_str(), dev_vfio.c_str(),
+                          vchips, 8) == 0);
+
   /* NULL-argument contract. */
   CHECK(tpuinfo_scan(nullptr, dev.c_str(), chips, 4) == -EINVAL);
   CHECK(tpuinfo_chip_coords(accel.c_str(), 0, nullptr) == -EINVAL);
   CHECK(tpuinfo_host_info(nullptr, &hi) == -EINVAL);
+  CHECK(tpuinfo_scan_vfio(nullptr, dev_vfio.c_str(), vchips, 8) == -EINVAL);
+  CHECK(tpuinfo_vfio_chip_coords(groups.c_str(), 10, nullptr) == -EINVAL);
 
   std::string cleanup = "rm -rf '" + base + "'";
   CHECK(system(cleanup.c_str()) == 0);

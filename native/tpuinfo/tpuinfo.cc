@@ -373,14 +373,14 @@ void tpuinfo_health_events_close(int fd) {
   if (fd >= 0) ::close(fd);
 }
 
-int tpuinfo_chip_coords(const char* sysfs_class_dir, int index,
-                        int out_xyz[3]) {
-  if (sysfs_class_dir == nullptr || out_xyz == nullptr) return -EINVAL;
-  char buf[512];
-  snprintf(buf, sizeof(buf), "%s/accel%d/device/coords", sysfs_class_dir,
-           index);
-  if (!PathExists(buf)) return 0; /* no ground truth published */
-  std::string s = ReadTrimmed(buf);
+namespace {
+
+/* Strict "x,y,z" attribute parse shared by the accel and vfio layouts.
+ * Returns 1 on success, 0 when the attribute is absent, -EINVAL on
+ * garbage. */
+int ParseCoordsAttr(const std::string& path, int out_xyz[3]) {
+  if (!PathExists(path)) return 0; /* no ground truth published */
+  std::string s = ReadTrimmed(path);
   int vals[3] = {0, 0, 0};
   int n = 0;
   std::stringstream ss(s);
@@ -405,6 +405,17 @@ int tpuinfo_chip_coords(const char* sysfs_class_dir, int index,
   if (n == 0) return -EINVAL;
   for (int i = 0; i < 3; ++i) out_xyz[i] = vals[i];
   return 1;
+}
+
+}  // namespace
+
+int tpuinfo_chip_coords(const char* sysfs_class_dir, int index,
+                        int out_xyz[3]) {
+  if (sysfs_class_dir == nullptr || out_xyz == nullptr) return -EINVAL;
+  char buf[512];
+  snprintf(buf, sizeof(buf), "%s/accel%d/device/coords", sysfs_class_dir,
+           index);
+  return ParseCoordsAttr(buf, out_xyz);
 }
 
 int tpuinfo_host_info(const char* proc_dir, tpuinfo_host_info_t* out) {
@@ -467,6 +478,168 @@ int tpuinfo_probe_libtpu(const char* path) {
   return 1;
 }
 
-const char* tpuinfo_version(void) { return "tpuinfo 0.1.0"; }
+namespace {
+
+struct TpuFunc {
+  std::string name;    /* PCI address dir name, e.g. 0000:00:04.0 */
+  std::string devdir;  /* full path to the device dir */
+  unsigned int device; /* PCI device id */
+};
+
+/* Google-TPU PCI functions inside one IOMMU group, sorted by name so the
+ * "first function" identity pick is deterministic (parity with the
+ * Python backend's sorted(os.listdir(...))). */
+std::vector<TpuFunc> TpuFuncsInGroup(const std::string& groups_dir,
+                                     int group) {
+  std::vector<TpuFunc> out;
+  char buf[512];
+  snprintf(buf, sizeof(buf), "%s/%d/devices", groups_dir.c_str(), group);
+  DIR* d = ::opendir(buf);
+  if (d == nullptr) return out;
+  struct dirent* ent;
+  while ((ent = ::readdir(d)) != nullptr) {
+    const char* name = ent->d_name;
+    if (name[0] == '.') continue;
+    TpuFunc f;
+    f.name = name;
+    f.devdir = std::string(buf) + "/" + name;
+    unsigned int vendor =
+        static_cast<unsigned int>(ReadLong(f.devdir + "/vendor", 0));
+    if (vendor != kGoogleVendorId) continue;
+    f.device = static_cast<unsigned int>(ReadLong(f.devdir + "/device", 0));
+    bool known = false;
+    for (const ChipModel& m : kModels)
+      if (m.device_id == f.device) known = true;
+    if (!known) continue;
+    out.push_back(f);
+  }
+  ::closedir(d);
+  std::sort(out.begin(), out.end(),
+            [](const TpuFunc& a, const TpuFunc& b) { return a.name < b.name; });
+  return out;
+}
+
+}  // namespace
+
+int tpuinfo_scan_vfio(const char* iommu_groups_dir, const char* dev_vfio_dir,
+                      tpuinfo_chip* out, int max_chips) {
+  if (iommu_groups_dir == nullptr || dev_vfio_dir == nullptr ||
+      out == nullptr)
+    return -EINVAL;
+  DIR* d = ::opendir(iommu_groups_dir);
+  if (d == nullptr) {
+    if (errno == ENOENT) return 0; /* not a vfio host */
+    return -errno;
+  }
+  std::vector<ScannedChip> chips;
+  struct dirent* ent;
+  while ((ent = ::readdir(d)) != nullptr) {
+    const char* name = ent->d_name;
+    char* endp = nullptr;
+    long group = std::strtol(name, &endp, 10);
+    if (endp == name || *endp != '\0') continue;
+    std::vector<TpuFunc> funcs =
+        TpuFuncsInGroup(iommu_groups_dir, static_cast<int>(group));
+    if (funcs.empty()) continue;
+    /* One chip per GROUP (the vfio isolation boundary), identified by
+     * its first function; see tpuinfo.h. */
+    const TpuFunc& f = funcs[0];
+    ScannedChip sc{};
+    sc.c.index = static_cast<int>(group);
+    snprintf(sc.c.dev_path, sizeof(sc.c.dev_path), "%s/%ld", dev_vfio_dir,
+             group);
+    std::string pci = PciAddr(f.devdir);
+    if (pci.empty()) pci = f.name;
+    snprintf(sc.c.pci_addr, sizeof(sc.c.pci_addr), "%s", pci.c_str());
+    sc.c.vendor_id = kGoogleVendorId;
+    sc.c.device_id = f.device;
+    sc.c.numa_node = static_cast<int>(ReadLong(f.devdir + "/numa_node", -1));
+    snprintf(sc.c.chip_type, sizeof(sc.c.chip_type), "unknown");
+    for (const ChipModel& m : kModels) {
+      if (m.device_id == f.device) {
+        snprintf(sc.c.chip_type, sizeof(sc.c.chip_type), "%s", m.type);
+        sc.c.hbm_bytes = m.hbm_bytes;
+        sc.c.core_count = m.core_count;
+        break;
+      }
+    }
+    char key[64];
+    snprintf(key, sizeof(key), "%s#%08ld", pci.c_str(), group);
+    sc.sort_key = key;
+    chips.push_back(sc);
+  }
+  ::closedir(d);
+  std::sort(chips.begin(), chips.end(),
+            [](const ScannedChip& a, const ScannedChip& b) {
+              return a.sort_key < b.sort_key;
+            });
+  int n = static_cast<int>(chips.size());
+  for (int i = 0; i < n && i < max_chips; ++i) out[i] = chips[i].c;
+  return n;
+}
+
+namespace {
+
+int VfioChipHealthImpl(const char* iommu_groups_dir, const char* dev_vfio_dir,
+                       int group, std::string* reason) {
+  if (iommu_groups_dir == nullptr || dev_vfio_dir == nullptr) return -EINVAL;
+  char buf[512];
+  snprintf(buf, sizeof(buf), "%s/%d", iommu_groups_dir, group);
+  if (!PathExists(buf)) return -ENOENT;
+  snprintf(buf, sizeof(buf), "%s/%d", dev_vfio_dir, group);
+  if (!PathExists(buf)) {
+    if (reason) *reason = "dev_node_missing";
+    return 0;
+  }
+  /* No enable==0 -> pci_disabled rule here (the accel layout has one):
+   * the kernel only pci_enable_device()s a vfio-bound function when
+   * userspace opens the group fd, so an IDLE chip legitimately reads
+   * enable=0 — the accel rule would deadlock every unallocated chip
+   * Unhealthy. (gasket/accel enables at probe time; safe there.) */
+  for (const TpuFunc& f : TpuFuncsInGroup(iommu_groups_dir, group)) {
+    std::string health_path = f.devdir + "/health";
+    if (PathExists(health_path)) {
+      std::string h = ReadTrimmed(health_path);
+      std::transform(h.begin(), h.end(), h.begin(), [](unsigned char ch) {
+        return (ch >= 'A' && ch <= 'Z') ? static_cast<char>(ch + ('a' - 'A'))
+                                        : static_cast<char>(ch);
+      });
+      if (h != "ok" && h != "healthy" && h != "1") {
+        if (reason) *reason = NormalizeReason(h);
+        return 0;
+      }
+    }
+  }
+  return 1;
+}
+
+}  // namespace
+
+int tpuinfo_vfio_chip_health(const char* iommu_groups_dir,
+                             const char* dev_vfio_dir, int group) {
+  return VfioChipHealthImpl(iommu_groups_dir, dev_vfio_dir, group, nullptr);
+}
+
+int tpuinfo_vfio_chip_health_reason(const char* iommu_groups_dir,
+                                    const char* dev_vfio_dir, int group,
+                                    char* reason, int reason_len) {
+  std::string why;
+  int rc = VfioChipHealthImpl(iommu_groups_dir, dev_vfio_dir, group, &why);
+  if (reason != nullptr && reason_len > 0)
+    snprintf(reason, static_cast<size_t>(reason_len), "%s", why.c_str());
+  return rc;
+}
+
+int tpuinfo_vfio_chip_coords(const char* iommu_groups_dir, int group,
+                             int out_xyz[3]) {
+  if (iommu_groups_dir == nullptr || out_xyz == nullptr) return -EINVAL;
+  for (const TpuFunc& f : TpuFuncsInGroup(iommu_groups_dir, group)) {
+    int rc = ParseCoordsAttr(f.devdir + "/coords", out_xyz);
+    if (rc != 0) return rc; /* found (1) or garbage (-EINVAL) */
+  }
+  return 0;
+}
+
+const char* tpuinfo_version(void) { return "tpuinfo 0.2.0"; }
 
 }  /* extern "C" */

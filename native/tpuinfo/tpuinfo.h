@@ -137,6 +137,37 @@ int tpuinfo_health_events_open(const char* sysfs_class_dir,
 int tpuinfo_health_events_wait(int fd, int timeout_ms);
 void tpuinfo_health_events_close(int fd);
 
+/* vfio layout (newer GKE TPU node images bind chips to vfio-pci; there
+ * is no /sys/class/accel). The discovery surface is the IOMMU-group
+ * topology:
+ *   <iommu_groups_dir>/<G>/devices/<pci_addr>/{vendor,device,...}
+ *   <dev_vfio_dir>/<G>        (group character device)
+ *   <dev_vfio_dir>/vfio       (shared container device)
+ * One chip per GROUP — vfio grants access per group node, so the group
+ * is the allocatable/isolation unit; a group holding several TPU
+ * functions (ACS off) is reported once, identified by its first
+ * function. chip.index is the group number; dev_path is the group node.
+ * Same return convention as tpuinfo_scan (missing tree → 0, not an
+ * error). Mirrors discovery/vfio.py VfioTpuInfo (parity-tested). */
+int tpuinfo_scan_vfio(const char* iommu_groups_dir, const char* dev_vfio_dir,
+                      tpuinfo_chip* out, int max_chips);
+
+/* Health of the chip in IOMMU group <group>: same conventions and
+ * reason tokens as tpuinfo_chip_health_reason (dev_node_missing /
+ * normalized "health" attribute), EXCEPT no enable-based pci_disabled
+ * rule — an idle vfio-bound function legitimately reads enable=0
+ * until userspace opens its group fd (see tpuinfo.cc). */
+int tpuinfo_vfio_chip_health(const char* iommu_groups_dir,
+                             const char* dev_vfio_dir, int group);
+int tpuinfo_vfio_chip_health_reason(const char* iommu_groups_dir,
+                                    const char* dev_vfio_dir, int group,
+                                    char* reason, int reason_len);
+
+/* Ground-truth ICI coords from a "coords" attribute on any of the
+ * group's TPU functions; same contract as tpuinfo_chip_coords. */
+int tpuinfo_vfio_chip_coords(const char* iommu_groups_dir, int group,
+                             int out_xyz[3]);
+
 const char* tpuinfo_version(void);
 
 #ifdef __cplusplus

@@ -12,8 +12,57 @@ import subprocess
 import sys
 import time
 
-from k8s_device_plugin_tpu.discovery.vfio import VfioTpuInfo
+import pytest
+
+from k8s_device_plugin_tpu.discovery.vfio import (
+    NativeVfioTpuInfo,
+    VfioTpuInfo,
+)
 from tests import fakes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "native", "tpuinfo")
+NATIVE_LIB = os.path.join(NATIVE_DIR, "build", "libtpuinfo.so")
+
+
+@pytest.fixture(scope="session")
+def native_lib():
+    if not os.path.exists(NATIVE_LIB):
+        subprocess.run(
+            ["make", "-C", NATIVE_DIR], check=True, capture_output=True
+        )
+    return NATIVE_LIB
+
+
+def test_native_and_python_vfio_identical(native_lib, tmp_path):
+    """Both walkers over the same fake tree: scan results, health
+    details (every built-in reason class), and coords — byte-identical,
+    like the accel parity suite (tests/test_discovery.py)."""
+    groups, dev = fakes.make_fake_vfio_node(
+        str(tmp_path), "v5p", 4, numa_of=lambda i: i % 2
+    )
+    py, native = VfioTpuInfo(), NativeVfioTpuInfo(native_lib)
+    assert native.scan(groups, dev) == py.scan(groups, dev)
+
+    fakes.set_vfio_chip_health(groups, 11, False, "HBM ECC!")
+    for g in (10, 11, 12):
+        assert native.chip_health_detail(groups, dev, g) == \
+            py.chip_health_detail(groups, dev, g)
+    os.unlink(os.path.join(dev, "12"))
+    assert native.chip_health_detail(groups, dev, 12) == \
+        py.chip_health_detail(groups, dev, 12) == (False, "dev_node_missing")
+
+    devdir = os.path.join(groups, "10", "devices", "0000:00:04.0")
+    with open(os.path.join(devdir, "coords"), "w") as f:
+        f.write(" 1 , 2 ,3\n")
+    assert native.chip_coords(groups, 10) == py.chip_coords(groups, 10) \
+        == (1, 2, 3)
+    assert native.chip_coords(groups, 11) is None is py.chip_coords(
+        groups, 11
+    )
+    # Missing tree: both report 0 chips, never a crash.
+    missing = str(tmp_path / "nope")
+    assert native.scan(missing, dev) == py.scan(missing, dev) == []
 
 
 def test_vfio_scan_enumerates_tpu_groups(tmp_path):
@@ -115,6 +164,22 @@ def test_vfio_health_detail(tmp_path):
     assert be.chip_health_detail(groups, dev, 11) == (
         False, "dev_node_missing",
     )
+
+
+def test_vfio_idle_chip_with_enable_zero_is_healthy(tmp_path, native_lib):
+    """vfio-pci functions read enable=0 until userspace opens the group
+    fd — an IDLE chip is healthy. (The accel layout's pci_disabled rule
+    must NOT apply here: it would withdraw every unallocated chip and
+    nothing could ever schedule to enable them.) Pinned for both
+    walkers."""
+    groups, dev = fakes.make_fake_vfio_node(str(tmp_path), "v5p", 1)
+    devdir = os.path.join(groups, "10", "devices", "0000:00:04.0")
+    with open(os.path.join(devdir, "enable"), "w") as f:
+        f.write("0\n")
+    assert VfioTpuInfo().chip_health_detail(groups, dev, 10) == (True, "")
+    assert NativeVfioTpuInfo(native_lib).chip_health_detail(
+        groups, dev, 10
+    ) == (True, "")
 
 
 def test_vfio_chip_coords(tmp_path):
