@@ -296,7 +296,11 @@ class DraDriver(DraPluginServicer):
                 group_chips = [self.plugin.mesh.by_id[i] for i in ids]
                 cdi_groups.append((
                     request,
-                    [mc.chip.dev_path for mc in group_chips],
+                    # Shared with classic Allocate (plugin.device_paths):
+                    # per-chip nodes + node-level extras (the vfio
+                    # layout's shared container device) — one source of
+                    # truth, both planes.
+                    self.plugin.device_paths(group_chips),
                     self.plugin._tpu_env(group_chips),
                     ids,
                 ))

@@ -505,13 +505,7 @@ class TpuDevicePlugin(DevicePluginServicer):
             # Protocol-legal: a container in the pod that requests no TPUs.
             return resp
         chips = [self.mesh.by_id[i] for i in ids]
-        for mc in chips:
-            resp.devices.add(
-                container_path=mc.chip.dev_path,
-                host_path=mc.chip.dev_path,
-                permissions=self.config.device_permissions,
-            )
-        for path in self.config.extra_device_paths:
+        for path in self.device_paths(chips):
             resp.devices.add(
                 container_path=path,
                 host_path=path,
@@ -532,6 +526,17 @@ class TpuDevicePlugin(DevicePluginServicer):
             for i in ids:
                 resp.cdi_devices.add(name=f"{self.config.cdi_kind}={i}")
         return resp
+
+    def device_paths(self, chips) -> List[str]:
+        """Host device nodes a container holding ``chips`` needs: the
+        per-chip nodes plus the node-level extras (the vfio layout's
+        shared /dev/vfio/vfio container device). The ONE source of
+        truth for both planes — classic Allocate and the DRA plane's
+        per-claim CDI specs call here, so a new node-level device can
+        never reach one plane and not the other."""
+        return [mc.chip.dev_path for mc in chips] + list(
+            self.config.extra_device_paths
+        )
 
     def _tpu_env(self, chips) -> Dict[str, str]:
         """TPU runtime env describing the chips visible in the container.

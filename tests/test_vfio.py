@@ -192,6 +192,80 @@ def test_vfio_chip_coords(tmp_path):
     assert be.chip_coords(groups, 10) == (1, 0, 1)
 
 
+def test_dra_cdi_spec_carries_vfio_container_node(tmp_path):
+    """On a vfio-layout host the DRA plane's per-claim CDI spec must
+    inject the shared /dev/vfio/vfio container node alongside the
+    per-chip group nodes — same injection the classic Allocate does."""
+    import grpc
+
+    from k8s_device_plugin_tpu.api.grpc_defs import DraPluginStub
+    from k8s_device_plugin_tpu.api import dra_pb2 as dpb
+    from k8s_device_plugin_tpu.dra.driver import DraDriver
+    from k8s_device_plugin_tpu.dra import slices
+    from k8s_device_plugin_tpu.kube.client import KubeClient
+    from k8s_device_plugin_tpu.server.plugin import (
+        PluginConfig, TpuDevicePlugin,
+    )
+    from k8s_device_plugin_tpu.topology.mesh import IciMesh
+    from tests.fake_apiserver import FakeApiServer
+
+    groups, dev_vfio = fakes.make_fake_vfio_node(str(tmp_path), "v5p", 4)
+    chips = VfioTpuInfo().scan(groups, dev_vfio)
+    container = os.path.join(dev_vfio, "vfio")
+    plugin = TpuDevicePlugin(
+        IciMesh(chips),
+        config=PluginConfig(
+            libtpu_host_path="", extra_device_paths=(container,)
+        ),
+    )
+    server = FakeApiServer()
+    url = server.start()
+    server.add_node("vfio-node")
+    driver = DraDriver(
+        plugin,
+        kube_client=KubeClient(url),
+        driver_name="tpu.google.com",
+        node_name="vfio-node",
+        plugins_dir=str(tmp_path / "plugins"),
+        plugins_registry_dir=str(tmp_path / "plugins_registry"),
+        cdi_dir=str(tmp_path / "cdi"),
+    )
+    driver.start()
+    try:
+        mc = plugin.mesh.mesh_chips[0]
+        server.add_resource_claim({
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {
+                "name": "claim-vfio", "namespace": "default", "uid": "uv1",
+            },
+            "status": {"allocation": {"devices": {"results": [{
+                "request": "tpus",
+                "driver": "tpu.google.com",
+                "pool": "vfio-node",
+                "device": slices.device_name(mc),
+            }]}}},
+        })
+        ch = grpc.insecure_channel(f"unix:{driver.socket_path}")
+        grpc.channel_ready_future(ch).result(timeout=5)
+        stub = DraPluginStub(ch)
+        req = dpb.NodePrepareResourcesRequest()
+        req.claims.add(namespace="default", name="claim-vfio", uid="uv1")
+        resp = stub.NodePrepareResources(req)
+        assert not resp.claims["uv1"].error, resp.claims["uv1"].error
+        spec = driver.cdi.read_claim_spec("uv1")
+        nodes = [
+            n["path"]
+            for d in spec["devices"]
+            for n in d["containerEdits"]["deviceNodes"]
+        ]
+        assert mc.chip.dev_path in nodes
+        assert container in nodes
+    finally:
+        driver.stop()
+        server.stop()
+
+
 def test_daemon_autodetects_vfio_layout(tmp_path):
     """Full daemon on a vfio-only fake node: accel dir absent, chips
     come from the vfio tree, Allocate injects the per-chip group node
