@@ -167,6 +167,47 @@ def test_sighup_triggers_rediscovery(tmp_path, dp_dir, kubelet):
         stop_daemon(daemon, t)
 
 
+def test_rebuild_redetects_layout_change(tmp_path, dp_dir, kubelet):
+    """A SIGHUP rebuild on a host whose devfs layout changed (node image
+    migration: accel class -> vfio) must re-run the layout detection —
+    not stay pinned to the previous round's backend — and the vfio
+    rebuild's Allocate must carry the shared container node."""
+    import shutil
+
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 2)
+    groups, dev_vfio = fakes.make_fake_vfio_node(
+        str(tmp_path / "vfio-root"), "v5p", 4
+    )
+    daemon = Daemon(
+        daemon_config(
+            tmp_path, dp_dir,
+            iommu_groups_dir=groups, dev_vfio_dir=dev_vfio,
+        )
+    )
+    t = run_daemon_thread(daemon)
+    try:
+        assert kubelet.registered.wait(10)
+        stub = kubelet.plugin_stub()
+        resp = next(iter(stub.ListAndWatch(pb.Empty())))
+        assert len(resp.devices) == 2  # accel layout wins while present
+
+        shutil.rmtree(accel)  # the "node image migration"
+        kubelet.registered.clear()
+        daemon.events.put(("signal", signal.SIGHUP))
+        assert kubelet.registered.wait(10)
+        stub = kubelet.plugin_stub()
+        resp = next(iter(stub.ListAndWatch(pb.Empty())))
+        assert len(resp.devices) == 4  # vfio layout detected on rebuild
+
+        areq = pb.AllocateRequest()
+        areq.container_requests.add(devicesIDs=[resp.devices[0].ID])
+        alloc = stub.Allocate(areq).container_responses[0]
+        paths = {d.host_path for d in alloc.devices}
+        assert os.path.join(dev_vfio, "vfio") in paths
+    finally:
+        stop_daemon(daemon, t)
+
+
 def test_fs_watcher_sees_socket_recreate(tmp_path):
     out: queue.Queue = queue.Queue()
     w = FsWatcher(str(tmp_path), out)
