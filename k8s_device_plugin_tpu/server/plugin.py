@@ -545,6 +545,15 @@ class TpuDevicePlugin(DevicePluginServicer):
         bounds when a *subset* of the host's chips is exposed; JAX reads
         these through libtpu. Bounds are the bounding box of the allocated
         coords when the set is an exact sub-box, else the full host bounds.
+
+        TPU_VISIBLE_CHIPS carries chip.index — the devfs-relative value
+        (accelN number on the accel layout; IOMMU group number on vfio).
+        On the accel layout that matches libtpu's 0-based expectation
+        because accel indexes are host-ordinal. On vfio the runtime
+        enumerates from the injected group nodes themselves, and what it
+        does with VISIBLE_CHIPS group numbers is unverified on real
+        hardware (docs/round4-notes.md "Known open items") — the device
+        nodes, not this env, are the binding mechanism there.
         """
         cfg = self.config
         whole_host = len(chips) == len(self.mesh.mesh_chips)
