@@ -34,6 +34,13 @@ taught this shape):
     matter what the smoke does — the cheap phase that can produce an
     accelerator number is never starved by the expensive one. The blind
     fixed-length smoke retry is gone; the probe loop IS the retry.
+  - **Tiered, sub-windowed kernel slice** (r4 #1): the slice is spent
+    as repeated ~30 s windows each running the microbench's ~15 s
+    MICRO tier (bare-matmul anchor + one flash-vs-dense at seq 2048,
+    streamed) — so any grant window >= ~20 s yields an artifact
+    number, a backend stall costs one window instead of the whole
+    slice, and every attempt is recorded. First capture upgrades to
+    the full tier with the remaining budget (run_kernels).
   - **Streaming smoke** (r3 #1c): the smoke emits a schema-guarded JSON
     line after devices-up / first compiled step / every measured
     window; a mid-run kill is harvested into the best partial.
@@ -370,33 +377,138 @@ def run_workload(alloc_env: dict) -> dict:
     return report
 
 
-def run_kernels(grant_ok: bool = True) -> dict:
-    """Kernel microbench with whatever budget remains (soft budget inside
-    the subprocess, hard timeout around it). Runs even without a grant —
-    a window may have opened since the probe loop gave up — but a
-    no-report failure is then annotated with the likely cause."""
-    budget = _budget_left() - 5
-    if budget < 35:
-        return {"skipped": f"budget exhausted ({budget:.0f}s left)"}
-    kernel_args = os.environ.get("BENCH_KERNEL_ARGS", "").split()
-    report, err = _run_accel_subprocess(
-        [
-            "k8s_device_plugin_tpu.ops.microbench",
-            "--stream",
-            "--budget-s", str(int(budget - 10)),
-            *kernel_args,
-        ],
-        budget,
-        {},
+def _case_has_numbers(case) -> bool:
+    """True when one kernel case carries a real timing (an ``ms`` side)
+    — a skipped/errored case does not."""
+    return isinstance(case, dict) and any(
+        isinstance(side, dict) and side.get("ms")
+        for side in case.values()
     )
-    if report is None:
+
+
+def _has_kernel_numbers(report) -> bool:
+    """True when at least one case carries a real timing — a report
+    whose cases are all skipped/errored, or a harvested devices_up
+    partial with empty kernels, is not capture."""
+    if not isinstance(report, dict):
+        return False
+    return any(
+        _case_has_numbers(c) for c in (report.get("kernels") or {}).values()
+    )
+
+
+def _merge_kernels(micro: dict, full: dict) -> dict:
+    """Full-tier cases override their micro twins (more iters, longer
+    scans) — but never with a skipped/errored entry when the micro tier
+    already measured that case: a captured number is exactly what the
+    sub-window design exists to preserve."""
+    merged = dict(micro)
+    for name, case in full.items():
+        if (
+            name in merged
+            and _case_has_numbers(merged[name])
+            and not _case_has_numbers(case)
+        ):
+            continue
+        merged[name] = case
+    return merged
+
+
+KERNEL_WINDOW_S = float(os.environ.get("BENCH_KERNEL_WINDOW_S", "30"))
+KERNEL_MAX_ATTEMPTS = int(os.environ.get("BENCH_KERNEL_MAX_ATTEMPTS", "8"))
+
+
+def run_kernels(grant_ok: bool = True) -> dict:
+    """Kernel phase on its reserved slice, restructured for grant
+    capture (VERDICT r4 #1): the round-4 shape was ONE subprocess
+    holding the whole remaining budget, so a backend stall on a held
+    chip consumed the entire slice and a window opening a second later
+    was lost. Now the slice is spent in sub-windows:
+
+      1. loop: run the ~15 s MICRO tier (bare-matmul anchor + one
+         flash-vs-dense at seq 2048, streamed immediately) under a
+         ~30 s window timeout; a stall costs one window, not the slice,
+         and each attempt doubles as a grant probe;
+      2. once any window yields real kernel numbers, spend whatever
+         budget remains on the FULL tier and merge (full-tier cases
+         override their micro twins — more iters, longer scans).
+
+    Runs even when the smoke's probe loop never got a grant — a window
+    may open during the slice. Every attempt is recorded in the
+    artifact (``attempts``), so a no-capture round proves what it
+    tried, per-window."""
+    kernel_args = os.environ.get("BENCH_KERNEL_ARGS", "").split()
+    attempts = []
+    micro = None
+    while len(attempts) < KERNEL_MAX_ATTEMPTS:
+        left = _budget_left() - 5
+        if left < 20:
+            break
+        window = min(KERNEL_WINDOW_S, left)
+        t0 = time.monotonic()
+        report, err = _run_accel_subprocess(
+            [
+                "k8s_device_plugin_tpu.ops.microbench",
+                "--stream", "--tier", "micro",
+                "--budget-s", str(int(window - 5)),
+                *kernel_args,
+            ],
+            window,
+            {},
+        )
+        took = round(time.monotonic() - t0, 1)
+        if _has_kernel_numbers(report):
+            attempts.append({"ok": True, "tier": "micro", "took_s": took})
+            micro = report
+            break
+        attempts.append({
+            "ok": False, "tier": "micro", "took_s": took,
+            "error": (err or "report without kernel numbers")[:200],
+        })
+        if took < 5:
+            # A fast failure (bad import, instant rc!=0) is not chip
+            # contention — spinning through the slice would spawn
+            # hundreds of doomed subprocesses. Brief pause; the attempt
+            # cap bounds the artifact either way.
+            time.sleep(3)
+    if micro is None:
+        if not attempts:
+            return {"skipped": f"budget exhausted ({_budget_left():.0f}s left)"}
+        msg = "no kernel numbers: every sub-window stalled before devices"
         if not grant_ok:
-            err = (
-                f"{err} — no grant window all round; the microbench "
-                "never reached devices (chip held by a co-tenant)"
+            msg += (
+                " (no grant window all round; chip held by a co-tenant)"
             )
-        return {"error": err}
-    return report
+        return {"error": msg, "attempts": attempts}
+
+    # Micro capture in hand — the remaining budget buys the full tier.
+    left = _budget_left() - 5
+    if left >= 45:
+        t0 = time.monotonic()
+        full, err = _run_accel_subprocess(
+            [
+                "k8s_device_plugin_tpu.ops.microbench",
+                "--stream",
+                "--budget-s", str(int(left - 10)),
+                *kernel_args,
+            ],
+            left,
+            {},
+        )
+        took = round(time.monotonic() - t0, 1)
+        if _has_kernel_numbers(full):
+            attempts.append({"ok": True, "tier": "full", "took_s": took})
+            full["kernels"] = _merge_kernels(
+                micro["kernels"], full["kernels"]
+            )
+            full["attempts"] = attempts
+            return full
+        attempts.append({
+            "ok": False, "tier": "full", "took_s": took,
+            "error": (err or "report without kernel numbers")[:200],
+        })
+    micro["attempts"] = attempts
+    return micro
 
 
 def main() -> int:

@@ -281,17 +281,35 @@ def test_microbench_tiny_shapes_reports_all_cases():
     from k8s_device_plugin_tpu.ops.microbench import run_microbench
 
     r = run_microbench(iters=1, seqs=[128], rmsnorm_shape=(64, 128),
-                       inner=1)
+                       inner=1, matmul_n=256)
     assert r["backend"] == "cpu"
     k = r["kernels"]
     assert set(k) == {
-        "attention_seq128", "attention_agreement", "xent_64x32x128",
-        "rmsnorm_64x128",
+        "matmul_256", "attention_seq128", "attention_agreement",
+        "xent_64x32x128", "rmsnorm_64x128",
     }
     assert k["xent_64x32x128"]["ok"] is True
     assert k["attention_agreement"]["ok"] is True
     assert "speedup_vs_dense" in k["attention_seq128"]
     assert "speedup_vs_xla" in k["rmsnorm_64x128"]
+    assert r["ok"] is True
+
+
+def test_microbench_micro_tier_is_the_grant_window_capture():
+    """The micro tier (VERDICT r4 #1b) must be exactly the three cheap
+    cases — matmul anchor, one flash-vs-dense at the shortest seq, the
+    agreement honesty check — with the matmul FIRST, so a kill partway
+    through a brief grant window still leaves the anchor number."""
+    from k8s_device_plugin_tpu.ops.microbench import run_microbench
+
+    r = run_microbench(iters=1, seqs=[128], inner=1, tier="micro",
+                       matmul_n=256)
+    assert r["tier"] == "micro"
+    assert list(r["kernels"]) == [
+        "matmul_256", "attention_seq128", "attention_agreement",
+    ]
+    assert r["kernels"]["matmul_256"]["matmul"].get("ms") is not None
+    assert r["kernels"]["attention_agreement"]["ok"] is True
     assert r["ok"] is True
 
 

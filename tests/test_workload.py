@@ -410,6 +410,47 @@ def test_bench_workload_args_skip_flag_strips_both_forms(monkeypatch):
     assert bench.workload_args_from_env() == ["--bench", "--steps", "8"]
 
 
+def test_bench_kernel_capture_detection():
+    """run_kernels' sub-window loop advances only on REAL capture: a
+    report with an ms-bearing side counts; a harvested devices_up
+    partial (empty kernels), an all-skipped report, or an error-only
+    case must read as no-capture so the next sub-window still runs."""
+    import bench
+
+    ok = {"kernels": {"matmul_4096": {"matmul": {"ms": 0.73, "inner": 64}}}}
+    assert bench._has_kernel_numbers(ok)
+    assert not bench._has_kernel_numbers(None)
+    assert not bench._has_kernel_numbers({"ok": None, "kernels": {}})
+    assert not bench._has_kernel_numbers(
+        {"kernels": {"matmul_4096": {"skipped": "budget exhausted"}}}
+    )
+    assert not bench._has_kernel_numbers(
+        {"kernels": {"attention_seq2048": {
+            "flash": {"error": "RESOURCE_EXHAUSTED"}}}}
+    )
+
+
+def test_bench_kernel_merge_never_clobbers_captured_numbers():
+    """The full tier overrides micro twins when it measured them — but a
+    budget-skipped or errored full-tier entry must NOT erase a number
+    the micro window already captured."""
+    import bench
+
+    micro = {
+        "matmul_4096": {"matmul": {"ms": 0.73}},
+        "attention_seq2048": {"flash": {"ms": 2.5}, "dense": {"ms": 5.0}},
+    }
+    full = {
+        "matmul_4096": {"matmul": {"ms": 0.71}},  # re-measured: wins
+        "attention_seq2048": {"skipped": "budget exhausted"},  # loses
+        "rmsnorm_8192x4096": {"pallas": {"ms": 0.4}},  # new: added
+    }
+    merged = bench._merge_kernels(micro, full)
+    assert merged["matmul_4096"]["matmul"]["ms"] == 0.71
+    assert merged["attention_seq2048"]["flash"]["ms"] == 2.5
+    assert "rmsnorm_8192x4096" in merged
+
+
 def test_bench_is_box_helper():
     """bench.py's placement-shape proof: exact sub-box tilings pass,
     scattered or duplicate picks fail."""
