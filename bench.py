@@ -397,17 +397,32 @@ def _has_kernel_numbers(report) -> bool:
     )
 
 
+def _case_captured(case) -> bool:
+    """A case worth preserving in a merge: it measured something (an
+    ms-bearing side) or delivered a verdict (the agreement check's
+    ``ok``) — as opposed to a skip/error marker."""
+    if _case_has_numbers(case):
+        return True
+    return (
+        isinstance(case, dict)
+        and "ok" in case
+        and "skipped" not in case
+        and "error" not in case
+    )
+
+
 def _merge_kernels(micro: dict, full: dict) -> dict:
     """Full-tier cases override their micro twins (more iters, longer
     scans) — but never with a skipped/errored entry when the micro tier
-    already measured that case: a captured number is exactly what the
-    sub-window design exists to preserve."""
+    already captured that case (timings AND the agreement verdict): a
+    captured result is exactly what the sub-window design exists to
+    preserve."""
     merged = dict(micro)
     for name, case in full.items():
         if (
             name in merged
-            and _case_has_numbers(merged[name])
-            and not _case_has_numbers(case)
+            and _case_captured(merged[name])
+            and not _case_captured(case)
         ):
             continue
         merged[name] = case

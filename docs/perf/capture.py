@@ -1,0 +1,227 @@
+"""Grant-watcher: capture raw perf artifacts when the shared chip frees.
+
+The attached TPU is tunnel-shared with co-tenants whose holds last
+hours (docs/round4-notes.md); driver bench windows have missed every
+grant so far (VERDICT r4 missing #1). This watcher is the other half of
+the round-5 strategy: probe on a short cadence, and the moment a window
+opens run the capture suite cheapest-first, streaming each step's full
+stdout to ``raw/`` so a window that closes mid-suite keeps everything
+finished so far. See docs/perf/README.md for the artifact standard.
+
+    python docs/perf/capture.py            # watch + capture until done
+    python docs/perf/capture.py --once     # single probe + capture pass
+
+State: ``raw/state.json`` marks completed steps (never re-run);
+``raw/GRANT_ACTIVE`` exists while a capture is in flight so interactive
+work can keep the host quiet; ``raw/fingerprint.jsonl`` gets one entry
+per step with UTC time, device kind, loadavg, and jax version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.abspath(os.path.join(HERE, "..", ".."))
+RAW = os.path.join(HERE, "raw")
+STATE = os.path.join(RAW, "state.json")
+SENTINEL = os.path.join(RAW, "GRANT_ACTIVE")
+FPRINT = os.path.join(RAW, "fingerprint.jsonl")
+
+PROBE_TIMEOUT_S = 75
+PROBE_SLEEP_S = 150
+ROUND = os.environ.get("CAPTURE_ROUND", "r5")
+
+_PROBE = (
+    "import json, time\n"
+    "t = time.monotonic()\n"
+    "import jax\n"
+    "d = jax.devices()\n"
+    "print(json.dumps({'ok': len(d) > 0, 'devices': len(d),"
+    " 'device_kind': d[0].device_kind if d else '',"
+    " 'probe_s': round(time.monotonic() - t, 1)}), flush=True)\n"
+)
+
+# (name, argv-after-python, timeout_s) — cheapest/most-valuable first.
+STEPS = [
+    (
+        "microbench-micro",
+        ["-m", "k8s_device_plugin_tpu.ops.microbench",
+         "--stream", "--tier", "micro"],
+        100,
+    ),
+    (
+        "kvsweep-2048",
+        ["-m", "k8s_device_plugin_tpu.tools.kv_sweep", "--seqs", "2048",
+         "--blocks", "512x512,512x1024,1024x1024,2048x1024,1024x2048"],
+        240,
+    ),
+    (
+        "kvsweep-8192",
+        ["-m", "k8s_device_plugin_tpu.tools.kv_sweep", "--seqs", "8192",
+         "--blocks", "512x512,512x1024,1024x1024"],
+        300,
+    ),
+    (
+        "microbench-full",
+        ["-m", "k8s_device_plugin_tpu.ops.microbench", "--stream",
+         "--budget-s", "280"],
+        320,
+    ),
+    ("bench", ["bench.py"], 320),
+    (
+        "smoke-mfu-2",
+        ["-m", "k8s_device_plugin_tpu.workload.smoke", "--bench",
+         "--steps", "80", "--batch-per-device", "4",
+         "--inner-steps", "40"],
+        240,
+    ),
+    (
+        "smoke-mfu-3",
+        ["-m", "k8s_device_plugin_tpu.workload.smoke", "--bench",
+         "--steps", "80", "--batch-per-device", "4",
+         "--inner-steps", "40"],
+        240,
+    ),
+]
+
+
+def _load_state() -> dict:
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"done": []}
+
+
+def _save_state(state: dict) -> None:
+    with open(STATE, "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def _fingerprint(step: str, extra: dict) -> None:
+    entry = {
+        "step": step,
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "loadavg": list(os.getloadavg()),
+        **extra,
+    }
+    with open(FPRINT, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def probe() -> dict:
+    env = dict(os.environ)
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _PROBE], capture_output=True,
+            text=True, timeout=PROBE_TIMEOUT_S, env=env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "why": f"probe timeout {PROBE_TIMEOUT_S}s"}
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            r = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(r, dict) and "ok" in r:
+            return r
+    return {"ok": False, "why": f"rc={p.returncode}"}
+
+
+def run_step(name: str, argv: list, timeout_s: float) -> bool:
+    """Stream one step's stdout straight to its raw file (a kill keeps
+    partials); True when the file ends with a parseable JSON line."""
+    ts = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+    out_path = os.path.join(RAW, f"{ROUND}-{name}-{ts}.jsonl")
+    err_path = out_path[:-6] + ".err"
+    env = dict(os.environ)
+    env.setdefault(
+        "TPU_WORKLOAD_COMPILATION_CACHE_DIR",
+        os.path.join(REPO, ".jax_compilation_cache"),
+    )
+    _fingerprint(name, {"raw": os.path.basename(out_path)})
+    with open(out_path, "w") as out, open(err_path, "w") as err:
+        proc = subprocess.Popen(
+            [sys.executable, *argv], stdout=out, stderr=err,
+            cwd=REPO, env=env,
+        )
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    try:
+        with open(out_path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        last = json.loads(lines[-1]) if lines else None
+    except (OSError, ValueError):
+        last = None
+    ok = isinstance(last, dict)
+    print(f"[capture] {name}: {'ok' if ok else 'NO REPORT'} "
+          f"-> {os.path.basename(out_path)}", flush=True)
+    return ok
+
+
+def capture_pass(state: dict) -> bool:
+    """Run every not-yet-done step; returns True when all are done."""
+    for name, argv, timeout_s in STEPS:
+        if name in state["done"]:
+            continue
+        # Re-probe between steps: if the window closed, stop burning
+        # timeouts against a held chip (the probe itself is cheap).
+        p = probe()
+        if not p.get("ok"):
+            print(f"[capture] window closed before {name}", flush=True)
+            return False
+        if run_step(name, argv, timeout_s):
+            state["done"].append(name)
+            _save_state(state)
+    return True
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--once", action="store_true",
+                   help="one probe+capture pass, then exit")
+    p.add_argument("--max-hours", type=float, default=10.5)
+    args = p.parse_args(argv)
+    os.makedirs(RAW, exist_ok=True)
+    state = _load_state()
+    t0 = time.monotonic()
+    while True:
+        if all(n in state["done"] for n, _, _ in STEPS):
+            print("[capture] suite complete", flush=True)
+            return 0
+        r = probe()
+        if r.get("ok"):
+            _fingerprint("grant", r)
+            print(f"[capture] GRANT {r}", flush=True)
+            open(SENTINEL, "w").close()
+            try:
+                done = capture_pass(state)
+            finally:
+                try:
+                    os.unlink(SENTINEL)
+                except OSError:
+                    pass
+            if done:
+                print("[capture] suite complete", flush=True)
+                return 0
+        else:
+            print(f"[capture] no grant: {r.get('why', '')}", flush=True)
+        if args.once:
+            return 1
+        if (time.monotonic() - t0) > args.max_hours * 3600:
+            print("[capture] max watch time reached", flush=True)
+            return 1
+        time.sleep(PROBE_SLEEP_S)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

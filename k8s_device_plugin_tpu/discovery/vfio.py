@@ -63,6 +63,42 @@ DEFAULT_DEV_VFIO = "/dev/vfio"
 CONTAINER_NODE = "vfio"
 
 
+def _pci_config_live(devdir: str) -> "Optional[bool]":
+    """Live PCI config-space probe (VERDICT r4 #5): the first two bytes
+    of sysfs ``config`` are the vendor id read from the DEVICE on each
+    access (the ``vendor`` attribute is cached at enumeration time, so
+    it stays plausible after the hardware dies). A device that fell off
+    the bus master-aborts config reads, which the root complex returns
+    as all-ones. Returns True (alive), False (fell off the bus /
+    config unreadable), or None (no probe possible: attribute absent on
+    this tree, or permissions deny it — e.g. a container's restricted
+    /sys — where flagging every chip dead would be a false mass
+    withdrawal)."""
+    path = os.path.join(devdir, "config")
+    try:
+        with open(path, "rb") as f:
+            raw = f.read(2)
+    except (FileNotFoundError, PermissionError):
+        return None
+    except OSError:
+        return False  # EIO & friends: the read itself is the signal
+    if len(raw) < 2:
+        return None
+    return raw != b"\xff\xff"
+
+
+def _warn_multi_function_group(group: int, func_names) -> None:
+    """One shared diagnostic for the ACS-off case (a group holding
+    several TPU functions advertised as ONE device) — emitted by the
+    Python walker inline and by ``NativeVfioTpuInfo`` post-scan, so the
+    native path has observability parity (ADVICE r4)."""
+    log.warning(
+        "IOMMU group %d holds %d TPU functions (%s); advertising it as "
+        "ONE device — the group node is the isolation boundary",
+        group, len(func_names), ", ".join(func_names),
+    )
+
+
 class VfioTpuInfo:
     """vfio-layout scanner; duck-compatible with PyTpuInfo/NativeTpuInfo
     with (iommu_groups_dir, dev_vfio_dir) as the directory pair."""
@@ -106,6 +142,13 @@ class VfioTpuInfo:
             entries = os.listdir(iommu_groups_dir)
         except FileNotFoundError:
             return []  # not a vfio host: 0 chips, never a crash
+        except OSError as e:
+            # EACCES / ENOTDIR (e.g. a container with a restricted /sys
+            # mount): the documented contract is 0 chips, never a crash
+            # (ADVICE r4) — the daemon's run loop contained this, but
+            # the topo CLI would traceback.
+            log.warning("cannot scan %s (%s); 0 chips", iommu_groups_dir, e)
+            return []
         chips = []
         for name in entries:
             if not name.isdigit():
@@ -115,12 +158,7 @@ class VfioTpuInfo:
             if not funcs:
                 continue
             if len(funcs) > 1:
-                log.warning(
-                    "IOMMU group %d holds %d TPU functions (%s); "
-                    "advertising it as ONE device — the group node is "
-                    "the isolation boundary",
-                    group, len(funcs), ", ".join(f[0] for f in funcs),
-                )
+                _warn_multi_function_group(group, [f[0] for f in funcs])
             dev_name, devdir, device = funcs[0]
             chip_type = DEVICE_ID_TO_TYPE[device]
             spec = spec_for(chip_type)
@@ -170,6 +208,13 @@ class VfioTpuInfo:
         if not os.path.exists(os.path.join(dev_vfio_dir, str(index))):
             return False, "dev_node_missing"
         for _, devdir, _ in self._tpu_device_dirs(iommu_groups_dir, index):
+            # Config-space liveness first (VERDICT r4 #5): a device off
+            # the bus can leave a stale-"ok" health attribute behind,
+            # and real vfio-bound PCI dirs may expose no health
+            # attribute at all — this probe is the one signal that
+            # works on both.
+            if _pci_config_live(devdir) is False:
+                return False, "pci_config_read_failed"
             health = os.path.join(devdir, "health")
             if os.path.exists(health):
                 token = _read_bytes_trimmed(health)
@@ -237,10 +282,19 @@ class NativeVfioTpuInfo:
         return self._inner.version() + "+vfio"
 
     def scan(self, iommu_groups_dir: str, dev_vfio_dir: str) -> List[TpuChip]:
+        import errno as _errno
+
         buf = (self._cchip * self._max)()
         n = self._lib.tpuinfo_scan_vfio(
             iommu_groups_dir.encode(), dev_vfio_dir.encode(), buf, self._max
         )
+        if -n in (_errno.EACCES, _errno.ENOTDIR, _errno.EPERM):
+            # Same contract as the Python walker (ADVICE r4): a
+            # restricted /sys mount is 0 chips + a warning, not a crash.
+            log.warning(
+                "cannot scan %s (errno %d); 0 chips", iommu_groups_dir, -n
+            )
+            return []
         if n < 0:
             raise OSError(-n, f"tpuinfo_scan_vfio({iommu_groups_dir}) failed")
         chips = []
@@ -259,6 +313,15 @@ class NativeVfioTpuInfo:
                     core_count=c.core_count,
                 )
             )
+        # Observability parity with the Python walker (ADVICE r4): the
+        # C ABI has no logging channel, so the ACS-off multi-function
+        # diagnostic is re-derived here — one extra listdir per scanned
+        # group, only on the vfio layout.
+        walker = VfioTpuInfo()
+        for chip in chips:
+            funcs = walker._tpu_device_dirs(iommu_groups_dir, chip.index)
+            if len(funcs) > 1:
+                _warn_multi_function_group(chip.index, [f[0] for f in funcs])
         return chips
 
     def chip_health(

@@ -79,6 +79,10 @@ class PluginConfig:
     # opens the shared /dev/vfio/vfio container device in addition to
     # its per-chip /dev/vfio/<group> nodes.
     extra_device_paths: tuple = ()
+    # Which devfs layout enumerated the chips ("accel" or "vfio", set by
+    # the daemon's layout detection). Allocate's env differs: see
+    # _tpu_env on TPU_VISIBLE_CHIPS.
+    devfs_layout: str = "accel"
     # CDI (Container Device Interface, k8s >= 1.26): when set (e.g.
     # "google.com/tpu"), Allocate additionally returns fully-qualified CDI
     # device names "<kind>=<chip id>" so CDI-aware runtimes do the device
@@ -546,14 +550,16 @@ class TpuDevicePlugin(DevicePluginServicer):
         these through libtpu. Bounds are the bounding box of the allocated
         coords when the set is an exact sub-box, else the full host bounds.
 
-        TPU_VISIBLE_CHIPS carries chip.index — the devfs-relative value
-        (accelN number on the accel layout; IOMMU group number on vfio).
-        On the accel layout that matches libtpu's 0-based expectation
-        because accel indexes are host-ordinal. On vfio the runtime
-        enumerates from the injected group nodes themselves, and what it
-        does with VISIBLE_CHIPS group numbers is unverified on real
-        hardware (docs/round4-notes.md "Known open items") — the device
-        nodes, not this env, are the binding mechanism there.
+        TPU_VISIBLE_CHIPS carries chip.index on the accel layout, where
+        accel indexes are host-ordinal and match libtpu's 0-based
+        expectation. On the vfio layout chip.index is the IOMMU group
+        number — NOT a dense 0-based ordinal — and libtpu's reading of
+        group numbers is unverified on real hardware (docs/
+        round4-notes.md "Known open items"), so the env var is OMITTED
+        there (ADVICE r4): the injected /dev/vfio/<group> nodes are the
+        binding mechanism, the runtime enumerates exactly the chips it
+        can open, and a wrong index list could misconfigure or crash
+        it. Revisit when real-vfio semantics are observed.
         """
         cfg = self.config
         whole_host = len(chips) == len(self.mesh.mesh_chips)
@@ -568,15 +574,16 @@ class TpuDevicePlugin(DevicePluginServicer):
             "TPU_HOST_BOUNDS": (
                 cfg.slice_host_bounds if multi_host else "1,1,1"
             ),
-            "TPU_VISIBLE_CHIPS": ",".join(
-                str(mc.chip.index) for mc in chips
-            ),
             "TPU_ACCELERATOR_TYPE": self._accelerator_type(
                 len(chips) * n_hosts
             ),
             "TPU_WORKER_ID": str(cfg.worker_id if multi_host else 0),
             "TPU_SKIP_MDS_QUERY": "true",
         }
+        if cfg.devfs_layout != "vfio":
+            env["TPU_VISIBLE_CHIPS"] = ",".join(
+                str(mc.chip.index) for mc in chips
+            )
         if multi_host:
             env["TPU_WORKER_HOSTNAMES"] = cfg.worker_hostnames
         return env

@@ -279,9 +279,10 @@ class Daemon:
                 log.warning("slice membership derivation failed: %s", e)
         from ..discovery.vfio import CONTAINER_NODE
 
+        is_vfio = self.backend is not self._accel_backend
         extra_devs = (
             (os.path.join(self.scan_dirs[1], CONTAINER_NODE),)
-            if self.backend is not self._accel_backend  # vfio layout
+            if is_vfio
             else ()
         )
         self.plugin = TpuDevicePlugin(
@@ -299,6 +300,7 @@ class Daemon:
                 registration_mode=self.cfg.registration_mode,
                 plugins_registry_dir=self.cfg.plugins_registry_dir,
                 extra_device_paths=extra_devs,
+                devfs_layout="vfio" if is_vfio else "accel",
             ),
         )
         if chips:

@@ -597,6 +597,32 @@ int VfioChipHealthImpl(const char* iommu_groups_dir, const char* dev_vfio_dir,
    * enable=0 — the accel rule would deadlock every unallocated chip
    * Unhealthy. (gasket/accel enables at probe time; safe there.) */
   for (const TpuFunc& f : TpuFuncsInGroup(iommu_groups_dir, group)) {
+    /* Config-space liveness first (mirrors VfioTpuInfo semantics): the
+     * first two bytes of sysfs `config` are the vendor id read from
+     * the DEVICE (the `vendor` attribute is cached at enumeration); a
+     * device off the bus master-aborts the read and the root complex
+     * returns all-ones. ENOENT/EACCES mean "no probe possible" (older
+     * trees, restricted /sys) — skip rather than mass-withdraw; any
+     * other open/read failure IS the signal. */
+    std::string cfg_path = f.devdir + "/config";
+    errno = 0;
+    FILE* cf = std::fopen(cfg_path.c_str(), "rb");
+    if (cf != nullptr) {
+      unsigned char b2[2];
+      size_t got = std::fread(b2, 1, 2, cf);
+      int rderr = std::ferror(cf);
+      std::fclose(cf);
+      if ((got == 2 && b2[0] == 0xff && b2[1] == 0xff) ||
+          (got < 2 && rderr != 0)) {
+        if (reason) *reason = "pci_config_read_failed";
+        return 0;
+      }
+    } else if (errno != ENOENT && errno != EACCES && errno != EPERM) {
+      /* EACCES/EPERM both mean "restricted /sys, no probe possible"
+       * (Python's PermissionError covers both) — not a dead device. */
+      if (reason) *reason = "pci_config_read_failed";
+      return 0;
+    }
     std::string health_path = f.devdir + "/health";
     if (PathExists(health_path)) {
       std::string h = ReadTrimmed(health_path);

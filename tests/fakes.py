@@ -94,6 +94,14 @@ def make_fake_vfio_node(
         _write(devdir, "device", f"0x{device_id:04x}")
         _write(devdir, "numa_node", str(numa_of(i)))
         _write(devdir, "uevent", f"DRIVER=vfio-pci\nPCI_SLOT_NAME={pci}\n")
+        # PCI config space header: vendor id 0x1ae0 little-endian, then
+        # device id — the liveness probe reads the first two bytes.
+        with open(os.path.join(devdir, "config"), "wb") as f:
+            f.write(
+                b"\xe0\x1a"
+                + device_id.to_bytes(2, "little")
+                + b"\x00" * 60
+            )
         with open(os.path.join(dev_vfio, str(group)), "w") as f:
             f.write("")
     os.makedirs(groups_dir, exist_ok=True)
@@ -109,6 +117,16 @@ def set_vfio_chip_health(
     for name in os.listdir(devs):
         _write(os.path.join(devs, name), "health",
                "ok" if healthy else reason)
+
+
+def set_vfio_pci_dead(groups_dir: str, group: int, dead: bool = True):
+    """Simulate the chip falling off the PCI bus: config-space reads
+    master-abort and return all-ones (what the vfio liveness probe
+    detects); ``dead=False`` restores a live vendor id."""
+    devs = os.path.join(groups_dir, str(group), "devices")
+    for name in os.listdir(devs):
+        with open(os.path.join(devs, name, "config"), "wb") as f:
+            f.write(b"\xff" * 64 if dead else b"\xe0\x1a" + b"\x00" * 62)
 
 
 def make_fake_proc(root: str, cpus: int = 4, sockets: int = 2,

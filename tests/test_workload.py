@@ -450,6 +450,13 @@ def test_bench_kernel_merge_never_clobbers_captured_numbers():
     assert merged["attention_seq2048"]["flash"]["ms"] == 2.5
     assert "rmsnorm_8192x4096" in merged
 
+    # The agreement VERDICT (no ms sides, just ok) is capture too — a
+    # budget-skipped full-tier entry must not erase it.
+    micro = {"attention_agreement": {"max_abs_diff": 0.001, "ok": True}}
+    full = {"attention_agreement": {"skipped": "budget exhausted"}}
+    assert bench._merge_kernels(micro, full)[
+        "attention_agreement"]["ok"] is True
+
 
 def test_bench_is_box_helper():
     """bench.py's placement-shape proof: exact sub-box tilings pass,
