@@ -182,7 +182,10 @@ def capture_pass(state: dict) -> bool:
         if run_step(name, argv, timeout_s):
             state["done"].append(name)
             _save_state(state)
-    return True
+    # A step can fail without closing the window (crash, no report) —
+    # "complete" means every step actually landed, not that the loop
+    # finished; incomplete steps get retried on the next grant.
+    return all(n in state["done"] for n, _, _ in STEPS)
 
 
 def main(argv=None) -> int:

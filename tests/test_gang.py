@@ -406,7 +406,7 @@ def test_explain_reports_every_gang_state(api, tmp_path):
         env=env,
     )
     assert out.returncode == 0, out.stderr
-    parsed = {r["gang"]: r for r in _json.loads(out.stdout)}
+    parsed = {r["gang"]: r for r in _json.loads(out.stdout)["gangs"]}
     assert set(parsed) == {"incomplete", "blocked", "fits", "released"}
 
 

@@ -424,7 +424,9 @@ def test_reservations_endpoint_and_cli_injection(api, tmp_path):
     )
     url = srv.start()
     try:
-        snap = rq.get(f"{url}/reservations", timeout=5).json()
+        payload = rq.get(f"{url}/reservations", timeout=5).json()
+        assert payload["holder"] == ""  # fence not enabled on this srv
+        snap = payload["holds"]
         assert snap[0]["gang"] == "alpha" and snap[0]["hosts"] == {"n1": 4}
 
         kubeconfig = tmp_path / "kubeconfig"
@@ -451,7 +453,9 @@ def test_reservations_endpoint_and_cli_injection(api, tmp_path):
                 env=env,
             )
             assert out.returncode == 0, out.stderr
-            return {r["gang"]: r for r in _json.loads(out.stdout)}
+            return {
+                r["gang"]: r for r in _json.loads(out.stdout)["gangs"]
+            }
 
         with_holds = run_cli("--extender-url", url)
         assert with_holds["beta"]["status"].startswith("blocked"), (

@@ -2,42 +2,80 @@
 
 Measured on the build machine (2026-07, Python 3.12) at 1,000 nodes /
 100 gangs, warm annotation/score caches: filter p50 ~6 ms, prioritize
-p50 ~7 ms, steady tick ~9 ms, full admission tick ~61 ms (copy-on-write
-_fits); p99s absorb the cold first call (~50-120 ms — parse + mesh
-build, cached thereafter). Bounds below carry generous headroom for
-slower CI hosts — they exist to catch algorithmic regressions (an
-accidental O(N²) rescore, per-gang full-view cloning creeping back into
-_fits, a lost cache), not to benchmark the host.
+p50 ~7 ms, steady tick ~7-9 ms, full admission tick ~61 ms
+(copy-on-write _fits); the cold first call (parse + mesh build of every
+annotation) is ~50-120 ms and is now measured SEPARATELY — VERDICT r4
+#4: the old bounds (p99 < 700 ms, min-of-two runs) were ~100x looser
+than measured and the cold spike polluted the warm distribution, so a
+10x hot-path regression would have passed silently.
+
+Bounds: warm p50 at ~10x measured (the regression tripwire), warm p99
+within 3x p50 (VERDICT r4 #7 — no unexplained spikes in the production
+path), cold bounded generously on its own. A full re-run is allowed
+once for host-contention flake (a parallel shard, a co-tenant build) —
+a real algorithmic regression fails both complete runs; there is no
+per-metric min-merging, so a run must pass every bound TOGETHER.
 """
 
 from k8s_device_plugin_tpu.extender import scale_bench
 
+WARM_P50_BOUNDS_MS = {
+    "filter": 60,
+    "prioritize": 70,
+    "gang_tick_steady": 100,
+    "gang_tick_full": 700,
+}
+# p99-to-p50 spike guard for the per-RPC paths. The absolute floor
+# absorbs scheduler jitter on loaded CI hosts (p99 of ~20 samples is
+# the max sample); the r4-artifact failure mode this exists to catch
+# was a 21x ratio.
+WARM_SPIKE_RATIO = 3.0
+WARM_SPIKE_FLOOR_MS = 30.0
+COLD_BOUND_MS = 2000.0
+
+
+def _check(r) -> list:
+    problems = []
+    for k, bound in WARM_P50_BOUNDS_MS.items():
+        if r[k]["p50_ms"] >= bound:
+            problems.append(f"{k} p50 {r[k]['p50_ms']}ms >= {bound}ms")
+    for k in ("filter", "prioritize"):
+        limit = max(WARM_SPIKE_RATIO * r[k]["p50_ms"], WARM_SPIKE_FLOOR_MS)
+        if r[k]["p99_ms"] >= limit:
+            problems.append(
+                f"{k} warm p99 {r[k]['p99_ms']}ms >= {limit:.0f}ms "
+                f"(p50 {r[k]['p50_ms']}ms)"
+            )
+    cold = r["cold_first_call"]
+    for k in ("filter_ms", "prioritize_ms"):
+        if cold[k] >= COLD_BOUND_MS:
+            problems.append(f"cold {k} {cold[k]}ms >= {COLD_BOUND_MS}ms")
+    return problems
+
 
 def test_scale_bench_bounds_at_full_scale():
-    """Bounds are asserted on the best of two attempts: a single run
-    can blow even 100x-headroom bounds when the host is contended (a
-    parallel test shard, a co-tenant build), and wall-clock flake
-    teaches nothing — a real algorithmic regression fails both."""
-    bounds = {
-        "filter": 700,
-        "prioritize": 1300,
-        "gang_tick_full": 1500,
-        "gang_tick_steady": 1000,
-    }
     last = None
-    for _ in range(2):
-        r = scale_bench.run(n_nodes=1000, n_gangs=100, filter_calls=9,
+    for attempt in range(2):
+        r = scale_bench.run(n_nodes=1000, n_gangs=100, filter_calls=20,
                             tick_rounds=2)
         assert r["nodes"] == 1000 and r["gangs"] == 100
-        if last is None:
-            last = r
-        else:
-            for k in bounds:
-                last[k]["p99_ms"] = min(last[k]["p99_ms"], r[k]["p99_ms"])
-        if all(last[k]["p99_ms"] < v for k, v in bounds.items()):
-            break
-    for k, v in bounds.items():
-        assert last[k]["p99_ms"] < v, last
+        last = _check(r), r
+        if not last[0]:
+            return
+    assert not last[0], last
+
+
+def test_scale_bench_cold_is_separated_from_warm():
+    """The artifact must carry the cold first call on its own (VERDICT
+    r4 #4) — and the warm distribution must not contain it: with the
+    parse LRU flushed inside run(), warm p99 staying under the spike
+    guard IS the separation proof at full scale; here a tiny run just
+    pins the schema."""
+    r = scale_bench.run(n_nodes=20, n_gangs=5, filter_calls=3,
+                        tick_rounds=1)
+    cold = r["cold_first_call"]
+    assert cold["filter_ms"] > 0 and cold["prioritize_ms"] > 0
+    assert r["filter"]["samples"] == 3
 
 
 def test_scale_bench_correctness_assertions_fire():
