@@ -46,6 +46,7 @@ import time
 from typing import Callable, Optional
 
 from ..kube.client import KubeError, rfc3339_now
+from ..utils import metrics
 
 log = logging.getLogger(__name__)
 
@@ -199,6 +200,7 @@ class LeaderLease:
 
     def start(self) -> "LeaderLease":
         self.acquire()
+        metrics.LEASE_HELD.set(1)
         self._thread = threading.Thread(
             target=self._renew_loop, name="extender-lease", daemon=True
         )
@@ -218,12 +220,14 @@ class LeaderLease:
                 self._renew_once()
             except SecondReplica as e:
                 log.error("lease lost: %s", e)
+                metrics.LEASE_HELD.set(0)
                 if self.on_lost is not None:
                     self.on_lost()
                 return
             except Exception as e:  # noqa: BLE001 — transient apiserver
                 # noise must not kill the admitter: until the lease
                 # duration passes unrenewed nobody else can take it.
+                metrics.LEASE_RENEWAL_ERRORS.inc()
                 log.warning("lease renewal failed (will retry): %s", e)
 
     def _renew_once(self) -> None:

@@ -256,6 +256,19 @@ NODE_CACHE_RELIST_ERRORS = EXTENDER_REGISTRY.counter(
     "tpu_extender_node_cache_relist_errors_total",
     "Node relists that failed (cache serves stale entries meanwhile)",
 )
+LEASE_HELD = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_lease_held",
+    "1 while this replica holds the single-admitter lease "
+    "(extender/leader.py); 0 before acquisition, after loss, or with "
+    "the fence disabled — alert if no replica exports 1 while gang "
+    "admission is expected to run",
+)
+LEASE_RENEWAL_ERRORS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_lease_renewal_errors_total",
+    "Lease renewals that failed transiently (the lease survives until "
+    "its duration passes unrenewed; sustained increase = apiserver "
+    "trouble that will end in admitter shutdown)",
+)
 
 
 class MetricsServer(BackgroundHTTPServer):

@@ -180,6 +180,33 @@ def test_second_extender_replica_exits_nonzero_e2e(api, tmp_path):
         proc.wait(timeout=10)
 
 
+def test_lease_held_metric_tracks_acquisition_and_loss(api):
+    from k8s_device_plugin_tpu.utils import metrics
+
+    server, client = api
+    ll = LeaderLease(client, identity="rep-a", lease_seconds=2.0)
+    ll.start()
+    try:
+        assert "tpu_extender_lease_held 1" in (
+            metrics.EXTENDER_REGISTRY.render()
+        )
+        from k8s_device_plugin_tpu.kube.client import rfc3339_now
+
+        def hijack():
+            with server._lock:
+                lease = server.leases[
+                    ("kube-system", "tpu-scheduler-extender")]
+                lease["spec"]["holderIdentity"] = "intruder"
+                lease["spec"]["renewTime"] = rfc3339_now()
+            return "tpu_extender_lease_held 0" in (
+                metrics.EXTENDER_REGISTRY.render()
+            )
+
+        assert _wait(hijack, 6), "lease_held never dropped to 0"
+    finally:
+        ll.stop()
+
+
 def test_gang_cli_warns_on_non_holder_snapshot(api):
     """tools/gang._check_holder: empty when holders agree or the fence
     is off; a loud warning when the snapshot's replica is not the lease
