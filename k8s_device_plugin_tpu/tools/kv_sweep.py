@@ -127,7 +127,45 @@ def run_sweep(
                 "block_kv": row["block_kv"],
             }
     report["best_by_seq"] = {str(s): v for s, v in best.items()}
+    # "Fast but wrong must not pass" (the repo's microbench rule): the
+    # winning tiling per seq feeds _resolve_blocks defaults, so verify
+    # its FORWARD against the dense oracle before anyone trusts the
+    # row. Small batch/heads keep the dense O(seq²) side affordable;
+    # the tiling (the thing under test) is exactly the winner's.
+    for seq_s, win in report["best_by_seq"].items():
+        try:
+            report.setdefault("agreement", {})[seq_s] = _agreement(
+                int(seq_s), win["block_q"], win["block_kv"], d
+            )
+        except Exception as e:  # noqa: BLE001 — typically dense OOM
+            report.setdefault("agreement", {})[seq_s] = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"
+            }
+        if emit:
+            emit(report)
+    if any(
+        isinstance(a, dict) and a.get("ok") is False
+        for a in report.get("agreement", {}).values()
+    ):
+        report["ok"] = False
     return report
+
+
+def _agreement(seq: int, block_q: int, block_kv: int, d: int) -> dict:
+    from ..ops.attention import flash_attention, reference_attention
+
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (1, 2, seq, d)
+    q = jax.random.normal(kq, shape, jnp.bfloat16)
+    k = jax.random.normal(kk, shape, jnp.bfloat16)
+    v = jax.random.normal(kv, shape, jnp.bfloat16)
+    f = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, block_q, block_kv)
+    )(q, k, v).astype(jnp.float32)
+    r = jax.jit(reference_attention)(q, k, v).astype(jnp.float32)
+    max_diff = float(jnp.max(jnp.abs(f - r)))
+    return {"max_abs_diff": round(max_diff, 5), "ok": max_diff < 0.05}
 
 
 def main(argv=None) -> int:

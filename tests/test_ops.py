@@ -341,6 +341,25 @@ def test_microbench_suspect_flag_trips_on_implausible_timing():
     ), xent
 
 
+def test_kv_sweep_rows_winner_and_agreement_guard():
+    """tools/kv_sweep on the CPU mesh: every requested tiling produces
+    a row (or an explicit error), the per-seq winner is identified, and
+    its forward is verified against the dense oracle — the sweep sets
+    kernel defaults, so a fast-but-wrong tiling must flip ok=False."""
+    from k8s_device_plugin_tpu.tools.kv_sweep import run_sweep
+
+    r = run_sweep([128], [(64, 64), (128, 128)], iters=1, inner=1,
+                  heads=2)
+    assert len(r["rows"]) == 2
+    assert {(row["block_q"], row["block_kv"]) for row in r["rows"]} == {
+        (64, 64), (128, 128),
+    }
+    win = r["best_by_seq"]["128"]
+    assert win["ms"] > 0
+    assert r["agreement"]["128"]["ok"] is True
+    assert r["ok"] is True
+
+
 def test_microbench_budget_skips_are_recorded():
     from k8s_device_plugin_tpu.ops.microbench import run_microbench
 
