@@ -430,6 +430,69 @@ def test_bench_kernel_capture_detection():
     )
 
 
+def test_bench_kernel_subwindow_loop_retries_then_upgrades(monkeypatch):
+    """run_kernels (VERDICT r4 #1): stalled micro windows are retried
+    (each recorded), the first capture upgrades to the full tier, and
+    the merged report carries the attempt history."""
+    import bench
+
+    calls = []
+    micro_report = {
+        "ok": True, "tier": "micro",
+        "kernels": {"matmul_4096": {"matmul": {"ms": 0.73}}},
+    }
+    full_report = {
+        "ok": True, "tier": "full",
+        "kernels": {"rmsnorm_8192x4096": {"pallas": {"ms": 0.4}}},
+    }
+
+    def fake_run(args, timeout_s, extra_env):
+        calls.append(args)
+        if "--tier" in args:
+            # First two micro windows stall; the third captures.
+            n_micro = sum("--tier" in c for c in calls)
+            if n_micro < 3:
+                return None, "timed out after 30s"
+            return dict(micro_report), None
+        return dict(full_report), None
+
+    monkeypatch.setattr(bench, "_run_accel_subprocess", fake_run)
+    monkeypatch.setattr(bench, "_budget_left", lambda: 200.0)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    out = bench.run_kernels(grant_ok=False)
+    kinds = [a["ok"] for a in out["attempts"]]
+    assert kinds == [False, False, True, True]
+    assert out["attempts"][2]["tier"] == "micro"
+    assert out["attempts"][3]["tier"] == "full"
+    # Merged: micro capture + full-tier addition both present.
+    assert "matmul_4096" in out["kernels"]
+    assert "rmsnorm_8192x4096" in out["kernels"]
+
+
+def test_bench_kernel_subwindow_loop_gives_up_with_named_cause(
+    monkeypatch,
+):
+    """Every window stalling must produce the honest no-capture error
+    (annotated with the no-grant cause), a bounded attempt list, and —
+    with no budget at all — the explicit budget-exhausted skip rather
+    than a stall claim for windows that never ran."""
+    import bench
+
+    monkeypatch.setattr(
+        bench, "_run_accel_subprocess",
+        lambda *a: (None, "timed out after 30s"),
+    )
+    monkeypatch.setattr(bench, "_budget_left", lambda: 1e9)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    out = bench.run_kernels(grant_ok=False)
+    assert "no grant window" in out["error"]
+    assert len(out["attempts"]) == bench.KERNEL_MAX_ATTEMPTS
+
+    monkeypatch.setattr(bench, "_budget_left", lambda: 10.0)
+    out = bench.run_kernels(grant_ok=False)
+    assert "skipped" in out and "attempts" not in out
+
+
 def test_bench_kernel_merge_never_clobbers_captured_numbers():
     """The full tier overrides micro twins when it measured them — but a
     budget-skipped or errored full-tier entry must NOT erase a number
