@@ -433,7 +433,7 @@ KERNEL_WINDOW_S = float(os.environ.get("BENCH_KERNEL_WINDOW_S", "30"))
 KERNEL_MAX_ATTEMPTS = int(os.environ.get("BENCH_KERNEL_MAX_ATTEMPTS", "8"))
 
 
-def run_kernels(grant_ok: bool = True) -> dict:
+def run_kernels(grant_ok: bool = True, emit=None) -> dict:
     """Kernel phase on its reserved slice, restructured for grant
     capture (VERDICT r4 #1): the round-4 shape was ONE subprocess
     holding the whole remaining budget, so a backend stall on a held
@@ -451,10 +451,20 @@ def run_kernels(grant_ok: bool = True) -> dict:
     Runs even when the smoke's probe loop never got a grant — a window
     may open during the slice. Every attempt is recorded in the
     artifact (``attempts``), so a no-capture round proves what it
-    tried, per-window."""
+    tried, per-window.
+
+    ``emit(partial)`` is called after every state change (each window
+    attempt, the micro capture, the final merge): the kernel phase can
+    run for minutes, and a driver kill mid-phase must leave the
+    attempt history and any captured numbers in the streamed tail, not
+    lose the whole phase."""
     kernel_args = os.environ.get("BENCH_KERNEL_ARGS", "").split()
     attempts = []
     micro = None
+
+    def note(state: dict) -> None:
+        if emit is not None:
+            emit(state)
     while len(attempts) < KERNEL_MAX_ATTEMPTS:
         left = _budget_left() - 5
         if left < 20:
@@ -475,11 +485,14 @@ def run_kernels(grant_ok: bool = True) -> dict:
         if _has_kernel_numbers(report):
             attempts.append({"ok": True, "tier": "micro", "took_s": took})
             micro = report
+            micro["attempts"] = attempts
+            note(micro)  # captured numbers survive a kill from here on
             break
         attempts.append({
             "ok": False, "tier": "micro", "took_s": took,
             "error": (err or "report without kernel numbers")[:200],
         })
+        note({"in_progress": True, "attempts": list(attempts)})
         if took < 5:
             # A fast failure (bad import, instant rc!=0) is not chip
             # contention — spinning through the slice would spawn
@@ -653,8 +666,16 @@ def main() -> int:
             emit()
 
         # Phase 3: kernel microbench (VERDICT r2 #4) on its RESERVED
-        # slice (r3 #1b) — runs even when the smoke never did.
-        result["detail"]["kernels"] = run_kernels(grant_ok=grant["ok"])
+        # slice (r3 #1b), sub-windowed (r4 #1) — runs even when the
+        # smoke never did, and streams every attempt so a driver kill
+        # mid-phase keeps the history and any captured numbers.
+        def on_kernel_progress(partial: dict) -> None:
+            result["detail"]["kernels"] = partial
+            emit()
+
+        result["detail"]["kernels"] = run_kernels(
+            grant_ok=grant["ok"], emit=on_kernel_progress
+        )
         result["detail"]["budget"] = {
             "total_s": TOTAL_BUDGET_S,
             "kernel_reserve_s": KERNEL_RESERVE_S,
