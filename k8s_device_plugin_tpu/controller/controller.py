@@ -35,6 +35,12 @@ from ..kube.client import KubeClient, KubeError
 from ..kube.podresources import PodResourcesClient
 from ..utils import metrics
 from ..utils.podresources import is_tpu_pod
+from ..utils.resilience import (
+    Backoff,
+    PendingWrites,
+    UnavailableError,
+    delay_for_attempt,
+)
 
 log = logging.getLogger(__name__)
 
@@ -98,6 +104,16 @@ class Controller:
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._threads = []
+        # Degradation queue: pod-annotation patches computed while the
+        # apiserver is unreachable park here and drain after the next
+        # successful relist — the annotation is delivered, not lost
+        # (utils/resilience.py; tests/test_chaos.py).
+        self._pending_writes = PendingWrites(
+            gauge=metrics.KUBE_QUEUED_WRITES
+        )
+        # Escalating reconnect delay for the informer loop, reset on any
+        # successful relist (replaces the old fixed 2 s wait).
+        self._watch_backoff = Backoff(base=0.5, max_delay=15.0)
         # pod uid -> chip ids we believe it holds (for delete-time free when
         # the annotation is missing).
         self._pod_devices: Dict[str, Set[str]] = {}
@@ -261,6 +277,13 @@ class Controller:
                 if not resource_version:
                     pods = self.client.list_pods(node_name=self.node_name)
                     last_list = time.time()
+                    self._watch_backoff.reset()
+                    # The relist succeeded, so the apiserver is back:
+                    # deliver the annotation patches queued while it was
+                    # unreachable before this cycle's events re-derive
+                    # the same writes.
+                    if len(self._pending_writes):
+                        self._pending_writes.drain()
                     resource_version = (
                         pods.get("metadata", {}).get("resourceVersion", "")
                     )
@@ -318,7 +341,7 @@ class Controller:
                     resource_version = ""
                 else:
                     log.warning("watch error: %s", e)
-                    self._stop.wait(2.0)
+                    self._stop.wait(self._watch_backoff.next_delay())
             except Exception as e:  # noqa: BLE001 — informer must survive
                 # stop() aborts an in-flight watch by closing its raw
                 # connection (interrupt_watches) — the resulting error
@@ -329,7 +352,7 @@ class Controller:
                 if self._stop.is_set():
                     return
                 log.warning("watch connection error: %s", e)
-                self._stop.wait(2.0)
+                self._stop.wait(self._watch_backoff.next_delay())
 
     def _enqueue(self, etype: str, pod: dict, retries: int = 0) -> None:
         if is_tpu_pod(pod, self.resource_name) or etype == "DELETED":
@@ -374,7 +397,11 @@ class Controller:
                     )
                 else:
                     log.warning("pod event retry (%s): %s", etype, e)
-                    time.sleep(min(0.1 * 2**retries, 2.0))
+                    # Jittered workqueue backoff (resilience.py), stop-
+                    # aware so shutdown never waits out a sleep.
+                    self._stop.wait(
+                        delay_for_attempt(retries, base=0.1, max_delay=2.0)
+                    )
                     self._queue.put((etype, pod, retries + 1))
 
     def _prune_stale(self, live_keys: Set[str]) -> None:
@@ -463,11 +490,29 @@ class Controller:
                     nsname, sorted(held & set(real)), other_key,
                 )
                 return
-        self.client.patch_pod_annotations(
-            meta.get("namespace", "default"),
-            meta.get("name", ""),
-            {self.devices_annotation: ",".join(sorted(real))},
-        )
+        ns = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        value = ",".join(sorted(real))
+        try:
+            self.client.patch_pod_annotations(
+                ns, name, {self.devices_annotation: value}
+            )
+        except UnavailableError as e:
+            # The apiserver is unreachable (retries/deadline/circuit all
+            # exhausted inside the client). The kubelet has already
+            # handed the chips over, so local state must proceed; only
+            # the PUBLISH is deferred — queued and drained after the
+            # next successful relist, so the annotation is delivered,
+            # not lost to the bounded workqueue retry.
+            log.warning(
+                "pod %s/%s annotation patch queued (apiserver "
+                "unreachable): %s", ns, name, e,
+            )
+            self._pending_writes.put(
+                ("pod-ann", ns, name),
+                lambda: self._deliver_queued_annotation(ns, name, uid, value),
+                describe=f"devices annotation for pod {ns}/{name}",
+            )
         for kid in consumed:
             self.plugin.shadow_map.pop(kid, None)
         # Migrate any rebuild-time namespace/name tracking to the uid key.
@@ -482,9 +527,40 @@ class Controller:
         )
 
     # reference deletePodFunc, /root/reference/controller.go:148-171
+    def _deliver_queued_annotation(
+        self, ns: str, name: str, uid: str, value: str
+    ) -> None:
+        """Drain-time delivery of an annotation queued during an
+        outage. The queue is keyed by namespace/name, but the chip list
+        belongs to one pod INCARNATION: if the pod was deleted and
+        recreated under the same name while the apiserver was
+        unreachable (no DELETED event ever discarded the entry), the
+        uid differs and patching would stamp the old incarnation's
+        chips onto the new pod — later freed from under their real
+        holder. Raising a semantic (non-Unavailable) error makes
+        drain() drop the entry; the new incarnation's own RUNNING event
+        derives its real annotation."""
+        pod = self.client.get(f"/api/v1/namespaces/{ns}/pods/{name}")
+        live_uid = (pod.get("metadata") or {}).get("uid", "")
+        if live_uid != uid:
+            raise ValueError(
+                f"pod {ns}/{name} was recreated (uid {uid} -> "
+                f"{live_uid}); queued annotation is stale"
+            )
+        self.client.patch_pod_annotations(
+            ns, name, {self.devices_annotation: value}
+        )
+
     def _handle_delete(self, pod: dict) -> None:
         meta = pod.get("metadata", {})
         uid = meta.get("uid", "")
+        # A patch queued for this pod during an outage is moot now (and
+        # would 404 at drain time anyway — dropped there too; this just
+        # spares the round trip).
+        self._pending_writes.discard(
+            ("pod-ann", meta.get("namespace", "default"),
+             meta.get("name", "")),
+        )
         annotations = meta.get("annotations") or {}
         ids: Set[str] = set()
         if self.devices_annotation in annotations:

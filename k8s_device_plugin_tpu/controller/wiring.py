@@ -20,6 +20,7 @@ from ..api import constants
 from ..kube.client import KubeClient, KubeError, rfc3339_now
 from ..topology.mesh import IciMesh
 from ..topology.schema import NodeTopology
+from ..utils.resilience import Backoff, delay_for_attempt
 from .controller import Controller
 
 log = logging.getLogger(__name__)
@@ -71,10 +72,13 @@ def publish_node_topology(
             )
             return topo
         except KubeError as e:
+            # Transport failures and 5xx are already retried inside the
+            # client (utils/resilience.py); only the 409 conflict is a
+            # caller-owned semantic worth a local retry.
             last = e
             if e.status_code != 409:
                 raise
-            time.sleep(0.2 * (attempt + 1))
+            time.sleep(delay_for_attempt(attempt, base=0.2, max_delay=2.0))
     raise last  # type: ignore[misc]
 
 
@@ -166,7 +170,7 @@ class TopologyPublisher:
             )
 
     def _run(self) -> None:
-        backoff = 1.0
+        backoff = Backoff(base=1.0, max_delay=30.0)
         while not self._stop.is_set():
             # Timed wait = heartbeat: an idle node still republishes every
             # heartbeat_s, advancing the condition's lastHeartbeatTime so
@@ -183,20 +187,21 @@ class TopologyPublisher:
                     self.publish_now()
                 else:
                     self.publish_heartbeat()
-                backoff = 1.0
+                backoff.reset()
             except Exception as e:
                 # A dropped publish would leave a stale condition or
-                # availability annotation until the NEXT change — retry.
+                # availability annotation until the NEXT change — retry
+                # on the shared jittered backoff (resilience.py).
                 # Post-stop failures are the expected shape of teardown
                 # (the apiserver is already gone): exit silently.
                 if self._stop.is_set():
                     return
+                delay = backoff.next_delay()
                 log.warning(
-                    "node publish failed (retry in %.0fs): %s", backoff, e
+                    "node publish failed (retry in %.1fs): %s", delay, e
                 )
-                if self._stop.wait(backoff):
+                if self._stop.wait(delay):
                     return
-                backoff = min(backoff * 2, 30.0)
                 self._dirty.set()
 
 

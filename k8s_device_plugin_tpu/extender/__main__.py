@@ -3,6 +3,7 @@
 
 import argparse
 import logging
+import os
 import signal
 import threading
 
@@ -45,6 +46,11 @@ def main() -> int:
         "--lease-namespace", default="kube-system",
         help="namespace of the singleton lease",
     )
+    p.add_argument(
+        "--lease-seconds", type=float, default=30.0,
+        help="singleton lease duration; the renew deadline (self-"
+        "demotion horizon under an apiserver partition) is 2/3 of it",
+    )
     p.add_argument("-v", "--verbose", action="count", default=0)
     a = p.parse_args()
     logging.basicConfig(
@@ -62,8 +68,15 @@ def main() -> int:
     node_cache = None
     if a.node_cache or a.gang_admission:
         from ..kube.client import KubeClient
+        from ..utils import resilience
 
         client = KubeClient.from_env(a.kubeconfig)
+        # Report this process's retry/circuit/latency telemetry to the
+        # EXTENDER registry (metrics.py keeps the two processes'
+        # registries separate on purpose).
+        client.resilience = resilience.Resilience(
+            metrics=resilience.extender_metrics()
+        )
     if a.node_cache:
         node_cache = NodeAnnotationCache(
             client, interval_s=a.node_cache_interval_s
@@ -88,8 +101,26 @@ def main() -> int:
     if a.gang_admission and not a.no_singleton_lease:
         from .leader import LeaderLease, SecondReplica
 
+        def lease_lost():
+            # Hard exit, not graceful shutdown (client-go's Fatal on
+            # renew failure): a graceful stop can take tens of seconds
+            # (thread joins, lease release), during which an admission
+            # PATCH already in flight under the client's retry envelope
+            # could still land AFTER our stale lease became
+            # takeover-able — releasing a gang the successor holds no
+            # reservation for. Dying instantly kills in-flight writes
+            # with the process; kubelet restarts us into a clean
+            # acquire.
+            logging.getLogger(__name__).error(
+                "singleton lease lost; exiting immediately so no "
+                "in-flight admission write can land past the takeover "
+                "horizon"
+            )
+            os._exit(1)
+
         leader = LeaderLease(
-            client, namespace=a.lease_namespace, on_lost=stop.set
+            client, namespace=a.lease_namespace,
+            lease_seconds=a.lease_seconds, on_lost=lease_lost,
         )
         try:
             leader.start()

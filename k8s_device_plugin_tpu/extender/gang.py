@@ -45,7 +45,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..api import constants
-from ..kube.client import KubeClient
+from ..kube.client import KubeClient, KubeError
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..topology.slice import SliceView, group_by_slice
 from ..utils import metrics
@@ -164,6 +164,13 @@ class GangAdmission:
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Last successfully-listed node topologies: served when a node
+        # relist fails mid-outage so admission decisions degrade to a
+        # slightly-stale capacity view instead of crashing the tick.
+        # Safe direction: a gang released against stale capacity just
+        # Pends (the reservation still fences it at /filter); it can
+        # never double-admit.
+        self._last_topos: List[NodeTopology] = []
         # (gang key, demands) already reported as not-fitting — a gang
         # waiting for capacity logs once per state, not once per resync.
         self._reported_waiting: set = set()
@@ -631,8 +638,22 @@ class GangAdmission:
         return reports
 
     def _node_topologies(self) -> List[NodeTopology]:
+        try:
+            items = self.client.list_nodes().get("items", [])
+        except (KubeError, OSError) as e:
+            # Graceful degradation: the client's resilience layer has
+            # already retried; serve the last-known topology (if any)
+            # rather than abort — matching the extender node cache's
+            # serve-stale-on-relist-failure behavior.
+            if self._last_topos:
+                log.warning(
+                    "node list failed (%s); serving last-known topology "
+                    "(%d nodes)", e, len(self._last_topos),
+                )
+                return list(self._last_topos)
+            raise
         topos = []
-        for node in self.client.list_nodes().get("items", []):
+        for node in items:
             ann = (node.get("metadata") or {}).get("annotations") or {}
             raw = ann.get(constants.TOPOLOGY_ANNOTATION)
             if not raw:
@@ -644,6 +665,7 @@ class GangAdmission:
                     "bad topology annotation on %s: %s",
                     (node.get("metadata") or {}).get("name"), e,
                 )
+        self._last_topos = list(topos)
         return topos
 
     # -- feasibility -------------------------------------------------------

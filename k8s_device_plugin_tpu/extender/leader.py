@@ -19,13 +19,34 @@ self-enforcing with the standard kube singleton primitive:
   reservation state itself is rebuilt by gang.py's restart re-fencing,
   so takeover needs no state handoff.
 - The holder renews on a background thread. If the apiserver ever
-  shows a DIFFERENT live holder (possible only after our renewals
-  failed past the lease duration — an apiserver partition longer than
-  the takeover window), ``on_lost`` fires; the entrypoint wires it to
-  process shutdown so the cluster is back to one admitter.
+  shows a DIFFERENT live holder, ``on_lost`` fires; the entrypoint
+  wires it to process shutdown so the cluster is back to one admitter.
+- **Renew deadline** (client-go's RenewDeadline, 2/3 of the lease
+  duration by default): a holder that cannot complete a renewal within
+  the deadline self-demotes (``on_lost``) WITHOUT waiting to observe a
+  competitor — so a partitioned holder stops admitting strictly before
+  its stale lease becomes takeover-able, closing the dual-admitter
+  window (ADVICE r5 medium).
+- **Graceful release**: ``stop()`` clears holderIdentity so a
+  replacement (Recreate rollout, node drain) acquires immediately
+  instead of CrashLoopBackOff-ing for up to the lease duration
+  (ADVICE r5 high; deploy/tpu-extender.yml pins ``strategy:
+  Recreate`` so old and new pods never overlap).
 - Acquisition and takeover go through create-or-replace with
   optimistic concurrency (resourceVersion), so two replicas racing the
   same stale lease cannot both win — the loser's PUT conflicts.
+
+Holder liveness (``_holder_is_live``) follows client-go's
+locally-observed-renewals model: once this process has seen a holder's
+record, the holder is live exactly while its renewTime keeps advancing
+within the lease's OWN ``spec.leaseDurationSeconds`` — no cross-node
+wall-clock comparison, so clock skew between nodes cannot make a
+renewing holder read as dead (ADVICE r5 low). Only the very first
+sight of a holder (fresh process, no observation history) falls back
+to comparing renewTime against the local clock; the documented skew
+tolerance for THAT path is the lease duration, and a wrongful takeover
+there self-heals in one renew interval (the skewed holder observes the
+new record and demotes rather than fights).
 
 The reference has no analog (its scheduler integration was a TODO,
 /root/reference/server.go:298-300); the pattern is the one
@@ -95,6 +116,7 @@ class LeaderLease:
         name: str = LEASE_NAME,
         identity: str = "",
         lease_seconds: float = 30.0,
+        renew_deadline_s: float = 0.0,
         on_lost: Optional[Callable[[], None]] = None,
         clock: Callable[[], float] = time.time,
     ):
@@ -103,10 +125,22 @@ class LeaderLease:
         self.name = name
         self.identity = identity or default_identity()
         self.lease_seconds = lease_seconds
+        # client-go convention (LeaseDuration 15 / RenewDeadline 10):
+        # demote at 2/3 of the lease so a partitioned holder stops
+        # admitting strictly BEFORE its lease becomes takeover-able.
+        self.renew_deadline_s = renew_deadline_s or (
+            lease_seconds * 2.0 / 3.0
+        )
         self.on_lost = on_lost
         self._clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_renew = 0.0
+        # Locally-observed holder record for liveness (client-go style):
+        # (holderIdentity, renewTime string) and when THIS process last
+        # saw it change.
+        self._observed: Optional[tuple] = None
+        self._observed_at = 0.0
 
     @property
     def _collection(self) -> str:
@@ -132,8 +166,36 @@ class LeaderLease:
         return spec
 
     def _holder_is_live(self, spec: dict) -> bool:
-        renew = _parse_rfc3339(spec.get("renewTime", ""))
-        return (self._clock() - renew) < self.lease_seconds
+        """Client-go-style liveness: a holder whose record this process
+        has watched CHANGE is live (a renewal was locally observed —
+        immune to cross-node clock skew); an unchanged record decays
+        dead once unrenewed for the lease's own published duration.
+        Only the first sight of a holder (no local history) compares
+        its renewTime against the local clock — skew tolerance there is
+        the published duration."""
+        duration = float(
+            spec.get("leaseDurationSeconds") or self.lease_seconds
+        )
+        record = (
+            spec.get("holderIdentity", ""),
+            spec.get("renewTime", ""),
+        )
+        now = self._clock()
+        if record != self._observed:
+            first_sight = (
+                self._observed is None or self._observed[0] != record[0]
+            )
+            self._observed = record
+            self._observed_at = now
+            if not first_sight:
+                return True  # same holder, renewTime advanced: renewing
+            live = (now - _parse_rfc3339(record[1])) < duration
+            if not live:
+                # Anchor the decay so an unchanged stale record is not
+                # resurrected by the next re-read.
+                self._observed_at = now - duration
+            return live
+        return (now - self._observed_at) < duration
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -200,6 +262,7 @@ class LeaderLease:
 
     def start(self) -> "LeaderLease":
         self.acquire()
+        self._last_renew = self._clock()
         metrics.LEASE_HELD.set(1)
         self._thread = threading.Thread(
             target=self._renew_loop, name="extender-lease", daemon=True
@@ -212,30 +275,109 @@ class LeaderLease:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        self._release()
+
+    def _release(self) -> None:
+        """Clear holderIdentity on graceful shutdown so the NEXT pod
+        (Recreate rollout, drain, plain restart) acquires instantly
+        instead of CrashLoopBackOff-ing against our fresh renewTime for
+        up to lease_seconds (ADVICE r5 high). Best-effort: on failure
+        (apiserver gone at teardown) the lease simply ages out."""
+        try:
+            # Bounded tightly: a Recreate rollout is waiting on this
+            # process to exit; a hanging apiserver must not eat the
+            # termination grace period.
+            lease = self.client.get(self._path, deadline_s=5.0, timeout=5.0)
+            spec = lease.get("spec") or {}
+            if spec.get("holderIdentity", "") != self.identity:
+                return  # lost/taken over already; nothing ours to free
+            spec["holderIdentity"] = ""
+            spec["renewTime"] = rfc3339_now()
+            lease["spec"] = spec
+            self.client.replace(self._path, lease, deadline_s=5.0, timeout=5.0)
+            log.info(
+                "released lease %s/%s on shutdown", self.namespace,
+                self.name,
+            )
+        except Exception as e:  # noqa: BLE001 — teardown is best-effort
+            log.warning("lease release on shutdown failed: %s", e)
+        finally:
+            metrics.LEASE_HELD.set(0)
+
+    def _demote(self, reason: str, detail) -> None:
+        log.error("lease lost (%s): %s", reason, detail)
+        metrics.LEASE_HELD.set(0)
+        metrics.LEASE_SELF_DEMOTIONS.inc(reason=reason)
+        if self.on_lost is not None:
+            self.on_lost()
 
     def _renew_loop(self) -> None:
-        interval = max(self.lease_seconds / 3.0, 1.0)
+        # Wake often enough for ~3 renewal attempts inside the renew
+        # deadline (client-go's RetryPeriod shape).
+        interval = max(
+            min(self.lease_seconds / 3.0, self.renew_deadline_s / 3.0),
+            0.2,
+        )
         while not self._stop.wait(interval):
+            # Pre-attempt guard: a previous attempt that blocked past
+            # the deadline (despite the clamps in _renew_once) must not
+            # buy the loop another full attempt while the lease may
+            # already be takeover-able.
+            unrenewed = self._clock() - self._last_renew
+            if unrenewed > self.renew_deadline_s:
+                self._demote(
+                    "renew_deadline",
+                    f"no successful renewal for {unrenewed:.1f}s "
+                    f"(deadline {self.renew_deadline_s:.1f}s)",
+                )
+                return
             try:
                 self._renew_once()
+                self._last_renew = self._clock()
             except SecondReplica as e:
-                log.error("lease lost: %s", e)
-                metrics.LEASE_HELD.set(0)
-                if self.on_lost is not None:
-                    self.on_lost()
+                self._demote("lost_to_peer", e)
                 return
             except Exception as e:  # noqa: BLE001 — transient apiserver
-                # noise must not kill the admitter: until the lease
-                # duration passes unrenewed nobody else can take it.
+                # noise must not kill the admitter outright; but past
+                # the renew deadline we can no longer PROVE the lease is
+                # ours (a peer may legitimately be taking the stale
+                # lease over right now), so self-demote instead of
+                # running a possibly-dual admitter (ADVICE r5 medium).
                 metrics.LEASE_RENEWAL_ERRORS.inc()
+                unrenewed = self._clock() - self._last_renew
+                if unrenewed > self.renew_deadline_s:
+                    self._demote(
+                        "renew_deadline",
+                        f"no successful renewal for {unrenewed:.1f}s "
+                        f"(deadline {self.renew_deadline_s:.1f}s): {e}",
+                    )
+                    return
                 log.warning("lease renewal failed (will retry): %s", e)
 
     def _renew_once(self) -> None:
-        lease = self.client.get(self._path)
+        # Clamp BOTH the retry envelope and the single in-flight
+        # request to the remaining renew budget: an attempt allowed to
+        # outlive the deadline (the client's default 20s envelope / 10s
+        # request timeout) could return only after the lease is already
+        # takeover-able — demotion must strictly precede that horizon.
+        rem = max(
+            0.5,
+            self.renew_deadline_s - (self._clock() - self._last_renew),
+        )
+        t_out = min(getattr(self.client, "timeout", rem) or rem, rem)
+        lease = self.client.get(self._path, deadline_s=rem, timeout=t_out)
+        if self._stop.is_set():
+            # stop() may have timed out joining this very thread and
+            # released the lease already: a zombie renewal must not
+            # renew (or re-take) what stop() just freed — that strands
+            # the lease on a dead process for up to lease_seconds.
+            return
         spec = lease.get("spec") or {}
         holder = spec.get("holderIdentity", "")
         if holder != self.identity:
-            if self._holder_is_live(spec):
+            # A released lease (empty holder) is simply re-taken; a
+            # LIVE different holder means we lost it.
+            if holder and self._holder_is_live(spec):
                 raise SecondReplica(f"now held by {holder!r}")
             log.warning("re-taking stale lease from %r", holder)
             lease["spec"] = self._spec(
@@ -245,4 +387,4 @@ class LeaderLease:
         else:
             spec["renewTime"] = rfc3339_now()
             lease["spec"] = spec
-        self.client.replace(self._path, lease)
+        self.client.replace(self._path, lease, deadline_s=rem, timeout=t_out)

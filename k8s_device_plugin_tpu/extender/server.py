@@ -39,6 +39,7 @@ from ..topology.slice import SliceView, group_by_slice
 from ..utils import metrics
 from ..utils.httpserver import BackgroundHTTPServer
 from ..utils.podresources import tpu_request
+from ..utils.resilience import Backoff
 from .gang import pod_gang
 from .reservations import DEFAULT_TABLE, ReservationTable
 
@@ -380,12 +381,30 @@ class NodeAnnotationCache:
             self._thread = None
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        # Escalating relist delay while the apiserver is down (the
+        # cache serves stale entries meanwhile — last-known topology is
+        # the designed degradation); reset to the normal cadence on the
+        # first success.
+        backoff = Backoff(
+            base=self.interval_s, max_delay=max(60.0, self.interval_s)
+        )
+        wait = self.interval_s
+        while not self._stop.wait(wait):
             try:
                 self.refresh()
+                backoff.reset()
+                wait = self.interval_s
             except Exception as e:  # noqa: BLE001 — keep serving stale
                 metrics.NODE_CACHE_RELIST_ERRORS.inc()
-                log.warning("node cache relist failed: %s", e)
+                # Floored at the healthy cadence: the jittered first
+                # escalation step can land BELOW interval_s, and a
+                # struggling apiserver must never be polled faster than
+                # a healthy one.
+                wait = max(self.interval_s, backoff.next_delay())
+                log.warning(
+                    "node cache relist failed (next in %.1fs): %s",
+                    wait, e,
+                )
 
     def refresh(self) -> None:
         items = self.client.list_nodes().get("items", [])

@@ -6,6 +6,14 @@ actually needs: in-cluster or kubeconfig auth, node get/patch, pod
 list/watch/patch. Built on `requests` (the only HTTP client in this image)
 over the plain Kubernetes REST API.
 
+Every call is routed through a shared resilience pipeline
+(utils/resilience.py): jittered exponential backoff, per-call
+deadlines, a retry budget, and a circuit breaker. Transport failures
+and 5xx answers are retried and eventually surface as
+``UnavailableError`` (an OSError — existing degradation sites catch
+it); semantic answers (404/409/410/422/429) propagate immediately as
+``KubeError`` because their handling belongs to the caller.
+
 Auth resolution order mirrors client-go's
 (/root/reference/controller.go:29-52: kubeconfig env first, else
 in-cluster):
@@ -28,6 +36,11 @@ from typing import Dict, Generator, Iterable, List, Optional, Tuple
 
 import requests
 import yaml
+
+from ..utils.resilience import Resilience, UnavailableError  # noqa: F401
+# UnavailableError is re-exported: callers that need to distinguish
+# "apiserver unreachable" (degrade/queue) from a semantic KubeError
+# import it from here alongside KubeError.
 
 log = logging.getLogger(__name__)
 
@@ -66,9 +79,16 @@ class KubeClient:
         ca_path: Optional[str] = None,
         client_cert: Optional[Tuple[str, str]] = None,
         timeout: float = 10.0,
+        resilience: Optional[Resilience] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # ALL request sites below flow through this retry/backoff/
+        # deadline/circuit pipeline (utils/resilience.py) — chaos tests
+        # assert no raw unretried site remains. Swappable after
+        # construction (the extender wires one that reports to the
+        # extender metrics registry).
+        self.resilience = resilience if resilience is not None else Resilience()
         self._session = requests.Session()
         if token:
             self._session.headers["Authorization"] = f"Bearer {token}"
@@ -143,47 +163,116 @@ class KubeClient:
 
     # -- raw ---------------------------------------------------------------
 
-    def _request(self, method: str, path: str, **kw) -> requests.Response:
+    def _attempt(
+        self, method: str, path: str, **kw
+    ) -> requests.Response:
+        """ONE raw HTTP attempt. Never call directly — the resilience
+        layer owns retries, backoff, deadlines, and the breaker."""
         kw.setdefault("timeout", self.timeout)
         resp = self._session.request(method, self.base_url + path, **kw)
         if resp.status_code >= 400:
             raise KubeError(resp.status_code, resp.text[:500])
         return resp
 
-    def get(self, path: str, params: Optional[dict] = None) -> dict:
-        return self._request("GET", path, params=params).json()
+    def _request(
+        self,
+        method: str,
+        path: str,
+        verb: str = "",
+        deadline_s: Optional[float] = None,
+        **kw,
+    ) -> requests.Response:
+        """Resilient request returning the raw Response (streaming
+        callers). Retries cover the connect/headers phase; body
+        streaming errors are the caller's reconnect loop's job."""
+        return self.resilience.call(
+            lambda: self._attempt(method, path, **kw),
+            verb=verb or method,
+            deadline_s=deadline_s,
+        )
+
+    def _request_json(
+        self,
+        method: str,
+        path: str,
+        verb: str = "",
+        deadline_s: Optional[float] = None,
+        **kw,
+    ) -> dict:
+        """Resilient request + body parse. The parse happens INSIDE the
+        retried closure so a truncated/garbled JSON body (proxy or
+        apiserver dying mid-response) is retried like any transport
+        failure instead of surfacing as a stray ValueError."""
+        return self.resilience.call(
+            lambda: self._attempt(method, path, **kw).json(),
+            verb=verb or method,
+            deadline_s=deadline_s,
+        )
+
+    def get(
+        self,
+        path: str,
+        params: Optional[dict] = None,
+        verb: str = "GET",
+        deadline_s: Optional[float] = None,
+        timeout: Optional[float] = None,
+    ) -> dict:
+        """``deadline_s``/``timeout`` let latency-contracted callers
+        (lease renewal) clamp the whole retry envelope AND the single
+        in-flight request below their own budget."""
+        kw: dict = {"params": params}
+        if timeout is not None:
+            kw["timeout"] = timeout
+        return self._request_json(
+            "GET", path, verb=verb, deadline_s=deadline_s, **kw
+        )
 
     def patch(
         self, path: str, body: dict, content_type: str = STRATEGIC_MERGE_PATCH
     ) -> dict:
-        return self._request(
+        return self._request_json(
             "PATCH",
             path,
             data=json.dumps(body),
             headers={"Content-Type": content_type},
-        ).json()
+        )
 
     def create(self, path: str, body: dict) -> dict:
-        """POST a new object to a collection path (e.g. ResourceSlices)."""
-        return self._request(
+        """POST a new object to a collection path (e.g. ResourceSlices).
+        Retried on transport failure: a retry of a create that actually
+        landed answers 409, which surfaces to the caller exactly like
+        losing a create race — every call site already handles it."""
+        return self._request_json(
             "POST",
             path,
             data=json.dumps(body),
             headers={"Content-Type": "application/json"},
-        ).json()
+        )
 
-    def replace(self, path: str, body: dict) -> dict:
+    def replace(
+        self,
+        path: str,
+        body: dict,
+        deadline_s: Optional[float] = None,
+        timeout: Optional[float] = None,
+    ) -> dict:
         """PUT over an existing object path (body must carry the current
-        resourceVersion for conflict detection)."""
-        return self._request(
+        resourceVersion for conflict detection — which also makes the
+        resilient retry safe: a landed-then-retried PUT conflicts)."""
+        kw: dict = {}
+        if timeout is not None:
+            kw["timeout"] = timeout
+        return self._request_json(
             "PUT",
             path,
             data=json.dumps(body),
             headers={"Content-Type": "application/json"},
-        ).json()
+            deadline_s=deadline_s,
+            **kw,
+        )
 
     def delete(self, path: str) -> dict:
-        return self._request("DELETE", path).json()
+        return self._request_json("DELETE", path)
 
     # -- nodes -------------------------------------------------------------
 
@@ -192,7 +281,7 @@ class KubeClient:
 
     def list_nodes(self, label_selector: str = "") -> dict:
         params = {"labelSelector": label_selector} if label_selector else None
-        return self.get("/api/v1/nodes", params=params)
+        return self.get("/api/v1/nodes", params=params, verb="LIST")
 
     def patch_node_annotations(
         self, name: str, annotations: Dict[str, Optional[str]]
@@ -233,7 +322,7 @@ class KubeClient:
             params["fieldSelector"] = f"spec.nodeName={node_name}"
         if label_selector:
             params["labelSelector"] = label_selector
-        return self.get(path, params=params)
+        return self.get(path, params=params, verb="LIST")
 
     def watch_pods(
         self,
@@ -256,6 +345,7 @@ class KubeClient:
         resp = self._request(
             "GET",
             "/api/v1/pods",
+            verb="WATCH",
             params=params,
             stream=True,
             timeout=timeout_seconds + 10,
@@ -334,12 +424,12 @@ class KubeClient:
             "lastTimestamp": now,
             "count": 1,
         }
-        return self._request(
+        return self._request_json(
             "POST",
             f"/api/v1/namespaces/{namespace}/events",
             data=json.dumps(body),
             headers={"Content-Type": "application/json"},
-        ).json()
+        )
 
     def evict_pod(self, namespace: str, name: str) -> dict:
         """Evict a pod via the Eviction subresource, so
@@ -410,12 +500,15 @@ class KubeClient:
             },
             {"op": "remove", "path": f"/spec/schedulingGates/{idx}"},
         ]
-        return self._request(
+        # Retry-safe despite being a write: the leading ``test`` op makes
+        # a landed-then-retried patch fail 422 (index shifted), which the
+        # caller already handles by re-reading.
+        return self._request_json(
             "PATCH",
             f"/api/v1/namespaces/{namespace}/pods/{name}",
             data=json.dumps(ops),
             headers={"Content-Type": JSON_PATCH},
-        ).json()
+        )
 
 
 def _named(items: Iterable[dict], name: str) -> Optional[dict]:

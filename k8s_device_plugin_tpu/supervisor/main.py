@@ -223,20 +223,17 @@ class Daemon:
             and not slice_config_is_explicit(self.cfg)
         )
         if self._kube_client is not None and need_node:
-            # A wrong chip spec lives until the next rebuild, so a
-            # transient apiserver blip gets a couple of brief retries.
-            for attempt in range(3):
-                try:
-                    node_obj = self._kube_client.get_node(node_name)
-                    break
-                except Exception as e:
-                    if attempt == 2:
-                        log.warning(
-                            "node prefetch failed (%s); GKE label "
-                            "derivations skipped this generation", e,
-                        )
-                    else:
-                        time.sleep(0.5 * (attempt + 1))
+            # A wrong chip spec lives until the next rebuild; transient
+            # apiserver blips are absorbed by the client's resilience
+            # layer (utils/resilience.py — backoff/deadline inside
+            # get_node), so no hand-rolled retry loop here.
+            try:
+                node_obj = self._kube_client.get_node(node_name)
+            except Exception as e:
+                log.warning(
+                    "node prefetch failed (%s); GKE label derivations "
+                    "skipped this generation", e,
+                )
         if not self.cfg.accelerator_type and node_obj is not None:
             try:
                 from ..kube.gke import derive_accelerator_type

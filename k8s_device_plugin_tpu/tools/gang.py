@@ -102,10 +102,15 @@ def main(argv=None) -> int:
     adm = GangAdmission(client, reservations=table)
     reports = adm.explain()
     if args.json:
-        out = {"gangs": reports}
+        # Machine-readable contract: a BARE LIST of gang reports on
+        # stdout (the original shape — r5 briefly wrapped it in a dict,
+        # breaking every consumer that iterated the output; ADVICE r5
+        # low). Diagnostics like the non-holder warning go to stderr so
+        # they can never corrupt a pipeline. Schema documented in
+        # docs/operations.md.
         if holder_warning:
-            out["warning"] = holder_warning
-        print(json.dumps(out, indent=1))
+            print(f"WARNING: {holder_warning}", file=sys.stderr)
+        print(json.dumps(reports, indent=1))
         return 0
     if holder_warning:
         print(f"WARNING: {holder_warning}")

@@ -211,6 +211,28 @@ DRA_PREPARED = REGISTRY.gauge(
     "tpu_plugin_dra_prepared_claims",
     "DRA claims currently prepared (holding chips) on this node",
 )
+# Control-plane resilience (utils/resilience.py): every kube REST call
+# the daemon makes flows through one retry/backoff/deadline/circuit
+# pipeline; these are its instruments.
+KUBE_RETRIES = REGISTRY.counter(
+    "tpu_plugin_kube_retries_total",
+    "Kube API attempts retried after a transport-level failure, by verb",
+)
+KUBE_CIRCUIT_STATE = REGISTRY.gauge(
+    "tpu_plugin_kube_circuit_state",
+    "Kube API circuit breaker: 0 closed, 1 open (failing fast), "
+    "2 half-open (probing)",
+)
+KUBE_REQUEST_LATENCY = REGISTRY.histogram(
+    "tpu_plugin_kube_request_latency_seconds",
+    "Wall latency of individual kube API request attempts, by verb and "
+    "outcome",
+)
+KUBE_QUEUED_WRITES = REGISTRY.gauge(
+    "tpu_plugin_kube_queued_writes",
+    "State-publishing writes queued while the apiserver is unreachable "
+    "(drained on reconnect; >0 for long = degraded mode)",
+)
 # The extender/gang-admission process exposes its own registry: sharing
 # the daemon's would publish every tpu_plugin_* family as constant zeros
 # from the extender Service, polluting sum()s and alerts across scrapes.
@@ -268,6 +290,29 @@ LEASE_RENEWAL_ERRORS = EXTENDER_REGISTRY.counter(
     "Lease renewals that failed transiently (the lease survives until "
     "its duration passes unrenewed; sustained increase = apiserver "
     "trouble that will end in admitter shutdown)",
+)
+LEASE_SELF_DEMOTIONS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_lease_self_demotions_total",
+    "Times this replica stopped admitting on its own, by reason "
+    "(renew_deadline: could not renew within the deadline — the "
+    "partitioned-holder guard; lost_to_peer: observed another live "
+    "holder)",
+)
+# Extender-process instances of the resilience instruments (separate
+# registry — see the pollution note above).
+EXT_KUBE_RETRIES = EXTENDER_REGISTRY.counter(
+    "tpu_extender_kube_retries_total",
+    "Kube API attempts retried after a transport-level failure, by verb",
+)
+EXT_KUBE_CIRCUIT_STATE = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_kube_circuit_state",
+    "Kube API circuit breaker: 0 closed, 1 open (failing fast), "
+    "2 half-open (probing)",
+)
+EXT_KUBE_REQUEST_LATENCY = EXTENDER_REGISTRY.histogram(
+    "tpu_extender_kube_request_latency_seconds",
+    "Wall latency of individual kube API request attempts, by verb and "
+    "outcome",
 )
 
 

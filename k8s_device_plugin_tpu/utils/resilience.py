@@ -1,0 +1,485 @@
+"""Unified retry/backoff/deadline layer for the control plane.
+
+Every REST call the plugin makes against the Kubernetes API server —
+kube/client.py's GET/LIST/WATCH/PATCH/POST/PUT/DELETE, and through it
+the controller, the topology publisher, the extender's node cache, gang
+admission, and lease renewal — flows through one :class:`Resilience`
+instance per client instead of the ad-hoc ``time.sleep`` loops each
+caller used to hand-roll. The reference swallowed these errors silently
+(/root/reference/controller.go, server.go:170); this layer makes the
+failure policy explicit, shared, and observable:
+
+* **jittered exponential backoff** between attempts (full-spectrum
+  jitter on the top half of the delay, so a fleet of daemons recovering
+  from an apiserver restart doesn't thundering-herd the first second);
+* **per-call deadlines**: one logical call never burns more than
+  ``deadline_s`` of wall clock across all its attempts — callers with
+  their own latency contracts (lease renewal, scheduler RPCs) stay
+  bounded;
+* **a retry budget** (token bucket) shared across the client: during a
+  sustained outage the FIRST attempts keep flowing (they're how we
+  notice recovery) but retry amplification is capped, mirroring
+  client-go's retry-budget rationale;
+* **a circuit breaker**: after ``failure_threshold`` consecutive
+  transport-level failures the circuit opens and calls fail fast
+  (``CircuitOpenError``) without touching the socket; after
+  ``reset_timeout_s`` one half-open probe is let through and its result
+  closes or re-opens the circuit. 4xx semantic answers (404/409/410/422)
+  are proof the apiserver is ALIVE — they never trip the breaker and are
+  never retried (409 conflicts and 410 resyncs are caller-owned
+  semantics; 429 likewise, because a PDB-blocked eviction must surface
+  to the controller's level-triggered retry, not spin here).
+
+Classification of retryable failures: transport errors (``OSError``,
+which covers every ``requests`` exception), HTTP 5xx (500/502/503/504),
+and truncated/garbled JSON bodies (``json.JSONDecodeError`` — a proxy
+or apiserver dying mid-response).
+
+Exhausted calls raise :class:`UnavailableError`, a subclass of
+``OSError`` so every existing ``except (KubeError, OSError)`` site in
+the controller/extender already handles degradation without edits.
+
+Instrumented via utils/metrics.py: ``*_kube_retries_total`` (by verb),
+``*_kube_circuit_state`` (0 closed / 1 open / 2 half-open), and a
+``*_kube_request_latency_seconds`` histogram per attempt (by verb and
+outcome) — ``tpu_plugin_*`` families for the daemon,
+``tpu_extender_*`` for the extender process (separate registries, see
+metrics.py).
+
+:class:`PendingWrites` implements the write-side degradation rule:
+state-publishing patches that fail with ``UnavailableError`` are queued
+(deduped by key, newest wins) and drained once the apiserver answers
+again, so a pod annotation computed during an outage is delivered, not
+dropped (tests/test_chaos.py asserts no annotation is lost across a
+watch-drop + 410 + 5xx-storm sequence).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+# HTTP statuses that indicate the apiserver (or a proxy in front of it)
+# is unhealthy rather than answering: retryable, breaker-counted.
+RETRYABLE_STATUS = frozenset({500, 502, 503, 504})
+
+# Circuit states, as exported by the *_kube_circuit_state gauge.
+CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+
+
+class UnavailableError(OSError):
+    """The API server could not be reached within the call's retry/
+    deadline policy. Subclasses OSError on purpose: every existing
+    ``except (KubeError, OSError)`` degradation site catches it."""
+
+
+class CircuitOpenError(UnavailableError):
+    """Failed fast: the circuit breaker is open (recent calls all died
+    at the transport level) and the reset timeout has not elapsed."""
+
+
+def retryable(exc: BaseException) -> bool:
+    """Default failure classification (see module docstring)."""
+    if isinstance(exc, UnavailableError):
+        return False  # already a final verdict; never re-wrapped
+    if isinstance(exc, OSError):  # covers all requests.* exceptions
+        return True
+    if isinstance(exc, json.JSONDecodeError):  # truncated/garbled body
+        return True
+    return getattr(exc, "status_code", None) in RETRYABLE_STATUS
+
+
+def delay_for_attempt(
+    attempt: int,
+    base: float = 0.1,
+    max_delay: float = 5.0,
+    jitter: float = 0.5,
+    rng: Callable[[], float] = random.random,
+) -> float:
+    """Jittered exponential delay for retry ``attempt`` (0-based): the
+    deterministic bottom ``1 - jitter`` fraction plus a randomized top
+    ``jitter`` fraction, capped at ``max_delay``. Shared by the
+    Resilience loop, the controller workqueue, and wiring's conflict
+    retry, so every backoff in the control plane has the same shape."""
+    d = min(base * (2.0 ** attempt), max_delay)
+    return d * (1.0 - jitter) + d * jitter * rng()
+
+
+class Backoff:
+    """Stateful escalating delay for long-lived retry loops (informer
+    reconnect, node-cache relist, topology republish): ``next_delay()``
+    escalates, ``reset()`` after any success."""
+
+    def __init__(
+        self,
+        base: float = 0.5,
+        max_delay: float = 30.0,
+        jitter: float = 0.5,
+        rng: Callable[[], float] = random.random,
+    ):
+        self.base = base
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self._rng = rng
+        self._attempt = 0
+
+    def next_delay(self) -> float:
+        d = delay_for_attempt(
+            self._attempt, self.base, self.max_delay, self.jitter, self._rng
+        )
+        self._attempt += 1
+        return d
+
+    def reset(self) -> None:
+        self._attempt = 0
+
+
+class RetryBudget:
+    """Token bucket bounding retry amplification across a whole client:
+    each RETRY (not first attempt) spends a token; refill is steady.
+    When the bucket is dry the call fails over to UnavailableError
+    immediately instead of multiplying load on a struggling apiserver."""
+
+    def __init__(
+        self,
+        capacity: float = 20.0,
+        refill_per_s: float = 2.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.capacity = capacity
+        self.refill_per_s = refill_per_s
+        self._clock = clock
+        self._tokens = capacity
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def try_spend(self, amount: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.capacity,
+                self._tokens + (now - self._last) * self.refill_per_s,
+            )
+            self._last = now
+            if self._tokens >= amount:
+                self._tokens -= amount
+                return True
+            return False
+
+
+class CircuitBreaker:
+    """Consecutive-transport-failure breaker with half-open probing.
+
+    Semantic HTTP answers (any status the classifier calls
+    non-retryable) count as SUCCESS here: a 404 proves the apiserver is
+    alive, and the breaker only models reachability."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        reset_timeout_s: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
+        on_state_change: Optional[Callable[[int], None]] = None,
+    ):
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self._clock = clock
+        self._on_state_change = on_state_change
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    def _set_state(self, state: int) -> None:
+        # Lock held by caller.
+        if state != self._state:
+            self._state = state
+            if self._on_state_change is not None:
+                self._on_state_change(state)
+
+    def allow(self) -> bool:
+        """True when a call may proceed. In the open state, exactly one
+        probe is admitted once ``reset_timeout_s`` has elapsed."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if (
+                self._state == OPEN
+                and self._clock() - self._opened_at >= self.reset_timeout_s
+            ):
+                self._set_state(HALF_OPEN)
+            if self._state == HALF_OPEN and not self._probe_in_flight:
+                self._probe_in_flight = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_in_flight = False
+            self._set_state(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._state == HALF_OPEN:
+                # The probe died: back to open, fresh reset window.
+                self._probe_in_flight = False
+                self._opened_at = self._clock()
+                self._set_state(OPEN)
+            elif (
+                self._state == CLOSED
+                and self._failures >= self.failure_threshold
+            ):
+                self._opened_at = self._clock()
+                self._set_state(OPEN)
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Per-call attempt/backoff/deadline envelope."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.1
+    max_delay_s: float = 2.0
+    jitter: float = 0.5
+    # Wall-clock budget for ONE logical call across all its attempts
+    # (sleeps included). Sized above a couple of request timeouts so a
+    # hanging apiserver costs bounded time, not max_attempts * timeout.
+    deadline_s: float = 20.0
+
+
+@dataclasses.dataclass
+class ResilienceMetrics:
+    """The metric objects one Resilience instance feeds. Two concrete
+    sets exist (plugin_metrics / extender_metrics) because the daemon
+    and the extender export separate registries (utils/metrics.py)."""
+
+    retries: object  # Metric counter, labeled by verb
+    circuit_state: object  # Metric gauge
+    latency: object  # Histogram, labeled by verb + outcome
+
+
+def plugin_metrics() -> ResilienceMetrics:
+    from . import metrics
+
+    return ResilienceMetrics(
+        retries=metrics.KUBE_RETRIES,
+        circuit_state=metrics.KUBE_CIRCUIT_STATE,
+        latency=metrics.KUBE_REQUEST_LATENCY,
+    )
+
+
+def extender_metrics() -> ResilienceMetrics:
+    from . import metrics
+
+    return ResilienceMetrics(
+        retries=metrics.EXT_KUBE_RETRIES,
+        circuit_state=metrics.EXT_KUBE_CIRCUIT_STATE,
+        latency=metrics.EXT_KUBE_REQUEST_LATENCY,
+    )
+
+
+# Thread-local marker proving a frame is executing inside Resilience.call
+# — tests/test_chaos.py wraps the HTTP session with it to assert that NO
+# kube/client.py request site bypasses the resilience layer.
+_ACTIVE = threading.local()
+
+
+def in_resilient_call() -> bool:
+    return getattr(_ACTIVE, "depth", 0) > 0
+
+
+class Resilience:
+    """One retry/backoff/deadline/circuit pipeline, shared by every
+    call of one KubeClient (kube/client.py constructs a default; the
+    extender entrypoint wires one backed by the extender registry)."""
+
+    def __init__(
+        self,
+        policy: Optional[RetryPolicy] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        budget: Optional[RetryBudget] = None,
+        metrics: Optional[ResilienceMetrics] = None,
+        classify: Callable[[BaseException], bool] = retryable,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.policy = policy or RetryPolicy()
+        self.metrics = metrics if metrics is not None else plugin_metrics()
+        self.breaker = breaker or CircuitBreaker(
+            on_state_change=self.metrics.circuit_state.set
+        )
+        if breaker is not None and breaker._on_state_change is None:
+            breaker._on_state_change = self.metrics.circuit_state.set
+        self.budget = budget or RetryBudget()
+        self.classify = classify
+        self._clock = clock
+        self._sleep = sleep
+
+    def call(
+        self,
+        fn: Callable[[], object],
+        verb: str = "",
+        deadline_s: Optional[float] = None,
+        max_attempts: Optional[int] = None,
+    ):
+        """Run ``fn`` under the policy. Semantic errors (non-retryable)
+        propagate unchanged on the first attempt; transport-level
+        failures are retried with jittered backoff until attempts,
+        deadline, or the retry budget run out — then UnavailableError.
+        """
+        if not self.breaker.allow():
+            raise CircuitOpenError(
+                "kube API circuit open (recent calls failed at the "
+                "transport level); failing fast until the reset probe"
+            )
+        deadline = self._clock() + (
+            self.policy.deadline_s if deadline_s is None else deadline_s
+        )
+        attempts = max_attempts or self.policy.max_attempts
+        last: Optional[BaseException] = None
+        _ACTIVE.depth = getattr(_ACTIVE, "depth", 0) + 1
+        try:
+            for attempt in range(attempts):
+                t0 = self._clock()
+                try:
+                    result = fn()
+                except Exception as e:  # noqa: BLE001 — classified below
+                    self.metrics.latency.observe(
+                        self._clock() - t0, verb=verb, outcome="error"
+                    )
+                    if not self.classify(e):
+                        # Semantic answer: the apiserver is alive.
+                        self.breaker.record_success()
+                        raise
+                    self.breaker.record_failure()
+                    last = e
+                    if not self.breaker.allow():
+                        break  # tripped mid-call: stop hammering
+                    if attempt + 1 >= attempts:
+                        break
+                    delay = delay_for_attempt(
+                        attempt,
+                        self.policy.base_delay_s,
+                        self.policy.max_delay_s,
+                        self.policy.jitter,
+                    )
+                    if self._clock() + delay >= deadline:
+                        break
+                    if not self.budget.try_spend():
+                        log.warning(
+                            "kube retry budget exhausted; failing %s fast",
+                            verb or "call",
+                        )
+                        break
+                    self.metrics.retries.inc(verb=verb)
+                    self._sleep(delay)
+                else:
+                    self.metrics.latency.observe(
+                        self._clock() - t0, verb=verb, outcome="ok"
+                    )
+                    self.breaker.record_success()
+                    return result
+        finally:
+            _ACTIVE.depth -= 1
+        raise UnavailableError(
+            f"kube API unavailable after {attempts} attempt(s) for "
+            f"{verb or 'call'}: {last}"
+        ) from last
+
+
+class PendingWrites:
+    """Degradation queue for state-publishing writes: a patch that
+    cannot reach the apiserver is parked here (deduped by key, newest
+    wins — a newer annotation value for the same pod supersedes the
+    queued one) and replayed by ``drain()`` once connectivity returns.
+
+    Drain semantics: success or a SEMANTIC error (pod deleted → 404)
+    removes the entry; another UnavailableError stops the drain and
+    keeps the remainder for the next reconnect. Bounded: past
+    ``max_items`` the oldest entry is dropped loudly — unbounded growth
+    during a long partition would be its own outage."""
+
+    def __init__(self, max_items: int = 1000, gauge=None):
+        self.max_items = max_items
+        self._gauge = gauge
+        self._lock = threading.Lock()
+        self._items: "Dict[object, Tuple[Callable[[], object], str]]" = {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def _publish_depth(self) -> None:
+        if self._gauge is not None:
+            self._gauge.set(len(self._items))
+
+    def put(self, key, fn: Callable[[], object], describe: str = "") -> None:
+        with self._lock:
+            self._items.pop(key, None)  # newest wins, moves to the end
+            self._items[key] = (fn, describe or str(key))
+            while len(self._items) > self.max_items:
+                dropped_key = next(iter(self._items))
+                _, desc = self._items.pop(dropped_key)
+                log.error(
+                    "pending-write queue full (%d); dropped oldest: %s",
+                    self.max_items, desc,
+                )
+            self._publish_depth()
+
+    def discard(self, key) -> None:
+        with self._lock:
+            self._items.pop(key, None)
+            self._publish_depth()
+
+    def _discard_entry(self, key, fn: Callable[[], object]) -> None:
+        """Remove ``key`` only if it still holds the SAME queued fn:
+        a writer may have put() a newer value for the key while drain()
+        was delivering this one — unconditional discard would silently
+        drop that newer write (lost update)."""
+        with self._lock:
+            cur = self._items.get(key)
+            if cur is not None and cur[0] is fn:
+                del self._items[key]
+            self._publish_depth()
+
+    def drain(self) -> Tuple[int, int]:
+        """(delivered, kept). Runs the queued writes in FIFO order."""
+        with self._lock:
+            batch: List[Tuple[object, Callable[[], object], str]] = [
+                (k, fn, desc) for k, (fn, desc) in self._items.items()
+            ]
+        delivered = 0
+        for key, fn, desc in batch:
+            try:
+                fn()
+            except UnavailableError as e:
+                log.warning(
+                    "pending-write drain stopped (apiserver still "
+                    "unreachable at %s): %s", desc, e,
+                )
+                break
+            except Exception as e:  # noqa: BLE001 — semantic failure:
+                # the target is gone or the write is no longer valid;
+                # keeping it would wedge the queue forever.
+                log.warning("pending write %s dropped: %s", desc, e)
+                self._discard_entry(key, fn)
+            else:
+                delivered += 1
+                log.info("queued write delivered: %s", desc)
+                self._discard_entry(key, fn)
+        return delivered, len(self)

@@ -3,14 +3,26 @@
 Implements exactly the REST surface KubeClient uses: pod list (with
 fieldSelector spec.nodeName), pod watch (close-delimited JSON-lines stream),
 pod/node PATCH. State mutations emit watch events like the real API server.
+
+Scriptable **fault injection** (``server.faults``) for the chaos suite
+(tests/test_chaos.py): 5xx storms, connection resets, response
+delays/hangs, truncated JSON bodies, dropped watch streams, and stale
+resourceVersion (410 Gone) watch errors — matchable by HTTP method,
+path regex, and bearer token (so one client can be "partitioned" while
+another keeps working). See :class:`Fault`.
 """
 
 from __future__ import annotations
 
 import copy
+import dataclasses
 import json
 import queue
+import re
+import socket as socket_mod
+import struct
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
@@ -22,6 +34,89 @@ class _JsonPatchTestFailed(Exception):
 
 class _JsonPatchUnsupported(Exception):
     pass
+
+
+@dataclasses.dataclass
+class Fault:
+    """One injection rule. ``kind``:
+
+    - ``status``: answer with HTTP ``status`` (default 500) — a 5xx
+      storm is ``times=-1`` until cleared;
+    - ``reset``: close the connection abruptly (RST via SO_LINGER) —
+      the client sees a connection error mid-request;
+    - ``hang``: sleep ``delay_s`` (set it beyond the client timeout),
+      then reset — a stuck apiserver/LB;
+    - ``delay``: sleep ``delay_s`` then answer NORMALLY — slow but
+      healthy;
+    - ``truncate_json``: answer normally but cut the body in half
+      (Content-Length matches the truncated bytes) — the client parses
+      garbage JSON;
+    - ``watch_drop``: accept the watch, emit half an event line, drop
+      the stream — a mid-stream disconnect;
+    - ``watch_410``: accept the watch, emit an ERROR event with code
+      410 — stale resourceVersion, forcing a relist.
+
+    Matching: ``method`` ("" = any), ``path_re`` (regex searched in the
+    URL path), ``token`` (substring of the Authorization header — lets
+    a test partition ONE client by its bearer token). ``times`` > 0
+    consumes the rule per matched request; -1 = until ``clear()``.
+    Watch kinds only match watch requests; other kinds match any."""
+
+    kind: str = "status"
+    status: int = 500
+    times: int = 1
+    method: str = ""
+    path_re: str = ""
+    token: str = ""
+    delay_s: float = 0.0
+    message: str = "injected fault"
+
+
+class FaultInjector:
+    """Rule list + injection log, shared by all handler threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rules: List[Fault] = []
+        # (kind, method, path) per injected fault — test observability.
+        self.injected: List[Tuple[str, str, str]] = []
+
+    def add(self, **kw) -> Fault:
+        fault = Fault(**kw)
+        with self._lock:
+            self.rules.append(fault)
+        return fault
+
+    def clear(self) -> None:
+        with self._lock:
+            self.rules.clear()
+
+    def count(self, kind: str = "") -> int:
+        with self._lock:
+            return sum(
+                1 for k, _, _ in self.injected if not kind or k == kind
+            )
+
+    def pick(
+        self, method: str, path: str, auth: str, watch: bool
+    ) -> Optional[Fault]:
+        with self._lock:
+            for f in self.rules:
+                if f.times == 0:
+                    continue
+                if f.method and f.method != method:
+                    continue
+                if f.kind.startswith("watch_") and not watch:
+                    continue
+                if f.path_re and not re.search(f.path_re, path):
+                    continue
+                if f.token and f.token not in (auth or ""):
+                    continue
+                if f.times > 0:
+                    f.times -= 1
+                self.injected.append((f.kind, method, path))
+                return f
+        return None
 
 
 class FakeApiServer:
@@ -53,6 +148,8 @@ class FakeApiServer:
         # coordination.k8s.io: (ns, name) -> Lease (extender singleton
         # fence).
         self._leases: Dict[Tuple[str, str], dict] = {}
+        # Scriptable fault injection (see Fault above).
+        self.faults = FaultInjector()
         self._watchers: List["queue.Queue"] = []
         # (rv, event) log so watches replay from a resourceVersion like the
         # real API server does.
@@ -120,6 +217,8 @@ class FakeApiServer:
                 pass
 
             def do_GET(self):
+                if server._apply_fault(self, "GET"):
+                    return
                 parsed = urllib.parse.urlparse(self.path)
                 params = dict(urllib.parse.parse_qsl(parsed.query))
                 if parsed.path == "/api/v1/pods":
@@ -192,6 +291,8 @@ class FakeApiServer:
                     self.send_error(404)
 
             def do_POST(self):
+                if server._apply_fault(self, "POST"):
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 parts = self.path.strip("/").split("/")
@@ -265,6 +366,8 @@ class FakeApiServer:
                     self.send_error(404)
 
             def do_PUT(self):
+                if server._apply_fault(self, "PUT"):
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 parts = self.path.strip("/").split("/")
@@ -325,6 +428,8 @@ class FakeApiServer:
                     self.send_error(404)
 
             def do_DELETE(self):
+                if server._apply_fault(self, "DELETE"):
+                    return
                 parts = self.path.strip("/").split("/")
                 if (
                     len(parts) == 5
@@ -344,6 +449,8 @@ class FakeApiServer:
                     self.send_error(404)
 
             def do_PATCH(self):
+                if server._apply_fault(self, "PATCH"):
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 parts = self.path.strip("/").split("/")
@@ -382,10 +489,69 @@ class FakeApiServer:
             self._httpd.server_close()
             self._httpd = None
 
+    # -- fault injection ---------------------------------------------------
+
+    def _apply_fault(self, handler, method: str) -> bool:
+        """Consult the fault rules for this request. True = the fault
+        consumed the request (the handler must return immediately);
+        False = continue normal processing (possibly delayed, or with a
+        truncation/watch flag set on the handler)."""
+        parsed = urllib.parse.urlparse(handler.path)
+        params = dict(urllib.parse.parse_qsl(parsed.query))
+        fault = self.faults.pick(
+            method,
+            parsed.path,
+            handler.headers.get("Authorization", ""),
+            watch=params.get("watch") == "true",
+        )
+        if fault is None:
+            return False
+        if fault.delay_s and fault.kind in ("delay", "hang", "status"):
+            time.sleep(fault.delay_s)
+        if fault.kind == "delay":
+            return False
+        if fault.kind == "truncate_json":
+            handler._truncate_body = True
+            return False
+        if fault.kind in ("watch_drop", "watch_410"):
+            handler._watch_fault = fault
+            return False
+        if fault.kind == "status":
+            self._send_json(
+                handler,
+                {"message": fault.message, "code": fault.status},
+                fault.status,
+            )
+            return True
+        if fault.kind in ("reset", "hang"):
+            # RST on close (SO_LINGER 0) so the client sees a genuine
+            # connection reset rather than a clean FIN.
+            try:
+                handler.connection.setsockopt(
+                    socket_mod.SOL_SOCKET,
+                    socket_mod.SO_LINGER,
+                    struct.pack("ii", 1, 0),
+                )
+            except OSError:
+                pass
+            try:
+                handler.connection.close()
+            except OSError:
+                pass
+            handler.close_connection = True
+            return True
+        raise ValueError(f"unknown fault kind {fault.kind!r}")
+
     # -- handlers ----------------------------------------------------------
 
     def _send_json(self, handler, obj, code=200):
         data = json.dumps(obj).encode()
+        if getattr(handler, "_truncate_body", False):
+            # Injected truncation: Content-Length matches the cut body,
+            # so the client reads a complete response whose JSON is
+            # garbage (a proxy/apiserver dying mid-marshal).
+            handler._truncate_body = False
+            data = data[: max(1, len(data) // 2)]
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(data)))
@@ -429,6 +595,33 @@ class FakeApiServer:
         )
 
     def _handle_watch(self, handler, params):
+        fault = getattr(handler, "_watch_fault", None)
+        if fault is not None:
+            handler._watch_fault = None
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.end_headers()
+            if fault.kind == "watch_410":
+                # Stale resourceVersion: the ERROR event shape a real
+                # apiserver streams before ending the watch.
+                handler.wfile.write(
+                    json.dumps(
+                        {
+                            "type": "ERROR",
+                            "object": {
+                                "kind": "Status",
+                                "code": 410,
+                                "message": "too old resource version "
+                                           "(injected)",
+                            },
+                        }
+                    ).encode()
+                    + b"\n"
+                )
+            else:  # watch_drop: half an event line, then the stream dies
+                handler.wfile.write(b'{"type":"MODIF')
+            handler.wfile.flush()
+            return
         q: "queue.Queue" = queue.Queue()
         since = int(params.get("resourceVersion", 0) or 0)
         with self._lock:

@@ -91,12 +91,32 @@ class FakePodResources(PodResourcesListerServicer):
         self.pods = {}
         self.allocatable = {}  # resource_name -> [device_ids]
         self.fail = False
+        # Scriptable transient faults (chaos suite): the next
+        # ``fail_times`` RPCs abort UNAVAILABLE then the endpoint
+        # recovers (a kubelet mid-restart); ``delay_s`` stalls every
+        # RPC first (a loaded kubelet).
+        self.fail_times = 0
+        self.delay_s = 0.0
         self._server: Optional[grpc.Server] = None
 
     def set_pod(self, namespace, name, resource_name, device_ids) -> None:
         self.pods.setdefault((namespace, name), {})[resource_name] = list(
             device_ids
         )
+
+    def _maybe_fault(self, context) -> None:
+        if self.delay_s:
+            import time
+
+            time.sleep(self.delay_s)
+        if self.fail:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "injected failure")
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                "injected transient failure (kubelet restarting)",
+            )
 
     # PodResourcesLister service --------------------------------------------
 
@@ -109,8 +129,7 @@ class FakePodResources(PodResourcesListerServicer):
         return pod
 
     def List(self, request, context) -> prpb.ListPodResourcesResponse:
-        if self.fail:
-            context.abort(grpc.StatusCode.UNAVAILABLE, "injected failure")
+        self._maybe_fault(context)
         resp = prpb.ListPodResourcesResponse()
         for key in self.pods:
             resp.pod_resources.append(self._pod_msg(key))
@@ -119,16 +138,14 @@ class FakePodResources(PodResourcesListerServicer):
     def GetAllocatableResources(
         self, request, context
     ) -> prpb.AllocatableResourcesResponse:
-        if self.fail:
-            context.abort(grpc.StatusCode.UNAVAILABLE, "injected failure")
+        self._maybe_fault(context)
         resp = prpb.AllocatableResourcesResponse()
         for resource, ids in self.allocatable.items():
             resp.devices.add(resource_name=resource, device_ids=ids)
         return resp
 
     def Get(self, request, context) -> prpb.GetPodResourcesResponse:
-        if self.fail:
-            context.abort(grpc.StatusCode.UNAVAILABLE, "injected failure")
+        self._maybe_fault(context)
         if not self.serve_get:
             context.abort(
                 grpc.StatusCode.UNIMPLEMENTED, "Get requires kubelet >= 1.27"

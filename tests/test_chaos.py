@@ -1,0 +1,570 @@
+"""Control-plane chaos scenarios (fault-injection harness).
+
+Drives the unified resilience layer (utils/resilience.py) and its
+consumers against scripted apiserver/kubelet misbehavior
+(tests/fake_apiserver.py Fault, tests/fake_kubelet.py): 5xx storms,
+connection resets, hangs, truncated JSON, dropped watch streams, stale
+resourceVersion, and per-client partitions. Asserts the ISSUE
+acceptance criteria: the controller converges with no lost pod
+annotation, the circuit breaker trips and recovers visibly in metrics,
+a partitioned lease holder self-demotes with zero dual-admission, and
+every kube/client.py request site verifiably flows through the
+resilience layer.
+"""
+
+import threading
+import time
+
+import pytest
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.controller.controller import Controller
+from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+from k8s_device_plugin_tpu.extender.leader import LeaderLease, SecondReplica
+from k8s_device_plugin_tpu.kube.client import KubeClient, KubeError
+from k8s_device_plugin_tpu.server.plugin import PluginConfig, TpuDevicePlugin
+from k8s_device_plugin_tpu.topology.mesh import IciMesh
+from k8s_device_plugin_tpu.utils import metrics
+from k8s_device_plugin_tpu.utils import resilience as rz
+from tests import fakes
+from tests.fake_apiserver import FakeApiServer
+from tests.test_controller import (
+    NODE,
+    make_controller,
+    pod_dict,
+    wait_for,
+    write_checkpoint,
+)
+
+
+@pytest.fixture
+def api():
+    s = FakeApiServer()
+    url = s.start()
+    s.add_node(NODE)
+    yield s, KubeClient(url)
+    s.stop()
+
+
+@pytest.fixture
+def plugin(tmp_path):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5p", 4)
+    chips = PyTpuInfo().scan(accel, dev)
+    return TpuDevicePlugin(
+        IciMesh(chips), config=PluginConfig(libtpu_host_path="")
+    )
+
+
+def fast_resilience(
+    max_attempts=3, deadline_s=2.0, threshold=5, reset_timeout_s=0.3,
+    metrics_set=None,
+):
+    """Test-speed policy: millisecond backoff, sub-second deadlines."""
+    return rz.Resilience(
+        policy=rz.RetryPolicy(
+            max_attempts=max_attempts,
+            base_delay_s=0.01,
+            max_delay_s=0.05,
+            deadline_s=deadline_s,
+        ),
+        breaker=rz.CircuitBreaker(
+            failure_threshold=threshold, reset_timeout_s=reset_timeout_s
+        ),
+        metrics=metrics_set,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Resilience layer unit behavior against injected faults
+# ---------------------------------------------------------------------------
+
+def test_transient_5xx_is_retried_to_success(api):
+    server, client = api
+    client.resilience = fast_resilience()
+    server.faults.add(kind="status", status=503, times=2)
+    node = client.get_node(NODE)  # two 503s absorbed, third attempt lands
+    assert node["metadata"]["name"] == NODE
+    assert metrics.KUBE_RETRIES.get(verb="GET") >= 2
+
+
+def test_connection_reset_is_retried(api):
+    server, client = api
+    client.resilience = fast_resilience()
+    server.faults.add(kind="reset", times=1)
+    assert client.get_node(NODE)["metadata"]["name"] == NODE
+
+
+def test_truncated_json_is_retried(api):
+    server, client = api
+    client.resilience = fast_resilience()
+    server.faults.add(kind="truncate_json", times=1)
+    pods = client.list_pods(node_name=NODE)
+    assert pods["kind"] == "PodList"
+    assert server.faults.count("truncate_json") == 1
+
+
+def test_semantic_errors_pass_through_without_retry(api):
+    server, client = api
+    client.resilience = fast_resilience()
+    before = metrics.KUBE_RETRIES.get(verb="GET")
+    with pytest.raises(KubeError) as err:
+        client.get_node("no-such-node")
+    assert err.value.status_code == 404
+    assert metrics.KUBE_RETRIES.get(verb="GET") == before  # zero retries
+
+
+def test_hang_is_bounded_by_deadline(api):
+    server, client = api
+    client.timeout = 0.3  # per-attempt read timeout
+    client.resilience = fast_resilience(max_attempts=2, deadline_s=1.0)
+    server.faults.add(kind="hang", delay_s=1.0, times=-1)
+    t0 = time.monotonic()
+    with pytest.raises(rz.UnavailableError):
+        client.get_node(NODE)
+    assert time.monotonic() - t0 < 3.0  # deadline, not attempts*hang
+
+
+def test_5xx_storm_trips_and_recovers_circuit_breaker(api):
+    """Acceptance: a 5xx storm opens the breaker (fail-fast, visible in
+    metrics) and the half-open probe closes it once the storm ends."""
+    server, client = api
+    res = fast_resilience(max_attempts=2, threshold=3, reset_timeout_s=0.3)
+    client.resilience = res
+    server.faults.add(kind="status", status=500, times=-1)
+    for _ in range(4):
+        with pytest.raises(OSError):
+            client.get_node(NODE)
+        if res.breaker.state == rz.OPEN:
+            break
+    assert res.breaker.state == rz.OPEN
+    assert "tpu_plugin_kube_circuit_state 1" in metrics.REGISTRY.render()
+    # Open circuit: fail fast without touching the network.
+    injected_before = server.faults.count()
+    with pytest.raises(rz.CircuitOpenError):
+        client.get_node(NODE)
+    assert server.faults.count() == injected_before
+    # Storm ends; after the reset timeout the half-open probe closes it.
+    server.faults.clear()
+    time.sleep(0.35)
+    assert client.get_node(NODE)["metadata"]["name"] == NODE
+    assert res.breaker.state == rz.CLOSED
+    assert "tpu_plugin_kube_circuit_state 0" in metrics.REGISTRY.render()
+    assert metrics.KUBE_RETRIES.get(verb="GET") > 0
+
+
+def test_all_client_calls_flow_through_resilience(api):
+    """Acceptance: no raw unretried request site remains in
+    kube/client.py — every HTTP request the session sends must happen
+    inside Resilience.call (thread-local marker)."""
+    server, client = api
+    server.add_pod(pod_dict("p1", "u1", tpus=1))
+    server.add_pod(
+        pod_dict(
+            "gated", "u2", tpus=1,
+        )
+    )
+    server.pods[("default", "gated")]["spec"]["schedulingGates"] = [
+        {"name": "g"}
+    ]
+    orig = client._session.request
+    raw_sites = []
+
+    def spy(method, url, **kw):
+        if not rz.in_resilient_call():
+            raw_sites.append((method, url))
+        return orig(method, url, **kw)
+
+    client._session.request = spy
+    # Every public request-making method on KubeClient:
+    client.get_node(NODE)
+    client.list_nodes()
+    client.list_nodes(label_selector="a=b")
+    client.patch_node_annotations(NODE, {"k": "v"})
+    client.patch_node_labels(NODE, {"l": "v"})
+    client.patch_node_condition(NODE, {"type": "T", "status": "True"})
+    client.list_pods(node_name=NODE)
+    client.get_pod("default", "p1")
+    client.patch_pod_annotations("default", "p1", {"a": "1"})
+    client.remove_pod_scheduling_gate(
+        "default", "gated", "g", [{"name": "g"}]
+    )
+    client.create_event(
+        "default", {"kind": "Pod", "name": "p1"}, "R", "m"
+    )
+    client.evict_pod("default", "p1")
+    client.create(
+        "/apis/coordination.k8s.io/v1/namespaces/ns/leases",
+        {"metadata": {"name": "l", "namespace": "ns"}, "spec": {}},
+    )
+    lease = client.get(
+        "/apis/coordination.k8s.io/v1/namespaces/ns/leases/l"
+    )
+    client.replace(
+        "/apis/coordination.k8s.io/v1/namespaces/ns/leases/l", lease
+    )
+    with pytest.raises(KubeError):
+        client.delete("/apis/resource.k8s.io/v1/resourceslices/none")
+    for _ in client.watch_pods(node_name=NODE, timeout_seconds=1):
+        break
+    assert not raw_sites, f"raw unretried request sites: {raw_sites}"
+
+
+# ---------------------------------------------------------------------------
+# Controller chaos: watch drops, 410 resync, outage-queued patches
+# ---------------------------------------------------------------------------
+
+def test_watch_drop_and_410_resync_converge_controller(api, plugin, tmp_path):
+    """Acceptance: dropped watch streams plus a stale-resourceVersion
+    (410) resync converge the controller — the pod annotation lands and
+    the daemon never crash-loops."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    ctrl.client.resilience = fast_resilience()
+    ctrl.resync_interval_s = 1.0
+    ctrl._watch_backoff = rz.Backoff(base=0.05, max_delay=0.2)
+    server.faults.add(kind="watch_drop", times=2)
+    server.faults.add(kind="watch_410", times=1)
+    server.add_pod(pod_dict("jax-pod", "uid-1", tpus=2))
+    write_checkpoint(tmp_path, {"uid-1": ids[:2]})
+    ctrl.start()
+    try:
+        assert wait_for(lambda: server.pod_patches, timeout=10)
+        ns, name, body = server.pod_patches[0]
+        assert (ns, name) == ("default", "jax-pod")
+        got = body["metadata"]["annotations"][
+            constants.POD_DEVICES_ANNOTATION
+        ]
+        assert got == ",".join(sorted(ids[:2]))
+        # The faults actually fired (the convergence wasn't a clean run).
+        assert server.faults.count("watch_drop") == 2
+        assert server.faults.count("watch_410") == 1
+    finally:
+        ctrl.stop()
+
+
+def test_outage_queues_pod_annotation_and_drains_on_reconnect(
+    api, plugin, tmp_path
+):
+    """Acceptance: no pod annotation is lost. While every PATCH answers
+    503, the computed annotation parks in the pending-write queue
+    (visible in the gauge); once the apiserver recovers, the next relist
+    drains it."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    ctrl.client.resilience = fast_resilience(max_attempts=2, threshold=100)
+    ctrl.resync_interval_s = 0.5
+    server.faults.add(kind="status", status=503, times=-1, method="PATCH")
+    server.add_pod(pod_dict("jax-pod", "uid-1", tpus=2))
+    write_checkpoint(tmp_path, {"uid-1": ids[:2]})
+    ctrl.start()
+    try:
+        assert wait_for(lambda: len(ctrl._pending_writes) == 1, timeout=10)
+        assert metrics.KUBE_QUEUED_WRITES.get() == 1
+        assert not server.pod_patches  # nothing landed during the outage
+        # Local state proceeded: the kubelet already handed chips over.
+        assert set(ids[:2]).issubset(plugin.state.allocated)
+        server.faults.clear()  # apiserver recovers
+        assert wait_for(lambda: server.pod_patches, timeout=10)
+        _, _, body = server.pod_patches[0]
+        got = body["metadata"]["annotations"][
+            constants.POD_DEVICES_ANNOTATION
+        ]
+        assert got == ",".join(sorted(ids[:2]))
+        assert wait_for(lambda: len(ctrl._pending_writes) == 0, timeout=5)
+        assert metrics.KUBE_QUEUED_WRITES.get() == 0
+    finally:
+        ctrl.stop()
+
+
+def test_controller_survives_apiserver_outage_at_start(
+    api, plugin, tmp_path
+):
+    """The daemon must not crash-loop when it boots into an outage:
+    start() succeeds with every request answered 500, and the informer
+    converges once the apiserver comes back."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    ctrl.client.resilience = fast_resilience(max_attempts=2, threshold=100)
+    ctrl.resync_interval_s = 0.5
+    ctrl._watch_backoff = rz.Backoff(base=0.05, max_delay=0.2)
+    server.faults.add(kind="status", status=500, times=-1)
+    server.add_pod(pod_dict("jax-pod", "uid-1", tpus=2))
+    write_checkpoint(tmp_path, {"uid-1": ids[:2]})
+    ctrl.start()  # must not raise despite the storm
+    try:
+        time.sleep(0.3)
+        server.faults.clear()
+        assert wait_for(lambda: server.pod_patches, timeout=10)
+    finally:
+        ctrl.stop()
+
+
+def test_kubelet_podresources_transient_failure_converges(
+    api, plugin, tmp_path
+):
+    """A kubelet mid-restart (PodResources RPCs transiently UNAVAILABLE)
+    degrades to the checkpoint file and later resyncs converge."""
+    from tests.fake_kubelet import FakePodResources
+
+    ids = plugin.mesh.ids
+    server, client = api
+    podres = FakePodResources(
+        str(tmp_path / "pod-resources" / "kubelet.sock")
+    )
+    podres.fail_times = 3  # every early RPC aborts, then recovery
+    podres.set_pod("default", "jax-pod", "google.com/tpu", ids[:2])
+    podres.start()
+    path = write_checkpoint(tmp_path, {"uid-1": ids[:2]})
+    ctrl = Controller(
+        client, plugin, node_name=NODE, checkpoint_path=path,
+        podresources_socket=podres.socket_path, watch_timeout_s=2,
+        resync_interval_s=0.5,
+    )
+    server.add_pod(pod_dict("jax-pod", "uid-1", tpus=2))
+    ctrl.start()
+    try:
+        assert wait_for(lambda: server.pod_patches, timeout=10)
+        got = server.pod_patches[0][2]["metadata"]["annotations"][
+            constants.POD_DEVICES_ANNOTATION
+        ]
+        assert got == ",".join(sorted(ids[:2]))
+    finally:
+        ctrl.stop()
+        podres.stop()
+
+
+# ---------------------------------------------------------------------------
+# Lease partition: self-demotion strictly before takeover
+# ---------------------------------------------------------------------------
+
+def test_partition_during_lease_hold_self_demotes_before_takeover(api):
+    """Acceptance: an apiserver partition during lease hold self-demotes
+    the admitter with zero dual-admission — the partitioned holder fires
+    on_lost strictly BEFORE a replacement can take the stale lease
+    over."""
+    server, client0 = api
+    base = client0.base_url
+    client_a = KubeClient(base, token="tok-a")
+    client_a.resilience = fast_resilience(
+        max_attempts=2, deadline_s=0.5, threshold=100
+    )
+    lost_at = []
+    # leaseDurationSeconds is written whole-second (like the real API
+    # type) and renewTime is second-precision, so the takeover horizon
+    # quantizes to ~duration-1s in the worst case: keep the renew
+    # deadline well inside it (here 1s vs a 4s lease) exactly as the
+    # 2/3 default does at production scale (10s vs 15s).
+    leader_a = LeaderLease(
+        client_a, identity="rep-a", lease_seconds=4.0,
+        renew_deadline_s=1.0,
+        on_lost=lambda: lost_at.append(time.monotonic()),
+    )
+    leader_a.start()
+    try:
+        time.sleep(0.5)  # at least one clean renewal
+        # Partition ONLY rep-a's client (matched by its bearer token).
+        server.faults.add(kind="reset", times=-1, token="tok-a")
+        # rep-b keeps polling for the lease like a rescheduled pod.
+        client_b = KubeClient(base, token="tok-b")
+        client_b.resilience = fast_resilience(threshold=100)
+        acquired_at = []
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not acquired_at:
+            try:
+                LeaderLease(
+                    client_b, identity="rep-b", lease_seconds=4.0
+                ).acquire()
+                acquired_at.append(time.monotonic())
+            except SecondReplica:
+                time.sleep(0.1)
+        assert acquired_at, "replacement never took the stale lease over"
+        assert lost_at, "partitioned holder never self-demoted"
+        # Zero dual-admission: demotion strictly precedes takeover.
+        assert lost_at[0] < acquired_at[0], (
+            f"dual-admitter window: demoted at {lost_at[0]}, "
+            f"taken over at {acquired_at[0]}"
+        )
+        assert metrics.LEASE_SELF_DEMOTIONS.get(reason="renew_deadline") > 0
+        assert "tpu_extender_lease_held 0" in (
+            metrics.EXTENDER_REGISTRY.render()
+        )
+    finally:
+        server.faults.clear()
+        leader_a.stop()
+
+
+def test_partitioned_extender_process_exits_hard(api, tmp_path):
+    """E2E through the real entrypoint: an extender whose apiserver is
+    partitioned away must EXIT (nonzero) at the renew deadline — a hard
+    exit, so no in-flight admission write under the client's retry
+    envelope can land after the stale lease becomes takeover-able."""
+    import os
+    import subprocess
+    import sys
+
+    from tests.test_leader import REPO, _kubeconfig
+
+    server, client0 = api
+    kubeconfig = _kubeconfig(tmp_path, client0.base_url)
+    env = {
+        k: v for k, v in os.environ.items()
+        if k != "PALLAS_AXON_POOL_IPS"
+    }
+    env["HOSTNAME"] = "chaos-rep-1"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "k8s_device_plugin_tpu.extender",
+            "--host", "127.0.0.1", "--port", "0", "--gang-admission",
+            "--lease-seconds", "3", "--kubeconfig", kubeconfig,
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=REPO, env=env, text=True,
+    )
+    try:
+        key = ("kube-system", "tpu-scheduler-extender")
+        deadline = time.time() + 15
+        while time.time() < deadline and (
+            key not in server.leases
+            or server.leases[key]["spec"]["holderIdentity"] != "chaos-rep-1"
+        ):
+            time.sleep(0.1)
+        assert server.leases[key]["spec"]["holderIdentity"] == "chaos-rep-1"
+        # Partition: every request from now on dies at the transport
+        # level (reset), matching no specific client — total outage.
+        server.faults.add(kind="reset", times=-1)
+        out, _ = proc.communicate(timeout=30)
+        assert proc.returncode == 1, out
+        assert "lease lost" in out
+        # Hard exit means NO graceful release: the lease must still
+        # name the dead holder (it ages out; the successor takes it
+        # over stale) — holderIdentity == "" here would mean the slow
+        # release path ran after all.
+        assert server.leases[key]["spec"]["holderIdentity"] == "chaos-rep-1"
+    finally:
+        server.faults.clear()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_renewal_attempt_clamped_to_renew_budget(api):
+    """A HANGING apiserver must not let one renewal attempt outlive the
+    renew deadline: the lease loop clamps each RPC's deadline AND
+    request timeout to the remaining renew budget, so demotion still
+    fires ~at the deadline — with the client's default 10s request
+    timeout unclamped, a single hung GET would keep the holder
+    admitting well past the takeover horizon."""
+    server, client0 = api
+    client = KubeClient(client0.base_url, token="tok-hang")
+    lost = []
+    ll = LeaderLease(
+        client, identity="rep-a", lease_seconds=6.0,
+        renew_deadline_s=1.0,
+        on_lost=lambda: lost.append(time.monotonic()),
+    )
+    ll.start()
+    try:
+        t0 = time.monotonic()
+        server.faults.add(
+            kind="hang", delay_s=3.0, times=-1, token="tok-hang"
+        )
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and not lost:
+            time.sleep(0.05)
+        assert lost, "holder never demoted under a hanging apiserver"
+        assert lost[0] - t0 < 5.0, (
+            f"demotion took {lost[0] - t0:.1f}s — the renewal attempt "
+            "was not clamped to the renew budget"
+        )
+    finally:
+        server.faults.clear()
+        ll.stop()
+
+
+def test_queued_annotation_not_stamped_on_reincarnated_pod(
+    api, plugin, tmp_path
+):
+    """A patch queued during an outage belongs to one pod INCARNATION:
+    if the pod is deleted and recreated under the same namespace/name
+    while the apiserver is unreachable (the DELETED event lost with the
+    dropped watch), the drain must DROP the stale write instead of
+    stamping the old incarnation's chips onto the new pod."""
+    ids = plugin.mesh.ids
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    ctrl.client.resilience = fast_resilience(max_attempts=2, threshold=100)
+    ctrl.resync_interval_s = 0.5
+    server.faults.add(kind="status", status=503, times=-1, method="PATCH")
+    server.add_pod(pod_dict("jax-pod", "uid-1", tpus=2))
+    write_checkpoint(tmp_path, {"uid-1": ids[:2]})
+    ctrl.start()
+    try:
+        assert wait_for(lambda: len(ctrl._pending_writes) == 1, timeout=10)
+        # The pod is replaced under the same name mid-outage (a
+        # StatefulSet recreation the watch never saw).
+        with server._lock:
+            server.pods[("default", "jax-pod")]["metadata"]["uid"] = "uid-2"
+        server.faults.clear()
+        # The drain (after the next relist) drops the entry on the uid
+        # mismatch — and nothing ever patches uid-1's chips onto uid-2.
+        assert wait_for(lambda: len(ctrl._pending_writes) == 0, timeout=10)
+        assert not server.pod_patches
+    finally:
+        ctrl.stop()
+
+
+def test_pending_writes_drain_preserves_newer_entry_queued_mid_drain():
+    """'Newest wins' must hold ACROSS a drain: a write re-queued for
+    the same key while drain() delivers the older snapshot must survive
+    (unconditional post-deliver discard would silently drop it)."""
+    pw = rz.PendingWrites()
+    delivered = []
+
+    def new_fn():
+        delivered.append("new")
+
+    def old_fn():
+        # While the drain delivers the old value, the workqueue thread
+        # queues a NEWER value for the same key.
+        pw.put("k", new_fn, "new")
+        delivered.append("old")
+
+    pw.put("k", old_fn, "old")
+    pw.drain()
+    assert delivered == ["old"]
+    assert len(pw) == 1, "newer write queued mid-drain was lost"
+    pw.drain()
+    assert delivered == ["old", "new"]
+    assert len(pw) == 0
+
+
+def test_gang_admission_serves_last_known_topology_through_outage(api):
+    """Graceful degradation: with the apiserver's node list failing, the
+    admitter's capacity view degrades to the last successful relist
+    instead of crashing the tick (explain() keeps answering)."""
+    from k8s_device_plugin_tpu.extender.gang import GangAdmission
+    from tests.test_extender import make_node
+
+    server, client = api
+    client.resilience = fast_resilience(max_attempts=2, threshold=100)
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    adm = GangAdmission(client)
+    assert len(adm._node_topologies()) == 1  # warm the last-known view
+    server.faults.add(kind="status", status=503, times=-1, method="GET")
+    topos = adm._node_topologies()  # served from the last-known view
+    assert [t.hostname for t in topos] == ["n1"]
+    server.faults.clear()
+
+
+def test_pending_writes_drop_for_vanished_target(api):
+    """A queued write whose target is gone (404 at drain) is dropped,
+    not retried forever — the queue cannot wedge."""
+    server, client = api
+    client.resilience = fast_resilience()
+    pw = rz.PendingWrites()
+    pw.put(
+        ("pod-ann", "default", "ghost"),
+        lambda: client.patch_pod_annotations("default", "ghost", {"a": "1"}),
+    )
+    delivered, kept = pw.drain()
+    assert delivered == 0 and kept == 0

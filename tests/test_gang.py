@@ -406,7 +406,9 @@ def test_explain_reports_every_gang_state(api, tmp_path):
         env=env,
     )
     assert out.returncode == 0, out.stderr
-    parsed = {r["gang"]: r for r in _json.loads(out.stdout)["gangs"]}
+    # --json emits a BARE LIST of gang reports (the stable machine
+    # contract; diagnostics go to stderr — docs/operations.md).
+    parsed = {r["gang"]: r for r in _json.loads(out.stdout)}
     assert set(parsed) == {"incomplete", "blocked", "fits", "released"}
 
 

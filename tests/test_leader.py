@@ -207,6 +207,159 @@ def test_lease_held_metric_tracks_acquisition_and_loss(api):
         ll.stop()
 
 
+def test_stop_releases_lease_and_successor_acquires_instantly(api):
+    """Graceful-stop release (ADVICE r5 high): stop() clears
+    holderIdentity, so the next pod (Recreate rollout, drain, restart)
+    acquires immediately instead of CrashLoopBackOff-ing against a
+    fresh renewTime for up to lease_seconds."""
+    server, client = api
+    old = LeaderLease(client, identity="pod-old", lease_seconds=30.0)
+    old.start()
+    old.stop()
+    lease = server.leases[("kube-system", "tpu-scheduler-extender")]
+    assert lease["spec"]["holderIdentity"] == ""
+    # Successor: no SecondReplica, no staleness wait (a 30s lease is
+    # nowhere near aged out — only the release makes this instant).
+    LeaderLease(client, identity="pod-new", lease_seconds=30.0).acquire()
+    lease = server.leases[("kube-system", "tpu-scheduler-extender")]
+    assert lease["spec"]["holderIdentity"] == "pod-new"
+
+
+def test_stop_after_takeover_leaves_new_holder_untouched(api):
+    """The release is conditional: a stopped lease that was ALREADY
+    taken over (we were partitioned, a successor holds it now) must not
+    clear the successor's holderIdentity."""
+    server, client = api
+    old = LeaderLease(client, identity="pod-old", lease_seconds=30.0)
+    old.acquire()
+    # Successor took the lease over while pod-old was wedged.
+    with server._lock:
+        lease = server.leases[("kube-system", "tpu-scheduler-extender")]
+        lease["spec"]["holderIdentity"] = "pod-new"
+    old.stop()
+    lease = server.leases[("kube-system", "tpu-scheduler-extender")]
+    assert lease["spec"]["holderIdentity"] == "pod-new"
+
+
+def test_zombie_renewal_after_stop_does_not_resurrect_released_lease(api):
+    """stop() can time out joining a renew thread blocked in a slow
+    RPC; when that attempt finally completes it must NOT renew or
+    re-take the lease stop() just released — that would strand the
+    lease on a dead process for up to lease_seconds."""
+    server, client = api
+    ll = LeaderLease(client, identity="rep-a", lease_seconds=30.0)
+    ll.start()
+    ll.stop()  # releases holderIdentity
+    key = ("kube-system", "tpu-scheduler-extender")
+    assert server.leases[key]["spec"]["holderIdentity"] == ""
+    ll._renew_once()  # the straggler attempt completing post-release
+    assert server.leases[key]["spec"]["holderIdentity"] == ""
+
+
+def test_rollout_under_recreate_hands_off_without_overlap(api):
+    """Satellite: deploy/tpu-extender.yml pins strategy Recreate (a
+    RollingUpdate surge deadlocks against the lease — ADVICE r5 high),
+    and the Recreate sequence (old pod fully stopped, THEN new pod
+    started) hands the lease off with zero crash-looping."""
+    import yaml
+
+    with open(os.path.join(REPO, "deploy", "tpu-extender.yml")) as f:
+        docs = list(yaml.safe_load_all(f))
+    dep = next(d for d in docs if d and d.get("kind") == "Deployment")
+    assert dep["spec"]["strategy"] == {"type": "Recreate"}
+    assert dep["spec"]["replicas"] == 1
+
+    server, client = api
+    gen1 = LeaderLease(client, identity="extender-gen1", lease_seconds=30)
+    gen1.start()
+    gen1.stop()  # Recreate: old pod terminates before the new one runs
+    gen2 = LeaderLease(client, identity="extender-gen2", lease_seconds=30)
+    gen2.start()  # acquires on the FIRST try — no CrashLoopBackOff
+    gen2.stop()
+    lease = server.leases[("kube-system", "tpu-scheduler-extender")]
+    assert lease["spec"]["leaseTransitions"] >= 1
+
+
+def test_renew_deadline_demotes_unreachable_holder(api):
+    """Renew-deadline self-demotion (ADVICE r5 medium): a holder whose
+    renewals fail past renew_deadline_s fires on_lost WITHOUT observing
+    a competitor — it can no longer prove the lease is its own."""
+    from k8s_device_plugin_tpu.utils import metrics
+
+    server, client = api
+    base = metrics.LEASE_SELF_DEMOTIONS.get(reason="renew_deadline")
+    lost = []
+    ll = LeaderLease(
+        client, identity="rep-a", lease_seconds=6.0,
+        renew_deadline_s=0.8, on_lost=lambda: lost.append(1),
+    )
+    ll.start()
+    try:
+        server.faults.add(kind="status", status=500, times=-1)
+        assert _wait(lambda: lost, 15), "renew deadline never demoted"
+        assert (
+            metrics.LEASE_SELF_DEMOTIONS.get(reason="renew_deadline")
+            > base
+        )
+        assert "tpu_extender_lease_held 0" in (
+            metrics.EXTENDER_REGISTRY.render()
+        )
+    finally:
+        server.faults.clear()
+        ll.stop()
+
+
+def test_skewed_clock_observer_does_not_take_over_renewing_holder(api):
+    """Skewed-clock non-takeover (ADVICE r5 low): an observer whose
+    wall clock reads the holder's renewTimes as ancient must still see
+    the holder as LIVE while it watches those renewTimes ADVANCE
+    (client-go's locally-observed model) — the old cross-node wall
+    clock comparison would take over a live holder here, opening a
+    dual-admitter window."""
+    server, client = api
+    holder = LeaderLease(client, identity="rep-a", lease_seconds=3.0)
+    holder.acquire()
+    observer = LeaderLease(client, identity="rep-b", lease_seconds=3.0)
+    with pytest.raises(SecondReplica):
+        observer.acquire()  # first sight: live; history recorded
+    # rep-b's node clock jumps 300s ahead — every renewTime rep-a
+    # writes now reads as long-expired on rep-b's wall clock.
+    observer._clock = lambda: time.time() + 300
+    for _ in range(2):
+        time.sleep(1.1)  # renewTime is second-precision; let it advance
+        holder._renew_once()
+        with pytest.raises(SecondReplica):
+            observer.acquire()  # observed renewal → live, no takeover
+    lease = server.leases[("kube-system", "tpu-scheduler-extender")]
+    assert lease["spec"]["holderIdentity"] == "rep-a"
+
+
+def test_holder_liveness_honors_lease_published_duration(api):
+    """_holder_is_live decays an UNCHANGED record on locally-elapsed
+    time against the lease's OWN spec.leaseDurationSeconds — not this
+    replica's configured duration, and not the record's wall-clock
+    timestamps."""
+    _, client = api
+
+    def rfc(epoch):
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(epoch))
+
+    t = [1000.0]
+    ll = LeaderLease(
+        client, identity="rep-b", lease_seconds=30.0, clock=lambda: t[0]
+    )
+    spec = {
+        "holderIdentity": "rep-a",
+        "renewTime": rfc(1000.0),
+        "leaseDurationSeconds": 5,
+    }
+    assert ll._holder_is_live(spec)  # first sight, fresh
+    t[0] = 1004.0  # within the lease's own 5s duration
+    assert ll._holder_is_live(spec)
+    t[0] = 1006.0  # past 5s — dead, even though OUR duration is 30s
+    assert not ll._holder_is_live(spec)
+
+
 def test_gang_cli_warns_on_non_holder_snapshot(api):
     """tools/gang._check_holder: empty when holders agree or the fence
     is off; a loud warning when the snapshot's replica is not the lease

@@ -453,8 +453,9 @@ def test_reservations_endpoint_and_cli_injection(api, tmp_path):
                 env=env,
             )
             assert out.returncode == 0, out.stderr
+            # Bare-list machine contract (docs/operations.md).
             return {
-                r["gang"]: r for r in _json.loads(out.stdout)["gangs"]
+                r["gang"]: r for r in _json.loads(out.stdout)
             }
 
         with_holds = run_cli("--extender-url", url)
