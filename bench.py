@@ -25,10 +25,13 @@ taught this shape):
     control-plane numbers, and a kill mid-kernels still leaves MFU.
   - Total accelerator budget is hard-capped (default 230 s, env
     ``BENCH_TOTAL_BUDGET_S``) — far below any plausible driver timeout.
-  - **Probe first** (r3 #1a): a ≤30 s devices-probe subprocess gates the
-    long smoke. No grant → re-probe on a short cadence, using any grant
-    window that opens; the 140 s smoke never runs into a chip a
-    co-tenant holds. Every probe attempt is recorded in detail.grant.
+  - **Probe first, at t=0, micro-in-probe** (r3 #1a → r5 #1): a ≤30 s
+    devices-probe subprocess gates the long smoke, and the probe LOOP
+    starts at t=0 on its own thread so its wait overlaps the chip-free
+    control-plane/scale phases instead of following them. On the first
+    grant the probe process itself runs the ~15 s micro kernel tier —
+    backend init is paid once, and any ~30 s window yields a committed
+    kernel artifact. Every probe attempt is recorded in detail.grant.
   - **Reserved kernel slice** (r3 #1b): ``BENCH_KERNEL_RESERVE_S``
     (default 60 s) of the budget belongs to the kernel microbench no
     matter what the smoke does — the cheap phase that can produce an
@@ -53,8 +56,11 @@ more complete):
   metric   time_to_first_device_s (daemon start → first train step done)
   vs_baseline  30 / value  (>1 means faster than the 30 s target)
   detail.control_plane.preferred_4_is_box   placement-shape proof
-  detail.control_plane_scale   /filter /prioritize + gang tick p50/p99
-                               at 1,000 nodes / 100 gangs
+  detail.control_plane_scale   /filter /prioritize (indexed + object
+                               paths) + gang tick p50/p99 at 5,000
+                               nodes / 500 gangs (sublinear proof);
+                               detail.control_plane_scale_1000 is the
+                               1,000/100 continuity run
   detail.grant     every chip-grant probe attempt
   detail.workload.mfu   model FLOPs/step ÷ step time ÷ chip peak bf16
   detail.workload_chunked_xent.vs_plain_step   chunked-vocab CE A/B
@@ -258,51 +264,188 @@ def _run_accel_subprocess_raw(py_args: list, timeout_s: float,
     return report, None
 
 
-_PROBE_CODE = (
-    "import json, time\n"
+# The probe subprocess asks for devices AND — on success — runs the
+# micro kernel tier in the SAME process, so backend init is paid once
+# (VERDICT r5 #1: round-5 spent 151.9 s re-paying init per sub-window).
+# Line 1 is the probe verdict (schema key 'probe'); the micro tier then
+# streams its partials/final on subsequent lines (schema key 'kernels').
+_PROBE_MICRO_CODE = (
+    "import json, sys, time\n"
     "t = time.monotonic()\n"
     "import jax\n"
     "d = jax.devices()\n"
-    "print(json.dumps({'ok': len(d) > 0, 'devices': len(d),"
+    "print(json.dumps({'probe': True, 'ok': len(d) > 0,"
+    " 'devices': len(d),"
     " 'device_kind': d[0].device_kind if d else '',"
     " 'probe_s': round(time.monotonic() - t, 1)}), flush=True)\n"
+    "if d:\n"
+    "    from k8s_device_plugin_tpu.ops import microbench\n"
+    "    sys.exit(microbench.main(['--stream', '--tier', 'micro',"
+    " '--budget-s', sys.argv[1]]))\n"
+)
+
+PROBE_MICRO_BUDGET_S = float(
+    os.environ.get("BENCH_PROBE_MICRO_BUDGET_S", "25")
 )
 
 
-def acquire_chip_grant() -> dict:
-    """Probe-first contention handling (VERDICT r3 #1a): a cheap
-    subprocess asks the backend for devices under a ≤30 s hard timeout.
-    A held chip stalls the probe, not the 140 s smoke; re-probe on a
-    short cadence and take any grant window that opens — stopping while
-    enough smoke-side budget remains (the kernel slice is never
-    touched). Returns {ok, attempts: [...], waited_s}."""
-    attempts = []
-    t0 = time.monotonic()
-    while True:
-        left = _smoke_budget_left()
-        if left < 45:  # too little left for probe + a meaningful smoke
-            return {
-                "ok": False,
-                "attempts": attempts,
-                "waited_s": round(time.monotonic() - t0, 1),
-                "stopped": f"smoke budget low ({left:.0f}s left)",
-            }
-        report, err = _run_accel_subprocess_raw(
-            ["-c", _PROBE_CODE], min(PROBE_TIMEOUT_S, left - 10), {}
+class GrantProbe:
+    """The chip-grant probe loop, started at t=0 on its own thread so
+    it runs CONCURRENTLY with the (chip-free) control-plane and scale
+    phases (VERDICT r5 #1 — round 5 ran it after them and burned 152 s
+    of budget on serial probe timeouts). On the first grant, the probe
+    subprocess itself runs the ~15 s micro kernel tier before exiting —
+    any ~30 s window therefore yields a committed kernel artifact with
+    backend init paid exactly once.
+
+    ``grant`` is the classic {ok, attempts, waited_s, ...} record;
+    ``micro`` is the micro-tier kernel report captured inside the
+    granted probe process (None when no window opened or the tier
+    produced no numbers)."""
+
+    def __init__(self):
+        self.grant = None
+        self.micro = None
+        self._proc = None
+        self._thread = None
+
+    def start(self) -> "GrantProbe":
+        import threading
+
+        self._thread = threading.Thread(
+            target=self._loop, name="grant-probe", daemon=True
         )
-        if report is not None and report.get("ok"):
-            attempts.append(
-                {"ok": True, "probe_s": report.get("probe_s"),
-                 "devices": report.get("devices")}
+        self._thread.start()
+        return self
+
+    def _one_probe(self, budget_left: float):
+        """One probe subprocess: (probe_report|None, micro|None, err).
+        Streams to a temp file so the probe verdict is read the moment
+        it appears; a stall is killed at PROBE_TIMEOUT_S without
+        waiting out the micro budget."""
+        import tempfile as _tf
+
+        probe_deadline = time.monotonic() + min(
+            PROBE_TIMEOUT_S, max(budget_left - 10, 5)
+        )
+        # Append mode matters: the child's dup'd fd SHARES this file
+        # description (and offset). The polling reads below seek(0);
+        # without O_APPEND a concurrent child write would land at the
+        # moved offset and clobber the probe-verdict line.
+        with _tf.TemporaryFile(mode="a+t") as out:
+            env = dict(os.environ)
+            env.setdefault(
+                "TPU_WORKLOAD_COMPILATION_CACHE_DIR",
+                os.path.join(REPO, ".jax_compilation_cache"),
             )
-            return {
-                "ok": True,
-                "device_kind": report.get("device_kind", ""),
-                "attempts": attempts,
-                "waited_s": round(time.monotonic() - t0, 1),
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-c", _PROBE_MICRO_CODE,
+                    str(int(PROBE_MICRO_BUDGET_S)),
+                ],
+                cwd=REPO,
+                stdout=out,
+                stderr=subprocess.DEVNULL,
+                text=True,
+                env=env,
+            )
+            self._proc = proc
+
+            def lines():
+                out.seek(0)
+                return out.read().splitlines()
+
+            probe = None
+            while time.monotonic() < probe_deadline:
+                for line in lines():
+                    rep = parse_json_report(line, key="probe")
+                    if rep is not None:
+                        probe = rep
+                        break
+                if probe is not None or proc.poll() is not None:
+                    break
+                time.sleep(0.5)
+            if probe is None or not probe.get("ok"):
+                proc.kill()
+                proc.wait()
+                err = (
+                    "no devices" if probe is not None
+                    else f"probe timeout {PROBE_TIMEOUT_S:.0f}s"
+                )
+                return probe, None, err
+            # Granted: let the in-process micro tier run to completion
+            # (bounded), then harvest the last kernels report.
+            try:
+                proc.wait(timeout=PROBE_MICRO_BUDGET_S + 20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            micro = None
+            for line in reversed(lines()):
+                rep = parse_json_report(line, key="kernels")
+                if rep is not None:
+                    micro = rep
+                    break
+            return probe, micro, None
+
+    def _loop(self) -> None:
+        attempts = []
+        t0 = time.monotonic()
+        while True:
+            left = _smoke_budget_left()
+            if left < 45:  # too little for probe + a meaningful smoke
+                self.grant = {
+                    "ok": False,
+                    "attempts": attempts,
+                    "waited_s": round(time.monotonic() - t0, 1),
+                    "stopped": f"smoke budget low ({left:.0f}s left)",
+                }
+                return
+            probe, micro, err = self._one_probe(left)
+            if probe is not None and probe.get("ok"):
+                attempts.append(
+                    {"ok": True, "probe_s": probe.get("probe_s"),
+                     "devices": probe.get("devices"),
+                     "micro_in_probe": _has_kernel_numbers(micro)}
+                )
+                if _has_kernel_numbers(micro):
+                    micro["attempts"] = [
+                        {"ok": True, "tier": "micro",
+                         "in_probe_process": True}
+                    ]
+                    self.micro = micro
+                self.grant = {
+                    "ok": True,
+                    "device_kind": probe.get("device_kind", ""),
+                    "attempts": attempts,
+                    "waited_s": round(time.monotonic() - t0, 1),
+                }
+                return
+            attempts.append({"ok": False, "error": err or "no devices"})
+            time.sleep(
+                min(PROBE_SLEEP_S, max(_smoke_budget_left() - 45, 0))
+            )
+
+    def join(self) -> dict:
+        """Wait for the loop (bounded by the smoke budget the loop
+        itself respects); returns the grant record."""
+        if self._thread is not None:
+            self._thread.join(
+                timeout=max(_smoke_budget_left() + 15.0, 5.0)
+            )
+            if self._thread.is_alive() and self._proc is not None:
+                try:
+                    self._proc.kill()
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+                self._thread.join(timeout=10)
+        if self.grant is None:
+            self.grant = {
+                "ok": False,
+                "attempts": [],
+                "stopped": "probe thread did not finish",
             }
-        attempts.append({"ok": False, "error": err or "no devices"})
-        time.sleep(min(PROBE_SLEEP_S, max(_smoke_budget_left() - 45, 0)))
+        return self.grant
 
 
 def workload_args_from_env() -> list:
@@ -433,7 +576,7 @@ KERNEL_WINDOW_S = float(os.environ.get("BENCH_KERNEL_WINDOW_S", "30"))
 KERNEL_MAX_ATTEMPTS = int(os.environ.get("BENCH_KERNEL_MAX_ATTEMPTS", "8"))
 
 
-def run_kernels(grant_ok: bool = True, emit=None) -> dict:
+def run_kernels(grant_ok: bool = True, emit=None, micro=None) -> dict:
     """Kernel phase on its reserved slice, restructured for grant
     capture (VERDICT r4 #1): the round-4 shape was ONE subprocess
     holding the whole remaining budget, so a backend stall on a held
@@ -457,15 +600,21 @@ def run_kernels(grant_ok: bool = True, emit=None) -> dict:
     attempt, the micro capture, the final merge): the kernel phase can
     run for minutes, and a driver kill mid-phase must leave the
     attempt history and any captured numbers in the streamed tail, not
-    lose the whole phase."""
+    lose the whole phase.
+
+    ``micro``, when given, is a micro-tier report ALREADY captured
+    inside the grant probe's own process (GrantProbe — VERDICT r5 #1):
+    the sub-window loop is skipped entirely and the remaining budget
+    goes straight to the full tier."""
     kernel_args = os.environ.get("BENCH_KERNEL_ARGS", "").split()
-    attempts = []
-    micro = None
+    attempts = list((micro or {}).get("attempts") or [])
 
     def note(state: dict) -> None:
         if emit is not None:
             emit(state)
-    while len(attempts) < KERNEL_MAX_ATTEMPTS:
+    if micro is not None and not _has_kernel_numbers(micro):
+        micro = None
+    while micro is None and len(attempts) < KERNEL_MAX_ATTEMPTS:
         left = _budget_left() - 5
         if left < 20:
             break
@@ -553,6 +702,15 @@ def main() -> int:
         print(json.dumps(result), flush=True)
 
     try:
+        # Phase 0 (t=0): start the chip-grant probe loop NOW, on its
+        # own thread — the control-plane phases below need no chip, so
+        # probe wait overlaps them instead of following them (VERDICT
+        # r5 #1: round 5 burned 151.9 s on serial post-phase probes).
+        # On grant, the probe process itself runs the micro kernel
+        # tier (backend init paid once), so any ~30 s window yields a
+        # committed kernel artifact.
+        probe = GrantProbe().start()
+
         # Phase 1: control plane (~3 s, no jax anywhere in-process).
         try:
             cp = control_plane_allocation(root)
@@ -571,24 +729,41 @@ def main() -> int:
             result["detail"]["partial"] = "control_plane_failed"
         emit()  # survives any later kill (VERDICT r2 #1)
 
-        # Phase 1.5: control-plane SCALE (no accelerator; ~7 s):
-        # /filter + /prioritize + gang tick p50/p99 at 1,000 nodes /
-        # 100 gangs (VERDICT r3 #7). Guarded so a regression here can't
+        # Phase 1.5: control-plane SCALE (no accelerator; ~10 s, fully
+        # overlapped with the probe loop): /filter + /prioritize +
+        # gang ticks at 5,000 nodes / 500 gangs — the sublinear proof
+        # (VERDICT r5 #5) — plus the 1,000/100 continuity run the
+        # r3–r5 artifacts carry. Guarded so a regression here can't
         # eat the accelerator phases' budget.
         try:
             from k8s_device_plugin_tpu.extender import scale_bench
 
-            result["detail"]["control_plane_scale"] = scale_bench.run()
+            result["detail"]["control_plane_scale"] = scale_bench.run(
+                n_nodes=5000, n_gangs=500
+            )
         except Exception as e:  # noqa: BLE001
             result["detail"]["control_plane_scale"] = {
                 "error": repr(e)[:400]
             }
         emit()
+        try:
+            result["detail"]["control_plane_scale_1000"] = (
+                scale_bench.run(n_nodes=1000, n_gangs=100)
+            )
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["control_plane_scale_1000"] = {
+                "error": repr(e)[:400]
+            }
+        emit()
 
-        # Phase 2a: chip-grant probe loop (VERDICT r3 #1a) — the long
-        # smoke runs only into a granted chip.
-        grant = acquire_chip_grant()
+        # Phase 2a: harvest the t=0 probe loop (VERDICT r3 #1a /
+        # r5 #1) — the long smoke runs only into a granted chip, and a
+        # micro-tier capture from the probe process lands in the
+        # artifact immediately.
+        grant = probe.join()
         result["detail"]["grant"] = grant
+        if probe.micro is not None:
+            result["detail"]["kernels"] = probe.micro
         emit()
 
         # Phase 2b: the accelerator workload (streamed; a kill keeps
@@ -674,7 +849,8 @@ def main() -> int:
             emit()
 
         result["detail"]["kernels"] = run_kernels(
-            grant_ok=grant["ok"], emit=on_kernel_progress
+            grant_ok=grant["ok"], emit=on_kernel_progress,
+            micro=probe.micro,
         )
         result["detail"]["budget"] = {
             "total_s": TOTAL_BUDGET_S,

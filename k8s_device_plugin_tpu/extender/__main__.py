@@ -36,6 +36,29 @@ def main() -> int:
         help="node-annotation cache relist interval",
     )
     p.add_argument(
+        "--no-node-watch", action="store_true",
+        help="disable the node WATCH that keeps the topology index "
+        "incremental (falls back to relist-only invalidation at the "
+        "cache interval)",
+    )
+    p.add_argument(
+        "--node-relist-backstop-s", type=float, default=300.0,
+        help="with the node watch on, how often to run a full relist "
+        "anyway (level-triggered backstop against missed events; see "
+        "docs/operations.md)",
+    )
+    p.add_argument(
+        "--gang-full-sweep-s", type=float, default=60.0,
+        help="gang admission full-sweep backstop interval: resyncs in "
+        "between are dirty ticks that evaluate only event-marked "
+        "gangs (see docs/operations.md)",
+    )
+    p.add_argument(
+        "--no-gang-watch", action="store_true",
+        help="disable the gang pod watch (every resync then waits for "
+        "the full-sweep backstop to observe pod changes)",
+    )
+    p.add_argument(
         "--no-singleton-lease", action="store_true",
         help="skip the coordination.k8s.io Lease that fences gang "
         "admission to ONE live replica (extender/leader.py). Only for "
@@ -79,7 +102,10 @@ def main() -> int:
         )
     if a.node_cache:
         node_cache = NodeAnnotationCache(
-            client, interval_s=a.node_cache_interval_s
+            client,
+            interval_s=a.node_cache_interval_s,
+            watch=not a.no_node_watch,
+            watch_backstop_s=a.node_relist_backstop_s,
         ).start()
     # The pre-warmed parse/mesh cache (and everything else alive at
     # startup) leaves the GC scan set: a gen2 pass over the ~1M
@@ -149,11 +175,35 @@ def main() -> int:
     if a.gang_admission:
         from .gang import GangAdmission
 
+        topo_source = None
+        if node_cache is not None:
+            cache = node_cache
+
+            def topo_source():
+                # The node cache's topology index feeds the tick's
+                # capacity view (already parsed, no per-tick relist).
+                # Before the first successful relist the index is
+                # EMPTY, not authoritative — raising routes the tick
+                # through gang.py's serve-stale/skip degradation
+                # instead of reading "zero capacity".
+                if not cache.synced:
+                    raise RuntimeError("node cache never synced")
+                return cache.index.topologies()
+
         gang = GangAdmission(
             client,
             resync_interval_s=a.gang_resync_s,
             reservations=reservations,
+            full_sweep_interval_s=a.gang_full_sweep_s,
+            topo_source=topo_source,
+            watch=not a.no_gang_watch,
         )
+        if node_cache is not None:
+            # … and its node-change events mark exactly the affected
+            # gangs dirty (slice→gangs index in gang.py).
+            node_cache.index.on_change = (
+                lambda name, slice_keys: gang.note_node_event(slice_keys)
+            )
         gang.start()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())

@@ -42,12 +42,13 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..api import constants
 from ..kube.client import KubeClient, KubeError
 from ..topology.schema import NodeTopology, parse_topology_cached
-from ..topology.slice import SliceView, group_by_slice
+from ..topology.slice import SliceView
 from ..utils import metrics
 from ..utils.podresources import tpu_request
 from .reservations import DEFAULT_TABLE, ReservationTable
@@ -57,6 +58,11 @@ log = logging.getLogger(__name__)
 GATE_NAME = "tpu.google.com/gang"
 GANG_NAME_LABEL = "tpu.google.com/gang-name"
 GANG_SIZE_LABEL = "tpu.google.com/gang-size"
+
+# Dependency sentinel for the slice→gangs index: a waiting gang with any
+# demand a single host could serve can be unblocked by ANY node's
+# capacity changing, not just a particular slice's.
+ANY_NODE: Tuple[str, str] = ("*", "*any-node*")
 
 
 def is_gated(pod: dict) -> bool:
@@ -133,6 +139,152 @@ class GangView:
         return out
 
 
+class _CapacityPool:
+    """One tick's consumable capacity view over published topologies.
+
+    The old ``_fits`` rebuilt a hostname→availability map (O(nodes))
+    and scanned every host per demand (O(nodes) again) for EVERY gang —
+    the gang_tick_full profile was O(gangs × nodes) and 59 ms at 1,000
+    nodes / 100 gangs. This pool is built ONCE per tick and keeps
+    hosts bucketed by free-chip count, so a single-host placement costs
+    a bucket probe instead of a full scan, and placements are
+    transactional (``fits`` rolls back a gang that cannot fully place),
+    which is what lets one pool thread consumption across all gangs of
+    a tick the way the old copy-on-write views did.
+
+    Input topologies are never mutated: consumption lives in the
+    ``avail`` map whose lists are replaced, and only slice math
+    materializes per-host clones (rare path)."""
+
+    def __init__(self, topos: List[NodeTopology]):
+        self.topos = list(topos)
+        self.by_host: Dict[str, NodeTopology] = {
+            t.hostname: t for t in self.topos
+        }
+        self.avail: Dict[str, List[str]] = {
+            t.hostname: t.available for t in self.topos
+        }
+        self.chip_count: Dict[str, int] = {
+            t.hostname: t.chip_count for t in self.topos
+        }
+        self.max_chip_count = max(
+            (t.chip_count for t in self.topos), default=0
+        )
+        # free-chip-count → hosts (insertion order = topos order, so the
+        # initial best-fit pick matches the old first-minimal scan).
+        self._by_len: Dict[int, Dict[str, None]] = {}
+        for t in self.topos:
+            self._by_len.setdefault(len(t.available), {})[
+                t.hostname
+            ] = None
+        self._max_len = max(self._by_len, default=0)
+        # slice key → member hostnames, in topos order (the order the
+        # old group_by_slice walk evaluated slices in).
+        self.slices: Dict[Tuple[str, ...], List[str]] = {}
+        for t in self.topos:
+            if len(t.slice_hosts) > 1:
+                self.slices.setdefault(
+                    tuple(t.slice_hosts), []
+                ).append(t.hostname)
+        self._undo: Optional[List[Tuple[str, List[str]]]] = None
+
+    def slice_host_sizes(self) -> List[Tuple[Tuple[str, ...], int]]:
+        """(slice key, chips per host) per known slice — dependency
+        registration for dirty-gang marking."""
+        return [
+            (key, self.chip_count[members[0]])
+            for key, members in self.slices.items()
+        ]
+
+    def _set_avail(self, host: str, new: List[str]) -> None:
+        old = self.avail[host]
+        if self._undo is not None:
+            self._undo.append((host, old))
+        self._move_bucket(host, len(old), len(new))
+        self.avail[host] = new
+
+    def _move_bucket(self, host: str, old_len: int, new_len: int) -> None:
+        bucket = self._by_len.get(old_len)
+        if bucket is not None:
+            bucket.pop(host, None)
+            if not bucket:
+                del self._by_len[old_len]
+        self._by_len.setdefault(new_len, {})[host] = None
+
+    def _place_single(self, n: int) -> Optional[str]:
+        """Best-fit: the tightest host whose free chips and chip count
+        both cover n (keeps large-free hosts for larger demands)."""
+        for length in range(n, self._max_len + 1):
+            bucket = self._by_len.get(length)
+            if not bucket:
+                continue
+            for h in bucket:
+                if self.chip_count[h] >= n:
+                    self._set_avail(h, self.avail[h][n:])
+                    return h
+        return None
+
+    def _place_multi(self, n: int) -> Optional[List[str]]:
+        """k = n/host_size whole-free hosts from one slice (contiguous
+        box preferred). Materializes current-availability clones only
+        for slice members (rare path: runs when no single host serves
+        the demand)."""
+        for members in self.slices.values():
+            per_host = self.chip_count[members[0]]
+            if per_host <= 0 or n % per_host != 0:
+                continue
+            k = n // per_host
+            views = []
+            for h in members:
+                t = self.by_host[h]
+                cur = self.avail[h]
+                views.append(
+                    t
+                    if cur is t.available
+                    else dataclasses.replace(t, available=cur)
+                )
+            view = SliceView(views)
+            gang_hosts, _ = view.best_gang(k)
+            if not gang_hosts:
+                free = view.free_coords()
+                if len(free) >= k:
+                    gang_hosts = [
+                        view.by_coords[c].hostname for c in free[:k]
+                    ]
+            if gang_hosts:
+                for h in gang_hosts:
+                    self._set_avail(h, [])
+                return list(gang_hosts)
+        return None
+
+    def fits(self, demands: List[int]) -> Optional[Dict[str, int]]:
+        """Whole-gang feasibility; on success the consumption STAYS in
+        the pool (later gangs of the same tick see it) and the
+        host→chips map is returned for the reservation; on failure
+        every placement this call made is rolled back. Semantics match
+        the old copy-on-write ``_fits``: conservative — a gang not
+        placed here definitely cannot fit."""
+        self._undo = []
+        consumed: Dict[str, int] = {}
+        for n in sorted((d for d in demands if d > 0), reverse=True):
+            host = self._place_single(n)
+            if host is not None:
+                consumed[host] = consumed.get(host, 0) + n
+                continue
+            hosts = self._place_multi(n)
+            if hosts is None:
+                for h, old in reversed(self._undo):
+                    self._move_bucket(h, len(self.avail[h]), len(old))
+                    self.avail[h] = old
+                self._undo = None
+                return None
+            per_host = n // len(hosts)
+            for h in hosts:
+                consumed[h] = consumed.get(h, 0) + per_host
+        self._undo = None
+        return consumed
+
+
 class GangAdmission:
     """Scheduling-gate lifter for TPU pod gangs."""
 
@@ -142,10 +294,30 @@ class GangAdmission:
         resource_name: str = constants.RESOURCE_NAME,
         resync_interval_s: float = 5.0,
         reservations: Optional[ReservationTable] = None,
+        full_sweep_interval_s: float = 60.0,
+        topo_source: Optional[Callable[[], List[NodeTopology]]] = None,
+        watch: bool = False,
     ):
         self.client = client
         self.resource_name = resource_name
         self.resync_interval_s = resync_interval_s
+        # Level-triggered backstop cadence: the background loop runs a
+        # FULL sweep (every gang rescanned) at least this often; the
+        # resyncs in between are dirty ticks that evaluate only gangs
+        # marked by pod/node events plus gangs holding reservations —
+        # steady-state tick cost scales with churn, not gang count.
+        # Tuning guidance: docs/operations.md.
+        self.full_sweep_interval_s = max(
+            full_sweep_interval_s, resync_interval_s
+        )
+        # Capacity view source for ticks: defaults to a node relist via
+        # the kube client; the extender entrypoint wires the node
+        # cache's topology index here (already-parsed clones, no HTTP,
+        # no JSON) when --node-cache is on.
+        self.topo_source = topo_source
+        # Watch gang-labeled pods and mark only the affected gangs
+        # dirty (the event plane behind dirty ticks).
+        self.watch = watch
         # Shared with the TopologyExtender in this process (see
         # reservations.py): what tick() reserves here, /filter enforces.
         self.reservations = (
@@ -178,6 +350,21 @@ class GangAdmission:
         # Gangs whose hold hit the age cap: never re-fenced (a re-fence
         # would reset the hold's age and turn the cap into no cap).
         self._lapsed_gangs: set = set()
+        # -- dirty-gang state (all guarded by _dirty_lock) -----------------
+        self._dirty_lock = threading.Lock()
+        # Gangs an event marked for re-evaluation on the next tick.
+        self._dirty: Set[Tuple[str, str]] = set()
+        # Complete gangs currently gated for lack of capacity (the
+        # GANG_WAITING gauge's source of truth — dirty ticks evaluate
+        # subsets, so the gauge can't be recomputed per pass).
+        self._waiting_gangs: Set[Tuple[str, str]] = set()
+        # Waiting gang → capacity dependencies and the reverse index
+        # (slice key or ANY_NODE → gangs): a node event wakes exactly
+        # the gangs whose feasibility that node could change.
+        self._gang_deps: Dict[Tuple[str, str], Set[tuple]] = {}
+        self._dep_gangs: Dict[tuple, Set[Tuple[str, str]]] = {}
+        self._last_full_sweep = float("-inf")  # first loop tick is full
+        self._watch_thread: Optional[threading.Thread] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -187,9 +374,23 @@ class GangAdmission:
             target=self._loop, name="gang-admission", daemon=True
         )
         self._thread.start()
+        if self.watch:
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop,
+                name="gang-pod-watch",
+                daemon=True,
+            )
+            self._watch_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._watch_thread is not None:
+            try:
+                self.client.interrupt_watches()
+            except Exception:  # noqa: BLE001 — best-effort unblock
+                pass
+            self._watch_thread.join(timeout=5)
+            self._watch_thread = None
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
@@ -197,23 +398,166 @@ class GangAdmission:
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
-                self.tick()
+                # Dirty tick by default; full sweep on the backstop
+                # cadence (level-triggered: whatever an event missed,
+                # the sweep catches within full_sweep_interval_s).
+                full = (
+                    time.monotonic() - self._last_full_sweep
+                    >= self.full_sweep_interval_s
+                )
+                self.tick(full=full)
             except Exception as e:  # noqa: BLE001 — admission must survive
                 if self._stop.is_set():
                     return
                 log.warning("gang admission tick failed: %s", e)
             self._stop.wait(self.resync_interval_s)
 
+    # -- event plane (dirty marking) ---------------------------------------
+
+    def mark_dirty(
+        self, key: Tuple[str, str], source: str = "manual"
+    ) -> None:
+        with self._dirty_lock:
+            self._dirty.add(key)
+        metrics.GANG_DIRTY_MARKS.inc(source=source)
+
+    def mark_all_dirty(self) -> None:
+        """Force the next tick to sweep fully (e.g. after a watch gap)."""
+        self._last_full_sweep = float("-inf")
+
+    def note_pod_event(self, pod: dict) -> None:
+        """A gang-labeled pod appeared/changed/vanished: only ITS gang
+        needs re-evaluation."""
+        info = pod_gang(pod)
+        if info is None:
+            return
+        with self._dirty_lock:
+            self._dirty.add((info[0], info[1]))
+        metrics.GANG_DIRTY_MARKS.inc(source="pod")
+
+    def note_node_event(
+        self, slice_keys: Tuple[Tuple[str, ...], ...] = ()
+    ) -> int:
+        """A node's published topology/availability changed: wake the
+        gangs whose feasibility that node could change — every waiting
+        gang registered under ANY_NODE (a demand a single host can
+        serve may land on any node) plus gangs registered under any of
+        the changed slices. Returns how many gangs were marked."""
+        with self._dirty_lock:
+            keys = set(self._dep_gangs.get(ANY_NODE, ()))
+            for sk in slice_keys:
+                keys |= self._dep_gangs.get(tuple(sk), set())
+            self._dirty |= keys
+        if keys:
+            metrics.GANG_DIRTY_MARKS.inc(len(keys), source="node")
+        return len(keys)
+
+    def _set_waiting(
+        self,
+        key: Tuple[str, str],
+        demands: List[int],
+        pool: _CapacityPool,
+    ) -> None:
+        """Register a capacity-waiting gang's dependencies in the
+        slice→gangs index. Conservative by construction: a demand any
+        single host shape could serve depends on ANY_NODE; a pure
+        multi-host demand depends on every slice whose host size
+        divides it, or ANY_NODE when no such slice exists yet (a new
+        slice appearing must still wake it)."""
+        deps: Set[tuple] = set()
+        sizes = pool.slice_host_sizes()
+        for d in demands:
+            if d <= 0:
+                continue
+            if d <= pool.max_chip_count:
+                deps.add(ANY_NODE)
+                continue
+            matched = False
+            for skey, per_host in sizes:
+                if per_host > 0 and d % per_host == 0:
+                    deps.add(skey)
+                    matched = True
+            if not matched:
+                deps.add(ANY_NODE)
+        if not deps:
+            deps.add(ANY_NODE)
+        with self._dirty_lock:
+            self._waiting_gangs.add(key)
+            for dep in self._gang_deps.pop(key, set()):
+                members = self._dep_gangs.get(dep)
+                if members is not None:
+                    members.discard(key)
+                    if not members:
+                        del self._dep_gangs[dep]
+            self._gang_deps[key] = deps
+            for dep in deps:
+                self._dep_gangs.setdefault(dep, set()).add(key)
+
+    def _clear_waiting(self, key: Tuple[str, str]) -> None:
+        with self._dirty_lock:
+            self._waiting_gangs.discard(key)
+            for dep in self._gang_deps.pop(key, set()):
+                members = self._dep_gangs.get(dep)
+                if members is not None:
+                    members.discard(key)
+                    if not members:
+                        del self._dep_gangs[dep]
+
+    def _watch_loop(self) -> None:
+        """Pod-event plane: stream gang-labeled pod events into dirty
+        marks. Any stream failure falls back to the level-triggered
+        full sweep (mark_all_dirty) — events are an optimization, never
+        a correctness dependency."""
+        rv = ""
+        while not self._stop.is_set():
+            try:
+                for etype, pod in self.client.watch_pods(
+                    label_selector=GANG_NAME_LABEL,
+                    resource_version=rv,
+                    timeout_seconds=60,
+                ):
+                    if self._stop.is_set():
+                        return
+                    if etype == "BOOKMARK":
+                        rv = (
+                            (pod.get("metadata") or {}).get(
+                                "resourceVersion", ""
+                            )
+                            or rv
+                        )
+                        continue
+                    rv = (
+                        (pod.get("metadata") or {}).get(
+                            "resourceVersion", ""
+                        )
+                        or rv
+                    )
+                    self.note_pod_event(pod)
+            except Exception as e:  # noqa: BLE001 — 410/drop/partition
+                if self._stop.is_set():
+                    return
+                log.debug("gang pod watch window ended: %s", e)
+                rv = ""
+                # The watch may have missed events; the next sweep
+                # catches anything dropped.
+                self.mark_all_dirty()
+                self._stop.wait(min(5.0, self.resync_interval_s))
+
     # -- one evaluation pass ----------------------------------------------
 
-    def _collect_gangs(self) -> Dict[Tuple[str, str], "GangView"]:
+    def _collect_gangs(
+        self, keys: Optional[Set[Tuple[str, str]]] = None
+    ) -> Dict[Tuple[str, str], "GangView"]:
         """Gang-labeled pods grouped by (namespace, gang_name) into
         GangViews. The ONE discovery path tick() and explain() share —
         drift between them would re-open tool-vs-controller divergence.
         Server-side filtering: only gang-labeled pods come back (an
         existence selector on the gang-name key) — a flat list of the
         whole cluster's pods every resync would be sustained apiserver
-        load for nothing.
+        load for nothing. ``keys`` narrows a dirty tick to the marked
+        gangs: a set selector (`key in (a,b)`) when the set is small,
+        the plain existence selector when it would be unwieldy; either
+        way the result is filtered to exactly ``keys``.
 
         Finished pods (phase Succeeded/Failed) are second-class members:
         with restartPolicy Never they linger undeleted, so counting one
@@ -229,8 +573,18 @@ class GangAdmission:
         stale nodeName holds no chips, and treating it as placed would
         let replacements skip the whole-gang capacity check one by one
         after a full-gang crash."""
+        selector = GANG_NAME_LABEL
+        if keys is not None:
+            if not keys:
+                return {}
+            names = sorted({name for _, name in keys})
+            # A huge `in (...)` selector would blow past apiserver URL
+            # norms; past ~40 names the existence selector plus local
+            # filtering is the cheaper shape anyway.
+            if len(names) <= 40:
+                selector = f"{GANG_NAME_LABEL} in ({','.join(names)})"
         pods = self.client.list_pods(
-            label_selector=GANG_NAME_LABEL
+            label_selector=selector
         ).get("items", [])
         live: Dict[Tuple[str, str], List[dict]] = {}
         finished: Dict[Tuple[str, str], List[dict]] = {}
@@ -276,35 +630,103 @@ class GangAdmission:
             views[key] = GangView(
                 size=size, live=alive, standins=done[:short]
             )
+        if keys is not None:
+            views = {k: v for k, v in views.items() if k in keys}
         return views
 
-    def tick(self) -> List[Tuple[str, str]]:
-        """Evaluate every complete gang once; returns the (namespace,
-        gang_name) pairs released this pass (test observability)."""
-        gangs = self._collect_gangs()
+    def tick(self, full: bool = True) -> List[Tuple[str, str]]:
+        """Evaluate gangs once; returns the (namespace, gang_name)
+        pairs released this pass (test observability).
+
+        ``full=True`` (the default, and what direct callers/tests get)
+        rescans every gang — the level-triggered behavior this
+        controller always had. ``full=False`` is the dirty tick the
+        background loop runs between backstop sweeps: only gangs
+        marked by pod/node events (note_pod_event / note_node_event)
+        plus gangs holding reservations (their upkeep — renewal,
+        shrink-on-schedule, lapse — is per-tick state) are listed and
+        evaluated, so steady-state cost scales with churn, not gang
+        count; with nothing dirty and nothing held it is O(1) and
+        touches neither the pod nor the node API."""
+        with self._dirty_lock:
+            dirty = set(self._dirty)
+            self._dirty.clear()
+        metrics.GANG_TICKS.inc(mode="full" if full else "dirty")
+        try:
+            return self._tick_inner(full, dirty)
+        except Exception:
+            # The consumed event marks must survive a failed tick (a
+            # transient list/apiserver error is survivable by design —
+            # _loop catches and retries): losing them would leave an
+            # event-marked gang waiting for the full-sweep backstop
+            # instead of the next resync. Re-marking gangs the failed
+            # pass DID evaluate only costs one redundant evaluation.
+            with self._dirty_lock:
+                self._dirty |= dirty
+            raise
+
+    def _tick_inner(
+        self, full: bool, dirty: Set[Tuple[str, str]]
+    ) -> List[Tuple[str, str]]:
+        requested: Optional[Set[Tuple[str, str]]] = None
+        if full:
+            gangs = self._collect_gangs()
+            # Stamped only after the sweep's listing succeeded: a
+            # failed backstop sweep must not be recorded as done (the
+            # next loop tick retries it rather than waiting out
+            # full_sweep_interval_s).
+            self._last_full_sweep = time.monotonic()
+        else:
+            requested = dirty | set(self.reservations.active())
+            if not requested:
+                # Idle dirty tick: nothing marked, nothing held.
+                metrics.GANG_WAITING.set(len(self._waiting_gangs))
+                return []
+            gangs = self._collect_gangs(requested)
         self._reservation_upkeep(gangs)
         # Prune the logged-waiting markers of gangs that vanished or
-        # changed shape — the set must not grow without bound.
-        self._reported_waiting = {
-            w for w in self._reported_waiting if w[0] in gangs
-        }
+        # changed shape — the set must not grow without bound. A dirty
+        # tick only saw ``requested``, so it may only prune those.
+        if full:
+            self._reported_waiting = {
+                w for w in self._reported_waiting if w[0] in gangs
+            }
+            with self._dirty_lock:
+                stale = self._waiting_gangs - set(gangs)
+            for key in stale:
+                self._clear_waiting(key)
+        else:
+            vanished = requested - set(gangs)
+            self._reported_waiting = {
+                w for w in self._reported_waiting
+                if w[0] in gangs or w[0] not in vanished
+            }
+            for key in vanished:
+                self._clear_waiting(key)
         if not gangs:
-            metrics.GANG_WAITING.set(0)  # gauge must not stay stale
+            metrics.GANG_WAITING.set(len(self._waiting_gangs))
             return []
 
         # One consumable capacity view for the WHOLE tick: a gang
-        # released earlier in this pass must shrink what later gangs see
-        # (two gangs that each fit alone but not together must not both
-        # release). _fits copies, consumes, and returns the consumed
-        # view on success; the loop adopts it. Active reservations of
-        # released-but-unscheduled gangs are subtracted up front: the
-        # daemon's published availability lags scheduling, and those
-        # chips are spoken for.
-        topos = self._node_topologies()
-        self.reservations.apply(topos)
+        # released earlier in this pass must shrink what later gangs
+        # see (two gangs that each fit alone but not together must not
+        # both release). The pool consumes transactionally
+        # (_CapacityPool.fits); active reservations of released-but-
+        # unscheduled gangs are subtracted up front: the daemon's
+        # published availability lags scheduling, and those chips are
+        # spoken for. Built LAZILY — a tick with nothing to capacity-
+        # check (e.g. only incomplete gangs) never lists nodes at all.
+        pool_cell: List[Optional[_CapacityPool]] = [None]
+
+        def pool() -> _CapacityPool:
+            if pool_cell[0] is None:
+                topos = self._node_topologies()
+                self.reservations.apply(topos)
+                pool_cell[0] = _CapacityPool(topos)
+            return pool_cell[0]
+
         standing = self.reservations.active()
         released = []
-        waiting_now = 0
         for key, gv in sorted(gangs.items()):
             gated = gv.gated
             if not gated:
@@ -315,7 +737,8 @@ class GangAdmission:
                 # take the chips they're Pending on. Never re-fence a
                 # LAPSED hold — that would reset its age and void the
                 # cap.
-                topos = self._maybe_refence(key, gv, standing, topos)
+                self._clear_waiting(key)
+                self._maybe_refence(key, gv, standing, pool)
                 continue
             members = gv.members
             if len(members) < gv.size:
@@ -323,6 +746,10 @@ class GangAdmission:
                     "gang %s/%s: %d/%d pods present; waiting",
                     key[0], key[1], len(members), gv.size,
                 )
+                # Incomplete gangs wait on POD events (which dirty
+                # them), not capacity — they must not hold a node-event
+                # dependency or inflate the capacity-waiting gauge.
+                self._clear_waiting(key)
                 continue
             if len(members) > gv.size:
                 log.warning(
@@ -330,6 +757,7 @@ class GangAdmission:
                     "refusing to release (misconfigured gang)",
                     key[0], key[1], len(members), gv.size,
                 )
+                self._clear_waiting(key)
                 continue
             if gv.ungated_live:
                 # Two distinct healthy-vs-broken shapes end here, and
@@ -366,6 +794,7 @@ class GangAdmission:
                     )
                 self._release(gated)
                 released.append(key)
+                self._clear_waiting(key)
                 continue
             hold = standing.get(key)
             demands = gv.demands(self.resource_name)
@@ -387,6 +816,7 @@ class GangAdmission:
                     )
                     self._release(gated)
                     released.append(key)
+                    self._clear_waiting(key)
                     continue
                 # Same-named gang recreated with a DIFFERENT shape
                 # while its predecessor's hold lived: the hold fences
@@ -408,9 +838,12 @@ class GangAdmission:
             # releasing into capacity that can hold ALL of it, while a
             # Succeeded member's finished work no longer holds the
             # remainder hostage.
-            fit = self._fits(demands, topos)
-            if fit is None:
-                waiting_now += 1
+            consumed_hosts = pool().fits(demands)
+            if consumed_hosts is None:
+                # Register capacity dependencies so node events wake
+                # exactly this gang (dirty ticks); the full sweep stays
+                # the level-triggered backstop.
+                self._set_waiting(key, demands, pool())
                 waiting = (key, tuple(sorted(demands)))
                 if waiting not in self._reported_waiting:
                     self._reported_waiting.add(waiting)
@@ -420,7 +853,7 @@ class GangAdmission:
                         key[0], key[1], demands, self.resync_interval_s,
                     )
                 continue
-            topos, consumed_hosts = fit
+            self._clear_waiting(key)
             self._reported_waiting = {
                 w for w in self._reported_waiting if w[0] != key
             }
@@ -442,7 +875,8 @@ class GangAdmission:
                 "gang %s/%s released: %d pods, demand %s",
                 key[0], key[1], gv.size, demands,
             )
-        metrics.GANG_WAITING.set(waiting_now)
+        with self._dirty_lock:
+            metrics.GANG_WAITING.set(len(self._waiting_gangs))
         for _ in released:
             metrics.GANG_RELEASED.inc()
         active = self.reservations.active()
@@ -467,12 +901,13 @@ class GangAdmission:
         key: Tuple[str, str],
         gv: GangView,
         standing: Dict,
-        topos: List[NodeTopology],
-    ) -> List[NodeTopology]:
+        pool: Callable[[], _CapacityPool],
+    ) -> None:
         """Re-reserve a fully-released gang's unscheduled demand when it
-        has no hold (in-memory holds die with the process). Returns the
-        capacity view with the new hold's consumption applied, so later
-        gangs in the same tick see it."""
+        has no hold (in-memory holds die with the process). Consumption
+        lands in the tick's shared pool, so later gangs see it.
+        ``pool`` is the tick's lazy pool accessor — only touched when a
+        re-fence is actually attempted."""
         # Drain AGAIN at the decision point: a hold can lapse in the
         # prunes between upkeep and this call (tick's own apply()/
         # active(), or a concurrent /filter thread) — and once lapsed
@@ -480,7 +915,7 @@ class GangAdmission:
         # drain before reserve() below.
         self._lapsed_gangs |= self.reservations.drain_lapsed()
         if key in standing or key in self._lapsed_gangs:
-            return topos
+            return
         pending = [
             p for p in gv.ungated_live
             if not (p.get("spec") or {}).get("nodeName")
@@ -494,11 +929,10 @@ class GangAdmission:
             # Nothing to fence (all scheduled, or only zero-TPU members
             # pending) — and reserving an empty hold would churn a
             # no-op re-fence + log every resync.
-            return topos
-        fit = self._fits(demands, topos)
-        if fit is None:
-            return topos  # capacity already gone; the gang Pends
-        new_topos, consumed = fit
+            return
+        consumed = pool().fits(demands)
+        if consumed is None:
+            return  # capacity already gone; the gang Pends
         # Members already scheduled are OUTSIDE this hold — pre-count
         # them so upkeep's note_scheduled doesn't drain the fresh hold
         # by re-subtracting their chips (which would re-create the hold
@@ -518,7 +952,6 @@ class GangAdmission:
             "pod(s) (hold was lost, e.g. process restart)",
             key[0], key[1], sum(consumed.values()), len(pending),
         )
-        return new_topos
 
     def _reservation_upkeep(
         self, gangs: Dict[Tuple[str, str], GangView]
@@ -577,6 +1010,7 @@ class GangAdmission:
         gangs = self._collect_gangs()
         topos = self._node_topologies()
         self.reservations.apply(topos)
+        pool = _CapacityPool(topos)
         standing = self.reservations.active()
         reports = []
         for key, gv in sorted(gangs.items()):
@@ -617,9 +1051,9 @@ class GangAdmission:
                     "re-evaluated next resync"
                 )
             else:
-                fit = self._fits(demands, topos)
-                if fit is not None:
-                    topos = fit[0]  # mirror tick()'s consumption
+                # Consumption stays in the pool — mirrors tick()'s
+                # threading of capacity across gangs in the same order.
+                if pool.fits(demands) is not None:
                     status = "fits: release due next resync"
                 else:
                     status = (
@@ -638,6 +1072,23 @@ class GangAdmission:
         return reports
 
     def _node_topologies(self) -> List[NodeTopology]:
+        if self.topo_source is not None:
+            # The extender's topology index: already-parsed per-call
+            # clones, no HTTP, no JSON — the tick's only remaining
+            # O(nodes) step is building the capacity pool.
+            try:
+                topos = list(self.topo_source())
+            except Exception as e:  # noqa: BLE001 — same degradation
+                # contract as a failed relist below
+                if self._last_topos:
+                    log.warning(
+                        "topology source failed (%s); serving last-known "
+                        "topology (%d nodes)", e, len(self._last_topos),
+                    )
+                    return list(self._last_topos)
+                raise
+            self._last_topos = list(topos)
+            return topos
         try:
             items = self.client.list_nodes().get("items", [])
         except (KubeError, OSError) as e:
@@ -667,110 +1118,6 @@ class GangAdmission:
                 )
         self._last_topos = list(topos)
         return topos
-
-    # -- feasibility -------------------------------------------------------
-
-    def _fits(
-        self, demands: List[int], topos: List[NodeTopology]
-    ) -> Optional[Tuple[List[NodeTopology], Dict[str, int]]]:
-        """Whole-gang feasibility against published availability.
-
-        Returns (capacity view with this gang's consumption applied,
-        host→chips consumed) — the view for the caller to carry into
-        later gangs of the same tick, the consumption map to reserve
-        before release (reservations.py) — or None when the gang cannot
-        fit. The per-demand bar matches the extender's /filter on every
-        node shape: a demand places single-host on any node whose
-        chip_count and free chips cover it, else multi-host onto
-        whole-free hosts of one slice (n a multiple of that slice's
-        host size, contiguous box preferred but not required — box-ness
-        is a scoring preference at placement time). Conservative on
-        purpose — a gang NOT released here definitely cannot fit."""
-        # Copy-on-write: consumption lives in a hostname→available map
-        # whose lists are REPLACED, never mutated, so the input topos
-        # are untouched and only hosts this gang actually consumed get
-        # a cloned NodeTopology in the returned view. Cloning all N
-        # nodes per gang made dataclasses.replace the top line of the
-        # 1,000-node × 100-gang tick profile (scale_bench).
-        avail: Dict[str, List[str]] = {
-            t.hostname: t.available for t in topos
-        }
-        by_host = {t.hostname: t for t in topos}
-        consumed: Dict[str, int] = {}
-        for n in sorted((d for d in demands if d > 0), reverse=True):
-            host = self._place_single(n, by_host, avail)
-            if host is not None:
-                consumed[host] = consumed.get(host, 0) + n
-                continue
-            hosts = self._place_multi(n, by_host, avail)
-            if hosts is None:
-                return None
-            per_host = n // len(hosts)
-            for h in hosts:
-                consumed[h] = consumed.get(h, 0) + per_host
-        work = [
-            t
-            if avail[t.hostname] is t.available
-            else dataclasses.replace(t, available=avail[t.hostname])
-            for t in topos
-        ]
-        return work, consumed
-
-    @staticmethod
-    def _place_single(
-        n: int,
-        by_host: Dict[str, NodeTopology],
-        avail: Dict[str, List[str]],
-    ) -> Optional[str]:
-        """Consume n chips from the tightest single node that can serve
-        the demand locally (best-fit keeps large-free nodes for larger
-        demands); returns the chosen hostname."""
-        best = None
-        best_len = 0
-        for h, t in by_host.items():
-            a_len = len(avail[h])
-            if t.chip_count >= n and a_len >= n:
-                if best is None or a_len < best_len:
-                    best, best_len = h, a_len
-        if best is None:
-            return None
-        avail[best] = avail[best][n:]
-        return best
-
-    @staticmethod
-    def _place_multi(
-        n: int,
-        by_host: Dict[str, NodeTopology],
-        avail: Dict[str, List[str]],
-    ) -> Optional[List[str]]:
-        """Consume k=n/host_size whole-free hosts from one slice;
-        returns the chosen hostnames. Materializes current-availability
-        clones for the slice math (rare path: only runs when no single
-        host can serve the demand)."""
-        views = [
-            t
-            if avail[t.hostname] is t.available
-            else dataclasses.replace(t, available=avail[t.hostname])
-            for t in by_host.values()
-        ]
-        for members in group_by_slice(views).values():
-            per_host = members[0].chip_count
-            if per_host <= 0 or n % per_host != 0:
-                continue
-            k = n // per_host
-            view = SliceView(members)
-            gang_hosts, _ = view.best_gang(k)
-            if not gang_hosts:
-                free = view.free_coords()
-                if len(free) >= k:
-                    gang_hosts = [
-                        view.by_coords[c].hostname for c in free[:k]
-                    ]
-            if gang_hosts:
-                for h in gang_hosts:
-                    avail[h] = []
-                return list(gang_hosts)
-        return None
 
     # -- release -----------------------------------------------------------
 

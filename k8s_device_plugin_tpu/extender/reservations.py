@@ -213,26 +213,37 @@ class ReservationTable:
                 if k != exclude
             )
 
-    def apply(self, topos, exclude: Optional[GangKey] = None) -> Dict[str, int]:
-        """Subtract active holds from published NodeTopology
-        availability, in place (chips within a host are fungible for
-        counting — the hold fences a COUNT, not identities). The ONE
-        place the holds→availability mapping lives: both the extender's
-        /filter shield and the admission tick's capacity view go
-        through here, so they cannot drift. Returns hostname→chips
-        withheld (for failure-reason diagnostics).
+    def held_by_host(
+        self, exclude: Optional[GangKey] = None
+    ) -> Dict[str, int]:
+        """hostname → chips held by gangs other than ``exclude``, as a
+        plain dict — the read-only form of ``apply`` for consumers that
+        must not mutate shared topology objects (the extender's indexed
+        fast path compares counts instead of truncating lists).
 
         One lock acquisition and one prune for the whole call — a
         per-node reserved_chips() would put O(nodes × holds) lock/prune
         cycles on the scheduler's /filter hot path."""
         with self._lock:
             self._prune_locked()
-            held_by_host: Dict[str, int] = {}
+            held: Dict[str, int] = {}
             for k, r in self._by_gang.items():
                 if k == exclude:
                     continue
                 for h, n in r.hosts.items():
-                    held_by_host[h] = held_by_host.get(h, 0) + n
+                    held[h] = held.get(h, 0) + n
+        return held
+
+    def apply(self, topos, exclude: Optional[GangKey] = None) -> Dict[str, int]:
+        """Subtract active holds from published NodeTopology
+        availability, in place (chips within a host are fungible for
+        counting — the hold fences a COUNT, not identities). The ONE
+        place the holds→availability mapping lives: both the extender's
+        /filter shield and the admission tick's capacity view go
+        through here (the indexed fast path uses the same
+        ``held_by_host`` counts), so they cannot drift. Returns
+        hostname→chips withheld (for failure-reason diagnostics)."""
+        held_by_host = self.held_by_host(exclude)
         withheld: Dict[str, int] = {}
         for t in topos:
             held = held_by_host.get(t.hostname, 0)

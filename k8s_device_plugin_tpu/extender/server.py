@@ -41,6 +41,7 @@ from ..utils.httpserver import BackgroundHTTPServer
 from ..utils.podresources import tpu_request
 from ..utils.resilience import Backoff
 from .gang import pod_gang
+from .index import IndexEntry, TopologyIndex, shielded
 from .reservations import DEFAULT_TABLE, ReservationTable
 
 log = logging.getLogger(__name__)
@@ -312,6 +313,159 @@ class TopologyExtender:
             out.append({"host": name, "score": score})
         return out
 
+    # -- indexed name-only fast path ---------------------------------------
+    #
+    # With ``nodeCacheCapable: true`` the scheduler sends node NAMES;
+    # these paths answer from the node cache's incremental topology
+    # index (extender/index.py): per-candidate work is a dict get plus
+    # integer arithmetic — zero JSON parsing, zero mesh building, zero
+    # per-node cloning — so the RPC cost is O(candidates) with a tiny
+    # constant instead of O(nodes × parse). Both return None when the
+    # index cannot serve (no cache configured, or no relist has ever
+    # succeeded); the caller then falls back to materialize()+filter(),
+    # which degrades safely rather than serving wrong topology.
+
+    def _index_entries(
+        self, names: List[str]
+    ) -> Optional[List[Tuple[str, Optional[IndexEntry]]]]:
+        cache = self.node_cache
+        if cache is None or not cache.synced:
+            return None
+        idx = cache.index
+        out = []
+        for name in names:
+            e = idx.get(name)
+            if e is None and not idx.known(name):
+                # A node the last relist never saw (just joined): one
+                # cache fetch, which also installs the index entry.
+                cache.node_object(name)
+                e = idx.get(name)
+            out.append((name, e))
+        metrics.PARSE_AVOIDED.inc(len(names))
+        return out
+
+    def _held_for(self, pod: dict) -> Dict[str, int]:
+        """host → chips other gangs' reservations withhold from this
+        pod — the count form of _shield, no topology mutation."""
+        info = pod_gang(pod)
+        own = (info[0], info[1]) if info else None
+        return self.reservations.held_by_host(exclude=own)
+
+    def _slice_views_from_entries(
+        self,
+        entries: List[Tuple[str, Optional[IndexEntry]]],
+        held: Dict[str, int],
+    ) -> Dict[tuple, SliceView]:
+        """Slice views over the slice-member CANDIDATES (multi-host
+        gangs are evaluated against the candidate list, exactly like
+        the full-object path), shielded by reservation counts. Only
+        hosts with a live hold cost a clone."""
+        topos = []
+        for _, e in entries:
+            if e is None or e.topo is None or e.slice_key is None:
+                continue
+            h = held.get(e.hostname, 0)
+            topos.append(shielded(e.topo, h) if h else e.topo)
+        return self._slice_views(topos)
+
+    def filter_names(
+        self, pod: dict, names: List[str]
+    ) -> Optional[Tuple[List[str], Dict[str, str]]]:
+        """Indexed /filter: (passing_names, failed) or None when the
+        index can't serve. Capacity-infeasible candidates are rejected
+        on integer counts before any topology object is touched."""
+        entries = self._index_entries(names)
+        if entries is None:
+            return None
+        n = tpu_request(pod, self.resource_name)
+        if n <= 0:
+            return list(names), {}
+        held = self._held_for(pod)
+        slice_views: Dict[tuple, SliceView] = {}
+        if any(
+            e is not None and n > e.chip_count > 0 and e.topo is not None
+            for _, e in entries
+        ):
+            slice_views = self._slice_views_from_entries(entries, held)
+        passing: List[str] = []
+        failed: Dict[str, str] = {}
+        for name, e in entries:
+            if e is None or e.topo is None:
+                failed[name] = "no TPU topology published"
+                continue
+            local = min(n, e.chip_count)
+            if local <= 0:
+                failed[name] = "node reports 0 TPU chips"
+                continue
+            h = held.get(e.hostname, 0)
+            avail = max(0, e.avail - h)
+            reserved_note = (
+                f" ({h} reserved for a released gang)" if h else ""
+            )
+            if n > e.chip_count:
+                topo = shielded(e.topo, h) if h else e.topo
+                reason = self._multi_host_reason(n, topo, slice_views)
+                if reason:
+                    failed[name] = reason + reserved_note
+                    continue
+            if avail < local:
+                failed[name] = (
+                    f"{avail} chips available, {local} needed"
+                    f"{reserved_note}"
+                )
+                continue
+            passing.append(name)
+        return passing, failed
+
+    def prioritize_names(
+        self, pod: dict, names: List[str]
+    ) -> Optional[List[dict]]:
+        """Indexed /prioritize: HostPriorityList or None when the index
+        can't serve. Single-host scores ride the same (annotation, n,
+        withheld) memo as the full-object path; a capacity-infeasible
+        candidate scores 0 without ever building a placement."""
+        entries = self._index_entries(names)
+        if entries is None:
+            return None
+        n = tpu_request(pod, self.resource_name)
+        if n <= 0:
+            return [{"host": name, "score": 0} for name in names]
+        held = self._held_for(pod)
+        slice_views: Dict[tuple, SliceView] = {}
+        if any(
+            e is not None and n > e.chip_count > 0 and e.topo is not None
+            for _, e in entries
+        ):
+            slice_views = self._slice_views_from_entries(entries, held)
+        out = []
+        for name, e in entries:
+            if e is None or e.topo is None:
+                out.append({"host": name, "score": 0})
+                continue
+            h = held.get(e.hostname, 0)
+            if n > e.chip_count > 0:
+                topo = shielded(e.topo, h) if h else e.topo
+                score = self.score_node(n, topo, slice_views)
+            elif max(0, e.avail - h) < min(n, e.chip_count):
+                score = 0  # infeasible: never reaches topology scoring
+            else:
+                key = (e.raw, n, h)
+                with self._score_lock:
+                    score = self._score_cache.get(key)
+                    if score is not None:
+                        self._score_cache.move_to_end(key)
+                if score is None:
+                    topo = shielded(e.topo, h) if h else e.topo
+                    score = self.score_node(n, topo, slice_views)
+                    with self._score_lock:
+                        self._score_cache[key] = score
+                        while (
+                            len(self._score_cache) > self._score_cache_max
+                        ):
+                            self._score_cache.popitem(last=False)
+            out.append({"host": name, "score": score})
+        return out
+
 
 def _get_ci(d: dict, key: str):
     """Case-tolerant key get: the kube-scheduler marshals ExtenderArgs with
@@ -332,21 +486,43 @@ class NodeAnnotationCache:
     node objects into every /filter and /prioritize call — megabytes per
     scheduling cycle at 1,000 nodes, dwarfing the (cached, ~6 ms)
     scoring itself. Flipping it to true makes the scheduler send node
-    NAMES only; this cache supplies the annotations from a periodic
-    relist against the API server (staleness up to ``interval_s``, the
-    same freshness class the upstream extender contract accepts for
-    cache-capable extenders), with an on-demand single-node fetch for
-    names the last relist hasn't seen (a node that just joined)."""
+    NAMES only; this cache supplies the annotations from a relist plus
+    (optionally) a node WATCH against the API server, with an on-demand
+    single-node fetch for names the last relist hasn't seen (a node
+    that just joined).
 
-    def __init__(self, client, interval_s: float = 5.0):
+    The cache also owns the incremental ``TopologyIndex``
+    (extender/index.py): every observation — relist diff, watch event,
+    single-node fetch — is applied to the index keyed by the node's
+    annotation STRING, so an unchanged annotation costs nothing and a
+    changed one rebuilds exactly that node's parsed entry, off the RPC
+    path. With ``watch=True`` the relist degrades to a low-frequency
+    level-triggered backstop (``watch_backstop_s``) and invalidation
+    latency drops from the relist interval to one watch event."""
+
+    def __init__(
+        self,
+        client,
+        interval_s: float = 5.0,
+        watch: bool = False,
+        watch_backstop_s: float = 300.0,
+    ):
         self.client = client
         self.interval_s = interval_s
+        self.watch = watch
+        # With the watch healthy, full relists are only the
+        # level-triggered backstop against missed events; this is the
+        # cadence floor for them (docs/operations.md).
+        self.watch_backstop_s = max(watch_backstop_s, interval_s)
         # name → annotation string, or None for a relisted node WITHOUT
         # one (daemon not publishing). The negative entries matter: a
         # no-annotation node is a steady state on mixed clusters, and
         # without them every RPC would re-fetch it from the API server —
         # the exact per-cycle load nodeCacheCapable exists to avoid.
         self._raw: Dict[str, Optional[str]] = {}
+        # Parsed, incrementally-maintained view (the /filter fast path).
+        self.index = TopologyIndex()
+        self._resource_version = ""
         # Set once a relist has succeeded. Until then, unknown names are
         # answered as no-topology WITHOUT per-name fetches: with an
         # empty cache (apiserver outage at start) a 1,000-name request
@@ -356,6 +532,10 @@ class NodeAnnotationCache:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def synced(self) -> bool:
+        return self._synced
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -376,6 +556,16 @@ class NodeAnnotationCache:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.watch:
+            # Unblock a thread sitting in the watch stream's socket
+            # read (up to ~70 s otherwise) — same teardown shape as
+            # GangAdmission.stop().
+            interrupt = getattr(self.client, "interrupt_watches", None)
+            if interrupt is not None:
+                try:
+                    interrupt()
+                except Exception:  # noqa: BLE001 — best-effort unblock
+                    pass
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
@@ -394,6 +584,16 @@ class NodeAnnotationCache:
                 self.refresh()
                 backoff.reset()
                 wait = self.interval_s
+                if self.watch:
+                    # Consume watch events until the stream goes stale
+                    # (410), errors, or the relist backstop comes due;
+                    # the refresh() above then level-triggers any event
+                    # the watch missed. A healthy backstop expiry
+                    # relists immediately; a broken watch waits out the
+                    # normal cadence first (no hot loop against an
+                    # apiserver that keeps dropping the stream).
+                    healthy = self._watch_until_stale()
+                    wait = 0.0 if healthy else self.interval_s
             except Exception as e:  # noqa: BLE001 — keep serving stale
                 metrics.NODE_CACHE_RELIST_ERRORS.inc()
                 # Floored at the healthy cadence: the jittered first
@@ -407,7 +607,12 @@ class NodeAnnotationCache:
                 )
 
     def refresh(self) -> None:
-        items = self.client.list_nodes().get("items", [])
+        listing = self.client.list_nodes()
+        items = listing.get("items", [])
+        self._resource_version = (
+            (listing.get("metadata") or {}).get("resourceVersion", "")
+            or self._resource_version
+        )
         fresh: Dict[str, Optional[str]] = {}
         for node in items:
             meta = node.get("metadata") or {}
@@ -420,28 +625,104 @@ class NodeAnnotationCache:
             # _fetch() calls mutate the installed dict, and iterating
             # it lock-free would race (dict changed size during
             # iteration).
+            removed = [n for n in self._raw if n not in fresh]
             self._raw = fresh
             raws = set(fresh.values())
             with_topo = sum(1 for r in fresh.values() if r)
             total = len(fresh)
             self._synced = True
+        # Incremental index maintenance: entries are keyed by the
+        # annotation STRING, so a steady cluster's relist applies N
+        # no-ops; only nodes whose annotation actually changed rebuild.
+        for name, raw in fresh.items():
+            kind = self.index.update(name, raw)
+            metrics.INDEX_EVENTS.inc(source="relist", kind=kind)
+        for name in removed:
+            metrics.INDEX_EVENTS.inc(
+                source="relist", kind=self.index.remove(name)
+            )
         metrics.NODE_CACHE_NODES.set(with_topo, state="with_topology")
         metrics.NODE_CACHE_NODES.set(
             total - with_topo, state="without_topology"
         )
+        metrics.INDEX_SLICES.set(self.index.stats()["slices"])
         metrics.NODE_CACHE_SYNCED.set(1)
-        # Pre-warm the parse/mesh cache for EVERY current annotation on
-        # THIS thread: the cold parse (json + mesh build, the p99 of
-        # /filter at 1,000 nodes) then never lands on a scheduler RPC.
-        # Unconditional on purpose — an already-warm value is a pure
-        # LRU hit, and delta-tracking against the previous relist would
-        # miss entries the shared 8192-entry LRU evicted in between.
+        # Pre-warm the parse/mesh LRU for EVERY current annotation on
+        # THIS thread: the index already holds parsed entries, but the
+        # full-object RPC path (nodeCacheCapable: false schedulers)
+        # still reads through the LRU, and its cold parse (json + mesh
+        # build, the p99 of /filter at 1,000 nodes) must not land on a
+        # scheduler RPC. Unconditional on purpose — an already-warm
+        # value is a pure LRU hit, and delta-tracking against the
+        # previous relist would miss entries the shared 8192-entry LRU
+        # evicted in between.
         for raw in raws:
             if raw:
                 try:
                     parse_topology_cached(raw)
                 except ValueError:
                     pass  # malformed stays the publisher's problem
+
+    # -- watch plane -------------------------------------------------------
+
+    def apply_event(self, etype: str, node: dict) -> str:
+        """Apply one node watch event to the raw map and the index.
+        Returns the index event kind (test observability). Rebuilds are
+        keyed by the annotation string: a MODIFIED event that didn't
+        touch the topology annotation is a no-op."""
+        meta = node.get("metadata") or {}
+        name = meta.get("name", "")
+        if not name or etype == "BOOKMARK":
+            return "noop"
+        if etype == "DELETED":
+            with self._lock:
+                self._raw.pop(name, None)
+            kind = self.index.remove(name)
+        else:  # ADDED / MODIFIED
+            raw = (meta.get("annotations") or {}).get(
+                constants.TOPOLOGY_ANNOTATION
+            )
+            with self._lock:
+                self._raw[name] = raw
+            kind = self.index.update(name, raw)
+        metrics.INDEX_EVENTS.inc(source="watch", kind=kind)
+        return kind
+
+    def _watch_until_stale(self) -> bool:
+        """Stream node events into the index until the watch breaks or
+        the relist backstop comes due. Every exit path leads back to a
+        refresh() (level-triggered), so a dropped event can delay an
+        update by at most watch_backstop_s, never lose it. Returns
+        True when the exit was the healthy backstop expiry, False when
+        the stream broke."""
+        import time as _time
+
+        deadline = _time.monotonic() + self.watch_backstop_s
+        rv = self._resource_version
+        while not self._stop.is_set() and _time.monotonic() < deadline:
+            window = min(60.0, max(1.0, deadline - _time.monotonic()))
+            try:
+                for etype, obj in self.client.watch_nodes(
+                    resource_version=rv,
+                    timeout_seconds=int(window),
+                ):
+                    if self._stop.is_set():
+                        return False
+                    rv = (
+                        (obj.get("metadata") or {}).get(
+                            "resourceVersion", ""
+                        )
+                        or rv
+                    )
+                    self.apply_event(etype, obj)
+                    if _time.monotonic() >= deadline:
+                        break
+            except Exception as e:  # noqa: BLE001 — 410s, drops,
+                # truncation: all mean "relist" (the caller's refresh)
+                log.debug("node watch window ended: %s", e)
+                return False
+        self._resource_version = rv
+        return True
 
     # -- lookup ------------------------------------------------------------
 
@@ -479,6 +760,9 @@ class NodeAnnotationCache:
             raw = None
         with self._lock:
             self._raw[name] = raw
+        metrics.INDEX_EVENTS.inc(
+            source="fetch", kind=self.index.update(name, raw)
+        )
         return raw
 
 
@@ -538,22 +822,39 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                 names_mode = bool(names) and not items
                 verb = self.path.strip("/")
                 try:
+                    fast_filter = fast_scores = None
                     if names_mode:
                         # nodeCacheCapable: the scheduler sent names
-                        # only; resolve annotations from our cache.
-                        items = ext.materialize(list(names))
+                        # only. The indexed fast path answers straight
+                        # from the incremental topology index (zero
+                        # per-RPC parsing); when the index can't serve
+                        # (no cache, or never synced) it returns None
+                        # and the materialize() path below degrades to
+                        # the full-object pipeline.
+                        if self.path == "/filter":
+                            fast_filter = ext.filter_names(
+                                pod, list(names)
+                            )
+                        elif self.path == "/prioritize":
+                            fast_scores = ext.prioritize_names(
+                                pod, list(names)
+                            )
+                        if fast_filter is None and fast_scores is None:
+                            items = ext.materialize(list(names))
                     if self.path == "/filter":
-                        passing, failed = ext.filter(pod, items)
+                        if fast_filter is not None:
+                            passing_names, failed = fast_filter
+                        else:
+                            passing, failed = ext.filter(pod, items)
+                            passing_names = [
+                                (n.get("metadata") or {}).get("name", "")
+                                for n in passing
+                            ]
                         if names_mode:
                             self._send(
                                 {
                                     "nodes": None,
-                                    "nodenames": [
-                                        (n.get("metadata") or {}).get(
-                                            "name", ""
-                                        )
-                                        for n in passing
-                                    ],
+                                    "nodenames": passing_names,
                                     "failedNodes": failed,
                                     "error": "",
                                 }
@@ -568,7 +869,11 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                                 }
                             )
                     elif self.path == "/prioritize":
-                        self._send(ext.prioritize(pod, items))
+                        self._send(
+                            fast_scores
+                            if fast_scores is not None
+                            else ext.prioritize(pod, items)
+                        )
                     else:
                         self._send({"error": f"unknown path {self.path}"}, 404)
                         return

@@ -329,6 +329,7 @@ class KubeClient:
         node_name: str = "",
         resource_version: str = "",
         timeout_seconds: int = 60,
+        label_selector: str = "",
     ) -> Generator[Tuple[str, dict], None, None]:
         """Yields (event_type, pod) from a single watch window; callers
         reconnect (the informer does). Raises KubeError(410) when the
@@ -340,11 +341,36 @@ class KubeClient:
         }
         if node_name:
             params["fieldSelector"] = f"spec.nodeName={node_name}"
+        if label_selector:
+            params["labelSelector"] = label_selector
         if resource_version:
             params["resourceVersion"] = resource_version
+        return self._watch_stream("/api/v1/pods", params, timeout_seconds)
+
+    def watch_nodes(
+        self,
+        resource_version: str = "",
+        timeout_seconds: int = 60,
+    ) -> Generator[Tuple[str, dict], None, None]:
+        """Yields (event_type, node) from a single watch window — the
+        extender's topology index consumes this to invalidate exactly
+        the node whose annotation changed, instead of relisting all
+        nodes. Same contract as watch_pods (410 ⇒ relist)."""
+        params: Dict[str, str] = {
+            "watch": "true",
+            "timeoutSeconds": str(timeout_seconds),
+            "allowWatchBookmarks": "true",
+        }
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        return self._watch_stream("/api/v1/nodes", params, timeout_seconds)
+
+    def _watch_stream(
+        self, path: str, params: Dict[str, str], timeout_seconds: int
+    ) -> Generator[Tuple[str, dict], None, None]:
         resp = self._request(
             "GET",
-            "/api/v1/pods",
+            path,
             verb="WATCH",
             params=params,
             stream=True,

@@ -94,6 +94,9 @@ class DaemonConfig:
     # onto healthy capacity (BASELINE config 4); ListAndWatch only
     # protects future placements.
     evict_on_unhealthy: bool = True
+    # Opt-in vfio dense chip reindexing for TPU_VISIBLE_CHIPS (see
+    # PluginConfig.vfio_dense_reindex).
+    vfio_dense_reindex: bool = False
     enable_dra: bool = False
     dra_driver_name: str = "tpu.google.com"
     plugins_dir: str = "/var/lib/kubelet/plugins"
@@ -298,6 +301,7 @@ class Daemon:
                 plugins_registry_dir=self.cfg.plugins_registry_dir,
                 extra_device_paths=extra_devs,
                 devfs_layout="vfio" if is_vfio else "accel",
+                vfio_dense_reindex=self.cfg.vfio_dense_reindex,
             ),
         )
         if chips:
@@ -519,6 +523,12 @@ def parse_args(argv) -> DaemonConfig:
     p.add_argument("--plugins-dir", default="/var/lib/kubelet/plugins",
                    help="kubelet plugins dir for the DRA socket")
     p.add_argument("--cdi-dir", default="/var/run/cdi")
+    p.add_argument("--vfio-dense-reindex", action="store_true",
+                   help="vfio layout: export TPU_VISIBLE_CHIPS as dense "
+                   "0-based ordinals (IOMMU group numbers remapped in "
+                   "sorted order) instead of omitting it; pair with the "
+                   "workload smoke's chip-count self-check "
+                   "(TPU_PLUGIN_ALLOCATED_CHIPS)")
     p.add_argument("--no-controller", action="store_true")
     p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
     p.add_argument("--python-backend", action="store_true",
@@ -554,6 +564,7 @@ def parse_args(argv) -> DaemonConfig:
         plugins_registry_dir=a.plugins_registry_dir,
         podresources_socket=a.podresources_socket,
         evict_on_unhealthy=not a.no_evict_on_unhealthy,
+        vfio_dense_reindex=a.vfio_dense_reindex,
         enable_dra=a.dra,
         dra_driver_name=a.dra_driver_name,
         plugins_dir=a.plugins_dir,

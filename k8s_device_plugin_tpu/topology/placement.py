@@ -26,6 +26,8 @@ All scoring uses the precomputed tables in IciMesh — no hardware queries
 
 from __future__ import annotations
 
+import dataclasses
+import functools
 import itertools
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
@@ -65,6 +67,96 @@ def ideal_box_links(n: int) -> int:
     if not shapes:
         return max(n - 1, 1)
     return box_links(shapes[0])
+
+
+@dataclasses.dataclass(frozen=True)
+class BoxCandidate:
+    """One axis-aligned sub-box of volume n inside a bounds grid,
+    precomputed for membership testing with coordinate bitmasks.
+
+    ``mask`` has bit ``x + bx*(y + by*z)`` set per member coordinate;
+    ``links`` is the box's internal link count on the grid INCLUDING
+    torus wrap links (a box spanning a wrapping dimension closes a
+    cycle); ``border_bits`` lists the bit index of every (member,
+    outside-neighbor) edge — with one entry PER EDGE, so a neighbor
+    touching two member cells appears twice, matching the exact
+    fragmentation count the live nested-loop search produced."""
+
+    shape: Coord
+    coords: Tuple[Coord, ...]
+    mask: int
+    links: int
+    border_bits: Tuple[int, ...]
+
+
+@functools.lru_cache(maxsize=256)
+def box_candidates(
+    n: int, bounds: Coord, wraps: Tuple[bool, bool, bool] = (False,) * 3
+) -> Tuple[BoxCandidate, ...]:
+    """Every placement of every n-volume box shape inside ``bounds``,
+    enumerated once per (n, bounds, wraps) and cached process-wide.
+
+    The live 6-deep loop in the old ``_best_box`` re-walked this exact
+    space on every allocation RPC; the space depends only on the grid
+    geometry, never on availability, so it is a pure precompute.
+    Ordering is preserved from the live search (shapes most-cube-like
+    first, then offsets x-outer/z-inner) — SliceView.best_gang takes
+    the FIRST free candidate, so the order is load-bearing there."""
+    bx, by, bz = bounds
+
+    def bit(c: Coord) -> int:
+        return c[0] + bx * (c[1] + by * c[2])
+
+    def neighbors(c: Coord) -> List[Coord]:
+        out = []
+        for dim in range(3):
+            size = bounds[dim]
+            if size <= 1:
+                continue
+            for step in (-1, 1):
+                v = c[dim] + step
+                if wraps[dim]:
+                    v %= size
+                elif not (0 <= v < size):
+                    continue
+                nc = list(c)
+                nc[dim] = v
+                out.append(tuple(nc))
+        return list(dict.fromkeys(out))
+
+    cands: List[BoxCandidate] = []
+    for shape in _box_shapes(n, bounds):
+        sx, sy, sz = shape
+        for ox in range(bx - sx + 1):
+            for oy in range(by - sy + 1):
+                for oz in range(bz - sz + 1):
+                    coords = tuple(
+                        (ox + dx, oy + dy, oz + dz)
+                        for dx in range(sx)
+                        for dy in range(sy)
+                        for dz in range(sz)
+                    )
+                    cset = set(coords)
+                    mask = 0
+                    links2 = 0
+                    border: List[int] = []
+                    for c in coords:
+                        mask |= 1 << bit(c)
+                        for nb in neighbors(c):
+                            if nb in cset:
+                                links2 += 1
+                            else:
+                                border.append(bit(nb))
+                    cands.append(
+                        BoxCandidate(
+                            shape=shape,
+                            coords=coords,
+                            mask=mask,
+                            links=links2 // 2,
+                            border_bits=tuple(border),
+                        )
+                    )
+    return tuple(cands)
 
 
 class PlacementState:
@@ -215,42 +307,51 @@ class PlacementState:
     def _best_box(
         self, n: int, pool: Set[str], must: Set[str]
     ) -> Optional[List[str]]:
+        """Best fully-available n-box: max internal links, then minimal
+        fragmentation, then lexicographically-smallest id set.
+
+        The box space is precomputed per (n, bounds, wraps)
+        (``box_candidates``) and availability is tested with coordinate
+        bitmasks — the live 6-deep coordinate walk this replaces was
+        the top line of the allocation-path profile (scale_bench). A
+        coordinate with no chip never sets a pool bit, so boxes over
+        missing chips fail the mask test exactly like they failed the
+        ``by_coords`` lookup."""
         mesh = self.mesh
         bx, by, bz = mesh.bounds
-        best: Optional[Tuple[Tuple[int, int, int], List[str]]] = None
-        for shape in _box_shapes(n, mesh.bounds):
-            sx, sy, sz = shape
-            for ox in range(bx - sx + 1):
-                for oy in range(by - sy + 1):
-                    for oz in range(bz - sz + 1):
-                        ids = []
-                        ok = True
-                        for dx in range(sx):
-                            for dy in range(sy):
-                                for dz in range(sz):
-                                    m = mesh.by_coords.get(
-                                        (ox + dx, oy + dy, oz + dz)
-                                    )
-                                    if m is None or m.id not in pool:
-                                        ok = False
-                                        break
-                                    ids.append(m.id)
-                                if not ok:
-                                    break
-                            if not ok:
-                                break
-                        if not ok or not must.issubset(ids):
-                            continue
-                        frag = sum(
-                            1
-                            for i in ids
-                            for nb in mesh.neighbors(i)
-                            if nb in pool and nb not in ids
-                        )
-                        key = (-mesh.internal_links(ids), frag, tuple(sorted(ids)))
-                        if best is None or key < best[0]:
-                            best = (key, ids)
-        return best[1] if best else None
+
+        def bit(c: Coord) -> int:
+            return c[0] + bx * (c[1] + by * c[2])
+
+        pool_mask = 0
+        for i in pool:
+            pool_mask |= 1 << bit(mesh.by_id[i].coords)
+        must_mask = 0
+        for i in must:
+            must_mask |= 1 << bit(mesh.by_id[i].coords)
+        wraps = tuple(mesh._dim_wraps(mesh.bounds[d]) for d in range(3))
+        best_key: Optional[Tuple[int, int]] = None
+        best_ids: Optional[Tuple[str, ...]] = None
+        for cand in box_candidates(n, mesh.bounds, wraps):
+            if cand.mask & ~pool_mask:
+                continue  # some member coord unavailable (or chipless)
+            if must_mask & ~cand.mask:
+                continue
+            frag = sum(
+                1 for b in cand.border_bits if (pool_mask >> b) & 1
+            )
+            key = (-cand.links, frag)
+            if best_key is not None and key > best_key:
+                continue
+            ids = tuple(
+                sorted(mesh.by_coords[c].id for c in cand.coords)
+            )
+            # Same total order as the old search's
+            # (-links, frag, sorted ids) key — ids materialized only
+            # for candidates that survive the cheap (links, frag) cut.
+            if best_key is None or key < best_key or ids < best_ids:
+                best_key, best_ids = key, ids
+        return list(best_ids) if best_ids is not None else None
 
     def _grow(
         self, n: int, pool: Set[str], must: List[str]

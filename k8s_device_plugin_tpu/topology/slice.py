@@ -26,7 +26,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import logging
 
-from .placement import _box_shapes, box_links, ideal_box_links
+from .placement import box_candidates, ideal_box_links
 from .schema import NodeTopology
 
 log = logging.getLogger(__name__)
@@ -122,7 +122,6 @@ class SliceView:
         free = set(self.free_coords())
         if k <= 0 or len(free) < k:
             return [], 0
-        bx, by, bz = self.bounds
         must_coord = None
         if must_include is not None:
             for c, t in self.by_coords.items():
@@ -131,24 +130,18 @@ class SliceView:
                     break
             if must_coord is None or must_coord not in free:
                 return [], 0
-        for shape in _box_shapes(k, self.bounds):
-            sx, sy, sz = shape
-            for ox in range(bx - sx + 1):
-                for oy in range(by - sy + 1):
-                    for oz in range(bz - sz + 1):
-                        box = [
-                            (ox + dx, oy + dy, oz + dz)
-                            for dx in range(sx)
-                            for dy in range(sy)
-                            for dz in range(sz)
-                        ]
-                        if must_coord is not None and must_coord not in box:
-                            continue
-                        if all(c in free for c in box):
-                            return (
-                                [self.by_coords[c].hostname for c in box],
-                                box_links(shape),
-                            )
+        # Precomputed host-grid box space (placement.box_candidates):
+        # first fully-free candidate wins, and the enumeration order
+        # (cube-like shapes first, then offsets) is the same one the
+        # live nested loop walked. Host grids model no wrap links.
+        for cand in box_candidates(k, self.bounds):
+            if must_coord is not None and must_coord not in cand.coords:
+                continue
+            if all(c in free for c in cand.coords):
+                return (
+                    [self.by_coords[c].hostname for c in cand.coords],
+                    cand.links,
+                )
         return [], 0
 
     def gang_score(self, k: int, hostname: str, max_score: int = 10) -> int:

@@ -263,6 +263,18 @@ GANG_RESERVATIONS_LAPSED = EXTENDER_REGISTRY.counter(
     "Gang reservations that hit the hard age cap with pods still "
     "unscheduled (their chips are no longer fenced)",
 )
+GANG_TICKS = EXTENDER_REGISTRY.counter(
+    "tpu_gang_ticks_total",
+    "Gang admission evaluation passes, by mode: full (every gang "
+    "rescanned — the level-triggered backstop) or dirty (only gangs "
+    "marked by pod/node events plus gangs holding reservations)",
+)
+GANG_DIRTY_MARKS = EXTENDER_REGISTRY.counter(
+    "tpu_gang_dirty_marked_total",
+    "Gangs marked for re-evaluation by an event, by source "
+    "(pod/node/manual); steady-state dirty-tick cost scales with this "
+    "churn, not with gang count",
+)
 NODE_CACHE_NODES = EXTENDER_REGISTRY.gauge(
     "tpu_extender_node_cache_nodes",
     "Nodes in the annotation cache by state (with_topology/"
@@ -277,6 +289,31 @@ NODE_CACHE_SYNCED = EXTENDER_REGISTRY.gauge(
 NODE_CACHE_RELIST_ERRORS = EXTENDER_REGISTRY.counter(
     "tpu_extender_node_cache_relist_errors_total",
     "Node relists that failed (cache serves stale entries meanwhile)",
+)
+# Incremental topology index (extender/index.py): the per-node parsed
+# view behind the zero-parse /filter+/prioritize fast path.
+INDEX_REBUILDS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_index_rebuilds_total",
+    "Per-node index entry rebuilds (parse + derived-state refresh); "
+    "steady state is ~0 — each node costs a rebuild only when its "
+    "annotation string actually changes",
+)
+INDEX_EVENTS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_index_events_total",
+    "Node observations applied to the topology index, by source "
+    "(relist/watch) and kind (add/update/clear/delete/noop); a high "
+    "noop share is healthy (unchanged annotations cost no work)",
+)
+INDEX_SLICES = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_index_slices",
+    "Multi-host slices currently tracked by the topology index",
+)
+PARSE_AVOIDED = EXTENDER_REGISTRY.counter(
+    "tpu_extender_parse_avoided_total",
+    "Candidate nodes served by /filter+/prioritize straight from the "
+    "topology index — zero per-RPC JSON parsing or mesh building "
+    "(the name-only fast path); compare against candidates served "
+    "through the full-object parse path to see fast-path coverage",
 )
 LEASE_HELD = EXTENDER_REGISTRY.gauge(
     "tpu_extender_lease_held",

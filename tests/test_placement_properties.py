@@ -117,3 +117,77 @@ def test_pairs_pack_v5e_without_fragmentation():
         assert mesh.hops(got[0], got[1]) == 1
         state.allocate(got)
     assert state.available() == []
+
+
+def _reference_best_box(state, n, pool, must):
+    """The pre-optimization 6-deep nested-loop search, kept verbatim as
+    the oracle for the precomputed bitmask `_best_box` (the two must
+    stay bit-identical: same box, same tie-breaks)."""
+    from k8s_device_plugin_tpu.topology.placement import _box_shapes
+
+    mesh = state.mesh
+    bx, by, bz = mesh.bounds
+    best = None
+    for shape in _box_shapes(n, mesh.bounds):
+        sx, sy, sz = shape
+        for ox in range(bx - sx + 1):
+            for oy in range(by - sy + 1):
+                for oz in range(bz - sz + 1):
+                    ids = []
+                    ok = True
+                    for dx in range(sx):
+                        for dy in range(sy):
+                            for dz in range(sz):
+                                m = mesh.by_coords.get(
+                                    (ox + dx, oy + dy, oz + dz)
+                                )
+                                if m is None or m.id not in pool:
+                                    ok = False
+                                    break
+                                ids.append(m.id)
+                            if not ok:
+                                break
+                        if not ok:
+                            break
+                    if not ok or not must.issubset(ids):
+                        continue
+                    frag = sum(
+                        1
+                        for i in ids
+                        for nb in mesh.neighbors(i)
+                        if nb in pool and nb not in ids
+                    )
+                    key = (
+                        -mesh.internal_links(ids),
+                        frag,
+                        tuple(sorted(ids)),
+                    )
+                    if best is None or key < best[0]:
+                        best = (key, ids)
+    return sorted(best[1]) if best else None
+
+
+@pytest.mark.parametrize(
+    "chip_type,count", [("v5e", 4), ("v5e", 8), ("v4", 4), ("v5p", 8)]
+)
+def test_best_box_matches_reference_search(chip_type, count):
+    """The precomputed-candidate `_best_box` must pick the EXACT box
+    the live nested-loop search picked (links, fragmentation, and id
+    tie-breaks included) across random pools and must-include sets —
+    including torus generations whose spanning boxes carry wrap
+    links."""
+    rng = random.Random(42)
+    mesh = mesh_of(chip_type, count)
+    state = PlacementState(mesh)
+    ids = mesh.ids
+    for _ in range(150):
+        pool = set(rng.sample(ids, rng.randint(1, count)))
+        n = rng.randint(1, len(pool))
+        must = set(
+            rng.sample(sorted(pool), rng.randint(0, min(2, len(pool))))
+        )
+        got = state._best_box(n, pool, must)
+        want = _reference_best_box(state, n, pool, must)
+        assert (sorted(got) if got else None) == want, (
+            chip_type, count, n, sorted(pool), sorted(must),
+        )

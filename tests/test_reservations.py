@@ -648,7 +648,8 @@ def test_lapse_between_upkeep_and_refence_is_still_barred(api):
     table.active()  # prunes + records the lapse internally
     gangs = adm._collect_gangs()
     gv = gangs[("default", "train")]
-    topos = adm._node_topologies()
-    out = adm._maybe_refence(("default", "train"), gv, {}, topos)
-    assert out is topos  # no re-fence
-    assert table.active() == {}
+    from k8s_device_plugin_tpu.extender.gang import _CapacityPool
+
+    pool = _CapacityPool(adm._node_topologies())
+    adm._maybe_refence(("default", "train"), gv, {}, lambda: pool)
+    assert table.active() == {}  # no re-fence (lapse bar held)

@@ -1,0 +1,405 @@
+"""Incremental topology index + dirty-gang admission (the sublinear
+extender hot path).
+
+Covers the invalidation contract the fast path's correctness rests on:
+
+* watch ADD/MODIFY/DELETE and annotation flips rebuild EXACTLY the
+  affected node's index entry (unchanged nodes keep their identical
+  parsed objects — the zero-work no-op the index exists for);
+* a stale/absent cache makes the fast path decline (return None) so
+  the caller falls back to full materialize — never serving wrong
+  topology;
+* the indexed name-only path answers identically to the full-object
+  path, reservations and multi-host slices included;
+* dirty-gang marking never skips a gang whose slice changed, and
+  doesn't wake gangs an unrelated slice's event cannot unblock.
+"""
+
+import pytest
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.extender.gang import (
+    ANY_NODE,
+    GangAdmission,
+)
+from k8s_device_plugin_tpu.extender.index import TopologyIndex
+from k8s_device_plugin_tpu.extender.reservations import ReservationTable
+from k8s_device_plugin_tpu.extender.server import (
+    NodeAnnotationCache,
+    TopologyExtender,
+)
+from tests.test_extender import (
+    make_node,
+    make_slice_nodes,
+    tpu_pod,
+)
+
+
+def _raw(node):
+    return node["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION]
+
+
+class _ListClient:
+    def __init__(self, nodes):
+        self.nodes = list(nodes)
+        self.get_calls = 0
+
+    def list_nodes(self, label_selector=""):
+        return {
+            "metadata": {"resourceVersion": "1"},
+            "items": self.nodes,
+        }
+
+    def get_node(self, name):
+        self.get_calls += 1
+        for n in self.nodes:
+            if n["metadata"]["name"] == name:
+                return n
+        raise KeyError(name)
+
+
+# ---------------------------------------------------------------------------
+# index invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_relist_diff_rebuilds_only_changed_entries():
+    n1, _ = make_node("n1")
+    n2, _ = make_node("n2")
+    client = _ListClient([n1, n2])
+    cache = NodeAnnotationCache(client, interval_s=3600)
+    cache.refresh()
+    e1 = cache.index.get("n1")
+    e2 = cache.index.get("n2")
+    assert e1 is not None and e1.avail == 4
+
+    # Unchanged relist: every entry survives IDENTICALLY (no rebuild).
+    cache.refresh()
+    assert cache.index.get("n1") is e1
+    assert cache.index.get("n2") is e2
+
+    # Annotation flip on n1 only: exactly n1's entry is rebuilt.
+    n1_new, _ = make_node("n1", available=["tpu-0000:00:04.0"])
+    client.nodes = [n1_new, n2]
+    cache.refresh()
+    e1b = cache.index.get("n1")
+    assert e1b is not e1 and e1b.avail == 1
+    assert cache.index.get("n2") is e2
+
+
+def test_watch_events_rebuild_exactly_the_affected_node():
+    n1, _ = make_node("n1")
+    n2, _ = make_node("n2")
+    cache = NodeAnnotationCache(_ListClient([n1, n2]), interval_s=3600)
+    cache.refresh()
+    e1, e2 = cache.index.get("n1"), cache.index.get("n2")
+
+    # MODIFIED with the same annotation string: a no-op.
+    assert cache.apply_event("MODIFIED", n1) == "noop"
+    assert cache.index.get("n1") is e1
+
+    # MODIFIED with a flipped annotation: rebuild of n1 alone.
+    n1_new, _ = make_node("n1", available=[])
+    assert cache.apply_event("MODIFIED", n1_new) == "update"
+    assert cache.index.get("n1") is not e1
+    assert cache.index.get("n1").avail == 0
+    assert cache.index.get("n2") is e2
+
+    # ADDED: a brand-new entry; DELETED: gone (and unknown again).
+    n3, _ = make_node("n3")
+    assert cache.apply_event("ADDED", n3) == "add"
+    assert cache.index.get("n3").avail == 4
+    assert cache.apply_event("DELETED", n3) == "delete"
+    assert cache.index.get("n3") is None
+    assert not cache.index.known("n3")
+
+    # Annotation REMOVED (daemon stopped publishing): entry cleared,
+    # node stays known (negative entry — no per-RPC fetch storms).
+    bare = {"metadata": {"name": "n2"}}
+    assert cache.apply_event("MODIFIED", bare) == "clear"
+    assert cache.index.get("n2") is None
+    assert cache.index.known("n2")
+
+
+def test_malformed_annotation_is_negative_cached_and_keyed():
+    idx = TopologyIndex()
+    assert idx.update("bad", "{not json") == "add"
+    assert idx.get("bad").topo is None
+    # Same bad string again: still a no-op (keyed by the string).
+    assert idx.update("bad", "{not json") == "noop"
+
+
+def test_watch_loop_applies_events_then_falls_back_to_relist():
+    n1, _ = make_node("n1")
+    n1_new, _ = make_node("n1", available=[])
+
+    class WatchClient(_ListClient):
+        def watch_nodes(self, resource_version="", timeout_seconds=60):
+            yield "MODIFIED", n1_new
+            raise ConnectionError("stream died")
+
+    cache = NodeAnnotationCache(
+        WatchClient([n1]), interval_s=3600, watch=True
+    )
+    cache.refresh()
+    assert cache.index.get("n1").avail == 4
+    healthy = cache._watch_until_stale()
+    assert healthy is False  # broken stream reports unhealthy
+    assert cache.index.get("n1").avail == 0  # but the event landed
+
+
+# ---------------------------------------------------------------------------
+# fast path: decline-and-fallback, parity
+# ---------------------------------------------------------------------------
+
+
+def test_fast_path_declines_without_cache_or_sync():
+    ext = TopologyExtender(reservations=ReservationTable())
+    assert ext.filter_names(tpu_pod(1), ["n1"]) is None
+    assert ext.prioritize_names(tpu_pod(1), ["n1"]) is None
+
+    cache = NodeAnnotationCache(_ListClient([]), interval_s=3600)
+    ext2 = TopologyExtender(
+        reservations=ReservationTable(), node_cache=cache
+    )
+    # Never synced (e.g. apiserver down at start): decline, so the
+    # HTTP layer falls back to materialize() — which answers unknown
+    # names as no-topology rather than inventing entries.
+    assert ext2.filter_names(tpu_pod(1), ["n1"]) is None
+    cache.refresh()
+    assert ext2.filter_names(tpu_pod(1), ["n1"]) is not None
+
+
+def test_indexed_filter_prioritize_match_full_object_path():
+    nodes = [
+        make_node("full")[0],
+        make_node("tight", available=["tpu-0000:00:04.0"])[0],
+        make_node("empty", available=[])[0],
+    ]
+    names = [n["metadata"]["name"] for n in nodes]
+    table = ReservationTable()
+    cache = NodeAnnotationCache(_ListClient(nodes), interval_s=3600)
+    cache.refresh()
+    ext_obj = TopologyExtender(reservations=table)
+    ext_idx = TopologyExtender(reservations=table, node_cache=cache)
+
+    # A standing reservation on "full" shields 2 chips from OTHER pods.
+    table.reserve(("default", "g"), {"full": 2})
+
+    for n in (1, 2, 4):
+        pod = tpu_pod(n)
+        passing, failed = ext_obj.filter(pod, nodes)
+        fast = ext_idx.filter_names(pod, names)
+        assert fast is not None
+        assert fast[0] == [
+            (p.get("metadata") or {}).get("name") for p in passing
+        ]
+        assert fast[1] == failed
+        scores_obj = ext_obj.prioritize(pod, nodes)
+        scores_idx = ext_idx.prioritize_names(pod, names)
+        assert scores_idx == scores_obj
+
+
+def test_indexed_multi_host_matches_full_object_path():
+    nodes = make_slice_nodes(
+        ["h0", "h1", "h2", "h3"], "4,1,1", busy=("h2",)
+    )
+    nodes.append(make_node("standalone")[0])
+    names = [n["metadata"]["name"] for n in nodes]
+    cache = NodeAnnotationCache(_ListClient(nodes), interval_s=3600)
+    cache.refresh()
+    ext_obj = TopologyExtender(reservations=ReservationTable())
+    ext_idx = TopologyExtender(
+        reservations=ReservationTable(), node_cache=cache
+    )
+    pod = tpu_pod(8)  # 2 whole v5p hosts over ICI
+    passing, failed = ext_obj.filter(pod, nodes)
+    fast = ext_idx.filter_names(pod, names)
+    assert fast is not None
+    assert fast[0] == [
+        (p.get("metadata") or {}).get("name") for p in passing
+    ]
+    assert fast[1] == failed
+    assert ext_idx.prioritize_names(pod, names) == ext_obj.prioritize(
+        pod, nodes
+    )
+
+
+def test_unknown_name_costs_one_fetch_and_is_indexed():
+    n1, _ = make_node("n1")
+    late, _ = make_node("late-joiner")
+    client = _ListClient([n1])
+    cache = NodeAnnotationCache(client, interval_s=3600)
+    cache.refresh()
+    client.nodes.append(late)  # joined after the relist
+    ext = TopologyExtender(
+        reservations=ReservationTable(), node_cache=cache
+    )
+    fast = ext.filter_names(tpu_pod(1), ["n1", "late-joiner"])
+    assert fast is not None and fast[0] == ["n1", "late-joiner"]
+    assert client.get_calls == 1
+    # Second RPC: served from the index, no second fetch.
+    ext.filter_names(tpu_pod(1), ["n1", "late-joiner"])
+    assert client.get_calls == 1
+
+
+# ---------------------------------------------------------------------------
+# dirty-gang marking
+# ---------------------------------------------------------------------------
+
+
+def _gang_pods(gang, size, chips):
+    from k8s_device_plugin_tpu.extender.gang import (
+        GANG_NAME_LABEL,
+        GANG_SIZE_LABEL,
+        GATE_NAME,
+    )
+
+    return [
+        {
+            "metadata": {
+                "name": f"{gang}-w{i}",
+                "namespace": "default",
+                "labels": {
+                    GANG_NAME_LABEL: gang,
+                    GANG_SIZE_LABEL: str(size),
+                },
+            },
+            "spec": {
+                "schedulingGates": [{"name": GATE_NAME}],
+                "containers": [
+                    {
+                        "name": "c",
+                        "resources": {
+                            "requests": {"google.com/tpu": str(chips)}
+                        },
+                    }
+                ],
+            },
+        }
+        for i in range(size)
+    ]
+
+
+class _GangClient:
+    """list_pods/list_nodes plus in-place gate removal (the scale_bench
+    stub's selector-aware shape, trimmed for these tests)."""
+
+    def __init__(self, nodes, pods):
+        self.nodes = nodes
+        self.pods = pods
+
+    def list_nodes(self, label_selector=""):
+        return {"items": self.nodes}
+
+    def list_pods(self, label_selector="", **kw):
+        return {"items": self.pods}
+
+    def get_pod(self, ns, name):
+        for p in self.pods:
+            m = p["metadata"]
+            if m["namespace"] == ns and m["name"] == name:
+                return p
+        raise KeyError(name)
+
+    def remove_pod_scheduling_gate(self, ns, name, gate_name, gates):
+        pod = self.get_pod(ns, name)
+        pod["spec"]["schedulingGates"] = [
+            g
+            for g in pod["spec"].get("schedulingGates", [])
+            if g.get("name") != gate_name
+        ]
+        return pod
+
+
+def test_dirty_marking_slice_dependencies():
+    """Slice-dependency bookkeeping: waiting multi-host gangs register
+    their slice keys; node events for those keys (and only those) wake
+    them; single-host-servable demands register ANY_NODE."""
+    slice_s = ["s0", "s1"]
+    # Both S hosts busy: the multi-host gang cannot fit anywhere.
+    nodes = make_slice_nodes(slice_s, "2,1,1", busy=("s0", "s1"))
+    pods = _gang_pods("multi", 1, 8)
+    client = _GangClient(nodes, pods)
+    adm = GangAdmission(client, reservations=ReservationTable())
+    assert adm.tick() == []
+    key = ("default", "multi")
+    assert key in adm._waiting_gangs
+    assert tuple(slice_s) in adm._gang_deps[key]
+
+    # An unrelated slice's event must not wake it (sublinearity)…
+    assert adm.note_node_event(((("u0", "u1")),)) == 0
+    assert adm.tick(full=False) == []
+
+    # …but its OWN slice's event must, and the dirty tick releases it
+    # once capacity appeared.
+    fresh = make_slice_nodes(slice_s, "2,1,1")
+    client.nodes[:] = fresh
+    assert adm.note_node_event((tuple(slice_s),)) == 1
+    assert adm.tick(full=False) == [key]
+    assert key not in adm._waiting_gangs
+    assert key not in adm._gang_deps
+
+
+def test_single_host_gang_wakes_on_any_node_event():
+    node, _ = make_node("n1", available=[])  # no free chips yet
+    pods = _gang_pods("solo", 2, 2)
+    client = _GangClient([node], pods)
+    adm = GangAdmission(client, reservations=ReservationTable())
+    assert adm.tick() == []
+    key = ("default", "solo")
+    assert ANY_NODE in adm._gang_deps[key]
+
+    # Capacity appears on SOME node (no slice key at all).
+    fresh, _ = make_node("n1")
+    client.nodes[:] = [fresh]
+    assert adm.note_node_event(()) == 1
+    assert adm.tick(full=False) == [key]
+
+
+def test_pod_event_marks_only_its_gang_and_idle_ticks_are_noops():
+    node, _ = make_node("n1")
+    pods = _gang_pods("a", 2, 1)
+    client = _GangClient([node], pods)
+    adm = GangAdmission(client, reservations=ReservationTable())
+    # Nothing dirty, nothing held: a dirty tick is a no-op that never
+    # touches the API.
+    client_calls = []
+    orig = client.list_pods
+    client.list_pods = lambda *a, **k: (
+        client_calls.append(1) or orig(*a, **k)
+    )
+    assert adm.tick(full=False) == []
+    assert client_calls == []
+
+    # A pod event for gang "a" marks exactly ("default", "a").
+    adm.note_pod_event(pods[0])
+    with adm._dirty_lock:
+        assert adm._dirty == {("default", "a")}
+    assert adm.tick(full=False) == [("default", "a")]
+
+
+def test_cache_to_gang_wiring_marks_dirty_on_annotation_change():
+    """The __main__ wiring: index.on_change → gang.note_node_event.
+    An annotation flip on a slice member must wake a gang waiting on
+    that slice, with no full sweep involved."""
+    slice_s = ["s0", "s1"]
+    nodes = make_slice_nodes(slice_s, "2,1,1", busy=("s0", "s1"))
+    pods = _gang_pods("multi", 1, 8)
+    client = _GangClient(nodes, pods)
+    cache = NodeAnnotationCache(_ListClient(nodes), interval_s=3600)
+    cache.refresh()
+    adm = GangAdmission(
+        client,
+        reservations=ReservationTable(),
+        topo_source=cache.index.topologies,
+    )
+    cache.index.on_change = lambda name, keys: adm.note_node_event(keys)
+    assert adm.tick() == []
+    assert ("default", "multi") in adm._waiting_gangs
+
+    # The slice frees up; the watch event lands in the cache, whose
+    # index change-hook dirties the gang; the next DIRTY tick releases.
+    for fresh_node in make_slice_nodes(slice_s, "2,1,1"):
+        cache.apply_event("MODIFIED", fresh_node)
+    assert adm.tick(full=False) == [("default", "multi")]

@@ -405,3 +405,105 @@ def test_daemon_autodetects_vfio_layout(tmp_path):
         daemon.terminate()
         daemon.wait(timeout=10)
         kubelet.stop()
+
+
+def _vfio_mesh(group_numbers=(10, 11, 12, 13)):
+    """A v5e host whose chip indexes are IOMMU group numbers (the vfio
+    scanner's convention) — deliberately NOT dense 0-based ordinals."""
+    from k8s_device_plugin_tpu.discovery.chips import TpuChip
+    from k8s_device_plugin_tpu.topology.mesh import IciMesh
+
+    chips = [
+        TpuChip(
+            index=g,
+            dev_path=f"/dev/vfio/{g}",
+            pci_addr=f"0000:00:{4 + i:02x}.0",
+            vendor_id=0x1AE0,
+            device_id=0x0063,
+            numa_node=0,
+            chip_type="v5e",
+            hbm_bytes=16 << 30,
+            core_count=1,
+        )
+        for i, g in enumerate(group_numbers)
+    ]
+    return IciMesh(chips)
+
+
+def test_vfio_dense_reindex_remaps_group_numbers_to_ordinals():
+    """VERDICT r5 #3: with the opt-in remap, TPU_VISIBLE_CHIPS carries
+    dense 0-based ordinals (host chips in sorted group order), never
+    raw group numbers; a subset allocation gets the subset's ordinals."""
+    from k8s_device_plugin_tpu.server.plugin import (
+        PluginConfig,
+        TpuDevicePlugin,
+    )
+
+    mesh = _vfio_mesh((12, 10, 13, 11))  # scrambled group numbers
+    plugin = TpuDevicePlugin(
+        mesh,
+        config=PluginConfig(
+            devfs_layout="vfio", vfio_dense_reindex=True
+        ),
+    )
+    # Whole host: every ordinal, in the allocated chips' order.
+    env = plugin._tpu_env(mesh.mesh_chips)
+    by_group = {mc.chip.index: mc for mc in mesh.mesh_chips}
+    got = env["TPU_VISIBLE_CHIPS"].split(",")
+    assert sorted(got) == ["0", "1", "2", "3"]
+    # Group 10 is the smallest group number -> ordinal 0, etc.
+    order = [mc.chip.index for mc in mesh.mesh_chips]
+    expect = [str(sorted(order).index(g)) for g in order]
+    assert got == expect
+    # Subset allocation: the two chips with the highest group numbers
+    # map to ordinals 2 and 3 regardless of raw group values.
+    subset = [by_group[12], by_group[13]]
+    env = plugin._tpu_env(subset)
+    assert env["TPU_VISIBLE_CHIPS"] == "2,3"
+    # The self-check count var always rides along.
+    assert env["TPU_PLUGIN_ALLOCATED_CHIPS"] == "2"
+
+
+def test_vfio_default_still_omits_visible_chips_but_exports_count():
+    """The safe default is unchanged (no TPU_VISIBLE_CHIPS on vfio) —
+    but the plugin's own allocation-count var is now always exported,
+    so the workload smoke self-checks libtpu's enumeration even on
+    this layout (workload/smoke.py expected_chip_count fallback)."""
+    from k8s_device_plugin_tpu.server.plugin import (
+        PluginConfig,
+        TpuDevicePlugin,
+    )
+
+    mesh = _vfio_mesh()
+    plugin = TpuDevicePlugin(
+        mesh, config=PluginConfig(devfs_layout="vfio")
+    )
+    env = plugin._tpu_env(mesh.mesh_chips[:3])
+    assert "TPU_VISIBLE_CHIPS" not in env
+    assert env["TPU_PLUGIN_ALLOCATED_CHIPS"] == "3"
+
+
+def test_smoke_expected_chip_count_falls_back_to_allocated_var():
+    from k8s_device_plugin_tpu.workload import smoke
+
+    old = {
+        k: os.environ.pop(k, None)
+        for k in ("TPU_VISIBLE_CHIPS", "TPU_PLUGIN_ALLOCATED_CHIPS")
+    }
+    try:
+        assert smoke.expected_chip_count() is None
+        os.environ["TPU_PLUGIN_ALLOCATED_CHIPS"] = "3"
+        assert smoke.expected_chip_count() == 3
+        # TPU_VISIBLE_CHIPS, when present, stays authoritative.
+        os.environ["TPU_VISIBLE_CHIPS"] = "0,1"
+        assert smoke.expected_chip_count() == 2
+        # Junk in the count var reads as "no expectation", never a crash.
+        del os.environ["TPU_VISIBLE_CHIPS"]
+        os.environ["TPU_PLUGIN_ALLOCATED_CHIPS"] = "junk"
+        assert smoke.expected_chip_count() is None
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
