@@ -755,6 +755,17 @@ def main() -> int:
                 "error": repr(e)[:400]
             }
         emit()
+        # Phase 1.6: tracing-overhead probe (ISSUE 3 — the disabled
+        # path must be a measured no-op: its indexed /filter p99 is the
+        # number bounded ≤ +5% vs PR-2's control_plane_scale; the
+        # enabled numbers price the opt-in span per RPC).
+        try:
+            result["detail"]["tracing_overhead"] = (
+                scale_bench.tracing_overhead(n_nodes=1000)
+            )
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["tracing_overhead"] = {"error": repr(e)[:400]}
+        emit()
 
         # Phase 2a: harvest the t=0 probe loop (VERDICT r3 #1a /
         # r5 #1) — the long smoke runs only into a granted chip, and a

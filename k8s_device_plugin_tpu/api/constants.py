@@ -45,6 +45,14 @@ POD_RESOURCES_SOCKET = POD_RESOURCES_PATH + "kubelet.sock"
 TOPOLOGY_ANNOTATION = "google.com/tpu-topology"
 POD_DEVICES_ANNOTATION = "google.com/tpu-devices"
 
+# Pod annotation carrying the allocation trace context (W3C traceparent
+# syntax, utils/tracing.py): stamped by the gang admitter before the
+# first scheduling gate comes off, read by the extender's /filter +
+# /prioritize and by the plugin daemon's controller at reconcile — one
+# trace id follows the pod across all three daemons
+# (docs/observability.md).
+TRACE_ANNOTATION = "tpu.google.com/trace-context"
+
 # Env var understood the same way as the reference's DP_DISABLE_HEALTHCHECKS
 # (/root/reference/server.go:32-33,231-242): a comma-separated list of
 # check classes to disable. Classes: "all", "events" (inotify fast path;

@@ -23,7 +23,6 @@ client-go, sized to this plugin's needs.
 
 from __future__ import annotations
 
-import logging
 import queue
 import threading
 import time
@@ -33,7 +32,9 @@ from ..api import constants
 from ..kube import checkpoint as ckpt
 from ..kube.client import KubeClient, KubeError
 from ..kube.podresources import PodResourcesClient
-from ..utils import metrics
+from ..utils import metrics, tracing
+from ..utils.flightrecorder import RECORDER
+from ..utils.logging import get_logger
 from ..utils.podresources import is_tpu_pod
 from ..utils.resilience import (
     Backoff,
@@ -42,7 +43,7 @@ from ..utils.resilience import (
     delay_for_attempt,
 )
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 
 def _pod_claim_refs(pod: dict) -> set:
@@ -440,6 +441,55 @@ class Controller:
 
     # reference updatePodFunc, /root/reference/controller.go:173-225
     def _handle_update(self, pod: dict) -> None:
+        """Trace-joining wrapper: a pod carrying the trace-context
+        annotation (stamped by the gang admitter before its gates came
+        off) gets its reconcile recorded as a ``controller.reconcile``
+        span in that trace — which also makes the annotation PATCH a
+        kube.* child span — and the plugin's provisional Allocate span
+        adopted in (see _adopt_allocate_span). Pods without a carrier
+        (or with tracing off) reconcile exactly as before."""
+        if not tracing.enabled():
+            return self._handle_update_impl(pod)
+        ctx = tracing.extract(pod)
+        if ctx is None:
+            return self._handle_update_impl(pod)
+        with tracing.span(
+            "controller.reconcile",
+            parent=ctx,
+            service="controller",
+            pod=tracing.pod_key(pod),
+        ):
+            return self._handle_update_impl(pod)
+
+    def _adopt_allocate_span(self, pod: dict, real: List[str]) -> None:
+        """The plugin-side trace join (utils/tracing.py module doc):
+        Allocate ran before any pod identity was knowable, recording a
+        provisional span + its chip ids in plugin.recent_allocations;
+        now that THIS pod resolved to those chips (podresources/
+        checkpoint lookup) and carries the trace annotation, adopt the
+        span into the pod's trace."""
+        if not tracing.enabled():
+            return
+        ctx = tracing.extract(pod)
+        recents = getattr(self.plugin, "recent_allocations", None)
+        if ctx is None or not recents:
+            return
+        target = None
+        # Snapshot: the gRPC Allocate thread appends concurrently, and
+        # a deque raises on mutation during iteration.
+        for rec in list(recents):
+            if rec.get("ids") and rec["ids"] & set(real):
+                target = rec
+                break
+        if target is None:
+            return
+        try:
+            recents.remove(target)
+        except ValueError:
+            pass  # another reconcile raced us to it
+        tracing.adopt(target["span_id"], ctx)
+
+    def _handle_update_impl(self, pod: dict) -> None:
         meta = pod.get("metadata", {})
         uid = meta.get("uid", "")
         annotations = meta.get("annotations") or {}
@@ -493,6 +543,13 @@ class Controller:
         ns = meta.get("namespace", "default")
         name = meta.get("name", "")
         value = ",".join(sorted(real))
+        self._adopt_allocate_span(pod, real)
+        RECORDER.record(
+            "reconcile",
+            f"pod {ns}/{name} reconciled to its real chips",
+            pod=f"{ns}/{name}",
+            chips=value,
+        )
         try:
             self.client.patch_pod_annotations(
                 ns, name, {self.devices_annotation: value}
@@ -686,6 +743,12 @@ class Controller:
             try:
                 self.client.evict_pod(ns, name)
                 metrics.EVICTIONS.inc(outcome="evicted")
+                RECORDER.record(
+                    "evict",
+                    f"pod {ns}/{name} evicted (unhealthy chips)",
+                    pod=f"{ns}/{name}",
+                    chips=",".join(sorted(pod_chips)),
+                )
                 log.warning(
                     "evicted pod %s/%s: TPU chip(s) %s unhealthy",
                     ns, name, sorted(pod_chips),

@@ -10,7 +10,6 @@ controller runs in threads so the supervisor loop stays responsive
 
 from __future__ import annotations
 
-import logging
 import os
 import threading
 import time
@@ -22,8 +21,9 @@ from ..topology.mesh import IciMesh
 from ..topology.schema import NodeTopology
 from ..utils.resilience import Backoff, delay_for_attempt
 from .controller import Controller
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 
 def publish_node_topology(

@@ -14,13 +14,13 @@ result (0 chips, CPU-only node), not an error.
 from __future__ import annotations
 
 import ctypes
-import logging
 import os
 from typing import List, Optional
 
 from .chips import DEVICE_ID_TO_TYPE, GOOGLE_VENDOR_ID, TpuChip, spec_for
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 DEFAULT_SYSFS_ACCEL = "/sys/class/accel"
 DEFAULT_DEV = "/dev"

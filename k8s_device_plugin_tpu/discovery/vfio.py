@@ -39,10 +39,10 @@ pure-Python ``VfioTpuInfo``, result-identical and parity-tested;
 
 from __future__ import annotations
 
-import logging
 import os
 from typing import List, Optional
 
+from ..utils.logging import get_logger
 from .chips import DEVICE_ID_TO_TYPE, GOOGLE_VENDOR_ID, TpuChip, spec_for
 from .scanner import (
     NativeTpuInfo,
@@ -53,7 +53,7 @@ from .scanner import (
     _read_int,
 )
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 DEFAULT_IOMMU_GROUPS = "/sys/kernel/iommu_groups"
 DEFAULT_DEV_VFIO = "/dev/vfio"

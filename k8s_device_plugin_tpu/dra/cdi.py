@@ -18,13 +18,13 @@ same shape the NVIDIA DRA driver uses for its per-claim specs.
 from __future__ import annotations
 
 import json
-import logging
 import os
 import re
 import tempfile
 from typing import Dict, List, Optional, Sequence
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 # CDI spec version: 0.6.0 is what containerd 1.7+/CRI-O 1.28+ understand.
 CDI_VERSION = "0.6.0"

@@ -23,7 +23,6 @@ double-allocating chips.
 
 from __future__ import annotations
 
-import logging
 import os
 import threading
 from concurrent import futures
@@ -44,8 +43,9 @@ from ..kube.client import KubeError
 from ..server import plugin as plugin_mod
 from ..utils import metrics
 from . import cdi, slices
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 DEFAULT_PLUGINS_DIR = "/var/lib/kubelet/plugins"
 

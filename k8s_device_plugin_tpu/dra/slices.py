@@ -21,14 +21,14 @@ v1 flattens them onto the device.
 
 from __future__ import annotations
 
-import logging
 import re
 from typing import Dict, Optional
 
 from ..kube.client import KubeClient, KubeError
 from ..topology.mesh import IciMesh, MeshChip
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 RESOURCE_GROUP = "/apis/resource.k8s.io"
 # Newest first: negotiation picks the first one the cluster serves.

@@ -7,6 +7,9 @@ import os
 import signal
 import threading
 
+from ..utils import logging as tpulog
+from ..utils import tracing
+from ..utils.flightrecorder import RECORDER
 from .server import ExtenderHTTPServer
 
 
@@ -74,12 +77,34 @@ def main() -> int:
         help="singleton lease duration; the renew deadline (self-"
         "demotion horizon under an apiserver partition) is 2/3 of it",
     )
+    p.add_argument(
+        "--trace", action="store_true",
+        help="enable allocation tracing + the flight recorder "
+        "(utils/tracing.py; also TPU_TRACE=1): the gang admitter "
+        "opens a trace per released gang and stamps the pod-annotation "
+        "carrier, /filter+/prioritize join it, spans serve at "
+        "/debug/traces. Off = exact no-op",
+    )
+    p.add_argument(
+        "--log-json", action="store_true",
+        help="JSON-lines logging with trace correlation "
+        "(also TPU_LOG_JSON=1)",
+    )
+    p.add_argument(
+        "--flight-dir", default=os.environ.get("TPU_FLIGHT_DIR", ""),
+        help="directory for flight-recorder dumps on SIGTERM/circuit-"
+        "break; empty keeps the ring in-memory/HTTP only",
+    )
     p.add_argument("-v", "--verbose", action="count", default=0)
     a = p.parse_args()
-    logging.basicConfig(
-        level=logging.DEBUG if a.verbose else logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    tpulog.setup(
+        verbose=a.verbose,
+        json_lines=a.log_json or None,
+        service="extender",
     )
+    if a.trace or tracing.env_enabled():
+        tracing.enable(service="extender")
+        RECORDER.enable(service="extender", dump_dir=a.flight_dir)
     from .reservations import ReservationTable
     from .server import NodeAnnotationCache, TopologyExtender
 
@@ -208,6 +233,8 @@ def main() -> int:
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
+    # Post-mortem capture before teardown starts losing state.
+    RECORDER.dump_on("sigterm")
     if gang is not None:
         gang.stop()
     if leader is not None:

@@ -40,7 +40,6 @@ release and the fence that makes it stick.
 from __future__ import annotations
 
 import dataclasses
-import logging
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
@@ -49,11 +48,13 @@ from ..api import constants
 from ..kube.client import KubeClient, KubeError
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..topology.slice import SliceView
-from ..utils import metrics
+from ..utils import metrics, tracing
+from ..utils.flightrecorder import RECORDER
+from ..utils.logging import get_logger
 from ..utils.podresources import tpu_request
 from .reservations import DEFAULT_TABLE, ReservationTable
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 GATE_NAME = "tpu.google.com/gang"
 GANG_NAME_LABEL = "tpu.google.com/gang-name"
@@ -792,7 +793,11 @@ class GangAdmission:
                         "%d still gated)", key[0], key[1], len(gated),
                         gv.size,
                     )
-                self._release(gated)
+                self._traced_release(
+                    key, gated,
+                    reason="replacement_join" if placed
+                    else "finish_partial_release",
+                )
                 released.append(key)
                 self._clear_waiting(key)
                 continue
@@ -814,7 +819,9 @@ class GangAdmission:
                         "standing reservation (previous release pass "
                         "failed wholesale)", key[0], key[1],
                     )
-                    self._release(gated)
+                    self._traced_release(
+                        key, gated, reason="release_retry"
+                    )
                     released.append(key)
                     self._clear_waiting(key)
                     continue
@@ -847,6 +854,13 @@ class GangAdmission:
                 waiting = (key, tuple(sorted(demands)))
                 if waiting not in self._reported_waiting:
                     self._reported_waiting.add(waiting)
+                    RECORDER.record(
+                        "gang_waiting",
+                        f"gang {key[0]}/{key[1]} blocked on capacity",
+                        namespace=key[0],
+                        gang=key[1],
+                        demands=demands,
+                    )
                     log.info(
                         "gang %s/%s: insufficient TPU capacity for %s; "
                         "stays gated (re-evaluated every %.0fs)",
@@ -869,12 +883,10 @@ class GangAdmission:
             # it clears any lapse bar a previous same-named generation
             # left behind (the new hold ages from now, legitimately).
             self._lapsed_gangs.discard(key)
-            self._release(gated)
-            released.append(key)
-            log.info(
-                "gang %s/%s released: %d pods, demand %s",
-                key[0], key[1], gv.size, demands,
+            self._traced_release(
+                key, gated, reason="admitted", demands=demands
             )
+            released.append(key)
         with self._dirty_lock:
             metrics.GANG_WAITING.set(len(self._waiting_gangs))
         for _ in released:
@@ -1120,6 +1132,88 @@ class GangAdmission:
         return topos
 
     # -- release -----------------------------------------------------------
+
+    def _traced_release(
+        self,
+        key: Tuple[str, str],
+        members: List[dict],
+        reason: str,
+        demands: Optional[List[int]] = None,
+    ) -> None:
+        """Release wrapped in the allocation trace's ROOT span.
+
+        Gang admission is the first daemon to touch a gang pod, so the
+        ``gang.admit`` span opens the trace; its context is stamped
+        onto every member as the pod-annotation carrier
+        (constants.TRACE_ANNOTATION) BEFORE the gates come off — the
+        scheduler then hands the annotated pod to the extender's
+        /filter+/prioritize and eventually the plugin daemon's
+        controller, which all join via tracing.extract. The gate-
+        removal patches inside become kube.* child spans through the
+        resilience layer. Exact no-op when tracing is disabled."""
+        def note_released() -> None:
+            # Inside the span when one is open, so both the JSON log
+            # line and the flight event carry the trace id (the "grep
+            # the trace id" contract, docs/observability.md).
+            RECORDER.record(
+                "gang_released",
+                f"gang {key[0]}/{key[1]} gates removed ({reason})",
+                namespace=key[0],
+                gang=key[1],
+                pods=len(members),
+                reason=reason,
+            )
+            log.info(
+                "gang %s/%s released (%s): %d pods, demand %s",
+                key[0], key[1], reason, len(members),
+                demands if demands is not None else "unchanged",
+            )
+
+        if not tracing.enabled():
+            self._release(members)
+            note_released()
+            return
+        with tracing.span(
+            "gang.admit",
+            service="extender",
+            namespace=key[0],
+            gang=key[1],
+            pods=len(members),
+            reason=reason,
+        ) as sp:
+            self._stamp_trace(members, sp.context)
+            self._release(members)
+            note_released()
+
+    def _stamp_trace(self, members: List[dict], ctx) -> None:
+        """Write the trace-context carrier annotation onto each member
+        (apiserver patch + the local dict, so this pass's own gate
+        snapshot and any in-process consumer see it too). Best-effort
+        per pod: a failed stamp costs that pod's trace join, never the
+        release."""
+        carrier: Dict[str, str] = {}
+        tracing.inject(carrier, ctx)
+        if not carrier:
+            return
+        for pod in members:
+            meta = pod.setdefault("metadata", {})
+            ns = meta.get("namespace", "default")
+            name = meta.get("name", "")
+            # None-safe like every other annotations consumer here: an
+            # explicit "annotations": null must not abort the release.
+            ann = meta.get("annotations")
+            if not isinstance(ann, dict):
+                ann = {}
+                meta["annotations"] = ann
+            ann.update(carrier)
+            try:
+                self.client.patch_pod_annotations(ns, name, dict(carrier))
+            except Exception as e:  # noqa: BLE001 — tracing is an
+                # overlay; losing the carrier must not block release
+                log.debug(
+                    "trace carrier stamp for %s/%s failed: %s",
+                    ns, name, e,
+                )
 
     def _release(self, members: List[dict]) -> None:
         """Remove the gang gate from every member. Best-effort per pod:

@@ -32,14 +32,14 @@ values); mutations serialize on one lock.
 from __future__ import annotations
 
 import dataclasses
-import logging
 import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..utils import metrics
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 SliceKey = Tuple[str, ...]
 

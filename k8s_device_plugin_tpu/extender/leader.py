@@ -59,7 +59,6 @@ failover peer to welcome; see deploy/tpu-extender.yml).
 from __future__ import annotations
 
 import calendar
-import logging
 import os
 import socket
 import threading
@@ -68,8 +67,9 @@ from typing import Callable, Optional
 
 from ..kube.client import KubeError, rfc3339_now
 from ..utils import metrics
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 LEASE_NAME = "tpu-scheduler-extender"
 

@@ -386,6 +386,70 @@ def run(
     }
 
 
+def tracing_overhead(n_nodes: int = 1000, filter_calls: int = 30) -> dict:
+    """The disabled-is-a-no-op proof, MEASURED (ISSUE 3 acceptance):
+    the indexed /filter+/prioritize hot path with tracing disabled vs
+    enabled, same fixtures as :func:`run`. ``disabled`` percentiles are
+    directly comparable to ``run()``'s ``filter``/``prioritize`` (and
+    so to the PR-2 artifact's control_plane_scale numbers — the ≤5%
+    regression gate); ``enabled`` is the opt-in cost of a span per RPC
+    into the bounded collector."""
+    from ..utils import tracing
+
+    nodes = [_node(f"node-{i:04d}") for i in range(n_nodes)]
+    names = [(n.get("metadata") or {}).get("name", "") for n in nodes]
+    cache = NodeAnnotationCache(_StubClient(nodes, []), interval_s=3600)
+    cache.refresh()
+    ext = TopologyExtender(
+        reservations=ReservationTable(), node_cache=cache
+    )
+    # Warm the score memo off-measurement for every pod shape, as
+    # run() does.
+    for chips in (4, 1, 2):
+        pod = _plain_pod(chips=chips)
+        assert ext.filter_names(pod, names) is not None
+        assert ext.prioritize_names(pod, names) is not None
+
+    def measure() -> Dict[str, Dict[str, float]]:
+        fs: List[float] = []
+        ps: List[float] = []
+        for i in range(filter_calls):
+            pod = _plain_pod(chips=(1, 2, 4)[i % 3])
+            t0 = time.perf_counter()
+            out = ext.filter_names(pod, names)
+            fs.append(time.perf_counter() - t0)
+            assert out is not None and len(out[0]) == n_nodes
+            t0 = time.perf_counter()
+            scores = ext.prioritize_names(pod, names)
+            ps.append(time.perf_counter() - t0)
+            assert scores is not None and len(scores) == n_nodes
+        return {"filter": _pctl(fs), "prioritize": _pctl(ps)}
+
+    was_enabled = tracing.enabled()
+    assert not was_enabled, "probe must start from the disabled default"
+    collector = tracing.SpanCollector()
+    saved_collector = tracing.COLLECTOR
+    disabled = measure()
+    tracing.COLLECTOR = collector
+    try:
+        tracing.enable(service="extender")
+        enabled = measure()
+    finally:
+        tracing.disable()
+        tracing.COLLECTOR = saved_collector
+        tracing.RECENT.clear()
+    base = disabled["filter"]["p99_ms"] or 1e-9
+    return {
+        "nodes": n_nodes,
+        "disabled": disabled,
+        "enabled": enabled,
+        "spans_collected": len(collector),
+        "filter_p99_overhead_pct": round(
+            (enabled["filter"]["p99_ms"] - base) / base * 100.0, 1
+        ),
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     import json
@@ -393,7 +457,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--nodes", type=int, default=1000)
     p.add_argument("--gangs", type=int, default=100)
+    p.add_argument(
+        "--tracing-overhead", action="store_true",
+        help="run the tracing-overhead probe instead of the scale run",
+    )
     a = p.parse_args(argv)
+    if a.tracing_overhead:
+        print(json.dumps(tracing_overhead(n_nodes=a.nodes)))
+        return 0
     print(json.dumps(run(n_nodes=a.nodes, n_gangs=a.gangs)))
     return 0
 

@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import collections
 import json
-import logging
 import threading
 from http.server import BaseHTTPRequestHandler
 from typing import Dict, List, Optional, Tuple
@@ -36,15 +35,16 @@ from ..api import constants
 from ..topology.placement import PlacementState, ideal_box_links
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..topology.slice import SliceView, group_by_slice
-from ..utils import metrics
+from ..utils import metrics, tracing
 from ..utils.httpserver import BackgroundHTTPServer
+from ..utils.logging import get_logger
 from ..utils.podresources import tpu_request
 from ..utils.resilience import Backoff
 from .gang import pod_gang
 from .index import IndexEntry, TopologyIndex, shielded
 from .reservations import DEFAULT_TABLE, ReservationTable
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 MAX_SCORE = 10
 
@@ -92,6 +92,31 @@ class TopologyExtender:
             [t for _, t in parsed if t is not None], exclude=own
         )
 
+    # -- tracing -----------------------------------------------------------
+    #
+    # Each public RPC method wraps its implementation in a span when
+    # tracing is enabled (one bool check on the disabled hot path —
+    # bench.py's tracing-overhead probe measures it stays a no-op):
+    # /filter joins the pod's carried trace (the annotation the gang
+    # admitter stamped before releasing the gates) or opens a fresh one
+    # for pods that never went through gang admission; /prioritize then
+    # joins whatever /filter opened via the RECENT memo, so both RPCs of
+    # one scheduling cycle land in one trace.
+
+    def _span_for(self, name: str, pod: dict, candidates: int):
+        key = tracing.pod_key(pod)
+        parent = tracing.extract(pod) or tracing.RECENT.recall(key)
+        return (
+            tracing.span(
+                name,
+                parent=parent,
+                service="extender",
+                pod=key,
+                candidates=candidates,
+            ),
+            key,
+        )
+
     # -- node topology parsing --------------------------------------------
 
     def _parsed(
@@ -136,7 +161,21 @@ class TopologyExtender:
 
     # -- filter ------------------------------------------------------------
 
-    def filter(self, pod: dict, nodes: List[dict]) -> Tuple[List[dict], Dict[str, str]]:
+    def filter(
+        self, pod: dict, nodes: List[dict]
+    ) -> Tuple[List[dict], Dict[str, str]]:
+        if not tracing.enabled():
+            return self._filter_impl(pod, nodes)
+        cm, key = self._span_for("extender.filter", pod, len(nodes))
+        with cm as sp:
+            passing, failed = self._filter_impl(pod, nodes)
+            sp.set(passing=len(passing), failed=len(failed))
+            tracing.RECENT.remember(key, sp.context)
+            return passing, failed
+
+    def _filter_impl(
+        self, pod: dict, nodes: List[dict]
+    ) -> Tuple[List[dict], Dict[str, str]]:
         """Returns (passing_nodes, failed{name: reason}).
 
         Multi-host requests (n > a node's chip count) are gang-evaluated
@@ -268,6 +307,15 @@ class TopologyExtender:
         )
 
     def prioritize(self, pod: dict, nodes: List[dict]) -> List[dict]:
+        if not tracing.enabled():
+            return self._prioritize_impl(pod, nodes)
+        cm, key = self._span_for("extender.prioritize", pod, len(nodes))
+        with cm as sp:
+            out = self._prioritize_impl(pod, nodes)
+            tracing.RECENT.remember(key, sp.context)
+            return out
+
+    def _prioritize_impl(self, pod: dict, nodes: List[dict]) -> List[dict]:
         n = tpu_request(pod, self.resource_name)
         parsed3 = (
             [(node, *self._parsed(node)) for node in nodes]
@@ -371,6 +419,20 @@ class TopologyExtender:
     def filter_names(
         self, pod: dict, names: List[str]
     ) -> Optional[Tuple[List[str], Dict[str, str]]]:
+        if not tracing.enabled():
+            return self._filter_names_impl(pod, names)
+        cm, key = self._span_for("extender.filter", pod, len(names))
+        with cm as sp:
+            out = self._filter_names_impl(pod, names)
+            if out is not None:
+                sp.set(passing=len(out[0]), failed=len(out[1]),
+                       path="indexed")
+            tracing.RECENT.remember(key, sp.context)
+            return out
+
+    def _filter_names_impl(
+        self, pod: dict, names: List[str]
+    ) -> Optional[Tuple[List[str], Dict[str, str]]]:
         """Indexed /filter: (passing_names, failed) or None when the
         index can't serve. Capacity-infeasible candidates are rejected
         on integer counts before any topology object is touched."""
@@ -418,6 +480,19 @@ class TopologyExtender:
         return passing, failed
 
     def prioritize_names(
+        self, pod: dict, names: List[str]
+    ) -> Optional[List[dict]]:
+        if not tracing.enabled():
+            return self._prioritize_names_impl(pod, names)
+        cm, key = self._span_for("extender.prioritize", pod, len(names))
+        with cm as sp:
+            out = self._prioritize_names_impl(pod, names)
+            if out is not None:
+                sp.set(path="indexed")
+            tracing.RECENT.remember(key, sp.context)
+            return out
+
+    def _prioritize_names_impl(
         self, pod: dict, names: List[str]
     ) -> Optional[List[dict]]:
         """Indexed /prioritize: HostPriorityList or None when the index
@@ -901,14 +976,32 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                         "holds": ext.reservations.snapshot(),
                     })
                 elif self.path == "/metrics":
-                    data = metrics.EXTENDER_REGISTRY.render().encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type", "text/plain; version=0.0.4"
+                    data, ctype = metrics.render_scrape(
+                        metrics.EXTENDER_REGISTRY,
+                        self.headers.get("Accept", ""),
                     )
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
+                elif self.path.startswith("/debug/"):
+                    # Observability surface (utils/tracing.py +
+                    # utils/flightrecorder.py): /debug/traces serves
+                    # the span collector's OTLP-JSON export,
+                    # /debug/events the flight-recorder ring — same
+                    # payloads the daemon's metrics server exposes.
+                    payload = metrics.debug_payload(self.path)
+                    if payload is None:
+                        self._send({"error": "not found"}, 404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header(
+                        "Content-Length", str(len(payload))
+                    )
+                    self.end_headers()
+                    self.wfile.write(payload)
                 else:
                     self._send({"error": "not found"}, 404)
 

@@ -48,7 +48,6 @@ only class is "xids"):
 
 from __future__ import annotations
 
-import logging
 import os
 import threading
 from typing import Callable, Dict, FrozenSet, Optional, Sequence
@@ -56,8 +55,9 @@ from typing import Callable, Dict, FrozenSet, Optional, Sequence
 from ..api import constants
 from ..discovery.chips import TpuChip
 from ..utils import metrics
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 HealthCallback = Callable[[str, bool], None]  # (chip_id, healthy)
 

@@ -17,10 +17,10 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import logging
 from typing import Dict, List
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 
 @dataclasses.dataclass

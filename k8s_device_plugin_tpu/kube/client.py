@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import base64
 import json
-import logging
 import os
 import tempfile
 import threading
@@ -38,11 +37,12 @@ import requests
 import yaml
 
 from ..utils.resilience import Resilience, UnavailableError  # noqa: F401
+from ..utils.logging import get_logger
 # UnavailableError is re-exported: callers that need to distinguish
 # "apiserver unreachable" (degrade/queue) from a semantic KubeError
 # import it from here alongside KubeError.
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 

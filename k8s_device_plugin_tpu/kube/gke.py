@@ -28,11 +28,11 @@ worker_hostnames is unset before invoking this).
 from __future__ import annotations
 
 import dataclasses
-import logging
 import re
 from typing import List, Optional, Sequence, Tuple
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"

@@ -17,7 +17,6 @@ match on whichever key their pod object provides.
 
 from __future__ import annotations
 
-import logging
 import os
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -26,8 +25,9 @@ import grpc
 
 from ..api import grpc_defs
 from ..api import podresources_pb2 as pb
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 # One List round-trip over a local unix socket is milliseconds; anything
 # slower means the kubelet is wedged and the checkpoint fallback is better.

@@ -20,8 +20,8 @@ the TPU-specific differences recorded in ARCHITECTURE.md:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-import logging
 import os
 import threading
 from concurrent import futures
@@ -41,9 +41,11 @@ from ..api.grpc_defs import (
 )
 from ..topology.mesh import IciMesh
 from ..topology.placement import PlacementState
-from ..utils import metrics, profiling
+from ..utils import metrics, profiling, tracing
+from ..utils.flightrecorder import RECORDER
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 
 def libtpu_mount(config) -> Optional[tuple]:
@@ -183,6 +185,16 @@ class TpuDevicePlugin(DevicePluginServicer):
         # kubelet also tracks and never double-assigns — the kubelet is
         # blind to them, so its picks are the only path to a double mount.
         self.external_holds: Optional[Callable[[], set]] = None
+        # Tracing join buffer (utils/tracing.py): the kubelet's Allocate
+        # RPC carries device ids but no pod identity, so the Allocate
+        # span is recorded under a provisional trace and remembered here
+        # ({ids, trace_id, span_id}); the controller adopts it into the
+        # pod's carried trace once reconcile resolves the pod
+        # (podresources/checkpoint). Bounded; only fed while tracing is
+        # enabled.
+        self.recent_allocations: "collections.deque" = collections.deque(
+            maxlen=64
+        )
         metrics.CHIPS.set(len(mesh.mesh_chips), state="total")
         self._update_chip_gauges()
         # Device-list versioning: streams re-send whenever bumped.
@@ -322,6 +334,13 @@ class TpuDevicePlugin(DevicePluginServicer):
             metrics.HEALTH_TRANSITIONS.inc(
                 direction="recovered" if healthy else "unhealthy"
             )
+            RECORDER.record(
+                "health_transition",
+                f"chip {chip_id} "
+                + ("recovered" if healthy else "went unhealthy"),
+                chip=chip_id,
+                healthy=healthy,
+            )
             self._bump()
             self._availability_changed()
             hook = self.on_health_transition
@@ -404,7 +423,9 @@ class TpuDevicePlugin(DevicePluginServicer):
             yield resp
 
     def GetPreferredAllocation(self, request, context):
-        with profiling.timed(method="GetPreferredAllocation"):
+        with profiling.timed(
+            metrics.RPC_LATENCY, method="GetPreferredAllocation"
+        ):
             return self._get_preferred_allocation(request, context)
 
     def _get_preferred_allocation(self, request, context):
@@ -425,8 +446,35 @@ class TpuDevicePlugin(DevicePluginServicer):
         return resp
 
     def Allocate(self, request, context):
-        with profiling.timed(method="Allocate"):
-            return self._allocate(request, context)
+        if not tracing.enabled():
+            with profiling.timed(metrics.RPC_LATENCY, method="Allocate"):
+                return self._allocate(request, context)
+        # Provisional root span: no pod identity is knowable here (the
+        # kubelet sends device ids only), so the span starts its own
+        # trace and the controller adopts it into the pod's carried
+        # trace at reconcile time (tracing.adopt; see
+        # recent_allocations). The RPC_LATENCY observation lands inside
+        # the span, so the histogram keeps an exemplar pointing at it.
+        with tracing.span(
+            "plugin.Allocate",
+            service="plugin",
+            containers=len(request.container_requests),
+        ) as sp:
+            with profiling.timed(metrics.RPC_LATENCY, method="Allocate"):
+                resp = self._allocate(request, context)
+            ids: set = set()
+            for cresp in resp.container_responses:
+                ann = cresp.annotations.get(
+                    constants.POD_DEVICES_ANNOTATION, ""
+                )
+                ids.update(i for i in ann.split(",") if i)
+            sp.set(chips=len(ids))
+            self.recent_allocations.append({
+                "ids": frozenset(ids),
+                "trace_id": sp.trace_id,
+                "span_id": sp.span_id,
+            })
+            return resp
 
     def _allocate(self, request, context):
         # Two-phase under one lock: validate + plan every container first,
@@ -500,6 +548,11 @@ class TpuDevicePlugin(DevicePluginServicer):
                 )
                 metrics.ALLOCATIONS.inc()
                 metrics.ALLOCATED_CHIPS.inc(len(assigned))
+                RECORDER.record(
+                    "allocate",
+                    "chips handed to a container",
+                    chips=",".join(assigned),
+                )
         self._availability_changed()
         return resp
 

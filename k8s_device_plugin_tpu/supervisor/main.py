@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-import logging
 import os
 import queue
 import signal
@@ -32,6 +31,10 @@ import time
 from typing import List, Optional
 
 from ..api import constants
+from ..utils import logging as tpulog
+from ..utils import tracing
+from ..utils.flightrecorder import RECORDER
+from ..utils.logging import get_logger
 from ..discovery.chips import TpuChip, parse_gke_accelerator_label, spec_for
 from ..discovery.scanner import (
     DEFAULT_DEV,
@@ -46,7 +49,7 @@ from ..topology.mesh import IciMesh
 from ..topology.placement import PlacementState
 from .watchers import FsWatcher, SignalWatcher
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 
 @dataclasses.dataclass
@@ -101,6 +104,14 @@ class DaemonConfig:
     dra_driver_name: str = "tpu.google.com"
     plugins_dir: str = "/var/lib/kubelet/plugins"
     cdi_dir: str = "/var/run/cdi"
+    # Observability plane (utils/tracing.py + utils/flightrecorder.py):
+    # allocation tracing + flight recorder, off by default (exact
+    # no-op). --trace / TPU_TRACE=1 enables; flight_dir is where the
+    # event ring is dumped on SIGTERM/circuit-break ("" = memory+HTTP
+    # only); log_json switches logging to correlated JSON lines.
+    trace: bool = False
+    log_json: bool = False
+    flight_dir: str = ""
 
 
 class Daemon:
@@ -108,6 +119,13 @@ class Daemon:
 
     def __init__(self, cfg: DaemonConfig):
         self.cfg = cfg
+        if cfg.trace or tracing.env_enabled():
+            # One switch turns on the whole observability plane for
+            # this daemon: spans (collected, /debug/traces, exemplars
+            # on the latency histograms) + the flight-recorder ring
+            # (/debug/events, dumped on SIGTERM/circuit-break).
+            tracing.enable(service="plugin")
+            RECORDER.enable(service="plugin", dump_dir=cfg.flight_dir)
         self._accel_backend = get_backend(
             prefer_native=cfg.prefer_native_backend
         )
@@ -432,9 +450,17 @@ class Daemon:
                     continue
                 if kind == "create" and payload == constants.KUBELET_SOCKET_NAME:
                     log.info("kubelet socket recreated; restarting plugin")
+                    RECORDER.record(
+                        "plugin_restart",
+                        "kubelet socket recreated; rebuilding",
+                        reason="kubelet_socket",
+                    )
                     restart = True
                 elif kind == "signal" and payload == signal.SIGHUP:
                     log.info("SIGHUP; restarting plugin")
+                    RECORDER.record(
+                        "plugin_restart", "SIGHUP rebuild", reason="sighup"
+                    )
                     restart = True
                 elif kind == "signal" and payload in (
                     signal.SIGTERM,
@@ -443,6 +469,10 @@ class Daemon:
                     log.info("signal %d; shutting down", payload)
                     return 0
         finally:
+            # Post-mortem capture on the way down (SIGTERM/SIGINT or a
+            # crash unwinding through here): the event ring is the last
+            # N notable things this daemon did.
+            RECORDER.dump_on("shutdown")
             self.teardown()
             fs.stop()
             sigs.stop()
@@ -533,11 +563,26 @@ def parse_args(argv) -> DaemonConfig:
     p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
     p.add_argument("--python-backend", action="store_true",
                    help="skip libtpuinfo.so, use the Python scanner")
+    p.add_argument("--trace", action="store_true",
+                   help="enable allocation tracing + the flight "
+                   "recorder (utils/tracing.py; also TPU_TRACE=1): "
+                   "spans at /debug/traces, events at /debug/events, "
+                   "exemplars on the latency histograms. Off = exact "
+                   "no-op")
+    p.add_argument("--log-json", action="store_true",
+                   help="JSON-lines logging with trace correlation "
+                   "(also TPU_LOG_JSON=1)")
+    p.add_argument("--flight-dir", default=os.environ.get(
+                       "TPU_FLIGHT_DIR", ""),
+                   help="directory for flight-recorder dumps on "
+                   "SIGTERM/circuit-break; empty keeps the ring "
+                   "in-memory/HTTP only")
     p.add_argument("-v", "--verbose", action="count", default=0)
     a = p.parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if a.verbose else logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    tpulog.setup(
+        verbose=a.verbose,
+        json_lines=a.log_json or None,
+        service="plugin",
     )
     return DaemonConfig(
         node_name=a.node_name,
@@ -569,6 +614,9 @@ def parse_args(argv) -> DaemonConfig:
         dra_driver_name=a.dra_driver_name,
         plugins_dir=a.plugins_dir,
         cdi_dir=a.cdi_dir,
+        trace=a.trace,
+        log_json=a.log_json,
+        flight_dir=a.flight_dir,
     )
 
 

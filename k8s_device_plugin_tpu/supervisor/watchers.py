@@ -11,7 +11,6 @@ stat-polling fallback for filesystems without inotify.
 from __future__ import annotations
 
 import errno
-import logging
 import os
 import queue
 import select
@@ -28,8 +27,9 @@ from ..utils.inotify import (
     init_nonblocking,
     load_libc,
 )
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 _EVENT_FMT = "iIII"
 _EVENT_SIZE = struct.calcsize(_EVENT_FMT)

@@ -1,0 +1,258 @@
+"""Debug CLI: pretty-print an allocation trace or flight-recorder dump.
+
+Consumes either artifact the observability plane produces
+(docs/observability.md):
+
+* an **OTLP-JSON trace export** — ``GET /debug/traces`` on the daemon's
+  metrics port or the extender port, or a file written by
+  ``tracing.COLLECTOR.export_file`` — rendered as a per-trace tree
+  (parent→children by span ids) with wall durations, services, and
+  error status;
+* a **flight-recorder dump** — ``GET /debug/events`` or a
+  SIGTERM/circuit-break dump file — rendered as a chronological event
+  table with trace correlation.
+
+    python -m k8s_device_plugin_tpu.tools.trace dump.json
+    curl -s extender:12346/debug/traces | \
+        python -m k8s_device_plugin_tpu.tools.trace -
+    python -m k8s_device_plugin_tpu.tools.trace --trace-id abc... dump.json
+    python -m k8s_device_plugin_tpu.tools.trace --self-test
+
+``--self-test`` generates a synthetic three-daemon trace in-process and
+renders it — the CI smoke (scripts/tier1.sh) that proves the CLI can
+parse what the collector exports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+def _flatten_otlp(doc: dict) -> List[dict]:
+    """OTLP-JSON resourceSpans → flat span dicts (the collector's
+    internal shape)."""
+    out = []
+    for rs in doc.get("resourceSpans", []):
+        service = ""
+        for attr in (rs.get("resource") or {}).get("attributes", []):
+            if attr.get("key") == "service.name":
+                service = (attr.get("value") or {}).get("stringValue", "")
+        for ss in rs.get("scopeSpans", []):
+            for s in ss.get("spans", []):
+                out.append({
+                    "trace_id": s.get("traceId", ""),
+                    "span_id": s.get("spanId", ""),
+                    "parent_span_id": s.get("parentSpanId", ""),
+                    "name": s.get("name", ""),
+                    "service": service,
+                    "start_ns": int(s.get("startTimeUnixNano", 0)),
+                    "end_ns": int(s.get("endTimeUnixNano", 0)),
+                    "attrs": {
+                        a.get("key", ""): (a.get("value") or {}).get(
+                            "stringValue", ""
+                        )
+                        for a in s.get("attributes", [])
+                    },
+                    "error": (s.get("status") or {}).get("message", ""),
+                })
+    return out
+
+
+def _ms(span: dict) -> float:
+    return max(0, span["end_ns"] - span["start_ns"]) / 1e6
+
+
+def _render_span(span: dict, children: Dict[str, List[dict]],
+                 depth: int, out: List[str]) -> None:
+    attrs = " ".join(
+        f"{k}={v}" for k, v in sorted((span.get("attrs") or {}).items())
+    )
+    status = " ERROR: " + span["error"] if span.get("error") else ""
+    out.append(
+        f"{'  ' * depth}{'└─ ' if depth else ''}"
+        f"{span['name']} [{span.get('service') or '?'}] "
+        f"{_ms(span):.2f}ms"
+        + (f" {{{attrs}}}" if attrs else "")
+        + status
+    )
+    for child in sorted(
+        children.get(span["span_id"], []), key=lambda s: s["start_ns"]
+    ):
+        _render_span(child, children, depth + 1, out)
+
+
+def render_trace_tree(spans: List[dict],
+                      trace_id: str = "") -> List[str]:
+    """One tree per trace (roots = spans whose parent is absent from
+    the set — an adopted or dropped parent still renders)."""
+    if trace_id:
+        spans = [s for s in spans if s["trace_id"] == trace_id]
+    out: List[str] = []
+    by_trace: Dict[str, List[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    for tid, members in sorted(by_trace.items()):
+        ids = {s["span_id"] for s in members}
+        children: Dict[str, List[dict]] = {}
+        roots = []
+        for s in members:
+            if s["parent_span_id"] and s["parent_span_id"] in ids:
+                children.setdefault(s["parent_span_id"], []).append(s)
+            else:
+                roots.append(s)
+        start = min(s["start_ns"] for s in members)
+        end = max(s["end_ns"] for s in members)
+        out.append(
+            f"trace {tid}  ({len(members)} spans, "
+            f"{(end - start) / 1e6:.2f}ms end-to-end)"
+        )
+        for root in sorted(roots, key=lambda s: s["start_ns"]):
+            _render_span(root, children, 1, out)
+        out.append("")
+    if not out:
+        out.append("(no spans)")
+    return out
+
+
+def render_events(doc: dict) -> List[str]:
+    events = doc.get("events", [])
+    out = [
+        f"flight recorder [{doc.get('service') or '?'}] "
+        f"{len(events)} events, {doc.get('dropped', 0)} dropped"
+        + (f", dumped on {doc['reason']}" if doc.get("reason") else "")
+    ]
+    for ev in events:
+        ts = time.strftime(
+            "%H:%M:%S", time.localtime(ev.get("ts", 0))
+        ) + f".{int((ev.get('ts', 0) % 1) * 1000):03d}"
+        attrs = " ".join(
+            f"{k}={v}" for k, v in sorted((ev.get("attrs") or {}).items())
+        )
+        trace = (
+            f" trace={ev['trace_id'][:16]}" if ev.get("trace_id") else ""
+        )
+        out.append(
+            f"  {ts}  {ev.get('kind', '?'):<18} {ev.get('message', '')}"
+            + (f"  [{attrs}]" if attrs else "")
+            + trace
+        )
+    return out
+
+
+def render(doc: dict, trace_id: str = "") -> List[str]:
+    """Dispatch on artifact shape: OTLP-JSON trace export vs
+    flight-recorder dump."""
+    if "resourceSpans" in doc:
+        lines = render_trace_tree(_flatten_otlp(doc), trace_id=trace_id)
+        if doc.get("dropped_spans"):
+            lines.append(
+                f"({doc['dropped_spans']} spans dropped by the collector "
+                "ring before this export)"
+            )
+        return lines
+    if "events" in doc:
+        return render_events(doc)
+    raise ValueError(
+        "unrecognized artifact: expected OTLP-JSON ('resourceSpans') "
+        "or a flight-recorder dump ('events')"
+    )
+
+
+def _self_test() -> dict:
+    """Synthesize the canonical allocation journey through the REAL
+    collector (tracing.py enable→span→export), so this smoke breaks if
+    the export shape and this renderer ever drift."""
+    from ..utils import tracing
+
+    collector = tracing.SpanCollector()
+    saved = tracing.COLLECTOR
+    tracing.COLLECTOR = collector
+    was_enabled = tracing.enabled()
+    try:
+        tracing.enable(service="extender")
+        with tracing.span(
+            "gang.admit", service="extender", gang="demo", pods=2
+        ) as root:
+            ctx = root.context
+            with tracing.span("kube.PATCH"):
+                pass
+        with tracing.span(
+            "extender.filter", parent=ctx, service="extender",
+            candidates=3,
+        ):
+            pass
+        with tracing.span(
+            "extender.prioritize", parent=ctx, service="extender"
+        ):
+            pass
+        with tracing.span(
+            "plugin.Allocate", parent=ctx, service="plugin", chips=4
+        ):
+            pass
+        with tracing.span(
+            "controller.reconcile", parent=ctx, service="controller"
+        ):
+            pass
+        return collector.otlp_json()
+    finally:
+        tracing.COLLECTOR = saved
+        if not was_enabled:
+            tracing.disable()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu-trace",
+        description="Pretty-print an OTLP-JSON trace export or a "
+        "flight-recorder dump (tree view with durations).",
+    )
+    p.add_argument(
+        "path", nargs="?", default="",
+        help="artifact file, or '-' for stdin",
+    )
+    p.add_argument(
+        "--trace-id", default="",
+        help="render only this trace from a span export",
+    )
+    p.add_argument(
+        "--self-test", action="store_true",
+        help="render a synthetic in-process trace (CI smoke)",
+    )
+    a = p.parse_args(argv)
+    if a.self_test:
+        doc = _self_test()
+    elif not a.path:
+        p.error("a file path (or '-') is required without --self-test")
+        return 2
+    elif a.path == "-":
+        doc = json.load(sys.stdin)
+    else:
+        with open(a.path) as f:
+            doc = json.load(f)
+    try:
+        lines = render(doc, trace_id=a.trace_id)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print("\n".join(lines))
+    if a.self_test:
+        # The smoke must fail loudly if the synthetic journey didn't
+        # render as ONE tree with every daemon's span in it.
+        text = "\n".join(lines)
+        needed = (
+            "gang.admit", "extender.filter", "extender.prioritize",
+            "plugin.Allocate", "controller.reconcile", "kube.PATCH",
+        )
+        missing = [n for n in needed if n not in text]
+        if missing or "trace " not in text:
+            print(f"self-test failed: missing {missing}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

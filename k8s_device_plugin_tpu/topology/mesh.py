@@ -26,11 +26,11 @@ import dataclasses
 import itertools
 from typing import Dict, List, Optional, Sequence, Tuple
 
-import logging
 
 from ..discovery.chips import AcceleratorSpec, TpuChip, spec_for
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 Coord = Tuple[int, int, int]
 

@@ -24,12 +24,12 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
-import logging
 
 from .placement import box_candidates, ideal_box_links
 from .schema import NodeTopology
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 Coord = Tuple[int, int, int]
 

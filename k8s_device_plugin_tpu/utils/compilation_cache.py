@@ -13,11 +13,11 @@ workload stack's time-to-first-step budget (BASELINE.md north star).
 
 from __future__ import annotations
 
-import logging
 import os
 from typing import Optional
+from .logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 ENV_VAR = "TPU_WORKLOAD_COMPILATION_CACHE_DIR"
 

@@ -1,0 +1,147 @@
+"""Flight recorder: a fixed-size ring of structured events per daemon.
+
+Post-mortem capture for the control plane. Metrics aggregate and traces
+follow single requests; what neither preserves is the ORDER of the last
+N notable things a daemon did before it died ("log archaeology" is the
+reference's only answer — SURVEY.md §5). Each daemon (plugin,
+extender, controller/supervisor) keeps one bounded in-memory
+:class:`FlightRecorder`; events are structured dicts (epoch timestamp,
+kind, message, flat attrs) stamped with the active trace context
+(utils/tracing.py) so a dump cross-references the trace that caused it.
+
+The ring is:
+
+* **served live** at ``GET /debug/events`` on both existing HTTP
+  servers (daemon metrics port, extender port);
+* **dumped to disk** on SIGTERM/shutdown (the entrypoints call
+  :meth:`dump_on`), and on a kube circuit-break (utils/resilience.py
+  hooks the breaker's OPEN transition) — the two moments an operator
+  most wants the preceding event tail;
+* **bounded**: past ``capacity`` the oldest event drops and
+  ``dropped`` counts it — a crash loop can never grow the recorder.
+
+Recording is gated on :meth:`enable` (one bool check when off — the
+observability layer is an exact no-op when disabled, measured by
+bench.py's tracing-overhead probe). Event rates surface as the
+``*_flight_events_total`` metric families (by ``kind``) so the Grafana
+dashboard can plot them next to the latency exemplars.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from . import tracing
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 2048):
+        self.capacity = capacity
+        self.enabled = False
+        self.service = ""
+        # Directory for fault/shutdown dumps; "" disables disk dumps
+        # (the in-memory ring and /debug/events still work).
+        self.dump_dir = ""
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._events: "collections.deque" = collections.deque()
+        self._counter = None  # *_flight_events_total, bound by enable()
+
+    def enable(self, service: str = "plugin", dump_dir: str = "",
+               capacity: Optional[int] = None) -> None:
+        from . import metrics
+
+        with self._lock:
+            self.service = service
+            self.dump_dir = dump_dir
+            if capacity is not None:
+                self.capacity = capacity
+            self._counter = (
+                metrics.EXT_FLIGHT_EVENTS
+                if service == "extender"
+                else metrics.FLIGHT_EVENTS
+            )
+            self.enabled = True
+
+    def disable(self) -> None:
+        with self._lock:
+            self.enabled = False
+            self._counter = None
+
+    def record(self, kind: str, message: str = "", **attrs) -> None:
+        """Append one event. First line is the enabled gate — recording
+        must cost one bool read when the recorder is off."""
+        if not self.enabled:
+            return
+        ctx = tracing.current()
+        ev = {
+            "ts": round(time.time(), 3),
+            "kind": kind,
+            "message": message,
+            "attrs": {k: str(v) for k, v in attrs.items()},
+        }
+        if ctx is not None:
+            ev["trace_id"] = ctx.trace_id
+            ev["span_id"] = ctx.span_id
+        with self._lock:
+            self._events.append(ev)
+            while len(self._events) > self.capacity:
+                self._events.popleft()
+                self.dropped += 1
+            counter = self._counter
+        if counter is not None:
+            counter.inc(kind=kind)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def snapshot(self) -> dict:
+        """The /debug/events payload and the dump-file body."""
+        with self._lock:
+            events = [dict(e) for e in self._events]
+            dropped = self.dropped
+        return {
+            "service": self.service,
+            "capacity": self.capacity,
+            "dropped": dropped,
+            "events": events,
+        }
+
+    def dump_on(self, reason: str) -> Optional[str]:
+        """Write the ring to ``dump_dir`` (timestamped file name carries
+        the reason + pid). Returns the path, or None when disabled /
+        no dump dir / empty ring. Never raises — a failed dump on the
+        way down must not mask the original failure."""
+        if not self.enabled or not self.dump_dir:
+            return None
+        snap = self.snapshot()
+        if not snap["events"]:
+            return None
+        snap["reason"] = reason
+        name = (
+            f"flight-{self.service or 'daemon'}-"
+            f"{time.strftime('%Y%m%dT%H%M%S')}-{os.getpid()}-{reason}.json"
+        )
+        path = os.path.join(self.dump_dir, name)
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(snap, f, indent=1)
+        except OSError:
+            return None
+        return path
+
+
+# One per process, like the metrics registry: a daemon is one process.
+RECORDER = FlightRecorder()

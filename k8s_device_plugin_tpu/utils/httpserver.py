@@ -2,12 +2,12 @@
 
 from __future__ import annotations
 
-import logging
 import threading
 from http.server import ThreadingHTTPServer
 from typing import Optional, Type
+from .logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 
 class BackgroundHTTPServer:

@@ -1,0 +1,138 @@
+"""Single logging bootstrap: JSON-lines output with trace correlation.
+
+Before this module, every entrypoint hand-rolled ``logging.basicConfig``
+with its own format string and ~28 modules called ``getLogger``
+directly — uncorrelatable text lines across three daemons. Now:
+
+* Modules take their logger from :func:`get_logger` (one import site,
+  so a future handler/filter change touches one file).
+* Entrypoints call :func:`setup` exactly once: level from the
+  ``-v`` flag or ``TPU_LOG_LEVEL``; plain human format by default,
+  **JSON lines** with ``--log-json`` or ``TPU_LOG_JSON=1``.
+* Every record carries ``trace_id``/``span_id`` from the active span
+  (utils/tracing.py) via a root-logger filter — a log line, an
+  OpenMetrics exemplar, and a span in /debug/traces all share one id,
+  which is what makes "grep the trace id" work across planes.
+
+The filter is installed even in plain-text mode (the fields ride the
+record; the plain format shows them only when a trace is active), so
+flipping a fleet to JSON is a config change, not a redeploy.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+
+def get_logger(name: str) -> logging.Logger:
+    """The module-logger constructor every package module uses (in
+    place of bare ``logging.getLogger``)."""
+    return logging.getLogger(name)
+
+
+class TraceContextFilter(logging.Filter):
+    """Stamps trace_id/span_id from the active span onto each record
+    (empty strings when no span is open or tracing is disabled)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        from . import tracing
+
+        ctx = tracing.current()
+        record.trace_id = ctx.trace_id if ctx else ""
+        record.span_id = ctx.span_id if ctx else ""
+        return True
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line: ts (epoch seconds), level, logger,
+    message, service, trace_id/span_id when a span is active, and the
+    exception text when present."""
+
+    def __init__(self, service: str = ""):
+        super().__init__()
+        self.service = service
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if self.service:
+            out["service"] = self.service
+        trace_id = getattr(record, "trace_id", "")
+        if trace_id:
+            out["trace_id"] = trace_id
+            out["span_id"] = getattr(record, "span_id", "")
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+class PlainFormatter(logging.Formatter):
+    """The pre-existing human format, plus a trailing trace marker when
+    a span is active (so -v debugging still correlates)."""
+
+    def __init__(self):
+        super().__init__("%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        trace_id = getattr(record, "trace_id", "")
+        if trace_id:
+            base += f" trace={trace_id[:16]}"
+        return base
+
+
+def resolve_level(verbose: int = 0,
+                  level: Optional[str] = None) -> int:
+    """flag > explicit level > TPU_LOG_LEVEL env > INFO."""
+    if verbose:
+        return logging.DEBUG
+    name = level or os.environ.get("TPU_LOG_LEVEL", "")
+    if name:
+        resolved = logging.getLevelName(name.upper())
+        if isinstance(resolved, int):
+            return resolved
+    return logging.INFO
+
+
+def json_lines_enabled(flag: Optional[bool] = None) -> bool:
+    if flag is not None:
+        return flag
+    return os.environ.get("TPU_LOG_JSON", "") in ("1", "true", "on")
+
+
+_MARKER = "_tpu_logging_bootstrap"
+
+
+def setup(
+    verbose: int = 0,
+    json_lines: Optional[bool] = None,
+    service: str = "",
+    level: Optional[str] = None,
+) -> logging.Logger:
+    """Configure the root logger exactly once per process (idempotent:
+    a second call replaces the handler this bootstrap installed, never
+    stacks a duplicate). Returns the root logger."""
+    root = logging.getLogger()
+    root.setLevel(resolve_level(verbose, level))
+    for h in list(root.handlers):
+        if getattr(h, _MARKER, False):
+            root.removeHandler(h)
+    handler = logging.StreamHandler()
+    setattr(handler, _MARKER, True)
+    handler.addFilter(TraceContextFilter())
+    if json_lines_enabled(json_lines):
+        handler.setFormatter(JsonFormatter(service=service))
+    else:
+        handler.setFormatter(PlainFormatter())
+    root.addHandler(handler)
+    # asctime in UTC like the apiserver's own stamps.
+    logging.Formatter.converter = time.gmtime
+    return root

@@ -11,8 +11,9 @@ from __future__ import annotations
 import threading
 import time
 from http.server import BaseHTTPRequestHandler
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
+from . import tracing
 from .httpserver import BackgroundHTTPServer
 
 
@@ -40,10 +41,20 @@ class Metric:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
+        # OpenMetrics declares a counter FAMILY without the _total
+        # suffix (samples keep it); emitting '# TYPE x_total counter'
+        # is rejected by spec-compliant parsers ('clashing name').
+        family = self.name
+        if (
+            openmetrics
+            and self.kind == "counter"
+            and family.endswith("_total")
+        ):
+            family = family[: -len("_total")]
         lines = [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} {self.kind}",
+            f"# HELP {family} {self.help}",
+            f"# TYPE {family} {self.kind}",
         ]
         with self._lock:
             if not self._values:
@@ -70,7 +81,15 @@ DEFAULT_BUCKETS = (
 
 
 class Histogram:
-    """Prometheus histogram (cumulative le buckets + _sum/_count)."""
+    """Prometheus histogram (cumulative le buckets + _sum/_count).
+
+    When tracing (utils/tracing.py) is enabled and an observation lands
+    inside an open span, the span's context is kept as an **exemplar**
+    for the smallest bucket the value falls in (latest wins, per
+    labelset per bucket). An OpenMetrics scrape
+    (``Accept: application/openmetrics-text``) renders them as
+    ``# {trace_id="…",span_id="…"} value ts`` suffixes — the link from
+    a p99 bucket to the trace that caused it."""
 
     def __init__(self, name: str, help_text: str,
                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
@@ -80,23 +99,53 @@ class Histogram:
         self._counts: Dict[Tuple[Tuple[str, str], ...], list] = {}
         self._sums: Dict[Tuple[Tuple[str, str], ...], float] = {}
         self._totals: Dict[Tuple[Tuple[str, str], ...], int] = {}
+        # labelset key -> bucket index (len(buckets) = +Inf) ->
+        # (trace_id, span_id, value, unix_ts)
+        self._exemplars: Dict[Tuple[Tuple[str, str], ...], Dict[int, tuple]] = {}
         self._lock = threading.Lock()
 
     def observe(self, value: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
+        ctx = tracing.current()  # one bool read when tracing is off
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            bucket_idx = len(self.buckets)  # +Inf
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     counts[i] += 1
+                    bucket_idx = min(bucket_idx, i)
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+            if ctx is not None:
+                self._exemplars.setdefault(key, {})[bucket_idx] = (
+                    ctx.trace_id, ctx.span_id, value, round(time.time(), 3)
+                )
 
     def count(self, **labels) -> int:
         with self._lock:
             return self._totals.get(tuple(sorted(labels.items())), 0)
 
-    def render(self) -> str:
+    def exemplar(self, bucket_index: int, **labels) -> Optional[tuple]:
+        """(trace_id, span_id, value, ts) kept for one bucket of one
+        labelset, or None. ``bucket_index == len(buckets)`` is +Inf."""
+        with self._lock:
+            return self._exemplars.get(
+                tuple(sorted(labels.items())), {}
+            ).get(bucket_index)
+
+    def _exemplar_suffix(self, key, idx: int, openmetrics: bool) -> str:
+        if not openmetrics:
+            return ""
+        ex = self._exemplars.get(key, {}).get(idx)
+        if ex is None:
+            return ""
+        trace_id, span_id, value, ts = ex
+        return (
+            f' # {{trace_id="{trace_id}",span_id="{span_id}"}} '
+            f"{_fmt(value)} {ts}"
+        )
+
+    def render(self, openmetrics: bool = False) -> str:
         lines = [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} histogram",
@@ -105,14 +154,20 @@ class Histogram:
             for key in sorted(self._totals):
                 base = ",".join(f'{k}="{v}"' for k, v in key)
                 sep = "," if base else ""
-                for bound, c in zip(self.buckets, self._counts[key]):
+                for i, (bound, c) in enumerate(
+                    zip(self.buckets, self._counts[key])
+                ):
                     lines.append(
                         f'{self.name}_bucket{{{base}{sep}le="{_fmt(bound)}"}}'
                         f" {c}"
+                        f"{self._exemplar_suffix(key, i, openmetrics)}"
                     )
                 lines.append(
                     f'{self.name}_bucket{{{base}{sep}le="+Inf"}} '
                     f"{self._totals[key]}"
+                    + self._exemplar_suffix(
+                        key, len(self.buckets), openmetrics
+                    )
                 )
                 label_s = f"{{{base}}}" if base else ""
                 lines.append(
@@ -150,15 +205,25 @@ class Registry:
             self._metrics[name] = Metric(name, help_text, kind)
         return self._metrics[name]
 
-    def render(self) -> str:
-        parts = [m.render() for m in self._metrics.values()]
+    def render(self, openmetrics: bool = False) -> str:
+        """Prometheus text format; ``openmetrics=True`` additionally
+        renders histogram exemplars and the closing ``# EOF`` the
+        OpenMetrics parser requires (served when the scrape's Accept
+        header asks for application/openmetrics-text)."""
+        parts = [
+            m.render(openmetrics=openmetrics)
+            for m in self._metrics.values()
+        ]
         parts.append(
             f"# HELP {self._uptime_name} Seconds since process start\n"
             f"# TYPE {self._uptime_name} gauge\n"
             f"{self._uptime_name} "
             f"{_fmt(round(time.time() - self._start, 1))}"
         )
-        return "\n".join(parts) + "\n"
+        out = "\n".join(parts) + "\n"
+        if openmetrics:
+            out += "# EOF\n"
+        return out
 
 
 # The plugin's metrics (module-level: one daemon per process).
@@ -232,6 +297,19 @@ KUBE_QUEUED_WRITES = REGISTRY.gauge(
     "tpu_plugin_kube_queued_writes",
     "State-publishing writes queued while the apiserver is unreachable "
     "(drained on reconnect; >0 for long = degraded mode)",
+)
+# Observability plane (utils/tracing.py + utils/flightrecorder.py):
+# constant 0 unless --trace / TPU_TRACE enables it.
+TRACE_SPANS = REGISTRY.counter(
+    "tpu_plugin_trace_spans_total",
+    "Trace spans recorded by this process's collector "
+    "(utils/tracing.py; served at /debug/traces)",
+)
+FLIGHT_EVENTS = REGISTRY.counter(
+    "tpu_plugin_flight_events_total",
+    "Flight-recorder events captured, by kind "
+    "(utils/flightrecorder.py; served at /debug/events, dumped on "
+    "SIGTERM/circuit-break)",
 )
 # The extender/gang-admission process exposes its own registry: sharing
 # the daemon's would publish every tpu_plugin_* family as constant zeros
@@ -351,10 +429,64 @@ EXT_KUBE_REQUEST_LATENCY = EXTENDER_REGISTRY.histogram(
     "Wall latency of individual kube API request attempts, by verb and "
     "outcome",
 )
+EXT_TRACE_SPANS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_trace_spans_total",
+    "Trace spans recorded by this process's collector "
+    "(utils/tracing.py; served at /debug/traces)",
+)
+EXT_FLIGHT_EVENTS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_flight_events_total",
+    "Flight-recorder events captured, by kind "
+    "(utils/flightrecorder.py; served at /debug/events)",
+)
+
+
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+
+
+def render_scrape(registry: Registry, accept: str) -> Tuple[bytes, str]:
+    """(body, content_type) for one /metrics scrape: OpenMetrics (with
+    histogram exemplars) when the Accept header asks for it, classic
+    Prometheus text otherwise. Shared by the daemon's MetricsServer and
+    the extender's HTTP server so exemplar behavior can't drift."""
+    openmetrics = "application/openmetrics-text" in (accept or "")
+    body = registry.render(openmetrics=openmetrics).encode()
+    ctype = (
+        OPENMETRICS_CONTENT_TYPE
+        if openmetrics
+        else "text/plain; version=0.0.4"
+    )
+    return body, ctype
+
+
+def debug_payload(path: str) -> Optional[bytes]:
+    """JSON body for the /debug/* observability endpoints (shared by
+    both HTTP servers): /debug/traces = the span collector's OTLP-JSON
+    export (optionally ?trace_id=...), /debug/events = the flight
+    recorder ring. None for any other path."""
+    import json as _json
+    import urllib.parse as _up
+
+    from . import tracing
+    from .flightrecorder import RECORDER
+
+    parsed = _up.urlparse(path)
+    if parsed.path == "/debug/traces":
+        trace_id = dict(_up.parse_qsl(parsed.query)).get("trace_id", "")
+        return _json.dumps(
+            tracing.COLLECTOR.otlp_json(trace_id=trace_id)
+        ).encode()
+    if parsed.path == "/debug/events":
+        return _json.dumps(RECORDER.snapshot()).encode()
+    return None
 
 
 class MetricsServer(BackgroundHTTPServer):
-    """Serves GET /metrics (and /healthz) for Prometheus scrapes.
+    """Serves GET /metrics (and /healthz) for Prometheus scrapes, plus
+    the observability debug surface: /debug/traces (OTLP-JSON span
+    export) and /debug/events (flight-recorder ring).
 
     ``liveness_check`` (optional, () -> bool) backs /healthz: this server
     runs on its own thread, so an unconditional 200 would only prove the
@@ -379,11 +511,23 @@ class MetricsServer(BackgroundHTTPServer):
 
             def do_GET(self):
                 if self.path == "/metrics":
-                    body = registry.render().encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type", "text/plain; version=0.0.4"
+                    body, ctype = render_scrape(
+                        registry, self.headers.get("Accept", "")
                     )
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                elif self.path.startswith("/debug/"):
+                    payload = debug_payload(self.path)
+                    if payload is None:
+                        body = b"not found\n"
+                        self.send_response(404)
+                        self.send_header("Content-Type", "text/plain")
+                    else:
+                        body = payload
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "application/json"
+                        )
                 elif self.path == "/healthz":
                     check = server.liveness_check
                     live = True

@@ -20,19 +20,31 @@ import contextlib
 import time
 from typing import Iterator, Optional
 
-from . import metrics
-
 
 @contextlib.contextmanager
-def timed(histogram=None, **labels) -> Iterator[None]:
-    """Observe the block's wall time into ``histogram`` (default: the
-    plugin RPC latency histogram)."""
-    h = metrics.RPC_LATENCY if histogram is None else histogram
+def timed(histogram, **labels) -> Iterator[None]:
+    """Observe the block's wall time into ``histogram``.
+
+    The histogram is REQUIRED: the old default (the plugin registry's
+    RPC_LATENCY) silently violated the deliberate plugin/extender
+    registry separation (docs/metrics.md preamble) whenever extender
+    code called ``timed()`` bare — latency observed in the wrong
+    process's families, invisible until a scrape showed plugin numbers
+    on the extender Service. Callers name their registry's histogram
+    explicitly (e.g. ``metrics.RPC_LATENCY`` in the daemon,
+    ``metrics.EXT_KUBE_REQUEST_LATENCY`` in the extender)."""
+    if histogram is None or not hasattr(histogram, "observe"):
+        raise TypeError(
+            "timed() requires an explicit Histogram (e.g. "
+            "metrics.RPC_LATENCY for the plugin daemon); the implicit "
+            "plugin-registry default was removed because it silently "
+            "crossed the plugin/extender registry separation"
+        )
     start = time.monotonic()
     try:
         yield
     finally:
-        h.observe(time.monotonic() - start, **labels)
+        histogram.observe(time.monotonic() - start, **labels)
 
 
 @contextlib.contextmanager

@@ -58,13 +58,13 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import logging
 import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
+from .logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 # HTTP statuses that indicate the apiserver (or a proxy in front of it)
 # is unhealthy rather than answering: retryable, breaker-counted.
@@ -321,14 +321,43 @@ class Resilience:
         self.policy = policy or RetryPolicy()
         self.metrics = metrics if metrics is not None else plugin_metrics()
         self.breaker = breaker or CircuitBreaker(
-            on_state_change=self.metrics.circuit_state.set
+            on_state_change=self._on_circuit_change
         )
         if breaker is not None and breaker._on_state_change is None:
-            breaker._on_state_change = self.metrics.circuit_state.set
+            breaker._on_state_change = self._on_circuit_change
         self.budget = budget or RetryBudget()
         self.classify = classify
         self._clock = clock
         self._sleep = sleep
+
+    def _on_circuit_change(self, state: int) -> None:
+        """Gauge update plus flight-recorder capture: a circuit OPENING
+        is exactly the moment the preceding event tail matters (the
+        apiserver just became unreachable from this daemon), so the
+        ring is dumped to disk right then — a crash-looping daemon
+        leaves its last moments behind even if SIGKILL follows."""
+        self.metrics.circuit_state.set(state)
+        from .flightrecorder import RECORDER
+
+        RECORDER.record(
+            "circuit_state",
+            "kube API circuit breaker state changed",
+            state={CLOSED: "closed", OPEN: "open", HALF_OPEN: "half-open"}[
+                state
+            ],
+        )
+        if state == OPEN and RECORDER.enabled and RECORDER.dump_dir:
+            # This callback runs under the breaker's lock (the lock
+            # every kube call takes in allow()/record_*): the disk
+            # write must happen off-thread or a slow volume would
+            # stall every kube-calling thread exactly when the
+            # apiserver is already down.
+            threading.Thread(
+                target=RECORDER.dump_on,
+                args=("circuit-break",),
+                name="flight-dump",
+                daemon=True,
+            ).start()
 
     def call(
         self,
@@ -341,7 +370,33 @@ class Resilience:
         propagate unchanged on the first attempt; transport-level
         failures are retried with jittered backoff until attempts,
         deadline, or the retry budget run out — then UnavailableError.
+
+        When tracing is enabled AND this call runs inside an open span,
+        the whole logical call (attempts + backoff sleeps) becomes a
+        ``kube.<verb>`` child span — every kube round-trip an
+        allocation's journey makes is a child of that journey's trace.
+        Root spans are deliberately NOT minted here: background relists
+        and watches outside any trace stay span-free.
         """
+        from . import tracing
+
+        if tracing.enabled() and tracing.current() is not None:
+            with tracing.span(f"kube.{verb or 'call'}") as sp:
+                result = self._call_inner(
+                    fn, verb, deadline_s, max_attempts
+                )
+                if sp is not None:
+                    sp.set(outcome="ok")
+                return result
+        return self._call_inner(fn, verb, deadline_s, max_attempts)
+
+    def _call_inner(
+        self,
+        fn: Callable[[], object],
+        verb: str = "",
+        deadline_s: Optional[float] = None,
+        max_attempts: Optional[int] = None,
+    ):
         if not self.breaker.allow():
             raise CircuitOpenError(
                 "kube API circuit open (recent calls failed at the "

@@ -1,0 +1,499 @@
+"""Zero-dependency distributed tracing for the control plane.
+
+The reference has no tracing at all (SURVEY.md §5: "Tracing / profiling:
+none"), and PRs 1-2 only added aggregate counters — an allocation's
+journey still spanned three daemons (extender, gang admitter, plugin
+daemon) with no way to follow ONE pod through them. This module is the
+missing causality plane, built on nothing but the standard library so
+the control-plane processes stay dependency-free:
+
+* **Trace/span model**: W3C-shaped ids (32-hex trace id, 16-hex span
+  id), spans with a name, service, wall-clock start/end (epoch ns),
+  flat string attributes, and an error status. A thread-local span
+  stack makes ``span()`` nest naturally; anything that runs inside an
+  open span (notably every kube API round-trip, hooked in
+  utils/resilience.py) becomes a child automatically.
+* **Propagation**: one trace follows the allocation journey across
+  processes via a **pod-annotation carrier**
+  (``constants.TRACE_ANNOTATION``, W3C ``traceparent`` syntax
+  ``00-<trace>-<span>-01``). The gang admitter opens the trace and
+  stamps the carrier before the first scheduling gate comes off; the
+  scheduler hands the annotated pod to the extender's ``/filter`` and
+  ``/prioritize`` (which join via :func:`extract`); the plugin daemon's
+  controller joins at reconcile time by reading the same annotation off
+  the pod the kubelet admitted (pod lookup via podresources/checkpoint)
+  and **adopting** the provisional ``plugin.Allocate`` span into the
+  trace (:func:`adopt` — the kubelet's Allocate RPC carries no pod
+  identity, so the join is necessarily retroactive).
+* **Collection/export**: a bounded in-memory :class:`SpanCollector`
+  per process (ring semantics: oldest spans drop, loudly counted),
+  exported as OTLP-JSON (the OpenTelemetry ``resourceSpans`` JSON
+  shape — loadable by any OTLP tooling and by ``tools/trace.py``) and
+  served at ``GET /debug/traces`` on both the daemon's metrics server
+  and the extender's HTTP server.
+
+**Exact no-op when disabled** (the default): every entry point checks
+one module-level bool first; ``span()`` then yields ``None`` without
+allocating ids, touching the thread-local, or recording anything.
+bench.py's tracing-overhead probe measures (not asserts) that the
+disabled path does not move the indexed /filter p99.
+
+Correlated logging (utils/logging.py) injects ``trace_id``/``span_id``
+from :func:`current` into every JSON log line, and the metrics
+histograms (utils/metrics.py) attach OpenMetrics exemplars from the
+same context — one id links a log line, a p99 bucket, and a trace.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# Span names are stable identifiers (docs/observability.md documents
+# each; tests/test_observability.py greps call sites into lockstep).
+# ``kube.<verb>`` child spans are minted dynamically by the resilience
+# layer — one per kube API logical call made inside an open span.
+
+_lock = threading.Lock()
+_enabled = False
+_service = ""
+_tls = threading.local()
+# Lazily-bound metric counter (per-process registry family; see
+# utils/metrics.py TRACE_SPANS / EXT_TRACE_SPANS).
+_span_counter = None
+
+
+class SpanContext(collections.namedtuple("SpanContext", "trace_id span_id")):
+    """The propagatable part of a span: (trace_id, span_id)."""
+
+    __slots__ = ()
+
+
+def _ids() -> Tuple[str, str]:
+    return os.urandom(16).hex(), os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+class Span:
+    """One in-flight span. Finished spans live on as plain dicts in the
+    collector (cheap to bound, trivially JSON-serializable)."""
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_span_id", "name", "service",
+        "start_ns", "end_ns", "attrs", "error",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        parent: Optional[SpanContext] = None,
+        service: str = "",
+        **attrs,
+    ):
+        if parent is not None:
+            self.trace_id = parent.trace_id
+            self.parent_span_id = parent.span_id
+            self.span_id = new_span_id()
+        else:
+            self.trace_id, self.span_id = _ids()
+            self.parent_span_id = ""
+        self.name = name
+        self.service = service or _service
+        self.start_ns = time.time_ns()
+        self.end_ns = 0
+        self.attrs = {k: str(v) for k, v in attrs.items()}
+        self.error = ""
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def set(self, **attrs) -> None:
+        self.attrs.update((k, str(v)) for k, v in attrs.items())
+
+    def finish(self, error: str = "") -> dict:
+        self.end_ns = time.time_ns()
+        if error:
+            self.error = error
+        d = {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "name": self.name,
+            "service": self.service,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "attrs": self.attrs,
+            "error": self.error,
+        }
+        COLLECTOR.add(d)
+        if _span_counter is not None:
+            _span_counter.inc()
+        return d
+
+
+class _SpanCM:
+    """Context manager for one span; pushes/pops the thread-local
+    current-span stack. Plain class (not @contextmanager) so the
+    disabled path in :func:`span` can avoid generator machinery."""
+
+    __slots__ = ("_span",)
+
+    def __init__(self, s: Span):
+        self._span = s
+
+    def __enter__(self) -> Span:
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        stack = getattr(_tls, "stack", None)
+        if stack and stack[-1] is self._span:
+            stack.pop()
+        self._span.finish(
+            error=f"{exc_type.__name__}: {exc}" if exc_type else ""
+        )
+        return False
+
+
+class _NoopCM:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a) -> bool:
+        return False
+
+
+_NOOP = _NoopCM()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(service: str = "plugin") -> None:
+    """Turn tracing on for this process. ``service`` names the daemon in
+    exported spans and picks the span-counter metric family (plugin vs
+    extender registry — the separation utils/metrics.py maintains)."""
+    global _enabled, _service, _span_counter
+    from . import metrics
+
+    with _lock:
+        _service = service
+        _span_counter = (
+            metrics.EXT_TRACE_SPANS
+            if service == "extender"
+            else metrics.TRACE_SPANS
+        )
+        _enabled = True
+
+
+def disable() -> None:
+    global _enabled, _span_counter
+    with _lock:
+        _enabled = False
+        _span_counter = None
+
+
+def env_enabled() -> bool:
+    """The TPU_TRACE=1 environment opt-in (entrypoints OR this with
+    their --trace flag)."""
+    return os.environ.get("TPU_TRACE", "") in ("1", "true", "on")
+
+
+def current() -> Optional[SpanContext]:
+    """The innermost open span's context on this thread, or None.
+    Cheap when disabled (one bool read)."""
+    if not _enabled:
+        return None
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return None
+    return stack[-1].context
+
+
+def current_span() -> Optional[Span]:
+    if not _enabled:
+        return None
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def span(name: str, parent: Optional[SpanContext] = None,
+         service: str = "", **attrs):
+    """Context manager for one span. Disabled ⇒ a shared no-op that
+    yields None (zero allocation beyond the call itself). ``parent``
+    overrides the thread-local parent (carrier-extracted contexts);
+    otherwise the innermost open span on this thread is the parent."""
+    if not _enabled:
+        return _NOOP
+    if parent is None:
+        stack = getattr(_tls, "stack", None)
+        if stack:
+            parent = stack[-1].context
+    return _SpanCM(Span(name, parent=parent, service=service, **attrs))
+
+
+def adopt(span_id: str, parent: SpanContext) -> bool:
+    """Re-parent an already-collected span into ``parent``'s trace —
+    the plugin-side join: Allocate runs before any pod identity is
+    knowable (the kubelet RPC carries device ids only), so its span is
+    recorded under a provisional trace and adopted once the controller
+    resolves the pod (podresources/checkpoint) and reads the carrier
+    annotation. The provisional trace id is kept as an attribute so
+    exemplars/log lines stamped before adoption stay resolvable.
+    Returns False when the span has already been dropped by the ring."""
+    return COLLECTOR.reparent(span_id, parent)
+
+
+# -- carrier (pod annotation) -----------------------------------------------
+
+def format_traceparent(ctx: SpanContext) -> str:
+    """W3C traceparent: version 00, sampled flag set."""
+    return f"00-{ctx.trace_id}-{ctx.span_id}-01"
+
+
+def parse_traceparent(value: str) -> Optional[SpanContext]:
+    parts = (value or "").strip().split("-")
+    if len(parts) != 4:
+        return None
+    _, trace_id, span_id, _ = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    return SpanContext(trace_id, span_id)
+
+
+def inject(annotations: Dict[str, str],
+           ctx: Optional[SpanContext] = None) -> None:
+    """Write the carrier annotation for ``ctx`` (default: the current
+    span) into a pod's annotations dict. No-op when there is nothing
+    to propagate."""
+    from ..api import constants
+
+    ctx = ctx or current()
+    if ctx is not None:
+        annotations[constants.TRACE_ANNOTATION] = format_traceparent(ctx)
+
+
+def extract(pod: Optional[dict]) -> Optional[SpanContext]:
+    """Read the carrier annotation off a pod object (or a bare
+    annotations dict). None when absent/malformed — a bad carrier must
+    never fail the request it rode in on."""
+    if not isinstance(pod, dict):
+        return None
+    from ..api import constants
+
+    ann = pod
+    meta = pod.get("metadata")
+    if isinstance(meta, dict):
+        ann = meta.get("annotations") or {}
+    raw = ann.get(constants.TRACE_ANNOTATION) if isinstance(ann, dict) else None
+    return parse_traceparent(raw) if raw else None
+
+
+# -- filter→prioritize correlation without a carrier -------------------------
+
+class _RecentTraces:
+    """Bounded, TTL'd pod-key → SpanContext memo: /filter and
+    /prioritize see the same pod in one scheduling cycle, but a pod
+    that never went through gang admission carries no annotation — the
+    extender remembers the /filter-opened trace here so /prioritize
+    joins it instead of opening a second root.
+
+    The TTL bounds a trace to roughly ONE scheduling cycle: a Pending
+    pod the scheduler retries every ~10-30 s must open a fresh root
+    per cycle, not chain hours of unrelated cycles into one mega-trace
+    (the two RPCs it exists to correlate land milliseconds apart)."""
+
+    def __init__(self, max_items: int = 1024, ttl_s: float = 5.0):
+        self.max_items = max_items
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        # key -> (ctx, monotonic stamp)
+        self._items: "collections.OrderedDict" = collections.OrderedDict()
+
+    def remember(self, key: str, ctx: SpanContext) -> None:
+        if not key:
+            return
+        with self._lock:
+            self._items.pop(key, None)
+            self._items[key] = (ctx, time.monotonic())
+            while len(self._items) > self.max_items:
+                self._items.popitem(last=False)
+
+    def recall(self, key: str) -> Optional[SpanContext]:
+        with self._lock:
+            entry = self._items.get(key)
+            if entry is None:
+                return None
+            ctx, stamp = entry
+            if time.monotonic() - stamp > self.ttl_s:
+                del self._items[key]
+                return None
+            return ctx
+
+    def clear(self) -> None:
+        with self._lock:
+            self._items.clear()
+
+
+RECENT = _RecentTraces()
+
+
+def pod_key(pod: dict) -> str:
+    """Stable correlation key for a pod object: uid when present, else
+    namespace/name."""
+    meta = (pod or {}).get("metadata") or {}
+    return meta.get("uid") or (
+        f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+    )
+
+
+# -- collection / export ------------------------------------------------------
+
+class SpanCollector:
+    """Bounded in-memory store of finished spans (ring semantics:
+    oldest drop first, counted in ``dropped``). One per process —
+    served at /debug/traces and exportable as OTLP-JSON."""
+
+    def __init__(self, max_spans: int = 4096):
+        self.max_spans = max_spans
+        self._lock = threading.Lock()
+        self._spans: "collections.deque" = collections.deque()
+        self.dropped = 0
+
+    def add(self, span_dict: dict) -> None:
+        with self._lock:
+            self._spans.append(span_dict)
+            while len(self._spans) > self.max_spans:
+                self._spans.popleft()
+                self.dropped += 1
+
+    def reparent(self, span_id: str, parent: SpanContext) -> bool:
+        """Rewrite one collected span (and its collected descendants)
+        into ``parent``'s trace — see :func:`adopt`."""
+        with self._lock:
+            target = None
+            for s in self._spans:
+                if s["span_id"] == span_id:
+                    target = s
+                    break
+            if target is None:
+                return False
+            old_trace = target["trace_id"]
+            target.setdefault("attrs", {})["adopted_from"] = old_trace
+            target["trace_id"] = parent.trace_id
+            target["parent_span_id"] = parent.span_id
+            # Children recorded under the provisional trace follow.
+            descendants = {span_id}
+            changed = True
+            while changed:
+                changed = False
+                for s in self._spans:
+                    if (
+                        s["trace_id"] == old_trace
+                        and s["parent_span_id"] in descendants
+                        and s["span_id"] not in descendants
+                    ):
+                        s["trace_id"] = parent.trace_id
+                        descendants.add(s["span_id"])
+                        changed = True
+            return True
+
+    def spans(self) -> List[dict]:
+        with self._lock:
+            return [dict(s) for s in self._spans]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    def traces(self) -> Dict[str, List[dict]]:
+        out: Dict[str, List[dict]] = {}
+        for s in self.spans():
+            out.setdefault(s["trace_id"], []).append(s)
+        return out
+
+    def trace(self, trace_id: str) -> List[dict]:
+        return [s for s in self.spans() if s["trace_id"] == trace_id]
+
+    def otlp_json(self, trace_id: str = "") -> dict:
+        """The OTLP/JSON ``resourceSpans`` shape, one resource per
+        service — loadable by OTLP tooling and tools/trace.py."""
+        spans = self.trace(trace_id) if trace_id else self.spans()
+        by_service: Dict[str, List[dict]] = {}
+        for s in spans:
+            by_service.setdefault(s.get("service", ""), []).append(s)
+        resource_spans = []
+        for service, members in sorted(by_service.items()):
+            resource_spans.append({
+                "resource": {
+                    "attributes": [{
+                        "key": "service.name",
+                        "value": {"stringValue": service or "unknown"},
+                    }]
+                },
+                "scopeSpans": [{
+                    "scope": {"name": "k8s_device_plugin_tpu"},
+                    "spans": [
+                        {
+                            "traceId": s["trace_id"],
+                            "spanId": s["span_id"],
+                            "parentSpanId": s["parent_span_id"],
+                            "name": s["name"],
+                            "startTimeUnixNano": str(s["start_ns"]),
+                            "endTimeUnixNano": str(s["end_ns"]),
+                            "attributes": [
+                                {
+                                    "key": k,
+                                    "value": {"stringValue": v},
+                                }
+                                for k, v in sorted(
+                                    (s.get("attrs") or {}).items()
+                                )
+                            ],
+                            "status": (
+                                {"code": 2, "message": s["error"]}
+                                if s.get("error")
+                                else {"code": 0}
+                            ),
+                        }
+                        for s in members
+                    ],
+                }],
+            })
+        return {
+            "resourceSpans": resource_spans,
+            "dropped_spans": self.dropped,
+        }
+
+    def export_file(self, path: str, trace_id: str = "") -> str:
+        """Write the OTLP-JSON export to ``path`` (dirs created)."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.otlp_json(trace_id=trace_id), f, indent=1)
+        return path
+
+
+COLLECTOR = SpanCollector()

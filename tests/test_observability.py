@@ -261,6 +261,49 @@ def test_grafana_dashboard_in_lockstep_with_registries():
     assert not ghosts, f"dashboard references unknown families: {sorted(ghosts)}"
 
 
+def test_observability_doc_in_lockstep_with_code():
+    """docs/observability.md must document every span name and
+    flight-event kind the code actually uses (grepped from call
+    sites), the carrier annotation, and both /debug endpoints — a
+    renamed span or event kind must break this test, not silently
+    orphan the doc."""
+    import os
+    import re
+
+    from k8s_device_plugin_tpu.api import constants as api_constants
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = open(os.path.join(repo, "docs", "observability.md")).read()
+    src = ""
+    pkg = os.path.join(repo, "k8s_device_plugin_tpu")
+    for root, _, files in os.walk(pkg):
+        for f in files:
+            if f.endswith(".py"):
+                src += open(os.path.join(root, f)).read()
+    span_names = set(
+        re.findall(r'tracing\.span\(\s*"([A-Za-z_.]+)"', src)
+    ) | set(re.findall(r'_span_for\(\s*"([A-Za-z_.]+)"', src))
+    assert span_names, "span-name grep found nothing (pattern drift?)"
+    undocumented = {n for n in span_names if n not in doc}
+    assert not undocumented, (
+        f"span names used in code but absent from "
+        f"docs/observability.md: {sorted(undocumented)}"
+    )
+    kinds = set(re.findall(r'RECORDER\.record\(\s*\n?\s*"([a-z_]+)"', src))
+    assert kinds, "flight-event grep found nothing (pattern drift?)"
+    missing_kinds = {k for k in kinds if k not in doc}
+    assert not missing_kinds, (
+        f"flight-event kinds used in code but absent from "
+        f"docs/observability.md: {sorted(missing_kinds)}"
+    )
+    assert api_constants.TRACE_ANNOTATION in doc
+    for endpoint in ("/debug/traces", "/debug/events"):
+        assert endpoint in doc, f"{endpoint} missing from the doc"
+    # The runbook entry the doc points at must exist.
+    ops = open(os.path.join(repo, "docs", "operations.md")).read()
+    assert "Reading an allocation trace" in ops
+
+
 def test_metrics_doc_in_lockstep_with_registries():
     """docs/metrics.md must document every registered family and name
     no family that doesn't exist (uptime families are rendered, not

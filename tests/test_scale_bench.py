@@ -141,6 +141,26 @@ def test_scale_bench_cold_is_separated_from_warm():
     assert r["filter"]["samples"] == 3
 
 
+def test_tracing_overhead_probe_schema_and_restore():
+    """The bench's tracing-overhead probe (ISSUE 3 acceptance: the
+    disabled path is a measured no-op) at toy scale: both arms
+    measured, spans collected only in the enabled arm, and — the part
+    that would poison every later test — tracing fully disabled and
+    the process collector restored afterwards."""
+    from k8s_device_plugin_tpu.utils import tracing
+
+    saved_collector = tracing.COLLECTOR
+    r = scale_bench.tracing_overhead(n_nodes=30, filter_calls=4)
+    assert r["nodes"] == 30
+    assert r["disabled"]["filter"]["samples"] == 4
+    assert r["enabled"]["filter"]["samples"] == 4
+    # One filter + one prioritize span per enabled call.
+    assert r["spans_collected"] == 8
+    assert "filter_p99_overhead_pct" in r
+    assert not tracing.enabled()
+    assert tracing.COLLECTOR is saved_collector
+
+
 def test_scale_bench_correctness_assertions_fire():
     """run() itself asserts every node passes the all-free filter on
     BOTH paths (indexed and full-object), every gang releases in the

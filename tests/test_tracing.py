@@ -1,0 +1,688 @@
+"""Observability plane: allocation tracing, flight recorder, correlated
+logging, exemplars — ISSUE 3.
+
+Covers the tentpole end to end: span model + thread-local nesting,
+pod-annotation carrier, bounded collector + OTLP-JSON export, the
+kube-call child spans hooked through utils/resilience.py, the
+retroactive plugin-Allocate adoption, flight-recorder ring semantics
+(overflow, dump-on-fault), JSON log correlation, exemplar rendering,
+and the full three-daemon propagation e2e through
+tests/fake_apiserver.py + tests/fake_kubelet.py.
+"""
+
+import json
+import logging as std_logging
+import threading
+
+import pytest
+import requests
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.utils import metrics, profiling, tracing
+from k8s_device_plugin_tpu.utils import logging as tpulog
+from k8s_device_plugin_tpu.utils.flightrecorder import RECORDER, FlightRecorder
+from k8s_device_plugin_tpu.utils.resilience import (
+    CircuitBreaker,
+    Resilience,
+    UnavailableError,
+)
+
+
+@pytest.fixture
+def traced():
+    """Fresh collector + tracing enabled for the test, fully restored
+    after (the tier-1 suite shares one process)."""
+    collector = tracing.SpanCollector()
+    saved = tracing.COLLECTOR
+    tracing.COLLECTOR = collector
+    tracing.RECENT.clear()
+    tracing.enable(service="test")
+    try:
+        yield collector
+    finally:
+        tracing.disable()
+        tracing.COLLECTOR = saved
+        tracing.RECENT.clear()
+
+
+# -- span model ---------------------------------------------------------------
+
+def test_disabled_is_noop():
+    assert not tracing.enabled()
+    before = len(tracing.COLLECTOR)
+    with tracing.span("extender.filter", pod="x") as sp:
+        assert sp is None
+        assert tracing.current() is None
+    assert len(tracing.COLLECTOR) == before
+    # The disabled context manager is a shared singleton: no per-call
+    # allocation on the hot path.
+    assert tracing.span("a") is tracing.span("b")
+
+
+def test_span_nesting_and_ids(traced):
+    with tracing.span("outer", service="svc", k="v") as outer:
+        assert len(outer.trace_id) == 32 and len(outer.span_id) == 16
+        assert tracing.current() == outer.context
+        with tracing.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_span_id == outer.span_id
+        assert tracing.current() == outer.context
+    assert tracing.current() is None
+    spans = {s["name"]: s for s in traced.spans()}
+    assert spans["outer"]["attrs"] == {"k": "v"}
+    assert spans["outer"]["service"] == "svc"
+    assert spans["inner"]["end_ns"] >= spans["inner"]["start_ns"]
+
+
+def test_span_records_error_status(traced):
+    with pytest.raises(RuntimeError):
+        with tracing.span("boom"):
+            raise RuntimeError("bad")
+    (s,) = traced.spans()
+    assert "RuntimeError: bad" in s["error"]
+    # ...and the stack was popped despite the exception.
+    assert tracing.current() is None
+
+
+def test_explicit_parent_overrides_thread_local(traced):
+    remote = tracing.SpanContext("ab" * 16, "cd" * 8)
+    with tracing.span("joined", parent=remote) as sp:
+        assert sp.trace_id == remote.trace_id
+        assert sp.parent_span_id == remote.span_id
+
+
+def test_thread_local_isolation(traced):
+    seen = {}
+
+    def other():
+        seen["ctx"] = tracing.current()
+
+    with tracing.span("main-thread"):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert seen["ctx"] is None
+
+
+# -- carrier ------------------------------------------------------------------
+
+def test_carrier_roundtrip(traced):
+    with tracing.span("root") as sp:
+        ann = {}
+        tracing.inject(ann)
+        raw = ann[constants.TRACE_ANNOTATION]
+        assert raw == f"00-{sp.trace_id}-{sp.span_id}-01"
+    pod = {"metadata": {"annotations": {constants.TRACE_ANNOTATION: raw}}}
+    ctx = tracing.extract(pod)
+    assert ctx == sp.context
+
+
+@pytest.mark.parametrize("bad", [
+    "", "garbage", "00-short-short-01", "00-" + "z" * 32 + "-" + "a" * 16 + "-01",
+])
+def test_carrier_malformed_is_ignored(bad):
+    pod = {"metadata": {"annotations": {constants.TRACE_ANNOTATION: bad}}}
+    assert tracing.extract(pod) is None
+    assert tracing.extract(None) is None
+    assert tracing.extract({}) is None
+
+
+def test_recent_memo_ttl_bounds_a_trace_to_one_cycle(traced):
+    """A Pending pod retried by the scheduler every ~10-30 s must open
+    a fresh root per cycle — the filter→prioritize memo expires after
+    its TTL instead of chaining cycles into one mega-trace."""
+    import time as _time
+
+    memo = tracing._RecentTraces(ttl_s=0.05)
+    ctx = tracing.SpanContext("ab" * 16, "cd" * 8)
+    memo.remember("ns/pod", ctx)
+    assert memo.recall("ns/pod") == ctx
+    _time.sleep(0.06)
+    assert memo.recall("ns/pod") is None
+
+
+def test_stamp_trace_survives_null_annotations(traced):
+    """An explicit 'annotations': null on a member must not abort the
+    release (the stamp is documented best-effort)."""
+    from k8s_device_plugin_tpu.extender.gang import GangAdmission
+
+    class _NoPatchClient:
+        def patch_pod_annotations(self, ns, name, ann):
+            raise OSError("apiserver down")
+
+    adm = GangAdmission.__new__(GangAdmission)
+    adm.client = _NoPatchClient()
+    pod = {"metadata": {"namespace": "d", "name": "p", "annotations": None}}
+    ctx = tracing.SpanContext("ab" * 16, "cd" * 8)
+    adm._stamp_trace([pod], ctx)  # must not raise
+    assert (
+        pod["metadata"]["annotations"][constants.TRACE_ANNOTATION]
+        == tracing.format_traceparent(ctx)
+    )
+
+
+# -- collector ----------------------------------------------------------------
+
+def test_collector_ring_bounds_and_drop_count(traced):
+    small = tracing.SpanCollector(max_spans=5)
+    for i in range(12):
+        small.add({"trace_id": "t", "span_id": str(i),
+                   "parent_span_id": "", "name": f"s{i}", "service": "x",
+                   "start_ns": i, "end_ns": i, "attrs": {}, "error": ""})
+    assert len(small) == 5
+    assert small.dropped == 7
+    assert small.otlp_json()["dropped_spans"] == 7
+
+
+def test_otlp_json_shape(traced):
+    with tracing.span("parent", service="extender"):
+        with tracing.span("child", service="extender"):
+            pass
+    doc = tracing.COLLECTOR.otlp_json()
+    (rs,) = doc["resourceSpans"]
+    attrs = rs["resource"]["attributes"]
+    assert attrs[0]["key"] == "service.name"
+    assert attrs[0]["value"]["stringValue"] == "extender"
+    spans = rs["scopeSpans"][0]["spans"]
+    names = {s["name"] for s in spans}
+    assert names == {"parent", "child"}
+    for s in spans:
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+    # JSON-serializable end to end (the /debug/traces body).
+    json.dumps(doc)
+
+
+def test_adopt_reparents_span_and_descendants(traced):
+    # A provisional trace (plugin.Allocate before the pod is knowable)
+    # with a child under it...
+    with tracing.span("plugin.Allocate", service="plugin") as alloc:
+        provisional = alloc.trace_id
+        with tracing.span("kube.GET"):
+            pass
+    # ...adopted into the carried trace.
+    carrier = tracing.SpanContext("12" * 16, "34" * 8)
+    assert tracing.adopt(alloc.span_id, carrier)
+    spans = {s["name"]: s for s in tracing.COLLECTOR.spans()}
+    assert spans["plugin.Allocate"]["trace_id"] == carrier.trace_id
+    assert spans["plugin.Allocate"]["parent_span_id"] == carrier.span_id
+    assert spans["plugin.Allocate"]["attrs"]["adopted_from"] == provisional
+    assert spans["kube.GET"]["trace_id"] == carrier.trace_id
+    # Unknown span id: the ring already dropped it.
+    assert not tracing.adopt("f" * 16, carrier)
+
+
+# -- resilience hook ----------------------------------------------------------
+
+def test_kube_call_becomes_child_span(traced):
+    r = Resilience()
+    with tracing.span("gang.admit", service="extender") as root:
+        r.call(lambda: "ok", verb="PATCH")
+    spans = {s["name"]: s for s in traced.spans()}
+    assert spans["kube.PATCH"]["trace_id"] == root.trace_id
+    assert spans["kube.PATCH"]["parent_span_id"] == root.span_id
+    assert spans["kube.PATCH"]["attrs"]["outcome"] == "ok"
+
+
+def test_kube_call_outside_trace_mints_no_span(traced):
+    r = Resilience()
+    r.call(lambda: "ok", verb="LIST")
+    assert traced.spans() == []  # background relists stay span-free
+
+
+def test_kube_call_failure_recorded_on_span(traced):
+    r = Resilience(sleep=lambda s: None)
+
+    def die():
+        raise OSError("conn refused")
+
+    with tracing.span("root"):
+        with pytest.raises(UnavailableError):
+            r.call(die, verb="GET", max_attempts=2)
+    kube = [s for s in traced.spans() if s["name"] == "kube.GET"]
+    assert kube and kube[0]["error"]
+
+
+# -- exemplars ----------------------------------------------------------------
+
+def test_histogram_exemplar_captured_and_rendered(traced):
+    h = metrics.Histogram("ex_test_seconds", "t", buckets=(0.1, 1.0))
+    with tracing.span("extender.filter") as sp:
+        h.observe(0.05, verb="filter")
+    ex = h.exemplar(0, verb="filter")
+    assert ex is not None and ex[0] == sp.trace_id and ex[1] == sp.span_id
+    classic = h.render()
+    assert "trace_id" not in classic
+    om = h.render(openmetrics=True)
+    assert f'# {{trace_id="{sp.trace_id}",span_id="{sp.span_id}"}}' in om
+    # The exemplar rides the bucket line, classic lines are unchanged.
+    assert 'ex_test_seconds_bucket{verb="filter",le="0.1"} 1 #' in om
+
+
+def test_histogram_no_exemplar_outside_span(traced):
+    h = metrics.Histogram("ex_none_seconds", "t", buckets=(1.0,))
+    h.observe(0.5)
+    assert h.exemplar(0) is None
+    assert "# {" not in h.render(openmetrics=True)
+
+
+def test_registry_openmetrics_render_ends_with_eof():
+    reg = metrics.Registry()
+    reg.counter("om_total", "t").inc()
+    out = reg.render(openmetrics=True)
+    assert out.endswith("# EOF\n")
+    assert not reg.render().endswith("# EOF\n")
+
+
+def test_openmetrics_counter_family_drops_total_suffix():
+    """OpenMetrics declares a counter family WITHOUT _total (samples
+    keep it); '# TYPE x_total counter' is rejected by spec-compliant
+    parsers. Classic Prometheus text keeps the legacy shape."""
+    reg = metrics.Registry()
+    reg.counter("omc_things_total", "t").inc()
+    om = reg.render(openmetrics=True)
+    assert "# TYPE omc_things counter" in om
+    assert "# TYPE omc_things_total" not in om
+    assert "omc_things_total 1" in om  # sample keeps the suffix
+    classic = reg.render()
+    assert "# TYPE omc_things_total counter" in classic
+
+
+# -- profiling.timed registry fix (satellite) ---------------------------------
+
+def test_timed_requires_explicit_histogram():
+    with pytest.raises(TypeError):
+        with profiling.timed(None, method="X"):
+            pass
+    # Positional histogram still works (the only supported shape now).
+    h = metrics.Histogram("timed_req_seconds", "t", buckets=(10.0,))
+    with profiling.timed(h, method="X"):
+        pass
+    assert h.count(method="X") == 1
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def test_flight_recorder_disabled_is_noop():
+    rec = FlightRecorder(capacity=4)
+    rec.record("allocate", "nope")
+    assert len(rec) == 0
+
+
+def test_flight_recorder_overflow_keeps_newest():
+    rec = FlightRecorder(capacity=3)
+    rec.enabled = True  # bare enable: no metrics binding needed
+    for i in range(10):
+        rec.record("allocate", f"ev{i}", i=i)
+    snap = rec.snapshot()
+    assert len(snap["events"]) == 3
+    assert snap["dropped"] == 7
+    assert [e["message"] for e in snap["events"]] == ["ev7", "ev8", "ev9"]
+
+
+def test_flight_recorder_stamps_trace_context(traced):
+    rec = FlightRecorder()
+    rec.enabled = True
+    with tracing.span("gang.admit") as sp:
+        rec.record("gang_released", "in-span")
+    rec.record("gang_released", "out-of-span")
+    evs = rec.snapshot()["events"]
+    assert evs[0]["trace_id"] == sp.trace_id
+    assert "trace_id" not in evs[1]
+
+
+def test_flight_recorder_dump_on_fault(tmp_path):
+    rec = FlightRecorder(capacity=16)
+    rec.enable(service="plugin", dump_dir=str(tmp_path))
+    try:
+        rec.record("health_transition", "chip died", chip="c0")
+        path = rec.dump_on("sigterm")
+        assert path is not None
+        doc = json.load(open(path))
+        assert doc["reason"] == "sigterm"
+        assert doc["service"] == "plugin"
+        assert doc["events"][0]["kind"] == "health_transition"
+    finally:
+        rec.disable()
+
+
+def test_circuit_break_dumps_flight_recorder(tmp_path):
+    """The resilience layer's breaker OPEN transition records an event
+    and dumps the ring — post-mortem capture at the moment the
+    apiserver becomes unreachable."""
+    saved = (RECORDER.enabled, RECORDER.service, RECORDER.dump_dir)
+    RECORDER.clear()
+    RECORDER.enable(service="plugin", dump_dir=str(tmp_path))
+    try:
+        r = Resilience(
+            breaker=CircuitBreaker(failure_threshold=2),
+            sleep=lambda s: None,
+        )
+
+        def die():
+            raise OSError("down")
+
+        with pytest.raises(UnavailableError):
+            r.call(die, verb="GET", max_attempts=3)
+        kinds = [e["kind"] for e in RECORDER.snapshot()["events"]]
+        assert "circuit_state" in kinds
+        # The dump runs on its own thread (it must not hold the breaker
+        # lock over disk I/O); poll briefly.
+        import time as _time
+
+        deadline = _time.time() + 5
+        dumps = []
+        while _time.time() < deadline and not dumps:
+            dumps = list(tmp_path.glob("flight-plugin-*circuit-break.json"))
+            _time.sleep(0.05)
+        assert dumps, "no circuit-break dump written"
+    finally:
+        RECORDER.disable()
+        RECORDER.clear()
+        if saved[0]:
+            RECORDER.enable(service=saved[1], dump_dir=saved[2])
+
+
+# -- correlated logging (satellite) -------------------------------------------
+
+def test_json_log_lines_carry_trace_ids(traced, capsys):
+    import io
+
+    stream = io.StringIO()
+    handler = std_logging.StreamHandler(stream)
+    handler.addFilter(tpulog.TraceContextFilter())
+    handler.setFormatter(tpulog.JsonFormatter(service="test"))
+    logger = std_logging.getLogger("tracing-json-test")
+    logger.addHandler(handler)
+    logger.setLevel(std_logging.INFO)
+    try:
+        with tracing.span("gang.admit") as sp:
+            logger.info("inside span %d", 1)
+        logger.info("outside span")
+    finally:
+        logger.removeHandler(handler)
+    lines = [json.loads(l) for l in stream.getvalue().splitlines()]
+    assert lines[0]["message"] == "inside span 1"
+    assert lines[0]["trace_id"] == sp.trace_id
+    assert lines[0]["span_id"] == sp.span_id
+    assert lines[0]["service"] == "test"
+    assert "trace_id" not in lines[1]
+
+
+def test_setup_is_idempotent():
+    root = std_logging.getLogger()
+    before = list(root.handlers)
+    try:
+        tpulog.setup(service="test", json_lines=True)
+        tpulog.setup(service="test", json_lines=False)
+        ours = [
+            h for h in root.handlers
+            if getattr(h, "_tpu_logging_bootstrap", False)
+        ]
+        assert len(ours) == 1
+    finally:
+        for h in list(root.handlers):
+            if getattr(h, "_tpu_logging_bootstrap", False):
+                root.removeHandler(h)
+        root.handlers[:] = before
+
+
+def test_resolve_level():
+    assert tpulog.resolve_level(verbose=1) == std_logging.DEBUG
+    assert tpulog.resolve_level(level="warning") == std_logging.WARNING
+    assert tpulog.resolve_level() == std_logging.INFO
+
+
+# -- /debug endpoints ---------------------------------------------------------
+
+def test_debug_endpoints_on_metrics_server(traced):
+    with tracing.span("plugin.Allocate", service="plugin"):
+        pass
+    srv = metrics.MetricsServer(host="127.0.0.1")
+    url = srv.start()
+    try:
+        doc = requests.get(f"{url}/debug/traces", timeout=5).json()
+        names = [
+            s["name"]
+            for rs in doc["resourceSpans"]
+            for ss in rs["scopeSpans"]
+            for s in ss["spans"]
+        ]
+        assert "plugin.Allocate" in names
+        ev = requests.get(f"{url}/debug/events", timeout=5).json()
+        assert "events" in ev
+        assert requests.get(
+            f"{url}/debug/nope", timeout=5
+        ).status_code == 404
+        # OpenMetrics negotiation on the scrape path.
+        om = requests.get(
+            f"{url}/metrics", timeout=5,
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        assert "openmetrics-text" in om.headers["Content-Type"]
+        assert om.text.endswith("# EOF\n")
+        classic = requests.get(f"{url}/metrics", timeout=5)
+        assert "version=0.0.4" in classic.headers["Content-Type"]
+    finally:
+        srv.stop()
+
+
+def test_debug_endpoints_on_extender_server(traced):
+    from k8s_device_plugin_tpu.extender.server import ExtenderHTTPServer
+
+    with tracing.span("extender.filter", service="extender"):
+        pass
+    srv = ExtenderHTTPServer(host="127.0.0.1")
+    url = srv.start()
+    try:
+        doc = requests.get(f"{url}/debug/traces", timeout=5).json()
+        assert doc["resourceSpans"]
+        # trace_id filter narrows the export.
+        tid = doc["resourceSpans"][0]["scopeSpans"][0]["spans"][0]["traceId"]
+        narrowed = requests.get(
+            f"{url}/debug/traces?trace_id={tid}", timeout=5
+        ).json()
+        assert narrowed["resourceSpans"]
+        none = requests.get(
+            f"{url}/debug/traces?trace_id={'0' * 32}", timeout=5
+        ).json()
+        assert none["resourceSpans"] == []
+        assert requests.get(
+            f"{url}/debug/events", timeout=5
+        ).status_code == 200
+        om = requests.get(
+            f"{url}/metrics", timeout=5,
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        assert "openmetrics-text" in om.headers["Content-Type"]
+    finally:
+        srv.stop()
+
+
+# -- trace CLI (satellite) ----------------------------------------------------
+
+def test_trace_cli_renders_tree_and_events(capsys, traced, tmp_path):
+    from k8s_device_plugin_tpu.tools import trace as trace_cli
+
+    with tracing.span("gang.admit", service="extender") as root:
+        with tracing.span("kube.PATCH"):
+            pass
+    path = tracing.COLLECTOR.export_file(str(tmp_path / "t.json"))
+    assert trace_cli.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "gang.admit" in out and "kube.PATCH" in out
+    assert root.trace_id in out
+    # Flight dump rendering.
+    rec = FlightRecorder()
+    rec.enabled = True
+    rec.service = "plugin"
+    rec.record("allocate", "chips out", chips="c0,c1")
+    dump = tmp_path / "events.json"
+    dump.write_text(json.dumps(rec.snapshot()))
+    assert trace_cli.main([str(dump)]) == 0
+    out = capsys.readouterr().out
+    assert "allocate" in out and "chips out" in out
+
+
+def test_trace_cli_self_test(capsys):
+    from k8s_device_plugin_tpu.tools import trace as trace_cli
+
+    assert trace_cli.main(["--self-test"]) == 0
+    out = capsys.readouterr().out
+    assert "extender.filter" in out and "plugin.Allocate" in out
+
+
+def test_trace_cli_rejects_garbage(capsys, tmp_path):
+    from k8s_device_plugin_tpu.tools import trace as trace_cli
+
+    p = tmp_path / "x.json"
+    p.write_text('{"neither": true}')
+    assert trace_cli.main([str(p)]) == 1
+
+
+# -- e2e propagation (satellite) ----------------------------------------------
+
+NODE = "tpu-node-1"
+
+
+def test_e2e_allocation_trace_spans_three_daemons(traced, tmp_path):
+    """The acceptance e2e: ONE trace whose spans cover gang admission,
+    extender /filter + /prioritize, and the plugin's Allocate — opened
+    by the gang admitter, carried by the pod annotation through the
+    fake apiserver, joined by the extender, and adopted by the
+    controller after the kubelet-side Allocate (fake kubelet +
+    podresources)."""
+    from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
+    from k8s_device_plugin_tpu.controller.controller import Controller
+    from k8s_device_plugin_tpu.extender.gang import GangAdmission
+    from k8s_device_plugin_tpu.extender.scale_bench import _gang_pod, _node
+    from k8s_device_plugin_tpu.extender.server import TopologyExtender
+    from k8s_device_plugin_tpu.extender.reservations import ReservationTable
+    from k8s_device_plugin_tpu.kube.client import KubeClient
+    from k8s_device_plugin_tpu.server.plugin import (
+        PluginConfig,
+        TpuDevicePlugin,
+    )
+    from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+    from k8s_device_plugin_tpu.topology.mesh import IciMesh
+    from tests import fakes
+    from tests.fake_apiserver import FakeApiServer
+    from tests.fake_kubelet import FakeKubelet, FakePodResources
+
+    api = FakeApiServer()
+    url = api.start()
+    client = KubeClient(url)
+    # A 4-chip node publishing real topology, and a complete 2-pod gang.
+    api.add_node(NODE, _node(NODE))
+    pods = []
+    for i in range(2):
+        pod = _gang_pod(f"trace-w{i}", "trace-gang", 2, 2)
+        pod["metadata"]["uid"] = f"uid-trace-{i}"
+        api.add_pod(pod)
+        pods.append(pod)
+    table = ReservationTable()
+    kubelet_dir = tmp_path / "dp"
+    kubelet_dir.mkdir()
+    kubelet = FakeKubelet(str(kubelet_dir))
+    kubelet.start()
+    podres = FakePodResources(str(tmp_path / "podres" / "kubelet.sock"))
+    podres.start()
+    plugin = None
+    try:
+        # 1) Gang admission opens the trace and stamps the carrier
+        #    before removing the gates. The flight recorder rides along
+        #    to prove the release event cross-references the trace.
+        RECORDER.clear()
+        RECORDER.enabled = True
+        adm = GangAdmission(client, reservations=table)
+        try:
+            released = adm.tick()
+        finally:
+            RECORDER.enabled = False
+        assert released == [("default", "trace-gang")]
+        live = client.get_pod("default", "trace-w0")
+        carrier = tracing.extract(live)
+        assert carrier is not None, "carrier annotation not stamped"
+        trace_id = carrier.trace_id
+        release_events = [
+            e for e in RECORDER.snapshot()["events"]
+            if e["kind"] == "gang_released"
+        ]
+        assert release_events and release_events[0]["trace_id"] == trace_id
+        RECORDER.clear()
+
+        # 2) The scheduler hands the annotated pod to the extender.
+        ext = TopologyExtender(reservations=table)
+        node_obj = api.nodes[NODE]
+        passing, failed = ext.filter(live, [node_obj])
+        assert passing and not failed
+        scores = ext.prioritize(live, [node_obj])
+        assert scores
+
+        # 3) Bind + kubelet Allocate on the real gRPC surface.
+        accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 4)
+        chips = PyTpuInfo().scan(accel, dev)
+        plugin = TpuDevicePlugin(
+            IciMesh(chips),
+            config=PluginConfig(
+                libtpu_host_path="",
+                device_plugin_dir=str(kubelet_dir),
+            ),
+        )
+        plugin.serve()
+        assert kubelet.registered.wait(10)
+        stub = kubelet.plugin_stub()
+        ids = plugin.mesh.ids[:2]
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=ids)
+        stub.Allocate(req)
+        assert plugin.recent_allocations
+
+        # 4) The pod binds; the controller reconciles it (podresources
+        #    lookup) and adopts the Allocate span into the carried
+        #    trace.
+        live["spec"]["nodeName"] = NODE
+        api.update_pod(live)
+        podres.set_pod("default", "trace-w0", constants.RESOURCE_NAME, ids)
+        controller = Controller(
+            client,
+            plugin,
+            node_name=NODE,
+            checkpoint_path=str(tmp_path / "no-checkpoint"),
+            podresources_socket=podres.socket_path,
+        )
+        controller._handle_update(client.get_pod("default", "trace-w0"))
+
+        # ONE trace, spans from all three daemons.
+        spans = traced.trace(trace_id)
+        names = {s["name"] for s in spans}
+        assert {"gang.admit", "extender.filter", "extender.prioritize",
+                "plugin.Allocate", "controller.reconcile"} <= names, names
+        services = {s["service"] for s in spans}
+        assert {"extender", "plugin", "controller"} <= services
+        # Kube round-trips rode along as child spans (gate removal /
+        # carrier stamp under gang.admit, annotation patch under
+        # reconcile).
+        assert any(s["name"].startswith("kube.") for s in spans)
+        # The adopted Allocate span remembers its provisional trace.
+        alloc = next(s for s in spans if s["name"] == "plugin.Allocate")
+        assert alloc["attrs"].get("adopted_from")
+        # The reconciled pod got its devices annotation as usual —
+        # tracing is an overlay, not a behavior change.
+        patched = client.get_pod("default", "trace-w0")
+        assert (
+            patched["metadata"]["annotations"][
+                constants.POD_DEVICES_ANNOTATION
+            ]
+            == ",".join(sorted(ids))
+        )
+        # OTLP export of exactly this trace is loadable by the CLI.
+        from k8s_device_plugin_tpu.tools import trace as trace_cli
+
+        out = trace_cli.render(traced.otlp_json(trace_id=trace_id))
+        assert any("gang.admit" in line for line in out)
+    finally:
+        if plugin is not None:
+            plugin.stop()
+        podres.stop()
+        kubelet.stop()
+        api.stop()
