@@ -766,6 +766,17 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             result["detail"]["tracing_overhead"] = {"error": repr(e)[:400]}
         emit()
+        # Phase 1.7: decision-ledger overhead probe (ISSUE 4 — the
+        # ledger-disabled indexed /filter p99 must stay within 1.1x of
+        # the tracing_overhead disabled baseline above; same fixtures,
+        # same measurement, directly comparable numbers).
+        try:
+            result["detail"]["ledger_overhead"] = (
+                scale_bench.ledger_overhead(n_nodes=1000)
+            )
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["ledger_overhead"] = {"error": repr(e)[:400]}
+        emit()
 
         # Phase 2a: harvest the t=0 probe loop (VERDICT r3 #1a /
         # r5 #1) — the long smoke runs only into a granted chip, and a

@@ -53,6 +53,13 @@ POD_DEVICES_ANNOTATION = "google.com/tpu-devices"
 # (docs/observability.md).
 TRACE_ANNOTATION = "tpu.google.com/trace-context"
 
+# Pod annotation carrying the gang admitter's release timestamp (epoch
+# seconds): stamped alongside the trace carrier before the gates come
+# off, read by the controller at reconcile to observe the
+# tpu_pod_time_to_allocate_seconds SLO histogram (admission-stamp to
+# reconcile — docs/observability.md).
+ADMIT_TS_ANNOTATION = "tpu.google.com/admitted-at"
+
 # Env var understood the same way as the reference's DP_DISABLE_HEALTHCHECKS
 # (/root/reference/server.go:32-33,231-242): a comma-separated list of
 # check classes to disable. Classes: "all", "events" (inotify fast path;

@@ -33,6 +33,7 @@ from ..kube import checkpoint as ckpt
 from ..kube.client import KubeClient, KubeError
 from ..kube.podresources import PodResourcesClient
 from ..utils import metrics, tracing
+from ..utils.decisions import LEDGER
 from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
 from ..utils.podresources import is_tpu_pod
@@ -488,6 +489,9 @@ class Controller:
         except ValueError:
             pass  # another reconcile raced us to it
         tracing.adopt(target["span_id"], ctx)
+        # The ledger's half of the same retroactive join: Allocate's
+        # decision records were stamped under the provisional trace.
+        LEDGER.retrace(target["trace_id"], ctx.trace_id)
 
     def _handle_update_impl(self, pod: dict) -> None:
         meta = pod.get("metadata", {})
@@ -569,6 +573,44 @@ class Controller:
                 ("pod-ann", ns, name),
                 lambda: self._deliver_queued_annotation(ns, name, uid, value),
                 describe=f"devices annotation for pod {ns}/{name}",
+            )
+        # Allocation SLO: admission-stamp (gang release) → this
+        # reconcile. Observed inside the reconcile span (exemplar), and
+        # only on the pod's FIRST completed pass: it sits AFTER the
+        # patch so a raising patch (409/5xx → workqueue retry) can't
+        # observe, and the first_reconcile guard covers the queued-
+        # UnavailableError path, whose next resync re-runs this whole
+        # block with uid already tracked. Double samples would inflate
+        # the histogram exactly during apiserver incidents. The
+        # nsname key covers apiserver-less rebuilds (rebuild_state
+        # tracks by namespace/name until this pass migrates it): a
+        # pod reconciled before a daemon restart must not re-observe
+        # its stale admitted-at stamp as a multi-hour sample.
+        first_reconcile = (
+            uid not in self._pod_devices
+            and nsname not in self._pod_devices
+        )
+        admit_raw = annotations.get(constants.ADMIT_TS_ANNOTATION)
+        elapsed = None
+        if admit_raw and first_reconcile:
+            try:
+                elapsed = max(0.0, time.time() - float(admit_raw))
+            except ValueError:
+                pass  # a mangled stamp costs the sample, nothing else
+            else:
+                metrics.POD_TIME_TO_ALLOCATE.observe(elapsed)
+        if LEDGER.enabled and first_reconcile:
+            extra = (
+                {"time_to_allocate_s": round(elapsed, 3)}
+                if elapsed is not None
+                else {}
+            )
+            LEDGER.record(
+                "reconcile", "reconciled",
+                f"pod {ns}/{name} reconciled to chips {value}",
+                pod=f"{ns}/{name}",
+                chips=value,
+                **extra,
             )
         for kid in consumed:
             self.plugin.shadow_map.pop(kid, None)
@@ -749,6 +791,14 @@ class Controller:
                     pod=f"{ns}/{name}",
                     chips=",".join(sorted(pod_chips)),
                 )
+                LEDGER.record(
+                    "evict", "chip_unhealthy",
+                    f"pod {ns}/{name} evicted: TPU chip(s) "
+                    f"{','.join(sorted(pod_chips))} unhealthy",
+                    pod=f"{ns}/{name}",
+                    node=self.node_name,
+                    chips=",".join(sorted(pod_chips)),
+                )
                 log.warning(
                     "evicted pod %s/%s: TPU chip(s) %s unhealthy",
                     ns, name, sorted(pod_chips),
@@ -772,6 +822,14 @@ class Controller:
                 # re-fires (the budget frees up as other pods move).
                 log.warning("eviction of %s/%s failed: %s", ns, name, e)
                 metrics.EVICTIONS.inc(outcome="failed")
+                LEDGER.record(
+                    "evict", "eviction_failed",
+                    f"eviction of {ns}/{name} failed (retried every "
+                    f"resync): {e}",
+                    pod=f"{ns}/{name}",
+                    node=self.node_name,
+                    chips=",".join(sorted(pod_chips)),
+                )
 
     def _kubelet_assigned_chips(self, exclude_uid: str = "") -> Set[str]:
         """Real chip ids the kubelet currently reports assigned, translated

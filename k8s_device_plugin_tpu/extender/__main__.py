@@ -86,6 +86,21 @@ def main() -> int:
         "/debug/traces. Off = exact no-op",
     )
     p.add_argument(
+        "--decisions", action="store_true",
+        help="enable the scheduling decision ledger (utils/"
+        "decisions.py; also TPU_DECISIONS=1): filter rejections, "
+        "prioritize breakdowns, and gang admission outcomes become "
+        "queryable records at /debug/decisions (tools/explain.py "
+        "answers 'why is my pod pending?' from them). Implied by "
+        "--trace; off = exact no-op",
+    )
+    p.add_argument(
+        "--gang-pending-event-s", type=float, default=300.0,
+        help="post a kube Event (kubectl describe pod) on gangs "
+        "capacity-waiting longer than this many seconds (budgeted + "
+        "deduped; 0 disables)",
+    )
+    p.add_argument(
         "--log-json", action="store_true",
         help="JSON-lines logging with trace correlation "
         "(also TPU_LOG_JSON=1)",
@@ -105,6 +120,10 @@ def main() -> int:
     if a.trace or tracing.env_enabled():
         tracing.enable(service="extender")
         RECORDER.enable(service="extender", dump_dir=a.flight_dir)
+    from ..utils import decisions
+
+    if decisions.should_enable(a.decisions, a.trace):
+        decisions.LEDGER.enable(service="extender")
     from .reservations import ReservationTable
     from .server import NodeAnnotationCache, TopologyExtender
 
@@ -222,6 +241,7 @@ def main() -> int:
             full_sweep_interval_s=a.gang_full_sweep_s,
             topo_source=topo_source,
             watch=not a.no_gang_watch,
+            pending_event_threshold_s=a.gang_pending_event_s,
         )
         if node_cache is not None:
             # … and its node-change events mark exactly the affected

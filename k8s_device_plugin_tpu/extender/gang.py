@@ -49,6 +49,7 @@ from ..kube.client import KubeClient, KubeError
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..topology.slice import SliceView
 from ..utils import metrics, tracing
+from ..utils.decisions import LEDGER
 from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
 from ..utils.podresources import tpu_request
@@ -188,6 +189,10 @@ class _CapacityPool:
                     tuple(t.slice_hosts), []
                 ).append(t.hostname)
         self._undo: Optional[List[Tuple[str, List[str]]]] = None
+        # Diagnosis of the demand that made the last fits() fail —
+        # the gang_waiting decision record's shortfall payload
+        # (utils/decisions.py). None after a successful fits().
+        self.last_reject: Optional[Dict] = None
 
     def slice_host_sizes(self) -> List[Tuple[Tuple[str, ...], int]]:
         """(slice key, chips per host) per known slice — dependency
@@ -266,6 +271,7 @@ class _CapacityPool:
         the old copy-on-write ``_fits``: conservative — a gang not
         placed here definitely cannot fit."""
         self._undo = []
+        self.last_reject = None
         consumed: Dict[str, int] = {}
         for n in sorted((d for d in demands if d > 0), reverse=True):
             host = self._place_single(n)
@@ -274,6 +280,10 @@ class _CapacityPool:
                 continue
             hosts = self._place_multi(n)
             if hosts is None:
+                # Diagnose against the CURRENT state (earlier
+                # placements of this same gang included — they ARE
+                # part of why this demand is blocked), then roll back.
+                self.last_reject = self._diagnose(n)
                 for h, old in reversed(self._undo):
                     self._move_bucket(h, len(self.avail[h]), len(old))
                     self.avail[h] = old
@@ -284,6 +294,52 @@ class _CapacityPool:
                 consumed[h] = consumed.get(h, 0) + per_host
         self._undo = None
         return consumed
+
+    def _diagnose(self, n: int) -> Dict:
+        """Why demand ``n`` could not place: the blocking shape
+        (single host / slice) and its shortfall, for the decision
+        ledger and the pending-gang kube Event."""
+        if n <= self.max_chip_count:
+            best_free = max(
+                (
+                    len(self.avail[h])
+                    for h in self.avail
+                    if self.chip_count[h] >= n
+                ),
+                default=0,
+            )
+            return {
+                "demand": n,
+                "blocking": "single_host",
+                "best_free_chips": best_free,
+                "shortfall_chips": n - best_free,
+            }
+        best: Optional[Tuple[Tuple[str, ...], int, int]] = None
+        for key, members in self.slices.items():
+            per_host = self.chip_count[members[0]]
+            if per_host <= 0 or n % per_host != 0:
+                continue
+            free = sum(
+                1
+                for h in members
+                if len(self.avail[h]) >= self.chip_count[h]
+            )
+            if best is None or free > best[1]:
+                best = (key, free, n // per_host)
+        if best is None:
+            return {"demand": n, "blocking": "no_matching_slice"}
+        key, free, k = best
+        label = ",".join(key[:4]) + (
+            f",+{len(key) - 4}" if len(key) > 4 else ""
+        )
+        return {
+            "demand": n,
+            "blocking": "slice",
+            "slice": label,
+            "needed_hosts": k,
+            "free_hosts": free,
+            "shortfall_hosts": k - free,
+        }
 
 
 class GangAdmission:
@@ -298,6 +354,9 @@ class GangAdmission:
         full_sweep_interval_s: float = 60.0,
         topo_source: Optional[Callable[[], List[NodeTopology]]] = None,
         watch: bool = False,
+        pending_event_threshold_s: float = 300.0,
+        pending_event_repost_s: float = 600.0,
+        pending_event_budget: int = 10,
     ):
         self.client = client
         self.resource_name = resource_name
@@ -344,9 +403,38 @@ class GangAdmission:
         # Pends (the reservation still fences it at /filter); it can
         # never double-admit.
         self._last_topos: List[NodeTopology] = []
-        # (gang key, demands) already reported as not-fitting — a gang
-        # waiting for capacity logs once per state, not once per resync.
-        self._reported_waiting: set = set()
+        # Ledger-backed waiting markers: gang key → demands fingerprint
+        # last reported as capacity-waiting. A decision record (+ flight
+        # event + log line) is emitted on every waiting-state CHANGE —
+        # a fresh wait, or demands edited in place under the same gang
+        # name — and the entry is pruned when the gang admits, stops
+        # being capacity-waiting, or vanishes, so the map is bounded by
+        # live waiting gangs (the old once-per-state set leaked one
+        # stale entry per in-place demand edit).
+        self._waiting_reported: Dict[Tuple[str, str], tuple] = {}
+        # First capacity evaluation of each complete, fully-gated gang
+        # (monotonic) — the tpu_gang_time_to_admit_seconds origin.
+        self._first_complete: Dict[Tuple[str, str], float] = {}
+        # Wall-clock start of each gang's current capacity wait, and
+        # when its last pending-gang kube Event was posted (the
+        # dedup/repost state for _maybe_post_pending_event).
+        self._waiting_since: Dict[Tuple[str, str], float] = {}
+        self._pending_evented: Dict[Tuple[str, str], float] = {}
+        # Gangs whose current waiting episode already produced the
+        # slo_breach ledger/flight records: the Event post retries on
+        # failure every tick, but the breach records must not — a
+        # flaking apiserver would otherwise flood both rings at the
+        # resync rate, evicting the incident context they describe.
+        self._breach_recorded: Set[Tuple[str, str]] = set()
+        # kubectl-describe surfacing for long waits: past this many
+        # seconds capacity-waiting, a Warning Event is posted on each
+        # gated member (through the client's resilience layer),
+        # re-posted every repost interval while the wait lasts, capped
+        # per tick by the budget. 0 disables.
+        self.pending_event_threshold_s = pending_event_threshold_s
+        self.pending_event_repost_s = pending_event_repost_s
+        self.pending_event_budget = pending_event_budget
+        self._event_budget_left = pending_event_budget
         self._lapsed_reported = 0  # table lapses already inc'd to metrics
         # Gangs whose hold hit the age cap: never re-fenced (a re-fence
         # would reset the hold's age and turn the cap into no cap).
@@ -503,6 +591,133 @@ class GangAdmission:
                     members.discard(key)
                     if not members:
                         del self._dep_gangs[dep]
+
+    def _clear_wait_state(self, key: Tuple[str, str]) -> None:
+        """Drop ALL per-gang waiting/SLO markers (report fingerprint,
+        wait origin, event + breach dedup, time-to-admit origin) — NOT
+        the dependency index, which is _clear_waiting's job. One
+        helper on purpose: an exit path that forgot one of these would
+        leak a stale SLO origin into a same-named successor gang."""
+        self._waiting_reported.pop(key, None)
+        self._waiting_since.pop(key, None)
+        self._pending_evented.pop(key, None)
+        self._breach_recorded.discard(key)
+        self._first_complete.pop(key, None)
+
+    @staticmethod
+    def _shortfall_text(diag: Dict) -> str:
+        """Operator-readable sentence for a _CapacityPool diagnosis —
+        shared by the log line, the gang_waiting decision record, and
+        the pending-gang kube Event so the three never disagree."""
+        if not diag:
+            return "capacity shortfall unknown"
+        if diag.get("blocking") == "single_host":
+            return (
+                f"blocking demand {diag['demand']}: best host has "
+                f"{diag['best_free_chips']} free chip(s), short "
+                f"{diag['shortfall_chips']}"
+            )
+        if diag.get("blocking") == "slice":
+            return (
+                f"blocking demand {diag['demand']}: slice "
+                f"{diag['slice']} has {diag['free_hosts']} whole-free "
+                f"host(s) of {diag['needed_hosts']} needed, short "
+                f"{diag['shortfall_hosts']}"
+            )
+        return (
+            f"blocking demand {diag.get('demand')}: no multi-host "
+            "slice whose host size divides it"
+        )
+
+    def _maybe_post_pending_event(
+        self,
+        key: Tuple[str, str],
+        gv: "GangView",
+        demands: List[int],
+        diag: Dict,
+    ) -> None:
+        """Surface a long capacity wait in ``kubectl describe pod``: a
+        Warning Event on each gated member once the gang has waited
+        past ``pending_event_threshold_s``, posted through the client's
+        resilience layer, deduped per gang (one post per waiting
+        episode, re-posted every ``pending_event_repost_s`` while the
+        wait lasts) and budgeted per tick so a mass-starvation tick
+        can't storm the apiserver with Events."""
+        if self.pending_event_threshold_s <= 0:
+            return
+        now = time.time()
+        since = self._waiting_since.get(key)
+        if since is None or now - since < self.pending_event_threshold_s:
+            return
+        if now - self._pending_evented.get(key, 0.0) < (
+            self.pending_event_repost_s
+        ):
+            return
+        create = getattr(self.client, "create_event", None)
+        if create is None:
+            return
+        if self._event_budget_left <= 0:
+            metrics.GANG_PENDING_EVENTS.inc(outcome="suppressed")
+            return
+        waited = int(now - since)
+        message = (
+            f"gang {key[0]}/{key[1]} waiting for TPU capacity for "
+            f"{waited}s: demand {demands}; {self._shortfall_text(diag)}"
+        )
+        if key not in self._breach_recorded:
+            # Once per waiting episode, independent of Event-post
+            # success: the post retries next tick on failure, but
+            # re-emitting the breach records each retry would flood
+            # the ledger and the flight ring at the resync rate during
+            # exactly the apiserver incident they describe.
+            self._breach_recorded.add(key)
+            RECORDER.record(
+                "slo_breach",
+                f"gang {key[0]}/{key[1]} capacity-waiting past "
+                f"{self.pending_event_threshold_s:.0f}s",
+                namespace=key[0],
+                gang=key[1],
+                waited_s=waited,
+            )
+            LEDGER.record(
+                "slo_breach", "gang_pending", message,
+                gang=f"{key[0]}/{key[1]}", waited_s=waited,
+            )
+        posted = 0
+        for pod in gv.gated:
+            if self._event_budget_left <= 0:
+                metrics.GANG_PENDING_EVENTS.inc(outcome="suppressed")
+                break
+            self._event_budget_left -= 1
+            meta = pod.get("metadata") or {}
+            try:
+                create(
+                    key[0],
+                    {
+                        "kind": "Pod",
+                        "name": meta.get("name", ""),
+                        "namespace": key[0],
+                        "uid": meta.get("uid", ""),
+                    },
+                    reason="TPUGangPending",
+                    message=message,
+                    event_type="Warning",
+                    component="tpu-gang-admission",
+                )
+                metrics.GANG_PENDING_EVENTS.inc(outcome="posted")
+                posted += 1
+            except (KubeError, OSError) as e:
+                metrics.GANG_PENDING_EVENTS.inc(outcome="error")
+                log.warning(
+                    "pending-gang event for %s/%s failed: %s",
+                    key[0], meta.get("name", ""), e,
+                )
+        if posted:
+            # Stamp the dedup clock only once at least one Event
+            # actually landed: a wholesale post failure (apiserver
+            # flaking — exactly when gangs wait) retries next tick,
+            # not after the whole repost interval.
+            self._pending_evented[key] = now
 
     def _watch_loop(self) -> None:
         """Pod-event plane: stream gang-labeled pod events into dirty
@@ -684,25 +899,28 @@ class GangAdmission:
                 metrics.GANG_WAITING.set(len(self._waiting_gangs))
                 return []
             gangs = self._collect_gangs(requested)
+        self._event_budget_left = self.pending_event_budget
         self._reservation_upkeep(gangs)
-        # Prune the logged-waiting markers of gangs that vanished or
-        # changed shape — the set must not grow without bound. A dirty
-        # tick only saw ``requested``, so it may only prune those.
+        # Prune the waiting markers of gangs that vanished — the maps
+        # must not grow without bound. A dirty tick only saw
+        # ``requested``, so it may only prune those; in-place demand
+        # edits are handled by the fingerprint comparison at report
+        # time (the value is replaced, never accumulated).
         if full:
-            self._reported_waiting = {
-                w for w in self._reported_waiting if w[0] in gangs
-            }
+            for key in list(self._waiting_reported):
+                if key not in gangs:
+                    self._clear_wait_state(key)
+            for key in list(self._first_complete):
+                if key not in gangs:
+                    self._first_complete.pop(key, None)
             with self._dirty_lock:
                 stale = self._waiting_gangs - set(gangs)
             for key in stale:
                 self._clear_waiting(key)
         else:
             vanished = requested - set(gangs)
-            self._reported_waiting = {
-                w for w in self._reported_waiting
-                if w[0] in gangs or w[0] not in vanished
-            }
             for key in vanished:
+                self._clear_wait_state(key)
                 self._clear_waiting(key)
         if not gangs:
             metrics.GANG_WAITING.set(len(self._waiting_gangs))
@@ -739,6 +957,7 @@ class GangAdmission:
                 # LAPSED hold — that would reset its age and void the
                 # cap.
                 self._clear_waiting(key)
+                self._clear_wait_state(key)
                 self._maybe_refence(key, gv, standing, pool)
                 continue
             members = gv.members
@@ -751,6 +970,7 @@ class GangAdmission:
                 # them), not capacity — they must not hold a node-event
                 # dependency or inflate the capacity-waiting gauge.
                 self._clear_waiting(key)
+                self._clear_wait_state(key)
                 continue
             if len(members) > gv.size:
                 log.warning(
@@ -759,6 +979,7 @@ class GangAdmission:
                     key[0], key[1], len(members), gv.size,
                 )
                 self._clear_waiting(key)
+                self._clear_wait_state(key)
                 continue
             if gv.ungated_live:
                 # Two distinct healthy-vs-broken shapes end here, and
@@ -797,12 +1018,18 @@ class GangAdmission:
                     key, gated,
                     reason="replacement_join" if placed
                     else "finish_partial_release",
+                    wait_started=self._waiting_since.get(key),
                 )
                 released.append(key)
                 self._clear_waiting(key)
+                self._clear_wait_state(key)
                 continue
             hold = standing.get(key)
             demands = gv.demands(self.resource_name)
+            # SLO origin: the first capacity evaluation of this
+            # complete, fully-gated gang (admission this very tick
+            # observes ~0s).
+            self._first_complete.setdefault(key, time.monotonic())
             if hold is not None:
                 if tuple(sorted(demands)) == hold.demands:
                     # A previous pass reserved and then EVERY
@@ -820,10 +1047,12 @@ class GangAdmission:
                         "failed wholesale)", key[0], key[1],
                     )
                     self._traced_release(
-                        key, gated, reason="release_retry"
+                        key, gated, reason="release_retry",
+                        wait_started=self._waiting_since.get(key),
                     )
                     released.append(key)
                     self._clear_waiting(key)
+                    self._clear_wait_state(key)
                     continue
                 # Same-named gang recreated with a DIFFERENT shape
                 # while its predecessor's hold lived: the hold fences
@@ -847,13 +1076,26 @@ class GangAdmission:
             # remainder hostage.
             consumed_hosts = pool().fits(demands)
             if consumed_hosts is None:
+                diag = pool().last_reject or {}
                 # Register capacity dependencies so node events wake
                 # exactly this gang (dirty ticks); the full sweep stays
                 # the level-triggered backstop.
                 self._set_waiting(key, demands, pool())
-                waiting = (key, tuple(sorted(demands)))
-                if waiting not in self._reported_waiting:
-                    self._reported_waiting.add(waiting)
+                dtuple = tuple(sorted(demands))
+                if self._waiting_reported.get(key) != dtuple:
+                    # Waiting-state CHANGE (fresh wait, or demands
+                    # edited in place): one decision record + flight
+                    # event + log line per state, not per resync.
+                    self._waiting_reported[key] = dtuple
+                    self._waiting_since.setdefault(key, time.time())
+                    LEDGER.record(
+                        "gang_waiting", "capacity",
+                        f"insufficient TPU capacity for {demands}: "
+                        + self._shortfall_text(diag),
+                        gang=f"{key[0]}/{key[1]}",
+                        demands=demands,
+                        **diag,
+                    )
                     RECORDER.record(
                         "gang_waiting",
                         f"gang {key[0]}/{key[1]} blocked on capacity",
@@ -862,15 +1104,23 @@ class GangAdmission:
                         demands=demands,
                     )
                     log.info(
-                        "gang %s/%s: insufficient TPU capacity for %s; "
-                        "stays gated (re-evaluated every %.0fs)",
-                        key[0], key[1], demands, self.resync_interval_s,
+                        "gang %s/%s: insufficient TPU capacity for %s "
+                        "(%s); stays gated (re-evaluated every %.0fs)",
+                        key[0], key[1], demands,
+                        self._shortfall_text(diag),
+                        self.resync_interval_s,
                     )
+                self._maybe_post_pending_event(key, gv, demands, diag)
                 continue
             self._clear_waiting(key)
-            self._reported_waiting = {
-                w for w in self._reported_waiting if w[0] != key
-            }
+            waited_s = max(
+                0.0,
+                time.monotonic() - self._first_complete.pop(
+                    key, time.monotonic()
+                ),
+            )
+            wait_started = self._waiting_since.get(key)
+            self._clear_wait_state(key)
             # Reserve BEFORE the first gate comes off: from the moment a
             # competitor pod can be scheduled, /filter already subtracts
             # this gang's hold (the whole point — reservations.py). The
@@ -884,7 +1134,9 @@ class GangAdmission:
             # left behind (the new hold ages from now, legitimately).
             self._lapsed_gangs.discard(key)
             self._traced_release(
-                key, gated, reason="admitted", demands=demands
+                key, gated, reason="admitted", demands=demands,
+                consumed=consumed_hosts, waited_s=waited_s,
+                wait_started=wait_started,
             )
             released.append(key)
         with self._dirty_lock:
@@ -1139,6 +1391,9 @@ class GangAdmission:
         members: List[dict],
         reason: str,
         demands: Optional[List[int]] = None,
+        consumed: Optional[Dict[str, int]] = None,
+        waited_s: Optional[float] = None,
+        wait_started: Optional[float] = None,
     ) -> None:
         """Release wrapped in the allocation trace's ROOT span.
 
@@ -1150,11 +1405,16 @@ class GangAdmission:
         /filter+/prioritize and eventually the plugin daemon's
         controller, which all join via tracing.extract. The gate-
         removal patches inside become kube.* child spans through the
-        resilience layer. Exact no-op when tracing is disabled."""
+        resilience layer. With the whole observability plane off
+        (neither tracing nor the decision ledger) this is an exact
+        no-op wrapper: no extra patch per pod — the release-stamp
+        annotation (the tpu_pod_time_to_allocate_seconds origin) is
+        only written when tracing or the ledger is on."""
         def note_released() -> None:
-            # Inside the span when one is open, so both the JSON log
-            # line and the flight event carry the trace id (the "grep
-            # the trace id" contract, docs/observability.md).
+            # Inside the span when one is open, so the JSON log line,
+            # the flight event, the decision record, and the SLO
+            # exemplar all carry the trace id (the "grep the trace id"
+            # contract, docs/observability.md).
             RECORDER.record(
                 "gang_released",
                 f"gang {key[0]}/{key[1]} gates removed ({reason})",
@@ -1163,6 +1423,44 @@ class GangAdmission:
                 pods=len(members),
                 reason=reason,
             )
+            gang_key = f"{key[0]}/{key[1]}"
+            ctx = tracing.current()
+            if ctx is not None and wait_started is not None:
+                # The gang's capacity-wait records predate this root
+                # span; stamp them into the admission trace so the
+                # whole chain correlates by one trace id — bounded to
+                # THIS waiting episode, so a deleted same-named
+                # predecessor's leftover records stay out.
+                LEDGER.tag_gang(
+                    gang_key, ctx.trace_id, ctx.span_id,
+                    since_ts=wait_started - 0.001,
+                )
+            if reason == "admitted":
+                if waited_s is not None:
+                    metrics.GANG_TIME_TO_ADMIT.observe(waited_s)
+                attrs = {
+                    "demands": demands,
+                    "hosts": ",".join(
+                        f"{h}={c}"
+                        for h, c in sorted((consumed or {}).items())
+                    ),
+                }
+                if waited_s is not None:
+                    attrs["waited_s"] = round(waited_s, 3)
+                LEDGER.record(
+                    "gang_admitted", "admitted",
+                    f"whole gang fits; gates removed for "
+                    f"{len(members)} pod(s)",
+                    gang=gang_key,
+                    **attrs,
+                )
+            else:
+                LEDGER.record(
+                    "gang_released", reason,
+                    f"gates removed ({reason}) for {len(members)} "
+                    f"pod(s)",
+                    gang=gang_key,
+                )
             log.info(
                 "gang %s/%s released (%s): %d pods, demand %s",
                 key[0], key[1], reason, len(members),
@@ -1170,6 +1468,8 @@ class GangAdmission:
             )
 
         if not tracing.enabled():
+            if LEDGER.enabled:
+                self._stamp_release(members, None)
             self._release(members)
             note_released()
             return
@@ -1181,20 +1481,29 @@ class GangAdmission:
             pods=len(members),
             reason=reason,
         ) as sp:
-            self._stamp_trace(members, sp.context)
+            self._stamp_release(members, sp.context)
             self._release(members)
             note_released()
 
-    def _stamp_trace(self, members: List[dict], ctx) -> None:
-        """Write the trace-context carrier annotation onto each member
-        (apiserver patch + the local dict, so this pass's own gate
-        snapshot and any in-process consumer see it too). Best-effort
-        per pod: a failed stamp costs that pod's trace join, never the
-        release."""
-        carrier: Dict[str, str] = {}
-        tracing.inject(carrier, ctx)
-        if not carrier:
-            return
+    def _stamp_release(self, members: List[dict], ctx) -> None:
+        """Write the release-time annotations onto each member before
+        the gates come off: the admission timestamp
+        (constants.ADMIT_TS_ANNOTATION — the controller's
+        tpu_pod_time_to_allocate_seconds origin) always, plus the
+        trace-context carrier when a span is open. One patch covers
+        both."""
+        ann = {constants.ADMIT_TS_ANNOTATION: str(round(time.time(), 3))}
+        if ctx is not None:
+            tracing.inject(ann, ctx)
+        self._stamp_annotations(members, ann)
+
+    def _stamp_annotations(
+        self, members: List[dict], carrier: Dict[str, str]
+    ) -> None:
+        """Write annotations onto each member (apiserver patch + the
+        local dict, so this pass's own gate snapshot and any in-process
+        consumer see it too). Best-effort per pod: a failed stamp costs
+        that pod's trace join / SLO sample, never the release."""
         for pod in members:
             meta = pod.setdefault("metadata", {})
             ns = meta.get("namespace", "default")

@@ -450,6 +450,65 @@ def tracing_overhead(n_nodes: int = 1000, filter_calls: int = 30) -> dict:
     }
 
 
+def ledger_overhead(n_nodes: int = 1000, filter_calls: int = 30) -> dict:
+    """The decision ledger's disabled-is-a-no-op proof, MEASURED
+    (ISSUE 4 acceptance): the indexed /filter+/prioritize hot path with
+    the ledger disabled vs enabled, same fixtures and measurement as
+    :func:`tracing_overhead` — so ``disabled`` percentiles are directly
+    comparable to the tracing_overhead baseline (the ≤1.1× acceptance
+    bound) and to ``run()``'s ``filter``/``prioritize``. ``enabled`` is
+    the opt-in cost of the per-RPC summary + top-k records into the
+    bounded ring (an all-free cluster: no per-node reject records)."""
+    from ..utils.decisions import LEDGER
+
+    nodes = [_node(f"node-{i:04d}") for i in range(n_nodes)]
+    names = [(n.get("metadata") or {}).get("name", "") for n in nodes]
+    cache = NodeAnnotationCache(_StubClient(nodes, []), interval_s=3600)
+    cache.refresh()
+    ext = TopologyExtender(
+        reservations=ReservationTable(), node_cache=cache
+    )
+    for chips in (4, 1, 2):  # warm the score memo off-measurement
+        pod = _plain_pod(chips=chips)
+        assert ext.filter_names(pod, names) is not None
+        assert ext.prioritize_names(pod, names) is not None
+
+    def measure() -> Dict[str, Dict[str, float]]:
+        fs: List[float] = []
+        ps: List[float] = []
+        for i in range(filter_calls):
+            pod = _plain_pod(chips=(1, 2, 4)[i % 3])
+            t0 = time.perf_counter()
+            out = ext.filter_names(pod, names)
+            fs.append(time.perf_counter() - t0)
+            assert out is not None and len(out[0]) == n_nodes
+            t0 = time.perf_counter()
+            scores = ext.prioritize_names(pod, names)
+            ps.append(time.perf_counter() - t0)
+            assert scores is not None and len(scores) == n_nodes
+        return {"filter": _pctl(fs), "prioritize": _pctl(ps)}
+
+    assert not LEDGER.enabled, "probe must start from the disabled default"
+    disabled = measure()
+    LEDGER.enable(service="extender")
+    try:
+        enabled = measure()
+        records = len(LEDGER)
+    finally:
+        LEDGER.disable()
+        LEDGER.clear()
+    base = disabled["filter"]["p99_ms"] or 1e-9
+    return {
+        "nodes": n_nodes,
+        "disabled": disabled,
+        "enabled": enabled,
+        "records_collected": records,
+        "filter_p99_overhead_pct": round(
+            (enabled["filter"]["p99_ms"] - base) / base * 100.0, 1
+        ),
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     import json
@@ -461,9 +520,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--tracing-overhead", action="store_true",
         help="run the tracing-overhead probe instead of the scale run",
     )
+    p.add_argument(
+        "--ledger-overhead", action="store_true",
+        help="run the decision-ledger overhead probe instead of the "
+        "scale run",
+    )
     a = p.parse_args(argv)
     if a.tracing_overhead:
         print(json.dumps(tracing_overhead(n_nodes=a.nodes)))
+        return 0
+    if a.ledger_overhead:
+        print(json.dumps(ledger_overhead(n_nodes=a.nodes)))
         return 0
     print(json.dumps(run(n_nodes=a.nodes, n_gangs=a.gangs)))
     return 0

@@ -26,6 +26,7 @@ future slice jobs).
 from __future__ import annotations
 
 import collections
+import heapq
 import json
 import threading
 from http.server import BaseHTTPRequestHandler
@@ -36,6 +37,7 @@ from ..topology.placement import PlacementState, ideal_box_links
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..topology.slice import SliceView, group_by_slice
 from ..utils import metrics, tracing
+from ..utils.decisions import LEDGER
 from ..utils.httpserver import BackgroundHTTPServer
 from ..utils.logging import get_logger
 from ..utils.podresources import tpu_request
@@ -47,6 +49,19 @@ from .reservations import DEFAULT_TABLE, ReservationTable
 log = get_logger(__name__)
 
 MAX_SCORE = 10
+
+NO_TOPOLOGY_MSG = "no TPU topology published"
+
+
+def ledger_pod_keys(pod: Optional[dict]) -> Tuple[str, str]:
+    """(pod key, gang key) for decision-ledger records — both
+    ``namespace/name`` strings (the shape tools/explain.py queries by);
+    gang is "" for a pod without gang labels."""
+    meta = (pod or {}).get("metadata") or {}
+    podkey = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+    info = pod_gang(pod or {})
+    gang = f"{info[0]}/{info[1]}" if info else ""
+    return podkey, gang
 
 
 class TopologyExtender:
@@ -196,32 +211,28 @@ class TopologyExtender:
             if any(n > t.chip_count > 0 for t in topos)
             else {}
         )
+        led = LEDGER.enabled  # one read per RPC, not per node
+        rejects: List[Tuple[str, str, str]] = []
         passing, failed = [], {}
         for node, topo in parsed:
             name = (node.get("metadata") or {}).get("name", "")
             if topo is None:
-                failed[name] = "no TPU topology published"
-                continue
-            local = min(n, topo.chip_count)
-            if local <= 0:
-                failed[name] = "node reports 0 TPU chips"
+                failed[name] = NO_TOPOLOGY_MSG
+                if led:
+                    rejects.append((name, "no_topology", NO_TOPOLOGY_MSG))
                 continue
             held = withheld.get(topo.hostname, 0)
-            reserved_note = (
-                f" ({held} reserved for a released gang)" if held else ""
+            rej = self._reject_reason(
+                n, topo, len(topo.available), held, slice_views
             )
-            if n > topo.chip_count:
-                reason = self._multi_host_reason(n, topo, slice_views)
-                if reason:
-                    failed[name] = reason + reserved_note
-                    continue
-            if len(topo.available) < local:
-                failed[name] = (
-                    f"{len(topo.available)} chips available, "
-                    f"{local} needed{reserved_note}"
-                )
+            if rej is not None:
+                failed[name] = rej[1]
+                if led:
+                    rejects.append((name, rej[0], rej[1]))
                 continue
             passing.append(node)
+        if led:
+            self._ledger_filter(pod, n, len(passing), rejects, "object")
         return passing, failed
 
     def _slice_views(
@@ -235,34 +246,142 @@ class TopologyExtender:
 
     def _multi_host_reason(
         self, n: int, topo: NodeTopology, slice_views: Dict[tuple, SliceView]
-    ) -> str:
-        """Empty string when the node can serve an n-chip multi-host gang;
-        else the filter-failure reason."""
+    ) -> Tuple[str, str]:
+        """("", "") when the node can serve an n-chip multi-host gang;
+        else (machine reason token, human filter-failure reason). The
+        token is the decision ledger's bounded-cardinality reason label
+        (utils/decisions.py); the message goes back to the scheduler."""
         if n % topo.chip_count != 0:
             return (
+                "not_chip_multiple",
                 f"multi-host request of {n} not a multiple of host "
-                f"size {topo.chip_count}"
+                f"size {topo.chip_count}",
             )
         if len(topo.available) < topo.chip_count:
-            return "multi-host slice needs the full host free"
+            return (
+                "host_not_whole_free",
+                "multi-host slice needs the full host free",
+            )
         if len(topo.slice_hosts) <= 1:
             return (
+                "no_slice_peers",
                 "node is not part of a multi-host slice (no ICI to peers; "
-                "a cross-host gang here would ride DCN)"
+                "a cross-host gang here would ride DCN)",
             )
         k = n // topo.chip_count
         if k > len(topo.slice_hosts):
             return (
+                "slice_too_few_hosts",
                 f"slice has {len(topo.slice_hosts)} hosts, "
-                f"{k} needed"
+                f"{k} needed",
             )
         view = slice_views.get(tuple(topo.slice_hosts))
         if view is None or len(view.free_coords()) < k:
             free = 0 if view is None else len(view.free_coords())
             return (
-                f"slice has {free} whole-free candidate hosts, {k} needed"
+                "slice_insufficient_free_hosts",
+                f"slice has {free} whole-free candidate hosts, {k} needed",
             )
-        return ""
+        return ("", "")
+
+    def _reject_reason(
+        self,
+        n: int,
+        topo: NodeTopology,
+        avail: int,
+        held: int,
+        slice_views: Dict[tuple, SliceView],
+    ) -> Optional[Tuple[str, str]]:
+        """(reason token, message) when a topology-publishing node
+        cannot serve an n-chip request, else None. The ONE reason
+        builder both the full-object and indexed name-only paths use —
+        ledger reasons and scheduler-visible messages cannot drift
+        between them (parity-tested in tests/test_decisions.py).
+        ``avail`` is the node's reservation-shielded free-chip count;
+        ``held`` is how many chips reservations withheld (the
+        diagnostic note)."""
+        local = min(n, topo.chip_count)
+        if local <= 0:
+            return ("zero_chips", "node reports 0 TPU chips")
+        reserved_note = (
+            f" ({held} reserved for a released gang)" if held else ""
+        )
+        if n > topo.chip_count:
+            code, reason = self._multi_host_reason(n, topo, slice_views)
+            if code:
+                return (code, reason + reserved_note)
+        if avail < local:
+            return (
+                "insufficient_chips",
+                f"{avail} chips available, {local} needed{reserved_note}",
+            )
+        return None
+
+    # -- decision-ledger recording ----------------------------------------
+    #
+    # Gated on LEDGER.enabled (hoisted to one bool read per RPC by the
+    # callers); when on, each rejected candidate becomes one
+    # ``filter_reject`` record (capped per RPC so a 5,000-node sweep
+    # can't flush the whole ring) plus one per-RPC ``filter`` summary,
+    # and each /prioritize RPC records its top-k scores with the
+    # winner's per-term breakdown.
+
+    _MAX_REJECT_RECORDS = 64
+
+    def _ledger_filter(
+        self,
+        pod: dict,
+        n: int,
+        passing: int,
+        rejects: List[Tuple[str, str, str]],
+        path: str,
+    ) -> None:
+        podkey, gang = ledger_pod_keys(pod)
+        for name, code, msg in rejects[: self._MAX_REJECT_RECORDS]:
+            LEDGER.record(
+                "filter_reject", code, msg,
+                pod=podkey, gang=gang, node=name, chips=n, path=path,
+            )
+        truncated = max(0, len(rejects) - self._MAX_REJECT_RECORDS)
+        extra = {"rejects_truncated": truncated} if truncated else {}
+        LEDGER.record(
+            "filter",
+            "ok" if passing else "all_rejected",
+            f"{passing}/{passing + len(rejects)} candidates passed "
+            f"for a {n}-chip request",
+            pod=podkey, gang=gang, chips=n, path=path, **extra,
+        )
+
+    def _ledger_prioritize(
+        self,
+        pod: dict,
+        n: int,
+        out: List[dict],
+        terms_for,
+        path: str,
+    ) -> None:
+        """``terms_for(host)`` lazily resolves the winner's score-term
+        breakdown (score_terms) — only the top node pays the recompute,
+        and only with the ledger on."""
+        podkey, gang = ledger_pod_keys(pod)
+        # nlargest, not a full sort: O(n) on a 5,000-candidate RPC.
+        top = heapq.nlargest(5, out, key=lambda h: h["score"])
+        attrs = {
+            "candidates": len(out),
+            "path": path,
+            "top": " ".join(f"{h['host']}={h['score']}" for h in top),
+        }
+        if top and n > 0:
+            terms = terms_for(top[0]["host"])
+            if terms:
+                attrs["best"] = top[0]["host"]
+                for k, v in terms.items():
+                    attrs[f"best_{k}"] = v
+        LEDGER.record(
+            "prioritize", "scored",
+            f"scored {len(out)} candidates for a {n}-chip request",
+            pod=podkey, gang=gang, **attrs,
+        )
 
     # -- prioritize --------------------------------------------------------
 
@@ -272,22 +391,43 @@ class TopologyExtender:
         topo: NodeTopology,
         slice_views: Optional[Dict[tuple, SliceView]] = None,
     ) -> int:
+        return self.score_terms(n, topo, slice_views)["score"]
+
+    def score_terms(
+        self,
+        n: int,
+        topo: NodeTopology,
+        slice_views: Optional[Dict[tuple, SliceView]] = None,
+    ) -> Dict[str, int]:
+        """The score plus its per-term breakdown — the decision
+        ledger's prioritize records surface these (term_links/ideal/
+        base/packing for the single-host placement simulation,
+        term_gang for multi-host). Only runs on score-memo misses and
+        ledger top-k lookups, so the dict build stays off the cached
+        hot path."""
         if n > topo.chip_count > 0:
-            return self._score_multi_host(n, topo, slice_views or {})
+            s = self._score_multi_host(n, topo, slice_views or {})
+            return {"score": s, "term_gang": s}
         local = min(n, topo.chip_count)
         if local <= 0 or len(topo.available) < local:
-            return 0
+            return {"score": 0}
         mesh = topo.to_mesh()
         state = PlacementState(mesh)
         state.reset(allocated=set(mesh.ids) - set(topo.available))
         sel = state.select(local)
         if len(sel) < local:
-            return 0
+            return {"score": 0}
         links = mesh.internal_links(sel)
         ideal = ideal_box_links(local)
         base = round((MAX_SCORE - 2) * min(links / ideal, 1.0)) if ideal else 0
         packing_bonus = 2 if len(topo.available) == local else 0
-        return min(base + packing_bonus, MAX_SCORE)
+        return {
+            "score": min(base + packing_bonus, MAX_SCORE),
+            "term_links": links,
+            "term_ideal": ideal,
+            "term_base": base,
+            "term_packing": packing_bonus,
+        }
 
     def _score_multi_host(
         self, n: int, topo: NodeTopology, slice_views: Dict[tuple, SliceView]
@@ -359,6 +499,19 @@ class TopologyExtender:
                         ):
                             self._score_cache.popitem(last=False)
             out.append({"host": name, "score": score})
+        if LEDGER.enabled:
+            by_name = {
+                (node.get("metadata") or {}).get("name", ""): topo
+                for node, _, topo in parsed3
+            }
+
+            def terms_for(host: str):
+                topo = by_name.get(host)
+                return (
+                    self.score_terms(n, topo, slice_views) if topo else None
+                )
+
+            self._ledger_prioritize(pod, n, out, terms_for, "object")
         return out
 
     # -- indexed name-only fast path ---------------------------------------
@@ -449,34 +602,34 @@ class TopologyExtender:
             for _, e in entries
         ):
             slice_views = self._slice_views_from_entries(entries, held)
+        led = LEDGER.enabled  # one read per RPC, not per node
+        rejects: List[Tuple[str, str, str]] = []
         passing: List[str] = []
         failed: Dict[str, str] = {}
         for name, e in entries:
             if e is None or e.topo is None:
-                failed[name] = "no TPU topology published"
-                continue
-            local = min(n, e.chip_count)
-            if local <= 0:
-                failed[name] = "node reports 0 TPU chips"
+                failed[name] = NO_TOPOLOGY_MSG
+                if led:
+                    rejects.append((name, "no_topology", NO_TOPOLOGY_MSG))
                 continue
             h = held.get(e.hostname, 0)
-            avail = max(0, e.avail - h)
-            reserved_note = (
-                f" ({h} reserved for a released gang)" if h else ""
+            # Only the multi-host check reads topology beyond the chip
+            # count, so the shield clone stays on that rare path; the
+            # single-host capacity check rides the integer counts.
+            topo = (
+                shielded(e.topo, h) if h and n > e.chip_count else e.topo
             )
-            if n > e.chip_count:
-                topo = shielded(e.topo, h) if h else e.topo
-                reason = self._multi_host_reason(n, topo, slice_views)
-                if reason:
-                    failed[name] = reason + reserved_note
-                    continue
-            if avail < local:
-                failed[name] = (
-                    f"{avail} chips available, {local} needed"
-                    f"{reserved_note}"
-                )
+            rej = self._reject_reason(
+                n, topo, max(0, e.avail - h), h, slice_views
+            )
+            if rej is not None:
+                failed[name] = rej[1]
+                if led:
+                    rejects.append((name, rej[0], rej[1]))
                 continue
             passing.append(name)
+        if led:
+            self._ledger_filter(pod, n, len(passing), rejects, "indexed")
         return passing, failed
 
     def prioritize_names(
@@ -539,6 +692,18 @@ class TopologyExtender:
                         ):
                             self._score_cache.popitem(last=False)
             out.append({"host": name, "score": score})
+        if LEDGER.enabled:
+            by_name = {name: e for name, e in entries}
+
+            def terms_for(host: str):
+                e = by_name.get(host)
+                if e is None or e.topo is None:
+                    return None
+                h = held.get(e.hostname, 0)
+                topo = shielded(e.topo, h) if h else e.topo
+                return self.score_terms(n, topo, slice_views)
+
+            self._ledger_prioritize(pod, n, out, terms_for, "indexed")
         return out
 
 

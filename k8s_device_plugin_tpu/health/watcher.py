@@ -55,6 +55,7 @@ from typing import Callable, Dict, FrozenSet, Optional, Sequence
 from ..api import constants
 from ..discovery.chips import TpuChip
 from ..utils import metrics
+from ..utils.decisions import LEDGER
 from ..utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -184,6 +185,15 @@ class HealthWatcher:
                         reason,
                     )
                     metrics.APP_FAULTS.inc(reason=reason)
+                    # The skip IS a health decision (the XID 31/43/45
+                    # analog): ledger it so "why wasn't this chip
+                    # withdrawn?" has a queryable answer.
+                    LEDGER.record(
+                        "app_fault", reason,
+                        f"chip {cid} reported app-level fault "
+                        f"{reason!r}; NOT marked unhealthy",
+                        chip=cid,
+                    )
                 continue
             self._app_fault.pop(cid, None)
             if healthy != self._last[cid]:

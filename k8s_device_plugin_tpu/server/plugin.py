@@ -42,6 +42,7 @@ from ..api.grpc_defs import (
 from ..topology.mesh import IciMesh
 from ..topology.placement import PlacementState
 from ..utils import metrics, profiling, tracing
+from ..utils.decisions import LEDGER
 from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
 
@@ -341,6 +342,14 @@ class TpuDevicePlugin(DevicePluginServicer):
                 chip=chip_id,
                 healthy=healthy,
             )
+            LEDGER.record(
+                "chip_health",
+                "recovered" if healthy else "unhealthy",
+                f"chip {chip_id} "
+                + ("recovered" if healthy else "went unhealthy")
+                + "; device list re-advertised",
+                chip=chip_id,
+            )
             self._bump()
             self._availability_changed()
             hook = self.on_health_transition
@@ -553,6 +562,26 @@ class TpuDevicePlugin(DevicePluginServicer):
                     "chips handed to a container",
                     chips=",".join(assigned),
                 )
+                if LEDGER.enabled and requested:
+                    # The reference's Allocate-time substitution is a
+                    # placement DECISION (kubelet pick vs topology
+                    # pick); the record is provisional-trace-stamped
+                    # here and retraced into the pod's carried trace
+                    # at controller adoption (decisions.retrace).
+                    LEDGER.record(
+                        "allocate_substitution",
+                        "substituted" if substitutions
+                        else "kubelet_choice",
+                        (
+                            f"kubelet requested {sorted(requested)}, "
+                            f"topology chose {sorted(assigned)}"
+                            if substitutions
+                            else f"kubelet's choice {sorted(requested)} "
+                            "kept"
+                        ),
+                        requested=",".join(sorted(requested)),
+                        assigned=",".join(sorted(assigned)),
+                    )
         self._availability_changed()
         return resp
 

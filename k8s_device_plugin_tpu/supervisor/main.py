@@ -112,6 +112,10 @@ class DaemonConfig:
     trace: bool = False
     log_json: bool = False
     flight_dir: str = ""
+    # Decision ledger (utils/decisions.py): allocate substitutions,
+    # chip health transitions, app-fault skips, and evictions become
+    # queryable records at /debug/decisions. Implied by trace.
+    decisions: bool = False
 
 
 class Daemon:
@@ -126,6 +130,10 @@ class Daemon:
             # (/debug/events, dumped on SIGTERM/circuit-break).
             tracing.enable(service="plugin")
             RECORDER.enable(service="plugin", dump_dir=cfg.flight_dir)
+        from ..utils import decisions
+
+        if decisions.should_enable(cfg.decisions, cfg.trace):
+            decisions.LEDGER.enable(service="plugin")
         self._accel_backend = get_backend(
             prefer_native=cfg.prefer_native_backend
         )
@@ -569,6 +577,13 @@ def parse_args(argv) -> DaemonConfig:
                    "spans at /debug/traces, events at /debug/events, "
                    "exemplars on the latency histograms. Off = exact "
                    "no-op")
+    p.add_argument("--decisions", action="store_true",
+                   help="enable the scheduling decision ledger "
+                   "(utils/decisions.py; also TPU_DECISIONS=1): "
+                   "allocate substitutions, chip health transitions, "
+                   "and evictions become queryable records at "
+                   "/debug/decisions. Implied by --trace; off = exact "
+                   "no-op")
     p.add_argument("--log-json", action="store_true",
                    help="JSON-lines logging with trace correlation "
                    "(also TPU_LOG_JSON=1)")
@@ -617,6 +632,7 @@ def parse_args(argv) -> DaemonConfig:
         trace=a.trace,
         log_json=a.log_json,
         flight_dir=a.flight_dir,
+        decisions=a.decisions,
     )
 
 

@@ -1,0 +1,347 @@
+"""Explain CLI: "why is my pod pending?" answered from the decision
+ledger, correlated with traces.
+
+Consumes the two artifacts the decision-provenance layer produces
+(docs/observability.md):
+
+* the **decision ledger** — ``GET /debug/decisions`` on the daemon's
+  metrics port or the extender port (utils/decisions.py snapshot
+  shape), one or more files (pass ``--decisions`` once per daemon to
+  merge the extender's and a node daemon's views);
+* a **trace export** — ``GET /debug/traces`` (OTLP-JSON), rendered
+  beneath the decision chain via tools/trace.py's tree renderer.
+
+Three questions, three selectors:
+
+* ``--pod X``  — the full decision chain for one allocation: the pod's
+  own filter/prioritize records, its gang's admission records, and
+  every record sharing a trace id with them (the plugin's Allocate
+  substitution joins here after controller adoption), chronological.
+* ``--gang Z`` — the gang's admission history: waiting-state changes
+  with their capacity shortfalls, the admit, releases.
+* ``--node Y`` — why the node was rejected: its filter_reject records
+  grouped by reason.
+
+    python -m k8s_device_plugin_tpu.tools.explain --pod my-pod \
+        --url http://extender:12346
+    python -m k8s_device_plugin_tpu.tools.explain --gang my-gang \
+        --decisions decisions.json --traces traces.json
+    python -m k8s_device_plugin_tpu.tools.explain --self-test
+
+``--self-test`` synthesizes a capacity-starved allocation journey
+through the REAL ledger + collector and renders it — the CI smoke
+(scripts/tier1.sh) that proves the snapshot/export shapes and this
+renderer never drift.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Set, Tuple
+
+from .trace import _flatten_otlp, render_trace_tree
+
+
+def _name_match(value: str, arg: str) -> bool:
+    """Record keys are ``namespace/name``; operators rarely type the
+    namespace — accept both."""
+    return bool(value) and (value == arg or value.endswith("/" + arg))
+
+
+def _ts(rec: dict) -> str:
+    t = rec.get("ts", 0)
+    return time.strftime("%H:%M:%S", time.localtime(t)) + (
+        f".{int((t % 1) * 1000):03d}"
+    )
+
+
+def _subject(rec: dict) -> str:
+    parts = []
+    if rec.get("node"):
+        parts.append(f"node {rec['node']}")
+    if rec.get("pod"):
+        parts.append(f"pod {rec['pod']}")
+    if rec.get("gang"):
+        parts.append(f"gang {rec['gang']}")
+    return ", ".join(parts)
+
+
+def _record_line(rec: dict) -> str:
+    attrs = " ".join(
+        f"{k}={v}" for k, v in sorted((rec.get("attrs") or {}).items())
+    )
+    trace = f" trace={rec['trace_id'][:16]}" if rec.get("trace_id") else ""
+    subject = _subject(rec)
+    return (
+        f"  {_ts(rec)}  {rec.get('kind', '?'):<22} "
+        f"[{rec.get('reason', '')}] {rec.get('message', '')}"
+        + (f"  ({subject})" if subject else "")
+        + (f"  [{attrs}]" if attrs else "")
+        + trace
+    )
+
+
+def chain_for_pod(
+    records: List[dict], pod: str
+) -> Tuple[List[dict], Set[str]]:
+    """The pod's decision chain: its own records, its gang's records,
+    and every record sharing a trace with either (that is how the
+    plugin daemon's Allocate-substitution record — which carries no pod
+    identity — joins after controller adoption)."""
+    direct = [r for r in records if _name_match(r.get("pod", ""), pod)]
+    gangs = {r["gang"] for r in direct if r.get("gang")}
+    traces = {r["trace_id"] for r in direct if r.get("trace_id")}
+    grown = True
+    while grown:  # gang records widen the trace set (gang.admit root)
+        grown = False
+        for r in records:
+            if r.get("gang") in gangs and r.get("trace_id"):
+                if r["trace_id"] not in traces:
+                    traces.add(r["trace_id"])
+                    grown = True
+    out = [
+        r
+        for r in records
+        if _name_match(r.get("pod", ""), pod)
+        or (r.get("gang") in gangs and not r.get("pod"))
+        or (r.get("trace_id") and r["trace_id"] in traces)
+    ]
+    return sorted(out, key=lambda r: r.get("ts", 0)), traces
+
+
+def render_pod(records: List[dict], spans: List[dict],
+               pod: str) -> List[str]:
+    chain, traces = chain_for_pod(records, pod)
+    if not chain:
+        return [f"(no decision records for pod {pod!r})"]
+    out = [
+        f"decision chain for pod {pod} "
+        f"({len(chain)} records, {len(traces)} trace(s)):",
+        "",
+    ]
+    out += [_record_line(r) for r in chain]
+    for tid in sorted(traces):
+        members = [s for s in spans if s["trace_id"] == tid]
+        if members:
+            out.append("")
+            out += render_trace_tree(members, trace_id=tid)
+    return out
+
+
+def render_gang(records: List[dict], spans: List[dict],
+                gang: str) -> List[str]:
+    chain = sorted(
+        (r for r in records if _name_match(r.get("gang", ""), gang)),
+        key=lambda r: r.get("ts", 0),
+    )
+    if not chain:
+        return [f"(no decision records for gang {gang!r})"]
+    waits = [r for r in chain if r.get("kind") == "gang_waiting"]
+    admits = [r for r in chain if r.get("kind") == "gang_admitted"]
+    head = f"gang {gang}: {len(waits)} waiting-state change(s)"
+    if admits:
+        waited = (admits[-1].get("attrs") or {}).get("waited_s")
+        head += ", admitted" + (
+            f" after {waited}s" if waited else ""
+        )
+    out = [head, ""]
+    out += [_record_line(r) for r in chain]
+    traces = {r["trace_id"] for r in chain if r.get("trace_id")}
+    for tid in sorted(traces):
+        members = [s for s in spans if s["trace_id"] == tid]
+        if members:
+            out.append("")
+            out += render_trace_tree(members, trace_id=tid)
+    return out
+
+
+def render_node(records: List[dict], node: str) -> List[str]:
+    mine = sorted(
+        (r for r in records if r.get("node") == node),
+        key=lambda r: r.get("ts", 0),
+    )
+    if not mine:
+        return [f"(no decision records for node {node!r})"]
+    by_reason: Dict[str, int] = {}
+    for r in mine:
+        by_reason[r.get("reason", "?")] = (
+            by_reason.get(r.get("reason", "?"), 0) + 1
+        )
+    out = [
+        f"node {node}: {len(mine)} decision record(s) — "
+        + ", ".join(
+            f"{reason}×{n}" for reason, n in sorted(by_reason.items())
+        ),
+        "",
+    ]
+    out += [_record_line(r) for r in mine]
+    return out
+
+
+def _load(path: str) -> dict:
+    if path == "-":
+        return json.load(sys.stdin)
+    with open(path) as f:
+        return json.load(f)
+
+
+def _fetch(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def gather(
+    url: str,
+    decision_files: List[str],
+    traces_file: str,
+) -> Tuple[List[dict], List[dict]]:
+    """(ledger records, flat spans) from a live daemon URL and/or
+    files. Multiple decision sources merge (extender + node daemons
+    each keep their own ledger)."""
+    records: List[dict] = []
+    spans: List[dict] = []
+    if url:
+        base = url.rstrip("/")
+        records += _fetch(f"{base}/debug/decisions").get("records", [])
+        try:
+            spans += _flatten_otlp(_fetch(f"{base}/debug/traces"))
+        except Exception:  # noqa: BLE001 — traces are enrichment; the
+            pass  # decision chain must render without them
+    for path in decision_files:
+        doc = _load(path)
+        records += doc.get("records", []) if isinstance(doc, dict) else doc
+    if traces_file:
+        spans += _flatten_otlp(_load(traces_file))
+    return records, spans
+
+
+def _self_test() -> Tuple[List[dict], List[dict]]:
+    """Synthesize the canonical capacity-starved journey through the
+    REAL ledger + collector (decisions.py record/snapshot, tracing.py
+    span/export), so this smoke breaks if either shape and this
+    renderer ever drift."""
+    from ..utils import decisions, tracing
+
+    led = decisions.DecisionLedger()
+    led.enabled = True  # bare enable: no metrics binding needed
+    collector = tracing.SpanCollector()
+    saved = tracing.COLLECTOR
+    tracing.COLLECTOR = collector
+    was_enabled = tracing.enabled()
+    try:
+        tracing.enable(service="extender")
+        with tracing.span("gang.admit", service="extender",
+                          gang="demo") as root:
+            ctx = root.context
+            led.record(
+                "gang_waiting", "capacity",
+                "insufficient TPU capacity for [2, 2]: blocking demand "
+                "2: best host has 0 free chip(s), short 2",
+                gang="default/demo",
+            )
+            led.record(
+                "gang_admitted", "admitted",
+                "whole gang fits; gates removed for 2 pod(s)",
+                gang="default/demo", waited_s=14.2,
+            )
+        with tracing.span("extender.filter", parent=ctx,
+                          service="extender"):
+            led.record(
+                "filter_reject", "insufficient_chips",
+                "0 chips available, 2 needed",
+                pod="default/demo-w0", gang="default/demo",
+                node="node-b",
+            )
+            led.record(
+                "filter", "ok", "1/2 candidates passed",
+                pod="default/demo-w0", gang="default/demo",
+            )
+        with tracing.span("plugin.Allocate", parent=ctx,
+                          service="plugin"):
+            led.record(
+                "allocate_substitution", "substituted",
+                "kubelet requested ['c2', 'c3'], topology chose "
+                "['c0', 'c1']",
+                requested="c2,c3", assigned="c0,c1",
+            )
+        return (
+            led.snapshot()["records"],
+            _flatten_otlp(collector.otlp_json()),
+        )
+    finally:
+        tracing.COLLECTOR = saved
+        if not was_enabled:
+            tracing.disable()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu-explain",
+        description="Answer 'why is my pod pending?' from the "
+        "scheduling decision ledger, correlated with traces.",
+    )
+    p.add_argument("--pod", default="", help="pod name or namespace/name")
+    p.add_argument("--gang", default="",
+                   help="gang name or namespace/name")
+    p.add_argument("--node", default="", help="node name")
+    p.add_argument(
+        "--url", default="",
+        help="daemon base URL; fetches /debug/decisions and "
+        "/debug/traces from it",
+    )
+    p.add_argument(
+        "--decisions", action="append", default=[],
+        help="decision-ledger JSON file ('-' for stdin); repeatable "
+        "to merge several daemons' ledgers",
+    )
+    p.add_argument(
+        "--traces", default="",
+        help="OTLP-JSON trace export file to correlate",
+    )
+    p.add_argument(
+        "--self-test", action="store_true",
+        help="render a synthetic in-process decision chain (CI smoke)",
+    )
+    a = p.parse_args(argv)
+    if a.self_test:
+        records, spans = _self_test()
+        lines = render_pod(records, spans, "demo-w0")
+        print("\n".join(lines))
+        text = "\n".join(lines)
+        needed = (
+            "gang_waiting", "gang_admitted", "filter_reject",
+            "allocate_substitution", "plugin.Allocate", "gang.admit",
+            "insufficient_chips",
+        )
+        missing = [n for n in needed if n not in text]
+        if missing or "decision chain" not in text:
+            print(f"self-test failed: missing {missing}",
+                  file=sys.stderr)
+            return 1
+        return 0
+    if not (a.pod or a.gang or a.node):
+        p.error("one of --pod / --gang / --node is required "
+                "(or --self-test)")
+    if not (a.url or a.decisions):
+        p.error("a source is required: --url and/or --decisions")
+    try:
+        records, spans = gather(a.url, a.decisions, a.traces)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if a.pod:
+        lines = render_pod(records, spans, a.pod)
+    elif a.gang:
+        lines = render_gang(records, spans, a.gang)
+    else:
+        lines = render_node(records, a.node)
+    print("\n".join(lines))
+    return 0 if not lines[0].startswith("(no decision records") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

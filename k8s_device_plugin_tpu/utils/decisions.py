@@ -1,0 +1,284 @@
+"""Scheduling decision ledger: a bounded, queryable record of WHY.
+
+The control plane's whole value is making placement decisions, yet until
+this module every decision's rationale died the moment it was acted on:
+filter rejection reasons went back to the scheduler and vanished, gang
+wait causes lived only in a once-per-state log marker, and the tracing
+plane (utils/tracing.py) records *when* things happened but not *why*.
+The ledger is the decision-provenance tier that composes with the
+trace/flight-recorder stack: every consequential decision — extender
+filter rejections (per node, per reason), prioritize score breakdowns,
+gang admission outcomes (admitted / waiting with the blocking shortfall
+/ released), health transitions and evictions, and plugin Allocate
+substitutions — becomes one structured record carrying a
+machine-readable ``reason`` token, the human message, the pod/gang/node
+it concerns, and the active ``trace_id``.
+
+Records are served at ``GET /debug/decisions`` on both HTTP servers
+(``?pod=``/``?gang=``/``?node=``/``?kind=``/``?trace_id=``/``?limit=``
+filtering — utils/metrics.py ``debug_payload``) and consumed by
+``tools/explain.py``, which merges them with ``/debug/traces`` to
+answer "why is my pod pending?" without grepping three daemons' logs.
+
+Shape notes, all deliberate mirrors of the flight recorder
+(utils/flightrecorder.py):
+
+* **bounded ring** — past ``capacity`` the oldest record drops and
+  ``dropped`` counts it; overflow pressure is additionally flight-
+  recorded (``decision_overflow``, throttled) so a circuit-break dump
+  captures that the ledger was lossy during the incident window;
+* **gated on :meth:`enable`** — recording costs one bool read when
+  off; bench.py's ``detail.ledger_overhead`` probe measures (not
+  asserts) that the disabled indexed-/filter p99 does not move;
+* **per-process** — each daemon keeps its own ledger under its own
+  registry's ``*_decisions_total{kind,reason}`` family. ``reason`` is
+  always a stable machine token (never a formatted message), so the
+  metric's label cardinality stays bounded while the record keeps the
+  full human string in ``message``.
+
+:meth:`retrace` is the ledger's half of the plugin-side trace join:
+``plugin.Allocate`` decisions are recorded under the provisional trace
+(no pod identity is knowable in the kubelet RPC), and the controller
+rewrites them into the pod's carried trace at adoption time — the same
+retroactive join tracing.adopt performs on spans.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import tracing
+
+
+def env_enabled() -> bool:
+    """The TPU_DECISIONS=1 environment opt-in (entrypoints OR this
+    with their --decisions/--trace flags — mirrors
+    tracing.env_enabled)."""
+    return os.environ.get("TPU_DECISIONS", "") in ("1", "true", "on")
+
+
+def should_enable(decisions_flag: bool, trace_flag: bool) -> bool:
+    """The ONE enablement rule both entrypoints apply: the --decisions
+    flag, the --trace flag (tracing implies the ledger), or either
+    env opt-in (TPU_DECISIONS / TPU_TRACE)."""
+    return (
+        decisions_flag
+        or trace_flag
+        or env_enabled()
+        or tracing.env_enabled()
+    )
+
+
+class DecisionLedger:
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self.enabled = False
+        self.service = ""
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._records: "collections.deque" = collections.deque()
+        self._counter = None  # *_decisions_total, bound by enable()
+        # Drop count at the last decision_overflow flight event —
+        # overflow is flight-recorded on the FIRST drop and then once
+        # per _OVERFLOW_EVERY, not per record (a hot ring must not spam
+        # the flight ring it is reporting pressure to).
+        self._overflow_reported = 0
+
+    _OVERFLOW_EVERY = 1024
+
+    def enable(self, service: str = "plugin",
+               capacity: Optional[int] = None) -> None:
+        from . import metrics
+
+        with self._lock:
+            self.service = service
+            if capacity is not None:
+                self.capacity = capacity
+            self._counter = (
+                metrics.EXT_DECISIONS
+                if service == "extender"
+                else metrics.DECISIONS
+            )
+            self.enabled = True
+
+    def disable(self) -> None:
+        with self._lock:
+            self.enabled = False
+            self._counter = None
+
+    def record(
+        self,
+        kind: str,
+        reason: str,
+        message: str = "",
+        pod: str = "",
+        gang: str = "",
+        node: str = "",
+        **attrs,
+    ) -> None:
+        """Append one decision. ``reason`` must be a stable machine
+        token (it becomes the ``*_decisions_total`` reason label); the
+        human detail goes in ``message``. First line is the enabled
+        gate — one bool read when the ledger is off."""
+        if not self.enabled:
+            return
+        ctx = tracing.current()
+        rec = {
+            "ts": round(time.time(), 3),
+            "kind": kind,
+            "reason": reason,
+            "message": message,
+            "pod": pod,
+            "gang": gang,
+            "node": node,
+            "attrs": {k: str(v) for k, v in attrs.items()},
+        }
+        if ctx is not None:
+            rec["trace_id"] = ctx.trace_id
+            rec["span_id"] = ctx.span_id
+        overflowed = False
+        with self._lock:
+            self._records.append(rec)
+            while len(self._records) > self.capacity:
+                self._records.popleft()
+                self.dropped += 1
+            if self.dropped and (
+                self._overflow_reported == 0
+                or self.dropped - self._overflow_reported
+                >= self._OVERFLOW_EVERY
+            ):
+                self._overflow_reported = self.dropped
+                overflowed = True
+            counter = self._counter
+        if counter is not None:
+            counter.inc(kind=kind, reason=reason)
+        if overflowed:
+            from .flightrecorder import RECORDER
+
+            RECORDER.record(
+                "decision_overflow",
+                "decision ledger dropping oldest records",
+                service=self.service,
+                dropped=self.dropped,
+                capacity=self.capacity,
+            )
+
+    def tag_gang(
+        self,
+        gang: str,
+        trace_id: str,
+        span_id: str = "",
+        since_ts: float = 0.0,
+    ) -> int:
+        """Stamp the trace onto this gang's earlier UNTRACED records:
+        a gang's capacity-wait history (gang_waiting, slo_breach)
+        predates the ``gang.admit`` root span, so the admitter calls
+        this inside the span at release time — the waiting chain joins
+        the admission trace retroactively, the way tracing.adopt joins
+        the provisional Allocate span. Records that already carry a
+        trace keep it; ``since_ts`` bounds the stamp to the current
+        waiting EPISODE (a deleted same-named predecessor's leftover
+        records must not join the successor's trace). Returns how many
+        records were stamped."""
+        if not gang or not trace_id:
+            return 0
+        n = 0
+        with self._lock:
+            for rec in self._records:
+                if (
+                    rec.get("gang") == gang
+                    and "trace_id" not in rec
+                    and rec.get("ts", 0) >= since_ts
+                ):
+                    rec["trace_id"] = trace_id
+                    if span_id:
+                        rec["span_id"] = span_id
+                    n += 1
+        return n
+
+    def retrace(self, old_trace_id: str, new_trace_id: str) -> int:
+        """Rewrite records stamped under ``old_trace_id`` into
+        ``new_trace_id`` (keeping ``retraced_from``) — the ledger side
+        of the plugin-Allocate adoption (tracing.adopt). Returns how
+        many records moved."""
+        if not old_trace_id or old_trace_id == new_trace_id:
+            return 0
+        n = 0
+        with self._lock:
+            for rec in self._records:
+                if rec.get("trace_id") == old_trace_id:
+                    rec["attrs"]["retraced_from"] = old_trace_id
+                    rec["trace_id"] = new_trace_id
+                    n += 1
+        return n
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self.dropped = 0
+            self._overflow_reported = 0
+
+    def query(
+        self,
+        pod: str = "",
+        gang: str = "",
+        node: str = "",
+        kind: str = "",
+        trace_id: str = "",
+        limit: int = 0,
+    ) -> List[dict]:
+        """Filtered records, oldest first. ``pod``/``gang`` match the
+        full ``namespace/name`` key or the bare name (operators rarely
+        type the namespace); ``node``/``kind``/``trace_id`` are exact.
+        ``limit`` keeps the NEWEST n matches."""
+
+        def name_match(value: str, arg: str) -> bool:
+            return value == arg or value.endswith("/" + arg)
+
+        with self._lock:
+            # attrs must be copied too: retrace()/tag_gang() mutate a
+            # live record's attrs dict, and a shared reference would
+            # let that race the JSON serialization of a /debug/
+            # decisions snapshot happening outside this lock.
+            records = [
+                {**r, "attrs": dict(r.get("attrs") or {})}
+                for r in self._records
+            ]
+        out = []
+        for r in records:
+            if pod and not name_match(r.get("pod", ""), pod):
+                continue
+            if gang and not name_match(r.get("gang", ""), gang):
+                continue
+            if node and r.get("node", "") != node:
+                continue
+            if kind and r.get("kind", "") != kind:
+                continue
+            if trace_id and r.get("trace_id", "") != trace_id:
+                continue
+            out.append(r)
+        if limit > 0:
+            out = out[-limit:]
+        return out
+
+    def snapshot(self, **filters) -> dict:
+        """The /debug/decisions payload (and the explain CLI's input
+        shape)."""
+        return {
+            "service": self.service,
+            "capacity": self.capacity,
+            "dropped": self.dropped,
+            "records": self.query(**filters),
+        }
+
+
+# One per process, like the flight recorder: a daemon is one process.
+LEDGER = DecisionLedger()

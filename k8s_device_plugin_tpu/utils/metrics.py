@@ -311,6 +311,25 @@ FLIGHT_EVENTS = REGISTRY.counter(
     "(utils/flightrecorder.py; served at /debug/events, dumped on "
     "SIGTERM/circuit-break)",
 )
+DECISIONS = REGISTRY.counter(
+    "tpu_plugin_decisions_total",
+    "Scheduling/health decisions recorded by this daemon's decision "
+    "ledger (utils/decisions.py; served at /debug/decisions), by kind "
+    "and machine-readable reason token",
+)
+# Allocation SLO bucket bounds (seconds): sub-second immediate
+# admissions through multi-minute capacity waits.
+SLO_BUCKETS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+    600.0, 1800.0, 3600.0,
+)
+POD_TIME_TO_ALLOCATE = REGISTRY.histogram(
+    "tpu_pod_time_to_allocate_seconds",
+    "Admission-stamp to controller reconcile per pod: how long a "
+    "released pod took to be scheduled, allocated, and reconciled to "
+    "its real chips (exemplar-linked to the allocation trace)",
+    buckets=SLO_BUCKETS,
+)
 # The extender/gang-admission process exposes its own registry: sharing
 # the daemon's would publish every tpu_plugin_* family as constant zeros
 # from the extender Service, polluting sum()s and alerts across scrapes.
@@ -439,6 +458,25 @@ EXT_FLIGHT_EVENTS = EXTENDER_REGISTRY.counter(
     "Flight-recorder events captured, by kind "
     "(utils/flightrecorder.py; served at /debug/events)",
 )
+EXT_DECISIONS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_decisions_total",
+    "Scheduling decisions recorded by the extender/admitter decision "
+    "ledger (utils/decisions.py; served at /debug/decisions), by kind "
+    "and machine-readable reason token",
+)
+GANG_TIME_TO_ADMIT = EXTENDER_REGISTRY.histogram(
+    "tpu_gang_time_to_admit_seconds",
+    "How long a complete gang waited from its first admission "
+    "evaluation to its gates coming off (exemplar-linked to the "
+    "gang.admit trace root)",
+    buckets=SLO_BUCKETS,
+)
+GANG_PENDING_EVENTS = EXTENDER_REGISTRY.counter(
+    "tpu_gang_pending_events_total",
+    "Kube Events posted (or suppressed/failed) for gangs capacity-"
+    "waiting past the pending-event threshold, by outcome "
+    "(posted/suppressed/error)",
+)
 
 
 OPENMETRICS_CONTENT_TYPE = (
@@ -465,11 +503,14 @@ def debug_payload(path: str) -> Optional[bytes]:
     """JSON body for the /debug/* observability endpoints (shared by
     both HTTP servers): /debug/traces = the span collector's OTLP-JSON
     export (optionally ?trace_id=...), /debug/events = the flight
-    recorder ring. None for any other path."""
+    recorder ring, /debug/decisions = the decision ledger
+    (?pod=/?gang=/?node=/?kind=/?trace_id=/?limit= filtering). None
+    for any other path."""
     import json as _json
     import urllib.parse as _up
 
     from . import tracing
+    from .decisions import LEDGER
     from .flightrecorder import RECORDER
 
     parsed = _up.urlparse(path)
@@ -480,6 +521,20 @@ def debug_payload(path: str) -> Optional[bytes]:
         ).encode()
     if parsed.path == "/debug/events":
         return _json.dumps(RECORDER.snapshot()).encode()
+    if parsed.path == "/debug/decisions":
+        q = dict(_up.parse_qsl(parsed.query))
+        try:
+            limit = int(q.get("limit", "0"))
+        except ValueError:
+            limit = 0
+        return _json.dumps(LEDGER.snapshot(
+            pod=q.get("pod", ""),
+            gang=q.get("gang", ""),
+            node=q.get("node", ""),
+            kind=q.get("kind", ""),
+            trace_id=q.get("trace_id", ""),
+            limit=limit,
+        )).encode()
     return None
 
 

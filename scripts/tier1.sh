@@ -17,4 +17,10 @@ cd "$(dirname "$0")/.." || exit 1
 # and the renderer fails CI here, before the pytest gate.
 python -m k8s_device_plugin_tpu.tools.trace --self-test > /dev/null \
   || { echo "tools/trace.py --self-test FAILED"; exit 1; }
+# Decision-ledger tooling smoke: the explain CLI must render a
+# synthetic capacity-starved decision chain generated through the real
+# ledger + collector (tools/explain.py --self-test) — a drift between
+# the /debug/decisions snapshot shape and the renderer fails CI here.
+python -m k8s_device_plugin_tpu.tools.explain --self-test > /dev/null \
+  || { echo "tools/explain.py --self-test FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,703 @@
+"""Scheduling decision ledger, explain surface, and allocation SLO
+instrumentation — ISSUE 4.
+
+Covers the tentpole end to end: ledger ring semantics (overflow,
+query filters, retrace/tag_gang), the shared filter reason builder's
+object-vs-indexed parity, ledger-backed gang waiting-state markers
+(once per state CHANGE, pruned on in-place demand edits), pending-gang
+kube Events, the SLO histograms, /debug/decisions on both HTTP
+servers, the explain CLI, and the acceptance e2e through
+fake_apiserver + fake_kubelet: a capacity-starved gang's full decision
+chain — filter-reject → gang-waiting(shortfall) → admit →
+Allocate-substitution → reconcile — correlated by ONE trace id and
+rendered by tools/explain.py --pod.
+"""
+
+import dataclasses
+import json
+import time
+
+import pytest
+import requests
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.extender.gang import GangAdmission, _CapacityPool
+from k8s_device_plugin_tpu.extender.reservations import ReservationTable
+from k8s_device_plugin_tpu.extender.scale_bench import (
+    _StubClient,
+    _gang_pod,
+    _node,
+    _plain_pod,
+)
+from k8s_device_plugin_tpu.extender.server import (
+    NodeAnnotationCache,
+    TopologyExtender,
+)
+from k8s_device_plugin_tpu.topology.schema import NodeTopology
+from k8s_device_plugin_tpu.utils import metrics, tracing
+from k8s_device_plugin_tpu.utils.decisions import LEDGER, DecisionLedger
+from k8s_device_plugin_tpu.utils.flightrecorder import RECORDER
+
+NODE = "tpu-node-1"
+
+
+@pytest.fixture
+def ledger():
+    """The process singleton, bare-enabled (no metric binding) and
+    fully cleared after — the tier-1 suite shares one process."""
+    LEDGER.clear()
+    LEDGER.enabled = True
+    try:
+        yield LEDGER
+    finally:
+        LEDGER.disable()
+        LEDGER.clear()
+
+
+@pytest.fixture
+def traced():
+    collector = tracing.SpanCollector()
+    saved = tracing.COLLECTOR
+    tracing.COLLECTOR = collector
+    tracing.RECENT.clear()
+    tracing.enable(service="test")
+    try:
+        yield collector
+    finally:
+        tracing.disable()
+        tracing.COLLECTOR = saved
+        tracing.RECENT.clear()
+
+
+# -- ledger unit ------------------------------------------------------------
+
+def test_ledger_disabled_is_noop():
+    led = DecisionLedger(capacity=4)
+    led.record("filter_reject", "no_topology", "nope", node="n1")
+    assert len(led) == 0
+    assert led.snapshot()["records"] == []
+
+
+def test_ledger_ring_overflow_keeps_newest_and_flight_records():
+    RECORDER.clear()
+    RECORDER.enabled = True
+    try:
+        led = DecisionLedger(capacity=3)
+        led.enabled = True
+        for i in range(8):
+            led.record("filter", "ok", f"r{i}", pod=f"d/p{i}")
+        snap = led.snapshot()
+        assert len(snap["records"]) == 3
+        assert snap["dropped"] == 5
+        assert [r["message"] for r in snap["records"]] == ["r5", "r6", "r7"]
+        kinds = [e["kind"] for e in RECORDER.snapshot()["events"]]
+        # Throttled: the FIRST drop flight-records, not every drop.
+        assert kinds.count("decision_overflow") == 1
+    finally:
+        RECORDER.enabled = False
+        RECORDER.clear()
+
+
+def test_ledger_query_filters_and_limit(ledger):
+    ledger.record("filter_reject", "no_topology", "m", pod="ns/p1",
+                  gang="ns/g1", node="n1")
+    ledger.record("filter_reject", "insufficient_chips", "m", pod="ns/p2",
+                  node="n2")
+    ledger.record("gang_waiting", "capacity", "m", gang="ns/g1")
+    ledger.record("gang_admitted", "admitted", "m", gang="ns/g1")
+    # Bare-name and full-key matching for pod/gang; node/kind exact.
+    assert len(ledger.query(pod="p1")) == 1
+    assert len(ledger.query(pod="ns/p1")) == 1
+    assert len(ledger.query(gang="g1")) == 3
+    assert len(ledger.query(node="n2")) == 1
+    assert len(ledger.query(kind="gang_waiting")) == 1
+    assert ledger.query(pod="p999") == []
+    # limit keeps the NEWEST matches.
+    newest = ledger.query(gang="g1", limit=1)
+    assert [r["kind"] for r in newest] == ["gang_admitted"]
+
+
+def test_ledger_records_trace_context_retrace_and_tag_gang(
+    ledger, traced
+):
+    with tracing.span("plugin.Allocate", service="plugin") as sp:
+        ledger.record("allocate_substitution", "substituted", "m")
+        provisional = sp.trace_id
+    ledger.record("gang_waiting", "capacity", "m", gang="ns/g")  # no span
+    assert ledger.query(kind="allocate_substitution")[0][
+        "trace_id"
+    ] == provisional
+    assert "trace_id" not in ledger.query(kind="gang_waiting")[0]
+    # retrace: the controller-adoption join.
+    assert ledger.retrace(provisional, "ab" * 16) == 1
+    rec = ledger.query(kind="allocate_substitution")[0]
+    assert rec["trace_id"] == "ab" * 16
+    assert rec["attrs"]["retraced_from"] == provisional
+    # tag_gang: the admit-time retroactive stamp, untraced records only.
+    assert ledger.tag_gang("ns/g", "cd" * 16, "12" * 8) == 1
+    assert ledger.query(kind="gang_waiting")[0]["trace_id"] == "cd" * 16
+    assert ledger.query(kind="allocate_substitution")[0][
+        "trace_id"
+    ] == "ab" * 16  # already traced: untouched
+
+
+# -- shared reason builder parity (satellite) --------------------------------
+
+def _starve(node_obj: dict, keep: int) -> dict:
+    topo = NodeTopology.from_json(
+        node_obj["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION]
+    )
+    starved = dataclasses.replace(topo, available=topo.available[:keep])
+    return {
+        "metadata": {
+            "name": topo.hostname,
+            "annotations": {
+                constants.TOPOLOGY_ANNOTATION: starved.to_json()
+            },
+        }
+    }
+
+
+def test_reject_reasons_identical_on_object_and_indexed_paths(ledger):
+    """The factored reason builder (TopologyExtender._reject_reason)
+    is the ONE source for both paths: same failed-node messages back
+    to the scheduler AND same ledger reason tokens, across
+    no-topology, zero-availability, partial-availability, and
+    multi-host-infeasible candidates — with a reservation note mixed
+    in."""
+    nodes = [
+        _node("full-free"),
+        _starve(_node("starved"), keep=1),
+        _starve(_node("empty"), keep=0),
+        {"metadata": {"name": "no-topo"}},
+        _node("reserved-node"),
+    ]
+    names = [(n["metadata"] or {}).get("name", "") for n in nodes]
+    table = ReservationTable()
+    # Another gang's hold withholds 3 chips on reserved-node.
+    table.reserve(("default", "other-gang"), {"reserved-node": 3},
+                  demands=(3,))
+    for n_chips in (2, 8):  # single-host and multi-host request shapes
+        ext_obj = TopologyExtender(reservations=table)
+        cache = NodeAnnotationCache(_StubClient(nodes, []),
+                                    interval_s=3600)
+        cache.refresh()
+        ext_idx = TopologyExtender(reservations=table, node_cache=cache)
+        pod = _plain_pod(chips=n_chips)
+        LEDGER.clear()
+        passing_obj, failed_obj = ext_obj.filter(pod, nodes)
+        codes_obj = {
+            r["node"]: r["reason"]
+            for r in LEDGER.query(kind="filter_reject")
+        }
+        LEDGER.clear()
+        fast = ext_idx.filter_names(pod, names)
+        assert fast is not None
+        passing_idx, failed_idx = fast
+        codes_idx = {
+            r["node"]: r["reason"]
+            for r in LEDGER.query(kind="filter_reject")
+        }
+        assert failed_obj == failed_idx, f"messages drifted at n={n_chips}"
+        assert codes_obj == codes_idx, f"reason codes drifted at n={n_chips}"
+        assert [
+            (n["metadata"] or {}).get("name") for n in passing_obj
+        ] == passing_idx
+        if n_chips == 2:
+            assert codes_obj["empty"] == "insufficient_chips"
+            assert "reserved for a released gang" in failed_obj[
+                "reserved-node"
+            ]
+        assert codes_obj["no-topo"] == "no_topology"
+
+
+def test_prioritize_records_top_k_with_term_breakdown(ledger):
+    nodes = [_node(f"n{i}") for i in range(3)]
+    names = [f"n{i}" for i in range(3)]
+    cache = NodeAnnotationCache(_StubClient(nodes, []), interval_s=3600)
+    cache.refresh()
+    ext = TopologyExtender(
+        reservations=ReservationTable(), node_cache=cache
+    )
+    pod = _plain_pod(chips=2)
+    assert ext.prioritize_names(pod, names) is not None
+    (rec,) = LEDGER.query(kind="prioritize")
+    assert rec["attrs"]["candidates"] == "3"
+    assert rec["attrs"]["path"] == "indexed"
+    assert "=" in rec["attrs"]["top"]
+    assert "best_score" in rec["attrs"]
+    assert "best_term_links" in rec["attrs"]  # per-term breakdown
+    # Object path records the same kind.
+    ext.prioritize(pod, nodes)
+    assert any(
+        r["attrs"]["path"] == "object"
+        for r in LEDGER.query(kind="prioritize")
+    )
+
+
+def test_filter_reject_records_capped_per_rpc(ledger):
+    n = TopologyExtender._MAX_REJECT_RECORDS + 20
+    nodes = [{"metadata": {"name": f"bare-{i}"}} for i in range(n)]
+    ext = TopologyExtender(reservations=ReservationTable())
+    ext.filter(_plain_pod(chips=1), nodes)
+    assert len(LEDGER.query(kind="filter_reject")) == (
+        TopologyExtender._MAX_REJECT_RECORDS
+    )
+    (summary,) = LEDGER.query(kind="filter")
+    assert summary["reason"] == "all_rejected"
+    assert summary["attrs"]["rejects_truncated"] == "20"
+
+
+# -- gang waiting state (satellite) ------------------------------------------
+
+def _fits_diag(pool: _CapacityPool, demands):
+    assert pool.fits(demands) is None
+    return pool.last_reject
+
+
+def test_capacity_pool_diagnoses_single_host_shortfall():
+    topo = NodeTopology.from_json(
+        _node("n1")["metadata"]["annotations"][
+            constants.TOPOLOGY_ANNOTATION
+        ]
+    )
+    starved = dataclasses.replace(topo, available=topo.available[:1])
+    diag = _fits_diag(_CapacityPool([starved]), [2, 2])
+    assert diag["blocking"] == "single_host"
+    assert diag["best_free_chips"] == 1
+    assert diag["shortfall_chips"] == 1
+    # Multi-host demand with no slice at all.
+    diag = _fits_diag(_CapacityPool([topo]), [8])
+    assert diag["blocking"] == "no_matching_slice"
+
+
+def test_gang_waiting_record_once_per_state_and_on_demand_edit(ledger):
+    """The ledger-backed once-per-state markers: a waiting gang records
+    ONE gang_waiting decision until its state changes; an in-place
+    demand edit (same gang name) records the change and REPLACES the
+    marker instead of leaking a stale fingerprint."""
+    nodes = [_starve(_node("n1"), keep=1)]
+    pods = [_gang_pod(f"w{i}", "g", 2, 2) for i in range(2)]
+    adm = GangAdmission(
+        _StubClient(nodes, pods), reservations=ReservationTable()
+    )
+    assert adm.tick() == []
+    assert adm.tick() == []
+    waits = LEDGER.query(kind="gang_waiting")
+    assert len(waits) == 1  # once per state, not per resync
+    assert waits[0]["attrs"]["shortfall_chips"] == "1"
+    assert "short 1" in waits[0]["message"]
+    # Demand edited in place: new record, marker replaced (not leaked).
+    for p in pods:
+        p["spec"]["containers"][0]["resources"]["requests"][
+            constants.RESOURCE_NAME
+        ] = "3"
+        adm.note_pod_event(p)
+    assert adm.tick() == []
+    waits = LEDGER.query(kind="gang_waiting")
+    assert len(waits) == 2
+    assert len(adm._waiting_reported) == 1  # pruned in place
+    assert adm._waiting_reported[("default", "g")] == (3, 3)
+
+
+def test_gang_admitted_clears_waiting_and_observes_slo(ledger):
+    nodes = [_starve(_node("n1"), keep=1)]
+    pods = [_gang_pod(f"w{i}", "g", 2, 2) for i in range(2)]
+    client = _StubClient(nodes, pods)
+    adm = GangAdmission(client, reservations=ReservationTable())
+    before = metrics.GANG_TIME_TO_ADMIT.count()
+    assert adm.tick() == []
+    client.nodes[:] = [_node("n1")]  # capacity arrives
+    assert adm.tick() == [("default", "g")]
+    assert metrics.GANG_TIME_TO_ADMIT.count() == before + 1
+    (admit,) = LEDGER.query(kind="gang_admitted")
+    assert admit["attrs"]["hosts"] == "n1=4"
+    assert "waited_s" in admit["attrs"]
+    assert adm._waiting_reported == {}
+    assert adm._waiting_since == {}
+    # The release stamped the admission timestamp on the members (the
+    # tpu_pod_time_to_allocate_seconds origin): the ledger is on, so
+    # the stamp rides even with tracing off.
+    for p in pods:
+        assert constants.ADMIT_TS_ANNOTATION in p["metadata"][
+            "annotations"
+        ]
+
+
+def test_release_with_plane_off_makes_no_extra_patch():
+    """With tracing AND the ledger both off (the default), a release
+    must cost exactly the gate-removal patches — no admission-stamp
+    annotation patch per pod (the 'off is an exact no-op' contract)."""
+    assert not LEDGER.enabled and not tracing.enabled()
+    nodes = [_node("n1")]
+    pods = [_gang_pod(f"off-w{i}", "off-g", 2, 2) for i in range(2)]
+    client = _StubClient(nodes, pods)
+    patches = []
+    client.patch_pod_annotations = (
+        lambda ns, name, ann: patches.append((ns, name, ann))
+    )
+    adm = GangAdmission(client, reservations=ReservationTable())
+    assert adm.tick() == [("default", "off-g")]
+    assert patches == []
+    for p in pods:
+        assert constants.ADMIT_TS_ANNOTATION not in (
+            p["metadata"].get("annotations") or {}
+        )
+
+
+# -- pending-gang kube events -------------------------------------------------
+
+@pytest.fixture
+def api():
+    from k8s_device_plugin_tpu.kube.client import KubeClient
+    from tests.fake_apiserver import FakeApiServer
+
+    s = FakeApiServer()
+    url = s.start()
+    s.add_node(NODE)
+    yield s, KubeClient(url)
+    s.stop()
+
+
+def test_pending_gang_event_posted_deduped_and_budgeted(api, ledger):
+    server, client = api
+    server.add_node(NODE, _starve(_node(NODE), keep=1))
+    for i in range(2):
+        pod = _gang_pod(f"pend-w{i}", "pend", 2, 2)
+        pod["metadata"]["uid"] = f"uid-pend-{i}"
+        server.add_pod(pod)
+    adm = GangAdmission(
+        client,
+        reservations=ReservationTable(),
+        pending_event_threshold_s=0.01,
+        pending_event_repost_s=30.0,
+    )
+    RECORDER.clear()
+    RECORDER.enabled = True
+    try:
+        assert adm.tick() == []  # starts the wait clock; too young
+        assert not server.events
+        time.sleep(0.05)
+        assert adm.tick() == []  # past threshold: one event per member
+        assert len(server.events) == 2
+        ev = server.events[0]
+        assert ev["reason"] == "TPUGangPending"
+        assert ev["type"] == "Warning"
+        assert ev["involvedObject"]["kind"] == "Pod"
+        assert "waiting for TPU capacity" in ev["message"]
+        assert "short 1" in ev["message"]  # the shortfall, in describe
+        assert adm.tick() == []  # within repost window: deduped
+        assert len(server.events) == 2
+        kinds = [e["kind"] for e in RECORDER.snapshot()["events"]]
+        assert "slo_breach" in kinds
+        assert LEDGER.query(kind="slo_breach")
+    finally:
+        RECORDER.enabled = False
+        RECORDER.clear()
+
+
+# -- /debug/decisions ---------------------------------------------------------
+
+def test_debug_decisions_on_both_servers(ledger):
+    from k8s_device_plugin_tpu.extender.server import ExtenderHTTPServer
+
+    ledger.record("filter_reject", "no_topology", "m", pod="d/p1",
+                  node="n1")
+    ledger.record("gang_waiting", "capacity", "m", gang="d/g1")
+    for srv in (
+        metrics.MetricsServer(host="127.0.0.1"),
+        ExtenderHTTPServer(host="127.0.0.1"),
+    ):
+        url = srv.start()
+        try:
+            doc = requests.get(f"{url}/debug/decisions", timeout=5).json()
+            assert len(doc["records"]) == 2
+            assert doc["dropped"] == 0
+            by_pod = requests.get(
+                f"{url}/debug/decisions?pod=p1", timeout=5
+            ).json()
+            assert [r["kind"] for r in by_pod["records"]] == [
+                "filter_reject"
+            ]
+            by_kind = requests.get(
+                f"{url}/debug/decisions?kind=gang_waiting", timeout=5
+            ).json()
+            assert len(by_kind["records"]) == 1
+            limited = requests.get(
+                f"{url}/debug/decisions?limit=1", timeout=5
+            ).json()
+            assert len(limited["records"]) == 1
+            assert requests.get(
+                f"{url}/debug/decisions?node=nope", timeout=5
+            ).json()["records"] == []
+        finally:
+            srv.stop()
+
+
+# -- explain CLI --------------------------------------------------------------
+
+def test_explain_cli_self_test(capsys):
+    from k8s_device_plugin_tpu.tools import explain as explain_cli
+
+    assert explain_cli.main(["--self-test"]) == 0
+    out = capsys.readouterr().out
+    assert "gang_waiting" in out and "allocate_substitution" in out
+
+
+def test_explain_cli_node_and_gang_views(capsys, tmp_path, ledger):
+    from k8s_device_plugin_tpu.tools import explain as explain_cli
+
+    ledger.record("filter_reject", "insufficient_chips",
+                  "0 chips available, 2 needed", pod="d/p", node="n1")
+    ledger.record("filter_reject", "no_topology", "m", pod="d/q",
+                  node="n1")
+    ledger.record("gang_waiting", "capacity", "blocked", gang="d/g")
+    ledger.record("gang_admitted", "admitted", "fits", gang="d/g",
+                  waited_s=7.5)
+    path = tmp_path / "dec.json"
+    path.write_text(json.dumps(ledger.snapshot()))
+    assert explain_cli.main(["--node", "n1", "--decisions",
+                             str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "insufficient_chips×1" in out and "no_topology×1" in out
+    assert explain_cli.main(["--gang", "g", "--decisions",
+                             str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "admitted after 7.5s" in out
+    assert explain_cli.main(["--pod", "absent", "--decisions",
+                             str(path)]) == 1
+
+
+# -- the acceptance e2e -------------------------------------------------------
+
+def test_e2e_decision_chain_one_trace(api, ledger, traced, tmp_path):
+    """A capacity-starved gang's whole decision chain — gang-waiting
+    with the blocking shortfall, admission, the pod's filter
+    rejection, the plugin's Allocate substitution, and the reconcile —
+    lands in the ledger correlated by ONE trace id, the SLO histograms
+    observe both legs, and tools/explain.py --pod renders the chain."""
+    from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
+    from k8s_device_plugin_tpu.controller.controller import Controller
+    from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+    from k8s_device_plugin_tpu.server.plugin import (
+        PluginConfig,
+        TpuDevicePlugin,
+    )
+    from k8s_device_plugin_tpu.tools import explain as explain_cli
+    from k8s_device_plugin_tpu.topology.mesh import IciMesh
+    from tests import fakes
+    from tests.fake_kubelet import FakeKubelet, FakePodResources
+
+    server, client = api
+    full_node = _node(NODE)
+    server.add_node(NODE, _starve(full_node, keep=1))
+    pods = []
+    for i in range(2):
+        pod = _gang_pod(f"chain-w{i}", "chain-gang", 2, 2)
+        pod["metadata"]["uid"] = f"uid-chain-{i}"
+        server.add_pod(pod)
+        pods.append(pod)
+    table = ReservationTable()
+    adm = GangAdmission(client, reservations=table)
+
+    # 1) Starved: the gang waits, with the blocking shortfall recorded.
+    assert adm.tick() == []
+    (wait,) = LEDGER.query(kind="gang_waiting")
+    assert wait["attrs"]["shortfall_chips"] == "1"
+
+    # 2) Capacity arrives: admitted; the waiting record joins the
+    #    admission trace retroactively (tag_gang).
+    server.add_node(NODE, full_node)
+    before_admit = metrics.GANG_TIME_TO_ADMIT.count()
+    assert adm.tick() == [("default", "chain-gang")]
+    assert metrics.GANG_TIME_TO_ADMIT.count() == before_admit + 1
+    live = client.get_pod("default", "chain-w0")
+    carrier = tracing.extract(live)
+    assert carrier is not None
+    trace_id = carrier.trace_id
+    assert constants.ADMIT_TS_ANNOTATION in live["metadata"][
+        "annotations"
+    ]
+    assert LEDGER.query(kind="gang_waiting")[0]["trace_id"] == trace_id
+    assert LEDGER.query(kind="gang_admitted")[0]["trace_id"] == trace_id
+
+    # 3) The scheduler filters the released pod: a topology-less
+    #    candidate is rejected, recorded in the pod's trace.
+    ext = TopologyExtender(reservations=table)
+    passing, failed = ext.filter(
+        live, [server.nodes[NODE], {"metadata": {"name": "no-topo"}}]
+    )
+    assert [p["metadata"]["name"] for p in passing] == [NODE]
+    assert "no-topo" in failed
+    (reject,) = LEDGER.query(kind="filter_reject")
+    assert reject["trace_id"] == trace_id
+    assert reject["node"] == "no-topo"
+    assert ext.prioritize(live, [server.nodes[NODE]])
+
+    # 4) Kubelet Allocate on the real gRPC surface, substitution mode:
+    #    recorded under the provisional trace for now.
+    kubelet_dir = tmp_path / "dp"
+    kubelet_dir.mkdir()
+    kubelet = FakeKubelet(str(kubelet_dir))
+    kubelet.start()
+    podres = FakePodResources(str(tmp_path / "podres" / "kubelet.sock"))
+    podres.start()
+    plugin = None
+    try:
+        accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 4)
+        chips = PyTpuInfo().scan(accel, dev)
+        plugin = TpuDevicePlugin(
+            IciMesh(chips),
+            config=PluginConfig(
+                libtpu_host_path="",
+                device_plugin_dir=str(kubelet_dir),
+                substitute_on_allocate=True,
+            ),
+        )
+        plugin.serve()
+        assert kubelet.registered.wait(10)
+        stub = kubelet.plugin_stub()
+        kubelet_ids = [plugin.mesh.ids[0], plugin.mesh.ids[3]]
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=kubelet_ids)
+        stub.Allocate(req)
+        (sub,) = LEDGER.query(kind="allocate_substitution")
+        assert sub["trace_id"] != trace_id  # provisional until adopted
+
+        # 5) Bind + reconcile: the controller adopts the Allocate span
+        #    AND retraces its ledger records; the SLO leg is observed.
+        live["spec"]["nodeName"] = NODE
+        server.update_pod(live)
+        podres.set_pod(
+            "default", "chain-w0", constants.RESOURCE_NAME, kubelet_ids
+        )
+        controller = Controller(
+            client,
+            plugin,
+            node_name=NODE,
+            checkpoint_path=str(tmp_path / "no-checkpoint"),
+            podresources_socket=podres.socket_path,
+        )
+        before_alloc = metrics.POD_TIME_TO_ALLOCATE.count()
+        controller._handle_update(client.get_pod("default", "chain-w0"))
+        assert metrics.POD_TIME_TO_ALLOCATE.count() == before_alloc + 1
+        (sub,) = LEDGER.query(kind="allocate_substitution")
+        assert sub["trace_id"] == trace_id  # retraced at adoption
+        (rec,) = LEDGER.query(kind="reconcile")
+        assert rec["trace_id"] == trace_id
+        assert "time_to_allocate_s" in rec["attrs"]
+
+        # The whole chain correlates by the ONE trace id.
+        chain_kinds = {
+            r["kind"] for r in LEDGER.query(trace_id=trace_id)
+        }
+        assert {
+            "filter_reject", "filter", "prioritize", "gang_waiting",
+            "gang_admitted", "allocate_substitution", "reconcile",
+        } <= chain_kinds
+
+        # 6) The explain CLI renders the chain from the artifacts.
+        dec_path = tmp_path / "decisions.json"
+        dec_path.write_text(json.dumps(LEDGER.snapshot()))
+        tr_path = tmp_path / "traces.json"
+        tr_path.write_text(json.dumps(traced.otlp_json()))
+        assert explain_cli.main([
+            "--pod", "chain-w0",
+            "--decisions", str(dec_path),
+            "--traces", str(tr_path),
+        ]) == 0
+    finally:
+        if plugin is not None:
+            plugin.stop()
+        podres.stop()
+        kubelet.stop()
+
+
+def test_explain_renders_full_chain(capsys, ledger, traced, tmp_path):
+    """The rendered chain carries the rejection reason, the gang
+    shortfall, and the chosen chips — the acceptance rendering
+    contract, on a synthetic chain through the real ledger."""
+    from k8s_device_plugin_tpu.tools import explain as explain_cli
+
+    with tracing.span("gang.admit", service="extender") as root:
+        ctx = root.context
+        LEDGER.tag_gang("d/g", ctx.trace_id, ctx.span_id)
+    LEDGER.record("gang_waiting", "capacity",
+                  "insufficient TPU capacity for [2, 2]: blocking "
+                  "demand 2: best host has 1 free chip(s), short 1",
+                  gang="d/g", shortfall_chips=1)
+    LEDGER.tag_gang("d/g", ctx.trace_id, ctx.span_id)
+    with tracing.span("extender.filter", parent=ctx, service="extender"):
+        LEDGER.record("filter_reject", "no_topology",
+                      "no TPU topology published", pod="d/w0",
+                      gang="d/g", node="bad-node")
+    with tracing.span("plugin.Allocate", parent=ctx, service="plugin"):
+        LEDGER.record("allocate_substitution", "substituted",
+                      "kubelet requested ['c3'], topology chose ['c0']",
+                      requested="c3", assigned="c0")
+    dec = tmp_path / "d.json"
+    dec.write_text(json.dumps(LEDGER.snapshot()))
+    tr = tmp_path / "t.json"
+    tr.write_text(json.dumps(traced.otlp_json()))
+    assert explain_cli.main([
+        "--pod", "w0", "--decisions", str(dec), "--traces", str(tr),
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "no TPU topology published" in out  # rejection reason
+    assert "short 1" in out  # gang shortfall
+    assert "topology chose ['c0']" in out  # chosen chips
+    assert "gang.admit" in out  # correlated trace tree
+    assert out.count(ctx.trace_id[:16]) >= 3  # one trace id throughout
+
+
+# -- doc lockstep -------------------------------------------------------------
+
+def test_decisions_doc_in_lockstep_with_code():
+    """docs/observability.md must document every decision kind the
+    code records (grepped from LEDGER.record call sites), the
+    /debug/decisions endpoint, and the pending-runbook section in
+    docs/operations.md — a renamed kind must break this test, not
+    silently orphan the doc."""
+    import os
+    import re
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = open(os.path.join(repo, "docs", "observability.md")).read()
+    src = ""
+    pkg = os.path.join(repo, "k8s_device_plugin_tpu")
+    for root, _, files in os.walk(pkg):
+        for f in files:
+            if f.endswith(".py"):
+                src += open(os.path.join(root, f)).read()
+    kinds = set(re.findall(r'LEDGER\.record\(\s*\n?\s*"([a-z_]+)"', src))
+    assert kinds, "decision-kind grep found nothing (pattern drift?)"
+    missing = {k for k in kinds if f"`{k}`" not in doc}
+    assert not missing, (
+        f"decision kinds used in code but absent from "
+        f"docs/observability.md: {sorted(missing)}"
+    )
+    assert "/debug/decisions" in doc
+    assert constants.ADMIT_TS_ANNOTATION in doc
+    ops = open(os.path.join(repo, "docs", "operations.md")).read()
+    assert "Why is my pod pending?" in ops
+    assert "tools.explain" in ops or "tools/explain" in ops
+
+
+# -- bench probe (satellite) --------------------------------------------------
+
+def test_ledger_overhead_probe_schema_and_restore():
+    """The bench's ledger-overhead probe at toy scale: both arms
+    measured, records collected only in the enabled arm, and the
+    process ledger fully disabled and cleared afterwards (the tier-1
+    suite shares one process)."""
+    from k8s_device_plugin_tpu.extender import scale_bench
+
+    r = scale_bench.ledger_overhead(n_nodes=30, filter_calls=4)
+    assert r["nodes"] == 30
+    assert r["disabled"]["filter"]["samples"] == 4
+    assert r["enabled"]["filter"]["samples"] == 4
+    # One filter summary + one prioritize record per enabled call.
+    assert r["records_collected"] == 8
+    assert "filter_p99_overhead_pct" in r
+    assert not LEDGER.enabled
+    assert len(LEDGER) == 0

@@ -141,9 +141,10 @@ def test_recent_memo_ttl_bounds_a_trace_to_one_cycle(traced):
     assert memo.recall("ns/pod") is None
 
 
-def test_stamp_trace_survives_null_annotations(traced):
+def test_stamp_release_survives_null_annotations(traced):
     """An explicit 'annotations': null on a member must not abort the
-    release (the stamp is documented best-effort)."""
+    release (the stamp is documented best-effort). Exercises the REAL
+    release-stamp path (_stamp_release: admit timestamp + carrier)."""
     from k8s_device_plugin_tpu.extender.gang import GangAdmission
 
     class _NoPatchClient:
@@ -154,11 +155,12 @@ def test_stamp_trace_survives_null_annotations(traced):
     adm.client = _NoPatchClient()
     pod = {"metadata": {"namespace": "d", "name": "p", "annotations": None}}
     ctx = tracing.SpanContext("ab" * 16, "cd" * 8)
-    adm._stamp_trace([pod], ctx)  # must not raise
-    assert (
-        pod["metadata"]["annotations"][constants.TRACE_ANNOTATION]
-        == tracing.format_traceparent(ctx)
+    adm._stamp_release([pod], ctx)  # must not raise
+    ann = pod["metadata"]["annotations"]
+    assert ann[constants.TRACE_ANNOTATION] == tracing.format_traceparent(
+        ctx
     )
+    assert constants.ADMIT_TS_ANNOTATION in ann
 
 
 # -- collector ----------------------------------------------------------------
