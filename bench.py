@@ -61,6 +61,9 @@ more complete):
                                nodes / 500 gangs (sublinear proof);
                                detail.control_plane_scale_1000 is the
                                1,000/100 continuity run
+  detail.journal_overhead      journaled vs unjournaled admission-tick
+                               p50/p99 (crash-consistent gang state;
+                               bound: journaled p99 <= 1.1x)
   detail.grant     every chip-grant probe attempt
   detail.workload.mfu   model FLOPs/step ÷ step time ÷ chip peak bf16
   detail.workload_chunked_xent.vs_plain_step   chunked-vocab CE A/B
@@ -776,6 +779,18 @@ def main() -> int:
             )
         except Exception as e:  # noqa: BLE001
             result["detail"]["ledger_overhead"] = {"error": repr(e)[:400]}
+        emit()
+        # Phase 1.8: admission-journal overhead probe (ISSUE 6 — the
+        # write-ahead journal behind crash-consistent gang state must
+        # keep the journaled admission-tick p99 within 1.1x of the
+        # unjournaled path; same dirty-tick workload as
+        # control_plane_scale's gang_tick_dirty).
+        try:
+            result["detail"]["journal_overhead"] = (
+                scale_bench.journal_overhead(n_nodes=1000, n_gangs=100)
+            )
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["journal_overhead"] = {"error": repr(e)[:400]}
         emit()
 
         # Phase 2a: harvest the t=0 probe loop (VERDICT r3 #1a /

@@ -95,6 +95,25 @@ def main() -> int:
         "--trace; off = exact no-op",
     )
     p.add_argument(
+        "--journal-dir", default=os.environ.get("TPU_JOURNAL_DIR", ""),
+        help="directory for the crash-safe admission-state journal "
+        "(extender/journal.py): gang reservations, lapse bars, and "
+        "wait clocks survive a SIGKILL/restart, and /filter+/"
+        "prioritize stay not-ready (/readyz 503) until the journal is "
+        "replayed and reconciled. Empty (the default) keeps admission "
+        "state in-memory only — a restart degrades to cluster-truth "
+        "rebuild",
+    )
+    p.add_argument(
+        "--journal-fsync", action="store_true",
+        help="fsync EVERY journal record for machine-crash durability "
+        "(~1 ms/record). Default: decision-critical reserve/admit/"
+        "lapse records are flushed to the OS before the daemon acts "
+        "on them — durable against process death, the designed "
+        "threat — and the rest batch until the end-of-tick flush; "
+        "see docs/operations.md",
+    )
+    p.add_argument(
         "--gang-pending-event-s", type=float, default=300.0,
         help="post a kube Event (kubectl describe pod) on gangs "
         "capacity-waiting longer than this many seconds (budgeted + "
@@ -206,6 +225,11 @@ def main() -> int:
                 e,
             )
             return 1
+    # Readiness gate: with a journal configured, /filter+/prioritize
+    # (and /readyz) answer 503 until the admission state is replayed
+    # and reconciled below — the scheduler must not score nodes
+    # against a capacity view missing the crashed incarnation's holds.
+    ready = threading.Event()
     srv = ExtenderHTTPServer(
         extender=TopologyExtender(
             reservations=reservations, node_cache=node_cache
@@ -213,6 +237,7 @@ def main() -> int:
         host=a.host,
         port=a.port,
         identity=leader.identity if leader else "",
+        ready_check=ready.is_set,
     )
     srv.start()
     gang = None
@@ -234,6 +259,13 @@ def main() -> int:
                     raise RuntimeError("node cache never synced")
                 return cache.index.topologies()
 
+        journal = None
+        if a.journal_dir:
+            from .journal import AdmissionJournal
+
+            journal = AdmissionJournal(
+                a.journal_dir, fsync_always=a.journal_fsync
+            )
         gang = GangAdmission(
             client,
             resync_interval_s=a.gang_resync_s,
@@ -242,6 +274,7 @@ def main() -> int:
             topo_source=topo_source,
             watch=not a.no_gang_watch,
             pending_event_threshold_s=a.gang_pending_event_s,
+            journal=journal,
         )
         if node_cache is not None:
             # … and its node-change events mark exactly the affected
@@ -249,7 +282,14 @@ def main() -> int:
             node_cache.index.on_change = (
                 lambda name, slice_keys: gang.note_node_event(slice_keys)
             )
+        # Rehydrate BEFORE serving scheduler RPCs or ticking: the
+        # singleton lease is already held (leadership precedes replay —
+        # the journal has one writer), and recover() never raises (an
+        # empty/absent/corrupt journal degrades to the cluster-truth
+        # rebuild the unjournaled daemon always did).
+        gang.recover()
         gang.start()
+    ready.set()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()

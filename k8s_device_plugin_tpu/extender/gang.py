@@ -53,6 +53,7 @@ from ..utils.decisions import LEDGER
 from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
 from ..utils.podresources import tpu_request
+from .journal import AdmissionJournal, Hold
 from .reservations import DEFAULT_TABLE, ReservationTable
 
 log = get_logger(__name__)
@@ -357,6 +358,7 @@ class GangAdmission:
         pending_event_threshold_s: float = 300.0,
         pending_event_repost_s: float = 600.0,
         pending_event_budget: int = 10,
+        journal: Optional[AdmissionJournal] = None,
     ):
         self.client = client
         self.resource_name = resource_name
@@ -383,6 +385,14 @@ class GangAdmission:
         self.reservations = (
             DEFAULT_TABLE if reservations is None else reservations
         )
+        # Write-ahead journal (extender/journal.py): every reservation
+        # transition (via the table's observer tap) plus the admit/wait
+        # records this controller writes directly. None = the pre-PR-6
+        # in-memory-only behavior (restart degrades to cluster-truth
+        # rebuild).
+        self.journal = journal
+        if journal is not None:
+            self.reservations.observer = journal.observe
         # Holds are renewed once per tick, so they must outlive several
         # resyncs — with a long --gang-resync-s a 60s TTL would expire
         # between renewals and silently reopen the steal window. The
@@ -483,6 +493,165 @@ class GangAdmission:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self.journal is not None:
+            # Graceful teardown folds state into one clean snapshot so
+            # the successor's replay is O(holds), not O(journal). The
+            # callable form captures the covered seq before the build
+            # (a /filter-thread prune may still be journaling).
+            self.journal.compact(self._journal_state)
+            self.journal.close()
+
+    # -- crash recovery ----------------------------------------------------
+
+    def _journal_state(self) -> dict:
+        """The compaction snapshot: the live table's holds (with true
+        ages), this controller's lapse bars, and the wait-episode
+        origins — everything replay() rebuilds."""
+        now = time.time()
+        holds = {
+            k: Hold(
+                hosts=st["hosts"],
+                demands=tuple(st["demands"]),
+                counted_pods=set(st["counted"]),
+                created_ts=now - st["age_s"],
+            )
+            for k, st in self.reservations.export_state().items()
+        }
+        return AdmissionJournal.state_data(
+            holds, set(self._lapsed_gangs), dict(self._waiting_since)
+        )
+
+    def recover(self) -> dict:
+        """Cold-start rehydration: replay the journal, reconcile it
+        against cluster truth, and re-install what survives — run
+        BEFORE start()/the first tick, behind the extender's readiness
+        gate (server.py refuses /filter+/prioritize until this
+        returns). Idempotent by construction: restored holds keep
+        their ORIGINAL age (the hard cap survives the crash), lapse
+        bars are restored so _maybe_refence never resurrects a lapsed
+        hold, and a half-released gang (killed between reserving and
+        the gate patches) resumes through the first tick's existing
+        release_retry / finish_partial_release paths. Never raises:
+        with no journal (or an unreadable one) recovery degrades to
+        the pre-PR-6 cluster-truth rebuild."""
+        if self.journal is None:
+            return {"status": "disabled"}
+        t0 = time.monotonic()
+        state = self.journal.replay()
+        now = time.time()
+        # Cluster truth, best-effort: an apiserver outage at startup
+        # must not block recovery — holds restore from the journal
+        # alone (conservative: they fence chips the upkeep will
+        # reconcile once the API answers).
+        gangs: Dict[Tuple[str, str], GangView] = {}
+        truth = False
+        keys = set(state.holds) | state.lapsed | set(state.waiting_since)
+        try:
+            if keys:
+                gangs = self._collect_gangs(set(keys))
+            truth = True
+        except Exception as e:  # noqa: BLE001 — degrade, don't block
+            log.warning(
+                "recovery could not list gang pods (%s); restoring "
+                "journal state without cluster reconciliation", e,
+            )
+        restored = dropped = lapsed_now = 0
+        for key, hold in sorted(state.holds.items()):
+            if truth and key not in gangs:
+                # Gang vanished while we were dead: nothing to fence.
+                self.journal.record("drop", key)
+                dropped += 1
+                continue
+            if not hold.hosts:
+                # Fully consumed (every host shrank to zero) but not
+                # yet pruned when the snapshot was cut: a plain drop —
+                # restore() would also refuse it, and falling through
+                # to the lapse branch would bar a gang that never
+                # lapsed from legitimate re-fencing.
+                self.journal.record("drop", key)
+                dropped += 1
+                continue
+            if not self.reservations.restore(
+                key,
+                hold.hosts,
+                age_s=hold.age_s(now),
+                demands=tuple(hold.demands),
+                counted_pods=hold.counted_pods,
+            ):
+                # Aged past the hard cap while we were dead: it lapses
+                # NOW — and stays lapsed (the bar below), never
+                # re-fenced with a reset age.
+                self._lapsed_gangs.add(key)
+                self.journal.record("lapse", key)
+                lapsed_now += 1
+                continue
+            restored += 1
+            self.mark_dirty(key, source="recovery")
+        # Lapse bars survive the crash verbatim (minus vanished gangs).
+        self._lapsed_gangs |= {
+            k for k in state.lapsed if not truth or k in gangs
+        }
+        # Wait-episode origins: the SLO clock and the pending-Event
+        # threshold keep counting from the TRUE start of the wait.
+        for key, since in state.waiting_since.items():
+            if truth and key not in gangs:
+                continue
+            self._waiting_since.setdefault(key, since)
+            self._first_complete.setdefault(
+                key, time.monotonic() - max(0.0, now - since)
+            )
+        # The first loop tick sweeps fully — whatever the journal
+        # missed, cluster truth catches within one resync.
+        self.mark_all_dirty()
+        # Fold the reconciled state into a fresh snapshot immediately:
+        # bounds replay work across a crash LOOP (each incarnation
+        # starts from a compact baseline, not an ever-longer journal).
+        self.journal.compact(self._journal_state)
+        took = round(time.monotonic() - t0, 3)
+        summary = {
+            "status": state.status,
+            "records": state.records,
+            "journal_dropped": state.dropped,
+            "holds_restored": restored,
+            "holds_dropped": dropped,
+            "holds_lapsed_on_restore": lapsed_now,
+            "lapse_bars": len(self._lapsed_gangs),
+            "waits_restored": len(state.waiting_since),
+            "cluster_truth": truth,
+            "took_s": took,
+        }
+        RECORDER.record(
+            "journal_replay",
+            f"admission journal replayed: {state.records} record(s), "
+            f"{state.status}",
+            **{k: v for k, v in summary.items() if k != "took_s"},
+        )
+        RECORDER.record(
+            "rehydrate",
+            f"admission state rehydrated: {restored} hold(s) restored, "
+            f"{lapsed_now} lapsed on restore, "
+            f"{len(self._lapsed_gangs)} lapse bar(s)",
+            holds=restored,
+            lapsed=lapsed_now,
+            cluster_truth=truth,
+        )
+        LEDGER.record(
+            "journal_replay", state.status,
+            f"replayed {state.records} journal record(s) in {took}s "
+            f"({state.dropped} dropped)",
+            records=state.records, dropped=state.dropped,
+        )
+        LEDGER.record(
+            "rehydrate",
+            "ok" if truth else "no_cluster_truth",
+            f"restored {restored} hold(s), {dropped} dropped for "
+            f"vanished gangs, {lapsed_now} lapsed at the cap, "
+            f"{len(self._lapsed_gangs)} lapse bar(s) standing",
+            **{k: v for k, v in summary.items()
+               if k not in ("status", "took_s")},
+        )
+        log.info("admission state recovered: %s", summary)
+        return summary
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -599,7 +768,11 @@ class GangAdmission:
         helper on purpose: an exit path that forgot one of these would
         leak a stale SLO origin into a same-named successor gang."""
         self._waiting_reported.pop(key, None)
-        self._waiting_since.pop(key, None)
+        if (
+            self._waiting_since.pop(key, None) is not None
+            and self.journal is not None
+        ):
+            self.journal.record("wait_clear", key)
         self._pending_evented.pop(key, None)
         self._breach_recorded.discard(key)
         self._first_complete.pop(key, None)
@@ -880,6 +1053,16 @@ class GangAdmission:
             with self._dirty_lock:
                 self._dirty |= dirty
             raise
+        finally:
+            if self.journal is not None:
+                # Off the decision path, once per tick — on EVERY exit
+                # (the idle/no-gangs early returns journal drops and
+                # wait_clears too, and "at most one tick's records at
+                # risk" must hold for them as well): push this tick's
+                # buffered records to the OS, then fold the journal
+                # into a snapshot when enough piled up.
+                self.journal.flush()
+                self.journal.maybe_compact(self._journal_state)
 
     def _tick_inner(
         self, full: bool, dirty: Set[Tuple[str, str]]
@@ -900,7 +1083,7 @@ class GangAdmission:
                 return []
             gangs = self._collect_gangs(requested)
         self._event_budget_left = self.pending_event_budget
-        self._reservation_upkeep(gangs)
+        self._reservation_upkeep(gangs, full)
         # Prune the waiting markers of gangs that vanished — the maps
         # must not grow without bound. A dirty tick only saw
         # ``requested``, so it may only prune those; in-place demand
@@ -922,6 +1105,11 @@ class GangAdmission:
             for key in vanished:
                 self._clear_wait_state(key)
                 self._clear_waiting(key)
+                # A vanished gang's lapse bar is moot (nothing left to
+                # re-fence) — dropping it here, for exactly the gangs
+                # this tick observed absent, is what lets upkeep's
+                # full-sweep intersection stay full-sweep-only.
+                self._lapsed_gangs.discard(key)
         if not gangs:
             metrics.GANG_WAITING.set(len(self._waiting_gangs))
             return []
@@ -1087,7 +1275,17 @@ class GangAdmission:
                     # edited in place): one decision record + flight
                     # event + log line per state, not per resync.
                     self._waiting_reported[key] = dtuple
-                    self._waiting_since.setdefault(key, time.time())
+                    if key not in self._waiting_since:
+                        self._waiting_since[key] = time.time()
+                        if self.journal is not None:
+                            # The wait episode's origin survives a
+                            # restart: the SLO clock and the pending-
+                            # Event threshold keep counting from the
+                            # TRUE start, not from the recovery.
+                            self.journal.record(
+                                "wait", key,
+                                since=self._waiting_since[key],
+                            )
                     LEDGER.record(
                         "gang_waiting", "capacity",
                         f"insufficient TPU capacity for {demands}: "
@@ -1133,6 +1331,16 @@ class GangAdmission:
             # it clears any lapse bar a previous same-named generation
             # left behind (the new hold ages from now, legitimately).
             self._lapsed_gangs.discard(key)
+            if self.journal is not None:
+                # Durable BEFORE the first gate patch (fsync'd op): a
+                # crash anywhere in the release below rehydrates the
+                # hold + this marker, and the next tick's release_retry
+                # path finishes the gates idempotently — never a
+                # double-booked chip, never a gateless-unfenced gang.
+                self.journal.record(
+                    "admit", key,
+                    hosts=consumed_hosts, demands=sorted(demands),
+                )
             self._traced_release(
                 key, gated, reason="admitted", demands=demands,
                 consumed=consumed_hosts, waited_s=waited_s,
@@ -1218,7 +1426,7 @@ class GangAdmission:
         )
 
     def _reservation_upkeep(
-        self, gangs: Dict[Tuple[str, str], GangView]
+        self, gangs: Dict[Tuple[str, str], GangView], full: bool = True
     ) -> None:
         """Shrink/renew/drop active reservations against live pod state:
         a scheduled member's chips leave its gang's hold (the daemon's
@@ -1246,7 +1454,11 @@ class GangAdmission:
             if unscheduled == 0 and len(gv.live) >= gv.size:
                 self.reservations.drop(key)
                 self._lapsed_gangs.discard(key)
-            elif not self.reservations.renew(key):
+            elif not self.reservations.renew(
+                # Skip the no-op extension (and its journal record)
+                # while the expiry has several resyncs of runway.
+                key, skip_if_remaining_s=3.0 * self.resync_interval_s
+            ):
                 self.reservations.lapse(key)
                 log.warning(
                     "gang %s/%s: reservation lapsed at the age cap with "
@@ -1259,7 +1471,14 @@ class GangAdmission:
         # lapse() branch; every lapsed gang observed this pass is barred
         # from re-fencing before tick() evaluates it.
         self._lapsed_gangs |= self.reservations.drain_lapsed()
-        self._lapsed_gangs &= set(gangs)  # bounded by live gangs
+        if full:
+            # Bounded by live gangs — but only a FULL sweep saw every
+            # gang: intersecting against a dirty tick's subset would
+            # erase the lapse bar of any gang outside it, and the next
+            # sweep would re-fence a lapsed hold with a reset age
+            # (exactly the amnesia the bar exists to prevent). Dirty
+            # ticks prune per-vanished-gang in _tick_inner instead.
+            self._lapsed_gangs &= set(gangs)
 
 
     def explain(self) -> List[dict]:

@@ -1,0 +1,397 @@
+"""Write-ahead journal for gang-admission state: what the extender must
+not forget when it dies.
+
+The admission daemon's only record of in-flight placement is process
+memory: the ReservationTable's holds (reservations.py), the lapse bars
+(gang.py "Never re-fence a LAPSED hold"), and each gang's wait clocks.
+A SIGKILL between reserving and the last gate-removal patch used to
+lose all three — the restarted daemon could double-book the chips a
+half-released gang was counting on, or resurrect a lapsed hold with a
+reset age and void the hard cap (the lapsed-hold amnesia bug,
+gang.py:1216 pre-PR-6). This module journals every state transition to
+a crash-safe store (utils/statestore.py: checksummed append-only
+records, atomic tmp+fsync+rename snapshot compaction, torn-tail
+tolerance — the kubelet device-manager checkpoint shape) and rebuilds
+the state on startup:
+
+* **record vocabulary** — ``reserve`` / ``shrink`` / ``renew`` /
+  ``drop`` / ``lapse`` mirror the ReservationTable's mutations
+  one-for-one (the table's ``observer`` hook emits them, so even a
+  lapse inside a /filter-thread prune is captured); ``admit`` marks
+  the all-or-nothing release decision (written durably BEFORE the
+  first gate patch); ``wait`` / ``wait_clear`` track each gang's
+  capacity-wait episode so the SLO origin and the pending-Event dedup
+  clock survive a restart.
+* **replay** (:meth:`AdmissionJournal.replay`) folds snapshot +
+  journal into a :class:`RehydratedState`; ``renew`` replays as a
+  no-op (expiry is process-local — a rehydrated hold gets a fresh TTL
+  but keeps its ORIGINAL age, so the hard cap still counts from the
+  pre-crash reserve).
+* **recovery** is wired in gang.py (``GangAdmission.recover``): replay,
+  reconcile against cluster truth, re-install holds with their true
+  ages, restore the lapse bars, and let the first tick's existing
+  idempotent paths (release_retry / finish_partial_release / upkeep)
+  finish whatever the crash interrupted. The extender refuses
+  /filter + /prioritize behind the readiness gate until this completes
+  (server.py, deploy/tpu-extender.yml /readyz).
+
+Durability model: the decision-critical ``reserve`` / ``admit`` /
+``lapse`` records are flushed to the OS before the call returns —
+immune to process death (SIGKILL, OOM, liveness kill, the designed
+threat) — while the rest batch until the end-of-tick flush (their loss
+is conservative). fsync (machine-crash durability) is the opt-in
+``fsync_always`` / ``--journal-fsync`` mode; see the runbook in
+docs/operations.md for the trade-off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..utils import metrics, statestore
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+GangKey = Tuple[str, str]
+
+# Ops whose loss could double-book chips or void the age cap: pushed
+# to the OS immediately (durable against process death — the designed
+# threat — the moment record() returns; an fsync on top, for machine-
+# crash durability, is the opt-in ``fsync_always`` mode: measured at
+# ~1 ms per fsync it alone would breach the 1.1x tick-overhead bound,
+# and a machine crash usually takes the journal volume with it anyway).
+CRITICAL_OPS = frozenset({"reserve", "admit", "lapse"})
+
+# One snapshot compaction per this many journal records keeps replay
+# bounded and the file small across renew-heavy steady states.
+DEFAULT_COMPACT_EVERY = 4096
+
+
+@dataclasses.dataclass
+class Hold:
+    hosts: Dict[str, int]
+    demands: Tuple[int, ...]
+    counted_pods: Set[str]
+    created_ts: float  # wall clock of the original reserve
+
+    def age_s(self, now: Optional[float] = None) -> float:
+        return max(0.0, (now or time.time()) - self.created_ts)
+
+
+@dataclasses.dataclass
+class RehydratedState:
+    holds: Dict[GangKey, Hold]
+    lapsed: Set[GangKey]
+    waiting_since: Dict[GangKey, float]  # wall-clock wait-episode starts
+    status: str  # statestore load status
+    records: int  # journal records applied (past the snapshot)
+    dropped: int  # torn/corrupt journal lines discarded
+
+
+class AdmissionJournal:
+    """The admission daemon's write-ahead journal + replay."""
+
+    def __init__(
+        self,
+        dir_path: str,
+        fsync_always: bool = False,
+        compact_every: int = DEFAULT_COMPACT_EVERY,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.store = statestore.StateStore(
+            dir_path, name="admission", fsync_always=fsync_always
+        )
+        self.compact_every = compact_every
+        self._clock = clock
+
+    # -- write plane -------------------------------------------------------
+
+    def record(self, op: str, gang: GangKey, **data) -> None:
+        """Append one transition. Never raises: a full/broken disk must
+        degrade journaling (logged + counted), not take down admission
+        — the in-memory state is still correct, and the next restart
+        falls back to cluster-truth rebuild exactly as the unjournaled
+        daemon always did."""
+        rec = {
+            "op": op,
+            "ts": round(self._clock(), 3),
+            "g": [gang[0], gang[1]],
+            **data,
+        }
+        try:
+            # Critical ops reach the OS before record() returns;
+            # everything else stays buffered until flush() (once per
+            # admission tick — gang.py): losing a buffered record to a
+            # crash is conservative (replay over-fences; reconciliation
+            # shrinks it back), and the batching is what keeps the
+            # journaled tick inside the 1.1x overhead bound
+            # (scale_bench journal_overhead). fsync is governed by the
+            # store's fsync_always mode.
+            self.store.append(rec, flush=op in CRITICAL_OPS)
+        except OSError as e:
+            metrics.STATE_JOURNAL_RECORDS.inc(op="error")
+            log.warning("journal append (%s) failed: %s", op, e)
+            return
+        # The bytes gauge is refreshed at flush/compact time, not here:
+        # a stat() per record would dominate the append itself.
+        metrics.STATE_JOURNAL_RECORDS.inc(op=op)
+
+    def observe(self, op: str, gang: GangKey, payload: dict) -> None:
+        """ReservationTable observer adapter (reservations.py calls it
+        for every mutation, including lapses inside routine prunes)."""
+        self.record(op, gang, **payload)
+
+    def flush(self) -> None:
+        """Push buffered non-critical records to the OS (end of each
+        admission tick): at most one tick's renewals/shrinks are ever
+        at risk to a SIGKILL, and their loss is conservative."""
+        self.store.flush()
+        metrics.STATE_JOURNAL_BYTES.set(self.store.size_bytes())
+
+    def maybe_compact(self, state_data_fn: Callable[[], dict]) -> bool:
+        """Fold the journal into a snapshot once enough records piled
+        up. ``state_data_fn`` supplies the owner's COMPLETE current
+        state lazily (building it costs a table walk — only pay on an
+        actual compaction). Never raises."""
+        if self.store.records_since_compact < self.compact_every:
+            return False
+        return self.compact(state_data_fn)
+
+    def compact(self, state_data) -> bool:
+        """``state_data``: the state document, or (preferred when other
+        threads can mutate the table — the /filter prune path) a
+        zero-arg callable building it. With the callable form the
+        covered seq is captured BEFORE the build, so a record racing
+        the capture survives compaction in the fresh journal instead
+        of being truncated away while also missing from the
+        snapshot."""
+        try:
+            if callable(state_data):
+                seq = self.store.current_seq()
+                self.store.compact(state_data(), seq=seq)
+            else:
+                self.store.compact(state_data)
+        except OSError as e:
+            metrics.STATE_COMPACTIONS.inc(outcome="error")
+            log.warning("journal compaction failed: %s", e)
+            return False
+        metrics.STATE_COMPACTIONS.inc(outcome="ok")
+        metrics.STATE_JOURNAL_BYTES.set(self.store.size_bytes())
+        return True
+
+    def close(self) -> None:
+        self.store.close()
+
+    # -- replay ------------------------------------------------------------
+
+    def replay(self) -> RehydratedState:
+        """Rebuild admission state from snapshot + journal. Tolerates
+        any damage (statestore never raises on bad bytes): a torn tail
+        keeps the durable prefix, a corrupt record stops replay there —
+        recovery then degrades toward cluster-truth rebuild, never
+        trusts a broken record, never crashes."""
+        t0 = time.perf_counter()
+        loaded = self.store.load()
+        holds: Dict[GangKey, Hold] = {}
+        lapsed: Set[GangKey] = set()
+        waiting: Dict[GangKey, float] = {}
+        if loaded.snapshot:
+            snap = loaded.snapshot
+            for h in snap.get("holds", []):
+                key = (h.get("ns", ""), h.get("gang", ""))
+                holds[key] = Hold(
+                    hosts={
+                        str(k): int(v)
+                        for k, v in (h.get("hosts") or {}).items()
+                    },
+                    demands=tuple(h.get("demands") or ()),
+                    counted_pods=set(h.get("counted") or ()),
+                    created_ts=float(h.get("created", 0.0)),
+                )
+            lapsed = {tuple(k) for k in snap.get("lapsed", [])}
+            waiting = {
+                (w[0], w[1]): float(w[2])
+                for w in snap.get("waiting", [])
+            }
+        applied = 0
+        for rec in loaded.records:
+            self._apply(rec, holds, lapsed, waiting)
+            applied += 1
+        dt = time.perf_counter() - t0
+        metrics.STATE_REPLAY_SECONDS.set(round(dt, 6))
+        metrics.STATE_REHYDRATIONS.inc(outcome=loaded.status)
+        return RehydratedState(
+            holds=holds,
+            lapsed=lapsed,
+            waiting_since=waiting,
+            status=loaded.status,
+            records=applied,
+            dropped=loaded.dropped,
+        )
+
+    @staticmethod
+    def _apply(
+        rec: dict,
+        holds: Dict[GangKey, Hold],
+        lapsed: Set[GangKey],
+        waiting: Dict[GangKey, float],
+    ) -> None:
+        g = rec.get("g") or ["", ""]
+        key: GangKey = (str(g[0]), str(g[1]))
+        op = rec.get("op", "")
+        if op == "reserve":
+            # A fresh reserve is a fresh all-or-nothing decision: it
+            # legitimately clears a predecessor's lapse bar (mirrors
+            # tick()'s _lapsed_gangs.discard after reserve). A restart
+            # RE-fence journals its preserved age instead.
+            holds[key] = Hold(
+                hosts={
+                    str(k): int(v)
+                    for k, v in (rec.get("hosts") or {}).items()
+                },
+                demands=tuple(rec.get("demands") or ()),
+                counted_pods=set(rec.get("counted") or ()),
+                created_ts=float(rec.get("ts", 0.0))
+                - float(rec.get("age_s", 0.0)),
+            )
+            lapsed.discard(key)
+        elif op == "shrink":
+            h = holds.get(key)
+            pod = rec.get("pod", "")
+            if h is None or pod in h.counted_pods:
+                return
+            h.counted_pods.add(pod)
+            host = rec.get("host", "")
+            if host in h.hosts:
+                h.hosts[host] = max(
+                    0, h.hosts[host] - int(rec.get("chips", 0))
+                )
+                if h.hosts[host] == 0:
+                    del h.hosts[host]
+            if not h.hosts:
+                # Fully consumed: the live table prunes empty holds as
+                # plain drops; replay must not resurrect one.
+                holds.pop(key, None)
+        elif op == "drop":
+            holds.pop(key, None)
+        elif op == "lapse":
+            holds.pop(key, None)
+            lapsed.add(key)
+        elif op == "wait":
+            waiting[key] = float(rec.get("since", rec.get("ts", 0.0)))
+        elif op == "wait_clear":
+            waiting.pop(key, None)
+        # "renew": expiry is process-local — a rehydrated hold gets a
+        # fresh TTL from its preserved age; "admit": the release
+        # decision marker (the reserve just before it carries the
+        # state; the first tick's release_retry path finishes the
+        # gates idempotently).
+
+    # -- snapshot shape ----------------------------------------------------
+
+    @staticmethod
+    def state_data(
+        holds: Dict[GangKey, Hold],
+        lapsed: Set[GangKey],
+        waiting_since: Dict[GangKey, float],
+    ) -> dict:
+        """The compaction document replay() consumes — built by the
+        owner (gang.py assembles it from the live table + its lapse
+        bars + wait clocks)."""
+        return {
+            "holds": [
+                {
+                    "ns": k[0],
+                    "gang": k[1],
+                    "hosts": dict(h.hosts),
+                    "demands": list(h.demands),
+                    "counted": sorted(h.counted_pods),
+                    "created": round(h.created_ts, 3),
+                }
+                for k, h in sorted(holds.items())
+            ],
+            "lapsed": sorted(list(k) for k in lapsed),
+            "waiting": [
+                [k[0], k[1], round(ts, 3)]
+                for k, ts in sorted(waiting_since.items())
+            ],
+        }
+
+
+def self_test() -> int:
+    """Crash-recovery smoke for scripts/tier1.sh: drive the journal
+    through reserve → crash → replay, a torn tail, and a compaction,
+    asserting the rehydrated state at each step. Runs in a temp dir;
+    prints a one-line JSON verdict."""
+    import json
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="tpu-journal-selftest-")
+    try:
+        j = AdmissionJournal(d)
+        key = ("default", "train")
+        j.record(
+            "reserve", key, hosts={"n1": 4}, demands=[2, 2], age_s=0.0
+        )
+        j.record("admit", key, hosts={"n1": 4}, demands=[2, 2])
+        j.record("shrink", key, pod="w0", host="n1", chips=2)
+        j.record("wait", ("default", "starved"), since=123.0)
+        j.close()  # process "dies"; the file survives
+
+        j2 = AdmissionJournal(d)
+        st = j2.replay()
+        assert st.status == statestore.CLEAN, st.status
+        assert st.holds[key].hosts == {"n1": 2}, st.holds
+        assert st.holds[key].counted_pods == {"w0"}
+        assert st.waiting_since[("default", "starved")] == 123.0
+
+        # Torn tail: truncate mid-record; the durable prefix survives.
+        j2.record("lapse", key)
+        j2.close()
+        with open(j2.store.journal_path, "rb+") as f:
+            f.truncate(max(0, f.seek(0, 2) - 7))
+        j3 = AdmissionJournal(d)
+        st = j3.replay()
+        assert st.status == statestore.TORN_TAIL, st.status
+        assert key in st.holds  # the torn lapse never committed
+
+        # Compaction + replay-over-snapshot.
+        j3.compact(
+            AdmissionJournal.state_data(
+                st.holds, st.lapsed, st.waiting_since
+            )
+        )
+        j3.record("drop", key)
+        j3.close()
+        st = AdmissionJournal(d).replay()
+        assert key not in st.holds
+        assert st.waiting_since[("default", "starved")] == 123.0
+        print(json.dumps({"journal_self_test": "ok"}))
+        return 0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--self-test", action="store_true",
+        help="run the crash-recovery smoke (scripts/tier1.sh)",
+    )
+    a = p.parse_args(argv)
+    if a.self_test:
+        return self_test()
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -67,6 +67,7 @@ from typing import Callable, Optional
 
 from ..kube.client import KubeError, rfc3339_now
 from ..utils import metrics
+from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -264,6 +265,14 @@ class LeaderLease:
         self.acquire()
         self._last_renew = self._clock()
         metrics.LEASE_HELD.set(1)
+        # The takeover moment anchors crash forensics: journal replay
+        # (gang.recover) runs right after this, and a flight dump from
+        # the new holder should show when leadership began.
+        RECORDER.record(
+            "leader_acquired",
+            f"singleton lease {self.namespace}/{self.name} acquired",
+            identity=self.identity,
+        )
         self._thread = threading.Thread(
             target=self._renew_loop, name="extender-lease", daemon=True
         )

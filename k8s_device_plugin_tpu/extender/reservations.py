@@ -30,10 +30,15 @@ the API's floor once gates are gone.
 
 One table is shared in-process between GangAdmission and the
 TopologyExtender (deploy/tpu-extender.yml runs both in one container;
-extender/__main__.py wires them). It is deliberately in-memory: on
-restart, gangs released-but-unscheduled lose protection for one
-scheduling race at most, and the admission tick re-reserves on its next
-pass if they still fit.
+extender/__main__.py wires them). The table itself is in-memory; with
+``--journal-dir`` every mutation is tapped by the ``observer`` hook
+into the write-ahead journal (extender/journal.py) and a restart
+rehydrates holds with their ORIGINAL ages (``restore``) behind the
+extender's readiness gate. Without a journal, gangs
+released-but-unscheduled lose protection for one scheduling race at
+most, and the admission tick re-reserves on its next pass if they
+still fit — with lapse ages reset to the restart, the amnesia hole
+the journal exists to close.
 """
 
 from __future__ import annotations
@@ -83,6 +88,14 @@ class ReservationTable:
         self._clock = clock
         self._lock = threading.Lock()
         self._by_gang: Dict[GangKey, Reservation] = {}
+        # State-transition observer: callable(op, gang_key, payload)
+        # invoked under the table lock (ordering must match mutation
+        # order) for reserve/renew/drop/lapse/shrink — the write-ahead
+        # journal's tap (extender/journal.py). Hooked here, not at the
+        # call sites, so a lapse inside a routine prune on the /filter
+        # hot path is captured too. None = no journaling (the default;
+        # recording must cost one None check when off).
+        self.observer = None
         self.lapsed_total = 0  # reservations that hit the hard age cap
         # Keys that lapsed since the last drain_lapsed() — a hold can
         # age out inside a routine prune (any active()/apply() call),
@@ -91,6 +104,20 @@ class ReservationTable:
         self._lapsed_keys: set = set()
 
     # -- mutation ----------------------------------------------------------
+
+    def _observe_reserve_locked(self, gang: GangKey, age_s: float) -> None:
+        """The ONE builder of the observer's 'reserve' payload — fresh
+        reserves and age-preserving restores must journal the same
+        record shape or replay diverges between them."""
+        if self.observer is None:
+            return
+        r = self._by_gang[gang]
+        self.observer("reserve", gang, {
+            "hosts": dict(r.hosts),
+            "demands": list(r.demands),
+            "counted": sorted(r.counted_pods),
+            "age_s": round(age_s, 3),
+        })
 
     def reserve(
         self,
@@ -118,10 +145,53 @@ class ReservationTable:
                 demands=tuple(sorted(demands)),
                 counted_pods=set(counted_pods or ()),
             )
+            self._observe_reserve_locked(gang, 0.0)
 
-    def renew(self, gang: GangKey) -> bool:
+    def restore(
+        self,
+        gang: GangKey,
+        host_chips: Dict[str, int],
+        age_s: float,
+        demands: Tuple[int, ...] = (),
+        counted_pods: Optional[Set[str]] = None,
+    ) -> bool:
+        """Re-install a journal-rehydrated hold with its pre-crash age
+        preserved: ``created_at`` is backdated by ``age_s`` so the hard
+        age cap keeps counting from the ORIGINAL reserve — a restart
+        must never reset a hold's age (that would void the cap, the
+        lapsed-hold amnesia bug). False (not installed) when the age
+        already exceeds the cap; the caller records the lapse
+        instead."""
+        if age_s >= self.max_age_s:
+            return False
+        now = self._clock()
+        hosts = {h: int(n) for h, n in host_chips.items() if n > 0}
+        if not hosts:
+            return False
+        with self._lock:
+            self._by_gang[gang] = Reservation(
+                gang=gang,
+                hosts=hosts,
+                created_at=now - age_s,
+                # Fresh TTL window, still clamped so expiry can never
+                # outlive the cap's remainder.
+                expires_at=now + min(self.ttl_s, self.max_age_s - age_s),
+                demands=tuple(sorted(demands)),
+                counted_pods=set(counted_pods or ()),
+            )
+            self._observe_reserve_locked(gang, age_s)
+        return True
+
+    def renew(self, gang: GangKey, skip_if_remaining_s: float = 0.0) -> bool:
         """Extend the reservation's expiry; False when absent or past the
-        hard age cap (the caller logs the lapse; expiry then prunes)."""
+        hard age cap (the caller logs the lapse; expiry then prunes).
+        ``skip_if_remaining_s``: when the current expiry still has at
+        least this much runway, report healthy WITHOUT extending — the
+        admission tick renews every hold every resync, and re-stamping
+        an expiry that is nowhere near due is pure lock churn plus one
+        journal record per hold per tick (the upkeep passes a few
+        resync intervals of slack, so a hold still can never expire
+        between ticks)."""
         now = self._clock()
         with self._lock:
             r = self._by_gang.get(gang)
@@ -129,14 +199,25 @@ class ReservationTable:
                 return False
             if now - r.created_at >= self.max_age_s:
                 return False
+            if (
+                skip_if_remaining_s > 0.0
+                and r.expires_at - now >= skip_if_remaining_s
+            ):
+                return True
             r.expires_at = min(
                 now + self.ttl_s, r.created_at + self.max_age_s
             )
+            if self.observer is not None:
+                self.observer("renew", gang, {})
             return True
 
     def drop(self, gang: GangKey) -> None:
         with self._lock:
-            self._by_gang.pop(gang, None)
+            if (
+                self._by_gang.pop(gang, None) is not None
+                and self.observer is not None
+            ):
+                self.observer("drop", gang, {})
 
     def lapse(self, gang: GangKey) -> None:
         """Drop a reservation that aged out with work still unscheduled
@@ -146,6 +227,8 @@ class ReservationTable:
             if r is not None and r.hosts:
                 self.lapsed_total += 1
                 self._lapsed_keys.add(gang)
+                if self.observer is not None:
+                    self.observer("lapse", gang, {})
 
     def drain_lapsed(self) -> set:
         """Gang keys whose holds lapsed since the last drain (consumed:
@@ -177,6 +260,12 @@ class ReservationTable:
                 r.hosts[hostname] = max(0, r.hosts[hostname] - chips)
                 if r.hosts[hostname] == 0:
                     del r.hosts[hostname]
+            if self.observer is not None:
+                self.observer("shrink", gang, {
+                    "pod": pod_name,
+                    "host": hostname,
+                    "chips": int(chips),
+                })
 
     # -- queries -----------------------------------------------------------
 
@@ -187,9 +276,15 @@ class ReservationTable:
             if r.expires_at <= now or not r.hosts
         ]:
             r = self._by_gang.pop(key)
-            if r.hosts and now - r.created_at >= self.max_age_s:
+            lapsed = r.hosts and now - r.created_at >= self.max_age_s
+            if lapsed:
                 self.lapsed_total += 1
                 self._lapsed_keys.add(key)
+            if self.observer is not None:
+                # Even prune-path exits are journaled: a TTL expiry is
+                # a drop, an age-cap expiry a lapse — otherwise replay
+                # would resurrect a hold the live table already shed.
+                self.observer("lapse" if lapsed else "drop", key, {})
 
     def active(self) -> Dict[GangKey, Reservation]:
         """Snapshot of live reservations (expired ones pruned)."""
@@ -269,6 +364,25 @@ class ReservationTable:
             }
             for k, r in sorted(self.active().items())
         ]
+
+    def export_state(self) -> Dict[GangKey, dict]:
+        """Full JSON-ready hold state — hosts, demands, counted pods,
+        and each hold's AGE (not its monotonic timestamps, which are
+        meaningless across processes) — the table's half of the
+        journal's compaction snapshot (extender/journal.py). No prune:
+        compaction must reflect exactly what the journal's records
+        said, not race an expiry into the snapshot."""
+        now = self._clock()
+        with self._lock:
+            return {
+                k: {
+                    "hosts": dict(r.hosts),
+                    "demands": list(r.demands),
+                    "counted": sorted(r.counted_pods),
+                    "age_s": round(max(0.0, now - r.created_at), 3),
+                }
+                for k, r in self._by_gang.items()
+            }
 
     def load_snapshot(self, entries) -> None:
         """Rebuild holds from a snapshot() payload (fresh TTLs — the

@@ -1021,6 +1021,7 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
         host: str = "0.0.0.0",
         port: int = 0,
         identity: str = "",
+        ready_check=None,
     ):
         super().__init__(host, port)
         self.extender = extender or TopologyExtender()
@@ -1028,10 +1029,28 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
         # served on /reservations so tools/gang can detect a snapshot
         # taken from a non-admitter replica.
         self.identity = identity
+        # Readiness gate (() -> bool, None = always ready): /filter and
+        # /prioritize answer 503 until admission state is rehydrated
+        # from the journal (extender/journal.py) — serving them sooner
+        # would score nodes without the crashed incarnation's holds,
+        # reopening the release→steal window recovery exists to close.
+        # /readyz serves the same answer for the kube readiness probe
+        # (deploy/tpu-extender.yml); /healthz stays pure liveness.
+        self.ready_check = ready_check
 
     def handler_class(self):
         ext = self.extender
         identity = self.identity
+        server = self
+
+        def ready() -> bool:
+            check = server.ready_check
+            if check is None:
+                return True
+            try:
+                return bool(check())
+            except Exception:  # noqa: BLE001 — a broken check reads as
+                return False  # not-ready, never a 500
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
@@ -1050,6 +1069,27 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                 self.wfile.write(data)
 
             def do_POST(self):
+                if not ready():
+                    # 503, not an empty 200: an empty filter result
+                    # would read as "no node fits" and fail the pod's
+                    # scheduling cycle outright; an error makes the
+                    # scheduler retry, and the readiness probe keeps
+                    # the Service from routing here at all.
+                    self._send(
+                        {"error": "admission state rehydrating"}, 503
+                    )
+                    # Bounded verb label: an arbitrary POST path during
+                    # the not-ready window must not mint metric
+                    # labelsets (the ready path only counts known
+                    # verbs, after routing).
+                    verb = self.path.strip("/")
+                    metrics.EXTENDER_REQUESTS.inc(
+                        verb=verb
+                        if verb in ("filter", "prioritize")
+                        else "other",
+                        outcome="not_ready",
+                    )
+                    return
                 try:
                     args = self._read_args()
                 except json.JSONDecodeError:
@@ -1128,6 +1168,23 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
             def do_GET(self):
                 if self.path == "/healthz":
                     self._send({"ok": True})
+                elif self.path == "/readyz":
+                    # The kube READINESS probe (deploy/tpu-extender.yml)
+                    # — 503 until journal rehydration completes, so the
+                    # scheduler's extender Service never routes a
+                    # /filter to a replica that hasn't restored its
+                    # holds. /healthz above stays pure liveness: a
+                    # rehydrating process is alive, not ready.
+                    ok = ready()
+                    self._send(
+                        {"ok": ok}
+                        if ok
+                        else {
+                            "ok": False,
+                            "reason": "admission state rehydrating",
+                        },
+                        200 if ok else 503,
+                    )
                 elif self.path == "/reservations":
                     # Active gang holds (reservations.py) — consumed by
                     # tools/gang so out-of-process diagnosis sees the

@@ -9,8 +9,10 @@ The ledger is the decision-provenance tier that composes with the
 trace/flight-recorder stack: every consequential decision — extender
 filter rejections (per node, per reason), prioritize score breakdowns,
 gang admission outcomes (admitted / waiting with the blocking shortfall
-/ released), health transitions and evictions, and plugin Allocate
-substitutions — becomes one structured record carrying a
+/ released), crash-recovery outcomes (journal replay + state
+rehydration, extender/journal.py), health transitions and evictions,
+and plugin Allocate substitutions — becomes one structured record
+carrying a
 machine-readable ``reason`` token, the human message, the pod/gang/node
 it concerns, and the active ``trace_id``.
 

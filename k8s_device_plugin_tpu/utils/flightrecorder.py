@@ -16,7 +16,10 @@ The ring is:
 * **dumped to disk** on SIGTERM/shutdown (the entrypoints call
   :meth:`dump_on`), and on a kube circuit-break (utils/resilience.py
   hooks the breaker's OPEN transition) — the two moments an operator
-  most wants the preceding event tail;
+  most wants the preceding event tail; crash-recovery events
+  (``leader_acquired``, ``journal_replay``, ``rehydrate`` —
+  extender/journal.py) land at the ring's head after a restart, so a
+  post-crash dump leads with what the successor rebuilt;
 * **bounded**: past ``capacity`` the oldest event drops and
   ``dropped`` counts it — a crash loop can never grow the recorder.
 

@@ -337,7 +337,8 @@ EXTENDER_REGISTRY = Registry(uptime_name="tpu_extender_uptime_seconds")
 EXTENDER_REQUESTS = EXTENDER_REGISTRY.counter(
     "tpu_extender_requests_total",
     "Scheduler-extender HTTP requests served, by verb (filter/"
-    "prioritize) and outcome (ok/error)",
+    "prioritize) and outcome (ok/error/not_ready — refused behind the "
+    "journal-rehydration readiness gate)",
 )
 GANG_RELEASED = EXTENDER_REGISTRY.counter(
     "tpu_gang_released_total",
@@ -476,6 +477,37 @@ GANG_PENDING_EVENTS = EXTENDER_REGISTRY.counter(
     "Kube Events posted (or suppressed/failed) for gangs capacity-"
     "waiting past the pending-event threshold, by outcome "
     "(posted/suppressed/error)",
+)
+# Crash-consistent admission state (utils/statestore.py +
+# extender/journal.py): the write-ahead journal behind gang
+# reservations/lapse bars and its cold-start rehydration.
+STATE_JOURNAL_RECORDS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_state_journal_records_total",
+    "Admission-state journal records appended, by op (reserve/shrink/"
+    "renew/drop/lapse/admit/wait/wait_clear; error = append failed and "
+    "the transition was NOT journaled)",
+)
+STATE_JOURNAL_BYTES = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_state_journal_bytes",
+    "Current admission-state journal file size; sawtooths with "
+    "compaction — sustained growth means compaction is failing",
+)
+STATE_REPLAY_SECONDS = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_state_replay_seconds",
+    "Duration of the last journal replay (startup rehydration gates "
+    "/filter+/prioritize readiness behind it)",
+)
+STATE_REHYDRATIONS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_state_rehydrations_total",
+    "Journal replays run at startup/recovery, by outcome (clean/empty/"
+    "torn_tail/corrupt/snapshot_corrupt — torn_tail is the expected "
+    "crash shape; corrupt means records were discarded and recovery "
+    "degraded toward cluster-truth rebuild)",
+)
+STATE_COMPACTIONS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_state_compactions_total",
+    "Admission-state snapshot compactions (tmp+fsync+rename then "
+    "journal truncate), by outcome (ok/error)",
 )
 
 

@@ -1,0 +1,386 @@
+"""Crash-safe state store: checksummed append-only journal + atomic
+snapshot compaction.
+
+The extender's admission state (gang reservations, lapse bars, wait
+clocks — extender/journal.py) is in-process memory, and the process is
+the one failure domain the resilience layer (utils/resilience.py)
+cannot see: a SIGKILL/OOM/liveness kill loses every hold and every
+lapse age (reservations.py:34, gang.py's restart story). This module
+is the durable substrate that closes that hole, the same shape as the
+kubelet device-manager checkpoint the reference controller already
+consumes (SURVEY §0.6, ``kube/checkpoint.py``), hardened for the
+append-heavy write pattern a journal needs:
+
+* **append-only journal** — one record per line, ``<crc32 hex> <json>``,
+  each record carrying a monotonically increasing ``seq``. A flushed
+  append survives *process* death (the designed threat model);
+  ``flush=False`` batches records whose loss is conservative until the
+  owner's per-tick flush, and fsync — machine-crash durability — is
+  opt-in (``sync=True`` per record, or ``fsync_always`` — see
+  docs/operations.md for the trade-off).
+* **snapshot compaction** — the owner periodically folds the journal
+  into one snapshot document written tmp + fsync + rename (the atomic
+  kubelet-checkpoint idiom), then truncates the journal. The snapshot
+  embeds its own CRC and the ``seq`` it covers, so a crash *between*
+  rename and truncate replays idempotently (records with
+  ``seq <= snapshot.seq`` are skipped).
+* **torn-tail tolerance** — a crash mid-append leaves a partial last
+  line; the reader keeps every intact prefix record and reports the
+  tail as torn rather than raising. A checksum mismatch ANYWHERE stops
+  the replay at that point (everything after a corrupt record is
+  suspect — the seq chain is broken) and reports ``corrupt``; the
+  caller degrades to cluster-truth rebuild for the remainder, never
+  trusts a torn record, and never crashes (fuzz-tested in
+  tests/test_journal.py).
+
+Nothing here knows about gangs or reservations; the admission-specific
+record vocabulary and replay state machine live in extender/journal.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import zlib
+from typing import List, Optional
+
+from .logging import get_logger
+
+log = get_logger(__name__)
+
+SNAPSHOT_VERSION = 1
+
+# Load statuses, in increasing order of damage. "clean" and "empty" are
+# healthy; "torn_tail" is the expected shape after a crash mid-append;
+# "corrupt" (mid-file checksum break) and "snapshot_corrupt" mean bytes
+# were lost and the caller must reconcile against cluster truth.
+CLEAN = "clean"
+EMPTY = "empty"
+TORN_TAIL = "torn_tail"
+CORRUPT = "corrupt"
+SNAPSHOT_CORRUPT = "snapshot_corrupt"
+
+
+def _crc(payload: bytes) -> str:
+    return f"{zlib.crc32(payload) & 0xFFFFFFFF:08x}"
+
+
+def encode_record(rec: dict) -> bytes:
+    payload = json.dumps(
+        rec, separators=(",", ":"), sort_keys=True
+    ).encode()
+    return _crc(payload).encode() + b" " + payload + b"\n"
+
+
+@dataclasses.dataclass
+class LoadResult:
+    snapshot: Optional[dict]  # the last compacted state document, or None
+    records: List[dict]  # journal records newer than the snapshot, in order
+    status: str  # CLEAN / EMPTY / TORN_TAIL / CORRUPT / SNAPSHOT_CORRUPT
+    dropped: int  # journal lines discarded as torn or corrupt
+    seq: int  # highest seq observed (snapshot's or last record's)
+
+
+def _decode_journal(data: bytes) -> "tuple[List[dict], str, int, int]":
+    """(records, status, dropped, good_end). Stops at the first
+    unreadable line: a missing trailing newline is a torn tail
+    (expected crash shape), a checksum/JSON failure is corruption —
+    either way the intact prefix is all that can be trusted.
+    ``good_end`` is the byte offset just past the last intact record —
+    the boundary load() heals the file to, so a later append can never
+    land on top of damaged bytes."""
+    records: List[dict] = []
+    if not data:
+        return records, CLEAN, 0, 0
+    lines = data.split(b"\n")
+    torn = lines[-1] != b""  # no final newline: the last append was cut
+    body, tail = (lines[:-1], [lines[-1]]) if torn else (lines[:-1], [])
+    status = CLEAN
+    dropped = len(tail)
+    good_end = 0
+    for i, line in enumerate(body):
+        if not line:
+            good_end += 1  # blank line (truncate artifact): skip it
+            continue
+        sep = line.find(b" ")
+        ok = sep == 8
+        if ok:
+            ok = _crc(line[sep + 1:]).encode() == line[:sep]
+        if ok:
+            try:
+                records.append(json.loads(line[sep + 1:]))
+                good_end += len(line) + 1
+                continue
+            except ValueError:
+                ok = False
+        # Everything from here on is suspect: the record boundary (and
+        # seq chain) can no longer be trusted.
+        status = CORRUPT
+        dropped += len(body) - i
+        return records, status, dropped, good_end
+    if torn:
+        status = TORN_TAIL
+    return records, status, dropped, good_end
+
+
+class StateStore:
+    """One journal file + one snapshot file in a directory.
+
+    Thread-safe; one writer process assumed (the extender's singleton
+    lease — extender/leader.py — is what guarantees it cluster-wide).
+    """
+
+    def __init__(
+        self,
+        dir_path: str,
+        name: str = "admission",
+        fsync_always: bool = False,
+    ):
+        self.dir = dir_path
+        self.journal_path = os.path.join(dir_path, f"{name}.journal")
+        self.snapshot_path = os.path.join(
+            dir_path, f"{name}.snapshot.json"
+        )
+        self._tmp_path = self.snapshot_path + ".tmp"
+        self.fsync_always = fsync_always
+        self._lock = threading.Lock()
+        self._fh = None
+        self._seq = 0
+        self.records_since_compact = 0
+
+    # -- read --------------------------------------------------------------
+
+    def load(self) -> LoadResult:
+        """Read snapshot + journal; never raises on damaged state files
+        (an unreadable store degrades to an empty one — the caller's
+        cluster-truth reconciliation is the floor, and a crash-looping
+        daemon must not wedge on its own journal)."""
+        snapshot = None
+        status = CLEAN
+        # A leftover tmp file is a compaction that crashed before
+        # rename: the real snapshot (if any) is still the authoritative
+        # one; the tmp is dead bytes.
+        try:
+            if os.path.exists(self._tmp_path):
+                os.remove(self._tmp_path)
+                log.warning(
+                    "removed half-written snapshot %s (crash "
+                    "mid-compaction; previous snapshot still "
+                    "authoritative)", self._tmp_path,
+                )
+        except OSError:
+            pass
+        snap_seq = 0
+        try:
+            with open(self.snapshot_path, "rb") as f:
+                doc = json.loads(f.read())
+            payload = json.dumps(
+                doc.get("data"), separators=(",", ":"), sort_keys=True
+            ).encode()
+            if doc.get("checksum") != _crc(payload):
+                log.warning(
+                    "snapshot %s failed its checksum; ignoring it",
+                    self.snapshot_path,
+                )
+                status = SNAPSHOT_CORRUPT
+            else:
+                snapshot = doc.get("data")
+                snap_seq = int(doc.get("seq", 0))
+        except FileNotFoundError:
+            pass
+        except (OSError, ValueError, TypeError) as e:
+            log.warning(
+                "unreadable snapshot %s (%s); ignoring it",
+                self.snapshot_path, e,
+            )
+            status = SNAPSHOT_CORRUPT
+        try:
+            with open(self.journal_path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            data = b""
+        except OSError as e:
+            log.warning(
+                "unreadable journal %s (%s); treating as empty",
+                self.journal_path, e,
+            )
+            data = b""
+            status = CORRUPT
+        records, jstatus, dropped, good_end = _decode_journal(data)
+        if status == CLEAN:
+            status = jstatus
+        if jstatus in (TORN_TAIL, CORRUPT) and good_end < len(data):
+            # Heal the file to the intact prefix NOW: appends open in
+            # 'ab' mode, and a record written after damaged bytes would
+            # be unreadable to every later replay (it lands on the same
+            # torn line) — the journal would silently stop journaling.
+            # The damaged suffix is already untrusted either way.
+            try:
+                with open(self.journal_path, "rb+") as f:
+                    f.truncate(good_end)
+            except OSError as e:
+                log.warning(
+                    "could not heal damaged journal tail of %s (%s); "
+                    "records appended before the next compaction may "
+                    "be lost to the next replay", self.journal_path, e,
+                )
+        # Idempotent replay across a crash between snapshot rename and
+        # journal truncate: drop records the snapshot already covers.
+        records = [r for r in records if int(r.get("seq", 0)) > snap_seq]
+        seq = max(
+            snap_seq, max((int(r.get("seq", 0)) for r in records), default=0)
+        )
+        if status == CLEAN and snapshot is None and not records:
+            status = EMPTY
+        with self._lock:
+            self._seq = max(self._seq, seq)
+        return LoadResult(
+            snapshot=snapshot,
+            records=records,
+            status=status,
+            dropped=dropped,
+            seq=seq,
+        )
+
+    # -- write -------------------------------------------------------------
+
+    def _open_locked(self, truncate: bool = False):
+        if self._fh is None or truncate:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+            os.makedirs(self.dir, exist_ok=True)
+            self._fh = open(
+                self.journal_path, "wb" if truncate else "ab"
+            )
+        return self._fh
+
+    def append(self, rec: dict, sync: bool = False, flush: bool = True) -> int:
+        """Append one record (its ``seq`` is assigned here). With
+        ``flush`` it reaches the OS immediately — durable against
+        process death; against machine crash only when fsync'd
+        (``sync=True`` / ``fsync_always``). ``flush=False`` leaves the
+        record in the file buffer until the next flushing append,
+        :meth:`flush`, or close — the owner batches records whose loss
+        is conservative (e.g. renewals: replay no-ops) and flushes once
+        per tick, keeping the hot path to one buffered write. A crash
+        with buffered records loses whole records, never bytes: the
+        file still ends at the last flush's record boundary. Returns
+        the assigned seq."""
+        with self._lock:
+            self._seq += 1
+            rec = dict(rec, seq=self._seq)
+            fh = self._open_locked()
+            fh.write(encode_record(rec))
+            if flush or sync or self.fsync_always:
+                fh.flush()
+                if sync or self.fsync_always:
+                    os.fsync(fh.fileno())
+            self.records_since_compact += 1
+            return self._seq
+
+    def flush(self) -> None:
+        """Push buffered (flush=False) appends to the OS."""
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                except OSError:
+                    pass
+
+    def current_seq(self) -> int:
+        """The seq a caller should capture BEFORE building a compaction
+        state document: compact(data, seq=<this>) then keeps any record
+        appended concurrently (seq above it) instead of truncating it
+        into oblivion."""
+        with self._lock:
+            return self._seq
+
+    def compact(self, data: dict, seq: Optional[int] = None) -> None:
+        """Fold state into the snapshot file (tmp + fsync + rename, the
+        kubelet-checkpoint idiom) and truncate the journal. ``data``
+        must be the owner's COMPLETE state as of ``seq`` (captured via
+        :meth:`current_seq` BEFORE building it; defaults to now —
+        callers without concurrent writers). Records with a seq above
+        the snapshot's are REWRITTEN into the fresh journal, not
+        discarded: a mutation racing the state capture (e.g. a prune on
+        another thread) stays replayable instead of being erased — and
+        since replay over the snapshot is at-least-once-idempotent, a
+        record the data DID already include is harmless to keep."""
+        with self._lock:
+            snap_seq = self._seq if seq is None else min(seq, self._seq)
+            payload = json.dumps(
+                data, separators=(",", ":"), sort_keys=True
+            ).encode()
+            doc = {
+                "version": SNAPSHOT_VERSION,
+                "seq": snap_seq,
+                "checksum": _crc(payload),
+                "data": data,
+            }
+            keep = b""
+            kept = 0
+            if snap_seq < self._seq:
+                # The keep-scan reads from DISK: push our own buffered
+                # (flush=False) appends there first, or a record racing
+                # the capture that is still in the userspace buffer
+                # would be invisible to the scan and destroyed by the
+                # truncate below.
+                if self._fh is not None:
+                    try:
+                        self._fh.flush()
+                    except OSError:
+                        pass
+                try:
+                    with open(self.journal_path, "rb") as f:
+                        raw = f.read()
+                except OSError:
+                    raw = b""
+                for line in raw.split(b"\n"):
+                    if not line:
+                        continue
+                    sep = line.find(b" ")
+                    if sep != 8 or _crc(line[sep + 1:]).encode() != line[:sep]:
+                        continue  # damaged: untrusted either way
+                    try:
+                        rec = json.loads(line[sep + 1:])
+                    except ValueError:
+                        continue
+                    if int(rec.get("seq", 0)) > snap_seq:
+                        keep += line + b"\n"
+                        kept += 1
+            os.makedirs(self.dir, exist_ok=True)
+            with open(self._tmp_path, "w") as f:
+                json.dump(doc, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(self._tmp_path, self.snapshot_path)
+            # Crash HERE is safe: load() skips journal records with
+            # seq <= the snapshot's (and the uncovered suffix, if any,
+            # is restored below before anything else is appended).
+            self._open_locked(truncate=True)
+            if keep:
+                self._fh.write(keep)
+                self._fh.flush()
+            self.records_since_compact = kept
+
+    def size_bytes(self) -> int:
+        """Current journal file size (the *_state_journal_bytes gauge)."""
+        try:
+            return os.path.getsize(self.journal_path)
+        except OSError:
+            return 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None

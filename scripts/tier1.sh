@@ -23,4 +23,11 @@ python -m k8s_device_plugin_tpu.tools.trace --self-test > /dev/null \
 # the /debug/decisions snapshot shape and the renderer fails CI here.
 python -m k8s_device_plugin_tpu.tools.explain --self-test > /dev/null \
   || { echo "tools/explain.py --self-test FAILED"; exit 1; }
+# Crash-recovery smoke: the admission-state journal must round-trip
+# reserve -> crash -> replay, tolerate a torn tail, and survive a
+# compaction (extender/journal.py --self-test) — a statestore format
+# drift fails CI here, before the pytest gate (the chaos kill-point
+# suite in tests/test_chaos_journal.py then covers the full daemon).
+python -m k8s_device_plugin_tpu.extender.journal --self-test > /dev/null \
+  || { echo "extender/journal.py --self-test FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,332 @@
+"""Admission-daemon SIGKILL chaos: the extender's own death is the one
+failure domain PR 1's fault harness never covered. Each scenario drives
+a real GangAdmission + write-ahead journal against the fake apiserver,
+SIGKILLs it at an injected kill-point (``SigKill`` is a BaseException:
+it tears through every best-effort ``except Exception`` exactly like
+process death, abandoning all in-memory state — only the journal's
+on-disk bytes survive, which is precisely what a SIGKILL leaves), then
+recovers a FRESH daemon over the same journal dir and proves via fake
+apiserver + reservation-table state:
+
+* no chip is double-booked (a competitor gang/pod can't take chips a
+  half-released gang reserved before dying);
+* no gang is left gateless-and-unfenced (a mid-release kill finishes
+  its gates AND keeps its fence);
+* lapsed holds stay lapsed across any number of restarts (the
+  amnesia bug of gang.py:1216 pre-PR-6);
+* torn journal tails and mid-compaction crashes degrade to
+  cluster-truth rebuild — never a crash, never trust in a torn record.
+
+Kill-points injected: (1) post-reserve/pre-gate-patch, (2) mid-release
+(first gate patch landed), (3) mid-compaction (after tmp write, before
+rename), (4) torn journal tail (append cut mid-record).
+"""
+
+import os
+
+import pytest
+
+from k8s_device_plugin_tpu.extender import journal as jr
+from k8s_device_plugin_tpu.extender.gang import GATE_NAME, GangAdmission
+from k8s_device_plugin_tpu.extender.reservations import ReservationTable
+from k8s_device_plugin_tpu.extender.server import TopologyExtender
+from k8s_device_plugin_tpu.kube.client import KubeClient
+from k8s_device_plugin_tpu.utils import metrics, statestore
+from tests.fake_apiserver import FakeApiServer
+from tests.test_extender import make_node, tpu_pod
+from tests.test_gang import gang_pod, gates_of
+
+
+class SigKill(BaseException):
+    """Process death: NOT an Exception, so every best-effort handler
+    in the daemon (per-pod release retries, tick recovery) is blown
+    through, exactly like a real SIGKILL."""
+
+
+class KillPointClient:
+    """Pass-through kube client that dies on the Nth call of one
+    method — the kill-point injector."""
+
+    def __init__(self, inner, method: str, calls_before_kill: int = 0):
+        self._inner = inner
+        self._method = method
+        self._remaining = calls_before_kill
+
+    def __getattr__(self, name):
+        real = getattr(self._inner, name)
+        if name != self._method:
+            return real
+
+        def wrapper(*a, **kw):
+            if self._remaining <= 0:
+                raise SigKill(name)
+            self._remaining -= 1
+            return real(*a, **kw)
+
+        return wrapper
+
+
+@pytest.fixture
+def api():
+    s = FakeApiServer()
+    url = s.start()
+    yield s, KubeClient(url)
+    s.stop()
+
+
+def add_gang(server, gang, n_pods=2, chips=2, gated=True):
+    for i in range(n_pods):
+        pod = gang_pod(f"{gang}-w{i}", gang, n_pods, chips)
+        if not gated:
+            pod["spec"]["schedulingGates"] = []
+        server.add_pod(pod)
+
+
+def fresh_admission(client, tmp_path):
+    """A recovered incarnation: fresh table + fresh journal handle over
+    the surviving journal dir."""
+    table = ReservationTable()
+    adm = GangAdmission(
+        client,
+        reservations=table,
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    return adm, table
+
+
+# ---------------------------------------------------------------------------
+# Kill-point 1: post-reserve / pre-gate-patch
+# ---------------------------------------------------------------------------
+
+def test_sigkill_post_reserve_pre_gate_patch_no_double_booking(
+    api, tmp_path
+):
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    add_gang(server, "atrain")
+
+    # Incarnation 1 dies on the very first gate patch: the reserve and
+    # admit records are already durable (flushed before the patch).
+    adm1 = GangAdmission(
+        client=KillPointClient(
+            client, "remove_pod_scheduling_gate", calls_before_kill=0
+        ),
+        reservations=ReservationTable(),
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    with pytest.raises(SigKill):
+        adm1.tick()
+    for i in range(2):  # nothing was released before the kill
+        assert GATE_NAME in gates_of(server, "default", f"atrain-w{i}")
+
+    # Incarnation 2 recovers over the same journal dir.
+    adm2, table2 = fresh_admission(client, tmp_path)
+    summary = adm2.recover()
+    assert summary["holds_restored"] == 1
+    assert table2.reserved_chips("n1") == 4  # fenced BEFORE any tick
+
+    # A competitor pod's /filter is shielded by the rehydrated hold —
+    # the chips the dead incarnation promised cannot be stolen.
+    ext = TopologyExtender(reservations=table2)
+    passing, failed = ext.filter(tpu_pod(2), [node])
+    assert passing == []
+    assert "reserved for a released gang" in failed["n1"]
+
+    # A competitor gang arriving now must NOT be admitted into the
+    # reserved chips, while the crashed gang's release FINISHES.
+    add_gang(server, "btrain")
+    released = adm2.tick()
+    assert released == [("default", "atrain")]
+    for i in range(2):
+        assert GATE_NAME not in gates_of(server, "default", f"atrain-w{i}")
+        assert GATE_NAME in gates_of(server, "default", f"btrain-w{i}")
+
+    # Exactly-once: the finished release is not repeated, the hold
+    # shrinks/drops as members bind, and only then can b admit.
+    assert adm2.tick() == []
+    for i in range(2):
+        server.pods[("default", f"atrain-w{i}")]["spec"]["nodeName"] = "n1"
+    # The tick that observes a's members bound drops its fence — and
+    # only THEN does b admit (same tick: upkeep precedes evaluation).
+    assert adm2.tick() == [("default", "btrain")]
+    assert ("default", "atrain") not in table2.active()
+    adm2.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# Kill-point 2: mid-release (one gate patch landed, one didn't)
+# ---------------------------------------------------------------------------
+
+def test_sigkill_mid_release_finishes_gates_and_keeps_fence(
+    api, tmp_path
+):
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    add_gang(server, "atrain")
+
+    adm1 = GangAdmission(
+        client=KillPointClient(
+            client, "remove_pod_scheduling_gate", calls_before_kill=1
+        ),
+        reservations=ReservationTable(),
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    with pytest.raises(SigKill):
+        adm1.tick()
+    states = [
+        GATE_NAME in gates_of(server, "default", f"atrain-w{i}")
+        for i in range(2)
+    ]
+    assert sorted(states) == [False, True]  # released exactly one
+
+    adm2, table2 = fresh_admission(client, tmp_path)
+    adm2.recover()
+    # The half-released gang is NOT gateless-and-unfenced: its full
+    # hold survived the crash.
+    assert table2.reserved_chips("n1") == 4
+    released = adm2.tick()  # finish_partial_release
+    assert released == [("default", "atrain")]
+    for i in range(2):
+        assert GATE_NAME not in gates_of(server, "default", f"atrain-w{i}")
+    # Fence still standing until members bind — the release→steal
+    # window stays closed through the whole crash+recovery.
+    assert table2.reserved_chips("n1") == 4
+    adm2.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# Kill-point 3: mid-compaction (tmp written, rename never happened)
+# ---------------------------------------------------------------------------
+
+def test_sigkill_mid_compaction_keeps_authoritative_state(
+    api, tmp_path, monkeypatch
+):
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    add_gang(server, "atrain")
+
+    j1 = jr.AdmissionJournal(str(tmp_path))
+    adm1 = GangAdmission(
+        client, reservations=ReservationTable(), journal=j1
+    )
+    assert adm1.tick() == [("default", "atrain")]  # hold now standing
+
+    # Compaction dies between the tmp fsync and the atomic rename.
+    real_replace = os.replace
+
+    def die_on_rename(src, dst):
+        if str(dst).endswith("admission.snapshot.json"):
+            raise SigKill("mid-compaction")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(statestore.os, "replace", die_on_rename)
+    with pytest.raises(SigKill):
+        j1.compact(adm1._journal_state())
+    monkeypatch.setattr(statestore.os, "replace", real_replace)
+    assert os.path.exists(j1.store.snapshot_path + ".tmp")
+
+    adm2, table2 = fresh_admission(client, tmp_path)
+    summary = adm2.recover()
+    # The journal (pre-compaction truth) is still authoritative; the
+    # half-written snapshot is ignored and cleaned up.
+    assert summary["holds_restored"] == 1
+    assert table2.reserved_chips("n1") == 4
+    assert not os.path.exists(j1.store.snapshot_path + ".tmp")
+    adm2.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# Kill-point 4: torn journal tail (append cut mid-record)
+# ---------------------------------------------------------------------------
+
+def test_sigkill_torn_tail_degrades_to_durable_prefix(api, tmp_path):
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    add_gang(server, "atrain")
+
+    j1 = jr.AdmissionJournal(str(tmp_path))
+    adm1 = GangAdmission(
+        client, reservations=ReservationTable(), journal=j1
+    )
+    assert adm1.tick() == [("default", "atrain")]
+    # The kill lands mid-append of a (hypothetical) drop record: bytes
+    # cut at an arbitrary point inside the last line.
+    j1.record("drop", ("default", "atrain"))
+    j1.close()
+    path = j1.store.journal_path
+    with open(path, "rb+") as f:
+        f.truncate(os.path.getsize(path) - 7)
+
+    before = metrics.STATE_REHYDRATIONS.get(outcome="torn_tail")
+    adm2, table2 = fresh_admission(client, tmp_path)
+    summary = adm2.recover()
+    assert summary["status"] == "torn_tail"
+    assert metrics.STATE_REHYDRATIONS.get(outcome="torn_tail") == before + 1
+    # The torn drop never committed: replay keeps the durable prefix
+    # (the hold) — the conservative direction; reconciliation, not the
+    # torn record, decides what happens next.
+    assert summary["holds_restored"] == 1
+    assert table2.reserved_chips("n1") == 4
+    # Cluster truth then converges normally: members bind, fence drops.
+    for i in range(2):
+        server.pods[("default", f"atrain-w{i}")]["spec"]["nodeName"] = "n1"
+    adm2.tick()
+    assert table2.active() == {}
+    adm2.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# The lapsed-hold amnesia bug: lapsed stays lapsed across restarts
+# ---------------------------------------------------------------------------
+
+def test_lapsed_hold_stays_lapsed_across_restarts(api, tmp_path):
+    import time as _time
+
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    # Gang fully released (gates already off) but still unscheduled —
+    # exactly the shape _maybe_refence re-fences after a restart.
+    add_gang(server, "atrain", gated=False)
+
+    # The hold was reserved 10,000 s before the crash: older than any
+    # default age cap by the time recovery runs.
+    old = jr.AdmissionJournal(
+        str(tmp_path), clock=lambda: _time.time() - 10000.0
+    )
+    old.record(
+        "reserve", ("default", "atrain"),
+        hosts={"n1": 4}, demands=[2, 2], age_s=0.0,
+    )
+    old.close()
+
+    # Restart 1: the hold lapses AT RECOVERY (aged out while dead) and
+    # the lapse bar forbids re-fencing with a reset age.
+    adm2, table2 = fresh_admission(client, tmp_path)
+    summary = adm2.recover()
+    assert summary["holds_lapsed_on_restore"] == 1
+    for _ in range(3):
+        adm2.tick()
+        assert table2.active() == {}, "re-fenced a LAPSED hold"
+    adm2.journal.close()
+
+    # Restart 2 (SIGKILL again): the lapse itself was journaled, so
+    # the bar survives a SECOND restart too — no amnesia, ever.
+    adm3, table3 = fresh_admission(client, tmp_path)
+    adm3.recover()
+    assert ("default", "atrain") in adm3._lapsed_gangs
+    for _ in range(3):
+        adm3.tick()
+        assert table3.active() == {}, "re-fenced a LAPSED hold"
+    adm3.journal.close()
+
+    # Sensitivity control: WITHOUT the journal the same cluster state
+    # re-fences (the pre-PR-6 amnesia this suite exists to prevent) —
+    # proving the assertions above bite.
+    adm0 = GangAdmission(client, reservations=ReservationTable())
+    adm0.tick()
+    assert adm0.reservations.reserved_chips("n1") == 4

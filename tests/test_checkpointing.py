@@ -53,22 +53,70 @@ def test_retention_keeps_newest(tmp_path):
         assert ckpt.latest_step() == 3
 
 
+def _run_training_subprocess(tmp_path, tag, **kwargs):
+    """run_training in a CHILD interpreter. Containment, not style:
+    on some kernel/jax combos the CPU pjit path this drives can
+    segfault the interpreter outright — in-process that kills the
+    whole pytest run at this file, taking every later test file with
+    it. bench.py isolates all accelerator work in subprocesses for
+    exactly this reason ("kill-and-move-on is the only reliable
+    containment"); here a crash becomes ONE failed test instead."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    out = tmp_path / f"{tag}.json"
+    code = textwrap.dedent(
+        f"""
+        import json, jax
+        from k8s_device_plugin_tpu.parallel.mesh import make_mesh
+        from k8s_device_plugin_tpu.workload.loop import run_training
+        from k8s_device_plugin_tpu.workload.model import ModelConfig
+        cfg = ModelConfig.tiny()
+        mesh = make_mesh(jax.devices()[:1])
+        r = run_training(cfg, mesh=mesh, **{kwargs!r})
+        json.dump(
+            {{
+                "losses": [float(x) for x in r["losses"]],
+                "resumed": bool(r["resumed"]),
+                "start_step": int(r["start_step"]),
+            }},
+            open({str(out)!r}, "w"),
+        )
+        """
+    )
+    p = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert p.returncode == 0, (
+        f"training subprocess ({tag}) died rc={p.returncode}: "
+        f"{p.stderr[-800:]}"
+    )
+    return json.load(open(out))
+
+
 def test_resume_continues_from_saved_step(tmp_path):
     """Interrupted run + resume == the same loss stream as one long run."""
-    cfg = tiny()
-    mesh = make_mesh(jax.devices()[:1])
     ckpt_dir = str(tmp_path / "ckpt")
 
-    full = run_training(cfg, steps=6, batch_per_device=4, mesh=mesh, seed=0)
+    full = _run_training_subprocess(
+        tmp_path, "full", steps=6, batch_per_device=4, seed=0
+    )
 
-    first = run_training(
-        cfg, steps=3, batch_per_device=4, checkpoint_dir=ckpt_dir,
-        save_every=100, mesh=mesh, seed=0,
+    first = _run_training_subprocess(
+        tmp_path, "first", steps=3, batch_per_device=4,
+        checkpoint_dir=ckpt_dir, save_every=100, seed=0,
     )
     assert not first["resumed"]
-    second = run_training(
-        cfg, steps=6, batch_per_device=4, checkpoint_dir=ckpt_dir,
-        save_every=100, mesh=mesh, seed=0,
+    second = _run_training_subprocess(
+        tmp_path, "second", steps=6, batch_per_device=4,
+        checkpoint_dir=ckpt_dir, save_every=100, seed=0,
     )
     assert second["resumed"]
     assert second["start_step"] == 3

@@ -330,6 +330,14 @@ def test_shipped_manifest_matches_served_protocol():
     ]
     assert pod_rules and "patch" in pod_rules[0]["verbs"]
     assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    # Readiness is the journal-rehydration gate (server.py /readyz),
+    # NOT liveness: a rehydrating replica is alive but must not be
+    # routed /filter traffic.
+    assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    # The journal dir the args name must be a mounted volume (the
+    # container runs readOnlyRootFilesystem).
+    jdir = container["args"][container["args"].index("--journal-dir") + 1]
+    assert jdir in {m["mountPath"] for m in container["volumeMounts"]}
     assert by_kind["Service"]["spec"]["ports"][0]["port"] == port
 
     sched = yaml.safe_load(by_kind["ConfigMap"]["data"]["config.yaml"])

@@ -1,0 +1,775 @@
+"""Crash-consistent admission state (utils/statestore.py +
+extender/journal.py): journal format, torn-tail/corruption tolerance
+(fuzzed), snapshot compaction atomicity, replay semantics, the
+ReservationTable observer tap + age-preserving restore, GangAdmission
+recovery, and the extender readiness gate. The full-daemon SIGKILL
+kill-point scenarios live in tests/test_chaos_journal.py."""
+
+import json
+import os
+import zlib
+
+import pytest
+import requests
+
+from k8s_device_plugin_tpu.extender import journal as jr
+from k8s_device_plugin_tpu.extender.gang import GangAdmission
+from k8s_device_plugin_tpu.extender.reservations import ReservationTable
+from k8s_device_plugin_tpu.extender.server import (
+    ExtenderHTTPServer,
+    TopologyExtender,
+)
+from k8s_device_plugin_tpu.kube.client import KubeClient
+from k8s_device_plugin_tpu.utils import statestore
+from tests.fake_apiserver import FakeApiServer
+from tests.test_extender import make_node
+from tests.test_gang import gang_pod, gates_of
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def api():
+    s = FakeApiServer()
+    url = s.start()
+    yield s, KubeClient(url)
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+# statestore: format, torn tails, corruption, compaction
+# ---------------------------------------------------------------------------
+
+def test_append_load_roundtrip(tmp_path):
+    st = statestore.StateStore(str(tmp_path))
+    st.append({"op": "a", "x": 1}, flush=True)
+    st.append({"op": "b"}, flush=True)
+    st.close()
+    out = statestore.StateStore(str(tmp_path)).load()
+    assert out.status == statestore.CLEAN
+    assert [r["op"] for r in out.records] == ["a", "b"]
+    assert [r["seq"] for r in out.records] == [1, 2]
+    assert out.seq == 2
+
+
+def test_empty_store_reads_empty(tmp_path):
+    out = statestore.StateStore(str(tmp_path)).load()
+    assert out.status == statestore.EMPTY
+    assert out.snapshot is None and out.records == []
+
+
+def test_buffered_appends_surface_after_flush(tmp_path):
+    st = statestore.StateStore(str(tmp_path))
+    st.append({"op": "a"}, flush=False)
+    # Unflushed data may not be on disk yet; flush makes it durable.
+    st.flush()
+    reader = statestore.StateStore(str(tmp_path)).load()
+    assert [r["op"] for r in reader.records] == ["a"]
+    st.close()
+
+
+def test_torn_tail_keeps_durable_prefix(tmp_path):
+    st = statestore.StateStore(str(tmp_path))
+    st.append({"op": "a"}, flush=True)
+    st.append({"op": "b"}, flush=True)
+    st.close()
+    path = os.path.join(str(tmp_path), "admission.journal")
+    with open(path, "rb+") as f:
+        f.truncate(os.path.getsize(path) - 5)  # cut mid-record
+    out = statestore.StateStore(str(tmp_path)).load()
+    assert out.status == statestore.TORN_TAIL
+    assert [r["op"] for r in out.records] == ["a"]
+    assert out.dropped == 1
+
+
+def test_bitflip_stops_replay_at_corruption(tmp_path):
+    st = statestore.StateStore(str(tmp_path))
+    for op in ("a", "b", "c"):
+        st.append({"op": op}, flush=True)
+    st.close()
+    path = os.path.join(str(tmp_path), "admission.journal")
+    data = bytearray(open(path, "rb").read())
+    # Flip a byte inside the SECOND record's payload.
+    lines = bytes(data).split(b"\n")
+    offset = len(lines[0]) + 1 + 12
+    data[offset] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    out = statestore.StateStore(str(tmp_path)).load()
+    assert out.status == statestore.CORRUPT
+    # Everything after the broken record is suspect and discarded.
+    assert [r["op"] for r in out.records] == ["a"]
+    assert out.dropped == 2
+
+
+def test_journal_fuzz_truncation_never_crashes(tmp_path):
+    """Truncate the journal at EVERY byte offset: load() must never
+    raise and must always return a strict prefix of the records."""
+    st = statestore.StateStore(str(tmp_path))
+    for i in range(6):
+        st.append({"op": f"op{i}", "i": i}, flush=True)
+    st.close()
+    path = os.path.join(str(tmp_path), "admission.journal")
+    full = open(path, "rb").read()
+    for cut in range(len(full)):
+        open(path, "wb").write(full[:cut])
+        out = statestore.StateStore(str(tmp_path)).load()
+        ids = [r["i"] for r in out.records]
+        assert ids == list(range(len(ids))), f"not a prefix at cut={cut}"
+        assert len(ids) <= 6
+
+
+def test_journal_fuzz_bitflip_never_crashes(tmp_path):
+    """Flip each byte of the journal in turn: load() must never raise,
+    never emit a record that fails its checksum-derived shape, and
+    always keep the intact prefix."""
+    st = statestore.StateStore(str(tmp_path))
+    for i in range(4):
+        st.append({"op": f"op{i}", "i": i}, flush=True)
+    st.close()
+    path = os.path.join(str(tmp_path), "admission.journal")
+    full = bytearray(open(path, "rb").read())
+    for pos in range(len(full)):
+        mutated = bytearray(full)
+        mutated[pos] ^= 0x41
+        open(path, "wb").write(bytes(mutated))
+        out = statestore.StateStore(str(tmp_path)).load()
+        ids = [r.get("i") for r in out.records]
+        # Prefix property: an intact prefix, nothing out of order.
+        assert ids == list(range(len(ids)))
+
+
+def test_append_after_damaged_load_stays_readable(tmp_path):
+    """load() must heal the file to the intact prefix: appends open in
+    'ab' mode, and a record written after damaged bytes would land on
+    the torn line and be unreadable to every later replay (the journal
+    would silently stop journaling)."""
+    st = statestore.StateStore(str(tmp_path))
+    st.append({"op": "a"}, flush=True)
+    st.append({"op": "b"}, flush=True)
+    st.close()
+    path = os.path.join(str(tmp_path), "admission.journal")
+    with open(path, "rb+") as f:
+        f.truncate(os.path.getsize(path) - 5)
+    st2 = statestore.StateStore(str(tmp_path))
+    assert st2.load().status == statestore.TORN_TAIL  # heals the tail
+    st2.append({"op": "c"}, flush=True)  # critical post-crash record
+    st2.close()
+    out = statestore.StateStore(str(tmp_path)).load()
+    assert out.status == statestore.CLEAN
+    assert [r["op"] for r in out.records] == ["a", "c"]
+
+
+def test_compact_preserves_records_newer_than_captured_seq(tmp_path):
+    """A record appended between the owner's state capture and the
+    compaction (e.g. a /filter-thread prune journaling a drop) must
+    survive in the fresh journal — truncating it away while it is also
+    missing from the snapshot would resurrect a hold the live table
+    already shed."""
+    st = statestore.StateStore(str(tmp_path))
+    st.append({"op": "a"}, flush=True)
+    seq = st.current_seq()  # the owner captures state as of here...
+    st.append({"op": "raced"}, flush=True)  # ...then this races in
+    st.compact({"covers": "a only"}, seq=seq)
+    st.close()
+    out = statestore.StateStore(str(tmp_path)).load()
+    assert out.snapshot == {"covers": "a only"}
+    assert [r["op"] for r in out.records] == ["raced"]
+
+
+def test_compact_sees_buffered_records_in_keep_scan(tmp_path):
+    """The keep-scan reads the journal from disk: a buffered
+    (flush=False) record racing the capture must be flushed there
+    first, or compaction destroys it while the snapshot also lacks
+    it."""
+    st = statestore.StateStore(str(tmp_path))
+    st.append({"op": "a"}, flush=True)
+    seq = st.current_seq()
+    st.append({"op": "buffered-race"}, flush=False)  # userspace only
+    st.compact({"covers": "a only"}, seq=seq)
+    st.close()
+    out = statestore.StateStore(str(tmp_path)).load()
+    assert [r["op"] for r in out.records] == ["buffered-race"]
+
+
+def test_compaction_roundtrip_and_truncation(tmp_path):
+    st = statestore.StateStore(str(tmp_path))
+    st.append({"op": "a"}, flush=True)
+    st.compact({"state": ["x"]})
+    assert st.size_bytes() == 0  # journal truncated
+    st.append({"op": "b"}, flush=True)
+    st.close()
+    out = statestore.StateStore(str(tmp_path)).load()
+    assert out.snapshot == {"state": ["x"]}
+    assert [r["op"] for r in out.records] == ["b"]
+    assert out.status == statestore.CLEAN
+
+
+def test_crash_between_rename_and_truncate_replays_idempotently(tmp_path):
+    """Snapshot carries the seq it covers: journal records at or below
+    it are skipped, so the rename→truncate window is crash-safe."""
+    st = statestore.StateStore(str(tmp_path))
+    st.append({"op": "a"}, flush=True)
+    st.append({"op": "b"}, flush=True)
+    journal_bytes = open(
+        os.path.join(str(tmp_path), "admission.journal"), "rb"
+    ).read()
+    st.compact({"covered": True})
+    st.close()
+    # Simulate the crash: the pre-compaction journal never truncated.
+    open(
+        os.path.join(str(tmp_path), "admission.journal"), "wb"
+    ).write(journal_bytes)
+    out = statestore.StateStore(str(tmp_path)).load()
+    assert out.snapshot == {"covered": True}
+    assert out.records == []  # seq <= snapshot.seq all skipped
+
+
+def test_crash_mid_compaction_leaves_old_snapshot_authoritative(tmp_path):
+    st = statestore.StateStore(str(tmp_path))
+    st.append({"op": "a"}, flush=True)
+    st.compact({"gen": 1})
+    st.append({"op": "b"}, flush=True)
+    # The next compaction dies after writing the tmp, before rename.
+    open(st.snapshot_path + ".tmp", "w").write('{"gen": 2, "junk": ')
+    st.close()
+    out = statestore.StateStore(str(tmp_path)).load()
+    assert out.snapshot == {"gen": 1}
+    assert [r["op"] for r in out.records] == ["b"]
+    assert not os.path.exists(st.snapshot_path + ".tmp")  # cleaned up
+
+
+def test_corrupt_snapshot_checksum_is_ignored(tmp_path):
+    st = statestore.StateStore(str(tmp_path))
+    st.append({"op": "a"}, flush=True)
+    st.compact({"gen": 1})
+    st.append({"op": "b"}, flush=True)
+    st.close()
+    doc = json.load(open(st.snapshot_path))
+    doc["data"] = {"gen": "tampered"}
+    json.dump(doc, open(st.snapshot_path, "w"))
+    out = statestore.StateStore(str(tmp_path)).load()
+    assert out.status == statestore.SNAPSHOT_CORRUPT
+    assert out.snapshot is None
+    # Post-snapshot journal records still replay.
+    assert [r["op"] for r in out.records] == ["b"]
+
+
+def test_record_crc_is_real(tmp_path):
+    line = statestore.encode_record({"op": "a", "seq": 1})
+    crc, payload = line.rstrip(b"\n").split(b" ", 1)
+    assert int(crc, 16) == zlib.crc32(payload) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# AdmissionJournal replay semantics
+# ---------------------------------------------------------------------------
+
+def test_replay_reserve_shrink_drop_lapse(tmp_path):
+    j = jr.AdmissionJournal(str(tmp_path))
+    a, b = ("ns", "a"), ("ns", "b")
+    j.record("reserve", a, hosts={"n1": 4}, demands=[2, 2], age_s=0.0)
+    j.record("shrink", a, pod="w0", host="n1", chips=2)
+    j.record("shrink", a, pod="w0", host="n1", chips=2)  # replayed event
+    j.record("reserve", b, hosts={"n2": 2}, demands=[2], age_s=0.0)
+    j.record("lapse", b)
+    j.record("renew", a)  # replay no-op
+    j.close()
+    st = jr.AdmissionJournal(str(tmp_path)).replay()
+    assert st.holds[a].hosts == {"n1": 2}  # idempotent shrink
+    assert b not in st.holds
+    assert st.lapsed == {b}
+    assert st.status == statestore.CLEAN
+
+
+def test_replay_reserve_clears_predecessor_lapse_bar(tmp_path):
+    j = jr.AdmissionJournal(str(tmp_path))
+    key = ("ns", "g")
+    j.record("reserve", key, hosts={"n1": 2}, demands=[2], age_s=0.0)
+    j.record("lapse", key)
+    # A fresh admission of a same-named successor legitimately clears
+    # the bar (mirrors tick()'s discard after reserve).
+    j.record("reserve", key, hosts={"n1": 2}, demands=[2], age_s=0.0)
+    j.close()
+    st = jr.AdmissionJournal(str(tmp_path)).replay()
+    assert key in st.holds and key not in st.lapsed
+
+
+def test_replay_preserves_age_through_reserve_record(tmp_path):
+    clock = FakeClock(5000.0)
+    j = jr.AdmissionJournal(str(tmp_path), clock=clock)
+    key = ("ns", "g")
+    j.record("reserve", key, hosts={"n1": 2}, demands=[2], age_s=120.0)
+    j.close()
+    st = jr.AdmissionJournal(str(tmp_path)).replay()
+    # created_ts = record ts - age_s.
+    assert st.holds[key].created_ts == pytest.approx(4880.0, abs=0.1)
+    assert st.holds[key].age_s(now=5010.0) == pytest.approx(130.0, abs=0.1)
+
+
+def test_replay_wait_episodes(tmp_path):
+    j = jr.AdmissionJournal(str(tmp_path))
+    a, b = ("ns", "a"), ("ns", "b")
+    j.record("wait", a, since=100.0)
+    j.record("wait", b, since=200.0)
+    j.record("wait_clear", b)
+    j.close()
+    st = jr.AdmissionJournal(str(tmp_path)).replay()
+    assert st.waiting_since == {a: 100.0}
+
+
+def test_journal_compaction_snapshot_roundtrip(tmp_path):
+    j = jr.AdmissionJournal(str(tmp_path))
+    key = ("ns", "g")
+    j.record("reserve", key, hosts={"n1": 4}, demands=[4], age_s=0.0)
+    st = j.replay()
+    j.compact(jr.AdmissionJournal.state_data(
+        st.holds, {("ns", "dead")}, {("ns", "slow"): 42.0}
+    ))
+    j.record("shrink", key, pod="w0", host="n1", chips=4)
+    j.close()
+    st2 = jr.AdmissionJournal(str(tmp_path)).replay()
+    assert key not in st2.holds  # fully consumed: replay drops it
+    assert st2.lapsed == {("ns", "dead")}
+    assert st2.waiting_since == {("ns", "slow"): 42.0}
+
+
+def test_journal_append_failure_degrades_not_raises(tmp_path):
+    j = jr.AdmissionJournal(str(tmp_path))
+    j.record("reserve", ("ns", "g"), hosts={"n1": 1}, age_s=0.0)
+    j.close()
+    # Point the store at an impossible path: appends must not raise.
+    j.store.dir = str(tmp_path / "gone")
+    j.store.journal_path = os.path.join(str(tmp_path), "nope", "x.j")
+    j.record("renew", ("ns", "g"))  # swallowed + counted, no raise
+
+
+def test_self_test_smoke():
+    assert jr.self_test() == 0
+
+
+# ---------------------------------------------------------------------------
+# ReservationTable: observer tap + age-preserving restore
+# ---------------------------------------------------------------------------
+
+def test_observer_sees_every_mutation_kind():
+    clock = FakeClock()
+    t = ReservationTable(ttl_s=10, max_age_s=25, clock=clock)
+    seen = []
+    t.observer = lambda op, key, payload: seen.append((op, key, payload))
+    key = ("ns", "g")
+    t.reserve(key, {"n1": 4}, demands=(2, 2))
+    t.note_scheduled(key, "w0", "n1", 2)
+    t.renew(key)
+    t.drop(key)
+    assert [s[0] for s in seen] == ["reserve", "shrink", "renew", "drop"]
+    assert seen[0][2]["hosts"] == {"n1": 4}
+    assert seen[0][2]["age_s"] == 0.0
+    assert seen[1][2] == {"pod": "w0", "host": "n1", "chips": 2}
+    # Explicit lapse.
+    t.reserve(key, {"n1": 4})
+    clock.t += 26
+    t.lapse(key)
+    assert seen[-1][0] == "lapse"
+
+
+def test_observer_sees_prune_path_exits():
+    """A TTL expiry inside a routine prune journals as drop; an
+    age-cap expiry as lapse — replay must not resurrect either."""
+    clock = FakeClock()
+    t = ReservationTable(ttl_s=10, max_age_s=25, clock=clock)
+    seen = []
+    t.observer = lambda op, key, payload: seen.append((op, key))
+    t.reserve(("ns", "ttl"), {"n1": 1})
+    clock.t += 11  # past TTL, under the cap
+    t.active()
+    assert ("drop", ("ns", "ttl")) in seen
+    t.reserve(("ns", "cap"), {"n1": 1})
+    for _ in range(3):
+        clock.t += 9
+        t.renew(("ns", "cap"))
+    clock.t += 9  # now past the age cap AND expired
+    t.active()
+    assert ("lapse", ("ns", "cap")) in seen
+
+
+def test_renew_skip_if_remaining_suppresses_churn():
+    clock = FakeClock()
+    t = ReservationTable(ttl_s=60, max_age_s=300, clock=clock)
+    seen = []
+    t.observer = lambda op, key, payload: seen.append(op)
+    t.reserve(("ns", "g"), {"n1": 1})
+    # Plenty of runway: healthy, but no extension and no record.
+    assert t.renew(("ns", "g"), skip_if_remaining_s=15.0)
+    assert seen == ["reserve"]
+    clock.t += 50  # 10s runway left (< 15): now it extends.
+    assert t.renew(("ns", "g"), skip_if_remaining_s=15.0)
+    assert seen == ["reserve", "renew"]
+    assert t.active()[("ns", "g")].expires_at == clock.t + 60
+
+
+def test_restore_preserves_age_and_cap():
+    clock = FakeClock()
+    t = ReservationTable(ttl_s=10, max_age_s=100, clock=clock)
+    key = ("ns", "g")
+    assert t.restore(key, {"n1": 4}, age_s=60.0, demands=(4,))
+    # renew() caps extension at created+max_age: 40s of cap left.
+    assert t.renew(key)
+    assert t.active()[key].expires_at == clock.t + 10
+    clock.t += 39
+    assert t.renew(key)
+    clock.t += 2  # age 101 > cap
+    assert not t.renew(key)
+    t.lapse(key)
+    assert t.drain_lapsed() == {key}
+
+
+def test_restore_refuses_past_cap_age():
+    t = ReservationTable(ttl_s=10, max_age_s=100, clock=FakeClock())
+    assert not t.restore(("ns", "g"), {"n1": 4}, age_s=101.0)
+    assert t.active() == {}
+
+
+# ---------------------------------------------------------------------------
+# GangAdmission journal wiring + recovery
+# ---------------------------------------------------------------------------
+
+def released_gang_setup(server, n_chips=4):
+    node, _ = make_node("n1", n=n_chips)
+    server.add_node("n1", node)
+    for i in range(2):
+        server.add_pod(gang_pod(f"w{i}", "train", 2, 2))
+
+
+def test_tick_journals_reserve_and_admit(api, tmp_path):
+    server, client = api
+    released_gang_setup(server)
+    j = jr.AdmissionJournal(str(tmp_path))
+    adm = GangAdmission(
+        client, reservations=ReservationTable(), journal=j
+    )
+    assert adm.tick() == [("default", "train")]
+    adm.journal.flush()
+    raw = open(j.store.journal_path, "rb").read().decode()
+    ops = [json.loads(ln.split(" ", 1)[1])["op"]
+           for ln in raw.splitlines() if ln]
+    assert "reserve" in ops and "admit" in ops
+    # reserve precedes admit (the WAL ordering the recovery relies on).
+    assert ops.index("reserve") < ops.index("admit")
+    j.close()
+
+
+def test_recover_restores_holds_and_finishes_release(api, tmp_path):
+    """The 'post-reserve/pre-gate-patch' story at module level: journal
+    has reserve+admit, gates never came off, process died."""
+    server, client = api
+    released_gang_setup(server)
+    j = jr.AdmissionJournal(str(tmp_path))
+    j.record(
+        "reserve", ("default", "train"),
+        hosts={"n1": 4}, demands=[2, 2], age_s=0.0,
+    )
+    j.record(
+        "admit", ("default", "train"), hosts={"n1": 4}, demands=[2, 2],
+    )
+    j.close()
+    table = ReservationTable()
+    adm = GangAdmission(
+        client, reservations=table,
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    summary = adm.recover()
+    assert summary["holds_restored"] == 1
+    assert table.reserved_chips("n1") == 4  # fenced before any tick
+    # First tick finishes the release against the standing hold.
+    assert adm.tick() == [("default", "train")]
+    from k8s_device_plugin_tpu.extender.gang import GATE_NAME
+
+    for i in range(2):
+        assert GATE_NAME not in gates_of(server, "default", f"w{i}")
+    adm.journal.close()
+
+
+def test_recover_drops_holds_of_vanished_gangs(api, tmp_path):
+    server, client = api
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)  # no gang pods exist
+    j = jr.AdmissionJournal(str(tmp_path))
+    j.record(
+        "reserve", ("default", "ghost"),
+        hosts={"n1": 4}, demands=[4], age_s=0.0,
+    )
+    j.close()
+    table = ReservationTable()
+    adm = GangAdmission(
+        client, reservations=table,
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    summary = adm.recover()
+    assert summary["holds_dropped"] == 1
+    assert table.active() == {}
+    adm.journal.close()
+
+
+def test_recover_without_cluster_truth_restores_conservatively(
+    api, tmp_path
+):
+    server, client = api
+    released_gang_setup(server)
+    j = jr.AdmissionJournal(str(tmp_path))
+    j.record(
+        "reserve", ("default", "train"),
+        hosts={"n1": 4}, demands=[2, 2], age_s=0.0,
+    )
+    j.close()
+    server.faults.add(kind="status", status=503, times=100)
+    table = ReservationTable()
+    client.timeout = 0.5
+    adm = GangAdmission(
+        client, reservations=table,
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    summary = adm.recover()
+    assert summary["cluster_truth"] is False
+    # Conservative direction: the hold is fenced anyway; upkeep
+    # reconciles once the apiserver answers.
+    assert table.reserved_chips("n1") == 4
+    adm.journal.close()
+
+
+def test_recover_lapses_hold_aged_past_cap_while_dead(api, tmp_path):
+    server, client = api
+    released_gang_setup(server)
+    import time as _time
+
+    # Records written 10,000 s "ago": age exceeds any default cap.
+    old = jr.AdmissionJournal(
+        str(tmp_path), clock=lambda: _time.time() - 10000.0
+    )
+    old.record(
+        "reserve", ("default", "train"),
+        hosts={"n1": 4}, demands=[2, 2], age_s=0.0,
+    )
+    old.close()
+    table = ReservationTable()
+    adm = GangAdmission(
+        client, reservations=table,
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    summary = adm.recover()
+    assert summary["holds_lapsed_on_restore"] == 1
+    assert table.active() == {}
+    assert ("default", "train") in adm._lapsed_gangs
+    adm.journal.close()
+
+
+def test_recover_restores_wait_clock(api, tmp_path):
+    server, client = api
+    # Starved gang: 2 pods x 4 chips on one 4-chip node.
+    node, _ = make_node("n1", n=4)
+    server.add_node("n1", node)
+    for i in range(2):
+        server.add_pod(gang_pod(f"s{i}", "starved", 2, 4))
+    j = jr.AdmissionJournal(str(tmp_path))
+    import time as _time
+
+    t_wait = _time.time() - 123.0
+    j.record("wait", ("default", "starved"), since=t_wait)
+    j.close()
+    adm = GangAdmission(
+        client, reservations=ReservationTable(),
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    adm.recover()
+    assert adm._waiting_since[("default", "starved")] == pytest.approx(
+        t_wait, abs=0.01
+    )
+    # The SLO origin keeps counting from the pre-crash wait start.
+    assert (
+        _time.monotonic()
+        - adm._first_complete[("default", "starved")]
+    ) == pytest.approx(123.0, abs=5.0)
+    adm.journal.close()
+
+
+def test_recover_disabled_without_journal(api):
+    _, client = api
+    adm = GangAdmission(client, reservations=ReservationTable())
+    assert adm.recover() == {"status": "disabled"}
+
+
+def test_early_return_tick_still_flushes_buffered_records(api, tmp_path):
+    """A dirty tick whose every gang vanished journals buffered drops
+    and wait_clears, then exits through the no-gangs early return —
+    the end-of-tick flush must cover that path too ('at most one
+    tick's records at risk')."""
+    server, client = api
+    released_gang_setup(server)
+    j = jr.AdmissionJournal(str(tmp_path))
+    adm = GangAdmission(
+        client, reservations=ReservationTable(), journal=j
+    )
+    assert adm.tick() == [("default", "train")]  # hold now standing
+    for i in range(2):
+        server.delete_pod("default", f"w{i}")
+    adm.mark_dirty(("default", "train"))
+    assert adm.tick(full=False) == []  # vanished: early return path
+    # The buffered 'drop' must already be on DISK (no close/flush
+    # here — a SIGKILL now must not lose it).
+    raw = open(j.store.journal_path, "rb").read().decode()
+    ops = [json.loads(ln.split(" ", 1)[1])["op"]
+           for ln in raw.splitlines() if ln]
+    assert "drop" in ops
+    j.close()
+
+
+def test_recover_drops_fully_consumed_hold_without_lapse(api, tmp_path):
+    """A hold whose every host shrank to zero (fully scheduled, not
+    yet pruned when the snapshot was cut) is a plain drop at recovery
+    — NOT a lapse: a spurious lapse bar would block the gang's
+    legitimate future re-fencing."""
+    server, client = api
+    released_gang_setup(server)
+    j = jr.AdmissionJournal(str(tmp_path))
+    j.compact(jr.AdmissionJournal.state_data(
+        {("default", "train"): jr.Hold(
+            hosts={}, demands=(2, 2), counted_pods={"w0", "w1"},
+            created_ts=0.0,
+        )},
+        set(), {},
+    ))
+    j.close()
+    table = ReservationTable()
+    adm = GangAdmission(
+        client, reservations=table,
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    summary = adm.recover()
+    assert summary["holds_lapsed_on_restore"] == 0
+    assert summary["holds_dropped"] == 1
+    assert ("default", "train") not in adm._lapsed_gangs
+    adm.journal.close()
+
+
+def test_lapse_bar_survives_dirty_tick_of_other_gangs(api):
+    """Regression for the bar-erasure hazard: a dirty tick evaluating
+    a SUBSET must not drop the lapse bar of a gang outside it."""
+    server, client = api
+    released_gang_setup(server)
+    adm = GangAdmission(client, reservations=ReservationTable())
+    adm._lapsed_gangs.add(("default", "train"))
+    # Dirty tick about a different gang only.
+    server.add_pod(gang_pod("x0", "other", 2, 2))
+    adm.mark_dirty(("default", "other"))
+    adm.tick(full=False)
+    assert ("default", "train") in adm._lapsed_gangs
+    # The full sweep still prunes bars of gangs that vanished.
+    for name in ("w0", "w1"):
+        server.delete_pod("default", name)
+    adm.tick(full=True)
+    assert ("default", "train") not in adm._lapsed_gangs
+
+
+# ---------------------------------------------------------------------------
+# Readiness gate (server.py /readyz + 503 on scheduler verbs)
+# ---------------------------------------------------------------------------
+
+def test_readiness_gate_holds_filter_until_rehydrated():
+    state = {"ready": False}
+    srv = ExtenderHTTPServer(
+        extender=TopologyExtender(reservations=ReservationTable()),
+        host="127.0.0.1",
+        ready_check=lambda: state["ready"],
+    )
+    url = srv.start()
+    try:
+        # Liveness stays green while NOT ready (alive, not ready).
+        assert requests.get(f"{url}/healthz", timeout=5).status_code == 200
+        r = requests.get(f"{url}/readyz", timeout=5)
+        assert r.status_code == 503
+        assert "rehydrating" in r.json()["reason"]
+        node, _ = make_node("n1")
+        body = {"pod": {}, "nodes": {"items": [node]}}
+        r = requests.post(f"{url}/filter", json=body, timeout=5)
+        assert r.status_code == 503
+        assert "rehydrating" in r.json()["error"]
+        r = requests.post(f"{url}/prioritize", json=body, timeout=5)
+        assert r.status_code == 503
+        state["ready"] = True
+        assert requests.get(f"{url}/readyz", timeout=5).status_code == 200
+        r = requests.post(f"{url}/filter", json=body, timeout=5)
+        assert r.status_code == 200
+        assert [
+            n["metadata"]["name"] for n in r.json()["nodes"]["items"]
+        ] == ["n1"]
+    finally:
+        srv.stop()
+
+
+def test_default_server_is_ready_immediately():
+    srv = ExtenderHTTPServer(
+        extender=TopologyExtender(reservations=ReservationTable()),
+        host="127.0.0.1",
+    )
+    url = srv.start()
+    try:
+        assert requests.get(f"{url}/readyz", timeout=5).status_code == 200
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Bench probe (satellite) + doc/tooling lockstep
+# ---------------------------------------------------------------------------
+
+def test_journal_overhead_probe_schema():
+    from k8s_device_plugin_tpu.extender import scale_bench
+
+    r = scale_bench.journal_overhead(
+        n_nodes=30, n_gangs=5, tick_rounds=6
+    )
+    assert r["nodes"] == 30 and r["gangs"] == 5
+    assert r["unjournaled"]["samples"] == 6
+    assert r["journaled"]["samples"] == 6
+    assert r["journal_bytes"] > 0
+    assert "tick_p99_overhead_pct" in r
+    # The acceptance bound (journaled p99 <= 1.1x) holds at bench scale
+    # (bench.py detail.journal_overhead); at toy scale on a shared CI
+    # box we allow an absolute slack floor against scheduler noise.
+    assert r["journaled"]["p99_ms"] <= max(
+        1.1 * r["unjournaled"]["p99_ms"],
+        r["unjournaled"]["p99_ms"] + 2.0,
+    )
+
+
+def test_crash_recovery_docs_in_lockstep():
+    """The runbook + state-file/readiness docs the satellites require
+    must exist and must name the real artifacts."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ops = open(os.path.join(repo, "docs", "operations.md")).read()
+    assert "Extender crash & failover recovery" in ops
+    assert "--journal-dir" in ops
+    assert "--journal-fsync" in ops
+    assert "journal --self-test" in ops.replace(".", " ").replace(
+        "`", ""
+    ) or "extender.journal --self-test" in ops
+    obs = open(os.path.join(repo, "docs", "observability.md")).read()
+    assert "admission.journal" in obs
+    assert "admission.snapshot.json" in obs
+    assert "/readyz" in obs
+    for op in ("reserve", "shrink", "renew", "drop", "lapse", "admit",
+               "wait", "wait_clear"):
+        assert f"`{op}`" in obs or f" {op} " in obs, op
+    tier1 = open(os.path.join(repo, "scripts", "tier1.sh")).read()
+    assert "extender.journal --self-test" in tier1
+    # The shipped manifest wires the journal + readiness probe
+    # (structural checks live in test_extender.py's manifest test).
+    manifest = open(
+        os.path.join(repo, "deploy", "tpu-extender.yml")
+    ).read()
+    assert "--journal-dir" in manifest and "/readyz" in manifest
