@@ -64,6 +64,11 @@ more complete):
   detail.journal_overhead      journaled vs unjournaled admission-tick
                                p50/p99 (crash-consistent gang state;
                                bound: journaled p99 <= 1.1x)
+  detail.telemetry_overhead    chip-telemetry plane: placeable-tracking
+                               control vs tracked /filter+tick p99
+                               (sampler-off bound <= 1.05x) plus the
+                               documented sampler-tick / node-gauge
+                               recompute costs
   detail.grant     every chip-grant probe attempt
   detail.workload.mfu   model FLOPs/step ÷ step time ÷ chip peak bf16
   detail.workload_chunked_xent.vs_plain_step   chunked-vocab CE A/B
@@ -791,6 +796,20 @@ def main() -> int:
             )
         except Exception as e:  # noqa: BLE001
             result["detail"]["journal_overhead"] = {"error": repr(e)[:400]}
+        emit()
+        # Phase 1.9: chip-telemetry overhead probe (ISSUE 7 — with the
+        # sampler off, the control-plane hot paths must stay within
+        # 1.05x of the placeable-tracking-off control arm; the
+        # sampler-on per-tick and node-gauge recompute costs are
+        # documented alongside).
+        try:
+            result["detail"]["telemetry_overhead"] = (
+                scale_bench.telemetry_overhead(n_nodes=1000)
+            )
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["telemetry_overhead"] = {
+                "error": repr(e)[:400]
+            }
         emit()
 
         # Phase 2a: harvest the t=0 probe loop (VERDICT r3 #1a /

@@ -60,6 +60,13 @@ TRACE_ANNOTATION = "tpu.google.com/trace-context"
 # reconcile — docs/observability.md).
 ADMIT_TS_ANNOTATION = "tpu.google.com/admitted-at"
 
+# Pod label carrying the gang identity (shared with the gang-size label
+# by extender/gang.py). Lives here, not in the extender package, because
+# the plugin daemon's telemetry exporter also reads it: per-chip series
+# are attributed to the holding pod's GANG so "which job is cooking
+# which chip" is one label filter (telemetry.py).
+GANG_NAME_LABEL = "tpu.google.com/gang-name"
+
 # Env var understood the same way as the reference's DP_DISABLE_HEALTHCHECKS
 # (/root/reference/server.go:32-33,231-242): a comma-separated list of
 # check classes to disable. Classes: "all", "events" (inotify fast path;

@@ -117,6 +117,62 @@ def parse_gke_accelerator_label(value: str) -> Optional[str]:
 
 
 @dataclasses.dataclass(frozen=True)
+class IciLinkTelemetry:
+    """State of one ICI link as published by the driver's
+    ``ici/link<K>/{state,errors}`` attributes."""
+
+    link: int
+    up: bool
+    errors: int  # cumulative; >= 0 (unparsable attribute reads 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipTelemetry:
+    """One chip's runtime counters (tpuinfo_chip_telemetry contract).
+
+    Every field is optional — the driver publishes what it publishes —
+    and ``None`` means "attribute absent or garbled", never 0: a chip
+    idling at duty 0 and a chip with no duty attribute are different
+    facts, and the exporter must not invent zeros for the latter.
+    """
+
+    index: int
+    duty_cycle_pct: Optional[float] = None
+    hbm_used_bytes: Optional[int] = None
+    temp_c: Optional[float] = None
+    power_w: Optional[float] = None
+    links: Tuple[IciLinkTelemetry, ...] = ()
+
+    def hbm_used_ratio(self, hbm_total_bytes: int) -> Optional[float]:
+        """HBM pressure as a 0–1 fraction, or None when it cannot be
+        computed honestly: used bytes unpublished, OR the chip has no
+        known HBM spec (``hbm_bytes == 0`` — the scanner's zero-spec
+        fallback for unknown generations, discovery/scanner.py). The
+        zero-spec case must degrade to "unknown", not divide by zero
+        or export a nonsense ratio."""
+        if self.hbm_used_bytes is None or hbm_total_bytes <= 0:
+            return None
+        return min(max(self.hbm_used_bytes / hbm_total_bytes, 0.0), 1.0)
+
+    def to_dict(self, hbm_total_bytes: int = 0) -> dict:
+        """JSON-able form for /debug/telemetry; ``hbm_used_pct`` is
+        null (not 0, not infinity) on zero-spec chips."""
+        ratio = self.hbm_used_ratio(hbm_total_bytes)
+        return {
+            "index": self.index,
+            "duty_cycle_pct": self.duty_cycle_pct,
+            "hbm_used_bytes": self.hbm_used_bytes,
+            "hbm_total_bytes": hbm_total_bytes or None,
+            "hbm_used_pct": (
+                round(ratio * 100.0, 1) if ratio is not None else None
+            ),
+            "temp_c": self.temp_c,
+            "power_w": self.power_w,
+            "links": [dataclasses.asdict(l) for l in self.links],
+        }
+
+
+@dataclasses.dataclass(frozen=True)
 class TpuChip:
     """One discovered TPU chip.
 

@@ -17,7 +17,14 @@ import ctypes
 import os
 from typing import List, Optional
 
-from .chips import DEVICE_ID_TO_TYPE, GOOGLE_VENDOR_ID, TpuChip, spec_for
+from .chips import (
+    DEVICE_ID_TO_TYPE,
+    GOOGLE_VENDOR_ID,
+    ChipTelemetry,
+    IciLinkTelemetry,
+    TpuChip,
+    spec_for,
+)
 from ..utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -29,6 +36,50 @@ DEFAULT_NUMA_DIR = "/sys/devices/system/node"
 _TPUINFO_MAX_CHIPS = 16
 _PATH_LEN = 128
 _TYPE_LEN = 16
+_MAX_LINKS = 8  # TPUINFO_MAX_LINKS
+
+# tpuinfo_chip_telemetry_t field bits (TPUINFO_TELEM_*).
+_TELEM_DUTY = 1
+_TELEM_HBM = 2
+_TELEM_TEMP = 4
+_TELEM_POWER = 8
+
+
+class _CChipTelemetry(ctypes.Structure):
+    # Mirrors tpuinfo_chip_telemetry_t in native/tpuinfo/tpuinfo.h.
+    _fields_ = [
+        ("fields", ctypes.c_int),
+        ("duty_cycle_pct", ctypes.c_double),
+        ("hbm_used_bytes", ctypes.c_longlong),
+        ("temp_c", ctypes.c_double),
+        ("power_w", ctypes.c_double),
+        ("link_count", ctypes.c_int),
+        ("link_id", ctypes.c_int * _MAX_LINKS),
+        ("link_up", ctypes.c_int * _MAX_LINKS),
+        ("link_errors", ctypes.c_longlong * _MAX_LINKS),
+    ]
+
+
+def _telemetry_from_cstruct(index: int, t: "_CChipTelemetry") -> ChipTelemetry:
+    return ChipTelemetry(
+        index=index,
+        duty_cycle_pct=(
+            t.duty_cycle_pct if t.fields & _TELEM_DUTY else None
+        ),
+        hbm_used_bytes=(
+            t.hbm_used_bytes if t.fields & _TELEM_HBM else None
+        ),
+        temp_c=t.temp_c if t.fields & _TELEM_TEMP else None,
+        power_w=t.power_w if t.fields & _TELEM_POWER else None,
+        links=tuple(
+            IciLinkTelemetry(
+                link=t.link_id[i],
+                up=bool(t.link_up[i]),
+                errors=t.link_errors[i],
+            )
+            for i in range(min(t.link_count, _MAX_LINKS))
+        ),
+    )
 
 
 class _CNumaNode(ctypes.Structure):
@@ -135,6 +186,22 @@ class NativeTpuInfo:
             self._has_host_surfaces = True
         except AttributeError:
             self._has_host_surfaces = False
+        # Telemetry is the newest surface; a stale .so degrades to
+        # "no counters published" (the sampler exports nothing but the
+        # daemon keeps running) rather than crashing at startup.
+        try:
+            self._lib.tpuinfo_chip_telemetry.restype = ctypes.c_int
+            self._lib.tpuinfo_chip_telemetry.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(_CChipTelemetry),
+            ]
+            self._has_telemetry = True
+        except AttributeError:
+            log.warning(
+                "libtpuinfo.so lacks tpuinfo_chip_telemetry; chip "
+                "telemetry disabled (rebuild native/tpuinfo)"
+            )
+            self._has_telemetry = False
         # Event API is newer than the core symbols: a stale .so (version
         # skew via TPUINFO_LIB) must degrade to interval polling, not
         # crash the daemon at startup with an AttributeError get_backend
@@ -251,6 +318,24 @@ class NativeTpuInfo:
             return None
         return (buf[0], buf[1], buf[2])
 
+    def chip_telemetry(
+        self, sysfs_accel_dir: str, index: int
+    ) -> ChipTelemetry:
+        """Runtime counters for chip accel<index>
+        (tpuinfo_chip_telemetry): duty cycle, HBM in use, temperature,
+        power, per-ICI-link state + error counters. Absent attributes
+        are None/empty, a missing chip raises. Result-identical to
+        PyTpuInfo.chip_telemetry (parity-tested)."""
+        if not self._has_telemetry:
+            return ChipTelemetry(index=index)
+        t = _CChipTelemetry()
+        r = self._lib.tpuinfo_chip_telemetry(
+            sysfs_accel_dir.encode(), index, ctypes.byref(t)
+        )
+        if r < 0:
+            raise OSError(-r, f"tpuinfo_chip_telemetry(accel{index}) failed")
+        return _telemetry_from_cstruct(index, t)
+
     def host_info(self, proc_dir: str = "/proc") -> dict:
         """Host CPU/memory summary (reference schema parity,
         /root/reference/device.go:19-97)."""
@@ -344,6 +429,93 @@ def _normalize_reason(raw: bytes) -> str:
         else:
             out.append("_")
     return "".join(out)
+
+
+# The telemetry integer grammar, shared with the native
+# TryReadLongLong (tpuinfo.cc): optional sign, then plain decimal
+# WITHOUT leading zeros, bare "0", or 0x hex. Deliberately narrower
+# than both int(s, 0) and strtoll base 0 — Python's "1_0"/"0o10" and
+# C's leading-zero octal ("010" → 8) would otherwise parse on exactly
+# one backend, breaking the byte-identical parity contract. Matched on
+# RAW BYTES (a failing driver can write arbitrary bytes, and a text
+# decode would raise right here — the same rule as the link-state and
+# health-token reads); any non-ASCII byte simply fails the match.
+import re as _re
+
+_STRICT_INT_RE = _re.compile(
+    rb"[+-]?(?:0[xX][0-9a-fA-F]+|[1-9][0-9]*|0)\Z"
+)
+# strtoll's value range: the native side rejects with ERANGE past
+# LLONG_MAX; Python's unbounded int must reject the same tokens.
+_INT64_MIN, _INT64_MAX = -(2**63), 2**63 - 1
+
+
+def _read_strict_int(path: str) -> Optional[int]:
+    """Telemetry-grade integer attribute read: present, non-empty, the
+    WHOLE trimmed byte token matches the shared grammar above, and the
+    value fits in a signed 64-bit integer — byte-identical
+    accept/reject behavior to the native TryReadLongLong (tpuinfo.cc,
+    parity-tested). The looser _read_int stays for the legacy identity
+    attributes."""
+    s = _read_bytes_trimmed(path)
+    if not s or not _STRICT_INT_RE.match(s):
+        return None
+    v = int(s, 0)
+    if not (_INT64_MIN <= v <= _INT64_MAX):
+        return None
+    return v
+
+
+def _telemetry_from_devdir(devdir: str, index: int) -> ChipTelemetry:
+    """The attribute walk behind both layouts' telemetry reads —
+    mirrors the native TelemetryFromDevdir (tpuinfo.cc) byte-for-byte:
+    strict non-negative integers for duty/hbm/power, signed for temp,
+    ``ici/link<K>/state`` is up only when it reads (ASCII-lowered)
+    "up", link errors default to 0, links sorted by K and truncated at
+    the native TPUINFO_MAX_LINKS."""
+    duty = _read_strict_int(os.path.join(devdir, "duty_cycle_pct"))
+    if duty is not None and duty < 0:
+        duty = None
+    hbm = _read_strict_int(os.path.join(devdir, "hbm_used_bytes"))
+    if hbm is not None and hbm < 0:
+        hbm = None
+    millic = _read_strict_int(os.path.join(devdir, "temp_millic"))
+    uw = _read_strict_int(os.path.join(devdir, "power_uw"))
+    if uw is not None and uw < 0:
+        uw = None
+    ici = os.path.join(devdir, "ici")
+    try:
+        names = os.listdir(ici)
+    except OSError:
+        names = []
+    link_ids = sorted(
+        int(n[4:]) for n in names if n.startswith("link") and n[4:].isdigit()
+    )[:_MAX_LINKS]
+    links = []
+    for k in link_ids:
+        base = os.path.join(ici, f"link{k}")
+        # Raw-byte read + ASCII-only lowering, like the native shim and
+        # the health token path: a failing link can write arbitrary
+        # bytes, and a strict text decode would raise exactly when the
+        # state matters most (locale-independent parity).
+        state = bytes(
+            b + 0x20 if 0x41 <= b <= 0x5A else b
+            for b in _read_bytes_trimmed(os.path.join(base, "state"))
+        )
+        errors = _read_strict_int(os.path.join(base, "errors"))
+        if errors is None or errors < 0:
+            errors = 0
+        links.append(
+            IciLinkTelemetry(link=k, up=state == b"up", errors=errors)
+        )
+    return ChipTelemetry(
+        index=index,
+        duty_cycle_pct=float(duty) if duty is not None else None,
+        hbm_used_bytes=hbm,
+        temp_c=millic / 1000.0 if millic is not None else None,
+        power_w=uw / 1e6 if uw is not None else None,
+        links=tuple(links),
+    )
 
 
 def _pci_addr(devdir: str) -> str:
@@ -529,6 +701,17 @@ class PyTpuInfo:
         if not os.path.exists(path):
             return None
         return _parse_coords_attr(path)
+
+    def chip_telemetry(
+        self, sysfs_accel_dir: str, index: int
+    ) -> ChipTelemetry:
+        """Result-identical to NativeTpuInfo.chip_telemetry
+        (tpuinfo.h): runtime counters off accel<index>'s device dir;
+        absent attributes are None/empty, a missing chip raises."""
+        base = os.path.join(sysfs_accel_dir, f"accel{index}")
+        if not os.path.exists(base):
+            raise FileNotFoundError(base)
+        return _telemetry_from_devdir(os.path.join(base, "device"), index)
 
     def host_info(self, proc_dir: str = "/proc") -> dict:
         """Result-identical to NativeTpuInfo.host_info (tpuinfo.h)."""

@@ -44,6 +44,7 @@ from typing import List, Optional
 
 from ..utils.logging import get_logger
 from .chips import DEVICE_ID_TO_TYPE, GOOGLE_VENDOR_ID, TpuChip, spec_for
+from .chips import ChipTelemetry
 from .scanner import (
     NativeTpuInfo,
     _normalize_reason,
@@ -51,6 +52,7 @@ from .scanner import (
     _pci_addr,
     _read_bytes_trimmed,
     _read_int,
+    _telemetry_from_devdir,
 )
 
 log = get_logger(__name__)
@@ -235,6 +237,22 @@ class VfioTpuInfo:
                 return _parse_coords_attr(path)
         return None
 
+    # -- telemetry ---------------------------------------------------------
+
+    def chip_telemetry(
+        self, iommu_groups_dir: str, index: int
+    ) -> ChipTelemetry:
+        """Runtime counters for the group's chip, read off its identity
+        function (the same funcs[0] pick the scanner advertises it by)
+        — result-identical to tpuinfo_vfio_chip_telemetry."""
+        base = os.path.join(iommu_groups_dir, str(index))
+        if not os.path.isdir(base):
+            raise FileNotFoundError(base)
+        funcs = self._tpu_device_dirs(iommu_groups_dir, index)
+        if not funcs:
+            return ChipTelemetry(index=index)
+        return _telemetry_from_devdir(funcs[0][1], index)
+
 
 class NativeVfioTpuInfo:
     """vfio scanning through libtpuinfo.so (tpuinfo_scan_vfio & co. in
@@ -276,6 +294,25 @@ class NativeVfioTpuInfo:
             ]
         except AttributeError as e:
             raise OSError(f"libtpuinfo.so predates the vfio surface: {e}")
+        # Telemetry is newer than the vfio core: degrade (no counters)
+        # on a stale .so rather than rejecting the whole native path —
+        # the same contract as NativeTpuInfo._has_telemetry.
+        from .scanner import _CChipTelemetry
+
+        self._ctelemetry = _CChipTelemetry
+        try:
+            lib.tpuinfo_vfio_chip_telemetry.restype = ctypes.c_int
+            lib.tpuinfo_vfio_chip_telemetry.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(_CChipTelemetry),
+            ]
+            self._has_telemetry = True
+        except AttributeError:
+            log.warning(
+                "libtpuinfo.so lacks tpuinfo_vfio_chip_telemetry; chip "
+                "telemetry disabled (rebuild native/tpuinfo)"
+            )
+            self._has_telemetry = False
         self._lib = lib
 
     def version(self) -> str:
@@ -362,6 +399,25 @@ class NativeVfioTpuInfo:
         if r == 0:
             return None
         return (xyz[0], xyz[1], xyz[2])
+
+    def chip_telemetry(
+        self, iommu_groups_dir: str, index: int
+    ) -> ChipTelemetry:
+        """Result-identical to VfioTpuInfo.chip_telemetry
+        (tpuinfo_vfio_chip_telemetry; parity-tested)."""
+        from .scanner import _telemetry_from_cstruct
+
+        if not self._has_telemetry:
+            return ChipTelemetry(index=index)
+        t = self._ctelemetry()
+        r = self._lib.tpuinfo_vfio_chip_telemetry(
+            iommu_groups_dir.encode(), index, self._ctypes.byref(t)
+        )
+        if r < 0:
+            raise OSError(
+                -r, f"tpuinfo_vfio_chip_telemetry(group {index}) failed"
+            )
+        return _telemetry_from_cstruct(index, t)
 
 
 _VFIO_BACKEND_CACHE: dict = {}

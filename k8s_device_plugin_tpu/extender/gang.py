@@ -59,7 +59,9 @@ from .reservations import DEFAULT_TABLE, ReservationTable
 log = get_logger(__name__)
 
 GATE_NAME = "tpu.google.com/gang"
-GANG_NAME_LABEL = "tpu.google.com/gang-name"
+# Single source in api/constants.py (the telemetry exporter reads it
+# too); re-exported here for the existing import sites.
+GANG_NAME_LABEL = constants.GANG_NAME_LABEL
 GANG_SIZE_LABEL = "tpu.google.com/gang-size"
 
 # Dependency sentinel for the slice→gangs index: a waiting gang with any

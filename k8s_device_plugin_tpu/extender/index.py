@@ -35,6 +35,8 @@ import dataclasses
 import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from .. import telemetry
+from ..topology.placement import fragmentation_stats
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..utils import metrics
 from ..utils.logging import get_logger
@@ -74,6 +76,12 @@ class IndexEntry:
     chip_count: int = 0
     hostname: str = ""
     slice_key: Optional[SliceKey] = None  # None = standalone host
+    # Power-of-two request sizes a contiguous free box currently fits
+    # for, derived at entry build (topology/placement.fragmentation_
+    # stats over the published availability) — the per-node term of the
+    # cluster capacity aggregate (tpu_extender_placeable_nodes); costs
+    # nothing on the RPC path, a few bitmask tests per REBUILD.
+    placeable: Tuple[int, ...] = ()
 
 
 class TopologyIndex:
@@ -84,6 +92,7 @@ class TopologyIndex:
         on_change: Optional[
             Callable[[str, Tuple[SliceKey, ...]], None]
         ] = None,
+        track_placeable: bool = True,
     ):
         # Nodes WITH a published annotation. Values are immutable and
         # replaced whole, so lock-free .get() reads are safe.
@@ -98,6 +107,76 @@ class TopologyIndex:
         # every slice key involved (old and new) — gang admission's
         # dirty marking hangs off this.
         self.on_change = on_change
+        # Cluster capacity aggregate: size → count of nodes whose entry
+        # says a contiguous box of that size is placeable, maintained
+        # incrementally as entries change (never recomputed over the
+        # whole cluster). ``track_placeable=False`` is the bench's
+        # control arm (scale_bench.telemetry_overhead).
+        self.track_placeable = track_placeable
+        self._placeable_counts: Dict[int, int] = {}
+        # /debug/telemetry's cluster panel reads the latest-constructed
+        # index of this process (one per extender daemon).
+        telemetry.CLUSTER_PROVIDER = self.placeable_snapshot
+
+    # -- capacity aggregate ------------------------------------------------
+
+    def _placeable_for(self, topo: Optional[NodeTopology]) -> Tuple[int, ...]:
+        if not self.track_placeable or topo is None:
+            return ()
+        try:
+            stats = fragmentation_stats(topo.to_mesh(), topo.available)
+        except Exception:  # noqa: BLE001 — a weird annotation costs its
+            # node's aggregate term, never index maintenance
+            log.exception("placeable-size derivation failed")
+            return ()
+        return tuple(
+            n for n, ok in sorted(stats["placeable"].items()) if ok
+        )
+
+    def _adjust_placeable_locked(
+        self,
+        old: Optional[IndexEntry],
+        new: Optional[IndexEntry],
+    ) -> Set[int]:
+        changed: Set[int] = set()
+        for n in old.placeable if old is not None else ():
+            self._placeable_counts[n] = self._placeable_counts.get(n, 0) - 1
+            changed.add(n)
+        for n in new.placeable if new is not None else ():
+            self._placeable_counts[n] = self._placeable_counts.get(n, 0) + 1
+            changed.add(n)
+        return changed
+
+    def _publish_placeable_locked(self, sizes: Set[int]) -> None:
+        """Caller holds self._lock: the count read, the zero-count pop,
+        AND the gauge write must be one atomic step — published outside
+        the lock, a concurrent update on another thread (watch vs
+        relist vs RPC-path fetch) could interleave its +1 between this
+        thread's count read and its series removal, destroying the
+        increment and dropping a size that IS placeable."""
+        for n in sizes:
+            count = self._placeable_counts.get(n, 0)
+            if count > 0:
+                metrics.EXT_PLACEABLE_NODES.set(count, size=str(n))
+            else:
+                # A size no node can place anymore drops its series
+                # (Metric.remove) — the emptied-state contract the
+                # per-chip telemetry families follow too.
+                self._placeable_counts.pop(n, None)
+                metrics.EXT_PLACEABLE_NODES.remove(size=str(n))
+
+    def placeable_snapshot(self) -> dict:
+        """size → count of nodes that can place a contiguous box of
+        that size right now (the /debug/telemetry cluster panel)."""
+        with self._lock:
+            return {
+                "placeable_nodes": {
+                    str(n): c
+                    for n, c in sorted(self._placeable_counts.items())
+                    if c > 0
+                },
+                "nodes_with_topology": len(self._entries),
+            }
 
     # -- mutation ----------------------------------------------------------
 
@@ -116,6 +195,9 @@ class TopologyIndex:
                 if prev is None and name in self._no_topo:
                     return "noop"
                 self._no_topo.add(name)
+                self._publish_placeable_locked(
+                    self._adjust_placeable_locked(prev, None)
+                )
                 if prev is not None:
                     self._drop_membership_locked(name, prev.slice_key)
             if prev is not None:
@@ -144,6 +226,7 @@ class TopologyIndex:
                     if len(topo.slice_hosts) > 1
                     else None
                 ),
+                placeable=self._placeable_for(topo),
             )
         with self._lock:
             # Re-read under the lock: relist, watch, and RPC-path fetch
@@ -152,6 +235,9 @@ class TopologyIndex:
             prev = self._entries.get(name)
             self._no_topo.discard(name)
             self._entries[name] = entry
+            self._publish_placeable_locked(
+                self._adjust_placeable_locked(prev, entry)
+            )
             if prev is not None and prev.slice_key != entry.slice_key:
                 self._drop_membership_locked(name, prev.slice_key)
             if entry.slice_key is not None:
@@ -168,6 +254,9 @@ class TopologyIndex:
             prev = self._entries.pop(name, None)
             was_known = prev is not None or name in self._no_topo
             self._no_topo.discard(name)
+            self._publish_placeable_locked(
+                self._adjust_placeable_locked(prev, None)
+            )
             if prev is not None:
                 self._drop_membership_locked(name, prev.slice_key)
         if prev is not None:

@@ -56,6 +56,7 @@ from ..api import constants
 from ..discovery.chips import TpuChip
 from ..utils import metrics
 from ..utils.decisions import LEDGER
+from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -198,7 +199,53 @@ class HealthWatcher:
             self._app_fault.pop(cid, None)
             if healthy != self._last[cid]:
                 self._last[cid] = healthy
+                if not healthy and reason == "ici_link_down":
+                    # The health attribute and the per-link telemetry
+                    # (ici/link*/state — telemetry.py samples the same
+                    # surface) must tell one story: corroborate before
+                    # the withdrawal propagates, so "which link, how
+                    # many errors" rides the transition instead of
+                    # waiting for the next sampler tick — and a
+                    # DISAGREEMENT (health says link down, every link
+                    # reads up) is flagged as its own fault.
+                    self._corroborate_link_fault(chip, cid)
                 self._callback(cid, healthy)
+
+    def _corroborate_link_fault(self, chip: TpuChip, cid: str) -> None:
+        """Cross-check an ``ici_link_down`` health reason against the
+        backend's per-link telemetry. Flight-records the evidence
+        (``ici_link_fault``) either way; warns when the two readings of
+        the same sysfs surface disagree. Never blocks or fails the
+        transition — corroboration is evidence, not a veto."""
+        if not hasattr(self._backend, "chip_telemetry"):
+            return
+        try:
+            tel = self._backend.chip_telemetry(self._sysfs, chip.index)
+        except (OSError, ValueError) as e:
+            log.warning("link telemetry read failed for %s: %s", cid, e)
+            return
+        down = [l.link for l in tel.links if not l.up]
+        corroborated = bool(down)
+        RECORDER.record(
+            "ici_link_fault",
+            f"chip {cid} health reads ici_link_down; telemetry shows "
+            + (
+                f"link(s) {','.join(str(k) for k in down)} down"
+                if down
+                else "no link down"
+            ),
+            chip=cid,
+            down_links=",".join(str(k) for k in down),
+            link_errors=sum(l.errors for l in tel.links),
+            corroborated=corroborated,
+        )
+        if tel.links and not corroborated:
+            log.warning(
+                "chip %s: health attribute reports ici_link_down but "
+                "every ici/link*/state reads up — the two surfaces "
+                "disagree; trust the withdrawal, suspect the driver",
+                cid,
+            )
 
     def _run(self) -> None:
         disabled = disabled_health_classes()

@@ -156,6 +156,60 @@ class PodResourcesClient:
         return self.device_ids_by_pod(resource_name).get((namespace, name))
 
 
+    def pod_container_device_ids(
+        self, namespace: str, name: str, resource_name: str
+    ) -> Optional[Dict[str, List[str]]]:
+        """container name → kubelet device IDs for one pod, or None
+        when the kubelet has no entry. The per-container dimension the
+        flat lookups above throw away — the telemetry exporter needs it
+        to label a chip's series with the CONTAINER that mounted it
+        (the checkpoint fallback has no container field, so checkpoint-
+        only kubelets attribute to the pod and leave container empty)."""
+        if not self._get_unimplemented:
+            try:
+                resp = self._call(
+                    "Get",
+                    pb.GetPodResourcesRequest(
+                        pod_name=name, pod_namespace=namespace
+                    ),
+                )
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code in (
+                    grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                ):
+                    raise
+                if code == grpc.StatusCode.UNIMPLEMENTED:
+                    self._get_unimplemented = True
+            else:
+                out = _ids_by_container(
+                    resp.pod_resources.containers, resource_name
+                )
+                return out or None
+        for pod in self.list():
+            if (pod.namespace, pod.name) == (namespace, name):
+                return (
+                    _ids_by_container(pod.containers, resource_name)
+                    or None
+                )
+        return None
+
+
+def _ids_by_container(
+    containers, resource_name: str
+) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = {}
+    for container in containers:
+        ids: List[str] = []
+        for dev in container.devices:
+            if dev.resource_name == resource_name:
+                ids.extend(dev.device_ids)
+        if ids:
+            out[container.name] = ids
+    return out
+
+
 def _ids_for_resource(containers, resource_name: str) -> List[str]:
     ids: List[str] = []
     for container in containers:

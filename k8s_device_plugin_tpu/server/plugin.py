@@ -380,9 +380,30 @@ class TpuDevicePlugin(DevicePluginServicer):
                 log.exception("availability-change hook failed")
 
     def _update_chip_gauges(self) -> None:
-        metrics.CHIPS.set(len(self.state.allocated), state="allocated")
-        metrics.CHIPS.set(len(self.state.unhealthy), state="unhealthy")
-        metrics.CHIPS.set(len(self.state.available()), state="available")
+        available = self.state.available()
+        # Event-ish states drop their series when they empty
+        # (Metric.remove) instead of lingering at 0 — "no unhealthy
+        # chips" reads as an absent series, the same shape the
+        # per-chip telemetry families use after a free. The structural
+        # states (total/available) always render, 0 included: an
+        # exhausted node is a fact, not a stale series.
+        for state, count in (
+            ("allocated", len(self.state.allocated)),
+            ("unhealthy", len(self.state.unhealthy)),
+        ):
+            if count:
+                metrics.CHIPS.set(count, state=state)
+            else:
+                metrics.CHIPS.remove(state=state)
+        metrics.CHIPS.set(len(available), state="available")
+        # Capacity/fragmentation gauges ride the same hook: every
+        # allocate/free/health transition recomputes largest-placeable-
+        # box / free-chips / fragmentation-index over the precomputed
+        # box space (telemetry.update_node_gauges — bitmask tests only;
+        # bounded by bench.py detail.telemetry_overhead).
+        from .. import telemetry
+
+        telemetry.update_node_gauges(self.mesh, available)
 
     def _bump(self) -> None:
         with self._version_cv:

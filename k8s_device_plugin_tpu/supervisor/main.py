@@ -116,6 +116,12 @@ class DaemonConfig:
     # chip health transitions, app-fault skips, and evictions become
     # queryable records at /debug/decisions. Implied by trace.
     decisions: bool = False
+    # Chip-telemetry sampler (telemetry.py): per-chip duty/HBM/temp/
+    # power/ICI-link series with pod/gang attribution, off the gRPC hot
+    # path on its own thread. 0 (the default) means no sampler at all —
+    # the disabled path is a no-op like --trace (measured by bench.py
+    # detail.telemetry_overhead).
+    telemetry_interval_s: float = 0.0
 
 
 class Daemon:
@@ -147,6 +153,7 @@ class Daemon:
         self.health: Optional[HealthWatcher] = None
         self.controller = None  # set by kube wiring when enabled
         self.dra = None  # set by _start_dra when enabled
+        self.telemetry_sampler = None  # set by _start_telemetry when on
         self._kube = None
         self._kube_client = None  # pre-serve client (build_and_serve)
         # GKE-label-derived chip type (per generation; never written into
@@ -350,6 +357,30 @@ class Daemon:
         self._start_kube_integration(mesh)
         if self.cfg.enable_dra:
             self._start_dra()
+        self._start_telemetry(mesh, chips)
+
+    def _start_telemetry(self, mesh: IciMesh, chips: List[TpuChip]) -> None:
+        """Chip-telemetry sampler (telemetry.py): built LAST so the
+        controller exists and its chip→pod allocation map can label the
+        series; 0 chips or interval 0 means no thread at all."""
+        if self.cfg.telemetry_interval_s <= 0 or not chips:
+            return
+        from .. import telemetry
+
+        attribution = (
+            self.controller.chip_attribution
+            if self.controller is not None
+            else None
+        )
+        self.telemetry_sampler = telemetry.TelemetrySampler(
+            self.backend,
+            self.scan_dirs[0],
+            mesh,
+            interval_s=self.cfg.telemetry_interval_s,
+            attribution=attribution,
+        )
+        telemetry.install_sampler(self.telemetry_sampler)
+        self.telemetry_sampler.start()
 
     def _start_dra(self) -> None:
         """DRA plane (resource.k8s.io): DRAPlugin service + ResourceSlice.
@@ -407,6 +438,15 @@ class Daemon:
             self.controller = None
 
     def teardown(self) -> None:
+        if self.telemetry_sampler is not None:
+            from .. import telemetry
+
+            try:
+                self.telemetry_sampler.stop()
+            except Exception:
+                log.exception("telemetry sampler stop failed")
+            telemetry.install_sampler(None)
+            self.telemetry_sampler = None
         if self.dra is not None:
             try:
                 self.dra.stop()
@@ -584,6 +624,14 @@ def parse_args(argv) -> DaemonConfig:
                    "and evictions become queryable records at "
                    "/debug/decisions. Implied by --trace; off = exact "
                    "no-op")
+    p.add_argument("--telemetry-interval-s", type=float,
+                   default=float(os.environ.get(
+                       "TPU_TELEMETRY_INTERVAL_S", "0") or 0),
+                   help="sample per-chip telemetry (duty cycle, HBM in "
+                   "use, temperature, power, ICI link state) every N "
+                   "seconds and export tpu_chip_* series labeled by the "
+                   "holding pod/gang (also TPU_TELEMETRY_INTERVAL_S); "
+                   "0 disables the sampler entirely")
     p.add_argument("--log-json", action="store_true",
                    help="JSON-lines logging with trace correlation "
                    "(also TPU_LOG_JSON=1)")
@@ -633,6 +681,7 @@ def parse_args(argv) -> DaemonConfig:
         log_json=a.log_json,
         flight_dir=a.flight_dir,
         decisions=a.decisions,
+        telemetry_interval_s=a.telemetry_interval_s,
     )
 
 

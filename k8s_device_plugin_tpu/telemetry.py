@@ -1,0 +1,385 @@
+"""Per-chip telemetry exporter with pod/gang attribution.
+
+The DCGM-exporter idiom, in-process: the reference leaves hardware
+telemetry to a sidecar that polls NVML and joins each GPU's series to
+the pod holding it via the kubelet PodResources API; here the daemon
+already owns both halves — the discovery backend grew a runtime-counter
+surface (``chip_telemetry``: duty cycle, HBM in use, temperature,
+power, per-ICI-link state/errors — native/tpuinfo/tpuinfo.h, identical
+across the ctypes and pure-Python backends) and the controller already
+maintains the chip→pod allocation map (podresources/checkpoint) — so
+one sampler thread joins them and publishes the ``tpu_chip_*`` families
+labeled by ``chip`` plus, when attributed, ``pod``/``namespace``/
+``container``/``gang``.
+
+Design rules:
+
+* **Off is the default and costs nothing**: the sampler only exists
+  when ``--telemetry-interval-s > 0`` — no thread, no reads, and the
+  gRPC hot path never touches this module (the node fragmentation
+  gauges ride the existing availability-change hook, measured by
+  bench.py's ``detail.telemetry_overhead`` probe).
+* **No invented zeros**: an absent driver attribute removes the series
+  (``Metric.remove``) rather than exporting 0 — a chip with no
+  temperature sensor and a chip at 0 °C are different facts.
+* **Stale series are pruned**: when a chip's attribution changes (pod
+  freed, pod vanished, new holder) every series the chip exported under
+  the old label set is dropped (``Metric.remove_matching``) before the
+  new one is written — a scrape after free never shows the dead pod.
+* **Thresholds flight-record**: duty/HBM/temperature crossings land in
+  the flight recorder (``chip_thermal``, ``chip_hbm_pressure`` kinds,
+  deduped while the condition persists) so a post-mortem dump carries
+  the thermal story next to the allocation story.
+
+The capacity/fragmentation plane shares this module: the daemon's
+``update_node_gauges`` (called from the plugin on every allocate/free/
+health transition) publishes largest-placeable-box / free-chips /
+fragmentation-index gauges from ``topology.placement
+.fragmentation_stats``, and the extender's incremental topology index
+registers a cluster-aggregate provider (placeable nodes per request
+size) — both surfaced at ``GET /debug/telemetry`` on the respective
+HTTP servers via ``metrics.debug_payload``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from .topology.placement import fragmentation_stats
+from .utils import metrics
+from .utils.flightrecorder import RECORDER
+from .utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# Attribution labels joined from the controller's allocation map; empty
+# values are OMITTED (an unattributed chip exports chip-only series —
+# Prometheus treats a missing label and an empty label as the same
+# series, and our renderer must not print pod="" ghosts).
+ATTRIBUTION_LABELS = ("pod", "namespace", "container", "gang")
+
+# Every family that carries a per-chip label set — the prune list for
+# "this chip's attribution changed / this chip vanished".
+CHIP_FAMILIES = (
+    metrics.CHIP_DUTY_CYCLE,
+    metrics.CHIP_HBM_USED,
+    metrics.CHIP_HBM_RATIO,
+    metrics.CHIP_TEMP,
+    metrics.CHIP_POWER,
+    metrics.CHIP_LINK_UP,
+    metrics.CHIP_LINK_ERRORS,
+)
+
+# Flight-recorder thresholds (overridable per sampler): TPU throttle
+# points sit near 95-100 °C, so 90 °C is "look now"; HBM above 95% is
+# one allocation away from an OOM.
+DEFAULT_TEMP_THRESHOLD_C = 90.0
+DEFAULT_HBM_PRESSURE_RATIO = 0.95
+
+# Process-global surface for /debug/telemetry (one daemon per process,
+# like RECORDER / the metrics registries).
+SAMPLER: Optional["TelemetrySampler"] = None
+# Last node fragmentation stats written by update_node_gauges.
+NODE_STATS: Optional[dict] = None
+# The extender's cluster aggregate (set by extender/index.py).
+CLUSTER_PROVIDER: Optional[Callable[[], dict]] = None
+
+
+def update_node_gauges(mesh, free_ids) -> dict:
+    """Publish the node capacity/fragmentation gauges for the current
+    healthy-and-free chip set. Called by the plugin on every
+    allocate/free/health transition (TpuDevicePlugin._update_chip_gauges)
+    — cheap by construction: the box space is precomputed per mesh
+    geometry (topology/placement.box_candidates), only bitmask tests
+    run here."""
+    global NODE_STATS
+    stats = fragmentation_stats(mesh, free_ids)
+    metrics.NODE_FREE_CHIPS.set(stats["free"])
+    metrics.NODE_LARGEST_BOX.set(stats["largest_box"])
+    metrics.NODE_FRAGMENTATION.set(stats["fragmentation"])
+    current = {str(s) for s in stats["placeable"]}
+    for labels, _ in metrics.NODE_BOX_PLACEABLE.series():
+        # A SIGHUP rebuild can shrink the mesh; sizes the new host
+        # shape doesn't track must not linger at their old value.
+        if labels.get("size") not in current:
+            metrics.NODE_BOX_PLACEABLE.remove(**labels)
+    for size, ok in stats["placeable"].items():
+        metrics.NODE_BOX_PLACEABLE.set(1 if ok else 0, size=str(size))
+    NODE_STATS = stats
+    return stats
+
+
+def debug_snapshot() -> dict:
+    """The /debug/telemetry payload (metrics.debug_payload): sampler
+    state + last per-chip readings with attribution (plugin daemon),
+    the node fragmentation stats, and the extender's cluster
+    placeable-nodes aggregate when this process maintains one."""
+    out: dict = {"enabled": SAMPLER is not None}
+    sampler = SAMPLER
+    if sampler is not None:
+        out.update(sampler.snapshot())
+    out["node"] = NODE_STATS
+    provider = CLUSTER_PROVIDER
+    if provider is not None:
+        try:
+            out["cluster"] = provider()
+        except Exception:  # noqa: BLE001 — debug surface must not 500
+            log.exception("cluster telemetry provider failed")
+            out["cluster"] = None
+    return out
+
+
+class TelemetrySampler:
+    """Samples every chip's runtime counters off the gRPC hot path.
+
+    One thread, ``interval_s`` cadence (plus an immediate first pass at
+    start), reading ``backend.chip_telemetry(scan_root, index)`` per
+    chip and joining ``attribution()`` — the controller's
+    chip→{pod,namespace,container,gang} map — into the label sets.
+    """
+
+    def __init__(
+        self,
+        backend,
+        scan_root: str,
+        mesh,
+        interval_s: float = 10.0,
+        attribution: Optional[Callable[[], Dict[str, dict]]] = None,
+        temp_threshold_c: float = DEFAULT_TEMP_THRESHOLD_C,
+        hbm_pressure_ratio: float = DEFAULT_HBM_PRESSURE_RATIO,
+    ):
+        self._backend = backend
+        self._scan_root = scan_root
+        self.mesh = mesh
+        self.interval_s = interval_s
+        self._attribution = attribution
+        self.temp_threshold_c = temp_threshold_c
+        self.hbm_pressure_ratio = hbm_pressure_ratio
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # chip id → the label tuple its series currently carry (the
+        # prune key), and (chip id, link) → last cumulative error count
+        # (delta base; survives attribution changes — the driver's
+        # counter doesn't reset when a pod does).
+        self._last_labels: Dict[str, tuple] = {}
+        # chip id → link ids seen on the last pass, so a link the
+        # driver stops publishing prunes its series (absent ≠ frozen).
+        self._last_links: Dict[str, set] = {}
+        self._err_base: Dict[tuple, int] = {}
+        # (chip id, condition) → currently above threshold (dedups the
+        # flight events while the condition persists).
+        self._over: Dict[tuple, bool] = {}
+        self._last_chips: list = []
+        self._ticks = 0
+        self._warned_unsupported = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-telemetry-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 2)
+            self._thread = None
+
+    def _run(self) -> None:
+        log.info(
+            "telemetry sampler started: %d chips, %.1fs interval",
+            len(self.mesh.mesh_chips), self.interval_s,
+        )
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — sampler must survive
+                log.exception("telemetry sample pass failed")
+                metrics.TELEMETRY_TICKS.inc(outcome="error")
+            if self._stop.wait(self.interval_s):
+                return
+
+    # -- one pass ----------------------------------------------------------
+
+    def _labels_for(self, chip_id: str, attr: dict) -> dict:
+        labels = {"chip": chip_id}
+        for k in ATTRIBUTION_LABELS:
+            v = attr.get(k, "")
+            if v:
+                labels[k] = v
+        return labels
+
+    def _set_or_remove(self, fam, value, **labels) -> None:
+        if value is None:
+            fam.remove(**labels)
+        else:
+            fam.set(value, **labels)
+
+    def _threshold(
+        self, chip_id: str, cond: str, over: bool, message: str, **attrs
+    ) -> None:
+        """Record a flight event on each threshold CROSSING (either
+        direction), never per-sample while the condition persists."""
+        was = self._over.get((chip_id, cond), False)
+        if over == was:
+            return
+        self._over[(chip_id, cond)] = over
+        kind = "chip_thermal" if cond == "thermal" else "chip_hbm_pressure"
+        RECORDER.record(
+            kind, message, chip=chip_id,
+            state="over" if over else "cleared", **attrs,
+        )
+        if over:
+            log.warning("%s", message)
+
+    def poll_once(self) -> None:
+        """One sample pass; also callable synchronously (tests, tools).
+        Never raises on per-chip read failures — a broken chip costs
+        its own series, not the pass."""
+        attribution: Dict[str, dict] = {}
+        if self._attribution is not None:
+            try:
+                attribution = self._attribution() or {}
+            except Exception:  # noqa: BLE001 — join failure ≠ no telemetry
+                log.exception("chip attribution lookup failed")
+        if not hasattr(self._backend, "chip_telemetry"):
+            if not self._warned_unsupported:
+                self._warned_unsupported = True
+                log.warning(
+                    "backend %s has no chip_telemetry surface; sampler "
+                    "exports nothing", type(self._backend).__name__,
+                )
+            metrics.TELEMETRY_TICKS.inc(outcome="error")
+            return
+        ok = True
+        chips_out = []
+        seen = set()
+        for mc in self.mesh.mesh_chips:
+            cid = mc.id
+            seen.add(cid)
+            try:
+                tel = self._backend.chip_telemetry(
+                    self._scan_root, mc.chip.index
+                )
+            except (OSError, ValueError) as e:
+                log.warning("telemetry read failed for %s: %s", cid, e)
+                ok = False
+                # Prune what the chip exported while it was readable:
+                # serving hours-old duty/temp values — still attributed
+                # to a pod — would read as a healthy chip to anyone
+                # triaging from the dashboard (absent beats frozen, the
+                # same rule as every other removal here).
+                if cid in self._last_labels:
+                    for fam in CHIP_FAMILIES:
+                        fam.remove_matching(chip=cid)
+                    del self._last_labels[cid]
+                    self._last_links.pop(cid, None)
+                    for base_key in [
+                        k for k in self._err_base if k[0] == cid
+                    ]:
+                        del self._err_base[base_key]
+                continue
+            attr = attribution.get(cid) or {}
+            labels = self._labels_for(cid, attr)
+            key = tuple(sorted(labels.items()))
+            prev = self._last_labels.get(cid)
+            if prev is not None and prev != key:
+                # Attribution changed (pod freed/replaced): drop every
+                # series this chip exported under the old labels BEFORE
+                # writing the new ones — no stale pod on the next scrape.
+                for fam in CHIP_FAMILIES:
+                    fam.remove_matching(chip=cid)
+            self._last_labels[cid] = key
+            ratio = tel.hbm_used_ratio(mc.chip.hbm_bytes)
+            self._set_or_remove(
+                metrics.CHIP_DUTY_CYCLE, tel.duty_cycle_pct, **labels
+            )
+            self._set_or_remove(
+                metrics.CHIP_HBM_USED, tel.hbm_used_bytes, **labels
+            )
+            self._set_or_remove(metrics.CHIP_HBM_RATIO, ratio, **labels)
+            self._set_or_remove(metrics.CHIP_TEMP, tel.temp_c, **labels)
+            self._set_or_remove(metrics.CHIP_POWER, tel.power_w, **labels)
+            current_links = {link.link for link in tel.links}
+            for gone in self._last_links.get(cid, set()) - current_links:
+                # The driver stopped publishing this link (dir removed
+                # after a link reset): drop its series — a dead link
+                # frozen at its last state is worse than absent data.
+                metrics.CHIP_LINK_UP.remove_matching(
+                    chip=cid, link=str(gone)
+                )
+                metrics.CHIP_LINK_ERRORS.remove_matching(
+                    chip=cid, link=str(gone)
+                )
+                self._err_base.pop((cid, gone), None)
+            self._last_links[cid] = current_links
+            for link in tel.links:
+                llabels = dict(labels, link=str(link.link))
+                metrics.CHIP_LINK_UP.set(1 if link.up else 0, **llabels)
+                base_key = (cid, link.link)
+                base = self._err_base.get(base_key)
+                if base is None:
+                    delta = 0  # first sight: baseline, don't import history
+                elif link.errors >= base:
+                    delta = link.errors - base
+                else:
+                    delta = link.errors  # driver counter reset
+                self._err_base[base_key] = link.errors
+                if delta or base is not None:
+                    metrics.CHIP_LINK_ERRORS.inc(delta, **llabels)
+            if tel.temp_c is not None:
+                self._threshold(
+                    cid, "thermal", tel.temp_c >= self.temp_threshold_c,
+                    f"chip {cid} at {tel.temp_c:.1f}C "
+                    f"(threshold {self.temp_threshold_c:.0f}C)",
+                    temp_c=round(tel.temp_c, 1),
+                    pod=attr.get("pod", ""),
+                )
+            if ratio is not None:
+                self._threshold(
+                    cid, "hbm", ratio >= self.hbm_pressure_ratio,
+                    f"chip {cid} HBM at {ratio * 100:.0f}% "
+                    f"(threshold {self.hbm_pressure_ratio * 100:.0f}%)",
+                    hbm_used_ratio=round(ratio, 3),
+                    pod=attr.get("pod", ""),
+                )
+            entry = tel.to_dict(mc.chip.hbm_bytes)
+            entry["chip"] = cid
+            for k in ATTRIBUTION_LABELS:
+                if attr.get(k):
+                    entry[k] = attr[k]
+            chips_out.append(entry)
+        # Chips gone from the mesh (SIGHUP rebuild shrank it): full prune.
+        for cid in [c for c in self._last_labels if c not in seen]:
+            for fam in CHIP_FAMILIES:
+                fam.remove_matching(chip=cid)
+            del self._last_labels[cid]
+            self._last_links.pop(cid, None)
+            for base_key in [k for k in self._err_base if k[0] == cid]:
+                del self._err_base[base_key]
+        metrics.TELEMETRY_TICKS.inc(outcome="ok" if ok else "error")
+        with self._lock:
+            self._ticks += 1
+            self._last_chips = chips_out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "interval_s": self.interval_s,
+                "ticks": self._ticks,
+                "chips": [dict(c) for c in self._last_chips],
+            }
+
+
+def install_sampler(sampler: Optional[TelemetrySampler]) -> None:
+    """Register (or clear, with None) the process's sampler for the
+    /debug/telemetry surface. The supervisor calls this around each
+    plugin generation so a SIGHUP rebuild swaps the snapshot source
+    with the mesh."""
+    global SAMPLER
+    SAMPLER = sampler

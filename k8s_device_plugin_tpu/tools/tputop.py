@@ -1,0 +1,338 @@
+"""tputop — live per-chip/per-pod telemetry table from a metrics scrape.
+
+The `nvidia-smi`/`tputop` moment for the fleet operator: one command
+answers "which pod is cooking which chip" from the daemon's existing
+Prometheus endpoint — no SSH, no kubectl exec. Reads the ``tpu_chip_*``
+and ``tpu_node_*`` families the telemetry sampler exports
+(telemetry.py, `docs/observability.md`) and renders:
+
+* a node header — free chips, largest placeable contiguous box,
+  fragmentation index, and which request sizes currently fit;
+* one row per chip — holder (namespace/pod, container, gang), duty
+  cycle, HBM used (and % of spec when known), temperature, power, and
+  ICI link state (up/down counts + accumulated errors).
+
+Usage::
+
+    python -m k8s_device_plugin_tpu.tools.tputop --url http://node:2112
+    curl -s node:2112/metrics | python -m k8s_device_plugin_tpu.tools.tputop -
+    python -m k8s_device_plugin_tpu.tools.tputop scrape.txt
+    python -m k8s_device_plugin_tpu.tools.tputop --url ... --watch 5
+    python -m k8s_device_plugin_tpu.tools.tputop --self-test   # CI smoke
+
+``--self-test`` drives the REAL pipeline end to end in-process: a fake
+sysfs tree → the discovery backend's chip_telemetry → the sampler with
+a synthetic pod/gang attribution → the registry's text exposition →
+this parser → the table — so a drift anywhere in that chain fails CI
+here (scripts/tier1.sh), before the pytest gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+GIB = 1024**3
+
+# One sample line of the Prometheus text exposition.
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)(?:\s+\S+)?$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+CHIP_PREFIX = "tpu_chip_"
+NODE_PREFIX = "tpu_node_"
+
+
+def parse_metrics(text: str) -> Dict[str, List[Tuple[dict, float]]]:
+    """family name → [(labels, value)] for every tpu_chip_*/tpu_node_*
+    sample in a text-exposition scrape. Tolerant: unparsable lines and
+    non-telemetry families are skipped, not fatal — the scrape carries
+    dozens of unrelated families."""
+    out: Dict[str, List[Tuple[dict, float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        if not (
+            name.startswith(CHIP_PREFIX) or name.startswith(NODE_PREFIX)
+        ):
+            continue
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels = dict(_LABEL_RE.findall(raw_labels or ""))
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def _fmt_bytes(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v >= GIB:
+        return f"{v / GIB:.1f}Gi"
+    return f"{v / 1024**2:.0f}Mi"
+
+
+def _chip_rows(
+    families: Dict[str, List[Tuple[dict, float]]]
+) -> List[dict]:
+    """Fold the per-chip families into one row dict per chip."""
+    rows: Dict[str, dict] = {}
+
+    def row(labels: dict) -> dict:
+        chip = labels.get("chip", "?")
+        r = rows.setdefault(
+            chip,
+            {
+                "chip": chip, "pod": "", "namespace": "",
+                "container": "", "gang": "", "duty": None, "hbm": None,
+                "hbm_ratio": None, "temp": None, "power": None,
+                "links_up": 0, "links_down": 0, "link_errors": 0,
+            },
+        )
+        for k in ("pod", "namespace", "container", "gang"):
+            if labels.get(k):
+                r[k] = labels[k]
+        return r
+
+    scalar = {
+        "tpu_chip_duty_cycle": "duty",
+        "tpu_chip_hbm_used_bytes": "hbm",
+        "tpu_chip_hbm_used_ratio": "hbm_ratio",
+        "tpu_chip_temperature_celsius": "temp",
+        "tpu_chip_power_watts": "power",
+    }
+    for fam, field in scalar.items():
+        for labels, value in families.get(fam, ()):
+            if "chip" not in labels:
+                continue  # the empty-family "fam 0" placeholder
+            row(labels)[field] = value
+    for labels, value in families.get("tpu_chip_ici_link_up", ()):
+        if "chip" not in labels:
+            continue
+        r = row(labels)
+        r["links_up" if value else "links_down"] += 1
+    for labels, value in families.get(
+        "tpu_chip_ici_link_errors_total", ()
+    ):
+        if "chip" not in labels:
+            continue
+        row(labels)["link_errors"] += int(value)
+    return [rows[c] for c in sorted(rows)]
+
+
+def _node_line(families: Dict[str, List[Tuple[dict, float]]]) -> str:
+    def one(fam: str) -> Optional[float]:
+        for labels, value in families.get(fam, ()):
+            if not labels:
+                return value
+        return None
+
+    free = one("tpu_node_free_chips")
+    box = one("tpu_node_largest_free_box_chips")
+    frag = one("tpu_node_topology_fragmentation")
+    placeable = sorted(
+        (
+            int(labels["size"])
+            for labels, value in families.get("tpu_node_box_placeable", ())
+            if value and labels.get("size", "").isdigit()
+        ),
+    )
+    parts = []
+    if free is not None:
+        parts.append(f"free={free:.0f}")
+    if box is not None:
+        parts.append(f"largest_box={box:.0f}")
+    if frag is not None:
+        parts.append(f"fragmentation={frag:.2f}")
+    if placeable:
+        parts.append(
+            "placeable=" + ",".join(str(n) for n in placeable)
+        )
+    return "node: " + (" ".join(parts) if parts else "no capacity gauges")
+
+
+def render(text: str) -> str:
+    """The table for one scrape; raises ValueError when the scrape has
+    no tpu_chip_*/tpu_node_* samples at all (wrong endpoint)."""
+    families = parse_metrics(text)
+    if not families:
+        raise ValueError(
+            "no tpu_chip_*/tpu_node_* samples in the input — is this "
+            "the device-plugin daemon's /metrics (and is "
+            "--telemetry-interval-s set)?"
+        )
+    rows = _chip_rows(families)
+    out = [_node_line(families)]
+    header = (
+        f"{'CHIP':<22} {'POD':<28} {'CONTAINER':<12} {'GANG':<14} "
+        f"{'DUTY%':>6} {'HBM':>14} {'TEMP':>7} {'PWR':>7} {'ICI':>12}"
+    )
+    out.append(header)
+    out.append("-" * len(header))
+    for r in rows:
+        pod = f"{r['namespace']}/{r['pod']}" if r["pod"] else "-"
+        hbm = _fmt_bytes(r["hbm"])
+        if r["hbm_ratio"] is not None:
+            hbm += f" ({r['hbm_ratio'] * 100:.0f}%)"
+        links = "-"
+        if r["links_up"] or r["links_down"]:
+            links = f"{r['links_up']}up/{r['links_down']}dn"
+            if r["link_errors"]:
+                links += f" e{r['link_errors']}"
+        out.append(
+            f"{r['chip']:<22} {pod:<28} "
+            f"{r['container'] or '-':<12} {r['gang'] or '-':<14} "
+            f"{('%.0f' % r['duty']) if r['duty'] is not None else '-':>6} "
+            f"{hbm:>14} "
+            f"{('%.1fC' % r['temp']) if r['temp'] is not None else '-':>7} "
+            f"{('%.0fW' % r['power']) if r['power'] is not None else '-':>7} "
+            f"{links:>12}"
+        )
+    if not rows:
+        out.append("(no per-chip series — sampler off or no chips)")
+    return "\n".join(out)
+
+
+def _fetch(url: str) -> str:
+    import urllib.request
+
+    target = url.rstrip("/")
+    if not target.endswith("/metrics"):
+        target += "/metrics"
+    with urllib.request.urlopen(target, timeout=10) as resp:
+        return resp.read().decode(errors="replace")
+
+
+def _self_test() -> str:
+    """Fake tree → backend → sampler → registry render → this parser.
+    Returns the rendered table; raises AssertionError on any drift."""
+    import os
+    import shutil
+    import tempfile
+
+    from .. import telemetry
+    from ..discovery.scanner import PyTpuInfo
+    from ..topology.mesh import IciMesh
+    from ..utils import metrics
+
+    root = tempfile.mkdtemp(prefix="tputop-selftest-")
+    try:
+        accel = os.path.join(root, "sys", "class", "accel")
+        dev = os.path.join(root, "dev")
+        os.makedirs(dev)
+        for i in range(4):
+            d = os.path.join(accel, f"accel{i}", "device")
+            os.makedirs(os.path.join(d, "ici", "link0"))
+            for attr, val in (
+                ("vendor", "0x1ae0"), ("device", "0x0062"),
+                ("numa_node", "0"),
+                ("uevent", f"PCI_SLOT_NAME=0000:00:{4 + i:02x}.0"),
+                ("duty_cycle_pct", str(40 + i)),
+                ("hbm_used_bytes", str(4 * GIB)),
+                ("temp_millic", "61500"), ("power_uw", "132000000"),
+                ("ici/link0/state", "up"), ("ici/link0/errors", "2"),
+            ):
+                with open(os.path.join(d, attr), "w") as f:
+                    f.write(val + "\n")
+            with open(os.path.join(dev, f"accel{i}"), "w") as f:
+                f.write("")
+        backend = PyTpuInfo()
+        chips = backend.scan(accel, dev)
+        assert len(chips) == 4
+        mesh = IciMesh(chips)
+        holder = {
+            mesh.ids[0]: {
+                "pod": "train-w0", "namespace": "ml",
+                "container": "main", "gang": "train",
+            }
+        }
+        sampler = telemetry.TelemetrySampler(
+            backend, accel, mesh, attribution=lambda: holder
+        )
+        sampler.poll_once()
+        telemetry.update_node_gauges(mesh, mesh.ids[1:])
+        table = render(metrics.REGISTRY.render())
+        assert "ml/train-w0" in table, table
+        assert "train" in table and "main" in table
+        assert "40" in table and "61.5C" in table and "132W" in table
+        assert "4.0Gi (25%)" in table, table
+        assert "fragmentation=" in table and "free=3" in table, table
+        assert "1up/0dn e" not in table  # first sight = baseline, no errs
+        return table
+    finally:
+        for fam in (
+            metrics.CHIP_DUTY_CYCLE, metrics.CHIP_HBM_USED,
+            metrics.CHIP_HBM_RATIO, metrics.CHIP_TEMP,
+            metrics.CHIP_POWER, metrics.CHIP_LINK_UP,
+            metrics.CHIP_LINK_ERRORS,
+        ):
+            for i in range(4):
+                fam.remove_matching(chip=f"tpu-0000:00:{4 + i:02x}.0")
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tputop",
+        description="per-chip/per-pod TPU telemetry table from a "
+        "device-plugin /metrics scrape",
+    )
+    p.add_argument(
+        "path", nargs="?",
+        help="scrape file, or '-' for stdin (alternative to --url)",
+    )
+    p.add_argument(
+        "--url",
+        help="daemon metrics endpoint, e.g. http://node:2112 "
+        "(/metrics is appended when missing)",
+    )
+    p.add_argument(
+        "--watch", type=float, default=0,
+        help="re-fetch and re-render every N seconds (with --url)",
+    )
+    p.add_argument(
+        "--self-test", action="store_true",
+        help="drive a fake tree through the sampler and this renderer "
+        "(CI smoke; exits non-zero on drift)",
+    )
+    a = p.parse_args(argv)
+    if a.self_test:
+        print(_self_test())
+        print("tputop self-test: OK")
+        return 0
+    try:
+        if a.url and a.watch > 0:
+            import time as _time
+
+            while True:
+                print("\x1b[2J\x1b[H" + render(_fetch(a.url)), flush=True)
+                _time.sleep(a.watch)
+        if a.url:
+            text = _fetch(a.url)
+        elif a.path == "-":
+            text = sys.stdin.read()
+        elif a.path:
+            with open(a.path) as f:
+                text = f.read()
+        else:
+            p.error("a scrape source is required: --url, a file, or '-'")
+        print(render(text))
+        return 0
+    except KeyboardInterrupt:
+        return 130
+    except (OSError, ValueError) as e:
+        print(f"tputop: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -159,6 +159,75 @@ def box_candidates(
     return tuple(cands)
 
 
+def _pool_mask(mesh: IciMesh, ids: Iterable[str]) -> int:
+    bx, by, _bz = mesh.bounds
+    mask = 0
+    for i in ids:
+        c = mesh.by_id[i].coords
+        mask |= 1 << (c[0] + bx * (c[1] + by * c[2]))
+    return mask
+
+
+def placeable_box_sizes(chip_count: int) -> List[int]:
+    """The request sizes the capacity gauges track: every power of two
+    up to the host's chip count (the shapes TPU workloads actually ask
+    for). One definition shared by the daemon's per-node gauges and the
+    extender's cluster aggregate so their size axes can't drift."""
+    sizes = []
+    n = 1
+    while n <= chip_count:
+        sizes.append(n)
+        n *= 2
+    return sizes
+
+
+def fragmentation_stats(mesh: IciMesh, free_ids: Iterable[str]) -> dict:
+    """Capacity/fragmentation view of a node's free chips, computed on
+    the same precomputed box space the placement policy allocates from
+    (``box_candidates``) — the gauges can never disagree with what
+    ``select`` would actually place.
+
+    Returns ``{"free", "largest_box", "fragmentation", "placeable"}``:
+    ``largest_box`` is the volume of the biggest fully-free contiguous
+    box, ``placeable`` maps each power-of-two request size to whether a
+    box of that size fits right now, and ``fragmentation`` is
+    ``1 - largest_box/free`` (0.0 when nothing is free: an empty node
+    is exhausted, not fragmented)."""
+    free = [i for i in free_ids if i in mesh.by_id]
+    n_free = len(free)
+    total = len(mesh.mesh_chips)
+    sizes = placeable_box_sizes(total)
+    if n_free == 0:
+        return {
+            "free": 0,
+            "largest_box": 0,
+            "fragmentation": 0.0,
+            "placeable": {n: False for n in sizes},
+        }
+    mask = _pool_mask(mesh, free)
+    wraps = tuple(mesh._dim_wraps(mesh.bounds[d]) for d in range(3))
+
+    def fits(n: int) -> bool:
+        return any(
+            not (cand.mask & ~mask)
+            for cand in box_candidates(n, mesh.bounds, wraps)
+        )
+
+    largest = 0
+    for n in range(n_free, 0, -1):
+        if fits(n):
+            largest = n
+            break
+    return {
+        "free": n_free,
+        "largest_box": largest,
+        "fragmentation": round(1.0 - largest / n_free, 4),
+        # Independently tested per size: n <= largest does NOT imply an
+        # n-box fits (a free 3x3x3 region holds 27 chips but no 16-box).
+        "placeable": {n: fits(n) for n in sizes},
+    }
+
+
 class PlacementState:
     """Allocation bookkeeping plus the best-fit selection policy.
 
@@ -318,17 +387,11 @@ class PlacementState:
         missing chips fail the mask test exactly like they failed the
         ``by_coords`` lookup."""
         mesh = self.mesh
-        bx, by, bz = mesh.bounds
-
-        def bit(c: Coord) -> int:
-            return c[0] + bx * (c[1] + by * c[2])
-
-        pool_mask = 0
-        for i in pool:
-            pool_mask |= 1 << bit(mesh.by_id[i].coords)
-        must_mask = 0
-        for i in must:
-            must_mask |= 1 << bit(mesh.by_id[i].coords)
+        # Same linearization as BoxCandidate.mask, via the ONE shared
+        # builder (also behind fragmentation_stats — the gauges and the
+        # allocator must read the identical bit space).
+        pool_mask = _pool_mask(mesh, pool)
+        must_mask = _pool_mask(mesh, must)
         wraps = tuple(mesh._dim_wraps(mesh.bounds[d]) for d in range(3))
         best_key: Optional[Tuple[int, int]] = None
         best_ids: Optional[Tuple[str, ...]] = None

@@ -41,6 +41,34 @@ class Metric:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
+    def remove(self, **labels) -> bool:
+        """Delete one labeled series. Without this, a family labeled by
+        an unbounded dimension (chip, pod, link) leaks every series it
+        ever touched — a freed chip's pod-attributed gauges would scrape
+        forever at their last value, which is worse than absent data.
+        Returns True when a series was actually dropped."""
+        with self._lock:
+            return self._values.pop(self._key(labels), None) is not None
+
+    def remove_matching(self, **labels) -> int:
+        """Delete every series whose label set CONTAINS ``labels``
+        (subset match) — the bulk prune for "this chip was freed / this
+        pod vanished": one call clears all of the chip's series across
+        whatever attribution labels they carried. Returns the count."""
+        want = set(labels.items())
+        with self._lock:
+            doomed = [k for k in self._values if want <= set(k)]
+            for k in doomed:
+                del self._values[k]
+            return len(doomed)
+
+    def series(self) -> "list[tuple[dict, float]]":
+        """Live (labels, value) pairs — the snapshot the
+        /debug/telemetry payload, tputop's self-test, and the pruning
+        tests read."""
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
     def render(self, openmetrics: bool = False) -> str:
         # OpenMetrics declares a counter FAMILY without the _total
         # suffix (samples keep it); emitting '# TYPE x_total counter'
@@ -330,6 +358,72 @@ POD_TIME_TO_ALLOCATE = REGISTRY.histogram(
     "its real chips (exemplar-linked to the allocation trace)",
     buckets=SLO_BUCKETS,
 )
+# Per-chip runtime telemetry (telemetry.py sampler over the discovery
+# backends' chip_telemetry surface): gauge/counter families labeled by
+# chip and — when the controller's allocation map attributes the chip —
+# pod/namespace/container/gang. Series are PRUNED (Metric.remove_matching)
+# when a chip is freed or its holder vanishes; constant 0 unless
+# --telemetry-interval-s enables the sampler.
+CHIP_DUTY_CYCLE = REGISTRY.gauge(
+    "tpu_chip_duty_cycle",
+    "Percent of the last sample window the chip spent executing, by "
+    "chip and holding pod/namespace/container/gang",
+)
+CHIP_HBM_USED = REGISTRY.gauge(
+    "tpu_chip_hbm_used_bytes",
+    "HBM bytes in use on the chip, by chip and holding pod",
+)
+CHIP_HBM_RATIO = REGISTRY.gauge(
+    "tpu_chip_hbm_used_ratio",
+    "HBM in use as a 0-1 fraction of the generation's capacity; absent "
+    "(not 0) for chips of unknown generation (no HBM spec to divide by)",
+)
+CHIP_TEMP = REGISTRY.gauge(
+    "tpu_chip_temperature_celsius",
+    "Die temperature reported by the chip's telemetry surface",
+)
+CHIP_POWER = REGISTRY.gauge(
+    "tpu_chip_power_watts", "Chip power draw"
+)
+CHIP_LINK_UP = REGISTRY.gauge(
+    "tpu_chip_ici_link_up",
+    "Per-ICI-link state (1 up, 0 down), by chip and link",
+)
+CHIP_LINK_ERRORS = REGISTRY.counter(
+    "tpu_chip_ici_link_errors_total",
+    "Per-ICI-link error events, accumulated from the driver's "
+    "cumulative counter (reset-safe deltas), by chip and link",
+)
+TELEMETRY_TICKS = REGISTRY.counter(
+    "tpu_telemetry_ticks_total",
+    "Telemetry sampler passes, by outcome (ok/error); error means a "
+    "chip read raised and that pass exported what it could",
+)
+# Node capacity/fragmentation observability (topology/placement.py
+# fragmentation_stats), recomputed on every allocate/free/health
+# transition — the "can a box still land here" facts behind the
+# extender's placement decisions, as dashboard numbers.
+NODE_FREE_CHIPS = REGISTRY.gauge(
+    "tpu_node_free_chips",
+    "Healthy-and-free chips on this node (the fragmentation "
+    "denominator)",
+)
+NODE_LARGEST_BOX = REGISTRY.gauge(
+    "tpu_node_largest_free_box_chips",
+    "Volume of the largest fully-free contiguous ICI box currently "
+    "placeable on this node",
+)
+NODE_FRAGMENTATION = REGISTRY.gauge(
+    "tpu_node_topology_fragmentation",
+    "ICI mesh fragmentation, 0-1: 1 - largest_free_box/free_chips "
+    "(0 = all free capacity is one contiguous box, or nothing free)",
+)
+NODE_BOX_PLACEABLE = REGISTRY.gauge(
+    "tpu_node_box_placeable",
+    "1 when a contiguous box of {size} chips is currently placeable "
+    "on this node, else 0, for each power-of-two request size up to "
+    "the host's chip count",
+)
 # The extender/gang-admission process exposes its own registry: sharing
 # the daemon's would publish every tpu_plugin_* family as constant zeros
 # from the extender Service, polluting sum()s and alerts across scrapes.
@@ -509,6 +603,17 @@ STATE_COMPACTIONS = EXTENDER_REGISTRY.counter(
     "Admission-state snapshot compactions (tmp+fsync+rename then "
     "journal truncate), by outcome (ok/error)",
 )
+# Cluster capacity/fragmentation aggregate (extender/index.py): how many
+# nodes could place a contiguous box of each request size RIGHT NOW,
+# maintained incrementally as index entries change — the "why can't a
+# 4-cube land anywhere" dashboard number (0 at size=4 with free chips
+# everywhere = cluster-wide fragmentation, not exhaustion).
+EXT_PLACEABLE_NODES = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_placeable_nodes",
+    "Nodes whose published availability can place a contiguous box of "
+    "{size} chips, per power-of-two request size (from the incremental "
+    "topology index; 0 everywhere when --node-cache is off)",
+)
 
 
 OPENMETRICS_CONTENT_TYPE = (
@@ -536,8 +641,11 @@ def debug_payload(path: str) -> Optional[bytes]:
     both HTTP servers): /debug/traces = the span collector's OTLP-JSON
     export (optionally ?trace_id=...), /debug/events = the flight
     recorder ring, /debug/decisions = the decision ledger
-    (?pod=/?gang=/?node=/?kind=/?trace_id=/?limit= filtering). None
-    for any other path."""
+    (?pod=/?gang=/?node=/?kind=/?trace_id=/?limit= filtering),
+    /debug/telemetry = the chip-telemetry snapshot (sampler state +
+    per-chip attributed readings + node fragmentation in the plugin
+    daemon; the cluster placeable-nodes aggregate in the extender).
+    None for any other path."""
     import json as _json
     import urllib.parse as _up
 
@@ -546,6 +654,10 @@ def debug_payload(path: str) -> Optional[bytes]:
     from .flightrecorder import RECORDER
 
     parsed = _up.urlparse(path)
+    if parsed.path == "/debug/telemetry":
+        from .. import telemetry
+
+        return _json.dumps(telemetry.debug_snapshot()).encode()
     if parsed.path == "/debug/traces":
         trace_id = dict(_up.parse_qsl(parsed.query)).get("trace_id", "")
         return _json.dumps(

@@ -190,12 +190,73 @@ int main() {
   CHECK(tpuinfo_scan_vfio((base + "/no-groups").c_str(), dev_vfio.c_str(),
                           vchips, 8) == 0);
 
+  /* Chip telemetry: absent attrs, full attrs, hostile values, links. */
+  tpuinfo_chip_telemetry_t tel;
+  CHECK(tpuinfo_chip_telemetry(accel.c_str(), 3, &tel) == 1);
+  CHECK(tel.fields == 0 && tel.link_count == 0); /* nothing published */
+  {
+    std::string d3 = accel + "/accel3/device";
+    WriteFile(d3 + "/duty_cycle_pct", "73\n");
+    WriteFile(d3 + "/hbm_used_bytes", "2048\n");
+    WriteFile(d3 + "/temp_millic", "66500\n");
+    WriteFile(d3 + "/power_uw", "175000000\n");
+    CHECK(system(("mkdir -p '" + d3 + "/ici/link0' '" + d3 +
+                  "/ici/link2'").c_str()) == 0);
+    WriteFile(d3 + "/ici/link0/state", "UP\n");
+    WriteFile(d3 + "/ici/link0/errors", "5\n");
+    WriteFile(d3 + "/ici/link2/state", "down\n");
+    /* link2 has no errors attribute -> 0, never a crash. */
+  }
+  CHECK(tpuinfo_chip_telemetry(accel.c_str(), 3, &tel) == 1);
+  CHECK(tel.fields == (TPUINFO_TELEM_DUTY | TPUINFO_TELEM_HBM |
+                       TPUINFO_TELEM_TEMP | TPUINFO_TELEM_POWER));
+  CHECK(tel.duty_cycle_pct == 73.0);
+  CHECK(tel.hbm_used_bytes == 2048);
+  CHECK(tel.temp_c == 66.5);
+  CHECK(tel.power_w == 175.0);
+  CHECK(tel.link_count == 2);
+  CHECK(tel.link_id[0] == 0 && tel.link_up[0] == 1 &&
+        tel.link_errors[0] == 5);
+  CHECK(tel.link_id[1] == 2 && tel.link_up[1] == 0 &&
+        tel.link_errors[1] == 0);
+  /* Garbled scalar attributes clear their bit instead of crashing —
+   * incl. the grammar edges where strtoll and Python's int(s, 0)
+   * disagree (leading-zero octal, underscores, 0o/0b prefixes): both
+   * backends must REJECT those identically. */
+  WriteFile(accel + "/accel3/device/duty_cycle_pct", "85%\n");
+  WriteFile(accel + "/accel3/device/hbm_used_bytes", "-4\n");
+  CHECK(tpuinfo_chip_telemetry(accel.c_str(), 3, &tel) == 1);
+  CHECK((tel.fields & TPUINFO_TELEM_DUTY) == 0);
+  CHECK((tel.fields & TPUINFO_TELEM_HBM) == 0);
+  CHECK((tel.fields & TPUINFO_TELEM_TEMP) != 0);
+  const char* bad_ints[] = {"010",  "1_0", "0o10",
+                            "0b1",  "0x",  "+",
+                            "",     "9223372036854775808", /* ERANGE */
+                            "0xffffffffffffffff1", "\xff\xfe""42"};
+  for (const char* bi : bad_ints) {
+    WriteFile(accel + "/accel3/device/hbm_used_bytes", bi);
+    CHECK(tpuinfo_chip_telemetry(accel.c_str(), 3, &tel) == 1);
+    CHECK((tel.fields & TPUINFO_TELEM_HBM) == 0);
+  }
+  WriteFile(accel + "/accel3/device/hbm_used_bytes", "0\n");
+  CHECK(tpuinfo_chip_telemetry(accel.c_str(), 3, &tel) == 1);
+  CHECK((tel.fields & TPUINFO_TELEM_HBM) != 0 && tel.hbm_used_bytes == 0);
+  CHECK(tpuinfo_chip_telemetry(accel.c_str(), 9, &tel) == -ENOENT);
+  /* vfio telemetry reads the group's identity function. */
+  WriteFile(groups + "/10/devices/0000:00:04.0/duty_cycle_pct", "12\n");
+  CHECK(tpuinfo_vfio_chip_telemetry(groups.c_str(), 10, &tel) == 1);
+  CHECK((tel.fields & TPUINFO_TELEM_DUTY) != 0);
+  CHECK(tel.duty_cycle_pct == 12.0);
+  CHECK(tpuinfo_vfio_chip_telemetry(groups.c_str(), 99, &tel) == -ENOENT);
+
   /* NULL-argument contract. */
   CHECK(tpuinfo_scan(nullptr, dev.c_str(), chips, 4) == -EINVAL);
   CHECK(tpuinfo_chip_coords(accel.c_str(), 0, nullptr) == -EINVAL);
   CHECK(tpuinfo_host_info(nullptr, &hi) == -EINVAL);
   CHECK(tpuinfo_scan_vfio(nullptr, dev_vfio.c_str(), vchips, 8) == -EINVAL);
   CHECK(tpuinfo_vfio_chip_coords(groups.c_str(), 10, nullptr) == -EINVAL);
+  CHECK(tpuinfo_chip_telemetry(accel.c_str(), 0, nullptr) == -EINVAL);
+  CHECK(tpuinfo_vfio_chip_telemetry(nullptr, 10, &tel) == -EINVAL);
 
   std::string cleanup = "rm -rf '" + base + "'";
   CHECK(system(cleanup.c_str()) == 0);

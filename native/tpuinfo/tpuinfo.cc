@@ -418,6 +418,122 @@ int tpuinfo_chip_coords(const char* sysfs_class_dir, int index,
   return ParseCoordsAttr(buf, out_xyz);
 }
 
+namespace {
+
+/* Strict integer attribute read for telemetry: file present, and the
+ * whole (ASCII-whitespace-trimmed) token matches the shared grammar
+ * `[+-]?(0[xX]hex | decimal-without-leading-zeros | 0)` — the Python
+ * backend's _STRICT_INT_RE (discovery/scanner.py) is byte-identical
+ * (parity-tested). Deliberately narrower than raw strtoll base 0:
+ * strtoll's leading-zero OCTAL ("010" → 8) and Python's "1_0"/"0o10"
+ * would each parse on exactly one backend otherwise. ReadLong above is
+ * looser and kept for the legacy identity attributes. */
+bool TryReadLongLong(const std::string& path, long long* out) {
+  if (!PathExists(path)) return false;
+  std::string s = ReadTrimmed(path); /* trims trailing whitespace */
+  size_t b = s.find_first_not_of(" \t\r\n\f\v");
+  if (b == std::string::npos) return false;
+  s = s.substr(b);
+  size_t i = 0;
+  if (s[i] == '+' || s[i] == '-') ++i;
+  if (i >= s.size()) return false;
+  if (i + 1 < s.size() && s[i] == '0' && (s[i + 1] == 'x' || s[i + 1] == 'X')) {
+    if (i + 2 >= s.size()) return false;
+    for (size_t j = i + 2; j < s.size(); ++j) {
+      char ch = s[j];
+      if (!((ch >= '0' && ch <= '9') || (ch >= 'a' && ch <= 'f') ||
+            (ch >= 'A' && ch <= 'F')))
+        return false;
+    }
+  } else if (s[i] == '0') {
+    if (i + 1 != s.size()) return false; /* "010" octal: rejected */
+  } else if (s[i] >= '1' && s[i] <= '9') {
+    for (size_t j = i + 1; j < s.size(); ++j)
+      if (s[j] < '0' || s[j] > '9') return false;
+  } else {
+    return false;
+  }
+  errno = 0;
+  char* end = nullptr;
+  long long v = std::strtoll(s.c_str(), &end, 0);
+  if (errno != 0 || end == s.c_str() || *end != '\0') return false;
+  *out = v;
+  return true;
+}
+
+/* Shared attribute walk behind both layouts' telemetry entry points:
+ * `devdir` is the chip's PCI device dir (accelN/device, or the vfio
+ * group's first TPU function). Mirrored byte-for-byte by the Python
+ * backends' _telemetry_from_devdir (parity-tested). */
+void TelemetryFromDevdir(const std::string& devdir,
+                         tpuinfo_chip_telemetry_t* out) {
+  out->fields = 0;
+  out->link_count = 0;
+  long long v = 0;
+  if (TryReadLongLong(devdir + "/duty_cycle_pct", &v) && v >= 0) {
+    out->fields |= TPUINFO_TELEM_DUTY;
+    out->duty_cycle_pct = static_cast<double>(v);
+  }
+  if (TryReadLongLong(devdir + "/hbm_used_bytes", &v) && v >= 0) {
+    out->fields |= TPUINFO_TELEM_HBM;
+    out->hbm_used_bytes = v;
+  }
+  if (TryReadLongLong(devdir + "/temp_millic", &v)) {
+    out->fields |= TPUINFO_TELEM_TEMP;
+    out->temp_c = static_cast<double>(v) / 1000.0;
+  }
+  if (TryReadLongLong(devdir + "/power_uw", &v) && v >= 0) {
+    out->fields |= TPUINFO_TELEM_POWER;
+    out->power_w = static_cast<double>(v) / 1e6;
+  }
+  std::string ici = devdir + "/ici";
+  DIR* d = ::opendir(ici.c_str());
+  if (d == nullptr) return;
+  std::vector<int> links;
+  struct dirent* ent;
+  while ((ent = ::readdir(d)) != nullptr) {
+    const char* name = ent->d_name;
+    if (strncmp(name, "link", 4) != 0) continue;
+    char* endp = nullptr;
+    long k = std::strtol(name + 4, &endp, 10);
+    if (endp == name + 4 || *endp != '\0') continue;
+    links.push_back(static_cast<int>(k));
+  }
+  ::closedir(d);
+  std::sort(links.begin(), links.end());
+  for (int k : links) {
+    if (out->link_count >= TPUINFO_MAX_LINKS) break;
+    std::string base = ici + "/link" + std::to_string(k);
+    std::string state = ReadTrimmed(base + "/state");
+    std::transform(state.begin(), state.end(), state.begin(),
+                   [](unsigned char ch) {
+                     return (ch >= 'A' && ch <= 'Z')
+                                ? static_cast<char>(ch + ('a' - 'A'))
+                                : static_cast<char>(ch);
+                   });
+    int i = out->link_count++;
+    out->link_id[i] = k;
+    out->link_up[i] = (state == "up") ? 1 : 0;
+    long long errs = 0;
+    if (!TryReadLongLong(base + "/errors", &errs) || errs < 0) errs = 0;
+    out->link_errors[i] = errs;
+  }
+}
+
+}  // namespace
+
+int tpuinfo_chip_telemetry(const char* sysfs_class_dir, int index,
+                           tpuinfo_chip_telemetry_t* out) {
+  if (sysfs_class_dir == nullptr || out == nullptr) return -EINVAL;
+  char buf[512];
+  snprintf(buf, sizeof(buf), "%s/accel%d", sysfs_class_dir, index);
+  if (!PathExists(buf)) return -ENOENT;
+  snprintf(buf, sizeof(buf), "%s/accel%d/device", sysfs_class_dir, index);
+  *out = tpuinfo_chip_telemetry_t{};
+  TelemetryFromDevdir(buf, out);
+  return 1;
+}
+
 int tpuinfo_host_info(const char* proc_dir, tpuinfo_host_info_t* out) {
   if (proc_dir == nullptr || out == nullptr) return -EINVAL;
   out->mem_total_bytes = 0;
@@ -666,6 +782,20 @@ int tpuinfo_vfio_chip_coords(const char* iommu_groups_dir, int group,
   return 0;
 }
 
-const char* tpuinfo_version(void) { return "tpuinfo 0.2.0"; }
+int tpuinfo_vfio_chip_telemetry(const char* iommu_groups_dir, int group,
+                                tpuinfo_chip_telemetry_t* out) {
+  if (iommu_groups_dir == nullptr || out == nullptr) return -EINVAL;
+  char buf[512];
+  snprintf(buf, sizeof(buf), "%s/%d", iommu_groups_dir, group);
+  if (!PathExists(buf)) return -ENOENT;
+  *out = tpuinfo_chip_telemetry_t{};
+  std::vector<TpuFunc> funcs = TpuFuncsInGroup(iommu_groups_dir, group);
+  /* Telemetry keys on the group's identity function (funcs[0]), the
+   * same pick tpuinfo_scan_vfio advertises the chip by. */
+  if (!funcs.empty()) TelemetryFromDevdir(funcs[0].devdir, out);
+  return 1;
+}
+
+const char* tpuinfo_version(void) { return "tpuinfo 0.3.0"; }
 
 }  /* extern "C" */

@@ -137,6 +137,48 @@ int tpuinfo_health_events_open(const char* sysfs_class_dir,
 int tpuinfo_health_events_wait(int fd, int timeout_ms);
 void tpuinfo_health_events_close(int fd);
 
+/* Runtime chip telemetry — the per-chip counters behind the daemon's
+ * tpu_chip_* metric families (the DCGM-exporter analog: per-device
+ * utilization/memory/temperature series the reference leaves to a
+ * sidecar). Read from optional driver attributes on the chip's device
+ * dir:
+ *
+ *   duty_cycle_pct   integer percent the chip spent executing (0-100)
+ *   hbm_used_bytes   HBM bytes currently in use
+ *   temp_millic      die temperature, millidegrees C (hwmon idiom)
+ *   power_uw         power draw, microwatts (hwmon idiom)
+ *   ici/link<K>/state   per-ICI-link state: "up" is up, anything else
+ *                       (incl. a missing attribute) reads down
+ *   ici/link<K>/errors  per-link cumulative error count (>= 0)
+ *
+ * Every attribute is optional: `fields` is a bitmask of which scalar
+ * fields were present AND parsed (strict base-0 integer, no trailing
+ * garbage — both backends accept byte-identical inputs, parity-
+ * tested); absent/garbled attributes simply clear their bit. Links are
+ * the ici/link<K> dirs, ascending K, truncated at TPUINFO_MAX_LINKS.
+ * Returns 1 when the chip exists (even with zero attributes), -errno
+ * when the chip's sysfs dir is missing. */
+#define TPUINFO_MAX_LINKS 8
+#define TPUINFO_TELEM_DUTY 1
+#define TPUINFO_TELEM_HBM 2
+#define TPUINFO_TELEM_TEMP 4
+#define TPUINFO_TELEM_POWER 8
+
+typedef struct {
+  int fields;                /* TPUINFO_TELEM_* bitmask */
+  double duty_cycle_pct;     /* valid iff TPUINFO_TELEM_DUTY */
+  long long hbm_used_bytes;  /* valid iff TPUINFO_TELEM_HBM */
+  double temp_c;             /* millic / 1000.0; valid iff ..._TEMP */
+  double power_w;            /* uw / 1e6; valid iff ..._POWER */
+  int link_count;            /* ici/link<K> dirs found (<= MAX_LINKS) */
+  int link_id[TPUINFO_MAX_LINKS];
+  int link_up[TPUINFO_MAX_LINKS];        /* 1 up, 0 down */
+  long long link_errors[TPUINFO_MAX_LINKS]; /* >= 0; unparsable -> 0 */
+} tpuinfo_chip_telemetry_t;
+
+int tpuinfo_chip_telemetry(const char* sysfs_class_dir, int index,
+                           tpuinfo_chip_telemetry_t* out);
+
 /* vfio layout (newer GKE TPU node images bind chips to vfio-pci; there
  * is no /sys/class/accel). The discovery surface is the IOMMU-group
  * topology:
@@ -167,6 +209,14 @@ int tpuinfo_vfio_chip_health_reason(const char* iommu_groups_dir,
  * group's TPU functions; same contract as tpuinfo_chip_coords. */
 int tpuinfo_vfio_chip_coords(const char* iommu_groups_dir, int group,
                              int out_xyz[3]);
+
+/* Runtime telemetry for the chip in IOMMU group <group>: the same
+ * attribute contract as tpuinfo_chip_telemetry, read off the group's
+ * first TPU function's device dir (the function that identifies the
+ * chip — see tpuinfo_scan_vfio). Returns 1 when the group exists,
+ * -errno when it doesn't. */
+int tpuinfo_vfio_chip_telemetry(const char* iommu_groups_dir, int group,
+                                tpuinfo_chip_telemetry_t* out);
 
 const char* tpuinfo_version(void);
 

@@ -23,6 +23,13 @@ python -m k8s_device_plugin_tpu.tools.trace --self-test > /dev/null \
 # the /debug/decisions snapshot shape and the renderer fails CI here.
 python -m k8s_device_plugin_tpu.tools.explain --self-test > /dev/null \
   || { echo "tools/explain.py --self-test FAILED"; exit 1; }
+# Telemetry tooling smoke: tputop must render a per-chip/per-pod table
+# from a scrape produced by the REAL pipeline (fake sysfs tree →
+# discovery backend chip_telemetry → sampler with attribution →
+# registry text exposition → the CLI parser) — a drift anywhere in
+# that chain fails CI here, before the pytest gate.
+python -m k8s_device_plugin_tpu.tools.tputop --self-test > /dev/null \
+  || { echo "tools/tputop.py --self-test FAILED"; exit 1; }
 # Crash-recovery smoke: the admission-state journal must round-trip
 # reserve -> crash -> replay, tolerate a torn tail, and survive a
 # compaction (extender/journal.py --self-test) — a statestore format

@@ -65,6 +65,52 @@ def set_chip_coords(accel_dir: str, index: int, coords: str):
     _write(devdir, "coords", coords)
 
 
+def set_chip_telemetry(
+    accel_dir: str,
+    index: int,
+    duty_pct=None,
+    hbm_used_bytes=None,
+    temp_c=None,
+    power_w=None,
+):
+    """Write the writable runtime-telemetry attributes for chip `index`
+    (the tpuinfo_chip_telemetry surface: duty_cycle_pct /
+    hbm_used_bytes / temp_millic / power_uw). Pass only what the fake
+    driver should publish — absent attributes must read as None, never
+    0, and a raw string value (e.g. "85%") exercises the
+    garbled-attribute path."""
+    devdir = os.path.join(accel_dir, f"accel{index}", "device")
+    if duty_pct is not None:
+        _write(devdir, "duty_cycle_pct", str(duty_pct))
+    if hbm_used_bytes is not None:
+        _write(devdir, "hbm_used_bytes", str(hbm_used_bytes))
+    if temp_c is not None:
+        millic = (
+            temp_c if isinstance(temp_c, str) else str(int(temp_c * 1000))
+        )
+        _write(devdir, "temp_millic", millic)
+    if power_w is not None:
+        uw = (
+            power_w
+            if isinstance(power_w, str)
+            else str(int(power_w * 1_000_000))
+        )
+        _write(devdir, "power_uw", uw)
+
+
+def set_chip_ici_link(
+    accel_dir: str, index: int, link: int, up: bool, errors: int = 0
+):
+    """Publish one ICI link's state/errors for chip `index`
+    (ici/link<K>/{state,errors})."""
+    linkdir = os.path.join(
+        accel_dir, f"accel{index}", "device", "ici", f"link{link}"
+    )
+    os.makedirs(linkdir, exist_ok=True)
+    _write(linkdir, "state", "up" if up else "down")
+    _write(linkdir, "errors", str(errors))
+
+
 def make_fake_vfio_node(
     root: str,
     chip_type: str = "v5p",

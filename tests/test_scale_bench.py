@@ -161,6 +161,75 @@ def test_tracing_overhead_probe_schema_and_restore():
     assert tracing.COLLECTOR is saved_collector
 
 
+def test_telemetry_overhead_probe_bound_and_schema():
+    """ISSUE 7 acceptance: the telemetry plane's cost on the
+    control-plane hot path with the sampler OFF (its production
+    default) is bounded ≤1.05× the placeable-tracking-off control arm
+    — filter, prioritize, AND the index-fed dirty admission tick. The
+    tracking work lives at entry-REBUILD time by construction, so the
+    only thing that could move these numbers is an accidental
+    RPC-path dependency; a small absolute floor absorbs sub-ms timer
+    noise (p99 of N samples is the max sample). Sampler-on costs are
+    schema-checked here and documented by bench.py
+    detail.telemetry_overhead at 1,000 nodes."""
+    from k8s_device_plugin_tpu import telemetry
+    from k8s_device_plugin_tpu.utils import metrics
+
+    saved_provider = telemetry.CLUSTER_PROVIDER
+
+    def probe():
+        # ≥101 samples per path so _pctl's p99 index lands BELOW the
+        # max sample: a single multi-ms OS scheduler preemption (they
+        # land randomly in either arm and the ratio bound can't absorb
+        # one) no longer decides the p99.
+        return scale_bench.telemetry_overhead(
+            n_nodes=60, filter_calls=101, tick_rounds=101,
+            sampler_rounds=5,
+        )
+
+    def violations(r):
+        out = []
+        for path in ("filter", "prioritize", "tick_dirty"):
+            base = r["control"][path]["p99_ms"]
+            got = r["tracked"][path]["p99_ms"]
+            if got > 1.05 * base + 0.3:
+                out.append(
+                    f"{path}: tracked p99 {got}ms vs control {base}ms "
+                    f"(bound 1.05x + 0.3ms noise floor)"
+                )
+        return out
+
+    r = probe()
+    failures = violations(r)
+    if failures:
+        # The suite-wide host-contention convention (module docstring):
+        # one full re-run; a real RPC-path dependency on the tracking
+        # plane fails both complete runs.
+        r = probe()
+        failures = violations(r)
+    assert not failures, failures
+    # Probe hygiene (the tracing_overhead save/restore contract): the
+    # bench indexes must not stay registered as the process's cluster
+    # provider, and their synthetic placeable series must be pruned.
+    assert telemetry.CLUSTER_PROVIDER is saved_provider
+    assert metrics.EXT_PLACEABLE_NODES.series() == []
+    assert r["nodes"] == 60
+    for arm in ("control", "tracked"):
+        assert r[arm]["filter"]["samples"] == 101
+        assert r[arm]["tick_dirty"]["samples"] == 101
+        assert r[arm]["index_build_ms"] > 0
+    assert r["sampler_tick"]["samples"] == 5
+    assert r["node_gauges"]["p99_ms"] >= 0
+    # The probe prunes its synthetic chips from the process registry.
+    assert not [
+        s for fam in telemetry.CHIP_FAMILIES for s in fam.series()
+    ]
+    assert "filter_p99_overhead_pct" in r
+    # The sampler's own numbers are off-hot-path but must stay sane:
+    # a full 8-chip pass is sub-100ms even on a loaded CI host.
+    assert r["sampler_tick"]["p99_ms"] < 100
+
+
 def test_scale_bench_correctness_assertions_fire():
     """run() itself asserts every node passes the all-free filter on
     BOTH paths (indexed and full-object), every gang releases in the

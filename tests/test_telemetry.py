@@ -1,0 +1,799 @@
+"""Chip telemetry: backend parity, sampler attribution + pruning,
+fragmentation gauges, the cluster aggregate, and the acceptance e2e
+(allocate → sampler tick → attributed scrape → free → pruned scrape).
+
+ISSUE 7: the DCGM-exporter idiom in-process — per-chip series labeled
+by the holding pod/gang, plus capacity/fragmentation observability.
+"""
+
+import json
+import os
+import subprocess
+import time
+
+import pytest
+import requests
+
+from k8s_device_plugin_tpu import telemetry
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.discovery.chips import ChipTelemetry
+from k8s_device_plugin_tpu.discovery.scanner import NativeTpuInfo, PyTpuInfo
+from k8s_device_plugin_tpu.discovery.vfio import NativeVfioTpuInfo, VfioTpuInfo
+from k8s_device_plugin_tpu.health.watcher import HealthWatcher
+from k8s_device_plugin_tpu.topology.mesh import IciMesh
+from k8s_device_plugin_tpu.topology.placement import (
+    fragmentation_stats,
+    placeable_box_sizes,
+)
+from k8s_device_plugin_tpu.topology.schema import NodeTopology
+from k8s_device_plugin_tpu.utils import metrics
+from k8s_device_plugin_tpu.utils.flightrecorder import RECORDER
+from tests import fakes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "native", "tpuinfo")
+NATIVE_LIB = os.path.join(NATIVE_DIR, "build", "libtpuinfo.so")
+
+NODE = "tpu-node-1"
+
+
+@pytest.fixture(scope="session")
+def native_lib():
+    if not os.path.exists(NATIVE_LIB):
+        subprocess.run(
+            ["make", "-C", NATIVE_DIR], check=True, capture_output=True
+        )
+    return NATIVE_LIB
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_series():
+    """Telemetry families live in the process-global registry; every
+    test starts and ends with no per-chip/per-size series so ordering
+    can't leak labels across tests."""
+    yield
+    for fam in telemetry.CHIP_FAMILIES:
+        fam.remove_matching()
+    for fam in (
+        metrics.NODE_BOX_PLACEABLE,
+        metrics.EXT_PLACEABLE_NODES,
+        metrics.TELEMETRY_TICKS,
+    ):
+        fam.remove_matching()
+    telemetry.install_sampler(None)
+    telemetry.NODE_STATS = None
+
+
+def _chips_and_mesh(tmp_path, chip_type="v5e", count=4):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), chip_type, count)
+    chips = PyTpuInfo().scan(accel, dev)
+    return accel, dev, chips, IciMesh(chips)
+
+
+# -- backend parity ----------------------------------------------------------
+
+def _publish_rich_telemetry(accel):
+    fakes.set_chip_telemetry(
+        accel, 0, duty_pct=73, hbm_used_bytes=8 * 2**30,
+        temp_c=66.5, power_w=175.0,
+    )
+    fakes.set_chip_ici_link(accel, 0, 0, up=True, errors=5)
+    fakes.set_chip_ici_link(accel, 0, 2, up=False)
+    # Garbled values must be rejected identically by both backends.
+    fakes.set_chip_telemetry(accel, 1, duty_pct="85%")
+    fakes.set_chip_telemetry(accel, 1, hbm_used_bytes="-4")
+    fakes.set_chip_telemetry(accel, 1, temp_c="0x1388")  # hex: valid
+
+
+def test_chip_telemetry_backend_parity(native_lib, tmp_path):
+    accel, dev, chips, _ = _chips_and_mesh(tmp_path)
+    _publish_rich_telemetry(accel)
+    py = PyTpuInfo()
+    nat = NativeTpuInfo(native_lib)
+    for i in range(4):
+        assert py.chip_telemetry(accel, i) == nat.chip_telemetry(accel, i)
+    rich = py.chip_telemetry(accel, 0)
+    assert rich.duty_cycle_pct == 73.0
+    assert rich.hbm_used_bytes == 8 * 2**30
+    assert rich.temp_c == 66.5 and rich.power_w == 175.0
+    assert [(l.link, l.up, l.errors) for l in rich.links] == [
+        (0, True, 5), (2, False, 0),
+    ]
+    garbled = py.chip_telemetry(accel, 1)
+    assert garbled.duty_cycle_pct is None  # "85%" rejected
+    assert garbled.hbm_used_bytes is None  # negative rejected
+    assert garbled.temp_c == 5.0  # base-0 parse: 0x1388 millidegrees
+    # Grammar edges where strtoll base 0 and Python's int(s, 0)
+    # DISAGREE ("010" octal vs ValueError; "1_0"/"0o10" Python-only):
+    # the shared strict grammar must reject them on BOTH backends.
+    for bad in (
+        "010", "1_0", "0o10", "0b1", "0x", "+",
+        str(2**63),  # LLONG_MAX+1: strtoll ERANGE, Python must match
+        "0x" + "f" * 17,  # >64-bit hex
+    ):
+        fakes.set_chip_telemetry(accel, 2, hbm_used_bytes=bad)
+        assert py.chip_telemetry(accel, 2).hbm_used_bytes is None, bad
+        assert nat.chip_telemetry(accel, 2).hbm_used_bytes is None, bad
+    # Non-UTF8 garbage in a scalar attribute costs that FIELD on both
+    # backends — never the whole chip (no text-decode crash).
+    with open(
+        os.path.join(accel, "accel2", "device", "hbm_used_bytes"), "wb"
+    ) as f:
+        f.write(b"\xff\xfe42\n")
+    assert py.chip_telemetry(accel, 2) == nat.chip_telemetry(accel, 2)
+    assert py.chip_telemetry(accel, 2).hbm_used_bytes is None
+    fakes.set_chip_telemetry(accel, 2, hbm_used_bytes="0")
+    assert py.chip_telemetry(accel, 2).hbm_used_bytes == 0
+    assert nat.chip_telemetry(accel, 2).hbm_used_bytes == 0
+    bare = py.chip_telemetry(accel, 3)
+    assert bare == ChipTelemetry(index=3)  # nothing published, no zeros
+    with pytest.raises(OSError):
+        py.chip_telemetry(accel, 9)
+    with pytest.raises(OSError):
+        nat.chip_telemetry(accel, 9)
+
+
+def test_vfio_chip_telemetry_backend_parity(native_lib, tmp_path):
+    groups, dev_vfio = fakes.make_fake_vfio_node(str(tmp_path), "v5p", 2)
+    # Telemetry attrs live on the group's identity function.
+    devs = os.path.join(groups, "10", "devices")
+    func = os.path.join(devs, sorted(os.listdir(devs))[0])
+    with open(os.path.join(func, "duty_cycle_pct"), "w") as f:
+        f.write("12\n")
+    py = VfioTpuInfo()
+    nat = NativeVfioTpuInfo(native_lib)
+    for g in (10, 11):
+        assert py.chip_telemetry(groups, g) == nat.chip_telemetry(groups, g)
+    assert py.chip_telemetry(groups, 10).duty_cycle_pct == 12.0
+    assert py.chip_telemetry(groups, 11) == ChipTelemetry(index=11)
+    with pytest.raises(OSError):
+        py.chip_telemetry(groups, 99)
+    with pytest.raises(OSError):
+        nat.chip_telemetry(groups, 99)
+
+
+def test_zero_spec_chip_degrades_gracefully(tmp_path):
+    """The scanner's unknown-generation fallback builds chips with
+    hbm_bytes=0; the HBM ratio must read None (absent series, null in
+    to_dict) — never a division by zero or a nonsense ratio."""
+    tel = ChipTelemetry(index=0, hbm_used_bytes=4 * 2**30)
+    assert tel.hbm_used_ratio(16 * 2**30) == 0.25
+    assert tel.hbm_used_ratio(0) is None
+    assert tel.hbm_used_ratio(-1) is None
+    assert ChipTelemetry(index=0).hbm_used_ratio(16 * 2**30) is None
+    d = tel.to_dict(0)
+    assert d["hbm_used_pct"] is None and d["hbm_total_bytes"] is None
+    # Over-reporting clamps instead of exporting >1.
+    assert ChipTelemetry(index=0, hbm_used_bytes=10).hbm_used_ratio(5) == 1.0
+    # End to end: an unknown-device-id chip through the sampler exports
+    # used-bytes but no ratio series.
+    accel, dev = fakes.make_fake_tpu_node(
+        str(tmp_path), chip_type="unknown-gen", count=2
+    )
+    chips = PyTpuInfo().scan(accel, dev)
+    assert all(c.hbm_bytes == 0 for c in chips)
+    fakes.set_chip_telemetry(accel, 0, hbm_used_bytes=123)
+    mesh = IciMesh(chips)
+    sampler = telemetry.TelemetrySampler(PyTpuInfo(), accel, mesh)
+    sampler.poll_once()
+    assert metrics.CHIP_HBM_USED.get(chip=mesh.ids[0]) == 123
+    assert not [
+        s for s in metrics.CHIP_HBM_RATIO.series()
+        if s[0].get("chip") == mesh.ids[0]
+    ]
+
+
+# -- metric label-set pruning ------------------------------------------------
+
+def test_metric_remove_and_remove_matching():
+    m = metrics.Metric("t", "t", "gauge")
+    m.set(1, chip="a", pod="p1")
+    m.set(2, chip="a", link="0", pod="p1")
+    m.set(3, chip="b")
+    assert m.remove(chip="b") is True
+    assert m.remove(chip="b") is False  # already gone
+    assert m.remove_matching(chip="a") == 2
+    assert m.series() == []
+    m.set(4, chip="c")
+    assert m.remove_matching() == 1  # empty subset matches everything
+
+
+# -- fragmentation math ------------------------------------------------------
+
+def test_fragmentation_stats_shapes(tmp_path):
+    _, _, chips, mesh = _chips_and_mesh(tmp_path, count=8)  # v5e (2,4,1)
+    assert placeable_box_sizes(8) == [1, 2, 4, 8]
+    all_free = fragmentation_stats(mesh, mesh.ids)
+    assert all_free == {
+        "free": 8, "largest_box": 8, "fragmentation": 0.0,
+        "placeable": {1: True, 2: True, 4: True, 8: True},
+    }
+    # Free chips at opposite corners: 2 free, nothing contiguous of 2.
+    corners = [mesh.by_coords[(0, 0, 0)].id, mesh.by_coords[(1, 3, 0)].id]
+    scattered = fragmentation_stats(mesh, corners)
+    assert scattered["free"] == 2
+    assert scattered["largest_box"] == 1
+    assert scattered["fragmentation"] == 0.5
+    assert scattered["placeable"] == {
+        1: True, 2: False, 4: False, 8: False,
+    }
+    empty = fragmentation_stats(mesh, [])
+    assert empty["fragmentation"] == 0.0  # exhausted, not fragmented
+    assert empty["largest_box"] == 0
+
+
+def test_plugin_updates_fragmentation_gauges_on_allocation(tmp_path):
+    from k8s_device_plugin_tpu.server.plugin import (
+        PluginConfig,
+        TpuDevicePlugin,
+    )
+
+    _, _, chips, mesh = _chips_and_mesh(tmp_path, count=8)
+    plugin = TpuDevicePlugin(
+        mesh, config=PluginConfig(libtpu_host_path="")
+    )
+    assert metrics.NODE_FRAGMENTATION.get() == 0.0
+    assert metrics.NODE_LARGEST_BOX.get() == 8
+    assert metrics.NODE_BOX_PLACEABLE.get(size="8") == 1
+    # Empty event-ish states carry no series (Metric.remove retrofit).
+    assert not [
+        s for s in metrics.CHIPS.series() if s[0].get("state") == "allocated"
+    ]
+    # Fragment the node: allocate a scattered pair by hand.
+    plugin.state.allocate(
+        [mesh.by_coords[(0, 1, 0)].id, mesh.by_coords[(1, 2, 0)].id]
+    )
+    plugin._availability_changed()
+    assert metrics.CHIPS.get(state="allocated") == 2
+    assert metrics.NODE_FREE_CHIPS.get() == 6
+    assert metrics.NODE_BOX_PLACEABLE.get(size="8") == 0
+    assert metrics.NODE_FRAGMENTATION.get() > 0
+    plugin.free_devices(plugin.state.allocated)
+    assert metrics.NODE_FRAGMENTATION.get() == 0.0
+    assert not [
+        s for s in metrics.CHIPS.series() if s[0].get("state") == "allocated"
+    ]
+
+
+# -- the sampler -------------------------------------------------------------
+
+def test_sampler_attribution_pruning_and_link_deltas(tmp_path):
+    accel, dev, chips, mesh = _chips_and_mesh(tmp_path)
+    cid = mesh.ids[0]
+    idx = mesh.by_id[cid].chip.index
+    fakes.set_chip_telemetry(accel, idx, duty_pct=50, temp_c=60.0)
+    fakes.set_chip_ici_link(accel, idx, 0, up=True, errors=100)
+    holder = {
+        cid: {
+            "pod": "w0", "namespace": "ml",
+            "container": "train", "gang": "g1",
+        }
+    }
+    state = {"attr": holder}
+    sampler = telemetry.TelemetrySampler(
+        PyTpuInfo(), accel, mesh, attribution=lambda: state["attr"]
+    )
+    sampler.poll_once()
+    labels = {
+        "chip": cid, "pod": "w0", "namespace": "ml",
+        "container": "train", "gang": "g1",
+    }
+    assert metrics.CHIP_DUTY_CYCLE.get(**labels) == 50
+    assert metrics.CHIP_TEMP.get(**labels) == 60.0
+    # First link sample is the baseline: no historical errors imported.
+    assert metrics.CHIP_LINK_ERRORS.get(**labels, link="0") == 0
+    fakes.set_chip_ici_link(accel, idx, 0, up=True, errors=107)
+    sampler.poll_once()
+    assert metrics.CHIP_LINK_ERRORS.get(**labels, link="0") == 7
+    # Driver counter reset: delta restarts from the new value.
+    fakes.set_chip_ici_link(accel, idx, 0, up=True, errors=3)
+    sampler.poll_once()
+    assert metrics.CHIP_LINK_ERRORS.get(**labels, link="0") == 10
+    # The holder vanishes: every old-labeled series must be pruned on
+    # the NEXT tick, replaced by unattributed (chip-only) series.
+    state["attr"] = {}
+    sampler.poll_once()
+    stale = [
+        s for fam in telemetry.CHIP_FAMILIES
+        for s in fam.series()
+        if s[0].get("pod") == "w0"
+    ]
+    assert stale == []
+    assert metrics.CHIP_DUTY_CYCLE.get(chip=cid) == 50
+    # An attribute the driver stops publishing drops its series too.
+    os.unlink(
+        os.path.join(accel, f"accel{idx}", "device", "temp_millic")
+    )
+    sampler.poll_once()
+    assert not [
+        s for s in metrics.CHIP_TEMP.series() if s[0].get("chip") == cid
+    ]
+    # ...and so does a link the driver stops publishing: a dead link
+    # frozen at its last up=1 reading would hide the fault.
+    import shutil
+
+    shutil.rmtree(
+        os.path.join(accel, f"accel{idx}", "device", "ici", "link0")
+    )
+    sampler.poll_once()
+    assert not [
+        s for fam in (metrics.CHIP_LINK_UP, metrics.CHIP_LINK_ERRORS)
+        for s in fam.series() if s[0].get("chip") == cid
+    ]
+    snap = sampler.snapshot()
+    assert snap["ticks"] == 6
+    # A chip whose read starts FAILING (device dir unbound mid-flight,
+    # no SIGHUP rebuild yet) prunes everything it exported — hours-old
+    # attributed values must not keep scraping as if live.
+    fakes.set_chip_telemetry(accel, idx, duty_pct=50)
+    sampler.poll_once()
+    assert metrics.CHIP_DUTY_CYCLE.get(chip=cid) == 50
+    import shutil as _sh
+
+    _sh.rmtree(os.path.join(accel, f"accel{idx}"))
+    sampler.poll_once()
+    assert not [
+        s for fam in telemetry.CHIP_FAMILIES
+        for s in fam.series() if s[0].get("chip") == cid
+    ]
+    assert metrics.TELEMETRY_TICKS.get(outcome="error") >= 1
+    assert any(c["chip"] == cid for c in snap["chips"])
+
+
+def test_sampler_threshold_flight_events(tmp_path):
+    accel, dev, chips, mesh = _chips_and_mesh(tmp_path)
+    idx = mesh.by_id[mesh.ids[0]].chip.index
+    RECORDER.enable(service="plugin")
+    RECORDER.clear()
+    try:
+        fakes.set_chip_telemetry(
+            accel, idx, temp_c=95.0,
+            hbm_used_bytes=int(16 * 2**30 * 0.99),
+        )
+        sampler = telemetry.TelemetrySampler(PyTpuInfo(), accel, mesh)
+        sampler.poll_once()
+        sampler.poll_once()  # deduped while the condition persists
+        events = RECORDER.snapshot()["events"]
+        thermal = [e for e in events if e["kind"] == "chip_thermal"]
+        hbm = [e for e in events if e["kind"] == "chip_hbm_pressure"]
+        assert len(thermal) == 1 and len(hbm) == 1
+        assert thermal[0]["attrs"]["state"] == "over"
+        # Crossing back records the clear, once.
+        fakes.set_chip_telemetry(accel, idx, temp_c=60.0)
+        sampler.poll_once()
+        thermal = [
+            e for e in RECORDER.snapshot()["events"]
+            if e["kind"] == "chip_thermal"
+        ]
+        assert [e["attrs"]["state"] for e in thermal] == ["over", "cleared"]
+    finally:
+        RECORDER.clear()
+        RECORDER.disable()
+
+
+def test_sampler_thread_start_stop(tmp_path):
+    accel, dev, chips, mesh = _chips_and_mesh(tmp_path)
+    fakes.set_chip_telemetry(accel, 0, duty_pct=10)
+    sampler = telemetry.TelemetrySampler(
+        PyTpuInfo(), accel, mesh, interval_s=0.05
+    )
+    before = metrics.TELEMETRY_TICKS.get(outcome="ok")
+    sampler.start()
+    deadline = time.time() + 5
+    while (
+        metrics.TELEMETRY_TICKS.get(outcome="ok") < before + 2
+        and time.time() < deadline
+    ):
+        time.sleep(0.02)
+    sampler.stop()
+    assert metrics.TELEMETRY_TICKS.get(outcome="ok") >= before + 2
+
+
+# -- health watcher corroboration --------------------------------------------
+
+def test_watcher_corroborates_ici_link_down(tmp_path):
+    accel, dev, chips, mesh = _chips_and_mesh(tmp_path)
+    transitions = []
+    watcher = HealthWatcher(
+        PyTpuInfo(), accel, dev, chips,
+        callback=lambda cid, h: transitions.append((cid, h)),
+    )
+    RECORDER.enable(service="plugin")
+    RECORDER.clear()
+    try:
+        # Corroborated: the health attribute and the link telemetry
+        # agree (link 1 down, errors accumulating).
+        fakes.set_chip_ici_link(accel, 0, 1, up=False, errors=44)
+        fakes.set_chip_health(accel, 0, healthy=False, reason="ici_link_down")
+        watcher.poll_once()
+        assert transitions == [(chips[0].device_id_str, False)]
+        (ev,) = [
+            e for e in RECORDER.snapshot()["events"]
+            if e["kind"] == "ici_link_fault"
+        ]
+        assert ev["attrs"]["corroborated"] == "True"
+        assert ev["attrs"]["down_links"] == "1"
+        assert ev["attrs"]["link_errors"] == "44"
+        # The sampler reads the SAME surface: it must agree.
+        tel = PyTpuInfo().chip_telemetry(accel, 0)
+        assert [l.link for l in tel.links if not l.up] == [1]
+        # Disagreement: health says link down, telemetry says all up.
+        fakes.set_chip_ici_link(accel, 1, 0, up=True)
+        fakes.set_chip_health(accel, 1, healthy=False, reason="ici_link_down")
+        watcher.poll_once()
+        uncorr = [
+            e for e in RECORDER.snapshot()["events"]
+            if e["kind"] == "ici_link_fault"
+            and e["attrs"]["chip"] == chips[1].device_id_str
+        ]
+        assert uncorr and uncorr[0]["attrs"]["corroborated"] == "False"
+    finally:
+        RECORDER.clear()
+        RECORDER.disable()
+
+
+# -- extender cluster aggregate ----------------------------------------------
+
+def _topo_json(tmp_path, name, count=4, available=None):
+    accel, dev = fakes.make_fake_tpu_node(
+        str(tmp_path / name), "v5e", count
+    )
+    chips = PyTpuInfo().scan(accel, dev)
+    mesh = IciMesh(chips)
+    return NodeTopology.from_mesh(
+        mesh, hostname=name,
+        available=available if available is not None else mesh.ids,
+    ).to_json(), mesh
+
+
+def test_index_maintains_placeable_aggregate(tmp_path):
+    from k8s_device_plugin_tpu.extender.index import TopologyIndex
+
+    index = TopologyIndex()
+    raw_a, mesh = _topo_json(tmp_path, "node-a", count=8)
+    raw_b, _ = _topo_json(tmp_path, "node-b", count=8)
+    index.update("node-a", raw_a)
+    index.update("node-b", raw_b)
+    assert index.get("node-a").placeable == (1, 2, 4, 8)
+    assert metrics.EXT_PLACEABLE_NODES.get(size="8") == 2
+    assert index.placeable_snapshot()["placeable_nodes"]["8"] == 2
+    # node-a fragments: only scattered singles left.
+    scattered = [
+        mesh.by_coords[(0, 0, 0)].id, mesh.by_coords[(1, 3, 0)].id,
+    ]
+    raw_frag, _ = _topo_json(
+        tmp_path, "node-a2", count=8, available=scattered
+    )
+    index.update("node-a", raw_frag)
+    assert metrics.EXT_PLACEABLE_NODES.get(size="8") == 1
+    assert metrics.EXT_PLACEABLE_NODES.get(size="1") == 2
+    # node-b leaves: the emptied size drops its series entirely.
+    index.remove("node-b")
+    assert not [
+        s for s in metrics.EXT_PLACEABLE_NODES.series()
+        if s[0].get("size") == "8"
+    ]
+    assert metrics.EXT_PLACEABLE_NODES.get(size="1") == 1
+    # The /debug/telemetry cluster panel reflects the same counts.
+    assert telemetry.debug_snapshot()["cluster"]["placeable_nodes"] == {
+        "1": 1
+    }
+    # Control arm for the bench: tracking off maintains nothing.
+    off = TopologyIndex(track_placeable=False)
+    off.update("node-c", raw_b)
+    assert off.get("node-c").placeable == ()
+
+
+# -- /debug/telemetry --------------------------------------------------------
+
+def test_debug_telemetry_endpoint(tmp_path):
+    accel, dev, chips, mesh = _chips_and_mesh(tmp_path)
+    fakes.set_chip_telemetry(accel, 0, duty_pct=41)
+    sampler = telemetry.TelemetrySampler(
+        PyTpuInfo(), accel, mesh,
+        attribution=lambda: {mesh.ids[0]: {"pod": "p", "namespace": "n",
+                                           "gang": "g"}},
+    )
+    telemetry.install_sampler(sampler)
+    sampler.poll_once()
+    telemetry.update_node_gauges(mesh, mesh.ids[1:])
+    srv = metrics.MetricsServer(host="127.0.0.1")
+    url = srv.start()
+    try:
+        payload = requests.get(
+            f"{url}/debug/telemetry", timeout=5
+        ).json()
+        assert payload["enabled"] is True
+        assert payload["ticks"] == 1
+        chip0 = [c for c in payload["chips"] if c["chip"] == mesh.ids[0]]
+        assert chip0 and chip0[0]["pod"] == "p" and chip0[0]["gang"] == "g"
+        assert chip0[0]["duty_cycle_pct"] == 41.0
+        assert payload["node"]["free"] == 3
+    finally:
+        srv.stop()
+
+
+# -- tputop ------------------------------------------------------------------
+
+def test_tputop_renders_and_self_tests(tmp_path, capsys):
+    from k8s_device_plugin_tpu.tools import tputop
+
+    accel, dev, chips, mesh = _chips_and_mesh(tmp_path)
+    fakes.set_chip_telemetry(
+        accel, 0, duty_pct=88, hbm_used_bytes=8 * 2**30, temp_c=71.0,
+        power_w=200.0,
+    )
+    fakes.set_chip_ici_link(accel, 0, 0, up=False, errors=9)
+    sampler = telemetry.TelemetrySampler(
+        PyTpuInfo(), accel, mesh,
+        attribution=lambda: {
+            mesh.ids[0]: {"pod": "w0", "namespace": "ml", "gang": "g"}
+        },
+    )
+    sampler.poll_once()
+    telemetry.update_node_gauges(mesh, mesh.ids[2:])
+    table = tputop.render(metrics.REGISTRY.render())
+    assert "ml/w0" in table and "88" in table and "71.0C" in table
+    assert "0up/1dn" in table
+    assert "fragmentation=" in table
+    scrape = tmp_path / "scrape.txt"
+    scrape.write_text(metrics.REGISTRY.render())
+    assert tputop.main([str(scrape)]) == 0
+    assert "ml/w0" in capsys.readouterr().out
+    with pytest.raises(ValueError):
+        tputop.render("nothing_here 1\n")
+
+
+def test_tputop_self_test(capsys):
+    """Runs on a clean registry (the autouse fixture pruned any earlier
+    chip series — the self-test's fake tree reuses the canonical fake
+    PCI addresses, so leftovers would collide)."""
+    from k8s_device_plugin_tpu.tools import tputop
+
+    assert tputop.main(["--self-test"]) == 0
+    assert "tputop self-test: OK" in capsys.readouterr().out
+
+
+def test_rebuild_partial_attribution_refreshed_at_resync(tmp_path):
+    """A daemon-restart rebuild records attribution without the
+    container (and, apiserver-less, without the gang); the pod's next
+    resync pass through the already-reconciled branch must refresh
+    both — not trust the partial record forever."""
+    from k8s_device_plugin_tpu.controller.controller import Controller
+    from k8s_device_plugin_tpu.server.plugin import (
+        PluginConfig,
+        TpuDevicePlugin,
+    )
+    from tests.fake_kubelet import FakePodResources
+
+    _, _, chips, mesh = _chips_and_mesh(tmp_path)
+    plugin = TpuDevicePlugin(
+        mesh, config=PluginConfig(libtpu_host_path="")
+    )
+    podres = FakePodResources(str(tmp_path / "podres" / "kubelet.sock"))
+    podres.start()
+    try:
+        controller = Controller(
+            None, plugin, node_name=NODE,
+            checkpoint_path=str(tmp_path / "no-checkpoint"),
+            podresources_socket=podres.socket_path,
+        )
+        want = mesh.ids[:2]
+        # What rebuild_state records: pod identity only, marked partial.
+        controller._record_attribution(
+            {"namespace": "ml", "name": "w0"}, want, partial=True
+        )
+        assert controller.chip_attribution()[want[0]]["container"] == ""
+        assert "_partial" not in controller.chip_attribution()[want[0]]
+        podres.set_pod("ml", "w0", constants.RESOURCE_NAME, want)
+        pod = {
+            "metadata": {
+                "name": "w0", "namespace": "ml", "uid": "u-w0",
+                "labels": {constants.GANG_NAME_LABEL: "g"},
+                "annotations": {
+                    constants.POD_DEVICES_ANNOTATION: ",".join(want)
+                },
+            },
+            "spec": {"containers": [{
+                "name": "main",
+                "resources": {"requests": {"google.com/tpu": "2"}},
+            }]},
+        }
+        controller._handle_update_impl(pod)
+        attr = controller.chip_attribution()[want[0]]
+        assert attr["container"] == "main" and attr["gang"] == "g"
+        # Fresh now: the next resync pass must NOT re-pay the lookup.
+        assert not controller._attribution_stale(
+            pod["metadata"], want
+        )
+    finally:
+        podres.stop()
+
+
+# -- supervisor wiring -------------------------------------------------------
+
+def test_supervisor_flag_and_sampler_lifecycle(tmp_path):
+    from k8s_device_plugin_tpu.supervisor.main import (
+        Daemon,
+        DaemonConfig,
+        parse_args,
+    )
+
+    cfg = parse_args(["--telemetry-interval-s", "7"])
+    assert cfg.telemetry_interval_s == 7.0
+    assert parse_args([]).telemetry_interval_s == 0.0  # off by default
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 4)
+    daemon = Daemon(
+        DaemonConfig(
+            device_plugin_dir=str(tmp_path / "dp"),
+            sysfs_accel_dir=accel,
+            dev_dir=dev,
+            libtpu_host_path="",
+            enable_controller=False,
+            telemetry_interval_s=0.2,
+        )
+    )
+    chips = daemon.discover()
+    daemon._start_telemetry(IciMesh(chips), chips)
+    try:
+        assert daemon.telemetry_sampler is not None
+        assert telemetry.SAMPLER is daemon.telemetry_sampler
+    finally:
+        daemon.teardown()
+    assert daemon.telemetry_sampler is None
+    assert telemetry.SAMPLER is None
+    # interval 0 = no sampler at all (the disabled no-op contract).
+    daemon.cfg.telemetry_interval_s = 0.0
+    daemon._start_telemetry(IciMesh(chips), chips)
+    assert daemon.telemetry_sampler is None
+
+
+# -- docs stay in lockstep ---------------------------------------------------
+
+def test_telemetry_docs_in_lockstep():
+    obs = open(os.path.join(REPO, "docs", "observability.md")).read()
+    assert "/debug/telemetry" in obs
+    assert "--telemetry-interval-s" in obs
+    assert "tputop" in obs
+    ops = open(os.path.join(REPO, "docs", "operations.md")).read()
+    assert "is it thermal or is it fragmentation?" in ops
+    mets = open(os.path.join(REPO, "docs", "metrics.md")).read()
+    for fam in (
+        "tpu_chip_duty_cycle", "tpu_chip_hbm_used_bytes",
+        "tpu_node_topology_fragmentation", "tpu_extender_placeable_nodes",
+    ):
+        assert f"`{fam}`" in mets, fam
+    # The daemonset ships the sampler on by default.
+    deploy = open(
+        os.path.join(REPO, "deploy", "tpu-device-plugin.yml")
+    ).read()
+    assert "--telemetry-interval-s" in deploy
+
+
+# -- the acceptance e2e ------------------------------------------------------
+
+def test_e2e_allocate_attribute_scrape_free_prune(tmp_path):
+    """allocate → sampler tick → scrape shows tpu_chip_* series with
+    the correct pod/gang labels (+ the fragmentation gauge moved) →
+    pod deleted + reconciled → next scrape carries NO stale labels."""
+    from k8s_device_plugin_tpu.api import deviceplugin_pb2 as pb
+    from k8s_device_plugin_tpu.controller.controller import Controller
+    from k8s_device_plugin_tpu.kube.client import KubeClient
+    from k8s_device_plugin_tpu.server.plugin import (
+        PluginConfig,
+        TpuDevicePlugin,
+    )
+    from tests.fake_apiserver import FakeApiServer
+    from tests.fake_kubelet import FakeKubelet, FakePodResources
+
+    api = FakeApiServer()
+    api_url = api.start()
+    api.add_node(NODE)
+    client = KubeClient(api_url)
+    kubelet_dir = tmp_path / "dp"
+    kubelet_dir.mkdir()
+    kubelet = FakeKubelet(str(kubelet_dir))
+    kubelet.start()
+    podres = FakePodResources(str(tmp_path / "podres" / "kubelet.sock"))
+    podres.start()
+    plugin = None
+    srv = None
+    try:
+        accel, dev, chips, mesh = _chips_and_mesh(tmp_path, count=4)
+        fakes.set_chip_telemetry(
+            accel, 0, duty_pct=97, hbm_used_bytes=4 * 2**30, temp_c=68.0
+        )
+        fakes.set_chip_telemetry(accel, 1, duty_pct=96)
+        plugin = TpuDevicePlugin(
+            mesh,
+            config=PluginConfig(
+                libtpu_host_path="",
+                device_plugin_dir=str(kubelet_dir),
+            ),
+        )
+        plugin.serve()
+        assert kubelet.registered.wait(10)
+        controller = Controller(
+            client,
+            plugin,
+            node_name=NODE,
+            checkpoint_path=str(tmp_path / "no-checkpoint"),
+            podresources_socket=podres.socket_path,
+        )
+        sampler = telemetry.TelemetrySampler(
+            PyTpuInfo(), accel, mesh,
+            attribution=controller.chip_attribution,
+        )
+        telemetry.install_sampler(sampler)
+        srv = metrics.MetricsServer(host="127.0.0.1")
+        url = srv.start()
+
+        # 1) The kubelet allocates two chips to a gang-labeled pod.
+        want = [mesh.ids[0], mesh.ids[1]]
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=want)
+        kubelet.plugin_stub().Allocate(req)
+        pod = {
+            "metadata": {
+                "name": "train-w0", "namespace": "ml",
+                "uid": "uid-train-0",
+                "labels": {
+                    constants.GANG_NAME_LABEL: "train",
+                    "tpu.google.com/gang-size": "1",
+                },
+            },
+            "spec": {
+                "nodeName": NODE,
+                "containers": [{
+                    "name": "main",
+                    "resources": {"requests": {"google.com/tpu": "2"}},
+                }],
+            },
+        }
+        api.add_pod(pod)
+        podres.set_pod("ml", "train-w0", constants.RESOURCE_NAME, want)
+        controller._handle_update(client.get_pod("ml", "train-w0"))
+
+        # 2) Sampler tick → scrape: series carry pod AND gang labels,
+        #    and the node fragmentation gauges reflect the allocation.
+        sampler.poll_once()
+        scrape = requests.get(f"{url}/metrics", timeout=5).text
+        assert (
+            'tpu_chip_duty_cycle{chip="%s",container="main",gang="train",'
+            'namespace="ml",pod="train-w0"} 97' % mesh.ids[0]
+        ) in scrape
+        assert (
+            'tpu_chip_hbm_used_bytes{chip="%s",container="main",'
+            'gang="train",namespace="ml",pod="train-w0"} %d'
+            % (mesh.ids[0], 4 * 2**30)
+        ) in scrape
+        assert 'pod="train-w0"' in scrape and 'gang="train"' in scrape
+        assert "tpu_node_topology_fragmentation" in scrape
+        assert "tpu_node_free_chips 2" in scrape
+        # /debug/telemetry shows the same attribution.
+        dbg = requests.get(f"{url}/debug/telemetry", timeout=5).json()
+        attributed = [c for c in dbg["chips"] if c.get("pod")]
+        assert {c["chip"] for c in attributed} == set(want)
+        assert all(c["gang"] == "train" for c in attributed)
+
+        # 3) The pod is deleted and the controller reconciles: the
+        #    next tick prunes every attributed series — no stale
+        #    pod/gang labels on the next scrape.
+        podres.set_pod("ml", "train-w0", constants.RESOURCE_NAME, [])
+        controller._handle_delete(pod)
+        sampler.poll_once()
+        scrape = requests.get(f"{url}/metrics", timeout=5).text
+        assert 'pod="train-w0"' not in scrape
+        assert 'gang="train"' not in scrape
+        assert (
+            'tpu_chip_duty_cycle{chip="%s"} 97' % mesh.ids[0]
+        ) in scrape  # the chip still reports, unattributed
+        assert "tpu_node_free_chips 4" in scrape
+    finally:
+        if srv is not None:
+            srv.stop()
+        if plugin is not None:
+            plugin.stop()
+        podres.stop()
+        kubelet.stop()
+        api.stop()
