@@ -69,6 +69,10 @@ more complete):
                                (sampler-off bound <= 1.05x) plus the
                                documented sampler-tick / node-gauge
                                recompute costs
+  detail.audit_overhead        consistency-audit plane: audit-free vs
+                               audited /filter p99 (bound <= 1.05x)
+                               plus the documented sweep cost at
+                               1,000 nodes
   detail.grant     every chip-grant probe attempt
   detail.workload.mfu   model FLOPs/step ÷ step time ÷ chip peak bf16
   detail.workload_chunked_xent.vs_plain_step   chunked-vocab CE A/B
@@ -810,6 +814,19 @@ def main() -> int:
             result["detail"]["telemetry_overhead"] = {
                 "error": repr(e)[:400]
             }
+        emit()
+        # Phase 1.10: consistency-audit overhead probe (ISSUE 8 — with
+        # the auditor sweeping between RPCs over a real journal +
+        # index, the indexed /filter p99 must stay within 1.05x of the
+        # audit-free arm; the sweep's own cost is documented
+        # alongside — it runs on the admission loop, never an RPC
+        # thread).
+        try:
+            result["detail"]["audit_overhead"] = (
+                scale_bench.audit_overhead(n_nodes=1000)
+            )
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["audit_overhead"] = {"error": repr(e)[:400]}
         emit()
 
         # Phase 2a: harvest the t=0 probe loop (VERDICT r3 #1a /

@@ -1,0 +1,1124 @@
+"""Cross-plane consistency auditor: continuous drift detection.
+
+The trust chain the reference reconciles once — kubelet device-manager
+state onto pod annotations (/root/reference/controller.go:173-225) —
+is spread across five independent state surfaces in this build:
+
+1. the kubelet's own record (PodResources API / internal checkpoint),
+2. the ``google.com/tpu-devices`` pod annotations the controller
+   publishes,
+3. the extender's ReservationTable + its write-ahead admission journal,
+4. the controller's chip→pod attribution map (the telemetry join), and
+5. the exported gauges (``tpu_plugin_chips``,
+   ``tpu_extender_placeable_nodes``) that dashboards and alerts trust.
+
+Nothing cross-checked that they agree: a stale annotation, a leaked
+reservation, or a gauge diverging from placement truth was a silent
+failure class that traces (PR 3) and telemetry (PR 7) could only
+surface *after* an operator already suspected the right pod. This
+module makes drift a first-class, alertable, self-reporting signal:
+
+* a **declarative invariant registry** — each :class:`Invariant` names
+  the planes it joins and returns structured :class:`Finding`s
+  ``{invariant, severity, pod/gang/node/chip, details}``;
+* an :class:`AuditEngine` running them on a cadence
+  (``--audit-interval-s``, 0 = off = no thread, the telemetry-sampler
+  idiom): node-side invariants in the plugin daemon off the gRPC hot
+  path, extender-side invariants piggybacked on the gang-admission
+  upkeep tick on the leader (``maybe_sweep`` — the one thread that
+  owns the journal, so the replay-equivalence check never races the
+  writer);
+* findings exported as ``tpu_audit_findings{invariant,severity}``
+  (+ ``tpu_audit_sweeps_total`` / ``tpu_audit_sweep_seconds`` /
+  ``tpu_audit_last_clean_sweep_timestamp``), fed to the flight
+  recorder and decision ledger as ``audit_divergence`` records on
+  every detection/clear transition (never per-sweep while a finding
+  persists — the threshold-crossing dedup idiom), with a NEW critical
+  finding dumping the flight ring (the PR-3 circuit-break idiom);
+* the whole snapshot served at ``GET /debug/audit`` on both HTTP
+  servers, rendered by ``tools/doctor.py`` (``tpu-doctor check``) and
+  collected into the support bundle (``tpu-doctor bundle``).
+
+Findings are deliberately *observations*, never auto-repairs: every
+plane already has an owner with a reconcile loop, and an auditor that
+"fixed" state would be a second writer racing them — the exact
+failure class it exists to detect.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from .api import constants
+from .kube import checkpoint as ckpt
+from .topology.placement import fragmentation_stats
+from .utils import metrics
+from .utils.decisions import LEDGER
+from .utils.flightrecorder import RECORDER
+from .utils.logging import get_logger
+from .utils.podresources import tpu_request
+
+log = get_logger(__name__)
+
+# Severity vocabulary. "warning" = a plane is stale/diverged but the
+# system is self-healing or degraded-safe; "critical" = capacity is
+# leaked or a crash would lose protection (chips held by a pod nothing
+# knows, a hold the journal would not rehydrate).
+WARNING = "warning"
+CRITICAL = "critical"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One observed divergence between two (or more) state planes."""
+
+    invariant: str
+    severity: str
+    message: str
+    pod: str = ""
+    gang: str = ""
+    node: str = ""
+    chip: str = ""
+    # Flat, JSON-ready detail payload (chip lists, expected-vs-got).
+    details: Tuple[Tuple[str, str], ...] = ()
+
+    @staticmethod
+    def make(invariant, severity, message, pod="", gang="", node="",
+             chip="", **details) -> "Finding":
+        return Finding(
+            invariant=invariant, severity=severity, message=message,
+            pod=pod, gang=gang, node=node, chip=chip,
+            details=tuple(sorted(
+                (k, str(v)) for k, v in details.items()
+            )),
+        )
+
+    def key(self) -> tuple:
+        """Identity for detected/cleared transition tracking — the
+        subject plus severity, not the message (a drifting detail
+        string must not re-fire the flight event every sweep, but a
+        warning→critical ESCALATION on the same subject is a new
+        detection — it must flight-record and, being critical, dump
+        the ring)."""
+        return (
+            self.invariant, self.severity,
+            self.pod, self.gang, self.node, self.chip,
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "invariant": self.invariant,
+            "severity": self.severity,
+            "message": self.message,
+            "pod": self.pod,
+            "gang": self.gang,
+            "node": self.node,
+            "chip": self.chip,
+            "details": dict(self.details),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class Invariant:
+    """One declarative cross-plane check: ``check()`` returns the
+    current findings (empty = the planes agree). ``planes`` names the
+    state surfaces it joins — documentation AND the /debug/audit
+    registry table tpu-doctor renders."""
+
+    name: str
+    planes: Tuple[str, ...]
+    description: str
+    check: Callable[[], List[Finding]]
+
+
+class AuditEngine:
+    """Runs an invariant set on a cadence and owns the reporting.
+
+    One engine per process (installed via :func:`install_engine`, the
+    telemetry-sampler global idiom). Node side runs it on its own
+    thread (``start``/``stop``); the extender calls :meth:`maybe_sweep`
+    from the gang-admission loop so sweeps never race the journal's
+    writer thread. ``sweep_once`` is the direct entry tests and
+    tpu-doctor's self-test drive."""
+
+    def __init__(
+        self,
+        service: str,
+        invariants: List[Invariant],
+        interval_s: float = 60.0,
+        prepare: Optional[Callable[[], None]] = None,
+        config: Optional[dict] = None,
+    ):
+        self.service = service
+        self.invariants = list(invariants)
+        self.interval_s = interval_s
+        # Optional per-sweep fact builder (one pod list shared by every
+        # invariant of the sweep instead of one list per invariant); a
+        # raising prepare fails the sweep as outcome="error".
+        self._prepare = prepare
+        # Sanitized config surfaced at /debug/audit and in the bundle:
+        # knob values only, never credentials/paths-with-secrets.
+        self.config = dict(config or {})
+        ext = service == "extender"
+        self._findings_fam = (
+            metrics.EXT_AUDIT_FINDINGS if ext else metrics.AUDIT_FINDINGS
+        )
+        self._sweeps_fam = (
+            metrics.EXT_AUDIT_SWEEPS if ext else metrics.AUDIT_SWEEPS
+        )
+        self._seconds_fam = (
+            metrics.EXT_AUDIT_SWEEP_SECONDS
+            if ext else metrics.AUDIT_SWEEP_SECONDS
+        )
+        self._last_clean_fam = (
+            metrics.EXT_AUDIT_LAST_CLEAN
+            if ext else metrics.AUDIT_LAST_CLEAN
+        )
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_sweep_mono = float("-inf")
+        self._sweeps = 0
+        self._last_ts = 0.0
+        self._last_duration_ms = 0.0
+        self._findings: List[Finding] = []
+        self._errors: Dict[str, str] = {}
+        # finding key → Finding from the previous sweep (transition
+        # detection), and the (invariant, severity) label pairs the
+        # gauge currently carries (the prune list).
+        self._prev: Dict[tuple, Finding] = {}
+        self._gauge_pairs: Set[Tuple[str, str]] = set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Node-side cadence thread (the TelemetrySampler shape):
+        immediate first sweep, then one per interval."""
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-audit", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 2)
+            self._thread = None
+
+    def _run(self) -> None:
+        log.info(
+            "consistency auditor started: %d invariants, %.1fs interval",
+            len(self.invariants), self.interval_s,
+        )
+        while not self._stop.is_set():
+            try:
+                self.sweep_once()
+            except Exception:  # noqa: BLE001 — the auditor must survive
+                log.exception("audit sweep failed")
+                self._sweeps_fam.inc(outcome="error")
+            if self._stop.wait(self.interval_s):
+                return
+
+    def maybe_sweep(self) -> bool:
+        """Cadence check for callers embedding the engine in their own
+        loop (the gang-admission tick). True when a sweep ran."""
+        if self.interval_s <= 0:
+            return False
+        now = time.monotonic()
+        if now - self._last_sweep_mono < self.interval_s:
+            return False
+        try:
+            self.sweep_once()
+        except Exception:  # noqa: BLE001 — never break the host loop
+            log.exception("audit sweep failed")
+            self._sweeps_fam.inc(outcome="error")
+        return True
+
+    # -- one sweep ---------------------------------------------------------
+
+    def sweep_once(self) -> List[Finding]:
+        """Run every invariant once; returns the findings (also kept
+        for /debug/audit). A raising invariant costs its own planes'
+        coverage this pass (recorded in ``errors`` + the error
+        outcome), never the sweep."""
+        self._last_sweep_mono = time.monotonic()
+        t0 = time.perf_counter()
+        findings: List[Finding] = []
+        errors: Dict[str, str] = {}
+        if self._prepare is not None:
+            try:
+                self._prepare()
+            except Exception as e:  # noqa: BLE001 — degraded sweep
+                log.warning("audit sweep prepare failed: %s", e)
+                errors["_prepare"] = f"{type(e).__name__}: {e}"
+        if "_prepare" not in errors:
+            for inv in self.invariants:
+                try:
+                    findings.extend(inv.check())
+                except Exception as e:  # noqa: BLE001 — isolate
+                    log.exception("audit invariant %s raised", inv.name)
+                    errors[inv.name] = f"{type(e).__name__}: {e}"
+        dt = time.perf_counter() - t0
+        self._publish(findings, errors, dt)
+        return findings
+
+    def _publish(
+        self,
+        findings: List[Finding],
+        errors: Dict[str, str],
+        duration_s: float,
+    ) -> None:
+        # Gauge: count per (invariant, severity); emptied pairs drop
+        # their series (absent = clean, the telemetry pruning contract).
+        counts: Dict[Tuple[str, str], int] = {}
+        for f in findings:
+            pair = (f.invariant, f.severity)
+            counts[pair] = counts.get(pair, 0) + 1
+        with self._lock:
+            for inv, sev in self._gauge_pairs - set(counts):
+                self._findings_fam.remove(invariant=inv, severity=sev)
+            for (inv, sev), n in counts.items():
+                self._findings_fam.set(n, invariant=inv, severity=sev)
+            self._gauge_pairs = set(counts)
+            prev = self._prev
+            current = {f.key(): f for f in findings}
+            self._prev = current
+            self._sweeps += 1
+            self._last_ts = time.time()
+            self._last_duration_ms = round(duration_s * 1000.0, 3)
+            self._findings = list(findings)
+            self._errors = dict(errors)
+        outcome = (
+            "error" if errors else ("findings" if findings else "clean")
+        )
+        self._sweeps_fam.inc(outcome=outcome)
+        self._seconds_fam.observe(duration_s)
+        if outcome == "clean":
+            self._last_clean_fam.set(round(time.time(), 3))
+        # Detection/clear transitions → flight recorder + ledger, once
+        # per transition (a persisting finding is silent until it
+        # clears — the chip_thermal crossing-dedup idiom).
+        new_critical = False
+        for key, f in current.items():
+            if key in prev:
+                continue
+            if f.severity == CRITICAL:
+                new_critical = True
+            RECORDER.record(
+                "audit_divergence",
+                f.message,
+                state="detected",
+                invariant=f.invariant,
+                severity=f.severity,
+                pod=f.pod, gang=f.gang, node=f.node, chip=f.chip,
+            )
+            LEDGER.record(
+                "audit_divergence", f.invariant, f.message,
+                pod=f.pod, gang=f.gang, node=f.node,
+                severity=f.severity, chip=f.chip,
+                **dict(f.details),
+            )
+            log.warning(
+                "audit divergence (%s, %s): %s",
+                f.invariant, f.severity, f.message,
+            )
+        for key, f in prev.items():
+            if key not in current:
+                RECORDER.record(
+                    "audit_divergence",
+                    f"cleared: {f.message}",
+                    state="cleared",
+                    invariant=f.invariant,
+                    severity=f.severity,
+                    pod=f.pod, gang=f.gang, node=f.node, chip=f.chip,
+                )
+        if new_critical:
+            # A NEW critical finding is a post-mortem moment: capture
+            # the event tail NOW, while the divergence's lead-up is
+            # still in the ring (the circuit-break dump idiom).
+            RECORDER.dump_on("audit_critical")
+
+    # -- snapshot ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "service": self.service,
+                "interval_s": self.interval_s,
+                "sweeps": self._sweeps,
+                "last_sweep_ts": self._last_ts,
+                "last_duration_ms": self._last_duration_ms,
+                "findings": [f.to_dict() for f in self._findings],
+                "errors": dict(self._errors),
+                "invariants": [
+                    {
+                        "name": inv.name,
+                        "planes": list(inv.planes),
+                        "description": inv.description,
+                    }
+                    for inv in self.invariants
+                ],
+                "config": dict(self.config),
+            }
+
+
+# Process-global engine for /debug/audit (one daemon per process, the
+# telemetry.SAMPLER idiom).
+ENGINE: Optional[AuditEngine] = None
+
+
+def install_engine(engine: Optional[AuditEngine]) -> None:
+    global ENGINE
+    ENGINE = engine
+
+
+def debug_snapshot() -> dict:
+    """The /debug/audit payload (metrics.debug_payload): engine state
+    + build identity — also the shape tpu-doctor check renders and the
+    support bundle archives."""
+    out: dict = {"enabled": ENGINE is not None}
+    out["build"] = metrics.build_info()
+    engine = ENGINE
+    if engine is not None:
+        out.update(engine.snapshot())
+        out["build"]["component"] = engine.service
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Node-side invariants (plugin daemon)
+# ---------------------------------------------------------------------------
+
+
+class NodeAudit:
+    """The plugin daemon's invariant set over one node's planes:
+    kubelet record (PodResources/checkpoint), pod annotations,
+    attribution map, placement state, exported gauges. Facts shared by
+    several invariants (the kubelet assignment map, the apiserver pod
+    list) are gathered ONCE per sweep in :meth:`prepare`."""
+
+    def __init__(
+        self,
+        plugin,  # TpuDevicePlugin
+        controller=None,  # Controller (None: no kube integration)
+        client=None,  # KubeClient (None: no apiserver)
+        node_name: str = "",
+        checkpoint_path: str = constants.KUBELET_CHECKPOINT,
+        podres=None,  # PodResourcesClient (None: checkpoint only)
+        resource_name: str = constants.RESOURCE_NAME,
+    ):
+        self.plugin = plugin
+        self.controller = controller
+        self.client = client
+        self.node_name = node_name
+        self.checkpoint_path = checkpoint_path
+        self.podres = podres
+        self.resource_name = resource_name
+        # Per-sweep facts (prepare()).
+        self._podres_by_pod: Optional[Dict[Tuple[str, str], Set[str]]] = None
+        self._ckpt_by_uid: Optional[Dict[str, Set[str]]] = None
+        self._pods: Optional[List[dict]] = None
+        self._pods_error: Optional[Exception] = None
+
+    def engine(self, interval_s: float = 60.0) -> AuditEngine:
+        return AuditEngine(
+            service="plugin",
+            invariants=self.invariants(),
+            interval_s=interval_s,
+            prepare=self.prepare,
+            config={
+                "audit_interval_s": interval_s,
+                "node_name": self.node_name,
+                "has_apiserver": self.client is not None,
+                "has_controller": self.controller is not None,
+                "resource_name": self.resource_name,
+            },
+        )
+
+    def invariants(self) -> List[Invariant]:
+        return [
+            Invariant(
+                "checkpoint_vs_podresources",
+                ("checkpoint", "podresources"),
+                "the kubelet's two records of the same assignments — "
+                "the internal checkpoint file and the PodResources API "
+                "— must name the same chip set",
+                self.check_checkpoint_vs_podresources,
+            ),
+            Invariant(
+                "annotation_vs_kubelet",
+                ("annotations", "podresources", "checkpoint"),
+                "a Running pod's google.com/tpu-devices annotation "
+                "must match the chips the kubelet actually assigned it",
+                self.check_annotation_vs_kubelet,
+            ),
+            Invariant(
+                "attribution_vs_kubelet",
+                ("attribution", "podresources", "checkpoint"),
+                "every chip in the controller's telemetry-attribution "
+                "map must be kubelet-assigned to the pod it names",
+                self.check_attribution_vs_kubelet,
+            ),
+            Invariant(
+                "gauge_vs_state",
+                ("metrics", "placement"),
+                "the tpu_plugin_chips gauges must equal the placement "
+                "state's discovery truth (total/available always "
+                "render; allocated/unhealthy drop when empty)",
+                self.check_gauge_vs_state,
+            ),
+            Invariant(
+                "orphaned_chip",
+                ("podresources", "checkpoint", "apiserver"),
+                "a chip the kubelet holds for a pod the apiserver no "
+                "longer knows is leaked capacity",
+                self.check_orphaned_chips,
+            ),
+        ]
+
+    # -- shared facts ------------------------------------------------------
+
+    def _real(self, kubelet_ids) -> Set[str]:
+        """Kubelet device ids → real chip ids, translated through the
+        plugin's permanent substitution record exactly like delete-time
+        reconciliation (controller._kubelet_assigned_chips)."""
+        out: Set[str] = set()
+        for kid in kubelet_ids:
+            rid = self.plugin.substitutions.get(kid, kid)
+            if rid in self.plugin.mesh.by_id:
+                out.add(rid)
+        return out
+
+    def prepare(self) -> None:
+        self._podres_by_pod = None
+        self._ckpt_by_uid = None
+        self._pods = None
+        self._pods_error = None
+        if self.podres is not None and self.podres.available():
+            try:
+                raw = self.podres.device_ids_by_pod(self.resource_name)
+                self._podres_by_pod = {
+                    key: self._real(ids) for key, ids in raw.items()
+                }
+            except Exception as e:  # noqa: BLE001 — a wedged kubelet
+                # costs this sweep's kubelet-joined invariants, audited
+                # again next interval
+                log.warning("audit: podresources list failed: %s", e)
+        entries = ckpt.read_checkpoint(self.checkpoint_path)
+        if entries:
+            self._ckpt_by_uid = {
+                uid: self._real(ids)
+                for uid, ids in ckpt.device_ids_by_pod(
+                    entries, self.resource_name
+                ).items()
+            }
+        if self.client is not None:
+            try:
+                self._pods = self.client.list_pods(
+                    node_name=self.node_name
+                ).get("items", [])
+            except Exception as e:  # noqa: BLE001 — apiserver-joined
+                # invariants raise per-invariant below (visible as an
+                # audit error, not silence)
+                self._pods_error = e
+
+    def _kubelet_truth(self) -> Optional[Dict[tuple, Set[str]]]:
+        """Pod key → real chip set, from the best available kubelet
+        source. Keys are ("name", ns, name) for PodResources entries,
+        ("uid", uid) for checkpoint-only kubelets. None = no source
+        answered (those invariants skip, not fire)."""
+        if self._podres_by_pod is not None:
+            return {
+                ("name",) + key: ids
+                for key, ids in self._podres_by_pod.items()
+            }
+        if self._ckpt_by_uid is not None:
+            return {
+                ("uid", uid): ids
+                for uid, ids in self._ckpt_by_uid.items()
+            }
+        return None
+
+    def _require_pods(self) -> List[dict]:
+        if self._pods_error is not None:
+            raise RuntimeError(
+                f"apiserver pod list failed: {self._pods_error}"
+            )
+        if self._pods is None:
+            raise _SkipInvariant()
+        return self._pods
+
+    # -- invariants --------------------------------------------------------
+
+    def check_checkpoint_vs_podresources(self) -> List[Finding]:
+        """Both kubelet sources present → their total assigned chip
+        sets must agree (the checkpoint is the fallback source; if it
+        drifts from the API, a kubelet downgrade or a daemon restart
+        would rebuild allocation state from the wrong record)."""
+        if self._podres_by_pod is None or self._ckpt_by_uid is None:
+            return []
+        pr = set().union(*self._podres_by_pod.values(), set())
+        ck = set().union(*self._ckpt_by_uid.values(), set())
+        out = []
+        only_pr = sorted(pr - ck)
+        only_ck = sorted(ck - pr)
+        if only_pr:
+            out.append(Finding.make(
+                "checkpoint_vs_podresources", WARNING,
+                f"chips {only_pr} assigned per PodResources but absent "
+                f"from the kubelet checkpoint",
+                node=self.node_name,
+                only_in_podresources=",".join(only_pr),
+            ))
+        if only_ck:
+            out.append(Finding.make(
+                "checkpoint_vs_podresources", WARNING,
+                f"chips {only_ck} in the kubelet checkpoint but absent "
+                f"from PodResources",
+                node=self.node_name,
+                only_in_checkpoint=",".join(only_ck),
+            ))
+        return out
+
+    def check_annotation_vs_kubelet(self) -> List[Finding]:
+        truth = self._kubelet_truth()
+        if truth is None or self.client is None:
+            return []
+        pods = self._require_pods()
+        by_name = {
+            k[1:]: ids for k, ids in truth.items() if k[0] == "name"
+        }
+        by_uid = {
+            k[1]: ids for k, ids in truth.items() if k[0] == "uid"
+        }
+        out = []
+        for pod in pods:
+            meta = pod.get("metadata") or {}
+            ann = (meta.get("annotations") or {}).get(
+                constants.POD_DEVICES_ANNOTATION
+            )
+            if not ann:
+                continue
+            if (pod.get("status") or {}).get("phase") not in (
+                "Running", "Pending",
+            ):
+                # A finished pod's annotation legitimately outlives its
+                # freed assignment.
+                continue
+            ns = meta.get("namespace", "default")
+            name = meta.get("name", "")
+            # The raw annotation set, unfiltered: an id the current
+            # mesh doesn't know (a prior generation's leftover) IS the
+            # stale-annotation drift this invariant exists to catch —
+            # filtering it out would compare the repaired version of
+            # the annotation instead of the annotation.
+            ann_ids = {i for i in ann.split(",") if i}
+            kub = by_name.get((ns, name))
+            if kub is None:
+                kub = by_uid.get(meta.get("uid", ""))
+            if kub is None:
+                # The kubelet has no entry at all: for a Running pod
+                # with an annotation that is drift (a stale annotation
+                # from a prior incarnation).
+                if (pod.get("status") or {}).get("phase") == "Running":
+                    out.append(Finding.make(
+                        "annotation_vs_kubelet", WARNING,
+                        f"pod {ns}/{name} annotation names chips "
+                        f"{sorted(ann_ids)} but the kubelet reports "
+                        f"no assignment",
+                        pod=f"{ns}/{name}", node=self.node_name,
+                        annotation=",".join(sorted(ann_ids)),
+                    ))
+                continue
+            if ann_ids != kub:
+                out.append(Finding.make(
+                    "annotation_vs_kubelet", WARNING,
+                    f"pod {ns}/{name} annotation says "
+                    f"{sorted(ann_ids)}, kubelet says {sorted(kub)}",
+                    pod=f"{ns}/{name}", node=self.node_name,
+                    annotation=",".join(sorted(ann_ids)),
+                    kubelet=",".join(sorted(kub)),
+                ))
+        return out
+
+    def check_attribution_vs_kubelet(self) -> List[Finding]:
+        if self.controller is None:
+            return []
+        truth = self._kubelet_truth()
+        if truth is None:
+            return []
+        attribution = self.controller.chip_attribution()
+        if not attribution:
+            return []
+        chip_holder: Dict[str, tuple] = {}
+        assigned: Set[str] = set()
+        for key, ids in truth.items():
+            assigned |= ids
+            for cid in ids:
+                chip_holder[cid] = key
+        out = []
+        for cid, attr in sorted(attribution.items()):
+            podkey = f"{attr.get('namespace', '')}/{attr.get('pod', '')}"
+            if cid not in assigned:
+                out.append(Finding.make(
+                    "attribution_vs_kubelet", WARNING,
+                    f"chip {cid} attributed to pod {podkey} but the "
+                    f"kubelet reports it unassigned (telemetry would "
+                    f"label a free chip with a dead pod)",
+                    pod=podkey, chip=cid, node=self.node_name,
+                ))
+                continue
+            holder = chip_holder.get(cid)
+            if holder and holder[0] == "name":
+                want = (attr.get("namespace", ""), attr.get("pod", ""))
+                if holder[1:] != want:
+                    out.append(Finding.make(
+                        "attribution_vs_kubelet", WARNING,
+                        f"chip {cid} attributed to {podkey} but "
+                        f"kubelet-assigned to "
+                        f"{holder[1]}/{holder[2]}",
+                        pod=podkey, chip=cid, node=self.node_name,
+                        kubelet_pod=f"{holder[1]}/{holder[2]}",
+                    ))
+        return out
+
+    def check_gauge_vs_state(self) -> List[Finding]:
+        """State truth and the exported gauge are read non-atomically
+        (the gRPC Allocate path mutates both between our two reads),
+        so any diff is recomputed once before it becomes a finding —
+        the same race mitigation as reservation_vs_journal; real drift
+        is steady, a mid-sweep allocation is not."""
+        out = self._gauge_diff()
+        return self._gauge_diff() if out else out
+
+    def _gauge_diff(self) -> List[Finding]:
+        state = self.plugin.state
+        truth = {
+            "total": len(self.plugin.mesh.mesh_chips),
+            "available": len(state.available()),
+            "allocated": len(state.allocated),
+            "unhealthy": len(state.unhealthy),
+        }
+        exported = {
+            labels.get("state", ""): value
+            for labels, value in metrics.CHIPS.series()
+        }
+        out = []
+        for st, want in truth.items():
+            got = exported.get(st)
+            if st in ("allocated", "unhealthy") and want == 0:
+                # Emptied event-ish states must be ABSENT, not 0: a
+                # frozen series is exactly the drift class this audits.
+                if got is not None:
+                    out.append(Finding.make(
+                        "gauge_vs_state", WARNING,
+                        f"tpu_plugin_chips{{state={st!r}}} still "
+                        f"exports {got:g} but the placement state has "
+                        f"none (stale series)",
+                        node=self.node_name, state=st, exported=got,
+                    ))
+                continue
+            if got is None or int(got) != want:
+                out.append(Finding.make(
+                    "gauge_vs_state", WARNING,
+                    f"tpu_plugin_chips{{state={st!r}}} exports "
+                    f"{'nothing' if got is None else '%g' % got} but "
+                    f"the placement state says {want}",
+                    node=self.node_name, state=st,
+                    exported="absent" if got is None else got,
+                    expected=want,
+                ))
+        return out
+
+    def check_orphaned_chips(self) -> List[Finding]:
+        truth = self._kubelet_truth()
+        if truth is None or self.client is None:
+            return []
+        pods = self._require_pods()
+        live_names = set()
+        live_uids = set()
+        for pod in pods:
+            meta = pod.get("metadata") or {}
+            live_names.add(
+                (meta.get("namespace", "default"), meta.get("name", ""))
+            )
+            live_uids.add(meta.get("uid", ""))
+        out = []
+        for key, ids in sorted(truth.items()):
+            if not ids:
+                continue
+            if key[0] == "name":
+                gone = key[1:] not in live_names
+                podkey = f"{key[1]}/{key[2]}"
+            else:
+                gone = key[1] not in live_uids
+                podkey = key[1]
+            if gone:
+                out.append(Finding.make(
+                    "orphaned_chip", CRITICAL,
+                    f"chips {sorted(ids)} held in the kubelet record "
+                    f"by pod {podkey}, which the apiserver no longer "
+                    f"knows — leaked capacity until pruned",
+                    pod=podkey, node=self.node_name,
+                    chips=",".join(sorted(ids)),
+                ))
+        return out
+
+
+class _SkipInvariant(Exception):
+    """Internal: an invariant's preconditions are absent (no apiserver
+    configured) — it contributes nothing, silently."""
+
+
+def _skippable(fn: Callable[[], List[Finding]]):
+    def wrapped() -> List[Finding]:
+        try:
+            return fn()
+        except _SkipInvariant:
+            return []
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Extender-side invariants (gang admitter / scheduler extender)
+# ---------------------------------------------------------------------------
+
+
+class ExtenderAudit:
+    """The extender's invariant set: ReservationTable vs admission
+    journal vs cluster truth vs the topology index's capacity
+    aggregate. Built by the entrypoint with whatever halves are wired
+    (no journal → no replay check; no gang admission → cluster-truth
+    checks are skipped); the engine is driven from the gang-admission
+    loop (``GangAdmission.auditor``) so journal reads never race the
+    single writer thread, or on its own thread when only the index
+    invariant applies."""
+
+    # From-scratch placeable recomputation is the one non-O(1) check:
+    # bound it to a rotating sample per sweep so a 5,000-node cluster
+    # re-proves every entry within ~minutes without any sweep paying
+    # the full O(nodes × boxes) cost.
+    RECOUNT_SAMPLE = 32
+
+    def __init__(
+        self,
+        reservations=None,  # ReservationTable
+        journal=None,  # AdmissionJournal
+        gang=None,  # GangAdmission
+        index=None,  # TopologyIndex
+        resource_name: str = constants.RESOURCE_NAME,
+    ):
+        self.reservations = reservations
+        self.journal = journal
+        self.gang = gang
+        self.index = index
+        self.resource_name = resource_name
+        self._recount_pos = 0
+        # Per-sweep facts.
+        self._gangs: Optional[dict] = None
+        self._gangs_error: Optional[Exception] = None
+
+    def engine(self, interval_s: float = 60.0) -> AuditEngine:
+        return AuditEngine(
+            service="extender",
+            invariants=self.invariants(),
+            interval_s=interval_s,
+            prepare=self.prepare,
+            config={
+                "audit_interval_s": interval_s,
+                "has_journal": self.journal is not None,
+                "has_gang_admission": self.gang is not None,
+                "has_topology_index": self.index is not None,
+                "resource_name": self.resource_name,
+            },
+        )
+
+    def invariants(self) -> List[Invariant]:
+        out = []
+        if self.journal is not None and self.reservations is not None:
+            out.append(Invariant(
+                "reservation_vs_journal",
+                ("reservations", "journal"),
+                "a from-scratch journal replay must rebuild exactly "
+                "the live ReservationTable — a hold the journal would "
+                "not rehydrate dies with the process",
+                self.check_reservation_vs_journal,
+            ))
+        if self.gang is not None and self.reservations is not None:
+            out.append(Invariant(
+                "reservation_vs_cluster",
+                ("reservations", "apiserver", "topology-index"),
+                "every standing hold must belong to a live gang on "
+                "known hosts — a hold for a vanished gang or node "
+                "fences capacity nothing will ever use",
+                _skippable(self.check_reservation_vs_cluster),
+            ))
+            out.append(Invariant(
+                "gate_vs_hold",
+                ("gates", "reservations"),
+                "gate state and hold state must agree: released-but-"
+                "unscheduled TPU pods need a fence (or a lapse bar); "
+                "a fully-gated gang with a standing hold is a release "
+                "that failed wholesale",
+                _skippable(self.check_gate_vs_hold),
+            ))
+        if self.index is not None:
+            out.append(Invariant(
+                "placeable_recount",
+                ("metrics", "topology-index"),
+                "the incrementally-maintained placeable-nodes "
+                "aggregate (and gauge) must equal a from-scratch "
+                "recount over the index's entries (sampled per-entry, "
+                "full aggregate each sweep)",
+                self.check_placeable_recount,
+            ))
+        return out
+
+    # -- shared facts ------------------------------------------------------
+
+    def prepare(self) -> None:
+        self._gangs = None
+        self._gangs_error = None
+        if self.gang is None:
+            return
+        try:
+            # The full gang view (every gang-labeled pod, one server-
+            # side-filtered list) — the same discovery path tick() and
+            # explain() share, so the auditor can never disagree with
+            # the admitter about membership.
+            self._gangs = self.gang._collect_gangs()
+        except Exception as e:  # noqa: BLE001 — surfaces per-invariant
+            self._gangs_error = e
+
+    def _require_gangs(self) -> dict:
+        if self._gangs_error is not None:
+            raise RuntimeError(
+                f"gang pod list failed: {self._gangs_error}"
+            )
+        if self._gangs is None:
+            raise _SkipInvariant()
+        return self._gangs
+
+    # -- invariants --------------------------------------------------------
+
+    def check_reservation_vs_journal(self) -> List[Finding]:
+        """Live table vs read-only replay. A mutation can race the
+        comparison (a /filter-thread prune journals under the table
+        lock but the file write lands after our read), so any diff is
+        re-checked once after a fresh flush before it becomes a
+        finding."""
+        def diff() -> List[Finding]:
+            self.journal.flush()
+            replayed = self.journal.replay_readonly().holds
+            live = self.reservations.export_state()
+            out = []
+            for key in sorted(set(live) - set(replayed)):
+                out.append(Finding.make(
+                    "reservation_vs_journal", CRITICAL,
+                    f"gang {key[0]}/{key[1]} holds a live reservation "
+                    f"the journal would NOT rehydrate — a restart "
+                    f"unfences its chips",
+                    gang=f"{key[0]}/{key[1]}",
+                    hosts=dict(live[key]["hosts"]),
+                ))
+            for key in sorted(set(replayed) - set(live)):
+                out.append(Finding.make(
+                    "reservation_vs_journal", WARNING,
+                    f"journal replay resurrects a hold for gang "
+                    f"{key[0]}/{key[1]} the live table no longer has "
+                    f"(conservative over-fencing after a restart)",
+                    gang=f"{key[0]}/{key[1]}",
+                    hosts=dict(replayed[key].hosts),
+                ))
+            for key in sorted(set(live) & set(replayed)):
+                lh = {
+                    h: int(n)
+                    for h, n in live[key]["hosts"].items() if n > 0
+                }
+                rh = {
+                    h: int(n)
+                    for h, n in replayed[key].hosts.items() if n > 0
+                }
+                if lh != rh:
+                    out.append(Finding.make(
+                        "reservation_vs_journal", WARNING,
+                        f"gang {key[0]}/{key[1]} hold differs between "
+                        f"table ({lh}) and journal replay ({rh})",
+                        gang=f"{key[0]}/{key[1]}",
+                        table=lh, journal=rh,
+                    ))
+            return out
+
+        out = diff()
+        return diff() if out else out
+
+    def check_reservation_vs_cluster(self) -> List[Finding]:
+        active = self.reservations.active()
+        if not active:
+            return []
+        gangs = self._require_gangs()
+        known_hosts: Optional[Set[str]] = None
+        if self.index is not None and len(self.index):
+            known_hosts = {
+                e.hostname for e in self.index.entries() if e.hostname
+            }
+        out = []
+        for key, res in sorted(active.items()):
+            if key not in gangs:
+                out.append(Finding.make(
+                    "reservation_vs_cluster", WARNING,
+                    f"reservation held for gang {key[0]}/{key[1]} "
+                    f"whose pods no longer exist (leaked hold; upkeep "
+                    f"should have dropped it)",
+                    gang=f"{key[0]}/{key[1]}",
+                    hosts=dict(res.hosts),
+                ))
+                continue
+            if known_hosts is None:
+                continue
+            for host in sorted(res.hosts):
+                if host not in known_hosts:
+                    out.append(Finding.make(
+                        "reservation_vs_cluster", WARNING,
+                        f"gang {key[0]}/{key[1]} reserves "
+                        f"{res.hosts[host]} chip(s) on {host}, which "
+                        f"no indexed node publishes (vanished node)",
+                        gang=f"{key[0]}/{key[1]}", node=host,
+                        chips=res.hosts[host],
+                    ))
+        return out
+
+    def check_gate_vs_hold(self) -> List[Finding]:
+        gangs = self._require_gangs()
+        active = self.reservations.active()
+        # The admitter's standing lapse bars PLUS the table's undrained
+        # lapse set: a hold can age out inside this very active() call
+        # (any prune path), reaching _lapsed_gangs only at the next
+        # tick's drain — that window must not read as an unprotected
+        # gang. peek_lapsed() observes without consuming the signal.
+        lapsed = (
+            set(getattr(self.gang, "_lapsed_gangs", set()))
+            | self.reservations.peek_lapsed()
+        )
+        out = []
+        for key, gv in sorted(gangs.items()):
+            gated = gv.gated
+            released_unscheduled = [
+                p for p in gv.ungated_live
+                if not (p.get("spec") or {}).get("nodeName")
+                and tpu_request(p, self.resource_name) > 0
+            ]
+            if (
+                released_unscheduled
+                and not gated
+                and key not in active
+                and key not in lapsed
+            ):
+                names = sorted(
+                    (p.get("metadata") or {}).get("name", "")
+                    for p in released_unscheduled
+                )
+                out.append(Finding.make(
+                    "gate_vs_hold", CRITICAL,
+                    f"gang {key[0]}/{key[1]}: {len(names)} released-"
+                    f"but-unscheduled TPU pod(s) with no reservation "
+                    f"and no lapse bar — the release→steal window is "
+                    f"open",
+                    gang=f"{key[0]}/{key[1]}",
+                    pods=",".join(names),
+                ))
+            if gated and not gv.ungated_live and key in active:
+                out.append(Finding.make(
+                    "gate_vs_hold", WARNING,
+                    f"gang {key[0]}/{key[1]} holds a reservation but "
+                    f"every member is still gated — a release pass "
+                    f"failed wholesale (release_retry finishes it "
+                    f"next tick; persisting = gate patches failing)",
+                    gang=f"{key[0]}/{key[1]}",
+                    gated=len(gated),
+                ))
+        return out
+
+    def check_placeable_recount(self) -> List[Finding]:
+        index = self.index
+        if not index.track_placeable:
+            return []
+        # The aggregate comparison reads entries, counts, and the
+        # gauge at three separate instants while the watch/relist
+        # thread can rebuild entries in between — any diff is
+        # recomputed once before it becomes a finding (the same
+        # non-atomic-read mitigation as gauge_vs_state); a real index
+        # bug is steady, a mid-sweep rebuild is not.
+        out = self._placeable_aggregate_diff()
+        if out:
+            out = self._placeable_aggregate_diff()
+        entries = index.entries()
+        # Sampled from-scratch per-entry verification (rotating window
+        # — every entry re-proved within n/sample sweeps).
+        sample = entries[
+            self._recount_pos:self._recount_pos + self.RECOUNT_SAMPLE
+        ]
+        if len(sample) < self.RECOUNT_SAMPLE:
+            sample += entries[:self.RECOUNT_SAMPLE - len(sample)]
+        self._recount_pos = (
+            (self._recount_pos + self.RECOUNT_SAMPLE) % max(1, len(entries))
+        )
+        seen = set()
+        for e in sample:
+            if e.name in seen or e.topo is None:
+                continue
+            seen.add(e.name)
+            stats = fragmentation_stats(e.topo.to_mesh(), e.topo.available)
+            fresh = tuple(
+                n for n, ok in sorted(stats["placeable"].items()) if ok
+            )
+            if fresh != e.placeable:
+                out.append(Finding.make(
+                    "placeable_recount", WARNING,
+                    f"node {e.name}: index entry says placeable sizes "
+                    f"{list(e.placeable)}, from-scratch recompute says "
+                    f"{list(fresh)}",
+                    node=e.name,
+                    entry=list(e.placeable), recompute=list(fresh),
+                ))
+        return out
+
+    def _placeable_aggregate_diff(self) -> List[Finding]:
+        """One pass of the aggregate comparison: cached per-entry
+        tuples vs the incremental counts vs the exported gauge."""
+        index = self.index
+        out: List[Finding] = []
+        want: Dict[int, int] = {}
+        for e in index.entries():
+            for n in e.placeable:
+                want[n] = want.get(n, 0) + 1
+        counts = {
+            int(k): v
+            for k, v in index.placeable_snapshot()[
+                "placeable_nodes"
+            ].items()
+        }
+        if counts != want:
+            out.append(Finding.make(
+                "placeable_recount", WARNING,
+                f"incremental placeable-nodes counts {counts} disagree "
+                f"with the per-entry recount {want}",
+                incremental=counts, recount=want,
+            ))
+        gauge = {
+            int(labels["size"]): int(value)
+            for labels, value in metrics.EXT_PLACEABLE_NODES.series()
+            if labels.get("size", "").isdigit()
+        }
+        if gauge != want:
+            out.append(Finding.make(
+                "placeable_recount", WARNING,
+                f"tpu_extender_placeable_nodes exports {gauge} but the "
+                f"per-entry recount says {want}",
+                gauge=gauge, recount=want,
+            ))
+        return out

@@ -114,6 +114,18 @@ def main() -> int:
         "see docs/operations.md",
     )
     p.add_argument(
+        "--audit-interval-s", type=float,
+        default=float(os.environ.get("TPU_AUDIT_INTERVAL_S", "0") or 0),
+        help="run the cross-plane consistency auditor (audit.py) "
+        "every N seconds: ReservationTable vs admission-journal "
+        "replay vs cluster truth vs the topology index's placeable "
+        "aggregate, findings at /debug/audit and tpu_audit_* metrics "
+        "(also TPU_AUDIT_INTERVAL_S). Sweeps ride the gang-admission "
+        "loop (the journal's writer thread); without --gang-admission "
+        "only the index invariant runs, on its own thread. 0 disables "
+        "the auditor entirely",
+    )
+    p.add_argument(
         "--gang-pending-event-s", type=float, default=300.0,
         help="post a kube Event (kubectl describe pod) on gangs "
         "capacity-waiting longer than this many seconds (budgeted + "
@@ -143,6 +155,9 @@ def main() -> int:
 
     if decisions.should_enable(a.decisions, a.trace):
         decisions.LEDGER.enable(service="extender")
+    from ..utils import metrics as tpumetrics
+
+    tpumetrics.set_build_info("extender")
     from .reservations import ReservationTable
     from .server import NodeAnnotationCache, TopologyExtender
 
@@ -289,12 +304,48 @@ def main() -> int:
         # rebuild the unjournaled daemon always did).
         gang.recover()
         gang.start()
+    auditor = None
+    if a.audit_interval_s > 0:
+        from .. import audit
+
+        ext_audit = audit.ExtenderAudit(
+            reservations=reservations,
+            journal=gang.journal if gang is not None else None,
+            gang=gang,
+            index=node_cache.index if node_cache is not None else None,
+        )
+        auditor = ext_audit.engine(interval_s=a.audit_interval_s)
+        if not auditor.invariants:
+            # Neither --gang-admission nor --node-cache: there is no
+            # plane to join. A zero-invariant engine would advance the
+            # clean-sweep clock and render a passing `tpu-doctor
+            # check` while auditing NOTHING — refuse loudly instead.
+            logging.getLogger(__name__).warning(
+                "--audit-interval-s set but no auditable plane is "
+                "wired (need --gang-admission and/or --node-cache); "
+                "the consistency auditor will not run"
+            )
+            auditor = None
+        else:
+            audit.install_engine(auditor)
+            if gang is not None:
+                # Sweeps ride the admission loop: this is the
+                # journal's single writer thread, so the replay-
+                # equivalence check never races an append.
+                gang.auditor = auditor
+            else:
+                # No admitter: only the index invariant is wired —
+                # safe on its own thread (entries are immutable,
+                # gauges atomic).
+                auditor.start()
     ready.set()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
     # Post-mortem capture before teardown starts losing state.
     RECORDER.dump_on("sigterm")
+    if auditor is not None and gang is None:
+        auditor.stop()  # loop-driven engines stop with the gang loop
     if gang is not None:
         gang.stop()
     if leader is not None:

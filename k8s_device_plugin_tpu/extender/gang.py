@@ -466,6 +466,13 @@ class GangAdmission:
         self._dep_gangs: Dict[tuple, Set[Tuple[str, str]]] = {}
         self._last_full_sweep = float("-inf")  # first loop tick is full
         self._watch_thread: Optional[threading.Thread] = None
+        # Optional consistency-audit engine (audit.py AuditEngine),
+        # wired by the entrypoint: driven from _loop AFTER each tick —
+        # this thread is the journal's single writer, so the replay-
+        # equivalence invariant never races an append, and the tick's
+        # end-of-pass flush has already pushed buffered records before
+        # the auditor reads the file.
+        self.auditor = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -670,6 +677,13 @@ class GangAdmission:
                 if self._stop.is_set():
                     return
                 log.warning("gang admission tick failed: %s", e)
+            auditor = self.auditor
+            if auditor is not None:
+                # Cadenced internally (--audit-interval-s); runs even
+                # after a failed tick — drift detection matters MOST
+                # when the reconcile loop is struggling. maybe_sweep
+                # never raises.
+                auditor.maybe_sweep()
             self._stop.wait(self.resync_interval_s)
 
     # -- event plane (dirty marking) ---------------------------------------

@@ -323,6 +323,12 @@ class TopologyIndex:
         with self._lock:
             return set(self._slice_members.get(key, ()))
 
+    def entries(self) -> List[IndexEntry]:
+        """Snapshot of every installed entry (immutable values, so the
+        list is safe to walk lock-free) — the consistency auditor's
+        from-scratch recount input (audit.py)."""
+        return list(self._entries.values())
+
     def topologies(self) -> List[NodeTopology]:
         """Per-call CLONES of every indexed topology (private
         ``available`` lists) — the gang admitter's capacity view,

@@ -195,6 +195,27 @@ class AdmissionJournal:
         trusts a broken record, never crashes."""
         t0 = time.perf_counter()
         loaded = self.store.load()
+        state = self._fold(loaded)
+        dt = time.perf_counter() - t0
+        metrics.STATE_REPLAY_SECONDS.set(round(dt, 6))
+        metrics.STATE_REHYDRATIONS.inc(outcome=loaded.status)
+        return state
+
+    def replay_readonly(self) -> RehydratedState:
+        """Replay from the files WITHOUT owning-writer side effects: no
+        tail healing, no seq bookkeeping, and none of the rehydration
+        metrics (a routine audit sweep must not masquerade as a crash
+        recovery in ``tpu_extender_state_rehydrations_total``). The
+        consistency auditor (audit.py) uses this to prove the live
+        ReservationTable and a from-scratch replay agree — flush() the
+        buffered tick records first, or the file legitimately lags the
+        table."""
+        loaded = statestore.read_state(
+            self.store.journal_path, self.store.snapshot_path
+        )
+        return self._fold(loaded)
+
+    def _fold(self, loaded) -> RehydratedState:
         holds: Dict[GangKey, Hold] = {}
         lapsed: Set[GangKey] = set()
         waiting: Dict[GangKey, float] = {}
@@ -220,9 +241,6 @@ class AdmissionJournal:
         for rec in loaded.records:
             self._apply(rec, holds, lapsed, waiting)
             applied += 1
-        dt = time.perf_counter() - t0
-        metrics.STATE_REPLAY_SECONDS.set(round(dt, 6))
-        metrics.STATE_REHYDRATIONS.inc(outcome=loaded.status)
         return RehydratedState(
             holds=holds,
             lapsed=lapsed,

@@ -238,6 +238,17 @@ class ReservationTable:
             self._lapsed_keys = set()
             return out
 
+    def peek_lapsed(self) -> set:
+        """The undrained lapse set, WITHOUT consuming it — the
+        consistency auditor's view (audit.py gate_vs_hold): a hold
+        that lapsed inside a routine prune after the admitter's last
+        drain is already barred from re-fencing, and the auditor must
+        not read that window as an unprotected gang (a false CRITICAL
+        would dump the flight ring and page someone). Draining here
+        instead would steal the admitter's own signal."""
+        with self._lock:
+            return set(self._lapsed_keys)
+
     def clear(self) -> None:
         """Drop every reservation (test isolation for DEFAULT_TABLE)."""
         with self._lock:

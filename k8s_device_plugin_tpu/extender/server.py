@@ -1207,12 +1207,17 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
-                elif self.path.startswith("/debug/"):
+                elif self.path == "/debug" or self.path.startswith(
+                    "/debug/"
+                ):
                     # Observability surface (utils/tracing.py +
-                    # utils/flightrecorder.py): /debug/traces serves
-                    # the span collector's OTLP-JSON export,
-                    # /debug/events the flight-recorder ring — same
-                    # payloads the daemon's metrics server exposes.
+                    # utils/flightrecorder.py + audit.py): /debug is
+                    # the index of every registered surface,
+                    # /debug/traces serves the span collector's
+                    # OTLP-JSON export, /debug/events the flight-
+                    # recorder ring, /debug/audit the consistency
+                    # auditor's findings — same payloads the daemon's
+                    # metrics server exposes.
                     payload = metrics.debug_payload(self.path)
                     if payload is None:
                         self._send({"error": "not found"}, 404)

@@ -122,6 +122,13 @@ class DaemonConfig:
     # the disabled path is a no-op like --trace (measured by bench.py
     # detail.telemetry_overhead).
     telemetry_interval_s: float = 0.0
+    # Consistency auditor (audit.py): cross-plane drift sweeps
+    # (checkpoint vs PodResources vs annotations vs attribution vs
+    # gauges) on their own thread, off the gRPC hot path. 0 (the
+    # default) means no auditor at all — same disabled contract as
+    # the telemetry sampler (measured by bench.py
+    # detail.audit_overhead).
+    audit_interval_s: float = 0.0
 
 
 class Daemon:
@@ -154,6 +161,12 @@ class Daemon:
         self.controller = None  # set by kube wiring when enabled
         self.dra = None  # set by _start_dra when enabled
         self.telemetry_sampler = None  # set by _start_telemetry when on
+        self.auditor = None  # set by _start_audit when on
+        # Build identity first: the info-gauge must be on the very
+        # first scrape (and in any support bundle), config regardless.
+        from ..utils.metrics import set_build_info
+
+        set_build_info("plugin")
         self._kube = None
         self._kube_client = None  # pre-serve client (build_and_serve)
         # GKE-label-derived chip type (per generation; never written into
@@ -358,6 +371,7 @@ class Daemon:
         if self.cfg.enable_dra:
             self._start_dra()
         self._start_telemetry(mesh, chips)
+        self._start_audit()
 
     def _start_telemetry(self, mesh: IciMesh, chips: List[TpuChip]) -> None:
         """Chip-telemetry sampler (telemetry.py): built LAST so the
@@ -381,6 +395,37 @@ class Daemon:
         )
         telemetry.install_sampler(self.telemetry_sampler)
         self.telemetry_sampler.start()
+
+    def _start_audit(self) -> None:
+        """Consistency auditor (audit.py): built LAST so every plane it
+        joins — plugin state, controller attribution, kubelet sources,
+        apiserver — exists; interval 0 means no thread at all."""
+        if self.cfg.audit_interval_s <= 0:
+            return
+        from .. import audit
+
+        controller = self.controller
+        node_audit = audit.NodeAudit(
+            self.plugin,
+            controller=controller,
+            client=self._kube or self._kube_client,
+            node_name=self.cfg.node_name or os.uname().nodename,
+            checkpoint_path=(
+                controller.checkpoint_path
+                if controller is not None
+                else constants.KUBELET_CHECKPOINT
+            ),
+            # The controller's PodResources channel is reused (grpc
+            # channels are thread-safe); without a controller the
+            # kubelet-joined invariants read the checkpoint only.
+            podres=controller.podres if controller is not None else None,
+            resource_name=self.cfg.resource_name,
+        )
+        self.auditor = node_audit.engine(
+            interval_s=self.cfg.audit_interval_s
+        )
+        audit.install_engine(self.auditor)
+        self.auditor.start()
 
     def _start_dra(self) -> None:
         """DRA plane (resource.k8s.io): DRAPlugin service + ResourceSlice.
@@ -438,6 +483,15 @@ class Daemon:
             self.controller = None
 
     def teardown(self) -> None:
+        if self.auditor is not None:
+            from .. import audit
+
+            try:
+                self.auditor.stop()
+            except Exception:
+                log.exception("auditor stop failed")
+            audit.install_engine(None)
+            self.auditor = None
         if self.telemetry_sampler is not None:
             from .. import telemetry
 
@@ -632,6 +686,16 @@ def parse_args(argv) -> DaemonConfig:
                    "seconds and export tpu_chip_* series labeled by the "
                    "holding pod/gang (also TPU_TELEMETRY_INTERVAL_S); "
                    "0 disables the sampler entirely")
+    p.add_argument("--audit-interval-s", type=float,
+                   default=float(os.environ.get(
+                       "TPU_AUDIT_INTERVAL_S", "0") or 0),
+                   help="run the cross-plane consistency auditor "
+                   "(audit.py) every N seconds: checkpoint vs "
+                   "PodResources vs pod annotations vs the telemetry "
+                   "attribution map vs the exported gauges, findings "
+                   "at /debug/audit and tpu_audit_* metrics (also "
+                   "TPU_AUDIT_INTERVAL_S); 0 disables the auditor "
+                   "entirely")
     p.add_argument("--log-json", action="store_true",
                    help="JSON-lines logging with trace correlation "
                    "(also TPU_LOG_JSON=1)")
@@ -682,6 +746,7 @@ def parse_args(argv) -> DaemonConfig:
         flight_dir=a.flight_dir,
         decisions=a.decisions,
         telemetry_interval_s=a.telemetry_interval_s,
+        audit_interval_s=a.audit_interval_s,
     )
 
 

@@ -1,0 +1,433 @@
+"""tpu-doctor — drift triage and a one-command support bundle.
+
+The `nvidia-bug-report` / `must-gather` moment for this stack: when
+state planes disagree (a stale annotation, a leaked reservation, a
+gauge diverging from placement truth — the consistency auditor's
+findings, `audit.py`) the operator needs two things fast: a readable
+verdict, and ONE artifact to attach to the incident that captures
+every observability surface at once.
+
+Usage::
+
+    # Render live audit findings from any daemon's /debug/audit:
+    python -m k8s_device_plugin_tpu.tools.doctor check \\
+        --url http://node:2112 --url http://extender:12346
+    python -m k8s_device_plugin_tpu.tools.doctor check audit.json
+
+    # Collect /metrics + every /debug/* surface (+ journal metadata)
+    # from both daemons into one timestamped tar.gz for offline triage:
+    python -m k8s_device_plugin_tpu.tools.doctor bundle \\
+        --url http://node:2112 --url http://extender:12346 \\
+        [--journal-dir /var/lib/tpu-extender] [-o bundle.tar.gz]
+
+    python -m k8s_device_plugin_tpu.tools.doctor --self-test  # CI smoke
+
+``check`` exits 0 on a clean audit, 1 when findings are open, 2 when a
+source is unreachable or the auditor reported sweep errors — scriptable
+as a fleet health probe. ``bundle`` is best-effort per endpoint: an
+unreachable surface becomes an error entry in ``manifest.json``, never
+a failed bundle (the daemon being broken is exactly when you want one).
+
+``--self-test`` drives the REAL pipeline in-process: a synthetic
+drifted engine → ``/debug/audit`` over a live MetricsServer → this
+renderer → a bundle tar → the manifest — a drift anywhere in that
+chain fails CI here (scripts/tier1.sh), before the pytest gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import sys
+import tarfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+# Severity sort order for the findings table (most urgent first).
+_SEV_ORDER = {"critical": 0, "warning": 1}
+
+
+def _fetch(url: str, path: str, timeout: float = 10.0) -> bytes:
+    import urllib.request
+
+    with urllib.request.urlopen(
+        url.rstrip("/") + path, timeout=timeout
+    ) as resp:
+        return resp.read()
+
+
+def _load_audit(source: str) -> dict:
+    """One source → its /debug/audit payload. ``source`` is a base URL
+    (http…) or a file path / '-' for stdin (offline: a bundle's
+    audit.json)."""
+    if source.startswith("http://") or source.startswith("https://"):
+        return json.loads(_fetch(source, "/debug/audit"))
+    if source == "-":
+        return json.loads(sys.stdin.read())
+    with open(source) as f:
+        return json.loads(f.read())
+
+
+def render_check(payload: dict, source: str = "") -> str:
+    """The `tpu-doctor check` view of one /debug/audit payload."""
+    build = payload.get("build") or {}
+    component = build.get("component") or payload.get("service") or "?"
+    head = f"== {source or component} =="
+    ident = (
+        f"{component} v{build.get('version', '?')} "
+        f"(py{build.get('python', '?')})"
+    )
+    out = [head, ident]
+    if not payload.get("enabled"):
+        out.append(
+            "auditor: DISABLED (--audit-interval-s 0) — no drift "
+            "detection on this daemon"
+        )
+        return "\n".join(out)
+    age = ""
+    if payload.get("last_sweep_ts"):
+        age = f", last sweep {time.time() - payload['last_sweep_ts']:.0f}s ago"
+    out.append(
+        f"auditor: {payload.get('sweeps', 0)} sweep(s), "
+        f"{len(payload.get('invariants', []))} invariant(s), "
+        f"interval {payload.get('interval_s', '?')}s{age} "
+        f"({payload.get('last_duration_ms', 0)}ms)"
+    )
+    errors = payload.get("errors") or {}
+    for name, err in sorted(errors.items()):
+        out.append(f"  SWEEP ERROR {name}: {err}")
+    findings = sorted(
+        payload.get("findings") or [],
+        key=lambda f: (
+            _SEV_ORDER.get(f.get("severity", ""), 9),
+            f.get("invariant", ""),
+        ),
+    )
+    if not findings:
+        out.append("  no findings — state planes agree")
+        return "\n".join(out)
+    header = f"  {'SEVERITY':<9} {'INVARIANT':<28} SUBJECT"
+    out.append(header)
+    out.append("  " + "-" * (len(header) + 20))
+    for f in findings:
+        subject = " ".join(
+            f"{k}={f[k]}"
+            for k in ("pod", "gang", "node", "chip")
+            if f.get(k)
+        ) or "-"
+        out.append(
+            f"  {f.get('severity', '?'):<9} "
+            f"{f.get('invariant', '?'):<28} {subject}"
+        )
+        out.append(f"            {f.get('message', '')}")
+    return "\n".join(out)
+
+
+def check(sources: List[str]) -> int:
+    """Render every source; exit code is the worst outcome."""
+    rc = 0
+    for source in sources:
+        try:
+            payload = _load_audit(source)
+        except (OSError, ValueError) as e:
+            print(f"== {source} ==\n  UNREACHABLE: {e}")
+            rc = max(rc, 2)
+            continue
+        print(render_check(payload, source))
+        if payload.get("errors"):
+            rc = max(rc, 2)
+        elif payload.get("findings"):
+            rc = max(rc, 1)
+    return rc
+
+
+# -- bundle ------------------------------------------------------------------
+
+# What the bundle pulls from each daemon, beyond /metrics: every
+# registered debug surface (kept in lockstep with the servers via
+# metrics.DEBUG_ENDPOINTS — a new surface is bundled automatically).
+def _bundle_paths() -> Dict[str, str]:
+    from ..utils.metrics import DEBUG_ENDPOINTS
+
+    paths = {"/metrics": "metrics.txt", "/debug": "debug-index.json"}
+    for endpoint in DEBUG_ENDPOINTS:
+        paths[endpoint] = endpoint.rsplit("/", 1)[-1] + ".json"
+    return paths
+
+
+def _journal_metadata(journal_dir: str) -> dict:
+    """Snapshot METADATA of the admission journal (sizes, seq, load
+    status, record count) via the side-effect-free reader — never the
+    raw holds (gang names stay out of the bundle unless the audit
+    payload itself names them), and never load()'s tail-healing
+    truncate against a file another process owns."""
+    from ..utils import statestore
+
+    # Paths come from StateStore itself (construction opens nothing),
+    # not re-spelled filenames — a store naming change must not
+    # silently turn the bundle's journal section into "empty".
+    store = statestore.StateStore(journal_dir)
+    meta: dict = {"dir": journal_dir, "files": {}}
+    for path in (
+        store.journal_path, store.snapshot_path, store._tmp_path,
+    ):
+        try:
+            st = os.stat(path)
+            meta["files"][os.path.basename(path)] = {
+                "size_bytes": st.st_size,
+                "mtime": round(st.st_mtime, 3),
+            }
+        except OSError:
+            continue
+    loaded = statestore.read_state(
+        store.journal_path, store.snapshot_path
+    )
+    meta.update({
+        "status": loaded.status,
+        "records_past_snapshot": len(loaded.records),
+        "dropped_lines": loaded.dropped,
+        "seq": loaded.seq,
+        "has_snapshot": loaded.snapshot is not None,
+    })
+    return meta
+
+
+def _source_dirname(url: str) -> str:
+    return (
+        url.split("://", 1)[-1].rstrip("/").replace("/", "_")
+        .replace(":", "_")
+    )
+
+
+def bundle(
+    urls: List[str],
+    out_path: str = "",
+    journal_dir: str = "",
+    now: Optional[float] = None,
+) -> Tuple[str, dict]:
+    """Collect every surface into one tar.gz; returns (path, manifest).
+    Best-effort per file: failures land in the manifest, not on the
+    floor."""
+    from ..utils.metrics import build_info
+
+    ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime(now))
+    out_path = out_path or f"tpu-doctor-{ts}.tar.gz"
+    manifest: dict = {
+        "created_utc": ts,
+        "tool": build_info(),
+        "sources": [],
+    }
+    paths = _bundle_paths()
+    with tarfile.open(out_path, "w:gz") as tar:
+        def add(name: str, data: bytes) -> None:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = int(now or time.time())
+            tar.addfile(info, io.BytesIO(data))
+
+        for url in urls:
+            dirname = _source_dirname(url)
+            entry: dict = {"url": url, "files": {}}
+            for endpoint, fname in sorted(paths.items()):
+                try:
+                    data = _fetch(url, endpoint)
+                except (OSError, ValueError) as e:
+                    entry["files"][fname] = f"error: {e}"
+                    continue
+                add(f"{dirname}/{fname}", data)
+                entry["files"][fname] = "ok"
+                if fname == "audit.json":
+                    # Surface the daemon's build identity + sanitized
+                    # config in the manifest so triage starts from the
+                    # manifest alone.
+                    try:
+                        audit_payload = json.loads(data)
+                        entry["build"] = audit_payload.get("build")
+                        entry["config"] = audit_payload.get("config")
+                    except ValueError:
+                        pass
+            manifest["sources"].append(entry)
+        if journal_dir:
+            try:
+                manifest["journal"] = _journal_metadata(journal_dir)
+            except Exception as e:  # noqa: BLE001 — metadata is
+                # best-effort like every other bundle member
+                manifest["journal"] = {"error": f"{e}"}
+        add(
+            "manifest.json",
+            json.dumps(manifest, indent=1, sort_keys=True).encode(),
+        )
+    return out_path, manifest
+
+
+# -- self-test ---------------------------------------------------------------
+
+def _self_test() -> str:
+    """Synthetic drifted engine → live /debug/audit → renderer →
+    bundle tar → manifest. Raises on any drift in the chain."""
+    import shutil
+    import tempfile
+
+    from .. import audit
+    from ..utils import metrics
+
+    metrics.set_build_info("plugin")
+    drift = {"on": True}
+
+    def leaky() -> List[audit.Finding]:
+        if not drift["on"]:
+            return []
+        return [audit.Finding.make(
+            "orphaned_chip", audit.CRITICAL,
+            "chips ['tpu-x'] held by pod ml/ghost, which the apiserver "
+            "no longer knows",
+            pod="ml/ghost", node="self-test-node", chips="tpu-x",
+        )]
+
+    engine = audit.AuditEngine(
+        service="plugin",
+        invariants=[
+            audit.Invariant(
+                "orphaned_chip", ("podresources", "apiserver"),
+                "self-test drifted invariant", leaky,
+            ),
+            audit.Invariant(
+                "gauge_vs_state", ("metrics", "placement"),
+                "self-test clean invariant", lambda: [],
+            ),
+        ],
+        interval_s=60,
+        config={"audit_interval_s": 60},
+    )
+    saved = audit.ENGINE
+    audit.install_engine(engine)
+    srv = metrics.MetricsServer(host="127.0.0.1")
+    url = srv.start()
+    tmp = tempfile.mkdtemp(prefix="tpu-doctor-selftest-")
+    try:
+        engine.sweep_once()
+        payload = _load_audit(url)
+        assert payload["enabled"] and payload["findings"], payload
+        table = render_check(payload, url)
+        assert "orphaned_chip" in table and "ml/ghost" in table, table
+        assert "critical" in table
+        assert check([url]) == 1  # findings → exit 1
+        # Repair → clean render and exit 0.
+        drift["on"] = False
+        engine.sweep_once()
+        assert "no findings" in render_check(_load_audit(url))
+        assert check([url]) == 0
+        # The findings gauge followed the drift lifecycle.
+        assert metrics.AUDIT_FINDINGS.series() == []
+        # Bundle: every surface collected, manifest carries the build.
+        out, manifest = bundle(
+            [url], out_path=os.path.join(tmp, "b.tar.gz")
+        )
+        with tarfile.open(out) as tar:
+            names = set(tar.getnames())
+        want = {"manifest.json"} | {
+            f"{_source_dirname(url)}/{f}"
+            for f in _bundle_paths().values()
+        }
+        missing = want - names
+        assert not missing, missing
+        src = manifest["sources"][0]
+        assert src["files"]["audit.json"] == "ok"
+        assert src["build"]["component"] == "plugin", src
+        return table
+    finally:
+        srv.stop()
+        audit.install_engine(saved)
+        metrics.AUDIT_FINDINGS.remove_matching()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu-doctor",
+        description="consistency-audit triage + support bundle "
+        "(audit.py /debug/audit)",
+    )
+    p.add_argument(
+        "--self-test", action="store_true",
+        help="drive a synthetic drifted engine through /debug/audit, "
+        "the renderer, and a bundle (CI smoke; exits non-zero on "
+        "drift)",
+    )
+    sub = p.add_subparsers(dest="cmd")
+    pc = sub.add_parser(
+        "check", help="render live findings from /debug/audit"
+    )
+    pc.add_argument(
+        "sources", nargs="*",
+        help="audit.json files or '-' (offline input)",
+    )
+    pc.add_argument(
+        "--url", action="append", default=[],
+        help="daemon base URL (repeatable: plugin :2112 and extender "
+        ":12346)",
+    )
+    pb = sub.add_parser(
+        "bundle",
+        help="collect /metrics + every /debug/* surface into one "
+        "timestamped tar.gz",
+    )
+    pb.add_argument(
+        "--url", action="append", default=[],
+        help="daemon base URL (repeatable)",
+    )
+    pb.add_argument(
+        "-o", "--output", default="",
+        help="output path (default tpu-doctor-<utc>.tar.gz)",
+    )
+    pb.add_argument(
+        "--journal-dir", default="",
+        help="include admission-journal METADATA (sizes, seq, load "
+        "status — never raw records) from this directory",
+    )
+    a = p.parse_args(argv)
+    if a.self_test:
+        print(_self_test())
+        print("tpu-doctor self-test: OK")
+        return 0
+    if a.cmd == "check":
+        sources = list(a.url) + list(a.sources)
+        if not sources:
+            pc.error("at least one --url or audit.json file is required")
+        return check(sources)
+    if a.cmd == "bundle":
+        if not a.url:
+            pb.error("at least one --url is required")
+        try:
+            out, manifest = bundle(
+                a.url, out_path=a.output, journal_dir=a.journal_dir
+            )
+        except OSError as e:
+            print(f"tpu-doctor: {e}", file=sys.stderr)
+            return 2
+        collected = sum(
+            1
+            for s in manifest["sources"]
+            for v in s["files"].values()
+            if v == "ok"
+        )
+        failed = sum(
+            1
+            for s in manifest["sources"]
+            for v in s["files"].values()
+            if v != "ok"
+        )
+        print(
+            f"wrote {out}: {collected} file(s) from "
+            f"{len(manifest['sources'])} daemon(s)"
+            + (f", {failed} surface(s) unreachable" if failed else "")
+        )
+        return 0
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -424,6 +424,48 @@ NODE_BOX_PLACEABLE = REGISTRY.gauge(
     "on this node, else 0, for each power-of-two request size up to "
     "the host's chip count",
 )
+# Consistency-audit plane (audit.py): continuous cross-plane drift
+# detection — checkpoint vs PodResources vs annotations vs gauges on
+# the node, reservations vs journal vs cluster truth on the extender.
+# Constant absent/0 unless --audit-interval-s enables the auditor.
+# Sweep-latency bucket bounds: sub-ms toy sweeps through multi-second
+# apiserver-listing sweeps on big clusters.
+AUDIT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0,
+)
+AUDIT_FINDINGS = REGISTRY.gauge(
+    "tpu_audit_findings",
+    "Open consistency-audit findings by invariant and severity "
+    "(audit.py; served at /debug/audit). A series disappears when its "
+    "findings clear — absent means clean, exactly like the pruned "
+    "tpu_chip_* families",
+)
+AUDIT_SWEEPS = REGISTRY.counter(
+    "tpu_audit_sweeps_total",
+    "Consistency-audit sweeps run, by outcome (clean/findings/error; "
+    "error means an invariant raised — its planes went unaudited that "
+    "pass)",
+)
+AUDIT_SWEEP_SECONDS = REGISTRY.histogram(
+    "tpu_audit_sweep_seconds",
+    "Wall latency of one consistency-audit sweep across every "
+    "registered invariant",
+    buckets=AUDIT_BUCKETS,
+)
+AUDIT_LAST_CLEAN = REGISTRY.gauge(
+    "tpu_audit_last_clean_sweep_timestamp",
+    "Unix time of the last sweep that found zero drift (and raised no "
+    "errors); time() minus this is the 'how long has state been "
+    "suspect' dashboard number",
+)
+BUILD_INFO = REGISTRY.gauge(
+    "tpu_build_info",
+    "Always 1; the labels are the point: version (the package "
+    "__version__), python, and component identify exactly what build "
+    "answered this scrape (tpu-doctor shows it, the support bundle "
+    "records it)",
+)
 # The extender/gang-admission process exposes its own registry: sharing
 # the daemon's would publish every tpu_plugin_* family as constant zeros
 # from the extender Service, polluting sum()s and alerts across scrapes.
@@ -614,6 +656,66 @@ EXT_PLACEABLE_NODES = EXTENDER_REGISTRY.gauge(
     "{size} chips, per power-of-two request size (from the incremental "
     "topology index; 0 everywhere when --node-cache is off)",
 )
+# Extender-process instances of the consistency-audit instruments
+# (separate registry — see the pollution note above; same family names
+# on purpose so one dashboard row covers both components).
+EXT_AUDIT_FINDINGS = EXTENDER_REGISTRY.gauge(
+    "tpu_audit_findings",
+    "Open consistency-audit findings by invariant and severity "
+    "(audit.py; served at /debug/audit); absent series = clean",
+)
+EXT_AUDIT_SWEEPS = EXTENDER_REGISTRY.counter(
+    "tpu_audit_sweeps_total",
+    "Consistency-audit sweeps run, by outcome (clean/findings/error)",
+)
+EXT_AUDIT_SWEEP_SECONDS = EXTENDER_REGISTRY.histogram(
+    "tpu_audit_sweep_seconds",
+    "Wall latency of one consistency-audit sweep across every "
+    "registered invariant",
+    buckets=AUDIT_BUCKETS,
+)
+EXT_AUDIT_LAST_CLEAN = EXTENDER_REGISTRY.gauge(
+    "tpu_audit_last_clean_sweep_timestamp",
+    "Unix time of the last sweep that found zero drift and raised no "
+    "errors",
+)
+EXT_BUILD_INFO = EXTENDER_REGISTRY.gauge(
+    "tpu_build_info",
+    "Always 1; labels version/python/component identify the build "
+    "answering this scrape",
+)
+
+
+def set_build_info(component: str) -> None:
+    """Publish the build-identity info-gauge for this process (the
+    Prometheus *_build_info idiom: value 1, identity in the labels).
+    Called once by each entrypoint; before this existed neither daemon
+    reported what build it was, so a support bundle couldn't say which
+    version produced it."""
+    import platform
+
+    from .. import __version__
+
+    fam = EXT_BUILD_INFO if component == "extender" else BUILD_INFO
+    fam.set(
+        1,
+        version=__version__,
+        python=platform.python_version(),
+        component=component,
+    )
+
+
+def build_info() -> dict:
+    """The same identity as a dict (the /debug/audit payload and the
+    tpu-doctor bundle manifest carry it)."""
+    import platform
+
+    from .. import __version__
+
+    return {
+        "version": __version__,
+        "python": platform.python_version(),
+    }
 
 
 OPENMETRICS_CONTENT_TYPE = (
@@ -636,50 +738,104 @@ def render_scrape(registry: Registry, accept: str) -> Tuple[bytes, str]:
     return body, ctype
 
 
+# Every registered debug surface with a one-line description — the
+# GET /debug index payload (operators should not have to know the
+# paths by heart), and the file list tpu-doctor's bundle collects.
+DEBUG_ENDPOINTS: Dict[str, str] = {
+    "/debug/traces": (
+        "span collector OTLP-JSON export (?trace_id= narrows to one "
+        "trace); populated when --trace/TPU_TRACE is on"
+    ),
+    "/debug/events": "flight-recorder ring (bounded, newest last)",
+    "/debug/decisions": (
+        "decision ledger (?pod=/?gang=/?node=/?kind=/?trace_id=/"
+        "?limit= filtering); populated when --decisions/--trace is on"
+    ),
+    "/debug/telemetry": (
+        "chip-telemetry snapshot: sampler state + attributed per-chip "
+        "readings + node fragmentation (plugin), cluster placeable-"
+        "nodes aggregate (extender)"
+    ),
+    "/debug/audit": (
+        "consistency-audit snapshot: invariant registry, open "
+        "findings, sweep stats (audit.py; --audit-interval-s)"
+    ),
+}
+
+
 def debug_payload(path: str) -> Optional[bytes]:
     """JSON body for the /debug/* observability endpoints (shared by
-    both HTTP servers): /debug/traces = the span collector's OTLP-JSON
+    both HTTP servers): /debug (or /debug/) = an index of every
+    registered surface, /debug/traces = the span collector's OTLP-JSON
     export (optionally ?trace_id=...), /debug/events = the flight
     recorder ring, /debug/decisions = the decision ledger
     (?pod=/?gang=/?node=/?kind=/?trace_id=/?limit= filtering),
-    /debug/telemetry = the chip-telemetry snapshot (sampler state +
-    per-chip attributed readings + node fragmentation in the plugin
-    daemon; the cluster placeable-nodes aggregate in the extender).
-    None for any other path."""
+    /debug/telemetry = the chip-telemetry snapshot,
+    /debug/audit = the consistency auditor's findings (audit.py).
+    None for an unknown path.
+
+    Each section's provider runs ISOLATED: a provider that raises
+    degrades that one endpoint to a 200 ``{"error": ...}`` body
+    instead of taking down the whole /debug surface — debuggability
+    must not depend on every subsystem being healthy at exactly the
+    moment an operator is debugging one of them."""
     import json as _json
     import urllib.parse as _up
 
-    from . import tracing
-    from .decisions import LEDGER
-    from .flightrecorder import RECORDER
-
     parsed = _up.urlparse(path)
-    if parsed.path == "/debug/telemetry":
-        from .. import telemetry
 
-        return _json.dumps(telemetry.debug_snapshot()).encode()
-    if parsed.path == "/debug/traces":
-        trace_id = dict(_up.parse_qsl(parsed.query)).get("trace_id", "")
+    def build() -> Optional[dict]:
+        from . import tracing
+        from .decisions import LEDGER
+        from .flightrecorder import RECORDER
+
+        if parsed.path in ("/debug", "/debug/"):
+            return {"endpoints": dict(DEBUG_ENDPOINTS)}
+        if parsed.path == "/debug/telemetry":
+            from .. import telemetry
+
+            return telemetry.debug_snapshot()
+        if parsed.path == "/debug/audit":
+            from .. import audit
+
+            return audit.debug_snapshot()
+        if parsed.path == "/debug/traces":
+            trace_id = dict(_up.parse_qsl(parsed.query)).get(
+                "trace_id", ""
+            )
+            return tracing.COLLECTOR.otlp_json(trace_id=trace_id)
+        if parsed.path == "/debug/events":
+            return RECORDER.snapshot()
+        if parsed.path == "/debug/decisions":
+            q = dict(_up.parse_qsl(parsed.query))
+            try:
+                limit = int(q.get("limit", "0"))
+            except ValueError:
+                limit = 0
+            return LEDGER.snapshot(
+                pod=q.get("pod", ""),
+                gang=q.get("gang", ""),
+                node=q.get("node", ""),
+                kind=q.get("kind", ""),
+                trace_id=q.get("trace_id", ""),
+                limit=limit,
+            )
+        return None
+
+    try:
+        payload = build()
+    except Exception as e:  # noqa: BLE001 — one broken provider must
+        # not 500 the debug plane (satellite fix, regression-tested in
+        # tests/test_audit.py)
+        payload = {"error": f"{type(e).__name__}: {e}"}
+    if payload is None:
+        return None
+    try:
+        return _json.dumps(payload).encode()
+    except (TypeError, ValueError) as e:
         return _json.dumps(
-            tracing.COLLECTOR.otlp_json(trace_id=trace_id)
+            {"error": f"unserializable payload: {e}"}
         ).encode()
-    if parsed.path == "/debug/events":
-        return _json.dumps(RECORDER.snapshot()).encode()
-    if parsed.path == "/debug/decisions":
-        q = dict(_up.parse_qsl(parsed.query))
-        try:
-            limit = int(q.get("limit", "0"))
-        except ValueError:
-            limit = 0
-        return _json.dumps(LEDGER.snapshot(
-            pod=q.get("pod", ""),
-            gang=q.get("gang", ""),
-            node=q.get("node", ""),
-            kind=q.get("kind", ""),
-            trace_id=q.get("trace_id", ""),
-            limit=limit,
-        )).encode()
-    return None
 
 
 class MetricsServer(BackgroundHTTPServer):
@@ -715,7 +871,9 @@ class MetricsServer(BackgroundHTTPServer):
                     )
                     self.send_response(200)
                     self.send_header("Content-Type", ctype)
-                elif self.path.startswith("/debug/"):
+                elif self.path == "/debug" or self.path.startswith(
+                    "/debug/"
+                ):
                     payload = debug_payload(self.path)
                     if payload is None:
                         body = b"not found\n"

@@ -125,6 +125,89 @@ def _decode_journal(data: bytes) -> "tuple[List[dict], str, int, int]":
     return records, status, dropped, good_end
 
 
+def _read_files(
+    journal_path: str, snapshot_path: str
+) -> "tuple[LoadResult, str, int, int]":
+    """The pure-read core both :meth:`StateStore.load` and
+    :func:`read_state` share — ONE parser, so the owner's replay and
+    the auditor's read-only replay can never fold different record
+    sets from the same bytes. Returns (result, journal_status,
+    good_end, journal_len); the extra three are what load()'s tail
+    healing needs."""
+    snapshot = None
+    status = CLEAN
+    snap_seq = 0
+    try:
+        with open(snapshot_path, "rb") as f:
+            doc = json.loads(f.read())
+        payload = json.dumps(
+            doc.get("data"), separators=(",", ":"), sort_keys=True
+        ).encode()
+        if doc.get("checksum") != _crc(payload):
+            log.warning(
+                "snapshot %s failed its checksum; ignoring it",
+                snapshot_path,
+            )
+            status = SNAPSHOT_CORRUPT
+        else:
+            snapshot = doc.get("data")
+            snap_seq = int(doc.get("seq", 0))
+    except FileNotFoundError:
+        pass
+    except (OSError, ValueError, TypeError) as e:
+        log.warning(
+            "unreadable snapshot %s (%s); ignoring it", snapshot_path, e
+        )
+        status = SNAPSHOT_CORRUPT
+    try:
+        with open(journal_path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        data = b""
+    except OSError as e:
+        log.warning(
+            "unreadable journal %s (%s); treating as empty",
+            journal_path, e,
+        )
+        data = b""
+        status = CORRUPT
+    records, jstatus, dropped, good_end = _decode_journal(data)
+    if status == CLEAN:
+        status = jstatus
+    # Idempotent replay across a crash between snapshot rename and
+    # journal truncate: drop records the snapshot already covers.
+    records = [r for r in records if int(r.get("seq", 0)) > snap_seq]
+    seq = max(
+        snap_seq, max((int(r.get("seq", 0)) for r in records), default=0)
+    )
+    if status == CLEAN and snapshot is None and not records:
+        status = EMPTY
+    return (
+        LoadResult(
+            snapshot=snapshot,
+            records=records,
+            status=status,
+            dropped=dropped,
+            seq=seq,
+        ),
+        jstatus,
+        good_end,
+        len(data),
+    )
+
+
+def read_state(journal_path: str, snapshot_path: str) -> LoadResult:
+    """Side-effect-free read of a store's current state: no tmp-file
+    cleanup, no tail healing, no writer-seq bookkeeping. The shape the
+    consistency auditor (audit.py) needs — it replays the OWNER's live
+    journal from another vantage point while the owner keeps appending,
+    and a reader that truncated the file (load()'s heal) or advanced
+    shared counters would corrupt the very state it is auditing.
+    Tolerates every damage class exactly like load() by construction
+    (same ``_read_files`` core)."""
+    return _read_files(journal_path, snapshot_path)[0]
+
+
 class StateStore:
     """One journal file + one snapshot file in a directory.
 
@@ -156,9 +239,9 @@ class StateStore:
         """Read snapshot + journal; never raises on damaged state files
         (an unreadable store degrades to an empty one — the caller's
         cluster-truth reconciliation is the floor, and a crash-looping
-        daemon must not wedge on its own journal)."""
-        snapshot = None
-        status = CLEAN
+        daemon must not wedge on its own journal). Parsing is the
+        shared ``_read_files`` core; this method adds the OWNER-only
+        side effects: tmp cleanup, tail healing, seq bookkeeping."""
         # A leftover tmp file is a compaction that crashed before
         # rename: the real snapshot (if any) is still the authoritative
         # one; the tmp is dead bytes.
@@ -172,46 +255,10 @@ class StateStore:
                 )
         except OSError:
             pass
-        snap_seq = 0
-        try:
-            with open(self.snapshot_path, "rb") as f:
-                doc = json.loads(f.read())
-            payload = json.dumps(
-                doc.get("data"), separators=(",", ":"), sort_keys=True
-            ).encode()
-            if doc.get("checksum") != _crc(payload):
-                log.warning(
-                    "snapshot %s failed its checksum; ignoring it",
-                    self.snapshot_path,
-                )
-                status = SNAPSHOT_CORRUPT
-            else:
-                snapshot = doc.get("data")
-                snap_seq = int(doc.get("seq", 0))
-        except FileNotFoundError:
-            pass
-        except (OSError, ValueError, TypeError) as e:
-            log.warning(
-                "unreadable snapshot %s (%s); ignoring it",
-                self.snapshot_path, e,
-            )
-            status = SNAPSHOT_CORRUPT
-        try:
-            with open(self.journal_path, "rb") as f:
-                data = f.read()
-        except FileNotFoundError:
-            data = b""
-        except OSError as e:
-            log.warning(
-                "unreadable journal %s (%s); treating as empty",
-                self.journal_path, e,
-            )
-            data = b""
-            status = CORRUPT
-        records, jstatus, dropped, good_end = _decode_journal(data)
-        if status == CLEAN:
-            status = jstatus
-        if jstatus in (TORN_TAIL, CORRUPT) and good_end < len(data):
+        result, jstatus, good_end, data_len = _read_files(
+            self.journal_path, self.snapshot_path
+        )
+        if jstatus in (TORN_TAIL, CORRUPT) and good_end < data_len:
             # Heal the file to the intact prefix NOW: appends open in
             # 'ab' mode, and a record written after damaged bytes would
             # be unreadable to every later replay (it lands on the same
@@ -226,23 +273,9 @@ class StateStore:
                     "records appended before the next compaction may "
                     "be lost to the next replay", self.journal_path, e,
                 )
-        # Idempotent replay across a crash between snapshot rename and
-        # journal truncate: drop records the snapshot already covers.
-        records = [r for r in records if int(r.get("seq", 0)) > snap_seq]
-        seq = max(
-            snap_seq, max((int(r.get("seq", 0)) for r in records), default=0)
-        )
-        if status == CLEAN and snapshot is None and not records:
-            status = EMPTY
         with self._lock:
-            self._seq = max(self._seq, seq)
-        return LoadResult(
-            snapshot=snapshot,
-            records=records,
-            status=status,
-            dropped=dropped,
-            seq=seq,
-        )
+            self._seq = max(self._seq, result.seq)
+        return result
 
     # -- write -------------------------------------------------------------
 

@@ -30,6 +30,13 @@ python -m k8s_device_plugin_tpu.tools.explain --self-test > /dev/null \
 # that chain fails CI here, before the pytest gate.
 python -m k8s_device_plugin_tpu.tools.tputop --self-test > /dev/null \
   || { echo "tools/tputop.py --self-test FAILED"; exit 1; }
+# Consistency-audit tooling smoke: tpu-doctor must render findings
+# from a drifted engine served over a REAL /debug/audit endpoint and
+# collect a complete support bundle (tools/doctor.py --self-test) — a
+# drift between the audit snapshot shape, the renderer, and the bundle
+# manifest fails CI here, before the pytest gate.
+python -m k8s_device_plugin_tpu.tools.doctor --self-test > /dev/null \
+  || { echo "tools/doctor.py --self-test FAILED"; exit 1; }
 # Crash-recovery smoke: the admission-state journal must round-trip
 # reserve -> crash -> replay, tolerate a torn tail, and survive a
 # compaction (extender/journal.py --self-test) — a statestore format

@@ -1,0 +1,1016 @@
+"""Cross-plane consistency auditor (audit.py) + tpu-doctor.
+
+ISSUE 8: drift between the five state surfaces (kubelet record, pod
+annotations, reservations+journal, attribution map, exported gauges)
+becomes a first-class, alertable signal. The acceptance e2e here
+corrupts each plane one at a time and asserts exactly the expected
+invariant fires with the right labels — then clears after repair —
+plus ledger/flight/metrics lockstep, the /debug surfaces, the
+debug-payload isolation fix, the build-info gauge, and doc lockstep.
+"""
+
+import dataclasses
+import json
+import os
+import tarfile
+
+import pytest
+import requests
+
+from k8s_device_plugin_tpu import audit, telemetry
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+from k8s_device_plugin_tpu.extender.index import TopologyIndex
+from k8s_device_plugin_tpu.extender.journal import AdmissionJournal
+from k8s_device_plugin_tpu.extender.reservations import ReservationTable
+from k8s_device_plugin_tpu.kube.client import KubeClient
+from k8s_device_plugin_tpu.topology.mesh import IciMesh
+from k8s_device_plugin_tpu.topology.schema import NodeTopology
+from k8s_device_plugin_tpu.utils import metrics
+from k8s_device_plugin_tpu.utils.decisions import LEDGER
+from k8s_device_plugin_tpu.utils.flightrecorder import RECORDER
+from tests import fakes
+from tests.fake_apiserver import FakeApiServer
+from tests.fake_kubelet import FakePodResources
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NODE = "tpu-node-1"
+RESOURCE = constants.RESOURCE_NAME
+
+
+@pytest.fixture(autouse=True)
+def _clean_audit_state():
+    """Audit families live in the process-global registries; every
+    test starts and ends with no findings series and no installed
+    engine."""
+    yield
+    for fam in (
+        metrics.AUDIT_FINDINGS,
+        metrics.EXT_AUDIT_FINDINGS,
+        metrics.EXT_PLACEABLE_NODES,
+    ):
+        fam.remove_matching()
+    audit.install_engine(None)
+    telemetry.CLUSTER_PROVIDER = None
+    RECORDER.clear()
+    RECORDER.disable()
+    LEDGER.clear()
+    LEDGER.disable()
+
+
+def _invariant_names(findings):
+    return {f.invariant for f in findings}
+
+
+# -- engine mechanics --------------------------------------------------------
+
+def test_engine_metrics_flight_ledger_lockstep(tmp_path):
+    """One drifting invariant through the full reporting chain: gauge
+    series appear and PRUNE on clear, sweeps counter carries the
+    outcome, detection/clear each flight-record exactly once (never
+    per-sweep while the finding persists), the ledger records the
+    machine reason, and a NEW critical finding dumps the flight ring
+    (the circuit-break idiom)."""
+    RECORDER.enable(service="plugin", dump_dir=str(tmp_path))
+    LEDGER.enable(service="plugin")
+    drift = {"on": False}
+
+    def check():
+        if not drift["on"]:
+            return []
+        return [audit.Finding.make(
+            "orphaned_chip", audit.CRITICAL,
+            "chips held by a vanished pod",
+            pod="ml/ghost", node=NODE, chips="tpu-a,tpu-b",
+        )]
+
+    engine = audit.AuditEngine(
+        "plugin",
+        [audit.Invariant("orphaned_chip", ("a", "b"), "test", check)],
+        interval_s=60,
+    )
+    before_clean = metrics.AUDIT_SWEEPS.get(outcome="clean")
+    assert engine.sweep_once() == []
+    assert metrics.AUDIT_SWEEPS.get(outcome="clean") == before_clean + 1
+    assert metrics.AUDIT_FINDINGS.series() == []
+    clean_ts = metrics.AUDIT_LAST_CLEAN.get()
+    assert clean_ts > 0
+
+    drift["on"] = True
+    findings = engine.sweep_once()
+    assert _invariant_names(findings) == {"orphaned_chip"}
+    assert metrics.AUDIT_FINDINGS.get(
+        invariant="orphaned_chip", severity="critical"
+    ) == 1
+    # The last-clean stamp did NOT advance through a dirty sweep.
+    assert metrics.AUDIT_LAST_CLEAN.get() == clean_ts
+    # Persisting finding: second sweep records NOTHING new.
+    engine.sweep_once()
+    events = [
+        e for e in RECORDER.snapshot()["events"]
+        if e["kind"] == "audit_divergence"
+    ]
+    assert len(events) == 1
+    assert events[0]["attrs"]["state"] == "detected"
+    assert events[0]["attrs"]["invariant"] == "orphaned_chip"
+    assert events[0]["attrs"]["pod"] == "ml/ghost"
+    recs = LEDGER.query(kind="audit_divergence")
+    assert len(recs) == 1
+    assert recs[0]["reason"] == "orphaned_chip"
+    assert recs[0]["pod"] == "ml/ghost"
+    assert recs[0]["attrs"]["severity"] == "critical"
+    # Critical detection dumped the ring to the flight dir.
+    dumps = [f for f in os.listdir(tmp_path) if "audit_critical" in f]
+    assert len(dumps) == 1
+    body = json.loads(open(tmp_path / dumps[0]).read())
+    assert body["reason"] == "audit_critical"
+
+    drift["on"] = False
+    assert engine.sweep_once() == []
+    assert metrics.AUDIT_FINDINGS.series() == []  # pruned, not zeroed
+    states = [
+        e["attrs"]["state"]
+        for e in RECORDER.snapshot()["events"]
+        if e["kind"] == "audit_divergence"
+    ]
+    assert states == ["detected", "cleared"]
+    assert metrics.AUDIT_LAST_CLEAN.get() >= clean_ts
+
+
+def test_severity_escalation_is_a_new_detection(tmp_path):
+    """A warning→critical escalation on the SAME subject must re-fire
+    the flight/ledger records and dump the ring — 'the finding
+    persisted' and 'the finding got worse' are different facts."""
+    RECORDER.enable(service="plugin", dump_dir=str(tmp_path))
+    LEDGER.enable(service="plugin")
+    sev = {"v": audit.WARNING}
+    engine = audit.AuditEngine(
+        "plugin",
+        [audit.Invariant(
+            "gate_vs_hold", ("a", "b"), "test",
+            lambda: [audit.Finding.make(
+                "gate_vs_hold", sev["v"], "drift", gang="ml/job"
+            )],
+        )],
+        interval_s=60,
+    )
+    engine.sweep_once()
+    sev["v"] = audit.CRITICAL
+    engine.sweep_once()
+    events = [
+        e for e in RECORDER.snapshot()["events"]
+        if e["kind"] == "audit_divergence"
+    ]
+    # warning detected, then (escalation) warning cleared + critical
+    # detected.
+    assert [
+        (e["attrs"]["state"], e["attrs"]["severity"]) for e in events
+    ] == [
+        ("detected", "warning"),
+        ("detected", "critical"),
+        ("cleared", "warning"),
+    ]
+    assert any("audit_critical" in f for f in os.listdir(tmp_path))
+
+
+def test_gate_vs_hold_respects_undrained_lapse(extender_stack):
+    """A hold that lapsed inside a routine prune — after the
+    admitter's last drain — must not read as an unprotected gang (a
+    false CRITICAL here would dump the flight ring and page)."""
+    s = extender_stack
+    engine = s["engine"]
+    s["add_gang_pod"]("naked", "naked-w0")
+    s["add_gang_pod"]("naked", "naked-w1")
+    # Lapse lands in the table's undrained set only (the gang loop has
+    # not ticked): reserve then lapse directly.
+    s["reservations"].reserve(
+        ("default", "naked"), {"node-a": 4}, demands=(2, 2)
+    )
+    s["reservations"].lapse(("default", "naked"))
+    assert ("default", "naked") not in s["gang"]._lapsed_gangs
+    assert engine.sweep_once() == []
+
+
+def test_engine_isolates_raising_invariant():
+    """One broken invariant costs its own planes' coverage for the
+    sweep (errors + outcome=error), never the sweep or the process."""
+    def boom():
+        raise RuntimeError("plane unavailable")
+
+    engine = audit.AuditEngine(
+        "plugin",
+        [
+            audit.Invariant("broken", ("x",), "raises", boom),
+            audit.Invariant(
+                "fine", ("y",), "works",
+                lambda: [audit.Finding.make(
+                    "fine", audit.WARNING, "drift"
+                )],
+            ),
+        ],
+        interval_s=60,
+    )
+    before = metrics.AUDIT_SWEEPS.get(outcome="error")
+    findings = engine.sweep_once()
+    assert _invariant_names(findings) == {"fine"}  # others still ran
+    assert metrics.AUDIT_SWEEPS.get(outcome="error") == before + 1
+    snap = engine.snapshot()
+    assert "broken" in snap["errors"]
+    assert "RuntimeError" in snap["errors"]["broken"]
+
+
+def test_maybe_sweep_cadence():
+    ticks = []
+    engine = audit.AuditEngine(
+        "extender",
+        [audit.Invariant(
+            "t", ("x",), "", lambda: ticks.append(1) or []
+        )],
+        interval_s=3600,
+    )
+    assert engine.maybe_sweep() is True
+    assert engine.maybe_sweep() is False  # interval not yet elapsed
+    assert len(ticks) == 1
+    engine.interval_s = 0
+    assert engine.maybe_sweep() is False  # 0 = off
+
+
+# -- /debug surfaces + satellite fixes ---------------------------------------
+
+def test_debug_index_and_audit_endpoint():
+    engine = audit.AuditEngine("plugin", [], interval_s=60)
+    audit.install_engine(engine)
+    engine.sweep_once()
+    srv = metrics.MetricsServer(host="127.0.0.1")
+    url = srv.start()
+    try:
+        # The index lists every registered surface with a description.
+        idx = requests.get(f"{url}/debug", timeout=5).json()
+        assert set(idx["endpoints"]) == set(metrics.DEBUG_ENDPOINTS)
+        assert "/debug/audit" in idx["endpoints"]
+        assert all(desc for desc in idx["endpoints"].values())
+        payload = requests.get(f"{url}/debug/audit", timeout=5).json()
+        assert payload["enabled"] is True
+        assert payload["sweeps"] == 1
+        assert payload["findings"] == []
+        assert payload["build"]["component"] == "plugin"
+        assert payload["build"]["version"]
+    finally:
+        srv.stop()
+
+
+def test_debug_index_on_extender_server():
+    from k8s_device_plugin_tpu.extender.server import ExtenderHTTPServer
+
+    srv = ExtenderHTTPServer(host="127.0.0.1")
+    url = srv.start()
+    try:
+        idx = requests.get(f"{url}/debug", timeout=5).json()
+        assert "/debug/audit" in idx["endpoints"]
+        # With no engine installed the endpoint still answers.
+        payload = requests.get(f"{url}/debug/audit", timeout=5).json()
+        assert payload["enabled"] is False
+    finally:
+        srv.stop()
+
+
+def test_broken_debug_provider_degrades_to_error_field(monkeypatch):
+    """Satellite fix: a raising payload provider used to 500 (abort)
+    the whole debug endpoint; now it degrades to a 200
+    {"error": ...} body and every OTHER surface keeps working."""
+    def boom():
+        raise RuntimeError("telemetry backend exploded")
+
+    monkeypatch.setattr(telemetry, "debug_snapshot", boom)
+    srv = metrics.MetricsServer(host="127.0.0.1")
+    url = srv.start()
+    try:
+        r = requests.get(f"{url}/debug/telemetry", timeout=5)
+        assert r.status_code == 200
+        assert "RuntimeError" in r.json()["error"]
+        # The sibling surfaces are unaffected.
+        assert requests.get(
+            f"{url}/debug/events", timeout=5
+        ).status_code == 200
+        assert "endpoints" in requests.get(
+            f"{url}/debug", timeout=5
+        ).json()
+    finally:
+        srv.stop()
+
+
+def test_build_info_gauge_and_helper():
+    from k8s_device_plugin_tpu import __version__
+
+    metrics.set_build_info("plugin")
+    metrics.set_build_info("extender")
+    text = metrics.REGISTRY.render()
+    assert f'version="{__version__}"' in text
+    assert 'component="plugin"' in text
+    ext = metrics.EXTENDER_REGISTRY.render()
+    assert 'component="extender"' in ext
+    assert "tpu_build_info" in text and "tpu_build_info" in ext
+    info = metrics.build_info()
+    assert info["version"] == __version__ and info["python"]
+
+
+# -- the node-side acceptance e2e --------------------------------------------
+
+@pytest.fixture
+def node_stack(tmp_path):
+    """plugin + controller + fake apiserver + fake PodResources, one
+    reconciled gang pod holding two chips — the clean baseline every
+    corruption below starts from."""
+    from k8s_device_plugin_tpu.controller.controller import Controller
+    from k8s_device_plugin_tpu.server.plugin import (
+        PluginConfig,
+        TpuDevicePlugin,
+    )
+
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 4)
+    chips = PyTpuInfo().scan(accel, dev)
+    mesh = IciMesh(chips)
+    plugin = TpuDevicePlugin(
+        mesh, config=PluginConfig(libtpu_host_path="")
+    )
+    api = FakeApiServer()
+    api_url = api.start()
+    api.add_node(NODE)
+    client = KubeClient(api_url)
+    podres = FakePodResources(str(tmp_path / "podres" / "kubelet.sock"))
+    podres.start()
+    checkpoint_path = str(tmp_path / "kubelet_internal_checkpoint")
+    controller = Controller(
+        client, plugin, node_name=NODE,
+        checkpoint_path=checkpoint_path,
+        podresources_socket=podres.socket_path,
+    )
+    want = mesh.ids[:2]
+    podres.set_pod("ml", "w0", RESOURCE, want)
+    pod = {
+        "metadata": {
+            "name": "w0", "namespace": "ml", "uid": "uid-w0",
+            "annotations": {
+                constants.POD_DEVICES_ANNOTATION: ",".join(want)
+            },
+        },
+        "spec": {
+            "nodeName": NODE,
+            "containers": [{
+                "name": "main",
+                "resources": {"requests": {RESOURCE: "2"}},
+            }],
+        },
+        "status": {"phase": "Running"},
+    }
+    api.add_pod(pod)
+    controller._handle_update(client.get_pod("ml", "w0"))
+    node_audit = audit.NodeAudit(
+        plugin,
+        controller=controller,
+        client=client,
+        node_name=NODE,
+        checkpoint_path=checkpoint_path,
+        podres=controller.podres,
+    )
+    engine = node_audit.engine(interval_s=60)
+    try:
+        yield {
+            "api": api, "client": client, "podres": podres,
+            "plugin": plugin, "controller": controller, "mesh": mesh,
+            "engine": engine, "pod": pod, "want": want,
+            "checkpoint_path": checkpoint_path,
+        }
+    finally:
+        controller.podres.close()
+        podres.stop()
+        api.stop()
+
+
+def _sweep(engine):
+    return engine.sweep_once()
+
+
+def test_e2e_clean_cluster_zero_findings_across_two_sweeps(node_stack):
+    engine = node_stack["engine"]
+    assert _sweep(engine) == []
+    assert _sweep(engine) == []
+    assert metrics.AUDIT_FINDINGS.series() == []
+    assert metrics.AUDIT_SWEEPS.get(outcome="clean") >= 2
+    snap = engine.snapshot()
+    assert snap["errors"] == {}
+    assert {i["name"] for i in snap["invariants"]} == {
+        "checkpoint_vs_podresources", "annotation_vs_kubelet",
+        "attribution_vs_kubelet", "gauge_vs_state", "orphaned_chip",
+    }
+
+
+def test_e2e_stale_annotation_fires_and_clears(node_stack):
+    engine = node_stack["engine"]
+    api = node_stack["api"]
+    pod = node_stack["pod"]
+    want = node_stack["want"]
+    assert _sweep(engine) == []
+    good = pod["metadata"]["annotations"][
+        constants.POD_DEVICES_ANNOTATION
+    ]
+    # Hand-corrupt the annotation plane: drop one chip from it.
+    pod["metadata"]["annotations"][
+        constants.POD_DEVICES_ANNOTATION
+    ] = want[0]
+    api.update_pod(pod)
+    findings = _sweep(engine)
+    assert _invariant_names(findings) == {"annotation_vs_kubelet"}
+    (f,) = findings
+    assert f.pod == "ml/w0" and f.severity == audit.WARNING
+    assert want[1] in dict(f.details)["kubelet"]
+    assert metrics.AUDIT_FINDINGS.get(
+        invariant="annotation_vs_kubelet", severity="warning"
+    ) == 1
+    # An annotation naming a chip NO mesh generation knows is the same
+    # drift class — it must not be filtered out of the comparison.
+    pod["metadata"]["annotations"][
+        constants.POD_DEVICES_ANNOTATION
+    ] = f"{good},tpu-ghost-generation"
+    api.update_pod(pod)
+    findings = _sweep(engine)
+    assert _invariant_names(findings) == {"annotation_vs_kubelet"}
+    assert "tpu-ghost-generation" in dict(findings[0].details)[
+        "annotation"
+    ]
+    # Repair → clears (and the gauge series prunes).
+    pod["metadata"]["annotations"][
+        constants.POD_DEVICES_ANNOTATION
+    ] = good
+    api.update_pod(pod)
+    assert _sweep(engine) == []
+    assert metrics.AUDIT_FINDINGS.series() == []
+
+
+def test_e2e_orphaned_chip_fires_and_clears(node_stack):
+    engine = node_stack["engine"]
+    podres = node_stack["podres"]
+    mesh = node_stack["mesh"]
+    RECORDER.enable(service="plugin")
+    LEDGER.enable(service="plugin")
+    assert _sweep(engine) == []
+    # The kubelet holds a chip for a pod the apiserver never heard of.
+    podres.set_pod("ml", "ghost", RESOURCE, [mesh.ids[3]])
+    findings = _sweep(engine)
+    assert _invariant_names(findings) == {"orphaned_chip"}
+    (f,) = findings
+    assert f.severity == audit.CRITICAL
+    assert f.pod == "ml/ghost"
+    assert mesh.ids[3] in dict(f.details)["chips"]
+    # Ledger + flight lockstep on the detection.
+    assert LEDGER.query(kind="audit_divergence")[0]["reason"] == (
+        "orphaned_chip"
+    )
+    assert [
+        e["attrs"]["state"]
+        for e in RECORDER.snapshot()["events"]
+        if e["kind"] == "audit_divergence"
+    ] == ["detected"]
+    podres.set_pod("ml", "ghost", RESOURCE, [])
+    assert _sweep(engine) == []
+
+
+def test_e2e_attribution_drift_fires_and_clears(node_stack):
+    engine = node_stack["engine"]
+    controller = node_stack["controller"]
+    mesh = node_stack["mesh"]
+    assert _sweep(engine) == []
+    # Corrupt the attribution plane: a chip attributed to a pod the
+    # kubelet never assigned it to.
+    controller._record_attribution(
+        {"namespace": "ml", "name": "phantom"}, [mesh.ids[1]]
+    )
+    findings = _sweep(engine)
+    assert _invariant_names(findings) == {"attribution_vs_kubelet"}
+    (f,) = findings
+    assert f.chip == mesh.ids[1]
+    assert f.pod == "ml/phantom"
+    assert dict(f.details)["kubelet_pod"] == "ml/w0"
+    # Repair: the real holder's reconcile path re-records it.
+    controller._record_attribution(
+        {"namespace": "ml", "name": "w0"}, [mesh.ids[1]],
+        {mesh.ids[1]: "main"},
+    )
+    assert _sweep(engine) == []
+
+
+def test_e2e_skewed_gauge_fires_and_clears(node_stack):
+    engine = node_stack["engine"]
+    plugin = node_stack["plugin"]
+    assert _sweep(engine) == []
+    # Skew the metrics plane by hand (the failure mode: a gauge update
+    # path that silently stopped firing).
+    metrics.CHIPS.set(99, state="available")
+    findings = _sweep(engine)
+    assert _invariant_names(findings) == {"gauge_vs_state"}
+    (f,) = findings
+    assert dict(f.details)["state"] == "available"
+    assert dict(f.details)["expected"] == "4"
+    # A frozen emptied series is the same drift class.
+    plugin._update_chip_gauges()
+    assert _sweep(engine) == []
+    metrics.CHIPS.set(0, state="unhealthy")  # lingering zero series
+    findings = _sweep(engine)
+    assert _invariant_names(findings) == {"gauge_vs_state"}
+    assert "stale series" in findings[0].message
+    plugin._update_chip_gauges()
+    assert _sweep(engine) == []
+
+
+def test_e2e_checkpoint_podresources_divergence(node_stack):
+    engine = node_stack["engine"]
+    mesh = node_stack["mesh"]
+    path = node_stack["checkpoint_path"]
+    assert _sweep(engine) == []
+    # A checkpoint file naming a different chip set than PodResources.
+    with open(path, "w") as f:
+        json.dump({"Data": {"PodDeviceEntries": [{
+            "PodUID": "uid-w0", "ContainerName": "main",
+            "ResourceName": RESOURCE,
+            "DeviceIDs": [mesh.ids[0], mesh.ids[2]],
+        }]}}, f)
+    findings = _sweep(engine)
+    assert _invariant_names(findings) == {"checkpoint_vs_podresources"}
+    details = [dict(f.details) for f in findings]
+    assert any(
+        mesh.ids[1] in d.get("only_in_podresources", "") for d in details
+    )
+    assert any(
+        mesh.ids[2] in d.get("only_in_checkpoint", "") for d in details
+    )
+    os.unlink(path)
+    assert _sweep(engine) == []
+
+
+def test_node_audit_without_apiserver_skips_not_errors(node_stack):
+    """No kube client (unit environments): the apiserver-joined
+    invariants contribute nothing — silently, not as sweep errors."""
+    plugin = node_stack["plugin"]
+    controller = node_stack["controller"]
+    na = audit.NodeAudit(
+        plugin, controller=controller, client=None, node_name=NODE,
+        checkpoint_path=node_stack["checkpoint_path"],
+        podres=controller.podres,
+    )
+    engine = na.engine(interval_s=60)
+    assert engine.sweep_once() == []
+    assert engine.snapshot()["errors"] == {}
+
+
+def test_node_audit_apiserver_down_is_a_sweep_error(node_stack):
+    """Client configured but unreachable: the joined invariants raise
+    — visible as outcome=error, never silence."""
+    class _DownClient:
+        def list_pods(self, **kw):
+            raise OSError("connection refused")
+
+    bad = _DownClient()
+    controller = node_stack["controller"]
+    na = audit.NodeAudit(
+        node_stack["plugin"], controller=controller, client=bad,
+        node_name=NODE,
+        checkpoint_path=node_stack["checkpoint_path"],
+        podres=controller.podres,
+    )
+    engine = na.engine(interval_s=60)
+    engine.sweep_once()
+    errs = engine.snapshot()["errors"]
+    assert "annotation_vs_kubelet" in errs
+    assert "orphaned_chip" in errs
+    assert "gauge_vs_state" not in errs  # local planes still audited
+
+
+# -- the extender-side invariants --------------------------------------------
+
+def _topo_json(tmp_path, name, count=4, available=None):
+    accel, dev = fakes.make_fake_tpu_node(
+        str(tmp_path / name), "v5e", count
+    )
+    chips = PyTpuInfo().scan(accel, dev)
+    mesh = IciMesh(chips)
+    return NodeTopology.from_mesh(
+        mesh, hostname=name,
+        available=available if available is not None else mesh.ids,
+    ).to_json()
+
+
+@pytest.fixture
+def extender_stack(tmp_path):
+    from k8s_device_plugin_tpu.extender.gang import (
+        GANG_SIZE_LABEL,
+        GangAdmission,
+    )
+
+    api = FakeApiServer()
+    api_url = api.start()
+    client = KubeClient(api_url)
+    reservations = ReservationTable()
+    journal = AdmissionJournal(str(tmp_path / "journal"))
+    reservations.observer = journal.observe
+    index = TopologyIndex()
+    index.update("node-a", _topo_json(tmp_path, "node-a"))
+    index.update("node-b", _topo_json(tmp_path, "node-b"))
+    gang = GangAdmission(
+        client, reservations=reservations, journal=journal,
+        topo_source=index.topologies,
+    )
+    ext_audit = audit.ExtenderAudit(
+        reservations=reservations, journal=journal, gang=gang,
+        index=index,
+    )
+    engine = ext_audit.engine(interval_s=60)
+
+    def add_gang_pod(gang_name, name, gated=False, node=""):
+        pod = {
+            "metadata": {
+                "name": name, "namespace": "default",
+                "uid": f"uid-{name}",
+                "labels": {
+                    constants.GANG_NAME_LABEL: gang_name,
+                    GANG_SIZE_LABEL: "2",
+                },
+            },
+            "spec": {
+                "containers": [{
+                    "name": "main",
+                    "resources": {"requests": {RESOURCE: "2"}},
+                }],
+            },
+        }
+        if gated:
+            pod["spec"]["schedulingGates"] = [
+                {"name": "tpu.google.com/gang"}
+            ]
+        if node:
+            pod["spec"]["nodeName"] = node
+        api.add_pod(pod)
+        return pod
+
+    try:
+        yield {
+            "api": api, "client": client, "reservations": reservations,
+            "journal": journal, "gang": gang, "index": index,
+            "engine": engine, "add_gang_pod": add_gang_pod,
+        }
+    finally:
+        journal.close()
+        api.stop()
+
+
+def test_extender_clean_and_leaked_reservation(extender_stack):
+    s = extender_stack
+    engine = s["engine"]
+    assert engine.sweep_once() == []
+    snap = engine.snapshot()
+    assert {i["name"] for i in snap["invariants"]} == {
+        "reservation_vs_journal", "reservation_vs_cluster",
+        "gate_vs_hold", "placeable_recount",
+    }
+    # A hold for a gang with no pods anywhere = leaked reservation.
+    s["reservations"].reserve(
+        ("default", "ghost-gang"), {"node-a": 2}, demands=(2,)
+    )
+    findings = engine.sweep_once()
+    assert _invariant_names(findings) == {"reservation_vs_cluster"}
+    (f,) = findings
+    assert f.gang == "default/ghost-gang"
+    s["reservations"].drop(("default", "ghost-gang"))
+    assert engine.sweep_once() == []
+
+
+def test_extender_reservation_on_vanished_node(extender_stack):
+    s = extender_stack
+    engine = s["engine"]
+    # Gang pods exist (released + scheduled elsewhere is irrelevant —
+    # the hold's HOST is what vanished).
+    s["add_gang_pod"]("train", "train-w0", node="node-a")
+    s["add_gang_pod"]("train", "train-w1", node="node-a")
+    s["reservations"].reserve(
+        ("default", "train"), {"node-gone": 2}, demands=(2,)
+    )
+    findings = engine.sweep_once()
+    assert _invariant_names(findings) == {"reservation_vs_cluster"}
+    (f,) = findings
+    assert f.node == "node-gone"
+    s["reservations"].drop(("default", "train"))
+    assert engine.sweep_once() == []
+
+
+def test_extender_journal_divergence_fires_critical(extender_stack):
+    s = extender_stack
+    engine = s["engine"]
+    # Gang pods exist and are placed, so cluster/gate invariants stay
+    # quiet and the journal plane is isolated.
+    s["add_gang_pod"]("train", "train-w0", node="node-a")
+    s["add_gang_pod"]("train", "train-w1", node="node-a")
+    # Detach the observer: the table mutates, the journal never hears
+    # — exactly the drift class a wiring regression would cause.
+    s["reservations"].observer = None
+    s["reservations"].reserve(
+        ("default", "train"), {"node-a": 4}, demands=(2, 2)
+    )
+    findings = engine.sweep_once()
+    assert _invariant_names(findings) == {"reservation_vs_journal"}
+    (f,) = findings
+    assert f.severity == audit.CRITICAL
+    assert f.gang == "default/train"
+    # Re-attach + re-reserve (journals it) → agreement again.
+    s["reservations"].observer = s["journal"].observe
+    s["reservations"].reserve(
+        ("default", "train"), {"node-a": 4}, demands=(2, 2)
+    )
+    assert engine.sweep_once() == []
+    # The inverse direction: a journal-only hold is conservative →
+    # warning, not critical.
+    s["reservations"].observer = None
+    s["reservations"].drop(("default", "train"))
+    findings = engine.sweep_once()
+    assert _invariant_names(findings) == {"reservation_vs_journal"}
+    assert findings[0].severity == audit.WARNING
+    s["reservations"].observer = s["journal"].observe
+
+
+def test_extender_gate_vs_hold(extender_stack):
+    s = extender_stack
+    engine = s["engine"]
+    # Released, unscheduled, TPU-demanding gang with NO hold and no
+    # lapse bar: the steal window is open.
+    s["add_gang_pod"]("naked", "naked-w0")
+    s["add_gang_pod"]("naked", "naked-w1")
+    findings = engine.sweep_once()
+    assert _invariant_names(findings) == {"gate_vs_hold"}
+    (f,) = findings
+    assert f.severity == audit.CRITICAL
+    assert f.gang == "default/naked"
+    assert "naked-w0" in dict(f.details)["pods"]
+    # A lapse bar legitimizes the unfenced state (gates cannot be
+    # re-added past the cap) — the finding clears.
+    s["gang"]._lapsed_gangs.add(("default", "naked"))
+    assert engine.sweep_once() == []
+    # The inverse shape: fully-gated gang with a standing hold = a
+    # release pass that failed wholesale (warning; release_retry
+    # finishes it).
+    s["add_gang_pod"]("stuck", "stuck-w0", gated=True)
+    s["add_gang_pod"]("stuck", "stuck-w1", gated=True)
+    s["reservations"].reserve(
+        ("default", "stuck"), {"node-b": 4}, demands=(2, 2)
+    )
+    findings = engine.sweep_once()
+    assert _invariant_names(findings) == {"gate_vs_hold"}
+    assert findings[0].severity == audit.WARNING
+    assert findings[0].gang == "default/stuck"
+
+
+def test_extender_placeable_recount(extender_stack):
+    s = extender_stack
+    engine = s["engine"]
+    index = s["index"]
+    assert engine.sweep_once() == []
+    # Corrupt the gauge plane by hand: the recount must catch it.
+    metrics.EXT_PLACEABLE_NODES.set(99, size="4")
+    findings = engine.sweep_once()
+    assert _invariant_names(findings) == {"placeable_recount"}
+    assert "gauge" in dict(findings[0].details)
+    metrics.EXT_PLACEABLE_NODES.set(2, size="4")
+    assert engine.sweep_once() == []
+    # Corrupt a cached entry: both the aggregate and the sampled
+    # from-scratch recompute disagree with it.
+    entry = index.get("node-a")
+    index._entries["node-a"] = dataclasses.replace(
+        entry, placeable=(1,)
+    )
+    findings = engine.sweep_once()
+    assert _invariant_names(findings) == {"placeable_recount"}
+    assert any(f.node == "node-a" for f in findings)
+    index._entries["node-a"] = entry
+    assert engine.sweep_once() == []
+
+
+# -- wiring ------------------------------------------------------------------
+
+def test_supervisor_flag_and_auditor_lifecycle(tmp_path):
+    from k8s_device_plugin_tpu.supervisor.main import (
+        Daemon,
+        DaemonConfig,
+        parse_args,
+    )
+
+    cfg = parse_args(["--audit-interval-s", "45"])
+    assert cfg.audit_interval_s == 45.0
+    assert parse_args([]).audit_interval_s == 0.0  # off by default
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 4)
+    daemon = Daemon(
+        DaemonConfig(
+            device_plugin_dir=str(tmp_path / "dp"),
+            sysfs_accel_dir=accel,
+            dev_dir=dev,
+            libtpu_host_path="",
+            enable_controller=False,
+            audit_interval_s=60.0,
+        )
+    )
+    chips = daemon.discover()
+    from k8s_device_plugin_tpu.server.plugin import (
+        PluginConfig,
+        TpuDevicePlugin,
+    )
+
+    daemon.plugin = TpuDevicePlugin(
+        IciMesh(chips), config=PluginConfig(libtpu_host_path="")
+    )
+    daemon._start_audit()
+    try:
+        assert daemon.auditor is not None
+        assert audit.ENGINE is daemon.auditor
+        # Build identity published at daemon construction.
+        assert metrics.BUILD_INFO.series()
+    finally:
+        daemon.plugin = None
+        daemon.teardown()
+    assert daemon.auditor is None
+    assert audit.ENGINE is None
+    # interval 0 = no auditor at all (the disabled no-op contract).
+    daemon.cfg.audit_interval_s = 0.0
+    daemon._start_audit()
+    assert daemon.auditor is None
+
+
+def test_gang_loop_drives_maybe_sweep(extender_stack):
+    """The extender wiring: the admission loop calls the installed
+    auditor after each tick (the journal's writer thread)."""
+    s = extender_stack
+    gang = s["gang"]
+    gang.auditor = s["engine"]
+    gang.resync_interval_s = 0.05
+    gang.start()
+    try:
+        import time as _time
+
+        deadline = _time.time() + 5
+        while s["engine"].snapshot()["sweeps"] == 0 and (
+            _time.time() < deadline
+        ):
+            _time.sleep(0.02)
+        assert s["engine"].snapshot()["sweeps"] >= 1
+    finally:
+        gang.stop()
+
+
+# -- tpu-doctor --------------------------------------------------------------
+
+def test_doctor_self_test(capsys):
+    from k8s_device_plugin_tpu.tools import doctor
+
+    assert doctor.main(["--self-test"]) == 0
+    assert "tpu-doctor self-test: OK" in capsys.readouterr().out
+
+
+def test_doctor_check_from_file_and_bundle(tmp_path, capsys):
+    from k8s_device_plugin_tpu.tools import doctor
+
+    engine = audit.AuditEngine(
+        "extender",
+        [audit.Invariant(
+            "reservation_vs_journal", ("reservations", "journal"),
+            "test",
+            lambda: [audit.Finding.make(
+                "reservation_vs_journal", audit.CRITICAL,
+                "hold not journaled", gang="default/train",
+            )],
+        )],
+        interval_s=60,
+    )
+    audit.install_engine(engine)
+    engine.sweep_once()
+    # Offline check from a saved audit.json (a bundle member).
+    snap = audit.debug_snapshot()
+    path = tmp_path / "audit.json"
+    path.write_text(json.dumps(snap))
+    assert doctor.main(["check", str(path)]) == 1  # findings → 1
+    out = capsys.readouterr().out
+    assert "reservation_vs_journal" in out
+    assert "gang=default/train" in out
+    # Live bundle over a real server, with journal metadata.
+    jdir = tmp_path / "jr"
+    j = AdmissionJournal(str(jdir))
+    j.record("reserve", ("default", "train"), hosts={"n1": 2}, age_s=0)
+    j.close()
+    srv = metrics.MetricsServer(host="127.0.0.1")
+    url = srv.start()
+    try:
+        out_path, manifest = doctor.bundle(
+            [url],
+            out_path=str(tmp_path / "b.tar.gz"),
+            journal_dir=str(jdir),
+        )
+    finally:
+        srv.stop()
+    with tarfile.open(out_path) as tar:
+        names = set(tar.getnames())
+        assert "manifest.json" in names
+        assert any(n.endswith("/metrics.txt") for n in names)
+        assert any(n.endswith("/audit.json") for n in names)
+        assert any(n.endswith("/debug-index.json") for n in names)
+    assert manifest["journal"]["status"] == "clean"
+    assert manifest["journal"]["records_past_snapshot"] == 1
+    assert manifest["sources"][0]["build"]["component"] == "extender"
+    # The read-only metadata pass did NOT heal/mutate the journal.
+    assert manifest["journal"]["files"]["admission.journal"][
+        "size_bytes"
+    ] > 0
+
+
+def test_doctor_unreachable_source_exits_2(capsys):
+    from k8s_device_plugin_tpu.tools import doctor
+
+    assert doctor.main(
+        ["check", "--url", "http://127.0.0.1:1"]
+    ) == 2
+    assert "UNREACHABLE" in capsys.readouterr().out
+
+
+# -- read-only journal replay ------------------------------------------------
+
+def test_replay_readonly_matches_replay_without_side_effects(tmp_path):
+    d = str(tmp_path / "j")
+    j = AdmissionJournal(d)
+    key = ("default", "train")
+    j.record("reserve", key, hosts={"n1": 4}, demands=[2, 2], age_s=0.0)
+    j.record("shrink", key, pod="w0", host="n1", chips=2)
+    j.flush()
+    before_rehydrations = sum(
+        v for _, v in metrics.STATE_REHYDRATIONS.series()
+    )
+    ro = j.replay_readonly()
+    assert ro.holds[key].hosts == {"n1": 2}
+    assert ro.status == "clean"
+    # No rehydration metrics, no writer-side effects.
+    assert sum(
+        v for _, v in metrics.STATE_REHYDRATIONS.series()
+    ) == before_rehydrations
+    # A torn tail reads identically (intact prefix) WITHOUT healing
+    # the file — the owner's load() does that, not the auditor.
+    j.record("drop", key)
+    j.flush()
+    size = os.path.getsize(j.store.journal_path)
+    with open(j.store.journal_path, "rb+") as f:
+        f.truncate(size - 5)
+    ro = j.replay_readonly()
+    assert ro.status == "torn_tail"
+    assert key in ro.holds  # the torn drop never committed
+    assert os.path.getsize(j.store.journal_path) == size - 5  # unhealed
+    j.close()
+
+
+# -- docs / deploy / CI lockstep ---------------------------------------------
+
+def test_audit_docs_in_lockstep_with_code():
+    """docs/observability.md must document every registered invariant
+    (node + extender sets), the /debug/audit and /debug index
+    endpoints, and the severities; metrics.md the new families;
+    operations.md the drift runbook; tier1/deploy/grafana the wiring."""
+    obs = open(os.path.join(REPO, "docs", "observability.md")).read()
+    node_names = {
+        i.name
+        for i in audit.NodeAudit(plugin=None).invariants()
+    }
+    sentinel = object()
+    ext_names = {
+        i.name
+        for i in audit.ExtenderAudit(
+            reservations=sentinel, journal=sentinel, gang=sentinel,
+            index=sentinel,
+        ).invariants()
+    }
+    assert node_names and ext_names
+    for name in node_names | ext_names:
+        assert f"`{name}`" in obs, name
+    for needle in (
+        "/debug/audit", "GET /debug", "--audit-interval-s",
+        "`audit_divergence`", "tpu-doctor", "audit_critical",
+    ):
+        assert needle in obs, needle
+    mets = open(os.path.join(REPO, "docs", "metrics.md")).read()
+    for fam in (
+        "tpu_audit_findings", "tpu_audit_sweeps_total",
+        "tpu_audit_sweep_seconds",
+        "tpu_audit_last_clean_sweep_timestamp", "tpu_build_info",
+    ):
+        assert f"`{fam}`" in mets, fam
+    ops = open(os.path.join(REPO, "docs", "operations.md")).read()
+    assert "State drift: reading `tpu-doctor check`" in ops
+    tier1 = open(os.path.join(REPO, "scripts", "tier1.sh")).read()
+    assert "tools.doctor --self-test" in tier1
+    for deploy in ("tpu-device-plugin.yml", "tpu-extender.yml"):
+        text = open(os.path.join(REPO, "deploy", deploy)).read()
+        assert "--audit-interval-s" in text, deploy
+    dash = open(
+        os.path.join(REPO, "deploy", "grafana-dashboard.json")
+    ).read()
+    assert "Consistency audit" in dash
+    assert "tpu_audit_findings" in dash

@@ -230,6 +230,59 @@ def test_telemetry_overhead_probe_bound_and_schema():
     assert r["sampler_tick"]["p99_ms"] < 100
 
 
+def test_audit_overhead_probe_bound_and_schema():
+    """ISSUE 8 acceptance: with the consistency auditor wired — engine
+    built over a REAL on-disk journal + standing holds + the topology
+    index, sweeps running between RPCs exactly where the admission
+    loop runs them — the indexed /filter p99 stays ≤1.05× the
+    audit-free control arm (+ the suite's 0.3 ms timer-noise floor,
+    101 samples so one OS-scheduler spike can't be the p99). The
+    sweep's own cost is documented, not bounded — it never shares a
+    thread with an RPC — but must stay sane and find NOTHING on the
+    undrifted fixtures (a false positive here would page someone)."""
+    from k8s_device_plugin_tpu import telemetry
+    from k8s_device_plugin_tpu.utils import metrics
+
+    saved_provider = telemetry.CLUSTER_PROVIDER
+
+    def probe():
+        return scale_bench.audit_overhead(
+            n_nodes=60, n_holds=10, filter_calls=101, sweep_every=10,
+            sweep_rounds=5,
+        )
+
+    def violations(r):
+        base = r["control"]["filter"]["p99_ms"]
+        got = r["audited"]["filter"]["p99_ms"]
+        if got > 1.05 * base + 0.3:
+            return [
+                f"filter: audited p99 {got}ms vs control {base}ms "
+                f"(bound 1.05x + 0.3ms noise floor)"
+            ]
+        return []
+
+    r = probe()
+    failures = violations(r)
+    if failures:
+        # The suite-wide host-contention convention: one full re-run;
+        # a real sweep-induced slowdown fails both complete runs.
+        r = probe()
+        failures = violations(r)
+    assert not failures, failures
+    # Probe hygiene: provider restored, no synthetic series left.
+    assert telemetry.CLUSTER_PROVIDER is saved_provider
+    assert metrics.EXT_PLACEABLE_NODES.series() == []
+    assert metrics.EXT_AUDIT_FINDINGS.series() == []
+    assert r["nodes"] == 60 and r["holds"] == 10
+    for arm in ("control", "audited"):
+        assert r[arm]["filter"]["samples"] == 101
+    assert r["sweep"]["samples"] == 5
+    # Each sweep replays the journal + recounts the index; even so it
+    # stays well under a second on a loaded CI host.
+    assert r["sweep"]["p99_ms"] < 1000
+    assert "filter_p99_overhead_pct" in r
+
+
 def test_scale_bench_correctness_assertions_fire():
     """run() itself asserts every node passes the all-free filter on
     BOTH paths (indexed and full-object), every gang releases in the
