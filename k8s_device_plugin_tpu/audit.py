@@ -54,7 +54,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from .api import constants
 from .kube import checkpoint as ckpt
-from .topology.placement import fragmentation_stats
+from .topology.placement import placeable_sizes
 from .utils import metrics
 from .utils.decisions import LEDGER
 from .utils.flightrecorder import RECORDER
@@ -1072,10 +1072,10 @@ class ExtenderAudit:
             if e.name in seen or e.topo is None:
                 continue
             seen.add(e.name)
-            stats = fragmentation_stats(e.topo.to_mesh(), e.topo.available)
-            fresh = tuple(
-                n for n, ok in sorted(stats["placeable"].items()) if ok
-            )
+            # The ONE shared derivation (placement.placeable_sizes) the
+            # index itself uses — this recount proves the cached tuple,
+            # not a re-spelled formula.
+            fresh = placeable_sizes(e.topo.to_mesh(), e.topo.available)
             if fresh != e.placeable:
                 out.append(Finding.make(
                     "placeable_recount", WARNING,

@@ -51,6 +51,30 @@ def main() -> int:
         "docs/operations.md)",
     )
     p.add_argument(
+        "--index-snapshot-dir",
+        default=os.environ.get("TPU_INDEX_SNAPSHOT_DIR", ""),
+        help="directory for the persisted topology-index snapshot "
+        "(checksummed derived state, content-addressed per node by "
+        "annotation hash): on restart, nodes whose annotation is "
+        "unchanged restore without re-parsing and time-to-ready is "
+        "O(changed nodes) instead of O(cluster); the background warm "
+        "pool re-parses the rest off the critical path. Empty (the "
+        "default) pays the full parse on every start. Needs "
+        "--node-cache",
+    )
+    p.add_argument(
+        "--index-warm-workers", type=int, default=2,
+        help="worker threads that materialize snapshot-restored index "
+        "entries in the background after a cold start (0 disables the "
+        "pool; entries still parse on first demand)",
+    )
+    p.add_argument(
+        "--node-event-coalesce-s", type=float, default=0.25,
+        help="coalesce node watch events for this long and apply the "
+        "latest event per node (one rebuild per node per tick under "
+        "annotation republish storms). 0 applies every event inline",
+    )
+    p.add_argument(
         "--gang-full-sweep-s", type=float, default=60.0,
         help="gang admission full-sweep backstop interval: resyncs in "
         "between are dirty ticks that evaluate only event-marked "
@@ -159,7 +183,11 @@ def main() -> int:
 
     tpumetrics.set_build_info("extender")
     from .reservations import ReservationTable
-    from .server import NodeAnnotationCache, TopologyExtender
+    from .server import (
+        NodeAnnotationCache,
+        ReadyStatus,
+        TopologyExtender,
+    )
 
     # One reservation table wires the two halves together: what the
     # gang admitter reserves before releasing gates, the extender's
@@ -167,6 +195,18 @@ def main() -> int:
     reservations = ReservationTable()
     client = None
     node_cache = None
+    # Readiness gate + phase tracker: with a journal configured,
+    # /filter+/prioritize (and /readyz) answer 503 until the admission
+    # state is replayed and reconciled below; /readyz carries the
+    # phase (replaying|warming|ready) and the index warm progress so a
+    # stuck start is diagnosable from the probe alone. Created FIRST
+    # so time-to-ready covers the whole startup, relist included.
+    ready = threading.Event()
+    status = ReadyStatus(
+        ready,
+        journal_configured=bool(a.journal_dir and a.gang_admission),
+    )
+    tpumetrics.READYZ_PROVIDER = status.snapshot
     if a.node_cache or a.gang_admission:
         from ..kube.client import KubeClient
         from ..utils import resilience
@@ -184,7 +224,11 @@ def main() -> int:
             interval_s=a.node_cache_interval_s,
             watch=not a.no_node_watch,
             watch_backstop_s=a.node_relist_backstop_s,
+            snapshot_dir=a.index_snapshot_dir,
+            warm_workers=a.index_warm_workers,
+            event_coalesce_s=a.node_event_coalesce_s,
         ).start()
+        status.warm_progress = node_cache.index.warm_progress
     # The pre-warmed parse/mesh cache (and everything else alive at
     # startup) leaves the GC scan set: a gen2 pass over the ~1M
     # long-lived objects behind 1,000 parsed topologies measured as an
@@ -240,11 +284,6 @@ def main() -> int:
                 e,
             )
             return 1
-    # Readiness gate: with a journal configured, /filter+/prioritize
-    # (and /readyz) answer 503 until the admission state is replayed
-    # and reconciled below — the scheduler must not score nodes
-    # against a capacity view missing the crashed incarnation's holds.
-    ready = threading.Event()
     srv = ExtenderHTTPServer(
         extender=TopologyExtender(
             reservations=reservations, node_cache=node_cache
@@ -253,6 +292,7 @@ def main() -> int:
         port=a.port,
         identity=leader.identity if leader else "",
         ready_check=ready.is_set,
+        ready_status=status.snapshot,
     )
     srv.start()
     gang = None
@@ -301,9 +341,12 @@ def main() -> int:
         # singleton lease is already held (leadership precedes replay —
         # the journal has one writer), and recover() never raises (an
         # empty/absent/corrupt journal degrades to the cluster-truth
-        # rebuild the unjournaled daemon always did).
+        # rebuild the unjournaled daemon always did). The index warm
+        # pool (node_cache.start above) runs CONCURRENTLY with this
+        # replay — neither serializes behind the other.
         gang.recover()
         gang.start()
+    status.mark_replayed()
     auditor = None
     if a.audit_interval_s > 0:
         from .. import audit
@@ -338,7 +381,9 @@ def main() -> int:
                 # safe on its own thread (entries are immutable,
                 # gauges atomic).
                 auditor.start()
-    ready.set()
+    # Ready: time-to-ready (the failover-outage window) is published as
+    # tpu_extender_time_to_ready_seconds and in the /readyz body.
+    status.mark_ready()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()

@@ -31,12 +31,14 @@ values); mutations serialize on one lock.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import hashlib
 import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from .. import telemetry
-from ..topology.placement import fragmentation_stats
+from ..topology.placement import placeable_sizes
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..utils import metrics
 from ..utils.logging import get_logger
@@ -44,6 +46,58 @@ from ..utils.logging import get_logger
 log = get_logger(__name__)
 
 SliceKey = Tuple[str, ...]
+
+# Bump when the derived-entry shape changes (new field, different
+# placeable semantics): a persisted snapshot from another version is
+# ignored wholesale — a full parse is always correct, a stale derived
+# record never is.
+INDEX_SNAPSHOT_VERSION = 1
+
+
+def annotation_hash(raw: str) -> str:
+    """Content address of one annotation string. The invalidation key
+    the persisted index snapshot is keyed by (per node), and the
+    derived-entry memo's cache key. A cryptographic digest, not crc32:
+    a collision here would install ANOTHER node's derived state as this
+    node's truth, so the 2^64 birthday margin is load-bearing."""
+    return hashlib.blake2b(raw.encode(), digest_size=16).hexdigest()
+
+
+# Content-addressed derived-entry memo: annotation hash → the derived
+# numbers an IndexEntry carries beyond the parsed topo (avail/chips/
+# hostname/slice/placeable — pure functions of the annotation string).
+# Shared process-wide (module-level, like schema's parse LRU) by the
+# cold warm path, watch-driven rebuilds, and snapshot restore, so a
+# flip-flopping annotation (A→B→A republish storms) or an identical
+# annotation re-seen anywhere never recomputes fragmentation stats.
+# Bounded LRU; entries are plain dicts treated as immutable.
+_DERIVED_MEMO_MAX = 8192
+_DERIVED_MEMO: "collections.OrderedDict[str, dict]" = (
+    collections.OrderedDict()
+)
+_DERIVED_LOCK = threading.Lock()
+
+
+def _derived_lookup(h: str) -> Optional[dict]:
+    with _DERIVED_LOCK:
+        rec = _DERIVED_MEMO.get(h)
+        if rec is not None:
+            _DERIVED_MEMO.move_to_end(h)
+        return rec
+
+
+def _derived_store(h: str, rec: dict) -> None:
+    with _DERIVED_LOCK:
+        _DERIVED_MEMO[h] = rec
+        _DERIVED_MEMO.move_to_end(h)
+        while len(_DERIVED_MEMO) > _DERIVED_MEMO_MAX:
+            _DERIVED_MEMO.popitem(last=False)
+
+
+def clear_derived_memo() -> None:
+    """Flush the memo (benches measuring true cold costs; tests)."""
+    with _DERIVED_LOCK:
+        _DERIVED_MEMO.clear()
 
 
 def clone_topology(t: NodeTopology) -> NodeTopology:
@@ -77,11 +131,33 @@ class IndexEntry:
     hostname: str = ""
     slice_key: Optional[SliceKey] = None  # None = standalone host
     # Power-of-two request sizes a contiguous free box currently fits
-    # for, derived at entry build (topology/placement.fragmentation_
-    # stats over the published availability) — the per-node term of the
+    # for, derived at entry build (topology/placement.placeable_sizes
+    # over the published availability) — the per-node term of the
     # cluster capacity aggregate (tpu_extender_placeable_nodes); costs
     # nothing on the RPC path, a few bitmask tests per REBUILD.
     placeable: Tuple[int, ...] = ()
+    # True for a snapshot-restored entry whose parse is DEFERRED: the
+    # derived fields above are live (hash-validated against the node's
+    # current annotation), ``topo`` is None until ensure_parsed
+    # materializes it — on first RPC demand or the cold-start warm
+    # pool, whichever comes first. Consumers that need ``topo`` go
+    # through ensure_parsed/topologies(); integer-count consumers
+    # (/filter capacity checks, the placeable aggregate, audit's
+    # aggregate recount) read a deferred entry as-is.
+    deferred: bool = False
+
+    def derived_record(self) -> dict:
+        """The persistable/memoizable derived-state record (everything
+        but the parsed topo), keyed externally by annotation hash."""
+        if self.topo is None and not self.deferred:
+            return {"bad": True}
+        return {
+            "avail": self.avail,
+            "chips": self.chip_count,
+            "host": self.hostname,
+            "slice": list(self.slice_key) if self.slice_key else None,
+            "placeable": list(self.placeable),
+        }
 
 
 class TopologyIndex:
@@ -114,6 +190,15 @@ class TopologyIndex:
         # control arm (scale_bench.telemetry_overhead).
         self.track_placeable = track_placeable
         self._placeable_counts: Dict[int, int] = {}
+        # Names of installed entries whose parse is deferred (snapshot
+        # restore) — the cold-start warm pool's work queue and the
+        # /readyz warm-progress denominator's pending half.
+        self._deferred: Set[str] = set()
+        # Monotonic mutation counter (restore/update/remove that
+        # actually changed an entry): the snapshot writer skips a write
+        # when nothing moved since the last one. Materializing a
+        # deferred entry does NOT bump it — derived state is unchanged.
+        self.generation = 0
         # /debug/telemetry's cluster panel reads the latest-constructed
         # index of this process (one per extender daemon).
         telemetry.CLUSTER_PROVIDER = self.placeable_snapshot
@@ -124,14 +209,11 @@ class TopologyIndex:
         if not self.track_placeable or topo is None:
             return ()
         try:
-            stats = fragmentation_stats(topo.to_mesh(), topo.available)
+            return placeable_sizes(topo.to_mesh(), topo.available)
         except Exception:  # noqa: BLE001 — a weird annotation costs its
             # node's aggregate term, never index maintenance
             log.exception("placeable-size derivation failed")
             return ()
-        return tuple(
-            n for n, ok in sorted(stats["placeable"].items()) if ok
-        )
 
     def _adjust_placeable_locked(
         self,
@@ -180,14 +262,18 @@ class TopologyIndex:
 
     # -- mutation ----------------------------------------------------------
 
-    def update(self, name: str, raw: Optional[str]) -> str:
+    def update(
+        self, name: str, raw: Optional[str], h: Optional[str] = None
+    ) -> str:
         """Install/refresh one node keyed by its annotation string.
 
         Returns the event kind: "noop" (string unchanged — the common
         relist case, zero work), "add", "update", or "clear" (annotation
         removed). Malformed annotations install a topo-less entry so
         they are negative-cached like missing ones (and stay keyed: a
-        republish of the same bad string is still a noop)."""
+        republish of the same bad string is still a noop). ``h`` is an
+        optional precomputed ``annotation_hash(raw)`` (the snapshot
+        reconcile path already paid for it)."""
         old = self._entries.get(name)
         if raw is None:
             with self._lock:
@@ -195,6 +281,14 @@ class TopologyIndex:
                 if prev is None and name in self._no_topo:
                     return "noop"
                 self._no_topo.add(name)
+                self._deferred.discard(name)
+                if prev is not None:
+                    # Negative (annotation-less) nodes are not
+                    # persisted, so only an entry transition changes
+                    # what the snapshot would contain — a mixed
+                    # cluster's pure-restore start must still skip its
+                    # byte-identical rewrite.
+                    self.generation += 1
                 self._publish_placeable_locked(
                     self._adjust_placeable_locked(prev, None)
                 )
@@ -205,29 +299,13 @@ class TopologyIndex:
                 return "clear"
             return "add"
         if old is not None and old.raw == raw:
-            return "noop"  # unchanged annotation string: zero work
-        try:
-            topo: Optional[NodeTopology] = parse_topology_cached(raw)
-        except ValueError as e:
-            log.warning("bad topology annotation on %s: %s", name, e)
-            topo = None
-        if topo is None:
-            entry = IndexEntry(name=name, raw=raw, topo=None)
-        else:
-            entry = IndexEntry(
-                name=name,
-                raw=raw,
-                topo=topo,
-                avail=len(topo.available),
-                chip_count=topo.chip_count,
-                hostname=topo.hostname,
-                slice_key=(
-                    tuple(topo.slice_hosts)
-                    if len(topo.slice_hosts) > 1
-                    else None
-                ),
-                placeable=self._placeable_for(topo),
-            )
+            # Unchanged annotation string (relist echo, status-only
+            # MODIFIED event): zero work — no parse, no rebuild. The
+            # hash-equality short-circuit the watch plane counts via
+            # tpu_extender_parse_avoided_total{reason="unchanged_
+            # annotation"} (apply_event increments on this kind).
+            return "noop"
+        entry = self._build_entry(name, raw, h=h)
         with self._lock:
             # Re-read under the lock: relist, watch, and RPC-path fetch
             # threads all land here, and membership bookkeeping must
@@ -235,6 +313,8 @@ class TopologyIndex:
             prev = self._entries.get(name)
             self._no_topo.discard(name)
             self._entries[name] = entry
+            self._deferred.discard(name)
+            self.generation += 1
             self._publish_placeable_locked(
                 self._adjust_placeable_locked(prev, entry)
             )
@@ -248,12 +328,85 @@ class TopologyIndex:
         self._changed(name, prev, entry)
         return "add" if prev is None else "update"
 
+    def _build_entry(
+        self, name: str, raw: str, h: Optional[str] = None
+    ) -> IndexEntry:
+        """Parse + derive one entry. The derived-state half (avail/
+        chips/host/slice/placeable) rides the content-addressed memo:
+        an annotation string whose hash was derived before — a watch
+        flip-flop, an identical annotation on a same-shaped node, a
+        snapshot-restored record — skips the fragmentation recompute;
+        the parse itself rides schema's string-keyed LRU, so a memo hit
+        on a warm LRU costs a clone, not a parse."""
+        h = h or annotation_hash(raw)
+        rec = _derived_lookup(h)
+        if rec is not None and rec.get("bad"):
+            # Known-malformed string: skip even the parse attempt.
+            metrics.PARSE_AVOIDED.inc(reason="derived_memo")
+            return IndexEntry(name=name, raw=raw, topo=None)
+        try:
+            topo: Optional[NodeTopology] = parse_topology_cached(raw)
+        except ValueError as e:
+            log.warning("bad topology annotation on %s: %s", name, e)
+            topo = None
+        if topo is None:
+            entry = IndexEntry(name=name, raw=raw, topo=None)
+            _derived_store(h, {"bad": True})
+            return entry
+        usable = rec is not None and (
+            not self.track_placeable or "placeable" in rec
+        )
+        if usable:
+            metrics.PARSE_AVOIDED.inc(reason="derived_memo")
+            return IndexEntry(
+                name=name,
+                raw=raw,
+                topo=topo,
+                avail=int(rec.get("avail", 0)),
+                chip_count=int(rec.get("chips", 0)),
+                hostname=str(rec.get("host", "")),
+                slice_key=(
+                    tuple(rec["slice"]) if rec.get("slice") else None
+                ),
+                placeable=(
+                    tuple(int(n) for n in rec.get("placeable", ()))
+                    if self.track_placeable
+                    else ()
+                ),
+            )
+        entry = IndexEntry(
+            name=name,
+            raw=raw,
+            topo=topo,
+            avail=len(topo.available),
+            chip_count=topo.chip_count,
+            hostname=topo.hostname,
+            slice_key=(
+                tuple(topo.slice_hosts)
+                if len(topo.slice_hosts) > 1
+                else None
+            ),
+            placeable=self._placeable_for(topo),
+        )
+        if self.track_placeable:
+            # Only tracking indexes publish to the shared memo: a
+            # record without the placeable term would poison a
+            # tracking index's aggregate if trusted (the bench's
+            # control arm shares this process).
+            _derived_store(h, entry.derived_record())
+        return entry
+
     def remove(self, name: str) -> str:
         """Forget a deleted node. Returns "delete" or "noop"."""
         with self._lock:
             prev = self._entries.pop(name, None)
             was_known = prev is not None or name in self._no_topo
             self._no_topo.discard(name)
+            self._deferred.discard(name)
+            if prev is not None:
+                # Same rationale as update()'s raw-None branch: only
+                # persisted (entry-bearing) state moves the snapshot.
+                self.generation += 1
             self._publish_placeable_locked(
                 self._adjust_placeable_locked(prev, None)
             )
@@ -262,6 +415,168 @@ class TopologyIndex:
         if prev is not None:
             self._changed(name, prev, None)
         return "delete" if was_known else "noop"
+
+    # -- snapshot restore + deferred materialization -----------------------
+    #
+    # Cold-start fast path (extender/server.py owns the snapshot
+    # FILE; this is the in-memory half): a restored entry installs the
+    # persisted derived state with the parse deferred, so time-to-ready
+    # is O(changed nodes) — the parse and mesh build land on the warm
+    # pool (or the first RPC that actually needs this node's topology),
+    # never on the startup critical path.
+
+    def restore(
+        self, name: str, raw: str, rec: dict, h: Optional[str] = None
+    ) -> bool:
+        """Install one snapshot-restored entry WITHOUT parsing. ``rec``
+        is the persisted derived record; the caller has validated that
+        ``annotation_hash(raw)`` matches the hash the record was
+        persisted under (and passes it as ``h`` so it isn't computed
+        twice on the time-to-ready critical path). Returns False when a
+        live entry already exists (live observation wins over the
+        snapshot)."""
+        if rec.get("bad"):
+            # Malformed-annotation negative entry: restored as-is (a
+            # republish of the same bad string stays a noop).
+            entry = IndexEntry(name=name, raw=raw, topo=None)
+        else:
+            entry = IndexEntry(
+                name=name,
+                raw=raw,
+                topo=None,
+                avail=int(rec.get("avail", 0)),
+                chip_count=int(rec.get("chips", 0)),
+                hostname=str(rec.get("host", "")),
+                slice_key=(
+                    tuple(rec["slice"]) if rec.get("slice") else None
+                ),
+                placeable=(
+                    tuple(int(n) for n in rec.get("placeable", ()))
+                    if self.track_placeable
+                    else ()
+                ),
+                deferred=True,
+            )
+        with self._lock:
+            if name in self._entries:
+                return False
+            self._no_topo.discard(name)
+            self._entries[name] = entry
+            if entry.deferred:
+                self._deferred.add(name)
+            # No generation bump: a restore installs exactly what the
+            # snapshot already persists, so a pure-restore start leaves
+            # the disk byte-identical and the post-relist snapshot
+            # write is skipped (server.py write_snapshot).
+            self._publish_placeable_locked(
+                self._adjust_placeable_locked(None, entry)
+            )
+            if entry.slice_key is not None:
+                self._slice_members.setdefault(
+                    entry.slice_key, set()
+                ).add(name)
+        # Seed the memo so a later watch flip back to this string
+        # skips the derived recompute too. (The caller batches the
+        # parse-avoided counter — restore is the time-to-ready
+        # critical path, one metric-lock hit per node would be ~6% of
+        # it at 1,000 nodes.)
+        if self.track_placeable or rec.get("bad"):
+            _derived_store(h or annotation_hash(raw), dict(rec))
+        return True
+
+    def ensure_parsed(self, name: str) -> Optional[IndexEntry]:
+        """Materialize a deferred entry's topo (idempotent; safe from
+        any thread). Returns the current entry — the materialized one,
+        an already-parsed one, a newer concurrent rebuild, or None for
+        an unknown node. The parse rides the shared LRU; the derived
+        fields are KEPT from the restored entry (hash-validated, so
+        recomputing them would be pure waste)."""
+        e = self._entries.get(name)
+        if e is None or not e.deferred:
+            return e
+        try:
+            topo: Optional[NodeTopology] = parse_topology_cached(e.raw)
+        except ValueError as err:
+            log.warning(
+                "snapshot-restored annotation on %s no longer parses "
+                "(%s); degrading to a no-topology entry", name, err,
+            )
+            topo = None
+        if topo is None:
+            # Version drift: the annotation validated against its hash
+            # but this build can't parse it — degrade to the malformed
+            # shape a fresh update() would have produced.
+            new = IndexEntry(name=name, raw=e.raw, topo=None)
+        else:
+            new = dataclasses.replace(e, topo=topo, deferred=False)
+        with self._lock:
+            cur = self._entries.get(name)
+            if cur is not e:
+                return cur  # a concurrent update/remove is newer truth
+            self._entries[name] = new
+            self._deferred.discard(name)
+            if new.placeable != e.placeable:
+                self._publish_placeable_locked(
+                    self._adjust_placeable_locked(e, new)
+                )
+            if new.slice_key != e.slice_key:
+                self._drop_membership_locked(name, e.slice_key)
+                if new.slice_key is not None:
+                    self._slice_members.setdefault(
+                        new.slice_key, set()
+                    ).add(name)
+        if topo is None:
+            # Derived state DID change (the restored numbers were for
+            # a parseable annotation): surface it like a rebuild.
+            with self._lock:
+                self.generation += 1
+            self._changed(name, e, new)
+        return new
+
+    def claim_deferred(self) -> Optional[str]:
+        """Pop one deferred node name for a warm worker (None = warm
+        complete). Racing ensure_parsed calls are idempotent."""
+        with self._lock:
+            try:
+                return self._deferred.pop()
+            except KeyError:
+                return None
+
+    def warm_progress(self) -> Dict[str, int]:
+        """{"parsed", "total"} over installed entries — the /readyz
+        warm-progress payload (a deferred entry is installed and
+        serviceable, but its first topology read still owes a parse)."""
+        with self._lock:
+            total = len(self._entries)
+            pending = sum(
+                1 for e in self._entries.values() if e.deferred
+            )
+        return {"parsed": total - pending, "total": total}
+
+    def warm_remaining(self) -> int:
+        """Materialize every deferred entry on THIS thread (tests and
+        the bench's drain measurements; production uses the warm
+        pool). Returns how many were materialized."""
+        n = 0
+        while True:
+            name = self.claim_deferred()
+            if name is None:
+                return n
+            self.ensure_parsed(name)
+            n += 1
+
+    def snapshot_data(self) -> dict:
+        """The persistable index document (extender/server.py writes it
+        through utils/statestore's checksummed snapshot machinery):
+        every installed entry's derived record, content-addressed by
+        its annotation hash. Negative (no-annotation) nodes are not
+        persisted — they cost nothing to rebuild."""
+        nodes: Dict[str, dict] = {}
+        for e in self.entries():
+            rec = e.derived_record()
+            rec["h"] = annotation_hash(e.raw)
+            nodes[e.name] = rec
+        return {"v": INDEX_SNAPSHOT_VERSION, "nodes": nodes}
 
     def _drop_membership_locked(
         self, name: str, key: Optional[SliceKey]
@@ -332,6 +647,14 @@ class TopologyIndex:
     def topologies(self) -> List[NodeTopology]:
         """Per-call CLONES of every indexed topology (private
         ``available`` lists) — the gang admitter's capacity view,
-        replacing a full node relist + parse per tick."""
-        entries = list(self._entries.values())
-        return [clone_topology(e.topo) for e in entries if e.topo is not None]
+        replacing a full node relist + parse per tick. Deferred
+        (snapshot-restored, unparsed) entries are materialized here:
+        the first tick after a cold start races the warm pool, and
+        ensure_parsed is idempotent either way."""
+        out: List[NodeTopology] = []
+        for e in list(self._entries.values()):
+            if e.deferred:
+                e = self.ensure_parsed(e.name) or e
+            if e.topo is not None:
+                out.append(clone_topology(e.topo))
+        return out

@@ -33,6 +33,13 @@ Gang admission is measured in its three production modes: ``full``
 — churn-proportional work incl. the capacity-pool build), and ``idle``
 (dirty tick with nothing marked and nothing held — must be O(1) and
 independent of gang count).
+
+``cold_start`` measures the restart story: extender time-to-ready
+with a persisted topology-index snapshot (hash-validated restore,
+parse deferred to the warm pool) vs the full-parse cold path vs a
+fully-stale snapshot — the fast-failover proof (ISSUE 9), bounded in
+tests/test_scale_bench.py and recorded as bench.py
+``detail.cold_start``.
 """
 
 from __future__ import annotations
@@ -50,7 +57,9 @@ from .reservations import ReservationTable
 from .server import NodeAnnotationCache, TopologyExtender
 
 
-def _node(name: str, n_chips: int = 4) -> dict:
+def _node(
+    name: str, n_chips: int = 4, available: Optional[List[str]] = None
+) -> dict:
     chips = [
         TpuChip(
             index=i,
@@ -65,7 +74,10 @@ def _node(name: str, n_chips: int = 4) -> dict:
         )
         for i in range(n_chips)
     ]
-    topo = NodeTopology.from_mesh(IciMesh(chips), hostname=name)
+    mesh = IciMesh(chips)
+    topo = NodeTopology.from_mesh(
+        mesh, hostname=name, available=available
+    )
     return {
         "metadata": {
             "name": name,
@@ -923,6 +935,252 @@ def audit_overhead(
     }
 
 
+def cold_start(
+    n_nodes: int = 1000,
+    ready_samples: int = 101,
+    slow_samples: int = 15,
+) -> dict:
+    """Extender time-to-ready across a restart, MEASURED (ISSUE 9
+    acceptance): snapshot-warm ≥5× faster than the full parse at 1,000
+    nodes, and the fully-stale fallback ≤1.05× of it. Three arms over
+    one node fixture set, every sample starting from FLUSHED process
+    caches (parse LRU + derived memo — the true restarted-process
+    shape):
+
+    * ``full_parse`` — no snapshot (today's cold path): time-to-ready
+      is the first relist parsing every annotation into the index.
+    * ``snapshot_warm`` — a persisted index snapshot whose per-node
+      annotation hashes all match the live relist: entries restore
+      with the parse DEFERRED, so time-to-ready is hash comparisons +
+      dict installs, O(changed)=O(0) parse work. ``cold_first_call``
+      is the first full-cluster /filter+/prioritize pair afterwards
+      (it materializes on demand, racing the warm pool in production);
+      ``warm_drain`` is the background pool's total work, measured
+      synchronously — both are the DEFERRED cost, paid off the
+      readiness critical path.
+    * ``snapshot_stale`` — every snapshot hash mismatches (annotations
+      changed while the daemon was down): the fallback must cost
+      ~nothing over ``full_parse`` (per-node hash + the same parse).
+
+    ``time_to_ready`` samples use ``ready_samples`` (the fast arm's
+    101-sample convention); the parse-heavy measurements use
+    ``slow_samples`` — their p50 is the bound input, so fewer samples
+    suffice and the gate stays inside its time budget."""
+    import os
+    import shutil
+    import tempfile
+
+    from .. import telemetry as telem
+    from ..topology.schema import _parse_template
+    from ..utils import metrics as _metrics
+    from . import index as _index
+
+    nodes = [_node(f"node-{i:04d}") for i in range(n_nodes)]
+    names = [(n.get("metadata") or {}).get("name", "") for n in nodes]
+    # Same annotations re-published with a smaller availability list:
+    # different strings, same node names — the changed-while-down arm.
+    stale_nodes = [
+        _node(f"node-{i:04d}", available=[]) for i in range(n_nodes)
+    ]
+    saved_provider = telem.CLUSTER_PROVIDER
+    d = tempfile.mkdtemp(prefix="tpu-cold-start-bench-")
+
+    def flush_caches() -> None:
+        # A restarted process holds neither the parse LRU nor the
+        # derived memo; every sample must pay (or legitimately skip)
+        # the true cold cost.
+        _parse_template.cache_clear()
+        _index.clear_derived_memo()
+
+    def fresh_cache(snapshot_dir: str = "") -> NodeAnnotationCache:
+        cache = NodeAnnotationCache(
+            _StubClient(nodes, []), interval_s=3600,
+            snapshot_dir=snapshot_dir,
+        )
+        if snapshot_dir:
+            cache.load_snapshot()
+            # Measurement isolation: the post-relist snapshot REWRITE
+            # (skipped anyway on a pure-restore start) must not let
+            # the stale arm overwrite its own fixture between samples,
+            # and disk-speed noise stays out of the timing.
+            cache._snapshot_store = None
+        return cache
+
+    def one_ready(snapshot_dir: str) -> Tuple[float, NodeAnnotationCache]:
+        flush_caches()
+        cache = fresh_cache(snapshot_dir)
+        t0 = time.perf_counter()
+        cache.refresh()
+        dt = time.perf_counter() - t0
+        assert len(cache.index) == n_nodes
+        return dt, cache
+
+    def one_first_call(snapshot_dir: str) -> float:
+        flush_caches()
+        cache = fresh_cache(snapshot_dir)
+        cache.refresh()
+        ext = TopologyExtender(
+            reservations=ReservationTable(), node_cache=cache
+        )
+        pod = _plain_pod(chips=2)
+        t0 = time.perf_counter()
+        out = ext.filter_names(pod, names)
+        scores = ext.prioritize_names(pod, names)
+        dt = time.perf_counter() - t0
+        assert out is not None and len(out[0]) == n_nodes
+        assert scores is not None and len(scores) == n_nodes
+        return dt
+
+    # GC OFF for the whole measurement (timeit's discipline, stronger
+    # than the sibling probes' freeze): every sample allocates ~1,000
+    # parsed topologies, and a threshold-triggered gen2 pass lands
+    # inside whichever arm's timed window the allocation counters
+    # happen to cross in — at a 1.05x bound that's the whole budget.
+    # Refcounting still reclaims the acyclic fixtures as samples drop
+    # them, so memory stays bounded.
+    import gc
+
+    gc_was_enabled = gc.isenabled()
+    gc.collect()
+    gc.disable()
+    try:
+        # Seed the persisted snapshots: one matching the live cluster,
+        # one from the same nodes' CHANGED annotations.
+        warm_dir = os.path.join(d, "warm")
+        stale_dir = os.path.join(d, "stale")
+        seed = NodeAnnotationCache(
+            _StubClient(nodes, []), interval_s=3600,
+            snapshot_dir=warm_dir,
+        )
+        seed.refresh()  # writes the snapshot as its final step
+        seed_stale = NodeAnnotationCache(
+            _StubClient(stale_nodes, []), interval_s=3600,
+            snapshot_dir=stale_dir,
+        )
+        seed_stale.refresh()
+
+        restored_before = int(
+            _metrics.INDEX_SNAPSHOT_ENTRIES.get(source="restored")
+        )
+        # The arms compare against each other (speedup, stale
+        # overhead), so they are INTERLEAVED sample-by-sample: a
+        # co-tenant build or thermal drift mid-probe hits every arm
+        # equally instead of skewing whichever ran last.
+        full_ttr: List[float] = []
+        stale_ttr: List[float] = []
+        snap_ttr: List[float] = []
+        full_calls: List[float] = []
+        snap_calls: List[float] = []
+        drains: List[float] = []
+        warm_chunk = max(1, ready_samples // max(1, slow_samples))
+        last = stale_last = None
+        for i in range(slow_samples):
+            dt, _ = one_ready("")
+            full_ttr.append(dt)
+            dt, stale_last = one_ready(stale_dir)
+            stale_ttr.append(dt)
+            for _ in range(warm_chunk):
+                if len(snap_ttr) < ready_samples:
+                    dt, last = one_ready(warm_dir)
+                    snap_ttr.append(dt)
+            full_calls.append(one_first_call(""))
+            snap_calls.append(one_first_call(warm_dir))
+            # Background-pool workload, measured synchronously:
+            # restore, then drain every deferred parse.
+            flush_caches()
+            cache = fresh_cache(warm_dir)
+            cache.refresh()
+            t0 = time.perf_counter()
+            warmed = cache.index.warm_remaining()
+            drains.append(time.perf_counter() - t0)
+            assert warmed == n_nodes, warmed
+        while len(snap_ttr) < ready_samples:
+            dt, last = one_ready(warm_dir)
+            snap_ttr.append(dt)
+        restored = (
+            int(_metrics.INDEX_SNAPSHOT_ENTRIES.get(source="restored"))
+            - restored_before
+        )
+        assert last is not None
+        wp = last.index.warm_progress()
+        assert wp["total"] == n_nodes and wp["parsed"] == 0, wp
+        assert stale_last is not None
+        # Every hash mismatched: nothing restored, everything parsed.
+        assert stale_last.index.warm_progress()["parsed"] == n_nodes
+        full_ready = _pctl(full_ttr)
+        snap_ready = _pctl(snap_ttr)
+        stale_ready = _pctl(stale_ttr)
+        full_call = _pctl(full_calls)
+        snap_call = _pctl(snap_calls)
+
+        # Parity: a snapshot-restored-then-warmed index is
+        # indistinguishable from a freshly parsed one (the tests pin
+        # this per-field; the bench keeps the cheap whole-set check).
+        flush_caches()
+        fresh = NodeAnnotationCache(_StubClient(nodes, []), interval_s=3600)
+        fresh.refresh()
+        restored_cache = fresh_cache(warm_dir)
+        restored_cache.refresh()
+        restored_cache.index.warm_remaining()
+        for name in names:
+            assert restored_cache.index.get(name) == fresh.index.get(
+                name
+            ), name
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+        gc.collect()
+        telem.CLUSTER_PROVIDER = saved_provider
+        _metrics.EXT_PLACEABLE_NODES.remove_matching()
+        shutil.rmtree(d, ignore_errors=True)
+
+    base = full_ready["p50_ms"] or 1e-9
+    return {
+        "nodes": n_nodes,
+        "full_parse": {
+            "time_to_ready": full_ready,
+            "cold_first_call": full_call,
+        },
+        "snapshot_warm": {
+            "time_to_ready": snap_ready,
+            "cold_first_call": snap_call,
+            "warm_drain": _pctl(drains),
+            # Every snapshot-arm start (ready + first-call + drain
+            # samples) restores the full cluster.
+            "restored_per_start": restored
+            // max(1, ready_samples + 2 * slow_samples),
+        },
+        "snapshot_stale": {"time_to_ready": stale_ready},
+        "ready_speedup_p50": round(
+            base / (snap_ready["p50_ms"] or 1e-9), 2
+        ),
+        "stale_overhead_pct": round(
+            (stale_ready["p50_ms"] - base) / base * 100.0, 1
+        ),
+    }
+
+
+def cold_start_self_test() -> int:
+    """Tiny-scale smoke for scripts/tier1.sh: the snapshot round-trip
+    (write → load → hash-validate → restore → warm) must produce an
+    index indistinguishable from a freshly parsed one, with every node
+    restored. The full-scale ratio bounds live in
+    tests/test_scale_bench.py; this catches format/plumbing drift
+    before the pytest gate."""
+    import json
+
+    r = cold_start(n_nodes=40, ready_samples=5, slow_samples=3)
+    assert r["nodes"] == 40
+    assert r["snapshot_warm"]["restored_per_start"] == 40, r
+    assert r["snapshot_warm"]["time_to_ready"]["samples"] == 5
+    assert r["snapshot_stale"]["time_to_ready"]["samples"] == 3
+    print(json.dumps({
+        "cold_start_self_test": "ok",
+        "ready_speedup_p50": r["ready_speedup_p50"],
+    }))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     import json
@@ -954,7 +1212,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="run the consistency-audit overhead probe instead of the "
         "scale run",
     )
+    p.add_argument(
+        "--cold-start", action="store_true",
+        help="run the cold-start failover probe (persistent index "
+        "snapshot vs full parse) instead of the scale run",
+    )
+    p.add_argument(
+        "--cold-start-self-test", action="store_true",
+        help="tiny-scale snapshot round-trip smoke (scripts/tier1.sh)",
+    )
     a = p.parse_args(argv)
+    if a.cold_start_self_test:
+        return cold_start_self_test()
+    if a.cold_start:
+        print(json.dumps(cold_start(n_nodes=a.nodes)))
+        return 0
     if a.audit_overhead:
         print(json.dumps(audit_overhead(n_nodes=a.nodes)))
         return 0

@@ -29,6 +29,7 @@ import collections
 import heapq
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler
 from typing import Dict, List, Optional, Tuple
 
@@ -36,14 +37,21 @@ from ..api import constants
 from ..topology.placement import PlacementState, ideal_box_links
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..topology.slice import SliceView, group_by_slice
-from ..utils import metrics, tracing
+from ..utils import metrics, statestore, tracing
 from ..utils.decisions import LEDGER
+from ..utils.flightrecorder import RECORDER
 from ..utils.httpserver import BackgroundHTTPServer
 from ..utils.logging import get_logger
 from ..utils.podresources import tpu_request
 from ..utils.resilience import Backoff
 from .gang import pod_gang
-from .index import IndexEntry, TopologyIndex, shielded
+from .index import (
+    INDEX_SNAPSHOT_VERSION,
+    IndexEntry,
+    TopologyIndex,
+    annotation_hash,
+    shielded,
+)
 from .reservations import DEFAULT_TABLE, ReservationTable
 
 log = get_logger(__name__)
@@ -534,6 +542,7 @@ class TopologyExtender:
             return None
         idx = cache.index
         out = []
+        parsed_on_demand = 0
         for name in names:
             e = idx.get(name)
             if e is None and not idx.known(name):
@@ -541,8 +550,19 @@ class TopologyExtender:
                 # cache fetch, which also installs the index entry.
                 cache.node_object(name)
                 e = idx.get(name)
+            if e is not None and e.deferred:
+                # Snapshot-restored entry racing the warm pool: the
+                # RPC needs its topology NOW; ensure_parsed is
+                # idempotent against the concurrent warm worker.
+                e = idx.ensure_parsed(name)
+                parsed_on_demand += 1
             out.append((name, e))
-        metrics.PARSE_AVOIDED.inc(len(names))
+        served = len(names) - parsed_on_demand
+        if served > 0:
+            # Only candidates actually answered from the index count
+            # as avoided — a deferred entry this RPC just materialized
+            # paid its parse right here.
+            metrics.PARSE_AVOIDED.inc(served, reason="indexed_rpc")
         return out
 
     def _held_for(self, pod: dict) -> Dict[str, int]:
@@ -738,7 +758,18 @@ class NodeAnnotationCache:
     changed one rebuilds exactly that node's parsed entry, off the RPC
     path. With ``watch=True`` the relist degrades to a low-frequency
     level-triggered backstop (``watch_backstop_s``) and invalidation
-    latency drops from the relist interval to one watch event."""
+    latency drops from the relist interval to one watch event.
+
+    With ``snapshot_dir`` set the cache persists the index's DERIVED
+    state (utils/statestore checksummed snapshot, content-addressed per
+    node by annotation hash) after relists and on stop, and restores it
+    before the first relist: nodes whose annotation hash is unchanged
+    install without parsing (parse deferred to the warm pool / first
+    demand), so a restarted extender's time-to-ready is O(changed
+    nodes) instead of O(cluster). ``event_coalesce_s`` > 0 batches
+    node watch events through a tiny applier tick (latest event per
+    node wins), so a republish storm costs one rebuild per node per
+    tick instead of one per event."""
 
     def __init__(
         self,
@@ -746,6 +777,9 @@ class NodeAnnotationCache:
         interval_s: float = 5.0,
         watch: bool = False,
         watch_backstop_s: float = 300.0,
+        snapshot_dir: str = "",
+        warm_workers: int = 2,
+        event_coalesce_s: float = 0.0,
     ):
         self.client = client
         self.interval_s = interval_s
@@ -754,6 +788,32 @@ class NodeAnnotationCache:
         # level-triggered backstop against missed events; this is the
         # cadence floor for them (docs/operations.md).
         self.watch_backstop_s = max(watch_backstop_s, interval_s)
+        # Cold-start snapshot store ("" = persistence off). The file
+        # set is {index.snapshot.json, index.journal} in snapshot_dir
+        # — the journal half stays empty (the index has no append
+        # stream; every relist is a full truth), but routing writes
+        # through StateStore keeps one checksummed format on disk.
+        self._snapshot_store = (
+            statestore.StateStore(snapshot_dir, name="index")
+            if snapshot_dir
+            else None
+        )
+        # hash-keyed derived records loaded from the snapshot, consumed
+        # (and then discarded) by the FIRST successful relist.
+        self._snap_pending: Optional[Dict[str, dict]] = None
+        self._snap_written_gen = -1
+        self.warm_workers = max(0, int(warm_workers))
+        self._warm_threads: List[threading.Thread] = []
+        # Watch-event coalescing (0 = apply inline): pending latest
+        # event per node, drained by the applier thread every tick.
+        self.event_coalesce_s = max(0.0, float(event_coalesce_s))
+        self._pending_events: "collections.OrderedDict[str, tuple]" = (
+            collections.OrderedDict()
+        )
+        self._event_lock = threading.Lock()
+        self._event_wake = threading.Event()
+        self._applier_thread: Optional[threading.Thread] = None
+        self._warm_t0 = 0.0
         # name → annotation string, or None for a relisted node WITHOUT
         # one (daemon not publishing). The negative entries matter: a
         # no-annotation node is a steady state on mixed clusters, and
@@ -780,6 +840,10 @@ class NodeAnnotationCache:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "NodeAnnotationCache":
+        # Snapshot BEFORE the first relist: the relist consumes the
+        # pending records (hash-validated per node) so unchanged nodes
+        # install without parsing — the cold-start fast path.
+        self.load_snapshot()
         try:
             self.refresh()
         except Exception as e:  # noqa: BLE001 — a transient apiserver
@@ -788,14 +852,27 @@ class NodeAnnotationCache:
             # recover once the apiserver answers.
             metrics.NODE_CACHE_RELIST_ERRORS.inc()
             log.warning("initial node-cache relist failed: %s", e)
+        self.start_warm()
         self._thread = threading.Thread(
             target=self._loop, name="node-annotation-cache", daemon=True
         )
         self._thread.start()
+        if self.watch and self.event_coalesce_s > 0:
+            self._applier_thread = threading.Thread(
+                target=self._applier_loop,
+                name="node-event-applier",
+                daemon=True,
+            )
+            self._applier_thread.start()
         return self
 
     def stop(self) -> None:
+        # Freshest possible snapshot for the successor (the graceful-
+        # rollout path; a SIGKILL keeps the last post-relist write and
+        # pays a re-parse only for nodes that changed since).
+        self.write_snapshot()
         self._stop.set()
+        self._event_wake.set()
         if self.watch:
             # Unblock a thread sitting in the watch stream's socket
             # read (up to ~70 s otherwise) — same teardown shape as
@@ -809,6 +886,172 @@ class NodeAnnotationCache:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._applier_thread is not None:
+            self._applier_thread.join(timeout=5)
+            self._applier_thread = None
+        for t in self._warm_threads:
+            t.join(timeout=5)
+        self._warm_threads = []
+
+    # -- cold-start snapshot plane -----------------------------------------
+
+    def load_snapshot(self) -> int:
+        """Read the persisted index snapshot into the pending map the
+        first relist validates against. Returns how many per-node
+        records were loaded (0 = no usable snapshot: missing, corrupt,
+        or a different derived-schema version — all degrade to the
+        full parse the snapshotless daemon always did)."""
+        if self._snapshot_store is None:
+            return 0
+        try:
+            res = self._snapshot_store.load()
+        except Exception as e:  # noqa: BLE001 — a broken store must
+            # never block startup; full parse is the floor
+            metrics.INDEX_SNAPSHOT_LOADS.inc(outcome="error")
+            log.warning("index snapshot load failed: %s", e)
+            return 0
+        doc = res.snapshot
+        if doc is None:
+            metrics.INDEX_SNAPSHOT_LOADS.inc(
+                outcome="empty"
+                if res.status in (statestore.EMPTY, statestore.CLEAN)
+                else "corrupt"
+            )
+            return 0
+        if doc.get("v") != INDEX_SNAPSHOT_VERSION:
+            # Derived-entry semantics may have changed across the
+            # upgrade: a stale derived record is never worth the risk.
+            metrics.INDEX_SNAPSHOT_LOADS.inc(outcome="version_mismatch")
+            log.info(
+                "index snapshot is schema v%s (want v%s); ignoring it",
+                doc.get("v"), INDEX_SNAPSHOT_VERSION,
+            )
+            return 0
+        nodes = doc.get("nodes") or {}
+        self._snap_pending = {
+            str(name): rec
+            for name, rec in nodes.items()
+            if isinstance(rec, dict) and rec.get("h")
+        }
+        # The disk currently matches what restores will install: a
+        # pure-restore first relist then skips its snapshot rewrite
+        # (restores don't bump the index generation; any update/remove
+        # does, and triggers a fresh write).
+        self._snap_written_gen = self.index.generation
+        metrics.INDEX_SNAPSHOT_LOADS.inc(outcome="ok")
+        return len(self._snap_pending)
+
+    def write_snapshot(self) -> bool:
+        """Persist the index's derived state (post-relist + on stop).
+        Skipped when persistence is off, no relist has succeeded, or
+        nothing changed since the last write. Never raises."""
+        if self._snapshot_store is None or not self._synced:
+            return False
+        gen = self.index.generation
+        if gen == self._snap_written_gen:
+            return False
+        try:
+            self._snapshot_store.compact(self.index.snapshot_data())
+        except Exception as e:  # noqa: BLE001 — persistence is an
+            # optimization; a full disk costs the NEXT cold start a
+            # full parse, never this process its relist loop
+            metrics.INDEX_SNAPSHOT_WRITES.inc(outcome="error")
+            log.warning("index snapshot write failed: %s", e)
+            return False
+        self._snap_written_gen = gen
+        metrics.INDEX_SNAPSHOT_WRITES.inc(outcome="ok")
+        return True
+
+    # -- parallel warm pool ------------------------------------------------
+
+    def start_warm(self) -> None:
+        """Spawn the warm workers that materialize deferred (snapshot-
+        restored) entries in the background — concurrent with journal
+        replay and gang recovery in the entrypoint. Idempotent and
+        re-invoked after every successful relist: when the INITIAL
+        relist failed (apiserver blip at start — the failover scenario
+        itself), the snapshot restore happens on a later relist in
+        _loop, and the pool must still pick the deferred entries up
+        rather than leaving the whole cluster's parse to land inline
+        on the first gang tick or RPC. No-op when nothing is deferred
+        or workers are already running."""
+        if self.warm_workers <= 0:
+            return
+        self._warm_threads = [
+            t for t in self._warm_threads if t.is_alive()
+        ]
+        if self._warm_threads:
+            return
+        wp = self.index.warm_progress()
+        if wp["parsed"] >= wp["total"]:
+            return
+        self._warm_t0 = time.monotonic()
+        for i in range(self.warm_workers):
+            t = threading.Thread(
+                target=self._warm_loop,
+                name=f"index-warm-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._warm_threads.append(t)
+
+    def _warm_loop(self) -> None:
+        while not self._stop.is_set():
+            name = self.index.claim_deferred()
+            if name is None:
+                break
+            try:
+                self.index.ensure_parsed(name)
+            except Exception:  # noqa: BLE001 — one bad entry must not
+                log.exception("index warm failed for %s", name)
+        # Last worker out records the drain duration (workers race the
+        # set-harmlessly; the values agree to within one parse).
+        metrics.INDEX_WARM_SECONDS.set(
+            round(time.monotonic() - self._warm_t0, 6)
+        )
+
+    # -- watch-event coalescing --------------------------------------------
+
+    def offer_event(self, etype: str, node: dict) -> None:
+        """Queue one watch event for the coalescing applier (latest
+        event per node wins). Falls back to inline apply when
+        coalescing is off or the applier isn't running."""
+        if self.event_coalesce_s <= 0 or self._applier_thread is None:
+            self.apply_event(etype, node)
+            return
+        name = (node.get("metadata") or {}).get("name", "")
+        if not name or etype == "BOOKMARK":
+            return
+        with self._event_lock:
+            if name in self._pending_events:
+                # Superseded mid-burst: that event's rebuild never
+                # happens — the storm-coalescing win, made visible.
+                metrics.INDEX_EVENTS.inc(
+                    source="watch", kind="coalesced"
+                )
+            self._pending_events[name] = (etype, node)
+        self._event_wake.set()
+
+    def flush_events(self) -> int:
+        """Apply the latest buffered event per node (one rebuild per
+        node per tick). Returns how many nodes were applied."""
+        with self._event_lock:
+            batch = self._pending_events
+            self._pending_events = collections.OrderedDict()
+        for etype, node in batch.values():
+            self.apply_event(etype, node)
+        return len(batch)
+
+    def _applier_loop(self) -> None:
+        while not self._stop.is_set():
+            self._event_wake.wait()
+            if self._stop.is_set():
+                break
+            self._event_wake.clear()
+            # Let the burst accumulate for one tick, then drain it.
+            self._stop.wait(self.event_coalesce_s)
+            self.flush_events()
+        self.flush_events()  # nothing buffered outlives the applier
 
     def _loop(self) -> None:
         # Escalating relist delay while the apiserver is down (the
@@ -824,6 +1067,10 @@ class NodeAnnotationCache:
                 self.refresh()
                 backoff.reset()
                 wait = self.interval_s
+                # Covers the failed-initial-relist path: a snapshot
+                # restored by THIS relist still gets its warm pool
+                # (no-op when nothing is deferred / already running).
+                self.start_warm()
                 if self.watch:
                     # Consume watch events until the stream goes stale
                     # (410), errors, or the relist backstop comes due;
@@ -874,12 +1121,70 @@ class NodeAnnotationCache:
         # Incremental index maintenance: entries are keyed by the
         # annotation STRING, so a steady cluster's relist applies N
         # no-ops; only nodes whose annotation actually changed rebuild.
+        # On the FIRST relist after a cold start, nodes whose
+        # annotation hash matches the persisted snapshot record are
+        # RESTORED (derived state installed, parse deferred to the
+        # warm pool) — time-to-ready scales with what changed while
+        # the daemon was down, not with cluster size.
+        pending = self._snap_pending
+        restored = stale = 0
         for name, raw in fresh.items():
-            kind = self.index.update(name, raw)
+            rec = pending.pop(name, None) if pending else None
+            h = None
+            if rec is not None and raw:
+                h = annotation_hash(raw)
+                if (
+                    self.index.get(name) is None
+                    and rec.get("h") == h
+                    and self.index.restore(name, raw, rec, h=h)
+                ):
+                    restored += 1
+                    continue
+            if rec is not None:
+                # Annotation changed (or vanished) while we were down:
+                # exactly this node pays a fresh parse (the hash
+                # computed above is handed down so it isn't paid
+                # twice — the stale fallback must cost ~nothing over
+                # the snapshotless path).
+                stale += 1
+            kind = self.index.update(name, raw, h=h)
             metrics.INDEX_EVENTS.inc(source="relist", kind=kind)
         for name in removed:
             metrics.INDEX_EVENTS.inc(
                 source="relist", kind=self.index.remove(name)
+            )
+        if pending is not None:
+            # Snapshot reconcile counters, batched (one lock hit per
+            # outcome, not one per node — this loop is the
+            # time-to-ready critical path).
+            if restored:
+                metrics.INDEX_SNAPSHOT_ENTRIES.inc(
+                    restored, source="restored"
+                )
+                metrics.INDEX_EVENTS.inc(
+                    restored, source="relist", kind="restore"
+                )
+                metrics.PARSE_AVOIDED.inc(
+                    restored, reason="snapshot_restore"
+                )
+            if stale:
+                metrics.INDEX_SNAPSHOT_ENTRIES.inc(
+                    stale, source="stale"
+                )
+            # Snapshot records for nodes the cluster no longer has.
+            if pending:
+                metrics.INDEX_SNAPSHOT_ENTRIES.inc(
+                    len(pending), source="vanished"
+                )
+            self._snap_pending = None
+            RECORDER.record(
+                "index_snapshot",
+                f"index snapshot reconciled against the first relist: "
+                f"{restored} restored, {stale} re-parsed, "
+                f"{len(pending)} vanished",
+                restored=restored,
+                stale=stale,
+                vanished=len(pending),
             )
         metrics.NODE_CACHE_NODES.set(with_topo, state="with_topology")
         metrics.NODE_CACHE_NODES.set(
@@ -895,13 +1200,22 @@ class NodeAnnotationCache:
         # scheduler RPC. Unconditional on purpose — an already-warm
         # value is a pure LRU hit, and delta-tracking against the
         # previous relist would miss entries the shared 8192-entry LRU
-        # evicted in between.
+        # evicted in between. Annotations behind DEFERRED (snapshot-
+        # restored) entries are the one exception: parsing them here
+        # would put the whole-cluster parse right back on the startup
+        # critical path — the warm pool owns them.
+        deferred_raws = {
+            e.raw for e in self.index.entries() if e.deferred
+        }
         for raw in raws:
-            if raw:
+            if raw and raw not in deferred_raws:
                 try:
                     parse_topology_cached(raw)
                 except ValueError:
                     pass  # malformed stays the publisher's problem
+        # Persist the refreshed derived state for the NEXT cold start
+        # (no-op when unchanged since the last write).
+        self.write_snapshot()
 
     # -- watch plane -------------------------------------------------------
 
@@ -925,6 +1239,14 @@ class NodeAnnotationCache:
             with self._lock:
                 self._raw[name] = raw
             kind = self.index.update(name, raw)
+            if kind == "noop" and raw:
+                # Relist echo / status-only update: the annotation
+                # string is unchanged, so the hash-equality
+                # short-circuit skipped the whole rebuild — made
+                # visible so "how much churn is real" is a query.
+                metrics.PARSE_AVOIDED.inc(
+                    reason="unchanged_annotation"
+                )
         metrics.INDEX_EVENTS.inc(source="watch", kind=kind)
         return kind
 
@@ -954,7 +1276,10 @@ class NodeAnnotationCache:
                         )
                         or rv
                     )
-                    self.apply_event(etype, obj)
+                    # Through the coalescer when enabled (one rebuild
+                    # per node per applier tick under event storms);
+                    # inline otherwise.
+                    self.offer_event(etype, obj)
                     if _time.monotonic() >= deadline:
                         break
             except Exception as e:  # noqa: BLE001 — 410s, drops,
@@ -1006,6 +1331,67 @@ class NodeAnnotationCache:
         return raw
 
 
+class ReadyStatus:
+    """Startup-phase tracker behind /readyz and /debug/readyz.
+
+    PR 6's readiness gate was a bare bool; an operator staring at a
+    503ing extender could not tell journal replay from index warm from
+    a wedged start. This names the phase — ``replaying`` (admission
+    journal replay + cluster reconciliation), ``warming`` (replay done,
+    entry install / ready-set still pending), ``ready`` — and carries
+    the index warm progress (``parsed/total``) so a STUCK warm (parsed
+    frozen) is distinguishable from a SLOW one (parsed climbing). The
+    entrypoint calls mark_replayed()/mark_ready(); the warm progress
+    callable keeps reporting after ready while the background pool
+    drains deferred parses."""
+
+    def __init__(
+        self,
+        ready_event: threading.Event,
+        journal_configured: bool = False,
+        warm_progress=None,
+    ):
+        self._ready = ready_event
+        self._replay_done = not journal_configured
+        # () -> {"parsed": int, "total": int}, or None without a cache.
+        self.warm_progress = warm_progress
+        self._t0 = time.monotonic()
+        self.time_to_ready_s: Optional[float] = None
+
+    def mark_replayed(self) -> None:
+        self._replay_done = True
+
+    def mark_ready(self) -> None:
+        if self.time_to_ready_s is None:
+            self.time_to_ready_s = round(
+                time.monotonic() - self._t0, 3
+            )
+            metrics.TIME_TO_READY.set(self.time_to_ready_s)
+        self._ready.set()
+
+    def phase(self) -> str:
+        if self._ready.is_set():
+            return "ready"
+        return "replaying" if not self._replay_done else "warming"
+
+    def snapshot(self) -> dict:
+        """The /readyz (and /debug/readyz) JSON body."""
+        phase = self.phase()
+        out: dict = {"ok": phase == "ready", "phase": phase}
+        if self.warm_progress is not None:
+            try:
+                out["warm"] = self.warm_progress()
+            except Exception:  # noqa: BLE001 — progress is advisory;
+                pass  # a broken provider must not break the probe
+        if self.time_to_ready_s is not None:
+            out["time_to_ready_s"] = self.time_to_ready_s
+        if phase == "replaying":
+            out["reason"] = "admission state rehydrating"
+        elif phase == "warming":
+            out["reason"] = "topology index warming"
+        return out
+
+
 class ExtenderHTTPServer(BackgroundHTTPServer):
     """HTTP wrapper speaking the scheduler-extender JSON protocol.
 
@@ -1022,6 +1408,7 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
         port: int = 0,
         identity: str = "",
         ready_check=None,
+        ready_status=None,
     ):
         super().__init__(host, port)
         self.extender = extender or TopologyExtender()
@@ -1037,6 +1424,11 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
         # /readyz serves the same answer for the kube readiness probe
         # (deploy/tpu-extender.yml); /healthz stays pure liveness.
         self.ready_check = ready_check
+        # Optional () -> dict (ReadyStatus.snapshot): upgrades /readyz
+        # from a bare 200/503 to a JSON body with the startup phase
+        # (replaying|warming|ready) and index warm progress, so probes
+        # and tpu-doctor can tell a stuck warm from a slow one.
+        self.ready_status = ready_status
 
     def handler_class(self):
         ext = self.extender
@@ -1068,6 +1460,15 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _ready_payload(self) -> dict:
+                status = server.ready_status
+                if status is None:
+                    return {}
+                try:
+                    return status()
+                except Exception:  # noqa: BLE001 — advisory detail
+                    return {}
+
             def do_POST(self):
                 if not ready():
                     # 503, not an empty 200: an empty filter result
@@ -1075,8 +1476,19 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                     # scheduling cycle outright; an error makes the
                     # scheduler retry, and the readiness probe keeps
                     # the Service from routing here at all.
+                    detail = self._ready_payload()
                     self._send(
-                        {"error": "admission state rehydrating"}, 503
+                        {
+                            "error": detail.get(
+                                "reason", "admission state rehydrating"
+                            ),
+                            **{
+                                k: v
+                                for k, v in detail.items()
+                                if k in ("phase", "warm")
+                            },
+                        },
+                        503,
                     )
                     # Bounded verb label: an arbitrary POST path during
                     # the not-ready window must not mint metric
@@ -1174,17 +1586,22 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                     # scheduler's extender Service never routes a
                     # /filter to a replica that hasn't restored its
                     # holds. /healthz above stays pure liveness: a
-                    # rehydrating process is alive, not ready.
+                    # rehydrating process is alive, not ready. With a
+                    # ReadyStatus wired, the body carries the startup
+                    # phase (replaying|warming|ready) and index warm
+                    # progress — also served (always-200) at
+                    # /debug/readyz for tpu-doctor bundles.
                     ok = ready()
-                    self._send(
-                        {"ok": ok}
-                        if ok
-                        else {
-                            "ok": False,
-                            "reason": "admission state rehydrating",
-                        },
-                        200 if ok else 503,
-                    )
+                    payload = {"ok": ok}
+                    detail = self._ready_payload()
+                    if detail:
+                        payload.update(detail)
+                        payload["ok"] = ok
+                    elif not ok:
+                        payload["reason"] = (
+                            "admission state rehydrating"
+                        )
+                    self._send(payload, 200 if ok else 503)
                 elif self.path == "/reservations":
                     # Active gang holds (reservations.py) — consumed by
                     # tools/gang so out-of-process diagnosis sees the

@@ -228,6 +228,17 @@ def fragmentation_stats(mesh: IciMesh, free_ids: Iterable[str]) -> dict:
     }
 
 
+def placeable_sizes(mesh: IciMesh, free_ids: Iterable[str]) -> Tuple[int, ...]:
+    """The sorted power-of-two request sizes a contiguous free box
+    currently fits for — the per-node derived term the topology index
+    stores on every entry, persists in its cold-start snapshot, and the
+    consistency auditor recomputes from scratch (audit.py
+    placeable_recount). ONE entry point over :func:`fragmentation_stats`
+    so the three consumers can never derive the tuple differently."""
+    stats = fragmentation_stats(mesh, free_ids)
+    return tuple(n for n, ok in sorted(stats["placeable"].items()) if ok)
+
+
 class PlacementState:
     """Allocation bookkeeping plus the best-fit selection policy.
 

@@ -544,10 +544,51 @@ INDEX_SLICES = EXTENDER_REGISTRY.gauge(
 )
 PARSE_AVOIDED = EXTENDER_REGISTRY.counter(
     "tpu_extender_parse_avoided_total",
-    "Candidate nodes served by /filter+/prioritize straight from the "
-    "topology index — zero per-RPC JSON parsing or mesh building "
-    "(the name-only fast path); compare against candidates served "
-    "through the full-object parse path to see fast-path coverage",
+    "Annotation parses/derivations avoided, by reason: indexed_rpc "
+    "(candidates served by /filter+/prioritize straight from the "
+    "topology index — zero per-RPC JSON parsing), "
+    "unchanged_annotation (watch event whose annotation string was "
+    "unchanged — relist echo / status-only update, short-circuited "
+    "before any parse), derived_memo (entry rebuild served from the "
+    "content-addressed derived-state memo), snapshot_restore (entry "
+    "installed from the persisted index snapshot with the parse "
+    "deferred to the warm pool)",
+)
+# Cold-start fast failover (extender/index.py snapshot restore +
+# server.py warm pool): how a restarted extender becomes ready in
+# O(changed nodes) instead of O(cluster).
+INDEX_SNAPSHOT_LOADS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_index_snapshot_loads_total",
+    "Persisted topology-index snapshot loads at startup, by outcome "
+    "(ok/empty/corrupt/version_mismatch/error); anything but ok "
+    "degrades that start to the full-parse cold path",
+)
+INDEX_SNAPSHOT_ENTRIES = EXTENDER_REGISTRY.counter(
+    "tpu_extender_index_snapshot_entries_total",
+    "Per-node snapshot records reconciled against the first relist, "
+    "by source (restored: annotation hash unchanged, installed "
+    "without parsing; stale: annotation changed while down, "
+    "re-parsed; vanished: node no longer in the cluster)",
+)
+INDEX_SNAPSHOT_WRITES = EXTENDER_REGISTRY.counter(
+    "tpu_extender_index_snapshot_writes_total",
+    "Topology-index snapshot persists (post-relist + graceful stop), "
+    "by outcome (ok/error); sustained errors mean the next failover "
+    "pays a full parse",
+)
+INDEX_WARM_SECONDS = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_index_warm_seconds",
+    "Duration of the last cold-start index warm: snapshot-restored "
+    "(deferred) entries materialized by the background worker pool, "
+    "concurrent with journal replay — never on the readiness "
+    "critical path",
+)
+TIME_TO_READY = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_time_to_ready_seconds",
+    "Startup to /readyz 200 for this incarnation: snapshot load + "
+    "relist reconcile + journal replay + recovery — the scheduling-"
+    "outage window a restart/failover costs (the fast-failover SLO "
+    "number)",
 )
 LEASE_HELD = EXTENDER_REGISTRY.gauge(
     "tpu_extender_lease_held",
@@ -760,7 +801,19 @@ DEBUG_ENDPOINTS: Dict[str, str] = {
         "consistency-audit snapshot: invariant registry, open "
         "findings, sweep stats (audit.py; --audit-interval-s)"
     ),
+    "/debug/readyz": (
+        "readiness phase + index warm progress (extender: "
+        "replaying|warming|ready with warm parsed/total, always 200 "
+        "— the probe-semantics 503 lives at /readyz; plugin: "
+        "not configured)"
+    ),
 }
+
+# () -> dict readiness snapshot (extender/server.py ReadyStatus),
+# installed by the extender entrypoint. The /debug/readyz surface —
+# unlike /readyz it always answers 200 so tpu-doctor bundles capture
+# the phase/warm payload even (especially) from a not-ready daemon.
+READYZ_PROVIDER = None
 
 
 def debug_payload(path: str) -> Optional[bytes]:
@@ -799,6 +852,14 @@ def debug_payload(path: str) -> Optional[bytes]:
             from .. import audit
 
             return audit.debug_snapshot()
+        if parsed.path == "/debug/readyz":
+            if READYZ_PROVIDER is None:
+                return {
+                    "configured": False,
+                    "note": "no readiness status wired in this "
+                    "process (the extender entrypoint installs one)",
+                }
+            return READYZ_PROVIDER()
         if parsed.path == "/debug/traces":
             trace_id = dict(_up.parse_qsl(parsed.query)).get(
                 "trace_id", ""

@@ -74,6 +74,69 @@ def encode_record(rec: dict) -> bytes:
     return _crc(payload).encode() + b" " + payload + b"\n"
 
 
+def snapshot_doc(data: dict, seq: int = 0) -> dict:
+    """Wrap a state document in the checksummed snapshot envelope
+    (version + covered seq + CRC over the canonical data encoding).
+    ONE builder shared by StateStore.compact and standalone snapshot
+    writers (the extender's topology-index snapshot), so every snapshot
+    on disk validates through the same checksum grammar."""
+    payload = json.dumps(
+        data, separators=(",", ":"), sort_keys=True
+    ).encode()
+    return {
+        "version": SNAPSHOT_VERSION,
+        "seq": seq,
+        "checksum": _crc(payload),
+        "data": data,
+    }
+
+
+def write_snapshot_file(
+    path: str, doc: dict, tmp_path: Optional[str] = None
+) -> None:
+    """Atomically persist a snapshot document: tmp + fsync + rename
+    (the kubelet-checkpoint idiom). Raises OSError on disk trouble —
+    callers decide whether a failed snapshot is fatal (the admission
+    journal degrades; the index snapshot is purely an optimization)."""
+    tmp = tmp_path or path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_snapshot_file(
+    snapshot_path: str,
+) -> "tuple[Optional[dict], int, str]":
+    """(data, covered_seq, status) for one snapshot file. Status is
+    CLEAN (validated), EMPTY (no file), or SNAPSHOT_CORRUPT (unreadable
+    or checksum mismatch — the data is None and the caller must fall
+    back to its from-scratch rebuild). Never raises: a damaged snapshot
+    degrades to an absent one, exactly like the journal reader."""
+    try:
+        with open(snapshot_path, "rb") as f:
+            doc = json.loads(f.read())
+        payload = json.dumps(
+            doc.get("data"), separators=(",", ":"), sort_keys=True
+        ).encode()
+        if doc.get("checksum") != _crc(payload):
+            log.warning(
+                "snapshot %s failed its checksum; ignoring it",
+                snapshot_path,
+            )
+            return None, 0, SNAPSHOT_CORRUPT
+        return doc.get("data"), int(doc.get("seq", 0)), CLEAN
+    except FileNotFoundError:
+        return None, 0, EMPTY
+    except (OSError, ValueError, TypeError) as e:
+        log.warning(
+            "unreadable snapshot %s (%s); ignoring it", snapshot_path, e
+        )
+        return None, 0, SNAPSHOT_CORRUPT
+
+
 @dataclasses.dataclass
 class LoadResult:
     snapshot: Optional[dict]  # the last compacted state document, or None
@@ -134,31 +197,8 @@ def _read_files(
     sets from the same bytes. Returns (result, journal_status,
     good_end, journal_len); the extra three are what load()'s tail
     healing needs."""
-    snapshot = None
-    status = CLEAN
-    snap_seq = 0
-    try:
-        with open(snapshot_path, "rb") as f:
-            doc = json.loads(f.read())
-        payload = json.dumps(
-            doc.get("data"), separators=(",", ":"), sort_keys=True
-        ).encode()
-        if doc.get("checksum") != _crc(payload):
-            log.warning(
-                "snapshot %s failed its checksum; ignoring it",
-                snapshot_path,
-            )
-            status = SNAPSHOT_CORRUPT
-        else:
-            snapshot = doc.get("data")
-            snap_seq = int(doc.get("seq", 0))
-    except FileNotFoundError:
-        pass
-    except (OSError, ValueError, TypeError) as e:
-        log.warning(
-            "unreadable snapshot %s (%s); ignoring it", snapshot_path, e
-        )
-        status = SNAPSHOT_CORRUPT
+    snapshot, snap_seq, snap_status = read_snapshot_file(snapshot_path)
+    status = CLEAN if snap_status in (CLEAN, EMPTY) else snap_status
     try:
         with open(journal_path, "rb") as f:
             data = f.read()
@@ -346,15 +386,7 @@ class StateStore:
         record the data DID already include is harmless to keep."""
         with self._lock:
             snap_seq = self._seq if seq is None else min(seq, self._seq)
-            payload = json.dumps(
-                data, separators=(",", ":"), sort_keys=True
-            ).encode()
-            doc = {
-                "version": SNAPSHOT_VERSION,
-                "seq": snap_seq,
-                "checksum": _crc(payload),
-                "data": data,
-            }
+            doc = snapshot_doc(data, seq=snap_seq)
             keep = b""
             kept = 0
             if snap_seq < self._seq:
@@ -387,11 +419,9 @@ class StateStore:
                         keep += line + b"\n"
                         kept += 1
             os.makedirs(self.dir, exist_ok=True)
-            with open(self._tmp_path, "w") as f:
-                json.dump(doc, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(self._tmp_path, self.snapshot_path)
+            write_snapshot_file(
+                self.snapshot_path, doc, tmp_path=self._tmp_path
+            )
             # Crash HERE is safe: load() skips journal records with
             # seq <= the snapshot's (and the uncovered suffix, if any,
             # is restored below before anything else is appended).

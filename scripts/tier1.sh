@@ -44,4 +44,12 @@ python -m k8s_device_plugin_tpu.tools.doctor --self-test > /dev/null \
 # suite in tests/test_chaos_journal.py then covers the full daemon).
 python -m k8s_device_plugin_tpu.extender.journal --self-test > /dev/null \
   || { echo "extender/journal.py --self-test FAILED"; exit 1; }
+# Cold-start failover smoke: the persisted topology-index snapshot must
+# round-trip write -> load -> hash-validate -> restore -> warm into an
+# index indistinguishable from a freshly parsed one
+# (extender/scale_bench.py --cold-start-self-test) — a snapshot format
+# or restore-plumbing drift fails CI here; the full-scale >=5x
+# time-to-ready bound lives in tests/test_scale_bench.py.
+python -m k8s_device_plugin_tpu.extender.scale_bench --cold-start-self-test > /dev/null \
+  || { echo "scale_bench --cold-start-self-test FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,730 @@
+"""Fast failover: persistent topology-index snapshots + memoized
+annotation parsing + the parallel cold-start warm path (ISSUE 9).
+
+Covers the contracts the O(changed)-time-to-ready claim rests on:
+
+* **parity** — a snapshot-restored index (restored from disk, hash-
+  validated per node, warmed) is indistinguishable from a freshly
+  parsed one: entries, placeable counts, slice membership, exported
+  gauges — and the indexed /filter+/prioritize answers identically
+  even BEFORE the warm pool finishes (on-demand materialization);
+* **never wrong entries** — truncation/bit-flip fuzz on the snapshot
+  file, a derived-schema version bump, and a checksum tamper all fall
+  back to the full parse; an annotation that changed while the daemon
+  was down invalidates exactly that node;
+* the audit `placeable_recount` invariant sweeps clean immediately
+  after a snapshot-restored start;
+* the watch plane's unchanged-annotation short-circuit and event-storm
+  coalescing (one rebuild per node per tick);
+* /readyz phases (replaying|warming|ready) with warm progress, on the
+  HTTP server and the /debug/readyz surface.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+import requests
+
+from k8s_device_plugin_tpu.extender import index as index_mod
+from k8s_device_plugin_tpu.extender.index import (
+    TopologyIndex,
+    annotation_hash,
+)
+from k8s_device_plugin_tpu.extender.reservations import ReservationTable
+from k8s_device_plugin_tpu.extender.server import (
+    ExtenderHTTPServer,
+    NodeAnnotationCache,
+    ReadyStatus,
+    TopologyExtender,
+)
+from k8s_device_plugin_tpu.utils import metrics
+from k8s_device_plugin_tpu.api import constants
+from tests.test_extender import make_node, make_slice_nodes, tpu_pod
+
+TOPO_KEY = constants.TOPOLOGY_ANNOTATION
+from tests.test_topology_index import _ListClient
+
+
+@pytest.fixture(autouse=True)
+def _fresh_process_caches():
+    """Each test starts from a restarted-process shape (cold memo) and
+    leaves no placeable series behind in the process registry."""
+    index_mod.clear_derived_memo()
+    yield
+    index_mod.clear_derived_memo()
+    metrics.EXT_PLACEABLE_NODES.remove_matching()
+
+
+def _cluster_nodes():
+    """A mixed cluster: plain single hosts, a constrained host, a
+    multi-host slice, a malformed annotation, and a no-annotation
+    node — every entry shape the snapshot must round-trip."""
+    nodes = [
+        make_node("full")[0],
+        make_node("tight", available=["tpu-0000:00:04.0"])[0],
+        make_node("empty", available=[])[0],
+    ]
+    nodes += make_slice_nodes(["s0", "s1"], "2,1,1", busy=("s1",))
+    nodes.append(
+        {
+            "metadata": {
+                "name": "mangled",
+                "annotations": {
+                    "google.com/tpu-topology": "{not json"
+                },
+            }
+        }
+    )
+    nodes.append({"metadata": {"name": "bare"}})
+    return nodes
+
+
+def _snapshot_dir(tmp_path, nodes):
+    """Build + persist a snapshot from a first daemon incarnation."""
+    d = str(tmp_path / "snap")
+    cache = NodeAnnotationCache(
+        _ListClient(nodes), interval_s=3600, snapshot_dir=d
+    )
+    cache.refresh()  # writes the snapshot as its final step
+    assert os.path.exists(os.path.join(d, "index.snapshot.json"))
+    return d
+
+
+def _restored_cache(nodes, d, **kw):
+    index_mod.clear_derived_memo()
+    from k8s_device_plugin_tpu.topology.schema import _parse_template
+
+    _parse_template.cache_clear()
+    cache = NodeAnnotationCache(
+        _ListClient(nodes), interval_s=3600, snapshot_dir=d, **kw
+    )
+    assert cache.load_snapshot() > 0
+    cache.refresh()
+    return cache
+
+
+# ---------------------------------------------------------------------------
+# parity: restored == freshly parsed
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_restore_parity_after_warm(tmp_path):
+    nodes = _cluster_nodes()
+    d = _snapshot_dir(tmp_path, nodes)
+
+    fresh = NodeAnnotationCache(_ListClient(nodes), interval_s=3600)
+    fresh.refresh()
+    restored = _restored_cache(nodes, d)
+
+    # Before warm: every annotation-bearing node restored, zero parsed.
+    wp = restored.index.warm_progress()
+    # "mangled" restores as a non-deferred negative entry; 5 good ones
+    # defer.
+    assert wp == {"parsed": 1, "total": 6}, wp
+    assert restored.index.warm_remaining() == 5
+
+    # Entry-for-entry equality (dataclass eq covers raw, derived
+    # fields, the parsed topo, and the deferred flag).
+    for name in (
+        "full", "tight", "empty", "s0", "s1", "mangled",
+    ):
+        assert restored.index.get(name) == fresh.index.get(name), name
+    assert restored.index.get("bare") is None
+    assert restored.index.known("bare")
+
+    # Aggregate planes: placeable counts, slice membership, stats.
+    assert (
+        restored.index.placeable_snapshot()
+        == fresh.index.placeable_snapshot()
+    )
+    assert restored.index.stats() == fresh.index.stats()
+    assert restored.index.slice_members(
+        ("s0", "s1")
+    ) == fresh.index.slice_members(("s0", "s1"))
+
+
+def test_snapshot_restore_gauges_match_fresh(tmp_path):
+    """The exported tpu_extender_placeable_nodes series after a
+    restored start equals the freshly-parsed series — before AND after
+    warm (restore installs the persisted placeable terms)."""
+    nodes = _cluster_nodes()
+    fresh = NodeAnnotationCache(_ListClient(nodes), interval_s=3600)
+    fresh.refresh()
+    want = sorted(
+        (labels["size"], v)
+        for labels, v in metrics.EXT_PLACEABLE_NODES.series()
+    )
+    assert want  # the fixture publishes at least one size
+    d = _snapshot_dir(tmp_path, nodes)
+    metrics.EXT_PLACEABLE_NODES.remove_matching()
+
+    restored = _restored_cache(nodes, d)
+    got_cold = sorted(
+        (labels["size"], v)
+        for labels, v in metrics.EXT_PLACEABLE_NODES.series()
+    )
+    assert got_cold == want
+    restored.index.warm_remaining()
+    got_warm = sorted(
+        (labels["size"], v)
+        for labels, v in metrics.EXT_PLACEABLE_NODES.series()
+    )
+    assert got_warm == want
+
+
+def test_rpc_parity_before_warm_materializes_on_demand(tmp_path):
+    """The indexed /filter+/prioritize answer identically from a
+    restored-but-unwarmed index: deferred candidates materialize on
+    demand (racing the warm pool in production)."""
+    nodes = _cluster_nodes()
+    names = [n["metadata"]["name"] for n in nodes]
+    d = _snapshot_dir(tmp_path, nodes)
+
+    fresh = NodeAnnotationCache(_ListClient(nodes), interval_s=3600)
+    fresh.refresh()
+    ext_fresh = TopologyExtender(
+        reservations=ReservationTable(), node_cache=fresh
+    )
+    restored = _restored_cache(nodes, d)
+    assert restored.index.warm_progress()["parsed"] == 1  # unwarmed
+    ext_restored = TopologyExtender(
+        reservations=ReservationTable(), node_cache=restored
+    )
+    for n in (1, 2, 4, 8):
+        pod = tpu_pod(n)
+        assert ext_restored.filter_names(
+            pod, names
+        ) == ext_fresh.filter_names(pod, names), n
+        assert ext_restored.prioritize_names(
+            pod, names
+        ) == ext_fresh.prioritize_names(pod, names), n
+    # The RPCs materialized what they touched.
+    assert restored.index.warm_progress()["parsed"] == 6
+
+
+def test_audit_placeable_recount_clean_after_restore(tmp_path):
+    """Acceptance: audit.py's placeable_recount invariant sweeps clean
+    immediately after a snapshot-restored start (deferred entries and
+    all), and again after the warm completes."""
+    from k8s_device_plugin_tpu import audit
+
+    nodes = _cluster_nodes()
+    d = _snapshot_dir(tmp_path, nodes)
+    metrics.EXT_PLACEABLE_NODES.remove_matching()
+    restored = _restored_cache(nodes, d)
+    engine = audit.ExtenderAudit(index=restored.index).engine(
+        interval_s=3600
+    )
+    try:
+        assert engine.sweep_once() == []
+        restored.index.warm_remaining()
+        assert engine.sweep_once() == []
+    finally:
+        metrics.EXT_AUDIT_FINDINGS.remove_matching()
+
+
+# ---------------------------------------------------------------------------
+# staleness: exactly the changed node re-parses
+# ---------------------------------------------------------------------------
+
+
+def test_annotation_changed_while_down_invalidates_exactly_that_node(
+    tmp_path,
+):
+    nodes = [make_node(f"n{i}")[0] for i in range(4)]
+    d = _snapshot_dir(tmp_path, nodes)
+    # n2's annotation changed while the daemon was down.
+    changed = make_node("n2", available=[])[0]
+    live = [nodes[0], nodes[1], changed, nodes[3]]
+    before = metrics.INDEX_SNAPSHOT_ENTRIES.get(source="stale")
+    restored = _restored_cache(live, d)
+    assert (
+        metrics.INDEX_SNAPSHOT_ENTRIES.get(source="stale") - before
+        == 1
+    )
+    # The changed node parsed fresh (not deferred) with the NEW truth;
+    # the unchanged ones restored deferred with the old (still-valid)
+    # derived numbers.
+    e2 = restored.index.get("n2")
+    assert not e2.deferred and e2.avail == 0 and e2.topo is not None
+    for name in ("n0", "n1", "n3"):
+        e = restored.index.get(name)
+        assert e.deferred and e.avail == 4, name
+
+
+def test_vanished_node_records_are_discarded(tmp_path):
+    nodes = [make_node(f"n{i}")[0] for i in range(3)]
+    d = _snapshot_dir(tmp_path, nodes)
+    before = metrics.INDEX_SNAPSHOT_ENTRIES.get(source="vanished")
+    restored = _restored_cache(nodes[:2], d)
+    assert (
+        metrics.INDEX_SNAPSHOT_ENTRIES.get(source="vanished") - before
+        == 1
+    )
+    assert restored.index.get("n2") is None
+    assert not restored.index.known("n2")
+    assert len(restored.index) == 2
+
+
+# ---------------------------------------------------------------------------
+# corruption: damaged snapshots fall back to full parse, never wrong
+# ---------------------------------------------------------------------------
+
+
+def _expect_never_wrong(nodes, d, require_fallback=False):
+    """Load + refresh + warm must ALWAYS converge on the correct
+    index. A damaged snapshot falls back to the full parse; damage
+    confined to the non-checksummed envelope fields (seq, store
+    version) legitimately still restores — correctly, because the
+    data document is checksum-protected. ``require_fallback`` pins
+    the stronger expectation where the data is provably unreadable."""
+    cache = NodeAnnotationCache(
+        _ListClient(nodes), interval_s=3600, snapshot_dir=d
+    )
+    cache.load_snapshot()
+    cache.refresh()
+    if require_fallback:
+        assert (
+            cache.index.warm_progress()["parsed"] == len(cache.index)
+        )
+    cache.index.warm_remaining()
+    fresh = NodeAnnotationCache(_ListClient(nodes), interval_s=3600)
+    fresh.refresh()
+    for n in nodes:
+        name = n["metadata"]["name"]
+        assert cache.index.get(name) == fresh.index.get(name), name
+
+
+def test_snapshot_truncation_fuzz_falls_back_to_full_parse(tmp_path):
+    """tests/test_journal.py's truncation-fuzz convention on the index
+    snapshot: at EVERY truncation offset the loader either validates
+    or ignores the file — a fully-parsed, correct index either way."""
+    nodes = [make_node(f"n{i}")[0] for i in range(3)]
+    d = _snapshot_dir(tmp_path, nodes)
+    path = os.path.join(d, "index.snapshot.json")
+    data = open(path, "rb").read()
+    # Every offset on small files; a rotating stride on bigger ones
+    # keeps the fuzz loop fast while still crossing every region.
+    step = max(1, len(data) // 64)
+    for cut in range(0, len(data), step):
+        with open(path, "wb") as f:
+            f.write(data[:cut])
+        # A truncated JSON document can never validate: full parse.
+        _expect_never_wrong(nodes, d, require_fallback=cut < len(data))
+        metrics.EXT_PLACEABLE_NODES.remove_matching()
+
+
+def test_snapshot_bitflip_fuzz_falls_back_to_full_parse(tmp_path):
+    nodes = [make_node(f"n{i}")[0] for i in range(3)]
+    d = _snapshot_dir(tmp_path, nodes)
+    path = os.path.join(d, "index.snapshot.json")
+    data = bytearray(open(path, "rb").read())
+    step = max(1, len(data) // 48)
+    for pos in range(0, len(data), step):
+        flipped = bytearray(data)
+        flipped[pos] ^= 0x40
+        with open(path, "wb") as f:
+            f.write(bytes(flipped))
+        # A flip can land in JSON syntax (unreadable), in the
+        # checksum (mismatch), in the data (the checksum catches it),
+        # or in a non-checksummed envelope field (seq/store version —
+        # harmlessly still restorable). Every case must converge on
+        # the correct index; a WRONG entry is the one impossible
+        # outcome (the checksum covers the whole data document, so a
+        # flipped node name/derived field can never validate).
+        _expect_never_wrong(nodes, d)
+        metrics.EXT_PLACEABLE_NODES.remove_matching()
+
+
+def test_snapshot_version_mismatch_is_ignored(tmp_path):
+    nodes = [make_node("n0")[0]]
+    d = _snapshot_dir(tmp_path, nodes)
+    path = os.path.join(d, "index.snapshot.json")
+    doc = json.loads(open(path).read())
+    # Re-wrap a future-versioned data document with a VALID checksum:
+    # version gating must not depend on the checksum failing.
+    from k8s_device_plugin_tpu.utils import statestore
+
+    data = doc["data"]
+    data["v"] = 999
+    statestore.write_snapshot_file(
+        path, statestore.snapshot_doc(data)
+    )
+    before = metrics.INDEX_SNAPSHOT_LOADS.get(
+        outcome="version_mismatch"
+    )
+    cache = NodeAnnotationCache(
+        _ListClient(nodes), interval_s=3600, snapshot_dir=d
+    )
+    assert cache.load_snapshot() == 0
+    assert (
+        metrics.INDEX_SNAPSHOT_LOADS.get(outcome="version_mismatch")
+        - before
+        == 1
+    )
+    cache.refresh()
+    assert cache.index.warm_progress()["parsed"] == 1  # full parse
+
+
+def test_snapshot_write_skipped_when_unchanged(tmp_path):
+    """A pure-restore start leaves the disk byte-identical, so the
+    post-relist rewrite is skipped — including on a MIXED cluster
+    (annotation-less nodes are not persisted, so their negative-cache
+    install must not mark the snapshot dirty); a real change writes."""
+    nodes = [make_node(f"n{i}")[0] for i in range(2)]
+    nodes.append({"metadata": {"name": "plain"}})  # no annotation
+    d = _snapshot_dir(tmp_path, nodes)
+    path = os.path.join(d, "index.snapshot.json")
+    mtime = os.stat(path).st_mtime_ns
+    restored = _restored_cache(nodes, d)
+    assert os.stat(path).st_mtime_ns == mtime  # skipped
+    # An annotation flip makes the state diverge → the next write
+    # persists it.
+    restored.apply_event(
+        "MODIFIED", make_node("n0", available=[])[0]
+    )
+    assert restored.write_snapshot() is True
+    assert os.stat(path).st_mtime_ns != mtime
+    # And the NEXT incarnation restores the flipped truth.
+    nodes2 = [make_node("n0", available=[])[0], nodes[1]]
+    cache2 = _restored_cache(nodes2, d)
+    assert cache2.index.get("n0").deferred
+    assert cache2.index.get("n0").avail == 0
+
+
+# ---------------------------------------------------------------------------
+# memoized parsing + watch short-circuit + storm coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_unchanged_annotation_watch_event_short_circuits():
+    """Satellite regression: a MODIFIED event whose annotation string
+    is unchanged (relist echo / status-only update) must not rebuild —
+    and the avoidance is counted with its reason label."""
+    node, _ = make_node("n1")
+    cache = NodeAnnotationCache(_ListClient([node]), interval_s=3600)
+    cache.refresh()
+    entry = cache.index.get("n1")
+    rebuilds = metrics.INDEX_REBUILDS.get()
+    avoided = metrics.PARSE_AVOIDED.get(reason="unchanged_annotation")
+    # Status-only MODIFIED: same annotation string, new echo.
+    echo = {
+        "metadata": {
+            "name": "n1",
+            "annotations": dict(node["metadata"]["annotations"]),
+            "resourceVersion": "999",
+        }
+    }
+    assert cache.apply_event("MODIFIED", echo) == "noop"
+    assert cache.index.get("n1") is entry  # identical object, no work
+    assert metrics.INDEX_REBUILDS.get() == rebuilds
+    assert (
+        metrics.PARSE_AVOIDED.get(reason="unchanged_annotation")
+        - avoided
+        == 1
+    )
+
+
+def test_derived_memo_serves_flip_flop_rebuilds():
+    """A→B→A annotation flip-flop: the third update re-derives nothing
+    (content-addressed memo hit), and the entry is still exact."""
+    a, _ = make_node("n1")
+    b, _ = make_node("n1", available=[])
+    idx = TopologyIndex()
+    idx.update("n1", a["metadata"]["annotations"][TOPO_KEY])
+    first = idx.get("n1")
+    raw_b = b["metadata"]["annotations"][TOPO_KEY]
+    idx.update("n1", raw_b)
+    hits = metrics.PARSE_AVOIDED.get(reason="derived_memo")
+    raw_a = a["metadata"]["annotations"][TOPO_KEY]
+    idx.update("n1", raw_a)
+    assert metrics.PARSE_AVOIDED.get(reason="derived_memo") - hits == 1
+    assert idx.get("n1") == first
+
+
+def test_malformed_annotation_memoized_as_bad():
+    idx = TopologyIndex()
+    assert idx.update("x", "{not json") == "add"
+    hits = metrics.PARSE_AVOIDED.get(reason="derived_memo")
+    # A DIFFERENT node republishing the same bad string: memo says
+    # bad, no parse attempt.
+    assert idx.update("y", "{not json") == "add"
+    assert metrics.PARSE_AVOIDED.get(reason="derived_memo") - hits == 1
+    assert idx.get("y").topo is None
+
+
+def test_event_storm_coalesces_to_one_rebuild_per_node(tmp_path):
+    """A burst of K distinct-annotation events for one node applies as
+    ONE rebuild with the latest truth (latest-per-node wins)."""
+    node, _ = make_node("n1")
+    cache = NodeAnnotationCache(
+        _ListClient([node]), interval_s=3600, event_coalesce_s=30.0
+    )
+    cache.refresh()
+    # Simulate the applier being alive without starting threads.
+    cache._applier_thread = threading.current_thread()
+    rebuilds = metrics.INDEX_REBUILDS.get()
+    coalesced = metrics.INDEX_EVENTS.get(
+        source="watch", kind="coalesced"
+    )
+    variants = [
+        make_node("n1", available=["tpu-0000:00:04.0"])[0],
+        make_node("n1", available=[])[0],
+        make_node("n1")[0],
+        make_node("n1", available=[])[0],
+    ]
+    for v in variants:
+        cache.offer_event("MODIFIED", v)
+    assert metrics.INDEX_REBUILDS.get() == rebuilds  # buffered
+    assert cache.flush_events() == 1
+    assert metrics.INDEX_REBUILDS.get() - rebuilds == 1
+    assert (
+        metrics.INDEX_EVENTS.get(source="watch", kind="coalesced")
+        - coalesced
+        == 3
+    )
+    assert cache.index.get("n1").avail == 0  # the LAST event's truth
+
+
+def test_coalescer_delete_then_add_lands_on_final_state():
+    node, _ = make_node("n1")
+    cache = NodeAnnotationCache(
+        _ListClient([node]), interval_s=3600, event_coalesce_s=30.0
+    )
+    cache.refresh()
+    cache._applier_thread = threading.current_thread()
+    cache.offer_event("DELETED", {"metadata": {"name": "n1"}})
+    cache.offer_event("ADDED", make_node("n1", available=[])[0])
+    cache.flush_events()
+    assert cache.index.get("n1").avail == 0
+
+
+# ---------------------------------------------------------------------------
+# warm pool + readiness surface
+# ---------------------------------------------------------------------------
+
+
+def test_background_warm_pool_drains_deferred_entries(tmp_path):
+    nodes = [make_node(f"n{i}")[0] for i in range(8)]
+    d = _snapshot_dir(tmp_path, nodes)
+    restored = _restored_cache(nodes, d, warm_workers=2)
+    assert restored.index.warm_progress()["parsed"] == 0
+    restored.start_warm()
+    try:
+        for t in restored._warm_threads:
+            t.join(timeout=10)
+        wp = restored.index.warm_progress()
+        assert wp == {"parsed": 8, "total": 8}, wp
+        assert metrics.INDEX_WARM_SECONDS.get() > 0
+        fresh = NodeAnnotationCache(_ListClient(nodes), interval_s=3600)
+        fresh.refresh()
+        for n in nodes:
+            name = n["metadata"]["name"]
+            assert restored.index.get(name) == fresh.index.get(name)
+    finally:
+        restored._stop.set()
+
+
+def test_warm_pool_starts_after_failed_initial_relist(tmp_path):
+    """The failover scenario itself: the apiserver is briefly down
+    when the extender restarts, so the INITIAL relist fails — the
+    snapshot restore happens on a later relist, and start_warm (re-
+    invoked from the relist loop) must still pick the deferred
+    entries up instead of leaving the whole parse to first demand."""
+    nodes = [make_node(f"n{i}")[0] for i in range(6)]
+    d = _snapshot_dir(tmp_path, nodes)
+
+    class FlakyClient(_ListClient):
+        def __init__(self, nodes):
+            super().__init__(nodes)
+            self.fail = True
+
+        def list_nodes(self, label_selector=""):
+            if self.fail:
+                raise ConnectionError("apiserver down at start")
+            return super().list_nodes(label_selector)
+
+    index_mod.clear_derived_memo()
+    client = FlakyClient(nodes)
+    cache = NodeAnnotationCache(
+        _ListClient(nodes), interval_s=3600, snapshot_dir=d,
+        warm_workers=2,
+    )
+    cache.client = client
+    assert cache.load_snapshot() > 0
+    with pytest.raises(ConnectionError):
+        cache.refresh()  # what start() catches
+    cache.start_warm()  # start()'s call: nothing to warm yet
+    assert not cache._warm_threads
+    # The relist loop's next pass succeeds and re-invokes start_warm.
+    client.fail = False
+    cache.refresh()
+    assert cache.index.warm_progress()["parsed"] == 0  # restored
+    cache.start_warm()
+    try:
+        assert cache._warm_threads
+        threads = list(cache._warm_threads)
+        # Idempotent: a second call never spawns NEW workers — either
+        # the originals are still alive (kept) or the warm already
+        # drained (nothing left to do).
+        cache.start_warm()
+        assert set(cache._warm_threads) <= set(threads)
+        for t in threads:
+            t.join(timeout=10)
+        assert cache.index.warm_progress() == {
+            "parsed": 6, "total": 6,
+        }
+    finally:
+        cache._stop.set()
+
+
+def test_indexed_rpc_parse_avoided_excludes_on_demand_parses(tmp_path):
+    """The fast-path coverage counter must not claim avoidance for
+    deferred candidates an RPC just materialized (paid parses)."""
+    nodes = [make_node(f"n{i}")[0] for i in range(4)]
+    names = [n["metadata"]["name"] for n in nodes]
+    d = _snapshot_dir(tmp_path, nodes)
+    restored = _restored_cache(nodes, d)
+    ext = TopologyExtender(
+        reservations=ReservationTable(), node_cache=restored
+    )
+    before = metrics.PARSE_AVOIDED.get(reason="indexed_rpc")
+    # First RPC: every candidate deferred → all parses paid here.
+    assert ext.filter_names(tpu_pod(1), names) is not None
+    assert metrics.PARSE_AVOIDED.get(reason="indexed_rpc") == before
+    # Second RPC: everything materialized → full avoidance.
+    assert ext.filter_names(tpu_pod(1), names) is not None
+    assert (
+        metrics.PARSE_AVOIDED.get(reason="indexed_rpc") - before == 4
+    )
+
+
+def test_ready_status_phases_and_http_surface():
+    """/readyz: 503 with phase=replaying during journal replay, then
+    warming, then 200 ready — with warm progress throughout; the POST
+    503 body names the phase too."""
+    idx = TopologyIndex()
+    node, _ = make_node("n1")
+    raw = node["metadata"]["annotations"][TOPO_KEY]
+    idx.restore(
+        "n1",
+        raw,
+        {
+            "avail": 4, "chips": 4, "host": "n1", "slice": None,
+            "placeable": [1, 2, 4],
+        },
+        h=annotation_hash(raw),
+    )
+    ready = threading.Event()
+    status = ReadyStatus(
+        ready, journal_configured=True, warm_progress=idx.warm_progress
+    )
+    srv = ExtenderHTTPServer(
+        extender=TopologyExtender(reservations=ReservationTable()),
+        host="127.0.0.1",
+        ready_check=ready.is_set,
+        ready_status=status.snapshot,
+    )
+    url = srv.start()
+    try:
+        r = requests.get(f"{url}/readyz", timeout=5)
+        assert r.status_code == 503
+        body = r.json()
+        assert body["phase"] == "replaying"
+        assert "rehydrating" in body["reason"]
+        assert body["warm"] == {"parsed": 0, "total": 1}
+        # Scheduler verbs refuse with the phase attached.
+        r = requests.post(f"{url}/filter", json={}, timeout=5)
+        assert r.status_code == 503
+        assert r.json()["phase"] == "replaying"
+
+        status.mark_replayed()
+        body = requests.get(f"{url}/readyz", timeout=5).json()
+        assert body["phase"] == "warming"
+        assert "warming" in body["reason"]
+
+        idx.warm_remaining()
+        status.mark_ready()
+        r = requests.get(f"{url}/readyz", timeout=5)
+        assert r.status_code == 200
+        body = r.json()
+        assert body["ok"] and body["phase"] == "ready"
+        assert body["warm"] == {"parsed": 1, "total": 1}
+        assert body["time_to_ready_s"] >= 0
+        assert metrics.TIME_TO_READY.get() == body["time_to_ready_s"]
+    finally:
+        srv.stop()
+
+
+def test_debug_readyz_surface_always_200():
+    """The tpu-doctor-facing surface: registered in DEBUG_ENDPOINTS,
+    served 200 by BOTH http servers (the plugin's reports
+    not-configured), carrying the phase payload on the extender."""
+    assert "/debug/readyz" in metrics.DEBUG_ENDPOINTS
+    ready = threading.Event()
+    status = ReadyStatus(ready, journal_configured=True)
+    saved = metrics.READYZ_PROVIDER
+    metrics.READYZ_PROVIDER = status.snapshot
+    srv = ExtenderHTTPServer(
+        extender=TopologyExtender(reservations=ReservationTable()),
+        host="127.0.0.1",
+    )
+    url = srv.start()
+    try:
+        r = requests.get(f"{url}/debug/readyz", timeout=5)
+        assert r.status_code == 200  # NOT 503: the bundle needs the body
+        assert r.json()["phase"] == "replaying"
+    finally:
+        srv.stop()
+        metrics.READYZ_PROVIDER = saved
+    # Plugin daemon (no provider): still a 200 JSON body.
+    msrv = metrics.MetricsServer(host="127.0.0.1")
+    murl = msrv.start()
+    try:
+        r = requests.get(f"{murl}/debug/readyz", timeout=5)
+        assert r.status_code == 200
+        assert r.json()["configured"] is False
+    finally:
+        msrv.stop()
+
+
+def test_gang_topo_source_materializes_deferred_entries(tmp_path):
+    """The admission tick's capacity view (index.topologies) must see
+    real topologies even when the warm pool hasn't finished."""
+    nodes = [make_node(f"n{i}")[0] for i in range(3)]
+    d = _snapshot_dir(tmp_path, nodes)
+    restored = _restored_cache(nodes, d)
+    assert restored.index.warm_progress()["parsed"] == 0
+    topos = restored.index.topologies()
+    assert len(topos) == 3
+    assert all(len(t.available) == 4 for t in topos)
+    assert restored.index.warm_progress()["parsed"] == 3
+
+
+# ---------------------------------------------------------------------------
+# docs + deploy lockstep (satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_failover_docs_and_deploy_in_lockstep():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ops = open(os.path.join(repo, "docs", "operations.md")).read()
+    assert "Extender failover timeline" in ops
+    for flag in (
+        "--index-snapshot-dir",
+        "--index-warm-workers",
+        "--node-event-coalesce-s",
+    ):
+        assert flag in ops, flag
+    assert "index.snapshot.json" in ops
+    obs = open(os.path.join(repo, "docs", "observability.md")).read()
+    assert "/debug/readyz" in obs
+    assert "index_snapshot" in obs  # the flight-recorder kind
+    manifest = open(
+        os.path.join(repo, "deploy", "tpu-extender.yml")
+    ).read()
+    assert "--index-snapshot-dir" in manifest
+    tier1 = open(os.path.join(repo, "scripts", "tier1.sh")).read()
+    assert "cold-start-self-test" in tier1

@@ -283,6 +283,62 @@ def test_audit_overhead_probe_bound_and_schema():
     assert "filter_p99_overhead_pct" in r
 
 
+def test_cold_start_snapshot_bounds_at_1000():
+    """ISSUE 9 acceptance, asserted at the 1,000-node default gate:
+    snapshot-warm time-to-ready is ≥5× faster than the full-parse arm
+    (p50 — the probe interleaves the arms sample-by-sample and runs
+    with GC off, so drift can't fake the ratio), and the fully-stale
+    fallback costs ≤1.05× the snapshotless path (+ the suite's small
+    absolute noise floor). The fast arm uses the 101-sample
+    convention; the parse-heavy arms are p50-bounded so fewer samples
+    suffice inside the gate's time budget. One full re-run for
+    host-contention flake, per the suite convention."""
+    from k8s_device_plugin_tpu import telemetry
+    from k8s_device_plugin_tpu.utils import metrics
+
+    saved_provider = telemetry.CLUSTER_PROVIDER
+
+    def probe():
+        return scale_bench.cold_start(
+            n_nodes=1000, ready_samples=101, slow_samples=7
+        )
+
+    def violations(r):
+        out = []
+        full = r["full_parse"]["time_to_ready"]["p50_ms"]
+        snap = r["snapshot_warm"]["time_to_ready"]["p50_ms"]
+        stale = r["snapshot_stale"]["time_to_ready"]["p50_ms"]
+        if snap * 5 > full:
+            out.append(
+                f"snapshot-warm time-to-ready p50 {snap}ms not 5x "
+                f"faster than full parse {full}ms"
+            )
+        if stale > 1.05 * full + 2.0:
+            out.append(
+                f"stale-snapshot fallback p50 {stale}ms exceeds "
+                f"1.05x full parse {full}ms (+2ms noise floor)"
+            )
+        return out
+
+    r = probe()
+    failures = violations(r)
+    if failures:
+        r = probe()
+        failures = violations(r)
+    assert not failures, failures
+    # Schema + restore completeness: every node restored per start,
+    # sample counts per the conventions above.
+    assert r["nodes"] == 1000
+    assert r["snapshot_warm"]["restored_per_start"] == 1000
+    assert r["snapshot_warm"]["time_to_ready"]["samples"] == 101
+    assert r["full_parse"]["time_to_ready"]["samples"] == 7
+    assert r["snapshot_warm"]["warm_drain"]["p50_ms"] > 0
+    assert r["snapshot_warm"]["cold_first_call"]["p50_ms"] > 0
+    # Probe hygiene (the sibling probes' save/restore contract).
+    assert telemetry.CLUSTER_PROVIDER is saved_provider
+    assert metrics.EXT_PLACEABLE_NODES.series() == []
+
+
 def test_scale_bench_correctness_assertions_fire():
     """run() itself asserts every node passes the all-free filter on
     BOTH paths (indexed and full-object), every gang releases in the
