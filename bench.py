@@ -73,6 +73,11 @@ more complete):
                                audited /filter p99 (bound <= 1.05x)
                                plus the documented sweep cost at
                                1,000 nodes
+  detail.profiler_overhead     sampling wall-clock profiler: paused vs
+                               19 Hz arms interleaved sample-by-sample
+                               over the indexed /filter (bound
+                               <= 1.05x p99) plus the sampler's own
+                               table stats
   detail.cold_start            extender failover: time-to-ready with a
                                persisted index snapshot vs the full
                                parse at 1,000 nodes (bound: snapshot
@@ -833,6 +838,20 @@ def main() -> int:
             )
         except Exception as e:  # noqa: BLE001
             result["detail"]["audit_overhead"] = {"error": repr(e)[:400]}
+        emit()
+        # Phase 1.10b: sampling-profiler overhead probe (ISSUE 10 —
+        # with the wall-clock profiler at the 19 Hz production rate,
+        # interleaved sample-by-sample against a paused-sampler
+        # control, the indexed /filter p99 must stay within 1.05x;
+        # the bound is enforced in tests/test_scale_bench.py).
+        try:
+            result["detail"]["profiler_overhead"] = (
+                scale_bench.profiler_overhead(n_nodes=1000)
+            )
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["profiler_overhead"] = {
+                "error": repr(e)[:400]
+            }
         emit()
         # Phase 1.11: cold-start failover probe (ISSUE 9 — a persisted
         # topology-index snapshot must make extender time-to-ready

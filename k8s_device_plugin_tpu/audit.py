@@ -55,7 +55,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from .api import constants
 from .kube import checkpoint as ckpt
 from .topology.placement import placeable_sizes
-from .utils import metrics
+from .utils import metrics, profiling
 from .utils.decisions import LEDGER
 from .utils.flightrecorder import RECORDER
 from .utils.logging import get_logger
@@ -196,10 +196,14 @@ class AuditEngine:
 
     def start(self) -> None:
         """Node-side cadence thread (the TelemetrySampler shape):
-        immediate first sweep, then one per interval."""
+        immediate first sweep, then one per interval. Supervised
+        (utils/profiling.py): the auditor watching every other plane
+        must not itself be able to die silently."""
         self._stop.clear()
         self._thread = threading.Thread(
-            target=self._run, name="tpu-audit", daemon=True
+            target=profiling.supervised("audit_sweep", self._run),
+            name="tpu-audit",
+            daemon=True,
         )
         self._thread.start()
 
@@ -214,7 +218,11 @@ class AuditEngine:
             "consistency auditor started: %d invariants, %.1fs interval",
             len(self.invariants), self.interval_s,
         )
+        hb = profiling.HEARTBEATS.register(
+            "audit_sweep", interval_s=self.interval_s
+        )
         while not self._stop.is_set():
+            hb.beat()
             try:
                 self.sweep_once()
             except Exception:  # noqa: BLE001 — the auditor must survive
@@ -390,6 +398,62 @@ def debug_snapshot() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Shared invariants (both daemons)
+# ---------------------------------------------------------------------------
+
+
+def check_thread_liveness() -> List[Finding]:
+    """Every registered long-lived loop (utils/profiling.HEARTBEATS)
+    must either beat within its own stall threshold or have been
+    stopped cleanly (which unregisters it). A DEAD loop — one that
+    exited on an unhandled exception (run_supervised marks it) — is
+    CRITICAL: whatever that loop maintained (gang gates, telemetry
+    series, audit sweeps, index freshness) is silently frozen until a
+    restart. A merely-silent loop is a WARNING: it may be wedged or
+    just slow, and the stall watchdog's capture bundle has the stack.
+    The finding clears on the next sweep after the loop restarts
+    (re-registering revives the heartbeat). The loop name rides the
+    Finding's ``chip`` slot — the generic small-subject field — so
+    two dead loops are two findings, not one."""
+    out: List[Finding] = []
+    for hb in profiling.HEARTBEATS.snapshot():
+        if hb["dead"]:
+            out.append(Finding.make(
+                "thread_liveness", CRITICAL,
+                f"background loop '{hb['name']}' died "
+                f"({hb['dead_reason']}): its plane is frozen until "
+                f"the loop restarts",
+                chip=hb["name"],
+                loop=hb["name"],
+                reason=hb["dead_reason"],
+                beats=hb["beats"],
+            ))
+        elif hb["age_s"] > hb["max_silence_s"]:
+            out.append(Finding.make(
+                "thread_liveness", WARNING,
+                f"background loop '{hb['name']}' heartbeat silent "
+                f"for {hb['age_s']:.1f}s "
+                f"(threshold {hb['max_silence_s']:.1f}s)",
+                chip=hb["name"],
+                loop=hb["name"],
+                age_s=hb["age_s"],
+                max_silence_s=hb["max_silence_s"],
+            ))
+    return out
+
+
+def thread_liveness_invariant() -> Invariant:
+    return Invariant(
+        "thread_liveness",
+        ("threads", "heartbeats"),
+        "every registered long-lived loop must beat its heartbeat "
+        "within its stall threshold; a dead loop (unhandled "
+        "exception) is critical — its plane is silently frozen",
+        check_thread_liveness,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Node-side invariants (plugin daemon)
 # ---------------------------------------------------------------------------
 
@@ -478,6 +542,7 @@ class NodeAudit:
                 "longer knows is leaked capacity",
                 self.check_orphaned_chips,
             ),
+            thread_liveness_invariant(),
         ]
 
     # -- shared facts ------------------------------------------------------
@@ -876,6 +941,11 @@ class ExtenderAudit:
                 "full aggregate each sweep)",
                 self.check_placeable_recount,
             ))
+        if out:
+            # Only when some plane is wired: a zero-plane ExtenderAudit
+            # must stay zero-invariant so the entrypoint's refuse-to-
+            # start-auditing-nothing guard keeps holding.
+            out.append(thread_liveness_invariant())
         return out
 
     # -- shared facts ------------------------------------------------------

@@ -32,7 +32,7 @@ from ..api import constants
 from ..kube import checkpoint as ckpt
 from ..kube.client import KubeClient, KubeError
 from ..kube.podresources import PodResourcesClient
-from ..utils import metrics, tracing
+from ..utils import metrics, profiling, tracing
 from ..utils.decisions import LEDGER
 from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
@@ -136,11 +136,19 @@ class Controller:
     def start(self) -> None:
         self.rebuild_state()
         self._stop.clear()
-        for name, target in (
-            ("pod-informer", self._informer_loop),
-            ("pod-worker", self._worker_loop),
+        # Supervised targets (utils/profiling.py): a dead informer
+        # means annotations/attribution silently freeze; a dead worker
+        # means chips stop being freed — both now count, flight-record,
+        # and trip the thread_liveness audit invariant.
+        for name, loop_name, target in (
+            ("pod-informer", "pod_informer", self._informer_loop),
+            ("pod-worker", "pod_worker", self._worker_loop),
         ):
-            t = threading.Thread(target=target, name=name, daemon=True)
+            t = threading.Thread(
+                target=profiling.supervised(loop_name, target),
+                name=name,
+                daemon=True,
+            )
             t.start()
             self._threads.append(t)
 
@@ -385,7 +393,17 @@ class Controller:
     def _informer_loop(self) -> None:
         resource_version = ""
         last_list = 0.0
+        # A healthy iteration can block in the watch stream for the
+        # whole window, so the threshold is generous.
+        hb = profiling.HEARTBEATS.register(
+            "pod_informer",
+            interval_s=self.resync_interval_s,
+            max_silence_s=max(
+                4 * self.resync_interval_s, 180.0
+            ),
+        )
         while not self._stop.is_set():
+            hb.beat()
             try:
                 # Periodic resync (informer-style): catches pods whose
                 # kubelet checkpoint entry appeared after their last pod

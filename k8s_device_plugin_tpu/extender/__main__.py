@@ -156,6 +156,36 @@ def main() -> int:
         "deduped; 0 disables)",
     )
     p.add_argument(
+        "--profile-hz", type=float,
+        default=float(os.environ.get("TPU_PROFILE_HZ", "0") or 0),
+        help="run the sampling wall-clock profiler at this rate "
+        "(utils/stackprof.py; also TPU_PROFILE_HZ): folded stacks "
+        "served at /debug/profile (?seconds=N, ?format=collapsed), "
+        "captured into SLO-breach bundles. 0 (the default) runs no "
+        "sampler thread at all; overhead at 19 Hz is bounded by "
+        "bench.py detail.profiler_overhead",
+    )
+    p.add_argument(
+        "--capture-dir",
+        default=os.environ.get("TPU_CAPTURE_DIR", ""),
+        help="directory for SLO-triggered black-box capture bundles "
+        "(utils/profiling.py CaptureManager; also TPU_CAPTURE_DIR): "
+        "when a windowed /filter or /prioritize p99 crosses "
+        "--capture-p99-ms, or a loop heartbeat stalls, the last "
+        "minute of profile samples + the flight ring + the ledger "
+        "tail + a metrics snapshot are dumped atomically as one JSON "
+        "bundle (crossing-deduped, budget-limited). Empty disables "
+        "capture",
+    )
+    p.add_argument(
+        "--capture-p99-ms", type=float,
+        default=float(os.environ.get("TPU_CAPTURE_P99_MS", "0") or 0),
+        help="windowed p99 threshold (ms) over /filter and "
+        "/prioritize that triggers a capture bundle; 0 disables the "
+        "SLO trigger (heartbeat-stall captures still fire with "
+        "--capture-dir set)",
+    )
+    p.add_argument(
         "--log-json", action="store_true",
         help="JSON-lines logging with trace correlation "
         "(also TPU_LOG_JSON=1)",
@@ -182,6 +212,29 @@ def main() -> int:
     from ..utils import metrics as tpumetrics
 
     tpumetrics.set_build_info("extender")
+    # Runtime-performance plane (utils/profiling.py + stackprof.py):
+    # heartbeat watchdog + GC pauses always on (cheap by construction);
+    # the sampling profiler and black-box capture opt in via flags.
+    from ..utils import profiling, stackprof
+
+    profiling.set_service("extender")
+    profiling.enable_gc_monitor()
+    profiler = None
+    if a.profile_hz > 0:
+        profiler = stackprof.SamplingProfiler(
+            hz=a.profile_hz, service="extender"
+        )
+        stackprof.install_profiler(profiler)
+        profiler.start()
+    profiling.CAPTURE.configure(
+        capture_dir=a.capture_dir,
+        p99_ms=a.capture_p99_ms,
+        service="extender",
+    )
+    watchdog = profiling.StallWatchdog(
+        service="extender",
+        on_stall=profiling.CAPTURE.heartbeat_stall,
+    ).start()
     from .reservations import ReservationTable
     from .server import (
         NodeAnnotationCache,
@@ -389,6 +442,10 @@ def main() -> int:
     stop.wait()
     # Post-mortem capture before teardown starts losing state.
     RECORDER.dump_on("sigterm")
+    watchdog.stop()
+    if profiler is not None:
+        profiler.stop()
+        stackprof.install_profiler(None)
     if auditor is not None and gang is None:
         auditor.stop()  # loop-driven engines stop with the gang loop
     if gang is not None:

@@ -48,7 +48,7 @@ from ..api import constants
 from ..kube.client import KubeClient, KubeError
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..topology.slice import SliceView
-from ..utils import metrics, tracing
+from ..utils import metrics, profiling, tracing
 from ..utils.decisions import LEDGER
 from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
@@ -478,13 +478,21 @@ class GangAdmission:
 
     def start(self) -> None:
         self._stop.clear()
+        # Supervised targets (utils/profiling.py): an unhandled
+        # exception out of either loop is counted, flight-recorded,
+        # and trips the thread_liveness audit invariant instead of
+        # silently ending gang admission for the cluster.
         self._thread = threading.Thread(
-            target=self._loop, name="gang-admission", daemon=True
+            target=profiling.supervised("gang_tick", self._loop),
+            name="gang-admission",
+            daemon=True,
         )
         self._thread.start()
         if self.watch:
             self._watch_thread = threading.Thread(
-                target=self._watch_loop,
+                target=profiling.supervised(
+                    "gang_pod_watch", self._watch_loop
+                ),
                 name="gang-pod-watch",
                 daemon=True,
             )
@@ -663,7 +671,16 @@ class GangAdmission:
         return summary
 
     def _loop(self) -> None:
+        # Stall-watchdog heartbeat: a tick loop frozen inside one tick
+        # (deadlocked pool, hung kube call past every deadline) stops
+        # beating and tpu_thread_heartbeat_age_seconds{loop="gang_tick"}
+        # gives it away — gates stop coming off the moment this wedges,
+        # so this loop's silence IS the outage.
+        hb = profiling.HEARTBEATS.register(
+            "gang_tick", interval_s=self.resync_interval_s
+        )
         while not self._stop.is_set():
+            hb.beat()
             try:
                 # Dirty tick by default; full sweep on the backstop
                 # cadence (level-triggered: whatever an event missed,
@@ -914,7 +931,13 @@ class GangAdmission:
         full sweep (mark_all_dirty) — events are an optimization, never
         a correctness dependency."""
         rv = ""
+        # Generous silence threshold: a healthy watch legitimately
+        # blocks the full 60 s stream window with zero events.
+        hb = profiling.HEARTBEATS.register(
+            "gang_pod_watch", interval_s=60.0, max_silence_s=180.0
+        )
         while not self._stop.is_set():
+            hb.beat()
             try:
                 for etype, pod in self.client.watch_pods(
                     label_selector=GANG_NAME_LABEL,
@@ -923,6 +946,7 @@ class GangAdmission:
                 ):
                     if self._stop.is_set():
                         return
+                    hb.beat()
                     if etype == "BOOKMARK":
                         rv = (
                             (pod.get("metadata") or {}).get(

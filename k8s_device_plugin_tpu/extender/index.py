@@ -40,7 +40,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from .. import telemetry
 from ..topology.placement import placeable_sizes
 from ..topology.schema import NodeTopology, parse_topology_cached
-from ..utils import metrics
+from ..utils import metrics, profiling
 from ..utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -178,7 +178,14 @@ class TopologyIndex:
         # costing per-RPC fetches (same rationale as the cache's).
         self._no_topo: Set[str] = set()
         self._slice_members: Dict[SliceKey, Set[str]] = {}
-        self._lock = threading.Lock()
+        # Instrumented lock (utils/profiling.TimedLock): a CONTENDED
+        # acquire — a watch rebuild racing an RPC's on-demand
+        # materialization — lands its wait in
+        # tpu_lock_wait_seconds{lock="topology_index"}; the
+        # uncontended path costs one extra try-acquire.
+        self._lock = profiling.TimedLock(
+            "topology_index", metrics.EXT_LOCK_WAIT
+        )
         # Called AFTER an entry actually changed, with the node name and
         # every slice key involved (old and new) — gang admission's
         # dirty marking hangs off this.

@@ -44,9 +44,10 @@ the journal exists to close.
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from typing import Dict, Optional, Set, Tuple
+
+from ..utils import metrics, profiling
 
 GangKey = Tuple[str, str]  # (namespace, gang name)
 
@@ -86,7 +87,13 @@ class ReservationTable:
         self.ttl_s = ttl_s
         self.max_age_s = max_age_s
         self._clock = clock
-        self._lock = threading.Lock()
+        # Instrumented lock (utils/profiling.TimedLock): every /filter
+        # thread and the gang tick serialize here, so convoy on this
+        # lock is scheduler-visible latency — contended waits land in
+        # tpu_lock_wait_seconds{lock="reservations"}.
+        self._lock = profiling.TimedLock(
+            "reservations", metrics.EXT_LOCK_WAIT
+        )
         self._by_gang: Dict[GangKey, Reservation] = {}
         # State-transition observer: callable(op, gang_key, payload)
         # invoked under the table lock (ordering must match mutation

@@ -37,7 +37,7 @@ from ..api import constants
 from ..topology.placement import PlacementState, ideal_box_links
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..topology.slice import SliceView, group_by_slice
-from ..utils import metrics, statestore, tracing
+from ..utils import metrics, profiling, statestore, tracing
 from ..utils.decisions import LEDGER
 from ..utils.flightrecorder import RECORDER
 from ..utils.httpserver import BackgroundHTTPServer
@@ -832,6 +832,9 @@ class NodeAnnotationCache:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Relist-loop heartbeat (set when the loop starts; the watch
+        # plane beats it per stream window).
+        self._hb = None
 
     @property
     def synced(self) -> bool:
@@ -853,13 +856,20 @@ class NodeAnnotationCache:
             metrics.NODE_CACHE_RELIST_ERRORS.inc()
             log.warning("initial node-cache relist failed: %s", e)
         self.start_warm()
+        # Supervised targets (utils/profiling.py): a dead relist loop
+        # used to mean silently-stale topology forever; now it counts,
+        # flight-records, and trips the thread_liveness invariant.
         self._thread = threading.Thread(
-            target=self._loop, name="node-annotation-cache", daemon=True
+            target=profiling.supervised("node_cache_relist", self._loop),
+            name="node-annotation-cache",
+            daemon=True,
         )
         self._thread.start()
         if self.watch and self.event_coalesce_s > 0:
             self._applier_thread = threading.Thread(
-                target=self._applier_loop,
+                target=profiling.supervised(
+                    "node_event_applier", self._applier_loop
+                ),
                 name="node-event-applier",
                 daemon=True,
             )
@@ -987,16 +997,26 @@ class NodeAnnotationCache:
             return
         self._warm_t0 = time.monotonic()
         for i in range(self.warm_workers):
+            loop_name = f"index_warm_{i}"
             t = threading.Thread(
-                target=self._warm_loop,
+                target=profiling.supervised(
+                    loop_name,
+                    lambda n=loop_name: self._warm_loop(n),
+                ),
                 name=f"index-warm-{i}",
                 daemon=True,
             )
             t.start()
             self._warm_threads.append(t)
 
-    def _warm_loop(self) -> None:
+    def _warm_loop(self, loop_name: str = "index_warm") -> None:
+        # Transient heartbeat: registered while draining, unregistered
+        # by the supervised wrapper on clean exit — a warm worker that
+        # wedges mid-parse shows a frozen age, one that finishes
+        # disappears from the table.
+        hb = profiling.HEARTBEATS.register(loop_name, interval_s=1.0)
         while not self._stop.is_set():
+            hb.beat()
             name = self.index.claim_deferred()
             if name is None:
                 break
@@ -1043,10 +1063,21 @@ class NodeAnnotationCache:
         return len(batch)
 
     def _applier_loop(self) -> None:
+        hb = profiling.HEARTBEATS.register(
+            "node_event_applier", interval_s=1.0
+        )
         while not self._stop.is_set():
-            self._event_wake.wait()
+            # Bounded wait (was unbounded): the applier beats its
+            # heartbeat at least once a second even with zero events,
+            # so "idle" and "wedged" are distinguishable on the
+            # watchdog gauge. Semantics are unchanged — an empty wake
+            # drains an empty batch.
+            woke = self._event_wake.wait(timeout=1.0)
+            hb.beat()
             if self._stop.is_set():
                 break
+            if not woke:
+                continue
             self._event_wake.clear()
             # Let the burst accumulate for one tick, then drain it.
             self._stop.wait(self.event_coalesce_s)
@@ -1061,8 +1092,22 @@ class NodeAnnotationCache:
         backoff = Backoff(
             base=self.interval_s, max_delay=max(60.0, self.interval_s)
         )
+        # In watch mode one healthy iteration legitimately blocks for
+        # the whole backstop window (the stream beats the heartbeat
+        # per 60 s watch window inside _watch_until_stale); the
+        # threshold covers that plus slack.
+        self._hb = profiling.HEARTBEATS.register(
+            "node_cache_relist",
+            interval_s=self.interval_s,
+            max_silence_s=(
+                self.watch_backstop_s + 180.0
+                if self.watch
+                else profiling.default_max_silence(self.interval_s)
+            ),
+        )
         wait = self.interval_s
         while not self._stop.wait(wait):
+            self._hb.beat()
             try:
                 self.refresh()
                 backoff.reset()
@@ -1261,7 +1306,12 @@ class NodeAnnotationCache:
 
         deadline = _time.monotonic() + self.watch_backstop_s
         rv = self._resource_version
+        hb = getattr(self, "_hb", None)
         while not self._stop.is_set() and _time.monotonic() < deadline:
+            if hb is not None:
+                # One beat per stream window: the relist loop's
+                # heartbeat keeps moving through a long healthy watch.
+                hb.beat()
             window = min(60.0, max(1.0, deadline - _time.monotonic()))
             try:
                 for etype, obj in self.client.watch_nodes(
@@ -1513,6 +1563,7 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                 names = _get_ci(args, "nodenames")
                 names_mode = bool(names) and not items
                 verb = self.path.strip("/")
+                t0 = time.perf_counter()
                 try:
                     fast_filter = fast_scores = None
                     if names_mode:
@@ -1570,6 +1621,11 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                         self._send({"error": f"unknown path {self.path}"}, 404)
                         return
                     metrics.EXTENDER_REQUESTS.inc(verb=verb, outcome="ok")
+                    # SLO-triggered capture feed (utils/profiling.py):
+                    # one bool read when --capture-dir is unset.
+                    profiling.CAPTURE.observe(
+                        verb, time.perf_counter() - t0
+                    )
                 except Exception as e:  # annotations are external input —
                     # one bad one must cost an error payload, not the
                     # scheduler's whole HTTP call.

@@ -54,7 +54,7 @@ from typing import Callable, Dict, FrozenSet, Optional, Sequence
 
 from ..api import constants
 from ..discovery.chips import TpuChip
-from ..utils import metrics
+from ..utils import metrics, profiling
 from ..utils.decisions import LEDGER
 from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
@@ -136,8 +136,12 @@ class HealthWatcher:
             )
             return
         self._stop.clear()
+        # Supervised (utils/profiling.py): a dead health watcher means
+        # broken chips stay advertised Healthy — loud, not silent.
         self._thread = threading.Thread(
-            target=self._run, name="tpu-health-watcher", daemon=True
+            target=profiling.supervised("health_watcher", self._run),
+            name="tpu-health-watcher",
+            daemon=True,
         )
         self._thread.start()
 
@@ -284,8 +288,12 @@ class HealthWatcher:
         # caught here rather than one full interval later.
         if not self._stop.is_set():
             self.poll_once()
+        hb = profiling.HEARTBEATS.register(
+            "health_watcher", interval_s=self._interval
+        )
         try:
             while not self._stop.is_set():
+                hb.beat()
                 woke = False
                 if events_fd is not None:
                     # Wait for an event OR one full interval (the fallback

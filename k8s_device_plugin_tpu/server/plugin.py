@@ -476,6 +476,20 @@ class TpuDevicePlugin(DevicePluginServicer):
         return resp
 
     def Allocate(self, request, context):
+        import time as _time
+
+        # SLO-triggered capture feed (utils/profiling.py CAPTURE): one
+        # bool read when --capture-dir is unset; with it set, a
+        # windowed Allocate p99 past --capture-p99-ms dumps a bundle.
+        t0 = _time.perf_counter()
+        try:
+            return self._allocate_traced(request, context)
+        finally:
+            profiling.CAPTURE.observe(
+                "allocate", _time.perf_counter() - t0
+            )
+
+    def _allocate_traced(self, request, context):
         if not tracing.enabled():
             with profiling.timed(metrics.RPC_LATENCY, method="Allocate"):
                 return self._allocate(request, context)

@@ -129,6 +129,15 @@ class DaemonConfig:
     # the telemetry sampler (measured by bench.py
     # detail.audit_overhead).
     audit_interval_s: float = 0.0
+    # Runtime-performance plane (utils/profiling.py + stackprof.py):
+    # sampling wall-clock profiler rate (0 = no sampler thread —
+    # /debug/profile still answers one-shot ?seconds= bursts), and
+    # SLO-triggered black-box capture (bundle dir + the windowed
+    # Allocate p99 threshold in ms; empty/0 disables). The heartbeat
+    # stall watchdog runs whenever the daemon runs.
+    profile_hz: float = 0.0
+    capture_dir: str = ""
+    capture_p99_ms: float = 0.0
 
 
 class Daemon:
@@ -147,6 +156,29 @@ class Daemon:
 
         if decisions.should_enable(cfg.decisions, cfg.trace):
             decisions.LEDGER.enable(service="plugin")
+        # Runtime-performance plane (utils/profiling.py): GC-pause
+        # recording + the capture manager configure here; the sampler
+        # and stall watchdog get their threads in run() so a Daemon
+        # built for a unit test doesn't spawn them.
+        from ..utils import profiling, stackprof
+
+        profiling.set_service("plugin")
+        profiling.enable_gc_monitor()
+        self._profiler = None
+        if cfg.profile_hz > 0:
+            self._profiler = stackprof.SamplingProfiler(
+                hz=cfg.profile_hz, service="plugin"
+            )
+            stackprof.install_profiler(self._profiler)
+        profiling.CAPTURE.configure(
+            capture_dir=cfg.capture_dir,
+            p99_ms=cfg.capture_p99_ms,
+            service="plugin",
+        )
+        self._watchdog = profiling.StallWatchdog(
+            service="plugin",
+            on_stall=profiling.CAPTURE.heartbeat_stall,
+        )
         self._accel_backend = get_backend(
             prefer_native=cfg.prefer_native_backend
         )
@@ -525,16 +557,28 @@ class Daemon:
     def run(self, max_iterations: Optional[int] = None) -> int:
         """The restart loop. max_iterations bounds event-queue turns for
         tests; None means run until SIGTERM/SIGINT."""
+        from ..utils import profiling
+
         fs = FsWatcher(self.cfg.device_plugin_dir, self.events)
         sigs = SignalWatcher(self.events)
         fs.start()
         sigs.start()
+        if self._profiler is not None:
+            self._profiler.start()
+        self._watchdog.start()
+        # The supervisor loop's own heartbeat (next to the legacy
+        # /healthz liveness float): one beat per event-queue turn.
+        hb = profiling.HEARTBEATS.register(
+            "supervisor", interval_s=1.0,
+            max_silence_s=self.heartbeat_stale_s,
+        )
         rc = 0
         restart = True
         iterations = 0
         try:
             while True:
                 self._heartbeat = time.monotonic()
+                hb.beat()
                 if restart:
                     self.teardown()
                     try:
@@ -578,6 +622,13 @@ class Daemon:
             self.teardown()
             fs.stop()
             sigs.stop()
+            self._watchdog.stop()
+            if self._profiler is not None:
+                from ..utils import stackprof
+
+                self._profiler.stop()
+                stackprof.install_profiler(None)
+            profiling.HEARTBEATS.unregister("supervisor")
             if self.metrics_server is not None:
                 self.metrics_server.stop()
                 self.metrics_server = None
@@ -696,6 +747,25 @@ def parse_args(argv) -> DaemonConfig:
                    "at /debug/audit and tpu_audit_* metrics (also "
                    "TPU_AUDIT_INTERVAL_S); 0 disables the auditor "
                    "entirely")
+    p.add_argument("--profile-hz", type=float,
+                   default=float(os.environ.get(
+                       "TPU_PROFILE_HZ", "0") or 0),
+                   help="run the sampling wall-clock profiler at this "
+                   "rate (utils/stackprof.py; also TPU_PROFILE_HZ): "
+                   "folded stacks at /debug/profile, captured into "
+                   "SLO-breach bundles; 0 runs no sampler thread")
+    p.add_argument("--capture-dir",
+                   default=os.environ.get("TPU_CAPTURE_DIR", ""),
+                   help="directory for SLO-triggered black-box capture "
+                   "bundles (profile window + flight ring + ledger "
+                   "tail + metrics snapshot, atomic JSON; also "
+                   "TPU_CAPTURE_DIR); empty disables capture")
+    p.add_argument("--capture-p99-ms", type=float,
+                   default=float(os.environ.get(
+                       "TPU_CAPTURE_P99_MS", "0") or 0),
+                   help="windowed Allocate p99 threshold (ms) that "
+                   "triggers a capture bundle; 0 disables the SLO "
+                   "trigger (heartbeat-stall captures still fire)")
     p.add_argument("--log-json", action="store_true",
                    help="JSON-lines logging with trace correlation "
                    "(also TPU_LOG_JSON=1)")
@@ -747,6 +817,9 @@ def parse_args(argv) -> DaemonConfig:
         decisions=a.decisions,
         telemetry_interval_s=a.telemetry_interval_s,
         audit_interval_s=a.audit_interval_s,
+        profile_hz=a.profile_hz,
+        capture_dir=a.capture_dir,
+        capture_p99_ms=a.capture_p99_ms,
     )
 
 

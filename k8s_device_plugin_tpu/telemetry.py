@@ -47,7 +47,7 @@ import threading
 from typing import Callable, Dict, Optional
 
 from .topology.placement import fragmentation_stats
-from .utils import metrics
+from .utils import metrics, profiling
 from .utils.flightrecorder import RECORDER
 from .utils.logging import get_logger
 
@@ -179,8 +179,14 @@ class TelemetrySampler:
 
     def start(self) -> None:
         self._stop.clear()
+        # Supervised (utils/profiling.py): a sampler thread dying on
+        # an unhandled exception used to freeze every tpu_chip_*
+        # series at its last value with zero signal; now the death is
+        # counted, flight-recorded, and trips thread_liveness.
         self._thread = threading.Thread(
-            target=self._run, name="tpu-telemetry-sampler", daemon=True
+            target=profiling.supervised("telemetry_sampler", self._run),
+            name="tpu-telemetry-sampler",
+            daemon=True,
         )
         self._thread.start()
 
@@ -195,7 +201,11 @@ class TelemetrySampler:
             "telemetry sampler started: %d chips, %.1fs interval",
             len(self.mesh.mesh_chips), self.interval_s,
         )
+        hb = profiling.HEARTBEATS.register(
+            "telemetry_sampler", interval_s=self.interval_s
+        )
         while not self._stop.is_set():
+            hb.beat()
             try:
                 self.poll_once()
             except Exception:  # noqa: BLE001 — sampler must survive

@@ -459,6 +459,50 @@ AUDIT_LAST_CLEAN = REGISTRY.gauge(
     "errors); time() minus this is the 'how long has state been "
     "suspect' dashboard number",
 )
+# Runtime-performance plane (utils/profiling.py + utils/stackprof.py):
+# heartbeat ages + stall counts from the watchdog, GC pauses from
+# gc.callbacks, sampling-profiler output and SLO-triggered capture
+# bundles. Heartbeats register whenever loops run; the gauge only
+# exports while a StallWatchdog is started (entrypoints).
+# GC/lock-wait pause bucket bounds (seconds): tens of µs young-gen
+# passes through pathological 1 s+ stop-the-world tails.
+PAUSE_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0,
+)
+HEARTBEAT_AGE = REGISTRY.gauge(
+    "tpu_thread_heartbeat_age_seconds",
+    "Seconds since each registered long-lived loop last beat its "
+    "heartbeat (utils/profiling.py; exported by the stall watchdog, "
+    "pruned when a loop stops cleanly) — a frozen age is a wedged or "
+    "dead thread",
+)
+LOOP_STALLS = REGISTRY.counter(
+    "tpu_loop_stall_total",
+    "Loop stall transitions by loop and reason: stalled (heartbeat "
+    "silent past its threshold — counted once per excursion) or died "
+    "(the thread exited on an unhandled exception; run_supervised "
+    "counts it and trips the thread_liveness audit invariant)",
+)
+GC_PAUSE = REGISTRY.histogram(
+    "tpu_gc_pause_seconds",
+    "Stop-the-world duration of each Python GC pass, by generation "
+    "(gc.callbacks; utils/profiling.enable_gc_monitor) — the "
+    "invisible tail-latency source behind otherwise-unexplained p99 "
+    "spikes",
+    buckets=PAUSE_BUCKETS,
+)
+PROFILE_SAMPLES = REGISTRY.counter(
+    "tpu_profile_samples_total",
+    "Thread-stack samples captured by the sampling profiler "
+    "(utils/stackprof.py; --profile-hz, served at /debug/profile)",
+)
+PROFILE_CAPTURES = REGISTRY.counter(
+    "tpu_profile_captures_total",
+    "SLO-triggered black-box capture bundles, by reason (slo_<op> / "
+    "stall_<loop>) and outcome (ok/budget/error) — "
+    "utils/profiling.CaptureManager writing to --capture-dir",
+)
 BUILD_INFO = REGISTRY.gauge(
     "tpu_build_info",
     "Always 1; the labels are the point: version (the package "
@@ -725,6 +769,42 @@ EXT_BUILD_INFO = EXTENDER_REGISTRY.gauge(
     "Always 1; labels version/python/component identify the build "
     "answering this scrape",
 )
+# Extender-process instances of the runtime-performance instruments
+# (separate registry — see the pollution note above; same family names
+# on purpose so one dashboard row covers both components).
+EXT_HEARTBEAT_AGE = EXTENDER_REGISTRY.gauge(
+    "tpu_thread_heartbeat_age_seconds",
+    "Seconds since each registered long-lived loop last beat its "
+    "heartbeat (utils/profiling.py; pruned on clean stop)",
+)
+EXT_LOOP_STALLS = EXTENDER_REGISTRY.counter(
+    "tpu_loop_stall_total",
+    "Loop stall transitions by loop and reason (stalled/died)",
+)
+EXT_GC_PAUSE = EXTENDER_REGISTRY.histogram(
+    "tpu_gc_pause_seconds",
+    "Stop-the-world duration of each Python GC pass, by generation",
+    buckets=PAUSE_BUCKETS,
+)
+EXT_LOCK_WAIT = EXTENDER_REGISTRY.histogram(
+    "tpu_lock_wait_seconds",
+    "Wall time spent WAITING for a contended hot-path lock, by lock "
+    "(topology_index, reservations — utils/profiling.TimedLock); an "
+    "uncontended acquire records nothing, so any volume here is real "
+    "convoy on the RPC path",
+    buckets=PAUSE_BUCKETS,
+)
+EXT_PROFILE_SAMPLES = EXTENDER_REGISTRY.counter(
+    "tpu_profile_samples_total",
+    "Thread-stack samples captured by the sampling profiler "
+    "(utils/stackprof.py; --profile-hz, served at /debug/profile)",
+)
+EXT_PROFILE_CAPTURES = EXTENDER_REGISTRY.counter(
+    "tpu_profile_captures_total",
+    "SLO-triggered black-box capture bundles, by reason and outcome "
+    "(ok/budget/error) — utils/profiling.CaptureManager writing to "
+    "--capture-dir",
+)
 
 
 def set_build_info(component: str) -> None:
@@ -807,6 +887,13 @@ DEBUG_ENDPOINTS: Dict[str, str] = {
         "— the probe-semantics 503 lives at /readyz; plugin: "
         "not configured)"
     ),
+    "/debug/profile": (
+        "sampling-profiler export (utils/stackprof.py): speedscope "
+        "JSON by default, ?format=collapsed for folded stacks, "
+        "?seconds=N for the trailing window (or a one-shot burst "
+        "when --profile-hz is 0); bare GET answers instantly with "
+        "the aggregated table (or enabled: false)"
+    ),
 }
 
 # () -> dict readiness snapshot (extender/server.py ReadyStatus),
@@ -860,6 +947,12 @@ def debug_payload(path: str) -> Optional[bytes]:
                     "process (the extender entrypoint installs one)",
                 }
             return READYZ_PROVIDER()
+        if parsed.path == "/debug/profile":
+            from . import profiling, stackprof
+
+            return stackprof.debug_profile(
+                parsed.query, service=profiling._SERVICE
+            )
         if parsed.path == "/debug/traces":
             trace_id = dict(_up.parse_qsl(parsed.query)).get(
                 "trace_id", ""

@@ -1,4 +1,6 @@
-"""Profiling & tracing hooks — daemon latency + workload XLA traces.
+"""Profiling & tracing hooks — daemon latency + workload XLA traces,
+plus the runtime-performance watchdog plane (heartbeats, GC pauses,
+lock waits, SLO-triggered black-box capture).
 
 The reference has neither tracing nor profiling (SURVEY.md §5 "Tracing /
 profiling: none"); this is a deliberate capability add on both planes:
@@ -12,13 +14,59 @@ profiling: none"); this is a deliberate capability add on both planes:
   timings, TPU step breakdown), and ``annotate()`` names host-side regions
   inside that trace. Both are exact no-ops unless a trace dir is given, so
   they can stay in production code paths.
+
+The runtime-performance layer (ISSUE 10) lives here because every
+daemon already imports this module on its hot path:
+
+- **Heartbeats + stall watchdog**: every long-lived loop (gang tick,
+  telemetry sampler, audit sweep, node-cache relist, watch applier,
+  warm pool, controller informer, health watcher) registers a
+  :class:`Heartbeat` in the process-global :data:`HEARTBEATS` registry
+  and beats once per iteration; the :class:`StallWatchdog` exports
+  ``tpu_thread_heartbeat_age_seconds{loop}``, counts stall/death
+  transitions in ``tpu_loop_stall_total{loop,reason}``, and a silently
+  wedged loop becomes an alertable crossing instead of a mystery.
+- **Supervised loops**: :func:`run_supervised` wraps thread targets so
+  an unhandled exception can no longer make a background thread vanish
+  without a trace — it logs, counts ``reason="died"``, marks the
+  heartbeat dead (which trips the ``thread_liveness`` audit invariant,
+  audit.py), and a clean return unregisters the heartbeat.
+- **GC pauses**: ``gc.callbacks`` → ``tpu_gc_pause_seconds`` — the
+  classic invisible tail-latency source, now a histogram.
+- **Lock waits**: :class:`TimedLock` wraps the TopologyIndex and
+  ReservationTable locks; only a CONTENDED acquire pays a timestamp,
+  and the wait lands in ``tpu_lock_wait_seconds{lock}``.
+- **Black-box capture**: :data:`CAPTURE` (a :class:`CaptureManager`)
+  tracks windowed p99s of the hot RPCs (filter/prioritize/Allocate);
+  when one crosses ``--capture-p99-ms`` — or the watchdog sees a
+  heartbeat stall — it atomically dumps a capture bundle (last N
+  seconds of profile samples from utils/stackprof.py, the flight ring,
+  the ledger tail, a metrics snapshot) to ``--capture-dir``,
+  crossing-deduped and budget-limited, recorded as ``profile_capture``
+  flight + ledger entries. The first occurrence of a regression yields
+  a flamegraph, not a shrug.
+
+Everything is off by default and gated on one cheap check: no
+watchdog thread without ``StallWatchdog.start()``, no capture
+evaluation without a configured ``--capture-dir``, no GC callback
+without :func:`enable_gc_monitor` — measured by
+``scale_bench.profiler_overhead``.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
+import gc
+import json
+import os
+import threading
 import time
-from typing import Iterator, Optional
+from typing import Callable, Dict, Iterator, List, Optional, Set
+
+from .logging import get_logger
+
+log = get_logger(__name__)
 
 
 @contextlib.contextmanager
@@ -76,3 +124,731 @@ def annotate(name: str) -> Iterator[None]:
         return
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+# ---------------------------------------------------------------------------
+# Runtime-performance watchdog plane (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+# Which registry's families this process reports into ("plugin" or
+# "extender") — set once by each entrypoint, the
+# flightrecorder.enable(service=...) idiom. Family lookups are lazy so
+# importing this module never drags metrics in before it's needed.
+_SERVICE = "plugin"
+
+
+def set_service(service: str) -> None:
+    global _SERVICE
+    _SERVICE = service
+
+
+def _fams():
+    from . import metrics
+
+    if _SERVICE == "extender":
+        return (
+            metrics.EXT_HEARTBEAT_AGE,
+            metrics.EXT_LOOP_STALLS,
+            metrics.EXT_GC_PAUSE,
+            metrics.EXT_PROFILE_CAPTURES,
+        )
+    return (
+        metrics.HEARTBEAT_AGE,
+        metrics.LOOP_STALLS,
+        metrics.GC_PAUSE,
+        metrics.PROFILE_CAPTURES,
+    )
+
+
+class Heartbeat:
+    """One long-lived loop's liveness record. The loop calls
+    :meth:`beat` once per iteration; everyone else reads
+    :meth:`age_s`. ``max_silence_s`` is the loop's OWN stall
+    threshold — a watch-blocking loop (60 s stream windows) gets a
+    generous one, a tick loop a tight one — so the watchdog never
+    needs per-loop configuration."""
+
+    def __init__(self, name: str, interval_s: float, max_silence_s: float):
+        self.name = name
+        self.interval_s = interval_s
+        self.max_silence_s = max_silence_s
+        self.beats = 0
+        self.dead = False
+        self.dead_reason = ""
+        self._last = time.monotonic()
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+        self.beats += 1
+        if self.dead:
+            # The loop restarted: death clears on the first new beat
+            # (the thread_liveness finding clears on the next sweep).
+            self.dead = False
+            self.dead_reason = ""
+
+    def age_s(self) -> float:
+        return time.monotonic() - self._last
+
+    def mark_dead(self, reason: str = "died") -> None:
+        self.dead = True
+        self.dead_reason = reason
+
+    def stalled(self) -> bool:
+        return self.dead or self.age_s() > self.max_silence_s
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "interval_s": round(self.interval_s, 3),
+            "max_silence_s": round(self.max_silence_s, 3),
+            "age_s": round(self.age_s(), 3),
+            "beats": self.beats,
+            "dead": self.dead,
+            "dead_reason": self.dead_reason,
+        }
+
+
+def default_max_silence(interval_s: float) -> float:
+    """Several missed intervals, floored generously: one slow tick
+    (a full sweep, a big relist) must never read as a stall."""
+    return max(4.0 * max(interval_s, 0.0), 15.0)
+
+
+class HeartbeatRegistry:
+    """Process-global loop registry (one daemon per process, like the
+    metrics registries). Re-registering an existing name revives it —
+    a restarted loop clears its own death."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._beats: Dict[str, Heartbeat] = {}
+
+    def register(
+        self,
+        name: str,
+        interval_s: float = 1.0,
+        max_silence_s: Optional[float] = None,
+    ) -> Heartbeat:
+        silence = (
+            default_max_silence(interval_s)
+            if max_silence_s is None
+            else max_silence_s
+        )
+        with self._lock:
+            hb = self._beats.get(name)
+            if hb is None:
+                hb = Heartbeat(name, interval_s, silence)
+                self._beats[name] = hb
+            else:
+                hb.interval_s = interval_s
+                hb.max_silence_s = silence
+                hb.beat()
+            return hb
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._beats.pop(name, None)
+
+    def get(self, name: str) -> Optional[Heartbeat]:
+        with self._lock:
+            return self._beats.get(name)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [hb.to_dict() for hb in self._beats.values()]
+
+    def clear(self) -> None:
+        """Test hygiene only: the tier-1 suite shares one process."""
+        with self._lock:
+            self._beats.clear()
+
+
+HEARTBEATS = HeartbeatRegistry()
+
+
+def run_supervised(name: str, fn: Callable[[], None]) -> None:
+    """Thread-target wrapper fixing silent background-thread death:
+    before this, a sampler/audit/warm-pool thread that raised out of
+    its loop simply vanished — no log guaranteed at the right level,
+    no metric, no audit signal, the gauge frozen at its last value.
+    Now the death is loud on every plane: logged with the traceback,
+    counted as ``tpu_loop_stall_total{loop,reason="died"}``,
+    flight-recorded, and the heartbeat marked dead so the
+    ``thread_liveness`` audit invariant (audit.py) fires until the
+    loop is restarted. A clean return unregisters the heartbeat —
+    a stopped loop is not a stalled one."""
+    try:
+        fn()
+    except Exception:  # noqa: BLE001 — the whole point
+        log.exception("supervised loop %r died", name)
+        hb = HEARTBEATS.get(name) or HEARTBEATS.register(name)
+        hb.mark_dead("died")
+        try:
+            _fams()[1].inc(loop=name, reason="died")
+            from .flightrecorder import RECORDER
+
+            RECORDER.record(
+                "loop_stall",
+                f"background loop {name} died from an unhandled "
+                f"exception (see logs for the traceback)",
+                loop=name,
+                reason="died",
+                state="detected",
+            )
+        except Exception:  # noqa: BLE001 — reporting must not re-raise
+            pass
+        return
+    HEARTBEATS.unregister(name)
+
+
+def supervised(name: str, fn: Callable[[], None]) -> Callable[[], None]:
+    """``threading.Thread(target=supervised("x", self._loop))``."""
+    return lambda: run_supervised(name, fn)
+
+
+class StallWatchdog:
+    """Exports every heartbeat's age and turns silence into signal.
+
+    One thread (``check_interval_s`` cadence, the telemetry-sampler
+    shape): per check it publishes
+    ``tpu_thread_heartbeat_age_seconds{loop}`` for every registered
+    loop (pruning series for unregistered ones), and on each loop's
+    stall CROSSING — age past its ``max_silence_s``, or marked dead —
+    counts ``tpu_loop_stall_total{loop,reason="stalled"}`` (death is
+    counted at death time by :func:`run_supervised`), flight-records
+    a ``loop_stall`` event, and invokes ``on_stall(loop)`` (wired to
+    :meth:`CaptureManager.heartbeat_stall` by the entrypoints, so a
+    wedged loop produces a capture bundle while it is still wedged).
+    Recovery records the cleared transition; a persisting stall is
+    silent in between — the chip_thermal crossing-dedup idiom."""
+
+    def __init__(
+        self,
+        check_interval_s: float = 2.0,
+        service: Optional[str] = None,
+        on_stall: Optional[Callable[[str], None]] = None,
+    ):
+        self.check_interval_s = check_interval_s
+        self.service = service or _SERVICE
+        self.on_stall = on_stall
+        self._stalled: Set[str] = set()
+        self._exported: Set[str] = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _families(self):
+        from . import metrics
+
+        if self.service == "extender":
+            return metrics.EXT_HEARTBEAT_AGE, metrics.EXT_LOOP_STALLS
+        return metrics.HEARTBEAT_AGE, metrics.LOOP_STALLS
+
+    def start(self) -> "StallWatchdog":
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="stall-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.check_interval_s + 2)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.check_interval_s):
+            try:
+                self.check_once()
+            except Exception:  # noqa: BLE001 — the watchdog survives
+                log.exception("stall watchdog check failed")
+
+    def check_once(self) -> List[str]:
+        """One pass; returns the currently-stalled loop names (tests
+        drive this directly)."""
+        from .flightrecorder import RECORDER
+
+        flush_gc_pauses()  # drain the callback's lock-free buffer
+        age_fam, stall_fam = self._families()
+        snap = HEARTBEATS.snapshot()
+        names = {hb["name"] for hb in snap}
+        stalled_now: List[str] = []
+        for hb in snap:
+            name = hb["name"]
+            age_fam.set(hb["age_s"], loop=name)
+            over = hb["dead"] or hb["age_s"] > hb["max_silence_s"]
+            if over:
+                stalled_now.append(name)
+            if over and name not in self._stalled:
+                self._stalled.add(name)
+                reason = "died" if hb["dead"] else "stalled"
+                if not hb["dead"]:
+                    # Death already counted once by run_supervised.
+                    stall_fam.inc(loop=name, reason="stalled")
+                RECORDER.record(
+                    "loop_stall",
+                    f"loop {name} heartbeat silent for "
+                    f"{hb['age_s']:.1f}s "
+                    f"(threshold {hb['max_silence_s']:.1f}s)",
+                    loop=name,
+                    reason=reason,
+                    state="detected",
+                    age_s=hb["age_s"],
+                )
+                log.warning(
+                    "loop %s %s (heartbeat age %.1fs, threshold %.1fs)",
+                    name, reason, hb["age_s"], hb["max_silence_s"],
+                )
+                if self.on_stall is not None:
+                    try:
+                        self.on_stall(name)
+                    except Exception:  # noqa: BLE001 — capture failure
+                        log.exception("stall capture for %s failed", name)
+            elif not over and name in self._stalled:
+                self._stalled.discard(name)
+                RECORDER.record(
+                    "loop_stall",
+                    f"loop {name} heartbeat recovered",
+                    loop=name,
+                    state="cleared",
+                )
+        for gone in self._exported - names:
+            # A cleanly-stopped loop's series must not scrape forever
+            # at its last age (the telemetry pruning contract).
+            age_fam.remove(loop=gone)
+            self._stalled.discard(gone)
+        self._exported = names
+        return stalled_now
+
+
+# -- GC pause recording ------------------------------------------------------
+
+_gc_start: Dict[int, float] = {}
+# Pauses measured by the callback but NOT yet observed into the
+# histogram. The callback must not touch any lock: a collection can
+# trigger INSIDE Histogram.observe (it allocates while holding the
+# histogram's non-reentrant lock), and an observe from the callback on
+# the same thread would self-deadlock the daemon. deque.append is
+# atomic and allocation inside a gc callback cannot re-trigger a
+# collection (CPython holds `collecting` while callbacks run), so the
+# callback only buffers; flush_gc_pauses() drains from safe contexts
+# (the watchdog tick, capture time, tests).
+_gc_pending: "collections.deque" = collections.deque(maxlen=4096)
+
+
+def _gc_callback(phase: str, info: dict) -> None:
+    gen = info.get("generation", 0)
+    if phase == "start":
+        _gc_start[gen] = time.perf_counter()
+    elif phase == "stop":
+        t0 = _gc_start.pop(gen, None)
+        if t0 is None:
+            return
+        _gc_pending.append((gen, time.perf_counter() - t0))
+
+
+def flush_gc_pauses() -> int:
+    """Drain buffered GC pauses into ``tpu_gc_pause_seconds``;
+    returns how many were flushed. Called from the stall watchdog's
+    tick (both entrypoints run one) and at capture time — never from
+    the gc callback itself (see the buffer's comment)."""
+    n = 0
+    try:
+        fam = _fams()[2]
+        while True:
+            try:
+                gen, dt = _gc_pending.popleft()
+            except IndexError:
+                break
+            fam.observe(dt, generation=str(gen))
+            n += 1
+    except Exception:  # noqa: BLE001 — metrics hiccups never propagate
+        pass
+    return n
+
+
+def enable_gc_monitor() -> None:
+    """Record every collector pass's stop-the-world duration into
+    ``tpu_gc_pause_seconds{generation}`` via ``gc.callbacks`` — the
+    pause source the PR-9 gc.freeze() work dodged on startup but
+    nothing measured at runtime. Idempotent."""
+    if _gc_callback not in gc.callbacks:
+        gc.callbacks.append(_gc_callback)
+
+
+def disable_gc_monitor() -> None:
+    if _gc_callback in gc.callbacks:
+        gc.callbacks.remove(_gc_callback)
+    flush_gc_pauses()
+    _gc_start.clear()
+
+
+# -- lock-wait instrumentation ----------------------------------------------
+
+
+class TimedLock:
+    """A ``threading.Lock`` whose CONTENDED acquires are measured.
+
+    The uncontended fast path is one extra non-blocking acquire
+    attempt — no clock read, no histogram touch (bounded by
+    ``scale_bench.profiler_overhead``'s hot-path arm). Only when that
+    fails does the caller pay two ``perf_counter`` reads and an
+    observation into ``histogram{lock=name}`` — which is exactly the
+    moment the data matters: lock convoy on the TopologyIndex or
+    ReservationTable is invisible to every other instrument (the RPC
+    histogram shows the total, never names the lock)."""
+
+    def __init__(self, name: str, histogram=None):
+        self.name = name
+        self._histogram = histogram
+        self._lock = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if self._lock.acquire(False):
+            return True
+        if not blocking:
+            return False
+        t0 = time.perf_counter()
+        ok = self._lock.acquire(True, timeout)
+        h = self._histogram
+        if h is not None:
+            try:
+                h.observe(time.perf_counter() - t0, lock=self.name)
+            except Exception:  # noqa: BLE001 — never fail an acquire
+                pass
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "TimedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+# -- SLO-triggered black-box capture ------------------------------------------
+
+
+class _LatencyWindow:
+    """A sliding window of one op's latencies with crossing state.
+    ``obs`` is per-window on purpose: a manager-global counter would
+    let a strictly alternating op mix (the default scheduler issues
+    /filter then /prioritize per pod) park one op's observations on
+    counts the evaluation tick never lands on — that op's breach
+    would never trigger a capture."""
+
+    __slots__ = ("samples", "over", "last_p99_ms", "obs")
+
+    def __init__(self, maxlen: int = 512):
+        self.samples: "collections.deque" = collections.deque(maxlen=maxlen)
+        self.over = False
+        self.last_p99_ms = 0.0
+        self.obs = 0
+
+    def p99_ms(self, window_s: float) -> Optional[float]:
+        cutoff = time.monotonic() - window_s
+        vals = [v for t, v in self.samples if t >= cutoff]
+        if not vals:
+            return None
+        vals.sort()
+        self.last_p99_ms = round(
+            vals[min(len(vals) - 1, int(0.99 * (len(vals) - 1) + 0.5))]
+            * 1000.0,
+            3,
+        )
+        return self.last_p99_ms
+
+
+class CaptureManager:
+    """SLO breach / stall → one atomic black-box bundle on disk.
+
+    ``observe(op, seconds)`` is called from the hot RPC paths
+    (extender /filter + /prioritize handlers, plugin Allocate) — one
+    bool read when unconfigured. With ``--capture-dir`` and
+    ``--capture-p99-ms`` set, each op keeps a sliding window
+    (``window_s``) and every ``_EVAL_EVERY``-th observation re-derives
+    its p99; the moment it CROSSES the threshold (deduped while it
+    stays over — the chip_thermal idiom) a bundle is dumped:
+
+    * the last ``profile_window_s`` seconds of profile samples
+      (utils/stackprof.py — collapsed + speedscope, or
+      ``enabled: false`` without a profiler),
+    * the flight-recorder ring, the decision-ledger tail, the
+      heartbeat table, and a full metrics-registry snapshot,
+
+    written atomically (tmp + ``os.replace``) as one JSON file in
+    ``--capture-dir``, budget-limited (``budget`` bundles per
+    ``budget_window_s`` — a flapping SLO cannot fill a disk), and
+    recorded as ``profile_capture`` flight + ledger entries so the
+    incident timeline names its own artifact. The watchdog's
+    ``on_stall`` hook routes heartbeat stalls here too
+    (``reason="stall_<loop>"``)."""
+
+    _EVAL_EVERY = 8
+
+    def __init__(self):
+        self.enabled = False
+        self.capture_dir = ""
+        self.p99_ms = 0.0
+        self.service = "plugin"
+        self.window_s = 60.0
+        self.min_samples = 20
+        self.budget = 8
+        self.budget_window_s = 3600.0
+        self.profile_window_s = 60.0
+        self.keep = 40
+        self._lock = threading.Lock()
+        self._windows: Dict[str, _LatencyWindow] = {}
+        self._captures: "collections.deque" = collections.deque()
+        self._seq = 0  # filename uniquifier within one second
+
+    def configure(
+        self,
+        capture_dir: str = "",
+        p99_ms: float = 0.0,
+        service: Optional[str] = None,
+        window_s: float = 60.0,
+        min_samples: int = 20,
+        budget: int = 8,
+        budget_window_s: float = 3600.0,
+        profile_window_s: float = 60.0,
+        keep: int = 40,
+    ) -> None:
+        with self._lock:
+            self.capture_dir = capture_dir
+            self.p99_ms = float(p99_ms)
+            if service is not None:
+                self.service = service
+            self.window_s = window_s
+            self.min_samples = max(1, int(min_samples))
+            self.budget = max(1, int(budget))
+            self.budget_window_s = budget_window_s
+            self.profile_window_s = profile_window_s
+            # Retention floor: the hourly budget bounds the RATE, this
+            # bounds the TOTAL — a months-long flapping SLO on a
+            # node-critical daemonset must not fill the capture volume
+            # one budget-window at a time.
+            self.keep = max(1, int(keep))
+            self._windows = {}
+            self._captures.clear()
+            self.enabled = bool(capture_dir)
+
+    def disable(self) -> None:
+        with self._lock:
+            self.enabled = False
+            self.capture_dir = ""
+            self._windows = {}
+
+    # -- hot-path feed -----------------------------------------------------
+
+    def observe(self, op: str, seconds: float) -> None:
+        """First line is the enabled gate — one bool read when off."""
+        if not self.enabled or self.p99_ms <= 0:
+            return
+        trigger = None
+        with self._lock:
+            w = self._windows.get(op)
+            if w is None:
+                w = self._windows[op] = _LatencyWindow()
+            w.samples.append((time.monotonic(), seconds))
+            w.obs += 1
+            if w.obs % self._EVAL_EVERY:
+                return
+            if len(w.samples) < self.min_samples:
+                return
+            p99 = w.p99_ms(self.window_s)
+            if p99 is None:
+                return
+            if p99 > self.p99_ms and not w.over:
+                w.over = True  # crossing: one capture per excursion
+                trigger = p99
+            elif p99 <= self.p99_ms and w.over:
+                w.over = False  # re-armed for the next excursion
+        if trigger is not None:
+            self.capture(
+                f"slo_{op}",
+                f"windowed {op} p99 {trigger}ms crossed the "
+                f"--capture-p99-ms threshold ({self.p99_ms}ms)",
+                op=op,
+                p99_ms=trigger,
+                threshold_ms=self.p99_ms,
+            )
+
+    def heartbeat_stall(self, loop: str) -> None:
+        """The watchdog's on_stall hook (crossing-deduped upstream)."""
+        self.capture(
+            f"stall_{loop}",
+            f"heartbeat stall on loop {loop}",
+            loop=loop,
+        )
+
+    # -- the bundle --------------------------------------------------------
+
+    def _captures_fam(self):
+        from . import metrics
+
+        return (
+            metrics.EXT_PROFILE_CAPTURES
+            if self.service == "extender"
+            else metrics.PROFILE_CAPTURES
+        )
+
+    def capture(self, reason: str, message: str = "", **attrs) -> Optional[str]:
+        """Dump one bundle now. Returns the path, or None (disabled /
+        budget exhausted / write failed). Never raises — capture runs
+        at the worst possible moment by design."""
+        if not self.enabled or not self.capture_dir:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            while (
+                self._captures
+                and now - self._captures[0] > self.budget_window_s
+            ):
+                self._captures.popleft()
+            if len(self._captures) >= self.budget:
+                try:
+                    self._captures_fam().inc(
+                        reason=reason, outcome="budget"
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+                log.warning(
+                    "capture %s suppressed: budget of %d per %.0fs "
+                    "exhausted", reason, self.budget, self.budget_window_s,
+                )
+                return None
+            self._captures.append(now)
+            windows = {
+                op: {
+                    "samples": len(w.samples),
+                    "p99_ms": w.last_p99_ms,
+                    "threshold_ms": self.p99_ms,
+                    "over": w.over,
+                }
+                for op, w in self._windows.items()
+            }
+        path = None
+        try:
+            from . import metrics, stackprof
+            from .decisions import LEDGER
+            from .flightrecorder import RECORDER
+
+            flush_gc_pauses()  # the metrics snapshot carries them
+            registry = (
+                metrics.EXTENDER_REGISTRY
+                if self.service == "extender"
+                else metrics.REGISTRY
+            )
+            bundle = {
+                "v": 1,
+                "service": self.service,
+                "reason": reason,
+                "message": message,
+                "ts": round(time.time(), 3),
+                "attrs": {k: str(v) for k, v in attrs.items()},
+                "profile": stackprof.bundle_section(
+                    self.profile_window_s
+                ),
+                "flight": RECORDER.snapshot(),
+                "decisions": LEDGER.snapshot(limit=256),
+                "heartbeats": HEARTBEATS.snapshot(),
+                "windows": windows,
+                "metrics": registry.render(),
+            }
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+            name = (
+                f"capture-{self.service}-"
+                f"{time.strftime('%Y%m%dT%H%M%S')}-{os.getpid()}-"
+                f"{seq:03d}-{reason}.json"
+            )
+            path = os.path.join(self.capture_dir, name)
+            tmp = path + ".tmp"
+            os.makedirs(self.capture_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(bundle, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # atomic: never a torn bundle
+            self._prune_old_bundles()
+            RECORDER.record(
+                "profile_capture",
+                message or f"capture bundle written ({reason})",
+                reason=reason,
+                path=path,
+                **attrs,
+            )
+            LEDGER.record(
+                "profile_capture",
+                reason,
+                message or f"capture bundle written to {path}",
+                **{k: str(v) for k, v in attrs.items()},
+            )
+            self._captures_fam().inc(reason=reason, outcome="ok")
+            log.warning("capture bundle written: %s (%s)", path, reason)
+            return path
+        except Exception:  # noqa: BLE001 — never let capture make the
+            # incident worse
+            log.exception("capture bundle for %s failed", reason)
+            try:
+                self._captures_fam().inc(reason=reason, outcome="error")
+            except Exception:  # noqa: BLE001
+                pass
+            return None
+
+    def _prune_old_bundles(self) -> int:
+        """Keep only the newest ``keep`` bundles in capture_dir (this
+        process's AND predecessors' — the files outlive restarts by
+        design). Best-effort, never raises; returns how many were
+        deleted."""
+        removed = 0
+        try:
+            bundles = sorted(
+                (
+                    os.path.join(self.capture_dir, f)
+                    for f in os.listdir(self.capture_dir)
+                    if f.startswith("capture-") and f.endswith(".json")
+                ),
+                key=os.path.getmtime,
+            )
+            for doomed in bundles[: -self.keep]:
+                try:
+                    os.unlink(doomed)
+                    removed += 1
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        return removed
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "capture_dir": self.capture_dir,
+                "p99_ms": self.p99_ms,
+                "window_s": self.window_s,
+                "budget": self.budget,
+                "captures_in_window": len(self._captures),
+                "windows": {
+                    op: {
+                        "samples": len(w.samples),
+                        "p99_ms": w.last_p99_ms,
+                        "over": w.over,
+                    }
+                    for op, w in self._windows.items()
+                },
+            }
+
+
+# One per process, like RECORDER / LEDGER: a daemon is one process.
+CAPTURE = CaptureManager()

@@ -52,4 +52,18 @@ python -m k8s_device_plugin_tpu.extender.journal --self-test > /dev/null \
 # time-to-ready bound lives in tests/test_scale_bench.py.
 python -m k8s_device_plugin_tpu.extender.scale_bench --cold-start-self-test > /dev/null \
   || { echo "scale_bench --cold-start-self-test FAILED"; exit 1; }
+# Profiler tooling smoke: tpu-flame must render a capture produced by
+# the REAL sampling profiler over a busy loop, in every accepted
+# format (collapsed text, speedscope JSON, /debug/profile payload,
+# capture bundle) — an export/renderer drift fails CI here, before
+# the pytest gate.
+python -m k8s_device_plugin_tpu.tools.flame --self-test > /dev/null \
+  || { echo "tools/flame.py --self-test FAILED"; exit 1; }
+# Continuous-profiling chain smoke: sample a busy loop through the
+# real profiler, serve it via the /debug/profile payload shape, write
+# an SLO capture bundle, and parse both with tools/flame.py
+# (scale_bench --profile-self-test) — a drift between the sampler's
+# export, the bundle layout, and the renderer fails CI here.
+python -m k8s_device_plugin_tpu.extender.scale_bench --profile-self-test > /dev/null \
+  || { echo "scale_bench --profile-self-test FAILED"; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,10 +1,16 @@
-"""Profiling hooks (utils/profiling.py) + histogram metric."""
+"""Profiling hooks (utils/profiling.py) + histogram metric + the
+runtime-performance plane (ISSUE 10): sampling profiler
+(utils/stackprof.py), stall watchdog, supervised loops, GC-pause and
+lock-wait recording, and SLO-triggered black-box capture."""
 
+import json
 import os
+import threading
+import time
 
 import pytest
 
-from k8s_device_plugin_tpu.utils import metrics, profiling
+from k8s_device_plugin_tpu.utils import metrics, profiling, stackprof
 from k8s_device_plugin_tpu.utils.metrics import Histogram, Registry
 
 
@@ -159,3 +165,921 @@ def test_compilation_cache_opt_in(tmp_path, monkeypatch):
         for name, value in saved.items():
             jax.config.update(name, value)
         compilation_cache.reset()
+
+
+# ---------------------------------------------------------------------------
+# Sampling profiler (utils/stackprof.py)
+# ---------------------------------------------------------------------------
+
+
+def _busy_thread():
+    """A busy loop with a stable, greppable hot frame."""
+    stop = threading.Event()
+
+    def _profiling_test_hotspot():
+        while not stop.is_set():
+            sum(i * i for i in range(300))
+
+    t = threading.Thread(
+        target=_profiling_test_hotspot, name="prof-busy", daemon=True
+    )
+    t.start()
+    return stop, t
+
+
+def test_sampler_start_stop_lifecycle():
+    stop, t = _busy_thread()
+    prof = stackprof.SamplingProfiler(hz=199, service="plugin")
+    assert not prof.running
+    prof.start()
+    try:
+        assert prof.running
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if prof.snapshot()["samples"] >= 10:
+                break
+            time.sleep(0.05)
+        snap = prof.snapshot()
+        assert snap["samples"] >= 10
+        assert snap["stacks"] >= 1
+    finally:
+        prof.stop()
+        stop.set()
+        t.join(timeout=2)
+    assert not prof.running
+    frozen = prof.snapshot()["samples"]
+    time.sleep(0.05)
+    assert prof.snapshot()["samples"] == frozen  # thread really gone
+    # The hot frame dominates its own thread's folded stacks, and the
+    # sampler thread never profiles itself.
+    col = prof.export_collapsed()
+    assert "_profiling_test_hotspot" in col
+    assert "stack-sampler" not in col
+
+
+def test_folded_stack_correctness_on_known_synthetic_stack():
+    """A thread parked inside a known a→b→c nesting must fold to one
+    stack whose frames appear in call order."""
+    entered = threading.Event()
+    release = threading.Event()
+
+    def _prof_leaf_c():
+        entered.set()
+        release.wait(10)
+
+    def _prof_mid_b():
+        _prof_leaf_c()
+
+    def _prof_root_a():
+        _prof_mid_b()
+
+    t = threading.Thread(
+        target=_prof_root_a, name="synthetic-stack", daemon=True
+    )
+    t.start()
+    assert entered.wait(5)
+    prof = stackprof.SamplingProfiler(hz=50, service="plugin")
+    try:
+        prof.sample_once()  # synchronous: no sampler thread involved
+    finally:
+        release.set()
+        t.join(timeout=2)
+    match = [
+        s for s in prof.folded_counts()
+        if "thread:synthetic-stack" in s
+    ]
+    assert len(match) == 1, match
+    stack = match[0]
+    ia = stack.index("_prof_root_a")
+    ib = stack.index("_prof_mid_b")
+    ic = stack.index("_prof_leaf_c")
+    assert ia < ib < ic, stack  # root-first fold, call order preserved
+    assert stack.startswith("thread:synthetic-stack;")
+
+
+def test_bounded_table_overflow_counts_and_caps():
+    prof = stackprof.SamplingProfiler(hz=10, max_stacks=16)
+    for i in range(40):
+        prof._record([f"thread:x;frame_{i} (f.py:1)"], ts=time.time())
+    snap = prof.snapshot()
+    # 16 distinct stacks + the (overflow) bucket, never more.
+    counts = prof.folded_counts()
+    assert len(counts) == 17
+    assert counts[stackprof.OVERFLOW_KEY] == 40 - 16
+    assert snap["dropped_stacks"] == 40 - 16
+    # Existing keys still aggregate after the table is full.
+    prof._record(["thread:x;frame_0 (f.py:1)"], ts=time.time())
+    assert prof.folded_counts()["thread:x;frame_0 (f.py:1)"] == 2
+    assert prof.snapshot()["dropped_stacks"] == 40 - 16
+
+
+def test_ring_window_export_keeps_only_recent_seconds():
+    prof = stackprof.SamplingProfiler(hz=10, ring_s=300)
+    now = time.time()
+    prof._record(["thread:x;old (f.py:1)"], ts=now - 120)
+    prof._record(["thread:x;recent (f.py:1)"], ts=now - 2)
+    whole = prof.folded_counts()
+    recent = prof.folded_counts(seconds=30)
+    assert len(whole) == 2
+    assert list(recent) == ["thread:x;recent (f.py:1)"]
+    # The collapsed export honors the same window.
+    assert "old" not in prof.export_collapsed(seconds=30)
+    assert "old" in prof.export_collapsed()
+
+
+def test_speedscope_and_collapsed_exports_agree():
+    from k8s_device_plugin_tpu.tools import flame
+
+    prof = stackprof.SamplingProfiler(hz=10)
+    for _ in range(3):
+        prof._record(
+            ["thread:x;a (f.py:1);b (f.py:2)", "thread:y;c (g.py:3)"],
+            ts=time.time(),
+        )
+    col = flame.parse_collapsed(prof.export_collapsed())
+    ss = flame.from_speedscope(prof.export_speedscope())
+    assert col == ss
+    assert col[("thread:x", "a (f.py:1)", "b (f.py:2)")] == 3
+
+
+def test_debug_profile_payload_modes():
+    saved = stackprof.PROFILER
+    stackprof.install_profiler(None)
+    try:
+        # No profiler, no seconds: instant disabled answer (tpu-doctor
+        # bundles hit the endpoint bare and must not block).
+        t0 = time.monotonic()
+        out = stackprof.debug_profile("")
+        assert time.monotonic() - t0 < 0.5
+        assert out["enabled"] is False
+        # No profiler + seconds: one-shot burst on the calling thread.
+        stop, t = _busy_thread()
+        try:
+            out = stackprof.debug_profile(
+                "seconds=0.3&format=collapsed&hz=97"
+            )
+        finally:
+            stop.set()
+            t.join(timeout=2)
+        assert out["enabled"] and out["burst"]
+        assert "_profiling_test_hotspot" in out["folded"]
+        # Installed profiler: served through metrics.debug_payload on
+        # both HTTP servers' shared route.
+        prof = stackprof.SamplingProfiler(hz=97)
+        stackprof.install_profiler(prof)
+        prof.start()
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if prof.snapshot()["samples"] >= 3:
+                    break
+                time.sleep(0.05)
+            body = metrics.debug_payload("/debug/profile")
+            payload = json.loads(body)
+            assert payload["enabled"] is True
+            assert payload["profile"]["profiles"]
+        finally:
+            prof.stop()
+    finally:
+        stackprof.install_profiler(saved)
+    assert "/debug/profile" in metrics.DEBUG_ENDPOINTS
+
+
+# ---------------------------------------------------------------------------
+# GC pauses, lock waits
+# ---------------------------------------------------------------------------
+
+
+def test_gc_callback_records_pauses():
+    """The callback only BUFFERS (it must not touch a histogram lock —
+    a collection triggering inside Histogram.observe would otherwise
+    self-deadlock); flush_gc_pauses() drains into the histogram (the
+    watchdog tick does this in production)."""
+    import gc
+
+    before = metrics.GC_PAUSE.count(generation="2")
+    profiling.set_service("plugin")
+    profiling.enable_gc_monitor()
+    try:
+        gc.collect()
+        gc.collect()
+        assert profiling.flush_gc_pauses() >= 2
+    finally:
+        profiling.disable_gc_monitor()
+    after = metrics.GC_PAUSE.count(generation="2")
+    assert after >= before + 2
+    # Disabled: no further observations, even after a flush.
+    gc.collect()
+    profiling.flush_gc_pauses()
+    assert metrics.GC_PAUSE.count(generation="2") == after
+
+
+def test_timed_lock_records_contended_waits_only():
+    h = Histogram("test_lock_wait_seconds", "t", buckets=(0.001, 1.0))
+    lock = profiling.TimedLock("test_lock", h)
+    with lock:
+        pass
+    assert h.count(lock="test_lock") == 0  # uncontended: no sample
+    holder_in = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lock:
+            holder_in.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert holder_in.wait(5)
+    waited = {}
+
+    def contender():
+        t0 = time.perf_counter()
+        with lock:
+            waited["s"] = time.perf_counter() - t0
+
+    t2 = threading.Thread(target=contender, daemon=True)
+    t2.start()
+    time.sleep(0.05)
+    release.set()
+    t.join(timeout=2)
+    t2.join(timeout=2)
+    assert h.count(lock="test_lock") == 1
+    assert waited["s"] > 0.02
+    # The real hot-path locks are TimedLocks wired to the extender
+    # registry's family.
+    from k8s_device_plugin_tpu.extender.index import TopologyIndex
+    from k8s_device_plugin_tpu.extender.reservations import (
+        ReservationTable,
+    )
+
+    assert isinstance(TopologyIndex()._lock, profiling.TimedLock)
+    assert isinstance(ReservationTable()._lock, profiling.TimedLock)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats, watchdog, supervised loops
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_registry_register_beat_revive_unregister():
+    reg = profiling.HeartbeatRegistry()
+    hb = reg.register("loop_a", interval_s=0.5)
+    assert hb.max_silence_s == 15.0  # generous floor
+    hb.beat()
+    assert hb.age_s() < 1.0 and hb.beats == 1
+    hb.mark_dead("died")
+    assert hb.dead and reg.snapshot()[0]["dead"]
+    # Re-registering (a restarted loop) revives it.
+    hb2 = reg.register("loop_a", interval_s=0.5)
+    assert hb2 is hb and not hb.dead
+    reg.unregister("loop_a")
+    assert reg.get("loop_a") is None and reg.snapshot() == []
+
+
+def test_watchdog_detects_hung_loop_and_recovery(tmp_path):
+    """A deliberately hung fake loop: the watchdog exports its age,
+    counts the stall ONCE per excursion, fires the capture hook, and
+    records the recovery."""
+    hang = threading.Event()
+    stop = threading.Event()
+
+    def fake_loop():
+        hb = profiling.HEARTBEATS.register(
+            "fake_hung_loop", interval_s=0.05, max_silence_s=0.2
+        )
+        while not stop.is_set():
+            hb.beat()
+            if hang.is_set():
+                hang.wait_released = True
+                while hang.is_set() and not stop.is_set():
+                    time.sleep(0.02)  # wedged: no beats
+            time.sleep(0.02)
+
+    captured = []
+    t = threading.Thread(target=fake_loop, daemon=True)
+    t.start()
+    dog = profiling.StallWatchdog(
+        check_interval_s=0.05,
+        service="plugin",
+        on_stall=captured.append,
+    )
+    before = metrics.LOOP_STALLS.get(
+        loop="fake_hung_loop", reason="stalled"
+    )
+    try:
+        time.sleep(0.15)
+        assert dog.check_once() == []  # healthy: beating
+        hang.set()
+        deadline = time.monotonic() + 5
+        stalled = []
+        while time.monotonic() < deadline:
+            stalled = dog.check_once()
+            if "fake_hung_loop" in stalled:
+                break
+            time.sleep(0.05)
+        assert "fake_hung_loop" in stalled
+        assert (
+            metrics.HEARTBEAT_AGE.get(loop="fake_hung_loop") > 0.2
+        )
+        assert metrics.LOOP_STALLS.get(
+            loop="fake_hung_loop", reason="stalled"
+        ) == before + 1
+        assert captured == ["fake_hung_loop"]
+        # Still stalled: no double-count, no second capture.
+        dog.check_once()
+        assert metrics.LOOP_STALLS.get(
+            loop="fake_hung_loop", reason="stalled"
+        ) == before + 1
+        assert captured == ["fake_hung_loop"]
+        # Recovery clears the crossing and re-arms.
+        hang.clear()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if "fake_hung_loop" not in dog.check_once():
+                break
+            time.sleep(0.05)
+        assert "fake_hung_loop" not in dog.check_once()
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        profiling.HEARTBEATS.unregister("fake_hung_loop")
+        dog.check_once()  # prunes the gauge series
+    assert metrics.HEARTBEAT_AGE.get(loop="fake_hung_loop") == 0.0
+
+
+def test_supervised_loop_death_fires_thread_liveness_then_clears():
+    """The silent-background-thread-death fix, end to end: a loop that
+    raises is logged + counted + marked dead, the thread_liveness
+    audit invariant fires CRITICAL, and restarting the loop clears
+    the finding on the next sweep."""
+    from k8s_device_plugin_tpu import audit
+
+    before = metrics.LOOP_STALLS.get(
+        loop="doomed_loop", reason="died"
+    )
+
+    def doomed():
+        hb = profiling.HEARTBEATS.register("doomed_loop", interval_s=0.1)
+        hb.beat()
+        raise RuntimeError("boom")
+
+    t = threading.Thread(
+        target=profiling.supervised("doomed_loop", doomed), daemon=True
+    )
+    t.start()
+    t.join(timeout=5)
+    try:
+        hb = profiling.HEARTBEATS.get("doomed_loop")
+        assert hb is not None and hb.dead
+        assert hb.dead_reason == "died"
+        assert metrics.LOOP_STALLS.get(
+            loop="doomed_loop", reason="died"
+        ) == before + 1
+        findings = audit.check_thread_liveness()
+        mine = [f for f in findings if f.chip == "doomed_loop"]
+        assert len(mine) == 1
+        assert mine[0].severity == audit.CRITICAL
+        assert mine[0].invariant == "thread_liveness"
+        # Restart the loop (clean this time): death clears, and the
+        # supervised wrapper unregisters on a clean return.
+        stop = threading.Event()
+
+        def healthy():
+            hb = profiling.HEARTBEATS.register(
+                "doomed_loop", interval_s=0.1
+            )
+            while not stop.is_set():
+                hb.beat()
+                time.sleep(0.02)
+
+        t2 = threading.Thread(
+            target=profiling.supervised("doomed_loop", healthy),
+            daemon=True,
+        )
+        t2.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            cleared = [
+                f for f in audit.check_thread_liveness()
+                if f.chip == "doomed_loop"
+            ]
+            if not cleared:
+                break
+            time.sleep(0.05)
+        assert not [
+            f for f in audit.check_thread_liveness()
+            if f.chip == "doomed_loop"
+        ]
+        stop.set()
+        t2.join(timeout=5)
+        assert profiling.HEARTBEATS.get("doomed_loop") is None
+    finally:
+        profiling.HEARTBEATS.unregister("doomed_loop")
+
+
+def test_supervised_real_sampler_thread_death_is_reported(tmp_path):
+    """Regression for the satellite: kill a REAL wired loop (the
+    telemetry sampler's thread target) with an unhandled exception and
+    assert the death is visible, then a restarted sampler clears it."""
+    from k8s_device_plugin_tpu import audit, telemetry
+    from tests import fakes
+    from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+    from k8s_device_plugin_tpu.topology.mesh import IciMesh
+
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5e", 4)
+    chips = PyTpuInfo().scan(accel, dev)
+    mesh = IciMesh(chips)
+    sampler = telemetry.TelemetrySampler(
+        PyTpuInfo(), accel, mesh, interval_s=0.05
+    )
+    # Arrange an unhandled exception INSIDE the run loop (poll_once's
+    # internal try only guards per-pass errors; the stop-wait path is
+    # outside it).
+    sampler._stop.wait = lambda *_a, **_k: (_ for _ in ()).throw(
+        RuntimeError("induced sampler death")
+    )
+    before = metrics.LOOP_STALLS.get(
+        loop="telemetry_sampler", reason="died"
+    )
+    sampler.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            hb = profiling.HEARTBEATS.get("telemetry_sampler")
+            if hb is not None and hb.dead:
+                break
+            time.sleep(0.05)
+        hb = profiling.HEARTBEATS.get("telemetry_sampler")
+        assert hb is not None and hb.dead
+        assert metrics.LOOP_STALLS.get(
+            loop="telemetry_sampler", reason="died"
+        ) == before + 1
+        assert [
+            f for f in audit.check_thread_liveness()
+            if f.chip == "telemetry_sampler"
+        ]
+        # A healthy restart clears the finding and the dead mark.
+        sampler2 = telemetry.TelemetrySampler(
+            PyTpuInfo(), accel, mesh, interval_s=0.05
+        )
+        sampler2.start()
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if not [
+                    f for f in audit.check_thread_liveness()
+                    if f.chip == "telemetry_sampler"
+                ]:
+                    break
+                time.sleep(0.05)
+            assert not [
+                f for f in audit.check_thread_liveness()
+                if f.chip == "telemetry_sampler"
+            ]
+        finally:
+            sampler2.stop()
+    finally:
+        profiling.HEARTBEATS.unregister("telemetry_sampler")
+        for fam in telemetry.CHIP_FAMILIES:
+            fam.remove_matching()
+
+
+# ---------------------------------------------------------------------------
+# SLO-triggered black-box capture
+# ---------------------------------------------------------------------------
+
+
+def _fresh_capture(tmp_path, **kw):
+    cm = profiling.CaptureManager()
+    defaults = dict(
+        capture_dir=str(tmp_path / "captures"),
+        p99_ms=20.0,
+        service="plugin",
+        window_s=30.0,
+        min_samples=5,
+        budget=3,
+        budget_window_s=60.0,
+    )
+    defaults.update(kw)
+    cm.configure(**defaults)
+    return cm
+
+
+def test_capture_disabled_observe_is_noop(tmp_path):
+    cm = profiling.CaptureManager()
+    cm.observe("filter", 10.0)  # unconfigured: one bool read, no state
+    assert cm.snapshot()["windows"] == {}
+    assert cm.capture("manual") is None
+
+
+def test_capture_fires_once_per_crossing_and_rearms(tmp_path):
+    cm = _fresh_capture(tmp_path)
+    # 8 slow observations: p99 crosses the 20ms threshold once.
+    for _ in range(16):
+        cm.observe("filter", 0.050)
+    files = os.listdir(tmp_path / "captures")
+    assert len(files) == 1, files
+    assert "slo_filter" in files[0]
+    # Still over: deduped, no second bundle.
+    for _ in range(16):
+        cm.observe("filter", 0.050)
+    assert len(os.listdir(tmp_path / "captures")) == 1
+    # Back under then over again: re-armed, second bundle.
+    for _ in range(600):
+        cm.observe("filter", 0.001)
+    for _ in range(600):
+        cm.observe("filter", 0.050)
+    assert len(os.listdir(tmp_path / "captures")) == 2
+
+
+def test_capture_bundle_contents_and_atomicity(tmp_path):
+    """The bundle must carry every black-box section and parse with
+    tools/flame.py when a profiler is installed; no tmp file survives
+    (atomic replace)."""
+    from k8s_device_plugin_tpu.tools import flame
+    from k8s_device_plugin_tpu.utils.decisions import LEDGER
+    from k8s_device_plugin_tpu.utils.flightrecorder import RECORDER
+
+    saved_prof = stackprof.PROFILER
+    stop, t = _busy_thread()
+    prof = stackprof.SamplingProfiler(hz=97, service="plugin")
+    stackprof.install_profiler(prof)
+    prof.start()
+    RECORDER.enable(service="plugin")
+    LEDGER.enable(service="plugin")
+    try:
+        RECORDER.record("reconcile", "pre-incident context")
+        LEDGER.record("allocate_substitution", "test", "context")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if prof.snapshot()["samples"] >= 5:
+                break
+            time.sleep(0.05)
+        cm = _fresh_capture(tmp_path)
+        hb = profiling.HEARTBEATS.register("capture_test_loop", 0.1)
+        path = cm.capture("stall_capture_test_loop", "test stall")
+        assert path and os.path.exists(path)
+        assert not [
+            f
+            for f in os.listdir(tmp_path / "captures")
+            if f.endswith(".tmp")
+        ]
+        bundle = json.load(open(path))
+        assert bundle["service"] == "plugin"
+        assert bundle["reason"] == "stall_capture_test_loop"
+        # Profile section: both formats, parseable by the renderer,
+        # and the busy thread's hot frame is in the sampled stacks.
+        assert bundle["profile"]["enabled"] is True
+        folded = flame.load_path(path)
+        assert any(
+            "_profiling_test_hotspot" in frame
+            for stack in folded
+            for frame in stack
+        )
+        assert flame.top_frames(folded, n=10)  # renderer input sane
+        # Flight ring + ledger tail + heartbeats + metrics snapshot.
+        kinds = [e["kind"] for e in bundle["flight"]["events"]]
+        assert "reconcile" in kinds
+        assert "profile_capture" not in kinds  # recorded AFTER snapshot
+        assert any(
+            r["kind"] == "allocate_substitution"
+            for r in bundle["decisions"]["records"]
+        )
+        assert any(
+            h["name"] == "capture_test_loop"
+            for h in bundle["heartbeats"]
+        )
+        assert "tpu_plugin_uptime_seconds" in bundle["metrics"]
+        # The capture records itself on the flight/ledger planes and
+        # the counter family.
+        assert any(
+            e["kind"] == "profile_capture"
+            for e in RECORDER.snapshot()["events"]
+        )
+        assert any(
+            r["kind"] == "profile_capture"
+            for r in LEDGER.query(kind="profile_capture")
+        )
+        assert metrics.PROFILE_CAPTURES.get(
+            reason="stall_capture_test_loop", outcome="ok"
+        ) >= 1
+    finally:
+        prof.stop()
+        stackprof.install_profiler(saved_prof)
+        stop.set()
+        t.join(timeout=2)
+        RECORDER.disable()
+        RECORDER.clear()
+        LEDGER.disable()
+        LEDGER.clear()
+        profiling.HEARTBEATS.unregister("capture_test_loop")
+
+
+def test_capture_budget_limits_bundles(tmp_path):
+    cm = _fresh_capture(tmp_path, budget=2)
+    assert cm.capture("stall_a") is not None
+    assert cm.capture("stall_b") is not None
+    assert cm.capture("stall_c") is None  # budget of 2 exhausted
+    assert len(os.listdir(tmp_path / "captures")) == 2
+    assert metrics.PROFILE_CAPTURES.get(
+        reason="stall_c", outcome="budget"
+    ) >= 1
+
+
+def test_capture_alternating_ops_both_evaluate(tmp_path):
+    """Regression: the p99-evaluation tick is per-WINDOW. With a
+    manager-global counter, the default scheduler's strictly
+    alternating /filter-then-/prioritize pattern parked every /filter
+    observation on counts the tick never landed on — a sustained
+    /filter breach produced zero captures."""
+    cm = _fresh_capture(tmp_path, budget=10)
+    for _ in range(16):
+        cm.observe("filter", 0.050)  # breaching
+        cm.observe("prioritize", 0.001)  # healthy
+    files = os.listdir(tmp_path / "captures")
+    assert any("slo_filter" in f for f in files), files
+    assert not any("slo_prioritize" in f for f in files), files
+
+
+def test_capture_retention_keeps_newest_bundles(tmp_path):
+    """The hourly budget bounds the RATE; retention bounds the TOTAL —
+    a months-long flapping SLO must not fill the capture volume."""
+    cm = _fresh_capture(tmp_path, budget=10, keep=3)
+    paths = [cm.capture(f"stall_loop{i}") for i in range(5)]
+    assert all(paths)
+    left = os.listdir(tmp_path / "captures")
+    assert len(left) == 3
+    assert any("stall_loop4" in f for f in left)  # newest kept
+    assert not any("stall_loop0" in f for f in left)  # oldest pruned
+
+
+# ---------------------------------------------------------------------------
+# Acceptance e2e (ISSUE 10): slow /filter + hung gang tick against
+# fake_apiserver → capture bundle + heartbeat stall + audit finding
+# ---------------------------------------------------------------------------
+
+
+def _injected_slow_scoring():
+    """The frame the acceptance test expects as the hottest stack on
+    the serving path — a sleep standing in for a regressed scoring
+    loop."""
+    time.sleep(0.05)
+
+
+def test_acceptance_slo_capture_stall_and_audit_e2e(tmp_path):
+    """ISSUE 10 acceptance: a real extender HTTP server over
+    fake_apiserver with a sleep injected into /filter scoring and a
+    deliberately hung gang-tick loop. Asserts: (1) a capture bundle
+    lands in --capture-dir whose hottest serving-path folded stack
+    names the injected sleep frame, carrying the flight ring and
+    ledger tail; (2) tpu_thread_heartbeat_age_seconds{loop=gang_tick}
+    exceeds its threshold and the thread_liveness audit finding fires,
+    then clears once the tick resumes. (The profiler_overhead bench
+    bound is asserted in tests/test_scale_bench.py.)"""
+    import requests as rq
+
+    from k8s_device_plugin_tpu import audit
+    from k8s_device_plugin_tpu.extender.gang import GangAdmission
+    from k8s_device_plugin_tpu.extender.server import (
+        ExtenderHTTPServer,
+        NodeAnnotationCache,
+        TopologyExtender,
+    )
+    from k8s_device_plugin_tpu.kube.client import KubeClient
+    from k8s_device_plugin_tpu.tools import flame
+    from k8s_device_plugin_tpu.utils.decisions import LEDGER
+    from k8s_device_plugin_tpu.utils.flightrecorder import RECORDER
+    from tests.fake_apiserver import FakeApiServer
+    from tests.test_extender import make_node, tpu_pod
+
+    class SlowExtender(TopologyExtender):
+        def _filter_names_impl(self, pod, names):
+            _injected_slow_scoring()
+            return super()._filter_names_impl(pod, names)
+
+    api = FakeApiServer()
+    url = api.start()
+    for i in range(3):
+        api.add_node(f"n{i}", make_node(f"n{i}"))
+    saved_prof = stackprof.PROFILER
+    saved_service = profiling._SERVICE
+    profiling.set_service("extender")
+    prof = stackprof.SamplingProfiler(hz=97, service="extender")
+    stackprof.install_profiler(prof)
+    prof.start()
+    RECORDER.enable(service="extender")
+    LEDGER.enable(service="extender")
+    cap_dir = tmp_path / "captures"
+    profiling.CAPTURE.configure(
+        capture_dir=str(cap_dir),
+        p99_ms=20.0,
+        service="extender",
+        window_s=30.0,
+        min_samples=5,
+    )
+    client = KubeClient(url)
+    cache = None
+    srv = None
+    gang = None
+    dog = None
+    resume = threading.Event()  # unset: the tick wedges in wait()
+    try:
+        RECORDER.record("reconcile", "pre-incident context")
+        cache = NodeAnnotationCache(client, interval_s=0.2).start()
+        srv = ExtenderHTTPServer(
+            extender=SlowExtender(node_cache=cache), host="127.0.0.1"
+        )
+        base = srv.start()
+        # A gang admitter whose tick hangs (the wedged-loop half).
+        gang = GangAdmission(
+            client, resync_interval_s=0.1, watch=False
+        )
+        gang.tick = lambda full=False: resume.wait()
+        gang.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            hb = profiling.HEARTBEATS.get("gang_tick")
+            if hb is not None:
+                break
+            time.sleep(0.02)
+        hb = profiling.HEARTBEATS.get("gang_tick")
+        assert hb is not None
+        hb.max_silence_s = 0.5  # test-speed stall threshold
+        dog = profiling.StallWatchdog(
+            check_interval_s=0.1,
+            service="extender",
+            on_stall=profiling.CAPTURE.heartbeat_stall,
+        ).start()
+
+        # -- SLO breach: slow /filter crosses --capture-p99-ms -------
+        body = {"pod": tpu_pod(2), "nodenames": ["n0", "n1", "n2"]}
+        for _ in range(10):
+            r = rq.post(f"{base}/filter", json=body, timeout=5)
+            assert r.status_code == 200
+        deadline = time.monotonic() + 10
+        slo_bundles = []
+        while time.monotonic() < deadline and not slo_bundles:
+            if cap_dir.is_dir():
+                slo_bundles = [
+                    f for f in os.listdir(cap_dir) if "slo_filter" in f
+                ]
+            if not slo_bundles:
+                rq.post(f"{base}/filter", json=body, timeout=5)
+        assert slo_bundles, (
+            os.listdir(cap_dir) if cap_dir.is_dir() else "no dir"
+        )
+        bundle = json.load(open(cap_dir / slo_bundles[0]))
+        # Profile samples present; the hottest folded stack on the
+        # SERVING path names the injected sleep frame.
+        assert bundle["profile"]["enabled"] is True
+        folded = flame.load_any(bundle)
+        serving = {
+            s: c
+            for s, c in folded.items()
+            if any("do_POST" in frame for frame in s)
+        }
+        assert serving, folded
+        hottest = max(serving.items(), key=lambda kv: kv[1])[0]
+        assert any(
+            "_injected_slow_scoring" in frame for frame in hottest
+        ), hottest
+        # Flight ring + ledger tail ride along.
+        assert any(
+            e["kind"] == "reconcile"
+            for e in bundle["flight"]["events"]
+        )
+        assert any(
+            r["kind"] == "filter"
+            for r in bundle["decisions"]["records"]
+        )
+        assert bundle["windows"]["filter"]["p99_ms"] > 20.0
+
+        # -- heartbeat stall: the hung tick loop ----------------------
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (
+                metrics.EXT_HEARTBEAT_AGE.get(loop="gang_tick")
+                > hb.max_silence_s
+            ):
+                break
+            time.sleep(0.05)
+        assert (
+            metrics.EXT_HEARTBEAT_AGE.get(loop="gang_tick")
+            > hb.max_silence_s
+        )
+        assert metrics.EXT_LOOP_STALLS.get(
+            loop="gang_tick", reason="stalled"
+        ) >= 1
+        # The stall produced its own capture bundle.
+        deadline = time.monotonic() + 5
+        stall_bundles = []
+        while time.monotonic() < deadline and not stall_bundles:
+            stall_bundles = [
+                f
+                for f in os.listdir(cap_dir)
+                if "stall_gang_tick" in f
+            ]
+            time.sleep(0.05)
+        assert stall_bundles
+        # thread_liveness fires on an audit sweep...
+        engine = audit.ExtenderAudit(index=cache.index).engine(
+            interval_s=3600
+        )
+        findings = [
+            f
+            for f in engine.sweep_once()
+            if f.invariant == "thread_liveness"
+            and f.chip == "gang_tick"
+        ]
+        assert findings, engine.snapshot()
+        # ...and clears once the tick resumes beating.
+        resume.set()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            still = [
+                f
+                for f in engine.sweep_once()
+                if f.invariant == "thread_liveness"
+                and f.chip == "gang_tick"
+            ]
+            if not still:
+                break
+            time.sleep(0.1)
+        assert not [
+            f
+            for f in engine.sweep_once()
+            if f.invariant == "thread_liveness"
+            and f.chip == "gang_tick"
+        ]
+    finally:
+        resume.set()
+        if dog is not None:
+            dog.stop()
+        if gang is not None:
+            gang.stop()
+        if srv is not None:
+            srv.stop()
+        if cache is not None:
+            cache.stop()
+        api.stop()
+        prof.stop()
+        stackprof.install_profiler(saved_prof)
+        profiling.CAPTURE.disable()
+        profiling.set_service(saved_service)
+        RECORDER.disable()
+        RECORDER.clear()
+        LEDGER.disable()
+        LEDGER.clear()
+        for name in ("gang_tick", "node_cache_relist",
+                     "node_event_applier"):
+            profiling.HEARTBEATS.unregister(name)
+        metrics.EXT_HEARTBEAT_AGE.remove_matching()
+        metrics.EXT_AUDIT_FINDINGS.remove_matching()
+
+
+# ---------------------------------------------------------------------------
+# Docs / deploy / CI lockstep
+# ---------------------------------------------------------------------------
+
+
+def test_runtime_profiling_docs_in_lockstep():
+    """docs/observability.md must document the profiler surface and
+    the new flight/ledger kinds; metrics.md the new families (the
+    registry-wide lockstep test already cross-checks exact names);
+    operations.md the regression runbook; tier1/deploy/grafana the
+    wiring."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    obs = open(os.path.join(repo, "docs", "observability.md")).read()
+    for needle in (
+        "/debug/profile", "--profile-hz", "--capture-dir",
+        "--capture-p99-ms", "`profile_capture`", "`loop_stall`",
+        "`thread_liveness`", "speedscope", "collapsed",
+        "tools/flame.py",
+    ):
+        assert needle in obs, needle
+    mets = open(os.path.join(repo, "docs", "metrics.md")).read()
+    for fam in (
+        "tpu_thread_heartbeat_age_seconds", "tpu_loop_stall_total",
+        "tpu_gc_pause_seconds", "tpu_lock_wait_seconds",
+        "tpu_profile_samples_total", "tpu_profile_captures_total",
+    ):
+        assert f"`{fam}`" in mets, fam
+    ops = open(os.path.join(repo, "docs", "operations.md")).read()
+    assert "Reading a latency regression: from alert to flamegraph" in ops
+    tier1 = open(os.path.join(repo, "scripts", "tier1.sh")).read()
+    assert "tools.flame --self-test" in tier1
+    assert "--profile-self-test" in tier1
+    for deploy in ("tpu-device-plugin.yml", "tpu-extender.yml"):
+        text = open(os.path.join(repo, "deploy", deploy)).read()
+        assert "--profile-hz" in text, deploy
+        assert "--capture-dir" in text, deploy
+    dash = open(
+        os.path.join(repo, "deploy", "grafana-dashboard.json")
+    ).read()
+    assert "Runtime performance" in dash
+    for fam in (
+        "tpu_thread_heartbeat_age_seconds", "tpu_gc_pause_seconds",
+        "tpu_lock_wait_seconds", "tpu_profile_captures_total",
+    ):
+        assert fam in dash, fam
