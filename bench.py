@@ -84,6 +84,13 @@ more complete):
                                arm >= 5x faster, fully-stale fallback
                                <= 1.05x), plus cold-first-call and
                                warm-drain costs
+  detail.shard_scaling         sharded active-active admission at
+                               50,000 nodes / 5,000 gangs / 4 shards:
+                               gangs admitted/s (single vs per-shard
+                               vs parallel) and per-shard /filter p99
+                               vs the single-shard baseline (bound
+                               <= 1.1x, enforced at gate scale in
+                               tests/test_scale_bench.py)
   detail.grant     every chip-grant probe attempt
   detail.workload.mfu   model FLOPs/step ÷ step time ÷ chip peak bf16
   detail.workload_chunked_xent.vs_plain_step   chunked-vocab CE A/B
@@ -852,6 +859,22 @@ def main() -> int:
             result["detail"]["profiler_overhead"] = {
                 "error": repr(e)[:400]
             }
+        emit()
+        # Phase 1.10c: sharded-admission scale probe (ISSUE 11 — the
+        # 50,000-node / 5,000-gang stretch: admission throughput
+        # (gangs admitted/s) is a first-class metric alongside
+        # latency; per-shard /filter p99 must stay within 1.1x of the
+        # single-shard figure as N grows, bounded at gate scale in
+        # tests/test_scale_bench.py; ~1 min, the longest control-plane
+        # phase by design — it IS the scale headline).
+        try:
+            result["detail"]["shard_scaling"] = (
+                scale_bench.shard_scaling(
+                    n_nodes=50000, n_gangs=5000, shards=4
+                )
+            )
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["shard_scaling"] = {"error": repr(e)[:400]}
         emit()
         # Phase 1.11: cold-start failover probe (ISSUE 9 — a persisted
         # topology-index snapshot must make extender time-to-ready

@@ -876,12 +876,14 @@ class ExtenderAudit:
         gang=None,  # GangAdmission
         index=None,  # TopologyIndex
         resource_name: str = constants.RESOURCE_NAME,
+        shard_manager=None,  # sharding.ShardManager
     ):
         self.reservations = reservations
         self.journal = journal
         self.gang = gang
         self.index = index
         self.resource_name = resource_name
+        self.shard_manager = shard_manager
         self._recount_pos = 0
         # Per-sweep facts.
         self._gangs: Optional[dict] = None
@@ -930,6 +932,17 @@ class ExtenderAudit:
                 "a fully-gated gang with a standing hold is a release "
                 "that failed wholesale",
                 _skippable(self.check_gate_vs_hold),
+            ))
+        if self.shard_manager is not None:
+            out.append(Invariant(
+                "reservation_shard_ownership",
+                ("reservations", "shard-ring", "topology-index"),
+                "every hold must fence capacity its OWN shard owns "
+                "(consistent-hash of the host's slice key), and no "
+                "host may carry holds from two shards — the "
+                "structural no-cross-shard-double-booking guarantee "
+                "of sharded admission",
+                self.check_shard_ownership,
             ))
         if self.index is not None:
             out.append(Invariant(
@@ -1025,6 +1038,87 @@ class ExtenderAudit:
 
         out = diff()
         return diff() if out else out
+
+    def check_shard_ownership(self) -> List[Finding]:
+        """Sharded admission's structural guarantee, re-proven from
+        scratch each sweep: walk every owned shard's table and hash
+        each held host's slice key through the ring — a hold on
+        capacity another shard owns (or one host carrying holds from
+        two local shards) is a CRITICAL cross-shard double-booking
+        hazard, the exact failure partitioning exists to make
+        impossible."""
+        mgr = self.shard_manager
+        ring = mgr.ring
+        # Host → its capacity-domain hash key: the slice key when the
+        # index knows it (every slice member hashes together), the
+        # hostname for a known standalone host. A host the index does
+        # NOT know (no index wired, or its entry vanished mid-incident
+        # while the hold still stands) yields None: hashing the bare
+        # hostname of a slice MEMBER would derive the wrong owner and
+        # page a false CRITICAL, so unresolvable hosts skip the
+        # ownership half (the two-shards-on-one-host check below
+        # needs no hashing and always runs).
+        host_keys: Optional[Dict[str, str]] = None
+        if self.index is not None:
+            host_keys = {}
+            for e in self.index.entries():
+                if e.hostname:
+                    host_keys[e.hostname] = (
+                        "|".join(e.slice_key)
+                        if e.slice_key
+                        else e.hostname
+                    )
+        out: List[Finding] = []
+        holder_of: Dict[str, int] = {}
+        conflicted: Set[str] = set()
+        for shard_id, table in mgr.shard_tables():
+            for key, res in sorted(table.active().items()):
+                for host, n in sorted(res.hosts.items()):
+                    cap_key = (
+                        host_keys.get(host)
+                        if host_keys is not None
+                        else None
+                    )
+                    owner = (
+                        ring.shard_of(cap_key)
+                        if cap_key is not None
+                        else shard_id
+                    )
+                    if owner != shard_id:
+                        out.append(Finding.make(
+                            "reservation_shard_ownership", CRITICAL,
+                            f"shard {shard_id} holds {n} chip(s) on "
+                            f"{host} for gang {key[0]}/{key[1]}, but "
+                            f"shard {owner} owns that capacity — a "
+                            f"chip held by a shard that doesn't own "
+                            f"it can be double-booked by its true "
+                            f"owner",
+                            gang=f"{key[0]}/{key[1]}",
+                            node=host,
+                            shard=shard_id,
+                            owner_shard=owner,
+                            chips=n,
+                        ))
+                    prev = holder_of.get(host)
+                    if prev is not None and prev != shard_id:
+                        # Once per host per sweep: ten gang entries
+                        # behind one conflicted host are ONE hazard,
+                        # not ten pages.
+                        if host not in conflicted:
+                            conflicted.add(host)
+                            out.append(Finding.make(
+                                "reservation_shard_ownership",
+                                CRITICAL,
+                                f"host {host} carries holds from two "
+                                f"shards ({prev} and {shard_id}) — "
+                                f"cross-shard double-booking in "
+                                f"progress",
+                                node=host,
+                                shards=f"{prev},{shard_id}",
+                            ))
+                    else:
+                        holder_of[host] = shard_id
+        return out
 
     def check_reservation_vs_cluster(self) -> List[Finding]:
         active = self.reservations.active()

@@ -361,10 +361,26 @@ class GangAdmission:
         pending_event_repost_s: float = 600.0,
         pending_event_budget: int = 10,
         journal: Optional[AdmissionJournal] = None,
+        gang_filter: Optional[
+            Callable[[Tuple[str, str]], bool]
+        ] = None,
+        topo_filter: Optional[Callable[[NodeTopology], bool]] = None,
+        shard_id: Optional[int] = None,
     ):
         self.client = client
         self.resource_name = resource_name
         self.resync_interval_s = resync_interval_s
+        # Sharded admission (extender/sharding.py): this admitter owns
+        # one shard of the consistent-hash ring. ``gang_filter`` keeps
+        # every pass — ticks, recovery reconcile, explain — to the
+        # gangs this shard owns; ``topo_filter`` restricts the
+        # capacity view to the slices it owns, which is what makes
+        # cross-shard double-booking structurally impossible (a shard
+        # can only reserve chips on capacity no other shard will ever
+        # place onto). None (the default) is the unsharded admitter.
+        self.gang_filter = gang_filter
+        self.topo_filter = topo_filter
+        self.shard_id = shard_id
         # Level-triggered backstop cadence: the background loop runs a
         # FULL sweep (every gang rescanned) at least this often; the
         # resyncs in between are dirty ticks that evaluate only gangs
@@ -722,6 +738,10 @@ class GangAdmission:
         info = pod_gang(pod)
         if info is None:
             return
+        if self.gang_filter is not None and not self.gang_filter(
+            (info[0], info[1])
+        ):
+            return  # another shard's gang: not ours to wake
         with self._dirty_lock:
             self._dirty.add((info[0], info[1]))
         metrics.GANG_DIRTY_MARKS.inc(source="pod")
@@ -1061,6 +1081,14 @@ class GangAdmission:
             )
         if keys is not None:
             views = {k: v for k, v in views.items() if k in keys}
+        if self.gang_filter is not None:
+            # Sharded admission: another shard's gangs are invisible to
+            # this admitter everywhere discovery feeds — tick, upkeep,
+            # recovery reconcile, explain — so it can neither admit nor
+            # drop what it doesn't own.
+            views = {
+                k: v for k, v in views.items() if self.gang_filter(k)
+            }
         return views
 
     def tick(self, full: bool = True) -> List[Tuple[str, str]]:
@@ -1391,6 +1419,12 @@ class GangAdmission:
             metrics.GANG_WAITING.set(len(self._waiting_gangs))
         for _ in released:
             metrics.GANG_RELEASED.inc()
+        if released and self.shard_id is not None:
+            # Per-shard admission throughput: rate() of this family is
+            # the gangs-admitted/s SLI the scale bench bounds.
+            metrics.SHARD_ADMITTED.inc(
+                len(released), shard=str(self.shard_id)
+            )
         active = self.reservations.active()
         metrics.GANG_RESERVED.set(len(active))
         metrics.GANG_RESERVED_CHIPS.set(
@@ -1610,6 +1644,11 @@ class GangAdmission:
                     )
                     return list(self._last_topos)
                 raise
+            if self.topo_filter is not None:
+                # Sharded admission: only capacity this shard owns —
+                # the structural no-double-booking half (its peer
+                # shards filter the complement).
+                topos = [t for t in topos if self.topo_filter(t)]
             self._last_topos = list(topos)
             return topos
         try:
@@ -1639,6 +1678,8 @@ class GangAdmission:
                     "bad topology annotation on %s: %s",
                     (node.get("metadata") or {}).get("name"), e,
                 )
+        if self.topo_filter is not None:
+            topos = [t for t in topos if self.topo_filter(t)]
         self._last_topos = list(topos)
         return topos
 

@@ -60,10 +60,11 @@ from __future__ import annotations
 
 import calendar
 import os
+import random
 import socket
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 from ..kube.client import KubeError, rfc3339_now
 from ..utils import metrics
@@ -120,12 +121,33 @@ class LeaderLease:
         renew_deadline_s: float = 0.0,
         on_lost: Optional[Callable[[], None]] = None,
         clock: Callable[[], float] = time.time,
+        retry_jitter_s: float = 0.5,
+        annotations_fn: Optional[Callable[[], Dict[str, str]]] = None,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
     ):
         self.client = client
         self.namespace = namespace
         self.name = name
         self.identity = identity or default_identity()
         self.lease_seconds = lease_seconds
+        # Jitter bound for the acquire retry after a lost optimistic-
+        # concurrency race: N shard replicas racing one released lease
+        # used to re-read/re-PUT on the same fixed cadence and conflict
+        # again in lockstep — a stampede of 409s against the apiserver.
+        # A uniform [0, retry_jitter_s) sleep desynchronizes the field
+        # so one loser wins the second round. 0 restores the old
+        # immediate retry. ``rng``/``sleep`` are injectable for tests.
+        self.retry_jitter_s = max(0.0, retry_jitter_s)
+        self._rng = rng or random.Random()
+        self._sleep = sleep
+        # Optional metadata-annotation publisher: called on every
+        # acquire/renew write and merged into the Lease's
+        # metadata.annotations. The sharded admission plane piggybacks
+        # each shard's reservation snapshot here (cross-shard /filter
+        # visibility rides the renew cadence — extender/sharding.py);
+        # None costs nothing.
+        self.annotations_fn = annotations_fn
         # client-go convention (LeaseDuration 15 / RenewDeadline 10):
         # demote at 2/3 of the lease so a partitioned holder stops
         # admitting strictly BEFORE its lease becomes takeover-able.
@@ -165,6 +187,40 @@ class LeaderLease:
         if acquire:
             spec["acquireTime"] = now
         return spec
+
+    def _stamp_annotations(self, lease: dict) -> None:
+        """Merge annotations_fn's payload into the lease metadata
+        (acquire + every renew). Best-effort: a raising publisher
+        costs the overlay's freshness, never the renewal — losing the
+        lease over a holds-snapshot bug would stall a whole shard."""
+        if self.annotations_fn is None:
+            return
+        try:
+            extra = self.annotations_fn()
+        except Exception as e:  # noqa: BLE001 — overlay, not the fence
+            log.warning("lease annotation publisher failed: %s", e)
+            return
+        if not extra:
+            return
+        meta = lease.setdefault("metadata", {})
+        ann = meta.get("annotations")
+        if not isinstance(ann, dict):
+            ann = {}
+            meta["annotations"] = ann
+        ann.update(extra)
+
+    def _race_lost(self, what: str) -> None:
+        """One lost optimistic-concurrency round: count it and sleep a
+        jittered beat so racing replicas desynchronize before the
+        re-read (the conflict-stampede guard)."""
+        metrics.SHARD_ACQUIRE_CONFLICTS.inc()
+        if self.retry_jitter_s > 0:
+            delay = self._rng.uniform(0, self.retry_jitter_s)
+            log.debug(
+                "lost %s race for %s/%s; retrying in %.3fs",
+                what, self.namespace, self.name, delay,
+            )
+            self._sleep(delay)
 
     def _holder_is_live(self, spec: dict) -> bool:
         """Client-go-style liveness: a holder whose record this process
@@ -220,11 +276,13 @@ class LeaderLease:
                     },
                     "spec": self._spec(transitions=0, acquire=True),
                 }
+                self._stamp_annotations(body)
                 try:
                     self.client.create(self._collection, body)
                     return
                 except KubeError as ce:
                     if ce.status_code == 409 and attempt == 0:
+                        self._race_lost("create")
                         continue  # lost the create race; re-read
                     raise
             spec = lease.get("spec") or {}
@@ -249,11 +307,13 @@ class LeaderLease:
                 + (1 if taking_over else 0),
                 acquire=taking_over or not holder,
             )
+            self._stamp_annotations(lease)
             try:
                 self.client.replace(self._path, lease)
                 return
             except KubeError as e:
                 if e.status_code == 409 and attempt == 0:
+                    self._race_lost("takeover")
                     continue  # lost the takeover race; re-read
                 raise
         raise SecondReplica(
@@ -396,4 +456,5 @@ class LeaderLease:
         else:
             spec["renewTime"] = rfc3339_now()
             lease["spec"] = spec
+        self._stamp_annotations(lease)
         self.client.replace(self._path, lease, deadline_s=rem, timeout=t_out)

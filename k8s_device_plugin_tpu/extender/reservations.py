@@ -55,6 +55,26 @@ DEFAULT_TTL_S = 60.0
 DEFAULT_MAX_AGE_S = 300.0
 
 
+def apply_held(topos, held_by_host: Dict[str, int]) -> Dict[str, int]:
+    """Subtract held chip COUNTS from published NodeTopology
+    availability, in place (chips within a host are fungible for
+    counting — a hold fences a count, not identities). The ONE place
+    the holds→availability truncation lives: ReservationTable.apply
+    and the sharded facade (sharding.ShardedReservations.apply) both
+    route through here, so single-table and sharded /filter shields
+    cannot drift. Returns hostname→chips withheld (for the
+    failure-reason diagnostics)."""
+    withheld: Dict[str, int] = {}
+    for t in topos:
+        held = held_by_host.get(t.hostname, 0)
+        if held > 0:
+            t.available = t.available[
+                : max(0, len(t.available) - held)
+            ]
+            withheld[t.hostname] = held
+    return withheld
+
+
 @dataclasses.dataclass
 class Reservation:
     gang: GangKey
@@ -349,23 +369,13 @@ class ReservationTable:
 
     def apply(self, topos, exclude: Optional[GangKey] = None) -> Dict[str, int]:
         """Subtract active holds from published NodeTopology
-        availability, in place (chips within a host are fungible for
-        counting — the hold fences a COUNT, not identities). The ONE
-        place the holds→availability mapping lives: both the extender's
-        /filter shield and the admission tick's capacity view go
-        through here (the indexed fast path uses the same
-        ``held_by_host`` counts), so they cannot drift. Returns
-        hostname→chips withheld (for failure-reason diagnostics)."""
-        held_by_host = self.held_by_host(exclude)
-        withheld: Dict[str, int] = {}
-        for t in topos:
-            held = held_by_host.get(t.hostname, 0)
-            if held > 0:
-                t.available = t.available[
-                    : max(0, len(t.available) - held)
-                ]
-                withheld[t.hostname] = held
-        return withheld
+        availability, in place, via the shared :func:`apply_held`
+        core: both the extender's /filter shield and the admission
+        tick's capacity view go through here (the indexed fast path
+        uses the same ``held_by_host`` counts), so they cannot drift.
+        Returns hostname→chips withheld (for failure-reason
+        diagnostics)."""
+        return apply_held(topos, self.held_by_host(exclude))
 
     def snapshot(self) -> list:
         """JSON-ready view of active holds (extender /reservations

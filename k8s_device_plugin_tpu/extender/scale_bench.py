@@ -398,6 +398,183 @@ def run(
     }
 
 
+def shard_scaling(
+    n_nodes: int = 1000,
+    n_gangs: int = 100,
+    shards: int = 3,
+    filter_calls: int = 20,
+) -> dict:
+    """Sharded active-active admission at scale (extender/sharding.py).
+
+    Three arms over identical fixtures:
+
+    * ``single`` — today's one-admitter shape: one GangAdmission
+      releases every gang in one full tick; its wall time is the
+      admission-throughput baseline (gangs admitted/s — the
+      first-class bench metric), and its indexed /filter p99 (shielded
+      by all standing holds in ONE table) is the latency baseline.
+    * ``sharded`` — N per-shard admitters over ring-partitioned gangs
+      and capacity; per-shard tick wall times give per-shard and
+      parallel (max-over-shards, the N-replica wall clock) throughput.
+    * /filter is measured interleaved sample-by-sample between the
+      single-table shield, the all-shards-local facade (the
+      post-takeover worst case), and the own-shard+peer-overlay
+      facade (the steady production shape: a replica owns ~1 shard
+      and reads N-1 peers' published holds) — the acceptance bound is
+      peer-overlay p99 ≤ 1.1x single-table p99 as N grows.
+    """
+    from .sharding import ShardRing, ShardedReservations
+
+    ring = ShardRing(shards)
+    nodes = [_node(f"node-{i:05d}") for i in range(n_nodes)]
+    names = [
+        (n.get("metadata") or {}).get("name", "") for n in nodes
+    ]
+    cache = NodeAnnotationCache(_StubClient(nodes, []), interval_s=3600)
+    cache.refresh()
+    topo_source = cache.index.topologies
+
+    def gang_pods() -> List[dict]:
+        return [
+            _gang_pod(f"g{g:05d}-w{i}", f"gang-{g:05d}", 2, 2)
+            for g in range(n_gangs)
+            for i in range(2)
+        ]
+
+    # -- single-admitter arm ----------------------------------------------
+    single_table = ReservationTable()
+    adm = GangAdmission(
+        _StubClient(nodes, gang_pods()),
+        reservations=single_table,
+        topo_source=topo_source,
+    )
+    t0 = time.perf_counter()
+    released = adm.tick()
+    single_admit_s = time.perf_counter() - t0
+    assert len(released) == n_gangs, len(released)
+
+    # -- sharded arm -------------------------------------------------------
+    tables: List[ReservationTable] = []
+    per_shard: Dict[str, dict] = {}
+    shard_admit_s: List[float] = []
+    total_released = 0
+    for s in range(shards):
+        table = ReservationTable()
+        tables.append(table)
+        adm_s = GangAdmission(
+            _StubClient(nodes, gang_pods()),
+            reservations=table,
+            topo_source=topo_source,
+            gang_filter=(
+                lambda key, s=s: ring.gang_shard(key) == s
+            ),
+            topo_filter=(
+                lambda t, s=s: ring.topo_shard(t) == s
+            ),
+            shard_id=s,
+        )
+        t0 = time.perf_counter()
+        rel = adm_s.tick()
+        dt = time.perf_counter() - t0
+        shard_admit_s.append(dt)
+        total_released += len(rel)
+        per_shard[str(s)] = {
+            "gangs": len(rel),
+            "admit_s": round(dt, 4),
+            "gangs_per_s": round(len(rel) / dt, 1) if dt > 0 else 0.0,
+        }
+    assert total_released == n_gangs, (
+        f"sharded arms admitted {total_released}/{n_gangs} — a gang "
+        f"did not fit its own shard's capacity partition"
+    )
+
+    # -- /filter arms, interleaved ----------------------------------------
+    ext_single = TopologyExtender(
+        reservations=single_table, node_cache=cache
+    )
+    facade_local = ShardedReservations(lambda: list(tables))
+    ext_local = TopologyExtender(
+        reservations=facade_local, node_cache=cache
+    )
+    # Steady production shape: this replica owns shard 0's table; the
+    # other shards' holds arrive as peer overlay records (the
+    # lease-annotation plane, pre-parsed by the scan loop).
+    peer_records = [
+        {
+            "namespace": e["namespace"],
+            "gang": e["gang"],
+            "hosts": e["hosts"],
+        }
+        for t in tables[1:]
+        for e in t.snapshot()
+    ]
+    facade_peer = ShardedReservations(
+        lambda: [tables[0]], lambda: peer_records
+    )
+    ext_peer = TopologyExtender(
+        reservations=facade_peer, node_cache=cache
+    )
+    arms = {
+        "single": (ext_single, []),
+        "sharded_local": (ext_local, []),
+        "sharded_peer": (ext_peer, []),
+    }
+    pod = _plain_pod(chips=2)
+    for ext, _ in arms.values():  # warm the score memos off-sample
+        out = ext.filter_names(pod, names)
+        assert out is not None
+    import gc
+
+    gc.collect()
+    gc.freeze()
+    try:
+        for _ in range(filter_calls):
+            # Interleaved sample-by-sample (the suite's timeit
+            # discipline): an OS-scheduler spike lands on one SAMPLE,
+            # not one ARM.
+            for ext, samples in arms.values():
+                t0 = time.perf_counter()
+                out = ext.filter_names(pod, names)
+                samples.append(time.perf_counter() - t0)
+                assert out is not None
+    finally:
+        gc.unfreeze()
+
+    single_f = _pctl(arms["single"][1])
+    local_f = _pctl(arms["sharded_local"][1])
+    peer_f = _pctl(arms["sharded_peer"][1])
+    return {
+        "nodes": n_nodes,
+        "gangs": n_gangs,
+        "shards": shards,
+        "single": {
+            "filter": single_f,
+            "admit_s": round(single_admit_s, 4),
+            "gangs_per_s": round(n_gangs / single_admit_s, 1),
+        },
+        "sharded": {
+            "filter_local": local_f,
+            "filter_peer_overlay": peer_f,
+            "per_shard": per_shard,
+            # N replicas tick concurrently: the slowest shard IS the
+            # wall clock, so parallel throughput divides by max().
+            "gangs_per_s_parallel": round(
+                n_gangs / max(shard_admit_s), 1
+            ),
+            "gangs_per_s_sequential": round(
+                n_gangs / sum(shard_admit_s), 1
+            ),
+        },
+        "filter_p99_ratio_peer_vs_single": round(
+            peer_f["p99_ms"] / single_f["p99_ms"], 3
+        ) if single_f["p99_ms"] > 0 else 0.0,
+        "throughput_scale_vs_single": round(
+            (n_gangs / max(shard_admit_s)) / (n_gangs / single_admit_s),
+            2,
+        ),
+    }
+
+
 def tracing_overhead(n_nodes: int = 1000, filter_calls: int = 30) -> dict:
     """The disabled-is-a-no-op proof, MEASURED (ISSUE 3 acceptance):
     the indexed /filter+/prioritize hot path with tracing disabled vs
@@ -1356,6 +1533,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--nodes", type=int, default=1000)
     p.add_argument("--gangs", type=int, default=100)
+    p.add_argument("--shards", type=int, default=3)
+    p.add_argument(
+        "--shard-scaling", action="store_true",
+        help="run the sharded-admission probe (per-shard /filter p99 "
+        "+ gangs-admitted/s, single vs N shards) instead of the "
+        "scale run",
+    )
     p.add_argument(
         "--tracing-overhead", action="store_true",
         help="run the tracing-overhead probe instead of the scale run",
@@ -1400,6 +1584,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "flame renderer → capture bundle (scripts/tier1.sh)",
     )
     a = p.parse_args(argv)
+    if a.shard_scaling:
+        print(json.dumps(shard_scaling(
+            n_nodes=a.nodes, n_gangs=a.gangs, shards=a.shards
+        )))
+        return 0
     if a.profile_self_test:
         return profile_self_test()
     if a.profiler_overhead:

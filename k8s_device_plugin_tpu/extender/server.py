@@ -1400,11 +1400,18 @@ class ReadyStatus:
         ready_event: threading.Event,
         journal_configured: bool = False,
         warm_progress=None,
+        shard_status=None,
     ):
         self._ready = ready_event
         self._replay_done = not journal_configured
         # () -> {"parsed": int, "total": int}, or None without a cache.
         self.warm_progress = warm_progress
+        # () -> ShardManager.status() dict, or None when unsharded:
+        # a rollout probe must distinguish "replica up but owns
+        # nothing yet" from "ready" — the owned-shard set and each
+        # shard's replay/warm phase ride the /readyz body (and
+        # /debug/readyz, so tpu-doctor bundles capture it).
+        self.shard_status = shard_status
         self._t0 = time.monotonic()
         self.time_to_ready_s: Optional[float] = None
 
@@ -1433,6 +1440,18 @@ class ReadyStatus:
                 out["warm"] = self.warm_progress()
             except Exception:  # noqa: BLE001 — progress is advisory;
                 pass  # a broken provider must not break the probe
+        if self.shard_status is not None:
+            try:
+                st = self.shard_status()
+                out["shard"] = {
+                    "shards": st.get("shards"),
+                    "home": st.get("home"),
+                    "owned": st.get("owned"),
+                    "phases": st.get("shard_phases"),
+                    "takeovers": st.get("takeovers"),
+                }
+            except Exception:  # noqa: BLE001 — advisory, same as warm
+                pass
         if self.time_to_ready_s is not None:
             out["time_to_ready_s"] = self.time_to_ready_s
         if phase == "replaying":
@@ -1535,7 +1554,7 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                             **{
                                 k: v
                                 for k, v in detail.items()
-                                if k in ("phase", "warm")
+                                if k in ("phase", "warm", "shard")
                             },
                         },
                         503,
@@ -1621,11 +1640,13 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                         self._send({"error": f"unknown path {self.path}"}, 404)
                         return
                     metrics.EXTENDER_REQUESTS.inc(verb=verb, outcome="ok")
-                    # SLO-triggered capture feed (utils/profiling.py):
-                    # one bool read when --capture-dir is unset.
-                    profiling.CAPTURE.observe(
-                        verb, time.perf_counter() - t0
-                    )
+                    dt = time.perf_counter() - t0
+                    # Serving-latency histogram (the per-shard /filter
+                    # p99 panel) + the SLO-triggered capture feed
+                    # (utils/profiling.py — one bool read when
+                    # --capture-dir is unset).
+                    metrics.EXT_REQUEST_LATENCY.observe(dt, verb=verb)
+                    profiling.CAPTURE.observe(verb, dt)
                 except Exception as e:  # annotations are external input —
                     # one bad one must cost an error payload, not the
                     # scheduler's whole HTTP call.

@@ -1,0 +1,1265 @@
+"""Sharded active-active admission: N extender replicas, no single
+point of failure.
+
+``leader.py``'s fail-fast singleton made ONE process both the
+availability bottleneck (admitter death stalls every gang until lease
+takeover + rehydration) and the throughput ceiling for the whole
+cluster. This module generalizes the fence from "one admitter per
+cluster" to "one admitter per SHARD":
+
+* **Consistent-hash ring** (:class:`ShardRing`): slice keys — the
+  capacity domain (a node's slice membership, or its hostname for a
+  standalone host) — hash onto N shards through a virtual-node ring,
+  so adding/removing a shard remaps only ~1/N of keys and the same key
+  can never map to two shards under one replica count. Gang keys ride
+  the same ring, so each gang is pinned to exactly one shard and is
+  admitted onto exactly that shard's capacity — cross-shard
+  double-booking of a chip is impossible *by construction*, not by
+  coordination.
+* **Per-shard Lease** (the ``leader.py`` fence, one per shard): a
+  replica not holding shard k's lease must not admit shard k's gangs —
+  the same renew-deadline self-demotion and optimistic-concurrency
+  takeover as the singleton, so split-brain admission of one shard
+  stays impossible. The home shard keeps the singleton's fail-fast
+  contract (a second replica targeting the same home shard exits
+  nonzero); OTHER shards' stale leases are taken over by the scan loop
+  (:class:`ShardManager`), with the acquire path's jittered backoff
+  keeping N replicas racing one released lease from stampeding the
+  apiserver with 409s.
+* **Per-shard journal**: each shard's admission state lives in its own
+  ``utils/statestore`` directory (``<journal-dir>/shard-<k>``), so a
+  takeover replays exactly the dead shard's journal — holds come back
+  with their ORIGINAL ages, lapse bars stand, and only that shard's
+  gangs ever stalled.
+* **Active-active serving**: /filter and /prioritize run on EVERY
+  replica from the shared watch-driven TopologyIndex. Cross-shard
+  reservation visibility flows through the existing annotation plane:
+  each shard publishes its hold snapshot as an annotation on the very
+  Lease it renews anyway (``leader.py annotations_fn``), every replica
+  reads its peers' overlays on the scan cadence, and
+  :class:`ShardedReservations` unions local tables + peer overlays
+  into the one ``apply``/``held_by_host`` surface the extender's
+  /filter shield already consumes.
+
+Failure semantics — the headline: SIGKILL one of N shards and only its
+gangs stall, and only until lease takeover; the surviving replica (or
+a restarted one) replays that shard's journal and resumes with
+original hold ages (tests/test_chaos_journal.py's kill-point suite
+extends to shard takeover, shard split-brain, and mid-rebalance
+death). Resharding (changing ``--shards``) is an operator action:
+roll all replicas together — ownership of ~1/N of keys moves, and a
+hold whose gang moved shards is dropped by the old owner's recovery
+reconcile and re-fenced by the new owner's first sweep (one-resync
+window; see docs/operations.md).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..utils import metrics, profiling
+from ..utils.flightrecorder import RECORDER
+from ..utils.logging import get_logger
+from .leader import LEASE_NAME, LeaderLease, SecondReplica
+
+log = get_logger(__name__)
+
+GangKey = Tuple[str, str]
+
+# Lease metadata annotation carrying one shard's reservation snapshot
+# (JSON: [{"namespace", "gang", "hosts": {host: chips}}]) — the
+# cross-shard visibility plane. A fresh RESERVE pushes it immediately
+# (the reserve-observer wakes the publisher, so the write side costs
+# milliseconds, not a renew interval); peers pick it up on their next
+# scan (~lease/3). Until that read lands, a pod racing through a PEER
+# replica's /filter can still see the fenced chips — the same
+# one-scheduling-race exposure as the journal-less restart story,
+# bounded by the scan interval (shorten --lease-seconds to tighten).
+# Releases/shrinks ride the ordinary renew cadence: THAT stale
+# direction is conservative (chips stay fenced a beat longer).
+HOLDS_ANNOTATION = "tpu.google.com/shard-holds"
+
+# The holder's OWN home shard, published alongside the holds: how a
+# restarted replica tells "my home is held by an interim takeover
+# owner (ask for it back)" from "another replica is misconfigured
+# with MY home shard (fail fast — the singleton's second-replica
+# contract, per shard)".
+HOME_ANNOTATION = "tpu.google.com/home-shard"
+
+
+def standby_lease_name(shard: int, shards: int) -> str:
+    """The handback-request signal: a replica whose home shard is
+    held by an interim (takeover) owner parks a live *standby* lease
+    here; the interim owner's scan observes it and gracefully releases
+    the shard back. Only shard k's home replica ever touches shard
+    k's standby lease, so a LIVE foreign holder on it means two
+    replicas claim the same home — the genuine-duplicate error."""
+    return f"{shard_lease_name(shard, shards)}-standby"
+
+# Ceiling for the serialized holds overlay: the apiserver caps an
+# object's TOTAL annotations at 256KiB, and a renew that starts
+# 422-ing would trip the renew deadline and crash-loop the shard.
+# Past this the payload degrades to an aggregated host→chips form
+# (loses per-gang identity — a scheduling gang's own pods then read
+# as blocked on PEER replicas' /filter until they retry through the
+# owner: over-fencing, the conservative direction), and past it AGAIN
+# to nothing (peers lose visibility — the pre-existing bounded
+# window; the capacity partition still prevents cross-shard
+# double-ADMISSION structurally).
+MAX_HOLDS_ANNOTATION_BYTES = 192 * 1024
+
+# Virtual nodes per shard on the ring: enough that the keyspace split
+# is within a few percent of even and a shard-count change remaps
+# close to the theoretical 1/N, cheap enough that ring construction is
+# microseconds (property-tested in tests/test_sharding.py).
+DEFAULT_VNODES = 64
+
+
+def _hash64(key: str) -> int:
+    """Ring position of a key. blake2b like the index's content
+    addressing (a collision would co-locate two keys, which is merely
+    suboptimal here, but one hash family across the module keeps the
+    reasoning simple)."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode(), digest_size=8).digest(), "big"
+    )
+
+
+class ShardRing:
+    """Consistent-hash ring: key string → shard id in [0, shards).
+
+    Deterministic (two replicas configured with the same shard count
+    ALWAYS agree — the no-dual-ownership property the per-shard lease
+    then enforces against config drift), and stable: shard k's virtual
+    points depend only on k, so growing N→N+1 adds points without
+    moving any existing ones — only keys falling nearest a new point
+    remap (~1/(N+1) of the keyspace)."""
+
+    def __init__(self, shards: int, vnodes: int = DEFAULT_VNODES):
+        self.shards = max(1, int(shards))
+        self.vnodes = max(1, int(vnodes))
+        points: List[Tuple[int, int]] = []
+        for s in range(self.shards):
+            for v in range(self.vnodes):
+                points.append((_hash64(f"tpu-shard-{s}#{v}"), s))
+        points.sort()
+        self._points = points
+        self._hashes = [h for h, _ in points]
+
+    def shard_of(self, key: str) -> int:
+        if self.shards == 1:
+            return 0
+        i = bisect.bisect_right(self._hashes, _hash64(key)) % len(
+            self._points
+        )
+        return self._points[i][1]
+
+    def gang_shard(self, key: GangKey) -> int:
+        return self.shard_of(f"{key[0]}/{key[1]}")
+
+    def topo_shard(self, topo) -> int:
+        """Owning shard of a node's capacity: its slice key (every
+        member of one slice lands on one shard — a multi-host gang is
+        never split across admitters), or the hostname for a
+        standalone host."""
+        return self.shard_of(slice_shard_key(topo))
+
+
+def slice_shard_key(topo) -> str:
+    """The capacity-domain hash key of one published topology."""
+    hosts = getattr(topo, "slice_hosts", None) or ()
+    if len(hosts) > 1:
+        return "|".join(hosts)
+    return getattr(topo, "hostname", "") or ""
+
+
+def shard_lease_name(shard: int, shards: int) -> str:
+    """Per-shard lease name. The 1-shard deployment keeps the
+    singleton's name so a rolling upgrade from the unsharded manifest
+    contends on the SAME lease (two admitters across the upgrade
+    boundary still fence each other)."""
+    if shards <= 1:
+        return LEASE_NAME
+    return f"{LEASE_NAME}-shard-{shard}"
+
+
+class ShardedReservations:
+    """Read-only union over the owned shards' tables + peer overlays.
+
+    The extender's /filter shield consumes exactly three verbs —
+    ``apply`` (mutating-subtract from per-request topology clones),
+    ``held_by_host`` (the indexed fast path's count form), and
+    ``snapshot`` (the /reservations endpoint) — and this facade serves
+    all three over N local :class:`ReservationTable`s plus the
+    peer-published hold records, so active-active /filter on every
+    replica withholds every shard's fenced chips, local or not.
+    Mutations stay with each shard's own table (and journal); this
+    object never writes."""
+
+    def __init__(
+        self,
+        tables_fn: Callable[[], List],
+        peers_fn: Optional[Callable[[], List[dict]]] = None,
+    ):
+        # () -> the CURRENT owned tables (ownership changes under
+        # takeover, so the list is re-read per call, never captured).
+        self._tables_fn = tables_fn
+        # () -> peer hold records [{"namespace","gang","hosts"}].
+        self._peers_fn = peers_fn
+
+    def held_by_host(
+        self, exclude: Optional[GangKey] = None
+    ) -> Dict[str, int]:
+        held: Dict[str, int] = {}
+        # Peers BEFORE tables, deliberately: the takeover swap (local
+        # table in, peer overlay out — ShardManager._adopt_shard)
+        # can land between the two reads, and this order makes that
+        # race read BOTH (double-fence, conservative) instead of
+        # NEITHER (a steal window on the mid-swap shard).
+        if self._peers_fn is not None:
+            for rec in self._peers_fn():
+                if (
+                    exclude is not None
+                    and (rec.get("namespace"), rec.get("gang")) ==
+                    exclude
+                ):
+                    # A pod is never blocked by its own gang's hold,
+                    # even when that hold lives on another shard.
+                    continue
+                for h, n in (rec.get("hosts") or {}).items():
+                    held[h] = held.get(h, 0) + int(n)
+        for table in self._tables_fn():
+            for h, n in table.held_by_host(exclude).items():
+                held[h] = held.get(h, 0) + n
+        return held
+
+    def apply(self, topos, exclude: Optional[GangKey] = None) -> Dict[str, int]:
+        """Same contract as ReservationTable.apply — both route
+        through reservations.apply_held, the one truncation core, so
+        sharded and single-table /filter shields cannot drift."""
+        from .reservations import apply_held
+
+        return apply_held(topos, self.held_by_host(exclude))
+
+    def reserved_chips(
+        self, hostname: str, exclude: Optional[GangKey] = None
+    ) -> int:
+        return self.held_by_host(exclude).get(hostname, 0)
+
+    def snapshot(self) -> list:
+        """Locally-owned holds only (full age/expiry detail — the
+        tools/gang schema); peers' overlays are served at
+        /debug/shards where their staleness is explicit."""
+        out: list = []
+        for table in self._tables_fn():
+            out.extend(table.snapshot())
+        return sorted(
+            out, key=lambda e: (e["namespace"], e["gang"])
+        )
+
+
+class _OwnedShard:
+    """One shard this replica currently admits."""
+
+    def __init__(self, shard_id: int, lease: LeaderLease):
+        self.shard_id = shard_id
+        self.lease = lease
+        self.admission = None  # set once the factory built it
+        self.phase = "acquiring"  # acquiring|replaying|ready
+        self.acquired_mono = time.monotonic()
+
+
+class ShardManager:
+    """Owns this replica's shard set: home-shard acquisition, peer
+    scanning (hold overlays + dead-shard takeover), and the per-shard
+    admitter lifecycle.
+
+    ``admitter_factory(shard_id, gang_filter, topo_filter)`` builds
+    one shard's admission controller (a GangAdmission wired with a
+    per-shard ReservationTable + per-shard journal); the manager
+    drives ``recover()``/``start()``/``stop()`` around lease
+    ownership. ``on_shard_lost(shard_id)`` fires when an owned
+    shard's lease is lost mid-flight — the production entrypoint wires
+    it to immediate process exit (the leader.py rationale: an admission
+    write already in flight must die with the process, not land past
+    the takeover horizon); tests wire a soft handler."""
+
+    def __init__(
+        self,
+        client,
+        shards: int,
+        home_shard: int,
+        admitter_factory: Callable[[int, Callable, Callable], object],
+        lease_namespace: str = "kube-system",
+        lease_seconds: float = 30.0,
+        identity: str = "",
+        scan_interval_s: float = 0.0,
+        takeover: bool = True,
+        on_shard_lost: Optional[Callable[[int], None]] = None,
+        auto_start: bool = True,
+    ):
+        if not (0 <= home_shard < shards):
+            raise ValueError(
+                f"home shard {home_shard} out of range for "
+                f"{shards} shard(s)"
+            )
+        self.client = client
+        self.ring = ShardRing(shards)
+        self.shards = self.ring.shards
+        self.home_shard = home_shard
+        self.admitter_factory = admitter_factory
+        self.lease_namespace = lease_namespace
+        self.lease_seconds = lease_seconds
+        self.identity = identity
+        # Peer scan cadence: one GET per foreign shard per pass. A
+        # third of the lease keeps overlay staleness well under the
+        # takeover horizon.
+        self.scan_interval_s = scan_interval_s or max(
+            1.0, lease_seconds / 3.0
+        )
+        self.takeover = takeover
+        self.on_shard_lost = on_shard_lost
+        # False = adopted admitters are recovered but their background
+        # loops are NOT started (tests and the self-test drive tick()
+        # deterministically; production keeps the default).
+        self.auto_start = auto_start
+        self._lock = threading.Lock()
+        self._owned: Dict[int, _OwnedShard] = {}
+        # Foreign-shard observations: shard → peer hold records, and
+        # shard → the observer lease used for liveness bookkeeping
+        # (never started; its _holder_is_live history is what makes
+        # takeover decisions clock-skew-safe, same as the singleton's).
+        self._peer_holds: Dict[int, List[dict]] = {}
+        self._observers: Dict[int, LeaderLease] = {}
+        # shard → when its lease was FIRST observed holder-less
+        # (absent or released): scan-path takeover of such a shard
+        # waits out one full lease duration, so a first rollout's
+        # still-starting replicas aren't scavenged by whoever came up
+        # first (a named-but-stale holder needs no grace — liveness
+        # decay already took a lease duration).
+        self._unheld_since: Dict[int, float] = {}
+        # Standby (handback-request) lease, held only while this
+        # replica's home shard is owned by an interim takeover owner.
+        self._standby: Optional[LeaderLease] = None
+        # Per-shard observers of OTHER replicas' standby leases (the
+        # handback signal read side).
+        self._standby_observers: Dict[int, LeaderLease] = {}
+        # Set by the reserve-observer tap on any owned shard: wakes
+        # the scan thread to push the holds overlay NOW instead of at
+        # the next renew.
+        self._publish_wake = threading.Event()
+        self.takeovers = 0
+        # Fired (with the home admission) whenever home adoption
+        # succeeds — including a LATE adoption after a standby wait.
+        # The entrypoint wires the consistency auditor through this so
+        # a replica that started in standby still gets its journal/
+        # cluster invariants once it owns its home, instead of
+        # permanently auditing nothing.
+        self.on_home_adopted: Optional[Callable[[object], None]] = None
+        self._stop = threading.Event()
+        self._scan_thread: Optional[threading.Thread] = None
+
+    # -- ownership predicates (the per-shard admission filters) ------------
+
+    def gang_filter_for(self, shard_id: int) -> Callable[[GangKey], bool]:
+        ring = self.ring
+        return lambda key: ring.gang_shard(key) == shard_id
+
+    def topo_filter_for(self, shard_id: int) -> Callable[[object], bool]:
+        ring = self.ring
+        return lambda topo: ring.topo_shard(topo) == shard_id
+
+    def owned_shards(self) -> Set[int]:
+        with self._lock:
+            return set(self._owned)
+
+    def shard_tables(self) -> List[Tuple[int, object]]:
+        """(shard_id, ReservationTable) per owned shard — the audit's
+        cross-shard ownership invariant walks this."""
+        with self._lock:
+            return [
+                (s.shard_id, s.admission.reservations)
+                for s in self._owned.values()
+                if s.admission is not None
+            ]
+
+    def reservations_view(self) -> ShardedReservations:
+        """The facade the TopologyExtender shields /filter with."""
+        def tables() -> List:
+            with self._lock:
+                return [
+                    s.admission.reservations
+                    for s in self._owned.values()
+                    if s.admission is not None
+                ]
+
+        return ShardedReservations(tables, self.peer_hold_records)
+
+    def peer_hold_records(self) -> List[dict]:
+        """The merged foreign-shard hold records (last scan's read).
+
+        A shard counts as 'ours' only once its admitter finished
+        journal replay: during a takeover the dead shard's PUBLISHED
+        overlay keeps shielding /filter until the local tables carry
+        the replayed holds (the swap is atomic under the lock in
+        _adopt_shard) — dropping it at lease-acquire time would
+        un-fence the dead shard's in-flight gangs for the whole
+        replay window."""
+        with self._lock:
+            out: List[dict] = []
+            for shard, recs in self._peer_holds.items():
+                s = self._owned.get(shard)
+                if s is None or s.admission is None:
+                    out.extend(recs)
+            return out
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ShardManager":
+        """Adopt the home shard (or enter standby when an interim
+        takeover owner holds it — the scan loop keeps retrying and
+        the owner hands it back on observing our standby lease) and
+        start the peer scan. A GENUINE second replica of this home —
+        a live holder whose published home IS this shard — still
+        fails fast with SecondReplica, preserving the singleton's
+        second-replica-is-an-operator-error contract per shard."""
+        self._try_adopt_home(fail_fast=True)
+        self._stop.clear()
+        self._scan_thread = threading.Thread(
+            target=profiling.supervised("shard_scan", self._scan_loop),
+            name="shard-scan",
+            daemon=True,
+        )
+        self._scan_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._publish_wake.set()
+        if self._scan_thread is not None:
+            self._scan_thread.join(timeout=5)
+            self._scan_thread = None
+        self._drop_standby()
+        with self._lock:
+            owned = list(self._owned.values())
+            self._owned.clear()
+        for s in owned:
+            if s.admission is not None:
+                s.admission.stop()
+            s.lease.stop()  # graceful release: successor acquires fast
+            metrics.SHARD_OWNED.remove(shard=str(s.shard_id))
+            metrics.SHARD_LEASE_AGE.remove(shard=str(s.shard_id))
+
+    def abandon(self) -> None:
+        """Simulate process death (chaos tests + the self-test): stop
+        renew threads WITHOUT releasing leases or flushing journals —
+        exactly what a SIGKILL leaves behind: stale leases that age
+        into takeover-ability, and journals whose durable prefix is
+        the only surviving state."""
+        self._stop.set()
+        self._publish_wake.set()
+        if self._scan_thread is not None:
+            self._scan_thread.join(timeout=5)
+            self._scan_thread = None
+        with self._lock:
+            owned = list(self._owned.values())
+            self._owned.clear()
+        leases = [s.lease for s in owned]
+        if self._standby is not None:
+            leases.append(self._standby)
+            self._standby = None
+        for lease in leases:
+            lease._stop.set()
+            if lease._thread is not None:
+                lease._thread.join(timeout=5)
+            # No admission.stop(): its compaction/flush must not run —
+            # in-memory state is abandoned like a real kill.
+
+    # -- home adoption / standby handback ----------------------------------
+
+    def _try_adopt_home(self, fail_fast: bool = False) -> bool:
+        """Adopt the home shard if possible; otherwise park a standby
+        lease so the interim owner hands it back. Returns True once
+        the home shard is owned. Raises SecondReplica only for a
+        GENUINE duplicate: a live holder whose published home is this
+        very shard (fail_fast), or a live foreign holder on our own
+        standby lease (two replicas configured with one home)."""
+        if self.home_shard in self.owned_shards():
+            return True
+        try:
+            self._adopt_shard(self.home_shard, reason="home")
+        except SecondReplica:
+            if fail_fast and self._holder_home(
+                self.home_shard
+            ) == self.home_shard:
+                raise
+            self._ensure_standby()
+            return False
+        self._drop_standby()
+        if self.on_home_adopted is not None:
+            try:
+                self.on_home_adopted(self.home_admission())
+            except Exception:  # noqa: BLE001 — a hook bug must not
+                # cost the adoption itself
+                log.exception("on_home_adopted hook failed")
+        return True
+
+    def _holder_home(self, shard_id: int) -> Optional[int]:
+        """The current holder's published home shard (HOME_ANNOTATION),
+        or None when unreadable — unknown reads as 'interim', which
+        degrades to visible standby waiting, never a silent dual
+        admitter (the lease itself still fences)."""
+        try:
+            lease = self.client.get(
+                f"/apis/coordination.k8s.io/v1/namespaces/"
+                f"{self.lease_namespace}/leases/"
+                f"{shard_lease_name(shard_id, self.shards)}"
+            )
+        except Exception:  # noqa: BLE001 — unreadable = unknown
+            return None
+        ann = (lease.get("metadata") or {}).get("annotations") or {}
+        try:
+            return int(ann.get(HOME_ANNOTATION, ""))
+        except ValueError:
+            return None
+
+    def _ensure_standby(self) -> None:
+        if self._standby is not None:
+            return
+        sb = LeaderLease(
+            self.client,
+            namespace=self.lease_namespace,
+            name=standby_lease_name(self.home_shard, self.shards),
+            identity=self.identity,
+            lease_seconds=self.lease_seconds,
+        )
+        # Raises SecondReplica when another live replica also claims
+        # this home — the genuine-duplicate misconfiguration.
+        sb.start()
+        self._standby = sb
+        log.warning(
+            "home shard %d is held by an interim owner; standing by "
+            "on %s until it hands the shard back",
+            self.home_shard, sb.name,
+        )
+
+    def _drop_standby(self) -> None:
+        if self._standby is not None:
+            self._standby.stop()
+            self._standby = None
+
+    def _standby_claimant_live(self, shard_id: int) -> bool:
+        """True when the shard's rightful home replica is parked on
+        its standby lease, asking for the shard back."""
+        obs = self._standby_observers.get(shard_id)
+        if obs is None:
+            obs = LeaderLease(
+                self.client,
+                namespace=self.lease_namespace,
+                name=standby_lease_name(shard_id, self.shards),
+                identity=self.identity,
+                lease_seconds=self.lease_seconds,
+            )
+            self._standby_observers[shard_id] = obs
+        try:
+            lease = self.client.get(obs._path)
+        except Exception:  # noqa: BLE001 — absent/unreachable: no claim
+            return False
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity", "")
+        return bool(holder) and holder != self.identity and (
+            obs._holder_is_live(spec)
+        )
+
+    def _handback(self, shard_id: int) -> None:
+        """Gracefully return a taken-over shard to its returning home
+        replica: stop the admitter (its final compaction leaves the
+        successor an O(holds) replay), release the lease, and let the
+        claimant's next retry acquire instantly."""
+        with self._lock:
+            s = self._owned.pop(shard_id, None)
+            if s is not None and s.admission is not None:
+                # Seed the peer overlay from the final local snapshot
+                # in the SAME step that drops the table from
+                # reservations_view(): this replica's /filter keeps
+                # fencing the handed-back shard's chips through the
+                # new owner's replay, instead of un-fencing them
+                # until the next scan re-reads the lease annotation.
+                self._peer_holds[shard_id] = [
+                    {
+                        "namespace": e["namespace"],
+                        "gang": e["gang"],
+                        "hosts": e["hosts"],
+                    }
+                    for e in s.admission.reservations.snapshot()
+                ]
+        if s is None:
+            return
+        log.warning(
+            "shard %d: home replica is back; handing the shard over",
+            shard_id,
+        )
+        RECORDER.record(
+            "shard_handback",
+            f"released taken-over shard {shard_id} to its returning "
+            f"home replica",
+            shard=shard_id,
+            identity=self.identity,
+        )
+        if s.admission is not None:
+            s.admission.stop()
+        s.lease.stop()
+        metrics.SHARD_OWNED.remove(shard=str(shard_id))
+        metrics.SHARD_LEASE_AGE.remove(shard=str(shard_id))
+
+    # -- shard adoption ----------------------------------------------------
+
+    def _holds_payload_fn(self, shard_id: int) -> Callable[[], Dict[str, str]]:
+        def payload() -> Dict[str, str]:
+            # Home is published even before the admitter exists: a
+            # returning replica must be able to tell interim owner
+            # from genuine duplicate from the very first renew.
+            out = {HOME_ANNOTATION: str(self.home_shard)}
+            with self._lock:
+                s = self._owned.get(shard_id)
+            if s is None or s.admission is None:
+                return out
+            recs = [
+                {
+                    "namespace": e["namespace"],
+                    "gang": e["gang"],
+                    "hosts": e["hosts"],
+                }
+                for e in s.admission.reservations.snapshot()
+            ]
+            raw = json.dumps(recs)
+            if len(raw) > MAX_HOLDS_ANNOTATION_BYTES:
+                # Size ceiling (see MAX_HOLDS_ANNOTATION_BYTES):
+                # degrade to the aggregated host→chips form — still
+                # fences every chip, loses only own-gang exclusion.
+                merged: Dict[str, int] = {}
+                for r in recs:
+                    for h, n in r["hosts"].items():
+                        merged[h] = merged.get(h, 0) + int(n)
+                raw = json.dumps(
+                    [{"namespace": "", "gang": "", "hosts": merged}]
+                )
+                if len(raw) > MAX_HOLDS_ANNOTATION_BYTES:
+                    log.warning(
+                        "shard %d holds overlay exceeds the "
+                        "annotation ceiling even aggregated "
+                        "(%d hosts); publishing empty — peer /filter "
+                        "visibility degrades to the scan-window "
+                        "exposure", shard_id, len(merged),
+                    )
+                    # Explicitly EMPTY, not omitted: the lease merge
+                    # never deletes keys, so omitting would leave the
+                    # last-published overlay fencing long-released
+                    # chips forever.
+                    raw = "[]"
+            out[HOLDS_ANNOTATION] = raw
+            return out
+
+        return payload
+
+    def _adopt_shard(self, shard_id: int, reason: str) -> None:
+        """Acquire shard_id's lease and bring its admitter up. Raises
+        SecondReplica when a live holder exists (the caller decides:
+        fail-fast for the home shard, skip for a takeover race)."""
+        lease = LeaderLease(
+            self.client,
+            namespace=self.lease_namespace,
+            name=shard_lease_name(shard_id, self.shards),
+            identity=self.identity,
+            lease_seconds=self.lease_seconds,
+            on_lost=lambda: self._shard_lost(shard_id),
+            annotations_fn=self._holds_payload_fn(shard_id),
+        )
+        # Reuse the observer's locally-witnessed renewal history for
+        # the liveness call (clock-skew-safe takeover, leader.py).
+        obs = self._observers.get(shard_id)
+        if obs is not None:
+            lease._observed = obs._observed
+            lease._observed_at = obs._observed_at
+        lease.start()
+        owned = _OwnedShard(shard_id, lease)
+        owned.phase = "replaying"
+        with self._lock:
+            self._owned[shard_id] = owned
+            # NOTE: _peer_holds[shard_id] is deliberately NOT popped
+            # here — the dead shard's published overlay must keep
+            # shielding /filter until recover() below installs the
+            # replayed holds locally (peer_hold_records ignores the
+            # overlay only once admission is set, and the set+pop at
+            # the bottom is one atomic step).
+            self._unheld_since.pop(shard_id, None)
+        metrics.SHARD_OWNED.set(1, shard=str(shard_id))
+        metrics.SHARD_LEASE_AGE.set(0.0, shard=str(shard_id))
+        if reason == "takeover":
+            self.takeovers += 1
+            metrics.SHARD_TAKEOVERS.inc(shard=str(shard_id))
+            RECORDER.record(
+                "shard_takeover",
+                f"took over shard {shard_id}'s admission lease",
+                shard=shard_id,
+                identity=self.identity,
+            )
+            log.warning(
+                "shard %d: lease taken over; replaying its journal",
+                shard_id,
+            )
+        try:
+            admission = self.admitter_factory(
+                shard_id,
+                self.gang_filter_for(shard_id),
+                self.topo_filter_for(shard_id),
+            )
+            # Reserve-observer tap: a fresh fence must reach the lease
+            # annotation NOW (wake the publisher), not at the next
+            # renew — peer replicas' /filter staleness then bounds at
+            # their scan interval alone. Chained in FRONT of whatever
+            # observer the factory wired (the journal's tap).
+            prev_obs = admission.reservations.observer
+
+            def tap(op, gang, payload, _prev=prev_obs):
+                if _prev is not None:
+                    _prev(op, gang, payload)
+                if op == "reserve":
+                    self._publish_wake.set()
+
+            admission.reservations.observer = tap
+            admission.recover()
+            if self.auto_start:
+                admission.start()
+        except Exception:
+            # A failed bring-up must not hold the lease hostage: the
+            # shard reads owned-but-dead otherwise, and no peer can
+            # take it over for a full lease duration.
+            with self._lock:
+                self._owned.pop(shard_id, None)
+            metrics.SHARD_OWNED.remove(shard=str(shard_id))
+            metrics.SHARD_LEASE_AGE.remove(shard=str(shard_id))
+            lease.stop()
+            raise
+        with self._lock:
+            # One atomic step: the local tables take over shielding
+            # exactly as the published overlay stops being consulted
+            # — never both (double-fence) and never neither (the
+            # takeover steal window).
+            owned.admission = admission
+            owned.phase = "ready"
+            self._peer_holds.pop(shard_id, None)
+
+    def _shard_lost(self, shard_id: int) -> None:
+        log.error("shard %d: admission lease lost", shard_id)
+        with self._lock:
+            s = self._owned.pop(shard_id, None)
+        metrics.SHARD_OWNED.remove(shard=str(shard_id))
+        metrics.SHARD_LEASE_AGE.remove(shard=str(shard_id))
+        if self.on_shard_lost is not None:
+            # Production wiring: immediate process exit (__main__.py —
+            # the leader.py rationale: in-flight admission writes must
+            # die with the process, not land past the takeover
+            # horizon).
+            self.on_shard_lost(shard_id)
+            return
+        # Library/test default: stop this shard's admitter so a lost
+        # lease at least stops minting new admissions.
+        if s is not None and s.admission is not None:
+            try:
+                s.admission.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                log.exception("shard %d admission stop failed", shard_id)
+
+    # -- peer scan ---------------------------------------------------------
+
+    def _scan_loop(self) -> None:
+        hb = profiling.HEARTBEATS.register(
+            "shard_scan", interval_s=self.scan_interval_s
+        )
+        last_scan = float("-inf")
+        while not self._stop.is_set():
+            remaining = self.scan_interval_s - (
+                time.monotonic() - last_scan
+            )
+            woke = self._publish_wake.wait(max(0.05, remaining))
+            if self._stop.is_set():
+                return
+            hb.beat()
+            if woke:
+                # A fresh reserve on some owned shard: push the holds
+                # overlay to its lease immediately.
+                self._publish_wake.clear()
+                self.publish_holds()
+            if time.monotonic() - last_scan >= self.scan_interval_s:
+                last_scan = time.monotonic()
+                try:
+                    self.scan_once()
+                except Exception as e:  # noqa: BLE001 — scanning must
+                    # survive apiserver noise; takeover waits a beat
+                    log.warning("shard scan failed: %s", e)
+
+    def publish_holds(self) -> None:
+        """Renew every owned shard's lease NOW, carrying the current
+        hold overlay (the reserve-observer wake path). Shares the
+        ordinary renew plumbing; racing the lease's own scheduled
+        renew is benign — both writes carry fresh state."""
+        with self._lock:
+            leases = [s.lease for s in self._owned.values()]
+        for lease in leases:
+            try:
+                lease._renew_once()
+            except Exception as e:  # noqa: BLE001 — the scheduled
+                # renew retries on its own cadence
+                log.debug("immediate hold publish failed: %s", e)
+
+    def scan_once(self) -> None:
+        """One pass over every shard: refresh owned-shard gauges, read
+        foreign shards' hold overlays, take over any shard whose lease
+        is stale (dead holder) or has been holder-less past the
+        rollout grace, hand taken-over shards back to their returning
+        home replica, and keep retrying our own home adoption while
+        an interim owner holds it."""
+        now = time.monotonic()
+        with self._lock:
+            owned_ids = set(self._owned)
+            for s in self._owned.values():
+                metrics.SHARD_LEASE_AGE.set(
+                    round(now - s.acquired_mono, 3),
+                    shard=str(s.shard_id),
+                )
+        if self.home_shard not in owned_ids:
+            # Interim owner still has our home (or it freed up):
+            # retry; genuine duplicates were already screened at
+            # start(), so SecondReplica here just means "not yet".
+            try:
+                if self._try_adopt_home():
+                    owned_ids.add(self.home_shard)
+            except SecondReplica:
+                pass
+        for shard_id in sorted(owned_ids):
+            if shard_id != self.home_shard and (
+                self._standby_claimant_live(shard_id)
+            ):
+                self._handback(shard_id)
+                owned_ids.discard(shard_id)
+        peer_chips = 0
+        for shard_id in range(self.shards):
+            if shard_id in owned_ids:
+                continue
+            obs = self._observers.get(shard_id)
+            if obs is None:
+                obs = LeaderLease(
+                    self.client,
+                    namespace=self.lease_namespace,
+                    name=shard_lease_name(shard_id, self.shards),
+                    identity=self.identity,
+                    lease_seconds=self.lease_seconds,
+                )
+                self._observers[shard_id] = obs
+            try:
+                lease = self.client.get(obs._path)
+            except Exception:  # noqa: BLE001 — 404 (never created) and
+                # outages both read as "nothing to see"; an uncreated
+                # shard lease is taken below via acquire's create path
+                lease = None
+            spec = (lease or {}).get("spec") or {}
+            holder = spec.get("holderIdentity", "")
+            live = bool(holder) and obs._holder_is_live(spec)
+            # Cross-shard visibility: the overlay is read from the
+            # lease annotation regardless of holder liveness — a DEAD
+            # shard's fenced chips must STAY invisible to /filter until
+            # its successor replays the journal and re-fences locally.
+            recs = self._parse_holds(lease)
+            with self._lock:
+                self._peer_holds[shard_id] = recs
+            peer_chips += sum(
+                int(n)
+                for r in recs
+                for n in (r.get("hosts") or {}).values()
+            )
+            if live:
+                self._unheld_since.pop(shard_id, None)
+            if self.takeover and not live:
+                if not holder:
+                    # Holder-less (never created, or released): grace
+                    # of one full lease duration before scavenging —
+                    # at first rollout the shard's own replica may
+                    # simply not have started yet, and adopting its
+                    # home out from under it would fail-fast the
+                    # whole StatefulSet bringup. (A named-but-stale
+                    # holder needs no grace: liveness decay already
+                    # took a lease duration.)
+                    first = self._unheld_since.setdefault(
+                        shard_id, time.monotonic()
+                    )
+                    if time.monotonic() - first < self.lease_seconds:
+                        continue
+                try:
+                    self._adopt_shard(shard_id, reason="takeover")
+                except SecondReplica:
+                    # Lost the takeover race to a peer replica — the
+                    # designed outcome for all but one racer (the
+                    # jittered acquire backoff kept the race short).
+                    continue
+                except Exception as e:  # noqa: BLE001 — a failed
+                    # bring-up released the lease; retry next pass
+                    log.warning(
+                        "shard %d takeover failed: %s", shard_id, e
+                    )
+        metrics.SHARD_PEER_HELD_CHIPS.set(peer_chips)
+
+    @staticmethod
+    def _parse_holds(lease: Optional[dict]) -> List[dict]:
+        if not lease:
+            return []
+        ann = (lease.get("metadata") or {}).get("annotations") or {}
+        raw = ann.get(HOLDS_ANNOTATION, "")
+        if not raw:
+            return []
+        try:
+            recs = json.loads(raw)
+        except ValueError:
+            return []
+        out = []
+        for r in recs if isinstance(recs, list) else []:
+            if isinstance(r, dict) and isinstance(
+                r.get("hosts"), dict
+            ):
+                out.append({
+                    "namespace": str(r.get("namespace", "")),
+                    "gang": str(r.get("gang", "")),
+                    "hosts": {
+                        str(h): int(n)
+                        for h, n in r["hosts"].items()
+                        if isinstance(n, int) and n > 0
+                    },
+                })
+        return out
+
+    # -- introspection -----------------------------------------------------
+
+    def status(self) -> dict:
+        """The /readyz ``shard`` payload and the /debug/shards body:
+        which shards this replica owns, each one's phase, and the peer
+        overlay — "replica up but owns nothing yet" and "ready" are
+        different rollout states and must read differently."""
+        with self._lock:
+            owned = {
+                str(s.shard_id): {
+                    "phase": s.phase,
+                    "lease_age_s": round(
+                        time.monotonic() - s.acquired_mono, 3
+                    ),
+                }
+                for s in self._owned.values()
+            }
+            peers = {
+                str(shard): recs
+                for shard, recs in self._peer_holds.items()
+                if (
+                    shard not in self._owned
+                    or self._owned[shard].admission is None
+                )
+            }
+        return {
+            "shards": self.shards,
+            "home": self.home_shard,
+            "owned": sorted(int(k) for k in owned),
+            "shard_phases": owned,
+            "takeovers": self.takeovers,
+            # True while our home shard is held by an interim owner
+            # and we're parked on the standby (handback-request)
+            # lease — the "up but owns nothing yet" rollout state.
+            "standby": self._standby is not None,
+            "peer_holds": peers,
+        }
+
+    def home_admission(self):
+        """The home shard's admission controller (the auditor rides
+        its tick loop — the per-shard journal's single writer)."""
+        with self._lock:
+            s = self._owned.get(self.home_shard)
+            return s.admission if s is not None else None
+
+    def ticked_admissions(self) -> List[object]:
+        """Every owned shard's admission controller (tests drive their
+        ticks directly; production uses each one's own loop)."""
+        with self._lock:
+            return [
+                s.admission
+                for s in self._owned.values()
+                if s.admission is not None
+            ]
+
+    def note_node_event(self, slice_keys) -> None:
+        """Fan a node-change event to every owned shard's dirty
+        marking (the index on_change hook in the sharded entrypoint)."""
+        for adm in self.ticked_admissions():
+            adm.note_node_event(slice_keys)
+
+
+# ---------------------------------------------------------------------------
+# Self-test (scripts/tier1.sh): two in-process shards, disjoint
+# admission, SIGKILL one, takeover re-admits its gang.
+# ---------------------------------------------------------------------------
+
+
+class _Killed(BaseException):
+    """SIGKILL stand-in (the chaos suite's idiom): a BaseException
+    blows through every best-effort handler like process death."""
+
+
+class _FakeKube:
+    """Minimal in-module apiserver: nodes, gang pods, leases — just
+    the verbs GangAdmission + LeaderLease consume. The full
+    fault-injecting FakeApiServer lives in tests/; this one keeps the
+    tier-1 smoke dependency-free."""
+
+    def __init__(self):
+        self.nodes: Dict[str, dict] = {}
+        self.pods: Dict[Tuple[str, str], dict] = {}
+        self.leases: Dict[str, dict] = {}
+        self.kill_gate_patch_for: Set[str] = set()
+
+    # nodes / pods ---------------------------------------------------------
+    def list_nodes(self, **kw) -> dict:
+        return {"items": list(self.nodes.values())}
+
+    def list_pods(self, label_selector: str = "", **kw) -> dict:
+        return {"items": [dict(p) for p in self.pods.values()]}
+
+    def get_pod(self, ns: str, name: str) -> dict:
+        return dict(self.pods[(ns, name)])
+
+    def remove_pod_scheduling_gate(self, ns, name, gate, gates) -> None:
+        pod = self.pods[(ns, name)]
+        g = (pod["metadata"]["labels"] or {}).get(
+            "tpu.google.com/gang-name", ""
+        )
+        if g in self.kill_gate_patch_for:
+            raise _Killed(f"SIGKILL before releasing {ns}/{name}")
+        pod["spec"]["schedulingGates"] = [
+            x
+            for x in (pod["spec"].get("schedulingGates") or [])
+            if x.get("name") != gate
+        ]
+
+    def patch_pod_annotations(self, ns, name, ann) -> None:
+        meta = self.pods[(ns, name)].setdefault("metadata", {})
+        meta.setdefault("annotations", {}).update(ann)
+
+    # leases ---------------------------------------------------------------
+    def get(self, path: str, **kw) -> dict:
+        from ..kube.client import KubeError
+
+        if path not in self.leases:
+            raise KubeError(404, "lease not found")
+        return json.loads(json.dumps(self.leases[path]))
+
+    def create(self, collection: str, body: dict, **kw) -> dict:
+        from ..kube.client import KubeError
+
+        path = f"{collection}/{body['metadata']['name']}"
+        if path in self.leases:
+            raise KubeError(409, "already exists")
+        self.leases[path] = json.loads(json.dumps(body))
+        return body
+
+    def replace(self, path: str, body: dict, **kw) -> dict:
+        self.leases[path] = json.loads(json.dumps(body))
+        return body
+
+
+def _pick_key(ring: ShardRing, shard: int, template: str) -> str:
+    """First template instantiation hashing onto ``shard``."""
+    for i in range(10000):
+        key = template.format(i)
+        if ring.shard_of(key) == shard:
+            return key
+    raise RuntimeError("keyspace search failed")
+
+
+def self_test() -> int:
+    """Tier-1 smoke: 2 in-process shards over the fake apiserver —
+    disjoint admission (each shard admits only its own gang onto its
+    own capacity), SIGKILL one shard mid-release, takeover replays its
+    journal with the original hold age and re-admits its gang."""
+    import shutil
+    import tempfile
+
+    from .gang import GATE_NAME, GangAdmission
+    from .journal import AdmissionJournal
+    from .reservations import ReservationTable
+
+    from ..api import constants
+    from ..discovery.chips import TpuChip
+    from ..topology.mesh import IciMesh
+    from ..topology.schema import NodeTopology
+
+    base = tempfile.mkdtemp(prefix="tpu-shard-selftest-")
+    kube = _FakeKube()
+    ring = ShardRing(2)
+    # One standalone node + one gang per shard, names searched so the
+    # ring assigns them where the scenario needs them.
+    hosts = [
+        _pick_key(ring, s, "host-{0:04d}-" + str(s)) for s in (0, 1)
+    ]
+    gangs = []
+    for s in (0, 1):
+        g = _pick_key(ring, s, "default/gang-{0:04d}-" + str(s))
+        gangs.append(g.split("/", 1)[1])
+    for host in hosts:
+        mesh = IciMesh([
+            TpuChip(
+                index=i,
+                dev_path=f"/dev/accel{i}",
+                pci_addr=f"0000:0{i}:00.0",
+                vendor_id=0x1AE0,
+                device_id=0x0063,
+                numa_node=0,
+                chip_type="v5e",
+                hbm_bytes=16 << 30,
+                core_count=1,
+            )
+            for i in range(4)
+        ])
+        topo = NodeTopology.from_mesh(mesh, hostname=host)
+        kube.nodes[host] = {
+            "metadata": {
+                "name": host,
+                "annotations": {
+                    constants.TOPOLOGY_ANNOTATION: topo.to_json()
+                },
+            }
+        }
+
+    def add_gang(gang: str, gated: bool = True) -> None:
+        for i in range(2):
+            kube.pods[("default", f"{gang}-w{i}")] = {
+                "metadata": {
+                    "name": f"{gang}-w{i}",
+                    "namespace": "default",
+                    "labels": {
+                        "tpu.google.com/gang-name": gang,
+                        "tpu.google.com/gang-size": "2",
+                    },
+                },
+                "spec": {
+                    "schedulingGates": (
+                        [{"name": GATE_NAME}] if gated else []
+                    ),
+                    "containers": [{
+                        "name": "w",
+                        "resources": {
+                            "limits": {"google.com/tpu": "2"}
+                        },
+                    }],
+                },
+                "status": {"phase": "Pending"},
+            }
+
+    def gates_on(gang: str) -> int:
+        return sum(
+            1
+            for (ns, name), p in kube.pods.items()
+            if name.startswith(gang)
+            and any(
+                g.get("name") == GATE_NAME
+                for g in (p["spec"].get("schedulingGates") or [])
+            )
+        )
+
+    try:
+        add_gang(gangs[0])
+        add_gang(gangs[1])
+
+        def factory(shard_id, gang_filter, topo_filter):
+            return GangAdmission(
+                kube,
+                reservations=ReservationTable(),
+                journal=AdmissionJournal(f"{base}/shard-{shard_id}"),
+                gang_filter=gang_filter,
+                topo_filter=topo_filter,
+                shard_id=shard_id,
+            )
+
+        managers = []
+        for s in (0, 1):
+            m = ShardManager(
+                kube,
+                shards=2,
+                home_shard=s,
+                admitter_factory=factory,
+                identity=f"rep-{s}",
+                lease_seconds=0.8,
+                takeover=(s == 0),
+                auto_start=False,
+            )
+            # Manual drive: adopt without scan threads (determinism).
+            m._adopt_shard(s, reason="home")
+            managers.append(m)
+
+        # Disjoint admission: each shard releases exactly its own gang.
+        kube.kill_gate_patch_for.add(gangs[1])
+        rel0 = managers[0].ticked_admissions()[0].tick()
+        assert rel0 == [("default", gangs[0])], rel0
+        assert gates_on(gangs[0]) == 0
+        try:
+            managers[1].ticked_admissions()[0].tick()
+            raise AssertionError("kill point never fired")
+        except _Killed:
+            pass
+        assert gates_on(gangs[1]) == 2  # died before any gate patch
+        # SIGKILL shard 1: leases go stale, journal survives.
+        managers[1].abandon()
+        kube.kill_gate_patch_for.clear()
+
+        # Takeover: shard 0's replica notices the dead lease, replays
+        # shard 1's journal (reserve+admit are durable), re-fences with
+        # the original age, and finishes the release.
+        time.sleep(1.0)  # let the 0.8s lease age out
+        managers[0].scan_once()
+        assert managers[0].owned_shards() == {0, 1}
+        assert managers[0].takeovers == 1
+        adopted = [
+            a
+            for a in managers[0].ticked_admissions()
+            if a.shard_id == 1
+        ][0]
+        held = adopted.reservations.held_by_host()
+        assert sum(held.values()) == 4, held  # rehydrated fence
+        rel1 = adopted.tick()
+        assert rel1 == [("default", gangs[1])], rel1
+        assert gates_on(gangs[1]) == 0
+        managers[0].stop()
+        print(json.dumps({"shard_self_test": "ok", "takeovers": 1}))
+        return 0
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--shard-self-test", action="store_true",
+        help="run the two-shard takeover smoke (scripts/tier1.sh)",
+    )
+    a = p.parse_args(argv)
+    if a.shard_self_test:
+        return self_test()
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -52,6 +52,15 @@ python -m k8s_device_plugin_tpu.extender.journal --self-test > /dev/null \
 # time-to-ready bound lives in tests/test_scale_bench.py.
 python -m k8s_device_plugin_tpu.extender.scale_bench --cold-start-self-test > /dev/null \
   || { echo "scale_bench --cold-start-self-test FAILED"; exit 1; }
+# Sharded-admission smoke: two in-process shards over the fake
+# apiserver must admit disjointly (each shard only its own gangs onto
+# its own capacity partition), survive a SIGKILL of one shard, and
+# take over the dead shard's lease + journal — re-admitting its gang
+# with the original hold age (extender/sharding.py --shard-self-test);
+# a ring/lease/journal plumbing drift fails CI here, before the chaos
+# suite in tests/test_chaos_journal.py covers the full matrix.
+python -m k8s_device_plugin_tpu.extender.sharding --shard-self-test > /dev/null \
+  || { echo "extender/sharding.py --shard-self-test FAILED"; exit 1; }
 # Profiler tooling smoke: tpu-flame must render a capture produced by
 # the REAL sampling profiler over a busy loop, in every accepted
 # format (collapsed text, speedscope JSON, /debug/profile payload,

@@ -330,3 +330,338 @@ def test_lapsed_hold_stays_lapsed_across_restarts(api, tmp_path):
     adm0 = GangAdmission(client, reservations=ReservationTable())
     adm0.tick()
     assert adm0.reservations.reserved_chips("n1") == 4
+
+
+# ---------------------------------------------------------------------------
+# Sharded admission (ISSUE 11): shard takeover, shard split-brain,
+# mid-rebalance death — the kill-point suite extended to the
+# per-shard lease + per-shard journal plane (extender/sharding.py).
+# ---------------------------------------------------------------------------
+
+import time as _t
+
+from k8s_device_plugin_tpu import audit
+from k8s_device_plugin_tpu.extender.leader import (
+    LeaderLease,
+    SecondReplica,
+)
+from k8s_device_plugin_tpu.extender.sharding import (
+    ShardManager,
+    ShardRing,
+    _pick_key,
+    shard_lease_name,
+)
+
+
+def _wait(cond, timeout):
+    deadline = _t.time() + timeout
+    while _t.time() < deadline:
+        if cond():
+            return True
+        _t.sleep(0.05)
+    return False
+
+
+def _sharded_factory(client, tmp_path, kill_gang_patch_for=frozenset()):
+    """Admitter factory over real per-shard journals; shards in
+    ``kill_gang_patch_for`` get a client that SIGKILLs on the first
+    gate patch (the post-reserve/pre-gate kill-point, per shard)."""
+
+    def factory(shard_id, gang_filter, topo_filter):
+        c = client
+        if shard_id in kill_gang_patch_for:
+            c = KillPointClient(
+                client, "remove_pod_scheduling_gate",
+                calls_before_kill=0,
+            )
+        return GangAdmission(
+            c,
+            reservations=ReservationTable(),
+            journal=jr.AdmissionJournal(
+                os.path.join(str(tmp_path), f"shard-{shard_id}")
+            ),
+            gang_filter=gang_filter,
+            topo_filter=topo_filter,
+            shard_id=shard_id,
+        )
+
+    return factory
+
+
+def test_sigkill_one_shard_stalls_only_its_gangs_until_takeover(
+    api, tmp_path
+):
+    """The ISSUE 11 acceptance chaos: 3 shards over a 1,000-node sim
+    cluster, SIGKILL of one shard (post-reserve/pre-gate — the
+    worst kill-point) stalls ONLY that shard's gangs; the surviving
+    shards keep admitting; takeover replays the dead shard's journal
+    within the lease bound, resumes with the ORIGINAL hold age, and
+    the audit's cross-shard ownership invariant sweeps clean
+    throughout — no gang gateless-and-unfenced, no chip held by two
+    shards."""
+    server, client = api
+    ring = ShardRing(3)
+    # 1,000-node sim cluster: names land on shards wherever the ring
+    # puts them (that's the point — capacity partitions by hash).
+    for i in range(1000):
+        name = f"node-{i:04d}"
+        node, _ = make_node(name, n=4)
+        server.add_node(name, node)
+    # Two gangs per shard, names searched onto each shard.
+    gangs = {s: [] for s in range(3)}
+    for s in range(3):
+        for j in range(2):
+            key = _pick_key(
+                ring, s, "default/g{0:04d}-" + f"{s}{j}"
+            )
+            gname = key.split("/", 1)[1]
+            add_gang(server, gname)
+            gangs[s].append(gname)
+
+    managers = []
+    for s in range(3):
+        m = ShardManager(
+            client,
+            shards=3,
+            home_shard=s,
+            admitter_factory=_sharded_factory(
+                client, tmp_path,
+                kill_gang_patch_for={2} if s == 2 else frozenset(),
+            ),
+            identity=f"rep-{s}",
+            lease_seconds=2.0,
+            takeover=(s == 0),
+            auto_start=False,
+        )
+        m._adopt_shard(s, reason="home")
+        managers.append(m)
+
+    def audit_clean(mgr_tables):
+        ea = audit.ExtenderAudit(
+            shard_manager=type(
+                "M", (), {
+                    "ring": ring,
+                    "shard_tables": staticmethod(
+                        lambda: mgr_tables
+                    ),
+                },
+            )()
+        )
+        return ea.check_shard_ownership()
+
+    # Healthy shards admit; shard 2 dies at its first gate patch with
+    # reserve+admit already durable in ITS journal.
+    released = {}
+    for s in (0, 1):
+        adm = managers[s].ticked_admissions()[0]
+        released[s] = adm.tick()
+        assert sorted(released[s]) == sorted(
+            ("default", g) for g in gangs[s]
+        )
+    dead_adm = managers[2].ticked_admissions()[0]
+    with pytest.raises(SigKill):
+        dead_adm.tick()
+    managers[2].abandon()
+
+    # Only shard 2's gangs stall: still gated, their chips fenced in
+    # shard 2's journal; shards 0/1 keep working (a second tick is a
+    # healthy no-op / upkeep pass).
+    stalled = gangs[2][0]  # the gang the kill-point caught mid-admit
+    for g in gangs[0] + gangs[1]:
+        for i in range(2):
+            assert GATE_NAME not in gates_of(server, "default", f"{g}-w{i}")
+    for i in range(2):
+        assert GATE_NAME in gates_of(
+            server, "default", f"{stalled}-w{i}"
+        )
+    for s in (0, 1):
+        managers[s].ticked_admissions()[0].tick()
+
+    tables = [
+        (s, managers[s].ticked_admissions()[0].reservations)
+        for s in (0, 1)
+    ]
+    assert audit_clean(tables) == []
+
+    kill_ts = _t.time()
+    # Takeover within the lease bound: the survivor replays shard 2's
+    # journal and finishes the interrupted release.
+    assert _wait(
+        lambda: (
+            managers[0].scan_once() or 2 in managers[0].owned_shards()
+        ),
+        10,
+    ), "takeover never happened within the lease bound"
+    adopted = [
+        a for a in managers[0].ticked_admissions() if a.shard_id == 2
+    ][0]
+    # Original hold age: the hold predates the kill, not the takeover.
+    st = adopted.reservations.export_state()
+    key = ("default", stalled)
+    assert key in st
+    assert st[key]["age_s"] >= (_t.time() - kill_ts) - 0.5
+    adopted.tick()  # finish_partial_release + admit the second gang
+    for g in gangs[2]:
+        for i in range(2):
+            assert GATE_NAME not in gates_of(
+                server, "default", f"{g}-w{i}"
+            )
+    # Fence standing until members bind — never gateless-and-unfenced
+    # (the interrupted gang's own 4 chips, plus its shard-mate's).
+    st = adopted.reservations.export_state()
+    assert sum(st[key]["hosts"].values()) == 4
+    tables = [
+        (a.shard_id, a.reservations)
+        for a in managers[0].ticked_admissions()
+    ] + [(1, managers[1].ticked_admissions()[0].reservations)]
+    assert audit_clean(tables) == []
+    managers[0].stop()
+    managers[1].stop()
+
+
+def test_shard_split_brain_partitioned_holder_self_demotes_first(api):
+    """Shard split-brain: a shard holder partitioned from the
+    apiserver self-demotes (renew deadline) STRICTLY BEFORE its lease
+    becomes takeover-able — at the moment on_lost fires, a competitor
+    still reads the lease as live; only after the published duration
+    elapses can it take the shard over. Dual admission of one shard
+    is therefore impossible even across a partition."""
+
+    class PartitionedClient:
+        def __init__(self, inner):
+            self._inner = inner
+            self.partitioned = False
+
+        def __getattr__(self, name):
+            real = getattr(self._inner, name)
+            if not callable(real):
+                return real
+
+            def wrapper(*a, **kw):
+                if self.partitioned:
+                    raise OSError("network partition")
+                return real(*a, **kw)
+
+            return wrapper
+
+    server, client = api
+    holder_client = PartitionedClient(client)
+    lost = []
+    name = shard_lease_name(1, 3)
+    holder = LeaderLease(
+        holder_client,
+        name=name,
+        identity="rep-holder",
+        lease_seconds=6.0,
+        renew_deadline_s=0.8,
+        on_lost=lambda: lost.append(_t.time()),
+    )
+    holder.start()
+    try:
+        holder_client.partitioned = True
+        assert _wait(lambda: lost, 15), "partitioned holder never demoted"
+        # At demotion time the lease is still LIVE to everyone else:
+        # takeover must raise.
+        competitor = LeaderLease(
+            client, name=name, identity="rep-competitor",
+            lease_seconds=6.0,
+        )
+        with pytest.raises(SecondReplica):
+            competitor.acquire()
+        # Once the published duration passes (simulated by the
+        # competitor's clock — the first-sight staleness compare),
+        # takeover succeeds into a shard whose old holder ALREADY
+        # stopped admitting.
+        competitor._clock = lambda: _t.time() + 7.0
+        competitor.acquire()
+        lease = server.leases[("kube-system", name)]
+        assert lease["spec"]["holderIdentity"] == "rep-competitor"
+    finally:
+        holder._stop.set()
+        if holder._thread is not None:
+            holder._thread.join(timeout=5)
+
+
+def test_mid_rebalance_death_second_takeover_replays_idempotently(
+    api, tmp_path
+):
+    """Mid-rebalance death: a replica dies AFTER acquiring a dead
+    shard's lease but BEFORE its journal replay completes. The next
+    takeover (a restarted replica) replays the same journal again —
+    idempotently: the gang admits exactly once, with its original
+    fence."""
+    server, client = api
+    ring = ShardRing(2)
+    host = _pick_key(ring, 1, "n-{0:04d}")
+    node, _ = make_node(host, n=4)
+    server.add_node(host, node)
+    gname = _pick_key(ring, 1, "default/g-{0:04d}").split("/", 1)[1]
+    add_gang(server, gname)
+
+    # Incarnation 1 owns shard 1, reserves, dies at the gate patch.
+    m1 = ShardManager(
+        client,
+        shards=2,
+        home_shard=1,
+        admitter_factory=_sharded_factory(
+            client, tmp_path, kill_gang_patch_for={1}
+        ),
+        identity="rep-1",
+        lease_seconds=2.0,
+        takeover=False,
+        auto_start=False,
+    )
+    m1._adopt_shard(1, reason="home")
+    with pytest.raises(SigKill):
+        m1.ticked_admissions()[0].tick()
+    m1.abandon()
+    _t.sleep(2.3)
+
+    # Incarnation 2 begins the takeover and dies mid-rebalance: lease
+    # acquired, replay never ran (the factory kills first).
+    class FactoryKill(BaseException):
+        pass
+
+    def dying_factory(shard_id, gang_filter, topo_filter):
+        raise FactoryKill("died between lease acquire and replay")
+
+    m2 = ShardManager(
+        client,
+        shards=2,
+        home_shard=0,
+        admitter_factory=dying_factory,
+        identity="rep-2",
+        lease_seconds=2.0,
+        auto_start=False,
+    )
+    with pytest.raises(FactoryKill):
+        m2._adopt_shard(1, reason="takeover")
+    m2.abandon()
+    for i in range(2):  # still stalled — nothing admitted twice
+        assert GATE_NAME in gates_of(server, "default", f"{gname}-w{i}")
+    _t.sleep(2.3)
+
+    # Incarnation 3 replays the SAME journal (third owner of the
+    # shard): recovery is idempotent — one fence, one release.
+    m3 = ShardManager(
+        client,
+        shards=2,
+        home_shard=0,
+        admitter_factory=_sharded_factory(client, tmp_path),
+        identity="rep-3",
+        lease_seconds=2.0,
+        auto_start=False,
+    )
+    m3._adopt_shard(0, reason="home")
+    m3.scan_once()
+    assert m3.owned_shards() == {0, 1}
+    adopted = [
+        a for a in m3.ticked_admissions() if a.shard_id == 1
+    ][0]
+    assert sum(adopted.reservations.held_by_host().values()) == 4
+    released = adopted.tick()
+    assert released == [("default", gname)]
+    for i in range(2):
+        assert GATE_NAME not in gates_of(server, "default", f"{gname}-w{i}")
+    assert adopted.tick() == []  # exactly once
+    m3.stop()

@@ -127,6 +127,82 @@ def test_scale_bench_sublinear_at_5000():
     assert not last[0], last
 
 
+def test_shard_scaling_probe_bound_and_schema():
+    """Sharded-admission probe (extender/sharding.py): every gang
+    admits under the partition (disjointly, each onto its own shard's
+    capacity), gangs-admitted/s is recorded for all three shapes
+    (single / per-shard / parallel), and the steady production
+    /filter shape — own shard local, peers via overlay — stays within
+    1.1x of the single-shard p99 (absolute slack floor for CI
+    scheduler noise, one full re-run for host contention, the suite's
+    convention)."""
+    last = None
+    for attempt in range(2):
+        r = scale_bench.shard_scaling(
+            n_nodes=300, n_gangs=30, shards=3, filter_calls=20
+        )
+        assert r["nodes"] == 300 and r["shards"] == 3
+        assert r["single"]["gangs_per_s"] > 0
+        assert r["sharded"]["gangs_per_s_parallel"] > 0
+        shard_gangs = sum(
+            v["gangs"] for v in r["sharded"]["per_shard"].values()
+        )
+        assert shard_gangs == 30  # disjoint AND complete
+        problems = []
+        peer_p99 = r["sharded"]["filter_peer_overlay"]["p99_ms"]
+        single_p99 = r["single"]["filter"]["p99_ms"]
+        limit = max(1.1 * single_p99, single_p99 + 2.0)
+        if peer_p99 >= limit:
+            problems.append(
+                f"sharded /filter p99 {peer_p99}ms >= {limit:.2f}ms "
+                f"(single-shard p99 {single_p99}ms — the per-shard "
+                f"latency bound)"
+            )
+        last = problems, r
+        if not problems:
+            return
+    assert not last[0], last
+
+
+@pytest.mark.slow
+def test_shard_scaling_at_50000():
+    """The ISSUE 11 acceptance scale: scale_bench runs at 50,000
+    nodes / 5,000 gangs, per-shard /filter p99 stays within 1.1x of
+    the single-shard figure as N grows, and admission throughput
+    (gangs admitted/s) is recorded. (~1-2 min; the tier-1 default
+    gate bounds the same probe at 300 nodes above.)"""
+    last = None
+    for attempt in range(2):
+        r = scale_bench.shard_scaling(
+            n_nodes=50000, n_gangs=5000, shards=4, filter_calls=10
+        )
+        assert r["nodes"] == 50000 and r["gangs"] == 5000
+        assert sum(
+            v["gangs"] for v in r["sharded"]["per_shard"].values()
+        ) == 5000
+        problems = []
+        peer_p99 = r["sharded"]["filter_peer_overlay"]["p99_ms"]
+        single_p99 = r["single"]["filter"]["p99_ms"]
+        if peer_p99 >= 1.1 * single_p99 + 5.0:
+            problems.append(
+                f"per-shard /filter p99 {peer_p99}ms >= 1.1x single "
+                f"{single_p99}ms at 50k nodes"
+            )
+        if (
+            r["sharded"]["gangs_per_s_parallel"]
+            <= r["single"]["gangs_per_s"]
+        ):
+            problems.append(
+                "parallel sharded throughput did not beat the single "
+                f"admitter: {r['sharded']['gangs_per_s_parallel']} vs "
+                f"{r['single']['gangs_per_s']} gangs/s"
+            )
+        last = problems, r
+        if not problems:
+            return
+    assert not last[0], last
+
+
 def test_scale_bench_cold_is_separated_from_warm():
     """The artifact must carry the cold first call on its own (VERDICT
     r4 #4) — and the warm distribution must not contain it: with the

@@ -1,0 +1,909 @@
+"""Sharded active-active admission (extender/sharding.py — ISSUE 11):
+the consistent-hash ring's stability properties, the per-shard lease
+fence (+ the jittered acquire backoff satellite), cross-shard
+reservation visibility through the lease-annotation plane, dead-shard
+takeover, per-shard restored==fresh journal parity, the /readyz shard
+payload, and the audit's cross-shard ownership invariant."""
+
+import json
+import os
+import time
+import types
+
+import pytest
+
+from k8s_device_plugin_tpu import audit
+from k8s_device_plugin_tpu.extender import journal as jr
+from k8s_device_plugin_tpu.extender import sharding
+from k8s_device_plugin_tpu.extender.gang import GATE_NAME, GangAdmission
+from k8s_device_plugin_tpu.extender.leader import (
+    LEASE_NAME,
+    LeaderLease,
+    SecondReplica,
+)
+from k8s_device_plugin_tpu.extender.reservations import ReservationTable
+from k8s_device_plugin_tpu.extender.server import (
+    ReadyStatus,
+    TopologyExtender,
+)
+from k8s_device_plugin_tpu.extender.sharding import (
+    HOLDS_ANNOTATION,
+    ShardManager,
+    ShardRing,
+    ShardedReservations,
+    _pick_key,
+    shard_lease_name,
+)
+from k8s_device_plugin_tpu.kube.client import KubeClient, KubeError
+from k8s_device_plugin_tpu.utils import metrics
+from tests.fake_apiserver import FakeApiServer
+from tests.test_extender import make_node, tpu_pod
+from tests.test_gang import gang_pod, gates_of
+
+
+@pytest.fixture
+def api():
+    s = FakeApiServer()
+    url = s.start()
+    yield s, KubeClient(url)
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Consistent-hash ring properties (satellite: shard-hash stability)
+# ---------------------------------------------------------------------------
+
+KEYS = [f"slice-{i:05d}" for i in range(4000)]
+
+
+def test_ring_deterministic_and_single_mapping():
+    """Two rings built with the same shard count agree on EVERY key
+    (and each key maps to exactly one in-range shard): two replicas
+    configured identically can never both claim a key."""
+    a, b = ShardRing(5), ShardRing(5)
+    for key in KEYS:
+        s = a.shard_of(key)
+        assert s == b.shard_of(key)
+        assert 0 <= s < 5
+
+
+def test_ring_every_shard_owns_keys():
+    ring = ShardRing(6)
+    owners = {ring.shard_of(k) for k in KEYS}
+    assert owners == set(range(6))
+
+
+def test_ring_grow_remaps_about_one_over_n():
+    """Adding a shard (N→N+1) remaps roughly 1/(N+1) of keys — never
+    a wholesale reshuffle. Keys that move, move TO the new shard
+    only (existing virtual points never move)."""
+    before, after = ShardRing(4), ShardRing(5)
+    moved = [
+        k for k in KEYS if before.shard_of(k) != after.shard_of(k)
+    ]
+    frac = len(moved) / len(KEYS)
+    assert frac < 0.40, f"grow remapped {frac:.0%} (~20% expected)"
+    assert frac > 0.02, "nothing remapped — the new shard owns nothing"
+    assert all(after.shard_of(k) == 4 for k in moved)
+
+
+def test_ring_shrink_moves_only_the_removed_shards_keys():
+    """Removing the last shard (N→N-1): every key owned by a
+    SURVIVING shard keeps its owner exactly — only the removed
+    shard's keys redistribute."""
+    big, small = ShardRing(5), ShardRing(4)
+    for k in KEYS:
+        if big.shard_of(k) != 4:
+            assert small.shard_of(k) == big.shard_of(k)
+
+
+def test_ring_one_shard_is_identity_and_lease_name_compat():
+    ring = ShardRing(1)
+    assert all(ring.shard_of(k) == 0 for k in KEYS[:100])
+    # The 1-shard lease keeps the singleton's name so a rolling
+    # upgrade from the unsharded manifest contends on the SAME lease.
+    assert shard_lease_name(0, 1) == LEASE_NAME
+    assert shard_lease_name(2, 8) == f"{LEASE_NAME}-shard-2"
+
+
+def test_gang_and_topo_shard_helpers():
+    ring = ShardRing(3)
+    assert ring.gang_shard(("ns", "g")) == ring.shard_of("ns/g")
+    solo = types.SimpleNamespace(hostname="h1", slice_hosts=["h1"])
+    sliced = types.SimpleNamespace(
+        hostname="h2", slice_hosts=["h2", "h3"]
+    )
+    assert ring.topo_shard(solo) == ring.shard_of("h1")
+    # Every member of one slice hashes together: a multi-host gang is
+    # never split across admitters.
+    assert ring.topo_shard(sliced) == ring.shard_of("h2|h3")
+
+
+# ---------------------------------------------------------------------------
+# Jittered acquire backoff (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class _RacingClient:
+    """Lease client whose first create 409s (a peer won the race) —
+    the retry path the jitter desynchronizes."""
+
+    def __init__(self):
+        self.creates = 0
+        self.lease = None
+
+    def get(self, path, **kw):
+        if self.lease is None:
+            raise KubeError(404, "not found")
+        return json.loads(json.dumps(self.lease))
+
+    def create(self, collection, body, **kw):
+        self.creates += 1
+        if self.creates == 1:
+            # The peer's create landed first — but its holder then
+            # reads as stale (empty renewTime) so OUR retry wins.
+            self.lease = {
+                "metadata": body["metadata"],
+                "spec": {"holderIdentity": "peer", "renewTime": ""},
+            }
+            raise KubeError(409, "conflict")
+        self.lease = json.loads(json.dumps(body))
+        return body
+
+    def replace(self, path, body, **kw):
+        self.lease = json.loads(json.dumps(body))
+        return body
+
+
+def test_acquire_retry_is_jittered_and_counted():
+    slept = []
+    before = metrics.SHARD_ACQUIRE_CONFLICTS.get()
+
+    class Rng:
+        def uniform(self, lo, hi):
+            assert (lo, hi) == (0, 0.5)
+            return 0.123
+
+    lease = LeaderLease(
+        _RacingClient(),
+        identity="rep-a",
+        retry_jitter_s=0.5,
+        rng=Rng(),
+        sleep=slept.append,
+    )
+    lease.acquire()
+    assert slept == [0.123], "lost race must sleep a jittered beat"
+    assert metrics.SHARD_ACQUIRE_CONFLICTS.get() == before + 1
+
+
+def test_acquire_zero_jitter_restores_immediate_retry():
+    slept = []
+    lease = LeaderLease(
+        _RacingClient(),
+        identity="rep-a",
+        retry_jitter_s=0.0,
+        sleep=slept.append,
+    )
+    lease.acquire()
+    assert slept == []
+
+
+# ---------------------------------------------------------------------------
+# ShardedReservations: the union shield /filter consumes
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_reservations_union_and_exclude():
+    t1, t2 = ReservationTable(), ReservationTable()
+    t1.reserve(("default", "a"), {"n1": 2})
+    t2.reserve(("default", "b"), {"n1": 1, "n2": 4})
+    peers = [
+        {"namespace": "default", "gang": "c", "hosts": {"n2": 2}},
+        {"namespace": "default", "gang": "a", "hosts": {"n3": 1}},
+    ]
+    view = ShardedReservations(lambda: [t1, t2], lambda: peers)
+    assert view.held_by_host() == {"n1": 3, "n2": 6, "n3": 1}
+    # Own-gang exclusion spans shards AND the peer overlay.
+    assert view.held_by_host(exclude=("default", "a")) == {
+        "n1": 1, "n2": 6,
+    }
+    assert view.reserved_chips("n2") == 6
+    assert view.reserved_chips("n2", exclude=("default", "c")) == 4
+    snap = view.snapshot()  # local holds only, sorted, peer-free
+    assert [e["gang"] for e in snap] == ["a", "b"]
+
+
+def test_sharded_reservations_filter_shield(api):
+    """A /filter served over the facade withholds a PEER shard's
+    published chips exactly like a local hold."""
+    _, _client = api
+    node, _ = make_node("n1", n=4)
+    peers = [{"namespace": "default", "gang": "g", "hosts": {"n1": 4}}]
+    view = ShardedReservations(lambda: [], lambda: peers)
+    ext = TopologyExtender(reservations=view)
+    passing, failed = ext.filter(tpu_pod(2), [node])
+    assert passing == []
+    assert "reserved for a released gang" in failed["n1"]
+    # The gang whose hold it is passes (its own reservation).
+    gp = gang_pod("g-w0", "g", 2, 2)
+    passing, _ = ext.filter(gp, [make_node("n1", n=4)[0]])
+    assert [n["metadata"]["name"] for n in passing] == ["n1"]
+
+
+# ---------------------------------------------------------------------------
+# ShardManager over the fake apiserver
+# ---------------------------------------------------------------------------
+
+
+class _DummyAdmission:
+    """Factory product for manager-level tests: just the surface the
+    manager drives."""
+
+    def __init__(self):
+        self.reservations = ReservationTable()
+        self.recovered = self.started = self.stopped = False
+
+    def recover(self):
+        self.recovered = True
+
+    def start(self):
+        self.started = True
+
+    def stop(self):
+        self.stopped = True
+
+    def tick(self, full=True):
+        return []
+
+
+def _manager(client, home, shards=2, identity=None, **kw):
+    kw.setdefault("lease_seconds", 30.0)
+    return ShardManager(
+        client,
+        shards=shards,
+        home_shard=home,
+        admitter_factory=lambda *_: _DummyAdmission(),
+        identity=identity or f"rep-{home}",
+        **kw,
+    )
+
+
+def test_home_shard_acquire_and_status(api):
+    server, client = api
+    m = _manager(client, home=0)
+    m._adopt_shard(0, reason="home")
+    try:
+        lease = server.leases[
+            ("kube-system", f"{LEASE_NAME}-shard-0")
+        ]
+        assert lease["spec"]["holderIdentity"] == "rep-0"
+        assert m.owned_shards() == {0}
+        st = m.status()
+        assert st["shards"] == 2 and st["home"] == 0
+        assert st["owned"] == [0]
+        assert st["shard_phases"]["0"]["phase"] == "ready"
+        assert metrics.SHARD_OWNED.get(shard="0") == 1
+    finally:
+        m.stop()
+    # Graceful stop released the lease and pruned the gauge series.
+    lease = server.leases[("kube-system", f"{LEASE_NAME}-shard-0")]
+    assert lease["spec"]["holderIdentity"] == ""
+    assert metrics.SHARD_OWNED.get(shard="0") == 0
+
+
+def test_second_replica_same_home_shard_fails_fast(api):
+    _, client = api
+    m0 = _manager(client, home=0, identity="rep-a")
+    m0._adopt_shard(0, reason="home")
+    try:
+        m1 = _manager(client, home=0, identity="rep-b")
+        with pytest.raises(SecondReplica, match="rep-a"):
+            m1.start()
+    finally:
+        m0.stop()
+
+
+def test_peer_holds_flow_through_lease_annotation(api):
+    """Cross-shard visibility: shard 0's holds publish on ITS lease
+    renew; shard 1's replica reads them on scan and its /filter
+    withholds the chips."""
+    server, client = api
+    m0 = _manager(client, home=0, identity="rep-a")
+    m0._adopt_shard(0, reason="home")
+    m1 = _manager(client, home=1, identity="rep-b", takeover=False)
+    m1._adopt_shard(1, reason="home")
+    try:
+        adm0 = m0._owned[0].admission
+        adm0.reservations.reserve(("default", "g"), {"n1": 4})
+        m0._owned[0].lease._renew_once()  # publish the overlay
+        ann = server.leases[
+            ("kube-system", f"{LEASE_NAME}-shard-0")
+        ]["metadata"].get("annotations", {})
+        recs = json.loads(ann[HOLDS_ANNOTATION])
+        assert recs == [
+            {"namespace": "default", "gang": "g", "hosts": {"n1": 4}}
+        ]
+        m1.scan_once()
+        assert m1.peer_hold_records() == recs
+        assert m1.reservations_view().held_by_host() == {"n1": 4}
+        assert metrics.SHARD_PEER_HELD_CHIPS.get() == 4
+        # The owner's own view serves the hold locally, not as a peer.
+        assert m0.peer_hold_records() == []
+        assert m0.reservations_view().held_by_host() == {"n1": 4}
+    finally:
+        m1.stop()
+        m0.stop()
+
+
+def test_takeover_of_dead_shard(api):
+    # Lease durations are wall-clock here (renewTime is the
+    # apiserver's second-precision form), so the test lease is 2 s —
+    # short enough to wait out, long enough that truncation noise
+    # can't fake staleness.
+    server, client = api
+    m1 = _manager(
+        client, home=1, identity="rep-b", lease_seconds=2.0,
+        takeover=False,
+    )
+    m1._adopt_shard(1, reason="home")
+    m0 = _manager(
+        client, home=0, identity="rep-a", lease_seconds=2.0,
+    )
+    m0._adopt_shard(0, reason="home")
+    try:
+        before = metrics.SHARD_TAKEOVERS.get(shard="1")
+        m1.abandon()  # SIGKILL: lease left standing, never renewed
+        m0.scan_once()
+        # First sight of rep-b's record starts the liveness clock; it
+        # must NOT be taken over while the published duration holds.
+        assert m0.owned_shards() == {0}
+        time.sleep(2.3)
+        m0.scan_once()
+        assert m0.owned_shards() == {0, 1}
+        assert m0.takeovers == 1
+        assert metrics.SHARD_TAKEOVERS.get(shard="1") == before + 1
+        lease = server.leases[
+            ("kube-system", f"{LEASE_NAME}-shard-1")
+        ]
+        assert lease["spec"]["holderIdentity"] == "rep-a"
+        adopted = m0._owned[1].admission
+        assert adopted.recovered, "takeover must replay the journal"
+        assert m0.status()["shard_phases"]["1"]["phase"] == "ready"
+    finally:
+        m0.stop()
+
+
+def test_takeover_race_has_one_winner(api):
+    """Two survivors race one dead shard's lease: exactly one wins
+    (the loser observes the winner's LIVE record and skips) — no
+    split-brain adoption of one shard."""
+    _, client = api
+    dead = _manager(
+        client, home=2, identity="rep-dead", lease_seconds=2.0,
+        takeover=False, shards=3,
+    )
+    dead._adopt_shard(2, reason="home")
+    dead.abandon()
+    a = _manager(
+        client, home=0, identity="rep-a", lease_seconds=2.0,
+        shards=3,
+    )
+    a._adopt_shard(0, reason="home")
+    b = _manager(
+        client, home=1, identity="rep-b", lease_seconds=2.0,
+        shards=3,
+    )
+    b._adopt_shard(1, reason="home")
+    try:
+        # Both observe the dead record once, then race after it
+        # decays.
+        a.scan_once()
+        b.scan_once()
+        assert a.owned_shards() == {0} and b.owned_shards() == {1}
+        time.sleep(2.3)
+        a.scan_once()  # wins the takeover
+        b.scan_once()  # sees a LIVE holder, skips — no split brain
+        assert a.owned_shards() == {0, 2}
+        assert b.owned_shards() == {1}
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_takeover_keeps_overlay_shield_until_replay_completes(api):
+    """The takeover steal window, closed: while a taken-over shard's
+    journal is still replaying, the dead shard's PUBLISHED hold
+    overlay keeps shielding /filter — the local-table swap happens
+    atomically when the admitter lands, never leaving the chips
+    visible mid-replay."""
+    server, client = api
+    m1 = _manager(
+        client, home=1, identity="rep-b", lease_seconds=2.0,
+        takeover=False,
+    )
+    m1._adopt_shard(1, reason="home")
+    m1._owned[1].admission.reservations.reserve(
+        ("default", "g"), {"n9": 4}
+    )
+    m1._owned[1].lease._renew_once()  # publish the overlay
+    m0 = _manager(
+        client, home=0, identity="rep-a", lease_seconds=2.0,
+    )
+    m0._adopt_shard(0, reason="home")
+    m0.scan_once()
+    assert m0.reservations_view().held_by_host() == {"n9": 4}
+
+    seen = {}
+
+    class _ReplayingAdm(_DummyAdmission):
+        def recover(self):
+            # Mid-replay view: the overlay must still fence.
+            seen["held"] = m0.reservations_view().held_by_host()
+            super().recover()
+
+    m0.admitter_factory = lambda *_: _ReplayingAdm()
+    try:
+        m1.abandon()
+        time.sleep(2.3)
+        m0.scan_once()  # takeover: recover() runs inside
+        assert m0.owned_shards() == {0, 1}
+        assert seen["held"] == {"n9": 4}, (
+            "overlay dropped before replay installed the holds"
+        )
+    finally:
+        m0.stop()
+
+
+def test_holds_annotation_degrades_at_size_ceiling(api, monkeypatch):
+    """Past the annotation byte ceiling the overlay degrades to the
+    aggregated host→chips form (still fences every chip), and past
+    it again to nothing — a renew must never start 422-ing on object
+    size and crash-loop the shard."""
+    server, client = api
+    m = _manager(client, home=0, lease_seconds=30.0)
+    m._adopt_shard(0, reason="home")
+    try:
+        table = m._owned[0].admission.reservations
+        table.reserve(("default", "a"), {"n1": 2, "n2": 1})
+        table.reserve(("default", "b"), {"n1": 1})
+        payload = m._holds_payload_fn(0)()
+        assert len(json.loads(payload[HOLDS_ANNOTATION])) == 2
+        monkeypatch.setattr(
+            sharding, "MAX_HOLDS_ANNOTATION_BYTES", 90
+        )
+        agg = json.loads(m._holds_payload_fn(0)()[HOLDS_ANNOTATION])
+        assert agg == [
+            {"namespace": "", "gang": "",
+             "hosts": {"n1": 3, "n2": 1}}
+        ]
+        monkeypatch.setattr(
+            sharding, "MAX_HOLDS_ANNOTATION_BYTES", 10
+        )
+        # Explicitly EMPTY, never omitted: the lease-annotation merge
+        # can't delete keys, so omission would leave the last
+        # published overlay fencing released chips forever.
+        assert m._holds_payload_fn(0)()[HOLDS_ANNOTATION] == "[]"
+    finally:
+        m.stop()
+
+
+def test_never_created_lease_gets_rollout_grace(api):
+    """First rollout: shard 1's replica hasn't started yet (its lease
+    was never created). The survivor must NOT scavenge it before one
+    full lease duration — else the first replica up steals every
+    home and the StatefulSet bringup never converges."""
+    _, client = api
+    m0 = _manager(
+        client, home=0, identity="rep-a", lease_seconds=1.0,
+    )
+    m0._adopt_shard(0, reason="home")
+    try:
+        m0.scan_once()
+        assert m0.owned_shards() == {0}  # grace holds
+        m0.scan_once()
+        assert m0.owned_shards() == {0}
+        time.sleep(1.2)
+        m0.scan_once()  # grace expired with no replica: scavenge
+        assert m0.owned_shards() == {0, 1}
+    finally:
+        m0.stop()
+
+
+def test_home_handback_after_takeover(api):
+    """The restart story closes the loop: the interim owner hands a
+    taken-over shard back when its home replica returns — the
+    returning replica parks a standby lease instead of fail-fasting,
+    and ends up owning its home again."""
+    server, client = api
+    m1 = _manager(
+        client, home=1, identity="rep-b", lease_seconds=2.0,
+        takeover=False,
+    )
+    m1._adopt_shard(1, reason="home")
+    m0 = _manager(
+        client, home=0, identity="rep-a", lease_seconds=2.0,
+    )
+    m0._adopt_shard(0, reason="home")
+    try:
+        m1.abandon()  # SIGKILL replica 1
+        m0.scan_once()
+        time.sleep(2.3)
+        m0.scan_once()
+        assert m0.owned_shards() == {0, 1}
+
+        # Replica 1 restarts: home held by a live INTERIM owner →
+        # standby, not SecondReplica, not CrashLoopBackOff.
+        m1b = _manager(
+            client, home=1, identity="rep-b2", lease_seconds=2.0,
+        )
+        assert m1b._try_adopt_home(fail_fast=True) is False
+        assert m1b._standby is not None
+        assert m1b.status()["standby"] is True
+        assert server.leases[
+            ("kube-system", f"{LEASE_NAME}-shard-1-standby")
+        ]["spec"]["holderIdentity"] == "rep-b2"
+
+        # The interim owner's next scan observes the claim and hands
+        # the shard back...
+        m0.scan_once()
+        assert m0.owned_shards() == {0}
+        assert server.leases[
+            ("kube-system", f"{LEASE_NAME}-shard-1")
+        ]["spec"]["holderIdentity"] == ""
+        # ...and the returning replica's next retry owns its home —
+        # firing the deferred-wiring hook (the entrypoint hangs the
+        # consistency auditor off it so a standby start still gets
+        # its journal/cluster invariants once home lands).
+        adopted_with = []
+        m1b.on_home_adopted = adopted_with.append
+        assert m1b._try_adopt_home() is True
+        assert m1b.owned_shards() == {1}
+        assert m1b._standby is None
+        assert m1b.status()["standby"] is False
+        assert adopted_with == [m1b.home_admission()]
+        m1b.stop()
+    finally:
+        m0.stop()
+
+
+def test_genuine_duplicate_home_still_fails_fast(api):
+    """A live holder whose PUBLISHED home is this very shard is a
+    misconfiguration (two replicas, one home), not an interim owner:
+    the singleton's fail-fast contract holds per shard."""
+    _, client = api
+    m0 = _manager(client, home=0, identity="rep-a", lease_seconds=30)
+    m0._adopt_shard(0, reason="home")
+    # Publish the home annotation (rides the first renew).
+    m0._owned[0].lease._renew_once()
+    try:
+        dup = _manager(
+            client, home=0, identity="rep-dup", lease_seconds=30
+        )
+        with pytest.raises(SecondReplica):
+            dup._try_adopt_home(fail_fast=True)
+        assert dup._standby is None
+    finally:
+        m0.stop()
+
+
+def test_fresh_reserve_wakes_immediate_publish(api):
+    """The cross-shard visibility write side: a reserve on an owned
+    shard's table wakes the publisher; publish_holds() pushes the
+    overlay to the lease without waiting for a renew interval."""
+
+    class _Adm(_DummyAdmission):
+        pass
+
+    server, client = api
+    m = ShardManager(
+        client,
+        shards=2,
+        home_shard=0,
+        admitter_factory=lambda *_: _Adm(),
+        identity="rep-a",
+        lease_seconds=30.0,
+    )
+    m._adopt_shard(0, reason="home")
+    try:
+        assert not m._publish_wake.is_set()
+        m._owned[0].admission.reservations.reserve(
+            ("default", "g"), {"n1": 4}
+        )
+        assert m._publish_wake.is_set()  # the observer tap fired
+        m.publish_holds()
+        ann = server.leases[
+            ("kube-system", f"{LEASE_NAME}-shard-0")
+        ]["metadata"]["annotations"]
+        assert json.loads(ann[HOLDS_ANNOTATION]) == [
+            {"namespace": "default", "gang": "g", "hosts": {"n1": 4}}
+        ]
+        assert ann["tpu.google.com/home-shard"] == "0"
+    finally:
+        m.stop()
+
+
+# ---------------------------------------------------------------------------
+# Disjoint admission + restored==fresh parity per shard
+# ---------------------------------------------------------------------------
+
+
+def _shard_fixture(server, ring):
+    """One 4-chip node + one 2x2-chip gang per shard, names searched
+    onto the right ring position."""
+    hosts, gangs = [], []
+    for s in (0, 1):
+        host = _pick_key(ring, s, "node-{0:04d}-" + str(s))
+        node, _ = make_node(host, n=4)
+        server.add_node(host, node)
+        hosts.append(host)
+        gkey = _pick_key(ring, s, "default/gang-{0:04d}-" + str(s))
+        gname = gkey.split("/", 1)[1]
+        for i in range(2):
+            server.add_pod(gang_pod(f"{gname}-w{i}", gname, 2, 2))
+        gangs.append(gname)
+    return hosts, gangs
+
+
+def _shard_admission(client, tmp_path, ring, shard):
+    return GangAdmission(
+        client,
+        reservations=ReservationTable(),
+        journal=jr.AdmissionJournal(
+            os.path.join(str(tmp_path), f"shard-{shard}")
+        ),
+        gang_filter=lambda key, s=shard: ring.gang_shard(key) == s,
+        topo_filter=lambda t, s=shard: ring.topo_shard(t) == s,
+        shard_id=shard,
+    )
+
+
+def test_disjoint_admission_and_restored_equals_fresh(api, tmp_path):
+    """Each shard admits exactly its own gang onto its own capacity;
+    a fresh admitter recovered over one shard's journal rebuilds
+    exactly the dead one's table for that shard (restored==fresh,
+    the per-shard parity the index-snapshot suite established for
+    topology state)."""
+    server, client = api
+    ring = ShardRing(2)
+    hosts, gangs = _shard_fixture(server, ring)
+
+    adms = [
+        _shard_admission(client, tmp_path, ring, s) for s in (0, 1)
+    ]
+    for s, adm in enumerate(adms):
+        released = adm.tick()
+        assert released == [("default", gangs[s])]
+        # The hold landed on the shard's OWN host only.
+        held = adm.reservations.held_by_host()
+        assert set(held) == {hosts[s]}, held
+    for s in (0, 1):
+        for i in range(2):
+            assert GATE_NAME not in gates_of(
+                server, "default", f"{gangs[s]}-w{i}"
+            )
+    pre_kill = [adm.reservations.export_state() for adm in adms]
+    # Flush this tick's buffered records (a real daemon's end-of-tick
+    # flush already ran inside tick()); then the process "dies" — no
+    # stop(), no compaction.
+    for adm in adms:
+        adm.journal.flush()
+
+    for s in (0, 1):
+        fresh = _shard_admission(client, tmp_path, ring, s)
+        summary = fresh.recover()
+        assert summary["holds_restored"] == 1
+        got = fresh.reservations.export_state()
+        want = pre_kill[s]
+        assert set(got) == set(want)
+        for key in want:
+            assert got[key]["hosts"] == want[key]["hosts"]
+            assert got[key]["counted"] == want[key]["counted"]
+            # Age preserved across the crash (within test slop).
+            assert abs(got[key]["age_s"] - want[key]["age_s"]) < 2.0
+        fresh.journal.close()
+
+
+def test_gang_filter_scopes_dirty_marks_and_collect(api, tmp_path):
+    server, client = api
+    ring = ShardRing(2)
+    _, gangs = _shard_fixture(server, ring)
+    adm0 = _shard_admission(client, tmp_path, ring, 0)
+    # A pod event for the OTHER shard's gang never dirties this one.
+    adm0.note_pod_event(gang_pod(f"{gangs[1]}-w0", gangs[1], 2, 2))
+    assert adm0._dirty == set()
+    adm0.note_pod_event(gang_pod(f"{gangs[0]}-w0", gangs[0], 2, 2))
+    assert adm0._dirty == {("default", gangs[0])}
+    views = adm0._collect_gangs()
+    assert set(views) == {("default", gangs[0])}
+    adm0.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# /readyz shard payload + /debug/shards
+# ---------------------------------------------------------------------------
+
+
+def test_readyz_carries_shard_payload(api):
+    import threading
+
+    _, client = api
+    m = _manager(client, home=0)
+    m._adopt_shard(0, reason="home")
+    try:
+        ready = threading.Event()
+        status = ReadyStatus(ready, shard_status=m.status)
+        status.mark_ready()
+        snap = status.snapshot()
+        assert snap["ok"] is True
+        assert snap["shard"]["shards"] == 2
+        assert snap["shard"]["home"] == 0
+        assert snap["shard"]["owned"] == [0]
+        assert snap["shard"]["phases"]["0"]["phase"] == "ready"
+        assert snap["shard"]["takeovers"] == 0
+    finally:
+        m.stop()
+
+
+def test_debug_shards_endpoint(api):
+    _, client = api
+    m = _manager(client, home=1)
+    m._adopt_shard(1, reason="home")
+    try:
+        metrics.SHARD_PROVIDER = m.status
+        body = json.loads(metrics.debug_payload("/debug/shards"))
+        assert body["owned"] == [1]
+        assert body["shard_phases"]["1"]["phase"] == "ready"
+    finally:
+        metrics.SHARD_PROVIDER = None
+        m.stop()
+    body = json.loads(metrics.debug_payload("/debug/shards"))
+    assert body == {
+        "configured": False,
+        "note": body["note"],
+    } and "not wired" in body["note"]
+
+
+def test_debug_index_lists_shards_endpoint():
+    body = json.loads(metrics.debug_payload("/debug"))
+    assert "/debug/shards" in body["endpoints"]
+
+
+# ---------------------------------------------------------------------------
+# Audit: the cross-shard ownership invariant
+# ---------------------------------------------------------------------------
+
+
+def _stub_manager(ring, tables):
+    return types.SimpleNamespace(
+        ring=ring, shard_tables=lambda: tables
+    )
+
+
+def _host_index(*hosts):
+    """Index stub mapping each host as a standalone entry (slice key
+    = hostname) — how the ownership check resolves capacity keys."""
+    entries = [
+        types.SimpleNamespace(hostname=h, slice_key=None)
+        for h in hosts
+    ]
+    return types.SimpleNamespace(entries=lambda: entries)
+
+
+def test_audit_shard_ownership_clean_and_registered():
+    ring = ShardRing(2)
+    host0 = _pick_key(ring, 0, "h-{0:04d}")
+    t0 = ReservationTable()
+    t0.reserve(("default", "g"), {host0: 2})
+    ea = audit.ExtenderAudit(
+        index=_host_index(host0),
+        shard_manager=_stub_manager(ring, [(0, t0)]),
+    )
+    names = [i.name for i in ea.invariants()]
+    assert "reservation_shard_ownership" in names
+    assert ea.check_shard_ownership() == []
+
+
+def test_audit_flags_hold_on_foreign_shards_capacity():
+    ring = ShardRing(2)
+    host1 = _pick_key(ring, 1, "h-{0:04d}")  # shard 1's capacity...
+    t0 = ReservationTable()
+    t0.reserve(("default", "g"), {host1: 2})  # ...held by shard 0
+    ea = audit.ExtenderAudit(
+        index=_host_index(host1),
+        shard_manager=_stub_manager(ring, [(0, t0)]),
+    )
+    findings = ea.check_shard_ownership()
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.severity == audit.CRITICAL
+    assert f.node == host1
+    assert dict(f.details)["owner_shard"] == "1"
+
+
+def test_audit_flags_host_held_by_two_shards():
+    ring = ShardRing(2)
+    host0 = _pick_key(ring, 0, "h-{0:04d}")
+    t0, t1 = ReservationTable(), ReservationTable()
+    t0.reserve(("default", "a"), {host0: 2})
+    t1.reserve(("default", "b"), {host0: 1})
+    ea = audit.ExtenderAudit(
+        index=_host_index(host0),
+        shard_manager=_stub_manager(ring, [(0, t0), (1, t1)])
+    )
+    findings = ea.check_shard_ownership()
+    # shard 1's hold is both on foreign capacity AND a double-hold.
+    sev = {f.severity for f in findings}
+    assert sev == {audit.CRITICAL}
+    assert any("two shards" in f.message for f in findings)
+
+
+def test_audit_unresolvable_host_skips_ownership_not_pages():
+    """Without an index (or for a host whose entry vanished), the
+    ownership half is SKIPPED — hashing a slice member's bare
+    hostname would derive the wrong owner and page a false CRITICAL.
+    The two-shards-on-one-host check still fires (no hashing)."""
+    ring = ShardRing(2)
+    host1 = _pick_key(ring, 1, "h-{0:04d}")
+    t0 = ReservationTable()
+    t0.reserve(("default", "g"), {host1: 2})
+    # No index wired: no ownership verdict, no false page.
+    ea = audit.ExtenderAudit(
+        shard_manager=_stub_manager(ring, [(0, t0)])
+    )
+    assert ea.check_shard_ownership() == []
+    # Double-hold detection is hash-free and still fires.
+    t1 = ReservationTable()
+    t1.reserve(("default", "h"), {host1: 1})
+    ea2 = audit.ExtenderAudit(
+        shard_manager=_stub_manager(ring, [(0, t0), (1, t1)])
+    )
+    findings = ea2.check_shard_ownership()
+    assert len(findings) == 1
+    assert "two shards" in findings[0].message
+
+
+def test_sharding_docs_in_lockstep():
+    """The satellite runbook + deploy wiring must exist and name the
+    real artifacts (the crash-recovery docs convention)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ops = open(os.path.join(repo, "docs", "operations.md")).read()
+    assert "Scaling the extender: shards, leases, and failover" in ops
+    assert "--shards" in ops
+    assert "--shard-scaling" in ops
+    assert HOLDS_ANNOTATION in ops
+    assert "--shard-self-test" in ops
+    obs = open(os.path.join(repo, "docs", "observability.md")).read()
+    assert "/debug/shards" in obs
+    deploy = open(
+        os.path.join(repo, "deploy", "tpu-extender.yml")
+    ).read()
+    assert "--shards" in deploy
+    tier1 = open(
+        os.path.join(repo, "scripts", "tier1.sh")
+    ).read()
+    assert "sharding --shard-self-test" in tier1
+
+
+def test_audit_shard_index_maps_slice_members_together():
+    """With an index wired, a held host's owning shard derives from
+    its SLICE key, not its hostname — every member of one slice is
+    audited against the same owner."""
+    ring = ShardRing(3)
+    entry = types.SimpleNamespace(
+        hostname="member-a", slice_key=("member-a", "member-b")
+    )
+    index = types.SimpleNamespace(entries=lambda: [entry])
+    owner = ring.shard_of("member-a|member-b")
+    table = ReservationTable()
+    table.reserve(("default", "g"), {"member-a": 4})
+    ea = audit.ExtenderAudit(
+        index=index,
+        shard_manager=_stub_manager(ring, [(owner, table)]),
+    )
+    assert ea.check_shard_ownership() == []
+    wrong = (owner + 1) % 3
+    ea2 = audit.ExtenderAudit(
+        index=index,
+        shard_manager=_stub_manager(ring, [(wrong, table)]),
+    )
+    assert len(ea2.check_shard_ownership()) == 1
