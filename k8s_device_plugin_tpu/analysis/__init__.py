@@ -1,0 +1,31 @@
+"""tpu-lint: project-native static analysis (ISSUE 12).
+
+The plugin/extender pair is a ~13-threaded concurrent system whose
+invariants — every long-lived loop supervised and heartbeated, every
+metric/flight/ledger kind documented, no blocking work under a hot
+lock — were enforced only by runtime grep tests and reviewer memory
+after three of those classes already bit us (the silently-dead
+background threads fixed in PR 10, the GC-callback-inside-
+``Histogram.observe`` self-deadlock, the lapsed-hold amnesia of PR 6).
+This package makes them machine-checked:
+
+* :mod:`registry_scan` — the ONE source of truth for "what does the
+  code register/record/serve and what do the docs document": AST
+  inventories of flight/ledger kinds, span names, metric families,
+  heartbeat loop names, and ``/debug`` endpoints, plus the matching
+  doc-side parsers.  The ``test_*_docs_in_lockstep*`` tests and the
+  lint rules both call it, so code, tests, and lint can never disagree
+  about what "documented" means.
+* :mod:`rules` — the rule engine behind the ``tpu-lint`` CLI
+  (``python -m k8s_device_plugin_tpu.tools.lint``): ~9 project rules
+  derived from real past bugs, a checked-in baseline
+  (``baseline.json``) for the deliberate exceptions (each with a
+  justification), and ``# tpu-lint: disable=<RULE>`` inline
+  suppressions.
+
+The runtime half of the story — the lock-order (lockdep) graph that
+``utils/profiling.TimedLock`` feeds and the ``lock_order`` /
+``loop_inventory`` audit invariants — lives in ``utils/profiling.py``
+and ``audit.py``; ``docs/analysis.md`` is the operator-facing rule
+reference.
+"""

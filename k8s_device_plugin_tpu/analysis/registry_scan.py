@@ -1,0 +1,448 @@
+"""The one source of truth for code↔doc lockstep inventories.
+
+Before this module, "is every flight-recorder kind documented?" was
+answered three different ways: a regex in
+``tests/test_observability.py``, a second regex in
+``tests/test_decisions.py``, and a reviewer's memory at PR time. A
+call-site shape those regexes didn't anticipate (a kind recorded via
+``self.record`` inside the recorder, a multi-line call) silently
+escaped all of them. Here every inventory is derived ONCE, from the
+AST, with file:line provenance — and both the lockstep tests and the
+tpu-lint rules (:mod:`rules`) consume the same functions, so code,
+tests, and lint can never disagree about what "documented" means.
+
+Code-side inventories (static, :func:`iter_sites`-shaped
+``(value, path, line)`` tuples):
+
+* :func:`flight_kind_sites` — ``RECORDER.record("<kind>", ...)``
+* :func:`ledger_kind_sites` — ``LEDGER.record("<kind>", ...)``
+* :func:`span_name_sites` — ``tracing.span("<name>")`` /
+  ``_span_for("<name>")``
+* :func:`metric_family_sites` — ``*REGISTRY.counter|gauge|histogram(
+  "tpu_...", ...)`` (+ :func:`uptime_families`, which are rendered
+  rather than registered)
+* :func:`heartbeat_names` — loop names from ``HEARTBEATS.register``
+  and ``profiling.supervised`` call sites (exact literals plus
+  f-string prefixes — the runtime ``loop_inventory`` audit invariant
+  matches against these)
+* :func:`debug_endpoint_keys` / :func:`debug_path_compare_sites` —
+  the ``DEBUG_ENDPOINTS`` index vs the paths ``debug_payload``
+  actually dispatches on
+
+Doc-side inventories: :func:`documented_backticked` parses the
+``\\`name\\``` convention every doc table uses.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# (value, relpath, line)
+Site = Tuple[str, str, int]
+
+
+def repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def package_files() -> List[str]:
+    """Every ``.py`` file of the shipped package (sorted, stable)."""
+    out: List[str] = []
+    for root, _dirs, files in os.walk(package_root()):
+        if "__pycache__" in root:
+            continue
+        for f in sorted(files):
+            if f.endswith(".py"):
+                out.append(os.path.join(root, f))
+    return sorted(out)
+
+
+_AST_CACHE: Dict[str, Tuple[float, ast.Module]] = {}
+
+
+def parse_file(path: str) -> ast.Module:
+    """Parse (and cache by mtime) one source file. A file that does
+    not parse raises — an unparseable module is itself a finding the
+    caller must surface, never skip silently."""
+    mtime = os.path.getmtime(path)
+    cached = _AST_CACHE.get(path)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    with open(path, "r") as f:
+        tree = ast.parse(f.read(), filename=path)
+    _AST_CACHE[path] = (mtime, tree)
+    return tree
+
+
+def relpath(path: str) -> str:
+    return os.path.relpath(path, repo_root())
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted-name rendering of a Name/Attribute chain
+    ("" for anything else) — the cheap way to ask "does this call sit
+    on RECORDER / LEDGER / a *REGISTRY?"."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _iter_calls(tree: ast.Module) -> Iterable[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _record_sites(files: Iterable[str], owner_suffix: str) -> List[Site]:
+    """Call sites ``<X>.record("<kind>", ...)`` where the dotted
+    receiver ends with ``owner_suffix`` (``RECORDER`` / ``LEDGER``) —
+    matching both the module-global (``RECORDER.record``) and
+    attribute (``self.recorder.record`` is NOT matched; taps go
+    through the globals by convention) shapes the old test regexes
+    covered, with multi-line calls handled for free."""
+    out: List[Site] = []
+    for path in files:
+        tree = parse_file(path)
+        for call in _iter_calls(tree):
+            func = call.func
+            if not (
+                isinstance(func, ast.Attribute) and func.attr == "record"
+            ):
+                continue
+            owner = _dotted(func.value)
+            if not (
+                owner == owner_suffix
+                or owner.endswith("." + owner_suffix)
+            ):
+                continue
+            kind = _const_str(call.args[0] if call.args else None)
+            if kind:
+                out.append((kind, relpath(path), call.lineno))
+    return out
+
+
+def flight_kind_sites(files: Optional[Iterable[str]] = None) -> List[Site]:
+    return _record_sites(files or package_files(), "RECORDER")
+
+
+def ledger_kind_sites(files: Optional[Iterable[str]] = None) -> List[Site]:
+    return _record_sites(files or package_files(), "LEDGER")
+
+
+def span_name_sites(files: Optional[Iterable[str]] = None) -> List[Site]:
+    """``tracing.span("<name>")`` and ``_span_for("<name>")`` literals
+    (f-string spans like ``kube.<verb>`` are documented as their
+    pattern, not enumerable statically)."""
+    out: List[Site] = []
+    for path in files or package_files():
+        tree = parse_file(path)
+        for call in _iter_calls(tree):
+            func = call.func
+            name = None
+            if isinstance(func, ast.Attribute) and func.attr == "span":
+                name = _const_str(call.args[0] if call.args else None)
+            elif isinstance(func, ast.Name) and func.id == "_span_for":
+                name = _const_str(call.args[0] if call.args else None)
+            if name:
+                out.append((name, relpath(path), call.lineno))
+    return out
+
+
+_REGISTER_METHODS = ("counter", "gauge", "histogram")
+
+
+def metric_family_sites(
+    files: Optional[Iterable[str]] = None,
+) -> List[Site]:
+    """Registration sites: ``<...>REGISTRY.counter|gauge|histogram(
+    "tpu_...", ...)``. The receiver must END with the CASE-SENSITIVE
+    ``REGISTRY`` (the module-global naming convention) so a transient
+    lowercase ``registry = Registry()`` in bench/test code doesn't
+    publish fake families into the inventory."""
+    out: List[Site] = []
+    for path in files or package_files():
+        tree = parse_file(path)
+        for call in _iter_calls(tree):
+            func = call.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr in _REGISTER_METHODS
+            ):
+                continue
+            owner = _dotted(func.value)
+            if not owner.endswith("REGISTRY"):
+                continue
+            fam = _const_str(call.args[0] if call.args else None)
+            if fam and fam.startswith("tpu_"):
+                out.append((fam, relpath(path), call.lineno))
+    return out
+
+
+def uptime_families(files: Optional[Iterable[str]] = None) -> Set[str]:
+    """Families rendered by ``Registry.render`` without registration:
+    every ``uptime_name=`` constant (keyword arguments at ``Registry``
+    construction sites plus the parameter default in
+    ``Registry.__init__``)."""
+    out: Set[str] = set()
+    for path in files or package_files():
+        tree = parse_file(path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg == "uptime_name":
+                        v = _const_str(kw.value)
+                        if v:
+                            out.add(v)
+            elif (
+                isinstance(node, ast.FunctionDef)
+                and node.name == "__init__"
+            ):
+                args = node.args
+                names = [a.arg for a in args.args]
+                defaults = args.defaults
+                for arg_name, default in zip(
+                    names[len(names) - len(defaults):], defaults
+                ):
+                    if arg_name == "uptime_name":
+                        v = _const_str(default)
+                        if v:
+                            out.add(v)
+    return out
+
+
+# -- heartbeat / supervised-loop names ---------------------------------------
+
+
+def _resolve_local_str(
+    func_node: ast.AST, name: str
+) -> Optional[ast.AST]:
+    """The last straight-line assignment of ``name`` inside
+    ``func_node`` (one hop — enough for the ``loop_name =
+    f"index_warm_{i}"`` idiom)."""
+    found: Optional[ast.AST] = None
+    for node in ast.walk(func_node):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    found = node.value
+    return found
+
+
+def _name_or_prefix(
+    node: Optional[ast.AST], scope: Optional[ast.AST] = None
+) -> Tuple[Optional[str], Optional[str]]:
+    """(exact, prefix) of a loop-name expression: a constant is exact,
+    an f-string contributes its constant lead as a prefix, a local
+    variable resolves one hop within ``scope``."""
+    if node is None:
+        return None, None
+    s = _const_str(node)
+    if s is not None:
+        return s, None
+    if isinstance(node, ast.JoinedStr) and node.values:
+        lead = _const_str(node.values[0])
+        if lead:
+            return None, lead
+        return None, None
+    if isinstance(node, ast.Name) and scope is not None:
+        resolved = _resolve_local_str(scope, node.id)
+        if resolved is not None and not isinstance(resolved, ast.Name):
+            return _name_or_prefix(resolved, None)
+    return None, None
+
+
+def heartbeat_names(
+    files: Optional[Iterable[str]] = None,
+) -> Tuple[Set[str], Set[str]]:
+    """(exact names, prefixes) of every loop the code registers a
+    heartbeat for or supervises — the static loop inventory. Sources:
+    ``HEARTBEATS.register(<name>, ...)``, ``supervised(<name>, ...)``
+    and ``run_supervised(<name>, ...)`` first arguments; f-strings
+    contribute their constant prefix (``index_warm_`` covers
+    ``index_warm_0..N``). The runtime ``loop_inventory`` audit
+    invariant warns about any registered heartbeat this inventory
+    cannot explain — a loop the linter cannot see is a loop the
+    ``loop-without-heartbeat`` rule cannot protect."""
+    exact: Set[str] = set()
+    prefixes: Set[str] = set()
+    for path in files or package_files():
+        tree = parse_file(path)
+        # Map every node to its enclosing function for one-hop local
+        # name resolution.
+        enclosing: Dict[int, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                for child in ast.walk(node):
+                    enclosing.setdefault(id(child), node)
+        for call in _iter_calls(tree):
+            func = call.func
+            is_register = (
+                isinstance(func, ast.Attribute)
+                and func.attr == "register"
+                and _dotted(func.value).endswith("HEARTBEATS")
+            )
+            is_supervised = (
+                isinstance(func, ast.Name)
+                and func.id in ("supervised", "run_supervised")
+            ) or (
+                isinstance(func, ast.Attribute)
+                and func.attr in ("supervised", "run_supervised")
+            )
+            if not (is_register or is_supervised):
+                continue
+            arg = call.args[0] if call.args else None
+            scope = enclosing.get(id(call))
+            # Parameter defaults (``loop_name: str = "index_warm"``)
+            # resolve through the scope walk too, via the local-assign
+            # miss → the default path below.
+            name, prefix = _name_or_prefix(arg, scope)
+            if name is None and prefix is None and isinstance(
+                arg, ast.Name
+            ) and isinstance(
+                scope, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                # A parameter with a constant default.
+                a = scope.args
+                names = [x.arg for x in a.args]
+                for arg_name, default in zip(
+                    names[len(names) - len(a.defaults):], a.defaults
+                ):
+                    if arg_name == arg.id:
+                        name = _const_str(default)
+            if name:
+                exact.add(name)
+            if prefix:
+                prefixes.add(prefix)
+    return exact, prefixes
+
+
+def loop_name_known(
+    name: str, exact: Set[str], prefixes: Set[str]
+) -> bool:
+    return name in exact or any(name.startswith(p) for p in prefixes)
+
+
+# -- /debug endpoints --------------------------------------------------------
+
+
+def debug_endpoint_keys(
+    files: Optional[Iterable[str]] = None,
+) -> List[Site]:
+    """The keys of the ``DEBUG_ENDPOINTS`` dict literal (the /debug
+    index + the tpu-doctor bundle collection list)."""
+    out: List[Site] = []
+    for path in files or package_files():
+        tree = parse_file(path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            else:
+                continue
+            is_target = any(
+                isinstance(t, ast.Name) and t.id == "DEBUG_ENDPOINTS"
+                for t in targets
+            )
+            if not is_target or not isinstance(node.value, ast.Dict):
+                continue
+            for key in node.value.keys:
+                k = _const_str(key)
+                if k:
+                    out.append((k, relpath(path), key.lineno))
+    return out
+
+
+def debug_path_compare_sites(
+    files: Optional[Iterable[str]] = None,
+) -> List[Site]:
+    """``/debug/...`` string literals used in COMPARISONS (the
+    dispatch tests inside ``debug_payload`` and the HTTP handlers) —
+    the surface a request can actually reach. Matching only Compare
+    nodes keeps descriptions, log lines, and doc strings out."""
+    out: List[Site] = []
+    index_paths = {"/debug", "/debug/"}
+    for path in files or package_files():
+        tree = parse_file(path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            literals: List[ast.AST] = [node.left]
+            literals.extend(node.comparators)
+            for lit in literals:
+                if isinstance(lit, (ast.Tuple, ast.List, ast.Set)):
+                    literals.extend(lit.elts)
+            for lit in literals:
+                s = _const_str(lit)
+                if (
+                    s
+                    and s.startswith("/debug/")
+                    and s not in index_paths
+                ):
+                    out.append((s, relpath(path), lit.lineno))
+    return out
+
+
+# -- doc-side parsing --------------------------------------------------------
+
+
+def doc_text(doc_name: str, docs_dir: Optional[str] = None) -> str:
+    base = docs_dir or os.path.join(repo_root(), "docs")
+    path = os.path.join(base, doc_name)
+    with open(path, "r") as f:
+        return f.read()
+
+
+def documented_backticked(
+    doc_name: str,
+    pattern: str = r"`([a-z][A-Za-z0-9_./<>-]*)`",
+    docs_dir: Optional[str] = None,
+) -> Set[str]:
+    """Every backticked token in a doc — the convention all the kind /
+    family / invariant tables share."""
+    return set(re.findall(pattern, doc_text(doc_name, docs_dir)))
+
+
+def documented_metric_families(
+    docs_dir: Optional[str] = None,
+) -> Set[str]:
+    return set(
+        re.findall(
+            r"`(tpu_[a-z0-9_]+)`", doc_text("metrics.md", docs_dir)
+        )
+    )
+
+
+def doc_line_of(
+    doc_name: str, needle: str, docs_dir: Optional[str] = None
+) -> int:
+    """1-based line of the first occurrence (0 when absent) — gives
+    doc-side findings a clickable location."""
+    for i, line in enumerate(
+        doc_text(doc_name, docs_dir).splitlines(), start=1
+    ):
+        if needle in line:
+            return i
+    return 0

@@ -1,0 +1,651 @@
+"""tpu-lint rules: ~9 project-native checks derived from real bugs.
+
+Each rule exists because its violation class has already cost an
+incident or a review round in THIS repo (the "motivated by" column in
+``docs/analysis.md``):
+
+========  =======================  ==================================
+id        slug                     the bug it would have caught
+========  =======================  ==================================
+TPL001    unsupervised-thread      silent background-thread death
+                                   (fixed across 9 loops in PR 10)
+TPL002    loop-without-heartbeat   a wedged-but-alive loop invisible
+                                   to the stall watchdog (PR 10)
+TPL003    undocumented-metric      dashboard families nobody documented
+                                   (the docs/metrics.md lockstep class)
+TPL004    undocumented-flight-kind flight kinds missing from the
+                                   observability kind table (PR 3+)
+TPL005    undocumented-ledger-kind decision kinds missing from the
+                                   ledger kind table (PR 4)
+TPL006    blocking-under-lock      the GC-callback-inside-
+                                   ``Histogram.observe`` self-deadlock
+                                   shape: blocking work (kube RPC,
+                                   file I/O, sleep, observe) while
+                                   holding a hot lock
+TPL007    bare-except              a bare ``except:`` (or a swallowed
+                                   ``BaseException``) that would eat
+                                   the SIGKILL-simulation/KeyboardInterrupt
+                                   class the chaos suite relies on
+TPL008    undocumented-debug-endpoint  a ``/debug/*`` surface served
+                                   but absent from ``DEBUG_ENDPOINTS``
+                                   (tpu-doctor bundles would silently
+                                   skip it) or from the docs
+TPL009    undocumented-span        span names missing from the
+                                   observability span table (PR 3)
+========  =======================  ==================================
+
+Suppression: ``# tpu-lint: disable=TPL006`` on the offending line (or
+the statement's first line) with a short reason in the same comment.
+Grandfathered findings live in ``baseline.json`` next to this module —
+every entry carries a one-line justification, and the CLI refuses a
+baseline entry without one.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import registry_scan as scan
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    slug: str
+    summary: str
+    motivated_by: str
+
+
+RULES: Tuple[Rule, ...] = (
+    Rule(
+        "TPL001", "unsupervised-thread",
+        "a threading.Thread target is not wrapped in "
+        "profiling.supervised — an unhandled exception would kill the "
+        "loop silently (no log level guarantee, no metric, no "
+        "thread_liveness finding)",
+        "PR 10 (silent background-thread death, fixed across 9 loops)",
+    ),
+    Rule(
+        "TPL002", "loop-without-heartbeat",
+        "a supervised long-lived loop (contains `while`) never "
+        "registers/beats a Heartbeat — the stall watchdog cannot see "
+        "it wedge",
+        "PR 10 (stall watchdog; a wedged loop without a heartbeat is "
+        "invisible)",
+    ),
+    Rule(
+        "TPL003", "undocumented-metric",
+        "a registered tpu_* metric family is absent from "
+        "docs/metrics.md (or documented but not registered)",
+        "the docs/metrics.md lockstep test class (PRs 2-11)",
+    ),
+    Rule(
+        "TPL004", "undocumented-flight-kind",
+        "a RECORDER.record kind is absent from the "
+        "docs/observability.md flight-event kind table",
+        "PR 3 (flight recorder) lockstep greps",
+    ),
+    Rule(
+        "TPL005", "undocumented-ledger-kind",
+        "a LEDGER.record kind is absent from the "
+        "docs/observability.md decision kind table",
+        "PR 4 (decision ledger) lockstep greps",
+    ),
+    Rule(
+        "TPL006", "blocking-under-lock",
+        "a blocking call (sleep, file open, kube RPC, "
+        "Histogram.observe) runs inside a `with <lock>:` block — the "
+        "GC-callback-inside-observe self-deadlock shape, and convoy "
+        "on the RPC hot path",
+        "the Histogram.observe GC-callback self-deadlock (PR 10) and "
+        "the TimedLock convoy work",
+    ),
+    Rule(
+        "TPL007", "bare-except",
+        "a bare `except:` or a swallowed `except BaseException:` — "
+        "eats KeyboardInterrupt/SystemExit and the chaos suite's "
+        "SIGKILL-simulation exceptions",
+        "the PR 6 chaos harness (BaseException must pass through "
+        "best-effort handlers)",
+    ),
+    Rule(
+        "TPL008", "undocumented-debug-endpoint",
+        "a /debug/* path is dispatched on but missing from "
+        "metrics.DEBUG_ENDPOINTS, or a DEBUG_ENDPOINTS key is missing "
+        "from docs/observability.md — tpu-doctor bundles collect from "
+        "DEBUG_ENDPOINTS, so an unlisted surface is silently absent "
+        "from every support bundle",
+        "PR 8 (tpu-doctor bundle collects via DEBUG_ENDPOINTS)",
+    ),
+    Rule(
+        "TPL009", "undocumented-span",
+        "a tracing span name is absent from the "
+        "docs/observability.md span table",
+        "PR 3 (tracing) lockstep greps",
+    ),
+)
+
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in RULES}
+
+
+@dataclasses.dataclass(frozen=True)
+class LintFinding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+    # Stable identity for baseline matching: the rule-specific subject
+    # (a metric family, a kind, a function qualname, a lock->call
+    # pair) — never a line number, so doc edits above a finding don't
+    # churn the baseline.
+    key: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# -- suppression -------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_,]+)")
+_LINES_CACHE: Dict[str, List[str]] = {}
+
+
+def _source_lines(path: str) -> List[str]:
+    if path not in _LINES_CACHE:
+        with open(path, "r") as f:
+            _LINES_CACHE[path] = f.read().splitlines()
+    return _LINES_CACHE[path]
+
+
+def _suppressed(abs_path: str, lines: Sequence[int], rule_id: str) -> bool:
+    src = _source_lines(abs_path)
+    for ln in lines:
+        if 1 <= ln <= len(src):
+            m = _SUPPRESS_RE.search(src[ln - 1])
+            if m and rule_id in m.group(1).split(","):
+                return True
+    return False
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+class _ModuleIndex:
+    """Per-module resolution helpers: method lookup by enclosing
+    class, module-level function lookup, enclosing-scope maps."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.tree = scan.parse_file(path)
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.class_methods: Dict[str, Dict[str, ast.FunctionDef]] = {}
+        self.enclosing_class: Dict[int, str] = {}
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                methods: Dict[str, ast.FunctionDef] = {}
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        methods[sub.name] = sub
+                self.class_methods[node.name] = methods
+                for sub in ast.walk(node):
+                    self.enclosing_class.setdefault(id(sub), node.name)
+
+    def resolve_callable(
+        self, node: ast.AST, at: ast.AST
+    ) -> Optional[ast.FunctionDef]:
+        """``self._loop`` → the method on the enclosing class;
+        ``module_fn`` → the module-level def; a lambda → the method it
+        calls (the ``lambda n=x: self._warm_loop(n)`` idiom). None =
+        unresolvable (a variable, a foreign attribute)."""
+        if isinstance(node, ast.Lambda):
+            for sub in ast.walk(node.body):
+                if isinstance(sub, ast.Call):
+                    resolved = self.resolve_callable(sub.func, at)
+                    if resolved is not None:
+                        return resolved
+            return None
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ) and node.value.id == "self":
+            cls = self.enclosing_class.get(id(at))
+            if cls is not None:
+                return self.class_methods.get(cls, {}).get(node.attr)
+            return None
+        if isinstance(node, ast.Name):
+            return self.functions.get(node.id)
+        return None
+
+    def one_level_callees(
+        self, fn: ast.FunctionDef
+    ) -> List[ast.FunctionDef]:
+        out: List[ast.FunctionDef] = []
+        seen: Set[int] = {id(fn)}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                resolved = self.resolve_callable(sub.func, fn)
+                if resolved is not None and id(resolved) not in seen:
+                    seen.add(id(resolved))
+                    out.append(resolved)
+        return out
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    name = scan._dotted(call.func)
+    return name == "Thread" or name.endswith(".Thread")
+
+
+def _is_supervised_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in ("supervised", "run_supervised")
+    if isinstance(f, ast.Attribute):
+        return f.attr in ("supervised", "run_supervised")
+    return False
+
+
+def _qualname(idx: _ModuleIndex, node: ast.AST) -> str:
+    cls = idx.enclosing_class.get(id(node))
+    fn = None
+    for candidate in ast.walk(idx.tree):
+        if isinstance(
+            candidate, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and any(id(sub) == id(node) for sub in ast.walk(candidate)):
+            fn = candidate.name
+    base = os.path.basename(idx.path)
+    parts = [p for p in (cls, fn) if p]
+    return f"{base}:{'.'.join(parts) or '<module>'}"
+
+
+# -- TPL001 / TPL002 ---------------------------------------------------------
+
+
+def _check_threads(
+    idx: _ModuleIndex,
+    rel: str,
+    out: List[LintFinding],
+    want: Set[str],
+) -> None:
+    for call in ast.walk(idx.tree):
+        if not isinstance(call, ast.Call) or not _is_thread_ctor(call):
+            continue
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        if target is None and len(call.args) > 1:
+            # threading.Thread(group, target, ...): target passed
+            # positionally must not dodge the rule.
+            target = call.args[1]
+        if target is None:
+            continue
+        if not _is_supervised_call(target):
+            if "TPL001" in want:
+                out.append(LintFinding(
+                    "TPL001", rel, call.lineno,
+                    "threading.Thread target is not wrapped in "
+                    "profiling.supervised(...) — an unhandled "
+                    "exception kills this loop silently (no died "
+                    "counter, no thread_liveness finding). Wrap the "
+                    "target, or suppress with a reason if the thread "
+                    "is short-lived by design.",
+                    key=f"thread:{ast.unparse(target)}",
+                ))
+            continue
+        if "TPL002" not in want:
+            continue
+        # Supervised: now the loop must be watchable. Resolve the real
+        # loop function (arg 1 of supervised) and require a heartbeat
+        # when it is a long-lived `while` loop.
+        sup_args = target.args  # type: ignore[union-attr]
+        loop_fn = (
+            idx.resolve_callable(sup_args[1], call)
+            if len(sup_args) > 1 else None
+        )
+        if loop_fn is None:
+            continue  # unresolvable across modules: not provable
+        fns = [loop_fn] + idx.one_level_callees(loop_fn)
+        has_while = any(
+            isinstance(sub, ast.While)
+            for fn in fns for sub in ast.walk(fn)
+        )
+        if not has_while:
+            continue
+        seg = "\n".join(ast.unparse(fn) for fn in fns)
+        if "HEARTBEATS.register" in seg or ".beat(" in seg:
+            continue
+        out.append(LintFinding(
+            "TPL002", rel, loop_fn.lineno,
+            f"supervised loop {loop_fn.name!r} runs a while-loop but "
+            f"never registers/beats a Heartbeat "
+            f"(profiling.HEARTBEATS.register) — the stall watchdog "
+            f"cannot tell wedged from idle",
+            key=f"loop:{_qualname(idx, loop_fn)}",
+        ))
+
+
+# -- TPL006 ------------------------------------------------------------------
+
+_LOCK_EXPR_RE = re.compile(r"(^|[._])lock\b", re.IGNORECASE)
+_KUBE_VERBS = {
+    "get", "list_pods", "list_nodes", "patch_node", "patch_pod",
+    "create_event", "replace", "watch_nodes", "watch_pods",
+    "delete_pod", "post", "put", "list_leases",
+}
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    f = call.func
+    dotted = scan._dotted(f)
+    if dotted in ("time.sleep", "sleep") or dotted.endswith(
+        ".sleep"
+    ):
+        return "sleep"
+    if isinstance(f, ast.Name) and f.id == "open":
+        return "file I/O (open)"
+    if dotted in ("os.fsync", "os.replace"):
+        return f"file I/O ({dotted})"
+    if isinstance(f, ast.Attribute) and f.attr == "observe":
+        return (
+            "Histogram.observe (a GC pass triggered inside observe "
+            "runs gc.callbacks under the histogram lock — the PR 10 "
+            "self-deadlock shape)"
+        )
+    if dotted.startswith("requests."):
+        return f"HTTP call ({dotted})"
+    if isinstance(f, ast.Attribute) and f.attr in _KUBE_VERBS:
+        owner = scan._dotted(f.value)
+        if "client" in owner or "resilience" in owner:
+            return f"kube RPC ({f.attr})"
+    return None
+
+
+def _check_blocking_under_lock(
+    idx: _ModuleIndex, rel: str, out: List[LintFinding]
+) -> None:
+    for node in ast.walk(idx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lock_names = []
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                continue  # `with open(...)`, `with timed(...)` etc.
+            src = ast.unparse(expr)
+            if _LOCK_EXPR_RE.search(src):
+                lock_names.append(src)
+        if not lock_names:
+            continue
+        # Walk the body, skipping nested function/lambda bodies (they
+        # run later, outside the hold).
+        stack: List[ast.AST] = list(node.body)
+        while stack:
+            sub = stack.pop()
+            if isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(sub, ast.Call):
+                reason = _blocking_reason(sub)
+                if reason is not None:
+                    out.append(LintFinding(
+                        "TPL006", rel, sub.lineno,
+                        f"blocking call under {lock_names[0]!r}: "
+                        f"{reason} — every other thread queuing on "
+                        f"this lock stalls for the duration; move "
+                        f"the blocking work outside the hold or "
+                        f"buffer it (the flush_gc_pauses idiom)",
+                        key=(
+                            f"lock:{lock_names[0]}"
+                            f"->{ast.unparse(sub.func)}"
+                        ),
+                    ))
+            for child in ast.iter_child_nodes(sub):
+                stack.append(child)
+
+
+# -- TPL007 ------------------------------------------------------------------
+
+
+def _check_bare_except(
+    idx: _ModuleIndex, rel: str, out: List[LintFinding]
+) -> None:
+    for node in ast.walk(idx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append(LintFinding(
+                "TPL007", rel, node.lineno,
+                "bare `except:` catches BaseException — "
+                "KeyboardInterrupt, SystemExit, and the chaos "
+                "suite's SIGKILL-simulation exceptions are silently "
+                "eaten; catch Exception (and re-raise what you "
+                "cannot handle)",
+                key=f"bare:{_qualname(idx, node)}",
+            ))
+            continue
+        type_src = ast.unparse(node.type)
+        if "BaseException" not in type_src:
+            continue
+        reraises = any(
+            isinstance(sub, ast.Raise) and sub.exc is None
+            for sub in ast.walk(node)
+        )
+        if not reraises:
+            out.append(LintFinding(
+                "TPL007", rel, node.lineno,
+                "`except BaseException:` without a bare `raise` "
+                "swallows SystemExit/KeyboardInterrupt — re-raise "
+                "after the cleanup, or catch Exception",
+                key=f"baseexc:{_qualname(idx, node)}",
+            ))
+
+
+# -- doc-lockstep rules (TPL003/4/5/8/9) -------------------------------------
+
+
+def _doc_rule_sites(
+    sites: List[scan.Site],
+    documented: Set[str],
+    rule_id: str,
+    doc_name: str,
+    what: str,
+    out: List[LintFinding],
+    abs_by_rel: Dict[str, str],
+) -> None:
+    seen: Set[str] = set()
+    for value, rel, line in sites:
+        if value in documented or value in seen:
+            continue
+        seen.add(value)
+        ap = abs_by_rel.get(rel)
+        if ap and _suppressed(ap, (line, line - 1), rule_id):
+            continue
+        out.append(LintFinding(
+            rule_id, rel, line,
+            f"{what} `{value}` is not documented in docs/{doc_name}",
+            key=value,
+        ))
+
+
+# -- engine ------------------------------------------------------------------
+
+BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+def load_baseline(path: Optional[str] = None) -> List[dict]:
+    p = path or BASELINE_PATH
+    if not os.path.exists(p):
+        return []
+    with open(p, "r") as f:
+        doc = json.load(f)
+    return list(doc.get("findings", []))
+
+
+def baseline_matches(entry: dict, finding: LintFinding) -> bool:
+    return (
+        entry.get("rule") == finding.rule
+        and entry.get("path") == finding.path
+        and entry.get("key") == finding.key
+    )
+
+
+def apply_baseline(
+    findings: List[LintFinding], baseline: List[dict]
+) -> Tuple[List[LintFinding], List[LintFinding], List[dict]]:
+    """(new, grandfathered, stale-baseline-entries)."""
+    new: List[LintFinding] = []
+    old: List[LintFinding] = []
+    used: Set[int] = set()
+    for f in findings:
+        hit = None
+        for i, entry in enumerate(baseline):
+            if baseline_matches(entry, f):
+                hit = i
+                break
+        if hit is None:
+            new.append(f)
+        else:
+            used.add(hit)
+            old.append(f)
+    stale = [e for i, e in enumerate(baseline) if i not in used]
+    return new, old, stale
+
+
+def run_rules(
+    files: Optional[Iterable[str]] = None,
+    docs_dir: Optional[str] = None,
+    rules: Optional[Set[str]] = None,
+    full_repo: Optional[bool] = None,
+) -> List[LintFinding]:
+    """Run the rule set over ``files`` (default: the whole package).
+
+    ``full_repo`` gates the checks that only make sense over the
+    complete package (ghost metrics: documented-but-never-registered
+    can only be judged when every registration site was scanned);
+    defaults to True exactly when ``files`` was not narrowed.
+    """
+    file_list = list(files) if files is not None else scan.package_files()
+    if full_repo is None:
+        full_repo = files is None
+    want = rules or {r.id for r in RULES}
+    out: List[LintFinding] = []
+    abs_by_rel = {scan.relpath(p): p for p in file_list}
+
+    for path in file_list:
+        rel = scan.relpath(path)
+        idx = _ModuleIndex(path)
+        if "TPL001" in want or "TPL002" in want:
+            _check_threads(idx, rel, out, want)
+        if "TPL006" in want:
+            _check_blocking_under_lock(idx, rel, out)
+        if "TPL007" in want:
+            _check_bare_except(idx, rel, out)
+
+    if "TPL003" in want:
+        fam_sites = scan.metric_family_sites(file_list)
+        documented = scan.documented_metric_families(docs_dir)
+        _doc_rule_sites(
+            fam_sites, documented, "TPL003", "metrics.md",
+            "registered metric family", out, abs_by_rel,
+        )
+        if full_repo:
+            registered = {v for v, _p, _l in fam_sites}
+            rendered = scan.uptime_families(file_list)
+            for ghost in sorted(documented - registered - rendered):
+                out.append(LintFinding(
+                    "TPL003", "docs/metrics.md",
+                    scan.doc_line_of(
+                        "metrics.md", f"`{ghost}`", docs_dir
+                    ),
+                    f"docs/metrics.md documents `{ghost}` but no "
+                    f"registry registers it (a renamed or removed "
+                    f"family left its row behind)",
+                    key=f"ghost:{ghost}",
+                ))
+
+    if "TPL004" in want or "TPL005" in want:
+        documented = scan.documented_backticked(
+            "observability.md", docs_dir=docs_dir
+        )
+        if "TPL004" in want:
+            _doc_rule_sites(
+                scan.flight_kind_sites(file_list), documented,
+                "TPL004", "observability.md", "flight-recorder kind",
+                out, abs_by_rel,
+            )
+        if "TPL005" in want:
+            _doc_rule_sites(
+                scan.ledger_kind_sites(file_list), documented,
+                "TPL005", "observability.md", "decision-ledger kind",
+                out, abs_by_rel,
+            )
+
+    if "TPL009" in want:
+        documented = scan.documented_backticked(
+            "observability.md", docs_dir=docs_dir
+        )
+        _doc_rule_sites(
+            scan.span_name_sites(file_list), documented,
+            "TPL009", "observability.md", "tracing span", out,
+            abs_by_rel,
+        )
+
+    if "TPL008" in want:
+        # The DEBUG_ENDPOINTS index always comes from the full
+        # package (the dict lives in utils/metrics.py) so a narrowed
+        # fixture scan still judges against the real index.
+        key_sites = scan.debug_endpoint_keys(file_list)
+        if not key_sites:
+            key_sites = scan.debug_endpoint_keys()
+        keys = {k for k, _p, _l in key_sites}
+        seen: Set[str] = set()
+        for path_lit, rel, line in scan.debug_path_compare_sites(
+            file_list
+        ):
+            if path_lit in keys or path_lit in seen:
+                continue
+            seen.add(path_lit)
+            ap = abs_by_rel.get(rel)
+            if ap and _suppressed(ap, (line, line - 1), "TPL008"):
+                continue
+            out.append(LintFinding(
+                "TPL008", rel, line,
+                f"debug surface `{path_lit}` is dispatched on but "
+                f"absent from metrics.DEBUG_ENDPOINTS — the /debug "
+                f"index won't list it and tpu-doctor bundles won't "
+                f"collect it",
+                key=path_lit,
+            ))
+        if full_repo:
+            obs = scan.doc_text("observability.md", docs_dir)
+            for k, rel, line in key_sites:
+                if k not in obs:
+                    out.append(LintFinding(
+                        "TPL008", rel, line,
+                        f"DEBUG_ENDPOINTS key `{k}` is not documented "
+                        f"in docs/observability.md",
+                        key=f"doc:{k}",
+                    ))
+
+    # Inline suppressions for the AST rules (doc rules handled above).
+    filtered: List[LintFinding] = []
+    for f in out:
+        ap = abs_by_rel.get(f.path)
+        if ap and _suppressed(ap, (f.line, f.line - 1), f.rule):
+            continue
+        filtered.append(f)
+    filtered.sort(key=lambda f: (f.path, f.line, f.rule))
+    return filtered

@@ -453,6 +453,107 @@ def thread_liveness_invariant() -> Invariant:
     )
 
 
+def check_lock_order() -> List[Finding]:
+    """The runtime lockdep graph (utils/profiling.LockdepGraph, fed
+    by every TimedLock acquire when --lockdep/TPU_LOCKDEP is on) must
+    hold NO inversion cycle: two threads that ever acquire the same
+    locks in opposite orders are one unlucky interleaving from a
+    deadlock, and unlike the deadlock itself the inversion is
+    detectable while both call sites still work. CRITICAL because the
+    fix is a code change, not a restart — the finding stands (the
+    witness stacks stay in /debug/lockdep) until the daemon restarts
+    with the ordering fixed."""
+    out: List[Finding] = []
+    for cyc in profiling.LOCKDEP.cycles():
+        out.append(Finding.make(
+            "lock_order", CRITICAL,
+            f"lock-order inversion {' -> '.join(cyc['nodes'])}: "
+            f"these locks have been acquired in opposite orders by "
+            f"different threads — witness stacks at /debug/lockdep",
+            chip=cyc["id"],
+            nodes=" -> ".join(cyc["nodes"]),
+            witnesses=len(cyc["witnesses"]),
+            first_seen_ts=cyc["ts"],
+        ))
+    return out
+
+
+def lock_order_invariant() -> Invariant:
+    return Invariant(
+        "lock_order",
+        ("threads", "locks"),
+        "the runtime lock-order graph must be acyclic: an inversion "
+        "cycle (same locks, opposite orders, different threads) is a "
+        "deadlock one interleaving away — critical, with witness "
+        "stacks kept at /debug/lockdep",
+        check_lock_order,
+    )
+
+
+# Cached static loop inventory (one AST pass over the package; the
+# analysis scanner is the same source of truth tpu-lint uses).
+_STATIC_LOOPS: Optional[Tuple[Set[str], Set[str]]] = None
+
+
+def _static_loop_inventory() -> Tuple[Set[str], Set[str]]:
+    global _STATIC_LOOPS
+    if _STATIC_LOOPS is None:
+        from .analysis import registry_scan
+
+        _STATIC_LOOPS = registry_scan.heartbeat_names()
+    return _STATIC_LOOPS
+
+
+def check_loop_inventory() -> List[Finding]:
+    """Every heartbeat registered at runtime must be statically
+    discoverable (a literal — or literal-prefixed — loop name at a
+    ``HEARTBEATS.register``/``supervised`` call site). The other half
+    of closing the static/runtime gap: tpu-lint's
+    loop-without-heartbeat rule can only protect loops it can SEE, so
+    a dynamically-named loop the scanner cannot attribute is itself a
+    WARNING — name it with a literal (or a literal prefix) so the
+    linter, the watchdog gauge, and the runbooks all agree on what
+    the loop is called."""
+    from .analysis import registry_scan
+
+    exact, prefixes = _static_loop_inventory()
+    out: List[Finding] = []
+    for hb in profiling.HEARTBEATS.snapshot():
+        name = hb["name"]
+        if not registry_scan.loop_name_known(name, exact, prefixes):
+            out.append(Finding.make(
+                "loop_inventory", WARNING,
+                f"runtime heartbeat '{name}' is not in the static "
+                f"loop inventory (no literal name at any "
+                f"HEARTBEATS.register/supervised call site) — "
+                f"tpu-lint cannot check a loop it cannot see",
+                chip=name,
+                loop=name,
+            ))
+    return out
+
+
+def loop_inventory_invariant() -> Invariant:
+    return Invariant(
+        "loop_inventory",
+        ("threads", "heartbeats", "static-analysis"),
+        "every runtime-registered heartbeat must be statically "
+        "discoverable by the tpu-lint loop scanner (a literal or "
+        "literal-prefixed name) — a loop the linter cannot see is a "
+        "loop its supervision rules cannot protect",
+        check_loop_inventory,
+    )
+
+
+def shared_invariants() -> List[Invariant]:
+    """The process-health invariant set both daemons carry."""
+    return [
+        thread_liveness_invariant(),
+        lock_order_invariant(),
+        loop_inventory_invariant(),
+    ]
+
+
 # ---------------------------------------------------------------------------
 # Node-side invariants (plugin daemon)
 # ---------------------------------------------------------------------------
@@ -542,7 +643,7 @@ class NodeAudit:
                 "longer knows is leaked capacity",
                 self.check_orphaned_chips,
             ),
-            thread_liveness_invariant(),
+            *shared_invariants(),
         ]
 
     # -- shared facts ------------------------------------------------------
@@ -958,7 +1059,7 @@ class ExtenderAudit:
             # Only when some plane is wired: a zero-plane ExtenderAudit
             # must stay zero-invariant so the entrypoint's refuse-to-
             # start-auditing-nothing guard keeps holding.
-            out.append(thread_liveness_invariant())
+            out.extend(shared_invariants())
         return out
 
     # -- shared facts ------------------------------------------------------

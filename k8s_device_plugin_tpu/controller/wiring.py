@@ -19,6 +19,7 @@ from ..api import constants
 from ..kube.client import KubeClient, KubeError, rfc3339_now
 from ..topology.mesh import IciMesh
 from ..topology.schema import NodeTopology
+from ..utils import profiling
 from ..utils.resilience import Backoff, delay_for_attempt
 from .controller import Controller
 from ..utils.logging import get_logger
@@ -121,7 +122,9 @@ class TopologyPublisher:
         # Last-written TPUChipsHealthy state (publish_tpu_condition cache).
         self._condition_cache: dict = {}
         self._thread = threading.Thread(
-            target=self._run, name="topology-publisher", daemon=True
+            target=profiling.supervised("topology_publisher", self._run),
+            name="topology-publisher",
+            daemon=True,
         )
 
     def start(self) -> None:
@@ -171,7 +174,17 @@ class TopologyPublisher:
 
     def _run(self) -> None:
         backoff = Backoff(base=1.0, max_delay=30.0)
+        # One healthy iteration legitimately spans the idle heartbeat
+        # wait plus a full retry backoff; the threshold covers both.
+        hb = profiling.HEARTBEATS.register(
+            "topology_publisher",
+            interval_s=self.heartbeat_s,
+            max_silence_s=(
+                profiling.default_max_silence(self.heartbeat_s) + 30.0
+            ),
+        )
         while not self._stop.is_set():
+            hb.beat()
             # Timed wait = heartbeat: an idle node still republishes every
             # heartbeat_s, advancing the condition's lastHeartbeatTime so
             # tooling can treat a STALE heartbeat as "plugin dead, health

@@ -41,7 +41,7 @@ from ..api.grpc_defs import (
 from ..api import pluginregistration_pb2 as regpb
 from ..kube.client import KubeError
 from ..server import plugin as plugin_mod
-from ..utils import metrics
+from ..utils import metrics, profiling
 from . import cdi, slices
 from ..utils.logging import get_logger
 
@@ -493,7 +493,9 @@ class DraDriver(DraPluginServicer):
         if self.client is not None:
             self._stop_pub.clear()
             self._pub_thread = threading.Thread(
-                target=self._publisher_loop,
+                target=profiling.supervised(
+                    "dra_slice_publisher", self._publisher_loop
+                ),
                 name="dra-slice-publisher",
                 daemon=True,
             )
@@ -519,7 +521,18 @@ class DraDriver(DraPluginServicer):
     def _publisher_loop(self) -> None:
         backoff = 2.0
         need_publish = True
+        # An iteration spans the resync wait plus (on publish failure)
+        # one capped retry backoff; the threshold covers both.
+        hb = profiling.HEARTBEATS.register(
+            "dra_slice_publisher",
+            interval_s=self.resync_interval_s,
+            max_silence_s=(
+                profiling.default_max_silence(self.resync_interval_s)
+                + 60.0
+            ),
+        )
         while not self._stop_pub.is_set():
+            hb.beat()
             if need_publish:
                 try:
                     self.publish()

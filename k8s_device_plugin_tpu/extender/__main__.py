@@ -212,6 +212,17 @@ def main() -> int:
         "--capture-dir set)",
     )
     p.add_argument(
+        "--lockdep", action="store_true",
+        default=os.environ.get("TPU_LOCKDEP", "").lower()
+        in ("1", "true", "on"),
+        help="record the runtime lock-order graph "
+        "(utils/profiling.LockdepGraph; also TPU_LOCKDEP=1): every "
+        "TimedLock acquire feeds per-thread held-lock edges, an "
+        "inversion cycle (deadlock one interleaving away) fires the "
+        "CRITICAL lock_order audit invariant with witness stacks at "
+        "/debug/lockdep. Always on in the test suite; opt-in here",
+    )
+    p.add_argument(
         "--log-json", action="store_true",
         help="JSON-lines logging with trace correlation "
         "(also TPU_LOG_JSON=1)",
@@ -245,6 +256,8 @@ def main() -> int:
 
     profiling.set_service("extender")
     profiling.enable_gc_monitor()
+    if a.lockdep:
+        profiling.LOCKDEP.enable()
     profiler = None
     if a.profile_hz > 0:
         profiler = stackprof.SamplingProfiler(

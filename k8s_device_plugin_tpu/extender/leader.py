@@ -67,7 +67,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..kube.client import KubeError, rfc3339_now
-from ..utils import metrics
+from ..utils import metrics, profiling
 from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
 
@@ -333,8 +333,14 @@ class LeaderLease:
             f"singleton lease {self.namespace}/{self.name} acquired",
             identity=self.identity,
         )
+        # Per-lease loop name: with --shards > 1 several LeaderLease
+        # instances renew in one process, and a shared heartbeat would
+        # let one wedged renew loop hide behind its siblings' beats.
+        loop_name = f"lease_renew_{self.name}"
         self._thread = threading.Thread(
-            target=self._renew_loop, name="extender-lease", daemon=True
+            target=profiling.supervised(loop_name, self._renew_loop),
+            name="extender-lease",
+            daemon=True,
         )
         self._thread.start()
         return self
@@ -387,7 +393,18 @@ class LeaderLease:
             min(self.lease_seconds / 3.0, self.renew_deadline_s / 3.0),
             0.2,
         )
+        # A renew attempt is deadline-clamped (_renew_once), so an
+        # iteration is bounded by interval + the renew budget.
+        hb = profiling.HEARTBEATS.register(
+            f"lease_renew_{self.name}",
+            interval_s=interval,
+            max_silence_s=(
+                profiling.default_max_silence(interval)
+                + self.renew_deadline_s
+            ),
+        )
         while not self._stop.wait(interval):
+            hb.beat()
             # Pre-attempt guard: a previous attempt that blocked past
             # the deadline (despite the clamps in _renew_once) must not
             # buy the loop another full attempt while the lease may

@@ -1447,6 +1447,8 @@ def profile_self_test() -> int:
         while not stop.is_set():
             sum(i * i for i in range(500))
 
+    # Self-test-local busy loop, joined below: supervision would only
+    # add teardown noise.  # tpu-lint: disable=TPL001
     t = threading.Thread(
         target=_profile_selftest_hotspot,
         name="profile-selftest",

@@ -1103,6 +1103,12 @@ def self_test() -> int:
     from ..topology.schema import NodeTopology
 
     base = tempfile.mkdtemp(prefix="tpu-shard-selftest-")
+    # Lockdep rides the self-test (ISSUE 12 acceptance): the whole
+    # two-shard admission/takeover drive runs with lock-order
+    # recording on, and a clean run must report zero inversion cycles.
+    from ..utils import profiling
+
+    profiling.LOCKDEP.enable()
     kube = _FakeKube()
     ring = ShardRing(2)
     # One standalone node + one gang per shard, names searched so the
@@ -1238,7 +1244,16 @@ def self_test() -> int:
         assert rel1 == [("default", gangs[1])], rel1
         assert gates_on(gangs[1]) == 0
         managers[0].stop()
-        print(json.dumps({"shard_self_test": "ok", "takeovers": 1}))
+        cycles = profiling.LOCKDEP.cycles()
+        assert not cycles, (
+            f"lockdep recorded lock-order inversion(s) during the "
+            f"shard self-test: {[c['nodes'] for c in cycles]}"
+        )
+        print(json.dumps({
+            "shard_self_test": "ok",
+            "takeovers": 1,
+            "lockdep_cycles": 0,
+        }))
         return 0
     finally:
         shutil.rmtree(base, ignore_errors=True)

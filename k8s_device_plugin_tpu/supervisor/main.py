@@ -138,6 +138,11 @@ class DaemonConfig:
     profile_hz: float = 0.0
     capture_dir: str = ""
     capture_p99_ms: float = 0.0
+    # Runtime lock-order (lockdep) recording: TimedLock acquires feed
+    # the process-global LockdepGraph; an inversion cycle fires the
+    # CRITICAL lock_order audit invariant with witness stacks at
+    # /debug/lockdep. Always on in the test suite; flag-gated here.
+    lockdep: bool = False
 
 
 class Daemon:
@@ -164,6 +169,8 @@ class Daemon:
 
         profiling.set_service("plugin")
         profiling.enable_gc_monitor()
+        if cfg.lockdep:
+            profiling.LOCKDEP.enable()
         self._profiler = None
         if cfg.profile_hz > 0:
             self._profiler = stackprof.SamplingProfiler(
@@ -766,6 +773,14 @@ def parse_args(argv) -> DaemonConfig:
                    help="windowed Allocate p99 threshold (ms) that "
                    "triggers a capture bundle; 0 disables the SLO "
                    "trigger (heartbeat-stall captures still fire)")
+    p.add_argument("--lockdep", action="store_true",
+                   default=os.environ.get("TPU_LOCKDEP", "").lower()
+                   in ("1", "true", "on"),
+                   help="record the runtime lock-order graph "
+                   "(utils/profiling.LockdepGraph; also "
+                   "TPU_LOCKDEP=1): inversion cycles fire the "
+                   "CRITICAL lock_order audit invariant with witness "
+                   "stacks at /debug/lockdep")
     p.add_argument("--log-json", action="store_true",
                    help="JSON-lines logging with trace correlation "
                    "(also TPU_LOG_JSON=1)")
@@ -820,6 +835,7 @@ def parse_args(argv) -> DaemonConfig:
         profile_hz=a.profile_hz,
         capture_dir=a.capture_dir,
         capture_p99_ms=a.capture_p99_ms,
+        lockdep=a.lockdep,
     )
 
 

@@ -27,6 +27,7 @@ from ..utils.inotify import (
     init_nonblocking,
     load_libc,
 )
+from ..utils import profiling
 from ..utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -59,7 +60,9 @@ class FsWatcher:
             log.warning("inotify unavailable (%s); polling %s", e, self.path)
             target = self._run_polling
         self._thread = threading.Thread(
-            target=target, name="fs-watcher", daemon=True
+            target=profiling.supervised("fs_watcher", target),
+            name="fs-watcher",
+            daemon=True,
         )
         self._thread.start()
 
@@ -86,7 +89,9 @@ class FsWatcher:
         self._fd = fd
 
     def _run_inotify(self) -> None:
+        hb = profiling.HEARTBEATS.register("fs_watcher", interval_s=0.5)
         while not self._stop.is_set():
+            hb.beat()
             r, _, _ = select.select([self._fd], [], [], 0.5)
             if not r:
                 continue
@@ -125,7 +130,11 @@ class FsWatcher:
 
     def _run_polling(self, interval: float = 1.0) -> None:
         prev = self._snapshot()
+        hb = profiling.HEARTBEATS.register(
+            "fs_watcher", interval_s=interval
+        )
         while not self._stop.wait(interval):
+            hb.beat()
             cur = self._snapshot()
             for name in cur:
                 # A recreated file (new inode) counts as a create: that is

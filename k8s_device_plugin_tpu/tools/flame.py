@@ -275,6 +275,8 @@ def self_test() -> int:
         while not stop.is_set():
             sum(i * i for i in range(500))
 
+    # Self-test-local busy loop, joined below: supervision would only
+    # add teardown noise.  # tpu-lint: disable=TPL001
     t = threading.Thread(
         target=_flame_selftest_spin, name="flame-selftest", daemon=True
     )

@@ -5,6 +5,8 @@ from __future__ import annotations
 import threading
 from http.server import ThreadingHTTPServer
 from typing import Optional, Type
+
+from . import profiling
 from .logging import get_logger
 
 log = get_logger(__name__)
@@ -28,8 +30,16 @@ class BackgroundHTTPServer:
 
     def start(self) -> str:
         self._httpd = ThreadingHTTPServer(self._address, self.handler_class())
+        # Supervised so a serve_forever that dies (a raising
+        # socketserver internal, an OOM-killed accept) marks a dead
+        # heartbeat and trips thread_liveness instead of leaving a
+        # silently connection-refusing daemon. Per-class name: one
+        # process runs several servers (metrics + extender HTTP).
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
+            target=profiling.supervised(
+                f"http_{type(self).__name__}",
+                self._httpd.serve_forever,
+            ),
             name=type(self).__name__,
             daemon=True,
         )

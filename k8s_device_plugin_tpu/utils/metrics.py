@@ -503,6 +503,20 @@ PROFILE_CAPTURES = REGISTRY.counter(
     "stall_<loop>) and outcome (ok/budget/error) — "
     "utils/profiling.CaptureManager writing to --capture-dir",
 )
+LOCKDEP_EDGES = REGISTRY.gauge(
+    "tpu_lockdep_edges",
+    "Distinct lock-order edges (lock A held while acquiring lock B) "
+    "recorded by the runtime lockdep graph "
+    "(utils/profiling.LockdepGraph; --lockdep/TPU_LOCKDEP, always on "
+    "in tests) — a growing edge set is normal, a cycle is not",
+)
+LOCKDEP_CYCLES = REGISTRY.counter(
+    "tpu_lockdep_cycles_total",
+    "Lock-order inversion cycles detected (two threads acquired the "
+    "same locks in opposite orders — a deadlock one interleaving "
+    "away); witness stacks are kept in the graph and the lock_order "
+    "audit invariant pages CRITICAL while any cycle stands",
+)
 BUILD_INFO = REGISTRY.gauge(
     "tpu_build_info",
     "Always 1; the labels are the point: version (the package "
@@ -855,6 +869,16 @@ EXT_PROFILE_CAPTURES = EXTENDER_REGISTRY.counter(
     "(ok/budget/error) — utils/profiling.CaptureManager writing to "
     "--capture-dir",
 )
+EXT_LOCKDEP_EDGES = EXTENDER_REGISTRY.gauge(
+    "tpu_lockdep_edges",
+    "Distinct lock-order edges recorded by the runtime lockdep graph "
+    "(utils/profiling.LockdepGraph; --lockdep/TPU_LOCKDEP)",
+)
+EXT_LOCKDEP_CYCLES = EXTENDER_REGISTRY.counter(
+    "tpu_lockdep_cycles_total",
+    "Lock-order inversion cycles detected; the lock_order audit "
+    "invariant pages CRITICAL while any cycle stands",
+)
 
 
 def set_build_info(component: str) -> None:
@@ -951,6 +975,12 @@ DEBUG_ENDPOINTS: Dict[str, str] = {
         "hold overlay (extender: not configured when --shards is 1; "
         "plugin: not configured)"
     ),
+    "/debug/lockdep": (
+        "runtime lock-order graph (utils/profiling.LockdepGraph; "
+        "--lockdep/TPU_LOCKDEP): recorded edges and any inversion "
+        "cycles with their witness stacks — enabled: false when the "
+        "flag is off"
+    ),
 }
 
 # () -> dict readiness snapshot (extender/server.py ReadyStatus),
@@ -1018,6 +1048,10 @@ def debug_payload(path: str) -> Optional[bytes]:
                     "process (extender --shards > 1 installs it)",
                 }
             return SHARD_PROVIDER()
+        if parsed.path == "/debug/lockdep":
+            from . import profiling
+
+            return profiling.LOCKDEP.snapshot()
         if parsed.path == "/debug/profile":
             from . import profiling, stackprof
 

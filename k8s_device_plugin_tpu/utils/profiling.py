@@ -345,8 +345,15 @@ class StallWatchdog:
 
     def start(self) -> "StallWatchdog":
         self._stop.clear()
+        # The watchdog is itself supervised and heartbeated: a dead
+        # watchdog froze EVERY heartbeat age gauge at its last export
+        # with nothing to notice — the audit sweep (its own thread)
+        # reads HEARTBEATS directly, so a dead/silent watchdog now
+        # trips thread_liveness like any other loop.
         self._thread = threading.Thread(
-            target=self._run, name="stall-watchdog", daemon=True
+            target=supervised("stall_watchdog", self._run),
+            name="stall-watchdog",
+            daemon=True,
         )
         self._thread.start()
         return self
@@ -358,7 +365,11 @@ class StallWatchdog:
             self._thread = None
 
     def _run(self) -> None:
+        hb = HEARTBEATS.register(
+            "stall_watchdog", interval_s=self.check_interval_s
+        )
         while not self._stop.wait(self.check_interval_s):
+            hb.beat()
             try:
                 self.check_once()
             except Exception:  # noqa: BLE001 — the watchdog survives
@@ -484,6 +495,308 @@ def disable_gc_monitor() -> None:
     _gc_start.clear()
 
 
+# -- lock-order (lockdep) race detection -------------------------------------
+
+
+class LockdepGraph:
+    """Runtime lock-order graph: inversion cycles without a deadlock.
+
+    Every :class:`TimedLock` acquire/release (when enabled) maintains a
+    per-thread held-lock list; acquiring lock B while holding lock A
+    records the directed edge A→B with a WITNESS STACK the first time
+    the edge is seen. An edge that closes a cycle (some thread
+    previously recorded B→…→A) is the Linux-lockdep insight: the
+    deadlock does not need to HAPPEN — two threads that ever take the
+    same locks in opposite orders are one unlucky interleaving from
+    one, and the proof (both witness stacks) is captured while both
+    call sites are easy to find.
+
+    Nodes are per-INSTANCE (``name@id``), never per-name: every
+    ReservationTable lock is named ``reservations``, and name-keyed
+    edges would mint false self-cycles the moment two tables are ever
+    held together (the sharded extender holds several legitimately).
+
+    Exported as ``tpu_lockdep_edges`` / ``tpu_lockdep_cycles_total``
+    and swept by the ``lock_order`` audit invariant (CRITICAL on any
+    cycle). Always-on in the test suite (tests/conftest.py) and the
+    extender self-tests; flag-gated in production (``--lockdep`` /
+    ``TPU_LOCKDEP`` — the bookkeeping costs a TLS list op per acquire
+    and a graph-lock touch per NEW edge). Cycles never self-clear:
+    an inversion is a property of the code, not of the moment — only
+    :meth:`reset` (tests) or a restart clears it."""
+
+    MAX_EDGES = 4096
+    MAX_CYCLES = 64
+    WITNESS_FRAMES = 16
+
+    def __init__(self):
+        self.enabled = False
+        self._glock = threading.Lock()
+        self._tls = threading.local()
+        # node -> the per-thread held list it currently sits in, so a
+        # lock RELEASED by a different thread than acquired it (legal
+        # for Lock semantics TimedLock mirrors) still leaves that
+        # thread's held set — a phantom "held" node would mint false
+        # edges and eventually a false cycle. _hlock serializes
+        # RELEASES only (two concurrent cross-thread releases from
+        # one list would race the scan+del); acquires are lock-free
+        # (see note_acquire). Never held together with _glock.
+        self._hlock = threading.Lock()
+        self._holders: Dict[str, List[str]] = {}
+        # (a, b) node pair -> {"stack", "thread", "count"}
+        self._edges: Dict[tuple, dict] = {}
+        # adjacency: node -> set(successors)
+        self._succ: Dict[str, Set[str]] = {}
+        self._cycles: List[dict] = []
+        self._cycle_keys: Set[frozenset] = set()
+        self._dropped_edges = 0
+        self._dropped_cycles = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def enable(self) -> "LockdepGraph":
+        self.enabled = True
+        return self
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._hlock:
+            self._holders.clear()
+        with self._glock:
+            self._edges.clear()
+            self._succ.clear()
+            self._cycles.clear()
+            self._cycle_keys.clear()
+            self._dropped_edges = 0
+            self._dropped_cycles = 0
+
+    # -- hot path ----------------------------------------------------------
+
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquire(self, name: str, obj_id: int) -> None:
+        # Lock-free on purpose — this runs on every TimedLock acquire
+        # of the RPC hot path when the flag is on. Safe under the GIL:
+        # the held list is this thread's own (appends land at the
+        # end; a concurrent cross-thread release only deletes earlier
+        # elements), list()/append/dict-set are each atomic, and one
+        # node's acquire/release can never overlap (the real lock
+        # serializes them).
+        node = f"{name}@{obj_id:x}"
+        held = self._held()
+        prevs = list(held)
+        held.append(node)
+        self._holders[node] = held
+        if prevs:
+            import traceback
+
+            for prev in prevs:
+                self._add_edge(prev, node, traceback)
+
+    def note_release(self, name: str, obj_id: int) -> None:
+        node = f"{name}@{obj_id:x}"
+        with self._hlock:
+            # The holders map finds the ACQUIRING thread's list even
+            # when another thread releases (legal for Lock); without
+            # it the acquirer's held set would keep a phantom node
+            # minting false edges — and eventually a false cycle.
+            held = self._holders.pop(node, None)
+            if held is None:
+                held = self._held()  # synthetic double-acquire case
+            # Remove the LAST occurrence: releases normally unwind
+            # LIFO, but out-of-order release is legal and must not
+            # corrupt the held set.
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == node:
+                    del held[i]
+                    return
+
+    # -- graph maintenance (under _glock) ----------------------------------
+
+    def _add_edge(self, a: str, b: str, traceback_mod) -> None:
+        # a == b (re-acquiring a held non-reentrant lock) IS the
+        # deadlock, not a risk of one; it records as a one-edge cycle.
+        info = self._edges.get((a, b))
+        if info is not None:
+            # Known edge: no graph lock. The racy += can drop a count
+            # under contention — the count is diagnostic color, and
+            # losing one beats convoying every nested acquire of the
+            # two hot locks through _glock.
+            info["count"] += 1
+            return
+        with self._glock:
+            info = self._edges.get((a, b))
+            if info is not None:
+                info["count"] += 1
+                return
+            if len(self._edges) >= self.MAX_EDGES:
+                self._dropped_edges += 1
+                return
+            stack = "".join(
+                traceback_mod.format_stack(limit=self.WITNESS_FRAMES)
+            )
+            self._edges[(a, b)] = {
+                "stack": stack,
+                "thread": threading.current_thread().name,
+                "count": 1,
+            }
+            self._succ.setdefault(a, set()).add(b)
+            # Self-edge (a == b) falls out naturally: the DFS returns
+            # the trivial path [a], making the cycle [a, a].
+            cycle_path = self._path_locked(b, a)
+            self._export_edges()
+            if cycle_path is None:
+                return
+            # cycle_path is b→…→a; the new edge a→b closes it.
+            nodes = [a] + cycle_path
+            self._record_cycle_locked(nodes)
+
+    def _path_locked(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS src→dst through recorded edges; the node path
+        [src, ..., dst] or None."""
+        stack: List[tuple] = [(src, [src])]
+        seen: Set[str] = set()
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self._succ.get(node, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def _record_cycle_locked(self, nodes: List[str]) -> None:
+        edge_pairs = frozenset(
+            (nodes[i], nodes[i + 1]) for i in range(len(nodes) - 1)
+        )
+        if edge_pairs in self._cycle_keys:
+            return
+        self._cycle_keys.add(edge_pairs)
+        if len(self._cycles) >= self.MAX_CYCLES:
+            # Witness RETENTION is bounded; the signal is not — a
+            # 65th genuinely new inversion still counts, logs, and
+            # flight-records (it just isn't individually pageable at
+            # /debug/lockdep; dropped_cycles says so).
+            self._dropped_cycles += 1
+            try:
+                self._cycles_fam().inc()
+            except Exception:  # noqa: BLE001 — never fail an acquire
+                pass
+            log.error(
+                "lockdep: lock-order inversion %s (witness retention "
+                "full at %d cycles — counted but not stored)",
+                " -> ".join(nodes), self.MAX_CYCLES,
+            )
+            try:
+                from .flightrecorder import RECORDER
+
+                RECORDER.record(
+                    "lockdep_cycle",
+                    f"lock-order inversion (retention full): "
+                    f"{' -> '.join(nodes)}",
+                    nodes=" -> ".join(nodes),
+                    stored=False,
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        witnesses = []
+        for pair in sorted(edge_pairs):
+            info = self._edges.get(tuple(pair))
+            if info is not None:
+                witnesses.append({
+                    "edge": f"{pair[0]} -> {pair[1]}",
+                    "thread": info["thread"],
+                    "stack": info["stack"],
+                })
+        cyc = {
+            "id": f"cycle-{len(self._cycles)}",
+            "nodes": list(nodes),
+            "ts": round(time.time(), 3),
+            "witnesses": witnesses,
+        }
+        self._cycles.append(cyc)
+        try:
+            self._cycles_fam().inc()
+        except Exception:  # noqa: BLE001 — never fail an acquire
+            pass
+        log.error(
+            "lockdep: lock-order inversion %s — two threads acquire "
+            "these locks in opposite orders; witness stacks kept "
+            "(audit invariant lock_order will page)",
+            " -> ".join(nodes),
+        )
+        try:
+            from .flightrecorder import RECORDER
+
+            RECORDER.record(
+                "lockdep_cycle",
+                f"lock-order inversion: {' -> '.join(nodes)}",
+                nodes=" -> ".join(nodes),
+                witnesses=len(witnesses),
+            )
+        except Exception:  # noqa: BLE001 — reporting must not re-raise
+            pass
+
+    def _fams(self):
+        from . import metrics
+
+        if _SERVICE == "extender":
+            return metrics.EXT_LOCKDEP_EDGES, metrics.EXT_LOCKDEP_CYCLES
+        return metrics.LOCKDEP_EDGES, metrics.LOCKDEP_CYCLES
+
+    def _cycles_fam(self):
+        return self._fams()[1]
+
+    def _export_edges(self) -> None:
+        try:
+            self._fams()[0].set(len(self._edges))
+        except Exception:  # noqa: BLE001 — never fail an acquire
+            pass
+
+    # -- reads -------------------------------------------------------------
+
+    def cycles(self) -> List[dict]:
+        with self._glock:
+            return [dict(c) for c in self._cycles]
+
+    def snapshot(self) -> dict:
+        """The /debug/lockdep payload: full graph + cycles with
+        witness stacks."""
+        with self._glock:
+            return {
+                "enabled": self.enabled,
+                "edges": [
+                    {
+                        "from": a, "to": b,
+                        "count": info["count"],
+                        "thread": info["thread"],
+                    }
+                    for (a, b), info in sorted(self._edges.items())
+                ],
+                "dropped_edges": self._dropped_edges,
+                "dropped_cycles": self._dropped_cycles,
+                "cycles": [dict(c) for c in self._cycles],
+            }
+
+
+# One per process, like CAPTURE / HEARTBEATS.
+LOCKDEP = LockdepGraph()
+
+# TimedLock lockdep-node serials (see TimedLock.__init__).
+import itertools as _itertools
+
+_LOCK_SERIALS = _itertools.count(1)
+
+
 # -- lock-wait instrumentation ----------------------------------------------
 
 
@@ -499,13 +812,27 @@ class TimedLock:
     ReservationTable is invisible to every other instrument (the RPC
     histogram shows the total, never names the lock)."""
 
-    def __init__(self, name: str, histogram=None):
+    def __init__(self, name: str, histogram=None, lockdep=None):
         self.name = name
         self._histogram = histogram
+        # Tests wire a private LockdepGraph so a SEEDED inversion never
+        # poisons the process-global graph the suite asserts clean.
+        self._lockdep = lockdep
+        # Lockdep node identity: a monotonic serial, NOT id(self) — a
+        # collected lock's id can be reused by a new instance, and a
+        # conflated node could stitch two unrelated orderings into a
+        # false cycle over a long run.
+        self._serial = next(_LOCK_SERIALS)
         self._lock = threading.Lock()
+
+    def _dep(self) -> "LockdepGraph":
+        return self._lockdep if self._lockdep is not None else LOCKDEP
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         if self._lock.acquire(False):
+            dep = self._dep()
+            if dep.enabled:
+                dep.note_acquire(self.name, self._serial)
             return True
         if not blocking:
             return False
@@ -517,9 +844,16 @@ class TimedLock:
                 h.observe(time.perf_counter() - t0, lock=self.name)
             except Exception:  # noqa: BLE001 — never fail an acquire
                 pass
+        if ok:
+            dep = self._dep()
+            if dep.enabled:
+                dep.note_acquire(self.name, self._serial)
         return ok
 
     def release(self) -> None:
+        dep = self._dep()
+        if dep.enabled:
+            dep.note_release(self.name, self._serial)
         self._lock.release()
 
     def locked(self) -> bool:

@@ -352,6 +352,9 @@ class Resilience:
             # write must happen off-thread or a slow volume would
             # stall every kube-calling thread exactly when the
             # apiserver is already down.
+            # One-shot dump, not a loop: supervision would add a died
+            # counter for a best-effort write that already logs its own
+            # failure.  # tpu-lint: disable=TPL001
             threading.Thread(
                 target=RECORDER.dump_on,
                 args=("circuit-break",),

@@ -46,6 +46,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from . import profiling
 from .logging import get_logger
 
 log = get_logger(__name__)
@@ -134,7 +135,9 @@ class SamplingProfiler:
         self._stop.clear()
         self._started_ts = time.time()
         self._thread = threading.Thread(
-            target=self._run, name="stack-sampler", daemon=True
+            target=profiling.supervised("stack_sampler", self._run),
+            name="stack-sampler",
+            daemon=True,
         )
         self._thread.start()
         return self
@@ -157,7 +160,11 @@ class SamplingProfiler:
             "sampling profiler started: %.1f Hz, %d-stack table, "
             "%.0fs ring", self.hz, self.max_stacks, self.ring_s,
         )
+        hb = profiling.HEARTBEATS.register(
+            "stack_sampler", interval_s=self.interval_s
+        )
         while not self._stop.wait(self.interval_s):
+            hb.beat()
             if self._pause.is_set():
                 continue
             try:

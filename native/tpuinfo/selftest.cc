@@ -15,8 +15,11 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <fstream>
 #include <string>
+#include <thread>
+#include <vector>
 
 static int failures = 0;
 
@@ -248,6 +251,43 @@ int main() {
   CHECK((tel.fields & TPUINFO_TELEM_DUTY) != 0);
   CHECK(tel.duty_cycle_pct == 12.0);
   CHECK(tpuinfo_vfio_chip_telemetry(groups.c_str(), 99, &tel) == -ENOENT);
+
+  /* Threaded telemetry reads (the TSan leg, ISSUE 12): the sampler
+   * thread and an HTTP burst handler can read telemetry concurrently
+   * in the Python daemon, so the walk must be reentrant and share no
+   * hidden mutable state. Four reader threads hammer the sysfs and
+   * vfio entry points while the main thread rewrites the backing
+   * attribute files; under -fsanitize=thread any shared static in
+   * the parse path is a reported race, not a latent bug. A reader
+   * racing a rewrite may legitimately see a torn/empty attribute —
+   * that clears the field bit, it never crashes or returns an error
+   * for a chip whose device dir exists. */
+  {
+    std::atomic<int> bad_rc{0};
+    std::vector<std::thread> readers;
+    for (int t = 0; t < 4; ++t) {
+      readers.emplace_back([&, t]() {
+        tpuinfo_chip_telemetry_t local;
+        for (int i = 0; i < 200; ++i) {
+          int chip = (t % 2 == 0) ? 3 : 0;
+          if (tpuinfo_chip_telemetry(accel.c_str(), chip, &local) != 1)
+            bad_rc.fetch_add(1);
+          if (tpuinfo_vfio_chip_telemetry(groups.c_str(), 10, &local) != 1)
+            bad_rc.fetch_add(1);
+        }
+      });
+    }
+    for (int i = 0; i < 200; ++i) {
+      WriteFile(accel + "/accel3/device/duty_cycle_pct",
+                i % 2 ? "50\n" : "75\n");
+      WriteFile(accel + "/accel3/device/hbm_used_bytes",
+                i % 2 ? "1024\n" : "garbled\n");
+      WriteFile(groups + "/10/devices/0000:00:04.0/duty_cycle_pct",
+                i % 2 ? "10\n" : "90\n");
+    }
+    for (auto& th : readers) th.join();
+    CHECK(bad_rc.load() == 0);
+  }
 
   /* NULL-argument contract. */
   CHECK(tpuinfo_scan(nullptr, dev.c_str(), chips, 4) == -EINVAL);

@@ -75,4 +75,19 @@ python -m k8s_device_plugin_tpu.tools.flame --self-test > /dev/null \
 # export, the bundle layout, and the renderer fails CI here.
 python -m k8s_device_plugin_tpu.extender.scale_bench --profile-self-test > /dev/null \
   || { echo "scale_bench --profile-self-test FAILED"; exit 1; }
+# Static-analysis engine smoke: every tpu-lint rule must detect its
+# embedded seeded violation (and stay quiet on the clean twin), the
+# registry scanner's inventories must be non-empty, and the static
+# metric inventory must equal the runtime registries (tools/lint.py
+# --self-test) — a rule or scanner-pattern drift fails CI here, with
+# the rule id named, before the pytest gate.
+python -m k8s_device_plugin_tpu.tools.lint --self-test > /dev/null \
+  || { echo "tools/lint.py --self-test FAILED"; exit 1; }
+# Repo lint gate: zero NEW findings (baseline'd exceptions carry
+# justifications in analysis/baseline.json) — an unsupervised thread,
+# an undocumented metric/kind/span/debug-endpoint, blocking work
+# under a hot lock, or a bare except fails CI here (docs/analysis.md
+# has the rule table and the suppression syntax).
+python -m k8s_device_plugin_tpu.tools.lint \
+  || { echo "tpu-lint repo scan FAILED (new findings above)"; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -55,6 +55,42 @@ def pytest_configure(config):
         "run explicitly for full-scale proofs (e.g. the 5,000-node "
         "control-plane bench)",
     )
+    # Runtime lockdep is ALWAYS on under the test suite (ISSUE 12):
+    # every TimedLock acquire across every test feeds the process-
+    # global lock-order graph, and pytest_sessionfinish below fails
+    # the run if any inversion cycle was recorded. Tests that SEED an
+    # inversion on purpose use a private LockdepGraph so the global
+    # one stays a clean-run assertion.
+    from k8s_device_plugin_tpu.utils import profiling
+
+    profiling.LOCKDEP.enable()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The suite-wide lock-order gate: a clean run of the full suite
+    must record NO inversion cycle in the global lockdep graph."""
+    from k8s_device_plugin_tpu.utils import profiling
+
+    cycles = profiling.LOCKDEP.cycles()
+    if cycles:
+        rep = session.config.pluginmanager.get_plugin(
+            "terminalreporter"
+        )
+        for cyc in cycles:
+            msg = (
+                f"LOCKDEP: lock-order inversion recorded during the "
+                f"suite: {' -> '.join(cyc['nodes'])}"
+            )
+            if rep is not None:
+                rep.write_line(msg, red=True)
+                for w in cyc["witnesses"]:
+                    rep.write_line(
+                        f"  witness [{w['thread']}] {w['edge']}:\n"
+                        f"{w['stack']}"
+                    )
+            else:  # pragma: no cover - no terminal reporter
+                print(msg)
+        session.exitstatus = 1
 
 
 _GUARDED_THREADS = ("pod-informer", "pod-worker", "topology-publisher")

@@ -1,0 +1,9 @@
+"""Violates TPL001: an unsupervised thread target."""
+import threading
+
+
+def loop():
+    pass
+
+
+t = threading.Thread(target=loop, daemon=True)  # LINT-EXPECT: TPL001

@@ -1,0 +1,14 @@
+"""Clean twin for TPL001: the target is supervised."""
+import threading
+
+from k8s_device_plugin_tpu.utils import profiling
+
+
+def loop():
+    pass
+
+
+t = threading.Thread(
+    target=profiling.supervised("fixture_loop", loop),
+    daemon=True,
+)

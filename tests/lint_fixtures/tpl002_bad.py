@@ -1,0 +1,15 @@
+"""Violates TPL002: a supervised long-lived loop with no heartbeat."""
+import threading
+
+from k8s_device_plugin_tpu.utils import profiling
+
+
+def loop():  # LINT-EXPECT: TPL002
+    while True:
+        pass
+
+
+t = threading.Thread(
+    target=profiling.supervised("fixture_loop", loop),
+    daemon=True,
+)

@@ -1,0 +1,16 @@
+"""Clean twin for TPL002: the loop registers and beats a heartbeat."""
+import threading
+
+from k8s_device_plugin_tpu.utils import profiling
+
+
+def loop():
+    hb = profiling.HEARTBEATS.register("fixture_loop", interval_s=1.0)
+    while True:
+        hb.beat()
+
+
+t = threading.Thread(
+    target=profiling.supervised("fixture_loop", loop),
+    daemon=True,
+)

@@ -1,0 +1,11 @@
+"""Violates TPL003: a registered family absent from docs/metrics.md.
+
+The receiver only needs to END with REGISTRY for the scanner; the
+stand-in is never executed (the engine parses, it does not import).
+"""
+FIXTURE_REGISTRY = None
+
+BOGUS = FIXTURE_REGISTRY.counter(  # LINT-EXPECT: TPL003
+    "tpu_fixture_never_documented_total",
+    "a family no doc will ever carry",
+)

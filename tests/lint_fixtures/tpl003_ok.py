@@ -1,0 +1,7 @@
+"""Clean twin for TPL003: the registered family has a doc row."""
+FIXTURE_REGISTRY = None
+
+OK = FIXTURE_REGISTRY.gauge(
+    "tpu_build_info",
+    "documented in docs/metrics.md",
+)

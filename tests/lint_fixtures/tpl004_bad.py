@@ -1,0 +1,7 @@
+"""Violates TPL004: a flight-recorder kind missing from the docs."""
+RECORDER = None
+
+RECORDER.record(  # LINT-EXPECT: TPL004
+    "fixture_never_documented_kind",
+    "a kind the observability table will never carry",
+)

@@ -1,0 +1,4 @@
+"""Clean twin for TPL004: a documented flight kind."""
+RECORDER = None
+
+RECORDER.record("allocate", "chips handed to a container")

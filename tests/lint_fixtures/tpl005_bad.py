@@ -1,0 +1,8 @@
+"""Violates TPL005: a decision-ledger kind missing from the docs."""
+LEDGER = None
+
+LEDGER.record(  # LINT-EXPECT: TPL005
+    "fixture_never_documented_kind",
+    "reason",
+    "a kind the ledger table will never carry",
+)

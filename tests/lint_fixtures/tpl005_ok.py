@@ -1,0 +1,4 @@
+"""Clean twin for TPL005: a documented ledger kind."""
+LEDGER = None
+
+LEDGER.record("filter_reject", "no_topology", "node rejected")

@@ -1,0 +1,10 @@
+"""Violates TPL006: blocking work inside a with-lock block."""
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def hold_and_sleep():
+    with _lock:
+        time.sleep(0.1)  # LINT-EXPECT: TPL006

@@ -1,0 +1,11 @@
+"""Clean twin for TPL006: the blocking work happens off the hold."""
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def hold_then_sleep():
+    with _lock:
+        x = 1  # noqa: F841
+    time.sleep(0.1)

@@ -1,0 +1,15 @@
+"""Violates TPL007: a bare except and a swallowed BaseException."""
+
+
+def eat_everything():
+    try:
+        pass
+    except:  # noqa: E722  LINT-EXPECT: TPL007
+        pass
+
+
+def swallow_base():
+    try:
+        pass
+    except BaseException:  # LINT-EXPECT: TPL007
+        pass

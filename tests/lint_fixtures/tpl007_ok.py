@@ -1,0 +1,15 @@
+"""Clean twin for TPL007: Exception caught; BaseException re-raised."""
+
+
+def best_effort():
+    try:
+        pass
+    except Exception:
+        pass
+
+
+def cleanup_then_reraise():
+    try:
+        pass
+    except BaseException:
+        raise

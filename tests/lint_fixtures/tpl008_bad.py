@@ -1,0 +1,7 @@
+"""Violates TPL008: a /debug path dispatched on but never indexed."""
+
+
+def debug_payload(path):
+    if path == "/debug/fixture-unlisted":  # LINT-EXPECT: TPL008
+        return {}
+    return None

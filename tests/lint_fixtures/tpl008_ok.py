@@ -1,0 +1,7 @@
+"""Clean twin for TPL008: a DEBUG_ENDPOINTS-indexed path."""
+
+
+def debug_payload(path):
+    if path == "/debug/events":
+        return {}
+    return None

@@ -1,0 +1,7 @@
+"""Violates TPL009: a span name missing from the span table."""
+tracing = None
+
+
+def traced():
+    with tracing.span("fixture.never_documented"):  # LINT-EXPECT: TPL009
+        pass

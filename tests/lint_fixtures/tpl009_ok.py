@@ -1,0 +1,7 @@
+"""Clean twin for TPL009: a documented span name."""
+tracing = None
+
+
+def traced():
+    with tracing.span("extender.filter"):
+        pass

@@ -1,0 +1,517 @@
+"""tpu-lint, the registry scanner, and runtime lockdep (ISSUE 12).
+
+Three layers under test:
+
+* the **lint engine** (`analysis/rules.py`) — one seeded-violation
+  fixture module per rule under ``tests/lint_fixtures/`` asserting the
+  exact rule id and file:line (so every rule has a test that fails
+  without it), plus suppression/baseline semantics and the repo-clean
+  gate;
+* the **registry scanner** (`analysis/registry_scan.py`) — the single
+  source of truth the doc-lockstep tests now call; its static
+  inventories must agree with the runtime registries;
+* **lockdep** (`utils/profiling.LockdepGraph`) — the acceptance
+  scenario: two TimedLocks taken in opposite orders on two threads
+  fire an inversion cycle with both witness stacks, and the
+  ``lock_order`` audit invariant pages CRITICAL on it. Seeded
+  inversions use PRIVATE graphs so the process-global graph (enabled
+  for the whole suite by conftest, asserted cycle-free at session
+  finish) stays clean.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from k8s_device_plugin_tpu import audit
+from k8s_device_plugin_tpu.analysis import registry_scan as scan
+from k8s_device_plugin_tpu.analysis import rules as R
+from k8s_device_plugin_tpu.utils import metrics, profiling
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def expected_lines(path: str, rule_id: str):
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            if f"LINT-EXPECT: {rule_id}" in line:
+                out.append(i)
+    return out
+
+
+# -- seeded violations: exact rule id + file:line ----------------------------
+
+
+@pytest.mark.parametrize("rule_id", sorted(R.RULES_BY_ID))
+def test_seeded_violation_fires_exactly(rule_id):
+    """The bad fixture produces the rule at exactly the marked lines;
+    the clean twin produces nothing. A rule that silently stops
+    matching fails here — every rule has a test that fails without
+    it."""
+    bad = fixture(f"{rule_id.lower()}_bad.py")
+    ok = fixture(f"{rule_id.lower()}_ok.py")
+    exp = expected_lines(bad, rule_id)
+    assert exp, f"fixture {bad} has no LINT-EXPECT marker"
+    findings = R.run_rules(files=[bad], rules={rule_id})
+    got = sorted((f.rule, f.line) for f in findings)
+    assert got == sorted((rule_id, ln) for ln in exp), (
+        f"{rule_id}: expected lines {exp}, got "
+        f"{[(f.line, f.message) for f in findings]}"
+    )
+    rel = os.path.relpath(bad, REPO)
+    assert all(f.path == rel for f in findings)
+    clean = R.run_rules(files=[ok], rules={rule_id})
+    assert not clean, (
+        f"{rule_id}: clean twin fired: {[f.message for f in clean]}"
+    )
+
+
+def test_rule_narrowing_does_not_leak_sibling_thread_rules():
+    """TPL001 and TPL002 share one AST walk but must respect the
+    requested rule set — a narrowed run (or --write-baseline --rules)
+    must not emit the sibling rule."""
+    bad001 = fixture("tpl001_bad.py")
+    bad002 = fixture("tpl002_bad.py")
+    assert R.run_rules(files=[bad001], rules={"TPL002"}) == []
+    assert R.run_rules(files=[bad002], rules={"TPL001"}) == []
+
+
+def test_positional_thread_target_is_checked(tmp_path):
+    """threading.Thread(group, target) — target passed positionally —
+    must not dodge TPL001."""
+    p = tmp_path / "positional.py"
+    p.write_text(
+        "import threading\n"
+        "def loop():\n"
+        "    pass\n"
+        "t = threading.Thread(None, loop)\n"
+    )
+    got = R.run_rules(files=[str(p)], rules={"TPL001"})
+    assert [f.rule for f in got] == ["TPL001"]
+
+
+def test_unknown_rule_id_is_an_error_not_a_green_scan():
+    from k8s_device_plugin_tpu.tools import lint as lint_cli
+
+    assert lint_cli.main(["--rules", "TPL999"]) == 2
+
+
+def test_lowercase_transient_registry_is_not_inventoried(tmp_path):
+    """The receiver guard is the CASE-SENSITIVE module-global
+    convention: `registry = Registry(); registry.counter(...)` in
+    bench/test code must not publish fake families (which would break
+    the static==runtime parity pin)."""
+    p = tmp_path / "bench_helper.py"
+    p.write_text(
+        "registry = None\n"
+        "X = registry.counter('tpu_bench_scratch_total', 'nope')\n"
+        "GOOD_REGISTRY = None\n"
+        "Y = GOOD_REGISTRY.counter('tpu_real_total', 'yes')\n"
+    )
+    fams = {v for v, _p, _l in scan.metric_family_sites([str(p)])}
+    assert fams == {"tpu_real_total"}
+
+
+def test_inline_suppression_silences_a_finding(tmp_path):
+    src = (
+        "import threading\n"
+        "def loop():\n"
+        "    pass\n"
+        "# short-lived by design  # tpu-lint: disable=TPL001\n"
+        "t = threading.Thread(target=loop)\n"
+    )
+    p = tmp_path / "suppressed.py"
+    p.write_text(src)
+    assert R.run_rules(files=[str(p)], rules={"TPL001"}) == []
+    # Without the comment the same shape fires.
+    p2 = tmp_path / "unsuppressed.py"
+    p2.write_text(src.replace("# short-lived by design  "
+                              "# tpu-lint: disable=TPL001\n", ""))
+    assert len(R.run_rules(files=[str(p2)], rules={"TPL001"})) == 1
+
+
+def test_baseline_matching_and_staleness():
+    f = R.LintFinding("TPL006", "pkg/x.py", 10, "msg",
+                      key="lock:self._lock->open")
+    entry = {"rule": "TPL006", "path": "pkg/x.py",
+             "key": "lock:self._lock->open", "justification": "why"}
+    new, old, stale = R.apply_baseline([f], [entry])
+    assert (new, old, stale) == ([], [f], [])
+    # Line churn must not break the match (key-based, not line-based).
+    f2 = R.LintFinding("TPL006", "pkg/x.py", 99, "msg",
+                       key="lock:self._lock->open")
+    new, old, stale = R.apply_baseline([f2], [entry])
+    assert not new and old == [f2]
+    # A fixed finding leaves its entry stale.
+    new, old, stale = R.apply_baseline([], [entry])
+    assert stale == [entry]
+
+
+def test_repo_scan_is_clean_modulo_baseline():
+    """The acceptance gate, in-process: zero non-baselined findings
+    on the current tree, and every baseline entry both justified and
+    still live (no stale rows left behind)."""
+    findings = R.run_rules()
+    baseline = R.load_baseline()
+    new, grandfathered, stale = R.apply_baseline(findings, baseline)
+    assert not new, [f.to_dict() for f in new]
+    assert not stale, stale
+    for e in baseline:
+        just = str(e.get("justification", "")).strip()
+        assert just and not just.startswith("FIXME"), e
+
+
+def test_lint_cli_self_test_and_scan():
+    """The two tier1.sh invocations, end to end."""
+    r = subprocess.run(
+        [sys.executable, "-m", "k8s_device_plugin_tpu.tools.lint",
+         "--self-test"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["lint_self_test"] == "ok"
+    assert sorted(doc["rules_proven"]) == sorted(R.RULES_BY_ID)
+    r = subprocess.run(
+        [sys.executable, "-m", "k8s_device_plugin_tpu.tools.lint",
+         "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["new"] == []
+
+
+# -- the registry scanner (the lockstep source of truth) ---------------------
+
+
+def test_scanner_inventories_are_plausible():
+    flights = {v for v, _p, _l in scan.flight_kind_sites()}
+    assert {"allocate", "loop_stall", "lockdep_cycle"} <= flights
+    ledgers = {v for v, _p, _l in scan.ledger_kind_sites()}
+    assert {"filter_reject", "gang_admitted"} <= ledgers
+    spans = {v for v, _p, _l in scan.span_name_sites()}
+    assert {"extender.filter", "gang.admit"} <= spans
+    endpoints = {v for v, _p, _l in scan.debug_endpoint_keys()}
+    assert {"/debug/events", "/debug/lockdep"} <= endpoints
+    # Every inventory carries provenance.
+    for v, p, ln in scan.flight_kind_sites():
+        assert p.endswith(".py") and ln > 0
+
+
+def test_scanner_static_metrics_equal_runtime_registries():
+    """The scanner IS what the metrics lockstep test trusts — prove
+    it can see every registration shape the registries actually
+    execute."""
+    static = {v for v, _p, _l in scan.metric_family_sites()}
+    runtime = set(metrics.REGISTRY._metrics) | set(
+        metrics.EXTENDER_REGISTRY._metrics
+    )
+    assert static == runtime
+    assert scan.uptime_families() == {
+        "tpu_plugin_uptime_seconds", "tpu_extender_uptime_seconds",
+    }
+
+
+def test_scanner_heartbeat_inventory():
+    exact, prefixes = scan.heartbeat_names()
+    for name in ("gang_tick", "audit_sweep", "telemetry_sampler",
+                 "stall_watchdog", "node_event_applier",
+                 "topology_publisher", "fs_watcher", "stack_sampler",
+                 "dra_slice_publisher"):
+        assert name in exact, (name, sorted(exact))
+    # f-string loop names resolve to their literal prefix.
+    assert any(p.startswith("index_warm") for p in prefixes), prefixes
+    assert any(p.startswith("lease_renew") for p in prefixes), prefixes
+    assert scan.loop_name_known("index_warm_7", exact, prefixes)
+    assert not scan.loop_name_known("totally_unknown", exact, prefixes)
+
+
+# -- lockdep -----------------------------------------------------------------
+
+
+def _nest(a, b):
+    with a:
+        with b:
+            pass
+
+
+def test_lockdep_inversion_two_threads_with_witness_stacks():
+    """The acceptance scenario: two TimedLocks taken in opposite
+    orders on two (sequential — lockdep needs no actual deadlock)
+    threads fire exactly one cycle carrying BOTH witness stacks."""
+    g = profiling.LockdepGraph().enable()
+    a = profiling.TimedLock("lock_a", lockdep=g)
+    b = profiling.TimedLock("lock_b", lockdep=g)
+    t1 = threading.Thread(target=_nest, args=(a, b), name="t-ab")
+    t1.start()
+    t1.join()
+    assert g.cycles() == []  # one order alone is fine
+    t2 = threading.Thread(target=_nest, args=(b, a), name="t-ba")
+    t2.start()
+    t2.join()
+    cycles = g.cycles()
+    assert len(cycles) == 1, cycles
+    cyc = cycles[0]
+    nodes = " ".join(cyc["nodes"])
+    assert "lock_a@" in nodes and "lock_b@" in nodes
+    assert len(cyc["witnesses"]) == 2
+    threads = {w["thread"] for w in cyc["witnesses"]}
+    assert threads == {"t-ab", "t-ba"}
+    for w in cyc["witnesses"]:
+        # Each witness stack names the acquisition site.
+        assert "_nest" in w["stack"], w["stack"]
+    # The same inversion does not re-fire a second cycle.
+    t3 = threading.Thread(target=_nest, args=(b, a))
+    t3.start()
+    t3.join()
+    assert len(g.cycles()) == 1
+
+
+def test_lockdep_consistent_order_stays_clean():
+    g = profiling.LockdepGraph().enable()
+    a = profiling.TimedLock("idx", lockdep=g)
+    b = profiling.TimedLock("res", lockdep=g)
+    for _ in range(3):
+        t = threading.Thread(target=_nest, args=(a, b))
+        t.start()
+        t.join()
+    assert g.cycles() == []
+    snap = g.snapshot()
+    assert len(snap["edges"]) == 1
+    assert snap["edges"][0]["count"] == 3
+
+
+def test_lockdep_self_deadlock_is_a_one_edge_cycle():
+    g = profiling.LockdepGraph().enable()
+    g.note_acquire("table", 1)
+    g.note_acquire("table", 1)  # re-acquiring a held Lock = deadlock
+    cycles = g.cycles()
+    assert len(cycles) == 1
+    assert cycles[0]["nodes"] == ["table@1", "table@1"]
+
+
+def test_lockdep_disabled_is_free_and_default_graph_is_global():
+    lock = profiling.TimedLock("plain")
+    assert lock._dep() is profiling.LOCKDEP
+    g = profiling.LockdepGraph()  # disabled
+    lock2 = profiling.TimedLock("off", lockdep=g)
+    with lock2:
+        pass
+    assert g.snapshot()["edges"] == []
+
+
+def test_lockdep_release_out_of_order_keeps_held_set_sane():
+    g = profiling.LockdepGraph().enable()
+    a = profiling.TimedLock("a", lockdep=g)
+    b = profiling.TimedLock("b", lockdep=g)
+    a.acquire()
+    b.acquire()
+    a.release()  # out-of-LIFO release is legal for Lock
+    c = profiling.TimedLock("c", lockdep=g)
+    c.acquire()  # held set is [b] now: edge b->c only
+    c.release()
+    b.release()
+    edges = {(e["from"], e["to"]) for e in g.snapshot()["edges"]}
+    assert {p[0].split("@")[0] for p in edges} == {"a", "b"}
+    assert ("a", "c") not in {
+        (f.split("@")[0], t.split("@")[0]) for f, t in edges
+    }
+
+
+def test_lockdep_cycle_overflow_is_counted_not_silent():
+    """Past MAX_CYCLES, witness RETENTION stops but the signal does
+    not: a new inversion still bumps dropped_cycles (and the
+    counter/flight record) instead of vanishing."""
+    g = profiling.LockdepGraph().enable()
+    g.MAX_CYCLES = 1
+    g.note_acquire("a", 1)
+    g.note_acquire("a", 1)  # stored cycle #1 (self-deadlock shape)
+    g.note_acquire("b", 2)
+    g.note_acquire("b", 2)  # distinct cycle #2: retention is full
+    snap = g.snapshot()
+    assert len(snap["cycles"]) == 1
+    assert snap["dropped_cycles"] == 1
+
+
+def test_write_baseline_with_narrowed_rules_preserves_other_entries(
+    tmp_path,
+):
+    """--write-baseline --rules TPLxxx must not delete other rules'
+    grandfathered entries (and their justifications)."""
+    from k8s_device_plugin_tpu.tools import lint as lint_cli
+
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"findings": [
+        {"rule": "TPL006",
+         "path": "k8s_device_plugin_tpu/utils/statestore.py",
+         "key": "lock:self._lock->os.fsync",
+         "justification": "the WAL ordering contract"},
+    ]}))
+    rc = lint_cli.main([
+        "--rules", "TPL001", "--write-baseline",
+        "--baseline", str(bl),
+    ])
+    assert rc == 0
+    entries = json.loads(bl.read_text())["findings"]
+    assert any(
+        e["rule"] == "TPL006" and
+        e["justification"] == "the WAL ordering contract"
+        for e in entries
+    ), entries
+
+
+def test_lint_self_test_uses_the_checked_in_fixture_corpus():
+    """In-repo, --self-test and test_seeded_violation_fires_exactly
+    prove the rules on the SAME fixture files — one corpus, no
+    drift."""
+    r = subprocess.run(
+        [sys.executable, "-m", "k8s_device_plugin_tpu.tools.lint",
+         "--self-test"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)["corpus"] == "fixtures"
+
+
+def test_lockdep_cross_thread_release_leaves_no_phantom_hold():
+    """A lock released by a DIFFERENT thread than acquired it (legal
+    for Lock semantics) must leave the acquirer's held set — a
+    phantom node would mint false edges and eventually a false
+    cycle."""
+    g = profiling.LockdepGraph().enable()
+    a = profiling.TimedLock("handoff", lockdep=g)
+    b = profiling.TimedLock("other", lockdep=g)
+    a.acquire()  # main thread acquires...
+    t = threading.Thread(target=a.release)  # ...worker releases
+    t.start()
+    t.join()
+    # If the phantom survived, this nest would record handoff->other.
+    with b:
+        pass
+    assert g.snapshot()["edges"] == []
+
+
+def test_lockdep_always_on_under_the_suite():
+    """conftest enables the global graph for every test; the session-
+    finish hook asserts it cycle-free."""
+    assert profiling.LOCKDEP.enabled
+
+
+def test_debug_lockdep_payload():
+    body = metrics.debug_payload("/debug/lockdep")
+    assert body is not None
+    doc = json.loads(body)
+    assert doc["enabled"] is True
+    assert "edges" in doc and "cycles" in doc
+
+
+# -- the lock_order / loop_inventory audit invariants ------------------------
+
+
+def test_lock_order_invariant_fires_critical_on_cycle(monkeypatch):
+    g = profiling.LockdepGraph().enable()
+    a = profiling.TimedLock("lock_a", lockdep=g)
+    b = profiling.TimedLock("lock_b", lockdep=g)
+    for pair in ((a, b), (b, a)):
+        t = threading.Thread(target=_nest, args=pair)
+        t.start()
+        t.join()
+    monkeypatch.setattr(profiling, "LOCKDEP", g)
+    findings = audit.check_lock_order()
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.invariant == "lock_order"
+    assert f.severity == audit.CRITICAL
+    assert "lock_a@" in f.message and "lock_b@" in f.message
+    assert int(dict(f.details)["witnesses"]) == 2
+
+
+def test_lock_order_invariant_clean_without_cycles(monkeypatch):
+    monkeypatch.setattr(
+        profiling, "LOCKDEP", profiling.LockdepGraph().enable()
+    )
+    assert audit.check_lock_order() == []
+
+
+def test_loop_inventory_warns_on_statically_invisible_loop():
+    profiling.HEARTBEATS.register("definitely_unknown_loop_xyz")
+    try:
+        findings = audit.check_loop_inventory()
+        mine = [
+            f for f in findings
+            if f.chip == "definitely_unknown_loop_xyz"
+        ]
+        assert len(mine) == 1
+        assert mine[0].severity == audit.WARNING
+        assert mine[0].invariant == "loop_inventory"
+    finally:
+        profiling.HEARTBEATS.unregister("definitely_unknown_loop_xyz")
+    # Known names — exact and prefixed — stay silent.
+    profiling.HEARTBEATS.register("gang_tick")
+    profiling.HEARTBEATS.register("index_warm_3")
+    try:
+        names = {f.chip for f in audit.check_loop_inventory()}
+        assert "gang_tick" not in names
+        assert "index_warm_3" not in names
+    finally:
+        profiling.HEARTBEATS.unregister("gang_tick")
+        profiling.HEARTBEATS.unregister("index_warm_3")
+
+
+def test_shared_invariants_registered_on_both_audit_sets():
+    node_names = {
+        i.name for i in audit.NodeAudit(plugin=None).invariants()
+    }
+    sentinel = object()
+    ext_names = {
+        i.name
+        for i in audit.ExtenderAudit(
+            reservations=sentinel, journal=sentinel, gang=sentinel,
+            index=sentinel,
+        ).invariants()
+    }
+    for name in ("thread_liveness", "lock_order", "loop_inventory"):
+        assert name in node_names
+        assert name in ext_names
+    # The refuse-to-audit-nothing guard still holds: zero wired
+    # planes means zero invariants, shared ones included.
+    assert audit.ExtenderAudit().invariants() == []
+
+
+# -- docs/tooling lockstep for this PR's own surfaces ------------------------
+
+
+def test_analysis_docs_in_lockstep():
+    doc = open(os.path.join(REPO, "docs", "analysis.md")).read()
+    for rule in R.RULES:
+        assert f"`{rule.id}`" in doc, rule.id
+        assert f"`{rule.slug}`" in doc, rule.slug
+    for needle in ("tpu-lint: disable=", "baseline.json", "--self-test",
+                   "lockdep", "check-tsan", "loop_inventory"):
+        assert needle in doc, needle
+    obs = open(os.path.join(REPO, "docs", "observability.md")).read()
+    assert "docs/analysis.md" in obs
+    assert "/debug/lockdep" in obs
+    readme = open(os.path.join(REPO, "README.md")).read()
+    assert "docs/analysis.md" in readme
+    mets = open(os.path.join(REPO, "docs", "metrics.md")).read()
+    for fam in ("tpu_lockdep_edges", "tpu_lockdep_cycles_total"):
+        assert f"`{fam}`" in mets, fam
+    tier1 = open(os.path.join(REPO, "scripts", "tier1.sh")).read()
+    assert "tools.lint --self-test" in tier1
+    assert "tools.lint \\\n" in tier1 or "tools.lint\n" in tier1
+    mk = open(
+        os.path.join(REPO, "native", "tpuinfo", "Makefile")
+    ).read()
+    assert "check-tsan" in mk and "-fsanitize=thread" in mk

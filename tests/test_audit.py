@@ -402,7 +402,7 @@ def test_e2e_clean_cluster_zero_findings_across_two_sweeps(node_stack):
     assert {i["name"] for i in snap["invariants"]} == {
         "checkpoint_vs_podresources", "annotation_vs_kubelet",
         "attribution_vs_kubelet", "gauge_vs_state", "orphaned_chip",
-        "thread_liveness",
+        "thread_liveness", "lock_order", "loop_inventory",
     }
 
 
@@ -671,6 +671,7 @@ def test_extender_clean_and_leaked_reservation(extender_stack):
     assert {i["name"] for i in snap["invariants"]} == {
         "reservation_vs_journal", "reservation_vs_cluster",
         "gate_vs_hold", "placeable_recount", "thread_liveness",
+        "lock_order", "loop_inventory",
     }
     # A hold for a gang with no pods anywhere = leaked reservation.
     s["reservations"].reserve(

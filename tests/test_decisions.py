@@ -659,23 +659,20 @@ def test_decisions_doc_in_lockstep_with_code():
     docs/operations.md — a renamed kind must break this test, not
     silently orphan the doc."""
     import os
-    import re
+
+    from k8s_device_plugin_tpu.analysis import registry_scan as scan
+    from k8s_device_plugin_tpu.analysis import rules as lint_rules
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     doc = open(os.path.join(repo, "docs", "observability.md")).read()
-    src = ""
-    pkg = os.path.join(repo, "k8s_device_plugin_tpu")
-    for root, _, files in os.walk(pkg):
-        for f in files:
-            if f.endswith(".py"):
-                src += open(os.path.join(root, f)).read()
-    kinds = set(re.findall(r'LEDGER\.record\(\s*\n?\s*"([a-z_]+)"', src))
-    assert kinds, "decision-kind grep found nothing (pattern drift?)"
-    missing = {k for k in kinds if f"`{k}`" not in doc}
-    assert not missing, (
-        f"decision kinds used in code but absent from "
-        f"docs/observability.md: {sorted(missing)}"
+    # Driven by the lint engine's registry scanner — the same
+    # inventory the TPL005 rule checks, so this test, tpu-lint, and
+    # the doc can never disagree about what "documented" means.
+    assert scan.ledger_kind_sites(), (
+        "decision-kind scanner found nothing (pattern drift?)"
     )
+    findings = lint_rules.run_rules(rules={"TPL005"})
+    assert not findings, [f.to_dict() for f in findings]
     assert "/debug/decisions" in doc
     assert constants.ADMIT_TS_ANNOTATION in doc
     ops = open(os.path.join(repo, "docs", "operations.md")).read()

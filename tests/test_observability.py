@@ -262,43 +262,31 @@ def test_grafana_dashboard_in_lockstep_with_registries():
 
 
 def test_observability_doc_in_lockstep_with_code():
-    """docs/observability.md must document every span name and
-    flight-event kind the code actually uses (grepped from call
-    sites), the carrier annotation, and both /debug endpoints — a
-    renamed span or event kind must break this test, not silently
-    orphan the doc."""
+    """docs/observability.md must document every span name, flight
+    kind, and /debug surface the code actually uses — now driven by
+    the lint engine's registry scanner (analysis/registry_scan.py),
+    the SAME inventories the TPL004/TPL008/TPL009 rules check, so
+    this test, tpu-lint, and the doc can never disagree about what
+    "documented" means. The old per-test regexes missed multi-line
+    calls; the AST scanner does not."""
     import os
-    import re
 
+    from k8s_device_plugin_tpu.analysis import registry_scan as scan
+    from k8s_device_plugin_tpu.analysis import rules as lint_rules
     from k8s_device_plugin_tpu.api import constants as api_constants
 
+    # Pattern-drift guards: an AST shape change that empties an
+    # inventory would make the rule pass vacuously.
+    assert scan.span_name_sites(), "span scanner found nothing"
+    assert scan.flight_kind_sites(), "flight-kind scanner found nothing"
+    assert scan.debug_endpoint_keys(), "endpoint scanner found nothing"
+    findings = lint_rules.run_rules(
+        rules={"TPL004", "TPL008", "TPL009"}
+    )
+    assert not findings, [f.to_dict() for f in findings]
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     doc = open(os.path.join(repo, "docs", "observability.md")).read()
-    src = ""
-    pkg = os.path.join(repo, "k8s_device_plugin_tpu")
-    for root, _, files in os.walk(pkg):
-        for f in files:
-            if f.endswith(".py"):
-                src += open(os.path.join(root, f)).read()
-    span_names = set(
-        re.findall(r'tracing\.span\(\s*"([A-Za-z_.]+)"', src)
-    ) | set(re.findall(r'_span_for\(\s*"([A-Za-z_.]+)"', src))
-    assert span_names, "span-name grep found nothing (pattern drift?)"
-    undocumented = {n for n in span_names if n not in doc}
-    assert not undocumented, (
-        f"span names used in code but absent from "
-        f"docs/observability.md: {sorted(undocumented)}"
-    )
-    kinds = set(re.findall(r'RECORDER\.record\(\s*\n?\s*"([a-z_]+)"', src))
-    assert kinds, "flight-event grep found nothing (pattern drift?)"
-    missing_kinds = {k for k in kinds if k not in doc}
-    assert not missing_kinds, (
-        f"flight-event kinds used in code but absent from "
-        f"docs/observability.md: {sorted(missing_kinds)}"
-    )
     assert api_constants.TRACE_ANNOTATION in doc
-    for endpoint in ("/debug/traces", "/debug/events"):
-        assert endpoint in doc, f"{endpoint} missing from the doc"
     # The runbook entry the doc points at must exist.
     ops = open(os.path.join(repo, "docs", "operations.md")).read()
     assert "Reading an allocation trace" in ops
@@ -306,27 +294,24 @@ def test_observability_doc_in_lockstep_with_code():
 
 def test_metrics_doc_in_lockstep_with_registries():
     """docs/metrics.md must document every registered family and name
-    no family that doesn't exist (uptime families are rendered, not
-    registered, and are asserted separately)."""
-    import os
-    import re
-
+    no family that doesn't exist — driven by the lint engine's
+    registry scanner (the TPL003 rule), with the static-vs-runtime
+    parity check pinning the scanner itself to the registries."""
+    from k8s_device_plugin_tpu.analysis import registry_scan as scan
+    from k8s_device_plugin_tpu.analysis import rules as lint_rules
     from k8s_device_plugin_tpu.utils import metrics
 
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "docs", "metrics.md",
-    )
-    doc = open(path).read()
-    documented = set(re.findall(r"`(tpu_[a-z0-9_]+)`", doc))
-    registered = set(metrics.REGISTRY._metrics) | set(
+    static = {v for v, _p, _l in scan.metric_family_sites()}
+    runtime = set(metrics.REGISTRY._metrics) | set(
         metrics.EXTENDER_REGISTRY._metrics
     )
-    rendered_only = {"tpu_plugin_uptime_seconds",
-                     "tpu_extender_uptime_seconds"}
-    missing = registered - documented
-    assert not missing, f"registered but undocumented: {sorted(missing)}"
-    ghosts = documented - registered - rendered_only
-    assert not ghosts, f"documented but not registered: {sorted(ghosts)}"
-    for fam in rendered_only:
+    assert static == runtime, (
+        f"scanner vs registries drift: "
+        f"only-static={sorted(static - runtime)} "
+        f"only-runtime={sorted(runtime - static)}"
+    )
+    findings = lint_rules.run_rules(rules={"TPL003"})
+    assert not findings, [f.to_dict() for f in findings]
+    documented = scan.documented_metric_families()
+    for fam in scan.uptime_families():
         assert fam in documented, f"{fam} missing from docs/metrics.md"
