@@ -67,6 +67,14 @@ ADMIT_TS_ANNOTATION = "tpu.google.com/admitted-at"
 # which chip" is one label filter (telemetry.py).
 GANG_NAME_LABEL = "tpu.google.com/gang-name"
 
+# Pod annotation carrying the workload's last checkpoint timestamp
+# (epoch seconds, stamped by workload/checkpointing.CheckpointBeacon
+# after each durable save). The preemption planner
+# (extender/preemption.py) reads it to rank victim restart cost: a gang
+# that checkpointed seconds ago loses almost nothing to an eviction, a
+# gang an hour past its last save loses an hour of chip time.
+CHECKPOINT_TS_ANNOTATION = "tpu.google.com/last-checkpoint"
+
 # Env var understood the same way as the reference's DP_DISABLE_HEALTHCHECKS
 # (/root/reference/server.go:32-33,231-242): a comma-separated list of
 # check classes to disable. Classes: "all", "events" (inotify fast path;

@@ -176,6 +176,23 @@ def main() -> int:
         "the auditor entirely",
     )
     p.add_argument(
+        "--no-preemption", action="store_true",
+        help="disable priority tiers & cost-aware preemption "
+        "(extender/preemption.py). By default (with --gang-admission) "
+        "complete gangs evaluate in PriorityClass order and a "
+        "capacity-blocked higher-priority gang may evict strictly "
+        "lower-priority running gangs — minimal victim set, ranked by "
+        "tier then restart cost (checkpoint recency + duty cycle), "
+        "two-phase journaled, served as the scheduler-extender "
+        "/preemption verb. With this flag every gang is equal "
+        "(the pre-PR-13 FIFO)",
+    )
+    p.add_argument(
+        "--preemption-rounds-per-tick", type=int, default=1,
+        help="max preemption rounds (one waiting gang's eviction "
+        "wave) per admission tick — the blast-radius budget",
+    )
+    p.add_argument(
         "--gang-pending-event-s", type=float, default=300.0,
         help="post a kube Event (kubectl describe pod) on gangs "
         "capacity-waiting longer than this many seconds (budgeted + "
@@ -352,6 +369,29 @@ def main() -> int:
 
         return src
 
+    # Priority tiers & preemption (extender/preemption.py): one
+    # PriorityClass resolver per process; each admitter — the
+    # singleton, or every per-shard one — gets its own engine so
+    # per-shard preemption stays inside the shard's gang/capacity
+    # ownership.
+    preempt_resolver = None
+    if a.gang_admission and not a.no_preemption:
+        from .preemption import PriorityResolver
+
+        preempt_resolver = PriorityResolver(client)
+
+    def wire_preemption(adm) -> None:
+        if preempt_resolver is None or adm is None:
+            return
+        from .preemption import PreemptionEngine
+
+        adm.priority_resolver = preempt_resolver
+        adm.preemption = PreemptionEngine(
+            adm,
+            preempt_resolver,
+            rounds_per_tick=a.preemption_rounds_per_tick,
+        )
+
     sharded = a.gang_admission and a.shards > 1
     if sharded and a.no_singleton_lease:
         logging.getLogger(__name__).error(
@@ -394,7 +434,7 @@ def main() -> int:
                     os.path.join(a.journal_dir, f"shard-{shard_id}"),
                     fsync_always=a.journal_fsync,
                 )
-            return GangAdmission(
+            adm = GangAdmission(
                 client,
                 resync_interval_s=a.gang_resync_s,
                 reservations=_Table(),
@@ -407,6 +447,8 @@ def main() -> int:
                 topo_filter=topo_filter,
                 shard_id=shard_id,
             )
+            wire_preemption(adm)
+            return adm
 
         def shard_lost(shard_id: int) -> None:
             # The leader.py rationale, per shard: an admission write
@@ -539,6 +581,7 @@ def main() -> int:
             pending_event_threshold_s=a.gang_pending_event_s,
             journal=journal,
         )
+        wire_preemption(gang)
         if node_cache is not None:
             # … and its node-change events mark exactly the affected
             # gangs dirty (slice→gangs index in gang.py).
@@ -555,6 +598,23 @@ def main() -> int:
         gang.recover()
         gang.start()
     status.mark_replayed()
+    if preempt_resolver is not None:
+        # The scheduler-extender /preemption verb (dry-run node →
+        # victims; the calling scheduler executes the evictions): in
+        # sharded mode it answers from the HOME shard's engine — each
+        # shard's own tick drives its in-process rounds regardless.
+        def preemption_verb(pod: dict) -> dict:
+            adm_obj = (
+                manager.home_admission()
+                if manager is not None
+                else gang
+            )
+            eng = getattr(adm_obj, "preemption", None)
+            if eng is None:
+                return {"nodeNameToMetaVictims": {}}
+            return eng.dry_run(pod)
+
+        srv.preemption_handler = preemption_verb
     auditor = None
     if a.audit_interval_s > 0:
         from .. import audit

@@ -54,6 +54,7 @@ from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
 from ..utils.podresources import tpu_request
 from .journal import AdmissionJournal, Hold
+from .preemption import TIER_STANDARD, tier_label
 from .reservations import DEFAULT_TABLE, ReservationTable
 
 log = get_logger(__name__)
@@ -196,6 +197,32 @@ class _CapacityPool:
         # the gang_waiting decision record's shortfall payload
         # (utils/decisions.py). None after a successful fits().
         self.last_reject: Optional[Dict] = None
+
+    def current_topos(self) -> List[NodeTopology]:
+        """Per-call topology clones carrying the pool's CURRENT
+        (post-consumption) availability — what the preemption
+        planner's what-if fits run over, so a victim plan accounts
+        for every admission this same tick already made."""
+        return [
+            t
+            if self.avail[t.hostname] is t.available
+            else dataclasses.replace(
+                t, available=list(self.avail[t.hostname])
+            )
+            for t in self.topos
+        ]
+
+    def debit(self, host_chips: Dict[str, int]) -> None:
+        """Consume ``host_chips`` from the pool's availability (what
+        the pool can still see of them — chips a preemption freed are
+        not in the pool yet and need no debit). Keeps later gangs of
+        the same tick from double-using chips a preemptor's fresh
+        reservation just claimed."""
+        for h, n in host_chips.items():
+            cur = self.avail.get(h)
+            if cur is None or n <= 0:
+                continue
+            self._set_avail(h, cur[min(n, len(cur)):])
 
     def slice_host_sizes(self) -> List[Tuple[Tuple[str, ...], int]]:
         """(slice key, chips per host) per known slice — dependency
@@ -489,6 +516,20 @@ class GangAdmission:
         # end-of-pass flush has already pushed buffered records before
         # the auditor reads the file.
         self.auditor = None
+        # Priority/preemption plane (extender/preemption.py), wired by
+        # the entrypoint. With a resolver, complete gangs evaluate in
+        # descending priority (the pending queue is tier-ordered) and
+        # reservations carry the gang's priority; with an engine, a
+        # capacity-blocked high-priority gang may evict lower-priority
+        # running gangs (two-phase journaled). Both None = the
+        # pre-PR-13 FIFO behavior, bit for bit.
+        self.priority_resolver = None
+        self.preemption = None
+        # Gang → (numeric priority, tier label), refreshed per
+        # evaluation; pruned with the gang (the tier feeds the
+        # per-tier waiting/admitted metric labels).
+        self._gang_priority: Dict[Tuple[str, str], int] = {}
+        self._gang_tier: Dict[Tuple[str, str], str] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -547,11 +588,19 @@ class GangAdmission:
                 demands=tuple(st["demands"]),
                 counted_pods=set(st["counted"]),
                 created_ts=now - st["age_s"],
+                priority=int(st.get("priority", 0)),
             )
             for k, st in self.reservations.export_state().items()
         }
         return AdmissionJournal.state_data(
-            holds, set(self._lapsed_gangs), dict(self._waiting_since)
+            holds,
+            set(self._lapsed_gangs),
+            dict(self._waiting_since),
+            preempting=(
+                self.preemption.open_intents()
+                if self.preemption is not None
+                else None
+            ),
         )
 
     def recover(self) -> dict:
@@ -578,7 +627,12 @@ class GangAdmission:
         # reconcile once the API answers).
         gangs: Dict[Tuple[str, str], GangView] = {}
         truth = False
-        keys = set(state.holds) | state.lapsed | set(state.waiting_since)
+        keys = (
+            set(state.holds)
+            | state.lapsed
+            | set(state.waiting_since)
+            | set(state.preempting)
+        )
         try:
             if keys:
                 gangs = self._collect_gangs(set(keys))
@@ -610,6 +664,7 @@ class GangAdmission:
                 age_s=hold.age_s(now),
                 demands=tuple(hold.demands),
                 counted_pods=hold.counted_pods,
+                priority=hold.priority,
             ):
                 # Aged past the hard cap while we were dead: it lapses
                 # NOW — and stays lapsed (the bar below), never
@@ -624,6 +679,57 @@ class GangAdmission:
         self._lapsed_gangs |= {
             k for k in state.lapsed if not truth or k in gangs
         }
+        # Open preemption rounds (two-phase protocol,
+        # extender/preemption.py): SIGKILL anywhere inside a round
+        # must rehydrate to a safe state. "evicted" with no reserve =
+        # the steal window preemption opened and never fenced —
+        # re-install the planned fence NOW (behind the readiness gate,
+        # so /filter never serves without it); "intent" = nothing
+        # irreversible landed — abort, the next tick re-plans from
+        # cluster truth. Either way the round's journal entry closes.
+        preempt_refenced = preempt_aborted = 0
+        active_now = (
+            self.reservations.active() if state.preempting else {}
+        )
+        for key, rec in sorted(state.preempting.items()):
+            if truth and key not in gangs:
+                self.journal.record(
+                    "preempt_abort", key, reason="gang_vanished"
+                )
+                preempt_aborted += 1
+                continue
+            if key in active_now:
+                # The reserve landed before the crash: the round is
+                # effectively complete; the standing-hold release path
+                # finishes the gates.
+                self.journal.record("preempt_done", key)
+                continue
+            if rec.get("phase") == "evicted":
+                hosts = {
+                    str(h): int(n)
+                    for h, n in (rec.get("consumed") or {}).items()
+                }
+                age = max(0.0, now - float(rec.get("ts", now)))
+                if hosts and self.reservations.restore(
+                    key,
+                    hosts,
+                    age_s=age,
+                    demands=tuple(sorted(
+                        int(d) for d in rec.get("demands") or ()
+                    )),
+                    priority=int(rec.get("priority", 0)),
+                ):
+                    # restore() journals the reserve via the observer
+                    # tap, so table and journal agree immediately.
+                    self.journal.record("preempt_done", key)
+                    preempt_refenced += 1
+                    self.mark_dirty(key, source="recovery")
+                    continue
+            self.journal.record(
+                "preempt_abort", key, reason="recovered"
+            )
+            preempt_aborted += 1
+            self.mark_dirty(key, source="recovery")
         # Wait-episode origins: the SLO clock and the pending-Event
         # threshold keep counting from the TRUE start of the wait.
         for key, since in state.waiting_since.items():
@@ -650,6 +756,8 @@ class GangAdmission:
             "holds_lapsed_on_restore": lapsed_now,
             "lapse_bars": len(self._lapsed_gangs),
             "waits_restored": len(state.waiting_since),
+            "preempt_refenced": preempt_refenced,
+            "preempt_aborted": preempt_aborted,
             "cluster_truth": truth,
             "took_s": took,
         }
@@ -829,6 +937,51 @@ class GangAdmission:
         self._pending_evented.pop(key, None)
         self._breach_recorded.discard(key)
         self._first_complete.pop(key, None)
+        if self.preemption is not None:
+            # The waiting episode ended (admit, vanish, or state
+            # change): a future episode may ledger a fresh no_plan.
+            self.preemption.note_admitted(key)
+
+    def _priority_of(
+        self, key: Tuple[str, str], gv: "GangView"
+    ) -> int:
+        """The gang's numeric scheduling priority (0 without a
+        resolver — the exact pre-priority behavior). Cached per gang
+        for the metric/ledger consumers; refreshed on every
+        evaluation (the resolver itself caches the PriorityClass
+        vocabulary, so this is dict reads in steady state)."""
+        if self.priority_resolver is None:
+            return 0
+        try:
+            prio = self.priority_resolver.gang_priority(gv.live)
+        except Exception:  # noqa: BLE001 — priority is an ordering
+            # hint; a resolver failure degrades to the cached value,
+            # never blocks the tick
+            prio = self._gang_priority.get(key, 0)
+        self._gang_priority[key] = prio
+        self._gang_tier[key] = tier_label(prio)
+        return prio
+
+    def _prune_priority(self, key: Tuple[str, str]) -> None:
+        self._gang_priority.pop(key, None)
+        self._gang_tier.pop(key, None)
+
+    def _publish_waiting(self) -> None:
+        """Publish the per-tier capacity-waiting gauge
+        (tpu_gang_waiting{tier}): one series per tier with waiting
+        gangs, emptied tiers pruned so an idle tier reads absent, not
+        frozen."""
+        with self._dirty_lock:
+            waiting = list(self._waiting_gangs)
+        counts: Dict[str, int] = {}
+        for key in waiting:
+            tier = self._gang_tier.get(key, TIER_STANDARD)
+            counts[tier] = counts.get(tier, 0) + 1
+        for labels, _ in metrics.GANG_WAITING.series():
+            if labels.get("tier") not in counts:
+                metrics.GANG_WAITING.remove(**labels)
+        for tier, n in counts.items():
+            metrics.GANG_WAITING.set(n, tier=tier)
 
     @staticmethod
     def _shortfall_text(diag: Dict) -> str:
@@ -1147,10 +1300,12 @@ class GangAdmission:
             requested = dirty | set(self.reservations.active())
             if not requested:
                 # Idle dirty tick: nothing marked, nothing held.
-                metrics.GANG_WAITING.set(len(self._waiting_gangs))
+                self._publish_waiting()
                 return []
             gangs = self._collect_gangs(requested)
         self._event_budget_left = self.pending_event_budget
+        if self.preemption is not None:
+            self.preemption.begin_tick()
         self._reservation_upkeep(gangs, full)
         # Prune the waiting markers of gangs that vanished — the maps
         # must not grow without bound. A dirty tick only saw
@@ -1164,6 +1319,9 @@ class GangAdmission:
             for key in list(self._first_complete):
                 if key not in gangs:
                     self._first_complete.pop(key, None)
+            for key in list(self._gang_priority):
+                if key not in gangs:
+                    self._prune_priority(key)
             with self._dirty_lock:
                 stale = self._waiting_gangs - set(gangs)
             for key in stale:
@@ -1173,13 +1331,14 @@ class GangAdmission:
             for key in vanished:
                 self._clear_wait_state(key)
                 self._clear_waiting(key)
+                self._prune_priority(key)
                 # A vanished gang's lapse bar is moot (nothing left to
                 # re-fence) — dropping it here, for exactly the gangs
                 # this tick observed absent, is what lets upkeep's
                 # full-sweep intersection stay full-sweep-only.
                 self._lapsed_gangs.discard(key)
         if not gangs:
-            metrics.GANG_WAITING.set(len(self._waiting_gangs))
+            self._publish_waiting()
             return []
 
         # One consumable capacity view for the WHOLE tick: a gang
@@ -1202,7 +1361,17 @@ class GangAdmission:
 
         standing = self.reservations.active()
         released = []
-        for key, gv in sorted(gangs.items()):
+        # Priority-ordered pending queue: higher tiers evaluate (and
+        # therefore consume the tick's shared capacity pool) first;
+        # equal priorities keep the stable key order — the exact
+        # pre-priority iteration when no resolver is wired (all 0).
+        prios = {
+            key: self._priority_of(key, gv)
+            for key, gv in gangs.items()
+        }
+        for key, gv in sorted(
+            gangs.items(), key=lambda kv: (-prios[kv[0]], kv[0])
+        ):
             gated = gv.gated
             if not gated:
                 # Fully released. An extender restart loses the
@@ -1331,6 +1500,24 @@ class GangAdmission:
             # Succeeded member's finished work no longer holds the
             # remainder hostage.
             consumed_hosts = pool().fits(demands)
+            preempted = False
+            if consumed_hosts is None and self.preemption is not None:
+                # Cost-aware preemption (extender/preemption.py): when
+                # a strictly-lower-priority victim set frees a
+                # placeable box, evict it (two-phase journaled) and
+                # flow the freed fit into the normal reserve→release
+                # path below — the existing gate/fence flow.
+                consumed_hosts = self.preemption.maybe_preempt(
+                    key, gv, demands, pool().current_topos(),
+                    prios[key],
+                    # A full sweep's map is the COMPLETE victim view;
+                    # a dirty tick's is narrowed to the marked subset
+                    # and the engine must list for itself.
+                    gangs=gangs if full else None,
+                )
+                if consumed_hosts is not None:
+                    preempted = True
+                    pool().debit(consumed_hosts)
             if consumed_hosts is None:
                 diag = pool().last_reject or {}
                 # Register capacity dependencies so node events wake
@@ -1393,8 +1580,14 @@ class GangAdmission:
             # demands fingerprint lets a later tick detect a recreated
             # same-named gang of a different shape.
             self.reservations.reserve(
-                key, consumed_hosts, demands=tuple(sorted(demands))
+                key, consumed_hosts, demands=tuple(sorted(demands)),
+                priority=prios[key],
             )
+            if preempted:
+                # Phase 3 of the preemption round: the fence landed
+                # (journaled via the observer tap) — close the
+                # two-phase journal entry before the gates come off.
+                self.preemption.finish(key)
             # A fresh gated release is a fresh all-or-nothing decision:
             # it clears any lapse bar a previous same-named generation
             # left behind (the new hold ages from now, legitimately).
@@ -1415,10 +1608,11 @@ class GangAdmission:
                 wait_started=wait_started,
             )
             released.append(key)
-        with self._dirty_lock:
-            metrics.GANG_WAITING.set(len(self._waiting_gangs))
-        for _ in released:
-            metrics.GANG_RELEASED.inc()
+        self._publish_waiting()
+        for key in released:
+            metrics.GANG_RELEASED.inc(
+                tier=self._gang_tier.get(key, TIER_STANDARD)
+            )
         if released and self.shard_id is not None:
             # Per-shard admission throughput: rate() of this family is
             # the gangs-admitted/s SLI the scale bench bounds.
@@ -1492,6 +1686,7 @@ class GangAdmission:
             key, consumed,
             demands=tuple(sorted(gv.demands(self.resource_name))),
             counted_pods=scheduled,
+            priority=self._gang_priority.get(key, 0),
         )
         log.info(
             "gang %s/%s: re-fenced %d chip(s) for %d unscheduled "

@@ -63,7 +63,15 @@ GangKey = Tuple[str, str]
 # crash durability, is the opt-in ``fsync_always`` mode: measured at
 # ~1 ms per fsync it alone would breach the 1.1x tick-overhead bound,
 # and a machine crash usually takes the journal volume with it anyway).
-CRITICAL_OPS = frozenset({"reserve", "admit", "lapse"})
+# The preempt_* ops (extender/preemption.py's two-phase protocol:
+# intent → victims evicted → done/abort) are all critical: losing one
+# to a crash could re-evict already-evicted victims or leave freed
+# chips unfenced through recovery.
+CRITICAL_OPS = frozenset({
+    "reserve", "admit", "lapse",
+    "preempt_intent", "preempt_evicted", "preempt_done",
+    "preempt_abort",
+})
 
 # One snapshot compaction per this many journal records keeps replay
 # bounded and the file small across renew-heavy steady states.
@@ -76,6 +84,7 @@ class Hold:
     demands: Tuple[int, ...]
     counted_pods: Set[str]
     created_ts: float  # wall clock of the original reserve
+    priority: int = 0  # the gang's priority at reserve time
 
     def age_s(self, now: Optional[float] = None) -> float:
         return max(0.0, (now or time.time()) - self.created_ts)
@@ -89,6 +98,17 @@ class RehydratedState:
     status: str  # statestore load status
     records: int  # journal records applied (past the snapshot)
     dropped: int  # torn/corrupt journal lines discarded
+    # Open preemption rounds (extender/preemption.py two-phase
+    # protocol), keyed by the PREEMPTOR gang: {"phase": intent|evicted,
+    # "victims": [[ns, gang], ...], "consumed": {host: chips},
+    # "demands": [...], "ts": wall clock of the last phase record}.
+    # Recovery (gang.py) turns an "evicted" phase into a restored
+    # fence (the chips were freed but never reserved) and aborts an
+    # "intent" phase (nothing irreversible happened yet — the next
+    # tick re-plans from cluster truth).
+    preempting: Dict[GangKey, dict] = dataclasses.field(
+        default_factory=dict
+    )
 
 
 class AdmissionJournal:
@@ -219,6 +239,7 @@ class AdmissionJournal:
         holds: Dict[GangKey, Hold] = {}
         lapsed: Set[GangKey] = set()
         waiting: Dict[GangKey, float] = {}
+        preempting: Dict[GangKey, dict] = {}
         if loaded.snapshot:
             snap = loaded.snapshot
             for h in snap.get("holds", []):
@@ -231,15 +252,25 @@ class AdmissionJournal:
                     demands=tuple(h.get("demands") or ()),
                     counted_pods=set(h.get("counted") or ()),
                     created_ts=float(h.get("created", 0.0)),
+                    priority=int(h.get("priority", 0)),
                 )
             lapsed = {tuple(k) for k in snap.get("lapsed", [])}
             waiting = {
                 (w[0], w[1]): float(w[2])
                 for w in snap.get("waiting", [])
             }
+            for p in snap.get("preempting", []):
+                preempting[(p.get("ns", ""), p.get("gang", ""))] = {
+                    "phase": p.get("phase", "intent"),
+                    "victims": p.get("victims") or [],
+                    "consumed": p.get("consumed") or {},
+                    "demands": p.get("demands") or [],
+                    "priority": int(p.get("priority", 0)),
+                    "ts": float(p.get("ts", 0.0)),
+                }
         applied = 0
         for rec in loaded.records:
-            self._apply(rec, holds, lapsed, waiting)
+            self._apply(rec, holds, lapsed, waiting, preempting)
             applied += 1
         return RehydratedState(
             holds=holds,
@@ -248,6 +279,7 @@ class AdmissionJournal:
             status=loaded.status,
             records=applied,
             dropped=loaded.dropped,
+            preempting=preempting,
         )
 
     @staticmethod
@@ -256,6 +288,7 @@ class AdmissionJournal:
         holds: Dict[GangKey, Hold],
         lapsed: Set[GangKey],
         waiting: Dict[GangKey, float],
+        preempting: Optional[Dict[GangKey, dict]] = None,
     ) -> None:
         g = rec.get("g") or ["", ""]
         key: GangKey = (str(g[0]), str(g[1]))
@@ -274,6 +307,7 @@ class AdmissionJournal:
                 counted_pods=set(rec.get("counted") or ()),
                 created_ts=float(rec.get("ts", 0.0))
                 - float(rec.get("age_s", 0.0)),
+                priority=int(rec.get("priority", 0)),
             )
             lapsed.discard(key)
         elif op == "shrink":
@@ -302,6 +336,24 @@ class AdmissionJournal:
             waiting[key] = float(rec.get("since", rec.get("ts", 0.0)))
         elif op == "wait_clear":
             waiting.pop(key, None)
+        elif op in ("preempt_intent", "preempt_evicted"):
+            if preempting is not None:
+                # Both phases carry the full plan payload (not just
+                # the intent): a compaction between the two records
+                # must not leave the evicted phase planless.
+                preempting[key] = {
+                    "phase": (
+                        "intent" if op == "preempt_intent" else "evicted"
+                    ),
+                    "victims": rec.get("victims") or [],
+                    "consumed": rec.get("consumed") or {},
+                    "demands": rec.get("demands") or [],
+                    "priority": int(rec.get("priority", 0)),
+                    "ts": float(rec.get("ts", 0.0)),
+                }
+        elif op in ("preempt_done", "preempt_abort"):
+            if preempting is not None:
+                preempting.pop(key, None)
         # "renew": expiry is process-local — a rehydrated hold gets a
         # fresh TTL from its preserved age; "admit": the release
         # decision marker (the reserve just before it carries the
@@ -315,10 +367,11 @@ class AdmissionJournal:
         holds: Dict[GangKey, Hold],
         lapsed: Set[GangKey],
         waiting_since: Dict[GangKey, float],
+        preempting: Optional[Dict[GangKey, dict]] = None,
     ) -> dict:
         """The compaction document replay() consumes — built by the
         owner (gang.py assembles it from the live table + its lapse
-        bars + wait clocks)."""
+        bars + wait clocks + the preemption engine's open intents)."""
         return {
             "holds": [
                 {
@@ -328,6 +381,7 @@ class AdmissionJournal:
                     "demands": list(h.demands),
                     "counted": sorted(h.counted_pods),
                     "created": round(h.created_ts, 3),
+                    "priority": int(h.priority),
                 }
                 for k, h in sorted(holds.items())
             ],
@@ -335,6 +389,19 @@ class AdmissionJournal:
             "waiting": [
                 [k[0], k[1], round(ts, 3)]
                 for k, ts in sorted(waiting_since.items())
+            ],
+            "preempting": [
+                {
+                    "ns": k[0],
+                    "gang": k[1],
+                    "phase": p.get("phase", "intent"),
+                    "victims": list(p.get("victims") or []),
+                    "consumed": dict(p.get("consumed") or {}),
+                    "demands": list(p.get("demands") or []),
+                    "priority": int(p.get("priority", 0)),
+                    "ts": round(float(p.get("ts", 0.0)), 3),
+                }
+                for k, p in sorted((preempting or {}).items())
             ],
         }
 
@@ -388,6 +455,40 @@ def self_test() -> int:
         st = AdmissionJournal(d).replay()
         assert key not in st.holds
         assert st.waiting_since[("default", "starved")] == 123.0
+
+        # Two-phase preemption protocol: an open "evicted" phase
+        # survives replay (recovery must re-fence the freed chips);
+        # "done" closes the round.
+        pk = ("default", "prod")
+        j4 = AdmissionJournal(d)
+        j4.replay()  # owner load: seq continues past the snapshot
+        j4.record(
+            "preempt_intent", pk,
+            victims=[["default", "batch"]], consumed={"n1": 4},
+            demands=[4],
+        )
+        j4.record(
+            "preempt_evicted", pk,
+            victims=[["default", "batch"]], consumed={"n1": 4},
+            demands=[4],
+        )
+        j4.close()
+        st = AdmissionJournal(d).replay()
+        assert st.preempting[pk]["phase"] == "evicted", st.preempting
+        assert st.preempting[pk]["consumed"] == {"n1": 4}
+        j5 = AdmissionJournal(d)
+        j5.replay()
+        # Open rounds must also survive a compaction (the snapshot
+        # carries them), then close on the done marker.
+        j5.compact(
+            AdmissionJournal.state_data(
+                st.holds, st.lapsed, st.waiting_since, st.preempting
+            )
+        )
+        assert j5.replay().preempting[pk]["phase"] == "evicted"
+        j5.record("preempt_done", pk)
+        j5.close()
+        assert pk not in AdmissionJournal(d).replay().preempting
         print(json.dumps({"journal_self_test": "ok"}))
         return 0
     finally:

@@ -89,6 +89,11 @@ class Reservation:
     demands: Tuple[int, ...] = ()
     # Pod names whose placement was already subtracted from ``hosts``.
     counted_pods: Set[str] = dataclasses.field(default_factory=set)
+    # The gang's scheduling priority at reserve time (PriorityClass-
+    # derived, extender/preemption.py): holds order by it in snapshots
+    # and the preemption planner never selects a victim whose hold
+    # outranks the preemptor. 0 = the cluster default.
+    priority: int = 0
 
     @property
     def total_chips(self) -> int:
@@ -144,6 +149,7 @@ class ReservationTable:
             "demands": list(r.demands),
             "counted": sorted(r.counted_pods),
             "age_s": round(age_s, 3),
+            "priority": r.priority,
         })
 
     def reserve(
@@ -152,6 +158,7 @@ class ReservationTable:
         host_chips: Dict[str, int],
         demands: Tuple[int, ...] = (),
         counted_pods: Optional[Set[str]] = None,
+        priority: int = 0,
     ) -> None:
         """``counted_pods`` pre-marks members whose chips are already
         OUTSIDE this hold (e.g. a restart re-fence covering only the
@@ -171,6 +178,7 @@ class ReservationTable:
                 expires_at=now + min(self.ttl_s, self.max_age_s),
                 demands=tuple(sorted(demands)),
                 counted_pods=set(counted_pods or ()),
+                priority=int(priority),
             )
             self._observe_reserve_locked(gang, 0.0)
 
@@ -181,6 +189,7 @@ class ReservationTable:
         age_s: float,
         demands: Tuple[int, ...] = (),
         counted_pods: Optional[Set[str]] = None,
+        priority: int = 0,
     ) -> bool:
         """Re-install a journal-rehydrated hold with its pre-crash age
         preserved: ``created_at`` is backdated by ``age_s`` so the hard
@@ -205,6 +214,7 @@ class ReservationTable:
                 expires_at=now + min(self.ttl_s, self.max_age_s - age_s),
                 demands=tuple(sorted(demands)),
                 counted_pods=set(counted_pods or ()),
+                priority=int(priority),
             )
             self._observe_reserve_locked(gang, age_s)
         return True
@@ -380,7 +390,9 @@ class ReservationTable:
     def snapshot(self) -> list:
         """JSON-ready view of active holds (extender /reservations
         endpoint; tools/gang injects it so the CLI's verdicts match the
-        in-process controller's)."""
+        in-process controller's). Ordered by tier — highest-priority
+        holds first, then key — so an operator reading the endpoint
+        sees the holds the preemption planner would protect first."""
         now = self._clock()
         return [
             {
@@ -389,8 +401,12 @@ class ReservationTable:
                 "hosts": dict(r.hosts),
                 "age_s": round(now - r.created_at, 1),
                 "expires_in_s": round(r.expires_at - now, 1),
+                "priority": r.priority,
             }
-            for k, r in sorted(self.active().items())
+            for k, r in sorted(
+                self.active().items(),
+                key=lambda kv: (-kv[1].priority, kv[0]),
+            )
         ]
 
     def export_state(self) -> Dict[GangKey, dict]:
@@ -408,6 +424,7 @@ class ReservationTable:
                     "demands": list(r.demands),
                     "counted": sorted(r.counted_pods),
                     "age_s": round(max(0.0, now - r.created_at), 3),
+                    "priority": r.priority,
                 }
                 for k, r in self._by_gang.items()
             }

@@ -1478,9 +1478,17 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
         identity: str = "",
         ready_check=None,
         ready_status=None,
+        preemption_handler=None,
     ):
         super().__init__(host, port)
         self.extender = extender or TopologyExtender()
+        # Scheduler-extender ``preemption`` verb (the third verb of
+        # k8s.io/kube-scheduler/extender/v1, next to filter and
+        # prioritize): pod dict → ExtenderPreemptionResult. Wired to
+        # PreemptionEngine.dry_run by the entrypoint; None answers 404
+        # so a scheduler policy declaring preemptVerb against a
+        # preemption-less deployment fails loudly, not emptily.
+        self.preemption_handler = preemption_handler
         # The admitter identity holding the singleton lease (leader.py),
         # served on /reservations so tools/gang can detect a snapshot
         # taken from a non-admitter replica.
@@ -1566,7 +1574,7 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                     verb = self.path.strip("/")
                     metrics.EXTENDER_REQUESTS.inc(
                         verb=verb
-                        if verb in ("filter", "prioritize")
+                        if verb in ("filter", "prioritize", "preemption")
                         else "other",
                         outcome="not_ready",
                     )
@@ -1636,6 +1644,19 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                             if fast_scores is not None
                             else ext.prioritize(pod, items)
                         )
+                    elif self.path == "/preemption":
+                        handler = server.preemption_handler
+                        if handler is None:
+                            self._send(
+                                {"error": "preemption not enabled"},
+                                404,
+                            )
+                            return
+                        # Dry-run only over HTTP: the scheduler that
+                        # calls this verb executes the evictions
+                        # itself; the in-process engine's own rounds
+                        # ride the admission tick instead.
+                        self._send(handler(pod))
                     else:
                         self._send({"error": f"unknown path {self.path}"}, 404)
                         return

@@ -477,6 +477,30 @@ class KubeClient:
                 return {}
             raise
 
+    def delete_pod(self, namespace: str, name: str) -> dict:
+        """Plain pod delete — the fallback when the Eviction
+        subresource cannot serve (e.g. an apiserver build without the
+        policy group); unlike evict_pod it does NOT honor
+        PodDisruptionBudgets, so callers reach for it only after the
+        subresource path failed. A 404 means already gone — success."""
+        try:
+            return self.delete(f"/api/v1/namespaces/{namespace}/pods/{name}")
+        except KubeError as e:
+            if e.status_code == 404:
+                return {}
+            raise
+
+    # -- priority classes --------------------------------------------------
+
+    def list_priority_classes(self) -> dict:
+        """scheduling.k8s.io/v1 PriorityClassList — the cluster's
+        priority vocabulary. The preemption tier resolver
+        (extender/preemption.py) folds name→value once and refreshes on
+        unknown-class misses, so steady state costs zero RPCs."""
+        return self.get(
+            "/apis/scheduling.k8s.io/v1/priorityclasses", verb="LIST"
+        )
+
     def patch_pod_annotations(
         self,
         namespace: str,

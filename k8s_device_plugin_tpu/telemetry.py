@@ -110,6 +110,26 @@ def update_node_gauges(mesh, free_ids) -> dict:
     return stats
 
 
+def gang_duty_cycles() -> Dict[str, float]:
+    """gang label → mean duty-cycle % across the chips attributed to
+    it on the sampler's last pass — the work-in-flight signal the
+    preemption planner's victim ranking consumes
+    (extender/preemption.py): an idle gang is a cheaper victim than
+    one at 95% duty. Empty when no sampler runs in this process (the
+    attribution join and the duty series both live on the node
+    daemon; a split deployment injects its own source)."""
+    sampler = SAMPLER
+    if sampler is None:
+        return {}
+    sums: Dict[str, list] = {}
+    for chip in sampler.snapshot().get("chips", []):
+        gang = chip.get("gang")
+        duty = chip.get("duty_cycle_pct")
+        if gang and duty is not None:
+            sums.setdefault(gang, []).append(float(duty))
+    return {g: sum(v) / len(v) for g, v in sums.items()}
+
+
 def debug_snapshot() -> dict:
     """The /debug/telemetry payload (metrics.debug_payload): sampler
     state + last per-chip readings with attribution (plugin daemon),

@@ -11,7 +11,7 @@ Consumes the two artifacts the decision-provenance layer produces
 * a **trace export** — ``GET /debug/traces`` (OTLP-JSON), rendered
   beneath the decision chain via tools/trace.py's tree renderer.
 
-Three questions, three selectors:
+Four questions, four selectors:
 
 * ``--pod X``  — the full decision chain for one allocation: the pod's
   own filter/prioritize records, its gang's admission records, and
@@ -21,6 +21,11 @@ Three questions, three selectors:
   with their capacity shortfalls, the admit, releases.
 * ``--node Y`` — why the node was rejected: its filter_reject records
   grouped by reason.
+* ``--evicted Z`` — why the gang was preempted: its ``preempt_victim``
+  selection records (evictor, rank, tier, and the duty-cycle /
+  checkpoint-age cost facts frozen at decision time) joined with the
+  evictor gang's ``preemption`` round records
+  (extender/preemption.py).
 
     python -m k8s_device_plugin_tpu.tools.explain --pod my-pod \
         --url http://extender:12346
@@ -159,6 +164,57 @@ def render_gang(records: List[dict], spans: List[dict],
     return out
 
 
+def render_evicted(records: List[dict], spans: List[dict],
+                   gang: str) -> List[str]:
+    """'Why was I evicted': the victim gang's preempt_victim records
+    (cost ranking at decision time) merged with the evictor's
+    preemption-round records, chronological, traces beneath."""
+    mine = sorted(
+        (
+            r for r in records
+            if r.get("kind") == "preempt_victim"
+            and _name_match(r.get("gang", ""), gang)
+        ),
+        key=lambda r: r.get("ts", 0),
+    )
+    if not mine:
+        return [f"(no preemption records for gang {gang!r})"]
+    evictors = {
+        (r.get("attrs") or {}).get("evictor", "")
+        for r in mine
+        if (r.get("attrs") or {}).get("evictor")
+    }
+    rounds = [
+        r for r in records
+        if r.get("kind") == "preemption" and r.get("gang") in evictors
+    ]
+    last = mine[-1]
+    attrs = last.get("attrs") or {}
+    head = (
+        f"gang {gang}: evicted by {attrs.get('evictor', '?')} "
+        f"(victim tier {attrs.get('victim_tier', '?')}, rank "
+        f"{attrs.get('rank', '?')}"
+    )
+    # The ledger stringifies attrs ("" = unknown), but file inputs may
+    # carry raw numbers — 0.0 (the idle, just-checkpointed canonical
+    # cheapest victim) is a COST FACT, not an absent one.
+    if attrs.get("duty_cycle") not in ("", None):
+        head += f", duty {attrs['duty_cycle']}%"
+    if attrs.get("checkpoint_age_s") not in ("", None):
+        head += f", last checkpoint {attrs['checkpoint_age_s']}s ago"
+    head += ")"
+    chain = sorted(mine + rounds, key=lambda r: r.get("ts", 0))
+    out = [head, ""]
+    out += [_record_line(r) for r in chain]
+    traces = {r["trace_id"] for r in chain if r.get("trace_id")}
+    for tid in sorted(traces):
+        members = [s for s in spans if s["trace_id"] == tid]
+        if members:
+            out.append("")
+            out += render_trace_tree(members, trace_id=tid)
+    return out
+
+
 def render_node(records: List[dict], node: str) -> List[str]:
     mine = sorted(
         (r for r in records if r.get("node") == node),
@@ -268,6 +324,24 @@ def _self_test() -> Tuple[List[dict], List[dict]]:
                 "['c0', 'c1']",
                 requested="c2,c3", assigned="c0,c1",
             )
+        # The preemption chain (extender/preemption.py kinds): a
+        # batch victim selected and evicted for the demo gang — what
+        # the --evicted view renders.
+        led.record(
+            "preempt_victim", "selected",
+            "victim 1/1 for default/demo: priority -10, restart "
+            "cost 12.0",
+            gang="default/batch", evictor="default/demo",
+            rank=1, victim_tier="batch", victim_priority=-10,
+            chips=4, duty_cycle=2.0, checkpoint_age_s=8.5,
+        )
+        led.record(
+            "preemption", "executed",
+            "evicted 1 lower-priority gang(s) (default/batch) "
+            "freeing 4 chip(s) for [4]",
+            gang="default/demo", tier="high", victims="default/batch",
+            victim_count=1, freed_chips=4,
+        )
         return (
             led.snapshot()["records"],
             _flatten_otlp(collector.otlp_json()),
@@ -288,6 +362,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--gang", default="",
                    help="gang name or namespace/name")
     p.add_argument("--node", default="", help="node name")
+    p.add_argument(
+        "--evicted", default="",
+        help="victim gang name or namespace/name: why was this gang "
+        "preempted (victim selection + the evictor's round records)",
+    )
     p.add_argument(
         "--url", default="",
         help="daemon base URL; fetches /debug/decisions and "
@@ -322,10 +401,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"self-test failed: missing {missing}",
                   file=sys.stderr)
             return 1
+        # The evicted view over the same synthetic ledger: the
+        # victim's cost facts and the evictor's round must render.
+        ev_lines = render_evicted(records, spans, "batch")
+        ev_text = "\n".join(ev_lines)
+        ev_needed = (
+            "evicted by default/demo", "preempt_victim", "preemption",
+            "duty 2.0%",
+        )
+        ev_missing = [n for n in ev_needed if n not in ev_text]
+        if ev_missing:
+            print(f"self-test failed: evicted view missing "
+                  f"{ev_missing}", file=sys.stderr)
+            return 1
         return 0
-    if not (a.pod or a.gang or a.node):
-        p.error("one of --pod / --gang / --node is required "
-                "(or --self-test)")
+    if not (a.pod or a.gang or a.node or a.evicted):
+        p.error("one of --pod / --gang / --node / --evicted is "
+                "required (or --self-test)")
     if not (a.url or a.decisions):
         p.error("a source is required: --url and/or --decisions")
     try:
@@ -337,10 +429,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         lines = render_pod(records, spans, a.pod)
     elif a.gang:
         lines = render_gang(records, spans, a.gang)
+    elif a.evicted:
+        lines = render_evicted(records, spans, a.evicted)
     else:
         lines = render_node(records, a.node)
     print("\n".join(lines))
-    return 0 if not lines[0].startswith("(no decision records") else 1
+    return 0 if not lines[0].startswith("(no ") else 1
 
 
 if __name__ == "__main__":

@@ -536,11 +536,31 @@ EXTENDER_REQUESTS = EXTENDER_REGISTRY.counter(
 )
 GANG_RELEASED = EXTENDER_REGISTRY.counter(
     "tpu_gang_released_total",
-    "Pod gangs released (scheduling gates removed) by the admitter",
+    "Pod gangs released (scheduling gates removed) by the admitter, "
+    "by priority tier (critical/high/standard/batch — "
+    "extender/preemption.py tier_label); sum() for the total",
 )
 GANG_WAITING = EXTENDER_REGISTRY.gauge(
     "tpu_gang_waiting",
-    "Complete gangs currently gated for lack of TPU capacity",
+    "Complete gangs currently gated for lack of TPU capacity, by "
+    "priority tier (emptied tiers prune their series); sum() for the "
+    "total",
+)
+# Priority & preemption (extender/preemption.py): the multi-tenant
+# scheduling plane — high-tier gangs evict lower-tier running gangs
+# when no box is placeable, two-phase journaled.
+PREEMPTIONS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_preemptions_total",
+    "Preemption rounds by the PREEMPTOR gang's tier and outcome "
+    "(executed: victims evicted and the freed box reserved; blocked: "
+    "an eviction was refused — PodDisruptionBudget or apiserver — "
+    "and the round aborted for retry next tick)",
+)
+PREEMPTION_VICTIMS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_preemption_victims_total",
+    "Gangs evicted by preemption, by the VICTIM's tier — a growing "
+    "critical/high share means high tiers are cannibalizing each "
+    "other and the cluster needs capacity, not priorities",
 )
 GANG_RESERVED = EXTENDER_REGISTRY.gauge(
     "tpu_gang_reservations",
@@ -769,8 +789,10 @@ GANG_PENDING_EVENTS = EXTENDER_REGISTRY.counter(
 STATE_JOURNAL_RECORDS = EXTENDER_REGISTRY.counter(
     "tpu_extender_state_journal_records_total",
     "Admission-state journal records appended, by op (reserve/shrink/"
-    "renew/drop/lapse/admit/wait/wait_clear; error = append failed and "
-    "the transition was NOT journaled)",
+    "renew/drop/lapse/admit/wait/wait_clear plus the two-phase "
+    "preemption protocol preempt_intent/preempt_evicted/preempt_done/"
+    "preempt_abort; error = append failed and the transition was NOT "
+    "journaled)",
 )
 STATE_JOURNAL_BYTES = EXTENDER_REGISTRY.gauge(
     "tpu_extender_state_journal_bytes",

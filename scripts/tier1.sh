@@ -75,6 +75,15 @@ python -m k8s_device_plugin_tpu.tools.flame --self-test > /dev/null \
 # export, the bundle layout, and the renderer fails CI here.
 python -m k8s_device_plugin_tpu.extender.scale_bench --profile-self-test > /dev/null \
   || { echo "scale_bench --profile-self-test FAILED"; exit 1; }
+# Preemption smoke: a full 2-node sim cluster held by two batch
+# gangs, a high-priority gang arrives gated — one admission tick must
+# plan a minimal victim set (cost-ranked by checkpoint recency), evict
+# it, fence the freed chips, and release the preemptor's gates,
+# two-phase journaled (extender/preemption.py --self-test); a
+# planner/engine/journal plumbing drift fails CI here, before the
+# chaos kill-point matrix in tests/test_chaos_journal.py.
+python -m k8s_device_plugin_tpu.extender.preemption --self-test > /dev/null \
+  || { echo "extender/preemption.py --self-test FAILED"; exit 1; }
 # Static-analysis engine smoke: every tpu-lint rule must detect its
 # embedded seeded violation (and stay quiet on the clean twin), the
 # registry scanner's inventories must be non-empty, and the static

@@ -143,8 +143,15 @@ class FakeApiServer:
         self.node_status_patches: List[Tuple[str, dict]] = []
         self.events: List[dict] = []
         self.evictions: List[Tuple[str, str]] = []
+        # Plain pod DELETEs (the eviction-subresource fallback path) —
+        # distinct from self.evictions so tests can tell which door a
+        # pod left through.
+        self.deletions: List[Tuple[str, str]] = []
         # True = answer evictions with 429 (PodDisruptionBudget blocked).
         self.block_evictions = False
+        # scheduling.k8s.io/v1: name -> PriorityClass (the preemption
+        # tier resolver lists these).
+        self.priorityclasses: Dict[str, dict] = {}
         # coordination.k8s.io: (ns, name) -> Lease (extender singleton
         # fence).
         self._leases: Dict[Tuple[str, str], dict] = {}
@@ -190,6 +197,18 @@ class FakeApiServer:
             if pod is not None:
                 pod["metadata"]["resourceVersion"] = self._next_rv()
                 self._broadcast("DELETED", pod)
+
+    def add_priority_class(
+        self, name: str, value: int, global_default: bool = False
+    ):
+        with self._lock:
+            self.priorityclasses[name] = {
+                "apiVersion": "scheduling.k8s.io/v1",
+                "kind": "PriorityClass",
+                "metadata": {"name": name},
+                "value": int(value),
+                "globalDefault": bool(global_default),
+            }
 
     def add_resource_claim(self, claim: dict):
         meta = claim.setdefault("metadata", {})
@@ -272,6 +291,29 @@ class FakeApiServer:
                             server._send_json(self, pod)
                     else:
                         self.send_error(404)
+                elif parsed.path == (
+                    "/apis/scheduling.k8s.io/v1/priorityclasses"
+                ):
+                    with server._lock:
+                        items = list(server.priorityclasses.values())
+                    server._send_json(
+                        self,
+                        {"kind": "PriorityClassList", "items": items},
+                    )
+                elif parsed.path.startswith(
+                    "/apis/scheduling.k8s.io/v1/priorityclasses/"
+                ):
+                    name = parsed.path.rsplit("/", 1)[1]
+                    with server._lock:
+                        pc = server.priorityclasses.get(name)
+                    if pc is None:
+                        server._send_json(
+                            self,
+                            {"message": "priorityclass not found"},
+                            404,
+                        )
+                    else:
+                        server._send_json(self, pc)
                 elif parsed.path.startswith(
                     "/apis/coordination.k8s.io/v1/namespaces/"
                 ):
@@ -431,7 +473,27 @@ class FakeApiServer:
                 if server._apply_fault(self, "DELETE"):
                     return
                 parts = self.path.strip("/").split("/")
+                # api/v1/namespaces/{ns}/pods/{name}: the plain-delete
+                # fallback of the eviction flow (no PDB consultation,
+                # like the real apiserver's pod DELETE).
                 if (
+                    len(parts) == 6
+                    and parts[2] == "namespaces"
+                    and parts[4] == "pods"
+                ):
+                    ns, name = parts[3], parts[5]
+                    with server._lock:
+                        exists = (ns, name) in server.pods
+                    if not exists:
+                        server._send_json(
+                            self, {"message": "pod not found"}, 404
+                        )
+                    else:
+                        with server._lock:
+                            server.deletions.append((ns, name))
+                        server.delete_pod(ns, name)
+                        server._send_json(self, {"status": "Success"})
+                elif (
                     len(parts) == 5
                     and parts[1] == "resource.k8s.io"
                     and parts[3] == "resourceslices"

@@ -665,3 +665,179 @@ def test_mid_rebalance_death_second_takeover_replays_idempotently(
         assert GATE_NAME not in gates_of(server, "default", f"{gname}-w{i}")
     assert adopted.tick() == []  # exactly once
     m3.stop()
+
+
+# ---------------------------------------------------------------------------
+# Mid-preemption kill points (PR 13, extender/preemption.py two-phase
+# protocol): SIGKILL anywhere inside a preemption round must rehydrate
+# to a state where no gang is gateless-and-unfenced and no chip can be
+# double-booked.
+# ---------------------------------------------------------------------------
+
+def _preemption_cluster(server):
+    """One full 4-chip node held by a 2-pod batch gang, plus a gated
+    4-chip high-priority gang that can only admit by preempting."""
+    from tests.test_preemption import running_gang_pod
+
+    node, mesh = make_node("n1", n=4, available=[])
+    server.add_node("n1", node)
+    for i in range(2):
+        server.add_pod(running_gang_pod(
+            f"b{i}", "batch", 2, 2, "n1", priority=-10
+        ))
+    hp = gang_pod("prod-w0", "prod", 1, 4)
+    hp["spec"]["priority"] = 100000
+    server.add_pod(hp)
+    return node, mesh
+
+
+def _wire_preemption(adm, client):
+    from k8s_device_plugin_tpu.extender.preemption import (
+        PreemptionEngine,
+        PriorityResolver,
+    )
+
+    resolver = PriorityResolver(client)
+    adm.priority_resolver = resolver
+    adm.preemption = PreemptionEngine(adm, resolver)
+
+
+def _republish(server, mesh, available):
+    """The node daemon freeing evicted chips and republishing."""
+    from k8s_device_plugin_tpu.api import constants
+    from k8s_device_plugin_tpu.topology.schema import NodeTopology
+
+    topo = NodeTopology.from_mesh(
+        mesh, hostname="n1", available=available
+    )
+    node = {
+        "metadata": {
+            "name": "n1",
+            "annotations": {
+                constants.TOPOLOGY_ANNOTATION: topo.to_json()
+            },
+        }
+    }
+    server.add_node("n1", node)
+    return node
+
+
+def test_sigkill_mid_preemption_evictions_aborts_then_replans(
+    api, tmp_path
+):
+    """Kill-point 5: after preempt_intent, mid-eviction (one victim
+    pod evicted, one not). Recovery aborts the open intent — nothing
+    was fenced, the preemptor is still gated (never
+    gateless-and-unfenced) — and the next tick re-plans from cluster
+    truth and finishes the job exactly once."""
+    server, client = api
+    _, mesh = _preemption_cluster(server)
+
+    kp = KillPointClient(client, "evict_pod", calls_before_kill=1)
+    adm1 = GangAdmission(
+        kp,
+        reservations=ReservationTable(),
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    _wire_preemption(adm1, client)
+    with pytest.raises(SigKill):
+        adm1.tick()
+    # Exactly one victim pod left through the eviction door; the
+    # intent is durable (critical op), nothing was reserved.
+    assert len(server.evictions) == 1
+
+    adm2, table2 = fresh_admission(client, tmp_path)
+    _wire_preemption(adm2, client)
+    summary = adm2.recover()
+    assert summary["preempt_aborted"] == 1
+    assert summary["preempt_refenced"] == 0
+    # Safe state: nothing fenced (conservative — no reserve ever
+    # landed) and the preemptor is still gated.
+    assert table2.active() == {}
+    assert GATE_NAME in gates_of(server, "default", "prod-w0")
+
+    # The node daemon frees the evicted pod's 2 chips and republishes;
+    # the retry round evicts only the REMAINING victim pod and admits.
+    _republish(server, mesh, mesh.ids[:2])
+    released = adm2.tick()
+    assert released == [("default", "prod")]
+    assert len(server.evictions) == 2  # one more, not a re-evict storm
+    assert GATE_NAME not in gates_of(server, "default", "prod-w0")
+    # The fence stands for the full demand: no chip double-bookable.
+    assert table2.reserved_chips("n1") == 4
+    assert adm2.preemption.open_intents() == {}
+    adm2.journal.close()
+
+
+def test_sigkill_between_evictions_and_fence_refences_on_recovery(
+    api, tmp_path
+):
+    """Kill-point 6: after preempt_evicted, before the reserve — the
+    exact window where freed chips would be stealable. Recovery
+    re-installs the planned fence BEHIND the readiness gate (before
+    any /filter or tick), the release finishes against the standing
+    hold, and the audit invariants sweep clean."""
+    from k8s_device_plugin_tpu import audit
+    from k8s_device_plugin_tpu.api import constants
+
+    server, client = api
+    node, mesh = _preemption_cluster(server)
+
+    table1 = ReservationTable()
+    adm1 = GangAdmission(
+        client,
+        reservations=table1,
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    _wire_preemption(adm1, client)
+
+    def die_on_reserve(*a, **kw):
+        raise SigKill("between preempt_evicted and reserve")
+
+    table1.reserve = die_on_reserve
+    with pytest.raises(SigKill):
+        adm1.tick()
+    # Both victim pods are gone; the evicted phase is durable.
+    assert len(server.evictions) == 2
+
+    adm2, table2 = fresh_admission(client, tmp_path)
+    _wire_preemption(adm2, client)
+    summary = adm2.recover()
+    assert summary["preempt_refenced"] == 1
+    assert summary["preempt_aborted"] == 0
+    # The fence was re-installed from the journaled plan BEFORE any
+    # tick: the freed chips cannot be stolen — and the preemptor's
+    # priority survived the crash with it.
+    assert table2.reserved_chips("n1") == 4
+    assert table2.active()[("default", "prod")].priority == 100000
+
+    # The daemon republishes the freed chips; a competitor pod's
+    # /filter is shielded by the rehydrated fence — the steal window
+    # stayed closed through the whole crash.
+    fresh_node = _republish(server, mesh, list(mesh.ids))
+    ext = TopologyExtender(reservations=table2)
+    passing, failed = ext.filter(tpu_pod(2), [fresh_node])
+    assert passing == []
+    assert "reserved for a released gang" in failed["n1"]
+
+    # The next tick finishes the release against the standing hold
+    # (the release_retry path): gates off, fence still standing —
+    # never gateless-and-unfenced at any point.
+    released = adm2.tick()
+    assert released == [("default", "prod")]
+    assert GATE_NAME not in gates_of(server, "default", "prod-w0")
+    assert table2.reserved_chips("n1") == 4
+    assert adm2.preemption.open_intents() == {}
+
+    # Audit invariants clean after rehydration: no double-booked chip
+    # (reservation_vs_journal, reservation_vs_cluster), no
+    # gateless-and-unfenced gang (gate_vs_hold).
+    eng = audit.ExtenderAudit(
+        reservations=table2, journal=adm2.journal, gang=adm2
+    ).engine()
+    findings = eng.sweep_once()
+    assert [f for f in findings if f.severity == audit.CRITICAL] == []
+    assert [
+        f for f in findings if f.invariant == "gate_vs_hold"
+    ] == []
+    adm2.journal.close()

@@ -350,9 +350,15 @@ def test_waiting_gauge_resets_when_gangs_vanish(api):
     server.add_pod(gang_pod("w0", "toobig", 1, 64))
     adm = GangAdmission(client)
     assert adm.tick() == []
-    assert "tpu_gang_waiting 1" in metrics.EXTENDER_REGISTRY.render()
+    # Tier-labeled since PR 13: no resolver wired means priority 0 =
+    # the standard tier.
+    assert (
+        'tpu_gang_waiting{tier="standard"} 1'
+        in metrics.EXTENDER_REGISTRY.render()
+    )
     server.delete_pod("default", "w0")
     assert adm.tick() == []
+    # The emptied tier drops its series; the family renders 0.
     assert "tpu_gang_waiting 0" in metrics.EXTENDER_REGISTRY.render()
 
 

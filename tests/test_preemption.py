@@ -1,0 +1,548 @@
+"""Priority tiers & cost-aware preemption (extender/preemption.py).
+
+Covers the PR-13 acceptance criteria:
+
+* a high-tier gang on a deliberately full sim cluster is admitted
+  within ONE preemption round (plan → evict → fence → release, one
+  tick);
+* victim selection prefers (a) lower tier, (b) most-recent checkpoint
+  / lowest duty cycle, and never evicts more gangs than needed to
+  free one placeable box (greedy + prune minimality);
+* the decision ledger's preemption records answer "why was I evicted"
+  end-to-end through tools/explain.py's --evicted view;
+* the scheduler-extender /preemption HTTP verb serves the dry-run
+  node→victims map;
+* PriorityClass resolution (fake apiserver scheduling.k8s.io/v1) and
+  the eviction subresource's plain-delete fallback.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.extender.gang import (
+    GATE_NAME,
+    GangAdmission,
+)
+from k8s_device_plugin_tpu.extender.preemption import (
+    PreemptionEngine,
+    PreemptionPlanner,
+    PriorityResolver,
+    Victim,
+    tier_label,
+)
+from k8s_device_plugin_tpu.extender.reservations import ReservationTable
+from k8s_device_plugin_tpu.extender.server import (
+    ExtenderHTTPServer,
+    TopologyExtender,
+)
+from k8s_device_plugin_tpu.kube.client import KubeClient, KubeError
+from k8s_device_plugin_tpu.utils import metrics
+from k8s_device_plugin_tpu.utils.decisions import LEDGER
+from tests.fake_apiserver import FakeApiServer
+from tests.test_extender import make_node, tpu_pod
+from tests.test_gang import gang_pod, gates_of
+
+
+@pytest.fixture
+def api():
+    s = FakeApiServer()
+    url = s.start()
+    yield s, KubeClient(url), url
+    s.stop()
+
+
+def running_gang_pod(
+    name, gang, size, chips, node, priority=None, ckpt_ts=None,
+    ns="default",
+):
+    """A placed (running, ungated) gang member — preemption's victim
+    shape."""
+    pod = gang_pod(name, gang, size, chips, ns=ns)
+    pod["spec"]["schedulingGates"] = []
+    pod["spec"]["nodeName"] = node
+    pod["metadata"]["uid"] = f"uid-{name}"
+    if priority is not None:
+        pod["spec"]["priority"] = priority
+    if ckpt_ts is not None:
+        pod["metadata"].setdefault("annotations", {})[
+            constants.CHECKPOINT_TS_ANNOTATION
+        ] = str(ckpt_ts)
+    return pod
+
+
+def full_node(server, name, n=4):
+    """A node whose published availability is zero (every chip held)."""
+    node, mesh = make_node(name, n=n, available=[])
+    server.add_node(name, node)
+    return node, mesh
+
+
+def wire(adm, client, **engine_kw):
+    resolver = PriorityResolver(client)
+    adm.priority_resolver = resolver
+    adm.preemption = PreemptionEngine(adm, resolver, **engine_kw)
+    return adm.preemption
+
+
+# ---------------------------------------------------------------------------
+# tiers & resolver
+# ---------------------------------------------------------------------------
+
+def test_tier_label_thresholds():
+    assert tier_label(2_000_000_000) == "critical"
+    assert tier_label(1_000_000) == "critical"
+    assert tier_label(100_000) == "high"
+    assert tier_label(1_000) == "high"
+    assert tier_label(999) == "standard"
+    assert tier_label(0) == "standard"
+    assert tier_label(-1) == "batch"
+
+
+def test_priority_resolver_resolves_priorityclass(api):
+    server, client, _ = api
+    server.add_priority_class("prod-inference", 100000)
+    server.add_priority_class("batch", -10, global_default=True)
+    r = PriorityResolver(client)
+    pod = tpu_pod(2)
+    # spec.priority wins outright (already admission-resolved).
+    pod["spec"]["priority"] = 7
+    assert r.pod_priority(pod) == 7
+    del pod["spec"]["priority"]
+    pod["spec"]["priorityClassName"] = "prod-inference"
+    assert r.pod_priority(pod) == 100000
+    # No class, no priority: the cluster's globalDefault.
+    del pod["spec"]["priorityClassName"]
+    assert r.pod_priority(pod) == -10
+    # Unknown class name degrades to the default, never raises.
+    pod["spec"]["priorityClassName"] = "no-such-class"
+    assert r.pod_priority(pod) == -10
+    # gang priority = max over members.
+    hi = tpu_pod(2)
+    hi["spec"]["priority"] = 50
+    assert r.gang_priority([pod, hi]) == 50
+
+
+def test_priority_resolver_without_client():
+    r = PriorityResolver(None)
+    pod = tpu_pod(1)
+    assert r.pod_priority(pod) == 0
+    pod["spec"]["priority"] = -5
+    assert r.pod_priority(pod) == -5
+
+
+# ---------------------------------------------------------------------------
+# fake apiserver satellites: PriorityClass GET + plain pod DELETE
+# ---------------------------------------------------------------------------
+
+def test_fake_apiserver_priorityclass_endpoints(api):
+    server, client, url = api
+    server.add_priority_class("gold", 5000)
+    listing = client.list_priority_classes()
+    assert [i["value"] for i in listing["items"]] == [5000]
+    with urllib.request.urlopen(
+        f"{url}/apis/scheduling.k8s.io/v1/priorityclasses/gold"
+    ) as resp:
+        assert json.loads(resp.read())["value"] == 5000
+
+
+def test_fake_apiserver_plain_pod_delete(api):
+    server, client, _ = api
+    server.add_pod(running_gang_pod("v0", "victim", 1, 2, "n1"))
+    client.delete_pod("default", "v0")
+    assert ("default", "v0") not in server.pods
+    assert server.deletions == [("default", "v0")]
+    assert server.evictions == []  # the OTHER door stayed shut
+    # Already gone = success, like the real apiserver contract.
+    assert client.delete_pod("default", "v0") == {}
+
+
+def test_eviction_fallback_to_delete(api):
+    """A non-429 eviction failure falls back to plain delete."""
+    server, client, _ = api
+    server.add_pod(running_gang_pod("v0", "victim", 1, 2, "n1"))
+    server.faults.add(
+        kind="status", status=405, times=-1, method="POST",
+        path_re=r"/eviction$",
+    )
+    table = ReservationTable()
+    adm = GangAdmission(client, reservations=table)
+    eng = wire(adm, client)
+    v = Victim(
+        key=("default", "victim"), priority=-1, hosts={"n1": 2},
+        pods=[{"ns": "default", "name": "v0", "uid": "u", "host": "n1",
+               "chips": 2}],
+    )
+    assert eng._evict_pod(v, v.pods[0]) is True
+    assert server.deletions == [("default", "v0")]
+
+
+# ---------------------------------------------------------------------------
+# planner unit tests
+# ---------------------------------------------------------------------------
+
+def planner(duty=None):
+    return PreemptionPlanner(
+        PriorityResolver(None),
+        duty_source=(lambda: duty or {}),
+    )
+
+
+def topo_of(name, n=4, available=()):
+    node, mesh = make_node(name, n=n, available=list(available))
+    from k8s_device_plugin_tpu.topology.schema import (
+        parse_topology_cached,
+    )
+
+    return parse_topology_cached(
+        node["metadata"]["annotations"][constants.TOPOLOGY_ANNOTATION]
+    )
+
+
+def mk_victim(gang, priority, hosts, duty=None, ckpt_age=None):
+    pods = [
+        {"ns": "default", "name": f"{gang}-w{i}", "uid": f"u-{gang}{i}",
+         "host": h, "chips": c}
+        for i, (h, c) in enumerate(hosts.items())
+    ]
+    return Victim(
+        key=("default", gang), priority=priority, hosts=dict(hosts),
+        pods=pods, duty_cycle=duty, checkpoint_age_s=ckpt_age,
+    )
+
+
+def test_planner_prefers_lower_tier():
+    topos = [topo_of("n1"), topo_of("n2")]  # both full (4 chips each)
+    victims = [
+        mk_victim("standard-job", 0, {"n1": 4}),
+        mk_victim("batch-job", -10, {"n2": 4}),
+    ]
+    plan = planner().plan(
+        ("default", "prod"), [4], 100000, topos, victims
+    )
+    assert plan is not None
+    assert [v.key[1] for v in plan.victims] == ["batch-job"]
+
+
+def test_planner_prefers_recent_checkpoint_and_low_duty():
+    topos = [topo_of("n1"), topo_of("n2")]
+    # Equal priority: the recently-checkpointed idle gang is cheaper
+    # than the busy one with an hour of unsaved work.
+    victims = [
+        mk_victim("busy-stale", -10, {"n1": 4}, duty=95.0,
+                  ckpt_age=3600.0),
+        mk_victim("idle-fresh", -10, {"n2": 4}, duty=2.0,
+                  ckpt_age=10.0),
+    ]
+    plan = planner().plan(
+        ("default", "prod"), [4], 1000, topos, victims
+    )
+    assert [v.key[1] for v in plan.victims] == ["idle-fresh"]
+    # And with only duty differing (no beacons), idle still wins.
+    victims = [
+        mk_victim("busy", -10, {"n1": 4}, duty=95.0),
+        mk_victim("idle", -10, {"n2": 4}, duty=1.0),
+    ]
+    plan = planner().plan(
+        ("default", "prod"), [4], 1000, topos, victims
+    )
+    assert [v.key[1] for v in plan.victims] == ["idle"]
+
+
+def test_planner_never_evicts_more_than_needed():
+    """Greedy picks the cheap-but-insufficient victim first; the prune
+    pass drops it once the sufficient one lands — exactly one gang
+    pays."""
+    # n1 full, held entirely by the EXPENSIVE victim; n2 full, the
+    # cheap victim holds only 2 of its 4 chips (freeing it leaves 2).
+    topos = [topo_of("n1"), topo_of("n2")]
+    victims = [
+        mk_victim("cheap-small", -10, {"n2": 2}, duty=0.0),
+        mk_victim("pricey-big", -10, {"n1": 4}, duty=90.0),
+    ]
+    plan = planner().plan(
+        ("default", "prod"), [4], 1000, topos, victims
+    )
+    assert plan is not None
+    assert [v.key[1] for v in plan.victims] == ["pricey-big"]
+    assert plan.freed == {"n1": 4}
+
+
+def test_planner_only_strictly_lower_priority(api):
+    """Victims at or above the preemptor's priority are untouchable."""
+    server, client, _ = api
+    server.add_pod(
+        running_gang_pod("eq0", "equal", 1, 4, "n1", priority=1000)
+    )
+    adm = GangAdmission(client, reservations=ReservationTable())
+    eng = wire(adm, client)
+    gangs = adm._collect_gangs()
+    victims = eng.planner.collect_victims(
+        gangs, ("default", "prod"), 1000
+    )
+    assert victims == []  # 1000 is not < 1000
+
+
+def test_planner_no_plan_when_nothing_frees_a_box():
+    topos = [topo_of("n1")]
+    victims = [mk_victim("small", -10, {"n1": 2})]
+    # Demand 4, only 2 chips evictable: no plan, no partial eviction.
+    assert (
+        planner().plan(("default", "p"), [4], 1000, topos, victims)
+        is None
+    )
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e: full cluster, one preemption round
+# ---------------------------------------------------------------------------
+
+def test_high_tier_gang_admitted_within_one_preemption_round(api):
+    server, client, _ = api
+    server.add_priority_class("prod-inference", 100000)
+    full_node(server, "n1")
+    full_node(server, "n2")
+    now = time.time()
+    # Two batch gangs hold the cluster: batch-a checkpointed seconds
+    # ago (cheap), batch-b has ~an hour of unsaved work (expensive).
+    for i in range(2):
+        server.add_pod(running_gang_pod(
+            f"ba{i}", "batch-a", 2, 2, "n1", priority=-10,
+            ckpt_ts=now - 5,
+        ))
+        server.add_pod(running_gang_pod(
+            f"bb{i}", "batch-b", 2, 2, "n2", priority=-10,
+            ckpt_ts=now - 3500,
+        ))
+    # The high-tier gang: a 4-chip cube, gated.
+    hp = gang_pod("prod-w0", "prod", 1, 4)
+    hp["spec"]["priorityClassName"] = "prod-inference"
+    server.add_pod(hp)
+
+    pre_exec = metrics.PREEMPTIONS.get(tier="high",
+                                       outcome="executed")
+    pre_victims = metrics.PREEMPTION_VICTIMS.get(victim_tier="batch")
+    table = ReservationTable()
+    adm = GangAdmission(client, reservations=table)
+    wire(adm, client)
+    released = adm.tick()
+
+    # Admitted within one preemption round: gates off this very tick.
+    assert released == [("default", "prod")]
+    assert GATE_NAME not in gates_of(server, "default", "prod-w0")
+    # The cheaper victim (recent checkpoint) paid; batch-b survived.
+    evicted = {name for _, name in server.evictions}
+    assert evicted == {"ba0", "ba1"}, server.evictions
+    for i in range(2):
+        assert ("default", f"bb{i}") in server.pods
+    # The freed chips are fenced for the preemptor, priority carried.
+    hold = table.active()[("default", "prod")]
+    assert sum(hold.hosts.values()) == 4
+    assert hold.priority == 100000
+    snap = table.snapshot()
+    assert snap[0]["gang"] == "prod" and snap[0]["priority"] == 100000
+    # Per-tier counters moved.
+    assert metrics.PREEMPTIONS.get(
+        tier="high", outcome="executed"
+    ) == pre_exec + 1
+    assert metrics.PREEMPTION_VICTIMS.get(
+        victim_tier="batch"
+    ) == pre_victims + 1
+    # Per-tier released counter carries the preemptor's tier.
+    assert metrics.GANG_RELEASED.get(tier="high") >= 1
+    # No open two-phase round left behind.
+    assert adm.preemption.open_intents() == {}
+    # A victim got the TPUGangPreempted Warning Event.
+    reasons = {e.get("reason") for e in server.events}
+    assert "TPUGangPreempted" in reasons
+
+
+def test_preemption_blocked_by_pdb_aborts_round(api):
+    server, client, _ = api
+    full_node(server, "n1")
+    server.add_pod(running_gang_pod(
+        "b0", "batch", 1, 4, "n1", priority=-10
+    ))
+    hp = gang_pod("prod-w0", "prod", 1, 4)
+    hp["spec"]["priority"] = 100000
+    server.add_pod(hp)
+    server.block_evictions = True
+
+    pre_blocked = metrics.PREEMPTIONS.get(tier="high",
+                                          outcome="blocked")
+    table = ReservationTable()
+    adm = GangAdmission(client, reservations=table)
+    wire(adm, client)
+    assert adm.tick() == []
+    # Round aborted cleanly: victim alive, preemptor still gated,
+    # nothing fenced, no open intent (retry next tick).
+    assert ("default", "b0") in server.pods
+    assert GATE_NAME in gates_of(server, "default", "prod-w0")
+    assert table.active() == {}
+    assert adm.preemption.open_intents() == {}
+    assert metrics.PREEMPTIONS.get(
+        tier="high", outcome="blocked"
+    ) == pre_blocked + 1
+    # PDB lifted: the retry round succeeds.
+    server.block_evictions = False
+    assert adm.tick() == [("default", "prod")]
+
+
+def test_low_priority_gang_cannot_preempt(api):
+    server, client, _ = api
+    full_node(server, "n1")
+    server.add_pod(running_gang_pod(
+        "b0", "batch", 1, 4, "n1", priority=-10
+    ))
+    # The arriving gang is ALSO priority 0 (below the default
+    # preemptor floor of 1): it waits, nothing is evicted.
+    server.add_pod(gang_pod("p0", "plain", 1, 4))
+    adm = GangAdmission(client, reservations=ReservationTable())
+    wire(adm, client)
+    assert adm.tick() == []
+    assert server.evictions == []
+    assert GATE_NAME in gates_of(server, "default", "p0")
+
+
+def test_waiting_gauge_carries_tier(api):
+    server, client, _ = api
+    full_node(server, "n1")
+    server.add_pod(running_gang_pod(
+        "b0", "batch", 1, 4, "n1", priority=0
+    ))
+    hp = gang_pod("prod-w0", "prod", 1, 4)
+    hp["spec"]["priority"] = 100000
+    server.add_pod(hp)
+    adm = GangAdmission(client, reservations=ReservationTable())
+    # Resolver only (no engine): prod waits, labeled critical.
+    adm.priority_resolver = PriorityResolver(client)
+    assert adm.tick() == []
+    assert metrics.GANG_WAITING.get(tier="high") == 1
+    # Capacity appears: the wait clears and the tier series prunes.
+    free, _ = make_node("n2", n=4)
+    server.add_node("n2", free)
+    assert adm.tick() == [("default", "prod")]
+    assert all(
+        labels.get("tier") != "high" or v == 0
+        for labels, v in metrics.GANG_WAITING.series()
+    )
+
+
+# ---------------------------------------------------------------------------
+# the /preemption HTTP verb
+# ---------------------------------------------------------------------------
+
+def post_json(url, path, payload):
+    req = urllib.request.Request(
+        url + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_preemption_verb_serves_dry_run_victims(api):
+    server, client, _ = api
+    full_node(server, "n1")
+    server.add_pod(running_gang_pod(
+        "b0", "batch", 1, 4, "n1", priority=-10
+    ))
+    hp = gang_pod("prod-w0", "prod", 1, 4)
+    hp["spec"]["priority"] = 100000
+    server.add_pod(hp)
+    adm = GangAdmission(client, reservations=ReservationTable())
+    eng = wire(adm, client)
+    srv = ExtenderHTTPServer(
+        extender=TopologyExtender(reservations=adm.reservations),
+        host="127.0.0.1",
+        preemption_handler=eng.dry_run,
+    )
+    url = srv.start()
+    try:
+        status, body = post_json(url, "/preemption", {"pod": hp})
+        assert status == 200
+        victims = body["nodeNameToMetaVictims"]
+        assert set(victims) == {"n1"}
+        assert [p["uid"] for p in victims["n1"]["pods"]] == ["uid-b0"]
+        # Dry run: nothing was actually evicted or fenced.
+        assert server.evictions == []
+        assert adm.reservations.active() == {}
+    finally:
+        srv.stop()
+
+
+def test_preemption_verb_404_when_not_wired():
+    srv = ExtenderHTTPServer(host="127.0.0.1")
+    url = srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post_json(url, "/preemption", {"pod": tpu_pod(2)})
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# explain --evicted end-to-end
+# ---------------------------------------------------------------------------
+
+def test_explain_evicted_answers_end_to_end(api):
+    from k8s_device_plugin_tpu.tools.explain import render_evicted
+
+    server, client, _ = api
+    full_node(server, "n1")
+    now = time.time()
+    server.add_pod(running_gang_pod(
+        "b0", "victim-gang", 1, 4, "n1", priority=-10,
+        ckpt_ts=now - 30,
+    ))
+    hp = gang_pod("prod-w0", "prod", 1, 4)
+    hp["spec"]["priority"] = 2_000_000
+    server.add_pod(hp)
+
+    LEDGER.enable(service="extender")
+    try:
+        adm = GangAdmission(client, reservations=ReservationTable())
+        wire(adm, client)
+        assert adm.tick() == [("default", "prod")]
+        records = LEDGER.snapshot()["records"]
+        lines = render_evicted(records, [], "victim-gang")
+    finally:
+        LEDGER.disable()
+        LEDGER.clear()
+    text = "\n".join(lines)
+    assert "evicted by default/prod" in text
+    assert "victim tier batch" in text
+    assert "preempt_victim" in text
+    assert "preemption" in text
+    assert "last checkpoint" in text
+
+
+# ---------------------------------------------------------------------------
+# checkpoint beacon (workload/checkpointing.py)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_beacon_stamps_annotation(api):
+    ckpt = pytest.importorskip(
+        "k8s_device_plugin_tpu.workload.checkpointing"
+    )
+    server, client, _ = api
+    server.add_pod(running_gang_pod("w0", "train", 1, 2, "n1"))
+    beacon = ckpt.CheckpointBeacon.for_pod(
+        client, namespace="default", name="w0"
+    )
+    assert beacon.note_saved(50) is True
+    ann = server.pods[("default", "w0")]["metadata"]["annotations"]
+    stamped = float(ann[constants.CHECKPOINT_TS_ANNOTATION])
+    assert abs(stamped - time.time()) < 5.0
+    # Best-effort contract: a dead apiserver costs the stamp, nothing
+    # else.
+    bad = ckpt.CheckpointBeacon(lambda ann: (_ for _ in ()).throw(
+        KubeError(500, "down")
+    ))
+    assert bad.note_saved(51) is False

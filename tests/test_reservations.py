@@ -180,7 +180,8 @@ def test_second_gang_waits_on_first_gangs_reservation(api):
     for i in range(2):
         server.add_pod(gang_pod(f"b{i}", "beta", 2, 2))
     assert adm.tick() == []  # beta waits: alpha's hold fences the chips
-    assert metrics.GANG_WAITING.get() == 1
+    # tier-labeled gauge (PR 13): sum across tiers is the total.
+    assert sum(v for _, v in metrics.GANG_WAITING.series()) == 1
     assert GATE_NAME in gates_of(server, "default", "b0")
 
     # Alpha binds and the daemon republishes 0 free: alpha's hold drops
