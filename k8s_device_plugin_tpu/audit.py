@@ -1016,6 +1016,17 @@ class ExtenderAudit:
                 "not rehydrate dies with the process",
                 self.check_reservation_vs_journal,
             ))
+            out.append(Invariant(
+                "defrag_vs_reservations",
+                ("journal", "reservations"),
+                "an open defrag_evicted journal phase must have "
+                "either a standing target-box fence for the stranded "
+                "gang or a journaled abort — victims were already "
+                "evicted, so a fenceless mid-migration round hands "
+                "the freed box to a scavenger and leaves the "
+                "stranded gang gateless-and-unfenced",
+                self.check_defrag_vs_reservations,
+            ))
         if self.gang is not None and self.reservations is not None:
             out.append(Invariant(
                 "reservation_vs_cluster",
@@ -1134,6 +1145,75 @@ class ExtenderAudit:
                         f"table ({lh}) and journal replay ({rh})",
                         gang=f"{key[0]}/{key[1]}",
                         table=lh, journal=rh,
+                    ))
+            return out
+
+        out = diff()
+        return diff() if out else out
+
+    def check_defrag_vs_reservations(self) -> List[Finding]:
+        """The defrag two-phase contract (extender/defrag.py),
+        re-proven from the journal each sweep: once a round reaches
+        ``defrag_evicted`` its victims are GONE, so the only safe
+        states are "target box fenced under the stranded gang's key"
+        or "round closed" (``defrag_done``/``defrag_abort`` pops it
+        from the replay). An open evicted phase with no standing
+        fence is the exact gateless-and-unfenced window the PR-13
+        kill-point contract forbids — CRITICAL. A fence that stands
+        but no longer covers the journaled plan is WARNING (drifted,
+        not unprotected). Open ``defrag_intent`` phases are safe by
+        construction (nothing irreversible has happened; recovery
+        aborts them) and are not findings. Same double-check idiom as
+        reservation_vs_journal: a mid-tick mutation can race the
+        read, so a diff only becomes a finding if it survives a
+        re-read after a fresh flush."""
+        def diff() -> List[Finding]:
+            self.journal.flush()
+            defragging = self.journal.replay_readonly().defragging
+            if not defragging:
+                return []
+            live = self.reservations.export_state()
+            out = []
+            for key, rec in sorted(defragging.items()):
+                if rec.get("phase") != "evicted":
+                    continue
+                planned = {
+                    str(h): int(n)
+                    for h, n in (rec.get("consumed") or {}).items()
+                    if int(n) > 0
+                }
+                hold = live.get(key)
+                if hold is None:
+                    out.append(Finding.make(
+                        "defrag_vs_reservations", CRITICAL,
+                        f"gang {key[0]}/{key[1]} has an open "
+                        f"defrag_evicted phase (victims already "
+                        f"migrated off {sorted(planned)}) but NO "
+                        f"standing target-box fence and no journaled "
+                        f"abort — the freed box is up for grabs and "
+                        f"the stranded gang is unprotected",
+                        gang=f"{key[0]}/{key[1]}",
+                        planned=planned,
+                    ))
+                    continue
+                held = {
+                    h: int(n)
+                    for h, n in hold["hosts"].items() if n > 0
+                }
+                short = {
+                    h: n for h, n in planned.items()
+                    if held.get(h, 0) < n
+                }
+                if short:
+                    out.append(Finding.make(
+                        "defrag_vs_reservations", WARNING,
+                        f"gang {key[0]}/{key[1]}'s standing fence "
+                        f"({held}) no longer covers its open "
+                        f"defrag_evicted plan ({planned}) — the "
+                        f"fence drifted (partial schedule/shrink) "
+                        f"while the round stayed open",
+                        gang=f"{key[0]}/{key[1]}",
+                        planned=planned, held=held,
                     ))
             return out
 

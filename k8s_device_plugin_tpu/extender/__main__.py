@@ -185,12 +185,45 @@ def main() -> int:
         "tier then restart cost (checkpoint recency + duty cycle), "
         "two-phase journaled, served as the scheduler-extender "
         "/preemption verb. With this flag every gang is equal "
-        "(the pre-PR-13 FIFO)",
+        "(the pre-PR-13 FIFO); defragmentation (if enabled) then "
+        "reads every waiting gang as standard tier and migrates "
+        "only batch-tier (negative-priority) victims",
     )
     p.add_argument(
         "--preemption-rounds-per-tick", type=int, default=1,
         help="max preemption rounds (one waiting gang's eviction "
         "wave) per admission tick — the blast-radius budget",
+    )
+    p.add_argument(
+        "--no-defrag", action="store_true",
+        help="disable active defragmentation (extender/defrag.py). "
+        "By default (with --gang-admission) a capacity-waiting gang "
+        "whose demand is STRANDED — enough free chips cluster-wide "
+        "but no contiguous box placeable anywhere — may, after "
+        "hysteresis and within the eviction budget, migrate "
+        "strictly-lower-priority running gangs (cheapest restart "
+        "cost first, proven relocation target) off one host to free "
+        "a contiguous box, two-phase journaled, fencing the freed "
+        "box for the stranded gang. With this flag fragmentation is "
+        "only ever observed (the PR-7 gauges), never repacked",
+    )
+    p.add_argument(
+        "--defrag-max-evictions-per-hour", type=int, default=12,
+        help="rolling-hour ceiling on victim-pod evictions the "
+        "defrag engine may execute — the operator's blast-radius "
+        "knob (0 closes the gate: stranded demand is still detected "
+        "and exported, but no plan executes)",
+    )
+    p.add_argument(
+        "--defrag-max-concurrent", type=int, default=2,
+        help="max victim GANGS one defrag plan may migrate; plans "
+        "needing more victims are rejected as no_plan",
+    )
+    p.add_argument(
+        "--defrag-stranded-ticks", type=int, default=3,
+        help="consecutive admission ticks a gang's demand must stay "
+        "stranded before the planner is consulted — hysteresis so a "
+        "transient release race never triggers a repack",
     )
     p.add_argument(
         "--gang-pending-event-s", type=float, default=300.0,
@@ -375,7 +408,9 @@ def main() -> int:
     # per-shard preemption stays inside the shard's gang/capacity
     # ownership.
     preempt_resolver = None
-    if a.gang_admission and not a.no_preemption:
+    if a.gang_admission and not (a.no_preemption and a.no_defrag):
+        # Both eviction planes rank by PriorityClass; one resolver
+        # per process (it caches the class vocabulary).
         from .preemption import PriorityResolver
 
         preempt_resolver = PriorityResolver(client)
@@ -383,14 +418,43 @@ def main() -> int:
     def wire_preemption(adm) -> None:
         if preempt_resolver is None or adm is None:
             return
-        from .preemption import PreemptionEngine
+        if not a.no_preemption:
+            # The pending-queue priority ordering belongs to the
+            # preemption plane: --no-preemption keeps its documented
+            # every-gang-equal FIFO contract (no resolver on the
+            # admitter), even when defrag below still uses the
+            # resolver to rank VICTIMS — with the queue unordered,
+            # every stranded requestor reads as standard (0), so
+            # defrag conservatively migrates only batch-tier (< 0)
+            # gangs.
+            adm.priority_resolver = preempt_resolver
+            from .preemption import PreemptionEngine
 
-        adm.priority_resolver = preempt_resolver
-        adm.preemption = PreemptionEngine(
-            adm,
-            preempt_resolver,
-            rounds_per_tick=a.preemption_rounds_per_tick,
-        )
+            adm.preemption = PreemptionEngine(
+                adm,
+                preempt_resolver,
+                rounds_per_tick=a.preemption_rounds_per_tick,
+            )
+        if not a.no_defrag:
+            # Active defragmentation (extender/defrag.py): one engine
+            # per admitter — the singleton, or every per-shard one —
+            # so a sharded engine plans only over the capacity and
+            # gangs its shard owns. install() publishes it on the
+            # /debug/defrag what-if surface; admission.stop()
+            # deregisters it (shard handback).
+            from . import defrag as defrag_mod
+
+            engine = defrag_mod.DefragEngine(
+                adm,
+                preempt_resolver,
+                stranded_ticks=a.defrag_stranded_ticks,
+                max_evictions_per_hour=(
+                    a.defrag_max_evictions_per_hour
+                ),
+                max_concurrent=a.defrag_max_concurrent,
+            )
+            adm.defrag = engine
+            defrag_mod.install(engine)
 
     sharded = a.gang_admission and a.shards > 1
     if sharded and a.no_singleton_lease:

@@ -1,0 +1,1336 @@
+"""Active defragmentation: detect stranded demand, repack the mesh
+within an eviction budget.
+
+PR 7 made fragmentation *visible* (`tpu_node_topology_fragmentation`,
+`tpu_extender_placeable_nodes{size}`), PR 13 made targeted eviction
+*safe* (two-phase journaled preemption, restart-cost ranking) — but
+nothing yet *acted* on the signal: a cluster can strand a 4-cube gang
+forever while enough chips sit free in unplaceable scraps, because
+both the reference plugin and our extender only react to the
+fragmentation the scheduler already created. This module is the
+planner that closes that loop, in three layers:
+
+* **Detection** — :class:`StrandedDemandDetector` rides the
+  gang-admission tick and recognizes the stranded shape: a waiting
+  gang needs size-N, free chips >= its whole demand exist
+  cluster-wide, but no contiguous N-box is placeable anywhere
+  (`topology/placement.box_fits` over the tick's shielded capacity
+  view — the same candidate space the allocator places from).
+  Hysteresis (K consecutive stranded ticks,
+  ``--defrag-stranded-ticks``) keeps a transient release race from
+  ever triggering a repack. Stranded demand is always exported
+  (`tpu_extender_stranded_demand{size}`), whether or not a plan
+  follows.
+
+* **Planning** — :class:`DefragPlanner` searches the existing
+  ``box_candidates`` space for a minimal *migration set*: running
+  gangs of STRICTLY lower priority whose relocation to other
+  placeable capacity frees a contiguous N-box. Candidate victims are
+  ranked by the PR-13 restart-cost model (duty cycle from the
+  telemetry attribution join + checkpoint recency from the
+  ``last-checkpoint`` beacon — `workload/checkpointing.py`), target
+  hosts by the total cost of the victims that would move; a greedy
+  cheapest-first build plus a most-expensive-first prune pass keeps
+  the set minimal, and a plan is only feasible when BOTH fits prove
+  on the same consumable pool admission uses: the stranded gang's
+  whole demand onto the freed box, AND every victim's relocation
+  demand onto what remains. A gang that cannot land elsewhere is
+  never "migrated" into thin air — that would be preemption wearing
+  a costume. Every plan is a *document* (victims with frozen cost
+  facts, target boxes, projected placeability delta) before it is an
+  action.
+
+* **Execution** — :class:`DefragEngine` coordinates each migration
+  with the checkpoint beacon (victims with a fresh save are
+  preferred by the cost ranking; a plan whose victims lack one is
+  deferred one tick — ``checkpoint_wait_ticks`` — so an in-flight
+  save can land), evicts through the PR-13 eviction door
+  (`preemption.evict_gang_pod`: Eviction subresource, PDB-honoring,
+  405-only delete fallback), and journals the round two-phase
+  (``defrag_intent`` -> evict -> ``defrag_evicted`` -> fence the
+  target box for the STRANDED gang -> ``defrag_done``) so a SIGKILL
+  anywhere rehydrates to a safe state (gang.py ``recover``: an open
+  evicted phase re-fences the target box behind the readiness gate;
+  an open intent aborts and the next tick re-plans from cluster
+  truth). The fence is reserved under the stranded gang's key — the
+  freed box goes to the gang the migration was FOR, never a
+  scavenger — and execution is bounded by an operator eviction
+  budget (``--defrag-max-evictions-per-hour`` rolling window,
+  ``--defrag-max-concurrent`` victims per plan). Cluster drift
+  between plan and eviction aborts the round cleanly (the eviction
+  door refuses, the round journals ``defrag_abort``, the next tick
+  re-plans).
+
+Read-only first: the `/debug/defrag` what-if surface serves the
+current stranded demand, the plan the planner would execute, cost
+breakdown, and budget state (registered in ``DEBUG_ENDPOINTS`` so
+tpu-doctor auto-bundles it); the ``tpu-defrag`` CLI renders it
+(``plan`` / ``status`` / ``--self-test``); ledger kinds ``defrag``
+and ``defrag_victim`` make ``tools/explain.py --migrated`` answer
+"why was I migrated" with the cost facts frozen at decision time.
+
+Sharding: one engine per admitter (the singleton, or every per-shard
+one — extender/__main__.py), so a sharded engine plans only over the
+capacity and gangs its shard owns (``gang_filter``/``topo_filter``
+already scope both) and cross-shard migration is structurally
+impossible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..topology.placement import box_fits, placeable_sizes
+from ..utils import metrics, tracing
+from ..utils.decisions import LEDGER
+from ..utils.flightrecorder import RECORDER
+from ..utils.logging import get_logger
+from .preemption import (
+    PreemptionPlanner,
+    PriorityResolver,
+    Victim,
+    credited_topos,
+    evict_gang_pod,
+    post_victim_event,
+    tier_label,
+)
+
+log = get_logger(__name__)
+
+GangKey = Tuple[str, str]
+
+# Consecutive stranded ticks before the planner is consulted: one
+# resync's worth of transient (a release racing the relist, a victim
+# mid-reschedule) must never trigger a repack.
+DEFAULT_STRANDED_TICKS = 3
+# Rolling-hour victim-pod eviction ceiling — the operator's blast-
+# radius knob. Conservative on purpose: defrag trades a bounded amount
+# of churn for placeability, never an unbounded amount.
+DEFAULT_MAX_EVICTIONS_PER_HOUR = 12
+# Victim GANGS one plan may migrate.
+DEFAULT_MAX_CONCURRENT = 2
+# A victim checkpointed within this window is "fresh": its save is
+# recent enough that eviction loses little. Plans whose victims are
+# all past it get one deferred tick for an in-flight save to land.
+CHECKPOINT_FRESH_S = 300.0
+
+BUDGET_WINDOW_S = 3600.0
+
+
+# -- detection ---------------------------------------------------------------
+
+
+def stranded_size(topos, demands: List[int]) -> Optional[int]:
+    """The single-host demand size that is stranded on ``topos`` (the
+    tick's shielded, post-consumption capacity view), or None.
+
+    Stranded means ALL of: the gang's largest per-pod demand N fits
+    inside some host's chip count (slice-spanning demands repack at
+    host granularity the slice planner owns, not here); enough free
+    chips exist cluster-wide to hold the gang's WHOLE demand (a
+    genuine capacity shortage cannot be repacked away — migration
+    conserves chips); and no contiguous N-box is placeable on any
+    node. The caller is already in the capacity-waiting branch, so
+    count-based admission has failed too — free >= N with no N-box is
+    exactly the "free does not imply placeable" gap the placeable
+    gauges document (a free 3x3x3 region holds 27 chips but no
+    16-box)."""
+    wanted = [d for d in demands if d > 0]
+    if not wanted:
+        return None
+    n = max(wanted)
+    max_chips = max((t.chip_count for t in topos), default=0)
+    if n > max_chips:
+        return None
+    if sum(len(t.available) for t in topos) < sum(wanted):
+        return None
+    for t in topos:
+        if t.chip_count >= n and box_fits(t.to_mesh(), t.available, n):
+            return None
+    return n
+
+
+class StrandedDemandDetector:
+    """Per-gang stranded-episode tracking with hysteresis, feeding the
+    ``tpu_extender_stranded_demand{size}`` gauge. Mutated only from
+    the admission tick thread; the internal lock exists for the
+    /debug/defrag snapshot, which reads from an HTTP handler
+    thread."""
+
+    def __init__(
+        self,
+        stranded_ticks: int = DEFAULT_STRANDED_TICKS,
+        clock: Callable[[], float] = time.time,
+        shard: Optional[int] = None,
+    ):
+        self.stranded_ticks = max(1, stranded_ticks)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # The gauge is process-global and one detector runs per
+        # (shard) admitter: series carry the shard label ("" when
+        # unsharded) so a sharded detector prunes only ITS shard's
+        # series — publishing local state unlabeled would clobber the
+        # peers' at every tick.
+        self._shard = "" if shard is None else str(shard)
+        # gang -> {"size", "ticks", "since"} for currently-stranded
+        # waiting gangs; pruned the moment a gang stops being
+        # stranded, admits, or vanishes.
+        self._state: Dict[GangKey, dict] = {}
+
+    def observe(self, key: GangKey, size: int) -> int:
+        """One stranded observation; returns the consecutive-tick
+        count. A size change mid-episode (gang recreated with a new
+        shape) restarts the count — hysteresis is per (gang, size)."""
+        with self._lock:
+            st = self._state.get(key)
+            if st is None or st["size"] != size:
+                st = {"size": size, "ticks": 0, "since": self._clock()}
+                self._state[key] = st
+            st["ticks"] += 1
+            return st["ticks"]
+
+    def clear(self, key: GangKey) -> None:
+        with self._lock:
+            self._state.pop(key, None)
+
+    def ready(self, key: GangKey) -> bool:
+        with self._lock:
+            st = self._state.get(key)
+            return st is not None and st["ticks"] >= self.stranded_ticks
+
+    def publish(self) -> None:
+        """Export the gauge; emptied sizes prune their series (absent
+        = no stranded demand at that size, the GANG_WAITING shape)."""
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for st in self._state.values():
+                s = str(st["size"])
+                counts[s] = counts.get(s, 0) + 1
+        for labels, _ in metrics.STRANDED_DEMAND.series():
+            if (
+                labels.get("shard", "") == self._shard
+                and labels.get("size") not in counts
+            ):
+                metrics.STRANDED_DEMAND.remove(**labels)
+        for size, count in counts.items():
+            metrics.STRANDED_DEMAND.set(
+                count, size=size, shard=self._shard
+            )
+
+    def snapshot(self) -> List[dict]:
+        now = self._clock()
+        with self._lock:
+            items = sorted(
+                (k, dict(st)) for k, st in self._state.items()
+            )
+        return [
+            {
+                "namespace": k[0],
+                "gang": k[1],
+                "size": st["size"],
+                "ticks": st["ticks"],
+                "threshold": self.stranded_ticks,
+                "stranded_for_s": round(max(0.0, now - st["since"]), 1),
+            }
+            for k, st in items
+        ]
+
+
+# -- planning ----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DefragPlan:
+    """One executable migration plan — the *document* the engine (and
+    the /debug/defrag what-if surface) renders before anything moves."""
+
+    requestor: GangKey
+    priority: int
+    demands: List[int]
+    size: int  # the stranded box size this plan frees
+    target_host: str
+    # Cheapest-first, exactly the set whose migration frees the box.
+    victims: List[Victim]
+    # host -> chips the victims vacate.
+    freed: Dict[str, int]
+    # host -> chips the requestor's post-migration fit consumed — what
+    # the engine fences for the STRANDED gang once the victims moved.
+    consumed: Dict[str, int]
+    # host -> chips the victims' relocation fit consumed (their
+    # proven landing capacity; informational — the victims reschedule
+    # through the ordinary admission path).
+    relocation: Dict[str, int]
+    # Placeable sizes on the target host before/after the migration —
+    # the projected placeability delta.
+    placeable_before: List[int]
+    placeable_after: List[int]
+    created_ts: float = 0.0
+
+    def victim_keys(self) -> List[List[str]]:
+        return [[v.key[0], v.key[1]] for v in self.victims]
+
+    def victim_pods(self) -> int:
+        return sum(len(v.pods) for v in self.victims)
+
+    def total_cost(self) -> float:
+        return round(sum(v.restart_cost() for v in self.victims), 1)
+
+    def to_doc(self) -> dict:
+        return {
+            "requestor": f"{self.requestor[0]}/{self.requestor[1]}",
+            "priority": self.priority,
+            "tier": tier_label(self.priority),
+            "demands": list(self.demands),
+            "size": self.size,
+            "target_host": self.target_host,
+            "consumed": dict(self.consumed),
+            "freed": dict(self.freed),
+            "relocation": dict(self.relocation),
+            "placeable_before": list(self.placeable_before),
+            "placeable_after": list(self.placeable_after),
+            "total_restart_cost": self.total_cost(),
+            "victims": [
+                {
+                    "gang": f"{v.key[0]}/{v.key[1]}",
+                    "tier": v.tier,
+                    "priority": v.priority,
+                    "hosts": dict(v.hosts),
+                    "pods": len(v.pods),
+                    "chips": v.total_chips,
+                    "duty_cycle": v.duty_cycle,
+                    "checkpoint_age_s": (
+                        None
+                        if v.checkpoint_age_s is None
+                        else round(v.checkpoint_age_s, 1)
+                    ),
+                    "restart_cost": round(v.restart_cost(), 1),
+                }
+                for v in self.victims
+            ],
+            "created_ts": round(self.created_ts, 3),
+        }
+
+
+class DefragPlanner:
+    """Pure planning: stranded demand + victims in, minimal migration
+    set with a proven relocation out. No apiserver calls, no journal
+    writes — the engine owns execution; /debug/defrag renders this
+    dry-run."""
+
+    def __init__(
+        self,
+        resolver: PriorityResolver,
+        resource_name: Optional[str] = None,
+        duty_source=None,
+        clock: Callable[[], float] = time.time,
+    ):
+        from ..api import constants
+
+        # Victim discovery is the preemption planner's (same Victim
+        # shape, same shard-scoped gang views, same cost facts) —
+        # defrag must rank victims exactly like preemption does or
+        # the two planes' "cheapest" would disagree.
+        self._victims = PreemptionPlanner(
+            resolver,
+            resource_name=resource_name or constants.RESOURCE_NAME,
+            duty_source=duty_source,
+            clock=clock,
+        )
+        self._clock = clock
+
+    def collect_victims(
+        self, gangs: Dict[GangKey, object], exclude: GangKey,
+        below_priority: int,
+    ) -> List[Victim]:
+        return self._victims.collect_victims(
+            gangs, exclude, below_priority
+        )
+
+    # -- feasibility helpers -----------------------------------------------
+
+    @staticmethod
+    def _frees_box(t, freed: int, n: int) -> bool:
+        """Would vacating ``freed`` chips on ``t`` make an n-box
+        placeable? Exact when the host ends up fully free (the common
+        repack shape: every resident hold was a victim's); otherwise
+        the freed chips are credited like preemption's ``_fits_with``
+        — optimistic about WHICH chips free, which can overestimate
+        box quality but never admission (the count-based fence below
+        still guarantees the requestor lands)."""
+        if freed <= 0:
+            return False
+        mesh = t.to_mesh()
+        avail = [i for i in t.available if i in mesh.by_id]
+        if len(avail) + freed >= t.chip_count:
+            return box_fits(mesh, mesh.ids, n)
+        have = set(avail)
+        credit = [i for i in mesh.ids if i not in have][:freed]
+        return box_fits(mesh, avail + credit, n)
+
+    # One credit construction and one victim-host summer for BOTH
+    # eviction planes (preemption.py owns them): a drift between the
+    # planes' what-if views would make their "feasible" disagree.
+    _sum_hosts = staticmethod(PreemptionPlanner._sum_hosts)
+
+    @staticmethod
+    def _credited(topos, victims: List[Victim]) -> list:
+        """Per-call topology clones with the victims' chips credited
+        back per host — the what-if capacity view both fits run
+        over (preemption's ``credited_topos``)."""
+        return credited_topos(
+            topos, DefragPlanner._sum_hosts(victims)
+        )
+
+    # -- the search ----------------------------------------------------------
+
+    def plan(
+        self,
+        requestor: GangKey,
+        demands: List[int],
+        priority: int,
+        topos,
+        victims: List[Victim],
+        max_victims: int = 0,
+    ) -> Optional[DefragPlan]:
+        """Minimal migration set freeing a placeable box for the
+        stranded demand, or None. ``victims`` must already be
+        strictly-lower-priority (collect_victims enforces it); this
+        never re-checks trust, only feasibility."""
+        from .gang import _CapacityPool
+
+        wanted = [d for d in demands if d > 0]
+        if not wanted or not victims:
+            return None
+        n = max(wanted)
+        by_host: Dict[str, List[Victim]] = {}
+        for v in victims:
+            for h in v.hosts:
+                by_host.setdefault(h, []).append(v)
+        # Per candidate host: the greedy cheapest-first victim set
+        # whose vacated chips make an n-box placeable there, pruned
+        # most-expensive-first (the preemption minimality shape) —
+        # cheap box math only; the expensive pool proofs run below in
+        # cost order.
+        candidates: List[Tuple[float, int, str, List[Victim]]] = []
+        for t in topos:
+            residents = by_host.get(t.hostname)
+            if not residents or t.chip_count < n:
+                continue
+            ordered = sorted(
+                residents,
+                key=lambda v: (v.priority, v.restart_cost(), v.key),
+            )
+            chosen: List[Victim] = []
+            feasible = False
+            for v in ordered:
+                chosen.append(v)
+                if self._frees_box(
+                    t, sum(c.hosts[t.hostname] for c in chosen), n
+                ):
+                    feasible = True
+                    break
+            if not feasible:
+                continue
+            for v in sorted(
+                chosen,
+                key=lambda v: (-v.priority, -v.restart_cost(), v.key),
+            ):
+                if len(chosen) == 1:
+                    break
+                trial = [c for c in chosen if c is not v]
+                if self._frees_box(
+                    t, sum(c.hosts[t.hostname] for c in trial), n
+                ):
+                    chosen = trial
+            if max_victims > 0 and len(chosen) > max_victims:
+                continue
+            cost = sum(v.restart_cost() for v in chosen)
+            candidates.append((cost, len(chosen), t.hostname, chosen))
+        candidates.sort(key=lambda c: (c[0], c[1], c[2]))
+        for cost, _count, host, chosen in candidates:
+            aug = self._credited(topos, chosen)
+            pool = _CapacityPool(aug)
+            # The stranded gang places FIRST (it outranks every
+            # victim by construction), and its big demand must land
+            # on the host whose box the migration frees — landing
+            # anywhere else would mean a >= n-chip host existed and
+            # the demand was never stranded.
+            consumed = pool.fits(wanted)
+            if consumed is None or consumed.get(host, 0) < n:
+                continue
+            relocation_demands = sorted(
+                (p["chips"] for v in chosen for p in v.pods),
+                reverse=True,
+            )
+            relocation = pool.fits(relocation_demands)
+            if relocation is None:
+                continue
+            target = next(
+                t for t in topos if t.hostname == host
+            )
+            mesh = target.to_mesh()
+            after_t = next(a for a in aug if a.hostname == host)
+            return DefragPlan(
+                requestor=requestor,
+                priority=priority,
+                demands=list(wanted),
+                size=n,
+                target_host=host,
+                victims=list(chosen),
+                freed=self._sum_hosts(chosen),
+                consumed=dict(consumed),
+                relocation=dict(relocation),
+                placeable_before=list(
+                    placeable_sizes(mesh, target.available)
+                ),
+                placeable_after=list(
+                    placeable_sizes(mesh, after_t.available)
+                ),
+                created_ts=self._clock(),
+            )
+        return None
+
+
+# -- execution ---------------------------------------------------------------
+
+
+class DefragEngine:
+    """Detection -> plan -> two-phase journal -> migrate -> fence.
+
+    Attached to a GangAdmission (``adm.defrag = engine``); the tick
+    invokes :meth:`maybe_defrag` for a capacity-waiting gang AFTER the
+    normal fit failed AND preemption (when wired) declined — defrag is
+    the remedy for fragmentation, not for entitlement — and a
+    successful round's consumed map flows into the tick's ordinary
+    reserve -> admit -> release path (the tick calls :meth:`finish`
+    right after the reserve lands so the journaled round closes)."""
+
+    def __init__(
+        self,
+        admission,
+        resolver: PriorityResolver,
+        planner: Optional[DefragPlanner] = None,
+        stranded_ticks: int = DEFAULT_STRANDED_TICKS,
+        max_evictions_per_hour: int = DEFAULT_MAX_EVICTIONS_PER_HOUR,
+        max_concurrent: int = DEFAULT_MAX_CONCURRENT,
+        checkpoint_fresh_s: float = CHECKPOINT_FRESH_S,
+        checkpoint_wait_ticks: int = 1,
+        post_events: bool = True,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.admission = admission
+        self.planner = planner or DefragPlanner(
+            resolver, resource_name=admission.resource_name
+        )
+        shard = getattr(admission, "shard_id", None)
+        # "" = the unsharded singleton; per-shard series keep N
+        # engines on one registry from overwriting each other.
+        self._shard_label = "" if shard is None else str(shard)
+        self.detector = StrandedDemandDetector(
+            stranded_ticks, clock=clock, shard=shard
+        )
+        self.max_evictions_per_hour = max(0, max_evictions_per_hour)
+        self.max_concurrent = max(1, max_concurrent)
+        self.checkpoint_fresh_s = checkpoint_fresh_s
+        self.checkpoint_wait_ticks = max(0, checkpoint_wait_ticks)
+        self.post_events = post_events
+        self._clock = clock
+        # Guards _evictions and _open: both are mutated on the
+        # admission tick thread and read by the /debug/defrag snapshot
+        # from an HTTP handler thread — an unlocked prune-and-reassign
+        # there could drop just-spent eviction stamps and silently
+        # exceed the operator's budget cap.
+        self._lock = threading.Lock()
+        # Wall clocks of executed victim-pod evictions inside the
+        # rolling budget window.
+        self._evictions: List[float] = []
+        # Open two-phase rounds, requestor -> plan payload (what the
+        # compaction snapshot carries — gang._journal_state reads it
+        # via open_intents()).
+        self._open: Dict[GangKey, dict] = {}
+        # Per-episode state, reset when the episode ends: deferred
+        # ticks already spent waiting for an in-flight checkpoint
+        # (bounded by checkpoint_wait_ticks), and the ledger-dedup
+        # marks for the no_plan / blocked_budget outcomes.
+        self._ckpt_waits: Dict[GangKey, int] = {}
+        self._noplan_reported: Set[GangKey] = set()
+        self._budget_reported: Set[GangKey] = set()
+        # The /debug/defrag what-if state.
+        self.last_plan: Optional[dict] = None
+        self.last_outcome: str = ""
+        self.last_outcome_ts: float = 0.0
+
+    # -- tick plumbing -----------------------------------------------------
+
+    def begin_tick(self) -> None:
+        metrics.DEFRAG_BUDGET.set(
+            self.budget_remaining(), shard=self._shard_label
+        )
+
+    def open_intents(self) -> Dict[GangKey, dict]:
+        with self._lock:
+            return dict(self._open)
+
+    def note_admitted(self, key: GangKey) -> None:
+        """The gang's waiting episode ended (admit/vanish/reshape):
+        drop its stranded state and per-episode dedup marks."""
+        self.detector.clear(key)
+        self.detector.publish()
+        self._ckpt_waits.pop(key, None)
+        self._noplan_reported.discard(key)
+        self._budget_reported.discard(key)
+
+    def budget_remaining(self) -> int:
+        now = self._clock()
+        with self._lock:
+            self._evictions = [
+                t for t in self._evictions if now - t < BUDGET_WINDOW_S
+            ]
+            return max(
+                0, self.max_evictions_per_hour - len(self._evictions)
+            )
+
+    def spend_window(self) -> List[float]:
+        """The budget window for the compaction snapshot (gang.py
+        ``_journal_state``)."""
+        now = self._clock()
+        with self._lock:
+            return [
+                t for t in self._evictions
+                if now - t < BUDGET_WINDOW_S
+            ]
+
+    def seed_spend(self, stamps) -> None:
+        """Rehydrate the rolling budget window on recovery (called
+        once, on a fresh engine, by gang.recover): a crashlooping
+        extender must NOT grant itself a fresh blast-radius budget
+        every restart — the journaled spend of the last hour still
+        counts. A plain merge, NOT a set union: two evictions in the
+        same clock reading are still two budget stamps."""
+        now = self._clock()
+        with self._lock:
+            self._evictions = sorted(
+                self._evictions
+                + [
+                    float(t) for t in stamps
+                    if now - float(t) < BUDGET_WINDOW_S
+                ]
+            )
+
+    def _outcome(self, outcome: str) -> None:
+        self.last_outcome = outcome
+        self.last_outcome_ts = self._clock()
+
+    # -- the round ---------------------------------------------------------
+
+    def maybe_defrag(
+        self,
+        key: GangKey,
+        gv,
+        demands: List[int],
+        topos,
+        priority: int,
+        gangs: Optional[Dict[GangKey, object]] = None,
+    ) -> Optional[Dict[str, int]]:
+        """One defrag evaluation for a capacity-waiting gang. Returns
+        the consumed host->chips map for the tick to reserve (the
+        stranded gang then admits through the normal path), or None
+        (not stranded / hysteresis still counting / no plan / budget
+        spent / deferred for a checkpoint / eviction blocked).
+        ``gangs`` follows maybe_preempt's contract: a full sweep
+        passes its complete map, a dirty tick passes None and the
+        engine lists for itself only once a plan is actually due."""
+        if key in self._open:
+            return None
+        n = stranded_size(topos, demands)
+        if n is None:
+            # Becoming un-stranded ENDS the episode: drop the
+            # hysteresis state AND the per-episode ledger-dedup /
+            # checkpoint-deferral marks — a later re-stranding of the
+            # same waiting gang is a fresh episode and must ledger
+            # (and defer) anew.
+            self.note_admitted(key)
+            return None
+        ticks = self.detector.observe(key, n)
+        self.detector.publish()
+        gang_key = f"{key[0]}/{key[1]}"
+        if ticks < self.detector.stranded_ticks:
+            # Advance the hysteresis clock at TICK cadence: a
+            # capacity-waiting gang is otherwise only re-evaluated on
+            # node events or the full-sweep backstop, which would
+            # stretch "K consecutive ticks" into K backstop sweeps.
+            # Marking it dirty re-evaluates it next resync (cheap: the
+            # gang's pods plus the tick's shared pool; the expensive
+            # victim listing and plan search still run only once the
+            # hysteresis clears).
+            self.admission.mark_dirty(key, source="defrag")
+            return None
+        if self.budget_remaining() <= 0:
+            if key not in self._budget_reported:
+                self._budget_reported.add(key)
+                metrics.DEFRAG_PLANS.inc(outcome="blocked_budget")
+                LEDGER.record(
+                    "defrag", "blocked_budget",
+                    f"stranded size-{n} demand cannot plan a repack: "
+                    f"the eviction budget is spent "
+                    f"({self.max_evictions_per_hour}/h)",
+                    gang=gang_key, size=n,
+                )
+                self._outcome("blocked_budget")
+            # Keep re-evaluating at resync cadence so the repack runs
+            # as soon as the rolling window refills — the backstop
+            # sweep alone could delay it by a full sweep interval.
+            self.admission.mark_dirty(key, source="defrag")
+            return None
+        if gangs is None:
+            gangs = self.admission._collect_gangs()
+        victims = self.planner.collect_victims(gangs, key, priority)
+        plan = self.planner.plan(
+            key, demands, priority, topos, victims,
+            max_victims=self.max_concurrent,
+        )
+        if plan is None:
+            if key not in self._noplan_reported:
+                self._noplan_reported.add(key)
+                metrics.DEFRAG_PLANS.inc(outcome="no_plan")
+                LEDGER.record(
+                    "defrag", "no_plan",
+                    f"size-{n} demand is stranded but no strictly-"
+                    f"lower-priority victim set with a proven "
+                    f"relocation frees a box",
+                    gang=gang_key, size=n,
+                    tier=tier_label(priority), priority=priority,
+                )
+                self._outcome("no_plan")
+            return None
+        self.last_plan = plan.to_doc()
+        if plan.victim_pods() > self.budget_remaining():
+            if key not in self._budget_reported:
+                self._budget_reported.add(key)
+                metrics.DEFRAG_PLANS.inc(outcome="blocked_budget")
+                LEDGER.record(
+                    "defrag", "blocked_budget",
+                    f"plan needs {plan.victim_pods()} eviction(s) but "
+                    f"only {self.budget_remaining()} remain in the "
+                    f"rolling hour",
+                    gang=gang_key, size=n,
+                    evictions=plan.victim_pods(),
+                    budget_remaining=self.budget_remaining(),
+                )
+                self._outcome("blocked_budget")
+            # Same resync-cadence retry as the gate above: the plan is
+            # feasible, only the window is closed.
+            self.admission.mark_dirty(key, source="defrag")
+            return None
+        # Checkpoint coordination: when some victim lacks a fresh
+        # save, hold the plan (up to checkpoint_wait_ticks ticks per
+        # episode) so an in-flight beacon stamp can land — each
+        # re-plan reads the updated recency and may pick a now-cheaper
+        # set.
+        stale = [
+            v for v in plan.victims
+            if v.checkpoint_age_s is None
+            or v.checkpoint_age_s > self.checkpoint_fresh_s
+        ]
+        waited = self._ckpt_waits.get(key, 0)
+        if stale and waited < self.checkpoint_wait_ticks:
+            self._ckpt_waits[key] = waited + 1
+            # "One tick" must mean one RESYNC, not one backstop sweep.
+            self.admission.mark_dirty(key, source="defrag")
+            metrics.DEFRAG_PLANS.inc(outcome="deferred")
+            LEDGER.record(
+                "defrag", "deferred",
+                f"{len(stale)} victim(s) lack a fresh checkpoint "
+                f"(> {self.checkpoint_fresh_s:.0f}s); holding the "
+                f"migration one tick for an in-flight save",
+                gang=gang_key, size=n, stale_victims=len(stale),
+            )
+            self._outcome("deferred")
+            return None
+        if not tracing.enabled():
+            return self._execute(key, gang_key, plan)
+        with tracing.span(
+            "gang.defrag",
+            service="extender",
+            namespace=key[0],
+            gang=key[1],
+            victims=len(plan.victims),
+            target=plan.target_host,
+        ):
+            return self._execute(key, gang_key, plan)
+
+    def _execute(
+        self, key: GangKey, gang_key: str, plan: DefragPlan
+    ) -> Optional[Dict[str, int]]:
+        journal = self.admission.journal
+        payload = {
+            "phase": "intent",
+            "victims": plan.victim_keys(),
+            "consumed": dict(plan.consumed),
+            "demands": list(plan.demands),
+            "priority": plan.priority,
+            "ts": self._clock(),
+        }
+        # Phase 1: the intent is durable BEFORE anything irreversible.
+        with self._lock:
+            self._open[key] = payload
+        if journal is not None:
+            journal.record(
+                "defrag_intent", key,
+                victims=plan.victim_keys(),
+                consumed=dict(plan.consumed),
+                demands=list(plan.demands),
+                priority=plan.priority,
+            )
+        # Phase 2: evict every victim pod through the shared door. A
+        # refusal (PDB, drift, apiserver) aborts the round — partial
+        # evictions already freed chips, so the re-plan gets cheaper.
+        # The per-victim "migrated" ledger record lands only AFTER its
+        # pods actually left (explain --migrated must never claim a
+        # migration an aborted round didn't perform).
+        blocked = False
+        spent: List[float] = []
+        for rank, v in enumerate(plan.victims):
+            for p in v.pods:
+                if not evict_gang_pod(
+                    self.admission.client,
+                    p.get("ns", "default"),
+                    p.get("name", ""),
+                ):
+                    blocked = True
+                    break
+                # Each EXECUTED eviction spends budget — including the
+                # partial victim of a blocked round (those pods are
+                # gone; the churn was real).
+                spent.append(self._clock())
+                with self._lock:
+                    self._evictions.append(spent[-1])
+            if blocked:
+                break
+            metrics.DEFRAG_MIGRATIONS.inc(victim_tier=v.tier)
+            LEDGER.record(
+                "defrag_victim", "migrated",
+                f"victim {rank + 1}/{len(plan.victims)} migrated off "
+                f"{plan.target_host} for {gang_key}: priority "
+                f"{v.priority}, restart cost {v.restart_cost():.1f}",
+                gang=f"{v.key[0]}/{v.key[1]}",
+                requestor=gang_key,
+                rank=rank + 1,
+                victim_tier=v.tier,
+                victim_priority=v.priority,
+                chips=v.total_chips,
+                target_host=plan.target_host,
+                duty_cycle=(
+                    "" if v.duty_cycle is None
+                    else round(v.duty_cycle, 1)
+                ),
+                checkpoint_age_s=(
+                    "" if v.checkpoint_age_s is None
+                    else round(v.checkpoint_age_s, 1)
+                ),
+            )
+            if self.post_events:
+                self._post_victim_event(v, gang_key, plan.target_host)
+        if spent and journal is not None:
+            # The budget spend survives a restart (journal replay +
+            # compaction snapshot seed the window), so a crashloop
+            # cannot mint a fresh blast-radius budget every
+            # incarnation. Non-critical on purpose: the evictions
+            # already happened; the tick-end flush covers it.
+            # Full precision on purpose: two pods evicted in the same
+            # millisecond must stay two budget stamps.
+            journal.record("defrag_spend", key, stamps=list(spent))
+        if blocked:
+            with self._lock:
+                self._open.pop(key, None)
+            if journal is not None:
+                journal.record(
+                    "defrag_abort", key, reason="eviction_blocked"
+                )
+            metrics.DEFRAG_ABORTED.inc(reason="eviction_blocked")
+            LEDGER.record(
+                "defrag", "blocked",
+                "a victim eviction was refused (PodDisruptionBudget, "
+                "drift, or apiserver); round aborted, re-planned next "
+                "tick",
+                gang=gang_key,
+            )
+            self._outcome("aborted")
+            return None
+        payload = dict(payload, phase="evicted", ts=self._clock())
+        with self._lock:
+            self._open[key] = payload
+        if journal is not None:
+            journal.record(
+                "defrag_evicted", key,
+                victims=plan.victim_keys(),
+                consumed=dict(plan.consumed),
+                demands=list(plan.demands),
+                priority=plan.priority,
+            )
+        metrics.DEFRAG_PLANS.inc(outcome="executed")
+        metrics.DEFRAG_BUDGET.set(
+            self.budget_remaining(), shard=self._shard_label
+        )
+        victims_s = ",".join(
+            f"{v.key[0]}/{v.key[1]}" for v in plan.victims
+        )
+        RECORDER.record(
+            "defrag",
+            f"defrag migrated {len(plan.victims)} gang(s) off "
+            f"{plan.target_host} to free a size-{plan.size} box for "
+            f"{gang_key}",
+            namespace=key[0],
+            gang=key[1],
+            target=plan.target_host,
+            size=plan.size,
+            victims=victims_s,
+            freed_chips=sum(plan.freed.values()),
+        )
+        LEDGER.record(
+            "defrag", "executed",
+            f"migrated {len(plan.victims)} gang(s) ({victims_s}) off "
+            f"{plan.target_host}, freeing a size-{plan.size} box "
+            f"(placeable {plan.placeable_before} -> "
+            f"{plan.placeable_after}) for {plan.demands}",
+            gang=gang_key,
+            size=plan.size,
+            target_host=plan.target_host,
+            victims=victims_s,
+            victim_count=len(plan.victims),
+            freed_chips=sum(plan.freed.values()),
+            total_restart_cost=plan.total_cost(),
+        )
+        log.warning(
+            "defrag: stranded gang %s (size %d) migrating %d gang(s) "
+            "[%s] off %s; reserving %s",
+            gang_key, plan.size, len(plan.victims), victims_s,
+            plan.target_host, plan.consumed,
+        )
+        self._outcome("executed")
+        self.detector.clear(key)
+        self.detector.publish()
+        self._noplan_reported.discard(key)
+        self._ckpt_waits.pop(key, None)
+        return dict(plan.consumed)
+
+    def finish(self, key: GangKey) -> None:
+        """Phase 3: the tick reserved the target box (the fence is
+        journaled via the table's observer tap) — close the round."""
+        with self._lock:
+            if self._open.pop(key, None) is None:
+                return
+        if self.admission.journal is not None:
+            self.admission.journal.record("defrag_done", key)
+
+    def close(self) -> None:
+        """Deregister from the /debug/defrag surface and prune this
+        engine's metric series — called by the owning admitter's
+        stop() (shard handback must not leave a stale engine in the
+        debug payload, a frozen budget gauge, or accumulate one per
+        re-adoption)."""
+        uninstall(self)
+        metrics.DEFRAG_BUDGET.remove(shard=self._shard_label)
+        for labels, _ in metrics.STRANDED_DEMAND.series():
+            if labels.get("shard", "") == self._shard_label:
+                metrics.STRANDED_DEMAND.remove(**labels)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _post_victim_event(
+        self, victim: Victim, requestor: str, target: str
+    ) -> None:
+        post_victim_event(
+            self.admission.client,
+            victim,
+            reason="TPUGangMigrated",
+            message=(
+                f"gang {victim.key[0]}/{victim.key[1]} migrated "
+                f"off {target} by defragmentation to free a "
+                f"contiguous box for stranded gang {requestor}"
+            ),
+        )
+
+    def snapshot(self) -> dict:
+        """The /debug/defrag payload for this engine."""
+        return {
+            "shard": getattr(self.admission, "shard_id", None),
+            "stranded": self.detector.snapshot(),
+            "stranded_ticks": self.detector.stranded_ticks,
+            "budget": {
+                "max_evictions_per_hour": self.max_evictions_per_hour,
+                "remaining": self.budget_remaining(),
+                "max_concurrent": self.max_concurrent,
+                "window_s": BUDGET_WINDOW_S,
+            },
+            "checkpoint": {
+                "fresh_s": self.checkpoint_fresh_s,
+                "wait_ticks": self.checkpoint_wait_ticks,
+            },
+            "open_rounds": [
+                {
+                    "requestor": f"{k[0]}/{k[1]}",
+                    "phase": p.get("phase"),
+                    "consumed": dict(p.get("consumed") or {}),
+                }
+                for k, p in sorted(self.open_intents().items())
+            ],
+            "last_plan": self.last_plan,
+            "last_outcome": self.last_outcome,
+            "last_outcome_ts": round(self.last_outcome_ts, 3),
+        }
+
+
+# -- /debug/defrag provider --------------------------------------------------
+
+# Engines registered by the entrypoint (one per admitter — the
+# singleton, or every per-shard one). metrics.debug_payload dispatches
+# /debug/defrag here; tpu-doctor auto-bundles it via DEBUG_ENDPOINTS.
+_ENGINES: List[DefragEngine] = []
+
+
+def install(engine: DefragEngine) -> None:
+    if engine not in _ENGINES:
+        _ENGINES.append(engine)
+
+
+def uninstall(engine: DefragEngine) -> None:
+    if engine in _ENGINES:
+        _ENGINES.remove(engine)
+
+
+def debug_snapshot() -> dict:
+    if not _ENGINES:
+        return {
+            "enabled": False,
+            "note": "defragmentation not wired in this process "
+            "(extender --gang-admission without --no-defrag "
+            "installs it)",
+        }
+    return {
+        "enabled": True,
+        "engines": [e.snapshot() for e in _ENGINES],
+    }
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def _fetch(url: str) -> dict:
+    import json
+    import urllib.request
+
+    base = url.rstrip("/")
+    with urllib.request.urlopen(
+        f"{base}/debug/defrag", timeout=10
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def _render_status(doc: dict) -> List[str]:
+    if not doc.get("enabled"):
+        return [f"defrag: not wired ({doc.get('note', '')})"]
+    out = []
+    for eng in doc.get("engines", []):
+        shard = eng.get("shard")
+        head = "defrag" + (
+            f" [shard {shard}]" if shard is not None else ""
+        )
+        budget = eng.get("budget") or {}
+        out.append(
+            f"{head}: budget {budget.get('remaining', '?')}/"
+            f"{budget.get('max_evictions_per_hour', '?')} evictions "
+            f"this hour, last outcome "
+            f"{eng.get('last_outcome') or '(none)'}"
+        )
+        stranded = eng.get("stranded") or []
+        if not stranded:
+            out.append("  no stranded demand")
+        for s in stranded:
+            out.append(
+                f"  stranded: {s['namespace']}/{s['gang']} size "
+                f"{s['size']} ({s['ticks']}/{s['threshold']} ticks, "
+                f"{s['stranded_for_s']}s)"
+            )
+        for r in eng.get("open_rounds") or []:
+            out.append(
+                f"  open round: {r['requestor']} phase {r['phase']}"
+            )
+    return out
+
+
+def _render_plan(doc: dict) -> List[str]:
+    if not doc.get("enabled"):
+        return [f"defrag: not wired ({doc.get('note', '')})"]
+    out = []
+    for eng in doc.get("engines", []):
+        plan = eng.get("last_plan")
+        if not plan:
+            out.append(
+                "no plan computed yet (no stranded demand has "
+                "cleared hysteresis, or none was plannable)"
+            )
+            continue
+        out.append(
+            f"plan for {plan['requestor']} (tier {plan['tier']}): "
+            f"free a size-{plan['size']} box on "
+            f"{plan['target_host']} — placeable "
+            f"{plan['placeable_before']} -> {plan['placeable_after']}"
+        )
+        out.append(
+            f"  total restart cost {plan['total_restart_cost']}, "
+            f"fence {plan['consumed']}, relocation "
+            f"{plan['relocation']}"
+        )
+        for v in plan.get("victims", []):
+            age = v.get("checkpoint_age_s")
+            out.append(
+                f"  migrate {v['gang']} (tier {v['tier']}, "
+                f"{v['chips']} chip(s), duty "
+                f"{v.get('duty_cycle') if v.get('duty_cycle') is not None else '?'}"  # noqa: E501
+                f", checkpoint "
+                f"{str(age) + 's ago' if age is not None else 'never'}"
+                f", cost {v['restart_cost']})"
+            )
+    return out
+
+
+def self_test() -> int:
+    """End-to-end smoke for scripts/tier1.sh: a deliberately
+    fragmented 2-node in-module sim — every node has free chips but no
+    node has a contiguous 4-box — a 4-chip gang arrives gated, the
+    detector counts it stranded through hysteresis, the planner picks
+    the batch victim whose migration (with a proven relocation target)
+    frees a box, the engine evicts two-phase-journaled, and the
+    stranded gang admits onto the freed, fenced box — driven through
+    the REAL GangAdmission/journal against an in-module fake client.
+    Prints a one-line JSON verdict."""
+    import json
+    import shutil
+    import tempfile
+
+    from ..api import constants
+    from ..discovery.chips import TpuChip
+    from ..topology.mesh import IciMesh
+    from ..topology.schema import NodeTopology
+    from .gang import GATE_NAME, GangAdmission
+    from .journal import AdmissionJournal
+    from .reservations import ReservationTable
+
+    def mk_mesh(n: int = 4) -> IciMesh:
+        return IciMesh([
+            TpuChip(
+                index=i,
+                dev_path=f"/dev/accel{i}",
+                pci_addr=f"0000:00:{4 + i:02x}.0",
+                vendor_id=0x1AE0,
+                device_id=0,
+                numa_node=0,
+                chip_type="v5e",
+                hbm_bytes=0,
+                core_count=1,
+            )
+            for i in range(n)
+        ])
+
+    class FakeClient:
+        def __init__(self):
+            self.pods: Dict[Tuple[str, str], dict] = {}
+            self.evicted: List[Tuple[str, str]] = []
+
+        def list_pods(self, label_selector: str = "", **_):
+            return {"items": [dict(p) for p in self.pods.values()]}
+
+        def get_pod(self, ns, name):
+            return dict(self.pods[(ns, name)])
+
+        def evict_pod(self, ns, name):
+            self.evicted.append((ns, name))
+            self.pods.pop((ns, name), None)
+            return {}
+
+        def delete_pod(self, ns, name):
+            self.pods.pop((ns, name), None)
+            return {}
+
+        def remove_pod_scheduling_gate(self, ns, name, gate, gates):
+            pod = self.pods[(ns, name)]
+            pod["spec"]["schedulingGates"] = [
+                g for g in gates if g.get("name") != gate
+            ]
+
+        def patch_pod_annotations(self, ns, name, ann):
+            pod = self.pods.get((ns, name))
+            if pod is not None:
+                pod.setdefault("metadata", {}).setdefault(
+                    "annotations", {}
+                ).update(
+                    {k: v for k, v in ann.items() if v is not None}
+                )
+
+        def create_event(self, *a, **kw):
+            pass
+
+    def pod(ns, gang, name, chips, size, gated, node="", priority=None,
+            ckpt=None):
+        p = {
+            "metadata": {
+                "name": name, "namespace": ns, "uid": f"uid-{name}",
+                "labels": {
+                    constants.GANG_NAME_LABEL: gang,
+                    "tpu.google.com/gang-size": str(size),
+                },
+                "annotations": {},
+            },
+            "spec": {
+                "schedulingGates": (
+                    [{"name": GATE_NAME}] if gated else []
+                ),
+                "containers": [{
+                    "name": "c",
+                    "resources": {
+                        "requests": {"google.com/tpu": str(chips)}
+                    },
+                }],
+            },
+            "status": {},
+        }
+        if node:
+            p["spec"]["nodeName"] = node
+        if priority is not None:
+            p["spec"]["priority"] = priority
+        if ckpt is not None:
+            p["metadata"]["annotations"][
+                constants.CHECKPOINT_TS_ANNOTATION
+            ] = str(ckpt)
+        return p
+
+    d = tempfile.mkdtemp(prefix="tpu-defrag-selftest-")
+    try:
+        client = FakeClient()
+        meshes = {n: mk_mesh(4) for n in ("n1", "n2")}
+        # Fragmented on purpose: each node has 2 free chips that do
+        # NOT form a contiguous pair's worth of a 4-box — free chips
+        # exist everywhere, a 4-box nowhere.
+        topos = [
+            NodeTopology.from_mesh(
+                meshes[n],
+                hostname=n,
+                available=[meshes[n].ids[0], meshes[n].ids[2]],
+            )
+            for n in ("n1", "n2")
+        ]
+        # The victim: a recently-checkpointed batch gang holding n1's
+        # other two chips (its migration fully frees n1).
+        now = time.time()
+        for w in range(2):
+            p = pod(
+                "default", "frag", f"frag-w{w}", 1, 2,
+                gated=False, node="n1", priority=-10, ckpt=now - 5,
+            )
+            client.pods[("default", p["metadata"]["name"])] = p
+        # The stranded gang: one 4-chip pod, standard priority.
+        sp = pod("default", "train", "train-w0", 4, 1, gated=True,
+                 priority=0)
+        client.pods[("default", "train-w0")] = sp
+
+        table = ReservationTable()
+        adm = GangAdmission(
+            client,
+            reservations=table,
+            journal=AdmissionJournal(d),
+            topo_source=lambda: [
+                dataclasses.replace(t, available=list(t.available))
+                for t in topos
+            ],
+        )
+        resolver = PriorityResolver()
+        adm.priority_resolver = resolver
+        engine = DefragEngine(
+            adm, resolver, stranded_ticks=2, checkpoint_wait_ticks=0,
+        )
+        adm.defrag = engine
+        released: List[Tuple[str, str]] = []
+        for _ in range(engine.detector.stranded_ticks):
+            released = adm.tick()
+        assert released == [("default", "train")], released
+        evicted_gangs = {
+            n.rsplit("-w", 1)[0] for _, n in client.evicted
+        }
+        assert evicted_gangs == {"frag"}, evicted_gangs
+        hold = table.active()[("default", "train")]
+        assert hold.hosts == {"n1": 4}, hold.hosts
+        gates = client.pods[("default", "train-w0")]["spec"][
+            "schedulingGates"
+        ]
+        assert gates == [], gates
+        assert not engine.open_intents()
+        assert engine.last_outcome == "executed", engine.last_outcome
+        assert engine.last_plan and (
+            engine.last_plan["target_host"] == "n1"
+        )
+        assert 4 in engine.last_plan["placeable_after"]
+        adm.journal.close()
+        print(json.dumps({
+            "defrag_self_test": "ok",
+            "migrated": sorted(evicted_gangs),
+            "target": engine.last_plan["target_host"],
+            "budget_remaining": engine.budget_remaining(),
+        }))
+        return 0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="tpu-defrag",
+        description="Active defragmentation: stranded demand, the "
+        "plan the planner would execute, and budget state — read "
+        "from a live extender's /debug/defrag surface.",
+    )
+    p.add_argument(
+        "command", nargs="?", choices=("plan", "status"),
+        help="plan: render the last computed migration plan (dry-run "
+        "view); status: stranded demand + budget + last outcome",
+    )
+    p.add_argument(
+        "--url", default="",
+        help="extender base URL, e.g. http://extender:12346",
+    )
+    p.add_argument(
+        "--self-test", action="store_true",
+        help="run the fragmented-2-node migration smoke "
+        "(scripts/tier1.sh)",
+    )
+    a = p.parse_args(argv)
+    if a.self_test:
+        return self_test()
+    if not a.command:
+        p.print_help()
+        return 2
+    if not a.url:
+        p.error("--url is required for plan/status")
+    try:
+        doc = _fetch(a.url)
+    except (OSError, ValueError) as e:
+        print(f"tpu-defrag: {e}", file=sys.stderr)
+        return 1
+    lines = (
+        _render_plan(doc) if a.command == "plan"
+        else _render_status(doc)
+    )
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

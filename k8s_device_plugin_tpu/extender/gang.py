@@ -525,6 +525,14 @@ class GangAdmission:
         # pre-PR-13 FIFO behavior, bit for bit.
         self.priority_resolver = None
         self.preemption = None
+        # Active defragmentation plane (extender/defrag.py), wired by
+        # the entrypoint. A capacity-waiting gang whose demand is
+        # STRANDED (free chips exist, no contiguous box anywhere) may
+        # — after preemption declined — trigger a budget-limited
+        # migration of strictly-lower-priority gangs off one host,
+        # two-phase journaled, and admit onto the freed, fenced box.
+        # None = no defrag (the pre-PR-15 behavior, bit for bit).
+        self.defrag = None
         # Gang → (numeric priority, tier label), refreshed per
         # evaluation; pruned with the gang (the tier feeds the
         # per-tier waiting/admitted metric labels).
@@ -567,6 +575,17 @@ class GangAdmission:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self.defrag is not None:
+            # AFTER the tick thread joined: deregister the defrag
+            # engine from the /debug/defrag surface and prune its
+            # metric series (shard handback stops the admitter; a
+            # stale engine must not linger in the debug payload).
+            # Closing before the join would race an in-flight tick
+            # re-publishing the just-pruned series, orphaning them
+            # forever. getattr: tests attach bare stubs.
+            close = getattr(self.defrag, "close", None)
+            if close is not None:
+                close()
         if self.journal is not None:
             # Graceful teardown folds state into one clean snapshot so
             # the successor's replay is O(holds), not O(journal). The
@@ -601,7 +620,78 @@ class GangAdmission:
                 if self.preemption is not None
                 else None
             ),
+            defragging=(
+                self.defrag.open_intents()
+                if self.defrag is not None
+                else None
+            ),
+            defrag_spend=(
+                self.defrag.spend_window()
+                if self.defrag is not None
+                else None
+            ),
         )
+
+    def _recover_rounds(
+        self,
+        rounds: Dict[Tuple[str, str], dict],
+        gangs: Dict[Tuple[str, str], "GangView"],
+        truth: bool,
+        now: float,
+        done_op: str,
+        abort_op: str,
+        abort_metric: Optional[Callable[[str], None]] = None,
+    ) -> Tuple[int, int]:
+        """Re-anchor the open two-phase rounds of ONE eviction
+        protocol (preempt_* or defrag_* — identical record shape by
+        design). Returns (refenced, aborted). An "evicted" phase whose
+        reserve never landed re-installs the planned fence from the
+        journaled plan (restore() journals the reserve via the
+        observer tap, so table and journal agree immediately); an
+        "intent" phase — or a fence that can no longer restore —
+        aborts, and the next tick re-plans from cluster truth."""
+        refenced = aborted = 0
+        active_now = self.reservations.active() if rounds else {}
+        for key, rec in sorted(rounds.items()):
+            if truth and key not in gangs:
+                self.journal.record(
+                    abort_op, key, reason="gang_vanished"
+                )
+                if abort_metric is not None:
+                    abort_metric("gang_vanished")
+                aborted += 1
+                continue
+            if key in active_now:
+                # The reserve landed before the crash: the round is
+                # effectively complete; the standing-hold release path
+                # finishes the gates.
+                self.journal.record(done_op, key)
+                continue
+            if rec.get("phase") == "evicted":
+                hosts = {
+                    str(h): int(n)
+                    for h, n in (rec.get("consumed") or {}).items()
+                }
+                age = max(0.0, now - float(rec.get("ts", now)))
+                if hosts and self.reservations.restore(
+                    key,
+                    hosts,
+                    age_s=age,
+                    demands=tuple(sorted(
+                        int(d) for d in rec.get("demands") or ()
+                    )),
+                    priority=int(rec.get("priority", 0)),
+                ):
+                    self.journal.record(done_op, key)
+                    refenced += 1
+                    self.mark_dirty(key, source="recovery")
+                    continue
+            self.journal.record(abort_op, key, reason="recovered")
+            if abort_metric is not None:
+                abort_metric("recovered")
+            aborted += 1
+            self.mark_dirty(key, source="recovery")
+        return refenced, aborted
 
     def recover(self) -> dict:
         """Cold-start rehydration: replay the journal, reconcile it
@@ -632,6 +722,7 @@ class GangAdmission:
             | state.lapsed
             | set(state.waiting_since)
             | set(state.preempting)
+            | set(state.defragging)
         )
         try:
             if keys:
@@ -679,57 +770,34 @@ class GangAdmission:
         self._lapsed_gangs |= {
             k for k in state.lapsed if not truth or k in gangs
         }
-        # Open preemption rounds (two-phase protocol,
-        # extender/preemption.py): SIGKILL anywhere inside a round
-        # must rehydrate to a safe state. "evicted" with no reserve =
-        # the steal window preemption opened and never fenced —
-        # re-install the planned fence NOW (behind the readiness gate,
-        # so /filter never serves without it); "intent" = nothing
-        # irreversible landed — abort, the next tick re-plans from
-        # cluster truth. Either way the round's journal entry closes.
-        preempt_refenced = preempt_aborted = 0
-        active_now = (
-            self.reservations.active() if state.preempting else {}
+        # Open preemption AND defragmentation rounds (the two-phase
+        # protocols of extender/preemption.py and extender/defrag.py —
+        # same record shape on purpose): SIGKILL anywhere inside a
+        # round must rehydrate to a safe state. "evicted" with no
+        # reserve = the steal window the round opened and never fenced
+        # — re-install the planned fence NOW (behind the readiness
+        # gate, so /filter never serves without it); "intent" =
+        # nothing irreversible landed — abort, the next tick re-plans
+        # from cluster truth. Either way the round's journal entry
+        # closes.
+        preempt_refenced, preempt_aborted = self._recover_rounds(
+            state.preempting, gangs, truth, now,
+            done_op="preempt_done", abort_op="preempt_abort",
         )
-        for key, rec in sorted(state.preempting.items()):
-            if truth and key not in gangs:
-                self.journal.record(
-                    "preempt_abort", key, reason="gang_vanished"
-                )
-                preempt_aborted += 1
-                continue
-            if key in active_now:
-                # The reserve landed before the crash: the round is
-                # effectively complete; the standing-hold release path
-                # finishes the gates.
-                self.journal.record("preempt_done", key)
-                continue
-            if rec.get("phase") == "evicted":
-                hosts = {
-                    str(h): int(n)
-                    for h, n in (rec.get("consumed") or {}).items()
-                }
-                age = max(0.0, now - float(rec.get("ts", now)))
-                if hosts and self.reservations.restore(
-                    key,
-                    hosts,
-                    age_s=age,
-                    demands=tuple(sorted(
-                        int(d) for d in rec.get("demands") or ()
-                    )),
-                    priority=int(rec.get("priority", 0)),
-                ):
-                    # restore() journals the reserve via the observer
-                    # tap, so table and journal agree immediately.
-                    self.journal.record("preempt_done", key)
-                    preempt_refenced += 1
-                    self.mark_dirty(key, source="recovery")
-                    continue
-            self.journal.record(
-                "preempt_abort", key, reason="recovered"
-            )
-            preempt_aborted += 1
-            self.mark_dirty(key, source="recovery")
+        defrag_refenced, defrag_aborted = self._recover_rounds(
+            state.defragging, gangs, truth, now,
+            done_op="defrag_done", abort_op="defrag_abort",
+            # The metric reason mirrors the journaled abort reason
+            # exactly (gang_vanished vs recovered).
+            abort_metric=lambda reason: metrics.DEFRAG_ABORTED.inc(
+                reason=reason
+            ),
+        )
+        if self.defrag is not None and state.defrag_spend:
+            # The defrag eviction budget's rolling window survives the
+            # crash: a crashlooping extender must not grant itself a
+            # fresh --defrag-max-evictions-per-hour every restart.
+            self.defrag.seed_spend(state.defrag_spend)
         # Wait-episode origins: the SLO clock and the pending-Event
         # threshold keep counting from the TRUE start of the wait.
         for key, since in state.waiting_since.items():
@@ -758,6 +826,8 @@ class GangAdmission:
             "waits_restored": len(state.waiting_since),
             "preempt_refenced": preempt_refenced,
             "preempt_aborted": preempt_aborted,
+            "defrag_refenced": defrag_refenced,
+            "defrag_aborted": defrag_aborted,
             "cluster_truth": truth,
             "took_s": took,
         }
@@ -941,6 +1011,11 @@ class GangAdmission:
             # The waiting episode ended (admit, vanish, or state
             # change): a future episode may ledger a fresh no_plan.
             self.preemption.note_admitted(key)
+        if self.defrag is not None:
+            # Same contract for the defrag plane: drop the gang's
+            # stranded-episode hysteresis state and per-episode
+            # ledger-dedup marks.
+            self.defrag.note_admitted(key)
 
     def _priority_of(
         self, key: Tuple[str, str], gv: "GangView"
@@ -1306,6 +1381,8 @@ class GangAdmission:
         self._event_budget_left = self.pending_event_budget
         if self.preemption is not None:
             self.preemption.begin_tick()
+        if self.defrag is not None:
+            self.defrag.begin_tick()
         self._reservation_upkeep(gangs, full)
         # Prune the waiting markers of gangs that vanished — the maps
         # must not grow without bound. A dirty tick only saw
@@ -1518,6 +1595,24 @@ class GangAdmission:
                 if consumed_hosts is not None:
                     preempted = True
                     pool().debit(consumed_hosts)
+            defragged = False
+            if consumed_hosts is None and self.defrag is not None:
+                # Active defragmentation (extender/defrag.py): when
+                # the demand is STRANDED — free chips exist but no
+                # contiguous box anywhere — and preemption (if wired)
+                # declined, a budget-limited migration of strictly-
+                # lower-priority gangs may free a box; the consumed
+                # map flows into the same reserve→release path, so the
+                # freed box is fenced for THIS gang before any gate
+                # comes off.
+                consumed_hosts = self.defrag.maybe_defrag(
+                    key, gv, demands, pool().current_topos(),
+                    prios[key],
+                    gangs=gangs if full else None,
+                )
+                if consumed_hosts is not None:
+                    defragged = True
+                    pool().debit(consumed_hosts)
             if consumed_hosts is None:
                 diag = pool().last_reject or {}
                 # Register capacity dependencies so node events wake
@@ -1588,6 +1683,10 @@ class GangAdmission:
                 # (journaled via the observer tap) — close the
                 # two-phase journal entry before the gates come off.
                 self.preemption.finish(key)
+            if defragged:
+                # Same phase-3 close for a defrag round: the target
+                # box is fenced under the stranded gang's key.
+                self.defrag.finish(key)
             # A fresh gated release is a fresh all-or-nothing decision:
             # it clears any lapse bar a previous same-named generation
             # left behind (the new hold ages from now, legitimately).

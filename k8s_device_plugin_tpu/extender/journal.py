@@ -67,10 +67,17 @@ GangKey = Tuple[str, str]
 # intent → victims evicted → done/abort) are all critical: losing one
 # to a crash could re-evict already-evicted victims or leave freed
 # chips unfenced through recovery.
+# The defrag_* ops (extender/defrag.py's migration protocol: intent →
+# victims evicted → target box fenced → done/abort) share the
+# preempt_* criticality rationale exactly: losing one could re-evict
+# already-migrated victims or leave the freed target box unfenced
+# through recovery.
 CRITICAL_OPS = frozenset({
     "reserve", "admit", "lapse",
     "preempt_intent", "preempt_evicted", "preempt_done",
     "preempt_abort",
+    "defrag_intent", "defrag_evicted", "defrag_done",
+    "defrag_abort",
 })
 
 # One snapshot compaction per this many journal records keeps replay
@@ -108,6 +115,24 @@ class RehydratedState:
     # tick re-plans from cluster truth).
     preempting: Dict[GangKey, dict] = dataclasses.field(
         default_factory=dict
+    )
+    # Open defragmentation rounds (extender/defrag.py two-phase
+    # protocol), keyed by the STRANDED (requestor) gang — same record
+    # shape and same recovery semantics as ``preempting``: an
+    # "evicted" phase re-fences the migrated-for target box, an
+    # "intent" phase aborts (the next tick re-plans from cluster
+    # truth).
+    defragging: Dict[GangKey, dict] = dataclasses.field(
+        default_factory=dict
+    )
+    # Wall clocks of executed defrag victim-pod evictions — the
+    # rolling-hour budget window (--defrag-max-evictions-per-hour),
+    # rehydrated so a crashlooping extender cannot grant itself a
+    # fresh blast-radius budget every restart. Best-effort by design
+    # (non-critical op, flushed at tick end): a crash can lose at most
+    # the dying tick's stamps.
+    defrag_spend: List[float] = dataclasses.field(
+        default_factory=list
     )
 
 
@@ -235,11 +260,27 @@ class AdmissionJournal:
         )
         return self._fold(loaded)
 
+    @staticmethod
+    def _round_from_snap(p: dict) -> dict:
+        """One open two-phase round from its snapshot form — shared by
+        the ``preempting`` and ``defragging`` lists, which carry the
+        identical record shape on purpose."""
+        return {
+            "phase": p.get("phase", "intent"),
+            "victims": p.get("victims") or [],
+            "consumed": p.get("consumed") or {},
+            "demands": p.get("demands") or [],
+            "priority": int(p.get("priority", 0)),
+            "ts": float(p.get("ts", 0.0)),
+        }
+
     def _fold(self, loaded) -> RehydratedState:
         holds: Dict[GangKey, Hold] = {}
         lapsed: Set[GangKey] = set()
         waiting: Dict[GangKey, float] = {}
         preempting: Dict[GangKey, dict] = {}
+        defragging: Dict[GangKey, dict] = {}
+        defrag_spend: List[float] = []
         if loaded.snapshot:
             snap = loaded.snapshot
             for h in snap.get("holds", []):
@@ -260,17 +301,22 @@ class AdmissionJournal:
                 for w in snap.get("waiting", [])
             }
             for p in snap.get("preempting", []):
-                preempting[(p.get("ns", ""), p.get("gang", ""))] = {
-                    "phase": p.get("phase", "intent"),
-                    "victims": p.get("victims") or [],
-                    "consumed": p.get("consumed") or {},
-                    "demands": p.get("demands") or [],
-                    "priority": int(p.get("priority", 0)),
-                    "ts": float(p.get("ts", 0.0)),
-                }
+                preempting[
+                    (p.get("ns", ""), p.get("gang", ""))
+                ] = self._round_from_snap(p)
+            for p in snap.get("defragging", []):
+                defragging[
+                    (p.get("ns", ""), p.get("gang", ""))
+                ] = self._round_from_snap(p)
+            defrag_spend.extend(
+                float(t) for t in snap.get("defrag_spend", [])
+            )
         applied = 0
         for rec in loaded.records:
-            self._apply(rec, holds, lapsed, waiting, preempting)
+            self._apply(
+                rec, holds, lapsed, waiting, preempting, defragging,
+                defrag_spend,
+            )
             applied += 1
         return RehydratedState(
             holds=holds,
@@ -280,6 +326,8 @@ class AdmissionJournal:
             records=applied,
             dropped=loaded.dropped,
             preempting=preempting,
+            defragging=defragging,
+            defrag_spend=defrag_spend,
         )
 
     @staticmethod
@@ -289,6 +337,8 @@ class AdmissionJournal:
         lapsed: Set[GangKey],
         waiting: Dict[GangKey, float],
         preempting: Optional[Dict[GangKey, dict]] = None,
+        defragging: Optional[Dict[GangKey, dict]] = None,
+        defrag_spend: Optional[List[float]] = None,
     ) -> None:
         g = rec.get("g") or ["", ""]
         key: GangKey = (str(g[0]), str(g[1]))
@@ -354,6 +404,31 @@ class AdmissionJournal:
         elif op in ("preempt_done", "preempt_abort"):
             if preempting is not None:
                 preempting.pop(key, None)
+        elif op in ("defrag_intent", "defrag_evicted"):
+            if defragging is not None:
+                # Like preempt_*: both phases carry the full plan so a
+                # compaction between the two records leaves the
+                # evicted phase self-sufficient.
+                defragging[key] = {
+                    "phase": (
+                        "intent" if op == "defrag_intent" else "evicted"
+                    ),
+                    "victims": rec.get("victims") or [],
+                    "consumed": rec.get("consumed") or {},
+                    "demands": rec.get("demands") or [],
+                    "priority": int(rec.get("priority", 0)),
+                    "ts": float(rec.get("ts", 0.0)),
+                }
+        elif op in ("defrag_done", "defrag_abort"):
+            if defragging is not None:
+                defragging.pop(key, None)
+        elif op == "defrag_spend":
+            # Executed victim-pod evictions spending the rolling-hour
+            # defrag budget; the engine prunes stamps past the window.
+            if defrag_spend is not None:
+                defrag_spend.extend(
+                    float(t) for t in rec.get("stamps") or []
+                )
         # "renew": expiry is process-local — a rehydrated hold gets a
         # fresh TTL from its preserved age; "admit": the release
         # decision marker (the reserve just before it carries the
@@ -363,15 +438,34 @@ class AdmissionJournal:
     # -- snapshot shape ----------------------------------------------------
 
     @staticmethod
+    def _rounds_to_snap(rounds: Optional[Dict[GangKey, dict]]) -> list:
+        return [
+            {
+                "ns": k[0],
+                "gang": k[1],
+                "phase": p.get("phase", "intent"),
+                "victims": list(p.get("victims") or []),
+                "consumed": dict(p.get("consumed") or {}),
+                "demands": list(p.get("demands") or []),
+                "priority": int(p.get("priority", 0)),
+                "ts": round(float(p.get("ts", 0.0)), 3),
+            }
+            for k, p in sorted((rounds or {}).items())
+        ]
+
+    @staticmethod
     def state_data(
         holds: Dict[GangKey, Hold],
         lapsed: Set[GangKey],
         waiting_since: Dict[GangKey, float],
         preempting: Optional[Dict[GangKey, dict]] = None,
+        defragging: Optional[Dict[GangKey, dict]] = None,
+        defrag_spend: Optional[List[float]] = None,
     ) -> dict:
         """The compaction document replay() consumes — built by the
         owner (gang.py assembles it from the live table + its lapse
-        bars + wait clocks + the preemption engine's open intents)."""
+        bars + wait clocks + the preemption and defrag engines' open
+        rounds and the defrag engine's budget-spend window)."""
         return {
             "holds": [
                 {
@@ -390,19 +484,13 @@ class AdmissionJournal:
                 [k[0], k[1], round(ts, 3)]
                 for k, ts in sorted(waiting_since.items())
             ],
-            "preempting": [
-                {
-                    "ns": k[0],
-                    "gang": k[1],
-                    "phase": p.get("phase", "intent"),
-                    "victims": list(p.get("victims") or []),
-                    "consumed": dict(p.get("consumed") or {}),
-                    "demands": list(p.get("demands") or []),
-                    "priority": int(p.get("priority", 0)),
-                    "ts": round(float(p.get("ts", 0.0)), 3),
-                }
-                for k, p in sorted((preempting or {}).items())
-            ],
+            "preempting": AdmissionJournal._rounds_to_snap(preempting),
+            "defragging": AdmissionJournal._rounds_to_snap(defragging),
+            # Full precision: same-millisecond evictions must stay
+            # distinct budget stamps across a replay.
+            "defrag_spend": sorted(
+                float(t) for t in defrag_spend or []
+            ),
         }
 
 
@@ -489,6 +577,39 @@ def self_test() -> int:
         j5.record("preempt_done", pk)
         j5.close()
         assert pk not in AdmissionJournal(d).replay().preempting
+
+        # Defrag migration protocol: the same two-phase shape, its own
+        # op vocabulary — an open "evicted" migration survives replay
+        # AND a compaction, then closes on done.
+        dk = ("default", "stranded")
+        j6 = AdmissionJournal(d)
+        j6.replay()
+        j6.record(
+            "defrag_intent", dk,
+            victims=[["default", "frag"]], consumed={"n2": 4},
+            demands=[4],
+        )
+        j6.record(
+            "defrag_evicted", dk,
+            victims=[["default", "frag"]], consumed={"n2": 4},
+            demands=[4],
+        )
+        j6.close()
+        st = AdmissionJournal(d).replay()
+        assert st.defragging[dk]["phase"] == "evicted", st.defragging
+        assert st.defragging[dk]["consumed"] == {"n2": 4}
+        j7 = AdmissionJournal(d)
+        st7 = j7.replay()
+        j7.compact(
+            AdmissionJournal.state_data(
+                st7.holds, st7.lapsed, st7.waiting_since,
+                st7.preempting, st7.defragging,
+            )
+        )
+        assert j7.replay().defragging[dk]["phase"] == "evicted"
+        j7.record("defrag_done", dk)
+        j7.close()
+        assert dk not in AdmissionJournal(d).replay().defragging
         print(json.dumps({"journal_self_test": "ok"}))
         return 0
     finally:

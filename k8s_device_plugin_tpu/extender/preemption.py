@@ -66,6 +66,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from ..api import constants
 from ..kube.client import KubeError
 from ..utils import metrics, tracing
+from ..workload.checkpointing import CheckpointBeacon
 from ..utils.decisions import LEDGER
 from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
@@ -204,6 +205,77 @@ class PriorityResolver:
         return max(
             (self.pod_priority(p) for p in pods), default=0
         )
+
+
+def evict_gang_pod(client, ns: str, name: str) -> bool:
+    """The ONE gang-eviction door (PR-13's): the Eviction subresource
+    first (PDB-honoring); plain delete fallback ONLY when the
+    subresource itself is unsupported (405 — an apiserver build
+    without the policy group). Every other refusal returns False and
+    the caller aborts its round: a 429 is a disruption budget doing
+    its job, and a 403/422/5xx must never escalate into a
+    PDB-ignoring forced delete. Shared by the preemption engine and
+    the defrag engine (extender/defrag.py) so "how we evict" can
+    never drift between the two planes that evict."""
+    try:
+        client.evict_pod(ns, name)
+        return True
+    except KubeError as e:
+        if e.status_code == 429:
+            log.warning(
+                "eviction of %s/%s blocked by disruption budget",
+                ns, name,
+            )
+            return False
+        if e.status_code != 405:
+            log.warning(
+                "eviction of %s/%s refused (%s); aborting the "
+                "round", ns, name, e,
+            )
+            return False
+        log.warning(
+            "eviction subresource unsupported for %s/%s (%s); "
+            "falling back to plain delete", ns, name, e,
+        )
+    except OSError as e:
+        log.warning(
+            "eviction of %s/%s unreachable: %s", ns, name, e
+        )
+        return False
+    try:
+        client.delete_pod(ns, name)
+        return True
+    except (KubeError, OSError) as e:
+        log.warning(
+            "plain-delete fallback failed for %s/%s: %s",
+            ns, name, e,
+        )
+        return False
+
+
+def credited_topos(topos, freed: Dict[str, int]) -> list:
+    """Per-call topology clones with ``freed`` chips credited back per
+    host — the ONE optimistic what-if availability builder both
+    eviction planes (preemption's ``_fits_with``, defrag's plan
+    proofs) run their feasibility on. Optimistic about WHICH chips
+    free (the first unavailable ids in chip order), which can
+    overestimate box quality but never count-based admission; sharing
+    the construction is what keeps the two planes' "feasible" from
+    ever diverging."""
+    aug = []
+    for t in topos:
+        extra = freed.get(t.hostname, 0)
+        if extra > 0:
+            have = set(t.available)
+            credit = [
+                c.id for c in t.chips if c.id not in have
+            ][:extra]
+            aug.append(dataclasses.replace(
+                t, available=list(t.available) + credit
+            ))
+        else:
+            aug.append(t)
+    return aug
 
 
 # -- victims & cost ----------------------------------------------------------
@@ -351,7 +423,7 @@ class PreemptionPlanner:
                 continue
             hosts: Dict[str, int] = {}
             pods: List[dict] = []
-            last_ckpt: Optional[float] = None
+            ckpt_age: Optional[float] = None
             for p in live:
                 node = (p.get("spec") or {}).get("nodeName")
                 if not node:
@@ -368,18 +440,16 @@ class PreemptionPlanner:
                     "host": node,
                     "chips": chips,
                 })
-                raw = (meta.get("annotations") or {}).get(
-                    constants.CHECKPOINT_TS_ANNOTATION
+                # The ONE beacon-annotation parser (workload/
+                # checkpointing.py) — the gang's age is its most
+                # RECENT member save (minimum age).
+                age = CheckpointBeacon.age_from(
+                    meta.get("annotations"), now=now
                 )
-                if raw:
-                    try:
-                        ts = float(raw)
-                    except ValueError:
-                        ts = None
-                    if ts is not None:
-                        last_ckpt = (
-                            ts if last_ckpt is None else max(last_ckpt, ts)
-                        )
+                if age is not None:
+                    ckpt_age = (
+                        age if ckpt_age is None else min(ckpt_age, age)
+                    )
             if not hosts:
                 continue  # nothing placed = nothing evictable frees chips
             gkey = f"{key[0]}/{key[1]}"
@@ -389,11 +459,7 @@ class PreemptionPlanner:
                 hosts=hosts,
                 pods=pods,
                 duty_cycle=duty.get(gkey, duty.get(key[1])),
-                checkpoint_age_s=(
-                    max(0.0, now - last_ckpt)
-                    if last_ckpt is not None
-                    else None
-                ),
+                checkpoint_age_s=ckpt_age,
             ))
         return out
 
@@ -409,20 +475,7 @@ class PreemptionPlanner:
         tick can admit."""
         from .gang import _CapacityPool  # deferred: gang imports us
 
-        aug = []
-        for t in topos:
-            extra = freed.get(t.hostname, 0)
-            if extra > 0:
-                have = set(t.available)
-                credit = [
-                    c.id for c in t.chips if c.id not in have
-                ][:extra]
-                aug.append(dataclasses.replace(
-                    t, available=list(t.available) + credit
-                ))
-            else:
-                aug.append(t)
-        return _CapacityPool(aug).fits(demands)
+        return _CapacityPool(credited_topos(topos, freed)).fits(demands)
 
     @staticmethod
     def _sum_hosts(victims: List[Victim]) -> Dict[str, int]:
@@ -768,76 +821,54 @@ class PreemptionEngine:
     # -- helpers -----------------------------------------------------------
 
     def _evict_pod(self, victim: Victim, p: dict) -> bool:
-        """Eviction subresource first (PDB-honoring); plain delete
-        fallback ONLY when the subresource itself is unsupported (405
-        — an apiserver build without the policy group). Every other
-        refusal aborts the round: a 429 is a disruption budget doing
-        its job, and a 403/422/5xx must never escalate into a
-        PDB-ignoring forced delete. False = the round aborts (retried
+        """One victim pod through the shared eviction door
+        (:func:`evict_gang_pod`). False = the round aborts (retried
         next tick)."""
-        client = self.admission.client
-        ns, name = p.get("ns", "default"), p.get("name", "")
-        try:
-            client.evict_pod(ns, name)
-            return True
-        except KubeError as e:
-            if e.status_code == 429:
-                log.warning(
-                    "eviction of %s/%s blocked by disruption budget",
-                    ns, name,
-                )
-                return False
-            if e.status_code != 405:
-                log.warning(
-                    "eviction of %s/%s refused (%s); aborting the "
-                    "round", ns, name, e,
-                )
-                return False
-            log.warning(
-                "eviction subresource unsupported for %s/%s (%s); "
-                "falling back to plain delete", ns, name, e,
-            )
-        except OSError as e:
-            log.warning(
-                "eviction of %s/%s unreachable: %s", ns, name, e
-            )
-            return False
-        try:
-            client.delete_pod(ns, name)
-            return True
-        except (KubeError, OSError) as e:
-            log.warning(
-                "plain-delete fallback failed for %s/%s: %s",
-                ns, name, e,
-            )
-            return False
+        return evict_gang_pod(
+            self.admission.client,
+            p.get("ns", "default"),
+            p.get("name", ""),
+        )
 
     def _post_victim_event(self, victim: Victim, evictor: str) -> None:
-        """Best-effort Warning Event on the victim gang's first pod so
-        `kubectl describe` shows who evicted it and why."""
-        create = getattr(self.admission.client, "create_event", None)
-        if create is None or not victim.pods:
-            return
-        p = victim.pods[0]
-        try:
-            create(
-                p.get("ns", "default"),
-                {
-                    "kind": "Pod",
-                    "name": p.get("name", ""),
-                    "namespace": p.get("ns", "default"),
-                    "uid": p.get("uid", ""),
-                },
-                reason="TPUGangPreempted",
-                message=(
-                    f"gang {victim.key[0]}/{victim.key[1]} preempted "
-                    f"by higher-priority gang {evictor}"
-                ),
-                event_type="Warning",
-                component="tpu-gang-admission",
-            )
-        except (KubeError, OSError) as e:
-            log.debug("preemption event post failed: %s", e)
+        post_victim_event(
+            self.admission.client,
+            victim,
+            reason="TPUGangPreempted",
+            message=(
+                f"gang {victim.key[0]}/{victim.key[1]} preempted "
+                f"by higher-priority gang {evictor}"
+            ),
+        )
+
+
+def post_victim_event(
+    client, victim: Victim, reason: str, message: str
+) -> None:
+    """Best-effort Warning Event on a victim gang's first pod so
+    `kubectl describe` shows who evicted it and why — ONE poster for
+    both eviction planes (preemption and extender/defrag.py), so
+    their event shape and failure handling can never drift."""
+    create = getattr(client, "create_event", None)
+    if create is None or not victim.pods:
+        return
+    p = victim.pods[0]
+    try:
+        create(
+            p.get("ns", "default"),
+            {
+                "kind": "Pod",
+                "name": p.get("name", ""),
+                "namespace": p.get("ns", "default"),
+                "uid": p.get("uid", ""),
+            },
+            reason=reason,
+            message=message,
+            event_type="Warning",
+            component="tpu-gang-admission",
+        )
+    except (KubeError, OSError) as e:
+        log.debug("victim event post failed (%s): %s", reason, e)
 
 
 # -- self-test ---------------------------------------------------------------

@@ -1112,6 +1112,111 @@ def audit_overhead(
     }
 
 
+def defrag_planning(
+    n_nodes: int = 1000,
+    n_victims: int = 100,
+    samples: int = 30,
+) -> dict:
+    """Defragmentation planning latency over a deliberately fragmented
+    1,000-node fixture (ISSUE 15): every node has free chips, NO node
+    has a contiguous 4-box — the exact cluster shape that strands a
+    4-cube gang — and ``n_victims`` low-priority 2-chip gangs sit on
+    distinct hosts as migration candidates. Two arms, interleaved
+    sample-by-sample (the shard_scaling convention — same-moment
+    machine state, no drift between arms):
+
+    * ``detect`` — :func:`~..extender.defrag.stranded_size` over all
+      N topologies: the per-tick scan EVERY capacity-waiting gang
+      pays while the hysteresis counts (box_candidates is precomputed
+      per shape, so this must stay cheap at cluster scale).
+    * ``plan`` — :meth:`~..extender.defrag.DefragPlanner.plan`: the
+      full search — per-host greedy victim sets, the credited what-if
+      capacity view over all N nodes, both pool feasibility proofs
+      (stranded fit + victim relocation) — paid only once per
+      stranded episode after hysteresis clears.
+
+    tests/test_scale_bench.py bounds the plan p99; bench.py records
+    both as ``detail.defrag_planning``."""
+    from .defrag import DefragPlanner, stranded_size
+    from .preemption import PriorityResolver, Victim
+
+    # Fragmented on purpose: chips 0 and 2 of a 4-chip node free —
+    # free chips everywhere, a contiguous 4-box nowhere.
+    topos = []
+    for i in range(n_nodes):
+        doc = _node(f"node-{i:04d}")
+        topo = NodeTopology.from_json(
+            (doc["metadata"]["annotations"] or {})[
+                constants.TOPOLOGY_ANNOTATION
+            ]
+        )
+        mesh = topo.to_mesh()
+        topos.append(
+            NodeTopology.from_mesh(
+                mesh,
+                hostname=f"node-{i:04d}",
+                available=[mesh.ids[0], mesh.ids[2]],
+            )
+        )
+    victims = [
+        Victim(
+            key=("default", f"batch-{v:03d}"),
+            priority=-10,
+            hosts={f"node-{v:04d}": 2},
+            pods=[
+                {
+                    "ns": "default",
+                    "name": f"batch-{v:03d}-w{w}",
+                    "uid": f"uid-{v}-{w}",
+                    "host": f"node-{v:04d}",
+                    "chips": 1,
+                }
+                for w in range(2)
+            ],
+            duty_cycle=5.0,
+            checkpoint_age_s=10.0,
+        )
+        for v in range(n_victims)
+    ]
+    planner = DefragPlanner(PriorityResolver())
+    requestor = ("default", "stranded-train")
+    # Warm both paths off-measurement (box_candidates memo, mesh
+    # memos, the pool's first build).
+    assert stranded_size(topos, [4]) == 4
+    warm = planner.plan(requestor, [4], 0, topos, victims,
+                        max_victims=2)
+    assert warm is not None and len(warm.victims) == 1, warm
+    import gc
+
+    gc.collect()
+    gc.freeze()
+    try:
+        detect_s: List[float] = []
+        plan_s: List[float] = []
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            n = stranded_size(topos, [4])
+            detect_s.append(time.perf_counter() - t0)
+            assert n == 4
+            t0 = time.perf_counter()
+            plan = planner.plan(
+                requestor, [4], 0, topos, victims, max_victims=2
+            )
+            plan_s.append(time.perf_counter() - t0)
+            assert plan is not None
+    finally:
+        gc.unfreeze()
+    return {
+        "nodes": n_nodes,
+        "victims": n_victims,
+        "plan_victims": len(warm.victims),
+        "target_host": warm.target_host,
+        "placeable_after": list(warm.placeable_after),
+        "detect": _pctl(detect_s),
+        "plan": _pctl(plan_s),
+    }
+
+
 def cold_start(
     n_nodes: int = 1000,
     ready_samples: int = 101,
@@ -1567,6 +1672,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "scale run",
     )
     p.add_argument(
+        "--defrag-planning", action="store_true",
+        help="run the defragmentation planning-latency probe "
+        "(stranded-demand detection scan + full plan search over a "
+        "fragmented fixture) instead of the scale run",
+    )
+    p.add_argument(
         "--cold-start", action="store_true",
         help="run the cold-start failover probe (persistent index "
         "snapshot vs full parse) instead of the scale run",
@@ -1600,6 +1711,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cold_start_self_test()
     if a.cold_start:
         print(json.dumps(cold_start(n_nodes=a.nodes)))
+        return 0
+    if a.defrag_planning:
+        print(json.dumps(defrag_planning(n_nodes=a.nodes)))
         return 0
     if a.audit_overhead:
         print(json.dumps(audit_overhead(n_nodes=a.nodes)))

@@ -1,0 +1,19 @@
+"""tpu-defrag — the defragmentation what-if CLI (thin alias).
+
+The implementation lives with the subsystem it renders
+(`extender/defrag.py`: the engine, the /debug/defrag surface, and the
+renderers share one module so they cannot drift); this alias gives it
+the same ``python -m k8s_device_plugin_tpu.tools.<name>`` address as
+the rest of the operator toolbox (tputop, explain, doctor, flame…).
+
+    python -m k8s_device_plugin_tpu.tools.defrag status --url http://extender:12346
+    python -m k8s_device_plugin_tpu.tools.defrag plan --url http://extender:12346
+    python -m k8s_device_plugin_tpu.tools.defrag --self-test   # CI smoke
+"""
+
+from ..extender.defrag import main
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

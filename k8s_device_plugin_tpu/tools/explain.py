@@ -26,6 +26,10 @@ Four questions, four selectors:
   checkpoint-age cost facts frozen at decision time) joined with the
   evictor gang's ``preemption`` round records
   (extender/preemption.py).
+* ``--migrated Z`` — why the gang was migrated by defragmentation:
+  its ``defrag_victim`` selection records (the stranded requestor it
+  moved FOR, target host, and the same frozen cost facts) joined with
+  the requestor gang's ``defrag`` round records (extender/defrag.py).
 
     python -m k8s_device_plugin_tpu.tools.explain --pod my-pod \
         --url http://extender:12346
@@ -215,6 +219,58 @@ def render_evicted(records: List[dict], spans: List[dict],
     return out
 
 
+def render_migrated(records: List[dict], spans: List[dict],
+                    gang: str) -> List[str]:
+    """'Why was I migrated': the victim gang's defrag_victim records
+    (cost ranking at decision time, the stranded requestor it moved
+    FOR) merged with the requestor's defrag-round records,
+    chronological, traces beneath."""
+    mine = sorted(
+        (
+            r for r in records
+            if r.get("kind") == "defrag_victim"
+            and _name_match(r.get("gang", ""), gang)
+        ),
+        key=lambda r: r.get("ts", 0),
+    )
+    if not mine:
+        return [f"(no defragmentation records for gang {gang!r})"]
+    requestors = {
+        (r.get("attrs") or {}).get("requestor", "")
+        for r in mine
+        if (r.get("attrs") or {}).get("requestor")
+    }
+    rounds = [
+        r for r in records
+        if r.get("kind") == "defrag" and r.get("gang") in requestors
+    ]
+    last = mine[-1]
+    attrs = last.get("attrs") or {}
+    head = (
+        f"gang {gang}: migrated off {attrs.get('target_host', '?')} "
+        f"for {attrs.get('requestor', '?')} (victim tier "
+        f"{attrs.get('victim_tier', '?')}, rank {attrs.get('rank', '?')}"
+    )
+    # Same convention as render_evicted: "" = unknown, but 0.0 is a
+    # cost FACT (the idle, just-checkpointed canonical cheapest
+    # victim), not an absent one.
+    if attrs.get("duty_cycle") not in ("", None):
+        head += f", duty {attrs['duty_cycle']}%"
+    if attrs.get("checkpoint_age_s") not in ("", None):
+        head += f", last checkpoint {attrs['checkpoint_age_s']}s ago"
+    head += ")"
+    chain = sorted(mine + rounds, key=lambda r: r.get("ts", 0))
+    out = [head, ""]
+    out += [_record_line(r) for r in chain]
+    traces = {r["trace_id"] for r in chain if r.get("trace_id")}
+    for tid in sorted(traces):
+        members = [s for s in spans if s["trace_id"] == tid]
+        if members:
+            out.append("")
+            out += render_trace_tree(members, trace_id=tid)
+    return out
+
+
 def render_node(records: List[dict], node: str) -> List[str]:
     mine = sorted(
         (r for r in records if r.get("node") == node),
@@ -342,6 +398,27 @@ def _self_test() -> Tuple[List[dict], List[dict]]:
             gang="default/demo", tier="high", victims="default/batch",
             victim_count=1, freed_chips=4,
         )
+        # The defragmentation chain (extender/defrag.py kinds): a
+        # batch victim migrated off a host to free a contiguous box
+        # for the stranded demo gang — what the --migrated view
+        # renders.
+        led.record(
+            "defrag_victim", "migrated",
+            "victim 1/1 migrated off node-a for default/demo: "
+            "priority -10, restart cost 12.0",
+            gang="default/batch", requestor="default/demo",
+            rank=1, victim_tier="batch", victim_priority=-10,
+            chips=2, target_host="node-a",
+            duty_cycle=2.0, checkpoint_age_s=8.5,
+        )
+        led.record(
+            "defrag", "executed",
+            "migrated 1 gang(s) (default/batch) off node-a, freeing "
+            "a size-4 box (placeable [1, 2] -> [1, 2, 4]) for [4]",
+            gang="default/demo", size=4, target_host="node-a",
+            victims="default/batch", victim_count=1, freed_chips=2,
+            total_restart_cost=12.0,
+        )
         return (
             led.snapshot()["records"],
             _flatten_otlp(collector.otlp_json()),
@@ -366,6 +443,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--evicted", default="",
         help="victim gang name or namespace/name: why was this gang "
         "preempted (victim selection + the evictor's round records)",
+    )
+    p.add_argument(
+        "--migrated", default="",
+        help="victim gang name or namespace/name: why was this gang "
+        "migrated by defragmentation (victim selection + the "
+        "stranded requestor's round records)",
     )
     p.add_argument(
         "--url", default="",
@@ -414,10 +497,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"self-test failed: evicted view missing "
                   f"{ev_missing}", file=sys.stderr)
             return 1
+        # The migrated view over the same synthetic ledger: the
+        # victim's cost facts, its target host, and the stranded
+        # requestor's round must render.
+        mg_lines = render_migrated(records, spans, "batch")
+        mg_text = "\n".join(mg_lines)
+        mg_needed = (
+            "migrated off node-a for default/demo", "defrag_victim",
+            "defrag", "duty 2.0%", "size-4 box",
+        )
+        mg_missing = [n for n in mg_needed if n not in mg_text]
+        if mg_missing:
+            print(f"self-test failed: migrated view missing "
+                  f"{mg_missing}", file=sys.stderr)
+            return 1
         return 0
-    if not (a.pod or a.gang or a.node or a.evicted):
-        p.error("one of --pod / --gang / --node / --evicted is "
-                "required (or --self-test)")
+    if not (a.pod or a.gang or a.node or a.evicted or a.migrated):
+        p.error("one of --pod / --gang / --node / --evicted / "
+                "--migrated is required (or --self-test)")
     if not (a.url or a.decisions):
         p.error("a source is required: --url and/or --decisions")
     try:
@@ -431,6 +528,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         lines = render_gang(records, spans, a.gang)
     elif a.evicted:
         lines = render_evicted(records, spans, a.evicted)
+    elif a.migrated:
+        lines = render_migrated(records, spans, a.migrated)
     else:
         lines = render_node(records, a.node)
     print("\n".join(lines))

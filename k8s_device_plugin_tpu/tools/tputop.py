@@ -10,7 +10,11 @@ and ``tpu_node_*`` families the telemetry sampler exports
   fragmentation index, and which request sizes currently fit;
 * one row per chip — holder (namespace/pod, container, gang), duty
   cycle, HBM used (and % of spec when known), temperature, power, and
-  ICI link state (up/down counts + accumulated errors).
+  ICI link state (up/down counts + accumulated errors);
+* a defragmentation footer — stranded sizes, eviction budget
+  remaining, plan/migration/abort tallies — when the scrape includes
+  the extender's `tpu_extender_stranded_demand`/`tpu_extender_defrag_*`
+  families (cat the extender's /metrics after the node daemon's).
 
 Usage::
 
@@ -44,6 +48,16 @@ _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 CHIP_PREFIX = "tpu_chip_"
 NODE_PREFIX = "tpu_node_"
+# The defragmentation families (extender/defrag.py, extender scrape):
+# kept by the parser so a scrape that includes the extender's
+# /metrics grows a stranded-demand / defrag footer under the table.
+DEFRAG_FAMILIES = frozenset({
+    "tpu_extender_stranded_demand",
+    "tpu_extender_defrag_plans_total",
+    "tpu_extender_defrag_migrations_total",
+    "tpu_extender_defrag_aborted_total",
+    "tpu_extender_defrag_budget_remaining",
+})
 
 
 def parse_metrics(text: str) -> Dict[str, List[Tuple[dict, float]]]:
@@ -61,7 +75,9 @@ def parse_metrics(text: str) -> Dict[str, List[Tuple[dict, float]]]:
             continue
         name, raw_labels, raw_value = m.groups()
         if not (
-            name.startswith(CHIP_PREFIX) or name.startswith(NODE_PREFIX)
+            name.startswith(CHIP_PREFIX)
+            or name.startswith(NODE_PREFIX)
+            or name in DEFRAG_FAMILIES
         ):
             continue
         try:
@@ -160,6 +176,68 @@ def _node_line(families: Dict[str, List[Tuple[dict, float]]]) -> str:
     return "node: " + (" ".join(parts) if parts else "no capacity gauges")
 
 
+def _defrag_footer(
+    families: Dict[str, List[Tuple[dict, float]]]
+) -> Optional[str]:
+    """The stranded-demand / defragmentation footer, present only when
+    the scrape carries any of the extender's defrag families (i.e. it
+    includes the extender's /metrics): sizes currently stranded,
+    eviction budget remaining, and the planning/migration/abort
+    tallies — the one-line "is fragmentation being repacked" view."""
+    # Only LABELED samples are real: an empty family still renders an
+    # unlabeled "<fam> 0" placeholder, and a footer built from those
+    # would read "budget 0/h" (gate closed!) on an extender running
+    # --no-defrag or one that simply hasn't ticked yet.
+    if not any(
+        labels
+        for f in DEFRAG_FAMILIES
+        for labels, _ in families.get(f, ())
+    ):
+        return None
+
+    def tally(fam: str, label: str) -> List[str]:
+        # Sum across the other labels (a sharded extender exports one
+        # series per shard) and skip the unlabeled empty-family
+        # placeholder sample.
+        agg: Dict[str, float] = {}
+        for labels, value in families.get(fam, ()):
+            if label not in labels or not value:
+                continue
+            agg[labels[label]] = agg.get(labels[label], 0) + value
+        return [f"{k}×{v:.0f}" for k, v in sorted(agg.items())]
+
+    parts = []
+    stranded = tally("tpu_extender_stranded_demand", "size")
+    parts.append(
+        "stranded " + (
+            " ".join(f"size={s}" for s in stranded)
+            if stranded else "none"
+        )
+    )
+    budget = [
+        (labels, v)
+        for labels, v in families.get(
+            "tpu_extender_defrag_budget_remaining", ()
+        )
+        if "shard" in labels  # skip the empty-family placeholder
+    ]
+    if budget:
+        # Summed across shards ("" = the unsharded singleton).
+        total = sum(v for _, v in budget)
+        parts.append(f"budget {total:.0f} eviction(s) left/h")
+    plans = tally("tpu_extender_defrag_plans_total", "outcome")
+    if plans:
+        parts.append("plans " + " ".join(plans))
+    migrated = tally("tpu_extender_defrag_migrations_total",
+                     "victim_tier")
+    if migrated:
+        parts.append("migrated " + " ".join(migrated))
+    aborted = tally("tpu_extender_defrag_aborted_total", "reason")
+    if aborted:
+        parts.append("aborted " + " ".join(aborted))
+    return "defrag: " + " | ".join(parts)
+
+
 def render(text: str) -> str:
     """The table for one scrape; raises ValueError when the scrape has
     no tpu_chip_*/tpu_node_* samples at all (wrong endpoint)."""
@@ -199,6 +277,9 @@ def render(text: str) -> str:
         )
     if not rows:
         out.append("(no per-chip series — sampler off or no chips)")
+    footer = _defrag_footer(families)
+    if footer is not None:
+        out.append(footer)
     return "\n".join(out)
 
 
@@ -267,6 +348,43 @@ def _self_test() -> str:
         assert "4.0Gi (25%)" in table, table
         assert "fragmentation=" in table and "free=3" in table, table
         assert "1up/0dn e" not in table  # first sight = baseline, no errs
+        # A plugin-only scrape must carry NO defrag footer (those
+        # families live on the extender registry).
+        assert "defrag:" not in table, table
+        # Defrag footer: populate the REAL extender families and feed
+        # a merged scrape (operators cat both daemons' /metrics) — a
+        # rename in metrics.py or a parser regression both fail here.
+        try:
+            metrics.STRANDED_DEMAND.set(1, size="4", shard="")
+            metrics.DEFRAG_BUDGET.set(10, shard="")
+            metrics.DEFRAG_PLANS.inc(outcome="executed")
+            metrics.DEFRAG_MIGRATIONS.inc(victim_tier="batch")
+            metrics.DEFRAG_ABORTED.inc(reason="eviction_blocked")
+            merged = render(
+                metrics.REGISTRY.render()
+                + "\n"
+                + metrics.EXTENDER_REGISTRY.render()
+            )
+            footer = merged.splitlines()[-1]
+            assert footer.startswith("defrag:"), merged
+            # Gauges are absolute; counters assert presence only (the
+            # suite's other defrag tests may have bumped them first —
+            # this smoke also runs under pytest).
+            assert "size=4×1" in footer, footer
+            assert "budget 10 eviction(s) left/h" in footer, footer
+            assert "executed×" in footer, footer
+            assert "migrated batch×" in footer, footer
+            assert "aborted eviction_blocked×" in footer, footer
+        finally:
+            metrics.STRANDED_DEMAND.remove_matching(size="4")
+            metrics.DEFRAG_PLANS.remove_matching(outcome="executed")
+            metrics.DEFRAG_MIGRATIONS.remove_matching(
+                victim_tier="batch"
+            )
+            metrics.DEFRAG_ABORTED.remove_matching(
+                reason="eviction_blocked"
+            )
+            metrics.DEFRAG_BUDGET.remove_matching(shard="")
         return table
     finally:
         for fam in (

@@ -181,6 +181,37 @@ def placeable_box_sizes(chip_count: int) -> List[int]:
     return sizes
 
 
+def _mask_fits(
+    n: int, bounds: Coord, wraps: Tuple[bool, bool, bool], mask: int
+) -> bool:
+    """Does any precomputed n-box lie entirely inside ``mask``? The ONE
+    membership test behind :func:`fragmentation_stats`,
+    :func:`box_fits`, and (through them) the defrag planner's
+    stranded-demand scan — three consumers, one bit space."""
+    return any(
+        not (cand.mask & ~mask)
+        for cand in box_candidates(n, bounds, wraps)
+    )
+
+
+def box_fits(mesh: IciMesh, free_ids: Iterable[str], n: int) -> bool:
+    """True when a fully-free contiguous n-box fits inside ``free_ids``
+    right now — the single-size entry point the defragmentation plane
+    (extender/defrag.py) scans per node per stranded demand, cheaper
+    than deriving the full :func:`fragmentation_stats` dict when only
+    one size matters. Same candidate space and mask linearization as
+    the allocator's ``_best_box``, so "placeable" here is exactly a
+    box ``select`` would place."""
+    if n <= 0:
+        return False
+    free = [i for i in free_ids if i in mesh.by_id]
+    if len(free) < n:
+        return False
+    mask = _pool_mask(mesh, free)
+    wraps = tuple(mesh._dim_wraps(mesh.bounds[d]) for d in range(3))
+    return _mask_fits(n, mesh.bounds, wraps, mask)
+
+
 def fragmentation_stats(mesh: IciMesh, free_ids: Iterable[str]) -> dict:
     """Capacity/fragmentation view of a node's free chips, computed on
     the same precomputed box space the placement policy allocates from
@@ -208,10 +239,7 @@ def fragmentation_stats(mesh: IciMesh, free_ids: Iterable[str]) -> dict:
     wraps = tuple(mesh._dim_wraps(mesh.bounds[d]) for d in range(3))
 
     def fits(n: int) -> bool:
-        return any(
-            not (cand.mask & ~mask)
-            for cand in box_candidates(n, mesh.bounds, wraps)
-        )
+        return _mask_fits(n, mesh.bounds, wraps, mask)
 
     largest = 0
     for n in range(n_free, 0, -1):

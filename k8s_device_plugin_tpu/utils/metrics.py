@@ -562,6 +562,52 @@ PREEMPTION_VICTIMS = EXTENDER_REGISTRY.counter(
     "critical/high share means high tiers are cannibalizing each "
     "other and the cluster needs capacity, not priorities",
 )
+# Active defragmentation (extender/defrag.py): the planner that ACTS
+# on the fragmentation signal — detect stranded demand, repack the
+# mesh within an eviction budget.
+STRANDED_DEMAND = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_stranded_demand",
+    "Waiting gangs whose demand is STRANDED, by request size and "
+    "admitter shard (\"\" = the unsharded singleton; each engine "
+    "owns only its shard's series): enough free chips exist in the "
+    "shard but no contiguous box of that size is placeable anywhere "
+    "(emptied sizes prune their series; sum() over shards for the "
+    "cluster view) — the signal the defrag planner acts on",
+)
+DEFRAG_PLANS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_defrag_plans_total",
+    "Defragmentation planning outcomes, by outcome (executed: the "
+    "migration ran and the target box was fenced; no_plan: no "
+    "strictly-lower-priority relocatable victim set frees a box — "
+    "counted once per stranded episode; deferred: execution held one "
+    "tick for an in-flight checkpoint; blocked_budget: a feasible "
+    "plan exceeded the remaining eviction budget)",
+)
+DEFRAG_MIGRATIONS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_defrag_migrations_total",
+    "Victim gangs migrated (evicted with a proven relocation target) "
+    "by defragmentation, by the victim's tier — a growing share in "
+    "high tiers means the priority floor is misconfigured, not that "
+    "defrag is working harder",
+)
+DEFRAG_ABORTED = EXTENDER_REGISTRY.counter(
+    "tpu_extender_defrag_aborted_total",
+    "Defragmentation rounds aborted mid-flight, by reason "
+    "(eviction_blocked: a victim eviction was PDB/apiserver-refused "
+    "— cluster drift from the plan surfaces here too, the eviction "
+    "door is where drift is discovered; recovered: an open round was "
+    "aborted by crash recovery; gang_vanished: the stranded "
+    "requestor disappeared while its round was open)",
+)
+DEFRAG_BUDGET = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_defrag_budget_remaining",
+    "Victim-pod evictions the defrag engine may still perform inside "
+    "the rolling hour (--defrag-max-evictions-per-hour minus the "
+    "evictions of the last 3600s), per admitter shard (\"\" = the "
+    "unsharded singleton — each engine budgets independently, so the "
+    "series would otherwise flap between shards); 0 = that shard's "
+    "budget gate is closed",
+)
 GANG_RESERVED = EXTENDER_REGISTRY.gauge(
     "tpu_gang_reservations",
     "Released-but-unscheduled gangs currently holding a chip reservation",
@@ -1003,6 +1049,14 @@ DEBUG_ENDPOINTS: Dict[str, str] = {
         "cycles with their witness stacks — enabled: false when the "
         "flag is off"
     ),
+    "/debug/defrag": (
+        "defragmentation what-if surface (extender/defrag.py): "
+        "current stranded demand with hysteresis progress, the plan "
+        "the planner would execute (victims, targets, per-victim "
+        "cost facts, projected placeability delta), eviction-budget "
+        "state, and the last round's outcome — per engine (one per "
+        "shard admitter); enabled: false when defrag is not wired"
+    ),
 }
 
 # () -> dict readiness snapshot (extender/server.py ReadyStatus),
@@ -1074,6 +1128,10 @@ def debug_payload(path: str) -> Optional[bytes]:
             from . import profiling
 
             return profiling.LOCKDEP.snapshot()
+        if parsed.path == "/debug/defrag":
+            from ..extender import defrag
+
+            return defrag.debug_snapshot()
         if parsed.path == "/debug/profile":
             from . import profiling, stackprof
 

@@ -21,10 +21,13 @@ import os
 import time
 from typing import Any, Callable, Optional, Tuple
 
-import jax
-import orbax.checkpoint as ocp
-
 from ..api import constants
+
+# jax/orbax are imported lazily inside the training-side classes: the
+# control plane (extender/preemption.py victim ranking,
+# extender/defrag.py migration coordination) imports this module for
+# CheckpointBeacon alone and must not drag the accelerator stack into
+# the scheduler-extender process.
 
 
 class CheckpointBeacon:
@@ -62,6 +65,29 @@ class CheckpointBeacon:
 
         return CheckpointBeacon(stamp)
 
+    @staticmethod
+    def age_from(
+        annotations: Optional[dict], now: Optional[float] = None
+    ) -> Optional[float]:
+        """Seconds since the last durable save recorded on a pod's
+        annotations, or None when never stamped / unparsable — the ONE
+        parser of the beacon's annotation, shared by the preemption
+        planner's victim ranking and the defrag engine's
+        fresh-checkpoint preference so the two cost models can never
+        read the same stamp differently. Clock skew that would read
+        negative clamps to 0 (a save from "the future" is simply
+        fresh)."""
+        raw = (annotations or {}).get(
+            constants.CHECKPOINT_TS_ANNOTATION
+        )
+        if not raw:
+            return None
+        try:
+            ts = float(raw)
+        except (TypeError, ValueError):
+            return None
+        return max(0.0, (now if now is not None else time.time()) - ts)
+
     def note_saved(self, step: int) -> bool:
         ts = round(time.time(), 3)
         try:
@@ -76,6 +102,7 @@ class CheckpointBeacon:
 def _abstract_like(tree):
     """ShapeDtypeStruct pytree carrying each leaf's sharding — the restore
     template that makes orbax lay leaves out for the current mesh."""
+    import jax
 
     def one(leaf):
         return jax.ShapeDtypeStruct(
@@ -99,6 +126,8 @@ class TrainCheckpointer:
         async_save: bool = False,
         beacon: Optional[CheckpointBeacon] = None,
     ):
+        import orbax.checkpoint as ocp
+
         self.directory = os.path.abspath(directory)
         self.save_every = max(1, save_every)
         # Control-plane recency beacon: each committed save stamps the
@@ -121,6 +150,8 @@ class TrainCheckpointer:
         return self.save(step, params, opt_state)
 
     def save(self, step: int, params, opt_state) -> bool:
+        import orbax.checkpoint as ocp
+
         saved = self._mgr.save(
             step,
             args=ocp.args.StandardSave(
@@ -152,6 +183,9 @@ class TrainCheckpointer:
         freshly initialized state on the current mesh. Returns
         (step, params, opt_state), or None when no checkpoint exists.
         """
+        import jax
+        import orbax.checkpoint as ocp
+
         step = self._mgr.latest_step()
         if step is None:
             return None

@@ -84,6 +84,16 @@ python -m k8s_device_plugin_tpu.extender.scale_bench --profile-self-test > /dev/
 # chaos kill-point matrix in tests/test_chaos_journal.py.
 python -m k8s_device_plugin_tpu.extender.preemption --self-test > /dev/null \
   || { echo "extender/preemption.py --self-test FAILED"; exit 1; }
+# Active-defragmentation smoke: a deliberately fragmented 2-node sim
+# (free chips everywhere, a contiguous 4-box nowhere) must detect the
+# stranded gang through hysteresis, plan the cheapest migration with a
+# proven relocation, migrate it two-phase journaled, and admit the
+# stranded gang onto the freed, fenced box (extender/defrag.py
+# --self-test); a detector/planner/engine/journal plumbing drift fails
+# CI here, before the chaos kill-points in tests/test_chaos_journal.py
+# and the 1,000-node acceptance e2e in tests/test_defrag.py.
+python -m k8s_device_plugin_tpu.extender.defrag --self-test > /dev/null \
+  || { echo "extender/defrag.py --self-test FAILED"; exit 1; }
 # Static-analysis engine smoke: every tpu-lint rule must detect its
 # embedded seeded violation (and stay quiet on the clean twin), the
 # registry scanner's inventories must be non-empty, and the static

@@ -669,7 +669,8 @@ def test_extender_clean_and_leaked_reservation(extender_stack):
     assert engine.sweep_once() == []
     snap = engine.snapshot()
     assert {i["name"] for i in snap["invariants"]} == {
-        "reservation_vs_journal", "reservation_vs_cluster",
+        "reservation_vs_journal", "defrag_vs_reservations",
+        "reservation_vs_cluster",
         "gate_vs_hold", "placeable_recount", "thread_liveness",
         "lock_order", "loop_inventory",
     }
@@ -735,6 +736,50 @@ def test_extender_journal_divergence_fires_critical(extender_stack):
     assert _invariant_names(findings) == {"reservation_vs_journal"}
     assert findings[0].severity == audit.WARNING
     s["reservations"].observer = s["journal"].observe
+
+
+def test_extender_defrag_vs_reservations(extender_stack):
+    s = extender_stack
+    engine = s["engine"]
+    key = ("default", "stranded")
+    # The gang exists and is placed so the cluster/gate invariants
+    # stay quiet and the defrag plane is isolated.
+    s["add_gang_pod"]("stranded", "stranded-w0", node="node-a")
+    s["add_gang_pod"]("stranded", "stranded-w1", node="node-a")
+    # An open defrag_evicted phase with NO standing fence: the victims
+    # are gone and nothing protects the freed box — the exact
+    # gateless-and-unfenced window the kill-point contract forbids.
+    s["journal"].record(
+        "defrag_evicted", key,
+        victims=[["default", "frag"]], consumed={"node-a": 4},
+        demands=[2, 2],
+    )
+    findings = engine.sweep_once()
+    assert _invariant_names(findings) == {"defrag_vs_reservations"}
+    (f,) = findings
+    assert f.severity == audit.CRITICAL
+    assert f.gang == "default/stranded"
+    # The fence lands (phase 3's reserve) → the round is protected
+    # even while still journaled open.
+    s["reservations"].reserve(key, {"node-a": 4}, demands=(2, 2))
+    assert engine.sweep_once() == []
+    # A fence that stands but no longer covers the plan = drift →
+    # warning, not critical.
+    s["reservations"].drop(key)
+    s["reservations"].reserve(key, {"node-a": 2}, demands=(2, 2))
+    findings = engine.sweep_once()
+    assert _invariant_names(findings) == {"defrag_vs_reservations"}
+    assert findings[0].severity == audit.WARNING
+    # Closing the round (defrag_done) clears everything — an intent
+    # phase alone is never a finding (recovery aborts it).
+    s["reservations"].drop(key)
+    s["journal"].record("defrag_done", key)
+    s["journal"].record(
+        "defrag_intent", key,
+        victims=[["default", "frag"]], consumed={"node-a": 4},
+        demands=[2, 2],
+    )
+    assert engine.sweep_once() == []
 
 
 def test_extender_gate_vs_hold(extender_stack):

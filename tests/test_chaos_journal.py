@@ -841,3 +841,177 @@ def test_sigkill_between_evictions_and_fence_refences_on_recovery(
         f for f in findings if f.invariant == "gate_vs_hold"
     ] == []
     adm2.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# Mid-defragmentation kill points (PR 15, extender/defrag.py two-phase
+# protocol): SIGKILL at either defrag journal phase must rehydrate to a
+# state where the stranded gang is never gateless-and-unfenced and no
+# chip is double-bookable — the defrag_vs_reservations contract.
+# ---------------------------------------------------------------------------
+
+def _defrag_cluster(server):
+    """Deliberately fragmented two-node cluster: every node has free
+    chips, NO node has a contiguous 4-box — n1's other two chips are
+    held by a cheap batch gang whose migration (relocating onto n2's
+    free pair) frees the box the gated 4-chip prod gang is stranded
+    for."""
+    from tests.test_extender import make_mesh
+    from tests.test_preemption import running_gang_pod
+
+    frag = make_mesh("v5p", 4)  # the id space, to pick the free pair
+    node1, mesh1 = make_node(
+        "n1", n=4, available=[frag.ids[0], frag.ids[2]]
+    )
+    server.add_node("n1", node1)
+    node2, mesh2 = make_node(
+        "n2", n=4, available=[frag.ids[0], frag.ids[2]]
+    )
+    server.add_node("n2", node2)
+    import time as _t
+
+    for i in range(2):
+        server.add_pod(running_gang_pod(
+            f"frag-w{i}", "frag", 2, 1, "n1", priority=-10,
+            ckpt_ts=_t.time() - 5,
+        ))
+    sp = gang_pod("prod-w0", "prod", 1, 4)
+    server.add_pod(sp)
+    return mesh1, mesh2
+
+
+def _wire_defrag(adm, client):
+    from k8s_device_plugin_tpu.extender.defrag import DefragEngine
+    from k8s_device_plugin_tpu.extender.preemption import (
+        PriorityResolver,
+    )
+
+    resolver = PriorityResolver(client)
+    adm.priority_resolver = resolver
+    # stranded_ticks=1: no hysteresis wait in the chaos scenarios;
+    # checkpoint_wait_ticks=0: no one-tick checkpoint deferral.
+    adm.defrag = DefragEngine(
+        adm, resolver, stranded_ticks=1, checkpoint_wait_ticks=0,
+    )
+    return adm.defrag
+
+
+def test_sigkill_mid_defrag_evictions_aborts_then_replans(
+    api, tmp_path
+):
+    """Kill-point 7: after defrag_intent, mid-migration (one victim
+    pod evicted, one not). Recovery aborts the open intent — nothing
+    was fenced, the stranded gang is still gated (never
+    gateless-and-unfenced) — and the next tick re-plans from cluster
+    truth and finishes the migration exactly once."""
+    server, client = api
+    mesh1, _ = _defrag_cluster(server)
+
+    kp = KillPointClient(client, "evict_pod", calls_before_kill=1)
+    adm1 = GangAdmission(
+        kp,
+        reservations=ReservationTable(),
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    _wire_defrag(adm1, client)
+    with pytest.raises(SigKill):
+        adm1.tick()
+    # Exactly one victim pod left through the eviction door; the
+    # intent is durable (critical op), nothing was reserved.
+    assert len(server.evictions) == 1
+
+    adm2, table2 = fresh_admission(client, tmp_path)
+    _wire_defrag(adm2, client)
+    summary = adm2.recover()
+    assert summary["defrag_aborted"] == 1
+    assert summary["defrag_refenced"] == 0
+    # Safe state: nothing fenced (no reserve ever landed) and the
+    # stranded gang is still gated.
+    assert table2.active() == {}
+    assert GATE_NAME in gates_of(server, "default", "prod-w0")
+
+    # The node daemon frees the evicted pod's chip and republishes;
+    # the retry round migrates only the REMAINING victim pod and the
+    # stranded gang admits onto the freed box.
+    _republish(server, mesh1, mesh1.ids[:3])
+    released = adm2.tick()
+    assert released == [("default", "prod")]
+    assert len(server.evictions) == 2  # one more, not a re-evict storm
+    assert GATE_NAME not in gates_of(server, "default", "prod-w0")
+    # The fence stands for the full demand: no chip double-bookable.
+    assert table2.reserved_chips("n1") == 4
+    assert adm2.defrag.open_intents() == {}
+    adm2.journal.close()
+
+
+def test_sigkill_between_defrag_evictions_and_fence_refences(
+    api, tmp_path
+):
+    """Kill-point 8: after defrag_evicted, before the reserve — the
+    exact window where the freed box would be stealable by a
+    scavenger. Recovery re-installs the planned fence under the
+    STRANDED gang's key BEHIND the readiness gate, the release
+    finishes against the standing hold, and the audit invariants —
+    including defrag_vs_reservations — sweep clean."""
+    from k8s_device_plugin_tpu import audit
+
+    server, client = api
+    mesh1, _ = _defrag_cluster(server)
+
+    table1 = ReservationTable()
+    adm1 = GangAdmission(
+        client,
+        reservations=table1,
+        journal=jr.AdmissionJournal(str(tmp_path)),
+    )
+    _wire_defrag(adm1, client)
+
+    def die_on_reserve(*a, **kw):
+        raise SigKill("between defrag_evicted and reserve")
+
+    table1.reserve = die_on_reserve
+    with pytest.raises(SigKill):
+        adm1.tick()
+    # Both victim pods are gone; the evicted phase is durable.
+    assert len(server.evictions) == 2
+
+    adm2, table2 = fresh_admission(client, tmp_path)
+    _wire_defrag(adm2, client)
+    summary = adm2.recover()
+    assert summary["defrag_refenced"] == 1
+    assert summary["defrag_aborted"] == 0
+    # The fence was re-installed from the journaled plan BEFORE any
+    # tick — under the stranded gang's key, so the freed box goes to
+    # the gang the migration was FOR, never a scavenger.
+    assert table2.reserved_chips("n1") == 4
+    assert ("default", "prod") in table2.active()
+
+    # The daemon republishes the freed chips; a competitor pod's
+    # /filter is shielded by the rehydrated fence.
+    fresh_node = _republish(server, mesh1, list(mesh1.ids))
+    ext = TopologyExtender(reservations=table2)
+    passing, failed = ext.filter(tpu_pod(2), [fresh_node])
+    assert passing == []
+    assert "reserved for a released gang" in failed["n1"]
+
+    # The next tick finishes the release against the standing hold
+    # (release_retry): gates off, fence still standing.
+    released = adm2.tick()
+    assert released == [("default", "prod")]
+    assert GATE_NAME not in gates_of(server, "default", "prod-w0")
+    assert table2.reserved_chips("n1") == 4
+    assert adm2.defrag.open_intents() == {}
+
+    # Audit clean after rehydration: no double-booked chip, no
+    # gateless-and-unfenced gang, and no open defrag phase without
+    # its fence (the new invariant's exact contract).
+    eng = audit.ExtenderAudit(
+        reservations=table2, journal=adm2.journal, gang=adm2
+    ).engine()
+    findings = eng.sweep_once()
+    assert [f for f in findings if f.severity == audit.CRITICAL] == []
+    assert [
+        f for f in findings
+        if f.invariant in ("gate_vs_hold", "defrag_vs_reservations")
+    ] == []
+    adm2.journal.close()

@@ -1,0 +1,690 @@
+"""Active defragmentation (extender/defrag.py, ISSUE 15): detection
+(stranded demand with hysteresis), planning (minimal migration set
+with a proven relocation, priority-respecting, budget-bounded), and
+execution (two-phase journaled migration that fences the freed box
+for the STRANDED gang) — plus the ROADMAP item 3 acceptance e2e: a
+deliberately fragmented 1,000-node sim cluster with a waiting 4-cube
+gang recovers size-4 placeability within the configured eviction
+budget, the cheapest victims migrate, higher/equal-tier gangs are
+untouched, and ExtenderAudit (including defrag_vs_reservations)
+sweeps clean throughout.
+
+SIGKILL crash-consistency at the two new journal phases lives in
+tests/test_chaos_journal.py (kill-points 7 and 8); the planner's
+placement-math dependencies (torus wraparound, the 3×3×3/16-box gap)
+in tests/test_placement_properties.py.
+"""
+
+import dataclasses
+import time
+from typing import Dict, List, Tuple
+
+import pytest
+
+from k8s_device_plugin_tpu import audit
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.discovery.chips import TpuChip
+from k8s_device_plugin_tpu.extender import defrag as dfg
+from k8s_device_plugin_tpu.extender.defrag import (
+    DefragEngine,
+    DefragPlanner,
+    StrandedDemandDetector,
+    stranded_size,
+)
+from k8s_device_plugin_tpu.extender.gang import GATE_NAME, GangAdmission
+from k8s_device_plugin_tpu.extender.journal import AdmissionJournal
+from k8s_device_plugin_tpu.extender.preemption import (
+    PreemptionEngine,
+    PriorityResolver,
+    Victim,
+)
+from k8s_device_plugin_tpu.extender.reservations import ReservationTable
+from k8s_device_plugin_tpu.kube.client import KubeError
+from k8s_device_plugin_tpu.topology.mesh import IciMesh
+from k8s_device_plugin_tpu.topology.schema import NodeTopology
+from k8s_device_plugin_tpu.utils import metrics
+
+
+def mk_mesh(n: int = 4) -> IciMesh:
+    return IciMesh([
+        TpuChip(
+            index=i,
+            dev_path=f"/dev/accel{i}",
+            pci_addr=f"0000:00:{4 + i:02x}.0",
+            vendor_id=0x1AE0,
+            device_id=0,
+            numa_node=0,
+            chip_type="v5e",
+            hbm_bytes=0,
+            core_count=1,
+        )
+        for i in range(n)
+    ])
+
+
+def topo(host: str, mesh: IciMesh, available: List[str]) -> NodeTopology:
+    return NodeTopology.from_mesh(
+        mesh, hostname=host, available=available
+    )
+
+
+def fragmented(host: str, mesh: IciMesh) -> NodeTopology:
+    """Chips 0 and 2 free: free chips on the node, no contiguous pair
+    of a 4-box's worth anywhere on it."""
+    return topo(host, mesh, [mesh.ids[0], mesh.ids[2]])
+
+
+class StubClient:
+    """The in-memory client the engine drives: list/get/evict/delete
+    pods, gate removal, annotation patch — no HTTP."""
+
+    def __init__(self):
+        self.pods: Dict[Tuple[str, str], dict] = {}
+        self.evicted: List[Tuple[str, str]] = []
+        self.evict_error: KubeError = None
+
+    def add(self, pod: dict) -> None:
+        m = pod["metadata"]
+        self.pods[(m["namespace"], m["name"])] = pod
+
+    def list_pods(self, label_selector: str = "", **_):
+        return {"items": [dict(p) for p in self.pods.values()]}
+
+    def get_pod(self, ns, name):
+        return dict(self.pods[(ns, name)])
+
+    def evict_pod(self, ns, name):
+        if self.evict_error is not None:
+            raise self.evict_error
+        self.evicted.append((ns, name))
+        self.pods.pop((ns, name), None)
+        return {}
+
+    def delete_pod(self, ns, name):
+        self.pods.pop((ns, name), None)
+        return {}
+
+    def remove_pod_scheduling_gate(self, ns, name, gate, gates):
+        pod = self.pods[(ns, name)]
+        pod["spec"]["schedulingGates"] = [
+            g for g in gates if g.get("name") != gate
+        ]
+
+    def patch_pod_annotations(self, ns, name, ann):
+        pod = self.pods.get((ns, name))
+        if pod is not None:
+            pod.setdefault("metadata", {}).setdefault(
+                "annotations", {}
+            ).update({k: v for k, v in ann.items() if v is not None})
+
+    def create_event(self, *a, **kw):
+        pass
+
+
+def pod(ns, gang, name, chips, size, gated, node="", priority=None,
+        ckpt=None):
+    p = {
+        "metadata": {
+            "name": name, "namespace": ns, "uid": f"uid-{name}",
+            "labels": {
+                constants.GANG_NAME_LABEL: gang,
+                "tpu.google.com/gang-size": str(size),
+            },
+            "annotations": {},
+        },
+        "spec": {
+            "schedulingGates": (
+                [{"name": GATE_NAME}] if gated else []
+            ),
+            "containers": [{
+                "name": "c",
+                "resources": {
+                    "requests": {"google.com/tpu": str(chips)}
+                },
+            }],
+        },
+        "status": {},
+    }
+    if node:
+        p["spec"]["nodeName"] = node
+    if priority is not None:
+        p["spec"]["priority"] = priority
+    if ckpt is not None:
+        p["metadata"]["annotations"][
+            constants.CHECKPOINT_TS_ANNOTATION
+        ] = str(ckpt)
+    return p
+
+
+def victim(gang, host, chips_per_pod, n_pods=1, priority=-10,
+           duty=None, ckpt_age=None):
+    return Victim(
+        key=("default", gang),
+        priority=priority,
+        hosts={host: chips_per_pod * n_pods},
+        pods=[
+            {
+                "ns": "default", "name": f"{gang}-w{w}",
+                "uid": f"uid-{gang}-{w}", "host": host,
+                "chips": chips_per_pod,
+            }
+            for w in range(n_pods)
+        ],
+        duty_cycle=duty,
+        checkpoint_age_s=ckpt_age,
+    )
+
+
+# ---------------------------------------------------------------------------
+# detection
+# ---------------------------------------------------------------------------
+
+def test_stranded_size_shapes():
+    mesh = mk_mesh(4)
+    frag = [fragmented("n1", mesh), fragmented("n2", mesh)]
+    # The canonical stranded shape: 4 free chips cluster-wide, no
+    # contiguous 4-box anywhere.
+    assert stranded_size(frag, [4]) == 4
+    # A placeable box somewhere: not stranded.
+    whole = [topo("n1", mesh, list(mesh.ids)), fragmented("n2", mesh)]
+    assert stranded_size(whole, [4]) is None
+    # Demand exceeding every host's chip count: slice-spanning —
+    # repacks at host granularity, not this planner's.
+    assert stranded_size(frag, [8]) is None
+    # Genuine capacity shortage (total free < total demand): migration
+    # conserves chips, so repacking cannot help.
+    short = [fragmented("n1", mesh), topo("n2", mesh, [])]
+    assert stranded_size(short, [4]) is None
+    # Multi-pod demand keys on the LARGEST per-pod box: diagonal free
+    # pairs (never adjacent in the (2,4,1) grid) strand even a 2-box.
+    diag = [
+        topo(h, mesh, [mesh.ids[0], mesh.ids[3]]) for h in ("n1", "n2")
+    ]
+    assert stranded_size(diag, [2, 2]) == 2
+    # ...while the y-adjacent pair of `fragmented` places a 2-box.
+    assert stranded_size(frag, [2, 2]) is None
+    assert stranded_size(frag, []) is None
+
+
+def test_detector_hysteresis_and_gauge():
+    det = StrandedDemandDetector(stranded_ticks=3)
+    key = ("default", "train")
+    assert det.observe(key, 4) == 1
+    assert not det.ready(key)
+    assert det.observe(key, 4) == 2
+    # A size change mid-episode (gang recreated with a new shape)
+    # restarts the count: hysteresis is per (gang, size).
+    assert det.observe(key, 2) == 1
+    assert det.observe(key, 2) == 2
+    assert det.observe(key, 2) == 3
+    assert det.ready(key)
+    det.publish()
+    assert metrics.STRANDED_DEMAND.get(size="2", shard="") == 1
+    snap = det.snapshot()
+    assert snap[0]["size"] == 2 and snap[0]["ticks"] == 3
+    det.clear(key)
+    det.publish()
+    # Emptied sizes prune their series (absent = no stranded demand).
+    assert metrics.STRANDED_DEMAND.series() == []
+    assert not det.ready(key)
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+
+def planner() -> DefragPlanner:
+    return DefragPlanner(PriorityResolver())
+
+
+def test_planner_prefers_cheapest_host_and_proves_relocation():
+    mesh = mk_mesh(4)
+    topos = [
+        fragmented("n1", mesh),
+        fragmented("n2", mesh),
+        fragmented("n3", mesh),
+    ]
+    cheap = victim("cheap", "n1", 1, n_pods=2, duty=5.0, ckpt_age=10.0)
+    costly = victim("costly", "n2", 1, n_pods=2, duty=95.0,
+                    ckpt_age=3000.0)
+    plan = planner().plan(
+        ("default", "train"), [4], 0, topos, [costly, cheap],
+    )
+    assert plan is not None
+    assert plan.target_host == "n1"
+    assert [v.key for v in plan.victims] == [("default", "cheap")]
+    assert plan.size == 4
+    # The stranded gang's fence lands on the freed host.
+    assert plan.consumed == {"n1": 4}
+    assert plan.freed == {"n1": 2}
+    # The relocation proof: the victims' pods land on the remaining
+    # fragmented capacity, not into thin air.
+    assert sum(plan.relocation.values()) == 2
+    assert "n1" not in plan.relocation
+    # The projected placeability delta the /debug document renders.
+    assert 4 not in plan.placeable_before
+    assert 4 in plan.placeable_after
+
+
+def test_planner_requires_relocation_capacity():
+    """A gang that cannot land elsewhere is never 'migrated' — that
+    would be preemption wearing a costume."""
+    mesh = mk_mesh(4)
+    topos = [fragmented("n1", mesh), topo("n2", mesh, [])]
+    v = victim("cheap", "n1", 1, n_pods=2)
+    # Freeing n1's box consumes its whole 4 chips for the stranded
+    # gang; nothing remains for the victims' 2 relocation chips.
+    assert planner().plan(
+        ("default", "train"), [4], 0, topos, [v],
+    ) is None
+
+
+def test_planner_minimal_set_and_max_victims():
+    mesh = mk_mesh(4)
+    topos = [
+        # n1: fully held by two 2-chip victims.
+        topo("n1", mesh, []),
+        fragmented("n2", mesh),
+        fragmented("n3", mesh),
+    ]
+    a = victim("aa", "n1", 2, duty=5.0, ckpt_age=10.0)
+    b = victim("bb", "n1", 2, duty=20.0, ckpt_age=100.0)
+    # A 4-box needs the WHOLE node: both victims migrate.
+    plan = planner().plan(("default", "t"), [4], 0, topos, [a, b])
+    assert plan is not None
+    assert {v.key[1] for v in plan.victims} == {"aa", "bb"}
+    assert plan.consumed == {"n1": 4}
+    # A 2-chip demand needs only the CHEAPEST victim: the greedy +
+    # prune passes keep the set minimal.
+    plan2 = planner().plan(("default", "t"), [2], 0, topos, [a, b])
+    assert plan2 is not None
+    assert [v.key[1] for v in plan2.victims] == ["aa"]
+    # max_victims caps the set: a plan needing two is rejected.
+    assert planner().plan(
+        ("default", "t"), [4], 0, topos, [a, b], max_victims=1,
+    ) is None
+
+
+# ---------------------------------------------------------------------------
+# execution (engine driven through the REAL admission tick)
+# ---------------------------------------------------------------------------
+
+def build_admission(client, tmp_path, topos, **engine_kw):
+    table = ReservationTable()
+    journal = AdmissionJournal(str(tmp_path / "journal"))
+    table.observer = journal.observe
+    adm = GangAdmission(
+        client,
+        reservations=table,
+        journal=journal,
+        topo_source=lambda: [
+            dataclasses.replace(t, available=list(t.available))
+            for t in topos
+        ],
+    )
+    resolver = PriorityResolver()
+    adm.priority_resolver = resolver
+    engine_kw.setdefault("stranded_ticks", 1)
+    engine_kw.setdefault("checkpoint_wait_ticks", 0)
+    engine = DefragEngine(adm, resolver, **engine_kw)
+    adm.defrag = engine
+    return adm, table, engine
+
+
+def stranded_cluster(client):
+    """Two fragmented nodes, a cheap fresh-checkpoint batch victim on
+    n1, and a gated standard-priority 4-chip gang."""
+    mesh = mk_mesh(4)
+    topos = [fragmented("n1", mesh), fragmented("n2", mesh)]
+    now = time.time()
+    for w in range(2):
+        client.add(pod(
+            "default", "frag", f"frag-w{w}", 1, 2,
+            gated=False, node="n1", priority=-10, ckpt=now - 5,
+        ))
+    client.add(pod("default", "train", "train-w0", 4, 1, gated=True,
+                   priority=0))
+    return topos
+
+
+def test_engine_budget_gate(tmp_path):
+    client = StubClient()
+    topos = stranded_cluster(client)
+    # Budget 1: the 2-pod victim eviction would exceed it.
+    adm, table, engine = build_admission(
+        client, tmp_path, topos, max_evictions_per_hour=1,
+    )
+    before = metrics.DEFRAG_PLANS.get(outcome="blocked_budget")
+    assert adm.tick() == []
+    assert client.evicted == []
+    assert engine.last_outcome == "blocked_budget"
+    assert metrics.DEFRAG_PLANS.get(
+        outcome="blocked_budget"
+    ) == before + 1
+    # Per-episode dedup: the next tick does not re-count the outcome.
+    assert adm.tick() == []
+    assert metrics.DEFRAG_PLANS.get(
+        outcome="blocked_budget"
+    ) == before + 1
+    assert table.active() == {}
+    adm.journal.close()
+
+
+def test_engine_checkpoint_deferral(tmp_path):
+    client = StubClient()
+    mesh = mk_mesh(4)
+    topos = [fragmented("n1", mesh), fragmented("n2", mesh)]
+    for w in range(2):
+        # NO checkpoint beacon stamp: the victim is stale by
+        # definition — the plan defers one tick for an in-flight save.
+        client.add(pod(
+            "default", "frag", f"frag-w{w}", 1, 2,
+            gated=False, node="n1", priority=-10,
+        ))
+    client.add(pod("default", "train", "train-w0", 4, 1, gated=True))
+    adm, table, engine = build_admission(
+        client, tmp_path, topos, checkpoint_wait_ticks=1,
+    )
+    assert adm.tick() == []
+    assert engine.last_outcome == "deferred"
+    assert client.evicted == []
+    # The deferral is once per episode: the next tick executes even
+    # though the save never landed.
+    released = adm.tick()
+    assert released == [("default", "train")]
+    assert engine.last_outcome == "executed"
+    assert len(client.evicted) == 2
+    adm.journal.close()
+
+
+def test_engine_eviction_blocked_aborts_and_retries(tmp_path):
+    client = StubClient()
+    topos = stranded_cluster(client)
+    adm, table, engine = build_admission(client, tmp_path, topos)
+    # A PodDisruptionBudget 429: the disruption budget doing its job —
+    # the round aborts (journaled), nothing is fenced, NO plain-delete
+    # escalation.
+    client.evict_error = KubeError(429, "pdb")
+    before = metrics.DEFRAG_ABORTED.get(reason="eviction_blocked")
+    assert adm.tick() == []
+    assert engine.last_outcome == "aborted"
+    assert client.evicted == [] and client.pods  # nothing deleted
+    assert table.active() == {}
+    assert engine.open_intents() == {}
+    assert metrics.DEFRAG_ABORTED.get(
+        reason="eviction_blocked"
+    ) == before + 1
+    # The journal holds no open round: SIGKILL now recovers clean.
+    adm.journal.flush()
+    assert adm.journal.replay_readonly().defragging == {}
+    # The PDB drains; the retry round finishes the migration.
+    client.evict_error = None
+    released = adm.tick()
+    assert released == [("default", "train")]
+    assert table.active()[("default", "train")].hosts == {"n1": 4}
+    adm.journal.close()
+
+
+def test_debug_snapshot_and_cli_renderers(tmp_path):
+    assert dfg.debug_snapshot()["enabled"] is False
+    client = StubClient()
+    topos = stranded_cluster(client)
+    adm, table, engine = build_admission(client, tmp_path, topos)
+    dfg.install(engine)
+    dfg.install(engine)  # idempotent
+    try:
+        released = adm.tick()
+        assert released == [("default", "train")]
+        doc = dfg.debug_snapshot()
+        assert doc["enabled"] is True
+        (eng,) = doc["engines"]
+        assert eng["last_outcome"] == "executed"
+        assert eng["last_plan"]["target_host"] == "n1"
+        assert eng["budget"]["remaining"] <= eng["budget"][
+            "max_evictions_per_hour"
+        ]
+        status = "\n".join(dfg._render_status(doc))
+        assert "budget" in status and "last outcome executed" in status
+        plan_txt = "\n".join(dfg._render_plan(doc))
+        assert "free a size-4 box on n1" in plan_txt
+        assert "migrate default/frag" in plan_txt
+    finally:
+        dfg.uninstall(engine)
+    assert dfg.debug_snapshot()["enabled"] is False
+    # The admitter's stop() deregisters the engine (shard handback).
+    dfg.install(engine)
+    adm.stop()
+    assert dfg.debug_snapshot()["enabled"] is False
+
+
+def test_defrag_self_test_smoke():
+    assert dfg.self_test() == 0
+
+
+# ---------------------------------------------------------------------------
+# the ROADMAP item 3 acceptance e2e, at 1,000 nodes
+# ---------------------------------------------------------------------------
+
+def test_acceptance_fragmented_1000_node_cluster(tmp_path):
+    """A deliberately fragmented 1,000-node sim cluster with a waiting
+    4-cube gang recovers size-4 placeability within the configured
+    eviction budget: the cheapest (recently-checkpointed, low-duty)
+    victim migrates, higher/equal-tier gangs are untouched, the
+    stranded gang admits onto the freed box, and ExtenderAudit —
+    including defrag_vs_reservations — sweeps clean after every tick.
+    Both eviction planes are wired production-shape: preemption
+    (min_preemptor_priority=1) correctly declines the standard-tier
+    gang, and defrag picks it up."""
+    client = StubClient()
+    mesh = mk_mesh(4)
+    topos = [
+        fragmented(f"node-{i:04d}", mesh) for i in range(1000)
+    ]
+    now = time.time()
+    # The cheapest victim: recently checkpointed, low duty, batch tier.
+    for w in range(2):
+        client.add(pod(
+            "default", "cheap", f"cheap-w{w}", 1, 2,
+            gated=False, node="node-0000", priority=-10, ckpt=now - 5,
+        ))
+    # An EXPENSIVE batch gang (stale checkpoint): must not be chosen
+    # while a cheaper set frees a box.
+    for w in range(2):
+        client.add(pod(
+            "default", "costly", f"costly-w{w}", 1, 2,
+            gated=False, node="node-0001", priority=-10,
+            ckpt=now - 3000,
+        ))
+    # Equal-tier and higher-tier gangs: untouchable by construction.
+    for w in range(2):
+        client.add(pod(
+            "default", "equal", f"equal-w{w}", 1, 2,
+            gated=False, node="node-0002", priority=0, ckpt=now - 5,
+        ))
+    for w in range(2):
+        client.add(pod(
+            "default", "prod", f"prod-w{w}", 1, 2,
+            gated=False, node="node-0003", priority=1_000_000,
+            ckpt=now - 5,
+        ))
+    # The stranded gang: one 4-chip pod, standard tier — free chips
+    # everywhere (2,000 cluster-wide), a contiguous 4-box nowhere.
+    client.add(pod("default", "train", "train-w0", 4, 1, gated=True,
+                   priority=0))
+
+    table = ReservationTable()
+    journal = AdmissionJournal(str(tmp_path / "journal"))
+    table.observer = journal.observe
+    adm = GangAdmission(
+        client,
+        reservations=table,
+        journal=journal,
+        topo_source=lambda: [
+            dataclasses.replace(t, available=list(t.available))
+            for t in topos
+        ],
+    )
+    resolver = PriorityResolver()
+    adm.priority_resolver = resolver
+    adm.preemption = PreemptionEngine(adm, resolver)
+    engine = DefragEngine(
+        adm, resolver,
+        stranded_ticks=2,
+        max_evictions_per_hour=2,  # exactly the plan's need
+        max_concurrent=2,
+    )
+    adm.defrag = engine
+    auditor = audit.ExtenderAudit(
+        reservations=table, journal=journal, gang=adm,
+    ).engine()
+
+    def assert_clean():
+        findings = auditor.sweep_once()
+        crit = [f for f in findings if f.severity == audit.CRITICAL]
+        assert crit == [], crit
+
+    released: List[Tuple[str, str]] = []
+    ticks = 0
+    while not released and ticks < 5:
+        released = adm.tick()
+        ticks += 1
+        assert_clean()
+    # Admitted within hysteresis + one planning tick.
+    assert released == [("default", "train")]
+    assert ticks == engine.detector.stranded_ticks
+
+    # The cheapest victim migrated — and ONLY it: the stale-checkpoint
+    # batch gang and the equal/higher-tier gangs are untouched.
+    evicted_gangs = {n.rsplit("-w", 1)[0] for _, n in client.evicted}
+    assert evicted_gangs == {"cheap"}, evicted_gangs
+    assert ("default", "costly-w0") in client.pods
+    assert ("default", "equal-w0") in client.pods
+    assert ("default", "prod-w0") in client.pods
+
+    # The stranded gang holds the freed box (fenced under ITS key),
+    # its gate is off, and size-4 placeability was recovered exactly
+    # where the plan projected it.
+    hold = table.active()[("default", "train")]
+    assert hold.hosts == {"node-0000": 4}
+    gates = client.pods[("default", "train-w0")]["spec"][
+        "schedulingGates"
+    ]
+    assert gates == []
+    assert engine.last_plan["target_host"] == "node-0000"
+    assert 4 in engine.last_plan["placeable_after"]
+    assert engine.last_outcome == "executed"
+
+    # Within the configured eviction budget, and the round closed.
+    assert engine.budget_remaining() == 0
+    assert engine.open_intents() == {}
+    journal.flush()
+    assert journal.replay_readonly().defragging == {}
+
+    # The stranded gauge pruned on admission; the plan counter moved.
+    assert metrics.STRANDED_DEMAND.series() == []
+    assert metrics.DEFRAG_MIGRATIONS.get(victim_tier="batch") >= 1
+
+    # One more tick + sweep: steady state stays clean (no re-evict
+    # storm, no dangling round).
+    assert adm.tick() == []
+    assert len(client.evicted) == 2
+    assert_clean()
+    journal.close()
+
+
+def test_detector_shard_scoped_series():
+    """Per-shard engines share one registry: a shard's publish must
+    prune only ITS OWN series, never a peer's (the sharded extender
+    runs one detector per owned shard)."""
+    d0 = StrandedDemandDetector(1, shard=0)
+    d1 = StrandedDemandDetector(1, shard=1)
+    try:
+        d0.observe(("a", "g"), 4)
+        d0.publish()
+        # Shard 1 has nothing stranded: publishing must not clobber
+        # shard 0's series.
+        d1.publish()
+        assert metrics.STRANDED_DEMAND.get(size="4", shard="0") == 1
+        d1.observe(("b", "h"), 4)
+        d1.publish()
+        assert metrics.STRANDED_DEMAND.get(size="4", shard="1") == 1
+        d0.clear(("a", "g"))
+        d0.publish()
+        assert metrics.STRANDED_DEMAND.get(size="4", shard="1") == 1
+        assert not any(
+            labels.get("shard") == "0"
+            for labels, _ in metrics.STRANDED_DEMAND.series()
+        )
+    finally:
+        d1.clear(("b", "h"))
+        d1.publish()
+        d0.publish()
+    assert metrics.STRANDED_DEMAND.series() == []
+
+
+def test_tputop_footer_aggregates_shards_and_skips_placeholders():
+    """The tputop defrag footer: an empty family's unlabeled
+    placeholder sample must not render (a --no-defrag extender is NOT
+    'budget 0, gate closed'), and multi-shard series aggregate."""
+    from k8s_device_plugin_tpu.tools.tputop import (
+        DEFRAG_FAMILIES,
+        _defrag_footer,
+    )
+
+    placeholders = {f: [({}, 0.0)] for f in DEFRAG_FAMILIES}
+    assert _defrag_footer(placeholders) is None
+    real = dict(placeholders)
+    real["tpu_extender_defrag_budget_remaining"] = [
+        ({"shard": ""}, 10.0), ({"shard": "1"}, 2.0),
+    ]
+    real["tpu_extender_stranded_demand"] = [
+        ({"shard": "", "size": "4"}, 1.0),
+        ({"shard": "1", "size": "4"}, 2.0),
+    ]
+    footer = _defrag_footer(real)
+    assert "budget 12 eviction(s) left/h" in footer
+    assert "stranded size=4×3" in footer
+
+
+def test_budget_window_survives_restart(tmp_path):
+    """The rolling eviction budget is journaled (defrag_spend +
+    compaction snapshot): a crashlooping extender cannot grant itself
+    a fresh --defrag-max-evictions-per-hour every incarnation."""
+    client = StubClient()
+    topos = stranded_cluster(client)
+    adm, table, engine = build_admission(
+        client, tmp_path, topos, max_evictions_per_hour=3,
+    )
+    assert adm.tick() == [("default", "train")]
+    assert engine.budget_remaining() == 1  # 2 pods evicted
+    adm.journal.flush()
+    adm.journal.close()
+
+    # A fresh incarnation over the same journal dir: the spend window
+    # rehydrates through recover(), whichever of the journal tail or
+    # the compaction snapshot carried it.
+    client2 = StubClient()
+    table2 = ReservationTable()
+    journal2 = AdmissionJournal(str(tmp_path / "journal"))
+    table2.observer = journal2.observe
+    adm2 = GangAdmission(
+        client2,
+        reservations=table2,
+        journal=journal2,
+        topo_source=lambda: [],
+    )
+    resolver = PriorityResolver()
+    adm2.priority_resolver = resolver
+    engine2 = DefragEngine(
+        adm2, resolver, max_evictions_per_hour=3,
+    )
+    adm2.defrag = engine2
+    adm2.recover()
+    assert engine2.budget_remaining() == 1
+    # And it survives a SECOND restart through the compaction
+    # recover() itself wrote.
+    journal2.close()
+    journal3 = AdmissionJournal(str(tmp_path / "journal"))
+    spend = journal3.replay().defrag_spend
+    journal3.close()
+    assert len(spend) == 2

@@ -191,3 +191,143 @@ def test_best_box_matches_reference_search(chip_type, count):
         assert (sorted(got) if got else None) == want, (
             chip_type, count, n, sorted(pool), sorted(must),
         )
+
+
+# ---------------------------------------------------------------------------
+# fragmentation_stats / placeable_sizes / box_fits edge cases the
+# defragmentation planner leans on (ISSUE 15): the stranded-demand
+# detector trusts these exactly — a drift here would repack a cluster
+# that isn't stranded, or strand one it could repack.
+# ---------------------------------------------------------------------------
+
+def test_torus_wraparound_never_mints_placeable_boxes():
+    """Torus generations (v5p: spec.torus, wraps on dims > 2): the two
+    ENDS of a 4-long torus line are wraparound-adjacent, but the box
+    space is the allocator's (`box_candidates` enumerates offsets
+    inside the bounds, wraps feed only the link scoring) — so the pair
+    must NOT read as a placeable 2-box, on the torus exactly as on the
+    mesh generation. Conservative on purpose: "placeable" is exactly a
+    box ``select`` would place, and the defrag planner must never
+    count a box the allocator would then refuse to pack."""
+    from k8s_device_plugin_tpu.topology.placement import (
+        box_fits,
+        fragmentation_stats,
+    )
+
+    torus = IciMesh(
+        [c.chip for c in mesh_of("v5p", 4).mesh_chips],
+        bounds=(4, 1, 1),
+    )
+    assert torus.spec.torus and torus._dim_wraps(4)
+    ends = [
+        torus.by_coords[(0, 0, 0)].id,
+        torus.by_coords[(3, 0, 0)].id,
+    ]
+    assert not box_fits(torus, ends, 2)
+    t_stats = fragmentation_stats(torus, ends)
+    assert t_stats["largest_box"] == 1
+    assert t_stats["placeable"] == {1: True, 2: False, 4: False}
+    # Same free shape on a mesh (non-torus) generation: identical
+    # verdict — wraparound links change scoring, never placeability.
+    line = IciMesh(
+        [c.chip for c in mesh_of("v5e", 4).mesh_chips],
+        bounds=(4, 1, 1),
+    )
+    assert not line.spec.torus
+    ends_m = [
+        line.by_coords[(0, 0, 0)].id,
+        line.by_coords[(3, 0, 0)].id,
+    ]
+    assert fragmentation_stats(line, ends_m) == t_stats
+    # An INTERIOR adjacent pair is placeable on both, of course.
+    mid = [
+        torus.by_coords[(1, 0, 0)].id,
+        torus.by_coords[(2, 0, 0)].id,
+    ]
+    assert box_fits(torus, mid, 2)
+
+
+def test_non_power_of_two_free_sets():
+    """largest_box is exact over EVERY box volume (a 3-chip contiguous
+    run reads 3, not 2), while the placeable dict stays power-of-two
+    (the request vocabulary)."""
+    from k8s_device_plugin_tpu.topology.placement import (
+        box_fits,
+        fragmentation_stats,
+        placeable_sizes,
+    )
+
+    line = IciMesh(
+        [c.chip for c in mesh_of("v5e", 4).mesh_chips],
+        bounds=(4, 1, 1),
+    )
+    run3 = [line.by_coords[(i, 0, 0)].id for i in range(3)]
+    stats = fragmentation_stats(line, run3)
+    assert stats["free"] == 3
+    assert stats["largest_box"] == 3
+    assert stats["fragmentation"] == 0.0
+    assert stats["placeable"] == {1: True, 2: True, 4: False}
+    assert placeable_sizes(line, run3) == (1, 2)
+    assert box_fits(line, run3, 3)  # non-power-of-two demand: exact
+    assert not box_fits(line, run3, 4)
+
+
+def test_single_chip_mesh():
+    """The 1-chip degenerate mesh: one placeable size, empty set reads
+    exhausted (fragmentation 0.0 — nothing to defragment), and
+    box_fits handles n=0 / n>count without tripping."""
+    from k8s_device_plugin_tpu.topology.placement import (
+        box_fits,
+        fragmentation_stats,
+        placeable_box_sizes,
+        placeable_sizes,
+    )
+
+    solo = mesh_of("unknown-accel", 1)
+    assert solo.bounds == (1, 1, 1)
+    assert placeable_box_sizes(1) == [1]
+    assert fragmentation_stats(solo, solo.ids) == {
+        "free": 1, "largest_box": 1, "fragmentation": 0.0,
+        "placeable": {1: True},
+    }
+    assert placeable_sizes(solo, solo.ids) == (1,)
+    assert box_fits(solo, solo.ids, 1)
+    assert not box_fits(solo, solo.ids, 2)
+    assert not box_fits(solo, solo.ids, 0)
+    empty = fragmentation_stats(solo, [])
+    assert empty == {
+        "free": 0, "largest_box": 0, "fragmentation": 0.0,
+        "placeable": {1: False},
+    }
+
+
+def test_free_27_does_not_imply_16_placeable():
+    """The documented regression (docs/metrics.md
+    `tpu_extender_placeable_nodes`, ISSUE 15): a fully-free 3×3×3 cube
+    holds 27 chips — zero fragmentation, largest_box 27 — yet NO
+    16-box is placeable (no factorization of 16 fits inside 3×3×3:
+    every shape needs a dimension ≥ 4). "free ≥ N" does not imply
+    "N-placeable", which is exactly the gap the stranded-demand
+    detector exists to catch — and a case where even migration cannot
+    help (the geometry, not the occupancy, is the limit)."""
+    from k8s_device_plugin_tpu.topology.placement import (
+        box_fits,
+        fragmentation_stats,
+        placeable_box_sizes,
+    )
+
+    cube = IciMesh(
+        [c.chip for c in mesh_of("unknown-accel", 27).mesh_chips],
+        bounds=(3, 3, 3),
+    )
+    assert cube.bounds == (3, 3, 3)
+    assert placeable_box_sizes(27) == [1, 2, 4, 8, 16]
+    stats = fragmentation_stats(cube, cube.ids)
+    assert stats["free"] == 27
+    assert stats["largest_box"] == 27
+    assert stats["fragmentation"] == 0.0  # not fragmented — bounded
+    assert stats["placeable"] == {
+        1: True, 2: True, 4: True, 8: True, 16: False,
+    }
+    assert box_fits(cube, cube.ids, 8)  # the 2×2×2 corner
+    assert not box_fits(cube, cube.ids, 16)
