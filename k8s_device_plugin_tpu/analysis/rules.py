@@ -32,6 +32,10 @@ TPL008    undocumented-debug-endpoint  a ``/debug/*`` surface served
                                    skip it) or from the docs
 TPL009    undocumented-span        span names missing from the
                                    observability span table (PR 3)
+TPL010    raw-kube-call            an apiserver hop that bypasses the
+                                   resilience wrapper (no deadline,
+                                   no retry budget, no breaker — the
+                                   PR 16 hostile-apiserver class)
 ========  =======================  ==================================
 
 Suppression: ``# tpu-lint: disable=TPL006`` on the offending line (or
@@ -127,6 +131,17 @@ RULES: Tuple[Rule, ...] = (
         "a tracing span name is absent from the "
         "docs/observability.md span table",
         "PR 3 (tracing) lockstep greps",
+    ),
+    Rule(
+        "TPL010", "raw-kube-call",
+        "a raw apiserver transport hop (`._attempt(...)` or a "
+        "`._session.<verb>(...)` call) outside the resilience "
+        "wrapper — it gets no per-call deadline, no retry budget, "
+        "no Retry-After handling, no circuit breaker, and no "
+        "outcome metric, so one hostile apiserver window hangs or "
+        "crashes the caller instead of degrading it",
+        "PR 16 (hostile-apiserver resilience: every kube hop must "
+        "ride utils/resilience)",
     ),
 )
 
@@ -451,6 +466,54 @@ def _check_bare_except(
             ))
 
 
+# -- TPL010 ------------------------------------------------------------------
+
+
+def _check_raw_kube_call(
+    idx: _ModuleIndex, rel: str, out: List[LintFinding]
+) -> None:
+    """Every apiserver hop must ride ``resilience.call``. Two raw
+    shapes are flagged: a direct ``<client>._attempt(...)`` call and a
+    direct ``<client>._session.<verb>(...)`` call. Two contexts are
+    sanctioned: anything lexically inside a ``*resilience*.call(...)``
+    argument (the wrapper's own thunk — ``lambda: self._attempt(...)``
+    in kube/client.py), and the body of a function named ``_attempt``
+    (the wrapper's single transport hop onto the session)."""
+    sanctioned: Set[int] = set()
+    for node in ast.walk(idx.tree):
+        if isinstance(node, ast.Call):
+            dotted = scan._dotted(node.func)
+            if dotted.endswith(".call") and "resilience" in dotted:
+                for sub in ast.walk(node):
+                    sanctioned.add(id(sub))
+        elif isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and node.name == "_attempt":
+            for sub in ast.walk(node):
+                sanctioned.add(id(sub))
+    for node in ast.walk(idx.tree):
+        if not isinstance(node, ast.Call) or id(node) in sanctioned:
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        raw = f.attr == "_attempt" or (
+            isinstance(f.value, ast.Attribute)
+            and f.value.attr == "_session"
+        )
+        if not raw:
+            continue
+        out.append(LintFinding(
+            "TPL010", rel, node.lineno,
+            f"raw kube transport call `{scan._dotted(f)}(...)` "
+            f"bypasses the resilience layer — no per-call deadline, "
+            f"no retry budget, no Retry-After handling, no circuit "
+            f"breaker, no outcome metric; go through the KubeClient "
+            f"verbs (or wrap the hop in `self.resilience.call(...)`)",
+            key=f"rawkube:{_qualname(idx, node)}->{f.attr}",
+        ))
+
+
 # -- doc-lockstep rules (TPL003/4/5/8/9) -------------------------------------
 
 
@@ -553,6 +616,8 @@ def run_rules(
             _check_blocking_under_lock(idx, rel, out)
         if "TPL007" in want:
             _check_bare_except(idx, rel, out)
+        if "TPL010" in want:
+            _check_raw_kube_call(idx, rel, out)
 
     if "TPL003" in want:
         fam_sites = scan.metric_family_sites(file_list)

@@ -545,12 +545,61 @@ def loop_inventory_invariant() -> Invariant:
     )
 
 
+def check_degraded_consistency() -> List[Finding]:
+    """No kube mutation may land while the circuit breaker is open.
+    The resilience layer (utils/resilience) promises exactly this —
+    breaker-open fails every call fast with CircuitOpenError, so
+    consumers abort-and-replan instead of writing on stale state —
+    and the TRACKER keeps the evidence either way: every successful
+    mutation timestamp and every breaker open/close window. A
+    mutation timestamp inside an open window means some call path
+    bypassed the wrapper (or a probe wrote when only reads may
+    probe): CRITICAL, because the write was made against a view of
+    the cluster the daemon could not have refreshed, and the finding
+    stands until restart — the evidence list never shrinks. The
+    verb rides the ``chip`` slot so two bad verbs are two findings."""
+    from .utils.resilience import TRACKER
+
+    out: List[Finding] = []
+    by_verb: Dict[str, List[float]] = {}
+    for ts, verb in TRACKER.mutations_while_open():
+        by_verb.setdefault(verb, []).append(ts)
+    for verb, stamps in sorted(by_verb.items()):
+        out.append(Finding.make(
+            "degraded_consistency", CRITICAL,
+            f"{len(stamps)} successful '{verb}' mutation(s) landed "
+            f"while the kube circuit breaker was OPEN — a write "
+            f"path bypassed the resilience wrapper; evidence at "
+            f"/debug/resilience",
+            chip=verb,
+            verb=verb,
+            count=len(stamps),
+            first_ts=min(stamps),
+            last_ts=max(stamps),
+        ))
+    return out
+
+
+def degraded_consistency_invariant() -> Invariant:
+    return Invariant(
+        "degraded_consistency",
+        ("kube", "resilience", "breaker"),
+        "no kube mutation may succeed while the circuit breaker is "
+        "open: breaker-open means the daemon's view of the cluster "
+        "is stale, and a write against stale state is critical — "
+        "the resilience tracker's mutation/window evidence proves "
+        "compliance",
+        check_degraded_consistency,
+    )
+
+
 def shared_invariants() -> List[Invariant]:
     """The process-health invariant set both daemons carry."""
     return [
         thread_liveness_invariant(),
         lock_order_invariant(),
         loop_inventory_invariant(),
+        degraded_consistency_invariant(),
     ]
 
 

@@ -38,6 +38,7 @@ from ..utils.flightrecorder import RECORDER
 from ..utils.logging import get_logger
 from ..utils.podresources import is_tpu_pod
 from ..utils.resilience import (
+    TRACKER,
     Backoff,
     PendingWrites,
     UnavailableError,
@@ -128,6 +129,11 @@ class Controller:
         self._chip_attr: Dict[str, Dict[str, str]] = {}
         # Optional TopologyPublisher owned by the wiring; stopped with us.
         self.publisher = None
+        # Optional utils/resilience.DegradedMode (supervisor wiring):
+        # every successful relist marks it fresh, so the plugin-side
+        # staleness gauge ages only while the apiserver is actually
+        # unreachable.
+        self.degraded = None
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -414,6 +420,8 @@ class Controller:
                     pods = self.client.list_pods(node_name=self.node_name)
                     last_list = time.time()
                     self._watch_backoff.reset()
+                    if self.degraded is not None:
+                        self.degraded.mark_fresh()
                     # The relist succeeded, so the apiserver is back:
                     # deliver the annotation patches queued while it was
                     # unreachable before this cycle's events re-derive
@@ -474,6 +482,8 @@ class Controller:
                     return
                 if e.status_code == 410:  # resourceVersion too old: relist
                     log.info("watch expired; relisting")
+                    TRACKER.record_watch("relist")
+                    metrics.KUBE_WATCH_STREAMS.inc(outcome="relist")
                     resource_version = ""
                 else:
                     log.warning("watch error: %s", e)
@@ -488,6 +498,12 @@ class Controller:
                 if self._stop.is_set():
                     return
                 log.warning("watch connection error: %s", e)
+                if resource_version:
+                    # The loop re-enters with resource_version intact:
+                    # a resume from the bookmarked rv, not a relist —
+                    # the apiserver replays everything we missed.
+                    TRACKER.record_watch("resumed")
+                    metrics.KUBE_WATCH_STREAMS.inc(outcome="resumed")
                 self._stop.wait(self._watch_backoff.next_delay())
 
     def _enqueue(self, etype: str, pod: dict, retries: int = 0) -> None:
@@ -900,6 +916,23 @@ class Controller:
                     "chip %s recovered before eviction ran; skipping",
                     chip_id,
                 )
+            return
+        if self.degraded is not None and self.degraded.active:
+            # Breaker open: every Eviction would fail fast anyway (it
+            # never blind-retries), and half-evicting a gang against an
+            # unreachable apiserver helps nobody. Eviction is LEVEL-
+            # triggered — the resync after recovery re-fires this sweep
+            # for as long as the chip stays broken.
+            log.warning(
+                "eviction sweep skipped: kube circuit open "
+                "(degraded mode); retried next resync"
+            )
+            RECORDER.record(
+                "degraded_mode",
+                "eviction sweep skipped while breaker open",
+                state="degraded",
+                reason="eviction_deferred",
+            )
             return
         try:
             pods = self.client.list_pods(

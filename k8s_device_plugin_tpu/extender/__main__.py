@@ -75,6 +75,17 @@ def main() -> int:
         "annotation republish storms). 0 applies every event inline",
     )
     p.add_argument(
+        "--staleness-cap-s", type=float,
+        default=float(os.environ.get("TPU_STALENESS_CAP_S", "60") or 60),
+        help="degraded-serving staleness cap (also TPU_STALENESS_CAP_S):"
+        " while the kube circuit breaker is open, /filter and "
+        "/prioritize keep answering from the last-known-good topology "
+        "index until the last successful sync is this many seconds old;"
+        " past the cap admission PAUSES (503, the scheduler retries) "
+        "instead of placing gangs on fiction — see docs/operations.md "
+        "'Surviving an apiserver brownout'",
+    )
+    p.add_argument(
         "--gang-full-sweep-s", type=float, default=60.0,
         help="gang admission full-sweep backstop interval: resyncs in "
         "between are dirty ticks that evaluate only event-marked "
@@ -349,16 +360,30 @@ def main() -> int:
         journal_configured=bool(a.journal_dir and a.gang_admission),
     )
     tpumetrics.READYZ_PROVIDER = status.snapshot
+    degraded = None
     if a.node_cache or a.gang_admission:
         from ..kube.client import KubeClient
         from ..utils import resilience
 
         client = KubeClient.from_env(a.kubeconfig)
+        # Explicit degraded mode, flipped by the circuit breaker: while
+        # open, serving continues from the last-known-good index with
+        # the staleness age exported; past --staleness-cap-s admission
+        # pauses. The /debug/resilience surface reads it through the
+        # process-global TRACKER (DegradedMode attaches itself).
+        degraded = resilience.DegradedMode(
+            staleness_cap_s=a.staleness_cap_s,
+            name="extender",
+            gauge=tpumetrics.EXT_KUBE_DEGRADED_MODE,
+            staleness_gauge=tpumetrics.EXT_KUBE_DEGRADED_STALENESS,
+        )
+        status.degraded = degraded
         # Report this process's retry/circuit/latency telemetry to the
         # EXTENDER registry (metrics.py keeps the two processes'
         # registries separate on purpose).
         client.resilience = resilience.Resilience(
-            metrics=resilience.extender_metrics()
+            metrics=resilience.extender_metrics(),
+            degraded=degraded,
         )
     if a.node_cache:
         node_cache = NodeAnnotationCache(
@@ -369,7 +394,9 @@ def main() -> int:
             snapshot_dir=a.index_snapshot_dir,
             warm_workers=a.index_warm_workers,
             event_coalesce_s=a.node_event_coalesce_s,
-        ).start()
+        )
+        node_cache.degraded = degraded
+        node_cache.start()
         status.warm_progress = node_cache.index.warm_progress
     # The pre-warmed parse/mesh cache (and everything else alive at
     # startup) leaves the GC scan set: a gen2 pass over the ~1M
@@ -511,6 +538,7 @@ def main() -> int:
                 topo_filter=topo_filter,
                 shard_id=shard_id,
             )
+            adm.degraded = degraded
             wire_preemption(adm)
             return adm
 
@@ -604,6 +632,7 @@ def main() -> int:
         ),
         ready_check=ready.is_set,
         ready_status=status.snapshot,
+        degraded=degraded,
     )
     srv.start()
     gang = None
@@ -645,6 +674,7 @@ def main() -> int:
             pending_event_threshold_s=a.gang_pending_event_s,
             journal=journal,
         )
+        gang.degraded = degraded
         wire_preemption(gang)
         if node_cache is not None:
             # … and its node-change events mark exactly the affected

@@ -533,6 +533,14 @@ class GangAdmission:
         # two-phase journaled, and admit onto the freed, fenced box.
         # None = no defrag (the pre-PR-15 behavior, bit for bit).
         self.defrag = None
+        # Optional utils/resilience.DegradedMode (entrypoint wiring):
+        # while PAUSED (breaker open AND the last-known-good state is
+        # past the staleness cap) the tick loop skips whole ticks —
+        # planning admissions, preemptions, or migrations against
+        # state that stale places gangs on fiction, and every mutation
+        # would fail fast against the open breaker anyway. Level-
+        # triggered: the first tick after recovery re-plans from truth.
+        self.degraded = None
         # Gang → (numeric priority, tier label), refreshed per
         # evaluation; pruned with the gang (the tier feeds the
         # per-tier waiting/admitted metric labels).
@@ -876,6 +884,19 @@ class GangAdmission:
         while not self._stop.is_set():
             hb.beat()
             try:
+                if self.degraded is not None and self.degraded.paused:
+                    # Past the staleness cap: pause admission entirely
+                    # (mirrors the HTTP plane's 503). A skipped tick
+                    # loses nothing — the sweep after recovery is full
+                    # truth.
+                    log.warning(
+                        "gang tick skipped: degraded serving paused "
+                        "(last-known-good state %.0fs old, cap %.0fs)",
+                        self.degraded.staleness_s(),
+                        self.degraded.staleness_cap_s,
+                    )
+                    self._stop.wait(self.resync_interval_s)
+                    continue
                 # Dirty tick by default; full sweep on the backstop
                 # cadence (level-triggered: whatever an event missed,
                 # the sweep catches within full_sweep_interval_s).

@@ -386,6 +386,35 @@ class LeaderLease:
         if self.on_lost is not None:
             self.on_lost()
 
+    def _renew_wait_s(
+        self, prev_wait: float, interval: float, failed: bool
+    ) -> float:
+        """Decorrelated per-instance jitter for the renewal cadence
+        (``next = min(cap, uniform(base, prev * 3))`` — the classic
+        decorrelated-jitter shape). Each instance draws from its
+        private RNG, so N replicas constructed with identical
+        parameters never renew — or, worse, retry a browned-out
+        apiserver — in lockstep. A healthy renewal waits within
+        [interval/2, interval], still >= 3 attempts inside the renew
+        deadline. A FAILED attempt tightens the cadence to
+        [interval/8, interval/2]: the demotion guard at the top of
+        the loop is evaluated more often, so a partitioned holder
+        self-demotes strictly BEFORE its lease becomes
+        takeover-able, while the jitter keeps the fleet's tight
+        retries spread across the recovering apiserver's window.
+        ``retry_jitter_s=0`` restores the fixed cadence (the
+        deterministic-timing escape hatch tests use)."""
+        if self.retry_jitter_s <= 0:
+            return interval
+        if failed:
+            base = max(interval / 8.0, 0.05)
+            cap = max(interval / 2.0, base)
+        else:
+            base = interval / 2.0
+            cap = interval
+        hi = max(min(prev_wait * 3.0, cap), base)
+        return min(cap, self._rng.uniform(base, hi))
+
     def _renew_loop(self) -> None:
         # Wake often enough for ~3 renewal attempts inside the renew
         # deadline (client-go's RetryPeriod shape).
@@ -403,7 +432,11 @@ class LeaderLease:
                 + self.renew_deadline_s
             ),
         )
-        while not self._stop.wait(interval):
+        # First wake is jittered too: replicas that acquired their
+        # leases in the same instant must not fire their first
+        # renewals in the same instant.
+        wait = self._renew_wait_s(interval, interval, failed=False)
+        while not self._stop.wait(wait):
             hb.beat()
             # Pre-attempt guard: a previous attempt that blocked past
             # the deadline (despite the clamps in _renew_once) must not
@@ -420,6 +453,7 @@ class LeaderLease:
             try:
                 self._renew_once()
                 self._last_renew = self._clock()
+                wait = self._renew_wait_s(wait, interval, failed=False)
             except SecondReplica as e:
                 self._demote("lost_to_peer", e)
                 return
@@ -439,6 +473,7 @@ class LeaderLease:
                     )
                     return
                 log.warning("lease renewal failed (will retry): %s", e)
+                wait = self._renew_wait_s(wait, interval, failed=True)
 
     def _renew_once(self) -> None:
         # Clamp BOTH the retry envelope and the single in-flight

@@ -835,6 +835,12 @@ class NodeAnnotationCache:
         # Relist-loop heartbeat (set when the loop starts; the watch
         # plane beats it per stream window).
         self._hb = None
+        # Optional utils/resilience.DegradedMode, attached by the
+        # entrypoint: every successful sync (relist or applied watch
+        # event) marks it fresh, so its staleness age measures how old
+        # the last-known-good index really is while the breaker is
+        # open.
+        self.degraded = None
 
     @property
     def synced(self) -> bool:
@@ -1237,6 +1243,8 @@ class NodeAnnotationCache:
         )
         metrics.INDEX_SLICES.set(self.index.stats()["slices"])
         metrics.NODE_CACHE_SYNCED.set(1)
+        if self.degraded is not None:
+            self.degraded.mark_fresh()
         # Pre-warm the parse/mesh LRU for EVERY current annotation on
         # THIS thread: the index already holds parsed entries, but the
         # full-object RPC path (nodeCacheCapable: false schedulers)
@@ -1297,22 +1305,38 @@ class NodeAnnotationCache:
 
     def _watch_until_stale(self) -> bool:
         """Stream node events into the index until the watch breaks or
-        the relist backstop comes due. Every exit path leads back to a
-        refresh() (level-triggered), so a dropped event can delay an
-        update by at most watch_backstop_s, never lose it. Returns
-        True when the exit was the healthy backstop expiry, False when
-        the stream broke."""
+        the relist backstop comes due. A dropped stream (reset,
+        truncation, transient error) RESUMES from the bookmarked
+        resourceVersion — no event between the drop and the resume is
+        lost, because the apiserver replays everything past rv; only a
+        ``410 Gone`` (rv aged out of the apiserver's window) or
+        repeated no-progress failures fall back to a full relist (the
+        caller's refresh). Every exit path still leads back to a
+        refresh() (level-triggered), so even a missed event is delayed
+        by at most watch_backstop_s, never lost. Returns True when the
+        exit was the healthy backstop expiry, False when the stream is
+        beyond resuming."""
         import time as _time
+
+        from ..kube.client import KubeError
+        from ..utils.resilience import TRACKER
 
         deadline = _time.monotonic() + self.watch_backstop_s
         rv = self._resource_version
         hb = getattr(self, "_hb", None)
+        # Consecutive stream failures without a single delivered event:
+        # each one resumes from rv, but a stream that dies repeatedly
+        # before making progress means the apiserver (or the path to
+        # it) is down — hand back to the relist loop's backoff instead
+        # of hot-looping reconnects.
+        barren_drops = 0
         while not self._stop.is_set() and _time.monotonic() < deadline:
             if hb is not None:
                 # One beat per stream window: the relist loop's
                 # heartbeat keeps moving through a long healthy watch.
                 hb.beat()
             window = min(60.0, max(1.0, deadline - _time.monotonic()))
+            progressed = False
             try:
                 for etype, obj in self.client.watch_nodes(
                     resource_version=rv,
@@ -1326,16 +1350,51 @@ class NodeAnnotationCache:
                         )
                         or rv
                     )
+                    progressed = True
+                    barren_drops = 0
                     # Through the coalescer when enabled (one rebuild
                     # per node per applier tick under event storms);
                     # inline otherwise.
                     self.offer_event(etype, obj)
+                    if self.degraded is not None and etype != "ERROR":
+                        self.degraded.mark_fresh()
                     if _time.monotonic() >= deadline:
                         break
-            except Exception as e:  # noqa: BLE001 — 410s, drops,
-                # truncation: all mean "relist" (the caller's refresh)
-                log.debug("node watch window ended: %s", e)
+            except KubeError as e:
+                if e.status_code == 410:
+                    # rv aged out — the ONE case resuming cannot cover:
+                    # a full relist re-establishes truth.
+                    TRACKER.record_watch("relist")
+                    metrics.EXT_KUBE_WATCH_STREAMS.inc(outcome="relist")
+                    log.debug("node watch 410, relisting: %s", e)
+                    self._resource_version = rv
+                    return False
+                log.debug("node watch window errored: %s", e)
                 return False
+            except Exception as e:  # noqa: BLE001 — drops, resets,
+                # truncation: resume from the bookmarked rv (the
+                # apiserver replays everything we missed), unless the
+                # stream keeps dying without delivering anything.
+                if not progressed:
+                    barren_drops += 1
+                    if barren_drops >= 3:
+                        log.debug(
+                            "node watch dropped %d times without "
+                            "progress, relisting: %s", barren_drops, e,
+                        )
+                        return False
+                TRACKER.record_watch("resumed")
+                metrics.EXT_KUBE_WATCH_STREAMS.inc(outcome="resumed")
+                log.debug("node watch dropped, resuming from rv=%s: %s",
+                          rv, e)
+                # Brief pause so a flapping stream doesn't reconnect
+                # hot (the resume path bypasses the relist backoff) —
+                # floored at one step: a stream that progresses before
+                # every drop keeps barren_drops at 0 but must not
+                # reconnect in a zero-wait loop.
+                if self._stop.wait(0.05 * max(1, barren_drops)):
+                    return False
+                continue
         self._resource_version = rv
         return True
 
@@ -1401,6 +1460,7 @@ class ReadyStatus:
         journal_configured: bool = False,
         warm_progress=None,
         shard_status=None,
+        degraded=None,
     ):
         self._ready = ready_event
         self._replay_done = not journal_configured
@@ -1412,6 +1472,11 @@ class ReadyStatus:
         # shard's replay/warm phase ride the /readyz body (and
         # /debug/readyz, so tpu-doctor bundles capture it).
         self.shard_status = shard_status
+        # Optional utils/resilience.DegradedMode: its state + staleness
+        # age ride the /readyz body so an operator can read "serving
+        # stale, N s old, pauses at M s" straight off the probe during
+        # an apiserver brownout (docs/operations.md runbook).
+        self.degraded = degraded
         self._t0 = time.monotonic()
         self.time_to_ready_s: Optional[float] = None
 
@@ -1452,6 +1517,11 @@ class ReadyStatus:
                 }
             except Exception:  # noqa: BLE001 — advisory, same as warm
                 pass
+        if self.degraded is not None:
+            try:
+                out["resilience"] = self.degraded.snapshot()
+            except Exception:  # noqa: BLE001 — advisory, same as warm
+                pass
         if self.time_to_ready_s is not None:
             out["time_to_ready_s"] = self.time_to_ready_s
         if phase == "replaying":
@@ -1479,8 +1549,16 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
         ready_check=None,
         ready_status=None,
         preemption_handler=None,
+        degraded=None,
     ):
         super().__init__(host, port)
+        # Optional utils/resilience.DegradedMode: while ACTIVE (breaker
+        # open) /filter and /prioritize keep serving from the last-
+        # known-good index; once the staleness age passes the cap
+        # (``paused``) they answer 503 instead — placing gangs on state
+        # that stale is placing them on fiction, and a 503 makes the
+        # scheduler retry until the apiserver answers again.
+        self.degraded = degraded
         self.extender = extender or TopologyExtender()
         # Scheduler-extender ``preemption`` verb (the third verb of
         # k8s.io/kube-scheduler/extender/v1, next to filter and
@@ -1577,6 +1655,30 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                         if verb in ("filter", "prioritize", "preemption")
                         else "other",
                         outcome="not_ready",
+                    )
+                    return
+                dm = server.degraded
+                if dm is not None and dm.paused:
+                    # Degraded past the staleness cap: pause admission.
+                    self._send(
+                        {
+                            "error": (
+                                "degraded serving paused: last-known-"
+                                "good cluster state is "
+                                f"{dm.staleness_s():.0f}s old (cap "
+                                f"{dm.staleness_cap_s:.0f}s) — "
+                                "apiserver unreachable"
+                            ),
+                            "resilience": dm.snapshot(),
+                        },
+                        503,
+                    )
+                    verb = self.path.strip("/")
+                    metrics.EXTENDER_REQUESTS.inc(
+                        verb=verb
+                        if verb in ("filter", "prioritize", "preemption")
+                        else "other",
+                        outcome="degraded_paused",
                     )
                     return
                 try:

@@ -842,6 +842,12 @@ class ShardManager:
                     owned_ids.add(self.home_shard)
             except SecondReplica:
                 pass
+            except Exception as e:  # noqa: BLE001 — apiserver outage
+                # (reset / 5xx / breaker open) mid-adoption: adoption
+                # cannot succeed until the apiserver is back, and the
+                # rest of the scan (gauges, peer-hold fencing) must
+                # still run — retry next pass.
+                log.warning("home shard re-adoption failed: %s", e)
         for shard_id in sorted(owned_ids):
             if shard_id != self.home_shard and (
                 self._standby_claimant_live(shard_id)
@@ -864,10 +870,28 @@ class ShardManager:
                 self._observers[shard_id] = obs
             try:
                 lease = self.client.get(obs._path)
-            except Exception:  # noqa: BLE001 — 404 (never created) and
-                # outages both read as "nothing to see"; an uncreated
-                # shard lease is taken below via acquire's create path
-                lease = None
+            except Exception as e:  # noqa: BLE001 — 404 vs outage,
+                # and the two could not be more different here:
+                status = getattr(e, "status_code", 0)
+                if status == 404:
+                    # Never created: genuinely no holds; the
+                    # rollout-grace scavenge below may take it.
+                    lease = None
+                else:
+                    # Apiserver brownout (5xx / reset / breaker
+                    # open): the LAST-KNOWN overlay keeps fencing —
+                    # an outage must not unfence a peer's held chips
+                    # mid-takeover — and holder liveness cannot be
+                    # judged from a failed read, so no takeover
+                    # decision is made for this shard either.
+                    with self._lock:
+                        stale = self._peer_holds.get(shard_id, [])
+                    peer_chips += sum(
+                        int(n)
+                        for r in stale
+                        for n in (r.get("hosts") or {}).values()
+                    )
+                    continue
             spec = (lease or {}).get("spec") or {}
             holder = spec.get("holderIdentity", "")
             live = bool(holder) and obs._holder_is_live(spec)

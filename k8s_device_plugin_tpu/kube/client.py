@@ -62,9 +62,19 @@ def rfc3339_now() -> str:
 
 
 class KubeError(Exception):
-    def __init__(self, status_code: int, message: str):
+    def __init__(
+        self,
+        status_code: int,
+        message: str,
+        retry_after_s: Optional[float] = None,
+    ):
         super().__init__(f"HTTP {status_code}: {message}")
         self.status_code = status_code
+        # Parsed Retry-After header (seconds), when the apiserver sent
+        # one (429/503 flow control). The resilience layer raises its
+        # backoff floor to honor it instead of hammering a server that
+        # just said "not yet".
+        self.retry_after_s = retry_after_s
 
 
 class KubeConfigError(Exception):
@@ -171,7 +181,16 @@ class KubeClient:
         kw.setdefault("timeout", self.timeout)
         resp = self._session.request(method, self.base_url + path, **kw)
         if resp.status_code >= 400:
-            raise KubeError(resp.status_code, resp.text[:500])
+            ra: Optional[float] = None
+            header = resp.headers.get("Retry-After", "")
+            if header:
+                try:
+                    ra = max(float(header), 0.0)
+                except ValueError:
+                    ra = None  # HTTP-date form — rare from kube; skip
+            raise KubeError(
+                resp.status_code, resp.text[:500], retry_after_s=ra
+            )
         return resp
 
     def _request(
@@ -180,15 +199,25 @@ class KubeClient:
         path: str,
         verb: str = "",
         deadline_s: Optional[float] = None,
+        idempotent: bool = True,
+        mutating: bool = False,
         **kw,
     ) -> requests.Response:
         """Resilient request returning the raw Response (streaming
         callers). Retries cover the connect/headers phase; body
-        streaming errors are the caller's reconnect loop's job."""
+        streaming errors are the caller's reconnect loop's job.
+
+        ``idempotent=False`` caps the envelope at ONE attempt (the
+        Eviction subresource — a blind retry can double-evict);
+        ``mutating=True`` records the call in the resilience tracker's
+        mutation ring, the evidence the ``degraded_consistency`` audit
+        invariant checks against breaker-open windows."""
         return self.resilience.call(
             lambda: self._attempt(method, path, **kw),
             verb=verb or method,
             deadline_s=deadline_s,
+            idempotent=idempotent,
+            mutating=mutating,
         )
 
     def _request_json(
@@ -197,6 +226,8 @@ class KubeClient:
         path: str,
         verb: str = "",
         deadline_s: Optional[float] = None,
+        idempotent: bool = True,
+        mutating: bool = False,
         **kw,
     ) -> dict:
         """Resilient request + body parse. The parse happens INSIDE the
@@ -207,6 +238,8 @@ class KubeClient:
             lambda: self._attempt(method, path, **kw).json(),
             verb=verb or method,
             deadline_s=deadline_s,
+            idempotent=idempotent,
+            mutating=mutating,
         )
 
     def get(
@@ -230,23 +263,33 @@ class KubeClient:
     def patch(
         self, path: str, body: dict, content_type: str = STRATEGIC_MERGE_PATCH
     ) -> dict:
+        # Merge patches are idempotent (applying twice = applying once),
+        # so the resilience layer may retry them.
         return self._request_json(
             "PATCH",
             path,
             data=json.dumps(body),
             headers={"Content-Type": content_type},
+            mutating=True,
         )
 
-    def create(self, path: str, body: dict) -> dict:
+    def create(
+        self, path: str, body: dict, idempotent: bool = True
+    ) -> dict:
         """POST a new object to a collection path (e.g. ResourceSlices).
         Retried on transport failure: a retry of a create that actually
         landed answers 409, which surfaces to the caller exactly like
-        losing a create race — every call site already handles it."""
+        losing a create race — every call site already handles it.
+        ``idempotent=False`` (Eviction) forbids the retry: the
+        subresource has no such conflict answer, and a blind re-POST
+        can evict twice."""
         return self._request_json(
             "POST",
             path,
             data=json.dumps(body),
             headers={"Content-Type": "application/json"},
+            idempotent=idempotent,
+            mutating=True,
         )
 
     def replace(
@@ -268,11 +311,14 @@ class KubeClient:
             data=json.dumps(body),
             headers={"Content-Type": "application/json"},
             deadline_s=deadline_s,
+            mutating=True,
             **kw,
         )
 
     def delete(self, path: str) -> dict:
-        return self._request_json("DELETE", path)
+        # Idempotent: a landed-then-retried DELETE answers 404, which
+        # every call site already treats as already-gone.
+        return self._request_json("DELETE", path, mutating=True)
 
     # -- nodes -------------------------------------------------------------
 
@@ -379,20 +425,31 @@ class KubeClient:
         with self._watch_lock:
             self._live_watches.add(resp)
         try:
+            truncated = None
             for line in resp.iter_lines():
                 if not line:
                     continue
                 try:
                     ev = json.loads(line)
                 except json.JSONDecodeError:
+                    # Mid-stream garbage is skippable; remember it so a
+                    # stream ENDING on an unparseable line — a partial
+                    # frame at connection death — surfaces as the drop
+                    # it is instead of a clean window expiry.
                     log.warning("unparseable watch line: %.120r", line)
+                    truncated = line
                     continue
+                truncated = None
                 etype = ev.get("type", "")
                 obj = ev.get("object", {})
                 if etype == "ERROR":
                     code = obj.get("code", 500)
                     raise KubeError(code, obj.get("message", "watch error"))
                 yield etype, obj
+            if truncated is not None:
+                raise ConnectionError(
+                    "watch stream died mid-event (truncated frame)"
+                )
         finally:
             with self._watch_lock:
                 self._live_watches.discard(resp)
@@ -450,11 +507,14 @@ class KubeClient:
             "lastTimestamp": now,
             "count": 1,
         }
+        # Events are additive telemetry: a landed-then-retried POST just
+        # double-counts one event — retry stays allowed.
         return self._request_json(
             "POST",
             f"/api/v1/namespaces/{namespace}/events",
             data=json.dumps(body),
             headers={"Content-Type": "application/json"},
+            mutating=True,
         )
 
     def evict_pod(self, namespace: str, name: str) -> dict:
@@ -469,8 +529,14 @@ class KubeClient:
             "metadata": {"name": name, "namespace": namespace},
         }
         try:
+            # idempotent=False: ONE attempt, no blind retry — a re-POST
+            # of an Eviction that actually landed can evict the pod's
+            # replacement. Transport failure surfaces immediately and
+            # the journaled preemption/defrag phase aborts-and-replans.
             return self.create(
-                f"/api/v1/namespaces/{namespace}/pods/{name}/eviction", body
+                f"/api/v1/namespaces/{namespace}/pods/{name}/eviction",
+                body,
+                idempotent=False,
             )
         except KubeError as e:
             if e.status_code == 404:
@@ -558,6 +624,7 @@ class KubeClient:
             f"/api/v1/namespaces/{namespace}/pods/{name}",
             data=json.dumps(ops),
             headers={"Content-Type": JSON_PATCH},
+            mutating=True,
         )
 
 

@@ -143,6 +143,13 @@ class DaemonConfig:
     # CRITICAL lock_order audit invariant with witness stacks at
     # /debug/lockdep. Always on in the test suite; flag-gated here.
     lockdep: bool = False
+    # Degraded-serving staleness cap (utils/resilience.DegradedMode):
+    # while the kube circuit breaker is open the controller serves its
+    # last-known-good view; past this many seconds of staleness the
+    # mode turns "paused" and side effects (eviction) stop until the
+    # apiserver recovers. docs/operations.md "Surviving an apiserver
+    # brownout".
+    staleness_cap_s: float = 60.0
 
 
 class Daemon:
@@ -297,8 +304,20 @@ class Daemon:
         if self.cfg.enable_controller or self.cfg.enable_dra:
             try:
                 from ..kube.client import KubeClient
+                from ..utils import metrics as tpumetrics
+                from ..utils import resilience as res_mod
 
                 self._kube_client = KubeClient.from_env(self.cfg.kubeconfig)
+                # Explicit degraded mode for the plugin's kube plane:
+                # flipped by the client's circuit breaker; the
+                # controller marks it fresh on every successful relist
+                # (staleness gauge + /debug/resilience evidence).
+                self._kube_client.resilience.degraded = res_mod.DegradedMode(
+                    staleness_cap_s=self.cfg.staleness_cap_s,
+                    name="plugin",
+                    gauge=tpumetrics.KUBE_DEGRADED_MODE,
+                    staleness_gauge=tpumetrics.KUBE_DEGRADED_STALENESS,
+                )
             except Exception as e:
                 log.warning("kube client unavailable pre-serve: %s", e)
         # One node fetch serves both label derivations — but only when a
@@ -517,6 +536,11 @@ class Daemon:
             self.controller, self._kube = start_kube_integration(
                 self, mesh, client=self._kube_client
             )
+            degraded = getattr(
+                self._kube.resilience, "degraded", None
+            )
+            if degraded is not None:
+                self.controller.degraded = degraded
         except Exception as e:  # pragma: no cover - env-dependent
             log.warning("kube integration disabled: %s", e)
             self.controller = None
@@ -781,6 +805,15 @@ def parse_args(argv) -> DaemonConfig:
                    "TPU_LOCKDEP=1): inversion cycles fire the "
                    "CRITICAL lock_order audit invariant with witness "
                    "stacks at /debug/lockdep")
+    p.add_argument("--staleness-cap-s", type=float,
+                   default=float(os.environ.get(
+                       "TPU_STALENESS_CAP_S", "60") or 60),
+                   help="degraded-serving staleness cap (also "
+                   "TPU_STALENESS_CAP_S): while the kube circuit "
+                   "breaker is open the controller serves its "
+                   "last-known-good node/pod view; past this many "
+                   "seconds of staleness side effects (eviction) "
+                   "pause until the apiserver recovers")
     p.add_argument("--log-json", action="store_true",
                    help="JSON-lines logging with trace correlation "
                    "(also TPU_LOG_JSON=1)")
@@ -836,6 +869,7 @@ def parse_args(argv) -> DaemonConfig:
         capture_dir=a.capture_dir,
         capture_p99_ms=a.capture_p99_ms,
         lockdep=a.lockdep,
+        staleness_cap_s=a.staleness_cap_s,
     )
 
 

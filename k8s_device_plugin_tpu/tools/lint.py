@@ -184,6 +184,17 @@ _SEEDS = [
         "    with tracing.span('extender.filter'):\n"
         "        pass\n",
     ),
+    (
+        "TPL010",
+        "def f(client):\n"
+        "    return client._attempt('GET', '/api/v1/pods')\n",
+        "class C:\n"
+        "    def _attempt(self, method, path):\n"
+        "        return self._session.request(method, path)\n"
+        "    def get(self, path):\n"
+        "        return self.resilience.call(\n"
+        "            lambda: self._attempt('GET', path))\n",
+    ),
 ]
 
 

@@ -326,6 +326,28 @@ KUBE_QUEUED_WRITES = REGISTRY.gauge(
     "State-publishing writes queued while the apiserver is unreachable "
     "(drained on reconnect; >0 for long = degraded mode)",
 )
+KUBE_CALL_OUTCOMES = REGISTRY.counter(
+    "tpu_plugin_kube_call_outcomes_total",
+    "Kube API call outcomes by verb and outcome (ok / retry / "
+    "retry_after / semantic / unavailable / circuit_open) — the "
+    "resilience layer's per-verb success/retry rate",
+)
+KUBE_DEGRADED_MODE = REGISTRY.gauge(
+    "tpu_plugin_kube_degraded_mode",
+    "1 while consumers run in explicit degraded mode (circuit breaker "
+    "open: serving last-known-good state, mutations failing fast)",
+)
+KUBE_DEGRADED_STALENESS = REGISTRY.gauge(
+    "tpu_plugin_kube_degraded_staleness_seconds",
+    "Age of the last successful cluster-state sync behind degraded "
+    "serving; past the staleness cap admission pauses",
+)
+KUBE_WATCH_STREAMS = REGISTRY.counter(
+    "tpu_plugin_kube_watch_streams_total",
+    "Watch stream recoveries by outcome: resumed (from bookmarked "
+    "resourceVersion after a drop) vs. relist (410 Gone forced a full "
+    "relist)",
+)
 # Observability plane (utils/tracing.py + utils/flightrecorder.py):
 # constant 0 unless --trace / TPU_TRACE enables it.
 TRACE_SPANS = REGISTRY.counter(
@@ -800,6 +822,30 @@ EXT_KUBE_REQUEST_LATENCY = EXTENDER_REGISTRY.histogram(
     "Wall latency of individual kube API request attempts, by verb and "
     "outcome",
 )
+EXT_KUBE_CALL_OUTCOMES = EXTENDER_REGISTRY.counter(
+    "tpu_extender_kube_call_outcomes_total",
+    "Kube API call outcomes by verb and outcome (ok / retry / "
+    "retry_after / semantic / unavailable / circuit_open) — the "
+    "resilience layer's per-verb success/retry rate",
+)
+EXT_KUBE_DEGRADED_MODE = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_kube_degraded_mode",
+    "1 while the extender serves in explicit degraded mode (circuit "
+    "breaker open: /filter and /prioritize answer from the "
+    "last-known-good index + peer-hold overlay)",
+)
+EXT_KUBE_DEGRADED_STALENESS = EXTENDER_REGISTRY.gauge(
+    "tpu_extender_kube_degraded_staleness_seconds",
+    "Age of the last successful cluster-state sync behind degraded "
+    "serving; past --staleness-cap-s admission pauses (filter answers "
+    "503) instead of placing on fiction",
+)
+EXT_KUBE_WATCH_STREAMS = EXTENDER_REGISTRY.counter(
+    "tpu_extender_kube_watch_streams_total",
+    "Node watch stream recoveries by outcome: resumed (from bookmarked "
+    "resourceVersion after a drop) vs. relist (410 Gone forced a full "
+    "relist)",
+)
 EXT_TRACE_SPANS = EXTENDER_REGISTRY.counter(
     "tpu_extender_trace_spans_total",
     "Trace spans recorded by this process's collector "
@@ -1057,6 +1103,14 @@ DEBUG_ENDPOINTS: Dict[str, str] = {
         "state, and the last round's outcome — per engine (one per "
         "shard admitter); enabled: false when defrag is not wired"
     ),
+    "/debug/resilience": (
+        "resilience-layer snapshot (utils/resilience.py TRACKER): "
+        "per-verb kube-call outcome counts, breaker open/close "
+        "windows, watch resume-vs-relist counts, Retry-After-honored "
+        "retries, degraded-mode state + staleness age, and the "
+        "mutation-while-open evidence list the degraded_consistency "
+        "audit invariant checks"
+    ),
 }
 
 # () -> dict readiness snapshot (extender/server.py ReadyStatus),
@@ -1070,6 +1124,13 @@ READYZ_PROVIDER = None
 # /debug/shards surface — tpu-doctor bundles collect it via
 # DEBUG_ENDPOINTS like every other registered surface.
 SHARD_PROVIDER = None
+
+# Optional () -> dict of EXTRA per-process resilience context (e.g. the
+# extender entrypoint adds the serving cache's degraded snapshot). The
+# /debug/resilience surface itself needs no wiring: it serves the
+# process-global utils/resilience.py TRACKER snapshot in both daemons,
+# enriched by this provider when one is installed.
+RESILIENCE_PROVIDER = None
 
 
 def debug_payload(path: str) -> Optional[bytes]:
@@ -1128,6 +1189,13 @@ def debug_payload(path: str) -> Optional[bytes]:
             from . import profiling
 
             return profiling.LOCKDEP.snapshot()
+        if parsed.path == "/debug/resilience":
+            from .resilience import TRACKER
+
+            snap = TRACKER.snapshot()
+            if RESILIENCE_PROVIDER is not None:
+                snap.update(RESILIENCE_PROVIDER())
+            return snap
         if parsed.path == "/debug/defrag":
             from ..extender import defrag
 

@@ -52,10 +52,47 @@ state-publishing patches that fail with ``UnavailableError`` are queued
 again, so a pod annotation computed during an outage is delivered, not
 dropped (tests/test_chaos.py asserts no annotation is lost across a
 watch-drop + 410 + 5xx-storm sequence).
+
+Hostile-apiserver extensions (ISSUE 16):
+
+* **Retry-After honoring**: a 429 or 503 carrying a ``Retry-After``
+  header (kube/client.py parses it onto ``KubeError.retry_after_s``)
+  is retried for IDEMPOTENT calls after at least the server-requested
+  delay (capped at ``RETRY_AFTER_CAP_S``) — the apiserver's explicit
+  load-shedding signal beats our own backoff guess. A 429 never counts
+  as a breaker failure (the apiserver is alive and answering). The one
+  deliberate exception: Eviction passes ``idempotent=False``, so its
+  PDB-blocked 429 surfaces to the caller's level-triggered retry
+  unchanged — blind-retrying an eviction could double-evict.
+* **Per-verb retry budgets**: each verb gets its own token bucket
+  (cloned from the shared template), so a LIST storm burning retries
+  cannot starve lease-renew (PUT) of its budget.
+* **Idempotency gating**: ``call(..., idempotent=False)`` disables
+  retries entirely (one attempt, still breaker-gated); mutating verbs
+  that ARE provably idempotent (lease renew CAS via resourceVersion,
+  guarded JSON-patch with a leading ``test`` op) keep their documented
+  retry justifications.
+* :class:`DegradedMode` — the consumer-facing registry a breaker-open
+  flips: /filter and /prioritize keep serving the last-known-good
+  index + peer-hold overlay while ``staleness_s()`` stays under the
+  cap; beyond the cap ``paused`` turns True and admission PAUSES
+  (placing pods on fiction is worse than not placing them).
+* :data:`TRACKER` — a process-global record of call outcomes, breaker
+  open/close windows, successful mutations, and watch
+  resume-vs-relist counts. ``/debug/resilience`` serves its snapshot,
+  and the ``degraded_consistency`` audit invariant (audit.py) proves
+  no mutation landed while the breaker was open.
+
+``python -m k8s_device_plugin_tpu.utils.resilience
+--resilience-self-test`` drives an in-module hostile apiserver through
+retry -> breaker trip -> degraded /filter -> recovery (scripts/tier1.sh
+runs it; ``--chaos-plan`` accepts the same JSON fault plans
+tests/fake_apiserver.py consumes).
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import random
@@ -70,8 +107,27 @@ log = get_logger(__name__)
 # is unhealthy rather than answering: retryable, breaker-counted.
 RETRYABLE_STATUS = frozenset({500, 502, 503, 504})
 
+# Upper bound on how long a server-sent Retry-After may park one call:
+# an apiserver (or an injected fault) asking for minutes must not eat a
+# caller's whole deadline — past the cap our own backoff shape resumes.
+RETRY_AFTER_CAP_S = 5.0
+
 # Circuit states, as exported by the *_kube_circuit_state gauge.
 CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+
+_STATE_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half-open"}
+
+
+def retry_after_of(exc: BaseException) -> Optional[float]:
+    """The server-requested retry delay carried by ``exc`` (KubeError
+    parses the ``Retry-After`` header), or None."""
+    v = getattr(exc, "retry_after_s", None)
+    if v is None:
+        return None
+    try:
+        return max(0.0, float(v))
+    except (TypeError, ValueError):
+        return None
 
 
 class UnavailableError(OSError):
@@ -248,6 +304,266 @@ class CircuitBreaker:
                 self._set_state(OPEN)
 
 
+class ResilienceTracker:
+    """Process-global record of what the resilience layer did — the
+    source of truth behind ``/debug/resilience`` and the
+    ``degraded_consistency`` audit invariant (audit.py).
+
+    Tracks, under one lock: per-(verb, outcome) call counts, breaker
+    open/close windows (wall-monotonic), every SUCCESSFUL mutating call
+    (timestamp + verb, bounded ring), watch stream outcomes
+    (resumed vs. relist), and any registered :class:`DegradedMode`
+    instances. ``mutations_while_open()`` is the invariant's evidence:
+    it must always be empty — a mutation landing while the breaker was
+    open means some call site bypassed the wrapper (TPL010's runtime
+    twin)."""
+
+    def __init__(
+        self,
+        max_mutations: int = 4096,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._outcomes: Dict[Tuple[str, str], int] = {}
+        self._mutations: "collections.deque" = collections.deque(
+            maxlen=max_mutations
+        )
+        # [open_ts, close_ts or None] — the live window has close None.
+        self._windows: List[List[Optional[float]]] = []
+        self._watch = {"resumed": 0, "relist": 0}
+        self._degraded: List["DegradedMode"] = []
+        self._retries_honoring_retry_after = 0
+
+    def reset(self) -> None:
+        """Tests only: a fresh slate between chaos scenarios."""
+        with self._lock:
+            self._outcomes.clear()
+            self._mutations.clear()
+            self._windows.clear()
+            self._watch = {"resumed": 0, "relist": 0}
+            self._degraded.clear()
+            self._retries_honoring_retry_after = 0
+
+    def record_outcome(self, verb: str, outcome: str) -> None:
+        with self._lock:
+            key = (verb or "call", outcome)
+            self._outcomes[key] = self._outcomes.get(key, 0) + 1
+
+    def record_retry_after(self) -> None:
+        with self._lock:
+            self._retries_honoring_retry_after += 1
+
+    def record_mutation(self, verb: str) -> None:
+        with self._lock:
+            self._mutations.append((self._clock(), verb or "call"))
+
+    def record_circuit(self, state: int) -> None:
+        with self._lock:
+            now = self._clock()
+            live = self._windows and self._windows[-1][1] is None
+            if state == OPEN and not live:
+                self._windows.append([now, None])
+            elif state == CLOSED and live:
+                self._windows[-1][1] = now
+            # HALF_OPEN keeps the current window: the probe phase is
+            # still "open" for the no-mutations contract.
+
+    def record_watch(self, outcome: str) -> None:
+        with self._lock:
+            if outcome in self._watch:
+                self._watch[outcome] += 1
+
+    def attach_degraded(self, dm: "DegradedMode") -> None:
+        with self._lock:
+            if dm not in self._degraded:
+                self._degraded.append(dm)
+
+    def breaker_open(self) -> bool:
+        with self._lock:
+            return bool(self._windows) and self._windows[-1][1] is None
+
+    def mutations_while_open(self) -> List[Tuple[float, str]]:
+        """Mutations whose success timestamp falls inside any breaker
+        open window — the degraded_consistency invariant's evidence
+        (always expected empty)."""
+        with self._lock:
+            windows = [list(w) for w in self._windows]
+            muts = list(self._mutations)
+        now = self._clock()
+        bad = []
+        for ts, verb in muts:
+            for opened, closed in windows:
+                if opened <= ts <= (closed if closed is not None else now):
+                    bad.append((ts, verb))
+                    break
+        return bad
+
+    def snapshot(self) -> dict:
+        """The /debug/resilience payload body (tracker part)."""
+        with self._lock:
+            now = self._clock()
+            outcomes: Dict[str, Dict[str, int]] = {}
+            for (verb, outcome), n in sorted(self._outcomes.items()):
+                outcomes.setdefault(verb, {})[outcome] = n
+            windows = [
+                {
+                    "opened_s_ago": round(now - o, 3),
+                    "closed_s_ago": (
+                        round(now - c, 3) if c is not None else None
+                    ),
+                }
+                for o, c in self._windows[-16:]
+            ]
+            degraded = [d.snapshot() for d in self._degraded]
+            mutations = len(self._mutations)
+        return {
+            "call_outcomes": outcomes,
+            "circuit_windows": windows,
+            "breaker_open": bool(windows) and (
+                windows[-1]["closed_s_ago"] is None
+            ),
+            "watch_streams": dict(self._watch),
+            "mutations_recorded": mutations,
+            "mutations_while_open": len(self.mutations_while_open()),
+            "retries_honoring_retry_after": (
+                self._retries_honoring_retry_after
+            ),
+            "degraded": degraded,
+        }
+
+
+#: The one tracker every Resilience instance reports into. Both
+#: daemons are separate processes, so a module-global is per-daemon.
+TRACKER = ResilienceTracker()
+
+
+class DegradedMode:
+    """Explicit consumer-facing degraded state, flipped by the circuit
+    breaker: while active, /filter and /prioritize keep serving the
+    last-known-good index + peer-hold overlay, and ``staleness_s()``
+    (age of the last successful sync, ``mark_fresh()``) is exported.
+    Beyond ``staleness_cap_s`` the mode turns ``paused`` — admission
+    stops rather than placing gangs on fiction; holds, leases, and the
+    journal keep their own tighter contracts.
+
+    Gang/preemption/defrag ticks consult ``paused`` before planning,
+    and the extender HTTP server turns paused /filter RPCs into 503s
+    (the scheduler retries; a 503 is honest, a stale placement is
+    not)."""
+
+    def __init__(
+        self,
+        staleness_cap_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+        name: str = "",
+        gauge=None,
+        staleness_gauge=None,
+        tracker: Optional[ResilienceTracker] = None,
+    ):
+        self.staleness_cap_s = staleness_cap_s
+        self.name = name or "kube"
+        self._clock = clock
+        self._gauge = gauge
+        self._staleness_gauge = staleness_gauge
+        self._lock = threading.Lock()
+        self._active = False
+        self._entered_at = 0.0
+        self._last_good = clock()
+        self._entries = 0
+        (tracker or TRACKER).attach_degraded(self)
+
+    def on_circuit_state(self, state: int) -> None:
+        """Breaker callback: OPEN enters degraded mode, CLOSED exits.
+        HALF_OPEN stays degraded — the probe hasn't proven anything."""
+        if state == OPEN:
+            self.enter("circuit_open")
+        elif state == CLOSED:
+            self.exit("circuit_closed")
+
+    def _transition(self, active: bool, reason: str) -> None:
+        from .flightrecorder import RECORDER
+        from .decisions import LEDGER
+
+        if self._gauge is not None:
+            self._gauge.set(1 if active else 0)
+        word = "entered" if active else "exited"
+        log.warning(
+            "%s consumers %s degraded mode (%s)", self.name, word, reason
+        )
+        RECORDER.record(
+            "degraded_mode",
+            f"{self.name} consumers {word} degraded mode",
+            state="degraded" if active else "normal",
+            reason=reason,
+        )
+        LEDGER.record(
+            "resilience",
+            f"degraded_{'enter' if active else 'exit'}",
+            f"{self.name} consumers {word} degraded mode ({reason})",
+        )
+
+    def enter(self, reason: str = "manual") -> None:
+        with self._lock:
+            if self._active:
+                return
+            self._active = True
+            self._entered_at = self._clock()
+            self._entries += 1
+        self._transition(True, reason)
+
+    def exit(self, reason: str = "manual") -> None:
+        with self._lock:
+            if not self._active:
+                return
+            self._active = False
+        self._transition(False, reason)
+
+    def mark_fresh(self) -> None:
+        """A successful sync of the consumer's view of cluster state
+        (relist, watch event applied) — resets the staleness clock."""
+        with self._lock:
+            self._last_good = self._clock()
+        if self._staleness_gauge is not None:
+            self._staleness_gauge.set(0.0)
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._active
+
+    def staleness_s(self) -> float:
+        with self._lock:
+            age = self._clock() - self._last_good
+        if self._staleness_gauge is not None:
+            self._staleness_gauge.set(round(age, 3))
+        return age
+
+    @property
+    def paused(self) -> bool:
+        """True when degraded AND the last-known-good view is older
+        than the cap: serving stops being better than not serving."""
+        return self.active and self.staleness_s() > self.staleness_cap_s
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            active = self._active
+            entered = self._entered_at
+            entries = self._entries
+            age = self._clock() - self._last_good
+        return {
+            "name": self.name,
+            "active": active,
+            "entries": entries,
+            "active_for_s": (
+                round(self._clock() - entered, 3) if active else 0.0
+            ),
+            "staleness_s": round(age, 3),
+            "staleness_cap_s": self.staleness_cap_s,
+            "paused": active and age > self.staleness_cap_s,
+        }
+
+
 @dataclasses.dataclass
 class RetryPolicy:
     """Per-call attempt/backoff/deadline envelope."""
@@ -271,6 +587,13 @@ class ResilienceMetrics:
     retries: object  # Metric counter, labeled by verb
     circuit_state: object  # Metric gauge
     latency: object  # Histogram, labeled by verb + outcome
+    # Counter labeled verb + outcome (ok / retry / retry_after /
+    # semantic / unavailable / circuit_open) — the Grafana "retry rate
+    # by verb/outcome" panel. None tolerated (older hand-built sets).
+    outcomes: object = None
+    degraded: object = None  # gauge: 1 while consumers run degraded
+    staleness: object = None  # gauge: degraded-serving staleness age
+    watch_streams: object = None  # counter labeled outcome
 
 
 def plugin_metrics() -> ResilienceMetrics:
@@ -280,6 +603,10 @@ def plugin_metrics() -> ResilienceMetrics:
         retries=metrics.KUBE_RETRIES,
         circuit_state=metrics.KUBE_CIRCUIT_STATE,
         latency=metrics.KUBE_REQUEST_LATENCY,
+        outcomes=metrics.KUBE_CALL_OUTCOMES,
+        degraded=metrics.KUBE_DEGRADED_MODE,
+        staleness=metrics.KUBE_DEGRADED_STALENESS,
+        watch_streams=metrics.KUBE_WATCH_STREAMS,
     )
 
 
@@ -290,6 +617,10 @@ def extender_metrics() -> ResilienceMetrics:
         retries=metrics.EXT_KUBE_RETRIES,
         circuit_state=metrics.EXT_KUBE_CIRCUIT_STATE,
         latency=metrics.EXT_KUBE_REQUEST_LATENCY,
+        outcomes=metrics.EXT_KUBE_CALL_OUTCOMES,
+        degraded=metrics.EXT_KUBE_DEGRADED_MODE,
+        staleness=metrics.EXT_KUBE_DEGRADED_STALENESS,
+        watch_streams=metrics.EXT_KUBE_WATCH_STREAMS,
     )
 
 
@@ -317,6 +648,8 @@ class Resilience:
         classify: Callable[[BaseException], bool] = retryable,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        degraded: Optional[DegradedMode] = None,
+        tracker: Optional[ResilienceTracker] = None,
     ):
         self.policy = policy or RetryPolicy()
         self.metrics = metrics if metrics is not None else plugin_metrics()
@@ -325,10 +658,32 @@ class Resilience:
         )
         if breaker is not None and breaker._on_state_change is None:
             breaker._on_state_change = self._on_circuit_change
+        # Template bucket: per-verb buckets below clone its shape, so a
+        # LIST retry storm can't starve lease-renew (PUT) of budget.
         self.budget = budget or RetryBudget()
+        self._verb_budgets: Dict[str, RetryBudget] = {}
+        self._budget_lock = threading.Lock()
         self.classify = classify
         self._clock = clock
         self._sleep = sleep
+        # Consumer-facing degraded state driven by this breaker
+        # (entrypoints wire one; None = nobody to flip).
+        self.degraded = degraded
+        self.tracker = tracker if tracker is not None else TRACKER
+
+    def _budget_for(self, verb: str) -> RetryBudget:
+        if not verb:
+            return self.budget
+        with self._budget_lock:
+            b = self._verb_budgets.get(verb)
+            if b is None:
+                b = RetryBudget(
+                    capacity=self.budget.capacity,
+                    refill_per_s=self.budget.refill_per_s,
+                    clock=self.budget._clock,
+                )
+                self._verb_budgets[verb] = b
+            return b
 
     def _on_circuit_change(self, state: int) -> None:
         """Gauge update plus flight-recorder capture: a circuit OPENING
@@ -337,15 +692,23 @@ class Resilience:
         ring is dumped to disk right then — a crash-looping daemon
         leaves its last moments behind even if SIGKILL follows."""
         self.metrics.circuit_state.set(state)
+        self.tracker.record_circuit(state)
         from .flightrecorder import RECORDER
+        from .decisions import LEDGER
 
         RECORDER.record(
             "circuit_state",
             "kube API circuit breaker state changed",
-            state={CLOSED: "closed", OPEN: "open", HALF_OPEN: "half-open"}[
-                state
-            ],
+            state=_STATE_NAMES[state],
         )
+        if state in (OPEN, CLOSED):
+            LEDGER.record(
+                "resilience",
+                "breaker_open" if state == OPEN else "breaker_close",
+                f"kube API circuit breaker {_STATE_NAMES[state]}",
+            )
+        if self.degraded is not None:
+            self.degraded.on_circuit_state(state)
         if state == OPEN and RECORDER.enabled and RECORDER.dump_dir:
             # This callback runs under the breaker's lock (the lock
             # every kube call takes in allow()/record_*): the disk
@@ -362,17 +725,34 @@ class Resilience:
                 daemon=True,
             ).start()
 
+    def _outcome(self, verb: str, outcome: str) -> None:
+        self.tracker.record_outcome(verb, outcome)
+        if self.metrics.outcomes is not None:
+            self.metrics.outcomes.inc(verb=verb or "call", outcome=outcome)
+
     def call(
         self,
         fn: Callable[[], object],
         verb: str = "",
         deadline_s: Optional[float] = None,
         max_attempts: Optional[int] = None,
+        idempotent: bool = True,
+        mutating: bool = False,
     ):
         """Run ``fn`` under the policy. Semantic errors (non-retryable)
         propagate unchanged on the first attempt; transport-level
         failures are retried with jittered backoff until attempts,
-        deadline, or the retry budget run out — then UnavailableError.
+        deadline, or the per-verb retry budget run out — then
+        UnavailableError.
+
+        ``idempotent=False`` marks a mutation that must NEVER blind-
+        retry (Eviction): one attempt, breaker-gated, every failure
+        surfaces to the caller. ``mutating=True`` records each SUCCESS
+        in :data:`TRACKER` so the ``degraded_consistency`` audit
+        invariant can prove no mutation landed while the breaker was
+        open. A 429/503 carrying Retry-After is (for idempotent calls)
+        retried no sooner than the server asked, capped at
+        ``RETRY_AFTER_CAP_S`` and the call deadline.
 
         When tracing is enabled AND this call runs inside an open span,
         the whole logical call (attempts + backoff sleeps) becomes a
@@ -386,12 +766,15 @@ class Resilience:
         if tracing.enabled() and tracing.current() is not None:
             with tracing.span(f"kube.{verb or 'call'}") as sp:
                 result = self._call_inner(
-                    fn, verb, deadline_s, max_attempts
+                    fn, verb, deadline_s, max_attempts, idempotent,
+                    mutating,
                 )
                 if sp is not None:
                     sp.set(outcome="ok")
                 return result
-        return self._call_inner(fn, verb, deadline_s, max_attempts)
+        return self._call_inner(
+            fn, verb, deadline_s, max_attempts, idempotent, mutating
+        )
 
     def _call_inner(
         self,
@@ -399,8 +782,11 @@ class Resilience:
         verb: str = "",
         deadline_s: Optional[float] = None,
         max_attempts: Optional[int] = None,
+        idempotent: bool = True,
+        mutating: bool = False,
     ):
         if not self.breaker.allow():
+            self._outcome(verb, "circuit_open")
             raise CircuitOpenError(
                 "kube API circuit open (recent calls failed at the "
                 "transport level); failing fast until the reset probe"
@@ -408,7 +794,14 @@ class Resilience:
         deadline = self._clock() + (
             self.policy.deadline_s if deadline_s is None else deadline_s
         )
-        attempts = max_attempts or self.policy.max_attempts
+        # Non-idempotent mutations get exactly ONE attempt: a transport
+        # error leaves "did it land?" unknown, and re-sending (e.g. an
+        # Eviction) could double-apply. The caller's level-triggered
+        # reconcile owns the retry.
+        attempts = (
+            1 if not idempotent
+            else (max_attempts or self.policy.max_attempts)
+        )
         last: Optional[BaseException] = None
         _ACTIVE.depth = getattr(_ACTIVE, "depth", 0) + 1
         try:
@@ -423,6 +816,27 @@ class Resilience:
                     if not self.classify(e):
                         # Semantic answer: the apiserver is alive.
                         self.breaker.record_success()
+                        ra = retry_after_of(e)
+                        if (
+                            ra is not None
+                            and getattr(e, "status_code", None) == 429
+                            and idempotent
+                            and attempt + 1 < attempts
+                        ):
+                            # Server-directed retry: the apiserver is
+                            # shedding load and told us when to come
+                            # back. Still budget- and deadline-gated.
+                            delay = min(ra, RETRY_AFTER_CAP_S)
+                            if (
+                                self._clock() + delay < deadline
+                                and self._budget_for(verb).try_spend()
+                            ):
+                                last = e
+                                self._retry_sleep(
+                                    verb, delay, "retry_after"
+                                )
+                                continue
+                        self._outcome(verb, "semantic")
                         raise
                     self.breaker.record_failure()
                     last = e
@@ -436,28 +850,63 @@ class Resilience:
                         self.policy.max_delay_s,
                         self.policy.jitter,
                     )
+                    ra = retry_after_of(e)
+                    if ra is not None:
+                        # A 503 with Retry-After: wait at least what
+                        # the server asked (capped), never less.
+                        delay = max(delay, min(ra, RETRY_AFTER_CAP_S))
                     if self._clock() + delay >= deadline:
                         break
-                    if not self.budget.try_spend():
+                    if not self._budget_for(verb).try_spend():
                         log.warning(
                             "kube retry budget exhausted; failing %s fast",
                             verb or "call",
                         )
+                        from .decisions import LEDGER
+
+                        LEDGER.record(
+                            "resilience",
+                            "retry_budget_exhausted",
+                            f"retry budget dry for {verb or 'call'}; "
+                            "failing fast",
+                        )
                         break
-                    self.metrics.retries.inc(verb=verb)
-                    self._sleep(delay)
+                    self._retry_sleep(verb, delay, "retry")
                 else:
                     self.metrics.latency.observe(
                         self._clock() - t0, verb=verb, outcome="ok"
                     )
                     self.breaker.record_success()
+                    self._outcome(verb, "ok")
+                    if mutating:
+                        self.tracker.record_mutation(verb)
                     return result
         finally:
             _ACTIVE.depth -= 1
+        self._outcome(verb, "unavailable")
         raise UnavailableError(
             f"kube API unavailable after {attempts} attempt(s) for "
             f"{verb or 'call'}: {last}"
         ) from last
+
+    def _retry_sleep(self, verb: str, delay: float, reason: str) -> None:
+        """One retry pause: counted (metrics + tracker), flight-
+        recorded (the ring is exactly where a retry storm's shape
+        matters post-mortem), then slept."""
+        from .flightrecorder import RECORDER
+
+        self.metrics.retries.inc(verb=verb)
+        self._outcome(verb, reason)
+        if reason == "retry_after":
+            self.tracker.record_retry_after()
+        RECORDER.record(
+            "kube_retry",
+            f"kube {verb or 'call'} retrying in {delay * 1000:.0f}ms",
+            verb=verb or "call",
+            reason=reason,
+            delay_ms=round(delay * 1000, 1),
+        )
+        self._sleep(delay)
 
 
 class PendingWrites:
@@ -541,3 +990,349 @@ class PendingWrites:
                 log.info("queued write delivered: %s", desc)
                 self._discard_entry(key, fn)
         return delivered, len(self)
+
+
+# ---------------------------------------------------------------------------
+# Self-test (scripts/tier1.sh): an in-module hostile apiserver drives
+# retry -> breaker trip -> degraded /filter -> recovery. The full
+# fault-injecting FakeApiServer lives in tests/fake_apiserver.py; this
+# one keeps the tier-1 smoke dependency-free (the sharding self-test's
+# idiom) while consuming the SAME chaos-plan JSON schema.
+# ---------------------------------------------------------------------------
+
+#: Default chaos plan for the self-test — the same {"faults": [...]}
+#: schema tests/fake_apiserver.py FaultInjector.load_plan() consumes
+#: (tests/chaos_plans/brownout.json is this plan on disk; the chaos
+#: suite replays it against the full fake apiserver).
+DEFAULT_CHAOS_PLAN = {
+    "name": "retry-then-brownout",
+    "faults": [
+        # One 429 with Retry-After: the honored server-directed retry.
+        {"kind": "status", "status": 429, "retry_after_s": 0.02,
+         "times": 1, "method": "GET"},
+        # A short 5xx burst: plain retryable failures.
+        {"kind": "status", "status": 503, "times": 2, "method": "GET"},
+        # Then the full brownout: every request dies at the transport
+        # level until the plan is cleared.
+        {"kind": "reset", "times": -1},
+    ],
+}
+
+
+def load_chaos_plan(path: str) -> dict:
+    """Read a ``--chaos-plan`` JSON file ({"faults": [fault-dicts]})."""
+    with open(path) as f:
+        plan = json.load(f)
+    if not isinstance(plan.get("faults"), list):
+        raise ValueError(f"chaos plan {path!r} has no 'faults' list")
+    return plan
+
+
+class _HostileApiServer:
+    """Just enough apiserver for the resilience smoke: node list/get
+    (with topology annotations) and node PATCH, behind a fault plan
+    implementing the {status, reset, delay} subset of the chaos-plan
+    schema (retry_after_s adds the Retry-After header)."""
+
+    def __init__(self, nodes: List[dict]):
+        import urllib.parse
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        self.nodes = {n["metadata"]["name"]: n for n in nodes}
+        self.node_patches: List[Tuple[str, dict]] = []
+        self._lock = threading.Lock()
+        self._faults: List[dict] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _json(self, obj, code=200, retry_after=None):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _fault(self, method: str) -> bool:
+                f = outer._pick(method, self.path)
+                if f is None:
+                    return False
+                kind = f.get("kind", "status")
+                if f.get("delay_s"):
+                    time.sleep(float(f["delay_s"]))
+                if kind == "delay":
+                    return False
+                if kind == "reset":
+                    import socket as socket_mod
+                    import struct
+
+                    try:
+                        self.connection.setsockopt(
+                            socket_mod.SOL_SOCKET,
+                            socket_mod.SO_LINGER,
+                            struct.pack("ii", 1, 0),
+                        )
+                        self.connection.close()
+                    except OSError:
+                        pass
+                    self.close_connection = True
+                    return True
+                status = int(f.get("status", 500))
+                self._json(
+                    {"message": "injected", "code": status},
+                    status,
+                    retry_after=f.get("retry_after_s"),
+                )
+                return True
+
+            def do_GET(self):
+                if self._fault("GET"):
+                    return
+                path = urllib.parse.urlparse(self.path).path
+                if path == "/api/v1/nodes":
+                    with outer._lock:
+                        items = list(outer.nodes.values())
+                    self._json({"kind": "NodeList", "items": items})
+                elif path.startswith("/api/v1/nodes/"):
+                    name = path.rsplit("/", 1)[1]
+                    with outer._lock:
+                        node = outer.nodes.get(name)
+                    if node is None:
+                        self._json({"message": "not found"}, 404)
+                    else:
+                        self._json(node)
+                else:
+                    self._json({"message": "not found"}, 404)
+
+            def do_PATCH(self):
+                if self._fault("PATCH"):
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                path = urllib.parse.urlparse(self.path).path
+                if path.startswith("/api/v1/nodes/"):
+                    name = path.rsplit("/", 1)[1]
+                    with outer._lock:
+                        node = outer.nodes.get(name)
+                        if node is None:
+                            self._json({"message": "not found"}, 404)
+                            return
+                        ann = (body.get("metadata") or {}).get(
+                            "annotations"
+                        ) or {}
+                        node["metadata"].setdefault(
+                            "annotations", {}
+                        ).update(
+                            {k: v for k, v in ann.items() if v is not None}
+                        )
+                        outer.node_patches.append((name, body))
+                    self._json(node)
+                else:
+                    self._json({"message": "not found"}, 404)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        # self-test-scoped, joined in stop()  # tpu-lint: disable=TPL001
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    def start(self) -> str:
+        self._thread.start()
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def load_plan(self, plan: dict) -> None:
+        with self._lock:
+            self._faults = [dict(f) for f in plan.get("faults", [])]
+
+    def clear_faults(self) -> None:
+        with self._lock:
+            self._faults = []
+
+    def _pick(self, method: str, path: str) -> Optional[dict]:
+        import re as _re
+
+        with self._lock:
+            for f in self._faults:
+                if f.get("times", 1) == 0:
+                    continue
+                if f.get("method") and f["method"] != method:
+                    continue
+                if f.get("path_re") and not _re.search(
+                    f["path_re"], path
+                ):
+                    continue
+                if f.get("times", 1) > 0:
+                    f["times"] -= 1
+                return f
+        return None
+
+
+def self_test(chaos_plan: Optional[dict] = None) -> int:
+    """Tier-1 smoke (scripts/tier1.sh): the hostile apiserver above
+    runs the chaos plan against a real KubeClient + Resilience +
+    DegradedMode + node cache + TopologyExtender chain and proves:
+    retries honor Retry-After, the breaker trips into fail-fast, a
+    degraded /filter keeps serving last-known-good under the staleness
+    cap, ZERO mutations land while the breaker is open, and recovery
+    closes the loop (probe -> closed -> degraded exit)."""
+    from ..extender.index import TopologyIndex
+    from ..extender.scale_bench import _node, _plain_pod
+    from ..extender.server import NodeAnnotationCache, TopologyExtender
+    from ..kube.client import KubeClient, KubeError
+
+    plan = chaos_plan or DEFAULT_CHAOS_PLAN
+    TRACKER.reset()
+    failures: List[str] = []
+    nodes = [_node(f"rz-node-{i}") for i in range(4)]
+    names = [n["metadata"]["name"] for n in nodes]
+    server = _HostileApiServer(nodes)
+    base_url = server.start()
+    try:
+        degraded = DegradedMode(staleness_cap_s=30.0, name="selftest")
+        res = Resilience(
+            policy=RetryPolicy(
+                max_attempts=3,
+                base_delay_s=0.01,
+                max_delay_s=0.05,
+                deadline_s=2.0,
+            ),
+            breaker=CircuitBreaker(
+                failure_threshold=3, reset_timeout_s=0.2
+            ),
+            metrics=extender_metrics(),
+            degraded=degraded,
+        )
+        client = KubeClient(base_url, resilience=res)
+        cache = NodeAnnotationCache(client, interval_s=3600)
+        cache.index = TopologyIndex()
+        cache.refresh()
+        degraded.mark_fresh()
+        ext = TopologyExtender(node_cache=cache)
+
+        # Phase 0: healthy — /filter serves, a mutation lands.
+        out = ext.filter_names(_plain_pod(chips=2), names)
+        if not out or len(out[0]) != len(names):
+            failures.append(f"healthy /filter wrong: {out!r}")
+        client.patch_node_annotations(names[0], {"rz-selftest": "1"})
+        if len(server.node_patches) != 1:
+            failures.append("healthy mutation did not land")
+
+        # Phase 1: the chaos plan — Retry-After'd 429, a 5xx burst,
+        # then a full brownout; the breaker must trip.
+        server.load_plan(plan)
+        tripped = False
+        for _ in range(12):
+            try:
+                client.list_nodes()
+            except CircuitOpenError:
+                tripped = True
+                break
+            except (KubeError, OSError):
+                continue
+        if not tripped:
+            failures.append("breaker never tripped during brownout")
+        if not degraded.active:
+            failures.append("degraded mode did not follow breaker open")
+        snap = TRACKER.snapshot()
+        if snap["retries_honoring_retry_after"] < 1:
+            failures.append(
+                f"Retry-After was not honored: {snap['call_outcomes']}"
+            )
+
+        # Phase 2: degraded serving — /filter still answers from the
+        # last-known-good index, inside the staleness cap; mutations
+        # fail FAST and none reach the server.
+        out = ext.filter_names(_plain_pod(chips=2), names)
+        if not out or len(out[0]) != len(names):
+            failures.append(f"degraded /filter wrong: {out!r}")
+        if degraded.paused:
+            failures.append("paused before the staleness cap")
+        try:
+            client.patch_node_annotations(names[0], {"rz-selftest": "2"})
+            failures.append("mutation succeeded while breaker open")
+        except OSError:
+            pass
+        if len(server.node_patches) != 1:
+            failures.append("mutation reached the apiserver while open")
+        if TRACKER.mutations_while_open():
+            failures.append(
+                f"mutations recorded while open: "
+                f"{TRACKER.mutations_while_open()}"
+            )
+
+        # Phase 3: recovery — faults cleared, probe closes the breaker,
+        # degraded mode exits, staleness resets.
+        server.clear_faults()
+        deadline = time.monotonic() + 5.0
+        recovered = False
+        while time.monotonic() < deadline:
+            try:
+                client.list_nodes()
+                recovered = True
+                break
+            except OSError:
+                time.sleep(0.05)
+        if not recovered:
+            failures.append("apiserver never recovered for the probe")
+        if res.breaker.state != CLOSED:
+            failures.append(f"breaker not closed: {res.breaker.state}")
+        if degraded.active:
+            failures.append("degraded mode did not exit on recovery")
+        cache.refresh()
+        degraded.mark_fresh()
+        if degraded.staleness_s() > 1.0:
+            failures.append("staleness did not reset after recovery")
+    finally:
+        server.stop()
+    if failures:
+        for f in failures:
+            print(f"resilience self-test FAILED: {f}")
+        return 1
+    print(json.dumps({
+        "resilience_self_test": "ok",
+        "plan": plan.get("name", "inline"),
+        "outcomes": TRACKER.snapshot()["call_outcomes"],
+    }))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m k8s_device_plugin_tpu.utils.resilience"
+    )
+    ap.add_argument(
+        "--resilience-self-test", action="store_true",
+        help="drive the in-module hostile apiserver through retry -> "
+             "trip -> degraded /filter -> recover",
+    )
+    ap.add_argument(
+        "--chaos-plan", default="",
+        help="JSON fault plan ({'faults': [...]} — the "
+             "tests/fake_apiserver.py schema); default: the embedded "
+             "retry-then-brownout plan",
+    )
+    a = ap.parse_args(argv)
+    if a.resilience_self_test:
+        plan = load_chaos_plan(a.chaos_plan) if a.chaos_plan else None
+        return self_test(plan)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

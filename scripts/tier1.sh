@@ -94,6 +94,18 @@ python -m k8s_device_plugin_tpu.extender.preemption --self-test > /dev/null \
 # and the 1,000-node acceptance e2e in tests/test_defrag.py.
 python -m k8s_device_plugin_tpu.extender.defrag --self-test > /dev/null \
   || { echo "extender/defrag.py --self-test FAILED"; exit 1; }
+# Apiserver-resilience smoke: drive the unified retry/backoff/breaker
+# pipeline against an in-process hostile apiserver running the SAME
+# chaos plan tests/test_chaos_apiserver.py loads (429+Retry-After
+# honored, 5xx burst absorbed, brownout trips the breaker and enters
+# degraded mode, recovery closes it and exits degraded, and ZERO
+# mutations land while the breaker is open — the degraded_consistency
+# evidence); a resilience-layer plumbing drift fails CI here, before
+# the chaos matrix in tests/test_chaos*.py (utils/resilience.py
+# --resilience-self-test).
+python -m k8s_device_plugin_tpu.utils.resilience --resilience-self-test \
+  --chaos-plan tests/chaos_plans/brownout.json > /dev/null \
+  || { echo "utils/resilience.py --resilience-self-test FAILED"; exit 1; }
 # Static-analysis engine smoke: every tpu-lint rule must detect its
 # embedded seeded violation (and stay quiet on the clean twin), the
 # registry scanner's inventories must be non-empty, and the static

@@ -121,3 +121,17 @@ def fresh_reservation_table():
 
     DEFAULT_TABLE.clear()
     yield
+
+
+@pytest.fixture(autouse=True)
+def fresh_resilience_tracker():
+    """The process-global resilience TRACKER mirrors production's
+    one-breaker-per-process shape, but the suite builds hundreds of
+    independent Resilience instances against it: a test that ends with
+    its breaker OPEN leaves the circuit window dangling forever, and
+    every later test's perfectly-wrapped mutation would be flagged by
+    the degraded_consistency invariant."""
+    from k8s_device_plugin_tpu.utils.resilience import TRACKER
+
+    TRACKER.reset()
+    yield

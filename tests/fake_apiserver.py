@@ -60,7 +60,21 @@ class Fault:
     URL path), ``token`` (substring of the Authorization header — lets
     a test partition ONE client by its bearer token). ``times`` > 0
     consumes the rule per matched request; -1 = until ``clear()``.
-    Watch kinds only match watch requests; other kinds match any."""
+    Watch kinds only match watch requests; other kinds match any.
+
+    Hostile-apiserver extensions (chaos plane):
+
+    - ``retry_after_s`` > 0 on a ``status`` fault adds a ``Retry-After``
+      header (429/503 flow control — the resilience layer must honor
+      it);
+    - ``duration_s`` > 0 turns the rule into a **window**: it activates
+      at its first match and expires ``duration_s`` wall seconds later
+      (combine with ``times=-1`` + ``kind="reset"`` for a full brownout
+      — see :meth:`FaultInjector.brownout`);
+    - ``after_events`` > 0 on a ``watch_drop`` streams that many REAL
+      events first, then drops mid-line — a disconnect after progress,
+      so resume-from-bookmark paths are exercised with a non-empty
+      resourceVersion."""
 
     kind: str = "status"
     status: int = 500
@@ -70,6 +84,12 @@ class Fault:
     token: str = ""
     delay_s: float = 0.0
     message: str = "injected fault"
+    retry_after_s: float = 0.0
+    duration_s: float = 0.0
+    after_events: int = 0
+    # Monotonic timestamp of the first match (duration_s windows);
+    # set by FaultInjector.pick, not by callers.
+    activated_at: Optional[float] = None
 
 
 class FaultInjector:
@@ -87,6 +107,36 @@ class FaultInjector:
             self.rules.append(fault)
         return fault
 
+    def brownout(self, duration_s: float, token: str = "") -> Fault:
+        """Full apiserver brownout: EVERY request (any verb, any path)
+        gets a connection reset for ``duration_s`` wall seconds from
+        the first matched request, then the window expires and the
+        server recovers on its own — the chaos e2e's 30 s outage."""
+        return self.add(
+            kind="reset", times=-1, duration_s=duration_s, token=token
+        )
+
+    def load_plan(self, plan: dict) -> List[Fault]:
+        """Install the rules of a chaos-plan dict (the ``--chaos-plan``
+        JSON shape shared with utils/resilience.py's self-test:
+        ``{"name": ..., "faults": [{kind, status, times, method,
+        path_re, token, delay_s, retry_after_s, duration_s,
+        after_events, message}, ...]}``). Unknown keys are rejected so
+        a typo'd plan fails loudly instead of silently not injecting."""
+        allowed = {f.name for f in dataclasses.fields(Fault)} - {
+            "activated_at"
+        }
+        added = []
+        for spec in plan.get("faults", []):
+            unknown = set(spec) - allowed
+            if unknown:
+                raise ValueError(
+                    f"chaos plan {plan.get('name', '?')!r}: unknown "
+                    f"fault keys {sorted(unknown)}"
+                )
+            added.append(self.add(**spec))
+        return added
+
     def clear(self) -> None:
         with self._lock:
             self.rules.clear()
@@ -100,9 +150,19 @@ class FaultInjector:
     def pick(
         self, method: str, path: str, auth: str, watch: bool
     ) -> Optional[Fault]:
+        now = time.monotonic()
         with self._lock:
             for f in self.rules:
                 if f.times == 0:
+                    continue
+                if (
+                    f.duration_s > 0
+                    and f.activated_at is not None
+                    and now - f.activated_at > f.duration_s
+                ):
+                    # Window expired — retire the rule so the server
+                    # recovers without the test having to clear().
+                    f.times = 0
                     continue
                 if f.method and f.method != method:
                     continue
@@ -112,6 +172,8 @@ class FaultInjector:
                     continue
                 if f.token and f.token not in (auth or ""):
                     continue
+                if f.duration_s > 0 and f.activated_at is None:
+                    f.activated_at = now
                 if f.times > 0:
                     f.times -= 1
                 self.injected.append((f.kind, method, path))
@@ -157,10 +219,18 @@ class FakeApiServer:
         self._leases: Dict[Tuple[str, str], dict] = {}
         # Scriptable fault injection (see Fault above).
         self.faults = FaultInjector()
+        # (method, path) of EVERY request seen (faulted or served) —
+        # lets chaos tests count relists vs. watch resumes and prove
+        # "exactly one LIST after the 410" style invariants.
+        self.requests: List[Tuple[str, str]] = []
         self._watchers: List["queue.Queue"] = []
         # (rv, event) log so watches replay from a resourceVersion like the
         # real API server does.
         self._event_log: List[Tuple[int, dict]] = []
+        # Node watch plane (the extender's annotation cache watches
+        # /api/v1/nodes): separate log + watcher registry from pods.
+        self._node_watchers: List["queue.Queue"] = []
+        self._node_event_log: List[Tuple[int, dict]] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -176,9 +246,14 @@ class FakeApiServer:
 
     def add_node(self, name: str, node: Optional[dict] = None):
         with self._lock:
-            self.nodes[name] = node or {
+            node = node or {
                 "metadata": {"name": name, "annotations": {}, "labels": {}}
             }
+            node.setdefault("metadata", {})[
+                "resourceVersion"
+            ] = self._next_rv()
+            self.nodes[name] = node
+            self._broadcast_node("ADDED", node)
 
     def add_pod(self, pod: dict, event: str = "ADDED"):
         meta = pod.setdefault("metadata", {})
@@ -224,6 +299,14 @@ class FakeApiServer:
         for q in list(self._watchers):
             q.put(ev)
 
+    def _broadcast_node(self, etype: str, node: dict):
+        ev = {"type": etype, "object": node}
+        self._node_event_log.append(
+            (int(node["metadata"]["resourceVersion"]), ev)
+        )
+        for q in list(self._node_watchers):
+            q.put(ev)
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> str:
@@ -252,9 +335,13 @@ class FakeApiServer:
                         return
                     server._handle_resource_get(self, parsed.path)
                 elif parsed.path == "/api/v1/nodes":
+                    if params.get("watch") == "true":
+                        server._handle_watch(self, params, resource="nodes")
+                        return
                     selector = params.get("labelSelector", "")
                     with server._lock:
                         items = list(server.nodes.values())
+                        rv = str(server._rv)
                     # Equality selectors only (all KubeClient emits).
                     for term in filter(None, selector.split(",")):
                         if "=" in term:
@@ -265,7 +352,12 @@ class FakeApiServer:
                                     or {}).get(k) == v
                             ]
                     server._send_json(
-                        self, {"kind": "NodeList", "items": items}
+                        self,
+                        {
+                            "kind": "NodeList",
+                            "metadata": {"resourceVersion": rv},
+                            "items": items,
+                        },
                     )
                 elif parsed.path.startswith("/api/v1/nodes/"):
                     name = parsed.path[len("/api/v1/nodes/"):]
@@ -544,7 +636,7 @@ class FakeApiServer:
         return f"http://{host}:{port}"
 
     def stop(self):
-        for q in list(self._watchers):
+        for q in list(self._watchers) + list(self._node_watchers):
             q.put(None)
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -560,6 +652,10 @@ class FakeApiServer:
         truncation/watch flag set on the handler)."""
         parsed = urllib.parse.urlparse(handler.path)
         params = dict(urllib.parse.parse_qsl(parsed.query))
+        with self._lock:
+            # Full path WITH query string, so tests can tell a relist
+            # (GET /api/v1/nodes) from a watch (…?watch=true…).
+            self.requests.append((method, handler.path))
         fault = self.faults.pick(
             method,
             parsed.path,
@@ -579,10 +675,17 @@ class FakeApiServer:
             handler._watch_fault = fault
             return False
         if fault.kind == "status":
+            headers = None
+            if fault.retry_after_s > 0:
+                # A real apiserver sends integer seconds; the client
+                # parses float, and fractional values keep compressed-
+                # time chaos tests fast — so send the value verbatim.
+                headers = {"Retry-After": f"{fault.retry_after_s:g}"}
             self._send_json(
                 handler,
                 {"message": fault.message, "code": fault.status},
                 fault.status,
+                headers=headers,
             )
             return True
         if fault.kind in ("reset", "hang"):
@@ -606,7 +709,7 @@ class FakeApiServer:
 
     # -- handlers ----------------------------------------------------------
 
-    def _send_json(self, handler, obj, code=200):
+    def _send_json(self, handler, obj, code=200, headers=None):
         data = json.dumps(obj).encode()
         if getattr(handler, "_truncate_body", False):
             # Injected truncation: Content-Length matches the cut body,
@@ -616,6 +719,8 @@ class FakeApiServer:
             data = data[: max(1, len(data) // 2)]
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            handler.send_header(k, v)
         handler.send_header("Content-Length", str(len(data)))
         handler.end_headers()
         handler.wfile.write(data)
@@ -667,43 +772,56 @@ class FakeApiServer:
             },
         )
 
-    def _handle_watch(self, handler, params):
+    def _handle_watch(self, handler, params, resource="pods"):
         fault = getattr(handler, "_watch_fault", None)
+        drop_after = 0
         if fault is not None:
             handler._watch_fault = None
-            handler.send_response(200)
-            handler.send_header("Content-Type", "application/json")
-            handler.end_headers()
-            if fault.kind == "watch_410":
-                # Stale resourceVersion: the ERROR event shape a real
-                # apiserver streams before ending the watch.
-                handler.wfile.write(
-                    json.dumps(
-                        {
-                            "type": "ERROR",
-                            "object": {
-                                "kind": "Status",
-                                "code": 410,
-                                "message": "too old resource version "
-                                           "(injected)",
-                            },
-                        }
-                    ).encode()
-                    + b"\n"
-                )
-            else:  # watch_drop: half an event line, then the stream dies
-                handler.wfile.write(b'{"type":"MODIF')
-            handler.wfile.flush()
-            return
+            if fault.kind == "watch_drop" and fault.after_events > 0:
+                # Stream that many REAL events first, then drop — the
+                # client has made progress (has a resourceVersion to
+                # resume from) when the disconnect hits.
+                drop_after = fault.after_events
+            else:
+                handler.send_response(200)
+                handler.send_header("Content-Type", "application/json")
+                handler.end_headers()
+                if fault.kind == "watch_410":
+                    # Stale resourceVersion: the ERROR event shape a
+                    # real apiserver streams before ending the watch.
+                    handler.wfile.write(
+                        json.dumps(
+                            {
+                                "type": "ERROR",
+                                "object": {
+                                    "kind": "Status",
+                                    "code": 410,
+                                    "message": "too old resource "
+                                               "version (injected)",
+                                },
+                            }
+                        ).encode()
+                        + b"\n"
+                    )
+                else:  # watch_drop: half an event line, stream dies
+                    handler.wfile.write(b'{"type":"MODIF')
+                handler.wfile.flush()
+                return
         q: "queue.Queue" = queue.Queue()
+        event_log = (
+            self._node_event_log if resource == "nodes" else self._event_log
+        )
+        watchers = (
+            self._node_watchers if resource == "nodes" else self._watchers
+        )
         since = int(params.get("resourceVersion", 0) or 0)
         with self._lock:
             # Replay events newer than the caller's resourceVersion, then
             # register for live ones — atomically, so none are lost.
-            for rv, ev in self._event_log:
+            for rv, ev in event_log:
                 if rv > since:
                     q.put(ev)
-            self._watchers.append(q)
+            watchers.append(q)
         try:
             handler.send_response(200)
             handler.send_header("Content-Type", "application/json")
@@ -722,10 +840,16 @@ class FakeApiServer:
                     return
                 handler.wfile.write(json.dumps(ev).encode() + b"\n")
                 handler.wfile.flush()
+                if drop_after > 0:
+                    drop_after -= 1
+                    if drop_after == 0:
+                        handler.wfile.write(b'{"type":"MODIF')
+                        handler.wfile.flush()
+                        return
         except (BrokenPipeError, ConnectionResetError):
             pass
         finally:
-            self._watchers.remove(q)
+            watchers.remove(q)
 
     def _handle_resource_group(self, handler):
         """APIGroup discovery for /apis/resource.k8s.io — what real
@@ -946,5 +1070,7 @@ class FakeApiServer:
             meta = body.get("metadata", {})
             self._merge_annotations(node["metadata"], meta, "annotations")
             self._merge_annotations(node["metadata"], meta, "labels")
+            node["metadata"]["resourceVersion"] = self._next_rv()
             self.node_patches.append((name, body))
+            self._broadcast_node("MODIFIED", node)
         self._send_json(handler, node)

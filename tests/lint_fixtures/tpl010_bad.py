@@ -1,0 +1,14 @@
+"""TPL010 seeded violation: raw apiserver transport hops outside the
+resilience wrapper. Parsed by the lint engine, never imported
+(tests/lint_fixtures/README.md) — the stand-in ``client`` carries the
+real attribute names the rule matches on."""
+
+
+def sneaky_get(client):
+    return client._attempt("GET", "/api/v1/pods")  # LINT-EXPECT: TPL010
+
+
+def sneakier_get(client):
+    return client._session.request(  # LINT-EXPECT: TPL010
+        "GET", "https://apiserver/api/v1/nodes"
+    )

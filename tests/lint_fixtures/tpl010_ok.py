@@ -1,0 +1,21 @@
+"""TPL010 clean twin: the sanctioned KubeClient wrapper shape — the
+single raw transport hop lives inside ``_attempt`` and every consumer
+reaches it through ``self.resilience.call`` (deadline, retry budget,
+Retry-After, breaker, outcome metric)."""
+
+
+class Client:
+    def __init__(self):
+        self._session = None
+        self.resilience = None
+
+    def _attempt(self, method, path):
+        # The one sanctioned raw hop: the wrapper's own transport.
+        return self._session.request(method, path)
+
+    def get(self, path):
+        return self.resilience.call(
+            lambda: self._attempt("GET", path),
+            verb="get",
+            path=path,
+        )

@@ -403,6 +403,7 @@ def test_e2e_clean_cluster_zero_findings_across_two_sweeps(node_stack):
         "checkpoint_vs_podresources", "annotation_vs_kubelet",
         "attribution_vs_kubelet", "gauge_vs_state", "orphaned_chip",
         "thread_liveness", "lock_order", "loop_inventory",
+        "degraded_consistency",
     }
 
 
@@ -672,7 +673,7 @@ def test_extender_clean_and_leaked_reservation(extender_stack):
         "reservation_vs_journal", "defrag_vs_reservations",
         "reservation_vs_cluster",
         "gate_vs_hold", "placeable_recount", "thread_liveness",
-        "lock_order", "loop_inventory",
+        "lock_order", "loop_inventory", "degraded_consistency",
     }
     # A hold for a gang with no pods anywhere = leaked reservation.
     s["reservations"].reserve(

@@ -1,0 +1,403 @@
+"""Apiserver fault-plan and watch-resume chaos matrix (ISSUE 16).
+
+Complements tests/test_chaos.py (resilience-layer unit behavior) with
+the scenario matrix the hostile-apiserver plane exists for:
+
+- the shared ``--chaos-plan`` JSON loads into the fake apiserver's
+  injector AND the resilience self-test's loader (one plan, two
+  consumers), and a typo'd plan fails loudly;
+- a dropped node watch stream resumes from the bookmarked
+  resourceVersion with ZERO missed events and ZERO relists, while a
+  410 Gone triggers exactly ONE relist with no duplicated rebuilds;
+- an apiserver brownout during a sharded takeover window keeps the
+  peer-hold overlay fenced and defers the takeover decision until the
+  lease is readable again;
+- lease renewals jitter per replica (no fleet lockstep against a
+  recovering apiserver);
+- the compressed end-to-end brownout: breaker opens, degraded mode
+  enters, zero mutations land while open, the lease holder does NOT
+  prematurely self-demote, and the ``degraded_consistency`` audit is
+  clean after recovery.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from k8s_device_plugin_tpu import audit
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.discovery.scanner import PyTpuInfo
+from k8s_device_plugin_tpu.extender.leader import LeaderLease
+from k8s_device_plugin_tpu.extender.server import NodeAnnotationCache
+from k8s_device_plugin_tpu.kube.client import KubeClient
+from k8s_device_plugin_tpu.server.plugin import PluginConfig, TpuDevicePlugin
+from k8s_device_plugin_tpu.topology.mesh import IciMesh
+from k8s_device_plugin_tpu.utils import resilience as rz
+from tests import fakes
+from tests.fake_apiserver import FakeApiServer, FaultInjector
+from tests.test_chaos import fast_resilience
+from tests.test_controller import (
+    NODE,
+    make_controller,
+    pod_dict,
+    wait_for,
+    write_checkpoint,
+)
+from tests.test_extender import make_node
+from tests.test_sharding import _manager
+
+PLAN_PATH = os.path.join(
+    os.path.dirname(__file__), "chaos_plans", "brownout.json"
+)
+
+
+@pytest.fixture
+def api():
+    s = FakeApiServer()
+    url = s.start()
+    s.add_node(NODE)
+    yield s, KubeClient(url)
+    s.stop()
+
+
+@pytest.fixture
+def plugin(tmp_path):
+    accel, dev = fakes.make_fake_tpu_node(str(tmp_path), "v5p", 4)
+    chips = PyTpuInfo().scan(accel, dev)
+    return TpuDevicePlugin(
+        IciMesh(chips), config=PluginConfig(libtpu_host_path="")
+    )
+
+
+def _node_lists(server):
+    """LIST requests against /api/v1/nodes (watch requests excluded)."""
+    return [
+        (m, p)
+        for m, p in server.requests
+        if m == "GET"
+        and p.split("?")[0] == "/api/v1/nodes"
+        and "watch=true" not in p
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Chaos-plan JSON: one plan, two consumers
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_plan_loads_into_injector_and_self_test_loader():
+    """tests/chaos_plans/brownout.json is the SAME file scripts/tier1.sh
+    feeds --resilience-self-test: both loaders must accept it."""
+    plan = rz.load_chaos_plan(PLAN_PATH)
+    assert plan["name"] == "retry-then-brownout"
+    inj = FaultInjector()
+    added = inj.load_plan(plan)
+    assert [f.kind for f in added] == ["status", "status", "reset"]
+    assert added[0].status == 429 and added[0].retry_after_s > 0
+    assert added[1].status == 503 and added[1].times == 2
+    assert added[2].times == -1  # the brownout runs until cleared
+
+
+def test_chaos_plan_with_unknown_fault_key_fails_loudly():
+    inj = FaultInjector()
+    with pytest.raises(ValueError, match="unknown fault keys"):
+        inj.load_plan(
+            {"name": "typo", "faults": [{"knid": "status"}]}
+        )
+    assert inj.rules == []  # nothing half-installed
+
+
+def test_chaos_plan_faults_actually_fire(api):
+    """The loaded plan drives a real client: the 429's Retry-After is
+    honored, the 503 burst is absorbed, and after clearing the
+    brownout rule the server recovers."""
+    server, client = api
+    client.resilience = fast_resilience(max_attempts=5, deadline_s=5.0)
+    server.faults.load_plan(rz.load_chaos_plan(PLAN_PATH))
+    honored = rz.TRACKER.snapshot()["retries_honoring_retry_after"]
+    # 429 + two 503s absorbed; the 4th attempt hits the reset wall —
+    # clear it mid-flight so the retry envelope wins.
+    server.faults.rules[-1].times = 1
+    node = client.get_node(NODE)
+    assert node["metadata"]["name"] == NODE
+    assert (
+        rz.TRACKER.snapshot()["retries_honoring_retry_after"]
+        == honored + 1
+    )
+    assert server.faults.count("status") == 3
+    assert server.faults.count("reset") == 1
+
+
+# ---------------------------------------------------------------------------
+# Watch-resume matrix: drop → bookmark resume; 410 → single relist
+# ---------------------------------------------------------------------------
+
+
+def test_watch_drop_resumes_from_bookmark_with_zero_missed_events(api):
+    server, client = api
+    client.resilience = fast_resilience()
+    n1, _ = make_node("n1")
+    server.add_node("n1", n1)
+    cache = NodeAnnotationCache(
+        client, interval_s=1.0, watch=True, watch_backstop_s=1.5
+    )
+    cache.refresh()
+    assert cache.index.get("n1") is not None
+    watch_before = rz.TRACKER.snapshot()["watch_streams"]
+    lists_before = len(_node_lists(server))
+    # Two events queued past the bookmark; the stream dies mid-line
+    # after delivering the first of them.
+    n2, _ = make_node("n2")
+    n3, _ = make_node("n3")
+    server.add_node("n2", n2)
+    server.add_node("n3", n3)
+    server.faults.add(kind="watch_drop", after_events=1, times=1)
+    healthy = cache._watch_until_stale()
+    # Healthy backstop expiry — the drop did NOT demand a relist.
+    assert healthy is True
+    assert server.faults.count("watch_drop") == 1
+    # Zero missed events: n2 arrived before the drop, n3 was replayed
+    # by the apiserver after the bookmarked-rv resume.
+    assert cache.index.get("n2") is not None
+    assert cache.index.get("n3") is not None
+    watch_after = rz.TRACKER.snapshot()["watch_streams"]
+    assert watch_after["resumed"] == watch_before["resumed"] + 1
+    assert watch_after["relist"] == watch_before["relist"]
+    assert len(_node_lists(server)) == lists_before  # zero relists
+
+
+def test_watch_410_forces_exactly_one_relist_without_duplication(api):
+    server, client = api
+    client.resilience = fast_resilience()
+    n1, _ = make_node("n1")
+    server.add_node("n1", n1)
+    cache = NodeAnnotationCache(
+        client, interval_s=1.0, watch=True, watch_backstop_s=1.5
+    )
+    cache.refresh()
+    entry_before = cache.index.get("n1")
+    assert entry_before is not None
+    watch_before = rz.TRACKER.snapshot()["watch_streams"]
+    n2, _ = make_node("n2")
+    server.add_node("n2", n2)
+    server.faults.add(kind="watch_410", times=1)
+    healthy = cache._watch_until_stale()
+    # 410 Gone is the ONE case resuming cannot cover: the stream hands
+    # back to the caller for a relist instead of hot-reconnecting.
+    assert healthy is False
+    watch_after = rz.TRACKER.snapshot()["watch_streams"]
+    assert watch_after["relist"] == watch_before["relist"] + 1
+    assert watch_after["resumed"] == watch_before["resumed"]
+    lists_before = len(_node_lists(server))
+    cache.refresh()  # the caller's single relist
+    assert len(_node_lists(server)) == lists_before + 1
+    # The relist re-established truth (n2 present) WITHOUT duplicated
+    # rebuilds: n1's unchanged annotation short-circuits to the same
+    # parsed entry object.
+    assert cache.index.get("n2") is not None
+    assert cache.index.get("n1") is entry_before
+
+
+def test_repeated_barren_drops_hand_back_to_relist_backoff(api):
+    """A stream that keeps dying WITHOUT delivering anything means the
+    apiserver is down: after three no-progress drops the watch stops
+    hot-reconnecting and hands control back to the relist loop."""
+    server, client = api
+    client.resilience = fast_resilience()
+    n1, _ = make_node("n1")
+    server.add_node("n1", n1)
+    cache = NodeAnnotationCache(
+        client, interval_s=1.0, watch=True, watch_backstop_s=30.0
+    )
+    cache.refresh()
+    server.faults.add(kind="watch_drop", times=-1)
+    t0 = time.monotonic()
+    assert cache._watch_until_stale() is False
+    assert time.monotonic() - t0 < 10.0  # bailed out, not 30 s of flap
+    assert server.faults.count("watch_drop") >= 3
+
+
+# ---------------------------------------------------------------------------
+# Brownout during a sharded takeover window: holds stay fenced
+# ---------------------------------------------------------------------------
+
+
+def test_brownout_mid_takeover_keeps_peer_holds_fenced(api):
+    """The dead shard's lease goes unreadable mid-takeover: the
+    last-known peer-hold overlay must KEEP fencing its chips, and no
+    takeover decision may be made on a lease whose holder liveness
+    cannot be judged. Once the brownout lifts, the takeover proceeds."""
+    server, client = api
+    client.resilience = fast_resilience(
+        max_attempts=2, deadline_s=0.5, threshold=1000
+    )
+    # rep-b's 2 s lease goes stale during the outage; rep-a's own home
+    # lease is 8 s so ITS renew deadline (2/3 ⇒ 5.3 s) spans the
+    # brownout — rep-a must not self-demote, only defer the takeover.
+    m1 = _manager(
+        client, home=1, identity="rep-b", lease_seconds=2.0,
+        takeover=False,
+    )
+    m1._adopt_shard(1, reason="home")
+    m0 = _manager(client, home=0, identity="rep-a", lease_seconds=8.0)
+    m0._adopt_shard(0, reason="home")
+    try:
+        adm1 = m1._owned[1].admission
+        adm1.reservations.reserve(("default", "g"), {"n1": 4})
+        m1._owned[1].lease._renew_once()  # publish the overlay
+        m0.scan_once()
+        assert m0.reservations_view().held_by_host() == {"n1": 4}
+        # rep-b is SIGKILLed (lease left standing, never renewed) and
+        # the apiserver browns out inside the same takeover window.
+        m1.abandon()
+        server.faults.add(kind="reset", times=-1)
+        time.sleep(2.3)  # the lease is now stale… but unreadable
+        m0.scan_once()
+        # Outage: the stale overlay still fences rep-b's chips, and
+        # shard 1 was NOT taken over on an unreadable lease.
+        assert m0.reservations_view().held_by_host() == {"n1": 4}
+        assert m0.owned_shards() == {0}
+        # Brownout lifts: liveness is judged from the real lease and
+        # the takeover proceeds normally.
+        server.faults.clear()
+        m0.scan_once()
+        assert m0.owned_shards() == {0, 1}
+    finally:
+        server.faults.clear()
+        m0.stop()
+
+
+# ---------------------------------------------------------------------------
+# Lease-renew jitter: no fleet lockstep (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_renew_jitter_spreads_replicas_and_stays_in_band():
+    interval = 10.0
+    leases = [
+        LeaderLease(None, identity=f"rep-{i}", lease_seconds=30.0)
+        for i in range(8)
+    ]
+    waits = [
+        l._renew_wait_s(interval, interval, failed=False) for l in leases
+    ]
+    # Private per-instance RNGs: identical configs must NOT renew in
+    # lockstep (the stampede against a recovering apiserver).
+    assert len(set(waits)) > 1
+    for w in waits:
+        assert interval / 2.0 <= w <= interval
+    # Failed renewals retry on a tighter (still jittered) cadence, so
+    # the self-demotion guard is evaluated more often under pressure.
+    for l in leases:
+        w = l._renew_wait_s(interval, interval, failed=True)
+        assert interval / 8.0 <= w <= interval / 2.0
+    # The decorrelated walk never escapes the healthy band.
+    lease, w = leases[0], interval
+    for _ in range(100):
+        w = lease._renew_wait_s(w, interval, failed=False)
+        assert interval / 2.0 <= w <= interval
+    # retry_jitter_s=0 restores the fixed cadence (the deterministic-
+    # timing escape hatch existing lease tests rely on).
+    fixed = LeaderLease(
+        None, identity="rep-x", lease_seconds=30.0, retry_jitter_s=0
+    )
+    assert fixed._renew_wait_s(interval, interval, failed=False) == interval
+    assert fixed._renew_wait_s(interval, interval, failed=True) == interval
+
+
+# ---------------------------------------------------------------------------
+# Compressed brownout end-to-end (the ISSUE's 30 s outage, time-scaled)
+# ---------------------------------------------------------------------------
+
+
+def test_compressed_brownout_e2e_recovers_clean(api, plugin, tmp_path):
+    """Breaker opens, degraded mode enters, ZERO mutations land while
+    the breaker is open, the lease holder does not prematurely
+    self-demote, and after the window self-expires everything
+    converges with a clean degraded_consistency audit."""
+    rz.TRACKER.reset()  # fresh evidence slate for this scenario
+    server, client0 = api
+    ids = plugin.mesh.ids
+    ctrl, server = make_controller(api, plugin, tmp_path)
+    res = fast_resilience(
+        max_attempts=2, deadline_s=0.5, threshold=3, reset_timeout_s=0.2
+    )
+    dm = rz.DegradedMode(staleness_cap_s=60.0, name="chaos-e2e")
+    res.degraded = dm
+    ctrl.client.resilience = res
+    ctrl.degraded = dm
+    ctrl.resync_interval_s = 0.25
+    ctrl._watch_backoff = rz.Backoff(base=0.05, max_delay=0.2)
+
+    # A lease holder rides through the same brownout: its renew
+    # deadline (2 s, the 2/3 default of a 3 s lease) comfortably spans
+    # the ~1 s window, so on_lost must NEVER fire. It shares the
+    # daemon's ONE resilience pipeline (one breaker per process), so
+    # its renewals also fail fast while the circuit is open.
+    lost = []
+    leader_client = KubeClient(client0.base_url, token="tok-lease")
+    leader_client.resilience = res
+    leader = LeaderLease(
+        leader_client, identity="e2e-rep", lease_seconds=3.0,
+        on_lost=lambda: lost.append(time.monotonic()),
+    )
+
+    server.add_pod(pod_dict("jax-a", "uid-a", tpus=2))
+    write_checkpoint(tmp_path, {"uid-a": ids[:2]})
+    ctrl.start()
+    leader.start()
+    try:
+        # Healthy baseline: the first annotation lands.
+        assert wait_for(lambda: server.pod_patches, timeout=10)
+
+        # The brownout: every request resets for ~1.2 s from the first
+        # match, then the window expires on its own (no clear() — the
+        # recovery is the server's, not the test's).
+        server.faults.brownout(1.2)
+        assert wait_for(
+            lambda: res.breaker.state == rz.OPEN, timeout=10
+        ), "breaker never opened during the brownout"
+        assert dm.active  # breaker OPEN ⇒ consumers degraded
+        assert rz.TRACKER.breaker_open()
+        # Work arrives DURING the outage.
+        server.add_pod(pod_dict("jax-b", "uid-b", tpus=2))
+        write_checkpoint(
+            tmp_path, {"uid-a": ids[:2], "uid-b": ids[2:4]}
+        )
+
+        # Recovery: the window self-expires, the half-open probe
+        # closes the breaker, degraded mode exits, and the queued work
+        # converges.
+        assert wait_for(
+            lambda: res.breaker.state == rz.CLOSED
+            and any(
+                name == "jax-b" for _, name, _ in server.pod_patches
+            ),
+            timeout=15,
+        ), "controller did not converge after the brownout lifted"
+        assert not dm.active
+        assert not rz.TRACKER.breaker_open()
+
+        # No premature self-demotion: the holder rode out the window.
+        assert not lost
+        assert (
+            server.leases[("kube-system", leader.name)]["spec"][
+                "holderIdentity"
+            ]
+            == "e2e-rep"
+        )
+
+        # The contract the whole layer exists for: NOT ONE successful
+        # mutation landed while the breaker was open, and the audit
+        # invariant agrees.
+        assert rz.TRACKER.mutations_while_open() == []
+        assert audit.check_degraded_consistency() == []
+        snap = rz.TRACKER.snapshot()
+        assert snap["circuit_windows"], "open window was never recorded"
+        assert snap["circuit_windows"][-1]["closed_s_ago"] is not None
+        assert snap["mutations_recorded"] > 0  # evidence, not absence
+    finally:
+        leader.stop()
+        ctrl.stop()

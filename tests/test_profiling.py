@@ -861,7 +861,7 @@ def test_acceptance_slo_capture_stall_and_audit_e2e(tmp_path):
     api = FakeApiServer()
     url = api.start()
     for i in range(3):
-        api.add_node(f"n{i}", make_node(f"n{i}"))
+        api.add_node(f"n{i}", make_node(f"n{i}")[0])
     saved_prof = stackprof.PROFILER
     saved_service = profiling._SERVICE
     profiling.set_service("extender")

@@ -134,18 +134,26 @@ def test_watch_loop_applies_events_then_falls_back_to_relist():
     n1_new, _ = make_node("n1", available=[])
 
     class WatchClient(_ListClient):
+        watch_calls = 0
+
         def watch_nodes(self, resource_version="", timeout_seconds=60):
-            yield "MODIFIED", n1_new
+            type(self).watch_calls += 1
+            if type(self).watch_calls == 1:
+                yield "MODIFIED", n1_new
             raise ConnectionError("stream died")
 
-    cache = NodeAnnotationCache(
-        WatchClient([n1]), interval_s=3600, watch=True
-    )
+    client = WatchClient([n1])
+    cache = NodeAnnotationCache(client, interval_s=3600, watch=True)
     cache.refresh()
     assert cache.index.get("n1").avail == 4
     healthy = cache._watch_until_stale()
-    assert healthy is False  # broken stream reports unhealthy
-    assert cache.index.get("n1").avail == 0  # but the event landed
+    # The first drop happened after a delivered event, so the stream
+    # RESUMES from the bookmarked rv; the following drops deliver
+    # nothing, and three consecutive barren drops prove the stream is
+    # beyond resuming — hand back to the relist loop.
+    assert healthy is False
+    assert type(client).watch_calls == 4  # 1 progressed + 3 barren
+    assert cache.index.get("n1").avail == 0  # the event landed
 
 
 # ---------------------------------------------------------------------------
