@@ -96,6 +96,13 @@ more complete):
                                detection scan + full migration-plan
                                search p50/p99, interleaved arms (plan
                                p99 bounded in tests/test_scale_bench.py)
+  detail.placement_kernel      vectorized placement core: indexed
+                               /filter p99 under the vector kernel at
+                               1,000 nodes, batched 4-shard admission
+                               screen vector vs scalar (interleaved,
+                               identical fixtures) + parity verdict
+                               (sub-ms p99 and >=3x speedup gated in
+                               tests/test_scale_bench.py)
   detail.grant     every chip-grant probe attempt
   detail.workload.mfu   model FLOPs/step ÷ step time ÷ chip peak bf16
   detail.workload_chunked_xent.vs_plain_step   chunked-vocab CE A/B
@@ -906,6 +913,21 @@ def main() -> int:
             )
         except Exception as e:  # noqa: BLE001
             result["detail"]["defrag_planning"] = {
+                "error": repr(e)[:400]
+            }
+        emit()
+        # Phase 1.13: vectorized placement-core probe (PR 17 — the
+        # indexed /filter p99 under the vector kernel at 1,000 nodes,
+        # the 4-shard batched admission screen vector vs scalar on
+        # identical interleaved fixtures, and the vector/scalar
+        # parity verdict; the sub-millisecond filter p99 and the >=3x
+        # admission speedup are gated in tests/test_scale_bench.py).
+        try:
+            result["detail"]["placement_kernel"] = (
+                scale_bench.placement_kernel(n_nodes=1000, n_shards=4)
+            )
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["placement_kernel"] = {
                 "error": repr(e)[:400]
             }
         emit()

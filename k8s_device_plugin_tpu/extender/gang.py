@@ -46,6 +46,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..api import constants
 from ..kube.client import KubeClient, KubeError
+from ..topology.placement import first_fit, hosts_box_fits, pool_mask
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..topology.slice import SliceView
 from ..utils import metrics, profiling, tracing
@@ -249,16 +250,87 @@ class _CapacityPool:
 
     def _place_single(self, n: int) -> Optional[str]:
         """Best-fit: the tightest host whose free chips and chip count
-        both cover n (keeps large-free hosts for larger demands)."""
+        both cover n (keeps large-free hosts for larger demands).
+        Within the tightness bucket, hosts where a contiguous n-box
+        actually fits are preferred — scored in ONE batched kernel
+        pass per grid geometry (placement.hosts_box_fits) — and the
+        box's exact chips are debited so later box tests this tick see
+        the truth. When no bucket member box-fits, the pick and the
+        debit fall back to the old count-based behavior: admission
+        stays the same conservative count test, never stricter."""
         for length in range(n, self._max_len + 1):
             bucket = self._by_len.get(length)
             if not bucket:
                 continue
+            # Collect at most the probe cap (the old pick took the
+            # FIRST qualifying host, so walking the whole bucket here
+            # would re-linearize what the buckets made O(1)).
+            eligible: List[str] = []
             for h in bucket:
                 if self.chip_count[h] >= n:
-                    self._set_avail(h, self.avail[h][n:])
-                    return h
+                    eligible.append(h)
+                    if len(eligible) >= self._BOX_PICK_MAX:
+                        break
+            if not eligible:
+                continue
+            host, box_ids = self._box_pick(n, eligible)
+            if host is None:
+                host = eligible[0]
+            cur = self.avail[host]
+            if box_ids is not None:
+                self._set_avail(
+                    host, [i for i in cur if i not in box_ids]
+                )
+            else:
+                self._set_avail(host, cur[n:])
+            return host
         return None
+
+    # Box probing is bounded: hosts are scored in small batches with
+    # early exit (the first batch almost always yields a hit — a
+    # fully-free host fits any geometrically-possible box), and at
+    # most _BOX_PICK_MAX hosts are ever probed per placement so a
+    # fully-fragmented bucket costs O(cap), not O(bucket). Beyond the
+    # cap the count-based fallback applies — exactly the old pick.
+    _BOX_PICK_CHUNK = 16
+    _BOX_PICK_MAX = 128
+
+    def _box_pick(
+        self, n: int, hosts: List[str]
+    ) -> Tuple[Optional[str], Optional[Set[str]]]:
+        """(host, box chip-id set) for the first host among ``hosts``
+        where a contiguous n-box fits its current availability, else
+        (None, None). Each batch scores in a single hosts_box_fits
+        kernel pass per grid geometry; first_fit then recovers the
+        winning host's actual box for the debit."""
+        probe = hosts[: self._BOX_PICK_MAX]
+        for start in range(0, len(probe), self._BOX_PICK_CHUNK):
+            chunk = probe[start:start + self._BOX_PICK_CHUNK]
+            prepared = []
+            for h in chunk:
+                mesh = self.by_host[h].to_mesh()
+                mask = pool_mask(mesh, self.avail[h])
+                prepared.append((h, mesh, mask))
+            groups: Dict[tuple, List[Tuple[str, int]]] = {}
+            for h, mesh, mask in prepared:
+                groups.setdefault(
+                    (mesh.bounds, mesh.wraps), []
+                ).append((h, mask))
+            verdicts: Dict[str, bool] = {}
+            for (bounds, wraps), members in groups.items():
+                fits = hosts_box_fits(
+                    n, bounds, wraps, [m for _, m in members]
+                )
+                for (h, _), ok in zip(members, fits):
+                    verdicts[h] = ok
+            for h, mesh, mask in prepared:
+                if not verdicts.get(h):
+                    continue
+                cand = first_fit(n, mesh.bounds, mesh.wraps, mask)
+                if cand is None:
+                    continue
+                return h, {mesh.by_coords[c].id for c in cand.coords}
+        return None, None
 
     def _place_multi(self, n: int) -> Optional[List[str]]:
         """k = n/host_size whole-free hosts from one slice (contiguous

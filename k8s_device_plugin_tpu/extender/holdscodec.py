@@ -1,0 +1,287 @@
+"""Compact binary wire form for the shard-holds overlay.
+
+The sharding plane mirrors each shard's reservation holds to its peers
+through a Lease annotation (``tpu.google.com/shard-holds``).  The original
+wire form was a JSON array of ``{"namespace", "gang", "hosts": {host:
+chips}}`` records; at fleet scale the same hostnames repeat across every
+record and the JSON framing dominates the payload.  This module packs the
+same records into a binary layout — a deduplicated host table plus, per
+record, a packed bitset selecting hosts out of that table and a varint
+chip count per selected host — then base64-armours it behind a ``tpb1:``
+prefix so it still travels as an annotation string.
+
+Wire negotiation happens entirely off the payload prefix on the read
+side: ``decode_holds`` routes ``tpb1:``-prefixed payloads through the
+binary decoder and everything else through the legacy JSON parser, so a
+new reader understands both forms with no handshake.  Old readers treat
+a binary payload exactly like corrupt JSON (empty overlay) — safe but
+blind — so mixed-version rollouts that need full peer visibility set
+``TPU_SHARD_HOLDS_WIRE=json`` on the writers until every replica can
+decode binary, then drop the variable.
+
+Binary layout (version 1), after base64-decoding the part following the
+``tpb1:`` prefix::
+
+    u8                      format version (== 1)
+    varint H                host-table size
+    H x (varint len, utf8)  hostnames, deduplicated, first-seen order
+    varint R                record count
+    R x record:
+        varint len, utf8    namespace
+        varint len, utf8    gang
+        ceil(H/8) bytes     host bitset (host i -> byte i//8, bit i%8)
+        per set bit, ascending host index:
+            varint          chips held on that host (> 0)
+
+Varints are unsigned LEB128.  Any structural violation — unknown
+version, truncation, trailing bytes, zero chip counts, bad UTF-8 or
+base64 — decodes to the empty overlay, matching how corrupt JSON has
+always been handled: the reader degrades to "peer holds unknown" rather
+than guessing.
+
+Decoding is content-addressed: the peer-scan loop re-reads every shard
+lease each sweep, and the annotation string is byte-identical between
+sweeps unless that shard's reservations actually changed, so decoded
+overlays are memoised by payload digest (same pattern as the index's
+derived-state memo).  Memo hits return the cached record list directly —
+callers treat decoded overlays as read-only (they only sum and display
+them), which keeps the hit path allocation-free.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+_PREFIX = "tpb1:"
+_VERSION = 1
+
+# Env escape hatch for mixed-version rollouts: old replicas cannot read
+# the binary form (they see it as corrupt JSON -> empty overlay), so the
+# writer side can be pinned to JSON until the fleet is uniformly new.
+_WIRE_ENV = "TPU_SHARD_HOLDS_WIRE"
+
+
+def _wire_is_json() -> bool:
+    return os.environ.get(_WIRE_ENV, "").strip().lower() == "json"
+
+
+# --------------------------------------------------------------------------
+# varint (unsigned LEB128)
+# --------------------------------------------------------------------------
+
+
+def _put_varint(out: bytearray, value: int) -> None:
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _get_varint(buf: bytes, pos: int) -> tuple:
+    """Return (value, new_pos); raise ValueError on truncation/overlong."""
+    value = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        value |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return value, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint overflow")
+
+
+def _put_str(out: bytearray, s: str) -> None:
+    raw = s.encode("utf-8")
+    _put_varint(out, len(raw))
+    out.extend(raw)
+
+
+def _get_str(buf: bytes, pos: int) -> tuple:
+    n, pos = _get_varint(buf, pos)
+    if pos + n > len(buf):
+        raise ValueError("truncated string")
+    return buf[pos : pos + n].decode("utf-8"), pos + n
+
+
+# --------------------------------------------------------------------------
+# encode
+# --------------------------------------------------------------------------
+
+
+def encode_holds(recs: List[dict]) -> str:
+    """Serialise hold records for the shard-holds annotation.
+
+    Emits the binary ``tpb1:`` form unless ``TPU_SHARD_HOLDS_WIRE=json``
+    pins the legacy wire.  Records must already be in canonical shape
+    (``namespace``/``gang`` strings, ``hosts`` mapping host -> chips>0) —
+    the sharding plane builds them from its own reservation snapshot.
+    """
+    if _wire_is_json():
+        return json.dumps(recs)
+    return _PREFIX + base64.b64encode(pack_holds(recs)).decode("ascii")
+
+
+def pack_holds(recs: List[dict]) -> bytes:
+    """Pack records into the raw (pre-base64) version-1 binary layout."""
+    host_index: Dict[str, int] = {}
+    for rec in recs:
+        for host in rec["hosts"]:
+            if host not in host_index:
+                host_index[host] = len(host_index)
+    out = bytearray()
+    out.append(_VERSION)
+    _put_varint(out, len(host_index))
+    for host in host_index:  # insertion order == index order
+        _put_str(out, host)
+    nbytes = (len(host_index) + 7) // 8
+    _put_varint(out, len(recs))
+    for rec in recs:
+        _put_str(out, rec["namespace"])
+        _put_str(out, rec["gang"])
+        bits = 0
+        for host in rec["hosts"]:
+            bits |= 1 << host_index[host]
+        out.extend(bits.to_bytes(nbytes, "little"))
+        for host in sorted(rec["hosts"], key=host_index.__getitem__):
+            _put_varint(out, rec["hosts"][host])
+    return bytes(out)
+
+
+# --------------------------------------------------------------------------
+# decode
+# --------------------------------------------------------------------------
+
+
+def unpack_holds(buf: bytes) -> List[dict]:
+    """Decode the raw binary layout; raise ValueError on any violation."""
+    if not buf or buf[0] != _VERSION:
+        raise ValueError("unknown holds format version")
+    pos = 1
+    nhosts, pos = _get_varint(buf, pos)
+    if nhosts > len(buf):  # cheap bound before allocating the table
+        raise ValueError("host table larger than payload")
+    hosts: List[str] = []
+    for _ in range(nhosts):
+        h, pos = _get_str(buf, pos)
+        hosts.append(h)
+    nbytes = (nhosts + 7) // 8
+    nrecs, pos = _get_varint(buf, pos)
+    if nrecs > len(buf):
+        raise ValueError("record count larger than payload")
+    recs: List[dict] = []
+    for _ in range(nrecs):
+        ns, pos = _get_str(buf, pos)
+        gang, pos = _get_str(buf, pos)
+        if pos + nbytes > len(buf):
+            raise ValueError("truncated host bitset")
+        bits = int.from_bytes(buf[pos : pos + nbytes], "little")
+        pos += nbytes
+        if bits >> nhosts:
+            raise ValueError("host bitset references unknown host")
+        held: Dict[str, int] = {}
+        rem = bits
+        while rem:
+            i = (rem & -rem).bit_length() - 1
+            rem &= rem - 1
+            chips, pos = _get_varint(buf, pos)
+            if chips <= 0:
+                raise ValueError("non-positive chip count")
+            held[hosts[i]] = chips
+        recs.append({"namespace": ns, "gang": gang, "hosts": held})
+    if pos != len(buf):
+        raise ValueError("trailing bytes after last record")
+    return recs
+
+
+def _decode_json(raw: str) -> List[dict]:
+    """Legacy JSON wire.  Validation semantics predate this module and
+    are deliberately lenient: malformed host entries are dropped from a
+    record rather than poisoning it, names are coerced to strings."""
+    try:
+        data = json.loads(raw)
+    except ValueError:
+        return []
+    out: List[dict] = []
+    for rec in data if isinstance(data, list) else []:
+        if isinstance(rec, dict) and isinstance(rec.get("hosts"), dict):
+            out.append({
+                "namespace": str(rec.get("namespace", "")),
+                "gang": str(rec.get("gang", "")),
+                "hosts": {
+                    str(h): int(n)
+                    for h, n in rec["hosts"].items()
+                    if isinstance(n, int) and n > 0
+                },
+            })
+    return out
+
+
+# Content-addressed decode memo.  Keyed by a short digest of the payload
+# string; the peer-scan loop re-decodes byte-identical annotations every
+# sweep, so steady state is all hits.  Same LRU discipline as the index's
+# derived-state memo.
+_MEMO_MAX = 1024
+_MEMO: "OrderedDict[bytes, List[dict]]" = OrderedDict()
+_MEMO_LOCK = threading.Lock()
+
+
+def _memo_key(raw: str) -> bytes:
+    return hashlib.blake2b(raw.encode("utf-8"), digest_size=16).digest()
+
+
+def clear_memo() -> None:
+    """Drop the decode memo (test isolation)."""
+    with _MEMO_LOCK:
+        _MEMO.clear()
+
+
+def decode_holds(raw: str) -> List[dict]:
+    """Parse a shard-holds annotation payload into hold records.
+
+    Negotiates the wire form off the payload prefix: ``tpb1:`` routes to
+    the binary decoder, anything else to the legacy JSON parser.  Any
+    corruption — either wire — yields the empty overlay.  Results are
+    memoised by content digest; callers must treat them as read-only.
+    """
+    if not raw:
+        return []
+    key = _memo_key(raw)
+    with _MEMO_LOCK:
+        hit = _MEMO.get(key)
+        if hit is not None:
+            _MEMO.move_to_end(key)
+    if hit is not None:
+        try:  # metrics are optional here: codec must work standalone
+            from ..utils import metrics
+
+            metrics.PARSE_AVOIDED.inc(reason="holds_memo")
+        except Exception:
+            pass
+        return hit
+    if raw.startswith(_PREFIX):
+        try:
+            recs = unpack_holds(base64.b64decode(raw[len(_PREFIX) :], validate=True))
+        except (ValueError, UnicodeDecodeError):
+            recs = []
+    else:
+        recs = _decode_json(raw)
+    with _MEMO_LOCK:
+        if key not in _MEMO:
+            _MEMO[key] = recs
+            while len(_MEMO) > _MEMO_MAX:
+                _MEMO.popitem(last=False)
+    return recs

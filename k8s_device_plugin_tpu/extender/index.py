@@ -38,6 +38,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from .. import telemetry
+from ..topology import placement
 from ..topology.placement import placeable_sizes
 from ..topology.schema import NodeTopology, parse_topology_cached
 from ..utils import metrics, profiling
@@ -160,6 +161,53 @@ class IndexEntry:
         }
 
 
+class ColumnPlane:
+    """Columnar mirror of the index for the vectorized /filter fast
+    path (server.py _filter_names_fast): per-row int32/bool arrays the
+    kernel's numpy scores in one pass instead of a per-candidate
+    Python loop. Immutable once built; replaced whole on rebuild, so
+    reads are lock-free. ``rows`` covers non-deferred entries only — a
+    candidate outside it (unknown node, deferred cold-start entry)
+    sends the whole RPC down the per-entry slow path, which owns every
+    rare shape. ``key`` is the invalidation stamp (the index's
+    ``_mutations`` counter — generation alone misses the documented
+    no-bump restore()/ensure_parsed() transitions)."""
+
+    __slots__ = (
+        "rows",
+        "host_row",
+        "avail",
+        "chip_count",
+        "has_topo",
+        "no_topo",
+        "size",
+        "key",
+    )
+
+    def __init__(self, np, entries, no_topo: Set[str], key: tuple):
+        names: List[str] = []
+        avail: List[int] = []
+        chips: List[int] = []
+        topod: List[bool] = []
+        self.host_row: Dict[str, int] = {}
+        for name, e in entries:
+            if e.hostname:
+                self.host_row[e.hostname] = len(names)
+            names.append(name)
+            avail.append(e.avail)
+            chips.append(e.chip_count)
+            topod.append(e.topo is not None)
+        self.rows: Dict[str, int] = {
+            name: i for i, name in enumerate(names)
+        }
+        self.avail = np.asarray(avail, dtype=np.int32)
+        self.chip_count = np.asarray(chips, dtype=np.int32)
+        self.has_topo = np.asarray(topod, dtype=bool)
+        self.no_topo = frozenset(no_topo)
+        self.size = len(names)
+        self.key = key
+
+
 class TopologyIndex:
     """name → IndexEntry, maintained incrementally per node."""
 
@@ -206,6 +254,15 @@ class TopologyIndex:
         # when nothing moved since the last one. Materializing a
         # deferred entry does NOT bump it — derived state is unchanged.
         self.generation = 0
+        # Lazily (re)built columnar mirror for the /filter fast path;
+        # None until first demanded, replaced whole on staleness.
+        # ``_mutations`` is its invalidation stamp: bumped on EVERY
+        # entry/no-topo mutation, unlike ``generation`` which
+        # deliberately skips restore()/ensure_parsed() (snapshot-write
+        # elision) — a plane keyed on generation alone would serve
+        # stale rows across those transitions.
+        self._plane: Optional[ColumnPlane] = None
+        self._mutations = 0
         # /debug/telemetry's cluster panel reads the latest-constructed
         # index of this process (one per extender daemon).
         telemetry.CLUSTER_PROVIDER = self.placeable_snapshot
@@ -289,6 +346,7 @@ class TopologyIndex:
                     return "noop"
                 self._no_topo.add(name)
                 self._deferred.discard(name)
+                self._mutations += 1
                 if prev is not None:
                     # Negative (annotation-less) nodes are not
                     # persisted, so only an entry transition changes
@@ -322,6 +380,7 @@ class TopologyIndex:
             self._entries[name] = entry
             self._deferred.discard(name)
             self.generation += 1
+            self._mutations += 1
             self._publish_placeable_locked(
                 self._adjust_placeable_locked(prev, entry)
             )
@@ -410,6 +469,7 @@ class TopologyIndex:
             was_known = prev is not None or name in self._no_topo
             self._no_topo.discard(name)
             self._deferred.discard(name)
+            self._mutations += 1
             if prev is not None:
                 # Same rationale as update()'s raw-None branch: only
                 # persisted (entry-bearing) state moves the snapshot.
@@ -469,6 +529,7 @@ class TopologyIndex:
                 return False
             self._no_topo.discard(name)
             self._entries[name] = entry
+            self._mutations += 1
             if entry.deferred:
                 self._deferred.add(name)
             # No generation bump: a restore installs exactly what the
@@ -522,6 +583,7 @@ class TopologyIndex:
                 return cur  # a concurrent update/remove is newer truth
             self._entries[name] = new
             self._deferred.discard(name)
+            self._mutations += 1
             if new.placeable != e.placeable:
                 self._publish_placeable_locked(
                     self._adjust_placeable_locked(e, new)
@@ -621,6 +683,29 @@ class TopologyIndex:
             log.exception("topology index on_change hook failed")
 
     # -- queries -----------------------------------------------------------
+
+    def column_plane(self) -> Optional[ColumnPlane]:
+        """The current columnar mirror, rebuilt lazily when stale
+        (O(entries), amortized across every RPC until the next index
+        mutation). None when numpy is unavailable or forced off
+        (placement.force_scalar — the same gate as the placement
+        kernel, so the mode gauge tells the whole story)."""
+        np = placement.numpy_or_none()
+        if np is None:
+            return None
+        with self._lock:
+            key = (self._mutations,)
+            plane = self._plane
+            if plane is not None and plane.key == key:
+                return plane
+            entries = [
+                (name, e)
+                for name, e in self._entries.items()
+                if not e.deferred
+            ]
+            plane = ColumnPlane(np, entries, self._no_topo, key)
+            self._plane = plane
+            return plane
 
     def get(self, name: str) -> Optional[IndexEntry]:
         return self._entries.get(name)

@@ -34,6 +34,20 @@ log = get_logger(__name__)
 
 Coord = Tuple[int, int, int]
 
+
+def linear_index(c: Coord, bounds: Coord) -> int:
+    """The ONE coordinate linearization: ``x + bx*(y + by*z)``.
+
+    Every bit space in the placement stack — ``BoxCandidate.mask``,
+    the allocator's pool masks, the packed ``uint64`` candidate words
+    the vectorized kernel scans — indexes bits with this function, and
+    it is the inverse of ``IciMesh._coords_of`` (PCI scan order,
+    x-fastest). Three private copies of this expression used to live
+    in placement.py; a fourth that drifted would have made the gauges
+    disagree with what ``select`` places."""
+    return c[0] + bounds[0] * (c[1] + bounds[1] * c[2])
+
+
 SCORE_ADJACENT = 10
 SCORE_2_HOPS = 6
 SCORE_3_HOPS = 4
@@ -111,6 +125,13 @@ class IciMesh:
             h = self._hop_distance(a.coords, b.coords)
             self._hops[(a.id, b.id)] = h
             self._hops[(b.id, a.id)] = h
+        # Cached once: bounds and spec are immutable after construction,
+        # and every placement-kernel entry point (box_fits,
+        # fragmentation_stats, _best_box, the defrag stranded scan) used
+        # to rebuild this 3-tuple per call.
+        self.wraps: Tuple[bool, bool, bool] = tuple(
+            self._dim_wraps(self.bounds[d]) for d in range(3)
+        )
 
     # -- geometry ----------------------------------------------------------
 

@@ -26,13 +26,20 @@ All scoring uses the precomputed tables in IciMesh — no hardware queries
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import itertools
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-from .mesh import Coord, IciMesh
+from .mesh import Coord, IciMesh, linear_index
+
+try:  # numpy is the vectorized kernel's only dependency; its absence
+    # degrades to the scalar kernel, never to an import error.
+    import numpy as _np
+except Exception:  # noqa: BLE001 — any import failure means "no numpy"
+    _np = None
 
 
 def _box_shapes(n: int, bounds: Coord) -> List[Coord]:
@@ -105,7 +112,7 @@ def box_candidates(
     bx, by, bz = bounds
 
     def bit(c: Coord) -> int:
-        return c[0] + bx * (c[1] + by * c[2])
+        return linear_index(c, bounds)
 
     def neighbors(c: Coord) -> List[Coord]:
         out = []
@@ -160,12 +167,237 @@ def box_candidates(
 
 
 def _pool_mask(mesh: IciMesh, ids: Iterable[str]) -> int:
-    bx, by, _bz = mesh.bounds
+    bounds = mesh.bounds
     mask = 0
     for i in ids:
-        c = mesh.by_id[i].coords
-        mask |= 1 << (c[0] + bx * (c[1] + by * c[2]))
+        mask |= 1 << linear_index(mesh.by_id[i].coords, bounds)
     return mask
+
+
+def pool_mask(mesh: IciMesh, ids: Iterable[str]) -> int:
+    """Public form of the availability-mask builder for kernel
+    consumers outside this module (defrag's stranded scan, the
+    admitter's box-aware bucket probe). Ids unknown to the mesh are
+    skipped — callers hold annotation-sourced id lists that may
+    mention chips the mesh never discovered."""
+    return _pool_mask(mesh, (i for i in ids if i in mesh.by_id))
+
+
+# ---------------------------------------------------------------------------
+# Vectorized box-search kernel
+#
+# Each (n, bounds, wraps) candidate space is packed ONCE into a numpy
+# uint64[C, W] word array next to the BoxCandidate tuples (row c =
+# candidate c's mask, W = ceil(grid_bits / 64), little-endian word
+# order, bit layout = mesh.linear_index). A host mask then scores ALL
+# candidates in one pass:
+#
+#     fits = ~((cand_words & ~mask_words).any(axis=1))
+#
+# and np.argmax over ``fits`` recovers the FIRST fitting candidate —
+# the enumeration order (cube-like shapes first, offsets x-outer/
+# z-inner) is load-bearing for SliceView.best_gang, so first-fit index
+# recovery preserves it exactly. The scalar path below each entry
+# point is kept both as the no-numpy fallback and as the parity oracle
+# the property tests and --placement-self-test drive against the
+# vector path (zero placement-decision drift, asserted per case).
+# ---------------------------------------------------------------------------
+
+# Packed-space cache: our own dict (not lru_cache) so eviction can keep
+# the byte-accounting gauge honest, and so the HIT path is a lock-free
+# dict.get — fragmentation_stats on the admission tick probes it per
+# geometry and a lock acquisition per probe showed up in the micro
+# profile. Writes (build + FIFO eviction) serialize on the lock; a
+# racing reader at worst rebuilds a space.
+_PACKED_MAX = 256
+_PACKED: Dict[tuple, object] = {}
+_PACKED_LOCK = threading.Lock()
+_PACKED_BYTES = 0
+
+# Below this many candidates the scalar any() — which early-exits on
+# the first fit and pays no numpy dispatch — beats the vector pass
+# (measured crossover ~2x this on the dev host; single-host 4/8-chip
+# spaces have C in the single digits). Parity is independent of the
+# choice: both kernels are property-tested equal on every case.
+_VECTOR_MIN_CANDS = 24
+
+# Test/bench/rollout override: True forces every entry point down the
+# scalar kernel even with numpy importable (the bench's scalar arm, the
+# parity oracle, and the operator's TPU_PLACEMENT_KERNEL=scalar escape
+# hatch — server/__main__ wires that env through force_scalar()).
+_FORCE_SCALAR = False
+
+
+class _PackedSpace:
+    """One candidate space's packed form: uint64[C, W] words (plus a
+    flat 1-D view when the grid fits one word — the common single-host
+    case, where the whole scan is a single numpy op against a scalar).
+    ``row_n`` is None for a per-size space; for the combined all-sizes
+    space it maps row → box volume, so one pass answers every size."""
+
+    __slots__ = ("words", "words1", "nwords", "nbytes", "row_n")
+
+    def __init__(self, words, nwords: int, nbytes: int, row_n=None):
+        self.words = words
+        self.words1 = words[:, 0] if nwords == 1 else None
+        self.nwords = nwords
+        self.nbytes = nbytes
+        self.row_n = row_n
+
+
+def kernel_mode() -> str:
+    """"vector" when the numpy kernel serves placement scans, else
+    "scalar" — the value behind tpu_placement_kernel_mode{mode}."""
+    return "vector" if (_np is not None and not _FORCE_SCALAR) else "scalar"
+
+
+def force_scalar(on: bool) -> None:
+    """Force the scalar kernel process-wide (parity oracles, the bench's
+    scalar arm, operator rollback). Republishes the mode gauge so a
+    fleet silently running the fallback is visible."""
+    global _FORCE_SCALAR
+    _FORCE_SCALAR = bool(on)
+    _publish_kernel_metrics()
+
+
+def numpy_or_none():
+    """The module's numpy (or None) — consumers that batch over hosts
+    (index column plane, scale_bench) share one gate with the kernel."""
+    return None if _FORCE_SCALAR else _np
+
+
+def _publish_kernel_metrics() -> None:
+    """Set the kernel observability gauges on BOTH registries (the
+    kernel runs in the daemon's PlacementState and in the extender's
+    index/defrag planes alike). Import is deferred: utils.metrics must
+    stay optional at placement-module import (the mesh.py idiom)."""
+    try:
+        from ..utils import metrics
+    except Exception:  # noqa: BLE001 — metrics plane optional here
+        return
+    mode = kernel_mode()
+    with _PACKED_LOCK:
+        count, nbytes = len(_PACKED), _PACKED_BYTES
+    for fam in metrics.PLACEMENT_KERNEL_MODE_FAMILIES:
+        for m in ("vector", "scalar", "native"):
+            fam.set(1 if m == mode else 0, mode=m)
+    for fam in metrics.PLACEMENT_SPACES_FAMILIES:
+        fam.set(count, unit="spaces")
+        fam.set(nbytes, unit="packed_bytes")
+
+
+def clear_packed_spaces() -> None:
+    """Flush the packed-space cache (benches measuring true cold costs;
+    tests)."""
+    global _PACKED_BYTES
+    with _PACKED_LOCK:
+        _PACKED.clear()
+        _PACKED_BYTES = 0
+
+
+def packed_space_stats() -> Tuple[int, int]:
+    """(cached spaces, packed bytes) — what the
+    ``tpu_placement_candidate_spaces`` gauge reports, readable
+    in-process for the bench/self-test."""
+    with _PACKED_LOCK:
+        return len(_PACKED), _PACKED_BYTES
+
+
+def _pack_rows(masks, bounds: Coord, row_n=None) -> _PackedSpace:
+    """Pack an iterable of Python-int bit masks into uint64 words, one
+    row per mask, little-endian word order."""
+    nbits = bounds[0] * bounds[1] * bounds[2]
+    nwords = max(1, (nbits + 63) // 64)
+    buf = b"".join(m.to_bytes(nwords * 8, "little") for m in masks)
+    words = _np.frombuffer(buf, dtype="<u8").reshape(-1, nwords)
+    nbytes = len(buf)
+    rn = None
+    if row_n is not None:
+        rn = _np.asarray(row_n, dtype=_np.int32)
+        nbytes += rn.nbytes
+    return _PackedSpace(words, nwords, nbytes, rn)
+
+
+def _store_packed(key: tuple, sp: _PackedSpace) -> _PackedSpace:
+    """Insert a freshly built space (first writer wins), evict FIFO past
+    the cap, publish gauges. Build-only — never on the hit path."""
+    global _PACKED_BYTES
+    with _PACKED_LOCK:
+        cur = _PACKED.get(key)
+        if cur is not None:
+            return cur
+        _PACKED[key] = sp
+        _PACKED_BYTES += sp.nbytes
+        while len(_PACKED) > _PACKED_MAX:
+            oldest = next(iter(_PACKED))
+            _PACKED_BYTES -= _PACKED.pop(oldest).nbytes
+    _publish_kernel_metrics()
+    return sp
+
+
+def _packed_space(
+    n: int, bounds: Coord, wraps: Tuple[bool, bool, bool]
+) -> Optional[_PackedSpace]:
+    """The packed words for one candidate space, built once and cached
+    beside box_candidates' tuple cache. None = use the scalar kernel
+    (numpy absent/forced off, or the space is empty)."""
+    if _np is None or _FORCE_SCALAR:
+        return None
+    key = (n, bounds, wraps)
+    sp = _PACKED.get(key)
+    if sp is not None:
+        return sp
+    cands = box_candidates(n, bounds, wraps)
+    if not cands:
+        return None
+    return _store_packed(key, _pack_rows((c.mask for c in cands), bounds))
+
+
+def _all_sizes_space(
+    bounds: Coord, wraps: Tuple[bool, bool, bool]
+) -> Optional[_PackedSpace]:
+    """EVERY candidate box of EVERY volume for one grid geometry,
+    stacked into a single [R, W] matrix with ``row_n[r]`` = row r's
+    volume. fragmentation_stats' descending largest-box scan and its
+    per-size placeable dict collapse to ONE pass over this matrix: the
+    fitting volumes are ``row_n[fits]``, largest = their max, placeable
+    = set membership. (A box of volume v can only fit a mask with
+    popcount >= v, so restricting the scan to n <= n_free — what the
+    scalar loop does — is automatic here.)"""
+    if _np is None or _FORCE_SCALAR:
+        return None
+    key = ("all", bounds, wraps)
+    sp = _PACKED.get(key)
+    if sp is not None:
+        return sp
+    nbits = bounds[0] * bounds[1] * bounds[2]
+    masks: List[int] = []
+    row_n: List[int] = []
+    for n in range(1, nbits + 1):
+        cands = box_candidates(n, bounds, wraps)
+        masks.extend(c.mask for c in cands)
+        row_n.extend([n] * len(cands))
+    if not masks:
+        return None
+    return _store_packed(key, _pack_rows(masks, bounds, row_n))
+
+
+def _mask_words(mask: int, nwords: int):
+    return _np.frombuffer(
+        mask.to_bytes(nwords * 8, "little"), dtype="<u8"
+    )
+
+
+def _fits_vector(sp: _PackedSpace, mask: int, nbits: int):
+    """bool[C]: candidate c lies entirely inside ``mask``. The single
+    vectorized pass the whole kernel reduces to. Single-word grids (any
+    host shape up to 64 chips) skip the bytes round-trip: the inverted
+    mask is one uint64 scalar and the scan is one AND + compare."""
+    inv = ~mask & ((1 << nbits) - 1)
+    if sp.words1 is not None:
+        return (sp.words1 & _np.uint64(inv)) == 0
+    inv_words = _mask_words(inv, sp.nwords)
+    return ~(_np.bitwise_and(sp.words, inv_words).any(axis=1))
 
 
 def placeable_box_sizes(chip_count: int) -> List[int]:
@@ -181,17 +413,118 @@ def placeable_box_sizes(chip_count: int) -> List[int]:
     return sizes
 
 
+def _mask_fits_scalar(
+    n: int, bounds: Coord, wraps: Tuple[bool, bool, bool], mask: int
+) -> bool:
+    """The scalar kernel: an any() over per-candidate Python-int masks.
+    Kept verbatim as the no-numpy fallback AND the parity oracle the
+    property tests / --placement-self-test drive the vector path
+    against."""
+    return any(
+        not (cand.mask & ~mask)
+        for cand in box_candidates(n, bounds, wraps)
+    )
+
+
 def _mask_fits(
     n: int, bounds: Coord, wraps: Tuple[bool, bool, bool], mask: int
 ) -> bool:
     """Does any precomputed n-box lie entirely inside ``mask``? The ONE
     membership test behind :func:`fragmentation_stats`,
     :func:`box_fits`, and (through them) the defrag planner's
-    stranded-demand scan — three consumers, one bit space."""
-    return any(
-        not (cand.mask & ~mask)
-        for cand in box_candidates(n, bounds, wraps)
+    stranded-demand scan — three consumers, one bit space. Vectorized:
+    all candidates score against the mask in a single packed-word
+    pass. Tiny spaces (C below _VECTOR_MIN_CANDS) stay on the scalar
+    early-exit loop, which beats numpy dispatch there."""
+    cands = box_candidates(n, bounds, wraps)
+    if len(cands) >= _VECTOR_MIN_CANDS:
+        sp = _packed_space(n, bounds, wraps)
+        if sp is not None:
+            nbits = bounds[0] * bounds[1] * bounds[2]
+            return bool(_fits_vector(sp, mask, nbits).any())
+    return _mask_fits_scalar(n, bounds, wraps, mask)
+
+
+def first_fit(
+    n: int,
+    bounds: Coord,
+    wraps: Tuple[bool, bool, bool],
+    mask: int,
+    must_bit: Optional[int] = None,
+) -> Optional[BoxCandidate]:
+    """The FIRST candidate (enumeration order — load-bearing, see
+    box_candidates) lying entirely inside ``mask`` and, when
+    ``must_bit`` is given, containing that bit. Vector path: one fits
+    pass, then argmax index recovery; scalar path: the original loop.
+    SliceView.best_gang's host-grid search rides this."""
+    cands = box_candidates(n, bounds, wraps)
+    sp = (
+        _packed_space(n, bounds, wraps)
+        if len(cands) >= _VECTOR_MIN_CANDS
+        else None
     )
+    if sp is None:
+        for cand in cands:
+            if cand.mask & ~mask:
+                continue
+            if must_bit is not None and not (cand.mask >> must_bit) & 1:
+                continue
+            return cand
+        return None
+    nbits = bounds[0] * bounds[1] * bounds[2]
+    fits = _fits_vector(sp, mask, nbits)
+    if must_bit is not None:
+        w, off = divmod(must_bit, 64)
+        has_bit = (
+            (sp.words[:, w] >> _np.uint64(off)) & _np.uint64(1)
+        ).astype(bool)
+        fits &= has_bit
+    if not fits.any():
+        return None
+    return cands[int(_np.argmax(fits))]
+
+
+def hosts_box_fits(
+    n: int,
+    bounds: Coord,
+    wraps: Tuple[bool, bool, bool],
+    masks: Sequence[int],
+) -> List[bool]:
+    """Batch form over HOSTS sharing one grid geometry: for each host
+    availability mask, does any n-box fit? One [H, C, W] pass instead
+    of H scalar scans — the defrag planner's stranded-demand scan and
+    the bench's gang-feasibility arm call this once per (geometry,
+    size) group instead of per host."""
+    if not masks:
+        return []
+    sp = _packed_space(n, bounds, wraps)
+    if sp is None:
+        return [
+            _mask_fits_scalar(n, bounds, wraps, m) for m in masks
+        ]
+    nbits = bounds[0] * bounds[1] * bounds[2]
+    full = (1 << nbits) - 1
+    if sp.words1 is not None:
+        # 1-word geometry (every per-host TPU mesh in practice): the
+        # masks load straight into a uint64 column — no per-host
+        # to_bytes round-trip — and ~m & full == m ^ full within the
+        # grid, so the inversion vectorizes too.
+        inv1 = _np.bitwise_xor(
+            _np.uint64(full), _np.array(masks, dtype=_np.uint64)
+        )
+        hits1 = (sp.words1[_np.newaxis, :] & inv1[:, _np.newaxis]) == 0
+        return hits1.any(axis=1).tolist()
+    buf = b"".join(
+        (~m & full).to_bytes(sp.nwords * 8, "little") for m in masks
+    )
+    inv = _np.frombuffer(buf, dtype="<u8").reshape(len(masks), sp.nwords)
+    # [H, C, W] — candidate words broadcast against per-host inverted
+    # masks; a candidate fits host h when no word intersects.
+    hits = ~(
+        _np.bitwise_and(sp.words[_np.newaxis, :, :], inv[:, _np.newaxis, :])
+        .any(axis=2)
+    )
+    return hits.any(axis=1).tolist()
 
 
 def box_fits(mesh: IciMesh, free_ids: Iterable[str], n: int) -> bool:
@@ -208,8 +541,7 @@ def box_fits(mesh: IciMesh, free_ids: Iterable[str], n: int) -> bool:
     if len(free) < n:
         return False
     mask = _pool_mask(mesh, free)
-    wraps = tuple(mesh._dim_wraps(mesh.bounds[d]) for d in range(3))
-    return _mask_fits(n, mesh.bounds, wraps, mask)
+    return _mask_fits(n, mesh.bounds, mesh.wraps, mask)
 
 
 def fragmentation_stats(mesh: IciMesh, free_ids: Iterable[str]) -> dict:
@@ -236,23 +568,38 @@ def fragmentation_stats(mesh: IciMesh, free_ids: Iterable[str]) -> dict:
             "placeable": {n: False for n in sizes},
         }
     mask = _pool_mask(mesh, free)
-    wraps = tuple(mesh._dim_wraps(mesh.bounds[d]) for d in range(3))
+    wraps = mesh.wraps
 
-    def fits(n: int) -> bool:
-        return _mask_fits(n, mesh.bounds, wraps, mask)
+    sp = _all_sizes_space(mesh.bounds, wraps)
+    if sp is not None:
+        # One pass over every box of every volume: the descending
+        # largest-box scan and the per-size placeable dict both read
+        # off the fitting rows' volumes. (n <= largest does NOT imply
+        # an n-box fits — a free 3x3x3 region holds 27 chips but no
+        # 16-box — which is why placeable is set membership, not a
+        # threshold.)
+        nbits = mesh.bounds[0] * mesh.bounds[1] * mesh.bounds[2]
+        ns = sp.row_n[_fits_vector(sp, mask, nbits)]
+        largest = int(ns.max()) if ns.size else 0
+        fit_sizes = set(ns.tolist())
+        placeable = {n: n in fit_sizes for n in sizes}
+    else:
+        def fits(n: int) -> bool:
+            return _mask_fits_scalar(n, mesh.bounds, wraps, mask)
 
-    largest = 0
-    for n in range(n_free, 0, -1):
-        if fits(n):
-            largest = n
-            break
+        largest = 0
+        for n in range(n_free, 0, -1):
+            if fits(n):
+                largest = n
+                break
+        # Independently tested per size: see the set-membership note
+        # above.
+        placeable = {n: fits(n) for n in sizes}
     return {
         "free": n_free,
         "largest_box": largest,
         "fragmentation": round(1.0 - largest / n_free, 4),
-        # Independently tested per size: n <= largest does NOT imply an
-        # n-box fits (a free 3x3x3 region holds 27 chips but no 16-box).
-        "placeable": {n: fits(n) for n in sizes},
+        "placeable": placeable,
     }
 
 
@@ -431,10 +778,30 @@ class PlacementState:
         # allocator must read the identical bit space).
         pool_mask = _pool_mask(mesh, pool)
         must_mask = _pool_mask(mesh, must)
-        wraps = tuple(mesh._dim_wraps(mesh.bounds[d]) for d in range(3))
+        wraps = mesh.wraps
+        cands = box_candidates(n, mesh.bounds, wraps)
+        sp = (
+            _packed_space(n, mesh.bounds, wraps)
+            if len(cands) >= _VECTOR_MIN_CANDS
+            else None
+        )
+        if sp is not None:
+            # Vector pre-pass: the availability test — the hot line of
+            # the old candidate walk — runs over ALL candidates at
+            # once; only the (typically few) survivors pay the scalar
+            # frag/ids scoring below, which preserves the exact
+            # (-links, frag, sorted ids) total order including the
+            # duplicate-edge border counting a popcount couldn't.
+            nbits = mesh.bounds[0] * mesh.bounds[1] * mesh.bounds[2]
+            fits = _fits_vector(sp, pool_mask, nbits)
+            survivors: Iterable[BoxCandidate] = (
+                cands[i] for i in _np.nonzero(fits)[0]
+            )
+        else:
+            survivors = cands
         best_key: Optional[Tuple[int, int]] = None
         best_ids: Optional[Tuple[str, ...]] = None
-        for cand in box_candidates(n, mesh.bounds, wraps):
+        for cand in survivors:
             if cand.mask & ~pool_mask:
                 continue  # some member coord unavailable (or chipless)
             if must_mask & ~cand.mask:

@@ -25,7 +25,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
-from .placement import box_candidates, ideal_box_links
+from .mesh import linear_index
+from .placement import first_fit, ideal_box_links
 from .schema import NodeTopology
 from ..utils.logging import get_logger
 
@@ -130,19 +131,27 @@ class SliceView:
                     break
             if must_coord is None or must_coord not in free:
                 return [], 0
-        # Precomputed host-grid box space (placement.box_candidates):
-        # first fully-free candidate wins, and the enumeration order
-        # (cube-like shapes first, then offsets) is the same one the
-        # live nested loop walked. Host grids model no wrap links.
-        for cand in box_candidates(k, self.bounds):
-            if must_coord is not None and must_coord not in cand.coords:
-                continue
-            if all(c in free for c in cand.coords):
-                return (
-                    [self.by_coords[c].hostname for c in cand.coords],
-                    cand.links,
-                )
-        return [], 0
+        # Precomputed host-grid box space via the vectorized kernel:
+        # the free set becomes a bit mask (mesh.linear_index — the ONE
+        # linearization), all candidates score in one packed pass, and
+        # first-fit index recovery preserves the enumeration order
+        # (cube-like shapes first, then offsets) the live nested loop
+        # walked. Host grids model no wrap links.
+        mask = 0
+        for c in free:
+            mask |= 1 << linear_index(c, self.bounds)
+        must_bit = (
+            linear_index(must_coord, self.bounds)
+            if must_coord is not None
+            else None
+        )
+        cand = first_fit(k, self.bounds, (False, False, False), mask, must_bit)
+        if cand is None:
+            return [], 0
+        return (
+            [self.by_coords[c].hostname for c in cand.coords],
+            cand.links,
+        )
 
     def gang_score(self, k: int, hostname: str, max_score: int = 10) -> int:
         """0..max_score quality of the best k-gang containing hostname:

@@ -269,6 +269,24 @@ HEALTH_TRANSITIONS = REGISTRY.counter(
     "tpu_plugin_health_transitions_total",
     "Chip health transitions by direction",
 )
+# Placement-kernel observability (topology/placement.py): registered on
+# BOTH registries — the kernel serves the daemon's PlacementState and
+# the extender's index/defrag/admission planes alike, and a fleet
+# silently running the scalar fallback must be visible from either
+# scrape. placement._publish_kernel_metrics() writes the whole family
+# list in one call.
+PLACEMENT_KERNEL_MODE = REGISTRY.gauge(
+    "tpu_placement_kernel_mode",
+    "1 on the active placement-kernel mode series (mode=vector/scalar/"
+    "native), 0 on the others — scalar sustained in a fleet that ships "
+    "numpy means the vectorized box search silently fell back",
+)
+PLACEMENT_SPACES = REGISTRY.gauge(
+    "tpu_placement_candidate_spaces",
+    "Packed (n, bounds, wraps) candidate spaces currently cached by the "
+    "vectorized placement kernel, by unit (spaces = cached space count, "
+    "packed_bytes = resident uint64 word bytes)",
+)
 COORD_MISMATCHES = REGISTRY.counter(
     "tpu_plugin_coord_assumption_mismatches_total",
     "Chips whose driver-published ICI coordinates contradicted the "
@@ -908,6 +926,26 @@ STATE_COMPACTIONS = EXTENDER_REGISTRY.counter(
     "Admission-state snapshot compactions (tmp+fsync+rename then "
     "journal truncate), by outcome (ok/error)",
 )
+# Extender-process instances of the placement-kernel instruments (same
+# family names on purpose — one dashboard row covers both components).
+EXT_PLACEMENT_KERNEL_MODE = EXTENDER_REGISTRY.gauge(
+    "tpu_placement_kernel_mode",
+    "1 on the active placement-kernel mode series (mode=vector/scalar/"
+    "native), 0 on the others — scalar sustained in a fleet that ships "
+    "numpy means the vectorized box search silently fell back",
+)
+EXT_PLACEMENT_SPACES = EXTENDER_REGISTRY.gauge(
+    "tpu_placement_candidate_spaces",
+    "Packed (n, bounds, wraps) candidate spaces currently cached by the "
+    "vectorized placement kernel, by unit (spaces = cached space count, "
+    "packed_bytes = resident uint64 word bytes)",
+)
+# The lists placement._publish_kernel_metrics() iterates: one write
+# updates both daemons' registries (whichever this process runs).
+PLACEMENT_KERNEL_MODE_FAMILIES = (
+    PLACEMENT_KERNEL_MODE, EXT_PLACEMENT_KERNEL_MODE,
+)
+PLACEMENT_SPACES_FAMILIES = (PLACEMENT_SPACES, EXT_PLACEMENT_SPACES)
 # Cluster capacity/fragmentation aggregate (extender/index.py): how many
 # nodes could place a contiguous box of each request size RIGHT NOW,
 # maintained incrementally as index entries change — the "why can't a

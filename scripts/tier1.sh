@@ -114,6 +114,14 @@ python -m k8s_device_plugin_tpu.utils.resilience --resilience-self-test \
 # the rule id named, before the pytest gate.
 python -m k8s_device_plugin_tpu.tools.lint --self-test > /dev/null \
   || { echo "tools/lint.py --self-test FAILED"; exit 1; }
+# Placement-kernel + holds-codec smoke: pack a candidate space, scan
+# it vectorized, cross-check every verdict against the scalar oracle
+# (exhaustive over the 2x4x1 grid), check first-fit order recovery,
+# and round-trip the binary shard-holds overlay (scale_bench
+# --placement-self-test) — a kernel or wire-format drift fails CI
+# here, before the pytest gate.
+python -m k8s_device_plugin_tpu.extender.scale_bench --placement-self-test > /dev/null \
+  || { echo "scale_bench --placement-self-test FAILED"; exit 1; }
 # Repo lint gate: zero NEW findings (baseline'd exceptions carry
 # justifications in analysis/baseline.json) — an unsupervised thread,
 # an undocumented metric/kind/span/debug-endpoint, blocking work

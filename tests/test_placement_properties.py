@@ -331,3 +331,128 @@ def test_free_27_does_not_imply_16_placeable():
     }
     assert box_fits(cube, cube.ids, 8)  # the 2×2×2 corner
     assert not box_fits(cube, cube.ids, 16)
+
+
+# ---------------------------------------------------------------------------
+# Vector/scalar kernel parity (PR 17). The vectorized packed-word kernel
+# and the original scalar loop must be indistinguishable to every
+# consumer: same fits verdicts, same FIRST-fit candidate (enumeration
+# order is load-bearing), same fragmentation stats — on every randomly
+# generated case, not just the curated shapes above.
+# ---------------------------------------------------------------------------
+
+from k8s_device_plugin_tpu.topology import placement as pl
+
+
+@pytest.fixture()
+def _scalar_toggle():
+    """Restore the kernel mode and packed cache around each parity test."""
+    yield
+    pl.force_scalar(False)
+    pl.clear_packed_spaces()
+
+
+def _both_kernels(fn):
+    """Run fn() under the vector kernel, then the scalar kernel."""
+    pl.force_scalar(False)
+    vec = fn()
+    pl.force_scalar(True)
+    sca = fn()
+    pl.force_scalar(False)
+    return vec, sca
+
+
+GEOMETRIES = [
+    # (bounds, wraps) spanning 1-word and multi-word packed spaces
+    ((2, 2, 1), (False, False, False)),
+    ((4, 4, 4), (True, True, True)),       # v4/v5p 64-chip torus: 64 bits
+    ((4, 4, 8), (True, True, True)),       # 128 bits -> 2 words
+    ((8, 16, 1), (False, False, False)),   # v5e slice grid: 128 bits
+    ((3, 3, 3), (False, False, False)),    # the 27-cube regression shape
+    ((16, 16, 1), (False, False, False)),  # 256 bits -> 4 words
+]
+
+
+@pytest.mark.parametrize("bounds,wraps", GEOMETRIES)
+def test_kernel_parity_mask_fits(bounds, wraps, _scalar_toggle):
+    if pl.numpy_or_none() is None:
+        pytest.skip("numpy unavailable; scalar is the only kernel")
+    nbits = bounds[0] * bounds[1] * bounds[2]
+    rng = random.Random(hash(bounds) & 0xFFFF)
+    for _ in range(40):
+        mask = rng.getrandbits(nbits)
+        for n in (1, 2, 4, 8, 16, 32):
+            if n > nbits:
+                continue
+            vec, sca = _both_kernels(
+                lambda: pl._mask_fits(n, bounds, wraps, mask)
+            )
+            assert vec == sca, (bounds, wraps, n, hex(mask))
+            assert sca == pl._mask_fits_scalar(n, bounds, wraps, mask)
+
+
+@pytest.mark.parametrize("bounds,wraps", GEOMETRIES)
+def test_kernel_parity_first_fit_order(bounds, wraps, _scalar_toggle):
+    """First-fit must return the SAME candidate either way — candidate
+    enumeration order is part of the placement policy, and index
+    recovery from the fits vector must not reorder it."""
+    if pl.numpy_or_none() is None:
+        pytest.skip("numpy unavailable; scalar is the only kernel")
+    nbits = bounds[0] * bounds[1] * bounds[2]
+    rng = random.Random(0xF1F + nbits)
+    for _ in range(40):
+        mask = rng.getrandbits(nbits)
+        must = rng.choice([None, rng.randrange(nbits)])
+        for n in (2, 4, 8):
+            if n > nbits:
+                continue
+            vec, sca = _both_kernels(
+                lambda: pl.first_fit(n, bounds, wraps, mask, must)
+            )
+            if sca is None:
+                assert vec is None, (bounds, n, hex(mask), must)
+            else:
+                assert vec is not None
+                assert vec.mask == sca.mask
+                assert vec.coords == sca.coords
+
+
+@pytest.mark.parametrize("bounds,wraps", GEOMETRIES)
+def test_kernel_parity_hosts_batch(bounds, wraps, _scalar_toggle):
+    if pl.numpy_or_none() is None:
+        pytest.skip("numpy unavailable; scalar is the only kernel")
+    nbits = bounds[0] * bounds[1] * bounds[2]
+    rng = random.Random(0xBA7C4 + nbits)
+    masks = [rng.getrandbits(nbits) for _ in range(37)]
+    for n in (2, 4, 8):
+        if n > nbits:
+            continue
+        vec, sca = _both_kernels(
+            lambda: pl.hosts_box_fits(n, bounds, wraps, masks)
+        )
+        assert vec == sca
+        assert sca == [
+            pl._mask_fits_scalar(n, bounds, wraps, m) for m in masks
+        ]
+
+
+@pytest.mark.parametrize("chip_type,count", SHAPES)
+def test_kernel_parity_fragmentation_stats(chip_type, count, _scalar_toggle):
+    """The one-pass all-sizes vector path must reproduce the scalar
+    descending scan exactly: largest_box, fragmentation ratio, and the
+    full per-size placeable dict."""
+    if pl.numpy_or_none() is None:
+        pytest.skip("numpy unavailable; scalar is the only kernel")
+    mesh = mesh_of(chip_type, count)
+    rng = random.Random(0x57A75 + count)
+    for _ in range(30):
+        k = rng.randrange(0, count + 1)
+        free = rng.sample(list(mesh.ids), k)
+        vec, sca = _both_kernels(
+            lambda: pl.fragmentation_stats(mesh, free)
+        )
+        assert vec == sca, (chip_type, sorted(free))
+        v_sizes, s_sizes = _both_kernels(
+            lambda: pl.placeable_sizes(mesh, free)
+        )
+        assert v_sizes == s_sizes

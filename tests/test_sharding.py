@@ -13,6 +13,7 @@ import types
 import pytest
 
 from k8s_device_plugin_tpu import audit
+from k8s_device_plugin_tpu.extender import holdscodec
 from k8s_device_plugin_tpu.extender import journal as jr
 from k8s_device_plugin_tpu.extender import sharding
 from k8s_device_plugin_tpu.extender.gang import GATE_NAME, GangAdmission
@@ -319,7 +320,8 @@ def test_peer_holds_flow_through_lease_annotation(api):
         ann = server.leases[
             ("kube-system", f"{LEASE_NAME}-shard-0")
         ]["metadata"].get("annotations", {})
-        recs = json.loads(ann[HOLDS_ANNOTATION])
+        assert ann[HOLDS_ANNOTATION].startswith("tpb1:")  # binary wire
+        recs = holdscodec.decode_holds(ann[HOLDS_ANNOTATION])
         assert recs == [
             {"namespace": "default", "gang": "g", "hosts": {"n1": 4}}
         ]
@@ -466,18 +468,21 @@ def test_holds_annotation_degrades_at_size_ceiling(api, monkeypatch):
         table = m._owned[0].admission.reservations
         table.reserve(("default", "a"), {"n1": 2, "n2": 1})
         table.reserve(("default", "b"), {"n1": 1})
-        payload = m._holds_payload_fn(0)()
-        assert len(json.loads(payload[HOLDS_ANNOTATION])) == 2
+        full_raw = m._holds_payload_fn(0)()[HOLDS_ANNOTATION]
+        assert len(holdscodec.decode_holds(full_raw)) == 2
+        # Pin the ceiling just under the measured full payload so the
+        # aggregation tier triggers regardless of wire density.
         monkeypatch.setattr(
-            sharding, "MAX_HOLDS_ANNOTATION_BYTES", 90
+            sharding, "MAX_HOLDS_ANNOTATION_BYTES", len(full_raw) - 1
         )
-        agg = json.loads(m._holds_payload_fn(0)()[HOLDS_ANNOTATION])
+        agg_raw = m._holds_payload_fn(0)()[HOLDS_ANNOTATION]
+        agg = holdscodec.decode_holds(agg_raw)
         assert agg == [
             {"namespace": "", "gang": "",
              "hosts": {"n1": 3, "n2": 1}}
         ]
         monkeypatch.setattr(
-            sharding, "MAX_HOLDS_ANNOTATION_BYTES", 10
+            sharding, "MAX_HOLDS_ANNOTATION_BYTES", len(agg_raw) - 1
         )
         # Explicitly EMPTY, never omitted: the lease-annotation merge
         # can't delete keys, so omission would leave the last
@@ -614,7 +619,7 @@ def test_fresh_reserve_wakes_immediate_publish(api):
         ann = server.leases[
             ("kube-system", f"{LEASE_NAME}-shard-0")
         ]["metadata"]["annotations"]
-        assert json.loads(ann[HOLDS_ANNOTATION]) == [
+        assert holdscodec.decode_holds(ann[HOLDS_ANNOTATION]) == [
             {"namespace": "default", "gang": "g", "hosts": {"n1": 4}}
         ]
         assert ann["tpu.google.com/home-shard"] == "0"
