@@ -103,7 +103,20 @@ more complete):
                                identical fixtures) + parity verdict
                                (sub-ms p99 and >=3x speedup gated in
                                tests/test_scale_bench.py)
-  detail.grant     every chip-grant probe attempt
+  detail.scheduling_quality    decision quality: the three canned
+                               traces (tests/sim_traces/) replayed
+                               through the real admission/preemption/
+                               defrag stack (extender/simulator.py) —
+                               per-tier time-to-admit, utilization,
+                               fragmentation, preemption churn, defrag
+                               efficiency, golden-baseline deltas, and
+                               a byte-identical-replay determinism
+                               verdict (bounds in
+                               tests/test_scale_bench.py)
+  detail.grant     every chip-grant probe attempt; on a shared box the
+                   loop stops after the FIRST failed attempt and hands
+                   the budget to control-plane probes
+                   (TPU_BENCH_FORCE_GRANT=1 restores retry-until-budget)
   detail.workload.mfu   model FLOPs/step ÷ step time ÷ chip peak bf16
   detail.workload_chunked_xent.vs_plain_step   chunked-vocab CE A/B
   detail.kernels   flash/rmsnorm vs XLA-dense comparisons
@@ -464,6 +477,25 @@ class GrantProbe:
                 }
                 return
             attempts.append({"ok": False, "error": err or "no devices"})
+            # One honest attempt is the signal on a shared box: BENCH
+            # r03-r05 all burned ~150s of the 260s budget retrying a
+            # grant that never arrives ("chip held by a co-tenant")
+            # and starved the control-plane probes that DO produce
+            # numbers. Stop after the first refusal and hand the
+            # budget back; TPU_BENCH_FORCE_GRANT=1 restores the
+            # retry-until-budget loop for boxes where a grant window
+            # is actually expected.
+            if os.environ.get("TPU_BENCH_FORCE_GRANT") != "1":
+                self.grant = {
+                    "ok": False,
+                    "attempts": attempts,
+                    "waited_s": round(time.monotonic() - t0, 1),
+                    "stopped": "first grant attempt failed; retries "
+                    "skipped, budget handed to control-plane probes "
+                    "(TPU_BENCH_FORCE_GRANT=1 restores the retry "
+                    "loop)",
+                }
+                return
             time.sleep(
                 min(PROBE_SLEEP_S, max(_smoke_budget_left() - 45, 0))
             )
@@ -656,6 +688,20 @@ def run_kernels(grant_ok: bool = True, emit=None, micro=None) -> dict:
             emit(state)
     if micro is not None and not _has_kernel_numbers(micro):
         micro = None
+    if (
+        micro is None
+        and not grant_ok
+        and os.environ.get("TPU_BENCH_FORCE_GRANT") != "1"
+    ):
+        # The smoke's probe already failed its one grant attempt this
+        # round: more sub-windows against the same held chip are the
+        # r03-r05 budget burn. Skip the tier and leave the budget to
+        # the control-plane probes (the hatch restores the windows).
+        return {
+            "skipped": "no grant this round; kernel sub-windows "
+            "skipped (TPU_BENCH_FORCE_GRANT=1 restores them)",
+            "attempts": attempts,
+        }
     while micro is None and len(attempts) < KERNEL_MAX_ATTEMPTS:
         left = _budget_left() - 5
         if left < 20:
@@ -928,6 +974,26 @@ def main() -> int:
             )
         except Exception as e:  # noqa: BLE001
             result["detail"]["placement_kernel"] = {
+                "error": repr(e)[:400]
+            }
+        emit()
+        # Phase 1.14: scheduling-quality probe (ISSUE 18 — the three
+        # canned traces replayed through the real admission/
+        # preemption/defrag stack by extender/simulator.py, scored
+        # for time-to-admit per tier, utilization, fragmentation,
+        # preemption churn, and defrag efficiency, plus a replay
+        # determinism check; scores are bounded in
+        # tests/test_scale_bench.py and compared against the golden
+        # baseline. This is control-plane work — it runs on the
+        # budget the grant probe's fail-fast hands back).
+        try:
+            from k8s_device_plugin_tpu.extender import simulator
+
+            result["detail"]["scheduling_quality"] = (
+                simulator.scheduling_quality()
+            )
+        except Exception as e:  # noqa: BLE001
+            result["detail"]["scheduling_quality"] = {
                 "error": repr(e)[:400]
             }
         emit()

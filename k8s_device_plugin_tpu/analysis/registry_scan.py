@@ -198,6 +198,37 @@ def metric_family_sites(
     return out
 
 
+def local_registry_family_sites(
+    files: Optional[Iterable[str]] = None,
+) -> List[Site]:
+    """Registration sites of ``tpu_*`` families on receivers that do
+    NOT follow the ``*REGISTRY`` module-global convention — transient
+    bench/simulator/test registries (``self._reg.counter(...)``,
+    ``reg = Registry(); reg.gauge(...)``). These are deliberately
+    invisible to the :func:`metric_family_sites` inventory (and so to
+    TPL003's docs lockstep); TPL011 checks they don't MINT a name that
+    collides with a production family — a local series with a
+    production name would poison any dashboard the two ever meet on
+    (the scrape can't tell a simulated count from a real one)."""
+    out: List[Site] = []
+    for path in files or package_files():
+        tree = parse_file(path)
+        for call in _iter_calls(tree):
+            func = call.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr in _REGISTER_METHODS
+            ):
+                continue
+            owner = _dotted(func.value)
+            if not owner or owner.endswith("REGISTRY"):
+                continue
+            fam = _const_str(call.args[0] if call.args else None)
+            if fam and fam.startswith("tpu_"):
+                out.append((fam, relpath(path), call.lineno))
+    return out
+
+
 def uptime_families(files: Optional[Iterable[str]] = None) -> Set[str]:
     """Families rendered by ``Registry.render`` without registration:
     every ``uptime_name=`` constant (keyword arguments at ``Registry``

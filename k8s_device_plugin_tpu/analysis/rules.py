@@ -36,6 +36,12 @@ TPL010    raw-kube-call            an apiserver hop that bypasses the
                                    resilience wrapper (no deadline,
                                    no retry budget, no breaker — the
                                    PR 16 hostile-apiserver class)
+TPL011    sim-metric-collision     a family registered on a local
+                                   (bench/simulator) registry reusing
+                                   a production family name — a
+                                   simulated series would poison the
+                                   dashboards the real one feeds
+                                   (the PR 18 simulator class)
 ========  =======================  ==================================
 
 Suppression: ``# tpu-lint: disable=TPL006`` on the offending line (or
@@ -142,6 +148,18 @@ RULES: Tuple[Rule, ...] = (
         "crashes the caller instead of degrading it",
         "PR 16 (hostile-apiserver resilience: every kube hop must "
         "ride utils/resilience)",
+    ),
+    Rule(
+        "TPL011", "sim-metric-collision",
+        "a tpu_* family registered on a LOCAL registry (a receiver "
+        "not ending in `REGISTRY` — the bench/simulator transient-"
+        "registry convention, invisible to the TPL003 inventory) "
+        "reuses a production family name — the scrape cannot tell a "
+        "simulated series from the real one, so a sim run inside a "
+        "live process would poison every dashboard and alert the "
+        "production family feeds",
+        "PR 18 (scheduling-quality simulator mints tpu_sim_* series "
+        "on run-local registries next to the production families)",
     ),
 )
 
@@ -640,6 +658,34 @@ def run_rules(
                     f"family left its row behind)",
                     key=f"ghost:{ghost}",
                 ))
+
+    if "TPL011" in want:
+        # Production inventory from the same scan scope; a narrowed
+        # run (fixtures) that carries no *REGISTRY site judges against
+        # the real package inventory, like TPL008's index fallback.
+        prod_sites = scan.metric_family_sites(file_list)
+        if not prod_sites and not full_repo:
+            prod_sites = scan.metric_family_sites()
+        production = {v for v, _p, _l in prod_sites}
+        seen_collide: Set[str] = set()
+        for fam, rel, line in scan.local_registry_family_sites(
+            file_list
+        ):
+            if fam not in production or fam in seen_collide:
+                continue
+            seen_collide.add(fam)
+            out.append(LintFinding(
+                "TPL011", rel, line,
+                f"local-registry family `{fam}` collides with a "
+                f"production family of the same name — a series "
+                f"minted on a bench/simulator registry is "
+                f"indistinguishable from the real one at scrape "
+                f"time and would poison its dashboards; rename the "
+                f"local family (the simulator uses tpu_sim_run_* "
+                f"for run-local series) or register it on the "
+                f"production registry and document it",
+                key=f"collide:{fam}",
+            ))
 
     if "TPL004" in want or "TPL005" in want:
         documented = scan.documented_backticked(

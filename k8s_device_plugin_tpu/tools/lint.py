@@ -195,6 +195,23 @@ _SEEDS = [
         "        return self.resilience.call(\n"
         "            lambda: self._attempt('GET', path))\n",
     ),
+    (
+        "TPL011",
+        "FIXTURE_REGISTRY = None\n"
+        "PROD = FIXTURE_REGISTRY.counter(\n"
+        "    'tpu_selftest_sim_score_total', 'prod')\n"
+        "def run_sim(factory):\n"
+        "    reg = factory()\n"
+        "    return reg.counter(\n"
+        "        'tpu_selftest_sim_score_total', 'collides')\n",
+        "FIXTURE_REGISTRY = None\n"
+        "PROD = FIXTURE_REGISTRY.counter(\n"
+        "    'tpu_selftest_sim_score_total', 'prod')\n"
+        "def run_sim(factory):\n"
+        "    reg = factory()\n"
+        "    return reg.counter(\n"
+        "        'tpu_selftest_sim_run_events_total', 'run-local')\n",
+    ),
 ]
 
 

@@ -1,0 +1,15 @@
+"""tpu-simreport — scheduling-quality scorecards from trace replay.
+
+Thin alias: ``python -m k8s_device_plugin_tpu.tools.simreport``. The
+implementation (trace loading, the deterministic replay through the
+real admission/preemption/defrag stack, golden-baseline deltas, and
+the /debug/simreport fetcher) lives in ``extender/simulator.py`` next
+to the stack it exercises.
+"""
+
+from ..extender.simulator import main
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -122,6 +122,15 @@ python -m k8s_device_plugin_tpu.tools.lint --self-test > /dev/null \
 # here, before the pytest gate.
 python -m k8s_device_plugin_tpu.extender.scale_bench --placement-self-test > /dev/null \
   || { echo "scale_bench --placement-self-test FAILED"; exit 1; }
+# Scheduling-quality simulator smoke: replay a tiny two-node burst
+# through the REAL admission/preemption/defrag stack at virtual time,
+# prove the replay is byte-deterministic, that the critical tier
+# preempted its way in, and that publish/prune round-trips the
+# tpu_sim_* families (extender/simulator.py --self-test) — a decision
+# or scorecard-format drift fails CI here, before the golden-baseline
+# gate in tests/test_scale_bench.py.
+python -m k8s_device_plugin_tpu.extender.simulator --self-test > /dev/null \
+  || { echo "extender/simulator.py --self-test FAILED"; exit 1; }
 # Repo lint gate: zero NEW findings (baseline'd exceptions carry
 # justifications in analysis/baseline.json) — an unsupervised thread,
 # an undocumented metric/kind/span/debug-endpoint, blocking work

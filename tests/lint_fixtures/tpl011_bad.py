@@ -1,0 +1,19 @@
+"""TPL011 seeded violation: a bench/simulator-local registry minting
+a family name the production registry already owns. Parsed by the
+lint engine, never imported (tests/lint_fixtures/README.md) — the
+fixture carries its own production-style ``*REGISTRY`` site so the
+collision is judged inside this file, the way the self-test's
+narrowed scan runs it."""
+
+FIXTURE_REGISTRY = None
+PROD = FIXTURE_REGISTRY.counter(
+    "tpu_selftest_sim_score_total", "the production family"
+)
+
+
+def run_sim(registry_factory):
+    reg = registry_factory()
+    local = reg.counter(  # LINT-EXPECT: TPL011
+        "tpu_selftest_sim_score_total", "same name, local registry"
+    )
+    return local

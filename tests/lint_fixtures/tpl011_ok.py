@@ -1,0 +1,18 @@
+"""TPL011 clean twin: the run-local registry names its family in its
+own namespace (the simulator's ``tpu_sim_run_*`` convention), so the
+production family and the per-run series can never be confused at
+scrape time."""
+
+FIXTURE_REGISTRY = None
+PROD = FIXTURE_REGISTRY.counter(
+    "tpu_selftest_sim_score_total", "the production family"
+)
+
+
+def run_sim(registry_factory):
+    reg = registry_factory()
+    local = reg.counter(
+        "tpu_selftest_sim_run_events_total",
+        "run-local series, run-local name",
+    )
+    return local

@@ -581,3 +581,89 @@ def test_placement_kernel_probe_bound_and_schema():
         if not problems:
             return
     assert not last[0], last
+
+def test_scheduling_quality_probe_bound_and_schema():
+    """Decision-quality probe (ISSUE 18 acceptance): replay the three
+    canned traces through the real admission/preemption/defrag stack
+    and bound the DECISIONS, not the latency — tier-ordered
+    time-to-admit on the priority burst, a utilization floor on the
+    steady mix (measured 0.916 on the dev host; 0.6 is the tripwire),
+    a defrag-efficiency floor on the churn/strand trace (measured
+    1.33 chips recovered per eviction; 0.5 is the tripwire), and the
+    byte-identical determinism proof. The replay is deterministic so
+    there is no re-run loop: a failure here is a policy change, not
+    host contention. Sim metric series are pruned after (probe
+    hygiene — the families stay registered, the series do not)."""
+    from k8s_device_plugin_tpu.extender import simulator
+    from k8s_device_plugin_tpu.utils import metrics as m
+
+    try:
+        r = simulator.scheduling_quality()
+    finally:
+        simulator.prune_metrics()
+    assert set(r["traces"]) == set(simulator.CANNED_TRACES)
+    assert r["golden_found"] is True
+    assert r["deterministic"] is True, r.get("determinism_sha256")
+    for name, card in r["traces"].items():
+        assert card["schema"] == simulator.SCORECARD_SCHEMA, name
+        assert card["trace"] == name
+
+    problems = []
+
+    # priority_burst: tiers are admitted in priority order — the
+    # critical gang preempts its way in fastest, batch waits longest.
+    tiers = r["traces"]["priority_burst"]["time_to_admit_s"]
+    order = ["critical", "high", "standard", "batch"]
+    missing = [t for t in order if t not in tiers]
+    if missing:
+        problems.append(f"priority_burst missing tiers: {missing}")
+    else:
+        p50s = [tiers[t]["p50_s"] for t in order]
+        if sorted(p50s) != p50s:
+            problems.append(
+                f"time-to-admit not tier-ordered: {dict(zip(order, p50s))}"
+            )
+        if r["traces"]["priority_burst"]["score"][
+            "preemption_churn_cost"
+        ] <= 0:
+            problems.append(
+                "priority_burst paid no restart cost — preemption "
+                "never fired, so the tier ordering is coincidental"
+            )
+
+    # steady_mixed: the packed mix keeps the cluster busy.
+    util = r["traces"]["steady_mixed"]["score"]["utilization"]
+    if util < 0.6:
+        problems.append(f"steady_mixed utilization {util} < 0.6 floor")
+
+    # churn_strand: defrag recovers more placeability than it spends.
+    eff = r["traces"]["churn_strand"]["score"][
+        "defrag_efficiency_chips_per_eviction"
+    ]
+    if eff < 0.5:
+        problems.append(
+            f"churn_strand defrag efficiency {eff} chips/eviction "
+            f"< 0.5 floor"
+        )
+
+    # Golden gate: a replay of the committed traces on the committed
+    # code matches the committed baseline exactly.
+    for name, deltas in r["deltas"].items():
+        drift = {k: v for k, v in deltas.items() if v != 0}
+        if drift:
+            problems.append(f"{name} drifted from golden: {drift}")
+
+    assert not problems, (problems, {
+        n: c["score"] for n, c in r["traces"].items()
+    })
+    # Hygiene: the probe pruned its series on the shared registry.
+    for fam in (
+        m.SIM_RUNS,
+        m.SIM_TIME_TO_ADMIT,
+        m.SIM_UTILIZATION,
+        m.SIM_FRAGMENTATION,
+        m.SIM_PREEMPTION_CHURN,
+        m.SIM_DEFRAG_EFFICIENCY,
+        m.SIM_BASELINE_DELTA,
+    ):
+        assert fam.series() == []
