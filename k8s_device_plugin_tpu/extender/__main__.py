@@ -293,6 +293,27 @@ def main() -> int:
         help="directory for flight-recorder dumps on SIGTERM/circuit-"
         "break; empty keeps the ring in-memory/HTTP only",
     )
+    p.add_argument(
+        "--blackbox-dir",
+        default=os.environ.get("TPU_BLACKBOX_DIR", ""),
+        help="directory for the crash-durable black box "
+        "(utils/blackbox.py; also TPU_BLACKBOX_DIR): flight events, "
+        "ledger decisions, spans, and periodic heartbeat/metric "
+        "snapshots stream into checksummed, segment-rotated files a "
+        "kill -9 cannot destroy (read with tpu-doctor postmortem). "
+        "Implies the flight recorder. Empty disables the recorder "
+        "entirely (no files, no thread)",
+    )
+    p.add_argument(
+        "--blackbox-fsync-s", type=float,
+        default=float(
+            os.environ.get("TPU_BLACKBOX_FSYNC_S", "2") or 2
+        ),
+        help="black-box fsync cadence in seconds (also "
+        "TPU_BLACKBOX_FSYNC_S): the stream is flushed every drain "
+        "tick regardless; 0 fsyncs every drain (max durability, max "
+        "I/O)",
+    )
     p.add_argument("-v", "--verbose", action="count", default=0)
     a = p.parse_args()
     tpulog.setup(
@@ -335,6 +356,20 @@ def main() -> int:
         service="extender",
         on_stall=profiling.CAPTURE.heartbeat_stall,
     ).start()
+    # Crash-durable black box: taps the flight/ledger/span planes into
+    # statestore-framed segments under --blackbox-dir. The flight
+    # recorder is implied (a black box with nothing flowing into it
+    # records only heartbeat/metric snapshots).
+    from ..utils.blackbox import BLACKBOX
+
+    if a.blackbox_dir:
+        if not RECORDER.enabled:
+            RECORDER.enable(service="extender", dump_dir=a.flight_dir)
+        BLACKBOX.start(
+            a.blackbox_dir,
+            service="extender",
+            fsync_interval_s=a.blackbox_fsync_s,
+        )
     from .reservations import ReservationTable
     from .server import (
         NodeAnnotationCache,
@@ -800,6 +835,10 @@ def main() -> int:
     if node_cache is not None:
         node_cache.stop()
     srv.stop()
+    # Last out: the black box drains everything the teardown above
+    # recorded, writes its clean-stop marker, and fsyncs — the marker
+    # is how tpu-doctor postmortem tells this exit from a crash.
+    BLACKBOX.stop()
     return 0
 
 

@@ -1531,6 +1531,117 @@ def profiler_overhead(
     }
 
 
+def blackbox_overhead(
+    n_nodes: int = 1000,
+    filter_calls: int = 101,
+) -> dict:
+    """The black-box recorder's cost on the hot path, MEASURED
+    (ISSUE 19 acceptance: with the crash-durable recorder running —
+    writer thread alive, all three plane taps installed, segments
+    landing on disk — the indexed /filter p99 stays ≤1.05× the
+    recorder-off arm + the suite's 0.3 ms timer-noise floor). Two
+    arms over the same fixtures as :func:`profiler_overhead`,
+    INTERLEAVED sample-by-sample with GC frozen:
+
+    * ``control`` — tracing/flight/ledger planes enabled but the
+      recorder's taps DETACHED (exactly a daemon without
+      ``--blackbox-dir``);
+    * ``blackbox`` — taps ATTACHED for the timed call, so every span
+      completion and flight append pays the real enqueue path
+      (enabled gate → depth check → deque append).
+
+    Each timed call runs inside a span and emits one flight record,
+    so the taps fire on genuine traffic in the measured region — a
+    no-op recorder would make the bound meaningless. After the run
+    the segments are read back: the recorder must have actually
+    persisted what it was fed (a recorder that wins the bench by
+    writing nothing is a failure, not a result). The 101-sample
+    convention applies (one OS-scheduler spike cannot be the p99)."""
+    import gc
+    import shutil
+    import tempfile
+
+    from ..utils import blackbox as bbmod
+    from ..utils import tracing
+    from ..utils.decisions import LEDGER
+    from ..utils.flightrecorder import RECORDER
+    from .index import TopologyIndex
+
+    nodes = [_node(f"node-{i:04d}") for i in range(n_nodes)]
+    names = [(n.get("metadata") or {}).get("name", "") for n in nodes]
+    cache = NodeAnnotationCache(_StubClient(nodes, []), interval_s=3600)
+    cache.index = TopologyIndex()
+    cache.refresh()
+    ext = TopologyExtender(node_cache=cache)
+    for chips in (4, 1, 2):  # warm the score memo off-measurement
+        pod = _plain_pod(chips=chips)
+        assert ext.filter_names(pod, names) is not None
+        assert ext.prioritize_names(pod, names) is not None
+    tmp = tempfile.mkdtemp(prefix="tpu-blackbox-bench-")
+    bb = bbmod.BlackBoxRecorder()
+    tracing.enable(service="extender")
+    RECORDER.enable(service="extender")
+    LEDGER.enable(service="extender")
+    assert bb.start(tmp, service="extender"), "recorder failed to start"
+    bb._remove_taps()  # control baseline: planes on, recorder detached
+    gc.collect()
+    gc.freeze()
+    control: List[float] = []
+    recorded: List[float] = []
+    try:
+        for i in range(filter_calls):
+            pod = _plain_pod(chips=(1, 2, 4)[i % 3])
+            t0 = time.perf_counter()
+            with tracing.span("scale_bench.filter", arm="control"):
+                out = ext.filter_names(pod, names)
+                RECORDER.record("bench_filter", arm="control", i=i)
+            control.append(time.perf_counter() - t0)
+            assert out is not None and len(out[0]) == n_nodes
+            bb._install_taps()
+            t0 = time.perf_counter()
+            with tracing.span("scale_bench.filter", arm="blackbox"):
+                out = ext.filter_names(pod, names)
+                RECORDER.record("bench_filter", arm="blackbox", i=i)
+            recorded.append(time.perf_counter() - t0)
+            bb._remove_taps()
+            assert out is not None and len(out[0]) == n_nodes
+    finally:
+        gc.unfreeze()
+        bb.stop()
+        tracing.disable()
+        tracing.COLLECTOR.clear()
+        RECORDER.disable()
+        RECORDER.clear()
+        LEDGER.disable()
+        LEDGER.clear()
+    # Persistence round-trip: the recorded arm's traffic must be on
+    # disk, framed and readable, before the tempdir goes away.
+    recs, meta = bbmod.read_dir(tmp, service="extender")
+    kinds = {r.get("kind") for r in recs}
+    assert {"meta", "flight", "span", "stop"} <= kinds, sorted(kinds)
+    assert all(
+        s.get("status") in ("clean", "CLEAN") for s in meta["segments"]
+    ), meta
+    segments = len(meta["segments"])
+    shutil.rmtree(tmp, ignore_errors=True)
+    base = _pctl(control)["p99_ms"] or 1e-9
+    return {
+        "nodes": n_nodes,
+        "control": {"filter": _pctl(control)},
+        "blackbox": {"filter": _pctl(recorded)},
+        "recorder": {
+            "records_written": bb.records_written,
+            "bytes_written": bb.bytes_written,
+            "rotations": bb.rotations,
+            "drops": dict(bb.drops),
+            "segments": segments,
+        },
+        "filter_p99_overhead_pct": round(
+            (_pctl(recorded)["p99_ms"] - base) / base * 100.0, 1
+        ),
+    }
+
+
 def resilience_overhead(
     calls: int = 101,
     batch: int = 50,
@@ -1995,6 +2106,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "scale run",
     )
     p.add_argument(
+        "--blackbox-overhead", action="store_true",
+        help="run the black-box recorder overhead probe (indexed "
+        "/filter p99, taps detached vs attached with the writer "
+        "persisting to a tempdir) instead of the scale run",
+    )
+    p.add_argument(
         "--placement-kernel", action="store_true",
         help="run the vectorized placement-core probe (indexed "
         "/filter p99 + batched admission screen, vector vs scalar "
@@ -2017,6 +2134,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if a.resilience_overhead:
         print(json.dumps(resilience_overhead()))
+        return 0
+    if a.blackbox_overhead:
+        print(json.dumps(blackbox_overhead(n_nodes=a.nodes)))
         return 0
     if a.shard_scaling:
         print(json.dumps(shard_scaling(

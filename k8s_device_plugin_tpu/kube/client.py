@@ -329,6 +329,22 @@ class KubeClient:
         params = {"labelSelector": label_selector} if label_selector else None
         return self.get("/api/v1/nodes", params=params, verb="LIST")
 
+    # -- leases --------------------------------------------------------------
+
+    def list_leases(
+        self, namespace: str = "kube-system", label_selector: str = ""
+    ) -> dict:
+        """LeaseList in one namespace (optionally label-filtered) —
+        fleet discovery (tpu-doctor fleet) finds every extender
+        shard/standby lease through this instead of guessing shard
+        counts from config."""
+        params = {"labelSelector": label_selector} if label_selector else None
+        return self.get(
+            f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases",
+            params=params,
+            verb="LIST",
+        )
+
     def patch_node_annotations(
         self, name: str, annotations: Dict[str, Optional[str]]
     ) -> dict:

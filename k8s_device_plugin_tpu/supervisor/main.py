@@ -143,6 +143,15 @@ class DaemonConfig:
     # CRITICAL lock_order audit invariant with witness stacks at
     # /debug/lockdep. Always on in the test suite; flag-gated here.
     lockdep: bool = False
+    # Crash-durable black box (utils/blackbox.py): flight events,
+    # ledger decisions, spans, and periodic heartbeat/metric snapshots
+    # stream into checksummed segment-rotated files under blackbox_dir
+    # ("" = no recorder at all — no files, no thread). Implies the
+    # flight recorder. fsync cadence in seconds (the stream is flushed
+    # every drain tick regardless; 0 fsyncs every drain). Read with
+    # `tpu-doctor postmortem <dir>` after a crash.
+    blackbox_dir: str = ""
+    blackbox_fsync_s: float = 2.0
     # Degraded-serving staleness cap (utils/resilience.DegradedMode):
     # while the kube circuit breaker is open the controller serves its
     # last-known-good view; past this many seconds of staleness the
@@ -597,6 +606,22 @@ class Daemon:
         if self._profiler is not None:
             self._profiler.start()
         self._watchdog.start()
+        # Crash-durable black box: taps the flight/ledger/span planes
+        # into statestore-framed segments under blackbox_dir. Thread
+        # spawned here (not __init__) like the watchdog, so a Daemon
+        # built for a unit test stays threadless.
+        from ..utils.blackbox import BLACKBOX
+
+        if self.cfg.blackbox_dir:
+            if not RECORDER.enabled:
+                RECORDER.enable(
+                    service="plugin", dump_dir=self.cfg.flight_dir
+                )
+            BLACKBOX.start(
+                self.cfg.blackbox_dir,
+                service="plugin",
+                fsync_interval_s=self.cfg.blackbox_fsync_s,
+            )
         # The supervisor loop's own heartbeat (next to the legacy
         # /healthz liveness float): one beat per event-queue turn.
         hb = profiling.HEARTBEATS.register(
@@ -663,6 +688,11 @@ class Daemon:
             if self.metrics_server is not None:
                 self.metrics_server.stop()
                 self.metrics_server = None
+            # Last out: the black box drains everything the teardown
+            # above recorded, writes its clean-stop marker, and
+            # fsyncs — the marker is how tpu-doctor postmortem tells
+            # this exit from a crash.
+            BLACKBOX.stop()
 
 
 def parse_args(argv) -> DaemonConfig:
@@ -822,6 +852,23 @@ def parse_args(argv) -> DaemonConfig:
                    help="directory for flight-recorder dumps on "
                    "SIGTERM/circuit-break; empty keeps the ring "
                    "in-memory/HTTP only")
+    p.add_argument("--blackbox-dir", default=os.environ.get(
+                       "TPU_BLACKBOX_DIR", ""),
+                   help="directory for the crash-durable black box "
+                   "(utils/blackbox.py; also TPU_BLACKBOX_DIR): "
+                   "flight events, ledger decisions, spans, and "
+                   "periodic heartbeat/metric snapshots stream into "
+                   "checksummed segment-rotated files a kill -9 "
+                   "cannot destroy (read with tpu-doctor postmortem)."
+                   " Implies the flight recorder; empty disables the "
+                   "recorder entirely")
+    p.add_argument("--blackbox-fsync-s", type=float,
+                   default=float(os.environ.get(
+                       "TPU_BLACKBOX_FSYNC_S", "2") or 2),
+                   help="black-box fsync cadence in seconds (also "
+                   "TPU_BLACKBOX_FSYNC_S); the stream is flushed "
+                   "every drain tick regardless; 0 fsyncs every "
+                   "drain")
     p.add_argument("-v", "--verbose", action="count", default=0)
     a = p.parse_args(argv)
     tpulog.setup(
@@ -870,6 +917,8 @@ def parse_args(argv) -> DaemonConfig:
         capture_p99_ms=a.capture_p99_ms,
         lockdep=a.lockdep,
         staleness_cap_s=a.staleness_cap_s,
+        blackbox_dir=a.blackbox_dir,
+        blackbox_fsync_s=a.blackbox_fsync_s,
     )
 
 

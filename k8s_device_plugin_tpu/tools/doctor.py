@@ -157,18 +157,20 @@ def _bundle_paths() -> Dict[str, str]:
     return paths
 
 
-def _journal_metadata(journal_dir: str) -> dict:
-    """Snapshot METADATA of the admission journal (sizes, seq, load
-    status, record count) via the side-effect-free reader — never the
-    raw holds (gang names stay out of the bundle unless the audit
-    payload itself names them), and never load()'s tail-healing
-    truncate against a file another process owns."""
+def _journal_metadata(journal_dir: str, name: str = "admission") -> dict:
+    """Snapshot METADATA of a statestore journal+snapshot pair (sizes,
+    seq, load status, record count) via the side-effect-free reader —
+    never the raw records (gang names stay out of the bundle unless
+    the audit payload itself names them), and never load()'s
+    tail-healing truncate against a file another process owns.
+    ``name`` picks the store: the admission journal by default, the
+    extender's topology-index snapshot with ``name="index"``."""
     from ..utils import statestore
 
     # Paths come from StateStore itself (construction opens nothing),
     # not re-spelled filenames — a store naming change must not
     # silently turn the bundle's journal section into "empty".
-    store = statestore.StateStore(journal_dir)
+    store = statestore.StateStore(journal_dir, name=name)
     meta: dict = {"dir": journal_dir, "files": {}}
     for path in (
         store.journal_path, store.snapshot_path, store._tmp_path,
@@ -194,6 +196,29 @@ def _journal_metadata(journal_dir: str) -> dict:
     return meta
 
 
+def _blackbox_metadata(bb_dir: str) -> dict:
+    """Per-segment metadata of a black-box directory (names, sizes,
+    read statuses — never record bodies; those only enter the bundle
+    as the one newest segment file, which is what a postmortem needs
+    first)."""
+    from ..utils import blackbox
+
+    meta: dict = {"dir": bb_dir, "segments": []}
+    for seg in blackbox.list_segments(bb_dir):
+        recs, status, dropped = blackbox.read_segment(seg["path"])
+        meta["segments"].append({
+            "name": seg["name"],
+            "service": seg["service"],
+            "pid": seg["pid"],
+            "size_bytes": seg["size_bytes"],
+            "mtime": seg["mtime"],
+            "status": status,
+            "records": len(recs),
+            "dropped_lines": dropped,
+        })
+    return meta
+
+
 def _source_dirname(url: str) -> str:
     return (
         url.split("://", 1)[-1].rstrip("/").replace("/", "_")
@@ -205,6 +230,8 @@ def bundle(
     urls: List[str],
     out_path: str = "",
     journal_dir: str = "",
+    blackbox_dir: str = "",
+    index_snapshot_dir: str = "",
     now: Optional[float] = None,
 ) -> Tuple[str, dict]:
     """Collect every surface into one tar.gz; returns (path, manifest).
@@ -255,11 +282,457 @@ def bundle(
             except Exception as e:  # noqa: BLE001 — metadata is
                 # best-effort like every other bundle member
                 manifest["journal"] = {"error": f"{e}"}
+        if index_snapshot_dir:
+            try:
+                manifest["index_snapshot"] = _journal_metadata(
+                    index_snapshot_dir, name="index"
+                )
+            except Exception as e:  # noqa: BLE001 — best-effort
+                manifest["index_snapshot"] = {"error": f"{e}"}
+        if blackbox_dir:
+            # Metadata for every segment; the NEWEST segment rides
+            # along verbatim — it holds the final minutes a postmortem
+            # reads first, and one bounded segment keeps the bundle
+            # size predictable.
+            try:
+                manifest["blackbox"] = _blackbox_metadata(blackbox_dir)
+                segments = manifest["blackbox"]["segments"]
+                if segments:
+                    newest = segments[-1]["name"]
+                    with open(
+                        os.path.join(blackbox_dir, newest), "rb"
+                    ) as f:
+                        add(f"blackbox/{newest}", f.read())
+                    manifest["blackbox"]["bundled_segment"] = newest
+            except Exception as e:  # noqa: BLE001 — best-effort
+                manifest["blackbox"] = {"error": f"{e}"}
         add(
             "manifest.json",
             json.dumps(manifest, indent=1, sort_keys=True).encode(),
         )
     return out_path, manifest
+
+
+# -- postmortem ----------------------------------------------------------------
+
+def _fmt_ts(ts: float) -> str:
+    return time.strftime("%H:%M:%S", time.localtime(ts)) + (
+        f".{int(round((ts % 1) * 1000)):03d}"
+    )
+
+
+def _rec_trace(rec: dict) -> str:
+    return (rec.get("data") or {}).get("trace_id", "")
+
+
+def _timeline_line(rec: dict) -> str:
+    """One black-box record → one merged-timeline line."""
+    ts = rec.get("ts") or 0
+    kind = rec.get("kind", "?")
+    d = rec.get("data") or {}
+    stamp = _fmt_ts(ts)
+    tid = d.get("trace_id", "")
+    tmark = f" trace={tid}" if tid else ""
+    if kind == "flight":
+        return (
+            f"{stamp} flight   {d.get('kind', '?'):<24} "
+            f"{d.get('message', '')}{tmark}"
+        )
+    if kind == "decision":
+        subject = " ".join(
+            f"{k}={d[k]}" for k in ("pod", "gang", "node") if d.get(k)
+        )
+        return (
+            f"{stamp} ledger   {d.get('kind', '?')}/"
+            f"{d.get('reason', '?')} {subject} "
+            f"{d.get('message', '')}{tmark}"
+        )
+    if kind == "span":
+        dur_ms = round(
+            (d.get("end_ns", 0) - d.get("start_ns", 0)) / 1e6, 2
+        )
+        err = f" ERROR {d['error']}" if d.get("error") else ""
+        return (
+            f"{stamp} span     {d.get('name', '?'):<24} "
+            f"{dur_ms}ms{err}{tmark}"
+        )
+    if kind == "heartbeats":
+        beats = d.get("beats") or []
+        dead = [b["name"] for b in beats if b.get("dead")]
+        worst = max((b.get("age_s", 0) for b in beats), default=0)
+        return (
+            f"{stamp} beats    {len(beats)} loop(s), max age "
+            f"{worst}s" + (f", DEAD: {', '.join(dead)}" if dead else "")
+        )
+    if kind == "metrics":
+        return (
+            f"{stamp} metrics  snapshot "
+            f"({len(d.get('families') or {})} families)"
+        )
+    if kind == "meta":
+        build = d.get("build") or {}
+        return (
+            f"{stamp} meta     segment {d.get('segment')} opened by "
+            f"{d.get('service')}[{d.get('pid')}] "
+            f"v{build.get('version', '?')}"
+        )
+    if kind == "stop":
+        return f"{stamp} stop     clean shutdown marker"
+    return f"{stamp} {kind}"
+
+
+def build_postmortem(
+    bb_dir: str, minutes: float = 10.0, service: str = ""
+) -> dict:
+    """Reconstruct a dead daemon's final ``minutes`` from its black
+    box (utils/blackbox.py segments): one merged timeline of flight
+    events + ledger decisions + spans + heartbeat ages + metric
+    deltas, trace ids joined. Exit-code contract (the pager's):
+    0 = the stream ends in a clean ``stop`` marker (ordinary
+    shutdown), 1 = it does not (the daemon died mid-flight — a torn
+    tail is read up to the damage and reported), 2 = nothing readable
+    (no directory / no segments / no intact records)."""
+    from ..utils import blackbox, statestore
+
+    records, meta = blackbox.read_dir(bb_dir, service=service)
+    if not meta["segments"]:
+        return {
+            "dir": bb_dir,
+            "error": f"no black-box segments under {bb_dir!r}",
+            "exit_code": 2,
+        }
+    if not records:
+        return {
+            "dir": bb_dir,
+            "error": "no intact records in any segment",
+            "segments": meta["segments"],
+            "exit_code": 2,
+        }
+    records.sort(key=lambda r: (r.get("ts") or 0, r.get("seq") or 0))
+    end_ts = records[-1].get("ts") or 0
+    start_ts = end_ts - minutes * 60.0
+    window = [r for r in records if (r.get("ts") or 0) >= start_ts]
+    clean_stop = records[-1].get("kind") == "stop"
+    metas = [r["data"] for r in records if r.get("kind") == "meta"]
+    decisions = [r for r in window if r.get("kind") == "decision"]
+    last_decision = dict(decisions[-1]["data"]) if decisions else None
+    hb_recs = [r for r in window if r.get("kind") == "heartbeats"]
+    heartbeats = (
+        hb_recs[-1]["data"].get("beats") or [] if hb_recs else []
+    )
+    met_recs = [r for r in window if r.get("kind") == "metrics"]
+    metric_deltas: Dict[str, float] = {}
+    if len(met_recs) >= 2:
+        first = met_recs[0]["data"].get("families") or {}
+        last = met_recs[-1]["data"].get("families") or {}
+        for name, v in sorted(last.items()):
+            delta = round(v - first.get(name, 0.0), 6)
+            if delta:
+                metric_deltas[name] = delta
+    trace_id = (last_decision or {}).get("trace_id", "")
+    trace_records = (
+        [r for r in window if _rec_trace(r) == trace_id]
+        if trace_id else []
+    )
+    return {
+        "dir": bb_dir,
+        "identity": metas[-1] if metas else {},
+        "segments": meta["segments"],
+        "torn": any(
+            s["status"] != statestore.CLEAN for s in meta["segments"]
+        ),
+        "window": {
+            "minutes": minutes,
+            "start_ts": round(start_ts, 3),
+            "end_ts": round(end_ts, 3),
+            "records": len(window),
+            "records_total": len(records),
+        },
+        "clean_stop": clean_stop,
+        "last_decision": last_decision,
+        "trace_id": trace_id,
+        "trace_records": [_timeline_line(r) for r in trace_records],
+        "heartbeats": heartbeats,
+        "metric_deltas": metric_deltas,
+        "timeline": [_timeline_line(r) for r in window],
+        "exit_code": 0 if clean_stop else 1,
+    }
+
+
+def render_postmortem(report: dict, max_timeline: int = 200) -> str:
+    """The `tpu-doctor postmortem` incident view of one report."""
+    if report.get("error"):
+        return f"POSTMORTEM UNAVAILABLE: {report['error']}"
+    ident = report.get("identity") or {}
+    build = ident.get("build") or {}
+    w = report["window"]
+    out = [
+        f"== postmortem: {report['dir']} ==",
+        f"{ident.get('service', '?')}[{ident.get('pid', '?')}] "
+        f"v{build.get('version', '?')} — final {w['minutes']}min "
+        f"window ({w['records']}/{w['records_total']} records, "
+        f"{_fmt_ts(w['start_ts'])} .. {_fmt_ts(w['end_ts'])})",
+    ]
+    verdict = (
+        "clean shutdown (stop marker present)"
+        if report["clean_stop"]
+        else "DIED MID-FLIGHT: no clean-stop marker"
+        + (" — torn tail read up to the damage"
+           if report["torn"] else "")
+    )
+    out.append(f"verdict: {verdict}")
+    out.append("segments:")
+    for s in report["segments"]:
+        out.append(
+            f"  {s['name']}: {s['records']} record(s), "
+            f"{s['size_bytes']}B, status={s['status']}"
+        )
+    if report.get("last_decision"):
+        d = report["last_decision"]
+        subject = " ".join(
+            f"{k}={d[k]}" for k in ("pod", "gang", "node") if d.get(k)
+        )
+        out.append(
+            f"last decision: {d.get('kind')}/{d.get('reason')} "
+            f"{subject} — {d.get('message', '')}"
+        )
+        if report.get("trace_id"):
+            out.append(
+                f"  trace {report['trace_id']} "
+                f"({len(report['trace_records'])} joined record(s)):"
+            )
+            out.extend(
+                f"    {line}" for line in report["trace_records"]
+            )
+    else:
+        out.append("last decision: none in window")
+    if report.get("heartbeats"):
+        out.append("heartbeats at last snapshot:")
+        for b in sorted(
+            report["heartbeats"],
+            key=lambda x: -(x.get("age_s") or 0),
+        ):
+            flag = " DEAD" if b.get("dead") else ""
+            out.append(
+                f"  {b.get('name', '?'):<24} age "
+                f"{b.get('age_s', '?')}s{flag}"
+            )
+    if report.get("metric_deltas"):
+        out.append("metric deltas across window (non-zero):")
+        for name, delta in report["metric_deltas"].items():
+            out.append(f"  {name:<44} {delta:+g}")
+    timeline = report["timeline"]
+    shown = timeline[-max_timeline:]
+    out.append(
+        f"timeline ({len(shown)} of {len(timeline)} in window, "
+        "newest last):"
+    )
+    out.extend(f"  {line}" for line in shown)
+    return "\n".join(out)
+
+
+def postmortem(
+    bb_dir: str, minutes: float = 10.0, service: str = ""
+) -> int:
+    report = build_postmortem(bb_dir, minutes=minutes, service=service)
+    print(render_postmortem(report))
+    return report["exit_code"]
+
+
+# -- fleet ---------------------------------------------------------------------
+
+def discover_fleet(
+    kubeconfig: str = "",
+    lease_namespace: str = "kube-system",
+    extender_port: int = 12346,
+    plugin_port: int = 2112,
+) -> List[dict]:
+    """Every extender shard + plugin endpoint, from the control plane
+    itself: extender replicas hold the ``tpu-scheduler-extender*``
+    shard/standby Leases (spec.holderIdentity is ``<host>-<pid>``),
+    plugins run one per TPU node (the node's InternalIP on the metrics
+    port). Raises on an unreachable apiserver — fleet discovery failing
+    IS the answer then."""
+    import re as _re
+
+    from ..extender.leader import LEASE_NAME
+    from ..kube.client import KubeClient
+
+    client = KubeClient.from_env(kubeconfig)
+    endpoints: List[dict] = []
+    seen = set()
+    leases = client.list_leases(namespace=lease_namespace) or {}
+    for item in leases.get("items") or []:
+        name = (item.get("metadata") or {}).get("name") or ""
+        if not name.startswith(LEASE_NAME):
+            continue
+        holder = (item.get("spec") or {}).get("holderIdentity") or ""
+        host = _re.sub(r"-\d+$", "", holder)  # strip the -<pid> tail
+        if not host:
+            continue
+        url = f"http://{host}:{extender_port}"
+        if url in seen:
+            continue
+        seen.add(url)
+        endpoints.append({
+            "role": "extender", "url": url,
+            "lease": name, "holder": holder,
+        })
+    nodes = client.list_nodes() or {}
+    for item in nodes.get("items") or []:
+        nodename = (item.get("metadata") or {}).get("name") or ""
+        addrs = (item.get("status") or {}).get("addresses") or []
+        ip = next(
+            (a.get("address") for a in addrs
+             if a.get("type") == "InternalIP" and a.get("address")),
+            "",
+        )
+        if not ip:
+            continue
+        url = f"http://{ip}:{plugin_port}"
+        if url in seen:
+            continue
+        seen.add(url)
+        endpoints.append({
+            "role": "plugin", "url": url, "node": nodename,
+        })
+    return endpoints
+
+
+def _fleet_row(endpoint: dict) -> dict:
+    """One endpoint's health row: /debug/audit (build identity +
+    findings), /debug/readyz (phase), /debug/resilience (degraded
+    mode). Best-effort per surface; a fully unreachable endpoint is
+    the row."""
+    row = dict(endpoint)
+    try:
+        audit = json.loads(_fetch(endpoint["url"], "/debug/audit"))
+    except (OSError, ValueError) as e:
+        row["unreachable"] = f"{e}"
+        return row
+    build = audit.get("build") or {}
+    row["component"] = build.get("component", "?")
+    row["version"] = build.get("version", "?")
+    row["findings"] = len(audit.get("findings") or [])
+    row["sweep_errors"] = len(audit.get("errors") or {})
+    try:
+        readyz = json.loads(_fetch(endpoint["url"], "/debug/readyz"))
+        row["phase"] = (
+            readyz.get("phase", "?")
+            if readyz.get("configured", True) else "n/a"
+        )
+    except (OSError, ValueError):
+        row["phase"] = "?"
+    try:
+        res = json.loads(_fetch(endpoint["url"], "/debug/resilience"))
+        row["degraded"] = any(
+            d.get("active") for d in res.get("degraded") or []
+        )
+        row["breaker_open"] = bool(res.get("breaker_open"))
+    except (OSError, ValueError):
+        row["degraded"] = None
+        row["breaker_open"] = None
+    return row
+
+
+def render_fleet(rows: List[dict]) -> Tuple[str, int]:
+    """The `tpu-doctor fleet` table + its exit code: 0 all healthy,
+    1 findings / degraded mode / build skew anywhere, 2 any endpoint
+    unreachable."""
+    rc = 0
+    header = (
+        f"{'ROLE':<9} {'ENDPOINT':<28} {'BUILD':<14} {'PHASE':<10} "
+        f"{'DEGRADED':<9} {'FINDINGS':<8} SOURCE"
+    )
+    out = [header, "-" * len(header)]
+    versions = set()
+    for row in sorted(
+        rows, key=lambda r: (r.get("role", ""), r.get("url", ""))
+    ):
+        source = row.get("lease") or row.get("node") or "--url"
+        if row.get("unreachable"):
+            rc = max(rc, 2)
+            out.append(
+                f"{row.get('role', '?'):<9} {row.get('url', ''):<28} "
+                f"UNREACHABLE: {row['unreachable']} ({source})"
+            )
+            continue
+        build = f"{row.get('component')}/{row.get('version')}"
+        versions.add(build)
+        degraded = row.get("degraded")
+        deg = (
+            "yes" if degraded
+            else ("no" if degraded is not None else "?")
+        )
+        if row.get("breaker_open"):
+            deg += "+open"
+        bad = (
+            row.get("findings")
+            or row.get("sweep_errors")
+            or degraded
+            or row.get("breaker_open")
+        )
+        if bad:
+            rc = max(rc, 1)
+        out.append(
+            f"{row.get('role', '?'):<9} {row.get('url', ''):<28} "
+            f"{build:<14} {row.get('phase', '?'):<10} {deg:<9} "
+            f"{row.get('findings', 0):<8} {source}"
+        )
+    per_role_versions: Dict[str, set] = {}
+    for row in rows:
+        if not row.get("unreachable"):
+            per_role_versions.setdefault(
+                row.get("component", "?"), set()
+            ).add(row.get("version", "?"))
+    skewed = {
+        comp: sorted(vs)
+        for comp, vs in per_role_versions.items() if len(vs) > 1
+    }
+    if skewed:
+        rc = max(rc, 1)
+        for comp, vs in sorted(skewed.items()):
+            out.append(
+                f"BUILD SKEW: {comp} running {len(vs)} versions: "
+                f"{', '.join(vs)}"
+            )
+    out.append(
+        f"{len(rows)} endpoint(s): "
+        f"{sum(1 for r in rows if r.get('unreachable'))} unreachable, "
+        f"{sum(1 for r in rows if r.get('findings'))} with findings"
+    )
+    return "\n".join(out), rc
+
+
+def fleet(
+    urls: List[str],
+    kubeconfig: str = "",
+    lease_namespace: str = "kube-system",
+    extender_port: int = 12346,
+    plugin_port: int = 2112,
+    discover: bool = True,
+) -> int:
+    endpoints = [{"role": "?", "url": u} for u in urls]
+    if discover:
+        try:
+            endpoints.extend(discover_fleet(
+                kubeconfig=kubeconfig,
+                lease_namespace=lease_namespace,
+                extender_port=extender_port,
+                plugin_port=plugin_port,
+            ))
+        except Exception as e:  # noqa: BLE001 — apiserver down is an
+            # answer (exit 2), not a traceback
+            print(f"fleet discovery failed: {e}", file=sys.stderr)
+            if not urls:
+                return 2
+    if not endpoints:
+        print("fleet: no endpoints discovered and no --url given")
+        return 2
+    rows = [_fleet_row(e) for e in endpoints]
+    text, rc = render_fleet(rows)
+    print(text)
+    return rc
 
 
 # -- self-test ---------------------------------------------------------------
@@ -336,6 +809,36 @@ def _self_test() -> str:
         src = manifest["sources"][0]
         assert src["files"]["audit.json"] == "ok"
         assert src["build"]["component"] == "plugin", src
+        # Bundle side of the black box + index snapshot: metadata in
+        # the manifest, the newest segment riding the tar.
+        from ..utils import blackbox as bb_mod
+        from ..utils import statestore
+
+        bb_dir = os.path.join(tmp, "bb")
+        bb = bb_mod.BlackBoxRecorder()
+        assert bb.start(
+            bb_dir, "plugin",
+            drain_interval_s=0.02, fsync_interval_s=0.0,
+        )
+        bb.put("flight", {"kind": "probe", "message": "bundle me"})
+        bb.stop()
+        idx_dir = os.path.join(tmp, "idx")
+        store = statestore.StateStore(idx_dir, name="index")
+        store.append({"op": "probe"})
+        store.close()
+        out2, manifest2 = bundle(
+            [url], out_path=os.path.join(tmp, "b2.tar.gz"),
+            blackbox_dir=bb_dir, index_snapshot_dir=idx_dir,
+        )
+        segs = manifest2["blackbox"]["segments"]
+        assert segs and segs[-1]["status"] == "clean", manifest2
+        assert manifest2["blackbox"]["bundled_segment"] == (
+            segs[-1]["name"]
+        )
+        assert manifest2["index_snapshot"]["files"], manifest2
+        with tarfile.open(out2) as tar:
+            names2 = set(tar.getnames())
+        assert f"blackbox/{segs[-1]['name']}" in names2, names2
         return table
     finally:
         srv.stop()
@@ -387,6 +890,68 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="include admission-journal METADATA (sizes, seq, load "
         "status — never raw records) from this directory",
     )
+    pb.add_argument(
+        "--blackbox-dir", default="",
+        help="include black-box segment METADATA (names, sizes, read "
+        "statuses) plus the newest segment file from this directory",
+    )
+    pb.add_argument(
+        "--index-snapshot-dir", default="",
+        help="include topology-index snapshot METADATA (sizes, seq, "
+        "load status) from this directory",
+    )
+    pp = sub.add_parser(
+        "postmortem",
+        help="reconstruct a dead daemon's final minutes from its "
+        "black-box directory (exit 0 clean stop, 1 died mid-flight, "
+        "2 nothing readable)",
+    )
+    pp.add_argument(
+        "dir", help="the daemon's --blackbox-dir directory"
+    )
+    pp.add_argument(
+        "--minutes", type=float, default=10.0,
+        help="window before the last record to reconstruct "
+        "(default 10)",
+    )
+    pp.add_argument(
+        "--service", default="",
+        help="only read segments written by this service "
+        "(plugin/extender; default: all)",
+    )
+    pf = sub.add_parser(
+        "fleet",
+        help="discover every extender shard (Leases) + plugin (node "
+        "list) and aggregate /debug/audit, readiness, degraded state, "
+        "and build skew into one table (exit 0 healthy, 1 findings/"
+        "degraded/skew, 2 unreachable)",
+    )
+    pf.add_argument(
+        "--url", action="append", default=[],
+        help="extra endpoint base URL (repeatable; added to "
+        "discovery)",
+    )
+    pf.add_argument(
+        "--kubeconfig", default="",
+        help="kubeconfig for discovery (default: in-cluster / "
+        "$KUBECONFIG)",
+    )
+    pf.add_argument(
+        "--lease-namespace", default="kube-system",
+        help="namespace of the extender shard Leases",
+    )
+    pf.add_argument(
+        "--extender-port", type=int, default=12346,
+        help="extender HTTP port for discovered shard holders",
+    )
+    pf.add_argument(
+        "--plugin-port", type=int, default=2112,
+        help="plugin metrics port for discovered nodes",
+    )
+    pf.add_argument(
+        "--no-discover", action="store_true",
+        help="skip apiserver discovery; probe only --url endpoints",
+    )
     a = p.parse_args(argv)
     if a.self_test:
         print(_self_test())
@@ -397,12 +962,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not sources:
             pc.error("at least one --url or audit.json file is required")
         return check(sources)
+    if a.cmd == "postmortem":
+        return postmortem(
+            a.dir, minutes=a.minutes, service=a.service
+        )
+    if a.cmd == "fleet":
+        return fleet(
+            list(a.url),
+            kubeconfig=a.kubeconfig,
+            lease_namespace=a.lease_namespace,
+            extender_port=a.extender_port,
+            plugin_port=a.plugin_port,
+            discover=not a.no_discover,
+        )
     if a.cmd == "bundle":
         if not a.url:
             pb.error("at least one --url is required")
         try:
             out, manifest = bundle(
-                a.url, out_path=a.output, journal_dir=a.journal_dir
+                a.url, out_path=a.output, journal_dir=a.journal_dir,
+                blackbox_dir=a.blackbox_dir,
+                index_snapshot_dir=a.index_snapshot_dir,
             )
         except OSError as e:
             print(f"tpu-doctor: {e}", file=sys.stderr)

@@ -89,6 +89,23 @@ class DecisionLedger:
         # per _OVERFLOW_EVERY, not per record (a hot ring must not spam
         # the flight ring it is reporting pressure to).
         self._overflow_reported = 0
+        # Live subscribers (the black-box recorder), mirroring the
+        # flight recorder's tap seam: called with every appended
+        # record OUTSIDE the ring lock; copy-on-write tuple so the
+        # hot path reads it lock-free.
+        self._taps: tuple = ()
+
+    def add_tap(self, fn) -> None:
+        """Subscribe ``fn(record_dict)`` to every recorded decision.
+        Taps must never block and never raise (they run on the
+        recording thread)."""
+        with self._lock:
+            if fn not in self._taps:
+                self._taps = self._taps + (fn,)
+
+    def remove_tap(self, fn) -> None:
+        with self._lock:
+            self._taps = tuple(t for t in self._taps if t != fn)
 
     _OVERFLOW_EVERY = 1024
 
@@ -158,6 +175,14 @@ class DecisionLedger:
             counter = self._counter
         if counter is not None:
             counter.inc(kind=kind, reason=reason)
+        # Taps get their own copy (attrs too): retrace()/tag_gang()
+        # mutate the live record under the ledger lock, which must not
+        # race a tap consumer serializing its copy off-thread.
+        for tap in self._taps:
+            try:
+                tap({**rec, "attrs": dict(rec["attrs"])})
+            except Exception:  # noqa: BLE001 — a broken subscriber
+                pass  # must never take the hot path down with it
         if overflowed:
             from .flightrecorder import RECORDER
 

@@ -54,6 +54,24 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._events: "collections.deque" = collections.deque()
         self._counter = None  # *_flight_events_total, bound by enable()
+        # Live subscribers (the black-box recorder): each tap is
+        # called with every appended event, OUTSIDE the ring lock so a
+        # slow tap can never convoy the hot path. The list is replaced
+        # wholesale on mutation (copy-on-write) so record() reads it
+        # without taking a lock.
+        self._taps: tuple = ()
+
+    def add_tap(self, fn) -> None:
+        """Subscribe ``fn(event_dict)`` to every recorded event. Taps
+        must never block and never raise (they run on the recording
+        thread); the black box's tap only appends to a bounded queue."""
+        with self._lock:
+            if fn not in self._taps:
+                self._taps = self._taps + (fn,)
+
+    def remove_tap(self, fn) -> None:
+        with self._lock:
+            self._taps = tuple(t for t in self._taps if t != fn)
 
     def enable(self, service: str = "plugin", dump_dir: str = "",
                capacity: Optional[int] = None) -> None:
@@ -99,6 +117,11 @@ class FlightRecorder:
             counter = self._counter
         if counter is not None:
             counter.inc(kind=kind)
+        for tap in self._taps:
+            try:
+                tap(ev)
+            except Exception:  # noqa: BLE001 — a broken subscriber
+                pass  # must never take the hot path down with it
 
     def __len__(self) -> int:
         with self._lock:
@@ -109,17 +132,33 @@ class FlightRecorder:
             self._events.clear()
             self.dropped = 0
 
-    def snapshot(self) -> dict:
-        """The /debug/events payload and the dump-file body."""
+    def export(self, reason: str = "") -> dict:
+        """THE ring-drain seam. Every consumer of the ring — the
+        ``/debug/events`` endpoint, :meth:`dump_on` (SIGTERM /
+        circuit-break / audit-critical dumps), and capture bundles
+        (utils/profiling.CaptureManager) — reads through this one
+        method, so there is exactly one copy of the "snapshot the
+        ring consistently" logic; live streaming consumers (the black
+        box) subscribe via :meth:`add_tap` instead of polling. A
+        non-empty ``reason`` is stamped on the payload (dump files
+        carry why they were cut; the live endpoint omits it)."""
         with self._lock:
             events = [dict(e) for e in self._events]
             dropped = self.dropped
-        return {
+        snap = {
             "service": self.service,
             "capacity": self.capacity,
             "dropped": dropped,
             "events": events,
         }
+        if reason:
+            snap["reason"] = reason
+        return snap
+
+    def snapshot(self) -> dict:
+        """The /debug/events payload and the dump-file body (the
+        :meth:`export` drain, reason-less)."""
+        return self.export()
 
     def dump_on(self, reason: str) -> Optional[str]:
         """Write the ring to ``dump_dir`` (timestamped file name carries
@@ -128,10 +167,9 @@ class FlightRecorder:
         way down must not mask the original failure."""
         if not self.enabled or not self.dump_dir:
             return None
-        snap = self.snapshot()
+        snap = self.export(reason)
         if not snap["events"]:
             return None
-        snap["reason"] = reason
         name = (
             f"flight-{self.service or 'daemon'}-"
             f"{time.strftime('%Y%m%dT%H%M%S')}-{os.getpid()}-{reason}.json"

@@ -385,6 +385,38 @@ DECISIONS = REGISTRY.counter(
     "ledger (utils/decisions.py; served at /debug/decisions), by kind "
     "and machine-readable reason token",
 )
+# Black-box recorder families (utils/blackbox.py; --blackbox-dir).
+# Same family names on both registries — a process only ever renders
+# one of them (the tpu_audit_*/tpu_chip_* shared-name idiom).
+BLACKBOX_RECORDS = REGISTRY.counter(
+    "tpu_blackbox_records_total",
+    "Records persisted to the crash-durable black box, by kind "
+    "(flight/decision/span/heartbeats/metrics/meta/stop — "
+    "utils/blackbox.py; read with tpu-doctor postmortem)",
+)
+BLACKBOX_DROPPED = REGISTRY.counter(
+    "tpu_blackbox_dropped_total",
+    "Black-box records dropped instead of blocking a hot path, by "
+    "reason (queue_full: the bounded queue was at capacity; "
+    "write_error: the segment file could not be written)",
+)
+BLACKBOX_BYTES = REGISTRY.counter(
+    "tpu_blackbox_bytes_total",
+    "Bytes appended to black-box segment files (statestore-framed; "
+    "bounded on disk by --blackbox rotation + pruning)",
+)
+BLACKBOX_ROTATIONS = REGISTRY.counter(
+    "tpu_blackbox_segment_rotations_total",
+    "Black-box segment rotations (a segment reached segment_bytes "
+    "and a new one was opened; oldest segments pruned past the "
+    "directory byte budget)",
+)
+BLACKBOX_QUEUE = REGISTRY.gauge(
+    "tpu_blackbox_queue_depth",
+    "Black-box records waiting in the bounded producer queue at the "
+    "last writer drain (sustained depth near queue_max precedes "
+    "queue_full drops)",
+)
 # Allocation SLO bucket bounds (seconds): sub-second immediate
 # admissions through multi-minute capacity waits.
 SLO_BUCKETS = (
@@ -935,6 +967,32 @@ EXT_DECISIONS = EXTENDER_REGISTRY.counter(
     "ledger (utils/decisions.py; served at /debug/decisions), by kind "
     "and machine-readable reason token",
 )
+# Extender-registry twins of the black-box families (see the plugin
+# registry block for the per-family semantics).
+EXT_BLACKBOX_RECORDS = EXTENDER_REGISTRY.counter(
+    "tpu_blackbox_records_total",
+    "Records persisted to the crash-durable black box, by kind "
+    "(utils/blackbox.py; read with tpu-doctor postmortem)",
+)
+EXT_BLACKBOX_DROPPED = EXTENDER_REGISTRY.counter(
+    "tpu_blackbox_dropped_total",
+    "Black-box records dropped instead of blocking a hot path, by "
+    "reason (queue_full / write_error)",
+)
+EXT_BLACKBOX_BYTES = EXTENDER_REGISTRY.counter(
+    "tpu_blackbox_bytes_total",
+    "Bytes appended to black-box segment files (statestore-framed)",
+)
+EXT_BLACKBOX_ROTATIONS = EXTENDER_REGISTRY.counter(
+    "tpu_blackbox_segment_rotations_total",
+    "Black-box segment rotations (oldest segments pruned past the "
+    "directory byte budget)",
+)
+EXT_BLACKBOX_QUEUE = EXTENDER_REGISTRY.gauge(
+    "tpu_blackbox_queue_depth",
+    "Black-box records waiting in the bounded producer queue at the "
+    "last writer drain",
+)
 GANG_TIME_TO_ADMIT = EXTENDER_REGISTRY.histogram(
     "tpu_gang_time_to_admit_seconds",
     "How long a complete gang waited from its first admission "
@@ -1205,6 +1263,13 @@ DEBUG_ENDPOINTS: Dict[str, str] = {
         "bench scheduling_quality probe or tpu-simreport populate "
         "it; a bare GET never runs a simulation)"
     ),
+    "/debug/blackbox": (
+        "crash-durable black-box recorder status (utils/blackbox.py; "
+        "--blackbox-dir): config, queue depth, drop counts, and "
+        "on-disk segment metadata — never record bodies (tpu-doctor "
+        "postmortem reads those from the segment files); enabled: "
+        "false when no --blackbox-dir is configured"
+    ),
     "/debug/resilience": (
         "resilience-layer snapshot (utils/resilience.py TRACKER): "
         "per-verb kube-call outcome counts, breaker open/close "
@@ -1318,7 +1383,11 @@ def debug_payload(path: str) -> Optional[bytes]:
             )
             return tracing.COLLECTOR.otlp_json(trace_id=trace_id)
         if parsed.path == "/debug/events":
-            return RECORDER.snapshot()
+            return RECORDER.export()
+        if parsed.path == "/debug/blackbox":
+            from .blackbox import BLACKBOX
+
+            return BLACKBOX.snapshot()
         if parsed.path == "/debug/decisions":
             q = dict(_up.parse_qsl(parsed.query))
             try:

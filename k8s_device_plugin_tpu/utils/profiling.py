@@ -1091,7 +1091,11 @@ class CaptureManager:
                 "profile": stackprof.bundle_section(
                     self.profile_window_s
                 ),
-                "flight": RECORDER.snapshot(),
+                # The one ring-drain seam (flightrecorder.export):
+                # capture bundles, /debug/events, and dump_on all read
+                # the ring through it — the black box taps the same
+                # seam live instead of keeping a fourth copy.
+                "flight": RECORDER.export("capture"),
                 "decisions": LEDGER.snapshot(limit=256),
                 "heartbeats": HEARTBEATS.snapshot(),
                 "windows": windows,

@@ -377,6 +377,23 @@ class SpanCollector:
         self._lock = threading.Lock()
         self._spans: "collections.deque" = collections.deque()
         self.dropped = 0
+        # Live subscribers (the black-box recorder), mirroring the
+        # flight recorder's tap seam: called with every finished span
+        # OUTSIDE the collector lock; copy-on-write tuple so add()
+        # reads it lock-free.
+        self._taps: tuple = ()
+
+    def add_tap(self, fn) -> None:
+        """Subscribe ``fn(span_dict)`` to every collected span. Taps
+        must never block and never raise (they run on the finishing
+        thread)."""
+        with self._lock:
+            if fn not in self._taps:
+                self._taps = self._taps + (fn,)
+
+    def remove_tap(self, fn) -> None:
+        with self._lock:
+            self._taps = tuple(t for t in self._taps if t != fn)
 
     def add(self, span_dict: dict) -> None:
         with self._lock:
@@ -384,6 +401,17 @@ class SpanCollector:
             while len(self._spans) > self.max_spans:
                 self._spans.popleft()
                 self.dropped += 1
+        # Taps get their own copy (attrs too): reparent() mutates the
+        # live span under the collector lock, which must not race a
+        # tap consumer serializing its copy off-thread.
+        for tap in self._taps:
+            try:
+                tap({
+                    **span_dict,
+                    "attrs": dict(span_dict.get("attrs") or {}),
+                })
+            except Exception:  # noqa: BLE001 — a broken subscriber
+                pass  # must never take the hot path down with it
 
     def reparent(self, span_id: str, parent: SpanContext) -> bool:
         """Rewrite one collected span (and its collected descendants)

@@ -131,6 +131,15 @@ python -m k8s_device_plugin_tpu.extender.scale_bench --placement-self-test > /de
 # gate in tests/test_scale_bench.py.
 python -m k8s_device_plugin_tpu.extender.simulator --self-test > /dev/null \
   || { echo "extender/simulator.py --self-test FAILED"; exit 1; }
+# Black-box recorder smoke: feed all three observability planes
+# (flight ring, decision ledger, span collector) through the tap seam
+# into an on-disk recorder, rotate + prune under a byte budget, tear
+# the newest segment's tail, and read the postmortem back up to the
+# damage — recorder-off must leave the filesystem untouched
+# (utils/blackbox.py --self-test); a framing/tap/rotation drift fails
+# CI here, before the chaos SIGKILL e2e in tests/test_blackbox.py.
+python -m k8s_device_plugin_tpu.utils.blackbox --self-test > /dev/null \
+  || { echo "utils/blackbox.py --self-test FAILED"; exit 1; }
 # Repo lint gate: zero NEW findings (baseline'd exceptions carry
 # justifications in analysis/baseline.json) — an unsupervised thread,
 # an undocumented metric/kind/span/debug-endpoint, blocking work

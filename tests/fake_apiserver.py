@@ -419,6 +419,42 @@ class FakeApiServer:
                             )
                         else:
                             server._send_json(self, lease)
+                    elif len(parts) == 6 and parts[5] == "leases":
+                        # Namespaced Lease LIST with labelSelector
+                        # equality filtering (k=v[,k2=v2]) — fleet
+                        # discovery (tpu-doctor fleet) lists the
+                        # extender shard leases through this.
+                        q = urllib.parse.parse_qs(parsed.query)
+                        selector = (
+                            q.get("labelSelector", [""])[0] or ""
+                        )
+                        wanted = {}
+                        for clause in selector.split(","):
+                            if "=" in clause:
+                                k, v = clause.split("=", 1)
+                                wanted[k.strip()] = v.strip("= ")
+                        ns = parts[4]
+                        with server._lock:
+                            items = [
+                                lease
+                                for (lns, _), lease in sorted(
+                                    server.leases.items()
+                                )
+                                if lns == ns and all(
+                                    (lease.get("metadata", {})
+                                     .get("labels") or {})
+                                    .get(k) == v
+                                    for k, v in wanted.items()
+                                )
+                            ]
+                        server._send_json(self, {
+                            "kind": "LeaseList",
+                            "apiVersion": "coordination.k8s.io/v1",
+                            "metadata": {
+                                "resourceVersion": str(server._rv),
+                            },
+                            "items": items,
+                        })
                     else:
                         self.send_error(404)
                 else:

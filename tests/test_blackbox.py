@@ -1,0 +1,555 @@
+"""ISSUE 19: the crash-durable black box + postmortem doctor + fleet.
+
+The acceptance chain is chaos-shaped on purpose: a REAL extender
+subprocess under fake-apiserver traffic is SIGKILLed mid-flight, and
+`tpu-doctor postmortem` must reconstruct the final-minute timeline —
+including the last admission decision and its trace id — from nothing
+but the on-disk segments, with no live process to ask. The satellites
+ride along: recorder-off parity (no directory is ever touched), segment
+rotation under a byte budget, the unified flight-ring drain/tap seam,
+the fake apiserver's Lease LIST (fleet discovery's substrate), and the
+`tpu-doctor fleet` sweep itself.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import requests
+
+from k8s_device_plugin_tpu.kube.client import KubeClient
+from k8s_device_plugin_tpu.tools import doctor
+from k8s_device_plugin_tpu.utils import blackbox, metrics, statestore, tracing
+from k8s_device_plugin_tpu.utils.blackbox import BlackBoxRecorder
+from k8s_device_plugin_tpu.utils.decisions import LEDGER
+from k8s_device_plugin_tpu.utils.flightrecorder import RECORDER
+from tests.fake_apiserver import FakeApiServer
+from tests.test_extender import make_node, tpu_pod
+from tests.test_leader import _kubeconfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_http(url: str, timeout: float = 20.0) -> None:
+    deadline = time.time() + timeout
+    while True:
+        try:
+            assert requests.get(f"{url}/healthz", timeout=2).json()[
+                "ok"
+            ]
+            return
+        except requests.ConnectionError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.1)
+
+
+def _clean_env(**extra) -> dict:
+    env = {
+        k: v for k, v in os.environ.items()
+        if k != "PALLAS_AXON_POOL_IPS"
+    }
+    env.update(extra)
+    return env
+
+
+# -- acceptance: SIGKILL a real extender, read the black box ------------------
+
+
+def test_sigkill_postmortem_names_last_decision_e2e(tmp_path):
+    """ISSUE 19 acceptance: `kill -9` a real extender under
+    fake-apiserver traffic, then `tpu-doctor postmortem` reconstructs
+    the final-minute timeline — the last ledger decision, its trace id,
+    the merged flight/span records joined on it — with no live process,
+    exit code 1 (died mid-flight). A simulated torn tail on top (the
+    cut final line a kill mid-write leaves) must still read up to the
+    damage and name a decision."""
+    api = FakeApiServer()
+    url = api.start()
+    kubeconfig = _kubeconfig(tmp_path, url)
+    bb_dir = str(tmp_path / "bb")
+    port = _free_port()
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "k8s_device_plugin_tpu.extender",
+            "--host", "127.0.0.1", "--port", str(port),
+            "--gang-admission", "--kubeconfig", kubeconfig,
+            "--gang-resync-s", "1", "--trace", "--decisions",
+            "--blackbox-dir", bb_dir, "--blackbox-fsync-s", "0",
+        ],
+        cwd=REPO, env=_clean_env(HOSTNAME="bb-rep-1"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    base = f"http://127.0.0.1:{port}"
+    calls = 24
+    try:
+        _wait_http(base)
+        node, _ = make_node("n1")
+        for i in range(calls):
+            pod = tpu_pod(2)
+            pod["metadata"]["name"] = f"p-{i}"
+            out = requests.post(
+                f"{base}/filter",
+                json={"pod": pod, "nodes": {"items": [node]}},
+                timeout=10,
+            ).json()
+            assert out["nodes"]["items"], out
+            time.sleep(0.02)
+        # Let the writer drain + fsync (drain tick 0.25s, fsync every
+        # drain with --blackbox-fsync-s 0), then murder the process.
+        time.sleep(0.8)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        api.stop()
+    # No live process — everything below reads only the directory.
+    report = doctor.build_postmortem(bb_dir, minutes=10.0)
+    assert report["exit_code"] == 1, report  # no clean-stop marker
+    assert report["clean_stop"] is False
+    assert report["identity"]["service"] == "extender"
+    assert report["identity"]["pid"] == proc.pid
+    last = report["last_decision"]
+    assert last is not None, report
+    assert last["kind"] == "filter"
+    assert last["pod"] == f"default/p-{calls - 1}", last
+    trace_id = report["trace_id"]
+    assert trace_id, last
+    # The trace join pulls at least the decision + its serving span.
+    assert len(report["trace_records"]) >= 2, report["trace_records"]
+    text = doctor.render_postmortem(report)
+    assert "DIED MID-FLIGHT" in text
+    assert trace_id in text
+    assert f"default/p-{calls - 1}" in text
+    # The pager-facing CLI agrees with the library.
+    cli = subprocess.run(
+        [
+            sys.executable, "-m", "k8s_device_plugin_tpu.tools.doctor",
+            "postmortem", bb_dir,
+        ],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+        env=_clean_env(),
+    )
+    assert cli.returncode == 1, cli.stdout + cli.stderr
+    assert "filter" in cli.stdout
+    # Torn tail on top: cut the newest segment mid-record (what a kill
+    # DURING a write leaves). The intact prefix must still yield a
+    # named decision; the tear is reported, never an error.
+    segs = blackbox.list_segments(bb_dir)
+    with open(segs[-1]["path"], "rb+") as f:
+        f.truncate(segs[-1]["size_bytes"] - 3)
+    report = doctor.build_postmortem(bb_dir)
+    assert report["exit_code"] == 1, report
+    assert report["torn"] is True
+    assert report["last_decision"]["kind"] == "filter"
+    assert report["last_decision"]["trace_id"]
+    assert "torn_tail" in doctor.render_postmortem(report)
+
+
+def test_recorder_off_process_leaves_directory_untouched(tmp_path):
+    """Parity: the same entrypoint WITHOUT --blackbox-dir serves the
+    same traffic and leaves the filesystem alone — no directory, no
+    thread, no files (the recorder-off contract is 'exact no-op', not
+    'empty black box')."""
+    bb_dir = tmp_path / "never-created"
+    port = _free_port()
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "k8s_device_plugin_tpu.extender",
+            "--host", "127.0.0.1", "--port", str(port),
+            "--trace", "--decisions",
+        ],
+        cwd=REPO, env=_clean_env(),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        _wait_http(base)
+        node, _ = make_node("n1")
+        out = requests.post(
+            f"{base}/filter",
+            json={"pod": tpu_pod(2), "nodes": {"items": [node]}},
+            timeout=10,
+        ).json()
+        assert out["nodes"]["items"]
+        # The debug surface says so too: disabled, no directory.
+        snap = requests.get(
+            f"{base}/debug/blackbox", timeout=5
+        ).json()
+        assert snap["enabled"] is False
+        assert snap["dir"] == ""
+        proc.terminate()
+        assert proc.wait(timeout=15) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert not bb_dir.exists()
+    # And a clean SIGTERM with no recorder left no stray dump either.
+    assert not any(
+        n.startswith("blackbox-") for n in os.listdir(tmp_path)
+    )
+    # In-process twin: an unstarted recorder's put() is a no-op and
+    # start("") refuses (False) without touching the filesystem.
+    off = BlackBoxRecorder()
+    assert off.start("", "extender") is False
+    off.put("flight", {"kind": "ignored"})
+    assert off.records_written == 0 and not off.drops
+
+
+def test_rotation_respects_byte_budget_under_sustained_load(tmp_path):
+    """Satellite: segments rotate at segment_bytes and the directory
+    prunes oldest-first past total_bytes UNDER LOAD — sampled while
+    records are still streaming in, not just after the fact."""
+    d = str(tmp_path / "rot")
+    budget = 16384
+    slack = 4096 + 512  # one in-flight segment past the prune point
+    bb = BlackBoxRecorder()
+    assert bb.start(
+        d, "extender", segment_bytes=4096, total_bytes=budget,
+        drain_interval_s=0.01, fsync_interval_s=0.0,
+        snapshot_interval_s=3600,
+    )
+    try:
+        for i in range(900):
+            bb.put(
+                "flight",
+                {"kind": "x", "message": "y" * 64, "i": i},
+            )
+            if i % 60 == 0:
+                time.sleep(0.03)
+                sizes = [
+                    s["size_bytes"] for s in blackbox.list_segments(d)
+                ]
+                assert sum(sizes) <= budget + slack, (i, sizes)
+        deadline = time.time() + 10.0
+        while time.time() < deadline and len(bb._queue):
+            time.sleep(0.02)
+    finally:
+        bb.stop()
+        from k8s_device_plugin_tpu.utils import profiling
+
+        profiling.HEARTBEATS.unregister("blackbox_writer")
+    segs = blackbox.list_segments(d)
+    assert bb.rotations >= 3, bb.rotations
+    assert sum(s["size_bytes"] for s in segs) <= budget + slack
+    # Oldest-first pruning: segment #1 is long gone, the newest stands.
+    present = {s["segment"] for s in segs}
+    assert 1 not in present, present
+    assert max(present) == bb._segment_seq
+    # Everything still on disk reads back through the journal grammar.
+    for seg in segs:
+        recs, status, _ = blackbox.read_segment(seg["path"])
+        assert status == statestore.CLEAN
+        assert recs and recs[0]["kind"] == "meta"
+
+
+# -- satellite: the unified ring drain/tap seam -------------------------------
+
+
+def test_flight_export_is_the_one_drain_seam(tmp_path):
+    """Every ring consumer routes through FlightRecorder.export():
+    /debug/events (reason-less), dump_on (reason stamped in the file),
+    and capture bundles. snapshot() is export() by another name."""
+    RECORDER.enable("extender", dump_dir=str(tmp_path))
+    try:
+        RECORDER.record("gang_released", "gates off", gang="ml/a")
+        snap = RECORDER.snapshot()
+        exp = RECORDER.export()
+        assert snap == exp
+        assert "reason" not in exp
+        stamped = RECORDER.export("capture")
+        assert stamped["reason"] == "capture"
+        assert stamped["events"] == exp["events"]
+        # /debug/events is the same drain (reason-less payload).
+        body = json.loads(metrics.debug_payload("/debug/events"))
+        assert body["events"] == [
+            {k: v for k, v in e.items()} for e in exp["events"]
+        ]
+        assert "reason" not in body
+        # dump_on carries its reason through export().
+        path = RECORDER.dump_on("sigterm")
+        assert path is not None
+        with open(path) as f:
+            dumped = json.load(f)
+        assert dumped["reason"] == "sigterm"
+        assert dumped["events"] == exp["events"]
+    finally:
+        RECORDER.disable()
+        RECORDER.clear()
+
+
+def test_plane_taps_roundtrip_copies_and_isolation():
+    """The add_tap seam on all three planes: every append is delivered
+    exactly once, ledger/span taps get COPIES (a consumer serializing
+    off-thread must not race retrace()'s in-place mutation), a removed
+    tap goes quiet, and a raising tap never takes the hot path down."""
+    got = {"flight": [], "decision": [], "span": []}
+    RECORDER.enable("extender")
+    LEDGER.enable("extender")
+    tracing.enable("extender")
+    f_tap = got["flight"].append
+    d_tap = got["decision"].append
+    s_tap = got["span"].append
+
+    def bomb(_):
+        raise RuntimeError("broken subscriber")
+
+    try:
+        RECORDER.add_tap(f_tap)
+        RECORDER.add_tap(bomb)
+        LEDGER.add_tap(d_tap)
+        tracing.COLLECTOR.add_tap(s_tap)
+        with tracing.span("gang.admit", gang="ml/t") as sp:
+            RECORDER.record("gang_released", "m", gang="ml/t")
+            LEDGER.record(
+                "gang_admitted", "capacity_ok", "ok", gang="ml/t"
+            )
+        assert len(got["flight"]) == 1
+        assert got["flight"][0]["kind"] == "gang_released"
+        assert len(got["decision"]) == 1
+        assert len(got["span"]) == 1
+        assert got["span"][0]["trace_id"] == sp.context.trace_id
+        # Copy isolation: mutating the tapped decision must not reach
+        # the live ledger record (and vice versa).
+        got["decision"][0]["attrs"]["injected"] = True
+        live = LEDGER.query(kind="gang_admitted")[0]
+        assert "injected" not in live["attrs"]
+        # Removal: no further delivery.
+        RECORDER.remove_tap(f_tap)
+        LEDGER.remove_tap(d_tap)
+        tracing.COLLECTOR.remove_tap(s_tap)
+        RECORDER.record("gang_released", "m2", gang="ml/t")
+        LEDGER.record("gang_admitted", "capacity_ok", "x", gang="ml/t")
+        assert len(got["flight"]) == 1
+        assert len(got["decision"]) == 1
+    finally:
+        RECORDER.remove_tap(bomb)
+        RECORDER.disable()
+        RECORDER.clear()
+        LEDGER.disable()
+        LEDGER.clear()
+        tracing.disable()
+        tracing.COLLECTOR.clear()
+
+
+# -- satellite: fake apiserver Lease LIST + fleet discovery -------------------
+
+
+def _lease(ns, name, holder, labels=None):
+    return (ns, name), {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {
+            "name": name, "namespace": ns,
+            "labels": dict(labels or {}),
+        },
+        "spec": {"holderIdentity": holder},
+    }
+
+
+def test_fake_apiserver_serves_lease_list_with_label_selector():
+    """fake_apiserver satellite: namespaced Lease LIST, optionally
+    filtered by labelSelector equality clauses — what fleet discovery
+    runs; previously only named GETs were exercised."""
+    api = FakeApiServer()
+    url = api.start()
+    try:
+        for key, lease in (
+            _lease("kube-system", "tpu-scheduler-extender-shard-0",
+                   "host-a-11", {"app": "tpu-extender"}),
+            _lease("kube-system", "unrelated-lock", "x-1"),
+            _lease("default", "tpu-scheduler-extender", "host-b-22",
+                   {"app": "tpu-extender"}),
+        ):
+            api.leases[key] = lease
+        client = KubeClient(url, token="t")
+        out = client.list_leases(namespace="kube-system")
+        assert out["kind"] == "LeaseList"
+        names = [i["metadata"]["name"] for i in out["items"]]
+        # Namespace-scoped: default's lease is absent.
+        assert names == [
+            "tpu-scheduler-extender-shard-0", "unrelated-lock"
+        ]
+        picked = client.list_leases(
+            namespace="kube-system", label_selector="app=tpu-extender"
+        )
+        assert [
+            i["metadata"]["name"] for i in picked["items"]
+        ] == ["tpu-scheduler-extender-shard-0"]
+        # A selector nothing matches is an empty list, not an error.
+        none = client.list_leases(
+            namespace="kube-system", label_selector="app=ghost"
+        )
+        assert none["items"] == []
+    finally:
+        api.stop()
+
+
+def test_fleet_discovery_from_leases_and_nodes(tmp_path):
+    """tpu-doctor fleet discovery: extender endpoints come from the
+    tpu-scheduler-extender* Lease holders (the -<pid> suffix stripped,
+    shard + standby leases on one host deduped), plugin endpoints from
+    every node's InternalIP — all through the real KubeClient against
+    the fake apiserver."""
+    api = FakeApiServer()
+    url = api.start()
+    try:
+        for key, lease in (
+            _lease("kube-system", "tpu-scheduler-extender-shard-0",
+                   "ext-a-101"),
+            _lease("kube-system", "tpu-scheduler-extender-shard-1",
+                   "ext-b-202"),
+            # Standby lease on an already-seen host: deduped.
+            _lease("kube-system",
+                   "tpu-scheduler-extender-shard-0-standby",
+                   "ext-a-101"),
+            # Foreign lease: ignored by the name-prefix filter.
+            _lease("kube-system", "kube-controller-manager", "cm-1"),
+        ):
+            api.leases[key] = lease
+        api.add_node("n1", {
+            "metadata": {"name": "n1", "annotations": {}, "labels": {}},
+            "status": {"addresses": [
+                {"type": "Hostname", "address": "n1"},
+                {"type": "InternalIP", "address": "10.0.0.5"},
+            ]},
+        })
+        api.add_node("n2")  # no InternalIP: skipped, not an error
+        endpoints = doctor.discover_fleet(
+            kubeconfig=_kubeconfig(tmp_path, url)
+        )
+        by_role = {}
+        for e in endpoints:
+            by_role.setdefault(e["role"], []).append(e["url"])
+        assert sorted(by_role["extender"]) == [
+            "http://ext-a:12346", "http://ext-b:12346"
+        ]
+        assert by_role["plugin"] == ["http://10.0.0.5:2112"]
+    finally:
+        api.stop()
+
+
+def test_fleet_rows_and_render_against_live_daemon():
+    """One live daemon (real MetricsServer: /debug/audit + readyz +
+    resilience) and one dead endpoint through _fleet_row/render_fleet:
+    the table carries build identity and phase, the dead endpoint is
+    UNREACHABLE, exit code 2; build skew across versions is flagged at
+    exit 1."""
+    from k8s_device_plugin_tpu import audit
+
+    metrics.set_build_info("plugin")
+    engine = audit.AuditEngine("plugin", [], interval_s=60)
+    audit.install_engine(engine)
+    srv = metrics.MetricsServer(host="127.0.0.1")
+    url = srv.start()
+    dead = f"http://127.0.0.1:{_free_port()}"
+    try:
+        rows = [
+            doctor._fleet_row({"role": "plugin", "url": url,
+                               "node": "n1"}),
+            doctor._fleet_row({"role": "extender", "url": dead,
+                               "lease": "tpu-scheduler-extender"}),
+        ]
+        live, down = rows
+        assert live["component"] == "plugin" and live["version"]
+        assert live["findings"] == 0
+        assert live["phase"] == "n/a"  # plugin: readyz not configured
+        assert down["unreachable"]
+        text, rc = doctor.render_fleet(rows)
+        assert rc == 2
+        assert "UNREACHABLE" in text
+        assert f"plugin/{live['version']}" in text
+        # Healthy-only rows exit 0.
+        _, rc_ok = doctor.render_fleet([live])
+        assert rc_ok == 0
+        # Version skew within one component exits 1 and is named.
+        skewed = dict(live)
+        skewed["version"] = "0.0.1-older"
+        skewed["url"] = "http://other:2112"
+        text2, rc2 = doctor.render_fleet([live, skewed])
+        assert rc2 == 1
+        assert "BUILD SKEW" in text2
+    finally:
+        srv.stop()
+
+
+# -- satellite: bundle metadata + exit-code edges -----------------------------
+
+
+def test_blackbox_metadata_reports_statuses(tmp_path):
+    """`tpu-doctor bundle --blackbox-dir` metadata: per-segment name,
+    service, pid, size, read status — a torn segment reads as
+    torn_tail with its intact-record count, never an error."""
+    d = str(tmp_path / "bb")
+    bb = BlackBoxRecorder()
+    assert bb.start(
+        d, "plugin", drain_interval_s=0.01, fsync_interval_s=0.0,
+        snapshot_interval_s=3600,
+    )
+    bb.put("flight", {"kind": "a", "message": "one"})
+    bb.put("flight", {"kind": "b", "message": "two"})
+    deadline = time.time() + 5
+    while time.time() < deadline and bb.records_written < 3:
+        time.sleep(0.02)
+    bb.stop()
+    from k8s_device_plugin_tpu.utils import profiling
+
+    profiling.HEARTBEATS.unregister("blackbox_writer")
+    meta = doctor._blackbox_metadata(d)
+    assert len(meta["segments"]) == 1
+    seg = meta["segments"][0]
+    assert seg["service"] == "plugin"
+    assert seg["pid"] == os.getpid()
+    assert seg["status"] == statestore.CLEAN
+    assert seg["records"] >= 4  # meta + 2 flight + stop
+    # Tear the tail: the metadata degrades the status, keeps counting.
+    path = os.path.join(d, seg["name"])
+    with open(path, "rb+") as f:
+        f.truncate(seg["size_bytes"] - 3)
+    seg2 = doctor._blackbox_metadata(d)["segments"][0]
+    assert seg2["status"] == statestore.TORN_TAIL
+    assert seg2["records"] == seg["records"] - 1
+
+
+def test_postmortem_exit_2_when_nothing_readable(tmp_path):
+    report = doctor.build_postmortem(str(tmp_path / "missing"))
+    assert report["exit_code"] == 2
+    assert "no black-box segments" in report["error"]
+    assert "UNAVAILABLE" in doctor.render_postmortem(report)
+    # A directory with only a zero-byte segment: segments exist but no
+    # intact record survives — still exit 2, still not a traceback.
+    d = tmp_path / "empty"
+    d.mkdir()
+    (d / "blackbox-extender-1-000001.seg").write_bytes(b"")
+    report = doctor.build_postmortem(str(d))
+    assert report["exit_code"] == 2
+
+
+def test_debug_blackbox_endpoint_serves_snapshot():
+    """/debug/blackbox (TPL008-documented, doctor-bundled) answers the
+    recorder's config/counters; disabled is an honest payload, not a
+    404."""
+    srv = metrics.MetricsServer(host="127.0.0.1")
+    url = srv.start()
+    try:
+        idx = requests.get(f"{url}/debug", timeout=5).json()
+        assert "/debug/blackbox" in idx["endpoints"]
+        snap = requests.get(f"{url}/debug/blackbox", timeout=5).json()
+        assert snap["enabled"] is False
+        assert snap["records_written"] == 0
+        assert "queue_depth" in snap and "drops" in snap
+    finally:
+        srv.stop()
