@@ -75,6 +75,20 @@ GANG_NAME_LABEL = "tpu.google.com/gang-name"
 # gang an hour past its last save loses an hour of chip time.
 CHECKPOINT_TS_ANNOTATION = "tpu.google.com/last-checkpoint"
 
+# Node taint marking TPU hardware maintenance (extender/rescue.py).
+# Any value excludes the node from placement and defrag/preemption
+# targeting; the value "drain" additionally makes the rescue plane
+# evacuate every resident gang (the tpu-drain verb sets it together
+# with spec.unschedulable so the intent survives an extender restart
+# in cluster state, not in a journal).
+MAINTENANCE_TAINT = "tpu.google.com/maintenance"
+DRAIN_TAINT_VALUE = "drain"
+
+# Node annotation stamped (epoch seconds) once a tpu-drain completes:
+# zero resident gang pods and zero reserved chips on the node. The
+# operator's "safe to power off" signal; removed on uncordon.
+DRAIN_COMPLETE_ANNOTATION = "tpu.google.com/drain-complete"
+
 # Env var understood the same way as the reference's DP_DISABLE_HEALTHCHECKS
 # (/root/reference/server.go:32-33,231-242): a comma-separated list of
 # check classes to disable. Classes: "all", "events" (inotify fast path;

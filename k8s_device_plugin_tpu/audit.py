@@ -1094,6 +1094,23 @@ class ExtenderAudit:
                 "that failed wholesale",
                 _skippable(self.check_gate_vs_hold),
             ))
+        if (
+            self.gang is not None
+            and getattr(self.gang, "rescue", None) is not None
+        ):
+            out.append(Invariant(
+                "rescue_vs_health",
+                ("rescue", "journal", "reservations"),
+                "a RUNNING gang known to sit on failed/withdrawn "
+                "capacity past the rescue grace window must be "
+                "accounted for — an open rescue round, a "
+                "RESCUE_PENDING parking, or a just-completed rescue; "
+                "and an open rescue_evicted journal phase must have "
+                "a standing target fence — its pods were already "
+                "evicted, so a fenceless round is a gang that is "
+                "gone AND unprotected",
+                self.check_rescue_vs_health,
+            ))
         if self.shard_manager is not None:
             out.append(Invariant(
                 "reservation_shard_ownership",
@@ -1264,6 +1281,80 @@ class ExtenderAudit:
                         gang=f"{key[0]}/{key[1]}",
                         planned=planned, held=held,
                     ))
+            return out
+
+        out = diff()
+        return diff() if out else out
+
+    def check_rescue_vs_health(self) -> List[Finding]:
+        """The rescue plane's two contracts (extender/rescue.py),
+        re-proven each sweep. (1) Liveness: a gang the engine itself
+        observes degraded (bound to withdrawn chips / a lost node)
+        STRICTLY past the grace window must be inside an open round,
+        parked RESCUE_PENDING, or just rescued — a degraded gang the
+        plane lost track of is a job silently burning on dead
+        hardware, CRITICAL. (2) Crash consistency, the defrag twin:
+        an open ``rescue_evicted`` journal phase means the gang's own
+        pods were already evicted, so the only safe states are
+        "target fenced under its key" or "round closed"; fenceless =
+        CRITICAL (the gang is gone AND unprotected). Same
+        double-check idiom as the siblings: a finding must survive a
+        re-read to rule out racing a mid-tick mutation."""
+        engine = getattr(self.gang, "rescue", None)
+        if engine is None:
+            return []
+
+        def diff() -> List[Finding]:
+            out = []
+            grace = int(getattr(engine, "grace_ticks", 1))
+            for key, st in sorted(engine.degraded_state().items()):
+                if int(st.get("ticks", 0)) <= grace:
+                    continue
+                if engine.tracked(key):
+                    continue
+                out.append(Finding.make(
+                    "rescue_vs_health", CRITICAL,
+                    f"gang {key[0]}/{key[1]} has been degraded on "
+                    f"{sorted(st.get('hosts') or {})} for "
+                    f"{st.get('ticks')} tick(s) (grace {grace}) "
+                    f"with no open rescue round, no RESCUE_PENDING "
+                    f"parking, and no completed rescue — the job is "
+                    f"burning on failed hardware and nothing is "
+                    f"moving it",
+                    gang=f"{key[0]}/{key[1]}",
+                    hosts=dict(st.get("hosts") or {}),
+                    ticks=int(st.get("ticks", 0)),
+                ))
+            if self.journal is not None and self.reservations is not None:
+                self.journal.flush()
+                rescuing = self.journal.replay_readonly().rescuing
+                if rescuing:
+                    live = self.reservations.export_state()
+                    for key, rec in sorted(rescuing.items()):
+                        if rec.get("phase") != "evicted":
+                            continue
+                        if key in live:
+                            continue
+                        planned = {
+                            str(h): int(n)
+                            for h, n in (
+                                rec.get("consumed") or {}
+                            ).items()
+                            if int(n) > 0
+                        }
+                        out.append(Finding.make(
+                            "rescue_vs_health", CRITICAL,
+                            f"gang {key[0]}/{key[1]} has an open "
+                            f"rescue_evicted phase (its pods were "
+                            f"already evacuated) but NO standing "
+                            f"fence on the planned target "
+                            f"{sorted(planned)} and no journaled "
+                            f"abort — the relocation target is up "
+                            f"for grabs and the rescued gang is "
+                            f"unprotected",
+                            gang=f"{key[0]}/{key[1]}",
+                            planned=planned,
+                        ))
             return out
 
         out = diff()

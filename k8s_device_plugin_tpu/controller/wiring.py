@@ -40,6 +40,7 @@ def publish_node_topology(
     worker_hostnames: str = "",
     slice_host_bounds: str = "1,1,1",
     host_info=None,
+    failed=None,
 ) -> NodeTopology:
     """Publish the ICI topology as a node annotation, retrying on conflict
     like the reference's patchNode loop (/root/reference/server.go:312-347).
@@ -50,6 +51,7 @@ def publish_node_topology(
         worker_hostnames=worker_hostnames,
         slice_host_bounds=slice_host_bounds,
         host_info=host_info,
+        failed=failed,
     )
     shape = "x".join(str(b) for b in mesh.bounds)
     last: Optional[Exception] = None
@@ -151,6 +153,10 @@ class TopologyPublisher:
                 worker_hostnames=self.worker_hostnames,
                 slice_host_bounds=self.slice_host_bounds,
                 host_info=self.host_info,
+                # Withdrawn-unhealthy chips ride the same annotation so
+                # the extender's rescue plane can join failures against
+                # the gangs holding them (schema.py NodeTopology.failed).
+                failed=sorted(self.plugin.state.unhealthy),
             )
             # The health condition rides the same serialized publish:
             # availability changes (allocation AND health transitions)

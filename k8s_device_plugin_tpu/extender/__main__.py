@@ -237,6 +237,26 @@ def main() -> int:
         "transient release race never triggers a repack",
     )
     p.add_argument(
+        "--no-rescue", action="store_true",
+        help="disable the hardware-failure rescue plane "
+        "(extender/rescue.py). By default (with --gang-admission) a "
+        "RUNNING gang bound to withdrawn/failed chips, a NotReady "
+        "node, or a draining node is evacuated through a journaled "
+        "two-phase round onto proven healthy capacity (evicting "
+        "strictly-lower-priority gangs under the shared defrag "
+        "eviction budget) and re-admitted at the head of its tier; "
+        "cordoned/tainted nodes are excluded from placement; the "
+        "/drain verb serves tpu-drain. With this flag gangs die "
+        "where their hardware dies (the pre-rescue behavior)",
+    )
+    p.add_argument(
+        "--rescue-grace-ticks", type=int, default=2,
+        help="consecutive admission ticks a gang must stay degraded "
+        "before its evacuation executes — hysteresis so a health-"
+        "check flap or node-condition blip never evacuates a live "
+        "job",
+    )
+    p.add_argument(
         "--gang-pending-event-s", type=float, default=300.0,
         help="post a kube Event (kubectl describe pod) on gangs "
         "capacity-waiting longer than this many seconds (budgeted + "
@@ -470,12 +490,33 @@ def main() -> int:
     # per-shard preemption stays inside the shard's gang/capacity
     # ownership.
     preempt_resolver = None
-    if a.gang_admission and not (a.no_preemption and a.no_defrag):
-        # Both eviction planes rank by PriorityClass; one resolver
-        # per process (it caches the class vocabulary).
+    if a.gang_admission and not (
+        a.no_preemption and a.no_defrag and a.no_rescue
+    ):
+        # All three eviction planes rank by PriorityClass; one
+        # resolver per process (it caches the class vocabulary).
         from .preemption import PriorityResolver
 
         preempt_resolver = PriorityResolver(client)
+    # Node lifecycle state for the rescue plane: ONE tracker per
+    # process (node Ready/cordon/taint state is cluster truth, not
+    # per-shard), fed by the node cache's watch+relist tap — no
+    # second node watch against the apiserver.
+    rescue_tracker = None
+    if a.gang_admission and not a.no_rescue:
+        from . import rescue as rescue_mod
+
+        rescue_tracker = rescue_mod.NodeStateTracker()
+        if node_cache is not None:
+            def _node_tap(etype, node, _t=rescue_tracker):
+                if etype == "DELETED":
+                    _t.remove_node(
+                        (node.get("metadata") or {}).get("name", "")
+                    )
+                else:
+                    _t.update_node(node)
+
+            node_cache.on_node_object = _node_tap
 
     def wire_preemption(adm) -> None:
         if preempt_resolver is None or adm is None:
@@ -517,6 +558,31 @@ def main() -> int:
             )
             adm.defrag = engine
             defrag_mod.install(engine)
+        if not a.no_rescue:
+            # Hardware-failure rescue plane (extender/rescue.py): one
+            # engine per admitter (its detection joins only the gangs
+            # and capacity the admitter owns); the process-wide node
+            # tracker is shared. The engine spends evictions through
+            # the defrag window above when wired — one operator
+            # blast-radius budget across both planes. install()
+            # publishes it on /debug/rescue; admission.stop()
+            # deregisters it.
+            from . import rescue as rescue_mod
+
+            engine = rescue_mod.RescueEngine(
+                adm,
+                preempt_resolver,
+                tracker=rescue_tracker,
+                grace_ticks=a.rescue_grace_ticks,
+                max_evictions_per_hour=(
+                    a.defrag_max_evictions_per_hour
+                ),
+            )
+            engine.drain_coordinator = rescue_mod.DrainCoordinator(
+                client, adm, rescue_tracker
+            )
+            adm.rescue = engine
+            rescue_mod.install(engine)
 
     sharded = a.gang_admission and a.shards > 1
     if sharded and a.no_singleton_lease:
@@ -744,6 +810,34 @@ def main() -> int:
             return eng.dry_run(pod)
 
         srv.preemption_handler = preemption_verb
+    if rescue_tracker is not None:
+        # The tpu-drain verb (POST /drain, driven by tools/doctor.py):
+        # answered by the HOME shard's rescue plane in sharded mode —
+        # cordon/taint are cluster-wide mutations, and every shard's
+        # placement filter reads the shared tracker.
+        def drain_verb(node: str, action: str) -> dict:
+            adm_obj = (
+                manager.home_admission()
+                if manager is not None
+                else gang
+            )
+            eng = (
+                getattr(adm_obj, "rescue", None)
+                if adm_obj is not None
+                else None
+            )
+            coord = getattr(eng, "drain_coordinator", None)
+            if coord is None:
+                return {
+                    "error": "rescue plane not active on this replica"
+                }
+            if action == "drain":
+                return coord.drain(node)
+            if action == "uncordon":
+                return coord.uncordon(node)
+            return coord.status(node)
+
+        srv.drain_handler = drain_verb
     auditor = None
     if a.audit_interval_s > 0:
         from .. import audit

@@ -621,6 +621,15 @@ class DefragEngine:
                 if now - t < BUDGET_WINDOW_S
             ]
 
+    def spend(self, stamp: float) -> None:
+        """Count one EXECUTED eviction against the rolling window.
+        The rescue plane (extender/rescue.py) spends through here too:
+        hardware rescue and defragmentation share ONE operator
+        blast-radius budget — two planes each granted the full cap
+        would double the churn ceiling the flag promises."""
+        with self._lock:
+            self._evictions.append(float(stamp))
+
     def seed_spend(self, stamps) -> None:
         """Rehydrate the rolling budget window on recovery (called
         once, on a fresh engine, by gang.recover): a crashlooping

@@ -605,6 +605,16 @@ class GangAdmission:
         # two-phase journaled, and admit onto the freed, fenced box.
         # None = no defrag (the pre-PR-15 behavior, bit for bit).
         self.defrag = None
+        # Hardware-failure rescue plane (extender/rescue.py), wired by
+        # the entrypoint. Every fully-released (RUNNING) gang is
+        # re-checked each evaluation: bound to withdrawn chips, a
+        # NotReady node, or a draining node → journaled two-phase
+        # evacuation onto proven healthy capacity (evicting strictly
+        # lower tiers under the shared defrag budget), or parked
+        # RESCUE_PENDING. Also filters non-placeable (cordoned/
+        # tainted/NotReady) nodes out of _node_topologies. None = no
+        # rescue (running gangs die where their hardware dies).
+        self.rescue = None
         # Optional utils/resilience.DegradedMode (entrypoint wiring):
         # while PAUSED (breaker open AND the last-known-good state is
         # past the staleness cap) the tick loop skips whole ticks —
@@ -666,6 +676,12 @@ class GangAdmission:
             close = getattr(self.defrag, "close", None)
             if close is not None:
                 close()
+        if self.rescue is not None:
+            # Same ordering contract as defrag above: deregister from
+            # /debug/rescue only after the tick thread is done.
+            close = getattr(self.rescue, "close", None)
+            if close is not None:
+                close()
         if self.journal is not None:
             # Graceful teardown folds state into one clean snapshot so
             # the successor's replay is O(holds), not O(journal). The
@@ -710,6 +726,11 @@ class GangAdmission:
                 if self.defrag is not None
                 else None
             ),
+            rescuing=(
+                self.rescue.open_intents()
+                if self.rescue is not None
+                else None
+            ),
         )
 
     def _recover_rounds(
@@ -721,19 +742,32 @@ class GangAdmission:
         done_op: str,
         abort_op: str,
         abort_metric: Optional[Callable[[str], None]] = None,
+        evicted_survives_vanish: bool = False,
     ) -> Tuple[int, int]:
         """Re-anchor the open two-phase rounds of ONE eviction
-        protocol (preempt_* or defrag_* — identical record shape by
-        design). Returns (refenced, aborted). An "evicted" phase whose
-        reserve never landed re-installs the planned fence from the
-        journaled plan (restore() journals the reserve via the
-        observer tap, so table and journal agree immediately); an
+        protocol (preempt_*, defrag_*, or rescue_* — identical record
+        shape by design). Returns (refenced, aborted). An "evicted"
+        phase whose reserve never landed re-installs the planned fence
+        from the journaled plan (restore() journals the reserve via
+        the observer tap, so table and journal agree immediately); an
         "intent" phase — or a fence that can no longer restore —
-        aborts, and the next tick re-plans from cluster truth."""
+        aborts, and the next tick re-plans from cluster truth.
+        evicted_survives_vanish (rescue rounds): a SIGKILL between
+        evicting the degraded gang's own pods and fencing its target
+        leaves the gang with NO pods — by design, we evicted them. The
+        fence must still restore (the controller's gated replacements
+        release against it); only the intent phase aborts on vanish."""
         refenced = aborted = 0
         active_now = self.reservations.active() if rounds else {}
         for key, rec in sorted(rounds.items()):
-            if truth and key not in gangs:
+            if (
+                truth
+                and key not in gangs
+                and not (
+                    evicted_survives_vanish
+                    and rec.get("phase") == "evicted"
+                )
+            ):
                 self.journal.record(
                     abort_op, key, reason="gang_vanished"
                 )
@@ -803,6 +837,7 @@ class GangAdmission:
             | set(state.waiting_since)
             | set(state.preempting)
             | set(state.defragging)
+            | set(state.rescuing)
         )
         try:
             if keys:
@@ -873,11 +908,39 @@ class GangAdmission:
                 reason=reason
             ),
         )
-        if self.defrag is not None and state.defrag_spend:
-            # The defrag eviction budget's rolling window survives the
+        rescue_refenced, rescue_aborted = self._recover_rounds(
+            state.rescuing, gangs, truth, now,
+            done_op="rescue_done", abort_op="rescue_abort",
+            # The abort reason becomes the outcome label; the round's
+            # original tier is not journaled, so recovery aborts are
+            # attributed to a dedicated tier.
+            abort_metric=lambda reason: metrics.RESCUES.inc(
+                outcome=reason, tier="recovery"
+            ),
+            # A rescue's evicted phase has, correctly, no live pods.
+            evicted_survives_vanish=True,
+        )
+        if self.rescue is not None and state.rescuing:
+            # A re-installed (or crash-surviving) rescue fence belongs
+            # to a gang whose pods WE evicted — replacements are still
+            # coming. Arm the engine's shield/boost window for it, or
+            # the first upkeep pass would drop the pod-less hold the
+            # recovery just fought to restore.
+            active_after = self.reservations.active()
+            for key in state.rescuing:
+                if key in active_after:
+                    self.rescue.note_refenced(key)
+        if state.defrag_spend:
+            # The shared eviction budget's rolling window survives the
             # crash: a crashlooping extender must not grant itself a
             # fresh --defrag-max-evictions-per-hour every restart.
-            self.defrag.seed_spend(state.defrag_spend)
+            # Rescue rounds journal their spend through the same op;
+            # defrag's window is the canonical one when wired (rescue
+            # delegates to it), else rescue keeps its own.
+            if self.defrag is not None:
+                self.defrag.seed_spend(state.defrag_spend)
+            elif self.rescue is not None:
+                self.rescue.seed_spend(state.defrag_spend)
         # Wait-episode origins: the SLO clock and the pending-Event
         # threshold keep counting from the TRUE start of the wait.
         for key, since in state.waiting_since.items():
@@ -908,6 +971,8 @@ class GangAdmission:
             "preempt_aborted": preempt_aborted,
             "defrag_refenced": defrag_refenced,
             "defrag_aborted": defrag_aborted,
+            "rescue_refenced": rescue_refenced,
+            "rescue_aborted": rescue_aborted,
             "cluster_truth": truth,
             "took_s": took,
         }
@@ -1109,6 +1174,12 @@ class GangAdmission:
             # stranded-episode hysteresis state and per-episode
             # ledger-dedup marks.
             self.defrag.note_admitted(key)
+        # NOT the rescue plane: this helper runs every tick for fully-
+        # released (RUNNING) gangs — exactly the population rescue
+        # tracks — so clearing its episode state here would reset the
+        # degraded grace counter forever. The engine clears its own
+        # episodes (healed / evacuated / no bound pods) and vanished
+        # gangs are pruned on full sweeps in _tick_inner.
 
     def _priority_of(
         self, key: Tuple[str, str], gv: "GangView"
@@ -1476,6 +1547,13 @@ class GangAdmission:
             self.preemption.begin_tick()
         if self.defrag is not None:
             self.defrag.begin_tick()
+        if self.rescue is not None:
+            self.rescue.begin_tick()
+            if full:
+                # Vanished gangs' degraded/parked episodes are pruned
+                # here (NOT in _clear_wait_state — see the note
+                # there); full sweeps see the complete population.
+                self.rescue.prune(set(gangs))
         self._reservation_upkeep(gangs, full)
         # Prune the waiting markers of gangs that vanished — the maps
         # must not grow without bound. A dirty tick only saw
@@ -1539,8 +1617,19 @@ class GangAdmission:
             key: self._priority_of(key, gv)
             for key, gv in gangs.items()
         }
+        # Within a tier, a just-rescued gang evaluates FIRST (boost 0
+        # vs 1): its standing fence re-admits it ahead of same-tier
+        # waiters — a gang evacuated through no fault of its own never
+        # re-queues behind newcomers. No rescue plane → all 1, the
+        # exact pre-rescue order.
+        boost = (
+            self.rescue.admit_boost
+            if self.rescue is not None
+            else lambda _key: 1
+        )
         for key, gv in sorted(
-            gangs.items(), key=lambda kv: (-prios[kv[0]], kv[0])
+            gangs.items(),
+            key=lambda kv: (-prios[kv[0]], boost(kv[0]), kv[0]),
         ):
             gated = gv.gated
             if not gated:
@@ -1554,6 +1643,25 @@ class GangAdmission:
                 self._clear_waiting(key)
                 self._clear_wait_state(key)
                 self._maybe_refence(key, gv, standing, pool)
+                if self.rescue is not None:
+                    # The rescue plane re-checks every RUNNING gang:
+                    # bound to withdrawn chips, a NotReady node, or a
+                    # draining node → journaled two-phase evacuation
+                    # onto proven healthy capacity. The consumed map
+                    # debits this tick's shared pool (the fenced
+                    # target must shrink what later gangs see); the
+                    # lazy topos_fn means a healthy steady-state tick
+                    # with no placed pods never lists nodes for this.
+                    consumed = self.rescue.maybe_rescue(
+                        key,
+                        gv,
+                        prios[key],
+                        lambda: pool().current_topos(),
+                        gangs=gangs if full else None,
+                    )
+                    if consumed:
+                        pool().debit(consumed)
+                        standing = self.reservations.active()
                 continue
             members = gv.members
             if len(members) < gv.size:
@@ -1899,6 +2007,18 @@ class GangAdmission:
         for key, res in self.reservations.active().items():
             gv = gangs.get(key)
             if gv is None:
+                if (
+                    self.rescue is not None
+                    and self.rescue.shield(key)
+                ):
+                    # A just-rescued gang has ZERO pods by design (the
+                    # rescue evicted them); dropping its fence before
+                    # the controller recreates the members would hand
+                    # the relocation target to a competitor. Bounded:
+                    # the shield expires with the rescue boost window,
+                    # then an ordinary pass reclaims an abandoned
+                    # fence.
+                    continue
                 self.reservations.drop(key)
                 continue
             unscheduled = 0
@@ -2036,6 +2156,7 @@ class GangAdmission:
                 # the structural no-double-booking half (its peer
                 # shards filter the complement).
                 topos = [t for t in topos if self.topo_filter(t)]
+            topos = self._drop_unplaceable(topos)
             self._last_topos = list(topos)
             return topos
         try:
@@ -2067,8 +2188,24 @@ class GangAdmission:
                 )
         if self.topo_filter is not None:
             topos = [t for t in topos if self.topo_filter(t)]
+        topos = self._drop_unplaceable(topos)
         self._last_topos = list(topos)
         return topos
+
+    def _drop_unplaceable(
+        self, topos: List[NodeTopology]
+    ) -> List[NodeTopology]:
+        """Node lifecycle filter (extender/rescue.py): cordoned,
+        maintenance-tainted, and NotReady nodes vanish from the
+        capacity view — so admission, re-fencing, preemption
+        targeting, and defrag targeting all refuse them with this one
+        cut. No rescue plane wired = no filter (pre-rescue behavior,
+        bit for bit; the scheduler's own cordon handling still
+        applies at bind time)."""
+        if self.rescue is None:
+            return topos
+        placeable = self.rescue.placeable
+        return [t for t in topos if placeable(t.hostname)]
 
     # -- release -----------------------------------------------------------
 

@@ -72,12 +72,20 @@ GangKey = Tuple[str, str]
 # preempt_* criticality rationale exactly: losing one could re-evict
 # already-migrated victims or leave the freed target box unfenced
 # through recovery.
+# The rescue_* ops (extender/rescue.py's hardware-evacuation protocol:
+# intent → degraded gang + victims evicted → relocation target fenced
+# → done/abort) are critical for the same reason — and worse: losing
+# rescue_evicted strands an evacuated gang with no fence at all, so
+# its replacement pods re-queue behind newcomers instead of landing on
+# the proven relocation target.
 CRITICAL_OPS = frozenset({
     "reserve", "admit", "lapse",
     "preempt_intent", "preempt_evicted", "preempt_done",
     "preempt_abort",
     "defrag_intent", "defrag_evicted", "defrag_done",
     "defrag_abort",
+    "rescue_intent", "rescue_evicted", "rescue_done",
+    "rescue_abort",
 })
 
 # One snapshot compaction per this many journal records keeps replay
@@ -123,6 +131,17 @@ class RehydratedState:
     # "intent" phase aborts (the next tick re-plans from cluster
     # truth).
     defragging: Dict[GangKey, dict] = dataclasses.field(
+        default_factory=dict
+    )
+    # Open hardware-rescue rounds (extender/rescue.py two-phase
+    # protocol), keyed by the DEGRADED gang being evacuated — same
+    # record shape as ``preempting``/``defragging``. Recovery differs
+    # in one way (gang.py): the degraded gang's own pods are evicted
+    # inside the round, so the gang legitimately has NO live pods at
+    # recovery time — an "evicted" phase re-fences the relocation
+    # target anyway (the replacement pods land on it), instead of
+    # aborting as gang_vanished.
+    rescuing: Dict[GangKey, dict] = dataclasses.field(
         default_factory=dict
     )
     # Wall clocks of executed defrag victim-pod evictions — the
@@ -280,6 +299,7 @@ class AdmissionJournal:
         waiting: Dict[GangKey, float] = {}
         preempting: Dict[GangKey, dict] = {}
         defragging: Dict[GangKey, dict] = {}
+        rescuing: Dict[GangKey, dict] = {}
         defrag_spend: List[float] = []
         if loaded.snapshot:
             snap = loaded.snapshot
@@ -308,6 +328,10 @@ class AdmissionJournal:
                 defragging[
                     (p.get("ns", ""), p.get("gang", ""))
                 ] = self._round_from_snap(p)
+            for p in snap.get("rescuing", []):
+                rescuing[
+                    (p.get("ns", ""), p.get("gang", ""))
+                ] = self._round_from_snap(p)
             defrag_spend.extend(
                 float(t) for t in snap.get("defrag_spend", [])
             )
@@ -315,7 +339,7 @@ class AdmissionJournal:
         for rec in loaded.records:
             self._apply(
                 rec, holds, lapsed, waiting, preempting, defragging,
-                defrag_spend,
+                defrag_spend, rescuing,
             )
             applied += 1
         return RehydratedState(
@@ -327,6 +351,7 @@ class AdmissionJournal:
             dropped=loaded.dropped,
             preempting=preempting,
             defragging=defragging,
+            rescuing=rescuing,
             defrag_spend=defrag_spend,
         )
 
@@ -339,6 +364,7 @@ class AdmissionJournal:
         preempting: Optional[Dict[GangKey, dict]] = None,
         defragging: Optional[Dict[GangKey, dict]] = None,
         defrag_spend: Optional[List[float]] = None,
+        rescuing: Optional[Dict[GangKey, dict]] = None,
     ) -> None:
         g = rec.get("g") or ["", ""]
         key: GangKey = (str(g[0]), str(g[1]))
@@ -422,6 +448,24 @@ class AdmissionJournal:
         elif op in ("defrag_done", "defrag_abort"):
             if defragging is not None:
                 defragging.pop(key, None)
+        elif op in ("rescue_intent", "rescue_evicted"):
+            if rescuing is not None:
+                # Full plan in both phases, like preempt_*/defrag_*: a
+                # compaction between the two records must leave the
+                # evicted phase self-sufficient for the re-fence.
+                rescuing[key] = {
+                    "phase": (
+                        "intent" if op == "rescue_intent" else "evicted"
+                    ),
+                    "victims": rec.get("victims") or [],
+                    "consumed": rec.get("consumed") or {},
+                    "demands": rec.get("demands") or [],
+                    "priority": int(rec.get("priority", 0)),
+                    "ts": float(rec.get("ts", 0.0)),
+                }
+        elif op in ("rescue_done", "rescue_abort"):
+            if rescuing is not None:
+                rescuing.pop(key, None)
         elif op == "defrag_spend":
             # Executed victim-pod evictions spending the rolling-hour
             # defrag budget; the engine prunes stamps past the window.
@@ -461,11 +505,13 @@ class AdmissionJournal:
         preempting: Optional[Dict[GangKey, dict]] = None,
         defragging: Optional[Dict[GangKey, dict]] = None,
         defrag_spend: Optional[List[float]] = None,
+        rescuing: Optional[Dict[GangKey, dict]] = None,
     ) -> dict:
         """The compaction document replay() consumes — built by the
         owner (gang.py assembles it from the live table + its lapse
-        bars + wait clocks + the preemption and defrag engines' open
-        rounds and the defrag engine's budget-spend window)."""
+        bars + wait clocks + the preemption, defrag, and rescue
+        engines' open rounds and the defrag engine's budget-spend
+        window)."""
         return {
             "holds": [
                 {
@@ -486,6 +532,7 @@ class AdmissionJournal:
             ],
             "preempting": AdmissionJournal._rounds_to_snap(preempting),
             "defragging": AdmissionJournal._rounds_to_snap(defragging),
+            "rescuing": AdmissionJournal._rounds_to_snap(rescuing),
             # Full precision: same-millisecond evictions must stay
             # distinct budget stamps across a replay.
             "defrag_spend": sorted(
@@ -610,6 +657,41 @@ def self_test() -> int:
         j7.record("defrag_done", dk)
         j7.close()
         assert dk not in AdmissionJournal(d).replay().defragging
+
+        # Hardware-rescue protocol: same two-phase shape again, its own
+        # op vocabulary — an open "evicted" evacuation survives replay
+        # AND a compaction (recovery must re-fence the relocation
+        # target for the evacuated gang), then closes on done.
+        rk = ("default", "degraded")
+        j8 = AdmissionJournal(d)
+        j8.replay()
+        j8.record(
+            "rescue_intent", rk,
+            victims=[["default", "bump"]], consumed={"n2": 4},
+            demands=[4],
+        )
+        j8.record(
+            "rescue_evicted", rk,
+            victims=[["default", "bump"]], consumed={"n2": 4},
+            demands=[4],
+        )
+        j8.close()
+        st = AdmissionJournal(d).replay()
+        assert st.rescuing[rk]["phase"] == "evicted", st.rescuing
+        assert st.rescuing[rk]["consumed"] == {"n2": 4}
+        j9 = AdmissionJournal(d)
+        st9 = j9.replay()
+        j9.compact(
+            AdmissionJournal.state_data(
+                st9.holds, st9.lapsed, st9.waiting_since,
+                st9.preempting, st9.defragging,
+                rescuing=st9.rescuing,
+            )
+        )
+        assert j9.replay().rescuing[rk]["phase"] == "evicted"
+        j9.record("rescue_done", rk)
+        j9.close()
+        assert rk not in AdmissionJournal(d).replay().rescuing
         print(json.dumps({"journal_self_test": "ok"}))
         return 0
     finally:

@@ -43,6 +43,14 @@ and the verb that enforces it:
   seconds ago loses almost nothing). The greedy build + prune pass
   never evicts more gangs than needed to free one placeable box.
 
+The planner and eviction door are deliberately engine-agnostic: the
+defrag plane (defrag.py) and the hardware-failure rescue plane
+(rescue.py) reuse :class:`PreemptionPlanner`'s victim ranking and the
+same PDB-honoring eviction path for their own two-phase rounds, and
+all three draw victim evictions from one shared rolling budget — a
+chip failure cannot double the cluster's eviction blast radius just
+because a different engine answered it.
+
 Every decision flows through the decision ledger (``preemption`` /
 ``preempt_victim`` kinds) so ``tools/explain.py --evicted`` answers
 "why was I evicted" with the same fidelity as "why am I pending", and

@@ -28,6 +28,14 @@ never schedule (node died post-release) doesn't fence capacity forever
 — after the lapse the gang Pends like any unschedulable pod, which is
 the API's floor once gates are gone.
 
+The preemption, defrag, and rescue planes all speak through this same
+table: their two-phase rounds end by fencing the freed/healthy box as
+a reservation under the beneficiary gang's key, and the rescue plane's
+pod-less holds (the gang's own pods were just evicted; replacements
+are coming) survive upkeep only while RescueEngine.shield() vouches
+for them — the ``rescue_vs_health`` audit invariant cross-checks an
+evicted-phase rescue journal round against a standing fence here.
+
 One table is shared in-process between GangAdmission and the
 TopologyExtender (deploy/tpu-extender.yml runs both in one container;
 extender/__main__.py wires them). The table itself is in-memory; with

@@ -948,6 +948,24 @@ class NodeAnnotationCache:
         # the last-known-good index really is while the breaker is
         # open.
         self.degraded = None
+        # Optional (etype, node) -> None tap, attached by the
+        # entrypoint: receives every WHOLE node object this cache sees
+        # (watch events AND relist items — the relist level-triggers
+        # whatever the watch missed). The rescue plane's
+        # NodeStateTracker (extender/rescue.py) rides this to follow
+        # Ready conditions, cordons, and maintenance taints without a
+        # second node watch against the apiserver. Exceptions are the
+        # tap's problem — never this cache's.
+        self.on_node_object = None
+
+    def _offer_node_object(self, etype: str, node: dict) -> None:
+        tap = self.on_node_object
+        if tap is None:
+            return
+        try:
+            tap(etype, node)
+        except Exception:  # noqa: BLE001 — advisory tap
+            log.exception("node object tap failed")
 
     @property
     def synced(self) -> bool:
@@ -1265,6 +1283,7 @@ class NodeAnnotationCache:
             fresh[meta.get("name", "")] = ann.get(
                 constants.TOPOLOGY_ANNOTATION
             )
+            self._offer_node_object("MODIFIED", node)
         with self._lock:
             # Snapshot the value set under the lock: concurrent
             # _fetch() calls mutate the installed dict, and iterating
@@ -1310,6 +1329,9 @@ class NodeAnnotationCache:
         for name in removed:
             metrics.INDEX_EVENTS.inc(
                 source="relist", kind=self.index.remove(name)
+            )
+            self._offer_node_object(
+                "DELETED", {"metadata": {"name": name}}
             )
         if pending is not None:
             # Snapshot reconcile counters, batched (one lock hit per
@@ -1388,6 +1410,7 @@ class NodeAnnotationCache:
         name = meta.get("name", "")
         if not name or etype == "BOOKMARK":
             return "noop"
+        self._offer_node_object(etype, node)
         if etype == "DELETED":
             with self._lock:
                 self._raw.pop(name, None)
@@ -1656,6 +1679,7 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
         ready_check=None,
         ready_status=None,
         preemption_handler=None,
+        drain_handler=None,
         degraded=None,
     ):
         super().__init__(host, port)
@@ -1674,6 +1698,13 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
         # so a scheduler policy declaring preemptVerb against a
         # preemption-less deployment fails loudly, not emptily.
         self.preemption_handler = preemption_handler
+        # The tpu-drain verb (extender/rescue.py DrainCoordinator,
+        # driven by tools/doctor.py): POST /drain {"node", "action":
+        # drain|status|uncordon} → drain status dict. Wired only on
+        # the admitter replica holding the rescue plane; None answers
+        # 404 so a doctor pointed at a rescue-less deployment fails
+        # loudly.
+        self.drain_handler = drain_handler
         # The admitter identity holding the singleton lease (leader.py),
         # served on /reservations so tools/gang can detect a snapshot
         # taken from a non-admitter replica.
@@ -1759,7 +1790,7 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                     verb = self.path.strip("/")
                     metrics.EXTENDER_REQUESTS.inc(
                         verb=verb
-                        if verb in ("filter", "prioritize", "preemption")
+                        if verb in ("filter", "prioritize", "preemption", "drain")
                         else "other",
                         outcome="not_ready",
                     )
@@ -1783,7 +1814,7 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                     verb = self.path.strip("/")
                     metrics.EXTENDER_REQUESTS.inc(
                         verb=verb
-                        if verb in ("filter", "prioritize", "preemption")
+                        if verb in ("filter", "prioritize", "preemption", "drain")
                         else "other",
                         outcome="degraded_paused",
                     )
@@ -1866,6 +1897,37 @@ class ExtenderHTTPServer(BackgroundHTTPServer):
                         # itself; the in-process engine's own rounds
                         # ride the admission tick instead.
                         self._send(handler(pod))
+                    elif self.path == "/drain":
+                        handler = server.drain_handler
+                        if handler is None:
+                            self._send(
+                                {"error": "drain not enabled"}, 404
+                            )
+                            return
+                        node = str(args.get("node") or "")
+                        action = str(
+                            args.get("action") or "status"
+                        )
+                        if not node:
+                            self._send(
+                                {"error": "node is required"}, 400
+                            )
+                            return
+                        if action not in (
+                            "drain", "status", "uncordon",
+                        ):
+                            self._send(
+                                {
+                                    "error": (
+                                        f"unknown action {action}"
+                                    )
+                                },
+                                400,
+                            )
+                            return
+                        # Idempotent by design: tools/doctor.py polls
+                        # by re-POSTing action=drain until done.
+                        self._send(handler(node, action))
                     else:
                         self._send({"error": f"unknown path {self.path}"}, 404)
                         return

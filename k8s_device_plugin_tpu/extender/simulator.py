@@ -100,7 +100,10 @@ DEFAULT_SEED = 1234
 
 # The canned traces scripts/tier1.sh, bench.py, and the CI bounds all
 # replay (tests/sim_traces/<name>.json).
-CANNED_TRACES = ("steady_mixed", "priority_burst", "churn_strand")
+CANNED_TRACES = (
+    "steady_mixed", "priority_burst", "churn_strand",
+    "chip_failure_rescue",
+)
 
 
 def trace_dir() -> str:
@@ -327,7 +330,10 @@ class _SimNode:
         self.name = name
         self.mesh = _mk_mesh(chips)
         self.avail: List[str] = list(self.mesh.ids)
-        self.failed = 0
+        # Withdrawn chip ids, published on the topology exactly like
+        # the controller publishes the health watcher's withdrawals —
+        # the rescue plane's detection join reads this field.
+        self.failed_ids: List[str] = []
 
     def take(self, n: int) -> List[str]:
         ids, self.avail = self.avail[:n], self.avail[n:]
@@ -336,26 +342,45 @@ class _SimNode:
     def give(self, ids: List[str]) -> None:
         # Mesh-order availability keeps the binder's pick (and the
         # box math over it) deterministic and stable across runs.
+        # Withdrawn silicon never returns to the free pool.
         order = {cid: i for i, cid in enumerate(self.mesh.ids)}
-        self.avail = sorted(set(self.avail) | set(ids),
-                            key=lambda c: order.get(c, 1 << 30))
+        dead = set(self.failed_ids)
+        self.avail = sorted(
+            (set(self.avail) | set(ids)) - dead,
+            key=lambda c: order.get(c, 1 << 30),
+        )
 
     def fail(self, n: int) -> Tuple[int, List[str]]:
         """Remove ``n`` chips from service, free chips last-first.
         Returns (chips actually failed from the FREE pool, ids) — the
-        caller kills bound pods for the remainder."""
+        caller handles bound-pod silicon for the remainder."""
         took = self.avail[-n:] if n > 0 else []
         self.avail = self.avail[: len(self.avail) - len(took)]
-        self.failed += len(took)
+        self.failed_ids.extend(took)
         return len(took), took
+
+    def fail_bound(self, ids: List[str]) -> None:
+        """Withdraw chips currently held by a bound pod WITHOUT
+        killing the pod — the overcommit (bound > healthy) is what
+        the rescue plane's count-granularity join detects."""
+        self.failed_ids.extend(
+            cid for cid in ids if cid not in self.failed_ids
+        )
+
+    @property
+    def failed(self) -> int:
+        return len(self.failed_ids)
 
     @property
     def capacity(self) -> int:
-        return len(self.mesh.ids) - self.failed
+        return len(self.mesh.ids) - len(self.failed_ids)
 
     def topology(self) -> NodeTopology:
         return NodeTopology.from_mesh(
-            self.mesh, hostname=self.name, available=list(self.avail)
+            self.mesh,
+            hostname=self.name,
+            available=list(self.avail),
+            failed=list(self.failed_ids),
         )
 
 
@@ -437,6 +462,9 @@ class _SimGang:
     depart_tick: Optional[int] = None
     generation: int = 0
     evicted_count: int = 0
+    # Virtual timestamp of the chip failure that degraded this gang —
+    # cleared (and scored as time-to-rescue) when it is running again.
+    degraded_t: Optional[float] = None
     # pod name -> (host, chip ids) for bound pods.
     bindings: Dict[str, Tuple[str, List[str]]] = dataclasses.field(
         default_factory=dict
@@ -544,6 +572,27 @@ class SimRun:
                 clock=self.clock.now,
             )
             self.adm.defrag = self.defrag
+        self.rescue = None
+        if self.policy.get("rescue", True):
+            rplanner = PreemptionPlanner(
+                resolver,
+                duty_source=self._duty_source,
+                clock=self.clock.now,
+            )
+            self.rescue = _RecordingRescueEngine(
+                self.adm,
+                resolver,
+                planner=rplanner,
+                grace_ticks=int(
+                    self.policy.get("rescue_grace_ticks", 1)
+                ),
+                max_evictions_per_hour=int(
+                    self.policy.get("max_evictions_per_hour", 12)
+                ),
+                post_events=False,
+                clock=self.clock.now,
+            )
+            self.adm.rescue = self.rescue
         # Scoring accumulators.
         self.tick_errors = 0
         self.frag_sum = 0.0
@@ -559,6 +608,12 @@ class SimRun:
         self.readmissions = 0
         self.chips_failed = 0
         self.fail_restarts = 0
+        self.rescued_gangs = 0
+        self.rescue_victim_cost = 0.0
+        self.rescue_times: List[float] = []
+        self.rescue_pending_ticks = 0
+        self.hw_lost_cost = 0.0
+        self._rescue_rounds_seen = 0
 
     # -- wiring ------------------------------------------------------------
 
@@ -686,7 +741,16 @@ class SimRun:
             short = want - got
             if short <= 0:
                 continue
-            # Not enough free chips: bound pods on that node die with
+            if self.rescue is not None:
+                # Rescue plane wired: withdraw the silicon UNDER the
+                # bound pods and leave them running degraded — the
+                # engine's count-granularity join (bound > healthy on
+                # the published topology) detects it and evacuates
+                # through the eviction door, exactly the production
+                # shape.
+                self._fail_bound_rescued(node, short)
+                continue
+            # No rescue plane: bound pods on that node die with
             # their silicon, and their whole gang restarts gated.
             for key in sorted(self.gangs):
                 if short <= 0:
@@ -702,7 +766,7 @@ class SimRun:
                     _h, ids = g.bindings.pop(pod_name)
                     self.client.delete_pod(self.NS, pod_name)
                     lost = min(short, len(ids))
-                    node.failed += lost
+                    node.fail_bound(ids[:lost])
                     short -= lost
                     self.chips_failed += lost
                     if len(ids) > lost:
@@ -717,10 +781,39 @@ class SimRun:
                     self.nodes[host].give(ids)
                 g.depart_tick = None
                 self.fail_restarts += 1
+                self.hw_lost_cost += Victim(
+                    key=key,
+                    priority=g.priority,
+                    hosts={},
+                    pods=[],
+                    duty_cycle=g.duty_cycle,
+                    checkpoint_age_s=g.checkpoint_age_s,
+                ).restart_cost()
                 self._events.inc(event="chip_failure_restart")
                 self._restarts.setdefault(
                     tick + RESTART_DELAY_TICKS, []
                 ).append(key)
+
+    def _fail_bound_rescued(self, node: _SimNode, short: int) -> None:
+        """Withdraw ``short`` chips from bound pods on ``node``
+        without killing anything — the rescue plane owns the
+        evacuation from here. Gangs touched are stamped degraded_t
+        for the time-to-rescue score."""
+        for key in sorted(self.gangs):
+            if short <= 0:
+                return
+            g = self.gangs[key]
+            for pod_name in sorted(g.bindings):
+                host, ids = g.bindings[pod_name]
+                if host != node.name or short <= 0:
+                    continue
+                lost = min(short, len(ids))
+                node.fail_bound(ids[:lost])
+                short -= lost
+                self.chips_failed += lost
+                if g.degraded_t is None:
+                    g.degraded_t = self.clock.now()
+                    self._events.inc(event="gang_degraded")
 
     def _bind(self, released: List[GangKey], tick: int) -> None:
         for key in released:
@@ -764,6 +857,14 @@ class SimRun:
                 self._events.inc(event="readmit")
                 if g.duration_ticks:
                     g.depart_tick = tick + g.duration_ticks
+            if g.degraded_t is not None and len(g.bindings) == g.pods:
+                # Running again on healthy silicon: the episode's
+                # time-to-rescue is failure -> full re-bind.
+                self.rescue_times.append(
+                    self.clock.now() - g.degraded_t
+                )
+                g.degraded_t = None
+                self._events.inc(event="rescued_running")
 
     def _drain_evictions(self, mark: int, tick: int) -> None:
         new = self.client.evictions[mark:]
@@ -775,6 +876,24 @@ class SimRun:
             for v in plan.victims
             for p in v.pods
         }
+        # Only the rounds executed since the last drain classify this
+        # window's evictions — a gang rescued earlier in the run can
+        # still be a plain preemption victim later.
+        new_rounds: List[dict] = []
+        if self.rescue is not None:
+            new_rounds = self.rescue.executed_rounds[
+                self._rescue_rounds_seen:
+            ]
+            self._rescue_rounds_seen = len(
+                self.rescue.executed_rounds
+            )
+        rescue_victim_pods = {
+            (p.get("ns", ""), p.get("name", ""))
+            for rnd in new_rounds
+            for v in rnd["victims"]
+            for p in v.pods
+        }
+        rescued_keys = {rnd["key"] for rnd in new_rounds}
         by_gang: Dict[GangKey, List[str]] = {}
         for _t, ns, name in new:
             gang_name = name.rsplit("-g", 1)[0]
@@ -796,7 +915,20 @@ class SimRun:
             is_defrag = any(
                 (self.NS, p) in defrag_pods for p in pods
             )
-            if is_defrag:
+            is_rescue_victim = any(
+                (self.NS, p) in rescue_victim_pods for p in pods
+            )
+            if key in rescued_keys:
+                # The degraded gang's own evacuation: the restart it
+                # pays is work the HARDWARE cost it, and it re-admits
+                # against the standing rescue fence.
+                self.rescued_gangs += 1
+                self.hw_lost_cost += cost
+                self._events.inc(event="rescue_evacuation")
+            elif is_rescue_victim:
+                self.rescue_victim_cost += cost
+                self._events.inc(event="rescue_victim")
+            elif is_defrag:
                 self.defrag_cost += cost
             else:
                 self.preempt_cost += cost
@@ -872,11 +1004,19 @@ class SimRun:
                 self._drain_evictions(evict_mark, tick)
                 self._bind(released, tick)
                 self._score_defrag(plan_mark, 0)
+                if self.rescue is not None:
+                    # Gang-ticks spent parked RESCUE_PENDING — the
+                    # stranded-demand exposure hardware failures cost.
+                    self.rescue_pending_ticks += len(
+                        self.rescue.pending_state()
+                    )
                 self._sample()
             return self._scorecard()
         finally:
             if self.defrag is not None:
                 self.defrag.close()
+            if self.rescue is not None:
+                self.rescue.close()
 
     # -- scoring -----------------------------------------------------------
 
@@ -925,10 +1065,11 @@ class SimRun:
             "policy": {
                 "preemption": self.preemption is not None,
                 "defrag": self.defrag is not None,
+                "rescue": self.rescue is not None,
                 **{
                     k: self.policy[k]
                     for k in sorted(self.policy)
-                    if k not in ("preemption", "defrag")
+                    if k not in ("preemption", "defrag", "rescue")
                 },
             },
             "arrivals": {
@@ -969,10 +1110,22 @@ class SimRun:
                 "efficiency_chips_per_eviction": efficiency,
                 "restart_cost_paid": self.defrag_cost,
             },
+            "rescue": {
+                "enabled": self.rescue is not None,
+                "rounds_executed": (
+                    len(self.rescue.executed_rounds)
+                    if self.rescue else 0
+                ),
+                "gangs_rescued": self.rescued_gangs,
+                "time_to_rescue_s": _pctls(self.rescue_times),
+                "pending_gang_ticks": self.rescue_pending_ticks,
+                "victim_restart_cost_paid": self.rescue_victim_cost,
+            },
             "failures": {
                 "chips_failed": self.chips_failed,
                 "gangs_restarted": self.fail_restarts,
                 "tick_errors": self.tick_errors,
+                "work_lost_to_hardware_cost": self.hw_lost_cost,
             },
             "events": events,
         }
@@ -987,6 +1140,10 @@ class SimRun:
             "preemption_churn_cost": self.preempt_cost,
             "defrag_efficiency_chips_per_eviction": efficiency,
             "evictions_total": self.preempt_pods + d_evictions,
+            "time_to_rescue_p50_s": card["rescue"][
+                "time_to_rescue_s"
+            ]["p50_s"],
+            "work_lost_to_hardware_cost": self.hw_lost_cost,
         }
         return _rounded(card)
 
@@ -1009,6 +1166,35 @@ class _RecordingDefragEngine:
                 out = super()._execute(key, gang_key, plan)
                 if out is not None:
                     self.executed_plans.append(plan)
+                return out
+
+        return _Impl(*args, **kwargs)
+
+
+class _RecordingRescueEngine:
+    """RescueEngine plus a per-run executed-round record ((key,
+    victims) per rescue — the eviction classifier and the rescue
+    scores need the join, and global counters would leak across runs
+    in one process). Composed lazily like the defrag twin."""
+
+    def __new__(cls, *args, **kwargs):
+        from .rescue import RescueEngine
+
+        class _Impl(RescueEngine):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self.executed_rounds = []
+
+            def _execute(self, key, gang_key, gv, priority, demands,
+                         consumed, victims, degraded, bound, since):
+                out = super()._execute(
+                    key, gang_key, gv, priority, demands, consumed,
+                    victims, degraded, bound, since,
+                )
+                if out is not None:
+                    self.executed_rounds.append(
+                        {"key": key, "victims": list(victims)}
+                    )
                 return out
 
         return _Impl(*args, **kwargs)

@@ -44,6 +44,15 @@ only class is "xids"):
 * ``interval`` — disable the periodic sweep (event-driven only; if the
   event source is also unavailable, health checking is inert and a
   warning is logged).
+
+Downstream of a transition: the daemon withdraws the chip from the
+kubelet (ListAndWatch re-advertisement) AND moves it to the published
+topology annotation's ``failed`` list (controller/wiring.py). That
+second hop is load-bearing for robustness: the extender's rescue plane
+(extender/rescue.py) joins ``failed`` against each RUNNING gang's bound
+chips to detect a gang burning on dead silicon and evacuate it — so a
+withdrawal here is not just "stop placing", it is the detection signal
+for evacuating what is already placed.
 """
 
 from __future__ import annotations

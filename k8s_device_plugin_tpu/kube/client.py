@@ -358,6 +358,48 @@ class KubeClient:
     ) -> dict:
         return self.patch(f"/api/v1/nodes/{name}", {"metadata": {"labels": labels}})
 
+    def set_node_unschedulable(
+        self, name: str, unschedulable: bool
+    ) -> dict:
+        """Cordon/uncordon: merge-patch spec.unschedulable, exactly what
+        kubectl cordon does. Idempotent (a merge patch applied twice =
+        once), so the resilience layer may retry it."""
+        return self.patch(
+            f"/api/v1/nodes/{name}",
+            {"spec": {"unschedulable": bool(unschedulable)}},
+        )
+
+    def set_node_taint(
+        self,
+        name: str,
+        key: str,
+        value: str = "",
+        effect: str = "NoSchedule",
+        remove: bool = False,
+    ) -> dict:
+        """Add or remove ONE taint by key via read-modify-write.
+
+        Strategic merge cannot delete a list entry and real apiservers
+        merge taints by key anyway only under the patchMergeKey
+        machinery our fake doesn't model — so the whole spec.taints
+        list is read, edited, and written back. The window between
+        read and write can lose a concurrent taint edit by another
+        controller; acceptable for the drain/maintenance flow, which
+        owns its one key and runs from a single extender."""
+        node = self.get_node(name)
+        taints = [
+            t
+            for t in (node.get("spec", {}).get("taints") or [])
+            if t.get("key") != key
+        ]
+        if not remove:
+            taints.append({"key": key, "value": value, "effect": effect})
+        return self.patch(
+            f"/api/v1/nodes/{name}",
+            {"spec": {"taints": taints}},
+            content_type=MERGE_PATCH,
+        )
+
     def patch_node_condition(self, name: str, condition: dict) -> dict:
         """Set one condition in node status (strategic merge keys
         conditions by ``type`` on real API servers) — the

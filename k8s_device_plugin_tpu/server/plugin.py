@@ -25,7 +25,7 @@ import dataclasses
 import os
 import threading
 from concurrent import futures
-from typing import Callable, Dict, Iterable, List, Optional, Sequence
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import grpc
 
@@ -168,6 +168,14 @@ class TpuDevicePlugin(DevicePluginServicer):
         self._server: Optional[grpc.Server] = None
         self._watcher_server: Optional[grpc.Server] = None
         self._stop = threading.Event()
+        # Kubelet-restart re-registration watcher (start_restart_watch):
+        # its own stop event, NOT self._stop — a restart cycle calls
+        # start(), which clears self._stop, and the watcher must
+        # outlive every such cycle until the real stop().
+        self._rereg_stop = threading.Event()
+        self._rereg_thread: Optional[threading.Thread] = None
+        self._rereg_baseline: Optional[Tuple[int, int]] = None
+        self._rereg_interval = 5.0
         # Serializes Allocate plan→commit so concurrent RPCs (8-thread
         # executor) can't plan overlapping chip sets.
         self._allocate_lock = threading.Lock()
@@ -225,6 +233,10 @@ class TpuDevicePlugin(DevicePluginServicer):
         log.info("device plugin serving on %s", sock)
 
     def stop(self) -> None:
+        self._rereg_stop.set()
+        if self._rereg_thread is not None:
+            self._rereg_thread.join(timeout=5)
+            self._rereg_thread = None
         self._stop.set()
         with self._version_cv:
             self._version_cv.notify_all()
@@ -319,6 +331,128 @@ class TpuDevicePlugin(DevicePluginServicer):
             self.start_watcher_registration()
         if mode in ("register", "both"):
             self.register()
+
+    # ------------------------------------------------------------------
+    # Kubelet-restart re-registration
+    # ------------------------------------------------------------------
+    #
+    # A kubelet restart silently unregisters every device plugin: the
+    # kubelet wipes its device-plugins dir (taking our serving socket
+    # with it), comes back up with an empty plugin registry, and the
+    # node advertises zero google.com/tpu until someone registers
+    # again. The reference plugin handles this with an fsnotify watch
+    # on the kubelet socket (the upstream nvidia pattern); here a
+    # supervised poll loop watches BOTH signals — the kubelet socket
+    # changing identity (restart) and our own socket vanishing (dir
+    # wipe) — and re-runs the serve()+register() cycle. Device,
+    # health, and allocation state all live in PlacementState, not in
+    # the gRPC server, so a re-serve loses nothing.
+
+    def start_restart_watch(self, interval_s: float = 5.0) -> None:
+        """Start the kubelet-restart watcher (supervised +
+        heartbeat). Called by the daemon entrypoint after the first
+        serve(); idempotent."""
+        if self._rereg_thread is not None:
+            return
+        self._rereg_interval = max(0.5, float(interval_s))
+        self._rereg_stop.clear()
+        # Baseline the kubelet socket identity HERE, on the caller's
+        # thread, not inside the loop: a kubelet restart that lands in
+        # the window between this call and the thread's first
+        # instruction would otherwise become the baseline and the
+        # restart would never be detected.
+        self._rereg_baseline = self._kubelet_socket_ino()
+        self._rereg_thread = threading.Thread(
+            target=profiling.supervised(
+                "plugin_reregister", self._reregister_loop
+            ),
+            name="plugin-reregister",
+            daemon=True,
+        )
+        self._rereg_thread.start()
+
+    def _kubelet_socket_ino(self) -> Optional[Tuple[int, int]]:
+        # Identity is (inode, mtime_ns), not inode alone: tmpfs and
+        # overlayfs happily hand the recreated kubelet.sock the same
+        # inode number back, which would make a fast kubelet bounce
+        # invisible. The creation timestamp disambiguates.
+        try:
+            st = os.stat(self.config.kubelet_socket)
+            return (st.st_ino, st.st_mtime_ns)
+        except OSError:
+            return None
+
+    def _reregister_loop(self) -> None:
+        hb = profiling.HEARTBEATS.register(
+            "plugin_reregister", interval_s=self._rereg_interval
+        )
+        last_ino = self._rereg_baseline
+        pending: Optional[str] = None
+        while not self._rereg_stop.wait(self._rereg_interval):
+            hb.beat()
+            ino = self._kubelet_socket_ino()
+            if pending is None:
+                if not os.path.exists(self.config.socket_path):
+                    pending = "plugin_socket_vanished"
+                elif (
+                    ino is not None
+                    and last_ino is not None
+                    and ino != last_ino
+                ):
+                    pending = "kubelet_restart"
+            if ino is not None:
+                last_ino = ino
+            if pending is None:
+                continue
+            if ino is None:
+                # The kubelet is still down: nothing to register
+                # with. Keep the trigger pending and retry next beat.
+                continue
+            try:
+                self._restart_serving(pending)
+            except Exception as e:  # noqa: BLE001 — the kubelet may
+                # still be coming up (Register refused, dial timeout):
+                # keep the trigger pending, retry next beat.
+                log.warning(
+                    "re-registration after %s failed (%s); retrying",
+                    pending, e,
+                )
+                continue
+            pending = None
+            last_ino = self._kubelet_socket_ino()
+
+    def _restart_serving(self, trigger: str) -> None:
+        """Tear down only the gRPC servers and re-run the serve +
+        register cycle. PlacementState (allocations, health) is
+        untouched — the kubelet re-learns the device list through the
+        fresh ListAndWatch stream it opens after Register."""
+        log.warning(
+            "kubelet restart detected (%s): re-serving %s and "
+            "re-registering",
+            trigger, self.config.resource_name,
+        )
+        if self._server is not None:
+            self._server.stop(grace=1).wait()
+            self._server = None
+        if self._watcher_server is not None:
+            self._watcher_server.stop(grace=1).wait()
+            self._watcher_server = None
+        self.serve()
+        metrics.PLUGIN_REREGISTRATIONS.inc(trigger=trigger)
+        RECORDER.record(
+            "reregister",
+            f"re-registered {self.config.resource_name} with the "
+            f"kubelet after {trigger}",
+            trigger=trigger,
+        )
+        LEDGER.record(
+            "reregister", trigger,
+            f"kubelet restart detected ({trigger}): device plugin "
+            f"re-served its socket and re-registered "
+            f"{self.config.resource_name} — without this the node "
+            f"advertises zero TPUs until the daemon is restarted",
+            resource=self.config.resource_name,
+        )
 
     # ------------------------------------------------------------------
     # Health plumbing (reference health chan, server.go:180-182)

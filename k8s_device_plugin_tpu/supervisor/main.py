@@ -432,6 +432,11 @@ class Daemon:
                 # Healthy for a poll interval (VERDICT r1 weak #6).
                 self.health.poll_once()
         self.plugin.serve()
+        # Kubelet-restart watcher: a restarted kubelet wipes its
+        # plugin registry (and our socket) — the node would advertise
+        # zero TPUs until this daemon re-registers. Supervised +
+        # heartbeat (server/plugin.py).
+        self.plugin.start_restart_watch()
         if self.health is not None:
             self.health.start()
         self._start_kube_integration(mesh)

@@ -58,6 +58,94 @@ def _fetch(url: str, path: str, timeout: float = 10.0) -> bytes:
         return resp.read()
 
 
+def _post_drain(
+    url: str, node: str, action: str, timeout: float = 30.0
+) -> dict:
+    import urllib.request
+
+    req = urllib.request.Request(
+        url.rstrip("/") + "/drain",
+        data=json.dumps({"node": node, "action": action}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def drain(
+    url: str,
+    node: str,
+    uncordon: bool = False,
+    wait: bool = True,
+    poll_s: float = 5.0,
+    timeout_s: float = 1800.0,
+    clock=time.time,
+    sleep=time.sleep,
+) -> int:
+    """The ``tpu-drain <node>`` verb: ask the extender's rescue plane
+    to cordon + taint the node and evacuate every resident gang
+    (journaled two-phase rounds, same path as a chip failure), then
+    poll until zero resident gang pods and zero reserved chips remain
+    — the drain-complete annotation is the "safe to power off"
+    signal. Idempotent: re-running resumes the poll; --uncordon
+    reverses everything. Exit 0 drained/uncordoned, 1 timed out, 2
+    the extender refused or is unreachable."""
+    action = "uncordon" if uncordon else "drain"
+    try:
+        st = _post_drain(url, node, action)
+    except (OSError, ValueError) as e:
+        print(f"tpu-doctor drain: {e}", file=sys.stderr)
+        return 2
+    if st.get("error"):
+        print(f"tpu-doctor drain: {st['error']}", file=sys.stderr)
+        return 2
+    if uncordon:
+        print(f"node {node} uncordoned: placement may use it again")
+        return 0
+    deadline = clock() + timeout_s
+    while True:
+        if st.get("error"):
+            print(
+                f"tpu-doctor drain: {st['error']}", file=sys.stderr
+            )
+            return 2
+        residents = st.get("resident_gangs") or []
+        print(
+            f"node {node}: draining={st.get('draining')} "
+            f"resident_gangs={len(residents)} "
+            f"held_chips={st.get('held_chips', 0)}"
+            + (f" [{', '.join(residents)}]" if residents else "")
+        )
+        if st.get("done"):
+            print(
+                f"node {node} drained: zero resident gang pods, "
+                f"zero reserved chips — safe for maintenance "
+                f"(annotation stamped; `tpu-doctor drain --uncordon "
+                f"{node}` to return it)"
+            )
+            return 0
+        if not wait:
+            return 1
+        if clock() >= deadline:
+            print(
+                f"tpu-doctor drain: node {node} still has "
+                f"{len(residents)} resident gang(s) / "
+                f"{st.get('held_chips', 0)} held chip(s) after "
+                f"{timeout_s:.0f}s — gangs may be parked "
+                f"RESCUE_PENDING (no healthy capacity to move "
+                f"them to); see /debug/rescue",
+                file=sys.stderr,
+            )
+            return 1
+        sleep(poll_s)
+        try:
+            st = _post_drain(url, node, "status")
+        except (OSError, ValueError) as e:
+            print(f"tpu-doctor drain: {e}", file=sys.stderr)
+            return 2
+
+
 def _load_audit(source: str) -> dict:
     """One source → its /debug/audit payload. ``source`` is a base URL
     (http…) or a file path / '-' for stdin (offline: a bundle's
@@ -919,6 +1007,36 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="only read segments written by this service "
         "(plugin/extender; default: all)",
     )
+    pd = sub.add_parser(
+        "drain",
+        help="evacuate every resident gang off a node via the "
+        "extender's rescue plane (cordon + maintenance taint, "
+        "journaled two-phase evacuations), poll until zero resident "
+        "pods and zero reserved chips, then report it safe for "
+        "maintenance; --uncordon reverses",
+    )
+    pd.add_argument("node", help="node name to drain")
+    pd.add_argument(
+        "--url", required=True,
+        help="extender base URL, e.g. http://extender:12346",
+    )
+    pd.add_argument(
+        "--uncordon", action="store_true",
+        help="reverse a drain: remove the cordon, taint, and "
+        "drain-complete annotation",
+    )
+    pd.add_argument(
+        "--no-wait", action="store_true",
+        help="start (or check) the drain and exit without polling",
+    )
+    pd.add_argument(
+        "--poll-s", type=float, default=5.0,
+        help="seconds between status polls (default 5)",
+    )
+    pd.add_argument(
+        "--timeout-s", type=float, default=1800.0,
+        help="give up polling after this many seconds (default 1800)",
+    )
     pf = sub.add_parser(
         "fleet",
         help="discover every extender shard (Leases) + plugin (node "
@@ -962,6 +1080,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not sources:
             pc.error("at least one --url or audit.json file is required")
         return check(sources)
+    if a.cmd == "drain":
+        return drain(
+            a.url, a.node,
+            uncordon=a.uncordon,
+            wait=not a.no_wait,
+            poll_s=a.poll_s,
+            timeout_s=a.timeout_s,
+        )
     if a.cmd == "postmortem":
         return postmortem(
             a.dir, minutes=a.minutes, service=a.service

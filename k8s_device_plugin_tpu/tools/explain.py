@@ -30,6 +30,11 @@ Four questions, four selectors:
   its ``defrag_victim`` selection records (the stranded requestor it
   moved FOR, target host, and the same frozen cost facts) joined with
   the requestor gang's ``defrag`` round records (extender/defrag.py).
+* ``--rescued Z`` — what a hardware failure did to the gang, both
+  roles in one view: its own ``rescue`` story (degraded → executed /
+  RESCUE_PENDING) and, if it was collateral, its ``rescue_victim``
+  selection records joined with the degraded requestor's ``rescue``
+  round records (extender/rescue.py).
 
     python -m k8s_device_plugin_tpu.tools.explain --pod my-pod \
         --url http://extender:12346
@@ -271,6 +276,75 @@ def render_migrated(records: List[dict], spans: List[dict],
     return out
 
 
+def render_rescued(records: List[dict], spans: List[dict],
+                   gang: str) -> List[str]:
+    """'What did the hardware failure do to me': both roles in one
+    view — the gang's own rescue story (``rescue`` records:
+    degraded → executed / pending) AND, if it was collateral, its
+    ``rescue_victim`` selection records joined with the degraded
+    requestor's round records. Chronological, traces beneath."""
+    own = [
+        r for r in records
+        if r.get("kind") == "rescue"
+        and _name_match(r.get("gang", ""), gang)
+    ]
+    victim = [
+        r for r in records
+        if r.get("kind") == "rescue_victim"
+        and _name_match(r.get("gang", ""), gang)
+    ]
+    if not own and not victim:
+        return [f"(no rescue records for gang {gang!r})"]
+    requestors = {
+        (r.get("attrs") or {}).get("requestor", "")
+        for r in victim
+        if (r.get("attrs") or {}).get("requestor")
+    }
+    rounds = [
+        r for r in records
+        if r.get("kind") == "rescue" and r.get("gang") in requestors
+    ]
+    if victim:
+        attrs = (sorted(victim, key=lambda r: r.get("ts", 0))[-1]
+                 .get("attrs") or {})
+        head = (
+            f"gang {gang}: evicted for the hardware rescue of "
+            f"{attrs.get('requestor', '?')} (victim tier "
+            f"{attrs.get('victim_tier', '?')}, rank "
+            f"{attrs.get('rank', '?')})"
+        )
+    else:
+        last = sorted(own, key=lambda r: r.get("ts", 0))[-1]
+        attrs = last.get("attrs") or {}
+        reason = last.get("reason", "?")
+        if reason == "executed":
+            head = (
+                f"gang {gang}: rescued off "
+                f"{attrs.get('hosts', '?')} onto "
+                f"{attrs.get('consumed', '?')}"
+            )
+            if attrs.get("latency_s") not in ("", None):
+                head += f" ({attrs['latency_s']}s after detection)"
+        elif reason == "pending":
+            head = (
+                f"gang {gang}: degraded but parked RESCUE_PENDING "
+                f"({attrs.get('cause', '?')}) — no healthy "
+                f"relocation target yet"
+            )
+        else:
+            head = f"gang {gang}: rescue in progress ({reason})"
+    chain = sorted(own + victim + rounds, key=lambda r: r.get("ts", 0))
+    out = [head, ""]
+    out += [_record_line(r) for r in chain]
+    traces = {r["trace_id"] for r in chain if r.get("trace_id")}
+    for tid in sorted(traces):
+        members = [s for s in spans if s["trace_id"] == tid]
+        if members:
+            out.append("")
+            out += render_trace_tree(members, trace_id=tid)
+    return out
+
+
 def render_node(records: List[dict], node: str) -> List[str]:
     mine = sorted(
         (r for r in records if r.get("node") == node),
@@ -419,6 +493,34 @@ def _self_test() -> Tuple[List[dict], List[dict]]:
             victims="default/batch", victim_count=1, freed_chips=2,
             total_restart_cost=12.0,
         )
+        # The rescue chain (extender/rescue.py kinds): the demo gang
+        # degraded by a chip failure, a batch victim evicted to make
+        # room, the evacuation executed — what the --rescued view
+        # renders for both roles.
+        led.record(
+            "rescue", "degraded",
+            "running gang default/demo is on degraded capacity: "
+            "node-a (chip_failed); rescue after 1 consecutive "
+            "tick(s)",
+            gang="default/demo", hosts=["node-a"], tier="high",
+        )
+        led.record(
+            "rescue_victim", "evicted",
+            "victim 1/1 evicted for the hardware rescue of "
+            "default/demo: priority -10, restart cost 12.0",
+            gang="default/batch", requestor="default/demo",
+            rank=1, victim_tier="batch", victim_priority=-10,
+            chips=4,
+        )
+        led.record(
+            "rescue", "executed",
+            "evacuated gang default/demo off ['node-a'] "
+            "(node-a:chip_failed) and fenced {'node-b': 4} for its "
+            "re-admission; evicted default/batch to make room",
+            gang="default/demo", hosts=["node-a"],
+            consumed={"node-b": 4}, victims="default/batch",
+            victim_count=1, tier="high", latency_s=0.5,
+        )
         return (
             led.snapshot()["records"],
             _flatten_otlp(collector.otlp_json()),
@@ -449,6 +551,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="victim gang name or namespace/name: why was this gang "
         "migrated by defragmentation (victim selection + the "
         "stranded requestor's round records)",
+    )
+    p.add_argument(
+        "--rescued", default="",
+        help="gang name or namespace/name: what a hardware failure "
+        "did to this gang — its own rescue story, or its selection "
+        "as a rescue victim plus the degraded requestor's rounds",
     )
     p.add_argument(
         "--url", default="",
@@ -511,10 +619,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"self-test failed: migrated view missing "
                   f"{mg_missing}", file=sys.stderr)
             return 1
+        # The rescued view, both roles over the same synthetic
+        # ledger: the rescued gang's evacuation story and the
+        # victim's selection for it must both render.
+        rs_text = "\n".join(render_rescued(records, spans, "demo"))
+        rv_text = "\n".join(render_rescued(records, spans, "batch"))
+        rs_needed = ("rescued off", "node-b", "0.5s after detection",
+                     "degraded")
+        rv_needed = ("evicted for the hardware rescue of "
+                     "default/demo", "rescue_victim", "rank 1")
+        rs_missing = [n for n in rs_needed if n not in rs_text]
+        rs_missing += [n for n in rv_needed if n not in rv_text]
+        if rs_missing:
+            print(f"self-test failed: rescued view missing "
+                  f"{rs_missing}", file=sys.stderr)
+            return 1
         return 0
-    if not (a.pod or a.gang or a.node or a.evicted or a.migrated):
+    if not (a.pod or a.gang or a.node or a.evicted or a.migrated
+            or a.rescued):
         p.error("one of --pod / --gang / --node / --evicted / "
-                "--migrated is required (or --self-test)")
+                "--migrated / --rescued is required (or --self-test)")
     if not (a.url or a.decisions):
         p.error("a source is required: --url and/or --decisions")
     try:
@@ -530,6 +654,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         lines = render_evicted(records, spans, a.evicted)
     elif a.migrated:
         lines = render_migrated(records, spans, a.migrated)
+    elif a.rescued:
+        lines = render_rescued(records, spans, a.rescued)
     else:
         lines = render_node(records, a.node)
     print("\n".join(lines))

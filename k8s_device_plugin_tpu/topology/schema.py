@@ -74,6 +74,13 @@ class NodeTopology:
     # only the static tree and leaves the extender integration as a TODO
     # (/root/reference/server.go:298-300).
     available: List[str] = dataclasses.field(default_factory=list)
+    # Chip ids withdrawn as UNHEALTHY (health/watcher.py): absent from
+    # ``available`` like allocated chips, but published separately so
+    # the extender's rescue plane can tell "a running pod holds this
+    # chip" from "this chip is dead under whoever holds it" — the
+    # detection join hardware rescue needs. Additive (older consumers
+    # ignore it; from_json filters to known fields).
+    failed: List[str] = dataclasses.field(default_factory=list)
     # Host NUMA detail from the native reader (tpuinfo_numa_topology) —
     # populates the CPU/memory part of the reference's schema that it
     # declared but never filled (/root/reference/device.go:19-97):
@@ -137,6 +144,7 @@ class NodeTopology:
         worker_hostnames: str = "",
         slice_host_bounds: str = "1,1,1",
         host_info: Optional[dict] = None,
+        failed: Optional[List[str]] = None,
     ) -> "NodeTopology":
         bounds = parse_bounds(slice_host_bounds)
         return NodeTopology(
@@ -150,6 +158,7 @@ class NodeTopology:
             available=sorted(available)
             if available is not None
             else sorted(mesh.ids),
+            failed=sorted(failed) if failed else [],
             numa=list(numa_info or []),
             host=dict(host_info or {}),
             slice_host_bounds=bounds,

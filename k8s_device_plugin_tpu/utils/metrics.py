@@ -304,6 +304,14 @@ LISTANDWATCH_SENDS = REGISTRY.counter(
 GRPC_ERRORS = REGISTRY.counter(
     "tpu_plugin_grpc_errors_total", "gRPC requests answered with an error"
 )
+PLUGIN_REREGISTRATIONS = REGISTRY.counter(
+    "tpu_plugin_reregistrations_total",
+    "Plugin re-serve + re-register cycles forced by a kubelet restart "
+    "(server/plugin.py watch loop), by trigger: kubelet_restart (the "
+    "kubelet's registration socket changed identity) or "
+    "plugin_socket_vanished (the kubelet wiped the device-plugins "
+    "dir, taking our serving socket with it)",
+)
 RPC_LATENCY = REGISTRY.histogram(
     "tpu_plugin_rpc_latency_seconds",
     "Wall latency of device-plugin gRPC handlers, by method",
@@ -734,6 +742,33 @@ SIM_BASELINE_DELTA = EXTENDER_REGISTRY.gauge(
     "nonzero means the scheduling policy decided differently than "
     "the baseline build; alert on the sign that hurts (see "
     "docs/observability.md, Scheduling quality)",
+)
+# Hardware-failure rescue plane (extender/rescue.py): gang evacuation
+# off withdrawn/failed capacity, node cordon/drain lifecycle.
+RESCUES = EXTENDER_REGISTRY.counter(
+    "tpu_extender_rescues_total",
+    "Hardware-rescue rounds for RUNNING gangs on degraded capacity, "
+    "by the rescued gang's tier and outcome (executed: the gang was "
+    "evacuated and a healthy target fenced under its key; pending: no "
+    "relocation target exists — the gang is parked RESCUE_PENDING and "
+    "its demand feeds the defrag plane, counted once per episode; "
+    "eviction_blocked: a victim or member eviction was PDB/apiserver-"
+    "refused and the round aborted for retry; recovered / "
+    "gang_vanished: an open round was closed by crash recovery)",
+)
+RESCUE_LATENCY = EXTENDER_REGISTRY.histogram(
+    "tpu_extender_rescue_latency_seconds",
+    "Seconds from first detecting a gang degraded (failed chip under "
+    "a bound pod, NotReady node, or drain) to its healthy target "
+    "being fenced — the time-to-rescue SLO; only executed rounds "
+    "observe",
+)
+NODE_CORDONED = EXTENDER_REGISTRY.gauge(
+    "tpu_node_cordoned",
+    "1 per node currently excluded from placement by the node "
+    "lifecycle plane (spec.unschedulable, the tpu.google.com/"
+    "maintenance taint, or NotReady), by node; placeable nodes prune "
+    "their series — sum() is the excluded-node count",
 )
 GANG_RESERVED = EXTENDER_REGISTRY.gauge(
     "tpu_gang_reservations",
@@ -1254,6 +1289,15 @@ DEBUG_ENDPOINTS: Dict[str, str] = {
         "state, and the last round's outcome — per engine (one per "
         "shard admitter); enabled: false when defrag is not wired"
     ),
+    "/debug/rescue": (
+        "hardware-failure rescue plane (extender/rescue.py): node "
+        "lifecycle state (cordon/taint/NotReady/draining), degraded "
+        "gangs with grace-window progress, parked RESCUE_PENDING "
+        "episodes, open two-phase rounds, shared eviction-budget "
+        "state, and the last round's outcome — per engine (one per "
+        "shard admitter); enabled: false when the rescue plane is "
+        "not wired"
+    ),
     "/debug/simreport": (
         "scheduling-quality simulator scorecards "
         "(extender/simulator.py): the last replay of each trace "
@@ -1367,6 +1411,10 @@ def debug_payload(path: str) -> Optional[bytes]:
             from ..extender import defrag
 
             return defrag.debug_snapshot()
+        if parsed.path == "/debug/rescue":
+            from ..extender import rescue
+
+            return rescue.debug_snapshot()
         if parsed.path == "/debug/simreport":
             from ..extender import simulator
 

@@ -140,6 +140,15 @@ python -m k8s_device_plugin_tpu.extender.simulator --self-test > /dev/null \
 # CI here, before the chaos SIGKILL e2e in tests/test_blackbox.py.
 python -m k8s_device_plugin_tpu.utils.blackbox --self-test > /dev/null \
   || { echo "utils/blackbox.py --self-test FAILED"; exit 1; }
+# Hardware-rescue plane smoke: a chip failure under a RUNNING gang's
+# bound pods must detect (degraded grace clock), evict a strictly-
+# lower-priority victim, re-fence the gang on healthy capacity
+# two-phase-journaled, and park RESCUE_PENDING when no target exists
+# (extender/rescue.py --self-test); a detection-join or journal-
+# protocol drift fails CI here, before the SIGKILL chaos e2e in
+# tests/test_rescue.py.
+python -m k8s_device_plugin_tpu.extender.rescue --self-test > /dev/null \
+  || { echo "extender/rescue.py --self-test FAILED"; exit 1; }
 # Repo lint gate: zero NEW findings (baseline'd exceptions carry
 # justifications in analysis/baseline.json) — an unsupervised thread,
 # an undocumented metric/kind/span/debug-endpoint, blocking work

@@ -273,6 +273,100 @@ class FakeApiServer:
                 pod["metadata"]["resourceVersion"] = self._next_rv()
                 self._broadcast("DELETED", pod)
 
+    # -- node-level failure injection (the rescue/chaos suites) -----------
+
+    def set_node_ready(self, name: str, ready: bool):
+        """Flip the node's Ready condition (NotReady injection) and
+        broadcast the MODIFIED event like a real kubelet lease expiry
+        would surface it."""
+        with self._lock:
+            node = self.nodes[name]
+            conditions = node.setdefault("status", {}).setdefault(
+                "conditions", []
+            )
+            cond = {
+                "type": "Ready",
+                "status": "True" if ready else "False",
+                "reason": "KubeletReady" if ready else "NodeStatusUnknown",
+            }
+            for existing in conditions:
+                if existing.get("type") == "Ready":
+                    existing.update(cond)
+                    break
+            else:
+                conditions.append(cond)
+            node["metadata"]["resourceVersion"] = self._next_rv()
+            self._broadcast_node("MODIFIED", node)
+
+    def set_node_unschedulable(self, name: str, unschedulable: bool):
+        """Cordon/uncordon injection from OUTSIDE the extender (an
+        operator's kubectl cordon racing the drain verb)."""
+        with self._lock:
+            node = self.nodes[name]
+            node.setdefault("spec", {})["unschedulable"] = bool(
+                unschedulable
+            )
+            node["metadata"]["resourceVersion"] = self._next_rv()
+            self._broadcast_node("MODIFIED", node)
+
+    def set_node_taint(
+        self,
+        name: str,
+        key: str,
+        value: str = "",
+        effect: str = "NoSchedule",
+        remove: bool = False,
+    ):
+        """Add/remove one taint by key (maintenance-taint injection)."""
+        with self._lock:
+            node = self.nodes[name]
+            spec = node.setdefault("spec", {})
+            taints = [
+                t for t in (spec.get("taints") or []) if t.get("key") != key
+            ]
+            if not remove:
+                taints.append(
+                    {"key": key, "value": value, "effect": effect}
+                )
+            spec["taints"] = taints
+            node["metadata"]["resourceVersion"] = self._next_rv()
+            self._broadcast_node("MODIFIED", node)
+
+    def fail_chips(
+        self,
+        name: str,
+        chips: List[str],
+        annotation: str = "google.com/tpu-topology",
+    ):
+        """Withdraw chips UNDER whatever holds them: rewrite the node's
+        topology annotation moving the ids out of ``available`` and
+        into ``failed`` — exactly what the node daemon's
+        TopologyPublisher republishes after health/watcher.py withdraws
+        a chip (wiring.py publish_now failed=state.unhealthy). Works
+        whether the chip was free or allocated to a placed pod (the
+        rescue plane's detection case)."""
+        with self._lock:
+            node = self.nodes[name]
+            ann = node.setdefault("metadata", {}).setdefault(
+                "annotations", {}
+            )
+            raw = ann.get(annotation)
+            if not raw:
+                raise KeyError(
+                    f"node {name} has no {annotation} annotation"
+                )
+            topo = json.loads(raw)
+            dead = set(chips)
+            topo["available"] = sorted(
+                c for c in topo.get("available", []) if c not in dead
+            )
+            topo["failed"] = sorted(
+                set(topo.get("failed", [])) | dead
+            )
+            ann[annotation] = json.dumps(topo, sort_keys=True)
+            node["metadata"]["resourceVersion"] = self._next_rv()
+            self._broadcast_node("MODIFIED", node)
+
     def add_priority_class(
         self, name: str, value: int, global_default: bool = False
     ):
@@ -1106,6 +1200,18 @@ class FakeApiServer:
             meta = body.get("metadata", {})
             self._merge_annotations(node["metadata"], meta, "annotations")
             self._merge_annotations(node["metadata"], meta, "labels")
+            # Node spec mutation (cordon/taint — the drain flow's
+            # patches): scalars merge, the taints list replaces
+            # wholesale (merge-patch semantics; the client's
+            # set_node_taint sends the whole edited list).
+            spec_patch = body.get("spec")
+            if isinstance(spec_patch, dict):
+                spec = node.setdefault("spec", {})
+                for k, v in spec_patch.items():
+                    if v is None:
+                        spec.pop(k, None)
+                    else:
+                        spec[k] = v
             node["metadata"]["resourceVersion"] = self._next_rv()
             self.node_patches.append((name, body))
             self._broadcast_node("MODIFIED", node)

@@ -61,6 +61,27 @@ class FakeKubelet(RegistrationServicer):
             self._server.stop(grace=0.2).wait()
             self._server = None
 
+    def restart(self, wipe_plugin_sockets: bool = True) -> None:
+        """Simulate a kubelet restart: tear the Registration server
+        down, wipe the device-plugins dir (the real kubelet clears
+        its plugin registry AND every plugin socket on startup), and
+        come back up on a fresh kubelet.sock (new inode). The
+        recorded registrations reset — a re-registering plugin is
+        observed via ``registered`` flipping again."""
+        self.stop()
+        if wipe_plugin_sockets:
+            for name in os.listdir(self.device_plugin_dir):
+                if name == constants.KUBELET_SOCKET_NAME:
+                    continue
+                path = os.path.join(self.device_plugin_dir, name)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        self.registrations = []
+        self.registered = threading.Event()
+        self.start()
+
     # Client side (kubelet → plugin) -----------------------------------------
 
     def plugin_channel(self, endpoint: str) -> grpc.Channel:

@@ -1,0 +1,562 @@
+"""Hardware-failure rescue plane (extender/rescue.py): detection
+(the health-withdrawal + node-lifecycle join, with hysteresis),
+execution (journaled two-phase evacuation that re-fences the degraded
+gang on proven healthy capacity, evicting only strictly-lower-priority
+victims under the shared budget), parking (RESCUE_PENDING when no
+target exists), the node drain verb end-to-end against the fake
+apiserver, and SIGKILL crash-consistency at the three rescue
+kill-points — mid-evacuation, between evict and re-fence, and
+mid-drain — each recovering exactly-once under a clean ExtenderAudit
+(including the new rescue_vs_health invariant).
+
+Cordon semantics are deliberately asymmetric and tested as such:
+``unschedulable`` (kubectl cordon) excludes a node from placement and
+both eviction planes' targeting but NEVER evacuates residents; only
+NotReady, the ``tpu.google.com/maintenance=drain`` taint, or a chip
+withdrawal under a bound pod does.
+"""
+
+import time
+
+import pytest
+
+from k8s_device_plugin_tpu import audit
+from k8s_device_plugin_tpu.api import constants
+from k8s_device_plugin_tpu.extender import journal as jr
+from k8s_device_plugin_tpu.extender.gang import GATE_NAME, GangAdmission
+from k8s_device_plugin_tpu.extender.preemption import (
+    PreemptionEngine,
+    PriorityResolver,
+)
+from k8s_device_plugin_tpu.extender.rescue import (
+    DrainCoordinator,
+    NodeStateTracker,
+    RescueEngine,
+)
+from k8s_device_plugin_tpu.extender.reservations import ReservationTable
+from k8s_device_plugin_tpu.kube.client import KubeClient
+from tests.fake_apiserver import FakeApiServer
+from tests.test_chaos_journal import KillPointClient, SigKill
+from tests.test_extender import make_node
+from tests.test_gang import gang_pod, gates_of
+from tests.test_preemption import running_gang_pod
+
+
+@pytest.fixture
+def api():
+    s = FakeApiServer()
+    url = s.start()
+    yield s, KubeClient(url)
+    s.stop()
+
+
+def build(client, tmp_path, tracker=None, **engine_kw):
+    """A journaled admission with the rescue plane wired the way
+    __main__.py wires it (preemption resolver shared, grace 1 for
+    one-tick tests)."""
+    table = ReservationTable()
+    journal = jr.AdmissionJournal(str(tmp_path / "journal"))
+    table.observer = journal.observe
+    adm = GangAdmission(
+        client, reservations=table, journal=journal,
+    )
+    resolver = PriorityResolver(client)
+    adm.priority_resolver = resolver
+    adm.preemption = PreemptionEngine(adm, resolver, post_events=False)
+    engine_kw.setdefault("grace_ticks", 1)
+    engine_kw.setdefault("post_events", False)
+    engine = RescueEngine(adm, resolver, tracker=tracker, **engine_kw)
+    adm.rescue = engine
+    return adm, table, engine
+
+
+def two_node_cluster(server, victim_priority=-10):
+    """train (prio 0, 2 pods x 2 chips) fills n1; a cheap victim gang
+    fills n2. Any rescue of train must go through n2's resident."""
+    n1, mesh1 = make_node("n1", n=4, available=[])
+    n2, mesh2 = make_node("n2", n=4, available=[])
+    server.add_node("n1", n1)
+    server.add_node("n2", n2)
+    now = time.time()
+    for i in range(2):
+        server.add_pod(running_gang_pod(
+            f"train-w{i}", "train", 2, 2, "n1", priority=0,
+        ))
+    for i in range(2):
+        server.add_pod(running_gang_pod(
+            f"batch-w{i}", "batch", 2, 2, "n2",
+            priority=victim_priority, ckpt_ts=now - 5,
+        ))
+    return (n1, mesh1), (n2, mesh2)
+
+
+def audit_clean(adm, table):
+    eng = audit.ExtenderAudit(
+        reservations=table, journal=adm.journal, gang=adm
+    ).engine()
+    findings = eng.sweep_once()
+    crit = [f for f in findings if f.severity == audit.CRITICAL]
+    assert crit == [], crit
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# detection + execution
+# ---------------------------------------------------------------------------
+
+def test_chip_withdrawal_rescues_through_lower_priority_victim(
+    api, tmp_path
+):
+    """The tentpole e2e: a chip withdrawn under running train (the
+    health watcher's failed-list republished by the node daemon) is
+    detected by the count-granularity join, the strictly-lower
+    priority resident of the only healthy node is evicted, train's
+    own pods are evacuated, the freed box is fenced under train's
+    key, and the gated replacements release against the standing hold
+    without a fresh capacity check."""
+    server, client = api
+    (_n1, mesh1), _ = two_node_cluster(server)
+    adm, table, engine = build(client, tmp_path)
+
+    # Healthy tick: nothing happens.
+    assert adm.tick() == []
+    assert server.evictions == []
+    assert engine.degraded_state() == {}
+
+    server.fail_chips("n1", [mesh1.ids[0]])
+    assert adm.tick() == []
+    # All four resident pods left through the eviction door: 2 batch
+    # victims + train's own 2 (the evacuation).
+    assert len(server.evictions) == 4
+    hold = table.active()[("default", "train")]
+    assert hold.hosts == {"n2": 4}
+    assert hold.priority == 0
+    assert engine.open_intents() == {}
+    assert engine.last_outcome == "executed"
+    audit_clean(adm, table)
+
+    # The controller recreates train's members gated; they release
+    # against the standing fence, head of tier.
+    for i in range(2):
+        server.add_pod(gang_pod(f"train-r{i}", "train", 2, 2))
+    released = adm.tick()
+    assert released == [("default", "train")]
+    assert GATE_NAME not in gates_of(server, "default", "train-r0")
+    assert table.reserved_chips("n2") == 4
+    audit_clean(adm, table)
+    adm.journal.close()
+
+
+def test_rescue_never_evicts_equal_or_higher_priority(api, tmp_path):
+    """Priority order is strict: if the only possible victim is the
+    same tier, the rescue parks RESCUE_PENDING instead of evicting —
+    the plane never trades one healthy equal-priority job for a
+    degraded one."""
+    server, client = api
+    (_n1, mesh1), _ = two_node_cluster(server, victim_priority=0)
+    adm, table, engine = build(client, tmp_path)
+
+    server.fail_chips("n1", [mesh1.ids[0]])
+    assert adm.tick() == []
+    assert server.evictions == []
+    assert table.active() == {}
+    assert ("default", "train") in engine.pending_state()
+    assert engine.tracked(("default", "train"))
+    audit_clean(adm, table)
+    adm.journal.close()
+
+
+def test_cordon_excludes_placement_but_never_evacuates(api, tmp_path):
+    """kubectl-cordon semantics: unschedulable removes the node from
+    admission targeting (a gated gang cannot land there) but running
+    residents stay untouched through any number of ticks."""
+    server, client = api
+    n1, _mesh = make_node("n1", n=4, available=[])
+    n2, _ = make_node("n2", n=4)
+    server.add_node("n1", n1)
+    server.add_node("n2", n2)
+    for i in range(2):
+        server.add_pod(running_gang_pod(
+            f"train-w{i}", "train", 2, 2, "n1", priority=0,
+        ))
+    tracker = NodeStateTracker()
+    adm, table, engine = build(client, tmp_path, tracker=tracker)
+
+    server.set_node_unschedulable("n2", True)
+    tracker.update_node(server.nodes["n2"])
+    assert not tracker.placeable("n2")
+    assert not tracker.evacuate("n2")
+
+    # A gated gang that would need n2 stays gated while cordoned.
+    server.add_pod(gang_pod("queued-w0", "queued", 1, 4))
+    for _ in range(3):
+        assert adm.tick() == []
+    assert server.evictions == []           # nobody was evacuated
+    assert engine.degraded_state() == {}    # cordon is not degraded
+    assert GATE_NAME in gates_of(server, "default", "queued-w0")
+
+    # Uncordon: placement may use it again.
+    server.set_node_unschedulable("n2", False)
+    tracker.update_node(server.nodes["n2"])
+    released = adm.tick()
+    assert released == [("default", "queued")]
+    audit_clean(adm, table)
+    adm.journal.close()
+
+
+def test_notready_node_evacuates_residents(api, tmp_path):
+    """Node-lost detection: a NotReady node's resident gang is
+    rescued onto free healthy capacity — no victims needed."""
+    server, client = api
+    n1, _ = make_node("n1", n=4, available=[])
+    n2, _ = make_node("n2", n=4)
+    server.add_node("n1", n1)
+    server.add_node("n2", n2)
+    for i in range(2):
+        server.add_pod(running_gang_pod(
+            f"train-w{i}", "train", 2, 2, "n1", priority=0,
+        ))
+    tracker = NodeStateTracker()
+    adm, table, engine = build(client, tmp_path, tracker=tracker)
+    assert adm.tick() == []
+
+    server.set_node_ready("n1", False)
+    tracker.update_node(server.nodes["n1"])
+    assert tracker.evacuate("n1")
+    assert adm.tick() == []
+    assert len(server.evictions) == 2  # train's own pods only
+    assert table.active()[("default", "train")].hosts == {"n2": 4}
+    audit_clean(adm, table)
+    adm.journal.close()
+
+
+def test_budget_exhaustion_parks_rescue_pending(api, tmp_path):
+    """A rescue whose victim eviction would blow the rolling budget
+    parks RESCUE_PENDING (first-class stranded demand) instead of
+    half-evicting; the episode is tracked, so rescue_vs_health stays
+    quiet."""
+    server, client = api
+    (_n1, mesh1), _ = two_node_cluster(server)
+    adm, table, engine = build(
+        client, tmp_path, max_evictions_per_hour=1,
+    )
+    server.fail_chips("n1", [mesh1.ids[0]])
+    assert adm.tick() == []
+    assert server.evictions == []
+    assert table.active() == {}
+    pending = engine.pending_state()
+    assert pending[("default", "train")]["reason"] == "budget_exhausted"
+    assert engine.last_outcome == "pending"
+    findings = audit_clean(adm, table)
+    assert [
+        f for f in findings if f.invariant == "rescue_vs_health"
+    ] == []
+    adm.journal.close()
+
+
+def test_rescue_vs_health_invariant_fires_on_lost_episode(
+    api, tmp_path
+):
+    """The liveness contract: a degraded episode strictly past the
+    grace window that the engine is NOT moving (no open round, no
+    parking, no completed rescue) is a CRITICAL finding — a job
+    silently burning on dead hardware."""
+    server, client = api
+    two_node_cluster(server)
+    adm, table, engine = build(client, tmp_path)
+    key = ("default", "train")
+    with engine._lock:
+        engine._degraded[key] = {
+            "hosts": {"n1": "chip_failed"}, "ticks": 5, "since": 0.0,
+        }
+    eng = audit.ExtenderAudit(
+        reservations=table, journal=adm.journal, gang=adm
+    ).engine()
+    crit = [
+        f for f in eng.sweep_once()
+        if f.invariant == "rescue_vs_health"
+        and f.severity == audit.CRITICAL
+    ]
+    assert len(crit) == 1
+    assert "burning on failed hardware" in crit[0].message
+    # Parking the episode clears it: tracked episodes are healthy.
+    with engine._lock:
+        engine._pending[key] = {"since": 0.0, "reason": "no_target"}
+    assert [
+        f for f in eng.sweep_once()
+        if f.invariant == "rescue_vs_health"
+    ] == []
+    adm.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL kill-points (the chaos acceptance: each recovers exactly-once)
+# ---------------------------------------------------------------------------
+
+def test_sigkill_mid_evacuation_aborts_then_rescues_once(
+    api, tmp_path
+):
+    """Kill-point A: after rescue_intent, mid-victim-eviction (one of
+    two victim pods evicted). Recovery aborts the intent — nothing
+    was fenced, train is still running degraded — and the next tick
+    re-plans from cluster truth, evicting each remaining pod exactly
+    once."""
+    server, client = api
+    (_n1, mesh1), _ = two_node_cluster(server)
+    server.fail_chips("n1", [mesh1.ids[0]])
+
+    kp = KillPointClient(client, "evict_pod", calls_before_kill=1)
+    adm1, table1, _eng1 = build(kp, tmp_path)
+    with pytest.raises(SigKill):
+        adm1.tick()
+    assert len(server.evictions) == 1
+    assert table1.active() == {}
+
+    adm2, table2, eng2 = build(client, tmp_path)
+    summary = adm2.recover()
+    assert summary["rescue_aborted"] == 1
+    assert summary["rescue_refenced"] == 0
+    assert table2.active() == {}
+
+    # The node daemon frees the dead victim pod's 2 chips and
+    # republishes n2 — the retry's relocation proof needs them.
+    n2_fresh, mesh2 = make_node("n2", n=4)
+    n2_fresh, _ = make_node("n2", n=4, available=mesh2.ids[:2])
+    server.add_node("n2", n2_fresh)
+
+    # Retry: the remaining victim pod + train's own 2 leave exactly
+    # once each (4 total door transits, not a re-evict storm).
+    assert adm2.tick() == []
+    assert len(server.evictions) == 4
+    assert len(set(server.evictions)) == 4
+    assert table2.active()[("default", "train")].hosts == {"n2": 4}
+    audit_clean(adm2, table2)
+    adm2.journal.close()
+
+
+def test_sigkill_between_evict_and_refence_restores_fence(
+    api, tmp_path
+):
+    """Kill-point B: after rescue_evicted, before the reserve — the
+    gang's own pods are already gone, which for every OTHER protocol
+    means 'gang vanished, abort'. Rescue's evicted phase survives the
+    vanish: recovery re-installs the fence from the journaled plan,
+    the shield keeps the pod-less hold alive, and the controller's
+    gated replacements release against it."""
+    server, client = api
+    two_node_cluster(server)
+    (_n1, mesh1) = (server.nodes["n1"], None)
+    server.fail_chips("n1", ["0-0-0"])
+
+    adm1, table1, _eng1 = build(client, tmp_path)
+
+    def die_on_reserve(*a, **kw):
+        raise SigKill("between rescue_evicted and reserve")
+
+    table1.reserve = die_on_reserve
+    with pytest.raises(SigKill):
+        adm1.tick()
+    # Everything was evicted before the kill: 2 victims + 2 own.
+    assert len(server.evictions) == 4
+
+    adm2, table2, eng2 = build(client, tmp_path)
+    summary = adm2.recover()
+    assert summary["rescue_refenced"] == 1
+    assert summary["rescue_aborted"] == 0
+    hold = table2.active()[("default", "train")]
+    assert hold.hosts == {"n2": 4}
+    assert hold.priority == 0
+    # The recovery armed the shield: a tick with no train pods in the
+    # cluster must NOT garbage-collect the re-installed fence.
+    assert eng2.shield(("default", "train"))
+    assert adm2.tick() == []
+    assert ("default", "train") in table2.active()
+
+    # Replacements release against the standing hold, exactly the
+    # no-crash path.
+    for i in range(2):
+        server.add_pod(gang_pod(f"train-r{i}", "train", 2, 2))
+    released = adm2.tick()
+    assert released == [("default", "train")]
+    assert GATE_NAME not in gates_of(server, "default", "train-r0")
+    assert table2.reserved_chips("n2") == 4
+    assert len(server.evictions) == 4  # recovery re-evicted nothing
+    audit_clean(adm2, table2)
+    adm2.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# node drain (the lifecycle verb)
+# ---------------------------------------------------------------------------
+
+def drain_setup(server, client, tmp_path):
+    n1, _ = make_node("n1", n=4, available=[])
+    n2, _ = make_node("n2", n=4)
+    server.add_node("n1", n1)
+    server.add_node("n2", n2)
+    for i in range(2):
+        server.add_pod(running_gang_pod(
+            f"train-w{i}", "train", 2, 2, "n1", priority=0,
+        ))
+    tracker = NodeStateTracker()
+    adm, table, engine = build(client, tmp_path, tracker=tracker)
+    coord = DrainCoordinator(client, adm, tracker)
+    engine.drain_coordinator = coord
+    return adm, table, engine, tracker, coord
+
+
+def test_drain_end_to_end(api, tmp_path):
+    """tpu-drain n1: cordon + maintenance taint persist in the
+    apiserver, the resident gang is rescued off under the normal
+    journal, the node ends with zero held chips and the
+    drain-complete stamp, placement refuses it until uncordon."""
+    server, client = api
+    adm, table, engine, tracker, coord = drain_setup(
+        server, client, tmp_path
+    )
+    assert adm.tick() == []
+
+    st = coord.drain("n1")
+    assert st["draining"] is True
+    node = server.nodes["n1"]
+    assert node["spec"]["unschedulable"] is True
+    taints = {t["key"]: t for t in node["spec"]["taints"]}
+    assert taints[constants.MAINTENANCE_TAINT]["value"] == (
+        constants.DRAIN_TAINT_VALUE
+    )
+    assert tracker.draining("n1")
+
+    # The tick evacuates the resident; replacements land on n2.
+    assert adm.tick() == []
+    assert len(server.evictions) == 2
+    assert table.active()[("default", "train")].hosts == {"n2": 4}
+    for i in range(2):
+        server.add_pod(gang_pod(f"train-r{i}", "train", 2, 2))
+    assert adm.tick() == [("default", "train")]
+
+    st = coord.status("n1")
+    assert st["resident_pods"] == 0
+    assert st["held_chips"] == 0
+    assert st["done"] is True
+    ann = server.nodes["n1"]["metadata"]["annotations"]
+    assert constants.DRAIN_COMPLETE_ANNOTATION in ann
+
+    # Placement refuses the drained node: a gated 4-chip gang has
+    # nowhere to go (n2 is now full) and stays gated.
+    server.add_pod(gang_pod("queued-w0", "queued", 1, 4))
+    assert adm.tick() == []
+    assert GATE_NAME in gates_of(server, "default", "queued-w0")
+
+    # Uncordon: taint + cordon + stamp removed, placement resumes.
+    coord.uncordon("n1")
+    node = server.nodes["n1"]
+    assert not node["spec"].get("unschedulable")
+    assert all(
+        t["key"] != constants.MAINTENANCE_TAINT
+        for t in node["spec"].get("taints", [])
+    )
+    assert constants.DRAIN_COMPLETE_ANNOTATION not in (
+        server.nodes["n1"]["metadata"]["annotations"]
+    )
+    # The node daemon republishes n1's freed chips post-maintenance;
+    # the queued gang admits onto the returned capacity.
+    n1_fresh, _ = make_node("n1", n=4)
+    server.add_node("n1", n1_fresh)
+    assert adm.tick() == [("default", "queued")]
+    audit_clean(adm, table)
+    adm.journal.close()
+
+
+def test_sigkill_mid_drain_resumes_from_cluster_truth(api, tmp_path):
+    """Kill-point C: SIGKILL mid-drain (cordon + taint landed, the
+    evacuation died on its first eviction). There is no drain journal
+    on purpose — the cordon and taint ARE the durable intent. A fresh
+    incarnation rebuilds the tracker from the node object and resumes
+    the evacuation exactly-once to completion."""
+    server, client = api
+    kp = KillPointClient(client, "evict_pod", calls_before_kill=0)
+    adm1, table1, engine1, tracker1, coord1 = drain_setup(
+        server, client, tmp_path
+    )
+    adm1.client = kp
+    coord1.drain("n1")
+    with pytest.raises(SigKill):
+        adm1.tick()
+    assert server.evictions == []
+
+    # Fresh incarnation: tracker fed from the apiserver's node object
+    # (the watch/relist tap) — the drain intent survived the crash.
+    tracker2 = NodeStateTracker()
+    tracker2.update_node(client.get_node("n1"))
+    assert tracker2.draining("n1")
+    adm2, table2, engine2 = build(client, tmp_path, tracker=tracker2)
+    coord2 = DrainCoordinator(client, adm2, tracker2)
+    summary = adm2.recover()
+    assert summary["rescue_aborted"] + summary["rescue_refenced"] <= 1
+
+    assert adm2.tick() == []
+    assert len(server.evictions) == 2
+    assert len(set(server.evictions)) == 2
+    assert table2.active()[("default", "train")].hosts == {"n2": 4}
+    for i in range(2):
+        server.add_pod(gang_pod(f"train-r{i}", "train", 2, 2))
+    assert adm2.tick() == [("default", "train")]
+    st = coord2.status("n1")
+    assert st["done"] is True and st["held_chips"] == 0
+    audit_clean(adm2, table2)
+    adm2.journal.close()
+
+
+def test_drain_http_verb_and_doctor_driver(api, tmp_path):
+    """The /drain wire protocol doctor's `tpu-drain` speaks: 404 with
+    no handler, 400 on a missing node, and the coordinator's status
+    dict round-trips; tools/doctor.py polls it to completion."""
+    import requests as rq
+
+    from k8s_device_plugin_tpu.extender.server import ExtenderHTTPServer
+    from k8s_device_plugin_tpu.tools import doctor
+
+    server, client = api
+    adm, table, engine, tracker, coord = drain_setup(
+        server, client, tmp_path
+    )
+
+    srv = ExtenderHTTPServer(host="127.0.0.1")
+    base = srv.start()
+    try:
+        # No handler wired: the verb does not exist.
+        r = rq.post(f"{base}/drain", json={"node": "n1"}, timeout=5)
+        assert r.status_code == 404
+
+        def drain_verb(node, action):
+            if action == "drain":
+                return coord.drain(node)
+            if action == "uncordon":
+                return coord.uncordon(node)
+            return coord.status(node)
+
+        srv.drain_handler = drain_verb
+        r = rq.post(f"{base}/drain", json={}, timeout=5)
+        assert r.status_code == 400
+        r = rq.post(
+            f"{base}/drain",
+            json={"node": "n1", "action": "drain"}, timeout=5,
+        )
+        assert r.status_code == 200
+        assert r.json()["draining"] is True
+
+        # Evacuate + readmit, then the doctor driver sees completion
+        # and exits 0 (its poll loop re-POSTs "status").
+        adm.tick()
+        for i in range(2):
+            server.add_pod(gang_pod(f"train-r{i}", "train", 2, 2))
+        adm.tick()
+        rc = doctor.drain(base, "n1", wait=True, poll_s=0.0,
+                          timeout_s=5.0)
+        assert rc == 0
+        rc = doctor.drain(base, "n1", uncordon=True, wait=False)
+        assert rc == 0
+        assert not server.nodes["n1"]["spec"].get("unschedulable")
+    finally:
+        srv.stop()
+    adm.journal.close()

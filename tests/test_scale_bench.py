@@ -701,6 +701,36 @@ def test_scheduling_quality_probe_bound_and_schema():
             f"< 0.5 floor"
         )
 
+    # chip_failure_rescue: a chip withdrawn under a running gang is
+    # rescued (evacuated + re-fenced) within a couple of ticks, the
+    # second failure with no healthy target parks RESCUE_PENDING
+    # instead of silently burning, and the work-lost score prices the
+    # hardware, not the policy. Measured: one rescue at 10 virtual
+    # seconds (1 tick), 30 s is the tripwire.
+    resc = r["traces"]["chip_failure_rescue"]["rescue"]
+    if resc["gangs_rescued"] < 1:
+        problems.append("chip_failure_rescue: no gang was rescued")
+    ttr = resc["time_to_rescue_s"]["p50_s"]
+    if not 0 < ttr <= 30.0:
+        problems.append(
+            f"chip_failure_rescue time-to-rescue p50 {ttr}s outside "
+            f"(0, 30] — detection or re-admission regressed"
+        )
+    if resc["pending_gang_ticks"] <= 0:
+        problems.append(
+            "chip_failure_rescue: the targetless failure never "
+            "parked RESCUE_PENDING"
+        )
+    lost = r["traces"]["chip_failure_rescue"]["score"][
+        "work_lost_to_hardware_cost"
+    ]
+    if lost <= 0:
+        problems.append(
+            "chip_failure_rescue paid no hardware restart cost — "
+            "the evacuation was free, so the score is not pricing "
+            "the failure"
+        )
+
     # Golden gate: a replay of the committed traces on the committed
     # code matches the committed baseline exactly.
     for name, deltas in r["deltas"].items():

@@ -401,3 +401,89 @@ def test_unknown_registration_mode_rejected(tmp_path, dp_dir):
     with pytest.raises(ValueError):
         p.serve()
     p.stop()
+
+
+# ---------------------------------------------------------------------------
+# Kubelet-restart re-registration (start_restart_watch)
+# ---------------------------------------------------------------------------
+
+
+def test_kubelet_restart_triggers_reregistration(tmp_path, dp_dir, kubelet):
+    """A kubelet restart wipes /var/lib/kubelet/device-plugins/ and
+    comes back with an empty registry; the restart watcher must notice
+    (our socket vanished, kubelet.sock changed inode) and re-run the
+    serve+register cycle without losing placement state."""
+    from k8s_device_plugin_tpu.utils import metrics
+
+    p = make_plugin(tmp_path, dp_dir)
+    p.serve()
+    try:
+        assert kubelet.registered.wait(timeout=5)
+        first = kubelet.registrations[-1]
+        base = metrics.PLUGIN_REREGISTRATIONS.get(
+            trigger="plugin_socket_vanished"
+        )
+
+        p.start_restart_watch(interval_s=0.1)
+        p.start_restart_watch(interval_s=0.1)  # idempotent, no 2nd thread
+
+        kubelet.restart()  # wipes plugin sockets + fresh kubelet.sock
+        assert kubelet.registered.wait(timeout=10), (
+            "plugin never re-registered after kubelet restart"
+        )
+        again = kubelet.registrations[-1]
+        assert again.resource_name == first.resource_name
+        assert again.endpoint == constants.PLUGIN_SOCKET_NAME
+        # The wiped plugin socket is the first signal the poll loop
+        # checks, so that's the trigger attribution we expect.
+        deadline = 50
+        while (
+            metrics.PLUGIN_REREGISTRATIONS.get(
+                trigger="plugin_socket_vanished"
+            ) <= base
+            and deadline > 0
+        ):
+            threading.Event().wait(0.1)
+            deadline -= 1
+        assert metrics.PLUGIN_REREGISTRATIONS.get(
+            trigger="plugin_socket_vanished"
+        ) > base
+        # Device state survived the re-serve: the fresh ListAndWatch
+        # the kubelet would open still sees every chip.
+        assert os.path.exists(os.path.join(
+            dp_dir, constants.PLUGIN_SOCKET_NAME
+        ))
+    finally:
+        p.stop()
+
+
+def test_kubelet_inode_change_alone_triggers_reregistration(
+    tmp_path, dp_dir, kubelet
+):
+    """A kubelet restart that somehow preserves the plugin dir (e.g.
+    a fast supervisor bounce) is still detected via the kubelet.sock
+    inode changing identity."""
+    from k8s_device_plugin_tpu.utils import metrics
+
+    p = make_plugin(tmp_path, dp_dir)
+    p.serve()
+    try:
+        assert kubelet.registered.wait(timeout=5)
+        base = metrics.PLUGIN_REREGISTRATIONS.get(trigger="kubelet_restart")
+        p.start_restart_watch(interval_s=0.1)
+        kubelet.restart(wipe_plugin_sockets=False)
+        assert kubelet.registered.wait(timeout=10)
+        deadline = 50
+        while (
+            metrics.PLUGIN_REREGISTRATIONS.get(trigger="kubelet_restart")
+            <= base
+            and deadline > 0
+        ):
+            threading.Event().wait(0.1)
+            deadline -= 1
+        assert (
+            metrics.PLUGIN_REREGISTRATIONS.get(trigger="kubelet_restart")
+            > base
+        )
+    finally:
+        p.stop()
